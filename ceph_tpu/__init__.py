@@ -1,0 +1,26 @@
+"""ceph_tpu — a TPU-native distributed-storage framework with the capabilities of Ceph.
+
+Reference: sdpeters/ceph (nautilus-era), studied structurally in SURVEY.md. This is a
+from-scratch, TPU-first design (JAX/XLA/Pallas for the numeric data path, Python/C++ for
+the runtime shell), not a port.
+
+Subpackages
+-----------
+gf        GF(2^8) algebra: tables, matrix generators, inversion (numpy oracle).
+ops       JAX/Pallas device kernels: batched erasure encode/decode, rjenkins hash,
+          crush_ln, straw2 selection.
+ec        Erasure-code plugin framework mirroring the reference contract
+          (src/erasure-code/ErasureCodeInterface.h:170-462): profiles, registry,
+          chunk/stripe math, TPU + CPU-oracle plugins.
+crush     CRUSH placement: map model, exact scalar oracle (crush/mapper.c semantics),
+          batched JAX mapper for bulk PG remaps.
+"""
+
+# CRUSH straw2 fixed-point math needs 64-bit integers (crush/mapper.c uses __s64/__u64
+# throughout); enable x64 before any jax array is created.  All kernels in this package
+# use explicit dtypes, so the global default-dtype change is inert for them.
+from jax import config as _jax_config
+
+_jax_config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

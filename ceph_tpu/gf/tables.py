@@ -1,0 +1,134 @@
+"""GF(2^8) table construction.
+
+The field is GF(2)[x]/(x^8 + x^4 + x^3 + x^2 + 1), i.e. reduction polynomial 0x11d,
+with generator 2 — the same field the reference's EC plugins compute in (ISA-L ec_base /
+gf-complete w=8; see SURVEY.md §2.1).  Tables are built once at import from first
+principles (repeated multiplication by the generator), not copied from anywhere.
+
+Two table families:
+
+* exp/log and the dense 256x256 product table ``mul_table()`` — used by the numpy
+  oracle plugin and by tests as the ground truth.
+* ``nibble_bit_table(coeff)`` — the TPU-kernel operand.  GF(2^8) multiplication by a
+  constant c is GF(2)-linear in the bits of the input byte, so a whole (m x k) coding
+  matrix can be flattened into one 0/1 matrix W with shape (k*32, m*8):  row index
+  enumerates (data-chunk j, nibble-half p, nibble-value n), column index enumerates
+  (parity-chunk i, output-bit r).  Encoding then is `one_hot(nibbles(data)) @ W mod 2`
+  — a plain matrix multiply that maps straight onto the TPU MXU.  This plays the role
+  ISA-L's ``ec_init_tables`` expanded-table form plays for PSHUFB
+  (reference: src/erasure-code/isa/ErasureCodeIsa.cc:118-130).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+GF_POLY = 0x11D
+GF_ORDER = 256
+
+
+@functools.lru_cache(maxsize=None)
+def _exp_log() -> tuple[np.ndarray, np.ndarray]:
+    exp = np.zeros(512, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= GF_POLY
+    # periodic extension so gf_mul can index log[a]+log[b] without a modulo
+    exp[255:510] = exp[0:255]
+    log[0] = -1  # log of zero is undefined; callers must special-case
+    return exp, log
+
+
+def gf_exp() -> np.ndarray:
+    """exp table (length 512, periodically extended)."""
+    return _exp_log()[0].copy()
+
+
+def gf_log() -> np.ndarray:
+    """log table (length 256; log[0] = -1 sentinel)."""
+    return _exp_log()[1].copy()
+
+
+def gf_mul(a: int, b: int) -> int:
+    exp, log = _exp_log()
+    if a == 0 or b == 0:
+        return 0
+    return int(exp[log[a] + log[b]])
+
+
+def gf_div(a: int, b: int) -> int:
+    if b == 0:
+        raise ZeroDivisionError("GF(2^8) division by zero")
+    if a == 0:
+        return 0
+    exp, log = _exp_log()
+    return int(exp[(log[a] - log[b]) % 255])
+
+
+def gf_inv(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("GF(2^8) inverse of zero")
+    exp, log = _exp_log()
+    return int(exp[255 - log[a]])
+
+
+def gf_pow(a: int, n: int) -> int:
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    exp, log = _exp_log()
+    return int(exp[(int(log[a]) * (n % 255)) % 255])
+
+
+@functools.lru_cache(maxsize=None)
+def _mul_table() -> np.ndarray:
+    exp, log = _exp_log()
+    a = np.arange(256)
+    la = log[a]
+    t = exp[np.add.outer(la, la)]
+    t[0, :] = 0
+    t[:, 0] = 0
+    t = t.astype(np.uint8)
+    t.flags.writeable = False
+    return t
+
+
+def mul_table() -> np.ndarray:
+    """Dense 256x256 product table M[a, b] = a*b in GF(2^8).  64 KiB, read-only."""
+    return _mul_table()
+
+
+def nibble_bit_table(coeff: np.ndarray) -> np.ndarray:
+    """Flatten a GF(2^8) coding matrix into the MXU bit-table operand.
+
+    Parameters
+    ----------
+    coeff : (m, k) uint8 — coding matrix (parity i = sum_j coeff[i, j] * data[j]).
+
+    Returns
+    -------
+    W : (k*32, m*8) uint8 with 0/1 entries.
+        Row (j*32 + p*16 + n)   — data chunk j, nibble half p (0=low, 1=high), value n.
+        Col (i*8 + r)           — parity chunk i, output bit r.
+        W[row, col] = bit r of coeff[i, j] * (n << 4p).
+
+    Because a data byte contributes exactly one low-nibble row and one high-nibble row,
+    `one_hot @ W` accumulates at most 2k ones per output — exactly representable in
+    bf16/int8 accumulation, and `& 1` of the integer sum is the GF(2) (XOR) result.
+    """
+    coeff = np.asarray(coeff, dtype=np.uint8)
+    m, k = coeff.shape
+    mt = _mul_table()
+    # products[j, p, n, i] = coeff[i, j] * (n << 4p)
+    nib_vals = np.stack([np.arange(16), np.arange(16) << 4])  # (2, 16)
+    prods = mt[coeff.T[:, None, None, :], nib_vals[None, :, :, None]]  # (k, 2, 16, m)
+    bits = (prods[..., None] >> np.arange(8)) & 1  # (k, 2, 16, m, 8)
+    return bits.reshape(k * 32, m * 8).astype(np.uint8)

@@ -1,0 +1,13 @@
+"""Device kernels (JAX/XLA, with Pallas variants for the hot paths).
+
+gf_kernel       batched GF(2^8) matrix-vector products: erasure encode/decode.
+crush_kernel    rjenkins1 hashes, crush_ln, straw2 selection — batched over inputs.
+"""
+
+from .gf_kernel import (
+    ec_encode_ref,
+    ec_encode_jax,
+    make_encoder,
+)
+
+__all__ = ["ec_encode_ref", "ec_encode_jax", "make_encoder"]

@@ -1,0 +1,17 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding (pjit/shard_map over a
+jax.sharding.Mesh) is exercised without TPU hardware — the same mechanism the driver's
+dryrun uses.  This must be configured before jax initializes its backends.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import ceph_tpu  # noqa: E402,F401  (enables x64 before tests create arrays)
