@@ -1,0 +1,138 @@
+"""rjenkins1 32-bit hash family — the only hash CRUSH uses.
+
+Semantics match src/crush/hash.c exactly: Robert Jenkins' 1997 96-bit mix applied to
+fixed seeds (crush_hash_seed = 1315423911, x = 231232, y = 1232) in arity-specific
+schedules (hash.c:26-90).  Scalar variants operate on Python ints (the oracle); the
+``_vec`` variants are numpy uint32 and broadcast elementwise; the jax variants live in
+ops.crush_kernel and are validated against these.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+CRUSH_HASH_RJENKINS1 = 0
+CRUSH_HASH_SEED = 1315423911
+
+_M32 = 0xFFFFFFFF
+
+
+def _mix(a: int, b: int, c: int) -> tuple[int, int, int]:
+    a = (a - b - c) & _M32; a ^= c >> 13
+    b = (b - c - a) & _M32; b ^= (a << 8) & _M32
+    c = (c - a - b) & _M32; c ^= b >> 13
+    a = (a - b - c) & _M32; a ^= c >> 12
+    b = (b - c - a) & _M32; b ^= (a << 16) & _M32
+    c = (c - a - b) & _M32; c ^= b >> 5
+    a = (a - b - c) & _M32; a ^= c >> 3
+    b = (b - c - a) & _M32; b ^= (a << 10) & _M32
+    c = (c - a - b) & _M32; c ^= b >> 15
+    return a, b, c
+
+
+def crush_hash32(a: int) -> int:
+    a &= _M32
+    h = (CRUSH_HASH_SEED ^ a) & _M32
+    b, x, y = a, 231232, 1232
+    b, x, h = _mix(b, x, h)
+    y, a, h = _mix(y, a, h)
+    return h
+
+
+def crush_hash32_2(a: int, b: int) -> int:
+    a &= _M32; b &= _M32
+    h = (CRUSH_HASH_SEED ^ a ^ b) & _M32
+    x, y = 231232, 1232
+    a, b, h = _mix(a, b, h)
+    x, a, h = _mix(x, a, h)
+    b, y, h = _mix(b, y, h)
+    return h
+
+
+def crush_hash32_3(a: int, b: int, c: int) -> int:
+    a &= _M32; b &= _M32; c &= _M32
+    h = (CRUSH_HASH_SEED ^ a ^ b ^ c) & _M32
+    x, y = 231232, 1232
+    a, b, h = _mix(a, b, h)
+    c, x, h = _mix(c, x, h)
+    y, a, h = _mix(y, a, h)
+    b, x, h = _mix(b, x, h)
+    y, c, h = _mix(y, c, h)
+    return h
+
+
+def crush_hash32_4(a: int, b: int, c: int, d: int) -> int:
+    a &= _M32; b &= _M32; c &= _M32; d &= _M32
+    h = (CRUSH_HASH_SEED ^ a ^ b ^ c ^ d) & _M32
+    x, y = 231232, 1232
+    a, b, h = _mix(a, b, h)
+    c, d, h = _mix(c, d, h)
+    a, x, h = _mix(a, x, h)
+    y, b, h = _mix(y, b, h)
+    c, x, h = _mix(c, x, h)
+    y, d, h = _mix(y, d, h)
+    return h
+
+
+def crush_hash32_5(a: int, b: int, c: int, d: int, e: int) -> int:
+    a &= _M32; b &= _M32; c &= _M32; d &= _M32; e &= _M32
+    h = (CRUSH_HASH_SEED ^ a ^ b ^ c ^ d ^ e) & _M32
+    x, y = 231232, 1232
+    a, b, h = _mix(a, b, h)
+    c, d, h = _mix(c, d, h)
+    e, x, h = _mix(e, x, h)
+    y, a, h = _mix(y, a, h)
+    b, x, h = _mix(b, x, h)
+    y, c, h = _mix(y, c, h)
+    d, x, h = _mix(d, x, h)
+    y, e, h = _mix(y, e, h)
+    return h
+
+
+# ---------------------------------------------------------------------------
+# numpy batch variants (uint32 wrap-around arithmetic)
+# ---------------------------------------------------------------------------
+
+def _mix_vec(a, b, c):
+    with np.errstate(over="ignore"):
+        a = a - b - c; a ^= c >> np.uint32(13)
+        b = b - c - a; b ^= a << np.uint32(8)
+        c = c - a - b; c ^= b >> np.uint32(13)
+        a = a - b - c; a ^= c >> np.uint32(12)
+        b = b - c - a; b ^= a << np.uint32(16)
+        c = c - a - b; c ^= b >> np.uint32(5)
+        a = a - b - c; a ^= c >> np.uint32(3)
+        b = b - c - a; b ^= a << np.uint32(10)
+        c = c - a - b; c ^= b >> np.uint32(15)
+    return a, b, c
+
+
+def crush_hash32_3_vec(a, b, c) -> np.ndarray:
+    a = np.asarray(a).astype(np.uint32)
+    b = np.asarray(b).astype(np.uint32)
+    c = np.asarray(c).astype(np.uint32)
+    a, b, c = np.broadcast_arrays(a, b, c)
+    h = np.uint32(CRUSH_HASH_SEED) ^ a ^ b ^ c
+    x = np.full_like(h, 231232)
+    y = np.full_like(h, 1232)
+    a = a.copy(); b = b.copy(); c = c.copy()
+    a, b, h = _mix_vec(a, b, h)
+    c, x, h = _mix_vec(c, x, h)
+    y, a, h = _mix_vec(y, a, h)
+    b, x, h = _mix_vec(b, x, h)
+    y, c, h = _mix_vec(y, c, h)
+    return h
+
+
+def crush_hash32_2_vec(a, b) -> np.ndarray:
+    a = np.asarray(a).astype(np.uint32)
+    b = np.asarray(b).astype(np.uint32)
+    a, b = np.broadcast_arrays(a, b)
+    h = np.uint32(CRUSH_HASH_SEED) ^ a ^ b
+    x = np.full_like(h, 231232)
+    y = np.full_like(h, 1232)
+    a = a.copy(); b = b.copy()
+    a, b, h = _mix_vec(a, b, h)
+    x, a, h = _mix_vec(x, a, h)
+    b, y, h = _mix_vec(b, y, h)
+    return h

@@ -39,7 +39,6 @@ def gen_rs_vandermonde_matrix(k: int, m: int) -> np.ndarray:
     """(k+m, k) generator matrix: identity stacked on geometric-progression rows."""
     if k + m > 256:
         raise ValueError(f"k+m={k + m} exceeds GF(2^8) field size")
-    exp, _ = _exp_log()
     a = np.zeros((k + m, k), dtype=np.uint8)
     a[:k, :k] = np.eye(k, dtype=np.uint8)
     gen = 1

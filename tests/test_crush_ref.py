@@ -1,0 +1,164 @@
+"""CRUSH scalar-oracle bit-exactness against reference golden vectors.
+
+tests/golden/crush_mapper_golden.txt.gz holds outputs generated (at development time)
+by a harness that compiled the reference C sources (src/crush/{crush,mapper,hash,
+builder}.c) and printed hash values and crush_do_rule placements for a matrix of maps:
+every bucket algorithm, firstn + indep, two-level chooseleaf, reweight vectors,
+choose_args overrides, jewel and legacy tunables.  The Python oracle must replay every
+line bit-for-bat.  Format: `tag x n id...` per placement, `hashN args... out` per hash.
+"""
+
+import collections
+import gzip
+import pathlib
+
+import pytest
+
+import ceph_tpu  # noqa: F401
+from ceph_tpu.crush import (
+    CRUSH_BUCKET_LIST,
+    CRUSH_BUCKET_STRAW,
+    CRUSH_BUCKET_TREE,
+    CRUSH_BUCKET_UNIFORM,
+    build_flat_map,
+    build_two_level_map,
+    crush_do_rule,
+    crush_hash32,
+    crush_hash32_2,
+    crush_hash32_3,
+    crush_hash32_4,
+    crush_hash32_5,
+)
+from ceph_tpu.crush.builder import add_simple_rule
+from ceph_tpu.crush.hashfn import crush_hash32_2_vec, crush_hash32_3_vec
+from ceph_tpu.crush.ln_table import lh_table, ll_table, rh_table
+from ceph_tpu.crush.mapper_ref import crush_ln
+from ceph_tpu.crush.types import ChooseArg, Tunables
+
+GOLDEN = pathlib.Path(__file__).parent / "golden" / "crush_mapper_golden.txt.gz"
+
+
+def _load():
+    placements = collections.defaultdict(dict)
+    hashes = []
+    for line in gzip.open(GOLDEN, "rt"):
+        p = line.split()
+        if p[0].startswith("hash"):
+            hashes.append(p)
+        else:
+            placements[p[0]][int(p[1])] = [int(v) for v in p[3:3 + int(p[2])]]
+    return placements, hashes
+
+
+PLACEMENTS, HASHES = _load()
+
+HASH_FNS = {"hash1": crush_hash32, "hash2": crush_hash32_2,
+            "hash3": crush_hash32_3, "hash4": crush_hash32_4,
+            "hash5": crush_hash32_5}
+
+
+def test_hash_golden():
+    assert len(HASHES) == 250
+    for p in HASHES:
+        args = [int(v) for v in p[1:-1]]
+        assert HASH_FNS[p[0]](*args) == int(p[-1]), p
+
+
+def test_hash_vec_matches_scalar():
+    import numpy as np
+    a = np.arange(1000, dtype=np.uint32) * np.uint32(2654435761)
+    got3 = crush_hash32_3_vec(a, a + np.uint32(1), a + np.uint32(2))
+    got2 = crush_hash32_2_vec(a, a + np.uint32(7))
+    for i in [0, 1, 17, 500, 999]:
+        assert int(got3[i]) == crush_hash32_3(int(a[i]), int(a[i]) + 1, int(a[i]) + 2)
+        assert int(got2[i]) == crush_hash32_2(int(a[i]), int(a[i]) + 7)
+
+
+def _assert_matches(tag, m, rid, result_max, weight, cargs=None):
+    g = PLACEMENTS[tag]
+    assert g, f"missing golden tag {tag}"
+    for x, want in g.items():
+        got = crush_do_rule(m, rid, x, result_max, weight, cargs)
+        assert got == want, f"{tag} x={x}: {got} != {want}"
+
+
+def test_straw2_flat():
+    m, _, _ = build_flat_map(10)
+    _assert_matches("s2flat_firstn", m, 0, 3, [0x10000] * 10)
+    _assert_matches("s2flat_indep", m, 1, 4, [0x10000] * 10)
+    rw = [0x10000] * 10
+    rw[2] = 0
+    rw[5] = 0x8000
+    rw[7] = 0x4000
+    _assert_matches("s2flat_reweight", m, 0, 3, rw)
+
+
+def test_straw2_choose_args():
+    m, _, _ = build_flat_map(10)
+    cargs = {0: ChooseArg(
+        ids=[1000 + i for i in range(10)],
+        weight_set=[[0x10000 + i * 0x1000 for i in range(10)],
+                    [0x20000 - i * 0x800 for i in range(10)]])}
+    _assert_matches("s2flat_cargs", m, 0, 3, [0x10000] * 10, cargs)
+
+
+def test_straw2_varied_weights():
+    w = [(i % 5 + 1) * 0x4000 for i in range(16)]
+    w[3] = 0
+    m, _, _ = build_flat_map(16, weights=w)
+    _assert_matches("s2var_firstn", m, 0, 3, [0x10000] * 16)
+
+
+@pytest.mark.parametrize("alg,name", [
+    (CRUSH_BUCKET_UNIFORM, "uni"), (CRUSH_BUCKET_LIST, "list"),
+    (CRUSH_BUCKET_TREE, "tree"), (CRUSH_BUCKET_STRAW, "straw")])
+def test_legacy_bucket_algs(alg, name):
+    wts = [0x10000] * 7 if alg == CRUSH_BUCKET_UNIFORM \
+        else [(i + 1) * 0x8000 for i in range(7)]
+    m, _, _ = build_flat_map(7, weights=wts, alg=alg)
+    _assert_matches(f"{name}_firstn", m, 0, 3, [0x10000] * 7)
+    _assert_matches(f"{name}_indep", m, 1, 3, [0x10000] * 7)
+
+
+def test_two_level_chooseleaf():
+    m, root, rid = build_two_level_map(4, 3)
+    rid_indep = add_simple_rule(m, root, 1, "indep")
+    _assert_matches("2lvl_leaf_firstn", m, rid, 3, [0x10000] * 12)
+    _assert_matches("2lvl_leaf_indep", m, rid_indep, 3, [0x10000] * 12)
+    out4 = [0x10000] * 12
+    out4[4] = 0
+    _assert_matches("2lvl_out4", m, rid, 3, out4)
+
+
+def test_legacy_tunables():
+    m, root, rid = build_two_level_map(4, 3)
+    m.tunables = Tunables.legacy()
+    _assert_matches("2lvl_legacy", m, rid, 3, [0x10000] * 12)
+
+
+# ---------------------------------------------------------------------------
+# ln tables: spot values transcribed from the reference header during the
+# development-time diff (crush_ln_table.h), pinning the generator + overrides.
+# ---------------------------------------------------------------------------
+
+def test_ln_table_spot_values():
+    rh, lh, ll = rh_table(), lh_table(), ll_table()
+    assert rh[0] == 0x0001000000000000
+    assert rh[1] == 0x0000FE03F80FE040
+    assert rh[128] == 0x0000800000000000
+    assert lh[0] == 0
+    assert lh[1] == 0x000002DFCA16DDE1
+    assert lh[128] == 0x0000FFFF00000000  # frozen quirk (math says 2^48)
+    assert ll[0] == 0
+    assert ll[1] == 0x00000002E2A60A00
+    assert ll[2] == 0x000000070CB64EC5   # carries the frozen excess
+    assert ll[199] == 0x0000023D13EE805B  # frozen stray
+    assert ll[255] == 0x000002DCED24F814  # exact floor
+
+
+def test_crush_ln_range_and_monotonicity_where_expected():
+    # domain used by straw2: xin in [0, 0xffff]; crush_ln(0) = log2(1) = 0
+    vals = [crush_ln(x) for x in range(0, 0x10000, 257)]
+    assert all(0 <= v < (1 << 48) for v in vals)
+    assert vals == sorted(vals)
+    assert crush_ln(0xFFFF) == (15 << 44) + ((int(lh_table()[128]) + int(ll_table()[0])) >> 4)
