@@ -1,0 +1,138 @@
+"""Flagship benchmark: erasure encode + 2-erasure recovery throughput.
+
+Mirrors the reference's `ceph_erasure_code_benchmark` workload (BASELINE.json
+north-star config: k=8 m=4 cauchy, 4 KiB chunks) — the reference harness reports
+elapsed seconds and KiB processed (src/test/erasure-code/
+ceph_erasure_code_benchmark.cc:188,326); here the same quantity is reported as
+MB/s directly, batched over many stripes per device call instead of one stripe
+per call (the ECUtil stripe-loop batch point, src/osd/ECUtil.cc:136).
+
+Timing: the device runtime acks dispatch before execution completes (remote
+tunnel), so naive block_until_ready under-measures.  Each measurement runs the
+kernel N times inside one jitted lax.scan with a forced data dependency between
+iterations, fetches a scalar (which cannot resolve until everything executed),
+and differences two iteration counts to cancel dispatch/transfer overhead.
+
+vs_baseline: ratio against a single-core CPU GF(2^8) table encode measured in
+the same process (numpy oracle — the same math jerasure computes without SIMD
+hand-tuning).  The reference publishes no numbers in-tree (BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import numpy as np
+
+
+def chained_seconds_per_step(step_fn, carry, n_lo: int = 4, n_hi: int = 12,
+                             reps: int = 3) -> float:
+    """Seconds per step_fn call, measured as d(time)/d(iterations)."""
+    import jax
+
+    @functools.partial(jax.jit, static_argnames="n")
+    def loop(c, n):
+        c, _ = jax.lax.scan(lambda c, _: (step_fn(c), ()), c, None, length=n)
+        leaf = jax.tree_util.tree_leaves(c)[0]
+        return leaf.ravel()[0]
+
+    def run(n):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.device_get(loop(carry, n))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    jax.device_get(loop(carry, n_lo))  # compile
+    jax.device_get(loop(carry, n_hi))
+    t_lo, t_hi = run(n_lo), run(n_hi)
+    return max(t_hi - t_lo, 1e-9) / (n_hi - n_lo)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.gf.matrix import gen_cauchy1_matrix, recovery_matrix
+    from ceph_tpu.gf.tables import nibble_bit_table
+    from ceph_tpu.ops.gf_kernel import _encode_impl, ec_encode_ref
+    from ceph_tpu.ops.crush_kernel import flat_firstn
+
+    k, m = 8, 4
+    chunk = 4096          # 4 KiB chunks — BASELINE.json config
+    stripes = 2048        # 64 MiB of data per device call
+    erasures = [1, k + 1]  # one data + one parity chunk lost
+
+    gen = gen_cauchy1_matrix(k, m)
+    coding = gen[k:]
+    chosen = [i for i in range(k + m) if i not in set(erasures)][:k]
+    rmat = recovery_matrix(gen, chosen, erasures)
+    w_enc = jnp.asarray(nibble_bit_table(coding))
+    w_rec = jnp.asarray(nibble_bit_table(rmat))
+
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(
+        rng.integers(0, 256, (stripes, k, chunk), dtype=np.uint8))
+    data_bytes = stripes * k * chunk
+
+    def enc_step(d):
+        p = _encode_impl(w_enc, d, k=k, m=m, dot_dtype=jnp.bfloat16)
+        return d.at[0, 0, 0].set(p[0, 0, 0] ^ jnp.uint8(1))
+
+    t_enc = chained_seconds_per_step(enc_step, data)
+    enc_mbps = data_bytes / t_enc / 1e6
+
+    surv = jnp.asarray(
+        rng.integers(0, 256, (stripes, k, chunk), dtype=np.uint8))
+
+    def dec_step(s):
+        r = _encode_impl(w_rec, s, k=k, m=len(erasures), dot_dtype=jnp.bfloat16)
+        return s.at[0, 0, 0].set(r[0, 0, 0] ^ jnp.uint8(1))
+
+    t_dec = chained_seconds_per_step(dec_step, surv)
+    dec_mbps = data_bytes / t_dec / 1e6
+
+    combined = 2 * data_bytes / (t_enc + t_dec) / 1e6
+
+    # CRUSH bulk placement: 64k PGs x 3 replicas on a 100-OSD straw2 root
+    n_osds, n_pgs, numrep = 100, 65536, 3
+    ids = jnp.arange(n_osds, dtype=jnp.int32)
+    wts = jnp.full((n_osds,), 0x10000, dtype=jnp.int64)
+    rw = jnp.full((n_osds,), 0x10000, dtype=jnp.int64)
+    xs = jnp.asarray(rng.integers(0, 2**32, (n_pgs,), dtype=np.uint32))
+
+    def crush_step(x):
+        p = flat_firstn(x, ids, wts, rw, numrep=numrep)
+        return x ^ p[0, 0].astype(jnp.uint32)
+
+    t_crush = chained_seconds_per_step(crush_step, xs)
+    crush_mpps = n_pgs / t_crush / 1e6
+
+    # single-core CPU baseline: same math via the numpy table oracle on a slice
+    cpu_stripes = max(stripes // 32, 1)
+    cpu_data = np.asarray(data[:cpu_stripes])
+    t0 = time.perf_counter()
+    ec_encode_ref(coding, cpu_data)
+    t_cpu = time.perf_counter() - t0
+    cpu_mbps = cpu_stripes * k * chunk / t_cpu / 1e6
+
+    print(json.dumps({
+        "metric": "ec encode+recover MB/s (k=8,m=4,4KiB chunks, batch=2048)",
+        "value": round(combined, 1),
+        "unit": "MB/s",
+        "vs_baseline": round(combined / cpu_mbps, 2),
+        "encode_mbps": round(enc_mbps, 1),
+        "recover_mbps": round(dec_mbps, 1),
+        "cpu_oracle_mbps": round(cpu_mbps, 1),
+        "crush_mpps": round(crush_mpps, 2),
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -3,13 +3,11 @@
 Semantics match src/crush/hash.c exactly: Robert Jenkins' 1997 96-bit mix applied to
 fixed seeds (crush_hash_seed = 1315423911, x = 231232, y = 1232) in arity-specific
 schedules (hash.c:26-90).  Scalar variants operate on Python ints (the oracle); the
-``_vec`` variants are numpy uint32 and broadcast elementwise; the jax variants live in
-ops.crush_kernel and are validated against these.
+batched jax variants live in ops.crush_kernel and are validated against these.
 """
 
 from __future__ import annotations
 
-import numpy as np
 
 CRUSH_HASH_RJENKINS1 = 0
 CRUSH_HASH_SEED = 1315423911
@@ -86,53 +84,4 @@ def crush_hash32_5(a: int, b: int, c: int, d: int, e: int) -> int:
     y, c, h = _mix(y, c, h)
     d, x, h = _mix(d, x, h)
     y, e, h = _mix(y, e, h)
-    return h
-
-
-# ---------------------------------------------------------------------------
-# numpy batch variants (uint32 wrap-around arithmetic)
-# ---------------------------------------------------------------------------
-
-def _mix_vec(a, b, c):
-    with np.errstate(over="ignore"):
-        a = a - b - c; a ^= c >> np.uint32(13)
-        b = b - c - a; b ^= a << np.uint32(8)
-        c = c - a - b; c ^= b >> np.uint32(13)
-        a = a - b - c; a ^= c >> np.uint32(12)
-        b = b - c - a; b ^= a << np.uint32(16)
-        c = c - a - b; c ^= b >> np.uint32(5)
-        a = a - b - c; a ^= c >> np.uint32(3)
-        b = b - c - a; b ^= a << np.uint32(10)
-        c = c - a - b; c ^= b >> np.uint32(15)
-    return a, b, c
-
-
-def crush_hash32_3_vec(a, b, c) -> np.ndarray:
-    a = np.asarray(a).astype(np.uint32)
-    b = np.asarray(b).astype(np.uint32)
-    c = np.asarray(c).astype(np.uint32)
-    a, b, c = np.broadcast_arrays(a, b, c)
-    h = np.uint32(CRUSH_HASH_SEED) ^ a ^ b ^ c
-    x = np.full_like(h, 231232)
-    y = np.full_like(h, 1232)
-    a = a.copy(); b = b.copy(); c = c.copy()
-    a, b, h = _mix_vec(a, b, h)
-    c, x, h = _mix_vec(c, x, h)
-    y, a, h = _mix_vec(y, a, h)
-    b, x, h = _mix_vec(b, x, h)
-    y, c, h = _mix_vec(y, c, h)
-    return h
-
-
-def crush_hash32_2_vec(a, b) -> np.ndarray:
-    a = np.asarray(a).astype(np.uint32)
-    b = np.asarray(b).astype(np.uint32)
-    a, b = np.broadcast_arrays(a, b)
-    h = np.uint32(CRUSH_HASH_SEED) ^ a ^ b
-    x = np.full_like(h, 231232)
-    y = np.full_like(h, 1232)
-    a = a.copy(); b = b.copy()
-    a, b, h = _mix_vec(a, b, h)
-    x, a, h = _mix_vec(x, a, h)
-    b, y, h = _mix_vec(b, y, h)
     return h

@@ -1,0 +1,23 @@
+"""Erasure-code plugin framework.
+
+Mirrors the reference's plugin architecture (src/erasure-code/): an abstract
+interface contract (ErasureCodeInterface.h:170-462), a base class with shared
+chunk math (ErasureCode.{h,cc}), a named-plugin registry (ErasureCodePlugin.cc),
+and the plugin families jerasure / isa / shec / lrc / clay.  The compute path is
+TPU-first: every plugin's encode/decode lowers to the batched GF(2^8) MXU matmul
+in ceph_tpu.ops.gf_kernel (with the numpy oracle as the bit-exactness ground
+truth and CPU fallback), instead of per-stripe SIMD calls.
+"""
+
+from .interface import ErasureCodeInterface
+from .base import ErasureCode
+from .registry import ErasureCodePluginRegistry, instance as registry_instance
+from . import jerasure as _jerasure  # noqa: F401  (registers plugins on import)
+from . import isa as _isa  # noqa: F401
+
+__all__ = [
+    "ErasureCodeInterface",
+    "ErasureCode",
+    "ErasureCodePluginRegistry",
+    "registry_instance",
+]

@@ -1,0 +1,211 @@
+"""ErasureCode base class — shared logic every matrix-code plugin inherits.
+
+Follows src/erasure-code/ErasureCode.{h,cc}: encode_prepare padding semantics
+(SIMD_ALIGN=32, zero-fill the tail of the last data chunks, ErasureCode.cc:
+137-172), generic encode via encode_chunks (:174-190), generic decode via
+matrix recovery (:198-234), greedy _minimum_to_decode (:89-106), chunk
+remapping (:260-279), and profile parsing helpers (:281-329).
+
+The compute path is the batched device kernel: encode_chunks/decode_chunks on
+(S, k, B) uint8 arrays lower to one MXU matmul (ceph_tpu.ops.gf_kernel), with
+the numpy oracle available for verification (profile runtime=cpu).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ceph_tpu.gf.matrix import recovery_matrix
+from ceph_tpu.ops.gf_kernel import ec_encode_ref
+
+from .interface import ErasureCodeInterface, ErasureCodeProfile
+
+SIMD_ALIGN = 32  # ErasureCode.h SIMD_ALIGN — chunk padding quantum
+
+
+class ErasureCode(ErasureCodeInterface):
+    """Systematic GF(2^8) matrix code driven by a (k+m, k) generator matrix.
+
+    Subclasses set self.k, self.m and implement _build_generator() returning the
+    generator matrix (identity on top).  Everything else — padding, batched
+    device encode, decode-by-inversion with an LRU recovery-matrix cache
+    (ErasureCodeIsaTableCache analog) — lives here.
+    """
+
+    #: profile keys consumed by init (reference: parse() per plugin)
+    _PROFILE_KEYS = ("k", "m", "technique", "runtime", "plugin",
+                     "crush-failure-domain", "crush-root",
+                     "crush-device-class", "directory", "w", "packetsize")
+
+    def __init__(self):
+        self.k = 0
+        self.m = 0
+        self.technique = ""
+        self.runtime = "tpu"   # "tpu" (device kernel) or "cpu" (numpy oracle)
+        self._generator: np.ndarray | None = None
+        self._encoder = None
+        self._decode_cache: dict = {}
+        self._chunk_mapping: list[int] = []
+
+    # -- profile parsing (ErasureCode.cc:281-329 to_int/to_bool) --------------
+
+    @staticmethod
+    def to_int(name: str, profile: ErasureCodeProfile, default: int) -> int:
+        v = profile.get(name, default)
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            raise ValueError(f"{name}={v!r} is not an integer")
+
+    @staticmethod
+    def to_bool(name: str, profile: ErasureCodeProfile, default: bool) -> bool:
+        v = str(profile.get(name, default)).lower()
+        return v in ("true", "1", "yes")
+
+    def init(self, profile: ErasureCodeProfile) -> None:
+        self.parse(profile)
+        self._generator = np.asarray(self._build_generator(), dtype=np.uint8)
+        assert self._generator.shape == (self.k + self.m, self.k)
+        self._encoder = None
+        self._decode_cache.clear()
+
+    def parse(self, profile: ErasureCodeProfile) -> None:
+        """Subclasses override to parse technique-specific keys; must set k, m."""
+        self.k = self.to_int("k", profile, self._default_k())
+        self.m = self.to_int("m", profile, self._default_m())
+        self.runtime = profile.get("runtime", "tpu")
+        if self.k < 1 or self.m < 1:
+            raise ValueError(f"k={self.k} m={self.m} must be >= 1")
+        unknown = set(profile) - set(self._PROFILE_KEYS)
+        if unknown:
+            raise ValueError(f"unknown profile keys {sorted(unknown)}")
+
+    def _default_k(self) -> int:
+        return 7
+
+    def _default_m(self) -> int:
+        return 3
+
+    def _build_generator(self) -> np.ndarray:
+        raise NotImplementedError
+
+    @property
+    def generator(self) -> np.ndarray:
+        assert self._generator is not None, "init() not called"
+        return self._generator
+
+    # -- chunk geometry -------------------------------------------------------
+
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def get_alignment(self) -> int:
+        """Bytes the object must pad to before splitting into k chunks."""
+        return self.k * SIMD_ALIGN
+
+    def get_chunk_size(self, stripe_width: int) -> int:
+        """ErasureCodeJerasure::get_chunk_size semantics: pad the object to the
+        alignment quantum, then divide by k."""
+        alignment = self.get_alignment()
+        padded = (stripe_width + alignment - 1) // alignment * alignment
+        return padded // self.k
+
+    # -- minimum_to_decode (ErasureCode.cc:89-106) ----------------------------
+
+    def minimum_to_decode(self, want_to_read: set, available: set) -> set:
+        if want_to_read <= available:
+            return set(want_to_read)
+        if len(available) < self.k:
+            raise IOError(
+                f"cannot decode {sorted(want_to_read)}: only "
+                f"{len(available)} of k={self.k} chunks available")
+        return set(sorted(available)[:self.k])
+
+    # -- encode (ErasureCode.cc:137-190) --------------------------------------
+
+    def encode_prepare(self, data: bytes) -> np.ndarray:
+        """Pad + split into (k, chunk) uint8 — zero-fill tail chunks
+        (ErasureCode.cc:137-172)."""
+        chunk = self.get_chunk_size(len(data))
+        padded = np.zeros(self.k * chunk, dtype=np.uint8)
+        padded[:len(data)] = np.frombuffer(data, dtype=np.uint8)
+        return padded.reshape(self.k, chunk)
+
+    def encode(self, want_to_encode: set, data: bytes) -> dict:
+        chunks = self.encode_prepare(data)
+        parity = np.asarray(self.encode_chunks(chunks[None]))[0]
+        allc = {i: chunks[i].tobytes() for i in range(self.k)}
+        allc.update({self.k + i: parity[i].tobytes() for i in range(self.m)})
+        return {i: allc[i] for i in want_to_encode}
+
+    def encode_chunks(self, data_chunks):
+        """(S, k, B) uint8 -> (S, m, B) uint8 on the selected runtime."""
+        coding = self.generator[self.k:]
+        if self.runtime == "cpu":
+            return ec_encode_ref(coding, np.asarray(data_chunks))
+        if self._encoder is None:
+            from ceph_tpu.ops.gf_kernel import make_encoder
+            self._encoder = make_encoder(coding)
+        return self._encoder(np.asarray(data_chunks, dtype=np.uint8))
+
+    # -- decode (ErasureCode.cc:198-234 / ErasureCodeIsa.cc:150-310) ----------
+
+    def _recovery(self, chosen: tuple, targets: tuple) -> np.ndarray:
+        """LRU-ish cached recovery matrix (ErasureCodeIsaTableCache analog)."""
+        key = (chosen, targets)
+        if key not in self._decode_cache:
+            if len(self._decode_cache) > 256:
+                self._decode_cache.clear()
+            self._decode_cache[key] = recovery_matrix(
+                self.generator, list(chosen), list(targets))
+        return self._decode_cache[key]
+
+    def decode_chunks(self, chosen, chunks, targets):
+        """chunks: (S, k, B) uint8 rows ``chosen`` -> (S, len(targets), B)."""
+        rmat = self._recovery(tuple(chosen), tuple(targets))
+        if self.runtime == "cpu":
+            return ec_encode_ref(rmat, np.asarray(chunks))
+        from ceph_tpu.ops.gf_kernel import ec_encode_jax
+        return ec_encode_jax(rmat, np.asarray(chunks, dtype=np.uint8))
+
+    def decode(self, want_to_read: set, chunks: dict) -> dict:
+        available = set(chunks)
+        out = {i: chunks[i] for i in want_to_read & available}
+        missing = sorted(want_to_read - available)
+        if not missing:
+            return out
+        if len(available) < self.k:
+            raise IOError(
+                f"cannot decode {missing}: only {len(available)} of "
+                f"k={self.k} chunks available")
+        chosen = sorted(available)[:self.k]
+        arr = np.stack([np.frombuffer(chunks[i], dtype=np.uint8)
+                        for i in chosen])
+        rebuilt = np.asarray(self.decode_chunks(chosen, arr[None], missing))[0]
+        for idx, i in enumerate(missing):
+            out[i] = rebuilt[idx].tobytes()
+        return out
+
+    # -- chunk remapping (ErasureCode.cc:260-279) -----------------------------
+
+    @staticmethod
+    def to_mapping(mapping: str) -> list[int]:
+        """Parse a mapping string like "_DDD_DD" — 'D' positions hold chunks,
+        other characters are gaps (used by LRC; ErasureCode.cc:260-279)."""
+        out = []
+        for pos, c in enumerate(mapping):
+            if c == "D":
+                out.append(pos)
+        return out
+
+    def get_chunk_mapping(self) -> list:
+        return list(self._chunk_mapping)
+
+    # -- CRUSH rule (ErasureCode.cc:53-72) ------------------------------------
+
+    def create_rule(self, name: str, crush_map) -> int:
+        from ceph_tpu.crush.builder import add_simple_rule
+        return add_simple_rule(crush_map, -1, 0, "indep")

@@ -65,6 +65,30 @@ def gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return np.bitwise_xor.reduce(prods, axis=1)
 
 
+def recovery_matrix(gen: np.ndarray, chosen: list[int],
+                    targets: list[int]) -> np.ndarray:
+    """Decode matrix: reconstruct chunk rows ``targets`` from chunk rows ``chosen``.
+
+    Mirrors the reference decode structure (ErasureCodeIsa.cc:150-310 /
+    jerasure_matrix_decode): take the k surviving generator rows, invert, and
+    multiply by the target rows.  ``gen`` is the (k+m, k) generator matrix,
+    ``chosen`` exactly k surviving chunk indices, ``targets`` the chunk indices to
+    rebuild.  Returns (len(targets), k) uint8 — apply it to the chosen chunks with
+    the same batched kernel used for encode.
+
+    Raises ValueError if the chosen rows are singular (non-MDS corner or bad choice).
+    """
+    gen = np.asarray(gen, dtype=np.uint8)
+    k = gen.shape[1]
+    if len(chosen) != k:
+        raise ValueError(f"need exactly k={k} chosen rows, got {len(chosen)}")
+    sub = gen[list(chosen)]
+    inv = gf_invert_matrix(sub)
+    if inv is None:
+        raise ValueError(f"chosen rows {chosen} give a singular submatrix")
+    return gf_matmul(gen[list(targets)], inv)
+
+
 def gf_invert_matrix(mat: np.ndarray) -> np.ndarray | None:
     """Invert a square GF(2^8) matrix; returns None if singular."""
     mat = np.asarray(mat, dtype=np.uint8)

@@ -1,0 +1,19 @@
+"""Multi-chip parallelism for ceph_tpu.
+
+The reference scales with a cluster messenger fanning shard writes to k+m OSDs
+(src/osd/ECBackend.cc:2033) and a thread pool for bulk remaps
+(src/osd/OSDMapMapping.h:17).  The TPU-native equivalents are mesh axes:
+
+    dp   placement/stripe data parallelism — independent PGs/stripes spread
+         across devices (the ParallelPGMapper / ECUtil stripe-loop axis).
+    ec   shard parallelism — the k+m chunk fan-out of an EC write lives across
+         devices, and recovery's shard fan-in (MOSDECSubOpRead) becomes an
+         all_gather over this axis riding ICI.
+
+See SURVEY.md §2.3 / §5 for the messenger→collectives mapping.
+"""
+
+from .mesh import make_mesh, factor_devices
+from .sharded import sharded_encode, make_cluster_step
+
+__all__ = ["make_mesh", "factor_devices", "sharded_encode", "make_cluster_step"]

@@ -7,11 +7,17 @@ dryrun uses.  This must be configured before jax initializes its backends.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# config.update, not the env var: the environment exports JAX_PLATFORMS=axon (the
+# real TPU tunnel) and the plugin outranks an env override, but tests need the
+# virtual 8-device CPU mesh
+jax.config.update("jax_platforms", os.environ.get("CEPH_TPU_TEST_PLATFORM", "cpu"))
 
 import ceph_tpu  # noqa: E402,F401  (enables x64 before tests create arrays)

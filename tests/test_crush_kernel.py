@@ -1,0 +1,86 @@
+"""Bit-exactness of the batched JAX CRUSH kernels vs the scalar oracle."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from ceph_tpu.crush import build_flat_map, crush_do_rule
+from ceph_tpu.crush.hashfn import crush_hash32_2, crush_hash32_3
+from ceph_tpu.crush.mapper_ref import crush_ln as crush_ln_ref
+from ceph_tpu.crush.mapper_ref import _bucket_straw2_choose
+from ceph_tpu.crush.types import Bucket, CRUSH_BUCKET_STRAW2
+from ceph_tpu.ops import crush_kernel as ck
+
+
+def test_hash32_2_matches_scalar():
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 2**32, 1000, dtype=np.uint32)
+    b = rng.integers(0, 2**32, 1000, dtype=np.uint32)
+    got = np.asarray(ck.hash32_2(a, b))
+    want = np.array([crush_hash32_2(int(x), int(y)) for x, y in zip(a, b)],
+                    dtype=np.uint32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_hash32_3_matches_scalar():
+    rng = np.random.default_rng(1)
+    a = rng.integers(0, 2**32, 1000, dtype=np.uint32)
+    b = rng.integers(0, 2**32, 1000, dtype=np.uint32)
+    c = rng.integers(0, 2**32, 1000, dtype=np.uint32)
+    got = np.asarray(ck.hash32_3(a, b, c))
+    want = np.array([crush_hash32_3(int(x), int(y), int(z))
+                     for x, y, z in zip(a, b, c)], dtype=np.uint32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_crush_ln_exhaustive_16bit():
+    """straw2 only feeds crush_ln 16-bit inputs (hash & 0xffff) — check all."""
+    xs = np.arange(1 << 16, dtype=np.uint32)
+    got = np.asarray(ck.crush_ln(xs))
+    want = np.array([crush_ln_ref(int(x)) for x in xs], dtype=np.int64)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_crush_ln_domain_is_16bit():
+    """Inputs beyond 0xffff index out of the ln tables in the reference C too
+    (mapper.c feeds crush_ln only hash & 0xffff, :335); the contract is 16-bit."""
+    assert int(ck.crush_ln(jnp.uint32(0xFFFF))) == crush_ln_ref(0xFFFF)
+    assert int(ck.crush_ln(jnp.uint32(0))) == crush_ln_ref(0)
+
+
+def test_straw2_choose_matches_oracle():
+    rng = np.random.default_rng(3)
+    size = 17
+    ids = np.arange(size, dtype=np.int32)
+    weights = rng.integers(1, 0x40000, size).astype(np.int64)
+    weights[5] = 0  # zero-weight item must never win
+    bucket = Bucket(id=-1, type=1, alg=CRUSH_BUCKET_STRAW2,
+                    items=[int(i) for i in ids],
+                    item_weights=[int(w) for w in weights])
+    xs = rng.integers(0, 2**32, 500, dtype=np.uint32)
+    rs = rng.integers(0, 10, 500, dtype=np.uint32)
+    got = np.asarray(ck.straw2_choose_index(jnp.asarray(xs), ids,
+                                            jnp.asarray(rs), weights))
+    for x, r, g in zip(xs, rs, got):
+        want = _bucket_straw2_choose(bucket, int(x), int(r), None, 0)
+        assert bucket.items[int(g)] == want
+
+
+@pytest.mark.parametrize("numrep", [1, 3])
+def test_flat_firstn_matches_oracle(numrep):
+    rng = np.random.default_rng(4)
+    n_osds = 40
+    weights = [0x10000] * 30 + [0x8000] * 5 + [0x20000] * 5
+    m, _root, rule = build_flat_map(n_osds, weights)
+    reweight = [0x10000] * n_osds
+    reweight[3] = 0        # marked out
+    reweight[7] = 0x8000   # half reweighted -> probabilistic rejection
+    xs = rng.integers(0, 2**32, 256, dtype=np.uint32)
+    got = np.asarray(ck.flat_firstn(
+        jnp.asarray(xs), np.arange(n_osds, dtype=np.int32),
+        np.asarray(weights, dtype=np.int64),
+        np.asarray(reweight, dtype=np.int64), numrep=numrep))
+    for i, x in enumerate(xs):
+        want = crush_do_rule(m, rule, int(x), numrep, reweight)
+        mine = [int(v) for v in got[i] if v != 0x7FFFFFFF]
+        assert want == mine, f"x={x}: want {want} got {mine}"

@@ -30,7 +30,6 @@ from ceph_tpu.crush import (
     crush_hash32_5,
 )
 from ceph_tpu.crush.builder import add_simple_rule
-from ceph_tpu.crush.hashfn import crush_hash32_2_vec, crush_hash32_3_vec
 from ceph_tpu.crush.ln_table import lh_table, ll_table, rh_table
 from ceph_tpu.crush.mapper_ref import crush_ln
 from ceph_tpu.crush.types import ChooseArg, Tunables
@@ -62,16 +61,6 @@ def test_hash_golden():
     for p in HASHES:
         args = [int(v) for v in p[1:-1]]
         assert HASH_FNS[p[0]](*args) == int(p[-1]), p
-
-
-def test_hash_vec_matches_scalar():
-    import numpy as np
-    a = np.arange(1000, dtype=np.uint32) * np.uint32(2654435761)
-    got3 = crush_hash32_3_vec(a, a + np.uint32(1), a + np.uint32(2))
-    got2 = crush_hash32_2_vec(a, a + np.uint32(7))
-    for i in [0, 1, 17, 500, 999]:
-        assert int(got3[i]) == crush_hash32_3(int(a[i]), int(a[i]) + 1, int(a[i]) + 2)
-        assert int(got2[i]) == crush_hash32_2(int(a[i]), int(a[i]) + 7)
 
 
 def _assert_matches(tag, m, rid, result_max, weight, cargs=None):
