@@ -61,7 +61,6 @@ def main() -> None:
     from ceph_tpu.gf.matrix import gen_cauchy1_matrix, recovery_matrix
     from ceph_tpu.gf.tables import nibble_bit_table
     from ceph_tpu.ops.gf_kernel import _encode_impl, ec_encode_ref
-    from ceph_tpu.ops.crush_kernel import flat_firstn
 
     k, m = 8, 4
     chunk = 4096          # 4 KiB chunks — BASELINE.json config
@@ -99,18 +98,23 @@ def main() -> None:
 
     combined = 2 * data_bytes / (t_enc + t_dec) / 1e6
 
-    # CRUSH bulk placement: 64k PGs x 3 replicas on a 100-OSD straw2 root
-    n_osds, n_pgs, numrep = 100, 65536, 3
-    ids = jnp.arange(n_osds, dtype=jnp.int32)
-    wts = jnp.full((n_osds,), 0x10000, dtype=jnp.int64)
-    rw = jnp.full((n_osds,), 0x10000, dtype=jnp.int64)
+    # CRUSH bulk placement (BASELINE config #5 shape): 10k-OSD two-level map
+    # (250 hosts x 40 osds), chooseleaf firstn 3, 64k PGs per device call
+    from ceph_tpu.crush import build_two_level_map
+    from ceph_tpu.crush.mapper_jax import BatchMapper
+
+    crush_map, _root, rid = build_two_level_map(250, 40)
+    bm = BatchMapper(crush_map)
+    n_pgs, numrep = 65536, 3
+    rw = jnp.full((10000,), 0x10000, dtype=jnp.int64)
     xs = jnp.asarray(rng.integers(0, 2**32, (n_pgs,), dtype=np.uint32))
+    bm.do_rule(rid, xs, numrep, rw)  # compile
 
     def crush_step(x):
-        p = flat_firstn(x, ids, wts, rw, numrep=numrep)
-        return x ^ p[0, 0].astype(jnp.uint32)
+        p = bm.do_rule(rid, x, numrep, rw)
+        return x ^ p[:, 0].astype(jnp.uint32)
 
-    t_crush = chained_seconds_per_step(crush_step, xs)
+    t_crush = chained_seconds_per_step(crush_step, xs, n_lo=2, n_hi=6)
     crush_mpps = n_pgs / t_crush / 1e6
 
     # single-core CPU baseline: same math via the numpy table oracle on a slice

@@ -1,0 +1,89 @@
+"""CrushMap → dense-array compilation for the batched device mapper.
+
+The scalar oracle walks Python objects; the batched mapper needs the map as
+static dense arrays so every step is a gather.  A compiled map holds, per
+bucket: id, type, size, and padded item/weight rows.  Devices are type 0;
+negative items index buckets at -1-id, exactly the reference layout
+(crush/crush.h:354 crush_map.buckets).
+
+Batchability contract (checked at compile time, ValueError otherwise):
+  * every bucket is straw2 — the modern default (the reference converts maps
+    to straw2 for the same reason: deterministic O(size) draws, no per-call
+    permutation state).  Other algs run through the scalar oracle fallback
+    (ceph_tpu.crush.mapper_ref / OSDMapMapping's scalar path).
+  * modern tunables: choose_local_tries=0 and choose_local_fallback_tries=0
+    (the jewel+ profile, Tunables defaults) — the legacy local-retry ladder
+    (mapper.c:497-503) and perm fallback are scalar-only.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .types import CRUSH_BUCKET_STRAW2, CrushMap
+
+
+@dataclass
+class CompiledCrushMap:
+    """Dense form of a CrushMap.  All arrays are host numpy; the mapper moves
+    them to device once per map epoch (like OSDMap distribution)."""
+
+    n_buckets: int
+    max_size: int
+    max_devices: int
+    bucket_id: np.ndarray      # (B,) int32  — crush bucket id (negative)
+    bucket_type: np.ndarray    # (B,) int32
+    bucket_size: np.ndarray    # (B,) int32
+    items: np.ndarray          # (B, S) int32, padded with INT32_MIN
+    weights: np.ndarray        # (B, S) int64 16.16, padded with 0
+    tunables_tries: int        # choose_total_tries + 1 (mapper.c:906)
+    vary_r: int
+    stable: int
+    descend_once: int
+
+    def bucket_index(self, item: int) -> int:
+        return -1 - item
+
+
+def compile_map(m: CrushMap) -> CompiledCrushMap:
+    t = m.tunables
+    if t.choose_local_tries or t.choose_local_fallback_tries:
+        raise ValueError(
+            "batched mapper requires modern tunables (choose_local_tries=0, "
+            "choose_local_fallback_tries=0); use the scalar oracle for legacy "
+            "profiles")
+    n = len(m.buckets)
+    sizes = []
+    for b in m.buckets:
+        if b is None:
+            sizes.append(0)
+            continue
+        if b.alg != CRUSH_BUCKET_STRAW2:
+            raise ValueError(
+                f"batched mapper supports straw2 buckets only; bucket "
+                f"{b.id} has alg {b.alg} — use the scalar oracle")
+        sizes.append(b.size)
+    s_max = max(sizes, default=1) or 1
+    bucket_id = np.zeros(n, dtype=np.int32)
+    bucket_type = np.zeros(n, dtype=np.int32)
+    bucket_size = np.zeros(n, dtype=np.int32)
+    items = np.full((n, s_max), np.iinfo(np.int32).min, dtype=np.int32)
+    weights = np.zeros((n, s_max), dtype=np.int64)
+    for idx, b in enumerate(m.buckets):
+        if b is None:
+            continue
+        bucket_id[idx] = b.id
+        bucket_type[idx] = b.type
+        bucket_size[idx] = b.size
+        items[idx, :b.size] = b.items
+        weights[idx, :b.size] = b.item_weights
+    return CompiledCrushMap(
+        n_buckets=n, max_size=s_max, max_devices=m.max_devices,
+        bucket_id=bucket_id, bucket_type=bucket_type, bucket_size=bucket_size,
+        items=items, weights=weights,
+        tunables_tries=t.choose_total_tries + 1,
+        vary_r=t.chooseleaf_vary_r, stable=t.chooseleaf_stable,
+        descend_once=t.chooseleaf_descend_once,
+    )

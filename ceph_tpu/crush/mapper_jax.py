@@ -1,0 +1,431 @@
+"""Batched CRUSH rule evaluation on device.
+
+One call evaluates a rule for N inputs at once — the TPU-native replacement for
+ParallelPGMapper's thread-pool fan-out (src/osd/OSDMapMapping.h:17) and the
+CrushTester loop (src/crush/CrushTester.cc:472-560).  Bit-exactness contract:
+for any straw2 map with modern tunables, results equal the scalar oracle
+(ceph_tpu.crush.mapper_ref, itself written against src/crush/mapper.c) exactly.
+
+Shape of the implementation:
+  * the rule program (TAKE/CHOOSE*/EMIT/SET_*) is interpreted in Python — it is
+    static per map epoch, exactly like the reference (mapper.c:900-1105);
+  * each CHOOSE step runs the whole batch through masked lax.while_loop retry
+    ladders: descent through the hierarchy, the firstn collision/reject ladder
+    (mapper.c:460-648) with chooseleaf recursion (vary_r/stable semantics), and
+    the breadth-first positionally-stable indep pass (mapper.c:655-843);
+  * per-lane state is (current bucket, ftotal, active); every draw is a
+    straw2 argmax over a gathered bucket row (ops.crush_kernel.straw2_draws).
+
+Working-set values are per-lane (a lane's chosen hosts differ), so multi-step
+rules like "take root / choose firstn 0 host / choose firstn 1 osd / emit"
+gather per-lane start buckets at each step.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ceph_tpu.ops.crush_kernel import is_out
+
+from .compile import CompiledCrushMap, compile_map
+from .types import (
+    CRUSH_ITEM_NONE,
+    RULE_CHOOSE_FIRSTN,
+    RULE_CHOOSE_INDEP,
+    RULE_CHOOSELEAF_FIRSTN,
+    RULE_CHOOSELEAF_INDEP,
+    RULE_EMIT,
+    RULE_SET_CHOOSE_TRIES,
+    RULE_SET_CHOOSELEAF_TRIES,
+    RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES,
+    RULE_SET_CHOOSE_LOCAL_TRIES,
+    RULE_SET_CHOOSELEAF_STABLE,
+    RULE_SET_CHOOSELEAF_VARY_R,
+    RULE_TAKE,
+    CrushMap,
+)
+
+NONE = jnp.int32(CRUSH_ITEM_NONE)
+
+
+class _Arrays:
+    """Device-resident compiled map."""
+
+    def __init__(self, c: CompiledCrushMap):
+        self.bucket_id = jnp.asarray(c.bucket_id)
+        self.bucket_type = jnp.asarray(c.bucket_type)
+        self.bucket_size = jnp.asarray(c.bucket_size)
+        self.items = jnp.asarray(c.items)
+        self.weights = jnp.asarray(c.weights)
+        self.n_buckets = c.n_buckets
+        self.max_devices = c.max_devices
+
+
+def _straw2_draws_per_row(x, items_row, r, w_row):
+    """Like ops.crush_kernel.straw2_draws but ids/weights differ per lane."""
+    from ceph_tpu.crush.types import S64_MIN
+    from ceph_tpu.ops.crush_kernel import _LN_2_48, crush_ln, hash32_3
+    u = hash32_3(x[:, None], items_row, r[:, None]) & jnp.uint32(0xFFFF)
+    ln = crush_ln(u) - _LN_2_48
+    w = w_row.astype(jnp.int64)
+    draw = -((-ln) // jnp.maximum(w, 1))
+    return jnp.where(w > 0, draw, jnp.int64(S64_MIN))
+
+
+def _winner(a: _Arrays, cur: jax.Array, x: jax.Array, r: jax.Array) -> jax.Array:
+    """Straw2 winner of bucket index ``cur`` for each lane (first max wins,
+    mapper.c:361-384; choose_args overrides are scalar-path only)."""
+    items_row = a.items[cur]                      # (N, S)
+    w_row = a.weights[cur]                        # (N, S) — padding weight 0
+    d = _straw2_draws_per_row(x, items_row, r, w_row)
+    pos = jnp.argmax(d, axis=-1)
+    return jnp.take_along_axis(items_row, pos[:, None], axis=1)[:, 0]
+
+
+def _widx(a: _Arrays, item: jax.Array) -> jax.Array:
+    """Bucket index of a (negative) item, clipped for safe gathering."""
+    return jnp.clip(-1 - item, 0, a.n_buckets - 1)
+
+
+def _wtype(a: _Arrays, item: jax.Array) -> jax.Array:
+    """Type of an item: devices are 0, buckets their bucket_type."""
+    return jnp.where(item < 0, a.bucket_type[_widx(a, item)], 0)
+
+
+def _descend(a: _Arrays, x, start, r, want_type, active):
+    """One full descent: from per-lane ``start`` bucket, draw and follow
+    sub-buckets until an item of ``want_type`` (or a terminal failure).
+
+    Returns (item, fail_perm, fail_retry):
+      item       winner of want_type where neither failure flag is set
+      fail_perm  skip_rep conditions — out-of-range device, wrong-type device,
+                 unresolvable bucket (mapper.c:540-556 / 744-760)
+      fail_retry empty bucket on the path (reject; mapper.c:533-537)
+    """
+    def cond(s):
+        return jnp.any(s[3])
+
+    def body(s):
+        item, perm, retry, live, cur = s
+        empty = a.bucket_size[cur] == 0
+        win = _winner(a, cur, x, r)
+        wt = _wtype(a, win)
+        oob = (win >= 0) & (win >= a.max_devices)
+        reached = ~empty & ~oob & (wt == want_type)
+        is_sub = win < 0
+        new_perm = live & ~empty & ~reached & (oob | ~is_sub)
+        new_retry = live & empty
+        descend = live & ~empty & ~reached & ~new_perm
+        item = jnp.where(live & reached, win, item)
+        perm = perm | new_perm
+        retry = retry | new_retry
+        cur = jnp.where(descend, _widx(a, win), cur)
+        live = descend
+        return item, perm, retry, live, cur
+
+    item0 = jnp.full_like(start, CRUSH_ITEM_NONE)
+    f = jnp.zeros_like(active)
+    out = jax.lax.while_loop(
+        cond, body, (item0, f, f, active, start))
+    return out[0], out[1], out[2]
+
+
+def _leaf_firstn(a: _Arrays, x, host_item, sub_r, leaf_out, rep, tries,
+                 reweight, active):
+    """chooseleaf recursion (stable tunable): choose 1 device inside
+    ``host_item`` with r = sub_r + ftotal, colliding against leaves of earlier
+    reps (out2 scoping, mapper.c:580-596).  Returns (leaf, ok)."""
+    start = _widx(a, host_item)
+
+    def cond(s):
+        return jnp.any(s[2])
+
+    def body(s):
+        leaf, ftotal, live = s
+        r = sub_r + ftotal
+        item, perm, retry = _descend(a, x, start, r, 0, live)
+        got = live & ~perm & ~retry
+        collide = jnp.zeros_like(live)
+        if rep > 0:
+            collide = jnp.any(leaf_out[:, :rep] == item[:, None], axis=1)
+        rejected = is_out(reweight, item, x)
+        bad = collide | rejected | ~got
+        leaf = jnp.where(live & got & ~bad, item, leaf)
+        placed = live & got & ~bad
+        ftotal = jnp.where(live & ~placed, ftotal + 1, ftotal)
+        live = live & ~placed & ~perm & (ftotal < tries)
+        return leaf, ftotal, live
+
+    leaf0 = jnp.full_like(host_item, CRUSH_ITEM_NONE)
+    leaf, _, _ = jax.lax.while_loop(
+        cond, body, (leaf0, jnp.zeros_like(host_item), active))
+    return leaf, leaf != NONE
+
+
+def _choose_firstn(a: _Arrays, x, start, numrep, want_type, tries,
+                   recurse_tries, vary_r, recurse_to_leaf, reweight, active):
+    """Batched crush_choose_firstn (mapper.c:460-648), modern tunables.
+
+    Returns (out, leaf_out): (N, numrep) int32, CRUSH_ITEM_NONE holes where a
+    rep was abandoned (the scalar result is the NONE-compacted row).
+    """
+    n = x.shape[0]
+    out = jnp.full((n, numrep), NONE, dtype=jnp.int32)
+    leaf_out = jnp.full((n, numrep), NONE, dtype=jnp.int32)
+
+    for rep in range(numrep):
+        def cond(s):
+            return jnp.any(s[3])
+
+        def body(s, rep=rep):
+            sel, leaf_sel, ftotal, live = s
+            r = rep + ftotal
+            item, perm, retry = _descend(a, x, start, r, want_type, live)
+            got = live & ~perm & ~retry
+            collide = jnp.any(out == item[:, None], axis=1) if numrep > 1 \
+                else jnp.zeros_like(live)
+            reject = jnp.zeros_like(live)
+            leaf = jnp.full_like(item, CRUSH_ITEM_NONE)
+            if recurse_to_leaf:
+                # sub_r = vary_r ? r >> (vary_r-1) : 0 (mapper.c:578)
+                sub_r = (r >> (vary_r - 1)) if vary_r else jnp.zeros_like(r)
+                leaf, leaf_ok = _leaf_firstn(
+                    a, x, item, sub_r, leaf_out, rep, recurse_tries,
+                    reweight, got & ~collide)
+                reject = got & ~collide & ~leaf_ok
+            if want_type == 0:
+                reject = reject | (got & is_out(reweight, item, x))
+            bad = collide | reject | retry | ~got
+            placed = live & ~perm & ~bad
+            sel = jnp.where(placed, item, sel)
+            if recurse_to_leaf:
+                leaf_sel = jnp.where(placed, leaf, leaf_sel)
+            ftotal = jnp.where(live & ~perm & bad, ftotal + 1, ftotal)
+            live = live & ~perm & bad & (ftotal < tries)
+            return sel, leaf_sel, ftotal, live
+
+        sel0 = jnp.full((n,), NONE, dtype=jnp.int32)
+        sel, leaf_sel, _, _ = jax.lax.while_loop(
+            cond, body,
+            (sel0, sel0, jnp.zeros((n,), jnp.int32), active))
+        out = out.at[:, rep].set(sel)
+        leaf_out = leaf_out.at[:, rep].set(leaf_sel)
+    return out, leaf_out
+
+
+def _leaf_indep(a: _Arrays, x, host_item, rep: int, parent_r, numrep_mult,
+                tries, reweight, active):
+    """indep chooseleaf recursion: positionally stable single-device pick at
+    position ``rep``: r = rep + parent_r + numrep*ftotal with the parent's
+    numrep as multiplier (the oracle's recursion wiring, mapper.c:794-806).
+    Terminal (oob/wrong-type) failures are permanent, like the C break that
+    leaves CRUSH_ITEM_NONE."""
+    start = _widx(a, host_item)
+
+    def cond(s):
+        return jnp.any(s[2])
+
+    def body(s):
+        leaf, ftotal, live = s
+        r = rep + parent_r + numrep_mult * ftotal
+        item, perm, retry = _descend(a, x, start, r, 0, live)
+        got = live & ~perm & ~retry
+        rejected = is_out(reweight, item, x)
+        placed = got & ~rejected
+        leaf = jnp.where(placed, item, leaf)
+        ftotal = ftotal + 1
+        live = live & ~placed & ~perm & (ftotal < tries)
+        return leaf, ftotal, live
+
+    leaf0 = jnp.full_like(host_item, CRUSH_ITEM_NONE)
+    leaf, _, _ = jax.lax.while_loop(
+        cond, body, (leaf0, jnp.zeros_like(host_item), active))
+    return leaf, leaf != NONE
+
+
+def _choose_indep(a: _Arrays, x, start, left, numrep_mult, want_type, tries,
+                  recurse_tries, recurse_to_leaf, reweight, active):
+    """Batched crush_choose_indep (mapper.c:655-843): breadth-first over
+    ``left`` positions, r = rep + numrep*ftotal with the *step's* numrep as
+    multiplier even when left < numrep; failures leave CRUSH_ITEM_NONE."""
+    n = x.shape[0]
+    out = jnp.full((n, left), NONE, dtype=jnp.int32)
+    leaf_out = jnp.full((n, left), NONE, dtype=jnp.int32)
+    undef = jnp.broadcast_to(active[:, None], (n, left)) & True
+
+    def cond(s):
+        out, leaf_out, undef, ftotal = s
+        return jnp.any(undef) & (ftotal < tries)
+
+    def body(s):
+        out, leaf_out, undef, ftotal = s
+        for rep in range(left):
+            live = undef[:, rep]
+            r = jnp.full((n,), rep, jnp.int32) + numrep_mult * ftotal
+            item, perm, retry = _descend(a, x, start, r, want_type, live)
+            got = live & ~perm & ~retry
+            collide = jnp.any(out == item[:, None], axis=1)
+            reject = jnp.zeros_like(live)
+            leaf = jnp.full_like(item, CRUSH_ITEM_NONE)
+            if recurse_to_leaf:
+                leaf, leaf_ok = _leaf_indep(
+                    a, x, item, rep, r, numrep_mult, recurse_tries,
+                    reweight, got & ~collide)
+                reject = got & ~collide & ~leaf_ok
+            if want_type == 0:
+                reject = reject | (got & is_out(reweight, item, x))
+            placed = got & ~collide & ~reject
+            out = out.at[:, rep].set(jnp.where(placed, item, out[:, rep]))
+            if recurse_to_leaf:
+                leaf_out = leaf_out.at[:, rep].set(
+                    jnp.where(placed, leaf, leaf_out[:, rep]))
+            # perm: terminal failure, position stays NONE (mapper.c:744-760)
+            undef = undef.at[:, rep].set(live & ~placed & ~perm)
+        return out, leaf_out, undef, ftotal + 1
+
+    out, leaf_out, _, _ = jax.lax.while_loop(
+        cond, body, (out, leaf_out, undef, jnp.int32(0)))
+    return out, leaf_out
+
+
+def _compact_rows(rows: jax.Array) -> jax.Array:
+    """Stable-compact NONE holes to the end of each row (firstn semantics:
+    the scalar result is the dense prefix).  jnp.argsort is stable."""
+    order = jnp.argsort(rows == NONE, axis=1)
+    return jnp.take_along_axis(rows, order, axis=1)
+
+
+class BatchMapper:
+    """Batched crush_do_rule over a compiled map.
+
+    >>> bm = BatchMapper(crush_map)
+    >>> out = bm.do_rule(ruleno, xs, result_max, reweight)   # (N, result_max)
+
+    firstn rules return NONE-compacted rows (dense prefix, NONE tail); indep
+    rules return positionally-stable rows with NONE holes — matching the
+    scalar crush_do_rule's list semantics in both cases.
+    """
+
+    def __init__(self, m: CrushMap, compiled: CompiledCrushMap | None = None):
+        self.map = m
+        self.compiled = compiled or compile_map(m)
+        self.arrays = _Arrays(self.compiled)
+        self._jit_cache: dict = {}
+
+    def do_rule(self, ruleno: int, xs, result_max: int, reweight) -> jax.Array:
+        xs = jnp.asarray(xs, dtype=jnp.uint32)
+        reweight = jnp.asarray(reweight, dtype=jnp.int64)
+        if (ruleno < 0 or ruleno >= self.map.max_rules
+                or self.map.rules[ruleno] is None):
+            # crush_do_rule returns empty for unknown rules (mapper.c:902-904)
+            return jnp.full((xs.shape[0], result_max), NONE, dtype=jnp.int32)
+        key = (ruleno, result_max)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(
+                functools.partial(self._run, ruleno, result_max))
+        return self._jit_cache[key](xs, reweight)
+
+    # -- the rule interpreter (mapper.c:900-1105) -----------------------------
+
+    def _run(self, ruleno: int, result_max: int, xs, reweight):
+        a = self.arrays
+        rule = self.map.rules[ruleno]
+        n = xs.shape[0]
+        t = self.map.tunables
+
+        choose_tries = self.compiled.tunables_tries
+        choose_leaf_tries = 0
+        vary_r = t.chooseleaf_vary_r
+        # working set: per-lane item ids, NONE-padded; starts empty
+        w = jnp.full((n, result_max), NONE, dtype=jnp.int32)
+        wsize = 0
+        results = []
+
+        for step in rule.steps:
+            if step.op == RULE_TAKE:
+                # validate like the reference (mapper.c:941-948): unknown
+                # bucket / device -> the take is ignored
+                ok = (0 <= step.arg1 < self.map.max_devices or
+                      self.map.bucket(step.arg1) is not None)
+                if ok:
+                    w = w.at[:, 0].set(jnp.int32(step.arg1))
+                    wsize = 1
+            elif step.op == RULE_SET_CHOOSE_TRIES:
+                if step.arg1 > 0:
+                    choose_tries = step.arg1
+            elif step.op == RULE_SET_CHOOSELEAF_TRIES:
+                if step.arg1 > 0:
+                    choose_leaf_tries = step.arg1
+            elif step.op in (RULE_SET_CHOOSE_LOCAL_TRIES,
+                             RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES):
+                if step.arg1 > 0:
+                    raise ValueError(
+                        "legacy local-retry tunables are scalar-only")
+            elif step.op == RULE_SET_CHOOSELEAF_VARY_R:
+                if step.arg1 >= 0:
+                    vary_r = step.arg1
+            elif step.op == RULE_SET_CHOOSELEAF_STABLE:
+                if step.arg1 >= 0 and step.arg1 != 1:
+                    raise ValueError("batched mapper requires stable=1")
+            elif step.op in (RULE_CHOOSE_FIRSTN, RULE_CHOOSELEAF_FIRSTN,
+                             RULE_CHOOSE_INDEP, RULE_CHOOSELEAF_INDEP):
+                if wsize == 0:
+                    continue
+                firstn = step.op in (RULE_CHOOSE_FIRSTN, RULE_CHOOSELEAF_FIRSTN)
+                leafy = step.op in (RULE_CHOOSELEAF_FIRSTN,
+                                    RULE_CHOOSELEAF_INDEP)
+                # numrep <= 0 means result_max + numrep (mapper.c:1009-1014)
+                numrep = step.arg1
+                if numrep <= 0:
+                    numrep += result_max
+                    if numrep <= 0:
+                        continue
+                if firstn:
+                    recurse = (choose_leaf_tries or
+                               (1 if t.chooseleaf_descend_once
+                                else choose_tries))
+                else:
+                    recurse = choose_leaf_tries if choose_leaf_tries else 1
+                outs = []
+                for i in range(wsize):
+                    src = w[:, i]
+                    active = src != NONE
+                    start = _widx(a, src)
+                    # a TAKE of a device id (src >= 0) is degenerate; treat
+                    # as inactive like the reference's type check would
+                    active = active & (src < 0)
+                    if firstn:
+                        # all numrep reps are attempted (count limiting in the
+                        # reference only caps kept successes — equivalent to
+                        # post-compaction truncation)
+                        o, leaf = _choose_firstn(
+                            a, xs, start, numrep, step.arg2, choose_tries,
+                            recurse, vary_r, leafy, reweight, active)
+                    else:
+                        o, leaf = _choose_indep(
+                            a, xs, start, min(numrep, result_max), numrep,
+                            step.arg2, choose_tries, recurse,
+                            leafy, reweight, active)
+                    outs.append(leaf if leafy else o)
+                new_w = jnp.concatenate(outs, axis=1)[:, :result_max]
+                if firstn:
+                    new_w = _compact_rows(new_w)
+                w = jnp.full((n, result_max), NONE, dtype=jnp.int32)
+                w = w.at[:, :new_w.shape[1]].set(new_w)
+                wsize = new_w.shape[1]
+            elif step.op == RULE_EMIT:
+                results.append(w[:, :wsize])
+                w = jnp.full((n, result_max), NONE, dtype=jnp.int32)
+                wsize = 0
+        if not results:
+            return jnp.full((n, result_max), NONE, dtype=jnp.int32)
+        res = jnp.concatenate(results, axis=1)[:, :result_max]
+        pad = result_max - res.shape[1]
+        if pad > 0:
+            res = jnp.concatenate(
+                [res, jnp.full((n, pad), NONE, dtype=jnp.int32)], axis=1)
+        return res

@@ -1,0 +1,15 @@
+"""Distributed object store (RADOS analog): maps, placement, and (as they land)
+the OSD daemon, PG logic, and backends.
+
+The placement pipeline mirrors src/osd/OSDMap.{h,cc}: objects hash to PGs
+(ceph_stable_mod), PGs hash to placement seeds (pps), CRUSH maps seeds to OSD
+sets, then upmap/primary-affinity/temp overrides apply.  Bulk evaluation is the
+batched device mapper (ceph_tpu.crush.mapper_jax) — the OSDMapMapping /
+ParallelPGMapper analog with the thread pool replaced by one device call.
+"""
+
+from .osdmap import OSDMap, PGPool, pg_to_pgid, ceph_stable_mod
+from .mapping import OSDMapMapping
+
+__all__ = ["OSDMap", "PGPool", "pg_to_pgid", "ceph_stable_mod",
+           "OSDMapMapping"]

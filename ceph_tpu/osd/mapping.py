@@ -1,0 +1,83 @@
+"""Bulk PG -> OSD mapping on device (OSDMapMapping / ParallelPGMapper analog).
+
+The reference computes the full PG->OSD table with a thread pool over pgid
+batches (src/osd/OSDMapMapping.h:17 ParallelPGMapper, used by the mgr balancer
+and OSDMonitor).  Here the whole pool maps in one device call: the pps seeds
+are a vectorized stable_mod + rjenkins hash, and placement is the batched rule
+engine (ceph_tpu.crush.mapper_jax.BatchMapper).
+
+Post-CRUSH overrides (upmap, primary affinity, temps) are sparse per-PG state
+and apply host-side on the dense result — the same split the reference uses
+(its mapping cache also stores raw CRUSH output and applies overrides on read).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ceph_tpu.crush.mapper_jax import BatchMapper
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+from ceph_tpu.ops.crush_kernel import hash32_2
+
+from .osdmap import CEPH_NOSD, OSDMap, PGPool, ceph_stable_mod
+
+
+def pps_batch(pool: PGPool, pgids: np.ndarray) -> np.ndarray:
+    """Vectorized raw_pg_to_pps over pg ids (osd_types.cc:1505-1521)."""
+    import jax.numpy as jnp
+    ps = np.asarray(pgids, dtype=np.uint32)
+    bmask = pool.pgp_num_mask
+    low = ps & bmask
+    stable = np.where(low < pool.pgp_num, low, ps & (bmask >> 1))
+    return np.asarray(hash32_2(jnp.asarray(stable),
+                               jnp.uint32(pool.pool_id & 0xFFFFFFFF)))
+
+
+class OSDMapMapping:
+    """Full-map PG->OSD cache, updated per epoch (OSDMapMapping.h:324-332)."""
+
+    def __init__(self, osdmap: OSDMap):
+        self.osdmap = osdmap
+        self._mappers: dict[int, BatchMapper] = {}
+        self._raw: dict[int, np.ndarray] = {}    # pool -> (pg_num, size) raw
+        self.epoch = -1
+
+    def update(self) -> None:
+        """Recompute every pool's raw placements (start_update/update)."""
+        m = self.osdmap
+        self._mappers.clear()
+        self._raw.clear()
+        bm = BatchMapper(m.crush)
+        weights = np.zeros(max(m.max_osd, 1), dtype=np.int64)
+        weights[:len(m.osd_weight)] = m.osd_weight
+        for pool_id, pool in m.pools.items():
+            if (pool.crush_rule < 0 or pool.crush_rule >= m.crush.max_rules
+                    or m.crush.rules[pool.crush_rule] is None):
+                # invalid rule -> empty raw, matching _pg_to_raw_osds's []
+                self._raw[pool_id] = np.zeros((pool.pg_num, 0), dtype=np.int32)
+                continue
+            pgids = np.arange(pool.pg_num, dtype=np.uint32)
+            pps = pps_batch(pool, pgids)
+            out = bm.do_rule(pool.crush_rule, pps, pool.size, weights)
+            self._raw[pool_id] = np.asarray(out)
+        self.epoch = m.epoch
+
+    def get_raw(self, pool_id: int) -> np.ndarray:
+        """(pg_num, size) int32 raw CRUSH output, CRUSH_ITEM_NONE holes."""
+        return self._raw[pool_id]
+
+    def get(self, pool_id: int, pgid: int
+            ) -> tuple[list[int], int, list[int], int]:
+        """Full pipeline for one PG using the cached raw placement."""
+        m = self.osdmap
+        pool = m.pools[pool_id]
+        raw = [int(o) for o in self._raw[pool_id][pgid]]
+        if not pool.is_erasure():
+            raw = [o for o in raw if o != CRUSH_ITEM_NONE]
+        return m._finish_pg_mapping(pool, (pool_id, pgid), raw)
+
+    def pg_counts(self, pool_id: int) -> np.ndarray:
+        """Per-OSD PG count histogram for a pool (balancer input)."""
+        raw = self._raw[pool_id]
+        valid = raw[(raw != CRUSH_ITEM_NONE) & (raw >= 0)]
+        return np.bincount(valid, minlength=self.osdmap.max_osd)

@@ -1,0 +1,227 @@
+"""OSDMap: the replicated cluster map and its placement pipeline.
+
+Semantics follow src/osd/OSDMap.{h,cc} and src/osd/osd_types.cc:
+
+  object -> pg      ceph_str_hash_rjenkins(object name) -> ps, then
+                    ceph_stable_mod(ps, pg_num, pg_num_mask)   (rados.h:85-91)
+  pg -> pps         crush_hash32_2(stable_mod(ps, pgp_num, pgp_num_mask), pool)
+                    (osd_types.cc:1505-1521 raw_pg_to_pps)
+  pps -> raw osds   crush do_rule with per-osd reweight   (OSDMap.cc:2198-2216)
+  raw -> up         drop nonexistent/down osds (compact for replicated, NONE
+                    holes for erasure)                    (OSDMap.cc:2275-2297)
+  upmap             pg_upmap / pg_upmap_items overrides   (OSDMap.cc:2228-2272)
+  primary affinity  hash coin-flip primary reselection    (OSDMap.cc:2299+)
+  temp              pg_temp / primary_temp                (OSDMap.cc:2417-2445)
+
+The scalar path is the oracle; OSDMapMapping (mapping.py) batches the heavy
+middle (pps -> raw osds) on device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ceph_tpu.crush.hashfn import crush_hash32_2
+from ceph_tpu.crush.mapper_ref import crush_do_rule
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE, CrushMap
+
+CEPH_NOSD = -1
+
+POOL_TYPE_REPLICATED = 1
+POOL_TYPE_ERASURE = 3
+
+OSD_EXISTS = 1
+OSD_UP = 2
+
+MAX_AFFINITY = 0x10000
+
+
+def _pg_mask(n: int) -> int:
+    """calc_pg_masks (osd_types.cc): smallest 2^b-1 >= n-1."""
+    if n <= 1:
+        return 0
+    return (1 << (n - 1).bit_length()) - 1
+
+
+def ceph_stable_mod(x: int, b: int, bmask: int) -> int:
+    """include/rados.h:85-91 — stable under pg_num growth."""
+    if (x & bmask) < b:
+        return x & bmask
+    return x & (bmask >> 1)
+
+
+def pg_to_pgid(ps: int, pg_num: int) -> int:
+    """raw ps -> actual pg id within the pool (raw_pg_to_pg)."""
+    return ceph_stable_mod(ps, pg_num, _pg_mask(pg_num))
+
+
+@dataclass
+class PGPool:
+    """pg_pool_t (src/osd/osd_types.h) — the subset that affects placement."""
+
+    pool_id: int
+    type: int = POOL_TYPE_REPLICATED
+    size: int = 3
+    min_size: int = 2
+    crush_rule: int = 0
+    pg_num: int = 64
+    pgp_num: int = 0  # 0 -> pg_num
+
+    def __post_init__(self):
+        if self.pgp_num == 0:
+            self.pgp_num = self.pg_num
+
+    @property
+    def pg_num_mask(self) -> int:
+        return _pg_mask(self.pg_num)
+
+    @property
+    def pgp_num_mask(self) -> int:
+        return _pg_mask(self.pgp_num)
+
+    def raw_pg_to_pps(self, ps: int) -> int:
+        """osd_types.cc:1505-1521 — placement seed for CRUSH."""
+        return crush_hash32_2(
+            ceph_stable_mod(ps, self.pgp_num, self.pgp_num_mask),
+            self.pool_id)
+
+    def is_erasure(self) -> bool:
+        return self.type == POOL_TYPE_ERASURE
+
+
+@dataclass
+class OSDMap:
+    """The authoritative cluster map (src/osd/OSDMap.h:class OSDMap)."""
+
+    epoch: int = 1
+    crush: CrushMap = field(default_factory=CrushMap)
+    max_osd: int = 0
+    osd_state: list[int] = field(default_factory=list)   # EXISTS|UP bits
+    osd_weight: list[int] = field(default_factory=list)  # 16.16 reweight
+    osd_primary_affinity: list[int] = field(default_factory=list)
+    pools: dict[int, PGPool] = field(default_factory=dict)
+    # overrides
+    pg_upmap: dict[tuple[int, int], list[int]] = field(default_factory=dict)
+    pg_upmap_items: dict[tuple[int, int], list[tuple[int, int]]] = \
+        field(default_factory=dict)
+    pg_temp: dict[tuple[int, int], list[int]] = field(default_factory=dict)
+    primary_temp: dict[tuple[int, int], int] = field(default_factory=dict)
+
+    # -- osd state ------------------------------------------------------------
+
+    def set_max_osd(self, n: int) -> None:
+        """OSDMap::set_max_osd — grow the state vectors."""
+        self.max_osd = n
+        for vec, dflt in ((self.osd_state, 0), (self.osd_weight, 0),
+                          (self.osd_primary_affinity, MAX_AFFINITY)):
+            while len(vec) < n:
+                vec.append(dflt)
+
+    def is_up(self, osd: int) -> bool:
+        return (0 <= osd < self.max_osd
+                and bool(self.osd_state[osd] & OSD_UP))
+
+    def exists(self, osd: int) -> bool:
+        return (0 <= osd < self.max_osd
+                and bool(self.osd_state[osd] & OSD_EXISTS))
+
+    def mark_up(self, osd: int, weight: int = 0x10000) -> None:
+        self.osd_state[osd] = OSD_EXISTS | OSD_UP
+        self.osd_weight[osd] = weight
+
+    def mark_down(self, osd: int) -> None:
+        self.osd_state[osd] &= ~OSD_UP
+
+    def mark_out(self, osd: int) -> None:
+        self.osd_weight[osd] = 0
+
+    # -- placement pipeline (scalar oracle) -----------------------------------
+
+    def _pg_to_raw_osds(self, pool: PGPool, ps: int) -> list[int]:
+        """OSDMap.cc:2198-2216."""
+        pps = pool.raw_pg_to_pps(ps)
+        ruleno = pool.crush_rule
+        if ruleno < 0 or ruleno >= self.crush.max_rules:
+            return []
+        return crush_do_rule(self.crush, ruleno, pps, pool.size,
+                             self.osd_weight)
+
+    def _apply_upmap(self, pool: PGPool, pgid: tuple[int, int],
+                     raw: list[int]) -> list[int]:
+        """OSDMap.cc:2228-2272 — explicit overrides, validity-checked."""
+        pm = self.pg_upmap.get(pgid)
+        if pm:
+            if all(self.exists(o) and not self._is_out(o) for o in pm):
+                return list(pm)
+        pairs = self.pg_upmap_items.get(pgid)
+        if pairs:
+            raw = list(raw)
+            for frm, to in pairs:
+                if (frm in raw and to not in raw and self.exists(to)
+                        and not self._is_out(to)):
+                    raw[raw.index(frm)] = to
+        return raw
+
+    def _is_out(self, osd: int) -> bool:
+        return not (0 <= osd < self.max_osd) or self.osd_weight[osd] == 0
+
+    def _raw_to_up_osds(self, pool: PGPool, raw: list[int]
+                        ) -> tuple[list[int], int]:
+        """OSDMap.cc:2275-2297: erasure keeps positions (NONE holes),
+        replicated compacts; primary = first valid."""
+        if pool.is_erasure():
+            up = [o if (o != CRUSH_ITEM_NONE and self.exists(o)
+                        and self.is_up(o)) else CEPH_NOSD for o in raw]
+            primary = next((o for o in up if o != CEPH_NOSD), CEPH_NOSD)
+        else:
+            up = [o for o in raw
+                  if o != CRUSH_ITEM_NONE and self.exists(o) and self.is_up(o)]
+            primary = up[0] if up else CEPH_NOSD
+        return up, primary
+
+    def _apply_primary_affinity(self, seed: int, up: list[int],
+                                primary: int) -> int:
+        """OSDMap.cc _apply_primary_affinity: the first osd in up that wins
+        the affinity coin flip (hash(seed, o) >> 16 < affinity) becomes
+        primary; default-affinity osds always win their flip."""
+        if not up or all(
+                not (0 <= o < self.max_osd)
+                or self.osd_primary_affinity[o] == MAX_AFFINITY
+                for o in up if o != CEPH_NOSD):
+            return primary
+        for pos, o in enumerate(up):
+            if o == CEPH_NOSD:
+                continue
+            a = self.osd_primary_affinity[o] \
+                if 0 <= o < self.max_osd else MAX_AFFINITY
+            if a == MAX_AFFINITY:
+                return o
+            if (crush_hash32_2(seed, o) >> 16) < a:
+                return o
+        return primary
+
+    def _finish_pg_mapping(self, pool: PGPool, pgid: tuple[int, int],
+                           raw: list[int]
+                           ) -> tuple[list[int], int, list[int], int]:
+        """Post-CRUSH pipeline tail: upmap -> up -> primary affinity -> temps.
+        Shared by the scalar path and the batched mapping cache."""
+        raw = self._apply_upmap(pool, pgid, raw)
+        up, up_primary = self._raw_to_up_osds(pool, raw)
+        up_primary = self._apply_primary_affinity(pgid[1], up, up_primary)
+        acting = list(self.pg_temp.get(pgid, [])) or list(up)
+        acting_primary = self.primary_temp.get(pgid, CEPH_NOSD)
+        if acting_primary == CEPH_NOSD:
+            acting_primary = next(
+                (o for o in acting if o != CEPH_NOSD), CEPH_NOSD)
+            if acting == up:
+                acting_primary = up_primary
+        return up, up_primary, acting, acting_primary
+
+    def pg_to_up_acting_osds(self, pool_id: int, ps: int
+                             ) -> tuple[list[int], int, list[int], int]:
+        """OSDMap.cc:2417-2445 — returns (up, up_primary, acting,
+        acting_primary)."""
+        pool = self.pools[pool_id]
+        pgid = (pool_id, pg_to_pgid(ps, pool.pg_num))
+        raw = self._pg_to_raw_osds(pool, pgid[1])
+        return self._finish_pg_mapping(pool, pgid, raw)

@@ -8,8 +8,6 @@ slice the mesh should be laid out so ``ec`` rides the minor (fastest ICI) axis â
 
 from __future__ import annotations
 
-import math
-
 import jax
 import numpy as np
 from jax.sharding import Mesh
@@ -53,10 +51,3 @@ def make_mesh(n_devices: int | None = None, *, ec: int | None = None,
     return Mesh(dev_array, axis_names=("dp", "ec"))
 
 
-def mesh_shape(mesh: Mesh) -> tuple[int, int]:
-    return mesh.shape["dp"], mesh.shape["ec"]
-
-
-def pad_to(n: int, multiple: int) -> int:
-    """Smallest value >= n that is a multiple of ``multiple``."""
-    return int(math.ceil(n / multiple) * multiple)

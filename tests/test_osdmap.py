@@ -1,0 +1,143 @@
+"""OSDMap placement-pipeline tests: scalar oracle semantics and batched
+full-map equality (OSDMap.cc / OSDMapMapping.h analogs)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import build_two_level_map
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+from ceph_tpu.osd import OSDMap, OSDMapMapping, PGPool, ceph_stable_mod
+from ceph_tpu.osd.osdmap import (
+    CEPH_NOSD, POOL_TYPE_ERASURE, POOL_TYPE_REPLICATED)
+
+
+def make_cluster(n_hosts=6, osds_per_host=4):
+    crush, _root, rule = build_two_level_map(n_hosts, osds_per_host)
+    m = OSDMap(crush=crush)
+    n = n_hosts * osds_per_host
+    m.set_max_osd(n)
+    for o in range(n):
+        m.mark_up(o)
+    m.pools[1] = PGPool(pool_id=1, type=POOL_TYPE_REPLICATED, size=3,
+                        crush_rule=rule, pg_num=64)
+    return m
+
+
+def test_stable_mod_matches_reference_property():
+    # ceph_stable_mod(x, b, bmask) == x % b when b is a power of two
+    for b in (1, 2, 4, 8, 64):
+        bmask = b - 1
+        for x in range(200):
+            assert ceph_stable_mod(x, b, bmask) == x % b
+    # growth stability: half the pgs keep their mapping when pg_num doubles
+    moved = sum(ceph_stable_mod(x, 12, 15) != ceph_stable_mod(x, 8, 7)
+                for x in range(1024))
+    assert 0 < moved < 1024
+
+
+def test_pg_to_up_acting_basic():
+    m = make_cluster()
+    ups = set()
+    for pg in range(64):
+        up, upp, acting, actp = m.pg_to_up_acting_osds(1, pg)
+        assert len(up) == 3
+        assert len(set(up)) == 3
+        assert upp == up[0]
+        assert acting == up and actp == upp
+        ups.update(up)
+    assert len(ups) > 12  # spread across the cluster
+
+
+def test_down_osd_leaves_up_set():
+    m = make_cluster()
+    up0, *_ = m.pg_to_up_acting_osds(1, 0)
+    victim = up0[0]
+    m.mark_down(victim)
+    up1, upp, _, _ = m.pg_to_up_acting_osds(1, 0)
+    assert victim not in up1
+    assert upp != victim
+
+
+def test_out_osd_remapped_by_crush():
+    m = make_cluster()
+    up0, *_ = m.pg_to_up_acting_osds(1, 0)
+    victim = up0[0]
+    m.mark_out(victim)  # weight 0: CRUSH rejects it, set stays full
+    up1, *_ = m.pg_to_up_acting_osds(1, 0)
+    assert victim not in up1
+    assert len(up1) == 3
+
+
+def test_erasure_pool_keeps_positions():
+    m = make_cluster()
+    m.pools[2] = PGPool(pool_id=2, type=POOL_TYPE_ERASURE, size=4,
+                        crush_rule=0, pg_num=32)
+    up, upp, _, _ = m.pg_to_up_acting_osds(2, 3)
+    assert len(up) == 4
+    victim = up[1]
+    m.mark_down(victim)
+    up2, *_ = m.pg_to_up_acting_osds(2, 3)
+    assert len(up2) == 4
+    assert up2[1] == CEPH_NOSD      # positional hole, not compaction
+    assert [o for i, o in enumerate(up2) if i != 1] == \
+           [o for i, o in enumerate(up) if i != 1]
+
+
+def test_pg_upmap_items_override():
+    m = make_cluster()
+    up0, *_ = m.pg_to_up_acting_osds(1, 5)
+    frm = up0[1]
+    to = next(o for o in range(m.max_osd) if o not in up0)
+    m.pg_upmap_items[(1, 5)] = [(frm, to)]
+    up1, *_ = m.pg_to_up_acting_osds(1, 5)
+    assert to in up1 and frm not in up1
+
+
+def test_pg_upmap_full_override():
+    m = make_cluster()
+    m.pg_upmap[(1, 7)] = [0, 4, 8]
+    up, upp, _, _ = m.pg_to_up_acting_osds(1, 7)
+    assert up == [0, 4, 8] and upp == 0
+
+
+def test_pg_temp_and_primary_temp():
+    m = make_cluster()
+    m.pg_temp[(1, 9)] = [1, 2, 3]
+    m.primary_temp[(1, 9)] = 3
+    up, upp, acting, actp = m.pg_to_up_acting_osds(1, 9)
+    assert acting == [1, 2, 3] and actp == 3
+    assert up != acting  # up still CRUSH-computed
+
+
+def test_primary_affinity_zero_shifts_primary():
+    m = make_cluster()
+    up0, upp0, _, _ = m.pg_to_up_acting_osds(1, 11)
+    m.osd_primary_affinity[upp0] = 0  # never primary
+    up1, upp1, _, _ = m.pg_to_up_acting_osds(1, 11)
+    assert up1 == up0           # membership unchanged
+    assert upp1 != upp0         # leadership moved
+
+
+def test_batched_mapping_matches_scalar():
+    m = make_cluster(n_hosts=8, osds_per_host=4)
+    m.pools[3] = PGPool(pool_id=3, type=POOL_TYPE_ERASURE, size=4,
+                        crush_rule=0, pg_num=128)
+    m.mark_down(5)
+    m.mark_out(9)
+    m.osd_primary_affinity[2] = 0x8000
+    m.pg_upmap_items[(1, 3)] = [(m.pg_to_up_acting_osds(1, 3)[0][0], 30)]
+    mapping = OSDMapMapping(m)
+    mapping.update()
+    for pool_id, pool in m.pools.items():
+        for pg in range(pool.pg_num):
+            assert mapping.get(pool_id, pg) == \
+                m.pg_to_up_acting_osds(pool_id, pg), (pool_id, pg)
+
+
+def test_pg_counts_histogram():
+    m = make_cluster()
+    mapping = OSDMapMapping(m)
+    mapping.update()
+    counts = mapping.pg_counts(1)
+    assert counts.sum() == 64 * 3
+    assert (counts > 0).sum() > 12
