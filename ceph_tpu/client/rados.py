@@ -1,0 +1,288 @@
+"""librados-style client + Objecter.
+
+The op path mirrors the reference (SURVEY.md §3.1): IoCtx.operate -> Objecter
+op_submit -> _calc_target (client-side CRUSH on the subscribed OSDMap) ->
+MOSDOp to the primary -> MOSDOpReply completes the waiter.  Map updates
+re-target and resend every in-flight op (Objecter resend-on-map-change).
+
+Object -> ps uses ceph_str_hash_rjenkins (src/common/ceph_hash.cc) — the
+Jenkins lookup2 string hash, distinct from the CRUSH rjenkins1 mix.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ceph_tpu.common.context import CephTpuContext
+from ceph_tpu.messages import MMonCommand, MMonCommandAck, MOSDMapMsg, MOSDOp
+from ceph_tpu.messages.osd_msgs import (
+    OP_DELETE, OP_OMAP_GET, OP_OMAP_SET, OP_READ, OP_STAT, OP_WRITE,
+    OP_WRITEFULL, OSDOpField)
+from ceph_tpu.mon.monitor import MMonSubscribe
+from ceph_tpu.msg.encoding import Decoder, Encoder
+from ceph_tpu.msg.messenger import (
+    ConnectionPolicy, Dispatcher, EntityName, Messenger)
+from ceph_tpu.messages import MOSDOpReply
+from ceph_tpu.osd.map_codec import decode_osdmap
+from ceph_tpu.osd.osdmap import CEPH_NOSD, OSDMap, pg_to_pgid
+
+_M32 = 0xFFFFFFFF
+
+
+def _mix3(a: int, b: int, c: int) -> tuple[int, int, int]:
+    """Jenkins lookup2 mix (ceph_hash.cc mix() macro)."""
+    a = (a - b - c) & _M32; a ^= c >> 13
+    b = (b - c - a) & _M32; b ^= (a << 8) & _M32
+    c = (c - a - b) & _M32; c ^= b >> 13
+    a = (a - b - c) & _M32; a ^= c >> 12
+    b = (b - c - a) & _M32; b ^= (a << 16) & _M32
+    c = (c - a - b) & _M32; c ^= b >> 5
+    a = (a - b - c) & _M32; a ^= c >> 3
+    b = (b - c - a) & _M32; b ^= (a << 10) & _M32
+    c = (c - a - b) & _M32; c ^= b >> 15
+    return a, b, c
+
+
+def ceph_str_hash_rjenkins(s: bytes | str) -> int:
+    """ceph_str_hash_rjenkins (src/common/ceph_hash.cc): lookup2 over bytes."""
+    if isinstance(s, str):
+        s = s.encode("utf-8")
+    length = len(s)
+    a = b = 0x9E3779B9
+    c = 0
+    i = 0
+    while length - i >= 12:
+        a = (a + int.from_bytes(s[i:i + 4], "little")) & _M32
+        b = (b + int.from_bytes(s[i + 4:i + 8], "little")) & _M32
+        c = (c + int.from_bytes(s[i + 8:i + 12], "little")) & _M32
+        a, b, c = _mix3(a, b, c)
+        i += 12
+    c = (c + length) & _M32
+    rest = s[i:]
+    if len(rest) >= 11:
+        c = (c + (rest[10] << 24)) & _M32
+    if len(rest) >= 10:
+        c = (c + (rest[9] << 16)) & _M32
+    if len(rest) >= 9:
+        c = (c + (rest[8] << 8)) & _M32
+    if len(rest) >= 8:
+        b = (b + (rest[7] << 24)) & _M32
+    if len(rest) >= 7:
+        b = (b + (rest[6] << 16)) & _M32
+    if len(rest) >= 6:
+        b = (b + (rest[5] << 8)) & _M32
+    if len(rest) >= 5:
+        b = (b + rest[4]) & _M32
+    if len(rest) >= 4:
+        a = (a + (rest[3] << 24)) & _M32
+    if len(rest) >= 3:
+        a = (a + (rest[2] << 16)) & _M32
+    if len(rest) >= 2:
+        a = (a + (rest[1] << 8)) & _M32
+    if len(rest) >= 1:
+        a = (a + rest[0]) & _M32
+    a, b, c = _mix3(a, b, c)
+    return c
+
+
+class _Waiter:
+    def __init__(self, msg: MOSDOp):
+        self.msg = msg
+        self.event = threading.Event()
+        self.reply: MOSDOpReply | None = None
+
+
+class RadosClient(Dispatcher):
+    """RadosClient + Objecter (librados/RadosClient.cc:229 connect)."""
+
+    _next_client_id = 1
+    _id_lock = threading.Lock()
+
+    def __init__(self, mon_addr: str, ctx: CephTpuContext | None = None,
+                 ms_type: str = "async", timeout: float = 10.0):
+        with RadosClient._id_lock:
+            self.client_id = RadosClient._next_client_id
+            RadosClient._next_client_id += 1
+        self.ctx = ctx or CephTpuContext(f"client.{self.client_id}")
+        self.mon_addr = mon_addr
+        self.timeout = timeout
+        self.osdmap = OSDMap()
+        self._map_event = threading.Event()
+        self._lock = threading.RLock()
+        self._next_tid = 1
+        self._waiters: dict[int, _Waiter] = {}
+        self._cmd_waiters: dict[int, tuple[threading.Event, list]] = {}
+        self.name = EntityName("client", self.client_id)
+        self.msgr = Messenger.create(self.name, ms_type)
+        self.msgr.set_policy("osd", ConnectionPolicy.stateful_peer())
+        self.msgr.set_policy("mon", ConnectionPolicy.stateful_peer())
+        self.msgr.add_dispatcher_tail(self)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def connect(self) -> None:
+        self.msgr.bind("127.0.0.1:0") if _is_tcp(self.msgr) else \
+            self.msgr.bind(f"client.{self.client_id}")
+        self.msgr.start()
+        mon = self.msgr.connect_to(self.mon_addr, EntityName("mon", 0))
+        mon.send_message(MMonSubscribe(name=str(self.name),
+                                       addr=self.msgr.my_addr))
+        if not self._map_event.wait(self.timeout):
+            raise TimeoutError("no OSDMap from mon")
+
+    def shutdown(self) -> None:
+        self.msgr.shutdown()
+
+    # -- dispatch -------------------------------------------------------------
+
+    def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, MOSDMapMsg):
+            with self._lock:
+                newmap = decode_osdmap(msg.map_blob)
+                if newmap.epoch <= self.osdmap.epoch:
+                    return True
+                self.osdmap = newmap
+                pending = list(self._waiters.values())
+            self._map_event.set()
+            for w in pending:   # resend on map change (Objecter semantics)
+                self._send_op(w)
+            return True
+        if isinstance(msg, MOSDOpReply):
+            with self._lock:
+                w = self._waiters.pop(msg.tid, None)
+            if w is not None:
+                w.reply = msg
+                w.event.set()
+            return True
+        if isinstance(msg, MMonCommandAck):
+            with self._lock:
+                cw = self._cmd_waiters.pop(msg.tid, None)
+            if cw is not None:
+                cw[1].append(msg)
+                cw[0].set()
+            return True
+        return False
+
+    # -- mon commands ---------------------------------------------------------
+
+    def mon_command(self, cmd: dict) -> tuple[int, str]:
+        with self._lock:
+            tid = self._next_tid
+            self._next_tid += 1
+            ev: tuple[threading.Event, list] = (threading.Event(), [])
+            self._cmd_waiters[tid] = ev
+        mon = self.msgr.connect_to(self.mon_addr, EntityName("mon", 0))
+        mon.send_message(MMonCommand(tid=tid, cmd=cmd))
+        if not ev[0].wait(self.timeout):
+            raise TimeoutError(f"mon command {cmd} timed out")
+        ack = ev[1][0]
+        return ack.result, ack.output
+
+    def wait_for_epoch(self, epoch: int, timeout: float | None = None
+                       ) -> None:
+        deadline = threading.Event()
+        t = timeout if timeout is not None else self.timeout
+        end = t
+        import time as _time
+        start = _time.time()
+        while self.osdmap.epoch < epoch:
+            if _time.time() - start > end:
+                raise TimeoutError(
+                    f"epoch {epoch} not reached (at {self.osdmap.epoch})")
+            deadline.wait(0.02)
+
+    # -- objecter -------------------------------------------------------------
+
+    def _calc_target(self, pool_id: int, oid: str) -> tuple[tuple[int, int],
+                                                            int]:
+        """osdc/Objecter.cc:2795 — object -> pg -> primary, client side."""
+        pool = self.osdmap.pools[pool_id]
+        ps = ceph_str_hash_rjenkins(oid)
+        # reduce to the pg first (raw_pg_to_pg), THEN place — the osd receives
+        # the reduced pg and must compute the identical mapping
+        pgid = pg_to_pgid(ps, pool.pg_num)
+        _up, _primary, _acting, acting_primary = \
+            self.osdmap.pg_to_up_acting_osds(pool_id, pgid)
+        return (pool_id, pgid), acting_primary
+
+    def _send_op(self, w: _Waiter) -> None:
+        pgid, primary = self._calc_target(w.msg.pgid[0], w.msg.oid)
+        w.msg.pgid = pgid
+        w.msg.epoch = self.osdmap.epoch
+        if primary == CEPH_NOSD:
+            return  # no primary this epoch; resent on next map
+        addr = self.osdmap.osd_addrs[primary]
+        con = self.msgr.connect_to(addr, EntityName("osd", primary))
+        con.send_message(w.msg)
+
+    def operate(self, pool_id: int, oid: str, ops: list[OSDOpField]
+                ) -> MOSDOpReply:
+        with self._lock:
+            tid = self._next_tid
+            self._next_tid += 1
+            msg = MOSDOp(client_id=self.client_id, tid=tid,
+                         pgid=(pool_id, 0), oid=oid, ops=ops,
+                         epoch=self.osdmap.epoch)
+            w = _Waiter(msg)
+            self._waiters[tid] = w
+        self._send_op(w)
+        if not w.event.wait(self.timeout):
+            with self._lock:
+                self._waiters.pop(tid, None)
+            raise TimeoutError(f"op {tid} on {oid} timed out")
+        if w.reply.result < 0:
+            raise OSError(-w.reply.result, f"op on {oid} failed")
+        return w.reply
+
+    # -- pools ----------------------------------------------------------------
+
+    def pool_id_by_name(self, name_or_id) -> int:
+        return int(name_or_id)
+
+    def open_ioctx(self, pool_id: int) -> "IoCtx":
+        return IoCtx(self, int(pool_id))
+
+
+def _is_tcp(msgr) -> bool:
+    from ceph_tpu.msg.async_tcp import AsyncMessenger
+    return isinstance(msgr, AsyncMessenger)
+
+
+class IoCtx:
+    """Pool I/O handle (librados IoCtx)."""
+
+    def __init__(self, client: RadosClient, pool_id: int):
+        self.client = client
+        self.pool_id = pool_id
+
+    def write_full(self, oid: str, data: bytes) -> None:
+        self.client.operate(self.pool_id, oid,
+                            [OSDOpField(OP_WRITEFULL, 0, len(data), data)])
+
+    def write(self, oid: str, data: bytes, offset: int = 0) -> None:
+        self.client.operate(self.pool_id, oid,
+                            [OSDOpField(OP_WRITE, offset, len(data), data)])
+
+    def read(self, oid: str, length: int = 0, offset: int = 0) -> bytes:
+        r = self.client.operate(self.pool_id, oid,
+                                [OSDOpField(OP_READ, offset, length)])
+        return r.ops[0].data if r.ops else b""
+
+    def remove(self, oid: str) -> None:
+        self.client.operate(self.pool_id, oid, [OSDOpField(OP_DELETE)])
+
+    def stat(self, oid: str) -> dict:
+        r = self.client.operate(self.pool_id, oid, [OSDOpField(OP_STAT)])
+        return {"size": r.ops[0].length}
+
+    def set_omap(self, oid: str, keys: dict) -> None:
+        e = Encoder()
+        e.map(keys, lambda e2, k: e2.str(k), lambda e2, v: e2.bytes(v))
+        self.client.operate(self.pool_id, oid,
+                            [OSDOpField(OP_OMAP_SET, 0, 0, e.tobytes())])
+
+    def get_omap(self, oid: str) -> dict:
+        r = self.client.operate(self.pool_id, oid,
+                                [OSDOpField(OP_OMAP_GET)])
+        return Decoder(r.ops[0].data).map(lambda d: d.str(),
+                                          lambda d: d.bytes())

@@ -1,0 +1,20 @@
+"""Foundation runtime (reference layer 0: src/common/, src/log/, src/global/).
+
+CephContext-style service locator, typed config registry with hot-reload
+observers, PerfCounters, leveled per-subsystem logging, admin-socket-style
+introspection, and throttles.  Every daemon and library in ceph_tpu builds on
+this layer, as in the reference (SURVEY.md §1 layer 0).
+"""
+
+from .config import Option, OPT_INT, OPT_STR, OPT_BOOL, OPT_FLOAT, Config
+from .context import CephTpuContext
+from .perf_counters import PerfCounters, PerfCountersBuilder
+from .logging import dout, get_logger, set_subsys_level
+from .admin_socket import AdminSocket
+from .throttle import Throttle
+
+__all__ = [
+    "Option", "OPT_INT", "OPT_STR", "OPT_BOOL", "OPT_FLOAT", "Config",
+    "CephTpuContext", "PerfCounters", "PerfCountersBuilder",
+    "dout", "get_logger", "set_subsys_level", "AdminSocket", "Throttle",
+]

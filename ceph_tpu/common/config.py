@@ -1,0 +1,155 @@
+"""Typed configuration registry with observers.
+
+The reference keeps one declarative option table (src/common/options.cc, 7510
+lines of Option{name, type, level, default, description, flags}) consumed by
+md_config_t (common/config.h:152-223) with observer-based hot reload
+(common/config_obs.h).  Sources are layered: compiled defaults < config file <
+mon config-db < env < CLI < runtime `config set`.  This module mirrors that:
+a declarative OPTIONS table, a Config object with layered sources, and
+observers notified on runtime changes.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+
+OPT_INT = "int"
+OPT_STR = "str"
+OPT_BOOL = "bool"
+OPT_FLOAT = "float"
+
+LEVEL_BASIC = "basic"
+LEVEL_ADVANCED = "advanced"
+LEVEL_DEV = "dev"
+
+_CASTS = {
+    OPT_INT: int,
+    OPT_FLOAT: float,
+    OPT_STR: str,
+    OPT_BOOL: lambda v: (v if isinstance(v, bool)
+                         else str(v).lower() in ("true", "1", "yes", "on")),
+}
+
+
+@dataclass(frozen=True)
+class Option:
+    name: str
+    type: str
+    default: object
+    description: str = ""
+    level: str = LEVEL_ADVANCED
+    runtime: bool = True      # changeable without restart (flag RUNTIME)
+    see_also: tuple = ()
+
+    def cast(self, value):
+        try:
+            return _CASTS[self.type](value)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"option {self.name}: {value!r} is not a valid {self.type}")
+
+
+#: The central option table (options.cc analog).  Components register theirs
+#: at import via register_options().
+OPTIONS: dict[str, Option] = {}
+
+
+def register_options(opts: list[Option]) -> None:
+    for o in opts:
+        if o.name in OPTIONS and OPTIONS[o.name] != o:
+            raise ValueError(f"conflicting re-registration of {o.name}")
+        OPTIONS[o.name] = o
+
+
+register_options([
+    Option("erasure_code_plugins", OPT_STR, "jerasure isa",
+           "plugins preloaded at init (options.cc:2197 analog)"),
+    Option("erasure_code_runtime", OPT_STR, "tpu",
+           "default EC execution runtime: tpu | cpu"),
+    Option("crush_backend", OPT_STR, "tpu",
+           "bulk placement backend: tpu (BatchMapper) | scalar"),
+    Option("osd_pool_default_size", OPT_INT, 3, "replicas per object"),
+    Option("osd_pool_default_min_size", OPT_INT, 2,
+           "min replicas to serve IO"),
+    Option("osd_pool_default_pg_num", OPT_INT, 32, "pgs per new pool"),
+    Option("osd_heartbeat_interval", OPT_FLOAT, 1.0,
+           "seconds between peer pings (osd_heartbeat_interval analog)"),
+    Option("osd_heartbeat_grace", OPT_FLOAT, 6.0,
+           "seconds without ping before reporting failure"),
+    Option("mon_osd_min_down_reporters", OPT_INT, 2,
+           "distinct reporters before the mon marks an osd down"),
+    Option("log_level", OPT_INT, 1, "default subsystem log level"),
+    Option("ms_type", OPT_STR, "async",
+           "messenger implementation: async | loopback"),
+    Option("objectstore", OPT_STR, "memstore",
+           "object store backend: memstore | filestore"),
+])
+
+
+class Config:
+    """Layered config with observers (md_config_t analog)."""
+
+    #: source precedence, low to high (config.h "sources" semantics)
+    SOURCES = ("default", "file", "mon", "env", "cli", "runtime")
+
+    def __init__(self, options: dict[str, Option] | None = None):
+        self._options = options if options is not None else OPTIONS
+        self._lock = threading.RLock()
+        self._values: dict[str, dict[str, object]] = {}  # name -> src -> val
+        self._observers: dict[str, list] = {}            # name -> callbacks
+
+    def get(self, name: str):
+        with self._lock:
+            opt = self._lookup(name)
+            layers = self._values.get(name, {})
+            for src in reversed(self.SOURCES):
+                if src in layers:
+                    return layers[src]
+            return opt.default
+
+    def set(self, name: str, value, source: str = "runtime") -> None:
+        if source not in self.SOURCES:
+            raise ValueError(f"unknown config source {source!r}")
+        with self._lock:
+            opt = self._lookup(name)
+            if source == "runtime" and not opt.runtime:
+                raise ValueError(
+                    f"option {name} cannot change at runtime (STARTUP flag)")
+            old = self.get(name)
+            self._values.setdefault(name, {})[source] = opt.cast(value)
+            new = self.get(name)
+            observers = list(self._observers.get(name, []))
+        if new != old:
+            for cb in observers:
+                cb(name, new)
+
+    def load_file(self, path: str) -> None:
+        """JSON config file (the ceph.conf layer)."""
+        with open(path) as f:
+            for k, v in json.load(f).items():
+                self.set(k, v, source="file")
+
+    def add_observer(self, name: str, callback) -> None:
+        """callback(name, new_value) on effective-value change
+        (config_obs.h analog)."""
+        with self._lock:
+            self._lookup(name)
+            self._observers.setdefault(name, []).append(callback)
+
+    def show(self) -> dict:
+        """Effective config (admin `config show`)."""
+        with self._lock:
+            return {name: self.get(name) for name in sorted(self._options)}
+
+    def diff(self) -> dict:
+        """Only values differing from defaults (admin `config diff`)."""
+        with self._lock:
+            return {name: self.get(name) for name in sorted(self._values)
+                    if self.get(name) != self._options[name].default}
+
+    def _lookup(self, name: str) -> Option:
+        if name not in self._options:
+            raise KeyError(f"unknown config option {name!r}")
+        return self._options[name]

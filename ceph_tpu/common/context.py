@@ -1,0 +1,49 @@
+"""CephTpuContext — the per-process service locator (CephContext analog,
+src/common/ceph_context.h).
+
+Owns the config, the perf-counter collection, the admin socket, and the log
+levels; daemons and libraries receive one context and hang their services off
+it, exactly as every reference component takes a CephContext*.
+"""
+
+from __future__ import annotations
+
+from .admin_socket import AdminSocket
+from .config import Config
+from .perf_counters import PerfCountersCollection
+
+
+class CephTpuContext:
+    def __init__(self, name: str = "client", admin_path: str | None = None):
+        self.name = name
+        self.conf = Config()
+        self.perf = PerfCountersCollection()
+        self.admin = AdminSocket(admin_path)
+        self.admin.register_command(
+            "perf dump", lambda **kw: self.perf.dump(),
+            "dump perf counters")
+        self.admin.register_command(
+            "config show", lambda **kw: self.conf.show(),
+            "show effective config")
+        self.admin.register_command(
+            "config diff", lambda **kw: self.conf.diff(),
+            "show non-default config")
+        self.admin.register_command(
+            "config set",
+            lambda name, value, **kw: (self.conf.set(name, value), "ok")[1],
+            "set a runtime option")
+        self.admin.register_command(
+            "config get",
+            lambda name, **kw: {name: self.conf.get(name)},
+            "get one option")
+
+
+_default: CephTpuContext | None = None
+
+
+def default_context() -> CephTpuContext:
+    """Process-wide fallback context (g_ceph_context analog)."""
+    global _default
+    if _default is None:
+        _default = CephTpuContext()
+    return _default

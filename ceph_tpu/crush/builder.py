@@ -192,7 +192,13 @@ def add_simple_rule(map: CrushMap, root_id: int, failure_domain_type: int,
                     rule_type: int = 1) -> int:
     steps = [RuleStep(RULE_TAKE, root_id, 0)]
     if mode == "firstn":
-        steps.append(RuleStep(RULE_CHOOSELEAF_FIRSTN, 0, failure_domain_type))
+        if failure_domain_type == 0:
+            # device-level failure domain: plain choose, no leaf recursion
+            # (CrushWrapper::add_simple_rule type==0 branch)
+            steps.append(RuleStep(RULE_CHOOSE_FIRSTN, 0, 0))
+        else:
+            steps.append(
+                RuleStep(RULE_CHOOSELEAF_FIRSTN, 0, failure_domain_type))
     elif mode == "indep":
         if failure_domain_type == 0:
             steps.append(RuleStep(RULE_CHOOSE_INDEP, 0, 0))

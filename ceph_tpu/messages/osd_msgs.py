@@ -1,0 +1,397 @@
+"""Concrete message types (see package docstring for the reference mapping).
+
+Type ids follow the reference's include/msgr.h numbering where one exists
+(MSG_OSD_OP=42, MSG_OSD_OPREPLY=43, MSG_OSD_PING=70, ...), so a wire dump is
+recognizable to someone who knows the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ceph_tpu.msg.encoding import Decoder, Encoder
+from ceph_tpu.msg.message import Message, register_message
+
+# op codes (rados op subset; include/rados.h CEPH_OSD_OP_*)
+OP_READ = 1
+OP_WRITE = 2
+OP_WRITEFULL = 3
+OP_DELETE = 4
+OP_STAT = 5
+OP_OMAP_GET = 6
+OP_OMAP_SET = 7
+
+
+@dataclass
+class OSDOpField:
+    """One sub-op of a client op (OSDOp in osd_types.h)."""
+
+    op: int
+    offset: int = 0
+    length: int = 0
+    data: bytes = b""
+
+    def encode(self, enc: Encoder) -> None:
+        enc.u8(self.op).u64(self.offset).u64(self.length).bytes(self.data)
+
+    @staticmethod
+    def decode(dec: Decoder) -> "OSDOpField":
+        return OSDOpField(op=dec.u8(), offset=dec.u64(), length=dec.u64(),
+                          data=dec.bytes())
+
+
+def _enc_pgid(enc: Encoder, pgid: tuple[int, int]) -> None:
+    enc.s64(pgid[0]).u32(pgid[1])
+
+
+def _dec_pgid(dec: Decoder) -> tuple[int, int]:
+    return (dec.s64(), dec.u32())
+
+
+@register_message
+class MOSDOp(Message):
+    TYPE = 42  # MSG_OSD_OP
+
+    def __init__(self, client_id: int = 0, tid: int = 0,
+                 pgid: tuple[int, int] = (0, 0), oid: str = "",
+                 ops: list[OSDOpField] | None = None, epoch: int = 0):
+        super().__init__()
+        self.client_id = client_id
+        self.tid = tid
+        self.pgid = pgid
+        self.oid = oid
+        self.ops = ops or []
+        self.epoch = epoch
+
+    def encode_payload(self, enc):
+        enc.versioned(1, 1, lambda e: (
+            e.u64(self.client_id), e.u64(self.tid), _enc_pgid(e, self.pgid),
+            e.str(self.oid), e.u32(self.epoch),
+            e.list(self.ops, lambda e2, op: op.encode(e2))))
+
+    def decode_payload(self, dec, version):
+        def body(d, v):
+            self.client_id = d.u64()
+            self.tid = d.u64()
+            self.pgid = _dec_pgid(d)
+            self.oid = d.str()
+            self.epoch = d.u32()
+            self.ops = d.list(OSDOpField.decode)
+        dec.versioned(1, body)
+
+
+@register_message
+class MOSDOpReply(Message):
+    TYPE = 43  # MSG_OSD_OPREPLY
+
+    def __init__(self, tid: int = 0, result: int = 0, epoch: int = 0,
+                 ops: list[OSDOpField] | None = None):
+        super().__init__()
+        self.tid = tid
+        self.result = result
+        self.epoch = epoch
+        self.ops = ops or []   # read results travel back in op fields
+
+    def encode_payload(self, enc):
+        enc.versioned(1, 1, lambda e: (
+            e.u64(self.tid), e.s32(self.result), e.u32(self.epoch),
+            e.list(self.ops, lambda e2, op: op.encode(e2))))
+
+    def decode_payload(self, dec, version):
+        def body(d, v):
+            self.tid = d.u64()
+            self.result = d.s32()
+            self.epoch = d.u32()
+            self.ops = d.list(OSDOpField.decode)
+        dec.versioned(1, body)
+
+
+@register_message
+class MOSDRepOp(Message):
+    TYPE = 112  # MSG_OSD_REPOP
+
+    def __init__(self, reqid: tuple[int, int] = (0, 0),
+                 pgid: tuple[int, int] = (0, 0), oid: str = "",
+                 txn: bytes = b"", pg_version: tuple[int, int] = (0, 0)):
+        super().__init__()
+        self.reqid = reqid          # (client_id, tid)
+        self.pgid = pgid
+        self.oid = oid
+        self.txn = txn              # encoded ObjectStore transaction
+        self.pg_version = pg_version
+
+    def encode_payload(self, enc):
+        enc.versioned(1, 1, lambda e: (
+            e.u64(self.reqid[0]), e.u64(self.reqid[1]),
+            _enc_pgid(e, self.pgid), e.str(self.oid), e.bytes(self.txn),
+            e.u32(self.pg_version[0]), e.u64(self.pg_version[1])))
+
+    def decode_payload(self, dec, version):
+        def body(d, v):
+            self.reqid = (d.u64(), d.u64())
+            self.pgid = _dec_pgid(d)
+            self.oid = d.str()
+            self.txn = d.bytes()
+            self.pg_version = (d.u32(), d.u64())
+        dec.versioned(1, body)
+
+
+@register_message
+class MOSDRepOpReply(Message):
+    TYPE = 113  # MSG_OSD_REPOPREPLY
+
+    def __init__(self, reqid: tuple[int, int] = (0, 0),
+                 pgid: tuple[int, int] = (0, 0), from_osd: int = 0,
+                 result: int = 0):
+        super().__init__()
+        self.reqid = reqid
+        self.pgid = pgid
+        self.from_osd = from_osd
+        self.result = result
+
+    def encode_payload(self, enc):
+        enc.versioned(1, 1, lambda e: (
+            e.u64(self.reqid[0]), e.u64(self.reqid[1]),
+            _enc_pgid(e, self.pgid), e.s32(self.from_osd),
+            e.s32(self.result)))
+
+    def decode_payload(self, dec, version):
+        def body(d, v):
+            self.reqid = (d.u64(), d.u64())
+            self.pgid = _dec_pgid(d)
+            self.from_osd = d.s32()
+            self.result = d.s32()
+        dec.versioned(1, body)
+
+
+@register_message
+class MOSDECSubOpWrite(Message):
+    TYPE = 108  # MSG_OSD_EC_WRITE
+
+    def __init__(self, reqid: tuple[int, int] = (0, 0),
+                 pgid: tuple[int, int] = (0, 0), oid: str = "",
+                 shard: int = 0, chunk: bytes = b"", epoch: int = 0):
+        super().__init__()
+        self.reqid = reqid
+        self.pgid = pgid
+        self.oid = oid
+        self.shard = shard
+        self.chunk = chunk
+        self.epoch = epoch
+
+    def encode_payload(self, enc):
+        enc.versioned(1, 1, lambda e: (
+            e.u64(self.reqid[0]), e.u64(self.reqid[1]),
+            _enc_pgid(e, self.pgid), e.str(self.oid), e.u8(self.shard),
+            e.bytes(self.chunk), e.u32(self.epoch)))
+
+    def decode_payload(self, dec, version):
+        def body(d, v):
+            self.reqid = (d.u64(), d.u64())
+            self.pgid = _dec_pgid(d)
+            self.oid = d.str()
+            self.shard = d.u8()
+            self.chunk = d.bytes()
+            self.epoch = d.u32()
+        dec.versioned(1, body)
+
+
+@register_message
+class MOSDECSubOpWriteReply(Message):
+    TYPE = 109
+
+    def __init__(self, reqid: tuple[int, int] = (0, 0), shard: int = 0,
+                 from_osd: int = 0, result: int = 0):
+        super().__init__()
+        self.reqid = reqid
+        self.shard = shard
+        self.from_osd = from_osd
+        self.result = result
+
+    def encode_payload(self, enc):
+        enc.versioned(1, 1, lambda e: (
+            e.u64(self.reqid[0]), e.u64(self.reqid[1]), e.u8(self.shard),
+            e.s32(self.from_osd), e.s32(self.result)))
+
+    def decode_payload(self, dec, version):
+        def body(d, v):
+            self.reqid = (d.u64(), d.u64())
+            self.shard = d.u8()
+            self.from_osd = d.s32()
+            self.result = d.s32()
+        dec.versioned(1, body)
+
+
+@register_message
+class MOSDECSubOpRead(Message):
+    TYPE = 110
+
+    def __init__(self, reqid: tuple[int, int] = (0, 0),
+                 pgid: tuple[int, int] = (0, 0), oid: str = "",
+                 shard: int = 0):
+        super().__init__()
+        self.reqid = reqid
+        self.pgid = pgid
+        self.oid = oid
+        self.shard = shard
+
+    def encode_payload(self, enc):
+        enc.versioned(1, 1, lambda e: (
+            e.u64(self.reqid[0]), e.u64(self.reqid[1]),
+            _enc_pgid(e, self.pgid), e.str(self.oid), e.u8(self.shard)))
+
+    def decode_payload(self, dec, version):
+        def body(d, v):
+            self.reqid = (d.u64(), d.u64())
+            self.pgid = _dec_pgid(d)
+            self.oid = d.str()
+            self.shard = d.u8()
+        dec.versioned(1, body)
+
+
+@register_message
+class MOSDECSubOpReadReply(Message):
+    TYPE = 111
+
+    def __init__(self, reqid: tuple[int, int] = (0, 0), shard: int = 0,
+                 from_osd: int = 0, result: int = 0, chunk: bytes = b""):
+        super().__init__()
+        self.reqid = reqid
+        self.shard = shard
+        self.from_osd = from_osd
+        self.result = result
+        self.chunk = chunk
+
+    def encode_payload(self, enc):
+        enc.versioned(1, 1, lambda e: (
+            e.u64(self.reqid[0]), e.u64(self.reqid[1]), e.u8(self.shard),
+            e.s32(self.from_osd), e.s32(self.result), e.bytes(self.chunk)))
+
+    def decode_payload(self, dec, version):
+        def body(d, v):
+            self.reqid = (d.u64(), d.u64())
+            self.shard = d.u8()
+            self.from_osd = d.s32()
+            self.result = d.s32()
+            self.chunk = d.bytes()
+        dec.versioned(1, body)
+
+
+@register_message
+class MOSDPing(Message):
+    TYPE = 70  # MSG_OSD_PING
+
+    PING = 0
+    PING_REPLY = 1
+
+    def __init__(self, from_osd: int = 0, op: int = 0, stamp: float = 0.0,
+                 epoch: int = 0):
+        super().__init__()
+        self.from_osd = from_osd
+        self.op = op
+        self.stamp = stamp
+        self.epoch = epoch
+
+    def encode_payload(self, enc):
+        enc.versioned(1, 1, lambda e: (
+            e.s32(self.from_osd), e.u8(self.op), e.f64(self.stamp),
+            e.u32(self.epoch)))
+
+    def decode_payload(self, dec, version):
+        def body(d, v):
+            self.from_osd = d.s32()
+            self.op = d.u8()
+            self.stamp = d.f64()
+            self.epoch = d.u32()
+        dec.versioned(1, body)
+
+
+@register_message
+class MOSDFailure(Message):
+    TYPE = 51  # MSG_OSD_FAILURE
+
+    def __init__(self, reporter: int = 0, failed_osd: int = 0,
+                 failed_for: float = 0.0, epoch: int = 0):
+        super().__init__()
+        self.reporter = reporter
+        self.failed_osd = failed_osd
+        self.failed_for = failed_for
+        self.epoch = epoch
+
+    def encode_payload(self, enc):
+        enc.versioned(1, 1, lambda e: (
+            e.s32(self.reporter), e.s32(self.failed_osd),
+            e.f64(self.failed_for), e.u32(self.epoch)))
+
+    def decode_payload(self, dec, version):
+        def body(d, v):
+            self.reporter = d.s32()
+            self.failed_osd = d.s32()
+            self.failed_for = d.f64()
+            self.epoch = d.u32()
+        dec.versioned(1, body)
+
+
+@register_message
+class MOSDMapMsg(Message):
+    TYPE = 41  # MSG_OSD_MAP
+
+    def __init__(self, epoch: int = 0, map_blob: bytes = b""):
+        super().__init__()
+        self.epoch = epoch
+        self.map_blob = map_blob  # OSDMap encoded via osd.map_codec
+
+    def encode_payload(self, enc):
+        enc.versioned(1, 1, lambda e: (e.u32(self.epoch),
+                                       e.bytes(self.map_blob)))
+
+    def decode_payload(self, dec, version):
+        def body(d, v):
+            self.epoch = d.u32()
+            self.map_blob = d.bytes()
+        dec.versioned(1, body)
+
+
+@register_message
+class MMonCommand(Message):
+    TYPE = 50  # MSG_MON_COMMAND
+
+    def __init__(self, tid: int = 0, cmd: dict | None = None):
+        super().__init__()
+        self.tid = tid
+        self.cmd = cmd or {}
+
+    def encode_payload(self, enc):
+        import json
+        enc.versioned(1, 1, lambda e: (e.u64(self.tid),
+                                       e.str(json.dumps(self.cmd))))
+
+    def decode_payload(self, dec, version):
+        import json
+
+        def body(d, v):
+            self.tid = d.u64()
+            self.cmd = json.loads(d.str())
+        dec.versioned(1, body)
+
+
+@register_message
+class MMonCommandAck(Message):
+    TYPE = 52  # MSG_MON_COMMAND_ACK
+
+    def __init__(self, tid: int = 0, result: int = 0, output: str = ""):
+        super().__init__()
+        self.tid = tid
+        self.result = result
+        self.output = output
+
+    def encode_payload(self, enc):
+        enc.versioned(1, 1, lambda e: (e.u64(self.tid), e.s32(self.result),
+                                       e.str(self.output)))
+
+    def decode_payload(self, dec, version):
+        def body(d, v):
+            self.tid = d.u64()
+            self.result = d.s32()
+            self.output = d.str()
+        dec.versioned(1, body)

@@ -1,0 +1,309 @@
+"""Monitor daemon.
+
+Map mutations follow the reference's pending_inc pattern (OSDMonitor): mutate a
+pending copy, commit it as epoch+1 to the versioned store, then broadcast to
+subscribers.  Failure handling mirrors check_failure (mon/OSDMonitor.cc:2537):
+an osd is marked down once `mon_osd_min_down_reporters` distinct reporters
+have filed MOSDFailure against it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ceph_tpu.common.context import CephTpuContext
+from ceph_tpu.crush.builder import add_simple_rule, make_bucket
+from ceph_tpu.crush.types import CRUSH_BUCKET_STRAW2, CrushMap
+from ceph_tpu.messages import (
+    MMonCommand, MMonCommandAck, MOSDFailure, MOSDMapMsg)
+from ceph_tpu.messages.osd_msgs import MOSDPing
+from ceph_tpu.msg.message import Message, register_message
+from ceph_tpu.msg.encoding import Encoder, Decoder
+from ceph_tpu.msg.messenger import (
+    ConnectionPolicy, Dispatcher, EntityName, Messenger)
+from ceph_tpu.objectstore.kv import LogDB, MemDB
+from ceph_tpu.osd.map_codec import decode_osdmap, encode_osdmap
+from ceph_tpu.osd.osdmap import OSDMap, PGPool, POOL_TYPE_ERASURE
+
+
+@register_message
+class MOSDBoot(Message):
+    """osd -> mon: I'm up at this address (messages/MOSDBoot.h analog)."""
+
+    TYPE = 71
+
+    def __init__(self, osd_id: int = 0, addr: str = ""):
+        super().__init__()
+        self.osd_id = osd_id
+        self.addr = addr
+
+    def encode_payload(self, enc: Encoder):
+        enc.versioned(1, 1, lambda e: (e.s32(self.osd_id), e.str(self.addr)))
+
+    def decode_payload(self, dec: Decoder, version: int):
+        def body(d, v):
+            self.osd_id = d.s32()
+            self.addr = d.str()
+        dec.versioned(1, body)
+
+
+@register_message
+class MMonSubscribe(Message):
+    """client/osd -> mon: send me map updates (MMonSubscribe analog)."""
+
+    TYPE = 15
+
+    def __init__(self, name: str = "", addr: str = ""):
+        super().__init__()
+        self.name = name
+        self.addr = addr
+
+    def encode_payload(self, enc: Encoder):
+        enc.versioned(1, 1, lambda e: (e.str(self.name), e.str(self.addr)))
+
+    def decode_payload(self, dec: Decoder, version: int):
+        def body(d, v):
+            self.name = d.str()
+            self.addr = d.str()
+        dec.versioned(1, body)
+
+
+class Monitor(Dispatcher):
+    def __init__(self, ctx: CephTpuContext | None = None, mon_id: int = 0,
+                 store_path: str | None = None, ms_type: str = "async",
+                 addr: str = "127.0.0.1:0"):
+        self.ctx = ctx or CephTpuContext(f"mon.{mon_id}")
+        self.mon_id = mon_id
+        self.name = EntityName("mon", mon_id)
+        self.db = LogDB(store_path) if store_path else MemDB()
+        self.osdmap = OSDMap()
+        self._lock = threading.RLock()
+        #: failure reports: failed_osd -> {reporter: report_time}
+        self._failure_reports: dict[int, dict[int, float]] = {}
+        #: subscriber name -> (addr, entity)
+        self._subs: dict[str, tuple[str, EntityName]] = {}
+        self._osd_addrs: dict[int, str] = {}
+        self.msgr = Messenger.create(self.name, ms_type)
+        self.msgr.set_policy("client", ConnectionPolicy.lossy_client())
+        self.msgr.set_policy("osd", ConnectionPolicy.stateful_server())
+        self.msgr.add_dispatcher_tail(self)
+        self._addr = addr
+        self.ctx.admin.register_command(
+            "mon status", lambda **kw: self.status(), "cluster status")
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def init(self) -> None:
+        if isinstance(self.db, LogDB):
+            self.db.open()
+        self._load_or_bootstrap()
+        self.msgr.bind(self._addr)
+        self.msgr.start()
+
+    def shutdown(self) -> None:
+        self.msgr.shutdown()
+        if isinstance(self.db, LogDB):
+            self.db.close()
+
+    @property
+    def addr(self) -> str:
+        return self.msgr.my_addr
+
+    def _load_or_bootstrap(self) -> None:
+        last = self.db.get("osdmap", "last_committed")
+        if last is not None:
+            blob = self.db.get("osdmap", f"full_{int(last.decode())}")
+            self.osdmap = decode_osdmap(blob)
+            return
+        # bootstrap: empty map with a root bucket and a default rule
+        m = OSDMap(epoch=0, crush=CrushMap())
+        m.crush.add_bucket(
+            make_bucket(-1, CRUSH_BUCKET_STRAW2, 2, [], []))
+        self.osdmap = m
+        self._commit(m)  # epoch 1
+
+    # -- the pending_inc commit path ------------------------------------------
+
+    def _commit(self, newmap: OSDMap) -> None:
+        """Versioned commit (Paxos store layout: one value per version)."""
+        with self._lock:
+            newmap.epoch += 1
+            blob = encode_osdmap(newmap)
+            t = self.db.get_transaction()
+            t.set("osdmap", f"full_{newmap.epoch}", blob)
+            t.set("osdmap", "last_committed", str(newmap.epoch).encode())
+            self.db.submit_transaction(t)
+            self.osdmap = newmap
+            subs = list(self._subs.values())
+        for addr, entity in subs:
+            con = self.msgr.connect_to(addr, entity)
+            con.send_message(MOSDMapMsg(epoch=newmap.epoch, map_blob=blob))
+
+    # -- dispatch -------------------------------------------------------------
+
+    def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, MMonCommand):
+            out, result = self.handle_command(msg.cmd)
+            msg.connection.send_message(
+                MMonCommandAck(tid=msg.tid, result=result, output=out))
+            return True
+        if isinstance(msg, MOSDBoot):
+            self._handle_boot(msg)
+            return True
+        if isinstance(msg, MMonSubscribe):
+            with self._lock:
+                entity = (msg.connection.peer_name
+                          or EntityName.parse(msg.name))
+                self._subs[msg.name] = (msg.addr, entity)
+                epoch, blob = self.osdmap.epoch, encode_osdmap(self.osdmap)
+            con = self.msgr.connect_to(msg.addr, entity)
+            con.send_message(MOSDMapMsg(epoch=epoch, map_blob=blob))
+            return True
+        if isinstance(msg, MOSDFailure):
+            self._handle_failure(msg)
+            return True
+        if isinstance(msg, MOSDPing):
+            return True  # mon liveness probe, nothing to do
+        return False
+
+    # -- osd lifecycle --------------------------------------------------------
+
+    def _handle_boot(self, msg: MOSDBoot) -> None:
+        with self._lock:
+            m = self.osdmap
+            osd = msg.osd_id
+            if osd >= m.max_osd:
+                m.set_max_osd(osd + 1)
+            newly_known = not m.exists(osd)
+            m.mark_up(osd, weight=m.osd_weight[osd] or 0x10000)
+            m.osd_addrs[osd] = msg.addr
+            if newly_known:
+                self._crush_add_osd(m, osd, 0x10000)
+            self._osd_addrs[osd] = msg.addr
+            self._failure_reports.pop(osd, None)
+            self._commit(m)
+
+    def _crush_add_osd(self, m: OSDMap, osd: int, weight: int) -> None:
+        root = m.crush.bucket(-1)
+        root.items.append(osd)
+        root.item_weights.append(weight)
+        root.weight += weight
+        m.crush.max_devices = max(m.crush.max_devices, osd + 1)
+
+    def _handle_failure(self, msg: MOSDFailure) -> None:
+        need = int(self.ctx.conf.get("mon_osd_min_down_reporters"))
+        with self._lock:
+            if not self.osdmap.is_up(msg.failed_osd):
+                return
+            reports = self._failure_reports.setdefault(msg.failed_osd, {})
+            reports[msg.reporter] = time.time()
+            if len(reports) < need:
+                return
+            # quorum of reporters: mark down (check_failure analog)
+            m = self.osdmap
+            m.mark_down(msg.failed_osd)
+            self._failure_reports.pop(msg.failed_osd, None)
+            self._commit(m)
+
+    # -- command table (MonCommands.h analog) ---------------------------------
+
+    def handle_command(self, cmd: dict) -> tuple[str, int]:
+        import json
+        prefix = cmd.get("prefix", "")
+        try:
+            if prefix == "status":
+                return json.dumps(self.status()), 0
+            if prefix == "osd pool create":
+                return self._cmd_pool_create(cmd)
+            if prefix == "osd pool set":
+                return self._cmd_pool_set(cmd)
+            if prefix == "osd tree":
+                return json.dumps(self._cmd_tree()), 0
+            if prefix == "osd out":
+                return self._cmd_osd_weight(int(cmd["id"]), 0)
+            if prefix == "osd in":
+                return self._cmd_osd_weight(int(cmd["id"]), 0x10000)
+            if prefix == "osd down":
+                with self._lock:
+                    m = self.osdmap
+                    osd = int(cmd["id"])
+                    if not m.exists(osd):
+                        return f"osd.{osd} does not exist", -2
+                    m.mark_down(osd)
+                    self._commit(m)
+                return "marked down", 0
+            if prefix == "osd getmap":
+                return json.dumps({"epoch": self.osdmap.epoch}), 0
+            return f"unknown command {prefix!r}", -22
+        except (KeyError, ValueError, IndexError) as e:
+            return f"command failed: {e}", -22
+
+    def _cmd_pool_create(self, cmd) -> tuple[str, int]:
+        with self._lock:
+            m = self.osdmap
+            pool_id = max(m.pools, default=0) + 1
+            pg_num = int(cmd.get("pg_num",
+                                 self.ctx.conf.get("osd_pool_default_pg_num")))
+            ptype = (POOL_TYPE_ERASURE if cmd.get("pool_type") == "erasure"
+                     else 1)
+            profile = {}
+            if ptype == POOL_TYPE_ERASURE:
+                k = int(cmd.get("k", 4))
+                ec_m = int(cmd.get("m", 2))
+                profile = {"plugin": cmd.get("plugin", "jerasure"),
+                           "technique": cmd.get("technique", "reed_sol_van"),
+                           "k": str(k), "m": str(ec_m)}
+                rule = add_simple_rule(m.crush, -1, 0, "indep")
+                size = k + ec_m
+            else:
+                rule = add_simple_rule(m.crush, -1, 0, "firstn")
+                size = int(cmd.get("size",
+                                   self.ctx.conf.get("osd_pool_default_size")))
+            m.pools[pool_id] = PGPool(
+                pool_id=pool_id, type=ptype, size=size,
+                min_size=max(1, size - 1) if ptype != POOL_TYPE_ERASURE
+                else int(cmd.get("k", 4)),
+                crush_rule=rule, pg_num=pg_num, ec_profile=profile)
+            self._commit(m)
+            return f"pool {pool_id} created", 0
+
+    def _cmd_pool_set(self, cmd) -> tuple[str, int]:
+        with self._lock:
+            m = self.osdmap
+            pool = m.pools[int(cmd["pool"])]
+            setattr(pool, cmd["var"], int(cmd["val"]))
+            self._commit(m)
+            return "set", 0
+
+    def _cmd_osd_weight(self, osd: int, weight: int) -> tuple[str, int]:
+        with self._lock:
+            m = self.osdmap
+            if not (0 <= osd < m.max_osd):
+                return f"osd.{osd} does not exist", -2
+            m.osd_weight[osd] = weight
+            self._commit(m)
+            return f"osd.{osd} weight {weight:#x}", 0
+
+    def _cmd_tree(self) -> dict:
+        m = self.osdmap
+        return {
+            "epoch": m.epoch,
+            "osds": [
+                {"id": o, "up": m.is_up(o), "exists": m.exists(o),
+                 "weight": m.osd_weight[o] / 0x10000}
+                for o in range(m.max_osd)],
+        }
+
+    def status(self) -> dict:
+        with self._lock:
+            m = self.osdmap
+            return {
+                "epoch": m.epoch,
+                "num_osds": sum(1 for o in range(m.max_osd) if m.exists(o)),
+                "num_up_osds": sum(1 for o in range(m.max_osd)
+                                   if m.is_up(o)),
+                "pools": {p: {"pg_num": pool.pg_num, "size": pool.size,
+                              "type": pool.type}
+                          for p, pool in m.pools.items()},
+            }

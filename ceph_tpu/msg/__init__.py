@@ -1,0 +1,27 @@
+"""Cluster communication (reference layer 2: src/msg/ + src/messages/).
+
+Entity-addressed, policy-governed, typed-message transport:
+
+  encoding     versioned binary encode/decode (bufferlist + denc analog)
+  message      Message base + type registry (154-type catalog analog;
+               ceph_tpu.messages holds the concrete types)
+  messenger    Messenger/Connection/Dispatcher/Policy abstraction
+               (msg/Messenger.h:120, msg/Policy.h)
+  async_tcp    asyncio TCP stack with banner handshake + length-prefixed
+               crc-checked frames (AsyncMessenger/ProtocolV1 analog)
+  loopback     in-process stack for unit tests (testmsgr analog)
+
+The TPU data plane (shard fan-out over ICI) lives in ceph_tpu.parallel as XLA
+collectives; this layer carries the control plane and host<->host data path,
+standing where posix/rdma/dpdk stacks stand in the reference (SURVEY.md §5).
+"""
+
+from .encoding import Encoder, Decoder
+from .message import Message, register_message
+from .messenger import (
+    ConnectionPolicy, Dispatcher, EntityName, Messenger)
+
+__all__ = [
+    "Encoder", "Decoder", "Message", "register_message",
+    "Messenger", "Dispatcher", "EntityName", "ConnectionPolicy",
+]

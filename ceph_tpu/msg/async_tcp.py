@@ -1,0 +1,235 @@
+"""TCP messenger stack.
+
+API-equivalent to the reference's default AsyncMessenger (src/msg/async/);
+internally thread-per-connection like its SimpleMessenger sibling — the
+portable structure for a multi-process vstart harness.  Protocol v1-lite
+(async/Protocol.h:103 analog):
+
+    banner          b"ceph_tpu v1\\n" both ways
+    announce        length-prefixed str(entity_name) both ways
+    frames          [u32 length][Message.encode() bytes]   (crc inside)
+
+Stateful policies reconnect on send failure and resend the queued backlog;
+lossy connections drop and notify ms_handle_reset (msg/Policy.h semantics).
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+import time
+
+from .message import Message
+from .messenger import Connection, ConnectionPolicy, EntityName, Messenger
+
+BANNER = b"ceph_tpu v1\n"
+_LEN = struct.Struct("<I")
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _handshake(sock: socket.socket, my_name: EntityName) -> EntityName:
+    sock.sendall(BANNER)
+    got = _read_exact(sock, len(BANNER))
+    if got != BANNER:
+        raise ConnectionError(f"bad banner {got!r}")
+    me = str(my_name).encode()
+    sock.sendall(_LEN.pack(len(me)) + me)
+    plen = _LEN.unpack(_read_exact(sock, _LEN.size))[0]
+    return EntityName.parse(_read_exact(sock, plen).decode())
+
+
+class TcpConnection(Connection):
+    def __init__(self, messenger: "AsyncMessenger", peer_addr: str,
+                 peer_name: EntityName | None, policy: ConnectionPolicy,
+                 sock: socket.socket | None = None, accepted: bool = False):
+        super().__init__(messenger, peer_addr)
+        self.peer_name = peer_name
+        self.policy = policy
+        # accepted sessions cannot dial the peer back; on failure they drop
+        # and wait for the initiator to reconnect (the reference server side
+        # replaces the Connection on re-accept)
+        self.accepted = accepted
+        self._sock = sock
+        self._sendq: queue.Queue = queue.Queue()
+        self._down = False
+        self._lock = threading.Lock()
+        self._writer = threading.Thread(target=self._write_loop, daemon=True)
+        self._writer.start()
+        if sock is not None:
+            self._start_reader()
+
+    # -- public ---------------------------------------------------------------
+
+    def send_message(self, msg: Message) -> None:
+        if self._down:
+            return
+        self._sendq.put(msg.encode())
+
+    def mark_down(self) -> None:
+        self._down = True
+        self._sendq.put(None)
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    def is_connected(self) -> bool:
+        with self._lock:
+            return self._sock is not None and not self._down
+
+    # -- internals ------------------------------------------------------------
+
+    def _start_reader(self) -> None:
+        threading.Thread(target=self._read_loop, daemon=True).start()
+
+    def _connect(self) -> None:
+        host, port = self.peer_addr.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=10)
+        s.settimeout(None)
+        peer = _handshake(s, self.messenger.my_name)
+        with self._lock:
+            self._sock = s
+        if self.peer_name is None:
+            self.peer_name = peer
+        self._start_reader()
+
+    def _write_loop(self) -> None:
+        backlog: list[bytes] = []
+        while not self._down:
+            item = self._sendq.get()
+            if item is None:
+                return
+            backlog.append(item)
+            while backlog and not self._down:
+                try:
+                    with self._lock:
+                        sock = self._sock
+                    if sock is None:
+                        self._connect()
+                        with self._lock:
+                            sock = self._sock
+                    sock.sendall(_LEN.pack(len(backlog[0])) + backlog[0])
+                    backlog.pop(0)
+                except OSError:
+                    with self._lock:
+                        if self._sock is not None:
+                            try:
+                                self._sock.close()
+                            except OSError:
+                                pass
+                            self._sock = None
+                    if self.policy.lossy or self.accepted:
+                        self._down = True
+                        self.messenger.notify_reset(self)
+                        return
+                    if not self.policy.resend_on_reconnect:
+                        backlog.clear()
+                    time.sleep(0.1)  # reconnect backoff
+
+    def _read_loop(self) -> None:
+        from ceph_tpu.common.logging import get_logger
+        try:
+            while not self._down:
+                with self._lock:
+                    sock = self._sock
+                if sock is None:
+                    return
+                frame_len = _LEN.unpack(_read_exact(sock, _LEN.size))[0]
+                data = _read_exact(sock, frame_len)
+                # a bad frame or handler bug must not kill the reader
+                try:
+                    msg = Message.decode(data)
+                    msg.connection = self
+                    self.messenger.deliver(msg)
+                except Exception:
+                    get_logger("ms").exception(
+                        "%s: dispatch failed for frame from %s",
+                        self.messenger.my_name, self.peer_name)
+        except (ConnectionError, OSError):
+            with self._lock:
+                self._sock = None
+            if not self._down:
+                if self.policy.lossy:
+                    self._down = True
+                self.messenger.notify_reset(self)
+
+
+class AsyncMessenger(Messenger):
+    def __init__(self, name: EntityName):
+        super().__init__(name)
+        self._listener: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+        self._conns: dict[str, TcpConnection] = {}
+        self._stop = False
+
+    def bind(self, addr: str) -> None:
+        host, port = addr.rsplit(":", 1)
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, int(port)))
+        s.listen(64)
+        self.my_addr = f"{host}:{s.getsockname()[1]}"  # resolves port 0
+        self._listener = s
+
+    def start(self) -> None:
+        if self._listener is None:
+            return
+
+        def accept_loop():
+            while not self._stop:
+                try:
+                    sock, _ = self._listener.accept()
+                except OSError:
+                    return
+                threading.Thread(target=self._accept_one, args=(sock,),
+                                 daemon=True).start()
+
+        self._accept_thread = threading.Thread(target=accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    def _accept_one(self, sock: socket.socket) -> None:
+        try:
+            peer = _handshake(sock, self.my_name)
+        except (ConnectionError, OSError):
+            sock.close()
+            return
+        policy = self.policy_for(peer.type)
+        con = TcpConnection(self, f"{sock.getpeername()[0]}:0", peer,
+                            policy, sock=sock, accepted=True)
+        with self._lock:
+            self._conns[f"accepted:{peer}"] = con
+
+    def shutdown(self) -> None:
+        self._stop = True
+        if self._listener is not None:
+            self._listener.close()
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            c.mark_down()
+
+    def connect_to(self, addr: str, peer_name: EntityName) -> Connection:
+        key = f"{addr}/{peer_name}"
+        with self._lock:
+            con = self._conns.get(key)
+            if con is not None and con.is_connected():
+                return con
+            policy = self.policy_for(peer_name.type)
+            con = TcpConnection(self, addr, peer_name, policy)
+            self._conns[key] = con
+            return con

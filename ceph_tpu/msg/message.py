@@ -1,0 +1,94 @@
+"""Message base class and type registry (src/msg/Message.h analog).
+
+Every concrete message declares a TYPE id and HEAD_VERSION/COMPAT_VERSION and
+implements encode_payload/decode_payload; the wire frame adds a fixed header
+(type, versions, seq, payload length) and a crc32 trailer, standing where
+ceph_msg_header/ceph_msg_footer stand (msg/Message.h, include/msgr.h).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+from .encoding import Decoder, DecodeError, Encoder
+
+_REGISTRY: dict[int, type] = {}
+
+_HEADER = struct.Struct("<IHBBQ I")   # type, reserved, ver, compat, seq, len
+_FOOTER = struct.Struct("<I")         # crc32 of payload
+
+
+def register_message(cls):
+    """Class decorator: adds the type to the catalog (the analog of the
+    decode_message switch over 154 types, src/msg/Message.cc)."""
+    t = cls.TYPE
+    if t in _REGISTRY and _REGISTRY[t] is not cls:
+        raise ValueError(f"message type {t} already registered "
+                         f"({_REGISTRY[t].__name__})")
+    _REGISTRY[t] = cls
+    return cls
+
+
+class Message:
+    TYPE = 0
+    HEAD_VERSION = 1
+    COMPAT_VERSION = 1
+
+    def __init__(self):
+        self.seq = 0
+        #: filled by the messenger on receive: the Connection it arrived on
+        self.connection = None
+
+    # subclasses implement:
+    def encode_payload(self, enc: Encoder) -> None:
+        raise NotImplementedError
+
+    def decode_payload(self, dec: Decoder, version: int) -> None:
+        raise NotImplementedError
+
+    # -- framing --------------------------------------------------------------
+
+    def encode(self) -> bytes:
+        enc = Encoder()
+        self.encode_payload(enc)
+        payload = enc.tobytes()
+        header = _HEADER.pack(self.TYPE, 0, self.HEAD_VERSION,
+                              self.COMPAT_VERSION, self.seq, len(payload))
+        return header + payload + _FOOTER.pack(zlib.crc32(payload))
+
+    @staticmethod
+    def decode(data: bytes) -> "Message":
+        if len(data) < _HEADER.size + _FOOTER.size:
+            raise DecodeError("short message frame")
+        mtype, _r, ver, compat, seq, plen = _HEADER.unpack_from(data, 0)
+        start = _HEADER.size
+        if len(data) < start + plen + _FOOTER.size:
+            raise DecodeError("truncated payload")
+        payload = data[start:start + plen]
+        (crc,) = _FOOTER.unpack_from(data, start + plen)
+        if zlib.crc32(payload) != crc:
+            raise DecodeError(f"payload crc mismatch on type {mtype}")
+        cls = _REGISTRY.get(mtype)
+        if cls is None:
+            raise DecodeError(f"unknown message type {mtype}")
+        if compat > cls.HEAD_VERSION:
+            raise DecodeError(
+                f"message type {mtype} compat {compat} > understood "
+                f"{cls.HEAD_VERSION}")
+        msg = cls.__new__(cls)
+        Message.__init__(msg)
+        msg.seq = seq
+        msg.decode_payload(Decoder(payload), ver)
+        return msg
+
+    def frame_size(self) -> int:
+        return len(self.encode())
+
+    def __repr__(self):
+        return f"<{type(self).__name__} seq={self.seq}>"
+
+
+def message_type_name(t: int) -> str:
+    cls = _REGISTRY.get(t)
+    return cls.__name__ if cls else f"unknown({t})"

@@ -1,0 +1,179 @@
+"""Messenger abstraction (src/msg/Messenger.h:120, Connection, Dispatcher,
+per-peer Policy — msg/Policy.h).
+
+A Messenger owns an entity identity ("osd.3", "mon.0", "client.4123"), binds a
+transport, hands out Connections keyed by peer address, and delivers inbound
+messages to a dispatcher chain.  Policies mirror the reference knobs set in
+ceph_osd.cc:531-545: lossy server-side client sessions, stateful cluster
+peers, byte throttles.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from ceph_tpu.common.throttle import Throttle
+
+from .message import Message
+
+
+@dataclass(frozen=True, order=True)
+class EntityName:
+    """entity_name_t: type.id ("osd.3")."""
+
+    type: str
+    id: int
+
+    def __str__(self):
+        return f"{self.type}.{self.id}"
+
+    @staticmethod
+    def parse(s: str) -> "EntityName":
+        t, i = s.rsplit(".", 1)
+        return EntityName(t, int(i))
+
+
+@dataclass
+class ConnectionPolicy:
+    """msg/Policy.h: lossy connections drop state on failure (server->client);
+    stateful ones reconnect and resend (cluster peers)."""
+
+    lossy: bool = False
+    server: bool = False
+    resend_on_reconnect: bool = True
+    throttler_bytes: Throttle | None = None
+
+    @staticmethod
+    def lossy_client() -> "ConnectionPolicy":
+        return ConnectionPolicy(lossy=True, server=True,
+                                resend_on_reconnect=False)
+
+    @staticmethod
+    def stateful_server() -> "ConnectionPolicy":
+        return ConnectionPolicy(lossy=False, server=True)
+
+    @staticmethod
+    def stateful_peer() -> "ConnectionPolicy":
+        return ConnectionPolicy(lossy=False, server=False)
+
+
+class Connection:
+    """One peer session; send_message is asynchronous and ordered
+    (msg/Connection.h)."""
+
+    def __init__(self, messenger: "Messenger", peer_addr: str):
+        self.messenger = messenger
+        self.peer_addr = peer_addr
+        self.peer_name: EntityName | None = None
+
+    def send_message(self, msg: Message) -> None:
+        raise NotImplementedError
+
+    def mark_down(self) -> None:
+        """Tear the session down (Connection::mark_down)."""
+        raise NotImplementedError
+
+    def is_connected(self) -> bool:
+        raise NotImplementedError
+
+
+class Dispatcher:
+    """Callback interface (msg/Dispatcher.h).  Messengers walk the dispatcher
+    chain until one returns True from ms_dispatch."""
+
+    def ms_dispatch(self, msg: Message) -> bool:
+        return False
+
+    def ms_handle_reset(self, con: Connection) -> None:
+        """Peer session dropped (stateful peer reset)."""
+
+    def ms_handle_remote_reset(self, con: Connection) -> None:
+        """Peer told us it reset."""
+
+
+class Messenger:
+    """Transport-agnostic base; create() picks the stack like
+    Messenger::create(cct, type, ...)."""
+
+    def __init__(self, name: EntityName):
+        self.my_name = name
+        self.my_addr: str | None = None
+        self._dispatchers: list[Dispatcher] = []
+        self._policies: dict[str, ConnectionPolicy] = {}
+        self._default_policy = ConnectionPolicy()
+        self._lock = threading.RLock()
+
+    @staticmethod
+    def create(name: EntityName, mtype: str = "async", **kw) -> "Messenger":
+        if mtype == "async":
+            from .async_tcp import AsyncMessenger
+            return AsyncMessenger(name, **kw)
+        if mtype == "loopback":
+            from .loopback import LoopbackMessenger
+            return LoopbackMessenger(name, **kw)
+        raise ValueError(f"unknown messenger type {mtype!r}")
+
+    # -- dispatcher chain (Messenger.h:337-352) -------------------------------
+
+    def add_dispatcher_head(self, d: Dispatcher) -> None:
+        with self._lock:
+            self._dispatchers.insert(0, d)
+
+    def add_dispatcher_tail(self, d: Dispatcher) -> None:
+        with self._lock:
+            self._dispatchers.append(d)
+
+    def deliver(self, msg: Message) -> bool:
+        tb = None
+        policy = self.policy_for(msg.connection.peer_name.type
+                                 if msg.connection and msg.connection.peer_name
+                                 else "client")
+        if policy.throttler_bytes is not None:
+            size = msg.frame_size()
+            policy.throttler_bytes.get(size)
+            tb = (policy.throttler_bytes, size)
+        try:
+            with self._lock:
+                chain = list(self._dispatchers)
+            for d in chain:
+                if d.ms_dispatch(msg):
+                    return True
+            return False
+        finally:
+            if tb:
+                tb[0].put(tb[1])
+
+    def notify_reset(self, con: Connection) -> None:
+        with self._lock:
+            chain = list(self._dispatchers)
+        for d in chain:
+            d.ms_handle_reset(con)
+
+    # -- policies -------------------------------------------------------------
+
+    def set_policy(self, peer_type: str, policy: ConnectionPolicy) -> None:
+        with self._lock:
+            self._policies[peer_type] = policy
+
+    def set_default_policy(self, policy: ConnectionPolicy) -> None:
+        with self._lock:
+            self._default_policy = policy
+
+    def policy_for(self, peer_type: str) -> ConnectionPolicy:
+        with self._lock:
+            return self._policies.get(peer_type, self._default_policy)
+
+    # -- transport lifecycle --------------------------------------------------
+
+    def bind(self, addr: str) -> None:
+        raise NotImplementedError
+
+    def start(self) -> None:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        raise NotImplementedError
+
+    def connect_to(self, addr: str, peer_name: EntityName) -> Connection:
+        raise NotImplementedError

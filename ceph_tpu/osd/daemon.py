@@ -1,0 +1,772 @@
+"""The OSD daemon (src/osd/OSD.{h,cc} + PrimaryLogPG + backends, condensed).
+
+Structure mirrors the reference data path (SURVEY.md §3.1/§3.3):
+
+  client MOSDOp -> primary:  replicated: local txn + MOSDRepOp fan-out, ack on
+                             all commits (ReplicatedBackend::submit_transaction)
+                             erasure: batched GF(2^8) encode -> per-shard
+                             MOSDECSubOpWrite fan-out (ECBackend::start_rmw ->
+                             ECUtil::encode; here the encode is one device call)
+  reads:                     replicated: local; erasure: shard fan-in
+                             (MOSDECSubOpRead) + recovery decode
+  heartbeats:                periodic MOSDPing to up peers; missed grace ->
+                             MOSDFailure to the mon (OSD::heartbeat_check)
+  map handling:              MOSDMapMsg -> activate PGs (collections), simple
+                             pull-based recovery for replicated objects
+
+Erasure objects store one chunk per shard-OSD as "<oid>:<shard>" with the
+stripe geometry in attrs; any k chunks reconstruct via the recovery-matrix
+kernel, exactly the ECBackend read path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ceph_tpu.common.context import CephTpuContext
+from ceph_tpu.common.logging import dout
+from ceph_tpu.common.perf_counters import PerfCountersBuilder
+from ceph_tpu.ec import registry_instance
+from ceph_tpu.messages import (
+    MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
+    MOSDECSubOpWriteReply, MOSDFailure, MOSDMapMsg, MOSDOp, MOSDOpReply,
+    MOSDPing, MOSDRepOp, MOSDRepOpReply)
+from ceph_tpu.messages.osd_msgs import (
+    OP_DELETE, OP_OMAP_GET, OP_OMAP_SET, OP_READ, OP_STAT, OP_WRITE,
+    OP_WRITEFULL, OSDOpField)
+from ceph_tpu.mon.monitor import MMonSubscribe, MOSDBoot
+from ceph_tpu.msg.encoding import Decoder, Encoder
+from ceph_tpu.msg.message import Message, register_message
+from ceph_tpu.msg.messenger import (
+    ConnectionPolicy, Dispatcher, EntityName, Messenger)
+from ceph_tpu.objectstore import Transaction, create_objectstore
+from ceph_tpu.osd.map_codec import decode_osdmap
+from ceph_tpu.osd.osdmap import CEPH_NOSD, OSDMap, pg_to_pgid
+
+import numpy as np
+
+
+@register_message
+class MOSDPGScan(Message):
+    """primary -> replica: list your objects for this PG (recovery scan)."""
+
+    TYPE = 114
+
+    def __init__(self, pgid: tuple[int, int] = (0, 0), from_osd: int = 0):
+        super().__init__()
+        self.pgid = pgid
+        self.from_osd = from_osd
+
+    def encode_payload(self, enc: Encoder):
+        enc.versioned(1, 1, lambda e: (e.s64(self.pgid[0]),
+                                       e.u32(self.pgid[1]),
+                                       e.s32(self.from_osd)))
+
+    def decode_payload(self, dec: Decoder, version):
+        def body(d, v):
+            self.pgid = (d.s64(), d.u32())
+            self.from_osd = d.s32()
+        dec.versioned(1, body)
+
+
+@register_message
+class MOSDPGScanReply(Message):
+    TYPE = 115
+
+    def __init__(self, pgid: tuple[int, int] = (0, 0), from_osd: int = 0,
+                 objects: list[str] | None = None):
+        super().__init__()
+        self.pgid = pgid
+        self.from_osd = from_osd
+        self.objects = objects or []
+
+    def encode_payload(self, enc: Encoder):
+        enc.versioned(1, 1, lambda e: (
+            e.s64(self.pgid[0]), e.u32(self.pgid[1]), e.s32(self.from_osd),
+            e.list(self.objects, lambda e2, o: e2.str(o))))
+
+    def decode_payload(self, dec: Decoder, version):
+        def body(d, v):
+            self.pgid = (d.s64(), d.u32())
+            self.from_osd = d.s32()
+            self.objects = d.list(lambda d2: d2.str())
+        dec.versioned(1, body)
+
+
+@register_message
+class MOSDPGPull(Message):
+    """primary -> holder: send me this object (recovery pull)."""
+
+    TYPE = 116
+
+    def __init__(self, pgid: tuple[int, int] = (0, 0), oid: str = "",
+                 from_osd: int = 0):
+        super().__init__()
+        self.pgid = pgid
+        self.oid = oid
+        self.from_osd = from_osd
+
+    def encode_payload(self, enc: Encoder):
+        enc.versioned(1, 1, lambda e: (e.s64(self.pgid[0]),
+                                       e.u32(self.pgid[1]),
+                                       e.str(self.oid), e.s32(self.from_osd)))
+
+    def decode_payload(self, dec: Decoder, version):
+        def body(d, v):
+            self.pgid = (d.s64(), d.u32())
+            self.oid = d.str()
+            self.from_osd = d.s32()
+        dec.versioned(1, body)
+
+
+@register_message
+class MOSDPGPush(Message):
+    """holder -> primary: object payload (recovery push; MOSDPGPush analog)."""
+
+    TYPE = 117
+
+    def __init__(self, pgid: tuple[int, int] = (0, 0), oid: str = "",
+                 data: bytes = b"", omap: dict | None = None,
+                 attrs: dict | None = None):
+        super().__init__()
+        self.pgid = pgid
+        self.oid = oid
+        self.data = data
+        self.omap = omap or {}
+        self.attrs = attrs or {}
+
+    def encode_payload(self, enc: Encoder):
+        enc.versioned(1, 1, lambda e: (
+            e.s64(self.pgid[0]), e.u32(self.pgid[1]), e.str(self.oid),
+            e.bytes(self.data),
+            e.map(self.omap, lambda e2, k: e2.str(k),
+                  lambda e2, v: e2.bytes(v)),
+            e.map(self.attrs, lambda e2, k: e2.str(k),
+                  lambda e2, v: e2.bytes(v))))
+
+    def decode_payload(self, dec: Decoder, version):
+        def body(d, v):
+            self.pgid = (d.s64(), d.u32())
+            self.oid = d.str()
+            self.data = d.bytes()
+            self.omap = d.map(lambda d2: d2.str(), lambda d2: d2.bytes())
+            self.attrs = d.map(lambda d2: d2.str(), lambda d2: d2.bytes())
+        dec.versioned(1, body)
+
+
+class _InFlight:
+    """One client op waiting on replica/shard acks (in-flight repop)."""
+
+    def __init__(self, msg: MOSDOp, waiting: set[int], reply: MOSDOpReply):
+        self.msg = msg
+        self.waiting = waiting
+        self.reply = reply
+
+
+class OSDDaemon(Dispatcher):
+    def __init__(self, osd_id: int, mon_addr: str,
+                 ctx: CephTpuContext | None = None,
+                 store_type: str = "memstore", store_path: str = "",
+                 ms_type: str = "async", addr: str = "127.0.0.1:0",
+                 heartbeats: bool = True):
+        self.osd_id = osd_id
+        self.whoami = EntityName("osd", osd_id)
+        self.ctx = ctx or CephTpuContext(f"osd.{osd_id}")
+        self.mon_addr = mon_addr
+        self.store = create_objectstore(store_type, store_path)
+        self.osdmap = OSDMap()
+        self._lock = threading.RLock()
+        self._in_flight: dict[tuple[int, int], _InFlight] = {}
+        #: reqid -> {"shards": {shard: bytes}, "need": int, ...} EC reads
+        self._ec_reads: dict[tuple[int, int], dict] = {}
+        self._codecs: dict[int, object] = {}
+        self._osd_addr_cache: dict[int, str] = {}
+        self._hb_last: dict[int, float] = {}
+        self._hb_timer: threading.Timer | None = None
+        self._heartbeats = heartbeats
+        self._stop = False
+
+        self.msgr = Messenger.create(self.whoami, ms_type)
+        self.msgr.set_policy("client", ConnectionPolicy.lossy_client())
+        self.msgr.set_policy("osd", ConnectionPolicy.stateful_peer())
+        self.msgr.set_policy("mon", ConnectionPolicy.stateful_peer())
+        self.msgr.add_dispatcher_tail(self)
+        self._addr = addr
+
+        self.perf = (PerfCountersBuilder(f"osd.{osd_id}")
+                     .add_u64("op_w").add_u64("op_r").add_u64("op_rep")
+                     .add_u64("ec_encode_stripes").add_u64("recovery_pulls")
+                     .add_time_avg("op_w_latency")
+                     .create_perf_counters())
+        self.ctx.perf.add(self.perf)
+        self.ctx.admin.register_command(
+            "dump_ops_in_flight",
+            lambda **kw: {"num": len(self._in_flight)}, "in-flight ops")
+        self.ctx.admin.register_command(
+            "osd map epoch", lambda **kw: {"epoch": self.osdmap.epoch},
+            "current map epoch")
+
+    # -- lifecycle (OSD::init, ceph_osd.cc main) ------------------------------
+
+    def init(self) -> None:
+        self.store.mkfs_if_needed()
+        self.store.mount()
+        self.msgr.bind(self._addr)
+        self.msgr.start()
+        mon = self.msgr.connect_to(self.mon_addr, EntityName("mon", 0))
+        mon.send_message(MMonSubscribe(name=str(self.whoami),
+                                       addr=self.msgr.my_addr))
+        mon.send_message(MOSDBoot(osd_id=self.osd_id,
+                                  addr=self.msgr.my_addr))
+        if self._heartbeats:
+            self._schedule_heartbeat()
+
+    def shutdown(self) -> None:
+        self._stop = True
+        if self._hb_timer:
+            self._hb_timer.cancel()
+        self.msgr.shutdown()
+        self.store.umount()
+
+    # -- map handling ---------------------------------------------------------
+
+    def _handle_map(self, msg: MOSDMapMsg) -> None:
+        newmap = decode_osdmap(msg.map_blob)
+        with self._lock:
+            if newmap.epoch <= self.osdmap.epoch:
+                return
+            oldmap = self.osdmap
+            self.osdmap = newmap
+            self._codecs.clear()
+        del oldmap
+        dout("osd", 5, "osd.%d got map epoch %d", self.osd_id, newmap.epoch)
+        my_pgs = self._my_pgs()
+        self._activate_pgs(my_pgs)
+        self._maybe_recover(my_pgs)
+
+    def _my_pgs(self) -> list[tuple[int, int, list[int], int]]:
+        """(pool, pg, up, primary) for PGs whose up set includes me."""
+        out = []
+        m = self.osdmap
+        for pool_id, pool in m.pools.items():
+            for pg in range(pool.pg_num):
+                up, primary, _a, _ap = m.pg_to_up_acting_osds(pool_id, pg)
+                if self.osd_id in up:
+                    out.append((pool_id, pg, up, primary))
+        return out
+
+    def _activate_pgs(self, my_pgs) -> None:
+        t = Transaction()
+        existing = set(self.store.list_collections())
+        for pool_id, pg, _up, _p in my_pgs:
+            cid = f"{pool_id}.{pg}"
+            if cid not in existing:
+                t.create_collection(cid)
+        if len(t):
+            self.store.apply_transaction(t)
+
+    # -- recovery (pull-based backfill-lite) ----------------------------------
+
+    def _maybe_recover(self, my_pgs) -> None:
+        """Where I'm now primary, scan peers and pull objects I miss."""
+        for pool_id, pg, up, primary in my_pgs:
+            if primary != self.osd_id:
+                continue
+            peers = [o for o in up if o != self.osd_id and o != CEPH_NOSD]
+            for peer in peers:
+                con = self._osd_con(peer)
+                if con:
+                    con.send_message(MOSDPGScan(pgid=(pool_id, pg),
+                                                from_osd=self.osd_id))
+
+    def _handle_scan(self, msg: MOSDPGScan) -> None:
+        cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
+        try:
+            objs = self.store.list_objects(cid)
+        except KeyError:
+            objs = []
+        con = self._osd_con(msg.from_osd)
+        if con:
+            con.send_message(MOSDPGScanReply(
+                pgid=msg.pgid, from_osd=self.osd_id, objects=objs))
+
+    def _handle_scan_reply(self, msg: MOSDPGScanReply) -> None:
+        cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
+        try:
+            mine = set(self.store.list_objects(cid))
+        except KeyError:
+            mine = set()
+        missing = [o for o in msg.objects if o not in mine]
+        con = self._osd_con(msg.from_osd)
+        if con is None:
+            return
+        for oid in missing:
+            self.perf.inc("recovery_pulls")
+            con.send_message(MOSDPGPull(pgid=msg.pgid, oid=oid,
+                                        from_osd=self.osd_id))
+
+    def _handle_pull(self, msg: MOSDPGPull) -> None:
+        cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
+        try:
+            data = self.store.read(cid, msg.oid)
+            omap = self.store.omap_get(cid, msg.oid)
+        except KeyError:
+            return
+        con = self._osd_con(msg.from_osd)
+        if con:
+            con.send_message(MOSDPGPush(pgid=msg.pgid, oid=msg.oid,
+                                        data=data, omap=omap))
+
+    def _handle_push(self, msg: MOSDPGPush) -> None:
+        cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
+        t = Transaction()
+        existing = set(self.store.list_collections())
+        if cid not in existing:
+            t.create_collection(cid)
+        t.write(cid, msg.oid, 0, msg.data)
+        if msg.omap:
+            t.omap_setkeys(cid, msg.oid, msg.omap)
+        self.store.apply_transaction(t)
+
+    # -- heartbeats (OSD::heartbeat, osd/OSD.cc:4879) -------------------------
+
+    def _schedule_heartbeat(self) -> None:
+        if self._stop:
+            return
+        interval = float(self.ctx.conf.get("osd_heartbeat_interval"))
+        self._hb_timer = threading.Timer(interval, self._heartbeat_tick)
+        self._hb_timer.daemon = True
+        self._hb_timer.start()
+
+    def _heartbeat_tick(self) -> None:
+        try:
+            now = time.time()
+            grace = float(self.ctx.conf.get("osd_heartbeat_grace"))
+            m = self.osdmap
+            peers = [o for o in range(m.max_osd)
+                     if o != self.osd_id and m.is_up(o)]
+            for peer in peers:
+                con = self._osd_con(peer)
+                if con:
+                    con.send_message(MOSDPing(
+                        from_osd=self.osd_id, op=MOSDPing.PING, stamp=now,
+                        epoch=m.epoch))
+                # first contact starts the grace clock; a peer that never
+                # answers is as failed as one that stopped answering
+                last = self._hb_last.setdefault(peer, now)
+                if now - last > grace:
+                    mon = self.msgr.connect_to(self.mon_addr,
+                                               EntityName("mon", 0))
+                    mon.send_message(MOSDFailure(
+                        reporter=self.osd_id, failed_osd=peer,
+                        failed_for=now - last, epoch=m.epoch))
+        finally:
+            self._schedule_heartbeat()
+
+    # -- dispatch -------------------------------------------------------------
+
+    def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, MOSDMapMsg):
+            self._handle_map(msg)
+            return True
+        if isinstance(msg, MOSDOp):
+            self._handle_op(msg)
+            return True
+        if isinstance(msg, MOSDRepOp):
+            self._handle_rep_op(msg)
+            return True
+        if isinstance(msg, MOSDRepOpReply):
+            self._handle_rep_reply(msg)
+            return True
+        if isinstance(msg, MOSDECSubOpWrite):
+            self._handle_ec_write(msg)
+            return True
+        if isinstance(msg, MOSDECSubOpWriteReply):
+            self._handle_ec_write_reply(msg)
+            return True
+        if isinstance(msg, MOSDECSubOpRead):
+            self._handle_ec_read(msg)
+            return True
+        if isinstance(msg, MOSDECSubOpReadReply):
+            self._handle_ec_read_reply(msg)
+            return True
+        if isinstance(msg, MOSDPing):
+            self._handle_ping(msg)
+            return True
+        if isinstance(msg, MOSDPGScan):
+            self._handle_scan(msg)
+            return True
+        if isinstance(msg, MOSDPGScanReply):
+            self._handle_scan_reply(msg)
+            return True
+        if isinstance(msg, MOSDPGPull):
+            self._handle_pull(msg)
+            return True
+        if isinstance(msg, MOSDPGPush):
+            self._handle_push(msg)
+            return True
+        return False
+
+    def _handle_ping(self, msg: MOSDPing) -> None:
+        self._hb_last[msg.from_osd] = time.time()
+        if msg.op == MOSDPing.PING and msg.connection is not None:
+            msg.connection.send_message(MOSDPing(
+                from_osd=self.osd_id, op=MOSDPing.PING_REPLY,
+                stamp=msg.stamp, epoch=self.osdmap.epoch))
+
+    # -- op execution (PrimaryLogPG::do_op analog) ----------------------------
+
+    def _pg_members(self, pgid) -> tuple[list[int], int]:
+        up, primary, _a, _ap = self.osdmap.pg_to_up_acting_osds(
+            pgid[0], pgid[1])
+        return up, primary
+
+    def _handle_op(self, msg: MOSDOp) -> None:
+        pool = self.osdmap.pools.get(msg.pgid[0])
+        if pool is None:
+            self._reply_err(msg, -2)
+            return
+        up, primary = self._pg_members(msg.pgid)
+        if primary != self.osd_id:
+            # not my op in this epoch; client resends on map update
+            dout("osd", 10, "osd.%d not primary for %s", self.osd_id,
+                 msg.pgid)
+            return
+        if pool.is_erasure():
+            self._do_ec_op(msg, pool, up)
+        else:
+            self._do_replicated_op(msg, pool, up)
+
+    def _reply_err(self, msg: MOSDOp, code: int) -> None:
+        msg.connection.send_message(
+            MOSDOpReply(tid=msg.tid, result=code, epoch=self.osdmap.epoch))
+
+    # replicated pools ---------------------------------------------------------
+
+    def _do_replicated_op(self, msg: MOSDOp, pool, up) -> None:
+        cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
+        t = Transaction()
+        reply_ops: list[OSDOpField] = []
+        result = 0
+        is_write = False
+        for op in msg.ops:
+            if op.op in (OP_WRITE, OP_WRITEFULL):
+                is_write = True
+                if op.op == OP_WRITEFULL:
+                    t.truncate(cid, msg.oid, 0)
+                t.write(cid, msg.oid, op.offset, op.data)
+            elif op.op == OP_DELETE:
+                is_write = True
+                t.remove(cid, msg.oid)
+            elif op.op == OP_OMAP_SET:
+                is_write = True
+                keys = _decode_omap(op.data)
+                t.touch(cid, msg.oid)
+                t.omap_setkeys(cid, msg.oid, keys)
+            elif op.op == OP_READ:
+                try:
+                    data = self.store.read(
+                        cid, msg.oid, op.offset,
+                        op.length if op.length else None)
+                    reply_ops.append(OSDOpField(OP_READ, op.offset,
+                                                len(data), data))
+                    self.perf.inc("op_r")
+                except KeyError:
+                    result = -2
+            elif op.op == OP_STAT:
+                try:
+                    st = self.store.stat(cid, msg.oid)
+                    reply_ops.append(OSDOpField(
+                        OP_STAT, 0, st["size"], b""))
+                except KeyError:
+                    result = -2
+            elif op.op == OP_OMAP_GET:
+                try:
+                    omap = self.store.omap_get(cid, msg.oid)
+                    reply_ops.append(OSDOpField(
+                        OP_OMAP_GET, 0, 0, _encode_omap(omap)))
+                except KeyError:
+                    result = -2
+            else:
+                result = -22
+        if not is_write or result != 0:
+            msg.connection.send_message(MOSDOpReply(
+                tid=msg.tid, result=result, epoch=self.osdmap.epoch,
+                ops=reply_ops))
+            return
+        # write path: local commit + replica fan-out (issue_repop)
+        self.perf.inc("op_w")
+        t0 = time.time()
+        self.store.apply_transaction(t)
+        replicas = [o for o in up if o != self.osd_id and o != CEPH_NOSD]
+        reply = MOSDOpReply(tid=msg.tid, result=0, epoch=self.osdmap.epoch)
+        if not replicas:
+            self.perf.tinc("op_w_latency", time.time() - t0)
+            msg.connection.send_message(reply)
+            return
+        reqid = (msg.client_id, msg.tid)
+        with self._lock:
+            self._in_flight[reqid] = _InFlight(msg, set(replicas), reply)
+        blob = t.encode()
+        for rep in replicas:
+            con = self._osd_con(rep)
+            if con is None:
+                # address unknown this epoch: count it as an instant nack so
+                # the op does not hang; the client retries on the next map
+                self._ack_shard(reqid, rep, -107)
+                continue
+            con.send_message(MOSDRepOp(reqid=reqid, pgid=msg.pgid,
+                                       oid=msg.oid, txn=blob))
+        self.perf.tinc("op_w_latency", time.time() - t0)
+
+    def _handle_rep_op(self, msg: MOSDRepOp) -> None:
+        self.perf.inc("op_rep")
+        t = Transaction.decode(msg.txn)
+        cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
+        if cid not in self.store.list_collections():
+            pre = Transaction().create_collection(cid)
+            self.store.apply_transaction(pre)
+        self.store.apply_transaction(t)
+        msg.connection.send_message(MOSDRepOpReply(
+            reqid=msg.reqid, pgid=msg.pgid, from_osd=self.osd_id, result=0))
+
+    def _handle_rep_reply(self, msg: MOSDRepOpReply) -> None:
+        self._ack_shard(msg.reqid, msg.from_osd, msg.result)
+
+    def _ack_shard(self, reqid, from_osd: int, result: int) -> None:
+        with self._lock:
+            inf = self._in_flight.get(reqid)
+            if inf is None:
+                return
+            inf.waiting.discard(from_osd)
+            if result != 0:
+                inf.reply.result = result
+            if inf.waiting:
+                return
+            del self._in_flight[reqid]
+        inf.msg.connection.send_message(inf.reply)
+
+    # erasure pools ------------------------------------------------------------
+
+    def _codec(self, pool):
+        with self._lock:
+            c = self._codecs.get(pool.pool_id)
+            if c is None:
+                profile = dict(pool.ec_profile)
+                plugin = profile.pop("plugin", "jerasure")
+                profile.setdefault(
+                    "runtime", self.ctx.conf.get("erasure_code_runtime"))
+                c = registry_instance().factory(plugin, profile)
+                self._codecs[pool.pool_id] = c
+            return c
+
+    def _do_ec_op(self, msg: MOSDOp, pool, up) -> None:
+        codec = self._codec(pool)
+        k = codec.get_data_chunk_count()
+        n = codec.get_chunk_count()
+        cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
+        for op in msg.ops:
+            if op.op == OP_WRITEFULL:
+                self.perf.inc("op_w")
+                chunks = codec.encode(set(range(n)), op.data)
+                self.perf.inc("ec_encode_stripes")
+                reqid = (msg.client_id, msg.tid)
+                shard_osds = {s: up[s] for s in range(min(n, len(up)))
+                              if up[s] != CEPH_NOSD}
+                reply = MOSDOpReply(tid=msg.tid, result=0,
+                                    epoch=self.osdmap.epoch)
+                waiting = set()
+                size_attr = str(len(op.data)).encode()
+                for shard, osd in shard_osds.items():
+                    if osd == self.osd_id:
+                        t = (Transaction()
+                             .write(cid, f"{msg.oid}:{shard}", 0,
+                                    chunks[shard])
+                             .setattr(cid, f"{msg.oid}:{shard}", "size",
+                                      size_attr))
+                        self.store.apply_transaction(t)
+                    else:
+                        waiting.add(osd)
+                with self._lock:
+                    if waiting:
+                        self._in_flight[reqid] = _InFlight(
+                            msg, set(waiting), reply)
+                for shard, osd in shard_osds.items():
+                    if osd == self.osd_id:
+                        continue
+                    con = self._osd_con(osd)
+                    if con is None:
+                        self._ack_shard(reqid, osd, -107)
+                        continue
+                    con.send_message(MOSDECSubOpWrite(
+                        reqid=reqid, pgid=msg.pgid,
+                        oid=f"{msg.oid}:{shard}|{len(op.data)}",
+                        shard=shard, chunk=chunks[shard],
+                        epoch=self.osdmap.epoch))
+                if not waiting:
+                    msg.connection.send_message(reply)
+            elif op.op == OP_READ:
+                self.perf.inc("op_r")
+                self._start_ec_read(msg, pool, up, cid)
+            else:
+                self._reply_err(msg, -22)
+                return
+
+    def _handle_ec_write(self, msg: MOSDECSubOpWrite) -> None:
+        oid, _, size = msg.oid.partition("|")
+        cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
+        if cid not in self.store.list_collections():
+            self.store.apply_transaction(Transaction().create_collection(cid))
+        t = (Transaction().write(cid, oid, 0, msg.chunk)
+             .setattr(cid, oid, "size", size.encode()))
+        self.store.apply_transaction(t)
+        msg.connection.send_message(MOSDECSubOpWriteReply(
+            reqid=msg.reqid, shard=msg.shard, from_osd=self.osd_id,
+            result=0))
+
+    def _handle_ec_write_reply(self, msg: MOSDECSubOpWriteReply) -> None:
+        self._ack_shard(msg.reqid, msg.from_osd, msg.result)
+
+    def _start_ec_read(self, msg: MOSDOp, pool, up, cid: str) -> None:
+        """objects_read_and_reconstruct analog: gather k shards, decode."""
+        codec = self._codec(pool)
+        k = codec.get_data_chunk_count()
+        n = codec.get_chunk_count()
+        reqid = (msg.client_id, msg.tid)
+        avail = {s: up[s] for s in range(min(n, len(up)))
+                 if up[s] != CEPH_NOSD}
+        if len(avail) < k:
+            # fewer than k shards mapped to live osds: unreadable this epoch
+            self._reply_err(msg, -5)
+            return
+        want = dict(list(avail.items()))
+        state = {"msg": msg, "pool": pool, "shards": {}, "k": k,
+                 "asked": set(), "failed": set()}
+        with self._lock:
+            self._ec_reads[reqid] = state
+        # ask k shards (prefer data shards: minimum_to_decode semantics)
+        chosen = sorted(want)[:k]
+        for s in chosen:
+            osd = want[s]
+            state["asked"].add(s)
+            if osd == self.osd_id:
+                self._ec_read_local(reqid, msg, cid, s)
+            else:
+                con = self._osd_con(osd)
+                if con is None:
+                    self._ec_read_failed(reqid, s)
+                    continue
+                con.send_message(MOSDECSubOpRead(
+                    reqid=reqid, pgid=msg.pgid, oid=msg.oid, shard=s))
+
+    def _ec_read_local(self, reqid, msg, cid, shard) -> None:
+        try:
+            chunk = self.store.read(cid, f"{msg.oid}:{shard}")
+            size = int(self.store.getattr(cid, f"{msg.oid}:{shard}", "size"))
+        except (KeyError, TypeError):
+            self._ec_read_failed(reqid, shard)
+            return
+        self._ec_read_done(reqid, shard, chunk, size)
+
+    def _handle_ec_read(self, msg: MOSDECSubOpRead) -> None:
+        cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
+        try:
+            chunk = self.store.read(cid, f"{msg.oid}:{msg.shard}")
+            size = int(self.store.getattr(cid, f"{msg.oid}:{msg.shard}",
+                                          "size"))
+            result = 0
+        except (KeyError, TypeError):
+            chunk, size, result = b"", 0, -2
+        msg.connection.send_message(MOSDECSubOpReadReply(
+            reqid=msg.reqid, shard=msg.shard, from_osd=self.osd_id,
+            result=result, chunk=chunk + size.to_bytes(8, "little")
+            if result == 0 else b""))
+
+    def _handle_ec_read_reply(self, msg: MOSDECSubOpReadReply) -> None:
+        if msg.result != 0:
+            self._ec_read_failed(msg.reqid, msg.shard)
+            return
+        chunk, size = msg.chunk[:-8], int.from_bytes(msg.chunk[-8:],
+                                                     "little")
+        self._ec_read_done(msg.reqid, msg.shard, chunk, size)
+
+    def _ec_read_failed(self, reqid, shard: int) -> None:
+        with self._lock:
+            state = self._ec_reads.get(reqid)
+            if state is None:
+                return
+            state["failed"].add(shard)
+            msg = state["msg"]
+            pool = state["pool"]
+        # ask a replacement shard not yet asked (min_to_decode retry)
+        up, _primary = self._pg_members(msg.pgid)
+        codec = self._codec(pool)
+        n = codec.get_chunk_count()
+        cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
+        with self._lock:
+            candidates = [s for s in range(min(n, len(up)))
+                          if up[s] != CEPH_NOSD and s not in state["asked"]]
+            if not candidates:
+                del self._ec_reads[reqid]
+                self._reply_err(msg, -5)
+                return
+            s = candidates[0]
+            state["asked"].add(s)
+            osd = up[s]
+        if osd == self.osd_id:
+            self._ec_read_local(reqid, msg, cid, s)
+        else:
+            con = self._osd_con(osd)
+            if con is None:
+                self._ec_read_failed(reqid, s)
+            else:
+                con.send_message(MOSDECSubOpRead(
+                    reqid=reqid, pgid=msg.pgid, oid=msg.oid, shard=s))
+
+    def _ec_read_done(self, reqid, shard: int, chunk: bytes,
+                      size: int) -> None:
+        with self._lock:
+            state = self._ec_reads.get(reqid)
+            if state is None:
+                return
+            state["shards"][shard] = chunk
+            state["size"] = size
+            if len(state["shards"]) < state["k"]:
+                return
+            del self._ec_reads[reqid]
+        msg = state["msg"]
+        codec = self._codec(state["pool"])
+        k = state["k"]
+        have = dict(sorted(state["shards"].items())[:k])
+        chunks = {s: c for s, c in have.items()}
+        decoded = codec.decode(set(range(k)), chunks)
+        data = b"".join(decoded[i] for i in range(k))[:state["size"]]
+        msg.connection.send_message(MOSDOpReply(
+            tid=msg.tid, result=0, epoch=self.osdmap.epoch,
+            ops=[OSDOpField(OP_READ, 0, len(data), data)]))
+
+    # -- peers ----------------------------------------------------------------
+
+    def set_osd_addr(self, osd: int, addr: str) -> None:
+        self._osd_addr_cache[osd] = addr
+
+    def _osd_con(self, osd: int):
+        addr = None
+        if 0 <= osd < len(self.osdmap.osd_addrs):
+            addr = self.osdmap.osd_addrs[osd] or None
+        if addr is None:
+            addr = self._osd_addr_cache.get(osd)
+        if addr is None:
+            return None
+        return self.msgr.connect_to(addr, EntityName("osd", osd))
+
+
+def _encode_omap(d: dict) -> bytes:
+    e = Encoder()
+    e.map(d, lambda e2, k2: e2.str(k2), lambda e2, v: e2.bytes(v))
+    return e.tobytes()
+
+
+def _decode_omap(data: bytes) -> dict:
+    return Decoder(data).map(lambda d: d.str(), lambda d: d.bytes())

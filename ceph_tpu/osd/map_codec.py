@@ -1,0 +1,204 @@
+"""Wire encoding for CrushMap and OSDMap (OSDMap::encode / CrushWrapper::encode
+analog) using the versioned binary codec, so maps distribute over MOSDMapMsg
+and persist in the mon store exactly like any other wire struct."""
+
+from __future__ import annotations
+
+from ceph_tpu.crush.types import (
+    Bucket, ChooseArg, CrushMap, Rule, RuleStep, Tunables)
+from ceph_tpu.msg.encoding import Decoder, Encoder
+
+from .osdmap import OSDMap, PGPool
+
+
+# -- crush ------------------------------------------------------------------
+
+def encode_crush(m: CrushMap, enc: Encoder) -> None:
+    def body(e: Encoder):
+        t = m.tunables
+        for v in (t.choose_local_tries, t.choose_local_fallback_tries,
+                  t.choose_total_tries, t.chooseleaf_descend_once,
+                  t.chooseleaf_vary_r, t.chooseleaf_stable,
+                  t.straw_calc_version):
+            e.u32(v)
+        e.u32(m.max_devices)
+
+        def enc_bucket(e2: Encoder, b: Bucket | None):
+            if b is None:
+                e2.u8(0)
+                return
+            e2.u8(1)
+            e2.s32(b.id).u32(b.type).u8(b.alg).u8(b.hash).u32(b.weight)
+            e2.list(b.items, lambda e3, v: e3.s32(v))
+            e2.list(b.item_weights, lambda e3, v: e3.u32(v))
+            e2.u32(b.item_weight)
+            e2.list(b.sum_weights, lambda e3, v: e3.u32(v))
+            e2.list(b.straws, lambda e3, v: e3.u64(v))
+            e2.list(b.node_weights, lambda e3, v: e3.u32(v))
+
+        e.list(m.buckets, enc_bucket)
+
+        def enc_rule(e2: Encoder, r: Rule | None):
+            if r is None:
+                e2.u8(0)
+                return
+            e2.u8(1)
+            e2.u32(r.ruleset).u32(r.type).u32(r.min_size).u32(r.max_size)
+            e2.list(r.steps, lambda e3, s: (e3.u32(s.op), e3.s32(s.arg1),
+                                            e3.s32(s.arg2)))
+
+        e.list(m.rules, enc_rule)
+
+        def enc_choose_args(e2: Encoder, d: dict):
+            def enc_arg(e3: Encoder, a: ChooseArg):
+                if a.ids is None:
+                    e3.u8(0)
+                else:
+                    e3.u8(1)
+                    e3.list(a.ids, lambda e4, v: e4.s32(v))
+                if a.weight_set is None:
+                    e3.u8(0)
+                else:
+                    e3.u8(1)
+                    e3.list(a.weight_set,
+                            lambda e4, ws: e4.list(ws, lambda e5, v: e5.u32(v)))
+
+            e2.map(d, lambda e3, k: e3.u32(k), enc_arg)
+
+        e.map(m.choose_args, lambda e2, k: e2.str(str(k)), enc_choose_args)
+
+    enc.versioned(1, 1, body)
+
+
+def decode_crush(dec: Decoder) -> CrushMap:
+    def body(d: Decoder, version: int) -> CrushMap:
+        t = Tunables(
+            choose_local_tries=d.u32(),
+            choose_local_fallback_tries=d.u32(),
+            choose_total_tries=d.u32(),
+            chooseleaf_descend_once=d.u32(),
+            chooseleaf_vary_r=d.u32(),
+            chooseleaf_stable=d.u32(),
+            straw_calc_version=d.u32(),
+        )
+        max_devices = d.u32()
+
+        def dec_bucket(d2: Decoder) -> Bucket | None:
+            if not d2.u8():
+                return None
+            b = Bucket(id=d2.s32(), type=d2.u32(), alg=d2.u8(),
+                       hash=d2.u8(), weight=d2.u32())
+            b.items = d2.list(lambda d3: d3.s32())
+            b.item_weights = d2.list(lambda d3: d3.u32())
+            b.item_weight = d2.u32()
+            b.sum_weights = d2.list(lambda d3: d3.u32())
+            b.straws = d2.list(lambda d3: d3.u64())
+            b.node_weights = d2.list(lambda d3: d3.u32())
+            return b
+
+        buckets = d.list(dec_bucket)
+
+        def dec_rule(d2: Decoder) -> Rule | None:
+            if not d2.u8():
+                return None
+            r = Rule(ruleset=d2.u32(), type=d2.u32(), min_size=d2.u32(),
+                     max_size=d2.u32())
+            r.steps = d2.list(
+                lambda d3: RuleStep(op=d3.u32(), arg1=d3.s32(), arg2=d3.s32()))
+            return r
+
+        rules = d.list(dec_rule)
+
+        def dec_choose_args(d2: Decoder) -> dict:
+            def dec_arg(d3: Decoder) -> ChooseArg:
+                ids = d3.list(lambda d4: d4.s32()) if d3.u8() else None
+                ws = (d3.list(lambda d4: d4.list(lambda d5: d5.u32()))
+                      if d3.u8() else None)
+                return ChooseArg(ids=ids, weight_set=ws)
+
+            return d2.map(lambda d3: d3.u32(), dec_arg)
+
+        choose_args = d.map(lambda d2: d2.str(), dec_choose_args)
+        m = CrushMap(buckets=buckets, rules=rules, max_devices=max_devices,
+                     tunables=t,
+                     choose_args={k: v for k, v in choose_args.items()})
+        return m
+
+    return dec.versioned(1, body)
+
+
+# -- osdmap -----------------------------------------------------------------
+
+def encode_osdmap(m: OSDMap) -> bytes:
+    enc = Encoder()
+
+    def body(e: Encoder):
+        e.u32(m.epoch).u32(m.max_osd)
+        encode_crush(m.crush, e)
+        e.list(m.osd_state, lambda e2, v: e2.u8(v))
+        e.list(m.osd_weight, lambda e2, v: e2.u32(v))
+        e.list(m.osd_primary_affinity, lambda e2, v: e2.u32(v))
+        e.list(m.osd_addrs, lambda e2, v: e2.str(v))
+
+        def enc_pool(e2: Encoder, p: PGPool):
+            e2.s64(p.pool_id).u8(p.type).u32(p.size).u32(p.min_size)
+            e2.u32(p.crush_rule).u32(p.pg_num).u32(p.pgp_num)
+            e2.map(p.ec_profile, lambda e3, k: e3.str(k),
+                   lambda e3, v: e3.str(str(v)))
+
+        e.map(m.pools, lambda e2, k: e2.s64(k), enc_pool)
+
+        def enc_pgid_key(e2: Encoder, k: tuple[int, int]):
+            e2.s64(k[0])
+            e2.u32(k[1])
+
+        e.map(m.pg_upmap, enc_pgid_key,
+              lambda e2, v: e2.list(v, lambda e3, o: e3.s32(o)))
+        e.map(m.pg_upmap_items, enc_pgid_key,
+              lambda e2, v: e2.list(v, lambda e3, p: (e3.s32(p[0]),
+                                                      e3.s32(p[1]))))
+        e.map(m.pg_temp, enc_pgid_key,
+              lambda e2, v: e2.list(v, lambda e3, o: e3.s32(o)))
+        e.map(m.primary_temp, enc_pgid_key, lambda e2, v: e2.s32(v))
+
+    enc.versioned(1, 1, body)
+    return enc.tobytes()
+
+
+def decode_osdmap(data: bytes) -> OSDMap:
+    dec = Decoder(data)
+
+    def body(d: Decoder, version: int) -> OSDMap:
+        epoch = d.u32()
+        max_osd = d.u32()
+        crush = decode_crush(d)
+        osd_state = d.list(lambda d2: d2.u8())
+        osd_weight = d.list(lambda d2: d2.u32())
+        affinity = d.list(lambda d2: d2.u32())
+        osd_addrs = d.list(lambda d2: d2.str())
+
+        def dec_pool(d2: Decoder) -> PGPool:
+            return PGPool(pool_id=d2.s64(), type=d2.u8(), size=d2.u32(),
+                          min_size=d2.u32(), crush_rule=d2.u32(),
+                          pg_num=d2.u32(), pgp_num=d2.u32(),
+                          ec_profile=d2.map(lambda d3: d3.str(),
+                                            lambda d3: d3.str()))
+
+        def dec_pgid_key(d2: Decoder) -> tuple[int, int]:
+            return (d2.s64(), d2.u32())
+
+        pools = d.map(lambda d2: d2.s64(), dec_pool)
+        pg_upmap = d.map(dec_pgid_key, lambda d2: d2.list(lambda d3: d3.s32()))
+        pg_upmap_items = d.map(
+            dec_pgid_key,
+            lambda d2: d2.list(lambda d3: (d3.s32(), d3.s32())))
+        pg_temp = d.map(dec_pgid_key, lambda d2: d2.list(lambda d3: d3.s32()))
+        primary_temp = d.map(dec_pgid_key, lambda d2: d2.s32())
+        return OSDMap(epoch=epoch, crush=crush, max_osd=max_osd,
+                      osd_state=osd_state, osd_weight=osd_weight,
+                      osd_primary_affinity=affinity, osd_addrs=osd_addrs,
+                      pools=pools,
+                      pg_upmap=pg_upmap, pg_upmap_items=pg_upmap_items,
+                      pg_temp=pg_temp, primary_temp=primary_temp)
+
+    return dec.versioned(1, body)

@@ -66,6 +66,8 @@ class PGPool:
     crush_rule: int = 0
     pg_num: int = 64
     pgp_num: int = 0  # 0 -> pg_num
+    # erasure pools carry their code profile (pg_pool_t erasure_code_profile)
+    ec_profile: dict = field(default_factory=dict)
 
     def __post_init__(self):
         if self.pgp_num == 0:
@@ -99,6 +101,7 @@ class OSDMap:
     osd_state: list[int] = field(default_factory=list)   # EXISTS|UP bits
     osd_weight: list[int] = field(default_factory=list)  # 16.16 reweight
     osd_primary_affinity: list[int] = field(default_factory=list)
+    osd_addrs: list[str] = field(default_factory=list)   # entity_addr_t
     pools: dict[int, PGPool] = field(default_factory=dict)
     # overrides
     pg_upmap: dict[tuple[int, int], list[int]] = field(default_factory=dict)
@@ -113,7 +116,8 @@ class OSDMap:
         """OSDMap::set_max_osd — grow the state vectors."""
         self.max_osd = n
         for vec, dflt in ((self.osd_state, 0), (self.osd_weight, 0),
-                          (self.osd_primary_affinity, MAX_AFFINITY)):
+                          (self.osd_primary_affinity, MAX_AFFINITY),
+                          (self.osd_addrs, "")):
             while len(vec) < n:
                 vec.append(dflt)
 

@@ -1,0 +1,7 @@
+"""CLI tools and harnesses (reference layer 7: src/tools/, src/vstart.sh).
+
+vstart        in-process MiniCluster harness
+crush_test    crushtool --test analog (batched)
+osdmap_test   osdmaptool --test-map-pgs analog
+ec_benchmark  ceph_erasure_code_benchmark analog
+"""

@@ -1,0 +1,100 @@
+"""vstart-style in-process cluster harness (src/vstart.sh +
+qa/standalone/ceph-helpers.sh analog).
+
+Starts one mon and N osds in this process over the chosen messenger stack,
+returns a handle with run_mon/run_osd/kill_osd/wait_for_clean-style helpers,
+and a connected RadosClient factory — the surface the standalone QA tier
+drives (SURVEY.md §4 tier 3).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ceph_tpu.client import RadosClient
+from ceph_tpu.mon import Monitor
+from ceph_tpu.osd.daemon import OSDDaemon
+
+
+class MiniCluster:
+    def __init__(self, n_osds: int = 3, ms_type: str = "async",
+                 store_type: str = "memstore", base_path: str = "",
+                 heartbeats: bool = False):
+        self.ms_type = ms_type
+        self.store_type = store_type
+        self.base_path = base_path
+        self.heartbeats = heartbeats
+        self.mon: Monitor | None = None
+        self.osds: dict[int, OSDDaemon] = {}
+        self.clients: list[RadosClient] = []
+        self._n_initial = n_osds
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "MiniCluster":
+        addr = ("127.0.0.1:0" if self.ms_type == "async" else "mon.0")
+        self.mon = Monitor(ms_type=self.ms_type, addr=addr)
+        self.mon.init()
+        for i in range(self._n_initial):
+            self.run_osd(i)
+        return self
+
+    def run_osd(self, osd_id: int) -> OSDDaemon:
+        addr = (f"127.0.0.1:0" if self.ms_type == "async"
+                else f"osd.{osd_id}")
+        path = (f"{self.base_path}/osd.{osd_id}" if self.base_path else "")
+        osd = OSDDaemon(osd_id, self.mon.addr, store_type=self.store_type,
+                        store_path=path, ms_type=self.ms_type, addr=addr,
+                        heartbeats=self.heartbeats)
+        osd.init()
+        self.osds[osd_id] = osd
+        return osd
+
+    def kill_osd(self, osd_id: int) -> None:
+        """Hard kill (Thrasher kill_osd analog)."""
+        osd = self.osds.pop(osd_id)
+        osd.shutdown()
+
+    def client(self, timeout: float = 10.0) -> RadosClient:
+        c = RadosClient(self.mon.addr, ms_type=self.ms_type, timeout=timeout)
+        c.connect()
+        self.clients.append(c)
+        return c
+
+    def stop(self) -> None:
+        for c in self.clients:
+            c.shutdown()
+        for osd in list(self.osds.values()):
+            osd.shutdown()
+        self.osds.clear()
+        if self.mon:
+            self.mon.shutdown()
+
+    # -- helpers (ceph-helpers.sh analog) -------------------------------------
+
+    def wait_for_epoch(self, epoch: int, timeout: float = 10.0) -> None:
+        """All live daemons have seen at least `epoch`."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if all(o.osdmap.epoch >= epoch for o in self.osds.values()):
+                return
+            time.sleep(0.02)
+        raise TimeoutError(f"cluster did not reach epoch {epoch}")
+
+    def wait_for_osd_count(self, n: int, timeout: float = 10.0) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.mon.status()["num_up_osds"] == n:
+                return
+            time.sleep(0.02)
+        raise TimeoutError(f"never saw {n} up osds")
+
+    def create_pool(self, client: RadosClient, **cmd) -> int:
+        res, out = client.mon_command(
+            dict({"prefix": "osd pool create"}, **cmd))
+        assert res == 0, out
+        pool_id = int(out.split()[1])
+        epoch = self.mon.osdmap.epoch
+        self.wait_for_epoch(epoch)
+        client.wait_for_epoch(epoch)
+        return pool_id

@@ -1,0 +1,142 @@
+"""Foundation-runtime tests: config registry + observers, perf counters,
+admin socket (in-process and over the unix socket), throttle, logging."""
+
+import os
+import tempfile
+import threading
+
+import pytest
+
+from ceph_tpu.common import (
+    CephTpuContext, Config, Option, OPT_INT, PerfCountersBuilder, Throttle,
+    dout, set_subsys_level)
+from ceph_tpu.common.admin_socket import admin_request
+from ceph_tpu.common.config import register_options
+
+
+def test_config_defaults_and_layers():
+    c = Config()
+    assert c.get("osd_pool_default_size") == 3
+    c.set("osd_pool_default_size", "5", source="file")
+    assert c.get("osd_pool_default_size") == 5        # cast to int
+    c.set("osd_pool_default_size", 4, source="runtime")
+    assert c.get("osd_pool_default_size") == 4        # runtime wins over file
+    c.set("osd_pool_default_size", 7, source="file")
+    assert c.get("osd_pool_default_size") == 4        # still runtime
+    assert c.diff() == {"osd_pool_default_size": 4}
+
+
+def test_config_validation():
+    c = Config()
+    with pytest.raises(KeyError):
+        c.get("no_such_option")
+    with pytest.raises(ValueError):
+        c.set("osd_pool_default_size", "abc")
+    with pytest.raises(ValueError):
+        c.set("osd_pool_default_size", 3, source="bogus")
+
+
+def test_config_observer_fires_on_change():
+    c = Config()
+    seen = []
+    c.add_observer("log_level", lambda n, v: seen.append((n, v)))
+    c.set("log_level", 5)
+    c.set("log_level", 5)   # no change, no callback
+    assert seen == [("log_level", 5)]
+
+
+def test_register_options_conflict():
+    register_options([Option("test_option_xyz", OPT_INT, 1)])
+    register_options([Option("test_option_xyz", OPT_INT, 1)])  # same: ok
+    with pytest.raises(ValueError):
+        register_options([Option("test_option_xyz", OPT_INT, 2)])
+
+
+def test_perf_counters():
+    pc = (PerfCountersBuilder("osd")
+          .add_u64("op_w", "writes")
+          .add_time_avg("op_w_latency", "write latency")
+          .add_histogram("op_size", [1024, 4096, 65536])
+          .create_perf_counters())
+    pc.inc("op_w")
+    pc.inc("op_w", 2)
+    pc.tinc("op_w_latency", 0.5)
+    pc.tinc("op_w_latency", 1.5)
+    pc.hinc("op_size", 2000)
+    pc.hinc("op_size", 100000)
+    d = pc.dump()
+    assert d["op_w"] == 3
+    assert d["op_w_latency"] == {"avgcount": 2, "sum": 2.0}
+    assert d["op_size"]["buckets"] == [0, 1, 0, 1]
+    assert pc.avg("op_w_latency") == 1.0
+
+
+def test_context_admin_commands():
+    ctx = CephTpuContext("osd.0")
+    pc = PerfCountersBuilder("osd").add_u64("ops").create_perf_counters()
+    ctx.perf.add(pc)
+    pc.inc("ops", 7)
+    assert ctx.admin.execute("perf dump")["osd"]["ops"] == 7
+    ctx.admin.execute("config set", name="log_level", value=3)
+    assert ctx.admin.execute("config get", name="log_level") == {"log_level": 3}
+    assert "perf dump" in ctx.admin.execute("help")
+    with pytest.raises(KeyError):
+        ctx.admin.execute("no such command")
+
+
+def test_admin_socket_over_unix_socket():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "osd.asok")
+        ctx = CephTpuContext("osd.1", admin_path=path)
+        ctx.admin.serve()
+        out = admin_request(path, "config get", name="osd_pool_default_size")
+        assert out == {"osd_pool_default_size": 3}
+        out = admin_request(path, "bogus")
+        assert "error" in out
+        ctx.admin.shutdown()
+
+
+def test_throttle_blocks_and_releases():
+    t = Throttle("bytes", 100)
+    assert t.get_or_fail(80)
+    assert not t.get_or_fail(30)
+    done = []
+
+    def waiter():
+        t.get(30)
+        done.append(True)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    th.join(0.05)
+    assert not done            # still blocked
+    t.put(80)
+    th.join(2)
+    assert done
+    assert t.current == 30
+
+
+def test_dout_gating():
+    import logging
+
+    class Capture(logging.Handler):
+        def __init__(self):
+            super().__init__()
+            self.msgs = []
+
+        def emit(self, record):
+            self.msgs.append(record.getMessage())
+
+    cap = Capture()
+    logging.getLogger("ceph_tpu").addHandler(cap)
+    try:
+        set_subsys_level("crush", 1)
+        dout("crush", 1, "visible %d", 1)
+        dout("crush", 10, "hidden")
+        set_subsys_level("crush", 10)
+        dout("crush", 10, "now visible")
+    finally:
+        logging.getLogger("ceph_tpu").removeHandler(cap)
+    assert "visible 1" in cap.msgs
+    assert "hidden" not in cap.msgs
+    assert "now visible" in cap.msgs
