@@ -170,7 +170,8 @@ class MOSDECSubOpWrite(Message):
 
     def __init__(self, reqid: tuple[int, int] = (0, 0),
                  pgid: tuple[int, int] = (0, 0), oid: str = "",
-                 shard: int = 0, chunk: bytes = b"", epoch: int = 0):
+                 shard: int = 0, chunk: bytes = b"", epoch: int = 0,
+                 obj_size: int = 0):
         super().__init__()
         self.reqid = reqid
         self.pgid = pgid
@@ -178,12 +179,13 @@ class MOSDECSubOpWrite(Message):
         self.shard = shard
         self.chunk = chunk
         self.epoch = epoch
+        self.obj_size = obj_size  # full (pre-encode) object size
 
     def encode_payload(self, enc):
         enc.versioned(1, 1, lambda e: (
             e.u64(self.reqid[0]), e.u64(self.reqid[1]),
             _enc_pgid(e, self.pgid), e.str(self.oid), e.u8(self.shard),
-            e.bytes(self.chunk), e.u32(self.epoch)))
+            e.bytes(self.chunk), e.u32(self.epoch), e.u64(self.obj_size)))
 
     def decode_payload(self, dec, version):
         def body(d, v):
@@ -193,6 +195,7 @@ class MOSDECSubOpWrite(Message):
             self.shard = d.u8()
             self.chunk = d.bytes()
             self.epoch = d.u32()
+            self.obj_size = d.u64()
         dec.versioned(1, body)
 
 

@@ -418,9 +418,11 @@ class OSDDaemon(Dispatcher):
     # -- op execution (PrimaryLogPG::do_op analog) ----------------------------
 
     def _pg_members(self, pgid) -> tuple[list[int], int]:
-        up, primary, _a, _ap = self.osdmap.pg_to_up_acting_osds(
-            pgid[0], pgid[1])
-        return up, primary
+        """(up, acting_primary) — ops are accepted by the acting primary,
+        matching the client's _calc_target (osdc/Objecter.cc:2795)."""
+        up, _up_primary, _acting, acting_primary = \
+            self.osdmap.pg_to_up_acting_osds(pgid[0], pgid[1])
+        return up, acting_primary
 
     def _handle_op(self, msg: MOSDOp) -> None:
         pool = self.osdmap.pools.get(msg.pgid[0])
@@ -569,11 +571,16 @@ class OSDDaemon(Dispatcher):
         for op in msg.ops:
             if op.op == OP_WRITEFULL:
                 self.perf.inc("op_w")
-                chunks = codec.encode(set(range(n)), op.data)
-                self.perf.inc("ec_encode_stripes")
                 reqid = (msg.client_id, msg.tid)
                 shard_osds = {s: up[s] for s in range(min(n, len(up)))
                               if up[s] != CEPH_NOSD}
+                if len(shard_osds) < max(k, pool.min_size):
+                    # below min_size the write could never be re-read;
+                    # block it (PrimaryLogPG checks acting >= min_size)
+                    self._reply_err(msg, -11)
+                    return
+                chunks = codec.encode(set(range(n)), op.data)
+                self.perf.inc("ec_encode_stripes")
                 reply = MOSDOpReply(tid=msg.tid, result=0,
                                     epoch=self.osdmap.epoch)
                 waiting = set()
@@ -581,6 +588,7 @@ class OSDDaemon(Dispatcher):
                 for shard, osd in shard_osds.items():
                     if osd == self.osd_id:
                         t = (Transaction()
+                             .truncate(cid, f"{msg.oid}:{shard}", 0)
                              .write(cid, f"{msg.oid}:{shard}", 0,
                                     chunks[shard])
                              .setattr(cid, f"{msg.oid}:{shard}", "size",
@@ -601,9 +609,10 @@ class OSDDaemon(Dispatcher):
                         continue
                     con.send_message(MOSDECSubOpWrite(
                         reqid=reqid, pgid=msg.pgid,
-                        oid=f"{msg.oid}:{shard}|{len(op.data)}",
+                        oid=f"{msg.oid}:{shard}",
                         shard=shard, chunk=chunks[shard],
-                        epoch=self.osdmap.epoch))
+                        epoch=self.osdmap.epoch,
+                        obj_size=len(op.data)))
                 if not waiting:
                     msg.connection.send_message(reply)
             elif op.op == OP_READ:
@@ -614,12 +623,13 @@ class OSDDaemon(Dispatcher):
                 return
 
     def _handle_ec_write(self, msg: MOSDECSubOpWrite) -> None:
-        oid, _, size = msg.oid.partition("|")
+        oid = msg.oid
         cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
         if cid not in self.store.list_collections():
             self.store.apply_transaction(Transaction().create_collection(cid))
-        t = (Transaction().write(cid, oid, 0, msg.chunk)
-             .setattr(cid, oid, "size", size.encode()))
+        t = (Transaction().truncate(cid, oid, 0)
+             .write(cid, oid, 0, msg.chunk)
+             .setattr(cid, oid, "size", str(msg.obj_size).encode()))
         self.store.apply_transaction(t)
         msg.connection.send_message(MOSDECSubOpWriteReply(
             reqid=msg.reqid, shard=msg.shard, from_osd=self.osd_id,

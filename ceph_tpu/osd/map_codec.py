@@ -65,7 +65,8 @@ def encode_crush(m: CrushMap, enc: Encoder) -> None:
 
             e2.map(d, lambda e3, k: e3.u32(k), enc_arg)
 
-        e.map(m.choose_args, lambda e2, k: e2.str(str(k)), enc_choose_args)
+        # choose_args ids are s64 in the reference (CrushWrapper.h:72)
+        e.map(m.choose_args, lambda e2, k: e2.s64(int(k)), enc_choose_args)
 
     enc.versioned(1, 1, body)
 
@@ -118,10 +119,9 @@ def decode_crush(dec: Decoder) -> CrushMap:
 
             return d2.map(lambda d3: d3.u32(), dec_arg)
 
-        choose_args = d.map(lambda d2: d2.str(), dec_choose_args)
+        choose_args = d.map(lambda d2: d2.s64(), dec_choose_args)
         m = CrushMap(buckets=buckets, rules=rules, max_devices=max_devices,
-                     tunables=t,
-                     choose_args={k: v for k, v in choose_args.items()})
+                     tunables=t, choose_args=choose_args)
         return m
 
     return dec.versioned(1, body)

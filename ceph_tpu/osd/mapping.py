@@ -40,6 +40,7 @@ class OSDMapMapping:
         self.osdmap = osdmap
         self._mappers: dict[int, BatchMapper] = {}
         self._raw: dict[int, np.ndarray] = {}    # pool -> (pg_num, size) raw
+        self._pps: dict[int, np.ndarray] = {}    # pool -> (pg_num,) pps seeds
         self.epoch = -1
 
     def update(self) -> None:
@@ -60,6 +61,7 @@ class OSDMapMapping:
             pps = pps_batch(pool, pgids)
             out = bm.do_rule(pool.crush_rule, pps, pool.size, weights)
             self._raw[pool_id] = np.asarray(out)
+            self._pps[pool_id] = pps
         self.epoch = m.epoch
 
     def get_raw(self, pool_id: int) -> np.ndarray:
@@ -74,7 +76,8 @@ class OSDMapMapping:
         raw = [int(o) for o in self._raw[pool_id][pgid]]
         if not pool.is_erasure():
             raw = [o for o in raw if o != CRUSH_ITEM_NONE]
-        return m._finish_pg_mapping(pool, (pool_id, pgid), raw)
+        pps = int(self._pps[pool_id][pgid]) if pool_id in self._pps else None
+        return m._finish_pg_mapping(pool, (pool_id, pgid), raw, pps)
 
     def pg_counts(self, pool_id: int) -> np.ndarray:
         """Per-OSD PG count histogram for a pool (balancer input)."""

@@ -141,9 +141,11 @@ class OSDMap:
 
     # -- placement pipeline (scalar oracle) -----------------------------------
 
-    def _pg_to_raw_osds(self, pool: PGPool, ps: int) -> list[int]:
+    def _pg_to_raw_osds(self, pool: PGPool, ps: int,
+                        pps: int | None = None) -> list[int]:
         """OSDMap.cc:2198-2216."""
-        pps = pool.raw_pg_to_pps(ps)
+        if pps is None:
+            pps = pool.raw_pg_to_pps(ps)
         ruleno = pool.crush_rule
         if ruleno < 0 or ruleno >= self.crush.max_rules:
             return []
@@ -205,13 +207,16 @@ class OSDMap:
         return primary
 
     def _finish_pg_mapping(self, pool: PGPool, pgid: tuple[int, int],
-                           raw: list[int]
+                           raw: list[int], pps: int | None = None
                            ) -> tuple[list[int], int, list[int], int]:
         """Post-CRUSH pipeline tail: upmap -> up -> primary affinity -> temps.
         Shared by the scalar path and the batched mapping cache."""
         raw = self._apply_upmap(pool, pgid, raw)
         up, up_primary = self._raw_to_up_osds(pool, raw)
-        up_primary = self._apply_primary_affinity(pgid[1], up, up_primary)
+        # affinity seed is pps, not the raw pg id (OSDMap.cc:2410-2447)
+        if pps is None:
+            pps = pool.raw_pg_to_pps(pgid[1])
+        up_primary = self._apply_primary_affinity(pps, up, up_primary)
         acting = list(self.pg_temp.get(pgid, [])) or list(up)
         acting_primary = self.primary_temp.get(pgid, CEPH_NOSD)
         if acting_primary == CEPH_NOSD:
@@ -227,5 +232,6 @@ class OSDMap:
         acting_primary)."""
         pool = self.pools[pool_id]
         pgid = (pool_id, pg_to_pgid(ps, pool.pg_num))
-        raw = self._pg_to_raw_osds(pool, pgid[1])
-        return self._finish_pg_mapping(pool, pgid, raw)
+        pps = pool.raw_pg_to_pps(pgid[1])
+        raw = self._pg_to_raw_osds(pool, pgid[1], pps)
+        return self._finish_pg_mapping(pool, pgid, raw, pps)

@@ -100,6 +100,21 @@ def test_ec_pool_write_read_with_tpu_kernels(cluster):
     assert shard_count == 3  # k+m shards
 
 
+def test_ec_overwrite_with_smaller_data(cluster):
+    """Shrinking WRITEFULL must truncate stale shard tails (advisor finding:
+    stale chunk tails corrupted the re-read)."""
+    client = cluster.client()
+    pool = cluster.create_pool(client, pg_num=2, pool_type="erasure",
+                               k=2, m=1)
+    io = client.open_ioctx(pool)
+    big = bytes(range(256)) * 40          # 10240 B
+    small = b"tiny payload"               # much smaller rewrite
+    io.write_full("shrink", big)
+    assert io.read("shrink") == big
+    io.write_full("shrink", small)
+    assert io.read("shrink") == small
+
+
 def test_ec_read_survives_shard_loss(cluster):
     client = cluster.client()
     pool = cluster.create_pool(client, pg_num=1, pool_type="erasure",
