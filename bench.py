@@ -13,9 +13,14 @@ kernel N times inside one jitted lax.scan with a forced data dependency between
 iterations, fetches a scalar (which cannot resolve until everything executed),
 and differences two iteration counts to cancel dispatch/transfer overhead.
 
-vs_baseline: ratio against a single-core CPU GF(2^8) table encode measured in
-the same process (numpy oracle — the same math jerasure computes without SIMD
-hand-tuning).  The reference publishes no numbers in-tree (BASELINE.md).
+vs_baseline: ratio against the single-core C baseline compiled from
+ceph_tpu/native/baseline.c — an ISA-L-class split-nibble SIMD GF(2^8) encode
+and a scalar straw2 crush_do_rule, both bit-validated against the same oracles
+the TPU kernels are (tests/test_native.py).  The reference publishes no
+numbers in-tree (BASELINE.md); this measures its algorithm class on this host.
+
+CRUSH runs with non-uniform bucket weights, a skewed reweight vector, and out
+OSDs — the retry-ladder-heavy case, not the easy uniform one.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 """
@@ -60,7 +65,7 @@ def main() -> None:
 
     from ceph_tpu.gf.matrix import gen_cauchy1_matrix, recovery_matrix
     from ceph_tpu.gf.tables import nibble_bit_table
-    from ceph_tpu.ops.gf_kernel import _encode_impl, ec_encode_ref
+    from ceph_tpu.ops.gf_kernel import _encode_impl
 
     k, m = 8, 4
     chunk = 4096          # 4 KiB chunks — BASELINE.json config
@@ -99,14 +104,32 @@ def main() -> None:
     combined = 2 * data_bytes / (t_enc + t_dec) / 1e6
 
     # CRUSH bulk placement (BASELINE config #5 shape): 10k-OSD two-level map
-    # (250 hosts x 40 osds), chooseleaf firstn 3, 64k PGs per device call
+    # (250 hosts x 40 osds), chooseleaf firstn 3, 64k PGs per device call.
+    # Non-uniform: skewed per-osd bucket weights, 10% reweighted to 0.5,
+    # 2% out — the retry ladder actually fires.
     from ceph_tpu.crush import build_two_level_map
     from ceph_tpu.crush.mapper_jax import BatchMapper
 
     crush_map, _root, rid = build_two_level_map(250, 40)
+    wrng = np.random.default_rng(42)
+    for b in crush_map.buckets:
+        if b is not None and b.type == 1:  # host level: skew osd weights
+            b.item_weights = [int(w) for w in
+                              wrng.integers(0x8000, 0x20000, b.size)]
+            b.weight = sum(b.item_weights)
+    root = crush_map.bucket(-1)
+    root.item_weights = [crush_map.bucket(h).weight for h in root.items]
+    root.weight = sum(root.item_weights)
+
+    n_osds = 10000
+    reweight = np.full(n_osds, 0x10000, dtype=np.int64)
+    idx = wrng.permutation(n_osds)
+    reweight[idx[:1000]] = 0x8000   # 10% half-weight
+    reweight[idx[1000:1200]] = 0    # 2% out
+
     bm = BatchMapper(crush_map)
     n_pgs, numrep = 65536, 3
-    rw = jnp.full((10000,), 0x10000, dtype=jnp.int64)
+    rw = jnp.asarray(reweight)
     xs = jnp.asarray(rng.integers(0, 2**32, (n_pgs,), dtype=np.uint32))
     bm.do_rule(rid, xs, numrep, rw)  # compile
 
@@ -117,23 +140,45 @@ def main() -> None:
     t_crush = chained_seconds_per_step(crush_step, xs, n_lo=2, n_hi=6)
     crush_mpps = n_pgs / t_crush / 1e6
 
-    # single-core CPU baseline: same math via the numpy table oracle on a slice
-    cpu_stripes = max(stripes // 32, 1)
-    cpu_data = np.asarray(data[:cpu_stripes])
+    # single-core C baselines (ceph_tpu/native): ISA-L-class SIMD encode and
+    # scalar crush_do_rule, same inputs, same math
+    from ceph_tpu.native import CrushBaseline, ec_encode_native
+
+    cpu_data = np.asarray(data)
+    t_c = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ec_encode_native(coding, cpu_data)
+        t_c = min(t_c, time.perf_counter() - t0)
+    c_enc_mbps = data_bytes / t_c / 1e6
+    t_c = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ec_encode_native(rmat, cpu_data)
+        t_c = min(t_c, time.perf_counter() - t0)
+    c_dec_mbps = data_bytes / t_c / 1e6
+    c_combined = 2 / (1 / c_enc_mbps + 1 / c_dec_mbps)
+
+    cb = CrushBaseline(crush_map)
+    c_xs = np.asarray(xs[:8192], dtype=np.uint32)
+    cb.do_rule_batch(rid, c_xs[:256], numrep, reweight.astype(np.uint32))
     t0 = time.perf_counter()
-    ec_encode_ref(coding, cpu_data)
-    t_cpu = time.perf_counter() - t0
-    cpu_mbps = cpu_stripes * k * chunk / t_cpu / 1e6
+    cb.do_rule_batch(rid, c_xs, numrep, reweight.astype(np.uint32))
+    c_crush_mpps = len(c_xs) / (time.perf_counter() - t0) / 1e6
 
     print(json.dumps({
         "metric": "ec encode+recover MB/s (k=8,m=4,4KiB chunks, batch=2048)",
         "value": round(combined, 1),
         "unit": "MB/s",
-        "vs_baseline": round(combined / cpu_mbps, 2),
+        "vs_baseline": round(combined / c_combined, 2),
         "encode_mbps": round(enc_mbps, 1),
         "recover_mbps": round(dec_mbps, 1),
-        "cpu_oracle_mbps": round(cpu_mbps, 1),
-        "crush_mpps": round(crush_mpps, 2),
+        "c_encode_mbps": round(c_enc_mbps, 1),
+        "c_recover_mbps": round(c_dec_mbps, 1),
+        "encode_vs_c": round(enc_mbps / c_enc_mbps, 2),
+        "crush_mpps": round(crush_mpps, 3),
+        "c_crush_mpps": round(c_crush_mpps, 3),
+        "crush_vs_c": round(crush_mpps / c_crush_mpps, 2),
         "device": str(jax.devices()[0]),
     }))
 

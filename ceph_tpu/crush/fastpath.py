@@ -1,0 +1,304 @@
+"""Fused fast path for the canonical CRUSH rules on two-level maps.
+
+The generic batched mapper (mapper_jax) re-draws the whole batch every retry
+ladder iteration and pads every bucket row to the global max bucket size.  For
+the rule shapes that carry ~all real placement traffic —
+
+    take root
+    chooseleaf firstn N type-t     (replicated pools; mapper.c:460-648)
+    emit
+and
+    take root
+    choose firstn N osd            (flat maps)
+    emit
+
+over a *uniform two-level* straw2 hierarchy (root -> type-t buckets ->
+devices), a better device schedule exists because the retry ladder's r values
+are shared across replicas: replica ``rep`` draws with r = rep + ftotal, so
+the whole ladder for all reps only ever consumes root/leaf winners at
+r in [0, numrep + max_ftotal).  The fast path therefore:
+
+  1. precomputes straw2 winners for a block of r values — a fori_loop
+     producing one r column per step (root (N, H) draw -> winner; that
+     host's item/weight rows, padded only to the max *leaf* size, -> (N, S)
+     leaf draw -> device + its is_out verdict);
+  2. consumes them with numrep cheap masked while_loops whose bodies are
+     (N,)-sized gathers and compares — no redraws, and reps 1..n-1 reuse the
+     winners rep 0 already paid for;
+  3. if any lane's ftotal walks past the precomputed block (rare: needs many
+     consecutive collisions/rejections), a lax.cond re-runs the same
+     computation with the full r range R = tries + numrep, which by
+     construction cannot overflow — bit-exactness is unconditional, the big
+     recompute just never happens on healthy maps.
+
+(A weight-class decomposition — draws are monotone in the 16-bit hash, so
+only the max-u item per distinct weight can win — was evaluated and rejected:
+truncated-quotient ties between items are common at realistic bucket weights
+(quotient spacing ~ crush_ln slope / w approaches 1 for host-sized w), so an
+exactness fallback triggers on virtually every bulk call.  The argmax over
+full per-item draws handles ties for free.)
+
+Bit-exactness: validated against the scalar oracle (crush.mapper_ref) in
+tests/test_mapper_jax.py::test_fastpath_* across skewed weights, reweights,
+out OSDs, uneven host sizes, and forced-fallback configurations.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ceph_tpu.ops.crush_kernel import is_out, straw2_choose_index
+
+from .types import (
+    CRUSH_BUCKET_STRAW2,
+    CRUSH_ITEM_NONE,
+    RULE_CHOOSE_FIRSTN,
+    RULE_CHOOSELEAF_FIRSTN,
+    RULE_EMIT,
+    RULE_SET_CHOOSE_TRIES,
+    RULE_SET_CHOOSELEAF_TRIES,
+    RULE_TAKE,
+    CrushMap,
+)
+
+NONE = jnp.int32(CRUSH_ITEM_NONE)
+
+#: extra r-values beyond numrep precomputed in the first block
+DEFAULT_BLOCK = 12
+
+
+@dataclass
+class FastRule:
+    """Host-side description of a fast-path-eligible rule."""
+
+    kind: str                 # "chooseleaf" | "choose_flat"
+    numrep_arg: int           # step arg1 (0 -> result_max)
+    tries: int                # choose_total_tries + 1 (or SET override)
+    vary_r: int
+    root_ids: np.ndarray      # (H,) root bucket items
+    root_w: np.ndarray        # (H,) int64 16.16 weights
+    leaf_ids: np.ndarray | None   # (H, S) device ids, row per root item
+    leaf_w: np.ndarray | None     # (H, S) int64, 0-padded
+    max_devices: int
+
+
+def detect(m: CrushMap, ruleno: int) -> FastRule | None:
+    """Return a FastRule if ``ruleno`` on map ``m`` fits the fused kernel."""
+    t = m.tunables
+    if (t.choose_local_tries or t.choose_local_fallback_tries
+            or t.chooseleaf_stable != 1):
+        return None
+    rule = m.rules[ruleno]
+    if rule is None:
+        return None
+    tries = t.choose_total_tries + 1
+    core: list = []
+    for step in rule.steps:
+        if step.op == RULE_SET_CHOOSE_TRIES:
+            if step.arg1 > 0:
+                tries = step.arg1
+        elif step.op == RULE_SET_CHOOSELEAF_TRIES:
+            if step.arg1 > 0 and step.arg1 != 1:
+                return None  # leaf retry loop not fused
+        else:
+            core.append(step)
+    if len(core) != 3:
+        return None
+    take, choose, emit = core
+    if take.op != RULE_TAKE or emit.op != RULE_EMIT:
+        return None
+    root = m.bucket(take.arg1)
+    if root is None or root.alg != CRUSH_BUCKET_STRAW2 or root.size == 0:
+        return None
+    if root.size > 1024:
+        return None  # (N, R, H) blocks would dwarf the iterative cost
+    root_ids = np.asarray(root.items, dtype=np.int32)
+    root_w = np.asarray(root.item_weights, dtype=np.int64)
+
+    if choose.op == RULE_CHOOSE_FIRSTN and choose.arg2 == 0:
+        # flat: every root item is a device
+        if any(i < 0 or i >= m.max_devices for i in root.items):
+            return None
+        return FastRule(
+            kind="choose_flat", numrep_arg=choose.arg1, tries=tries,
+            vary_r=t.chooseleaf_vary_r, root_ids=root_ids, root_w=root_w,
+            leaf_ids=None, leaf_w=None, max_devices=m.max_devices)
+
+    if choose.op != RULE_CHOOSELEAF_FIRSTN:
+        return None
+    if not t.chooseleaf_descend_once:
+        # without descend_once the leaf recursion retries inside the host
+        # (recurse_tries = choose_tries, mapper.c:1041-1046); the fused
+        # kernel only models the single-attempt (descend_once) semantics
+        return None
+    want_type = choose.arg2
+    hosts = []
+    for item in root.items:
+        h = m.bucket(item)
+        if (h is None or h.alg != CRUSH_BUCKET_STRAW2
+                or h.type != want_type or h.size == 0):
+            return None
+        if any(i < 0 or i >= m.max_devices for i in h.items):
+            return None
+        hosts.append(h)
+    s_max = max(h.size for h in hosts)
+    leaf_ids = np.zeros((len(hosts), s_max), dtype=np.int32)
+    leaf_w = np.zeros((len(hosts), s_max), dtype=np.int64)
+    for row, h in enumerate(hosts):
+        leaf_ids[row, :h.size] = h.items
+        leaf_w[row, :h.size] = h.item_weights
+    return FastRule(
+        kind="chooseleaf", numrep_arg=choose.arg1, tries=tries,
+        vary_r=t.chooseleaf_vary_r, root_ids=root_ids, root_w=root_w,
+        leaf_ids=leaf_ids, leaf_w=leaf_w, max_devices=m.max_devices)
+
+
+# ---------------------------------------------------------------------------
+# device kernels
+# ---------------------------------------------------------------------------
+
+def _draw_argmax(x, ids, weights, r):
+    """Straw2 winner position for one r value across the batch.
+
+    x (N,) uint32; ids (S,) shared or (N, S) per-lane rows; weights
+    broadcastable to ids; r scalar uint32.  Returns (N,) positions.
+    straw2_choose_index's jnp.argmax takes the first maximum — exactly the
+    strict-``>`` scan of bucket_straw2_choose (mapper.c:374-380), so
+    truncation ties resolve to the lowest index for free.
+    """
+    idb = ids[None, :] if ids.ndim == 1 else ids
+    wb = jnp.broadcast_to(
+        weights[None, :] if weights.ndim == 1 else weights, idb.shape)
+    return straw2_choose_index(x, idb, r, wb)
+
+
+def _consume(host_win, leaf_win, leaf_bad, numrep, tries, R, n):
+    """Walk the firstn ladder over precomputed winners.
+
+    host_win (N, R) int32: first-level item chosen at r (host id, or the
+    device itself for flat rules).  leaf_win (N, R) int32: device at r.
+    leaf_bad (N, R) bool: device rejected (is_out).  Returns
+    (out_host, out_leaf, overflow): (N, numrep) selections with NONE holes
+    and a per-lane flag for ftotal walking past R.
+    """
+    out_h = jnp.full((n, numrep), NONE, dtype=jnp.int32)
+    out_l = jnp.full((n, numrep), NONE, dtype=jnp.int32)
+    overflow = jnp.zeros((n,), dtype=bool)
+
+    for rep in range(numrep):
+        def cond(s):
+            return jnp.any(s[3])
+
+        def body(s, rep=rep, out_h=out_h, out_l=out_l):
+            sel_h, sel_l, ft, act, ovf = s
+            r = rep + ft
+            within = r < R
+            ridx = jnp.minimum(r, R - 1)[:, None]
+            hb = jnp.take_along_axis(host_win, ridx, 1)[:, 0]
+            lf = jnp.take_along_axis(leaf_win, ridx, 1)[:, 0]
+            bad_l = jnp.take_along_axis(leaf_bad, ridx, 1)[:, 0]
+            coll_h = jnp.any(out_h == hb[:, None], axis=1)
+            coll_l = jnp.any(out_l == lf[:, None], axis=1)
+            bad = coll_h | coll_l | bad_l
+            place = act & within & ~bad
+            sel_h = jnp.where(place, hb, sel_h)
+            sel_l = jnp.where(place, lf, sel_l)
+            ft = jnp.where(act & within & bad, ft + 1, ft)
+            ovf = ovf | (act & ~within)
+            act = act & within & bad & (ft < tries)
+            return sel_h, sel_l, ft, act, ovf
+
+        sel0 = jnp.full((n,), NONE, dtype=jnp.int32)
+        sel_h, sel_l, _, _, overflow = jax.lax.while_loop(
+            cond, body,
+            (sel0, sel0, jnp.zeros((n,), jnp.int32),
+             jnp.ones((n,), bool), overflow))
+        out_h = out_h.at[:, rep].set(sel_h)
+        out_l = out_l.at[:, rep].set(sel_l)
+    return out_h, out_l, overflow
+
+
+def _compact_rows(rows):
+    order = jnp.argsort(rows == NONE, axis=1)
+    return jnp.take_along_axis(rows, order, axis=1)
+
+
+class FastMapper:
+    """Compiled fast path for one (map, rule)."""
+
+    def __init__(self, fr: FastRule):
+        self.fr = fr
+        self.root_ids = jnp.asarray(fr.root_ids)
+        self.root_w = jnp.asarray(fr.root_w)
+        if fr.leaf_ids is not None:
+            self.leaf_ids = jnp.asarray(fr.leaf_ids)
+            self.leaf_w = jnp.asarray(fr.leaf_w)
+
+    def _winners(self, xs, reweight, R: int):
+        """host_win/leaf_win/leaf_bad for r in [0, R): a fori_loop producing
+        one r column per step (bounds the (N, H) ln-matmul intermediates to a
+        single r; an unrolled R-wide block OOMs HBM at bulk batch sizes)."""
+        fr = self.fr
+        n = xs.shape[0]
+        hw0 = jnp.full((n, R), NONE, dtype=jnp.int32)
+        lw0 = jnp.full((n, R), NONE, dtype=jnp.int32)
+        lb0 = jnp.zeros((n, R), dtype=bool)
+
+        def body(i, bufs):
+            hw, lw, lb = bufs
+            r = i.astype(jnp.uint32)
+            pos = _draw_argmax(xs, self.root_ids, self.root_w, r)
+            first = self.root_ids[pos]                         # (N,)
+            if fr.kind == "choose_flat":
+                leaf = first
+            else:
+                # r_leaf = vary_r ? r >> (vary_r-1) : 0 (mapper.c:578)
+                if fr.vary_r:
+                    r_leaf = r >> jnp.uint32(fr.vary_r - 1)
+                else:
+                    r_leaf = jnp.uint32(0)
+                ids = self.leaf_ids[pos]                       # (N, S)
+                w = self.leaf_w[pos]                           # (N, S)
+                lpos = _draw_argmax(xs, ids, w, r_leaf)
+                leaf = jnp.take_along_axis(ids, lpos[:, None], 1)[:, 0]
+            bad = is_out(reweight, leaf, xs)
+            hw = jax.lax.dynamic_update_slice(hw, first[:, None], (0, i))
+            lw = jax.lax.dynamic_update_slice(lw, leaf[:, None], (0, i))
+            lb = jax.lax.dynamic_update_slice(lb, bad[:, None], (0, i))
+            return hw, lw, lb
+
+        return jax.lax.fori_loop(0, R, body, (hw0, lw0, lb0))
+
+    def run(self, xs, reweight, result_max: int,
+            block: int = DEFAULT_BLOCK):
+        """Full do_rule: returns (N, result_max) NONE-compacted placements."""
+        fr = self.fr
+        numrep = fr.numrep_arg
+        if numrep <= 0:
+            numrep += result_max
+        n = xs.shape[0]
+        if numrep <= 0:
+            return jnp.full((n, result_max), NONE, dtype=jnp.int32)
+        Rf = fr.tries + numrep
+        R0 = min(numrep + block, Rf)
+        hw, lw, lb = self._winners(xs, reweight, R0)
+        out_h, out_l, ovf = _consume(hw, lw, lb, numrep, fr.tries, R0, n)
+
+        def slow(_):
+            hw2, lw2, lb2 = self._winners(xs, reweight, Rf)
+            oh, ol, _ = _consume(hw2, lw2, lb2, numrep, fr.tries, Rf, n)
+            return oh, ol
+
+        out_h, out_l = jax.lax.cond(
+            jnp.any(ovf), slow, lambda _: (out_h, out_l), None)
+        res = out_l if fr.kind == "chooseleaf" else out_h
+        res = _compact_rows(res)
+        if numrep < result_max:
+            res = jnp.concatenate(
+                [res, jnp.full((n, result_max - numrep), NONE,
+                               dtype=jnp.int32)], axis=1)
+        return res[:, :result_max]

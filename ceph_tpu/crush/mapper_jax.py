@@ -315,6 +315,16 @@ class BatchMapper:
         self.compiled = compiled or compile_map(m)
         self.arrays = _Arrays(self.compiled)
         self._jit_cache: dict = {}
+        self._fast_cache: dict = {}
+
+    def _fastpath(self, ruleno: int):
+        """Fused two-level kernel if the rule fits (crush.fastpath)."""
+        if ruleno not in self._fast_cache:
+            from . import fastpath
+            fr = fastpath.detect(self.map, ruleno)
+            self._fast_cache[ruleno] = (
+                fastpath.FastMapper(fr) if fr is not None else None)
+        return self._fast_cache[ruleno]
 
     def do_rule(self, ruleno: int, xs, result_max: int, reweight) -> jax.Array:
         xs = jnp.asarray(xs, dtype=jnp.uint32)
@@ -323,6 +333,13 @@ class BatchMapper:
                 or self.map.rules[ruleno] is None):
             # crush_do_rule returns empty for unknown rules (mapper.c:902-904)
             return jnp.full((xs.shape[0], result_max), NONE, dtype=jnp.int32)
+        fast = self._fastpath(ruleno)
+        if fast is not None:
+            key = ("fast", ruleno, result_max)
+            if key not in self._jit_cache:
+                self._jit_cache[key] = jax.jit(
+                    functools.partial(fast.run, result_max=result_max))
+            return self._jit_cache[key](xs, reweight)
         key = (ruleno, result_max)
         if key not in self._jit_cache:
             self._jit_cache[key] = jax.jit(

@@ -182,7 +182,7 @@ class MOSDECSubOpWrite(Message):
         self.obj_size = obj_size  # full (pre-encode) object size
 
     def encode_payload(self, enc):
-        enc.versioned(1, 1, lambda e: (
+        enc.versioned(2, 1, lambda e: (
             e.u64(self.reqid[0]), e.u64(self.reqid[1]),
             _enc_pgid(e, self.pgid), e.str(self.oid), e.u8(self.shard),
             e.bytes(self.chunk), e.u32(self.epoch), e.u64(self.obj_size)))
@@ -195,8 +195,9 @@ class MOSDECSubOpWrite(Message):
             self.shard = d.u8()
             self.chunk = d.bytes()
             self.epoch = d.u32()
-            self.obj_size = d.u64()
-        dec.versioned(1, body)
+            if v >= 2:  # v1 smuggled the size in the oid
+                self.obj_size = d.u64()
+        dec.versioned(2, body)
 
 
 @register_message

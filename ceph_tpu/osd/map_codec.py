@@ -65,10 +65,11 @@ def encode_crush(m: CrushMap, enc: Encoder) -> None:
 
             e2.map(d, lambda e3, k: e3.u32(k), enc_arg)
 
-        # choose_args ids are s64 in the reference (CrushWrapper.h:72)
+        # choose_args ids are s64 in the reference (CrushWrapper.h:72);
+        # v1 encoded them as strings, hence the struct version bump
         e.map(m.choose_args, lambda e2, k: e2.s64(int(k)), enc_choose_args)
 
-    enc.versioned(1, 1, body)
+    enc.versioned(2, 1, body)
 
 
 def decode_crush(dec: Decoder) -> CrushMap:
@@ -119,12 +120,18 @@ def decode_crush(dec: Decoder) -> CrushMap:
 
             return d2.map(lambda d3: d3.u32(), dec_arg)
 
-        choose_args = d.map(lambda d2: d2.s64(), dec_choose_args)
+        if version >= 2:
+            choose_args = d.map(lambda d2: d2.s64(), dec_choose_args)
+        else:  # v1 stores persisted before the s64 key change
+            raw = d.map(lambda d2: d2.str(), dec_choose_args)
+            choose_args = {
+                int(k) if k.lstrip("-").isdigit() else k: v
+                for k, v in raw.items()}
         m = CrushMap(buckets=buckets, rules=rules, max_devices=max_devices,
                      tunables=t, choose_args=choose_args)
         return m
 
-    return dec.versioned(1, body)
+    return dec.versioned(2, body)
 
 
 # -- osdmap -----------------------------------------------------------------

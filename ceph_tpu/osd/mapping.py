@@ -48,6 +48,7 @@ class OSDMapMapping:
         m = self.osdmap
         self._mappers.clear()
         self._raw.clear()
+        self._pps.clear()
         bm = BatchMapper(m.crush)
         weights = np.zeros(max(m.max_osd, 1), dtype=np.int64)
         weights[:len(m.osd_weight)] = m.osd_weight

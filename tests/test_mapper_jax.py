@@ -106,6 +106,67 @@ def test_negative_numrep_means_result_max_minus():
     assert_matches(m, rid, 3, [0x10000] * 12, n=60)
 
 
+def test_fastpath_detected_for_canonical_rules():
+    from ceph_tpu.crush import fastpath
+    m, _root, rid = build_two_level_map(8, 4)
+    fr = fastpath.detect(m, rid)
+    assert fr is not None and fr.kind == "chooseleaf"
+    mf, _root2, ridf = build_flat_map(16)
+    fr2 = fastpath.detect(mf, ridf)
+    assert fr2 is not None and fr2.kind == "choose_flat"
+    # indep rule on the flat map is not fast-pathed
+    assert fastpath.detect(mf, 1) is None
+
+
+def test_fastpath_overflow_falls_back_exactly():
+    """Tiny block forces the lax.cond full-range recompute; results must
+    still match the oracle bit for bit (heavy rejection: most OSDs out)."""
+    import functools
+    import jax
+    from ceph_tpu.crush import fastpath
+    m, _root, rid = build_two_level_map(4, 3)
+    rw = [0] * 12
+    rw[1] = 0x10000
+    rw[7] = 0x6000
+    rw[10] = 0x2000  # nearly everything out -> long retry ladders
+    fr = fastpath.detect(m, rid)
+    assert fr is not None
+    fm = fastpath.FastMapper(fr)
+    xs = rng.integers(0, 2**32, 100, dtype=np.uint32)
+    got = np.asarray(jax.jit(functools.partial(fm.run, result_max=3, block=1))(
+        xs, np.asarray(rw, dtype=np.int64)))
+    for i, x in enumerate(xs):
+        want = crush_do_rule(m, rid, int(x), 3, rw)
+        compact = [int(v) for v in got[i] if v != CRUSH_ITEM_NONE]
+        assert compact == want, f"x={x}: want={want} got={compact}"
+
+
+def test_fastpath_vary_r_zero():
+    m, _root, rid = build_two_level_map(5, 4)
+    m.tunables.chooseleaf_vary_r = 0
+    assert_matches(m, rid, 3, [0x10000] * 20, n=100)
+
+
+def test_fastpath_uneven_host_sizes():
+    m = CrushMap()
+    m.max_devices = 16
+    sizes = [1, 3, 5, 7]
+    hosts, base = [], 0
+    for h, sz in enumerate(sizes):
+        osds = list(range(base, base + sz))
+        base += sz
+        hid = -(h + 2)
+        m.add_bucket(make_bucket(hid, CRUSH_BUCKET_STRAW2, 1, osds,
+                                 [0x10000 + 0x1000 * i for i in range(sz)]))
+        hosts.append(hid)
+    m.add_bucket(make_bucket(-1, CRUSH_BUCKET_STRAW2, 2, hosts,
+                             [m.bucket(h).weight for h in hosts]))
+    rid = add_simple_rule(m, -1, 1, "firstn")
+    rw = [0x10000] * 16
+    rw[0] = 0x8000
+    assert_matches(m, rid, 3, rw, n=120)
+
+
 def test_invalid_ruleno_returns_empty():
     m, _root, _rid = build_flat_map(8)
     bm = BatchMapper(m)
