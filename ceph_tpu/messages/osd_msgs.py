@@ -112,19 +112,22 @@ class MOSDRepOp(Message):
 
     def __init__(self, reqid: tuple[int, int] = (0, 0),
                  pgid: tuple[int, int] = (0, 0), oid: str = "",
-                 txn: bytes = b"", pg_version: tuple[int, int] = (0, 0)):
+                 txn: bytes = b"", pg_version: tuple[int, int] = (0, 0),
+                 entry: bytes = b""):
         super().__init__()
         self.reqid = reqid          # (client_id, tid)
         self.pgid = pgid
         self.oid = oid
         self.txn = txn              # encoded ObjectStore transaction
         self.pg_version = pg_version
+        self.entry = entry          # encoded pg LogEntry (v2+)
 
     def encode_payload(self, enc):
-        enc.versioned(1, 1, lambda e: (
+        enc.versioned(2, 1, lambda e: (
             e.u64(self.reqid[0]), e.u64(self.reqid[1]),
             _enc_pgid(e, self.pgid), e.str(self.oid), e.bytes(self.txn),
-            e.u32(self.pg_version[0]), e.u64(self.pg_version[1])))
+            e.u32(self.pg_version[0]), e.u64(self.pg_version[1]),
+            e.bytes(self.entry)))
 
     def decode_payload(self, dec, version):
         def body(d, v):
@@ -133,7 +136,9 @@ class MOSDRepOp(Message):
             self.oid = d.str()
             self.txn = d.bytes()
             self.pg_version = (d.u32(), d.u64())
-        dec.versioned(1, body)
+            if v >= 2:
+                self.entry = d.bytes()
+        dec.versioned(2, body)
 
 
 @register_message
@@ -171,7 +176,7 @@ class MOSDECSubOpWrite(Message):
     def __init__(self, reqid: tuple[int, int] = (0, 0),
                  pgid: tuple[int, int] = (0, 0), oid: str = "",
                  shard: int = 0, chunk: bytes = b"", epoch: int = 0,
-                 obj_size: int = 0):
+                 obj_size: int = 0, entry: bytes = b""):
         super().__init__()
         self.reqid = reqid
         self.pgid = pgid
@@ -180,12 +185,14 @@ class MOSDECSubOpWrite(Message):
         self.chunk = chunk
         self.epoch = epoch
         self.obj_size = obj_size  # full (pre-encode) object size
+        self.entry = entry        # encoded pg LogEntry (v3+)
 
     def encode_payload(self, enc):
-        enc.versioned(2, 1, lambda e: (
+        enc.versioned(3, 1, lambda e: (
             e.u64(self.reqid[0]), e.u64(self.reqid[1]),
             _enc_pgid(e, self.pgid), e.str(self.oid), e.u8(self.shard),
-            e.bytes(self.chunk), e.u32(self.epoch), e.u64(self.obj_size)))
+            e.bytes(self.chunk), e.u32(self.epoch), e.u64(self.obj_size),
+            e.bytes(self.entry)))
 
     def decode_payload(self, dec, version):
         def body(d, v):
@@ -197,7 +204,9 @@ class MOSDECSubOpWrite(Message):
             self.epoch = d.u32()
             if v >= 2:  # v1 smuggled the size in the oid
                 self.obj_size = d.u64()
-        dec.versioned(2, body)
+            if v >= 3:
+                self.entry = d.bytes()
+        dec.versioned(3, body)
 
 
 @register_message
@@ -258,18 +267,21 @@ class MOSDECSubOpReadReply(Message):
     TYPE = 111
 
     def __init__(self, reqid: tuple[int, int] = (0, 0), shard: int = 0,
-                 from_osd: int = 0, result: int = 0, chunk: bytes = b""):
+                 from_osd: int = 0, result: int = 0, chunk: bytes = b"",
+                 ver: tuple[int, int] = (0, 0)):
         super().__init__()
         self.reqid = reqid
         self.shard = shard
         self.from_osd = from_osd
         self.result = result
         self.chunk = chunk
+        self.ver = ver          # shard's object version (v2+; recovery reads)
 
     def encode_payload(self, enc):
-        enc.versioned(1, 1, lambda e: (
+        enc.versioned(2, 1, lambda e: (
             e.u64(self.reqid[0]), e.u64(self.reqid[1]), e.u8(self.shard),
-            e.s32(self.from_osd), e.s32(self.result), e.bytes(self.chunk)))
+            e.s32(self.from_osd), e.s32(self.result), e.bytes(self.chunk),
+            e.u32(self.ver[0]), e.u64(self.ver[1])))
 
     def decode_payload(self, dec, version):
         def body(d, v):
@@ -278,7 +290,9 @@ class MOSDECSubOpReadReply(Message):
             self.from_osd = d.s32()
             self.result = d.s32()
             self.chunk = d.bytes()
-        dec.versioned(1, body)
+            if v >= 2:
+                self.ver = (d.u32(), d.u64())
+        dec.versioned(2, body)
 
 
 @register_message

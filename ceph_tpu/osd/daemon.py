@@ -1,22 +1,36 @@
 """The OSD daemon (src/osd/OSD.{h,cc} + PrimaryLogPG + backends, condensed).
 
-Structure mirrors the reference data path (SURVEY.md §3.1/§3.3):
+Structure mirrors the reference data path (SURVEY.md §3.1/§3.3), now with the
+PG consistency backbone (src/osd/PGLog.h, src/osd/PG.h peering):
 
-  client MOSDOp -> primary:  replicated: local txn + MOSDRepOp fan-out, ack on
-                             all commits (ReplicatedBackend::submit_transaction)
+  client MOSDOp -> primary:  dedup against the pg log (reqid), allocate an
+                             (epoch, seq) version, append a log entry, then
+                             replicated: local txn + MOSDRepOp fan-out
                              erasure: batched GF(2^8) encode -> per-shard
-                             MOSDECSubOpWrite fan-out (ECBackend::start_rmw ->
-                             ECUtil::encode; here the encode is one device call)
-  reads:                     replicated: local; erasure: shard fan-in
-                             (MOSDECSubOpRead) + recovery decode
+                             MOSDECSubOpWrite fan-out (the whole-stripe encode
+                             is one device call, ECUtil::encode's batch point)
+  map change:                every PG re-peers: GetInfo (MOSDPGQuery/Notify)
+                             -> GetLog from the peer with the longest history
+                             (MOSDPGLog) -> merge_log (divergent-entry
+                             rollback) -> recover missing objects ->
+                             Activate (authoritative log to every replica)
+  recovery:                  log-based, not scan-based: each OSD computes its
+                             own missing set from the authoritative log and
+                             pulls exactly those objects (MOSDPGPull/Push);
+                             EC shards are reconstructed from k live shards
+                             at the needed version and pushed per-shard
   heartbeats:                periodic MOSDPing to up peers; missed grace ->
                              MOSDFailure to the mon (OSD::heartbeat_check)
-  map handling:              MOSDMapMsg -> activate PGs (collections), simple
-                             pull-based recovery for replicated objects
 
 Erasure objects store one chunk per shard-OSD as "<oid>:<shard>" with the
 stripe geometry in attrs; any k chunks reconstruct via the recovery-matrix
-kernel, exactly the ECBackend read path.
+kernel, exactly the ECBackend read path.  Every object carries a "_v"
+version attr so recovery can tell stale copies from current ones.
+
+Durability: the pg log and pg info ride in the *same* ObjectStore
+transaction as the data mutation (omap of the per-PG "_pgmeta_" object),
+so replay after restart reconstructs exactly the logged history
+(OSD::load_pgs, osd/OSD.cc:4061).
 """
 
 from __future__ import annotations
@@ -35,68 +49,28 @@ from ceph_tpu.messages import (
 from ceph_tpu.messages.osd_msgs import (
     OP_DELETE, OP_OMAP_GET, OP_OMAP_SET, OP_READ, OP_STAT, OP_WRITE,
     OP_WRITEFULL, OSDOpField)
+from ceph_tpu.messages.peering_msgs import MOSDPGLog, MOSDPGNotify, MOSDPGQuery
 from ceph_tpu.mon.monitor import MMonSubscribe, MOSDBoot
 from ceph_tpu.msg.encoding import Decoder, Encoder
 from ceph_tpu.msg.message import Message, register_message
 from ceph_tpu.msg.messenger import (
     ConnectionPolicy, Dispatcher, EntityName, Messenger)
 from ceph_tpu.objectstore import Transaction, create_objectstore
-from ceph_tpu.osd.map_codec import decode_osdmap
-from ceph_tpu.osd.osdmap import CEPH_NOSD, OSDMap, pg_to_pgid
-
-import numpy as np
-
-
-@register_message
-class MOSDPGScan(Message):
-    """primary -> replica: list your objects for this PG (recovery scan)."""
-
-    TYPE = 114
-
-    def __init__(self, pgid: tuple[int, int] = (0, 0), from_osd: int = 0):
-        super().__init__()
-        self.pgid = pgid
-        self.from_osd = from_osd
-
-    def encode_payload(self, enc: Encoder):
-        enc.versioned(1, 1, lambda e: (e.s64(self.pgid[0]),
-                                       e.u32(self.pgid[1]),
-                                       e.s32(self.from_osd)))
-
-    def decode_payload(self, dec: Decoder, version):
-        def body(d, v):
-            self.pgid = (d.s64(), d.u32())
-            self.from_osd = d.s32()
-        dec.versioned(1, body)
-
-
-@register_message
-class MOSDPGScanReply(Message):
-    TYPE = 115
-
-    def __init__(self, pgid: tuple[int, int] = (0, 0), from_osd: int = 0,
-                 objects: list[str] | None = None):
-        super().__init__()
-        self.pgid = pgid
-        self.from_osd = from_osd
-        self.objects = objects or []
-
-    def encode_payload(self, enc: Encoder):
-        enc.versioned(1, 1, lambda e: (
-            e.s64(self.pgid[0]), e.u32(self.pgid[1]), e.s32(self.from_osd),
-            e.list(self.objects, lambda e2, o: e2.str(o))))
-
-    def decode_payload(self, dec: Decoder, version):
-        def body(d, v):
-            self.pgid = (d.s64(), d.u32())
-            self.from_osd = d.s32()
-            self.objects = d.list(lambda d2: d2.str())
-        dec.versioned(1, body)
+from ceph_tpu.osd.map_codec import decode_osdmap, encode_osdmap
+from ceph_tpu.osd.osdmap import CEPH_NOSD, OSDMap
+from ceph_tpu.osd.pg import (
+    EVERSION_ZERO, LOG_DELETE, LOG_MODIFY, PG, LogEntry, MissingItem,
+    PeerState, PGInfo, STATE_ACTIVE, STATE_GETINFO, STATE_GETLOG,
+    STATE_INACTIVE, STATE_RECOVERING, STATE_REPLICA)
 
 
 @register_message
 class MOSDPGPull(Message):
-    """primary -> holder: send me this object (recovery pull)."""
+    """recovering OSD -> source: send me this object (recovery pull).
+
+    For EC PGs the oid is "<logical>:<shard>": the source reconstructs
+    that shard's chunk from k live shards and pushes it back.
+    """
 
     TYPE = 116
 
@@ -122,7 +96,8 @@ class MOSDPGPull(Message):
 
 @register_message
 class MOSDPGPush(Message):
-    """holder -> primary: object payload (recovery push; MOSDPGPush analog)."""
+    """source -> recovering OSD: object payload (MOSDPGPush analog).
+    attrs carries the per-object metadata including the "_v" version."""
 
     TYPE = 117
 
@@ -155,6 +130,20 @@ class MOSDPGPush(Message):
         dec.versioned(1, body)
 
 
+def enc_version(v: tuple[int, int]) -> bytes:
+    return f"{v[0]}.{v[1]}".encode()
+
+
+def dec_version(blob: bytes | None) -> tuple[int, int] | None:
+    if not blob:
+        return None
+    try:
+        e, s = blob.decode().split(".")
+        return (int(e), int(s))
+    except ValueError:
+        return None
+
+
 class _InFlight:
     """One client op waiting on replica/shard acks (in-flight repop)."""
 
@@ -162,6 +151,11 @@ class _InFlight:
         self.msg = msg
         self.waiting = waiting
         self.reply = reply
+
+
+#: client_id used by internal EC recovery reads (cannot collide with real
+#: clients, whose ids are small monotonically assigned ints)
+RECOVERY_CLIENT = 0xFFFFFFFF00000000
 
 
 class OSDDaemon(Dispatcher):
@@ -177,15 +171,20 @@ class OSDDaemon(Dispatcher):
         self.store = create_objectstore(store_type, store_path)
         self.osdmap = OSDMap()
         self._lock = threading.RLock()
+        self.pgs: dict[tuple[int, int], PG] = {}
         self._in_flight: dict[tuple[int, int], _InFlight] = {}
-        #: reqid -> {"shards": {shard: bytes}, "need": int, ...} EC reads
+        #: reqid -> EC read/recovery state
         self._ec_reads: dict[tuple[int, int], dict] = {}
+        self._recover_tid = 0
         self._codecs: dict[int, object] = {}
         self._osd_addr_cache: dict[int, str] = {}
         self._hb_last: dict[int, float] = {}
         self._hb_timer: threading.Timer | None = None
+        self._tick_timer: threading.Timer | None = None
         self._heartbeats = heartbeats
         self._stop = False
+        #: fault injection (reference: OSD.h debug_heartbeat_drops_remaining)
+        self.debug_drop_rep_ops = 0
 
         self.msgr = Messenger.create(self.whoami, ms_type)
         self.msgr.set_policy("client", ConnectionPolicy.lossy_client())
@@ -197,6 +196,7 @@ class OSDDaemon(Dispatcher):
         self.perf = (PerfCountersBuilder(f"osd.{osd_id}")
                      .add_u64("op_w").add_u64("op_r").add_u64("op_rep")
                      .add_u64("ec_encode_stripes").add_u64("recovery_pulls")
+                     .add_u64("peering_rounds").add_u64("log_entries")
                      .add_time_avg("op_w_latency")
                      .create_perf_counters())
         self.ctx.perf.add(self.perf)
@@ -206,12 +206,23 @@ class OSDDaemon(Dispatcher):
         self.ctx.admin.register_command(
             "osd map epoch", lambda **kw: {"epoch": self.osdmap.epoch},
             "current map epoch")
+        self.ctx.admin.register_command(
+            "pg dump", lambda **kw: self._pg_dump(), "pg states")
+
+    def _pg_dump(self) -> dict:
+        with self._lock:
+            return {f"{p[0]}.{p[1]}": {
+                "state": pg.state, "last_update": list(pg.info.last_update),
+                "log_len": len(pg.log), "missing": len(pg.missing),
+                "up": pg.up, "primary": pg.primary}
+                for p, pg in self.pgs.items()}
 
     # -- lifecycle (OSD::init, ceph_osd.cc main) ------------------------------
 
     def init(self) -> None:
         self.store.mkfs_if_needed()
         self.store.mount()
+        self._load_pgs()
         self.msgr.bind(self._addr)
         self.msgr.start()
         mon = self.msgr.connect_to(self.mon_addr, EntityName("mon", 0))
@@ -221,13 +232,112 @@ class OSDDaemon(Dispatcher):
                                   addr=self.msgr.my_addr))
         if self._heartbeats:
             self._schedule_heartbeat()
+        self._schedule_tick()
 
     def shutdown(self) -> None:
         self._stop = True
         if self._hb_timer:
             self._hb_timer.cancel()
+        if self._tick_timer:
+            self._tick_timer.cancel()
         self.msgr.shutdown()
         self.store.umount()
+
+    # -- tick (OSD::tick analog: watchdog for stuck peering/recovery) ---------
+
+    TICK_INTERVAL = 0.5
+    STUCK_AFTER = 2.0
+
+    def _schedule_tick(self) -> None:
+        if self._stop:
+            return
+        self._tick_timer = threading.Timer(self.TICK_INTERVAL, self._tick)
+        self._tick_timer.daemon = True
+        self._tick_timer.start()
+
+    def _tick(self) -> None:
+        try:
+            now = time.time()
+            with self._lock:
+                pgs = list(self.pgs.values())
+            for pg in pgs:
+                self._tick_pg(pg, now)
+        finally:
+            self._schedule_tick()
+
+    def _tick_pg(self, pg: PG, now: float) -> None:
+        restart = False
+        repulls: list[str] = []
+        flush: list = []
+        with self._lock:
+            # defensive: re-dispatch waiters whose block condition cleared
+            if pg.state == STATE_ACTIVE:
+                for oid in list(pg.waiting_for_missing):
+                    if not self._blocked_on_recovery(pg, oid, True, True):
+                        flush.extend(pg.waiting_for_missing.pop(oid))
+                if pg.waiting_for_active:
+                    flush.extend(pg.waiting_for_active)
+                    pg.waiting_for_active = []
+        for m in flush:
+            self._handle_op(m)
+        with self._lock:
+            if (pg.primary == self.osd_id
+                    and pg.state in (STATE_GETINFO, STATE_GETLOG)
+                    and now - pg.peering_started > self.STUCK_AFTER):
+                restart = True   # a query/notify was lost; re-run the round
+            elif pg.state == STATE_RECOVERING:
+                for oid in sorted(pg.missing):
+                    started = pg.recovering.get(oid)
+                    if started is None or now - started > self.STUCK_AFTER:
+                        pg.recovering.pop(oid, None)
+                        repulls.append(oid)
+        if restart:
+            self._start_peering(pg, pg.up, pg.primary)
+            return
+        if not repulls:
+            return
+        pool = self.osdmap.pools.get(pg.pgid[0])
+        ec = pool is not None and pool.is_erasure()
+        for oid in repulls:
+            if pg.primary == self.osd_id:
+                if ec:
+                    self._recover_ec_object(pg, oid, dest_osd=self.osd_id)
+                else:
+                    source = self._pick_source(pg, pg.missing[oid].need)
+                    if source is not None:
+                        self._pull_object(pg, oid, source)
+            else:
+                self._pull_object(pg, oid, pg.primary)
+
+    def _load_pgs(self) -> None:
+        """Rebuild in-memory PG state from persisted pgmeta
+        (OSD::load_pgs analog)."""
+        for cid in self.store.list_collections():
+            parts = cid.split(".")
+            if len(parts) != 2:
+                continue
+            try:
+                pgid = (int(parts[0]), int(parts[1]))
+            except ValueError:
+                continue
+            try:
+                meta = self.store.omap_get(cid, PG.PGMETA)
+            except KeyError:
+                continue
+            pg = PG(pgid)
+            info_blob = meta.get("info")
+            if info_blob:
+                pg.info = PG.decode_info(info_blob)
+            entries = [PG.decode_entry(v) for k, v in sorted(meta.items())
+                       if k.startswith("log.")]
+            pg.log.copy_from(entries)
+            missing_blob = meta.get("missing")
+            if missing_blob:
+                pg.decode_missing(missing_blob)
+            pg.next_seq = pg.log.head[1]
+            self.pgs[pgid] = pg
+            dout("osd", 10, "osd.%d loaded pg %s: %d entries, head %s",
+                 self.osd_id, cid, len(entries), pg.log.head)
 
     # -- map handling ---------------------------------------------------------
 
@@ -241,93 +351,439 @@ class OSDDaemon(Dispatcher):
             self._codecs.clear()
         del oldmap
         dout("osd", 5, "osd.%d got map epoch %d", self.osd_id, newmap.epoch)
-        my_pgs = self._my_pgs()
-        self._activate_pgs(my_pgs)
-        self._maybe_recover(my_pgs)
+        self._scan_pgs()
 
-    def _my_pgs(self) -> list[tuple[int, int, list[int], int]]:
-        """(pool, pg, up, primary) for PGs whose up set includes me."""
-        out = []
+    def _pg_cid(self, pgid) -> str:
+        return f"{pgid[0]}.{pgid[1]}"
+
+    def _get_pg(self, pgid) -> PG:
+        with self._lock:
+            pg = self.pgs.get(pgid)
+            if pg is None:
+                pg = PG(pgid)
+                self.pgs[pgid] = pg
+                cid = self._pg_cid(pgid)
+                if cid not in self.store.list_collections():
+                    self.store.apply_transaction(
+                        Transaction().create_collection(cid))
+            return pg
+
+    def _scan_pgs(self) -> None:
+        """On every new map: (re)start peering for PGs whose membership
+        changed (the map-change edge of the peering statechart)."""
         m = self.osdmap
         for pool_id, pool in m.pools.items():
-            for pg in range(pool.pg_num):
-                up, primary, _a, _ap = m.pg_to_up_acting_osds(pool_id, pg)
-                if self.osd_id in up:
-                    out.append((pool_id, pg, up, primary))
-        return out
+            for pgnum in range(pool.pg_num):
+                up, _upp, _acting, primary = \
+                    m.pg_to_up_acting_osds(pool_id, pgnum)
+                pgid = (pool_id, pgnum)
+                if self.osd_id not in up:
+                    pg = self.pgs.get(pgid)
+                    if pg and pg.state != STATE_INACTIVE:
+                        pg.state = STATE_INACTIVE
+                    continue
+                pg = self._get_pg(pgid)
+                if pg.up != up or pg.primary != primary \
+                        or pg.state == STATE_INACTIVE:
+                    self._start_peering(pg, up, primary)
 
-    def _activate_pgs(self, my_pgs) -> None:
-        t = Transaction()
-        existing = set(self.store.list_collections())
-        for pool_id, pg, _up, _p in my_pgs:
-            cid = f"{pool_id}.{pg}"
-            if cid not in existing:
-                t.create_collection(cid)
-        if len(t):
-            self.store.apply_transaction(t)
-
-    # -- recovery (pull-based backfill-lite) ----------------------------------
-
-    def _maybe_recover(self, my_pgs) -> None:
-        """Where I'm now primary, scan peers and pull objects I miss."""
-        for pool_id, pg, up, primary in my_pgs:
+    def _start_peering(self, pg: PG, up: list[int], primary: int) -> None:
+        with self._lock:
+            if pg.up and pg.up != up:
+                self._merge_past_up(pg, [pg.up], new_up=up)
+            pg.up = list(up)
+            pg.primary = primary
+            pg.peering_epoch = self.osdmap.epoch
+            pg.peering_started = time.time()
+            pg.peers = {}
+            pg.recovering.clear()
+            # ops queued against the old interval: requeue for re-check
+            # after this round settles (clients also resend on map change)
+            for ops in pg.waiting_for_missing.values():
+                pg.waiting_for_active.extend(ops)
+            pg.waiting_for_missing.clear()
             if primary != self.osd_id:
-                continue
-            peers = [o for o in up if o != self.osd_id and o != CEPH_NOSD]
-            for peer in peers:
-                con = self._osd_con(peer)
-                if con:
-                    con.send_message(MOSDPGScan(pgid=(pool_id, pg),
-                                                from_osd=self.osd_id))
+                pg.state = STATE_REPLICA
+                pg.waiting_for_active.clear()  # clients re-target
+                return
+            self.perf.inc("peering_rounds")
+            peers = [o for o in up
+                     if o != self.osd_id and o != CEPH_NOSD]
+            if not peers:
+                self._pg_recover_or_activate(pg)
+                return
+            pg.state = STATE_GETINFO
+        for o in peers:
+            con = self._osd_con(o)
+            if con:
+                con.send_message(MOSDPGQuery(
+                    pgid=pg.pgid, qtype=MOSDPGQuery.INFO,
+                    epoch=pg.peering_epoch, from_osd=self.osd_id))
 
-    def _handle_scan(self, msg: MOSDPGScan) -> None:
-        cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
-        try:
-            objs = self.store.list_objects(cid)
-        except KeyError:
-            objs = []
-        con = self._osd_con(msg.from_osd)
-        if con:
-            con.send_message(MOSDPGScanReply(
-                pgid=msg.pgid, from_osd=self.osd_id, objects=objs))
+    # -- peering (primary side) ----------------------------------------------
 
-    def _handle_scan_reply(self, msg: MOSDPGScanReply) -> None:
-        cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
-        try:
-            mine = set(self.store.list_objects(cid))
-        except KeyError:
-            mine = set()
-        missing = [o for o in msg.objects if o not in mine]
-        con = self._osd_con(msg.from_osd)
+    def _advertised_info(self, pg: PG) -> "PGInfo":
+        """Info snapshot for peering replies.  Includes my current up set
+        among the advertised intervals: if my map is older than the
+        asker's, what I call "current" is a past interval to them — and
+        it is where my shard chunks physically live."""
+        info = PGInfo(pgid=pg.info.pgid, last_update=pg.info.last_update,
+                      last_complete=pg.info.last_complete,
+                      last_epoch_started=pg.info.last_epoch_started,
+                      past_up=[list(iv) for iv in pg.info.past_up])
+        if pg.up and pg.up not in info.past_up:
+            info.past_up.append(list(pg.up))
+        return info
+
+    def _handle_pg_query(self, msg: MOSDPGQuery) -> None:
+        pg = self._get_pg(msg.pgid)
+        # reply over the incoming connection: a just-booted OSD may not
+        # have the asker's address in its (older) map yet
+        con = msg.connection or self._osd_con(msg.from_osd)
         if con is None:
             return
-        for oid in missing:
-            self.perf.inc("recovery_pulls")
-            con.send_message(MOSDPGPull(pgid=msg.pgid, oid=oid,
+        if msg.qtype == MOSDPGQuery.INFO:
+            con.send_message(MOSDPGNotify(
+                pgid=msg.pgid, info=self._advertised_info(pg),
+                epoch=msg.epoch, from_osd=self.osd_id))
+        else:
+            con.send_message(MOSDPGLog(
+                pgid=msg.pgid, info=self._advertised_info(pg),
+                entries=pg.log.entries, purpose=MOSDPGLog.REPLY,
+                epoch=msg.epoch, from_osd=self.osd_id))
+
+    def _handle_pg_notify(self, msg: MOSDPGNotify) -> None:
+        with self._lock:
+            pg = self.pgs.get(msg.pgid)
+            if (pg is None or pg.state != STATE_GETINFO
+                    or msg.epoch != pg.peering_epoch):
+                return
+            pg.peers[msg.from_osd] = PeerState(info=msg.info)
+            self._merge_past_up(pg, msg.info.past_up)
+            expected = [o for o in pg.up
+                        if o != self.osd_id and o != CEPH_NOSD]
+            if not all(o in pg.peers for o in expected):
+                return
+            # all infos in: pick the authoritative history
+            # (PG::find_best_info — longest last_update wins, self on ties)
+            best = max(expected,
+                       key=lambda o: pg.peers[o].info.last_update)
+            if pg.peers[best].info.last_update > pg.info.last_update:
+                pg.state = STATE_GETLOG
+                target = best
+            else:
+                target = None
+        if target is None:
+            self._pg_recover_or_activate(pg)
+            return
+        con = self._osd_con(target)
+        if con:
+            con.send_message(MOSDPGQuery(
+                pgid=pg.pgid, qtype=MOSDPGQuery.LOG, since=EVERSION_ZERO,
+                epoch=pg.peering_epoch, from_osd=self.osd_id))
+
+    def _handle_pg_log(self, msg: MOSDPGLog) -> None:
+        with self._lock:
+            pg = self.pgs.get(msg.pgid)
+            if pg is None:
+                return
+            if msg.purpose == MOSDPGLog.REPLY:
+                if (pg.state != STATE_GETLOG
+                        or msg.epoch != pg.peering_epoch):
+                    return
+                self._merge_past_up(pg, msg.info.past_up)
+                self._pg_merge(pg, msg.entries)
+                self._pg_recover_or_activate(pg)
+                return
+            # ACTIVATE: primary's authoritative history
+            if msg.epoch < pg.peering_epoch or pg.primary == self.osd_id:
+                return
+            self._merge_past_up(pg, msg.info.past_up)
+            self._pg_merge(pg, msg.entries)
+            pg.info.last_epoch_started = msg.info.last_epoch_started
+            if pg.missing:
+                pg.state = STATE_RECOVERING
+                pulls = sorted(pg.missing)
+            else:
+                pg.state = STATE_ACTIVE
+                pulls = []
+                self._persist_info(pg)
+        for oid in pulls:
+            self._pull_object(pg, oid, source=pg.primary,
+                              con=msg.connection)
+
+    def _pg_merge(self, pg: PG, entries: list[LogEntry]) -> None:
+        """merge_log + on-disk application of its consequences."""
+        cid = self._pg_cid(pg.pgid)
+        pool = self.osdmap.pools.get(pg.pgid[0])
+        ec = pool is not None and pool.is_erasure()
+        myshard = pg.up.index(self.osd_id) if ec \
+            and self.osd_id in pg.up else None
+
+        def store_oid(oid: str) -> str:
+            return f"{oid}:{myshard}" if ec else oid
+
+        def local_has(oid: str):
+            return dec_version(self._getattr_safe(cid, store_oid(oid), "_v"))
+
+        old_keys = {PG.log_key(e.version) for e in pg.log.entries}
+        to_remove, to_recover = pg.merge_log(entries, local_has)
+        new_keys = {PG.log_key(e.version): PG.encode_entry(e)
+                    for e in pg.log.entries}
+        t = Transaction()
+        for oid in to_remove:
+            t.remove(cid, store_oid(oid))
+        t.touch(cid, PG.PGMETA)
+        stale = [k for k in old_keys if k not in new_keys]
+        if stale:
+            t.omap_rmkeys(cid, PG.PGMETA, stale)
+        new_keys["info"] = pg.encode_info()
+        new_keys["missing"] = pg.encode_missing()
+        t.omap_setkeys(cid, PG.PGMETA, new_keys)
+        self.store.apply_transaction(t)
+        pg.next_seq = pg.log.head[1]
+        dout("osd", 10,
+             "osd.%d pg %s merged log: head %s, %d missing, %d removed",
+             self.osd_id, cid, pg.log.head, len(to_recover), len(to_remove))
+
+    def _getattr_safe(self, cid, oid, name):
+        try:
+            return self.store.getattr(cid, oid, name)
+        except KeyError:
+            return None
+
+    def _persist_info(self, pg: PG) -> None:
+        cid = self._pg_cid(pg.pgid)
+        t = (Transaction().touch(cid, PG.PGMETA)
+             .omap_setkeys(cid, PG.PGMETA, {
+                 "info": pg.encode_info(),
+                 "missing": pg.encode_missing()}))
+        self.store.apply_transaction(t)
+
+    def _pg_recover_or_activate(self, pg: PG) -> None:
+        """Primary with the authoritative log: recover own missing objects
+        first, then activate replicas."""
+        with self._lock:
+            if pg.missing:
+                pg.state = STATE_RECOVERING
+                pulls = sorted(pg.missing)
+            else:
+                pulls = []
+        if pulls:
+            pool = self.osdmap.pools.get(pg.pgid[0])
+            ec = pool is not None and pool.is_erasure()
+            # the auth peer (or any peer at/after need) has current data
+            for oid in pulls:
+                if ec:
+                    self._recover_ec_object(pg, oid, dest_osd=self.osd_id)
+                else:
+                    source = self._pick_source(pg, pg.missing[oid].need)
+                    if source is not None:
+                        self._pull_object(pg, oid, source)
+            return
+        self._pg_activate(pg)
+
+    def _pick_source(self, pg: PG, need) -> int | None:
+        candidates = [o for o, ps in pg.peers.items()
+                      if ps.info and ps.info.last_update >= need]
+        if not candidates:
+            return None
+        return max(candidates,
+                   key=lambda o: pg.peers[o].info.last_update)
+
+    def _pg_activate(self, pg: PG) -> None:
+        """Primary is complete: ship the authoritative log to every replica
+        and open for business (PG::activate)."""
+        with self._lock:
+            pg.state = STATE_ACTIVE
+            pg.info.last_epoch_started = pg.peering_epoch
+            peers = [o for o in pg.up
+                     if o != self.osd_id and o != CEPH_NOSD]
+            for o in peers:
+                ps = pg.peers.setdefault(o, PeerState())
+                last = ps.info.last_update if ps.info else EVERSION_ZERO
+                ps.missing = pg.peer_missing_from_log(last)
+            waiting = pg.waiting_for_active
+            pg.waiting_for_active = []
+        self._persist_info(pg)
+        for o in peers:
+            con = self._osd_con(o)
+            if con:
+                con.send_message(MOSDPGLog(
+                    pgid=pg.pgid, info=pg.info, entries=pg.log.entries,
+                    purpose=MOSDPGLog.ACTIVATE, epoch=pg.peering_epoch,
+                    from_osd=self.osd_id))
+        dout("osd", 5, "osd.%d pg %s active, head %s (%d queued ops)",
+             self.osd_id, self._pg_cid(pg.pgid), pg.log.head, len(waiting))
+        for m in waiting:
+            self._handle_op(m)
+
+    # -- recovery -------------------------------------------------------------
+
+    def _pull_object(self, pg: PG, oid: str, source: int,
+                     con=None) -> None:
+        pool = self.osdmap.pools.get(pg.pgid[0])
+        ec = pool is not None and pool.is_erasure()
+        with self._lock:
+            if oid in pg.recovering:
+                return
+            pg.recovering[oid] = time.time()
+        self.perf.inc("recovery_pulls")
+        wire_oid = oid
+        if ec:
+            if self.osd_id not in pg.up:
+                return
+            myshard = pg.up.index(self.osd_id)
+            wire_oid = f"{oid}:{myshard}"
+        con = con or self._osd_con(source)
+        if con:
+            con.send_message(MOSDPGPull(pgid=pg.pgid, oid=wire_oid,
                                         from_osd=self.osd_id))
 
     def _handle_pull(self, msg: MOSDPGPull) -> None:
         cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
+        pool = self.osdmap.pools.get(msg.pgid[0])
+        pg = self.pgs.get(msg.pgid)
+        if pool is not None and pool.is_erasure():
+            logical, _, shard = msg.oid.rpartition(":")
+            if pg is None:
+                return
+            self._recover_ec_object(pg, logical, dest_osd=msg.from_osd,
+                                    dest_shard=int(shard))
+            return
         try:
             data = self.store.read(cid, msg.oid)
             omap = self.store.omap_get(cid, msg.oid)
+            attrs = {}
+            v = self._getattr_safe(cid, msg.oid, "_v")
+            if v:
+                attrs["_v"] = v
         except KeyError:
             return
-        con = self._osd_con(msg.from_osd)
+        con = msg.connection or self._osd_con(msg.from_osd)
         if con:
             con.send_message(MOSDPGPush(pgid=msg.pgid, oid=msg.oid,
-                                        data=data, omap=omap))
+                                        data=data, omap=omap, attrs=attrs))
+        self._peer_recovered(pg, msg.from_osd, msg.oid)
+
+    def _peer_recovered(self, pg: PG | None, peer: int, oid: str) -> None:
+        """Primary bookkeeping: a peer now has `oid` (unblocks writes)."""
+        if pg is None or pg.primary != self.osd_id:
+            return
+        logical = oid.rsplit(":", 1)[0] if ":" in oid else oid
+        with self._lock:
+            ps = pg.peers.get(peer)
+            if ps:
+                ps.missing.pop(logical, None)
+            waiting = pg.waiting_for_missing.pop(logical, [])
+        for m in waiting:
+            self._handle_op(m)
 
     def _handle_push(self, msg: MOSDPGPush) -> None:
         cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
+        pg = self.pgs.get(msg.pgid)
+        push_v = dec_version(msg.attrs.get("_v"))
+        local_v = dec_version(self._getattr_safe(cid, msg.oid, "_v"))
+        if local_v is not None and push_v is not None and local_v > push_v:
+            return  # stale push; we already advanced past it
         t = Transaction()
-        existing = set(self.store.list_collections())
-        if cid not in existing:
+        if cid not in self.store.list_collections():
             t.create_collection(cid)
+        # replace wholesale: a divergent local copy's omap/attrs must not
+        # survive union-merged into the authoritative state
+        t.remove(cid, msg.oid)
         t.write(cid, msg.oid, 0, msg.data)
         if msg.omap:
             t.omap_setkeys(cid, msg.oid, msg.omap)
+        for name, val in msg.attrs.items():
+            t.setattr(cid, msg.oid, name, val)
         self.store.apply_transaction(t)
+        if pg is None:
+            return
+        logical = msg.oid.rsplit(":", 1)[0] if ":" in msg.oid else msg.oid
+        self._object_recovered(pg, logical, push_v)
+
+    def _object_recovered(self, pg: PG, oid: str,
+                          got_version) -> None:
+        """My own missing object arrived; maybe finish recovery."""
+        activate = False
+        with self._lock:
+            item = pg.missing.get(oid)
+            if item is not None and (got_version is None
+                                     or got_version >= item.need):
+                del pg.missing[oid]
+            pg.recovering.pop(oid, None)
+            if not pg.missing and pg.state == STATE_RECOVERING:
+                if pg.primary == self.osd_id:
+                    activate = True
+                else:
+                    pg.state = STATE_ACTIVE
+            pg.info.last_complete = pg.complete_to()
+            waiting = pg.waiting_for_missing.pop(oid, [])
+        self._persist_info(pg)
+        if activate:
+            self._pg_activate(pg)
+        for m in waiting:
+            self._handle_op(m)
+
+    def _merge_past_up(self, pg: PG, intervals, new_up=None) -> None:
+        """Adopt prior-interval up sets (own or learned from peer infos)."""
+        cur = new_up if new_up is not None else pg.up
+        for iv in intervals:
+            iv = list(iv)
+            if iv and iv != cur and iv not in pg.info.past_up:
+                pg.info.past_up.append(iv)
+        del pg.info.past_up[:-8]
+
+    def _ec_shard_candidates(self, pg: PG, n: int) -> dict[int, list[int]]:
+        """Per-shard holder candidates: current position first, then the
+        holders from prior intervals (PastIntervals — after a remap the
+        chunk still lives on its old positional holder)."""
+        cand: dict[int, list[int]] = {}
+        intervals = [pg.up] + list(reversed(pg.info.past_up))
+        for s in range(n):
+            seen: list[int] = []
+            for iv in intervals:
+                if s < len(iv) and iv[s] != CEPH_NOSD \
+                        and iv[s] not in seen:
+                    seen.append(iv[s])
+            cand[s] = seen
+        return cand
+
+    def _recover_ec_object(self, pg: PG, oid: str, dest_osd: int,
+                           dest_shard: int | None = None) -> None:
+        """Reconstruct one EC object's shard at the logged version from k
+        live shards, then store (self) or push (peer) the chunk
+        (ECBackend recovery: objects_read_and_reconstruct)."""
+        entry = pg.log.index.get(oid)
+        if entry is None or entry.is_delete():
+            return
+        need = entry.version
+        if dest_shard is None:
+            if self.osd_id not in pg.up:
+                return
+            dest_shard = pg.up.index(self.osd_id)
+        pool = self.osdmap.pools.get(pg.pgid[0])
+        if pool is None:
+            return
+        with self._lock:
+            if dest_osd == self.osd_id:
+                if oid in pg.recovering:
+                    return
+                pg.recovering[oid] = time.time()
+            self._recover_tid += 1
+            reqid = (RECOVERY_CLIENT + self.osd_id, self._recover_tid)
+        self.perf.inc("recovery_pulls")
+        codec = self._codec(pool)
+        k = codec.get_data_chunk_count()
+        n = codec.get_chunk_count()
+        state = {"kind": "recover", "pool": pool, "pgid": pg.pgid,
+                 "oid": oid, "need": need, "dest_osd": dest_osd,
+                 "dest_shard": dest_shard, "shards": {}, "k": k,
+                 "active": set(), "cand": self._ec_shard_candidates(pg, n)}
+        with self._lock:
+            self._ec_reads[reqid] = state
+        self._ec_gather(reqid, state)
 
     # -- heartbeats (OSD::heartbeat, osd/OSD.cc:4879) -------------------------
 
@@ -394,11 +850,14 @@ class OSDDaemon(Dispatcher):
         if isinstance(msg, MOSDPing):
             self._handle_ping(msg)
             return True
-        if isinstance(msg, MOSDPGScan):
-            self._handle_scan(msg)
+        if isinstance(msg, MOSDPGQuery):
+            self._handle_pg_query(msg)
             return True
-        if isinstance(msg, MOSDPGScanReply):
-            self._handle_scan_reply(msg)
+        if isinstance(msg, MOSDPGNotify):
+            self._handle_pg_notify(msg)
+            return True
+        if isinstance(msg, MOSDPGLog):
+            self._handle_pg_log(msg)
             return True
         if isinstance(msg, MOSDPGPull):
             self._handle_pull(msg)
@@ -431,38 +890,111 @@ class OSDDaemon(Dispatcher):
             return
         up, primary = self._pg_members(msg.pgid)
         if primary != self.osd_id:
-            # not my op in this epoch; client resends on map update
+            # not my op in this epoch: share my newer map with the stale
+            # sender so it re-targets (OSD maybe_share_map semantics);
+            # without this a client whose map never changes again would
+            # hang forever
             dout("osd", 10, "osd.%d not primary for %s", self.osd_id,
                  msg.pgid)
+            m = self.osdmap
+            if msg.epoch < m.epoch and msg.connection is not None:
+                msg.connection.send_message(MOSDMapMsg(
+                    epoch=m.epoch, map_blob=encode_osdmap(m)))
             return
+        # check-and-enqueue must be atomic with the flush paths
+        # (_pg_activate / _peer_recovered / _object_recovered), or an op can
+        # slip into a waiting list just after its last flush ran
+        with self._lock:
+            pg = self.pgs.get(msg.pgid)
+            if pg is None or pg.state != STATE_ACTIVE:
+                if pg is not None:
+                    pg.waiting_for_active.append(msg)
+                return
+            is_write = any(op.op in (OP_WRITE, OP_WRITEFULL, OP_DELETE,
+                                     OP_OMAP_SET) for op in msg.ops)
+            if self._blocked_on_recovery(pg, msg.oid, is_write,
+                                         pool.is_erasure()):
+                pg.waiting_for_missing.setdefault(msg.oid, []).append(msg)
+                return
         if pool.is_erasure():
-            self._do_ec_op(msg, pool, up)
+            self._do_ec_op(msg, pool, pg)
         else:
-            self._do_replicated_op(msg, pool, up)
+            self._do_replicated_op(msg, pool, pg)
+
+    def _blocked_on_recovery(self, pg: PG, oid: str, is_write: bool,
+                             ec: bool) -> bool:
+        """Block ops on objects still being recovered
+        (PrimaryLogPG objects_blocked_on_recovery semantics)."""
+        with self._lock:
+            if oid in pg.missing or oid in pg.recovering:
+                return True
+            if is_write or ec:
+                return any(oid in ps.missing for ps in pg.peers.values())
+        return False
 
     def _reply_err(self, msg: MOSDOp, code: int) -> None:
         msg.connection.send_message(
             MOSDOpReply(tid=msg.tid, result=code, epoch=self.osdmap.epoch))
 
+    def _dedup_resend(self, pg: PG, reqid, msg: MOSDOp) -> bool:
+        """Client resent an op already in the log.  If the original is
+        still waiting on replica commits, attach the resend to it (reply
+        when it completes) instead of acking an under-replicated write."""
+        with self._lock:
+            if not pg.log.has_reqid(reqid):
+                return False
+            inf = self._in_flight.get(reqid)
+            if inf is not None:
+                inf.msg = msg      # reply goes to the latest connection
+                return True
+        msg.connection.send_message(MOSDOpReply(
+            tid=msg.tid, result=0, epoch=self.osdmap.epoch))
+        return True
+
+    def _log_write(self, pg: PG, t: Transaction, oid: str, is_delete: bool,
+                   reqid) -> LogEntry:
+        """Allocate a version, build the log entry, and fold the log append
+        + info update into the data transaction (one atomic commit)."""
+        cid = self._pg_cid(pg.pgid)
+        version = pg.next_version(self.osdmap.epoch)
+        prior = pg.log.index[oid].version if oid in pg.log.index \
+            else EVERSION_ZERO
+        entry = LogEntry(op=LOG_DELETE if is_delete else LOG_MODIFY,
+                         oid=oid, version=version, prior_version=prior,
+                         reqid=reqid)
+        pg.record(entry)
+        self.perf.inc("log_entries")
+        t.touch(cid, PG.PGMETA)
+        t.omap_setkeys(cid, PG.PGMETA, {
+            PG.log_key(version): PG.encode_entry(entry),
+            "info": pg.encode_info()})
+        return entry
+
     # replicated pools ---------------------------------------------------------
 
-    def _do_replicated_op(self, msg: MOSDOp, pool, up) -> None:
-        cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
+    def _do_replicated_op(self, msg: MOSDOp, pool, pg: PG) -> None:
+        up = pg.up
+        cid = self._pg_cid(pg.pgid)
+        reqid = (msg.client_id, msg.tid)
         t = Transaction()
         reply_ops: list[OSDOpField] = []
         result = 0
         is_write = False
+        is_delete = False
         for op in msg.ops:
             if op.op in (OP_WRITE, OP_WRITEFULL):
                 is_write = True
+                is_delete = False
                 if op.op == OP_WRITEFULL:
                     t.truncate(cid, msg.oid, 0)
                 t.write(cid, msg.oid, op.offset, op.data)
             elif op.op == OP_DELETE:
                 is_write = True
+                is_delete = True
                 t.remove(cid, msg.oid)
             elif op.op == OP_OMAP_SET:
                 is_write = True
+                is_delete = False
                 keys = _decode_omap(op.data)
                 t.touch(cid, msg.oid)
                 t.omap_setkeys(cid, msg.oid, keys)
@@ -497,9 +1029,14 @@ class OSDDaemon(Dispatcher):
                 tid=msg.tid, result=result, epoch=self.osdmap.epoch,
                 ops=reply_ops))
             return
-        # write path: local commit + replica fan-out (issue_repop)
+        # write path: dedup, log, local commit, replica fan-out (issue_repop)
+        if self._dedup_resend(pg, reqid, msg):
+            return
         self.perf.inc("op_w")
         t0 = time.time()
+        entry = self._log_write(pg, t, msg.oid, is_delete, reqid)
+        if not is_delete:
+            t.setattr(cid, msg.oid, "_v", enc_version(entry.version))
         self.store.apply_transaction(t)
         replicas = [o for o in up if o != self.osd_id and o != CEPH_NOSD]
         reply = MOSDOpReply(tid=msg.tid, result=0, epoch=self.osdmap.epoch)
@@ -507,11 +1044,14 @@ class OSDDaemon(Dispatcher):
             self.perf.tinc("op_w_latency", time.time() - t0)
             msg.connection.send_message(reply)
             return
-        reqid = (msg.client_id, msg.tid)
         with self._lock:
             self._in_flight[reqid] = _InFlight(msg, set(replicas), reply)
         blob = t.encode()
+        entry_blob = PG.encode_entry(entry)
         for rep in replicas:
+            if self.debug_drop_rep_ops > 0:
+                self.debug_drop_rep_ops -= 1
+                continue
             con = self._osd_con(rep)
             if con is None:
                 # address unknown this epoch: count it as an instant nack so
@@ -519,17 +1059,24 @@ class OSDDaemon(Dispatcher):
                 self._ack_shard(reqid, rep, -107)
                 continue
             con.send_message(MOSDRepOp(reqid=reqid, pgid=msg.pgid,
-                                       oid=msg.oid, txn=blob))
+                                       oid=msg.oid, txn=blob,
+                                       pg_version=entry.version,
+                                       entry=entry_blob))
         self.perf.tinc("op_w_latency", time.time() - t0)
 
     def _handle_rep_op(self, msg: MOSDRepOp) -> None:
         self.perf.inc("op_rep")
-        t = Transaction.decode(msg.txn)
-        cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
-        if cid not in self.store.list_collections():
-            pre = Transaction().create_collection(cid)
-            self.store.apply_transaction(pre)
-        self.store.apply_transaction(t)
+        pg = self._get_pg(msg.pgid)
+        entry = PG.decode_entry(msg.entry) if msg.entry else None
+        # head-check, txn apply and log append must be one atomic step:
+        # a concurrent peering merge advancing the head between them would
+        # apply the data but trip record()'s ordering assert
+        with self._lock:
+            if entry is None or entry.version > pg.log.head:
+                t = Transaction.decode(msg.txn)
+                self.store.apply_transaction(t)
+                if entry is not None:
+                    pg.record(entry)
         msg.connection.send_message(MOSDRepOpReply(
             reqid=msg.reqid, pgid=msg.pgid, from_osd=self.osd_id, result=0))
 
@@ -563,15 +1110,18 @@ class OSDDaemon(Dispatcher):
                 self._codecs[pool.pool_id] = c
             return c
 
-    def _do_ec_op(self, msg: MOSDOp, pool, up) -> None:
+    def _do_ec_op(self, msg: MOSDOp, pool, pg: PG) -> None:
+        up = pg.up
         codec = self._codec(pool)
         k = codec.get_data_chunk_count()
         n = codec.get_chunk_count()
-        cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
+        cid = self._pg_cid(pg.pgid)
         for op in msg.ops:
             if op.op == OP_WRITEFULL:
-                self.perf.inc("op_w")
                 reqid = (msg.client_id, msg.tid)
+                if self._dedup_resend(pg, reqid, msg):
+                    return
+                self.perf.inc("op_w")
                 shard_osds = {s: up[s] for s in range(min(n, len(up)))
                               if up[s] != CEPH_NOSD}
                 if len(shard_osds) < max(k, pool.min_size):
@@ -585,6 +1135,11 @@ class OSDDaemon(Dispatcher):
                                     epoch=self.osdmap.epoch)
                 waiting = set()
                 size_attr = str(len(op.data)).encode()
+                meta_t = Transaction()
+                entry = self._log_write(pg, meta_t, msg.oid,
+                                        is_delete=False, reqid=reqid)
+                entry_blob = PG.encode_entry(entry)
+                v_attr = enc_version(entry.version)
                 for shard, osd in shard_osds.items():
                     if osd == self.osd_id:
                         t = (Transaction()
@@ -592,7 +1147,10 @@ class OSDDaemon(Dispatcher):
                              .write(cid, f"{msg.oid}:{shard}", 0,
                                     chunks[shard])
                              .setattr(cid, f"{msg.oid}:{shard}", "size",
-                                      size_attr))
+                                      size_attr)
+                             .setattr(cid, f"{msg.oid}:{shard}", "_v",
+                                      v_attr))
+                        t.ops.extend(meta_t.ops)
                         self.store.apply_transaction(t)
                     else:
                         waiting.add(osd)
@@ -612,7 +1170,8 @@ class OSDDaemon(Dispatcher):
                         oid=f"{msg.oid}:{shard}",
                         shard=shard, chunk=chunks[shard],
                         epoch=self.osdmap.epoch,
-                        obj_size=len(op.data)))
+                        obj_size=len(op.data),
+                        entry=entry_blob))
                 if not waiting:
                     msg.connection.send_message(reply)
             elif op.op == OP_READ:
@@ -625,12 +1184,22 @@ class OSDDaemon(Dispatcher):
     def _handle_ec_write(self, msg: MOSDECSubOpWrite) -> None:
         oid = msg.oid
         cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
-        if cid not in self.store.list_collections():
-            self.store.apply_transaction(Transaction().create_collection(cid))
-        t = (Transaction().truncate(cid, oid, 0)
-             .write(cid, oid, 0, msg.chunk)
-             .setattr(cid, oid, "size", str(msg.obj_size).encode()))
-        self.store.apply_transaction(t)
+        pg = self._get_pg(msg.pgid)
+        entry = PG.decode_entry(msg.entry) if msg.entry else None
+        # atomic head-check + apply + append (see _handle_rep_op)
+        with self._lock:
+            if entry is None or entry.version > pg.log.head:
+                t = (Transaction().truncate(cid, oid, 0)
+                     .write(cid, oid, 0, msg.chunk)
+                     .setattr(cid, oid, "size", str(msg.obj_size).encode()))
+                if entry is not None:
+                    t.setattr(cid, oid, "_v", enc_version(entry.version))
+                    t.touch(cid, PG.PGMETA)
+                    pg.record(entry)
+                    t.omap_setkeys(cid, PG.PGMETA, {
+                        PG.log_key(entry.version): PG.encode_entry(entry),
+                        "info": pg.encode_info()})
+                self.store.apply_transaction(t)
         msg.connection.send_message(MOSDECSubOpWriteReply(
             reqid=msg.reqid, shard=msg.shard, from_osd=self.osd_id,
             result=0))
@@ -644,40 +1213,81 @@ class OSDDaemon(Dispatcher):
         k = codec.get_data_chunk_count()
         n = codec.get_chunk_count()
         reqid = (msg.client_id, msg.tid)
-        avail = {s: up[s] for s in range(min(n, len(up)))
-                 if up[s] != CEPH_NOSD}
-        if len(avail) < k:
-            # fewer than k shards mapped to live osds: unreadable this epoch
+        pg = self.pgs.get(msg.pgid)
+        cand = (self._ec_shard_candidates(pg, n) if pg is not None
+                else {s: [up[s]] for s in range(min(n, len(up)))
+                      if up[s] != CEPH_NOSD})
+        if sum(1 for c in cand.values() if c) < k:
+            # fewer than k shards locatable: unreadable this epoch
             self._reply_err(msg, -5)
             return
-        want = dict(list(avail.items()))
-        state = {"msg": msg, "pool": pool, "shards": {}, "k": k,
-                 "asked": set(), "failed": set()}
+        entry = pg.log.index.get(msg.oid) if pg is not None else None
+        state = {"kind": "client", "msg": msg, "pool": pool,
+                 "pgid": msg.pgid, "oid": msg.oid,
+                 # the logged version pins the stripe: past-interval
+                 # holders may serve stale chunks that must not be mixed
+                 # into the decode
+                 "need": entry.version if entry is not None
+                 and not entry.is_delete() else None,
+                 "shards": {}, "k": k, "active": set(), "cand": cand}
         with self._lock:
             self._ec_reads[reqid] = state
-        # ask k shards (prefer data shards: minimum_to_decode semantics)
-        chosen = sorted(want)[:k]
-        for s in chosen:
-            osd = want[s]
-            state["asked"].add(s)
-            if osd == self.osd_id:
-                self._ec_read_local(reqid, msg, cid, s)
-            else:
-                con = self._osd_con(osd)
-                if con is None:
-                    self._ec_read_failed(reqid, s)
-                    continue
-                con.send_message(MOSDECSubOpRead(
-                    reqid=reqid, pgid=msg.pgid, oid=msg.oid, shard=s))
+        self._ec_gather(reqid, state)
 
-    def _ec_read_local(self, reqid, msg, cid, shard) -> None:
+    def _ec_gather(self, reqid, state: dict) -> None:
+        """Keep enough shard reads in flight to reach k results
+        (get_min_avail_to_read_shards + the retry ladder, unified)."""
+        while True:
+            with self._lock:
+                if reqid not in self._ec_reads:
+                    return
+                have = len(state["shards"]) + len(state["active"])
+                if have >= state["k"]:
+                    return
+                # lowest-index shard with a candidate left, not already
+                # satisfied or in flight (prefer data shards)
+                pick = None
+                for s in sorted(state["cand"]):
+                    if (s not in state["shards"]
+                            and s not in state["active"]
+                            and state["cand"][s]):
+                        pick = s
+                        break
+                if pick is None:
+                    del self._ec_reads[reqid]
+                    give_up = True
+                else:
+                    give_up = False
+                    osd = state["cand"][pick].pop(0)
+                    state["active"].add(pick)
+            if give_up:
+                self._ec_read_give_up(state)
+                return
+            self._ec_ask(reqid, state, pick, osd)
+
+    def _ec_ask(self, reqid, state: dict, shard: int, osd: int) -> None:
+        pgid = state["pgid"]
+        oid = state["oid"]
+        if osd == self.osd_id:
+            self._ec_read_local(reqid, oid, f"{pgid[0]}.{pgid[1]}", shard)
+            return
+        con = self._osd_con(osd)
+        if con is None:
+            self._ec_read_failed(reqid, shard)
+            return
+        con.send_message(MOSDECSubOpRead(
+            reqid=reqid, pgid=pgid, oid=oid, shard=shard))
+
+    def _ec_read_local(self, reqid, oid: str, cid: str, shard) -> None:
         try:
-            chunk = self.store.read(cid, f"{msg.oid}:{shard}")
-            size = int(self.store.getattr(cid, f"{msg.oid}:{shard}", "size"))
+            chunk = self.store.read(cid, f"{oid}:{shard}")
+            size = int(self.store.getattr(cid, f"{oid}:{shard}", "size"))
+            ver = dec_version(self._getattr_safe(cid, f"{oid}:{shard}",
+                                                 "_v")) or EVERSION_ZERO
         except (KeyError, TypeError):
             self._ec_read_failed(reqid, shard)
             return
-        self._ec_read_done(reqid, shard, chunk, size)
+        self._ec_read_done(reqid, shard, chunk, size, ver)
 
     def _handle_ec_read(self, msg: MOSDECSubOpRead) -> None:
         cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
@@ -685,13 +1295,16 @@ class OSDDaemon(Dispatcher):
             chunk = self.store.read(cid, f"{msg.oid}:{msg.shard}")
             size = int(self.store.getattr(cid, f"{msg.oid}:{msg.shard}",
                                           "size"))
+            ver = dec_version(self._getattr_safe(
+                cid, f"{msg.oid}:{msg.shard}", "_v")) or EVERSION_ZERO
             result = 0
         except (KeyError, TypeError):
-            chunk, size, result = b"", 0, -2
+            chunk, size, ver, result = b"", 0, EVERSION_ZERO, -2
         msg.connection.send_message(MOSDECSubOpReadReply(
             reqid=msg.reqid, shard=msg.shard, from_osd=self.osd_id,
-            result=result, chunk=chunk + size.to_bytes(8, "little")
-            if result == 0 else b""))
+            result=result, ver=ver,
+            chunk=chunk + size.to_bytes(8, "little") if result == 0
+            else b""))
 
     def _handle_ec_read_reply(self, msg: MOSDECSubOpReadReply) -> None:
         if msg.result != 0:
@@ -699,62 +1312,86 @@ class OSDDaemon(Dispatcher):
             return
         chunk, size = msg.chunk[:-8], int.from_bytes(msg.chunk[-8:],
                                                      "little")
-        self._ec_read_done(msg.reqid, msg.shard, chunk, size)
+        self._ec_read_done(msg.reqid, msg.shard, chunk, size, msg.ver)
 
     def _ec_read_failed(self, reqid, shard: int) -> None:
         with self._lock:
             state = self._ec_reads.get(reqid)
             if state is None:
                 return
-            state["failed"].add(shard)
-            msg = state["msg"]
-            pool = state["pool"]
-        # ask a replacement shard not yet asked (min_to_decode retry)
-        up, _primary = self._pg_members(msg.pgid)
-        codec = self._codec(pool)
-        n = codec.get_chunk_count()
-        cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
-        with self._lock:
-            candidates = [s for s in range(min(n, len(up)))
-                          if up[s] != CEPH_NOSD and s not in state["asked"]]
-            if not candidates:
-                del self._ec_reads[reqid]
-                self._reply_err(msg, -5)
-                return
-            s = candidates[0]
-            state["asked"].add(s)
-            osd = up[s]
-        if osd == self.osd_id:
-            self._ec_read_local(reqid, msg, cid, s)
+            state["active"].discard(shard)
+        self._ec_gather(reqid, state)
+
+    def _ec_read_give_up(self, state: dict) -> None:
+        if state["kind"] == "client":
+            self._reply_err(state["msg"], -5)
         else:
-            con = self._osd_con(osd)
-            if con is None:
-                self._ec_read_failed(reqid, s)
-            else:
-                con.send_message(MOSDECSubOpRead(
-                    reqid=reqid, pgid=msg.pgid, oid=msg.oid, shard=s))
+            pg = self.pgs.get(state["pgid"])
+            if pg is not None:
+                with self._lock:
+                    pg.recovering.pop(state["oid"], None)
 
     def _ec_read_done(self, reqid, shard: int, chunk: bytes,
-                      size: int) -> None:
+                      size: int, ver) -> None:
         with self._lock:
             state = self._ec_reads.get(reqid)
             if state is None:
                 return
-            state["shards"][shard] = chunk
-            state["size"] = size
-            if len(state["shards"]) < state["k"]:
-                return
-            del self._ec_reads[reqid]
-        msg = state["msg"]
+            state["active"].discard(shard)
+            need = state.get("need")
+            stale = need is not None and ver != need
+            if not stale:
+                state["shards"][shard] = chunk
+                state["size"] = size
+                if len(state["shards"]) < state["k"]:
+                    return
+                del self._ec_reads[reqid]
+        if stale:
+            self._ec_gather(reqid, state)
+            return
         codec = self._codec(state["pool"])
         k = state["k"]
         have = dict(sorted(state["shards"].items())[:k])
-        chunks = {s: c for s, c in have.items()}
-        decoded = codec.decode(set(range(k)), chunks)
+        decoded = codec.decode(set(range(k)), dict(have))
         data = b"".join(decoded[i] for i in range(k))[:state["size"]]
-        msg.connection.send_message(MOSDOpReply(
-            tid=msg.tid, result=0, epoch=self.osdmap.epoch,
-            ops=[OSDOpField(OP_READ, 0, len(data), data)]))
+        if state["kind"] == "client":
+            msg = state["msg"]
+            msg.connection.send_message(MOSDOpReply(
+                tid=msg.tid, result=0, epoch=self.osdmap.epoch,
+                ops=[OSDOpField(OP_READ, 0, len(data), data)]))
+            return
+        self._ec_recover_done(state, data)
+
+    def _ec_recover_done(self, state: dict, data: bytes) -> None:
+        """Reconstructed the full object: re-encode and deliver the
+        destination shard's chunk."""
+        pool = state["pool"]
+        pgid = state["pgid"]
+        oid = state["oid"]
+        need = state["need"]
+        dest_shard = state["dest_shard"]
+        codec = self._codec(pool)
+        n = codec.get_chunk_count()
+        chunks = codec.encode(set(range(n)), data)
+        cid = f"{pgid[0]}.{pgid[1]}"
+        shard_oid = f"{oid}:{dest_shard}"
+        attrs = {"size": str(len(data)).encode(), "_v": enc_version(need)}
+        pg = self.pgs.get(pgid)
+        if state["dest_osd"] == self.osd_id:
+            t = (Transaction().truncate(cid, shard_oid, 0)
+                 .write(cid, shard_oid, 0, chunks[dest_shard]))
+            for name, val in attrs.items():
+                t.setattr(cid, shard_oid, name, val)
+            self.store.apply_transaction(t)
+            if pg is not None:
+                self._object_recovered(pg, oid, need)
+            return
+        con = self._osd_con(state["dest_osd"])
+        if con:
+            con.send_message(MOSDPGPush(
+                pgid=pgid, oid=shard_oid, data=chunks[dest_shard],
+                attrs=attrs))
+        self._peer_recovered(pg, state["dest_osd"], shard_oid)
 
     # -- peers ----------------------------------------------------------------
 
