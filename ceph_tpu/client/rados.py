@@ -105,6 +105,9 @@ class RadosClient(Dispatcher):
             RadosClient._next_client_id += 1
         self.ctx = ctx or CephTpuContext(f"client.{self.client_id}")
         self.mon_addr = mon_addr
+        #: comma-separated mon_host list; subscribe to all, command with
+        #: per-mon failover (any mon forwards commands to the leader)
+        self.mon_addrs = [a for a in mon_addr.split(",") if a]
         self.timeout = timeout
         self.osdmap = OSDMap()
         self._map_event = threading.Event()
@@ -124,9 +127,10 @@ class RadosClient(Dispatcher):
         self.msgr.bind("127.0.0.1:0") if _is_tcp(self.msgr) else \
             self.msgr.bind(f"client.{self.client_id}")
         self.msgr.start()
-        mon = self.msgr.connect_to(self.mon_addr, EntityName("mon", 0))
-        mon.send_message(MMonSubscribe(name=str(self.name),
-                                       addr=self.msgr.my_addr))
+        for rank, addr in enumerate(self.mon_addrs):
+            mon = self.msgr.connect_to(addr, EntityName("mon", rank))
+            mon.send_message(MMonSubscribe(name=str(self.name),
+                                           addr=self.msgr.my_addr))
         if not self._map_event.wait(self.timeout):
             raise TimeoutError("no OSDMap from mon")
 
@@ -166,17 +170,38 @@ class RadosClient(Dispatcher):
     # -- mon commands ---------------------------------------------------------
 
     def mon_command(self, cmd: dict) -> tuple[int, str]:
-        with self._lock:
-            tid = self._next_tid
-            self._next_tid += 1
-            ev: tuple[threading.Event, list] = (threading.Event(), [])
-            self._cmd_waiters[tid] = ev
-        mon = self.msgr.connect_to(self.mon_addr, EntityName("mon", 0))
-        mon.send_message(MMonCommand(tid=tid, cmd=cmd))
-        if not ev[0].wait(self.timeout):
-            raise TimeoutError(f"mon command {cmd} timed out")
-        ack = ev[1][0]
-        return ack.result, ack.output
+        """Cycle through the monitors until the overall deadline: a mon
+        may be dead, electing, or between leaders — transient windows
+        that the next attempt (or the next mon) heals."""
+        import time as _time
+        deadline = _time.time() + self.timeout
+        last_exc: Exception | None = None
+        while True:
+            for rank, addr in enumerate(self.mon_addrs):
+                remaining = deadline - _time.time()
+                if remaining <= 0:
+                    raise last_exc if last_exc \
+                        else TimeoutError("no monitors")
+                with self._lock:
+                    tid = self._next_tid
+                    self._next_tid += 1
+                    ev: tuple[threading.Event, list] = (threading.Event(),
+                                                        [])
+                    self._cmd_waiters[tid] = ev
+                mon = self.msgr.connect_to(addr, EntityName("mon", rank))
+                mon.send_message(MMonCommand(tid=tid, cmd=cmd))
+                if ev[0].wait(min(2.5, remaining)):
+                    ack = ev[1][0]
+                    if ack.result == -11:  # no quorum there yet: an
+                        # election is running; don't hammer the mons
+                        last_exc = OSError(11, ack.output)
+                        threading.Event().wait(0.25)
+                        continue
+                    return ack.result, ack.output
+                with self._lock:
+                    self._cmd_waiters.pop(tid, None)
+                last_exc = TimeoutError(
+                    f"mon command {cmd} timed out ({addr})")
 
     def wait_for_epoch(self, epoch: int, timeout: float | None = None
                        ) -> None:

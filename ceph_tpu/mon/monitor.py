@@ -1,23 +1,39 @@
-"""Monitor daemon.
+"""Monitor daemon: Paxos-replicated cluster-map authority.
 
-Map mutations follow the reference's pending_inc pattern (OSDMonitor): mutate a
-pending copy, commit it as epoch+1 to the versioned store, then broadcast to
-subscribers.  Failure handling mirrors check_failure (mon/OSDMonitor.cc:2537):
-an osd is marked down once `mon_osd_min_down_reporters` distinct reporters
-have filed MOSDFailure against it.
+Map mutations follow the reference's pending_inc pattern (OSDMonitor):
+mutate a *copy* of the map, then commit it through Paxos — the committed
+blob is what every monitor (leader and peons alike) applies in the
+on_commit callback, so all quorum members converge on the identical map
+bytes.  Leadership comes from the Elector (lowest reachable rank); peons
+forward client commands to the leader (MForward, src/mon/Monitor.cc
+forward_request_leader) and OSDs simply send their boot/failure reports
+to every monitor (the leader executes, peons ignore — the reports are
+idempotent and re-sent, so no relay machinery is needed for them).
+
+Failure handling mirrors check_failure (mon/OSDMonitor.cc:2537): an osd
+is marked down once `mon_osd_min_down_reporters` distinct reporters have
+filed MOSDFailure against it.
+
+Mutations run on a single worker thread, never on a messenger dispatch
+thread: propose_and_wait blocks until the quorum accepts, and the
+dispatch thread must stay free to process those very ACCEPT messages.
 """
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 
 from ceph_tpu.common.context import CephTpuContext
+from ceph_tpu.common.logging import dout
 from ceph_tpu.crush.builder import add_simple_rule, make_bucket
 from ceph_tpu.crush.types import CRUSH_BUCKET_STRAW2, CrushMap
 from ceph_tpu.messages import (
     MMonCommand, MMonCommandAck, MOSDFailure, MOSDMapMsg)
 from ceph_tpu.messages.osd_msgs import MOSDPing
+from ceph_tpu.mon.elector import Elector, MMonElection
+from ceph_tpu.mon.paxos import MMonPaxos, Paxos
 from ceph_tpu.msg.message import Message, register_message
 from ceph_tpu.msg.encoding import Encoder, Decoder
 from ceph_tpu.msg.messenger import (
@@ -69,7 +85,58 @@ class MMonSubscribe(Message):
         dec.versioned(1, body)
 
 
+@register_message
+class MMonForward(Message):
+    """peon -> leader: relayed client command (messages/MForward.h)."""
+
+    TYPE = 46  # MSG_FORWARD
+
+    def __init__(self, fwd_tid: int = 0, cmd_tid: int = 0,
+                 cmd_blob: bytes = b""):
+        super().__init__()
+        self.fwd_tid = fwd_tid
+        self.cmd_tid = cmd_tid
+        self.cmd_blob = cmd_blob   # json-encoded command dict
+
+    def encode_payload(self, enc: Encoder):
+        enc.versioned(1, 1, lambda e: (
+            e.u64(self.fwd_tid), e.u64(self.cmd_tid),
+            e.bytes(self.cmd_blob)))
+
+    def decode_payload(self, dec: Decoder, version: int):
+        def body(d, v):
+            self.fwd_tid = d.u64()
+            self.cmd_tid = d.u64()
+            self.cmd_blob = d.bytes()
+        dec.versioned(1, body)
+
+
+@register_message
+class MMonForwardAck(Message):
+    TYPE = 47
+
+    def __init__(self, fwd_tid: int = 0, result: int = 0,
+                 output: str = ""):
+        super().__init__()
+        self.fwd_tid = fwd_tid
+        self.result = result
+        self.output = output
+
+    def encode_payload(self, enc: Encoder):
+        enc.versioned(1, 1, lambda e: (
+            e.u64(self.fwd_tid), e.s32(self.result), e.str(self.output)))
+
+    def decode_payload(self, dec: Decoder, version: int):
+        def body(d, v):
+            self.fwd_tid = d.u64()
+            self.result = d.s32()
+            self.output = d.str()
+        dec.versioned(1, body)
+
+
 class Monitor(Dispatcher):
+    TICK_INTERVAL = 0.25
+
     def __init__(self, ctx: CephTpuContext | None = None, mon_id: int = 0,
                  store_path: str | None = None, ms_type: str = "async",
                  addr: str = "127.0.0.1:0"):
@@ -84,9 +151,20 @@ class Monitor(Dispatcher):
         #: subscriber name -> (addr, entity)
         self._subs: dict[str, tuple[str, EntityName]] = {}
         self._osd_addrs: dict[int, str] = {}
+        self.monmap: list[str] = []
+        self.elector: Elector | None = None
+        self.paxos: Paxos | None = None
+        self._tick_timer: threading.Timer | None = None
+        self._work_q: queue.Queue = queue.Queue()
+        self._worker: threading.Thread | None = None
+        self._fwd_tid = 0
+        #: fwd_tid -> (client connection, client tid)
+        self._fwd_waiting: dict[int, tuple] = {}
+        self._stop = False
         self.msgr = Messenger.create(self.name, ms_type)
         self.msgr.set_policy("client", ConnectionPolicy.lossy_client())
         self.msgr.set_policy("osd", ConnectionPolicy.stateful_server())
+        self.msgr.set_policy("mon", ConnectionPolicy.stateful_peer())
         self.msgr.add_dispatcher_tail(self)
         self._addr = addr
         self.ctx.admin.register_command(
@@ -94,14 +172,43 @@ class Monitor(Dispatcher):
 
     # -- lifecycle ------------------------------------------------------------
 
-    def init(self) -> None:
+    def init(self, monmap: list[str] | None = None) -> None:
         if isinstance(self.db, LogDB):
             self.db.open()
-        self._load_or_bootstrap()
         self.msgr.bind(self._addr)
         self.msgr.start()
+        self._worker = threading.Thread(target=self._work_loop, daemon=True)
+        self._worker.start()
+        if monmap:
+            self.set_monmap(monmap)
+        elif monmap is None and self.monmap == []:
+            # single-mon convenience: I am the whole quorum
+            # (monmap=[] defers: caller will set_monmap once every mon
+            # in the cluster has bound its address)
+            self.set_monmap([self.addr])
+
+    def set_monmap(self, addrs: list[str]) -> None:
+        """Fix the monitor cluster membership and start electing.
+        Must run after init() (our own address must be known)."""
+        self.monmap = list(addrs)
+        self.elector = Elector(self.mon_id, len(addrs), self._send_mon,
+                               self._on_election_win, self._on_election_lose)
+        self.paxos = Paxos(self.mon_id, self.db, self._send_mon,
+                           self._on_paxos_commit, self._request_election)
+        self.paxos.on_active = self._on_paxos_active
+        # restore the last committed map (mon store = Paxos store)
+        if self.paxos.last_committed > 0:
+            blob = self.paxos.get(self.paxos.last_committed)
+            if blob:
+                self.osdmap = decode_osdmap(blob)
+        self._schedule_tick()
+        self.elector.start()
 
     def shutdown(self) -> None:
+        self._stop = True
+        if self._tick_timer:
+            self._tick_timer.cancel()
+        self._work_q.put(None)
         self.msgr.shutdown()
         if isinstance(self.db, LogDB):
             self.db.close()
@@ -110,46 +217,158 @@ class Monitor(Dispatcher):
     def addr(self) -> str:
         return self.msgr.my_addr
 
-    def _load_or_bootstrap(self) -> None:
-        last = self.db.get("osdmap", "last_committed")
-        if last is not None:
-            blob = self.db.get("osdmap", f"full_{int(last.decode())}")
-            self.osdmap = decode_osdmap(blob)
+    def is_leader(self) -> bool:
+        return (self.elector is not None
+                and self.elector.leader == self.mon_id
+                and not self.elector.electing)
+
+    def quorum(self) -> list[int]:
+        return list(self.elector.quorum) if self.elector else []
+
+    # -- mon-to-mon plumbing --------------------------------------------------
+
+    def _send_mon(self, rank: int, msg) -> None:
+        if not (0 <= rank < len(self.monmap)):
             return
-        # bootstrap: empty map with a root bucket and a default rule
-        m = OSDMap(epoch=0, crush=CrushMap())
-        m.crush.add_bucket(
-            make_bucket(-1, CRUSH_BUCKET_STRAW2, 2, [], []))
-        self.osdmap = m
-        self._commit(m)  # epoch 1
+        con = self.msgr.connect_to(self.monmap[rank],
+                                   EntityName("mon", rank))
+        con.send_message(msg)
 
-    # -- the pending_inc commit path ------------------------------------------
+    def _request_election(self) -> None:
+        # one election at a time: restarting every liveness tick would
+        # bump the epoch faster than peers can ack and never converge
+        if self.elector and not self._stop and not self.elector.electing:
+            dout("mon", 5, "mon.%d calling new election", self.mon_id)
+            self.elector.start()
 
-    def _commit(self, newmap: OSDMap) -> None:
-        """Versioned commit (Paxos store layout: one value per version)."""
+    def _on_election_win(self, epoch: int, quorum: list[int]) -> None:
+        dout("mon", 5, "mon.%d won election epoch %d quorum %s",
+             self.mon_id, epoch, quorum)
+        self.paxos.leader_init(epoch, quorum)
+
+    def _on_election_lose(self, epoch: int, leader: int,
+                          quorum: list[int]) -> None:
+        dout("mon", 5, "mon.%d peon of mon.%d epoch %d", self.mon_id,
+             leader, epoch)
+        self.paxos.peon_init(epoch, leader, quorum)
+
+    def _on_paxos_active(self) -> None:
+        """Leader finished the collect phase.  Bootstrap the very first
+        map if the store is empty (must not block the calling thread)."""
+        if self.paxos.last_committed == 0:
+            self._work_q.put(("bootstrap", None, None))
+
+    def _on_paxos_commit(self, version: int, blob: bytes) -> None:
+        """Every quorum member applies committed maps identically."""
+        newmap = decode_osdmap(blob)
         with self._lock:
-            newmap.epoch += 1
-            blob = encode_osdmap(newmap)
-            t = self.db.get_transaction()
-            t.set("osdmap", f"full_{newmap.epoch}", blob)
-            t.set("osdmap", "last_committed", str(newmap.epoch).encode())
-            self.db.submit_transaction(t)
+            if newmap.epoch <= self.osdmap.epoch:
+                return
             self.osdmap = newmap
             subs = list(self._subs.values())
         for addr, entity in subs:
             con = self.msgr.connect_to(addr, entity)
             con.send_message(MOSDMapMsg(epoch=newmap.epoch, map_blob=blob))
 
+    def _schedule_tick(self) -> None:
+        if self._stop:
+            return
+        self._tick_timer = threading.Timer(self.TICK_INTERVAL, self._tick)
+        self._tick_timer.daemon = True
+        self._tick_timer.start()
+
+    def _tick(self) -> None:
+        try:
+            if self.elector:
+                self.elector.tick()
+            if self.paxos:
+                self.paxos.tick()
+        finally:
+            self._schedule_tick()
+
+    # -- the mutation path (worker thread only) -------------------------------
+
+    def _work_loop(self) -> None:
+        while True:
+            item = self._work_q.get()
+            if item is None:
+                return
+            kind, payload, reply_to = item
+            try:
+                if kind == "bootstrap":
+                    self._do_bootstrap()
+                elif kind == "cmd":
+                    out, res = self.handle_command(payload)
+                    if reply_to is not None:
+                        con, tid, fwd = reply_to
+                        if fwd is None:
+                            con.send_message(MMonCommandAck(
+                                tid=tid, result=res, output=out))
+                        else:
+                            con.send_message(MMonForwardAck(
+                                fwd_tid=fwd, result=res, output=out))
+                elif kind == "boot":
+                    self._do_boot(payload)
+                elif kind == "failure":
+                    self._do_failure(payload)
+            except Exception:
+                from ceph_tpu.common.logging import get_logger
+                get_logger("mon").exception("mon.%d work item failed",
+                                            self.mon_id)
+
+    def _mutate(self, fn) -> bool:
+        """Run fn on a copy of the map; commit through Paxos on change.
+        fn returns False for a no-op.  Worker thread only."""
+        if not self.is_leader():
+            return False
+        with self._lock:
+            m = decode_osdmap(encode_osdmap(self.osdmap))
+        if fn(m) is False:
+            return True  # nothing to do
+        m.epoch += 1
+        blob = encode_osdmap(m)
+        return self.paxos.propose_and_wait(blob)
+
+    def _do_bootstrap(self) -> None:
+        if self.paxos.last_committed > 0:
+            return
+
+        def fn(m: OSDMap):
+            m.crush = CrushMap()
+            m.crush.add_bucket(
+                make_bucket(-1, CRUSH_BUCKET_STRAW2, 2, [], []))
+        self._mutate(fn)
+
     # -- dispatch -------------------------------------------------------------
 
     def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, MMonElection):
+            if self.elector:
+                self.elector.handle(msg)
+            return True
+        if isinstance(msg, MMonPaxos):
+            if self.paxos:
+                self.paxos.handle(msg)
+            return True
         if isinstance(msg, MMonCommand):
-            out, result = self.handle_command(msg.cmd)
-            msg.connection.send_message(
-                MMonCommandAck(tid=msg.tid, result=result, output=out))
+            self._handle_command_msg(msg)
+            return True
+        if isinstance(msg, MMonForward):
+            import json
+            cmd = json.loads(msg.cmd_blob.decode())
+            self._work_q.put(("cmd", cmd,
+                              (msg.connection, msg.cmd_tid, msg.fwd_tid)))
+            return True
+        if isinstance(msg, MMonForwardAck):
+            with self._lock:
+                waiting = self._fwd_waiting.pop(msg.fwd_tid, None)
+            if waiting is not None:
+                con, tid = waiting
+                con.send_message(MMonCommandAck(
+                    tid=tid, result=msg.result, output=msg.output))
             return True
         if isinstance(msg, MOSDBoot):
-            self._handle_boot(msg)
+            self._work_q.put(("boot", msg, None))
             return True
         if isinstance(msg, MMonSubscribe):
             with self._lock:
@@ -157,22 +376,46 @@ class Monitor(Dispatcher):
                           or EntityName.parse(msg.name))
                 self._subs[msg.name] = (msg.addr, entity)
                 epoch, blob = self.osdmap.epoch, encode_osdmap(self.osdmap)
-            con = self.msgr.connect_to(msg.addr, entity)
-            con.send_message(MOSDMapMsg(epoch=epoch, map_blob=blob))
+            if epoch > 0:
+                con = self.msgr.connect_to(msg.addr, entity)
+                con.send_message(MOSDMapMsg(epoch=epoch, map_blob=blob))
             return True
         if isinstance(msg, MOSDFailure):
-            self._handle_failure(msg)
+            self._work_q.put(("failure", msg, None))
             return True
         if isinstance(msg, MOSDPing):
             return True  # mon liveness probe, nothing to do
         return False
 
-    # -- osd lifecycle --------------------------------------------------------
-
-    def _handle_boot(self, msg: MOSDBoot) -> None:
+    def _handle_command_msg(self, msg: MMonCommand) -> None:
+        if self.is_leader():
+            self._work_q.put(("cmd", msg.cmd,
+                              (msg.connection, msg.tid, None)))
+            return
+        # peon: forward to the leader (MForward)
+        leader = self.elector.leader if self.elector else None
+        if leader is None or leader == self.mon_id:
+            msg.connection.send_message(MMonCommandAck(
+                tid=msg.tid, result=-11, output="no quorum"))
+            return
+        import json
         with self._lock:
-            m = self.osdmap
+            self._fwd_tid += 1
+            fwd = self._fwd_tid
+            self._fwd_waiting[fwd] = (msg.connection, msg.tid)
+        self._send_mon(leader, MMonForward(
+            fwd_tid=fwd, cmd_tid=msg.tid,
+            cmd_blob=json.dumps(msg.cmd).encode()))
+
+    # -- osd lifecycle (worker thread) ----------------------------------------
+
+    def _do_boot(self, msg: MOSDBoot) -> None:
+        def fn(m: OSDMap):
             osd = msg.osd_id
+            if (osd < m.max_osd and m.is_up(osd)
+                    and osd < len(m.osd_addrs)
+                    and m.osd_addrs[osd] == msg.addr):
+                return False  # dup boot (osd sends to every mon)
             if osd >= m.max_osd:
                 m.set_max_osd(osd + 1)
             newly_known = not m.exists(osd)
@@ -180,18 +423,24 @@ class Monitor(Dispatcher):
             m.osd_addrs[osd] = msg.addr
             if newly_known:
                 self._crush_add_osd(m, osd, 0x10000)
-            self._osd_addrs[osd] = msg.addr
-            self._failure_reports.pop(osd, None)
-            self._commit(m)
+        with self._lock:
+            self._osd_addrs[msg.osd_id] = msg.addr
+            self._failure_reports.pop(msg.osd_id, None)
+        self._mutate(fn)
 
     def _crush_add_osd(self, m: OSDMap, osd: int, weight: int) -> None:
         root = m.crush.bucket(-1)
+        if root is None:
+            # boot raced the bootstrap commit: create the root here
+            m.crush.add_bucket(
+                make_bucket(-1, CRUSH_BUCKET_STRAW2, 2, [], []))
+            root = m.crush.bucket(-1)
         root.items.append(osd)
         root.item_weights.append(weight)
         root.weight += weight
         m.crush.max_devices = max(m.crush.max_devices, osd + 1)
 
-    def _handle_failure(self, msg: MOSDFailure) -> None:
+    def _do_failure(self, msg: MOSDFailure) -> None:
         need = int(self.ctx.conf.get("mon_osd_min_down_reporters"))
         with self._lock:
             if not self.osdmap.is_up(msg.failed_osd):
@@ -201,12 +450,15 @@ class Monitor(Dispatcher):
             if len(reports) < need:
                 return
             # quorum of reporters: mark down (check_failure analog)
-            m = self.osdmap
-            m.mark_down(msg.failed_osd)
             self._failure_reports.pop(msg.failed_osd, None)
-            self._commit(m)
 
-    # -- command table (MonCommands.h analog) ---------------------------------
+        def fn(m: OSDMap):
+            if not m.is_up(msg.failed_osd):
+                return False
+            m.mark_down(msg.failed_osd)
+        self._mutate(fn)
+
+    # -- command table (MonCommands.h analog; worker thread) ------------------
 
     def handle_command(self, cmd: dict) -> tuple[str, int]:
         import json
@@ -214,6 +466,12 @@ class Monitor(Dispatcher):
         try:
             if prefix == "status":
                 return json.dumps(self.status()), 0
+            if prefix == "quorum_status":
+                return json.dumps({
+                    "quorum": self.quorum(),
+                    "leader": self.elector.leader if self.elector else None,
+                    "election_epoch": self.elector.epoch
+                    if self.elector else 0}), 0
             if prefix == "osd pool create":
                 return self._cmd_pool_create(cmd)
             if prefix == "osd pool set":
@@ -225,13 +483,16 @@ class Monitor(Dispatcher):
             if prefix == "osd in":
                 return self._cmd_osd_weight(int(cmd["id"]), 0x10000)
             if prefix == "osd down":
-                with self._lock:
-                    m = self.osdmap
-                    osd = int(cmd["id"])
-                    if not m.exists(osd):
-                        return f"osd.{osd} does not exist", -2
+                osd = int(cmd["id"])
+                if not self.osdmap.exists(osd):
+                    return f"osd.{osd} does not exist", -2
+
+                def fn(m: OSDMap):
+                    if not m.is_up(osd):
+                        return False
                     m.mark_down(osd)
-                    self._commit(m)
+                if not self._mutate(fn):
+                    return "commit failed", -11
                 return "marked down", 0
             if prefix == "osd getmap":
                 return json.dumps({"epoch": self.osdmap.epoch}), 0
@@ -240,8 +501,9 @@ class Monitor(Dispatcher):
             return f"command failed: {e}", -22
 
     def _cmd_pool_create(self, cmd) -> tuple[str, int]:
-        with self._lock:
-            m = self.osdmap
+        result: list[int] = []
+
+        def fn(m: OSDMap):
             pool_id = max(m.pools, default=0) + 1
             pg_num = int(cmd.get("pg_num",
                                  self.ctx.conf.get("osd_pool_default_pg_num")))
@@ -265,25 +527,28 @@ class Monitor(Dispatcher):
                 min_size=max(1, size - 1) if ptype != POOL_TYPE_ERASURE
                 else int(cmd.get("k", 4)),
                 crush_rule=rule, pg_num=pg_num, ec_profile=profile)
-            self._commit(m)
-            return f"pool {pool_id} created", 0
+            result.append(pool_id)
+        if not self._mutate(fn):
+            return "commit failed", -11
+        return f"pool {result[0]} created", 0
 
     def _cmd_pool_set(self, cmd) -> tuple[str, int]:
-        with self._lock:
-            m = self.osdmap
+        def fn(m: OSDMap):
             pool = m.pools[int(cmd["pool"])]
             setattr(pool, cmd["var"], int(cmd["val"]))
-            self._commit(m)
-            return "set", 0
+        if not self._mutate(fn):
+            return "commit failed", -11
+        return "set", 0
 
     def _cmd_osd_weight(self, osd: int, weight: int) -> tuple[str, int]:
-        with self._lock:
-            m = self.osdmap
-            if not (0 <= osd < m.max_osd):
-                return f"osd.{osd} does not exist", -2
+        if not (0 <= osd < self.osdmap.max_osd):
+            return f"osd.{osd} does not exist", -2
+
+        def fn(m: OSDMap):
             m.osd_weight[osd] = weight
-            self._commit(m)
-            return f"osd.{osd} weight {weight:#x}", 0
+        if not self._mutate(fn):
+            return "commit failed", -11
+        return f"osd.{osd} weight {weight:#x}", 0
 
     def _cmd_tree(self) -> dict:
         m = self.osdmap
@@ -300,6 +565,8 @@ class Monitor(Dispatcher):
             m = self.osdmap
             return {
                 "epoch": m.epoch,
+                "quorum": self.quorum(),
+                "leader": self.elector.leader if self.elector else None,
                 "num_osds": sum(1 for o in range(m.max_osd) if m.exists(o)),
                 "num_up_osds": sum(1 for o in range(m.max_osd)
                                    if m.is_up(o)),

@@ -167,7 +167,10 @@ class OSDDaemon(Dispatcher):
         self.osd_id = osd_id
         self.whoami = EntityName("osd", osd_id)
         self.ctx = ctx or CephTpuContext(f"osd.{osd_id}")
+        #: comma-separated monitor addresses (mon_host); boot/failure
+        #: reports go to every mon — the leader executes, peons ignore
         self.mon_addr = mon_addr
+        self.mon_addrs = [a for a in mon_addr.split(",") if a]
         self.store = create_objectstore(store_type, store_path)
         self.osdmap = OSDMap()
         self._lock = threading.RLock()
@@ -225,11 +228,12 @@ class OSDDaemon(Dispatcher):
         self._load_pgs()
         self.msgr.bind(self._addr)
         self.msgr.start()
-        mon = self.msgr.connect_to(self.mon_addr, EntityName("mon", 0))
-        mon.send_message(MMonSubscribe(name=str(self.whoami),
-                                       addr=self.msgr.my_addr))
-        mon.send_message(MOSDBoot(osd_id=self.osd_id,
-                                  addr=self.msgr.my_addr))
+        for rank, addr in enumerate(self.mon_addrs):
+            mon = self.msgr.connect_to(addr, EntityName("mon", rank))
+            mon.send_message(MMonSubscribe(name=str(self.whoami),
+                                           addr=self.msgr.my_addr))
+            mon.send_message(MOSDBoot(osd_id=self.osd_id,
+                                      addr=self.msgr.my_addr))
         if self._heartbeats:
             self._schedule_heartbeat()
         self._schedule_tick()
@@ -258,12 +262,30 @@ class OSDDaemon(Dispatcher):
     def _tick(self) -> None:
         try:
             now = time.time()
+            self._maybe_reboot()
             with self._lock:
                 pgs = list(self.pgs.values())
             for pg in pgs:
                 self._tick_pg(pg, now)
         finally:
             self._schedule_tick()
+
+    def _maybe_reboot(self) -> None:
+        """Re-send MOSDBoot until the map shows us up at our address —
+        the first boot can race the monitor election/bootstrap
+        (OSD::start_boot retry semantics)."""
+        m = self.osdmap
+        booted = (m.epoch > 0 and m.is_up(self.osd_id)
+                  and self.osd_id < len(m.osd_addrs)
+                  and m.osd_addrs[self.osd_id] == self.msgr.my_addr)
+        if booted:
+            return
+        for rank, addr in enumerate(self.mon_addrs):
+            mon = self.msgr.connect_to(addr, EntityName("mon", rank))
+            mon.send_message(MMonSubscribe(name=str(self.whoami),
+                                           addr=self.msgr.my_addr))
+            mon.send_message(MOSDBoot(osd_id=self.osd_id,
+                                      addr=self.msgr.my_addr))
 
     def _tick_pg(self, pg: PG, now: float) -> None:
         restart = False
@@ -528,13 +550,20 @@ class OSDDaemon(Dispatcher):
 
         old_keys = {PG.log_key(e.version) for e in pg.log.entries}
         to_remove, to_recover = pg.merge_log(entries, local_has)
-        new_keys = {PG.log_key(e.version): PG.encode_entry(e)
-                    for e in pg.log.entries}
         t = Transaction()
         for oid in to_remove:
             t.remove(cid, store_oid(oid))
         t.touch(cid, PG.PGMETA)
-        stale = [k for k in old_keys if k not in new_keys]
+        # only touch the delta: rewriting the whole untrimmed log per
+        # merge would make every map change O(full history)
+        new_keys = {}
+        cur_keys = set()
+        for e in pg.log.entries:
+            lk = PG.log_key(e.version)
+            cur_keys.add(lk)
+            if lk not in old_keys:
+                new_keys[lk] = PG.encode_entry(e)
+        stale = [k for k in old_keys if k not in cur_keys]
         if stale:
             t.omap_rmkeys(cid, PG.PGMETA, stale)
         new_keys["info"] = pg.encode_info()
@@ -812,11 +841,12 @@ class OSDDaemon(Dispatcher):
                 # answers is as failed as one that stopped answering
                 last = self._hb_last.setdefault(peer, now)
                 if now - last > grace:
-                    mon = self.msgr.connect_to(self.mon_addr,
-                                               EntityName("mon", 0))
-                    mon.send_message(MOSDFailure(
-                        reporter=self.osd_id, failed_osd=peer,
-                        failed_for=now - last, epoch=m.epoch))
+                    for rank, addr in enumerate(self.mon_addrs):
+                        mon = self.msgr.connect_to(
+                            addr, EntityName("mon", rank))
+                        mon.send_message(MOSDFailure(
+                            reporter=self.osd_id, failed_osd=peer,
+                            failed_for=now - last, epoch=m.epoch))
         finally:
             self._schedule_heartbeat()
 
@@ -916,10 +946,14 @@ class OSDDaemon(Dispatcher):
                                          pool.is_erasure()):
                 pg.waiting_for_missing.setdefault(msg.oid, []).append(msg)
                 return
-        if pool.is_erasure():
-            self._do_ec_op(msg, pool, pg)
-        else:
-            self._do_replicated_op(msg, pool, pg)
+            # execute under the lock: version allocation + log append +
+            # store apply must be atomic against concurrent dispatch
+            # threads (each connection has its own reader thread) and the
+            # tick/activation requeue paths
+            if pool.is_erasure():
+                self._do_ec_op(msg, pool, pg)
+            else:
+                self._do_replicated_op(msg, pool, pg)
 
     def _blocked_on_recovery(self, pg: PG, oid: str, is_write: bool,
                              ec: bool) -> bool:
@@ -1071,14 +1105,29 @@ class OSDDaemon(Dispatcher):
         # head-check, txn apply and log append must be one atomic step:
         # a concurrent peering merge advancing the head between them would
         # apply the data but trip record()'s ordering assert
+        result = 0
         with self._lock:
             if entry is None or entry.version > pg.log.head:
                 t = Transaction.decode(msg.txn)
                 self.store.apply_transaction(t)
                 if entry is not None:
                     pg.record(entry)
+            elif not self._is_dup_entry(pg, entry):
+                # an old interval's write racing a newer merged history:
+                # the txn was NOT applied, and acking it would let a
+                # deposed primary count a dropped write as committed
+                result = -116  # ESTALE
         msg.connection.send_message(MOSDRepOpReply(
-            reqid=msg.reqid, pgid=msg.pgid, from_osd=self.osd_id, result=0))
+            reqid=msg.reqid, pgid=msg.pgid, from_osd=self.osd_id,
+            result=result))
+
+    @staticmethod
+    def _is_dup_entry(pg: PG, entry: LogEntry) -> bool:
+        """True if this exact entry is already in the log (primary
+        resend), as opposed to a stale-interval write we discarded."""
+        have = pg.log.reqids.get(entry.reqid) if entry.reqid != (0, 0) \
+            else None
+        return have == entry.version
 
     def _handle_rep_reply(self, msg: MOSDRepOpReply) -> None:
         self._ack_shard(msg.reqid, msg.from_osd, msg.result)
@@ -1187,6 +1236,7 @@ class OSDDaemon(Dispatcher):
         pg = self._get_pg(msg.pgid)
         entry = PG.decode_entry(msg.entry) if msg.entry else None
         # atomic head-check + apply + append (see _handle_rep_op)
+        result = 0
         with self._lock:
             if entry is None or entry.version > pg.log.head:
                 t = (Transaction().truncate(cid, oid, 0)
@@ -1200,9 +1250,11 @@ class OSDDaemon(Dispatcher):
                         PG.log_key(entry.version): PG.encode_entry(entry),
                         "info": pg.encode_info()})
                 self.store.apply_transaction(t)
+            elif not self._is_dup_entry(pg, entry):
+                result = -116  # ESTALE: stale-interval shard write dropped
         msg.connection.send_message(MOSDECSubOpWriteReply(
             reqid=msg.reqid, shard=msg.shard, from_osd=self.osd_id,
-            result=0))
+            result=result))
 
     def _handle_ec_write_reply(self, msg: MOSDECSubOpWriteReply) -> None:
         self._ack_shard(msg.reqid, msg.from_osd, msg.result)

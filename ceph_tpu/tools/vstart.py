@@ -17,33 +17,83 @@ from ceph_tpu.osd.daemon import OSDDaemon
 
 
 class MiniCluster:
+    _instances = 0
+
     def __init__(self, n_osds: int = 3, ms_type: str = "async",
                  store_type: str = "memstore", base_path: str = "",
-                 heartbeats: bool = False):
+                 heartbeats: bool = False, n_mons: int = 1):
+        # namespace loopback addresses per cluster: sequential tests reuse
+        # names like "mon.0", and a timer from a dying daemon of the
+        # previous cluster must never reach this one
+        MiniCluster._instances += 1
+        self._ns = f"c{MiniCluster._instances}."
         self.ms_type = ms_type
         self.store_type = store_type
         self.base_path = base_path
         self.heartbeats = heartbeats
-        self.mon: Monitor | None = None
+        self.mons: dict[int, Monitor] = {}
+        self.monmap: list[str] = []
         self.osds: dict[int, OSDDaemon] = {}
         self.clients: list[RadosClient] = []
         self._n_initial = n_osds
+        self._n_mons = n_mons
+
+    @property
+    def mon(self) -> Monitor:
+        """A live monitor (prefer the leader — its map is freshest)."""
+        for m in self.mons.values():
+            if m.is_leader():
+                return m
+        return next(iter(self.mons.values()))
+
+    @property
+    def mon_host(self) -> str:
+        return ",".join(self.monmap)
 
     # -- lifecycle ------------------------------------------------------------
 
     def start(self) -> "MiniCluster":
-        addr = ("127.0.0.1:0" if self.ms_type == "async" else "mon.0")
-        self.mon = Monitor(ms_type=self.ms_type, addr=addr)
-        self.mon.init()
+        # bind all mons first (TCP ports are ephemeral), then hand every
+        # mon the complete monmap so elections can begin
+        for i in range(self._n_mons):
+            self.run_mon(i, defer_monmap=True)
+        self.monmap = [self.mons[i].addr for i in range(self._n_mons)]
+        for m in self.mons.values():
+            m.set_monmap(self.monmap)
         for i in range(self._n_initial):
             self.run_osd(i)
         return self
 
+    def run_mon(self, mon_id: int, defer_monmap: bool = False) -> Monitor:
+        addr = ("127.0.0.1:0" if self.ms_type == "async"
+                else f"{self._ns}mon.{mon_id}")
+        path = (f"{self.base_path}/mon.{mon_id}" if self.base_path else None)
+        mon = Monitor(mon_id=mon_id, ms_type=self.ms_type, addr=addr,
+                      store_path=path)
+        if defer_monmap:
+            mon.init(monmap=[])   # bind only; set_monmap comes later
+        else:
+            # rejoin: reuse the recorded monmap slot (loopback addrs are
+            # stable; TCP rejoin needs the same port, so record it)
+            mon.init(monmap=[])
+            if self.monmap:
+                self.monmap[mon_id] = mon.addr
+                monmap = list(self.monmap)
+                mon.set_monmap(monmap)
+                for other in self.mons.values():
+                    other.monmap[mon_id] = mon.addr
+        self.mons[mon_id] = mon
+        return mon
+
+    def kill_mon(self, mon_id: int) -> None:
+        mon = self.mons.pop(mon_id)
+        mon.shutdown()
+
     def run_osd(self, osd_id: int) -> OSDDaemon:
         addr = (f"127.0.0.1:0" if self.ms_type == "async"
-                else f"osd.{osd_id}")
+                else f"{self._ns}osd.{osd_id}")
         path = (f"{self.base_path}/osd.{osd_id}" if self.base_path else "")
-        osd = OSDDaemon(osd_id, self.mon.addr, store_type=self.store_type,
+        osd = OSDDaemon(osd_id, self.mon_host, store_type=self.store_type,
                         store_path=path, ms_type=self.ms_type, addr=addr,
                         heartbeats=self.heartbeats)
         osd.init()
@@ -56,7 +106,8 @@ class MiniCluster:
         osd.shutdown()
 
     def client(self, timeout: float = 10.0) -> RadosClient:
-        c = RadosClient(self.mon.addr, ms_type=self.ms_type, timeout=timeout)
+        c = RadosClient(self.mon_host, ms_type=self.ms_type,
+                        timeout=timeout)
         c.connect()
         self.clients.append(c)
         return c
@@ -67,8 +118,9 @@ class MiniCluster:
         for osd in list(self.osds.values()):
             osd.shutdown()
         self.osds.clear()
-        if self.mon:
-            self.mon.shutdown()
+        for mon in list(self.mons.values()):
+            mon.shutdown()
+        self.mons.clear()
 
     # -- helpers (ceph-helpers.sh analog) -------------------------------------
 

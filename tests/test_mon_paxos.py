@@ -1,0 +1,137 @@
+"""Multi-monitor consensus: Elector + Paxos (src/mon/Elector.cc,
+src/mon/Paxos.cc semantics) on a 3-mon MiniCluster — leader election,
+commit replication, leader failover, peon command forwarding, and
+rejoin catch-up.
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.tools.vstart import MiniCluster
+
+
+def wait_until(cond, timeout=15.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"never satisfied: {msg}")
+
+
+@pytest.fixture()
+def cluster3():
+    c = MiniCluster(n_osds=3, ms_type="loopback", n_mons=3).start()
+    try:
+        yield c
+    finally:
+        c.stop()
+
+
+def test_lowest_rank_wins_election(cluster3):
+    wait_until(lambda: all(m.elector.leader == 0 and not m.elector.electing
+                           for m in cluster3.mons.values()),
+               msg="mon.0 leads everywhere")
+    assert cluster3.mons[0].is_leader()
+    assert not cluster3.mons[1].is_leader()
+    assert sorted(cluster3.mons[0].quorum()) == [0, 1, 2]
+
+
+def test_commits_replicate_to_all_mons(cluster3):
+    cluster3.wait_for_osd_count(3)
+    client = cluster3.client()
+    pool = cluster3.create_pool(client, pg_num=4, size=3)
+    leader = cluster3.mons[0]
+    wait_until(lambda: all(
+        m.osdmap.epoch == leader.osdmap.epoch
+        and pool in m.osdmap.pools for m in cluster3.mons.values()),
+        msg="peons converge on the leader's map")
+    # the paxos stores hold identical committed tails
+    lcs = {m.paxos.last_committed for m in cluster3.mons.values()}
+    assert len(lcs) == 1
+
+
+def test_command_to_peon_is_forwarded(cluster3):
+    cluster3.wait_for_osd_count(3)
+    from ceph_tpu.client.rados import RadosClient
+    # a client that only knows a peon's address still mutates the map
+    peon_addr = cluster3.mons[1].addr
+    c = RadosClient(peon_addr, ms_type="loopback", timeout=15.0)
+    c.connect()
+    try:
+        res, out = c.mon_command({"prefix": "osd pool create",
+                                  "pg_num": "4", "size": "2"})
+        assert res == 0, out
+        assert "created" in out
+    finally:
+        c.shutdown()
+
+
+def test_leader_death_elects_new_leader_and_commits(cluster3):
+    cluster3.wait_for_osd_count(3)
+    client = cluster3.client(timeout=20.0)
+    pool = cluster3.create_pool(client, pg_num=4, size=3)
+    io = client.open_ioctx(pool)
+    io.write_full("before", b"pre-failover")
+
+    cluster3.kill_mon(0)
+    wait_until(lambda: any(m.is_leader() for m in cluster3.mons.values()),
+               msg="new leader elected")
+    leader = next(m for m in cluster3.mons.values() if m.is_leader())
+    assert leader.mon_id == 1  # lowest surviving rank
+    assert 0 not in leader.quorum()
+
+    # the cluster still commits map changes...
+    res, out = client.mon_command({"prefix": "osd pool create",
+                                   "pg_num": "4", "size": "2"})
+    assert res == 0, out
+    # ...and the data path still works end to end
+    io.write_full("after", b"post-failover")
+    assert io.read("after") == b"post-failover"
+    assert io.read("before") == b"pre-failover"
+
+
+def test_two_mon_deaths_lose_quorum(cluster3):
+    """Majority of the FULL monmap is required: 1 of 3 cannot lead."""
+    cluster3.wait_for_osd_count(3)
+    cluster3.kill_mon(0)
+    cluster3.kill_mon(1)
+    time.sleep(3.0)
+    assert not cluster3.mons[2].is_leader()
+
+
+def test_rejoining_mon_catches_up(cluster3):
+    cluster3.wait_for_osd_count(3)
+    client = cluster3.client(timeout=20.0)
+    cluster3.create_pool(client, pg_num=4, size=3)
+    cluster3.kill_mon(2)
+    # commits happen while mon.2 is gone
+    res, out = client.mon_command({"prefix": "osd pool create",
+                                   "pg_num": "4", "size": "2"})
+    assert res == 0, out
+    leader = cluster3.mons[0]
+    rejoined = cluster3.run_mon(2)
+    wait_until(lambda: rejoined.paxos is not None
+               and rejoined.paxos.last_committed
+               == leader.paxos.last_committed
+               and rejoined.osdmap.epoch == leader.osdmap.epoch,
+               timeout=30.0,
+               msg="rejoined mon catches up on committed maps")
+    assert rejoined.osdmap.pools.keys() == leader.osdmap.pools.keys()
+
+
+def test_failure_reports_reach_new_leader():
+    """OSD heartbeat failure detection works after mon failover."""
+    c = MiniCluster(n_osds=3, ms_type="loopback", n_mons=3,
+                    heartbeats=True).start()
+    try:
+        c.wait_for_osd_count(3)
+        c.kill_mon(0)
+        wait_until(lambda: any(m.is_leader() for m in c.mons.values()),
+                   msg="new leader")
+        c.kill_osd(2)
+        wait_until(lambda: not c.mon.osdmap.is_up(2), timeout=30.0,
+                   msg="osd.2 marked down via the new leader")
+    finally:
+        c.stop()
