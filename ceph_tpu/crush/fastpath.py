@@ -51,7 +51,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ceph_tpu.ops.crush_kernel import is_out, straw2_choose_index
+from ceph_tpu.ops.crush_kernel import is_out
+from ceph_tpu.ops.straw2_u32 import (
+    _ln_f32_error_bound, magic_tables, straw2_choose_index_approx)
 
 from .types import (
     CRUSH_BUCKET_STRAW2,
@@ -161,19 +163,25 @@ def detect(m: CrushMap, ruleno: int) -> FastRule | None:
 # device kernels
 # ---------------------------------------------------------------------------
 
-def _draw_argmax(x, ids, weights, r):
+def _draw_argmax(x, ids, weights, r, magic, off):
     """Straw2 winner position for one r value across the batch.
 
-    x (N,) uint32; ids (S,) shared or (N, S) per-lane rows; weights
-    broadcastable to ids; r scalar uint32.  Returns (N,) positions.
-    straw2_choose_index's jnp.argmax takes the first maximum — exactly the
-    strict-``>`` scan of bucket_straw2_choose (mapper.c:374-380), so
-    truncation ties resolve to the lowest index for free.
+    x (N,) uint32; ids (S,) shared or (N, S) per-lane rows; weights /
+    magic / off broadcastable to ids; r scalar uint32.  Returns (N,)
+    positions.  Runs the u32 magic-division kernel (ops.straw2_u32) —
+    bit-exact against the s64 kernel by exhaustive validation — whose
+    argmin takes the first minimum, exactly the strict-``>`` scan of
+    bucket_straw2_choose (mapper.c:374-380): truncation ties resolve to
+    the lowest index for free.
     """
     idb = ids[None, :] if ids.ndim == 1 else ids
     wb = jnp.broadcast_to(
         weights[None, :] if weights.ndim == 1 else weights, idb.shape)
-    return straw2_choose_index(x, idb, r, wb)
+    mb = jnp.broadcast_to(
+        magic[None, :, :] if magic.ndim == 2 else magic, (*idb.shape, 5))
+    ob = jnp.broadcast_to(
+        off[None, :] if off.ndim == 1 else off, idb.shape)
+    return straw2_choose_index_approx(x, idb, r, wb, mb, ob)
 
 
 def _consume(host_win, leaf_win, leaf_bad, numrep, tries, R, n):
@@ -232,11 +240,50 @@ class FastMapper:
 
     def __init__(self, fr: FastRule):
         self.fr = fr
-        self.root_ids = jnp.asarray(fr.root_ids)
+        _ln_f32_error_bound()   # measure eagerly: must be concrete by
+        self.root_ids = jnp.asarray(fr.root_ids)   # the time jit traces
         self.root_w = jnp.asarray(fr.root_w)
+        rm, ro = magic_tables(fr.root_w)
+        self.root_magic = jnp.asarray(rm)
+        self.root_off = jnp.asarray(ro)
         if fr.leaf_ids is not None:
             self.leaf_ids = jnp.asarray(fr.leaf_ids)
             self.leaf_w = jnp.asarray(fr.leaf_w)
+            lm, lo = magic_tables(fr.leaf_w)
+            self.leaf_magic = jnp.asarray(lm)
+            self.leaf_off = jnp.asarray(lo)
+        # the fused Pallas column kernels (2.5x the XLA path on this
+        # backend); TPU-only — the CPU mesh tests keep the XLA path
+        self._pallas = None
+        if jax.default_backend() == "tpu":
+            try:
+                from ceph_tpu.ops.pallas_straw2 import PallasColumns
+            except ImportError:   # pragma: no cover
+                PallasColumns = None
+            if PallasColumns is not None:
+                # construction failures must surface, not silently
+                # degrade to the slower XLA path
+                self._pallas = PallasColumns(fr)
+
+    def _winners_pallas(self, xs, reweight, R: int):
+        """host_win/leaf_win/leaf_bad via the fused kernels.  Pads the
+        batch to the 128-lane block quantum and returns (N, R) views."""
+        from ceph_tpu.ops.pallas_straw2 import BLOCK
+        n = xs.shape[0]
+        pad = (-n) % BLOCK
+        if pad:
+            xs = jnp.concatenate(
+                [xs, jnp.zeros((pad,), dtype=xs.dtype)])
+        pos, ids, bad = self._pallas.root_columns(xs, reweight, R)
+        if self.fr.kind == "choose_flat":
+            hw = lw = ids.T[:n]
+            lb = bad.T[:n] != 0
+        else:
+            lid, lbad = self._pallas.leaf_columns(xs, pos, reweight, R)
+            hw = ids.T[:n]
+            lw = lid.T[:n]
+            lb = lbad.T[:n] != 0
+        return hw, lw, lb
 
     def _winners(self, xs, reweight, R: int):
         """host_win/leaf_win/leaf_bad for r in [0, R): a fori_loop producing
@@ -251,7 +298,8 @@ class FastMapper:
         def body(i, bufs):
             hw, lw, lb = bufs
             r = i.astype(jnp.uint32)
-            pos = _draw_argmax(xs, self.root_ids, self.root_w, r)
+            pos = _draw_argmax(xs, self.root_ids, self.root_w, r,
+                               self.root_magic, self.root_off)
             first = self.root_ids[pos]                         # (N,)
             if fr.kind == "choose_flat":
                 leaf = first
@@ -263,7 +311,9 @@ class FastMapper:
                     r_leaf = jnp.uint32(0)
                 ids = self.leaf_ids[pos]                       # (N, S)
                 w = self.leaf_w[pos]                           # (N, S)
-                lpos = _draw_argmax(xs, ids, w, r_leaf)
+                lpos = _draw_argmax(xs, ids, w, r_leaf,
+                                    self.leaf_magic[pos],
+                                    self.leaf_off[pos])
                 leaf = jnp.take_along_axis(ids, lpos[:, None], 1)[:, 0]
             bad = is_out(reweight, leaf, xs)
             hw = jax.lax.dynamic_update_slice(hw, first[:, None], (0, i))
@@ -285,11 +335,13 @@ class FastMapper:
             return jnp.full((n, result_max), NONE, dtype=jnp.int32)
         Rf = fr.tries + numrep
         R0 = min(numrep + block, Rf)
-        hw, lw, lb = self._winners(xs, reweight, R0)
+        winners = (self._winners_pallas if self._pallas is not None
+                   else self._winners)
+        hw, lw, lb = winners(xs, reweight, R0)
         out_h, out_l, ovf = _consume(hw, lw, lb, numrep, fr.tries, R0, n)
 
         def slow(_):
-            hw2, lw2, lb2 = self._winners(xs, reweight, Rf)
+            hw2, lw2, lb2 = winners(xs, reweight, Rf)
             oh, ol, _ = _consume(hw2, lw2, lb2, numrep, fr.tries, Rf, n)
             return oh, ol
 

@@ -1,0 +1,480 @@
+"""Fused Pallas TPU kernels for the CRUSH straw2 column draws.
+
+The XLA path (ops.straw2_u32 driven by crush.fastpath) is bit-exact but
+this backend leaves long u32 elementwise chains unfused: a single
+(65536, 256) draw column costs ~25 ms against a ~0.5 ms roofline, with
+hundreds of materialized (N, S) intermediates.  These kernels fuse one
+whole column — rjenkins hash, crush_ln limb pipeline, magic division,
+first-min winner select, and the is_out verdict — into one VMEM-resident
+Pallas program per (r, block) grid step:
+
+  root kernel:  xs block -> winner position/id per r  (+ is_out for flat
+                rules, whose first level already lands on devices)
+  leaf kernel:  root winner position -> the winning host's device row
+                (fetched with an exact f32 one-hot MXU dot — a vectorized
+                row gather the VPU cannot do) -> device winner + is_out
+
+Bit-exactness contract: identical output to ops.straw2_u32 (itself
+validated exhaustively against the s64 kernel and the scalar C-semantics
+oracle).  tests/test_pallas_straw2.py compares both, exhaustively over
+the 16-bit hash domain for the ln/divide pipeline and end-to-end on
+random maps, in interpret mode on CPU and compiled on TPU.
+
+Table lookups ride the MXU as exact one-hot matmuls (8-bit limbs in
+bf16, one-hot 0/1 exact; f32 accumulator sums < 2^15).  The count-
+leading-zeros of the ln normalization uses the f32 exponent field
+(exact: inputs < 2^17 convert exactly).  All element math is u32/i32 —
+no 64-bit emulation anywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+
+# the unrolled R-column kernels build deep expression trees; default
+# CPython recursion limits trip inside jax lowering
+if sys.getrecursionlimit() < 20000:
+    sys.setrecursionlimit(20000)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ceph_tpu.ops.crush_kernel import (
+    _ln_limb_operands_np, hash32_2, hash32_3)
+
+_U32 = jnp.uint32
+_I32 = jnp.int32
+
+#: rows per grid step (TPU blocks need a 128-divisible last dim; VMEM
+#: stays small because table lookups are group-accumulated — see _lookup)
+BLOCK = 128
+
+
+def _bitlen_f32(v):
+    """bit length of v (uint32, v < 2^17) via the f32 exponent field —
+    Mosaic-safe replacement for lax.clz; exact because the convert is."""
+    # Mosaic has no u32->f32 cast; go through i32 (values < 2^17, safe)
+    f = (v | _U32(1)).astype(_I32).astype(jnp.float32)
+    e = (jax.lax.bitcast_convert_type(f, _U32) >> 23) - _U32(127)
+    return e + _U32(1)
+
+
+def _row_lookup(idx, row):
+    """Per-lane table lookup: idx (B, S) i32 with values < S; row (S,)
+    i32 holding the table in its leading lanes.  Lowers to Mosaic's
+    tpu.dynamic_gather (take_along_axis on same-shaped 2-D operands) —
+    a lane shuffle, with none of the one-hot matmul's VMEM or reshape
+    trouble."""
+    x = jnp.broadcast_to(row[None, :], idx.shape)
+    # raw lax.gather with i32 indices: jnp.take_along_axis promotes its
+    # indices to i64 under x64, which Mosaic cannot lower.  These
+    # dimension numbers are exactly the per-lane tpu.dynamic_gather
+    # pattern Mosaic's gather rule recognizes.
+    dnums = jax.lax.GatherDimensionNumbers(
+        offset_dims=(), collapsed_slice_dims=(1,), start_index_map=(1,),
+        operand_batching_dims=(0,), start_indices_batching_dims=(0,))
+    return jax.lax.gather(
+        x, idx[..., None], dnums, slice_sizes=(1, 1),
+        mode=jax.lax.GatherScatterMode.PROMISE_IN_BOUNDS)
+
+
+def _ln_p48_pl(u, rhlh_ref, ll_lo_ref, ll_hi_ref, rh128):
+    """P = 2^48 - crush_ln(u) as (p_hi17, p_lo32) u32 — the Pallas twin
+    of straw2_u32._crush_ln_p48.
+
+    rhlh_ref (13, S): limb j's table for k in [0, 127]; rh128 is the
+    k == 128 row as python constants (tables must fit the S-lane gather
+    width, and the leaf kernel runs at S = 128).  ll_lo/ll_hi (6, S):
+    the 256-entry LL table split at row 128 the same way.
+    """
+    x = u.astype(_U32) + _U32(1)
+    low17 = x & _U32(0x1FFFF)
+    bits = _U32(16) - _bitlen_f32(low17)
+    needs_norm = (x & _U32(0x18000)) == 0
+    xnorm = jnp.where(needs_norm, x << bits, x).astype(_I32)
+    iexpon = jnp.where(needs_norm, _U32(15) - bits, _U32(15)).astype(_I32)
+    idx1 = (xnorm.astype(_U32) >> 8) << 1
+    k = ((idx1 - _U32(256)) >> 1).astype(_I32)
+    k_cap = jnp.minimum(k, _I32(127))
+    is128 = k == _I32(128)
+    rhlh = [jnp.where(is128, _I32(rh128[j]),
+                      _row_lookup(k_cap, rhlh_ref[j, :]))
+            for j in range(13)]
+    acc = jnp.zeros_like(xnorm)
+    for j in range(7):
+        acc = (acc >> 8) + xnorm * rhlh[j]
+    idx2 = acc & _I32(0xFF)
+    lo7 = idx2 & _I32(127)
+    hi_half = idx2 >= _I32(128)
+    ll = [jnp.where(hi_half, _row_lookup(lo7, ll_hi_ref[j, :]),
+                    _row_lookup(lo7, ll_lo_ref[j, :]))
+          for j in range(6)]
+    bj = []
+    carry = jnp.zeros_like(xnorm)
+    for j in range(6):
+        t = rhlh[7 + j] + ll[j] + carry
+        bj.append(t & _I32(0xFF))
+        carry = t >> 8
+    bj.append(carry)
+    v = [((bj[j] >> 4) | ((bj[j + 1] & _I32(0xF)) << 4)) for j in range(6)]
+    v[5] = v[5] + ((iexpon & _I32(0xF)) << 4)
+    ln_lo = (v[0] | (v[1] << 8) | (v[2] << 16)).astype(_U32) \
+        | (v[3].astype(_U32) << 24)
+    ln_hi = (v[4] | (v[5] << 8)).astype(_U32)
+    is_zero = (ln_lo == 0) & (ln_hi == 0)
+    p_lo = (~ln_lo) + _U32(1)
+    carry_in = jnp.where(ln_lo == 0, _U32(1), _U32(0))
+    p_hi = (((~ln_hi) & _U32(0xFFFF)) + carry_in) & _U32(0x1FFFF)
+    p_lo = jnp.where(is_zero, _U32(0), p_lo)
+    p_hi = jnp.where(is_zero, _U32(0x10000), p_hi)
+    return p_hi, p_lo
+
+
+def _magic_div_pl(p_hi, p_lo, magic, off):
+    """floor(P/w) via 16-bit limb magic multiply; magic (5, B, S)? no —
+    magic indexed [j] -> (B, S) planes; off (B, S) i32 in {4,5,6}."""
+    a = [p_lo & _U32(0xFFFF), p_lo >> 16,
+         p_hi & _U32(0xFFFF), p_hi >> 16]
+    prod = []
+    carry = jnp.zeros_like(p_lo)
+    for kcol in range(10):
+        s = carry
+        for i in range(4):
+            j = kcol - i
+            if 0 <= j < 5:
+                s = s + ((a[i] * magic[j]) & _U32(0xFFFF))
+            j2 = kcol - 1 - i
+            if 0 <= j2 < 5:
+                s = s + ((a[i] * magic[j2]) >> 16)
+        prod.append(s & _U32(0xFFFF))
+        carry = s >> 16
+
+    def pick(base):
+        out = prod[4 + base]
+        for o in (5, 6):
+            if o + base < len(prod):
+                out = jnp.where(off == o, prod[o + base], out)
+        return out
+    q_lo = pick(0) | (pick(1) << 16)
+    q_hi = pick(2) | (pick(3) << 16)
+    return q_hi, q_lo
+
+
+def _umin(v, axis, keepdims):
+    """u32 min via the order-preserving signed bias (Mosaic has no
+    unsigned reductions)."""
+    s = (v ^ _U32(0x80000000)).astype(_I32)
+    m = jnp.min(s, axis=axis, keepdims=keepdims)
+    return m.astype(_U32) ^ _U32(0x80000000)
+
+
+def _ult(a, b):
+    """unsigned < via the sign bias (Mosaic lacks unsigned compares)."""
+    return ((a ^ _U32(0x80000000)).astype(_I32)
+            < (b ^ _U32(0x80000000)).astype(_I32))
+
+
+def _first_min(q_hi, q_lo, ids):
+    """Lexicographic first minimum along axis 1: winner q pair, position,
+    id, and the winner one-hot mask (for gathering sibling values)."""
+    b, s = q_hi.shape
+    min_hi = _umin(q_hi, 1, True)
+    on_h = q_hi == min_hi
+    lo_m = jnp.where(on_h, q_lo, _U32(0xFFFFFFFF))
+    min_lo = _umin(lo_m, 1, True)
+    on = on_h & (lo_m == min_lo)
+    # "first index wins": the smallest position among the tied minima
+    # (no cumsum in Mosaic — a masked min over iota does the same)
+    iota = jax.lax.broadcasted_iota(_I32, (b, s), 1)
+    pos_m = jnp.where(on, iota, _I32(2 ** 31 - 1))
+    minpos = jnp.min(pos_m, axis=1, keepdims=True)
+    first = on & (iota == minpos)
+    pos = minpos[:, 0]
+    # dtype pinned: with x64 enabled jnp.sum promotes i32 -> i64,
+    # which Mosaic cannot lower
+    wid = jnp.sum(jnp.where(first, ids, _I32(0)), axis=1, dtype=_I32)
+    return min_hi[:, 0], min_lo[:, 0], pos, wid, first
+
+
+def _is_out_scalar(rw, item, x):
+    """is_out (mapper.c:424-438) for already-gathered reweight values;
+    all (B,) vectors."""
+    keep_full = rw >= _I32(0x10000)
+    zero = rw == 0
+    h = hash32_2(x, item.astype(_U32)) & _U32(0xFFFF)
+    keep_prob = h.astype(_I32) < rw
+    return ~(keep_full | ((~zero) & keep_prob))
+
+
+def _draw_slab(x, ids, wz, magic_planes, off, tabs, r):
+    """One 128-lane slab of a straw2 column: (B,) x, (B, 128) item
+    operands -> winner (q_hi, q_lo, pos, wid, first).  Slabs are 128 wide
+    because tpu.dynamic_gather shuffles within a single vreg."""
+    rhlh_ref, ll_lo_ref, ll_hi_ref, rh128 = tabs
+    u = hash32_3(x[:, None], ids, r) & _U32(0xFFFF)
+    p_hi, p_lo = _ln_p48_pl(u, rhlh_ref, ll_lo_ref, ll_hi_ref, rh128)
+    q_hi, q_lo = _magic_div_pl(p_hi, p_lo, magic_planes, off)
+    bad = wz != 0
+    q_hi = jnp.where(bad, _U32(0xFFFFFFFF), q_hi)
+    q_lo = jnp.where(bad, _U32(0xFFFFFFFF), q_lo)
+    return _first_min(q_hi, q_lo, ids)
+
+
+def _merge_slabs(best, new):
+    """Merge a later slab's winner into the running best: strictly
+    smaller (q_hi, q_lo) wins — ties stay with the earlier slab, whose
+    positions are lower (the first-index rule)."""
+    if best is None:
+        return new
+    bqh, bql, bpos, bwid, brw = best
+    nqh, nql, npos, nwid, nrw = new
+    better = _ult(nqh, bqh) | ((nqh == bqh) & _ult(nql, bql))
+    return (jnp.where(better, nqh, bqh), jnp.where(better, nql, bql),
+            jnp.where(better, npos, bpos), jnp.where(better, nwid, bwid),
+            jnp.where(better, nrw, brw))
+
+
+def _column_over_slabs(x, S, tabs, r, slab_operands, rw_of_slab):
+    """Full-bucket column: iterate 128-wide slabs, merge winners.
+    slab_operands(slab) -> (ids, wz, magic[5], off) as (B, 128) values;
+    rw_of_slab(slab, first) -> (B,) winner reweight (or zeros)."""
+    best = None
+    for slab in range(S // 128):
+        ids, wz, magic, off = slab_operands(slab)
+        qh, ql, pos, wid, first = _draw_slab(x, ids, wz, magic, off,
+                                             tabs, r)
+        rwv = rw_of_slab(slab, first)
+        pos = pos + _I32(slab * 128)
+        best = _merge_slabs(best, (qh, ql, pos, wid, rwv))
+    return best
+
+
+def _store_row(ref, r, value):
+    """Write one (B,) row at dynamic sublane index r of an (R, B) ref."""
+    ref[pl.dslice(r, 1), :] = value[None, :]
+
+
+def _root_kernel(xs_ref, ids_ref, wz_ref, magic_ref, off_ref, rw_ref,
+                 rhlh_ref, ll_lo_ref, ll_hi_ref,
+                 pos_ref, id_ref, bad_ref, *, flat, S, rh128):
+    """Grid (n//B, R): one (block, r) column per step — r rides the grid
+    so the kernel stays small enough for Mosaic to compile quickly."""
+    r = pl.program_id(1)
+    x = xs_ref[0, :]
+    tabs = (rhlh_ref, ll_lo_ref, ll_hi_ref, rh128)
+
+    def operands(slab):
+        sl = slice(slab * 128, (slab + 1) * 128)
+        return (ids_ref[0, sl][None, :], wz_ref[0, sl][None, :],
+                [magic_ref[j, sl][None, :].astype(_U32) for j in range(5)],
+                off_ref[0, sl][None, :])
+
+    def rw_of(slab, first):
+        if not flat:
+            return jnp.zeros((x.shape[0],), dtype=_I32)
+        sl = slice(slab * 128, (slab + 1) * 128)
+        return jnp.sum(jnp.where(first, rw_ref[0, sl][None, :], _I32(0)),
+                       axis=1, dtype=_I32)
+
+    _qh, _ql, pos, wid, rwv = _column_over_slabs(
+        x, S, tabs, r.astype(_U32), operands, rw_of)
+    _store_row(pos_ref, r, pos)
+    _store_row(id_ref, r, wid)
+    if flat:
+        _store_row(bad_ref, r, _is_out_scalar(rwv, wid, x).astype(_I32))
+    else:
+        _store_row(bad_ref, r, jnp.zeros_like(pos))
+
+
+def _leaf_kernel(xs_ref, pos_ref, static_ref, rw_ref,
+                 rhlh_ref, ll_lo_ref, ll_hi_ref,
+                 id_ref, bad_ref, *, H, S, vary_r, rh128):
+    r = pl.program_id(1)
+    if vary_r:
+        r_leaf = (r >> (vary_r - 1)).astype(_U32)
+    else:
+        r_leaf = _U32(0)
+    x = xs_ref[0, :]
+    iota = jax.lax.broadcasted_iota(_I32, (1, H), 1)
+    tabs = (rhlh_ref, ll_lo_ref, ll_hi_ref, rh128)
+    pos = pos_ref[pl.dslice(r, 1), :][0, :]   # this r's root winners
+    # exact f32 one-hot row gather of the winning host's packed
+    # fields: [ids | wz | off | magic0..magic4] (each S wide) + the
+    # reweight row (dynamic) — a vectorized row gather on the MXU
+    oh = jnp.where(pos[:, None] == iota, jnp.float32(1.0),
+                   jnp.float32(0.0))
+    # HIGHEST precision: the default TPU matmul truncates f32 operands
+    # to bf16, mangling ids and 16-bit magic limbs
+    rows = jnp.dot(oh, static_ref[...],
+                   preferred_element_type=jnp.float32,
+                   precision=jax.lax.Precision.HIGHEST)   # (B, 8*S)
+    rwrow = jnp.dot(oh, rw_ref[...],
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST)  # (B, S)
+
+    def operands(slab):
+        sl = slice(slab * 128, (slab + 1) * 128)
+        # f32 -> u32 is an unhandled Mosaic cast; go via i32 (limb
+        # values < 2^16, so fptosi is exact)
+        return (rows[:, sl].astype(_I32),
+                rows[:, S + slab * 128:S + (slab + 1) * 128]
+                .astype(_I32),
+                [rows[:, (3 + j) * S + slab * 128:
+                      (3 + j) * S + (slab + 1) * 128]
+                 .astype(_I32).astype(_U32) for j in range(5)],
+                rows[:, 2 * S + slab * 128:2 * S + (slab + 1) * 128]
+                .astype(_I32))
+
+    def rw_of(slab, first):
+        sl = slice(slab * 128, (slab + 1) * 128)
+        return jnp.sum(
+            jnp.where(first, rwrow[:, sl].astype(_I32), _I32(0)),
+            axis=1, dtype=_I32)
+
+    _qh, _ql, _pos_l, wid, rwv = _column_over_slabs(
+        x, S, tabs, r_leaf, operands, rw_of)
+    _store_row(id_ref, r, wid)
+    _store_row(bad_ref, r, _is_out_scalar(rwv, wid, x).astype(_I32))
+
+
+def _pad_lanes(n: int) -> int:
+    return max(128, -(-n // 128) * 128)
+
+
+@functools.lru_cache(maxsize=None)
+def _ln_tables_rows():
+    """Gather-layout ln tables, one vreg (128 lanes) wide: rhlh rows
+    (13, 128) for k in [0,127] + the k==128 row as python constants; the
+    256-entry LL table split at row 128 into (6, 128) halves."""
+    rhlh, ll = _ln_limb_operands_np()          # (129, 13), (256, 6) bytes
+    rhlh = rhlh.astype(np.int32)
+    ll = ll.astype(np.int32)
+    rh_rows = np.ascontiguousarray(rhlh[:128].T)
+    rh128 = tuple(int(v) for v in rhlh[128])
+    ll_lo = np.ascontiguousarray(ll[:128].T)
+    ll_hi = np.ascontiguousarray(ll[128:].T)
+    return rh_rows, rh128, ll_lo, ll_hi
+
+
+class PallasColumns:
+    """Compiled winner-precompute for one FastRule on the TPU backend.
+
+    Produces (host_win_ids, host_pos, leaf_win, leaf_bad) arrays shaped
+    (R, N) for r in [0, R): drop-in data for fastpath._consume.
+    """
+
+    def __init__(self, fr, interpret: bool = False):
+        from ceph_tpu.ops.straw2_u32 import magic_tables
+        self.fr = fr
+        self.interpret = interpret
+        S = _pad_lanes(len(fr.root_ids))
+        self.S_root = S
+        ids = np.zeros(S, dtype=np.int32)
+        ids[:len(fr.root_ids)] = fr.root_ids
+        w = np.zeros(S, dtype=np.int64)
+        w[:len(fr.root_w)] = fr.root_w
+        limbs, off = magic_tables(w)
+        self.root_ids = jnp.asarray(ids[None, :])
+        self.root_wz = jnp.asarray((w <= 0).astype(np.int32)[None, :])
+        self.root_magic = jnp.asarray(
+            np.ascontiguousarray(limbs.T))            # (5, S)
+        self.root_off = jnp.asarray(off.astype(np.int32)[None, :])
+        rh, self.rh128, ll_lo, ll_hi = _ln_tables_rows()
+        self.tabs = (jnp.asarray(rh), jnp.asarray(ll_lo),
+                     jnp.asarray(ll_hi))
+
+        if fr.leaf_ids is not None:
+            H, S_l = fr.leaf_ids.shape
+            Sp = _pad_lanes(S_l)
+            Hp = _pad_lanes(H)      # the one-hot dot wants 128-multiples
+            self.H = Hp
+            self.S_leaf = Sp
+            lids = np.zeros((Hp, Sp), dtype=np.int64)
+            lids[:H, :S_l] = fr.leaf_ids
+            lw = np.zeros((Hp, Sp), dtype=np.int64)
+            lw[:H, :S_l] = fr.leaf_w
+            l_limbs, l_off = magic_tables(lw)
+            # packed static per-host fields, all exact in f32
+            packed = np.concatenate([
+                lids.astype(np.float32),
+                (lw <= 0).astype(np.float32),
+                l_off.astype(np.float32),
+            ] + [l_limbs[..., j].astype(np.float32) for j in range(5)],
+                axis=1)                                # (Hp, 8*Sp)
+            self.leaf_static = jnp.asarray(packed)
+            self.leaf_ids_np = lids                    # for reweight rows
+
+    @staticmethod
+    def _fullspec(shape):
+        return pl.BlockSpec(shape,
+                            lambda i, r: (jnp.int32(0), jnp.int32(0)),
+                            memory_space=pltpu.VMEM)
+
+    def root_columns(self, xs, reweight, R: int):
+        """xs (N,) uint32 -> (pos, ids, bad) each (R, N) int32.
+        bad is meaningful only for flat rules (devices at level one)."""
+        n = xs.shape[0]
+        S = self.S_root
+        flat = self.fr.kind == "choose_flat"
+        if flat:
+            rw = jnp.asarray(reweight).astype(jnp.int32)[
+                jnp.clip(self.root_ids[0], 0, len(reweight) - 1)][None, :]
+        else:
+            rw = jnp.zeros((1, S), dtype=jnp.int32)
+        B = BLOCK
+        grid = (n // B, R)     # r innermost: output blocks revisited
+        outs = [jax.ShapeDtypeStruct((R, n), jnp.int32) for _ in range(3)]
+        out_specs = [pl.BlockSpec((R, B), lambda i, r: (jnp.int32(0), i))
+                     for _ in range(3)]
+        fs = self._fullspec
+        rh, ll_lo, ll_hi = self.tabs
+        pos, ids, bad = pl.pallas_call(
+            functools.partial(_root_kernel, flat=flat, S=S,
+                              rh128=self.rh128),
+            grid=grid,
+            out_shape=outs,
+            in_specs=[pl.BlockSpec((1, B), lambda i, r: (jnp.int32(0), i)),
+                      fs((1, S)), fs((1, S)), fs((5, S)), fs((1, S)),
+                      fs((1, S)), fs(rh.shape), fs(ll_lo.shape),
+                      fs(ll_hi.shape)],
+            out_specs=out_specs,
+            interpret=self.interpret,
+        )(xs[None, :], self.root_ids, self.root_wz, self.root_magic,
+          self.root_off, rw, rh, ll_lo, ll_hi)
+        return pos, ids, bad
+
+    def leaf_columns(self, xs, root_pos, reweight, R: int):
+        """root winner positions -> (leaf_id, leaf_bad) each (R, N)."""
+        n = xs.shape[0]
+        # reweight row per (host, slot): dynamic, built by XLA per call
+        # (zero-padded slots never win the draw — wz masks them — so
+        # their reweight value is irrelevant)
+        rw_rows = jnp.asarray(reweight).astype(jnp.int32)[
+            jnp.clip(jnp.asarray(self.leaf_ids_np), 0,
+                     len(reweight) - 1)].astype(jnp.float32)
+        B = BLOCK
+        grid = (n // B, R)
+        outs = [jax.ShapeDtypeStruct((R, n), jnp.int32) for _ in range(2)]
+        out_specs = [pl.BlockSpec((R, B), lambda i, r: (jnp.int32(0), i))
+                     for _ in range(2)]
+        fs = self._fullspec
+        rh, ll_lo, ll_hi = self.tabs
+        lid, lbad = pl.pallas_call(
+            functools.partial(_leaf_kernel, H=self.H, S=self.S_leaf,
+                              vary_r=self.fr.vary_r,
+                              rh128=self.rh128),
+            grid=grid,
+            out_shape=outs,
+            in_specs=[pl.BlockSpec((1, B), lambda i, r: (jnp.int32(0), i)),
+                      pl.BlockSpec((R, B), lambda i, r: (jnp.int32(0), i)),
+                      fs(self.leaf_static.shape), fs(rw_rows.shape),
+                      fs(rh.shape), fs(ll_lo.shape), fs(ll_hi.shape)],
+            out_specs=out_specs,
+            interpret=self.interpret,
+        )(xs[None, :], root_pos, self.leaf_static, rw_rows,
+          rh, ll_lo, ll_hi)
+        return lid, lbad

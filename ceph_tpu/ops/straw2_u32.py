@@ -1,0 +1,314 @@
+"""straw2 draws without int64: u32/i32 limb arithmetic + magic division.
+
+The baseline kernel (ops/crush_kernel.straw2_draws) is bit-exact but leans
+on s64 arithmetic — 64-bit emulation on a 32-bit-lane TPU VPU multiplies
+every op, and the s64 `//` with a runtime divisor lowers particularly
+badly.  This module computes the *same* draws (validated exhaustively over
+the full 16-bit hash domain against the s64 kernel) using only u32/i32
+element ops plus the existing exact bf16 one-hot MXU table lookups:
+
+  draw(x, id, r, w) = -floor(P / w),  P = 2^48 - crush_ln(u),  u 16-bit
+  argmax(draw) == argmin(P // w)      (first index on ties, both sides)
+
+* crush_ln runs in 8-bit limbs end to end: the RH/LH/LL table lookups are
+  the same one-hot bf16 matmuls, the u64 wraparound product and the
+  (LH+LL)>>4 recombination become byte-limb carry chains in i32.
+* The division P//w is a Granlund-Montgomery magic multiply: divisors are
+  per-*item* (a few hundred per bucket), so exact (magic, shift) pairs are
+  precomputed host-side with arbitrary-precision ints, shifts rounded up
+  to a whole limb so the kernel never bit-shifts across limbs.  The magic
+  product runs in 16-bit limb partial products (u32-exact).
+* Winner selection is a lexicographic argmin over the (hi, lo) u32 pair.
+
+Semantics preserved from mapper.c: bucket_straw2_choose's strict `>` keeps
+the first maximum (mapper.c:374-380) == first minimum of P//w; zero-weight
+items never win (draw = S64_MIN, here Q = +inf).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ceph_tpu.ops.crush_kernel import (
+    _ln_limb_operands, _onehot_rows, hash32_3)
+
+_U32 = jnp.uint32
+_I32 = jnp.int32
+
+# dividends are < 2^49 (P <= 2^48 inclusive: u == 0 gives crush_ln == 0)
+_NBITS = 49
+
+
+@functools.lru_cache(maxsize=None)
+def _magic_for(w: int) -> tuple[int, int]:
+    """Exact magic (m, shift) with floor(P/w) == (P*m) >> shift for all
+    P < 2^49 (classic round-up method; the error bound is checked, not
+    assumed).  shift is then rounded up to a multiple of 16 by scaling m,
+    so the kernel's "shift" is a pure limb selection."""
+    assert w >= 1
+    p = max(0, w.bit_length() - 1)
+    while True:
+        m = ((1 << (_NBITS + p)) // w) + 1
+        err = m * w - (1 << (_NBITS + p))
+        if 0 < err <= (1 << p):
+            break
+        p += 1
+    shift = _NBITS + p
+    pad = (16 - shift % 16) % 16
+    m <<= pad
+    shift += pad
+    assert m < (1 << 66)
+    return m, shift
+
+
+def magic_tables(weights: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(..., 5) uint32 magic limbs (16-bit each) and (...,) int32 limb
+    offset (shift // 16) for an array of 16.16 weights.  Zero weights get
+    magic 0 (they are masked to +inf draws by the kernel)."""
+    flat = np.asarray(weights, dtype=np.int64).ravel()
+    limbs = np.zeros((flat.size, 5), dtype=np.uint32)
+    offs = np.zeros(flat.size, dtype=np.int32)
+    for i, w in enumerate(flat):
+        if w <= 0:
+            continue
+        m, shift = _magic_for(int(w))
+        if shift // 16 > 6:
+            # the kernels' limb pick covers off in {4,5,6} (shift <= 112
+            # needs product limbs 4..9, exactly what they compute); a
+            # 16.16 weight would need to exceed ~2^47 to get here
+            raise ValueError(
+                f"weight {w:#x} too large for the straw2 magic divide")
+        for j in range(5):
+            limbs[i, j] = (m >> (16 * j)) & 0xFFFF
+        offs[i] = shift // 16
+    return (limbs.reshape(*np.shape(weights), 5),
+            offs.reshape(np.shape(weights)))
+
+
+def _crush_ln_p48(u):
+    """P = 2^48 - crush_ln(u) for u in [0, 2^16), as (p_hi17, p_lo32) u32.
+
+    Follows crush_ln (mapper.c:248-290) with byte-limb carry arithmetic
+    instead of int64 recombination.
+    """
+    x = u.astype(_U32) + _U32(1)          # 1..2^16
+    low17 = x & _U32(0x1FFFF)
+    bitlen = _U32(32) - jax.lax.clz(low17 | _U32(1))
+    bits = _U32(16) - bitlen
+    needs_norm = (x & _U32(0x18000)) == 0
+    xnorm = jnp.where(needs_norm, x << bits, x).astype(_I32)
+    iexpon = jnp.where(needs_norm, _U32(15) - bits, _U32(15)).astype(_I32)
+    idx1 = (xnorm.astype(_U32) >> 8) << 1
+    k = ((idx1 - _U32(256)) >> 1).astype(_I32)
+    rhlh_tab, ll_tab = _ln_limb_operands()
+    rhlh = _onehot_rows(k, 129, rhlh_tab)          # (..., 13) f32 bytes
+    # u64 wraparound product xnorm * RH, byte 6 (bits 48..55): partial
+    # products c_j = xnorm * rh_j < 2^25 (exact in i32), byte-carry chain
+    acc = jnp.zeros_like(xnorm)
+    for j in range(7):
+        c = xnorm * rhlh[..., j].astype(_I32)
+        acc = (acc >> 8) + c
+    idx2 = acc & _I32(0xFF)
+    ll = _onehot_rows(idx2, 256, ll_tab)           # (..., 6) f32 bytes
+    # T = LH + LL in bytes (t_j < 512), carry-normalize; V = T >> 4;
+    # ln = (iexpon << 44) + V  — all staying in 8-bit limbs
+    b = []
+    carry = jnp.zeros_like(xnorm)
+    for j in range(6):
+        s = (rhlh[..., 7 + j].astype(_I32) + ll[..., j].astype(_I32)
+             + carry)
+        b.append(s & _I32(0xFF))
+        carry = s >> 8
+    b.append(carry)                                # b6 <= 1
+    v = [((b[j] >> 4) | ((b[j + 1] & _I32(0xF)) << 4)) for j in range(6)]
+    # add iexpon << 44 into byte 5 bits 4..7 (never carries: ln < 2^48)
+    v[5] = v[5] + ((iexpon & _I32(0xF)) << 4)
+    # ln bytes v0..v5; P = 2^48 - ln.  ln == 0 <=> all bytes zero.
+    ln_lo = (v[0] | (v[1] << 8) | (v[2] << 16)).astype(_U32) \
+        | (v[3].astype(_U32) << 24)
+    ln_hi = (v[4] | (v[5] << 8)).astype(_U32)      # bits 32..47
+    is_zero = (ln_lo == 0) & (ln_hi == 0)
+    # two's complement over 48 bits: P = (~ln + 1) mod 2^48
+    p_lo = (~ln_lo) + _U32(1)
+    # ~ln_lo + 1 wraps (carries into hi) exactly when ln_lo == 0
+    carry_in = jnp.where(ln_lo == 0, _U32(1), _U32(0))
+    p_hi = ((~ln_hi) & _U32(0xFFFF)) + carry_in
+    p_hi = p_hi & _U32(0x1FFFF)
+    # ln == 0: P = 2^48 exactly (bit 48 set, rest zero)
+    p_lo = jnp.where(is_zero, _U32(0), p_lo)
+    p_hi = jnp.where(is_zero, _U32(0x10000), p_hi)
+    return p_hi, p_lo
+
+
+def _magic_divide(p_hi, p_lo, magic, off):
+    """(q_hi, q_lo) = floor(P / w) via the magic multiply.
+
+    p_hi (..., ) u32 17-bit, p_lo u32; magic (..., 5) u32 16-bit limbs;
+    off (...,) i32 in {4, 5, 6} (shift // 16 after limb rounding).
+    Product is 49 + ~66 bits -> 8x16 limbs; Q < 2^49 -> limbs [off..off+3].
+    """
+    a = [p_lo & _U32(0xFFFF), p_lo >> 16,
+         p_hi & _U32(0xFFFF), p_hi >> 16]          # 4x16-bit, a3 <= 1
+    # column accumulation: a naive sum of <= 4 full 16x16 products would
+    # overflow u32, so each product contributes its lo half to column k
+    # and its hi half to column k+1 (column sums then stay < 2^20)
+    prod = []
+    lo_carry = jnp.zeros_like(p_lo)
+    for kcol in range(10):
+        s = lo_carry
+        for i in range(4):
+            j = kcol - i
+            if 0 <= j < 5:
+                s = s + ((a[i] * magic[..., j]) & _U32(0xFFFF))
+            j2 = kcol - 1 - i
+            if 0 <= j2 < 5:
+                s = s + ((a[i] * magic[..., j2]) >> 16)
+        prod.append(s & _U32(0xFFFF))
+        lo_carry = s >> 16
+    # select limbs [off .. off+3] (off in {4,5,6})
+    def pick(base):
+        out = prod[4 + base]
+        for o in (5, 6):
+            if o + base < len(prod):
+                out = jnp.where(off == o, prod[o + base], out)
+        return out
+    q0, q1, q2, q3 = pick(0), pick(1), pick(2), pick(3)
+    q_lo = q0 | (q1 << 16)
+    q_hi = q2 | (q3 << 16)
+    return q_hi, q_lo
+
+
+def straw2_qvals(x, ids, r, weights, magic, off):
+    """Per-item (q_hi, q_lo): P//w for each item; +inf for weight 0.
+
+    x (...,) uint32; ids (S,) or (..., S); r scalar/(...,) uint32;
+    weights broadcastable to ids' shape (only used for the ==0 mask);
+    magic/off from magic_tables(weights).
+    """
+    u = hash32_3(x[..., None], ids, r[..., None] if jnp.ndim(r) else r) \
+        & _U32(0xFFFF)
+    p_hi, p_lo = _crush_ln_p48(u)
+    q_hi, q_lo = _magic_divide(p_hi, p_lo, magic, off)
+    wz = jnp.asarray(weights) <= 0
+    q_hi = jnp.where(wz, _U32(0xFFFFFFFF), q_hi)
+    q_lo = jnp.where(wz, _U32(0xFFFFFFFF), q_lo)
+    return q_hi, q_lo
+
+
+def argmin_lex(q_hi, q_lo):
+    """First index of the lexicographic minimum along the last axis —
+    the first-max-wins rule of bucket_straw2_choose on negated draws."""
+    min_hi = jnp.min(q_hi, axis=-1, keepdims=True)
+    on_hi = q_hi == min_hi
+    lo_m = jnp.where(on_hi, q_lo, _U32(0xFFFFFFFF))
+    min_lo = jnp.min(lo_m, axis=-1, keepdims=True)
+    return jnp.argmax(on_hi & (lo_m == min_lo), axis=-1)
+
+
+def straw2_choose_index_u32(x, ids, r, weights, magic, off):
+    q_hi, q_lo = straw2_qvals(x, ids, r, weights, magic, off)
+    return argmin_lex(q_hi, q_lo)
+
+
+# ---------------------------------------------------------------------------
+# approximate-filter + exact-verify winner selection
+# ---------------------------------------------------------------------------
+#
+# The exact pipeline above prices every item at ~150 u32 ops.  But the
+# winner is almost always obvious: a cheap f32 approximation of the draw
+# with a *certified* error bound narrows each lane to a handful of
+# candidate items; the exact pipeline then runs on just those K items, and
+# a lax.cond falls back to the full exact column in the (measured: never
+# at realistic weights) case where more than K items land inside the
+# error band of the minimum — bit-exactness is unconditional.
+#
+# The ln error bound D is measured EXHAUSTIVELY: crush_ln's domain is
+# exactly the 16-bit hash, so max|f32_approx - exact| over all 65536
+# inputs is a fact, not an estimate (it also absorbs the frozen table
+# deviations).  f32 evaluation is deterministic on device, so the bound
+# holds at runtime.
+
+_K = 4
+
+
+@functools.lru_cache(maxsize=None)
+def _ln_f32_error_bound() -> float:
+    """max over all u in [0, 2^16) of |2^44*log2(u+1) - crush_ln(u)|,
+    evaluated with the same f32 ops the approx path uses."""
+    from ceph_tpu.ops.crush_kernel import crush_ln
+    u = jnp.arange(65536, dtype=jnp.uint32)
+    approx = _ln_f32(u)
+    exact = crush_ln(u).astype(jnp.float32)
+    return float(jnp.max(jnp.abs(approx - exact)))
+
+
+def _ln_f32(u):
+    xf = (u.astype(jnp.float32) + 1.0)
+    return jnp.log2(xf) * np.float32(2.0 ** 44)
+
+
+def straw2_choose_index_approx(x, ids, r, weights, magic, off):
+    """Bit-exact straw2 winner via approx-filter + exact-verify.
+
+    Shapes as straw2_choose_index_u32 (ids (..., S) broadcastable).
+    """
+    ids_b = jnp.broadcast_to(ids, (*x.shape, ids.shape[-1]))
+    S = ids_b.shape[-1]
+    w = jnp.asarray(weights)
+    if S <= _K + 1:
+        # tiny bucket: the exact pipeline on all items is already cheap
+        q_hi, q_lo = straw2_qvals(x, ids_b, r, w, magic, off)
+        return argmin_lex(q_hi, q_lo).astype(jnp.int32)
+    wf = jnp.maximum(w.astype(jnp.float32), 1.0)
+    u = hash32_3(x[..., None], ids_b,
+                 r[..., None] if jnp.ndim(r) else r) & _U32(0xFFFF)
+    D = np.float32(_ln_f32_error_bound())
+    q_approx = (np.float32(2.0 ** 48) - _ln_f32(u)) / wf
+    # margin: ln bound + f32 representation error of P (~2^25 safe) +
+    # relative f32 division error + floor-tie quantization
+    m = ((D + np.float32(2 ** 25)) / wf
+         + q_approx * np.float32(2.0 ** -21) + np.float32(4.0))
+    wz = jnp.asarray(w) <= 0
+    big = np.float32(3.0e38)
+    q_approx = jnp.where(wz, big, q_approx)
+    m = jnp.where(wz, 0.0, m)
+    lo = q_approx - m
+    hi = q_approx + m
+    min_hi = jnp.min(hi, axis=-1, keepdims=True)
+    in_band = lo <= min_hi
+    need_fallback = jnp.any(jnp.sum(in_band, axis=-1) > _K)
+
+    # K smallest lower bounds always contain every in-band item when the
+    # certificate holds
+    _, cand = jax.lax.top_k(-lo, _K)                      # (..., K)
+
+    def exact_on_candidates(_):
+        c_ids = jnp.take_along_axis(ids_b, cand, axis=-1)
+        c_w = jnp.take_along_axis(
+            jnp.broadcast_to(w, ids_b.shape), cand, axis=-1)
+        mg = jnp.broadcast_to(magic, (*ids_b.shape, 5))
+        c_mg = jnp.take_along_axis(
+            mg, cand[..., None], axis=-2)
+        c_off = jnp.take_along_axis(
+            jnp.broadcast_to(off, ids_b.shape), cand, axis=-1)
+        qh, ql = straw2_qvals(x, c_ids, r, c_w, c_mg, c_off)
+        # lexicographic min over (q_hi, q_lo, original index): the floor
+        # tie rule is "first index wins" in ORIGINAL item order
+        min_h = jnp.min(qh, axis=-1, keepdims=True)
+        on_h = qh == min_h
+        ql_m = jnp.where(on_h, ql, _U32(0xFFFFFFFF))
+        min_l = jnp.min(ql_m, axis=-1, keepdims=True)
+        on = on_h & (ql_m == min_l)
+        idx_m = jnp.where(on, cand, jnp.int32(2 ** 31 - 1))
+        return jnp.min(idx_m, axis=-1)
+
+    def exact_full(_):
+        q_hi, q_lo = straw2_qvals(x, ids_b, r, w, magic, off)
+        return argmin_lex(q_hi, q_lo).astype(jnp.int32)
+
+    return jax.lax.cond(need_fallback, exact_full, exact_on_candidates,
+                        None)
