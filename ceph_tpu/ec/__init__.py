@@ -14,6 +14,9 @@ from .base import ErasureCode
 from .registry import ErasureCodePluginRegistry, instance as registry_instance
 from . import jerasure as _jerasure  # noqa: F401  (registers plugins on import)
 from . import isa as _isa  # noqa: F401
+from . import shec as _shec  # noqa: F401
+from . import lrc as _lrc  # noqa: F401
+from . import clay as _clay  # noqa: F401
 
 __all__ = [
     "ErasureCodeInterface",

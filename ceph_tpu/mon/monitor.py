@@ -21,6 +21,7 @@ dispatch thread must stay free to process those very ACCEPT messages.
 
 from __future__ import annotations
 
+import json
 import queue
 import threading
 import time
@@ -511,13 +512,29 @@ class Monitor(Dispatcher):
                      else 1)
             profile = {}
             if ptype == POOL_TYPE_ERASURE:
-                k = int(cmd.get("k", 4))
-                ec_m = int(cmd.get("m", 2))
                 profile = {"plugin": cmd.get("plugin", "jerasure"),
-                           "technique": cmd.get("technique", "reed_sol_van"),
-                           "k": str(k), "m": str(ec_m)}
+                           "k": str(cmd.get("k", 4)),
+                           "m": str(cmd.get("m", 2))}
+                # plugin-specific keys ride through (shec's c, lrc's
+                # mapping/layers, jerasure/isa techniques); non-string
+                # values must be JSON, not python repr
+                for key in ("technique", "c", "mapping", "layers"):
+                    if key in cmd:
+                        v = cmd[key]
+                        profile[key] = (v if isinstance(v, str)
+                                        else json.dumps(v))
+                if profile["plugin"] in ("jerasure", "isa"):
+                    profile.setdefault("technique", "reed_sol_van")
+                # validate the profile NOW (reference: OSDMonitor
+                # get_erasure_code at pool create) and take the true
+                # chunk geometry from the codec — lrc's width comes from
+                # its mapping, not k+m
+                from ceph_tpu.ec import registry_instance
+                codec = registry_instance().factory(
+                    profile["plugin"], dict(profile))
+                size = codec.get_chunk_count()
+                data_chunks = codec.get_data_chunk_count()
                 rule = add_simple_rule(m.crush, -1, 0, "indep")
-                size = k + ec_m
             else:
                 rule = add_simple_rule(m.crush, -1, 0, "firstn")
                 size = int(cmd.get("size",
@@ -525,7 +542,7 @@ class Monitor(Dispatcher):
             m.pools[pool_id] = PGPool(
                 pool_id=pool_id, type=ptype, size=size,
                 min_size=max(1, size - 1) if ptype != POOL_TYPE_ERASURE
-                else int(cmd.get("k", 4)),
+                else data_chunks,
                 crush_rule=rule, pg_num=pg_num, ec_profile=profile)
             result.append(pool_id)
         if not self._mutate(fn):

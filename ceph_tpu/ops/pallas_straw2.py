@@ -135,33 +135,11 @@ def _ln_p48_pl(u, rhlh_ref, ll_lo_ref, ll_hi_ref, rh128):
 
 
 def _magic_div_pl(p_hi, p_lo, magic, off):
-    """floor(P/w) via 16-bit limb magic multiply; magic (5, B, S)? no —
-    magic indexed [j] -> (B, S) planes; off (B, S) i32 in {4,5,6}."""
-    a = [p_lo & _U32(0xFFFF), p_lo >> 16,
-         p_hi & _U32(0xFFFF), p_hi >> 16]
-    prod = []
-    carry = jnp.zeros_like(p_lo)
-    for kcol in range(10):
-        s = carry
-        for i in range(4):
-            j = kcol - i
-            if 0 <= j < 5:
-                s = s + ((a[i] * magic[j]) & _U32(0xFFFF))
-            j2 = kcol - 1 - i
-            if 0 <= j2 < 5:
-                s = s + ((a[i] * magic[j2]) >> 16)
-        prod.append(s & _U32(0xFFFF))
-        carry = s >> 16
-
-    def pick(base):
-        out = prod[4 + base]
-        for o in (5, 6):
-            if o + base < len(prod):
-                out = jnp.where(off == o, prod[o + base], out)
-        return out
-    q_lo = pick(0) | (pick(1) << 16)
-    q_hi = pick(2) | (pick(3) << 16)
-    return q_hi, q_lo
+    """floor(P/w): the shared magic-multiply (straw2_u32) with magic as
+    a list of 5 (B, S) limb planes — one implementation for both the
+    XLA path and these kernels (pure jnp, Mosaic-safe)."""
+    from ceph_tpu.ops.straw2_u32 import magic_divide_planes
+    return magic_divide_planes(p_hi, p_lo, magic, off)
 
 
 def _umin(v, axis, keepdims):

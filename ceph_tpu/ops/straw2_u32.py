@@ -144,12 +144,15 @@ def _crush_ln_p48(u):
     return p_hi, p_lo
 
 
-def _magic_divide(p_hi, p_lo, magic, off):
-    """(q_hi, q_lo) = floor(P / w) via the magic multiply.
+def magic_divide_planes(p_hi, p_lo, magic_planes, off):
+    """(q_hi, q_lo) = floor(P / w) via the magic multiply — the ONE
+    implementation shared by the XLA path and the Pallas kernels (a
+    bit-exactness-critical algorithm must not exist twice).
 
-    p_hi (..., ) u32 17-bit, p_lo u32; magic (..., 5) u32 16-bit limbs;
-    off (...,) i32 in {4, 5, 6} (shift // 16 after limb rounding).
-    Product is 49 + ~66 bits -> 8x16 limbs; Q < 2^49 -> limbs [off..off+3].
+    p_hi (...,) u32 17-bit, p_lo u32; magic_planes: list of 5 u32 arrays
+    (16-bit limbs, broadcastable); off (...,) i32 in {4, 5, 6}
+    (shift // 16 after limb rounding).  Product is 49 + ~66 bits ->
+    10x16 limbs; Q < 2^49 -> limbs [off .. off+3].
     """
     a = [p_lo & _U32(0xFFFF), p_lo >> 16,
          p_hi & _U32(0xFFFF), p_hi >> 16]          # 4x16-bit, a3 <= 1
@@ -163,10 +166,10 @@ def _magic_divide(p_hi, p_lo, magic, off):
         for i in range(4):
             j = kcol - i
             if 0 <= j < 5:
-                s = s + ((a[i] * magic[..., j]) & _U32(0xFFFF))
+                s = s + ((a[i] * magic_planes[j]) & _U32(0xFFFF))
             j2 = kcol - 1 - i
             if 0 <= j2 < 5:
-                s = s + ((a[i] * magic[..., j2]) >> 16)
+                s = s + ((a[i] * magic_planes[j2]) >> 16)
         prod.append(s & _U32(0xFFFF))
         lo_carry = s >> 16
     # select limbs [off .. off+3] (off in {4,5,6})
@@ -180,6 +183,12 @@ def _magic_divide(p_hi, p_lo, magic, off):
     q_lo = q0 | (q1 << 16)
     q_hi = q2 | (q3 << 16)
     return q_hi, q_lo
+
+
+def _magic_divide(p_hi, p_lo, magic, off):
+    """magic as a (..., 5) stacked array (the XLA-path layout)."""
+    return magic_divide_planes(
+        p_hi, p_lo, [magic[..., j] for j in range(5)], off)
 
 
 def straw2_qvals(x, ids, r, weights, magic, off):

@@ -1397,14 +1397,22 @@ class OSDDaemon(Dispatcher):
                 state["size"] = size
                 if len(state["shards"]) < state["k"]:
                     return
-                del self._ec_reads[reqid]
         if stale:
             self._ec_gather(reqid, state)
             return
         codec = self._codec(state["pool"])
-        k = state["k"]
-        have = dict(sorted(state["shards"].items())[:k])
-        decoded = codec.decode(set(range(k)), dict(have))
+        k = codec.get_data_chunk_count()
+        try:
+            decoded = codec.decode(set(range(k)), dict(state["shards"]))
+        except IOError:
+            # non-MDS codecs (shec) cannot decode from every k-subset:
+            # widen the gather by one shard and keep going
+            with self._lock:
+                state["k"] = len(state["shards"]) + 1
+            self._ec_gather(reqid, state)
+            return
+        with self._lock:
+            self._ec_reads.pop(reqid, None)
         data = b"".join(decoded[i] for i in range(k))[:state["size"]]
         if state["kind"] == "client":
             msg = state["msg"]

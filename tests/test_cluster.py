@@ -254,3 +254,23 @@ def test_heartbeat_failure_detection():
         assert not c.mon.osdmap.is_up(victim)
     finally:
         c.stop()
+
+
+def test_shec_and_clay_pools_end_to_end():
+    """The advanced EC plugins drive the same batched OSD data path."""
+    c = MiniCluster(n_osds=7, ms_type="loopback").start()
+    try:
+        c.wait_for_osd_count(7)
+        client = c.client(timeout=20.0)
+        shec = c.create_pool(client, pg_num=4, pool_type="erasure",
+                             plugin="shec", k=4, m=3, c=2)
+        io = client.open_ioctx(shec)
+        io.write_full("s1", b"shec-on-the-cluster" * 50)
+        assert io.read("s1") == b"shec-on-the-cluster" * 50
+        clay = c.create_pool(client, pg_num=4, pool_type="erasure",
+                             plugin="clay", k=4, m=2)
+        io2 = client.open_ioctx(clay)
+        io2.write_full("c1", b"clay-coupled-layers" * 64)
+        assert io2.read("c1") == b"clay-coupled-layers" * 64
+    finally:
+        c.stop()

@@ -1,0 +1,170 @@
+"""shec / lrc / clay plugins: exhaustive erasure sweeps (the pattern of
+src/test/erasure-code/TestErasureCodeIsa.cc:399,525), locality
+(minimum_to_decode cost) checks, and clay's sub-chunk repair bandwidth.
+"""
+
+from itertools import combinations
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import registry_instance
+
+
+def roundtrip(codec, data: bytes, erase: set) -> bool:
+    """encode, drop `erase`, decode everything back; False if the codec
+    reported the pattern unrecoverable."""
+    n = codec.get_chunk_count()
+    enc = codec.encode(set(range(n)), data)
+    chunks = {i: enc[i] for i in range(n) if i not in erase}
+    try:
+        dec = codec.decode(set(range(n)), chunks)
+    except IOError:
+        return False
+    for i in range(n):
+        assert dec[i] == enc[i], f"chunk {i} corrupted (erase={erase})"
+    return True
+
+
+DATA = bytes(np.random.default_rng(7).integers(0, 256, 2500, dtype=np.uint8))
+
+
+class TestShec:
+    @pytest.fixture(scope="class")
+    def codec(self):
+        return registry_instance().factory(
+            "shec", {"k": "4", "m": "3", "c": "2", "runtime": "cpu"})
+
+    def test_single_erasures_exhaustive(self, codec):
+        n = codec.get_chunk_count()
+        for i in range(n):
+            assert roundtrip(codec, DATA, {i})
+
+    def test_double_erasures_exhaustive(self, codec):
+        """c=2: every 2-failure pattern must decode."""
+        n = codec.get_chunk_count()
+        for pair in combinations(range(n), 2):
+            assert roundtrip(codec, DATA, set(pair)), pair
+
+    def test_triple_erasures_report_cleanly(self, codec):
+        """Beyond c the code is probabilistic: either the bytes round
+        trip or the codec raises IOError — never silent corruption
+        (roundtrip asserts equality whenever decode claims success)."""
+        n = codec.get_chunk_count()
+        ok = sum(roundtrip(codec, DATA, set(t))
+                 for t in combinations(range(n), 3))
+        assert ok > 0   # some triples are recoverable
+
+    def test_local_repair_is_cheaper_than_k(self, codec):
+        """The recovery-bandwidth trade: one lost data chunk reads a
+        shingle (l chunks), not k."""
+        n = codec.get_chunk_count()
+        avail = set(range(n)) - {0}
+        need = codec.minimum_to_decode({0}, avail)
+        width = len(codec.window(0))
+        assert len(need) <= width + 1
+        # and the chosen set actually decodes
+        enc = codec.encode(set(range(n)), DATA)
+        dec = codec.decode({0}, {i: enc[i] for i in need})
+        assert dec[0] == enc[0]
+
+    def test_min_to_decode_with_cost(self, codec):
+        n = codec.get_chunk_count()
+        avail = {i: 1 for i in range(n) if i != 1}
+        chosen, cost = codec.minimum_to_decode_with_cost({1}, avail)
+        assert cost == len(chosen)
+
+
+class TestLrc:
+    @pytest.fixture(scope="class")
+    def codec(self):
+        # global layout: [c D D D c D D D]: two local groups, each a
+        # jerasure k=3 m=1 layer (the reference's canonical example)
+        import json
+        layers = json.dumps([
+            ["cDDD____", {"plugin": "jerasure", "technique": "reed_sol_van"}],
+            ["____cDDD", {"plugin": "jerasure", "technique": "reed_sol_van"}],
+        ])
+        return registry_instance().factory(
+            "lrc", {"mapping": "_DDD_DDD", "layers": layers,
+                    "runtime": "cpu"})
+
+    def test_geometry(self, codec):
+        assert codec.get_chunk_count() == 8
+        assert codec.get_data_chunk_count() == 6
+
+    def test_single_erasures_exhaustive(self, codec):
+        for i in range(8):
+            assert roundtrip(codec, DATA, {i})
+
+    def test_local_repair_stays_in_group(self, codec):
+        """Losing a chunk of group 0 must not read group 1 — the whole
+        point of locality."""
+        avail = set(range(8)) - {1}
+        need = codec.minimum_to_decode({1}, avail)
+        assert need <= {0, 2, 3}, need
+
+    def test_one_per_group_recovers(self, codec):
+        assert roundtrip(codec, DATA, {1, 5})
+
+    def test_two_in_one_group_fails_cleanly(self, codec):
+        assert not roundtrip(codec, DATA, {1, 2})
+
+    def test_decode_concat_roundtrip(self, codec):
+        n = codec.get_chunk_count()
+        enc = codec.encode(set(range(n)), DATA)
+        out = codec.decode_concat({i: enc[i] for i in range(n) if i != 2})
+        assert out[:len(DATA)] == DATA
+
+
+class TestClay:
+    @pytest.fixture(scope="class", params=[(4, 2), (2, 2), (4, 4)])
+    def codec(self, request):
+        k, m = request.param
+        return registry_instance().factory(
+            "clay", {"k": str(k), "m": str(m), "runtime": "cpu"})
+
+    def test_sub_chunk_count(self, codec):
+        q, t = codec.q, codec.t
+        assert codec.get_sub_chunk_count() == q ** t
+        assert q * t == codec.k + codec.m
+
+    def test_single_erasures_exhaustive(self, codec):
+        n = codec.get_chunk_count()
+        for i in range(n):
+            assert roundtrip(codec, DATA, {i})
+
+    def test_m_erasures_exhaustive(self, codec):
+        """MDS: every m-failure pattern decodes."""
+        n = codec.get_chunk_count()
+        for combo in combinations(range(n), codec.m):
+            assert roundtrip(codec, DATA, set(combo)), combo
+
+    def test_systematic(self, codec):
+        """Data chunks concatenate back to the input (systematic code)."""
+        n = codec.get_chunk_count()
+        enc = codec.encode(set(range(n)), DATA)
+        joined = b"".join(enc[i] for i in range(codec.k))
+        assert joined[:len(DATA)] == DATA
+
+    def test_repair_bandwidth_optimal(self, codec):
+        """Single-node repair reads alpha/q sub-chunks per helper and
+        reconstructs the exact chunk — the MSR property the sub-chunk
+        interface exists for."""
+        n = codec.get_chunk_count()
+        alpha = codec.get_sub_chunk_count()
+        enc = codec.encode(set(range(n)), DATA)
+        planes = codec._planes()
+        for lost in range(n):
+            sub_idx = codec.repair_subchunks(lost)
+            assert len(sub_idx) == alpha // codec.q
+            helper_subchunks = {}
+            for i in range(n):
+                if i == lost:
+                    continue
+                arr = np.frombuffer(enc[i], dtype=np.uint8)
+                per = codec._split(arr)
+                helper_subchunks[i] = {
+                    planes[si]: per[planes[si]] for si in sub_idx}
+            rebuilt = codec.repair(lost, helper_subchunks)
+            assert rebuilt == enc[lost], f"node {lost}"
