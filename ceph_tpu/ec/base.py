@@ -32,6 +32,11 @@ class ErasureCode(ErasureCodeInterface):
     (ErasureCodeIsaTableCache analog) — lives here.
     """
 
+    #: MDS matrix codecs with batched encode_chunks/decode_chunks can be
+    #: laid out striped for range rmw (ECUtil stripe math); non-MDS or
+    #: layered codecs fall back to whole-object writes
+    supports_rmw_striping = True
+
     #: profile keys consumed by init (reference: parse() per plugin)
     _PROFILE_KEYS = ("k", "m", "technique", "runtime", "plugin",
                      "crush-failure-domain", "crush-root",

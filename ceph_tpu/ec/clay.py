@@ -51,6 +51,8 @@ def _mul(coef: int, arr: np.ndarray) -> np.ndarray:
 
 
 class ErasureCodeClay(ErasureCode):
+    supports_rmw_striping = False
+
     def __init__(self):
         super().__init__()
         self.q = 0

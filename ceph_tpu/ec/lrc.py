@@ -55,6 +55,8 @@ class _Layer:
 class ErasureCodeLrc(ErasureCodeInterface):
     """Interface-level plugin (not a matrix code itself: the layers are)."""
 
+    supports_rmw_striping = False
+
     def __init__(self):
         self.mapping = ""
         self.layers: list[_Layer] = []

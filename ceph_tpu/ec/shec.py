@@ -70,6 +70,8 @@ def _gf_solve(a: np.ndarray, b: np.ndarray):
 class ErasureCodeShec(ErasureCode):
     _PROFILE_KEYS = ErasureCode._PROFILE_KEYS + ("c",)
 
+    supports_rmw_striping = False
+
     def __init__(self):
         super().__init__()
         self.c = 0

@@ -176,7 +176,9 @@ class MOSDECSubOpWrite(Message):
     def __init__(self, reqid: tuple[int, int] = (0, 0),
                  pgid: tuple[int, int] = (0, 0), oid: str = "",
                  shard: int = 0, chunk: bytes = b"", epoch: int = 0,
-                 obj_size: int = 0, entry: bytes = b""):
+                 obj_size: int = 0, entry: bytes = b"",
+                 offset: int = 0, shard_len: int = 0,
+                 truncate: bool = True):
         super().__init__()
         self.reqid = reqid
         self.pgid = pgid
@@ -186,13 +188,19 @@ class MOSDECSubOpWrite(Message):
         self.epoch = epoch
         self.obj_size = obj_size  # full (pre-encode) object size
         self.entry = entry        # encoded pg LogEntry (v3+)
+        # v4: ranged stripe writes (ECBackend rmw pipeline)
+        self.offset = offset      # byte offset within the shard object
+        self.shard_len = shard_len  # full shard length after this write
+        self.truncate = truncate  # True = replace the shard wholesale
 
     def encode_payload(self, enc):
-        enc.versioned(3, 1, lambda e: (
+        enc.versioned(4, 1, lambda e: (
             e.u64(self.reqid[0]), e.u64(self.reqid[1]),
             _enc_pgid(e, self.pgid), e.str(self.oid), e.u8(self.shard),
             e.bytes(self.chunk), e.u32(self.epoch), e.u64(self.obj_size),
-            e.bytes(self.entry)))
+            e.bytes(self.entry),
+            e.u64(self.offset), e.u64(self.shard_len),
+            e.u8(1 if self.truncate else 0)))
 
     def decode_payload(self, dec, version):
         def body(d, v):
@@ -206,7 +214,11 @@ class MOSDECSubOpWrite(Message):
                 self.obj_size = d.u64()
             if v >= 3:
                 self.entry = d.bytes()
-        dec.versioned(3, body)
+            if v >= 4:
+                self.offset = d.u64()
+                self.shard_len = d.u64()
+                self.truncate = d.u8() != 0
+        dec.versioned(4, body)
 
 
 @register_message

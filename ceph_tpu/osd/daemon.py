@@ -63,6 +63,8 @@ from ceph_tpu.osd.pg import (
     PeerState, PGInfo, STATE_ACTIVE, STATE_GETINFO, STATE_GETLOG,
     STATE_INACTIVE, STATE_RECOVERING, STATE_REPLICA)
 
+import numpy as np
+
 
 @register_message
 class MOSDPGPull(Message):
@@ -960,7 +962,7 @@ class OSDDaemon(Dispatcher):
         """Block ops on objects still being recovered
         (PrimaryLogPG objects_blocked_on_recovery semantics)."""
         with self._lock:
-            if oid in pg.missing or oid in pg.recovering:
+            if oid in pg.missing or oid in pg.recovering or oid in pg.rmw:
                 return True
             if is_write or ec:
                 return any(oid in ps.missing for ps in pg.peers.values())
@@ -1159,89 +1161,255 @@ class OSDDaemon(Dispatcher):
                 self._codecs[pool.pool_id] = c
             return c
 
-    def _do_ec_op(self, msg: MOSDOp, pool, pg: PG) -> None:
-        up = pg.up
-        codec = self._codec(pool)
+    def _ec_stripe_info(self, codec, pool):
+        """StripeInfo for MDS matrix codecs; None = whole-object layout
+        (shec/lrc/clay encode through their own bespoke paths).  The
+        stripe unit rounds up to the codec's per-chunk alignment quantum
+        — bitmatrix techniques need chunk % w == 0."""
+        if not getattr(codec, "supports_rmw_striping", False):
+            return None
+        from ceph_tpu.osd.ec_util import StripeInfo
         k = codec.get_data_chunk_count()
+        su = int(pool.ec_profile.get("stripe_unit", 4096))
+        quantum = max(1, codec.get_alignment() // k)
+        su = -(-su // quantum) * quantum
+        return StripeInfo(k, su)
+
+    def _ec_encode_object(self, codec, si, data: bytes) -> dict[int, bytes]:
+        """Full object -> {shard: shard bytes}.  Striped pools lay shard
+        s out as column s of every stripe, with ALL stripes encoded in
+        one batched device call (the ECUtil::encode batch point)."""
         n = codec.get_chunk_count()
+        if si is None:
+            return codec.encode(set(range(n)), data)
+        stripes = si.split(np.frombuffer(data, dtype=np.uint8))
+        parity = np.asarray(codec.encode_chunks(stripes))
+        full = np.concatenate([stripes, parity], axis=1)   # (S, n, su)
+        return {s: si.shard_column(full, s).tobytes() for s in range(n)}
+
+    def _do_ec_op(self, msg: MOSDOp, pool, pg: PG) -> None:
         cid = self._pg_cid(pg.pgid)
         for op in msg.ops:
-            if op.op == OP_WRITEFULL:
-                reqid = (msg.client_id, msg.tid)
-                if self._dedup_resend(pg, reqid, msg):
-                    return
-                self.perf.inc("op_w")
-                shard_osds = {s: up[s] for s in range(min(n, len(up)))
-                              if up[s] != CEPH_NOSD}
-                if len(shard_osds) < max(k, pool.min_size):
-                    # below min_size the write could never be re-read;
-                    # block it (PrimaryLogPG checks acting >= min_size)
-                    self._reply_err(msg, -11)
-                    return
-                chunks = codec.encode(set(range(n)), op.data)
-                self.perf.inc("ec_encode_stripes")
-                reply = MOSDOpReply(tid=msg.tid, result=0,
-                                    epoch=self.osdmap.epoch)
-                waiting = set()
-                size_attr = str(len(op.data)).encode()
-                meta_t = Transaction()
-                entry = self._log_write(pg, meta_t, msg.oid,
-                                        is_delete=False, reqid=reqid)
-                entry_blob = PG.encode_entry(entry)
-                v_attr = enc_version(entry.version)
-                for shard, osd in shard_osds.items():
-                    if osd == self.osd_id:
-                        t = (Transaction()
-                             .truncate(cid, f"{msg.oid}:{shard}", 0)
-                             .write(cid, f"{msg.oid}:{shard}", 0,
-                                    chunks[shard])
-                             .setattr(cid, f"{msg.oid}:{shard}", "size",
-                                      size_attr)
-                             .setattr(cid, f"{msg.oid}:{shard}", "_v",
-                                      v_attr))
-                        t.ops.extend(meta_t.ops)
-                        self.store.apply_transaction(t)
-                    else:
-                        waiting.add(osd)
-                with self._lock:
-                    if waiting:
-                        self._in_flight[reqid] = _InFlight(
-                            msg, set(waiting), reply)
-                for shard, osd in shard_osds.items():
-                    if osd == self.osd_id:
-                        continue
-                    con = self._osd_con(osd)
-                    if con is None:
-                        self._ack_shard(reqid, osd, -107)
-                        continue
-                    con.send_message(MOSDECSubOpWrite(
-                        reqid=reqid, pgid=msg.pgid,
-                        oid=f"{msg.oid}:{shard}",
-                        shard=shard, chunk=chunks[shard],
-                        epoch=self.osdmap.epoch,
-                        obj_size=len(op.data),
-                        entry=entry_blob))
-                if not waiting:
-                    msg.connection.send_message(reply)
-            elif op.op == OP_READ:
+            if op.op in (OP_WRITE, OP_WRITEFULL):
+                self._ec_write_op(msg, pool, pg, op)
+                return
+            if op.op == OP_READ:
                 self.perf.inc("op_r")
-                self._start_ec_read(msg, pool, up, cid)
+                self._start_ec_read(msg, pool, pg.up, cid, op)
             else:
                 self._reply_err(msg, -22)
                 return
+
+    def _ec_write_op(self, msg: MOSDOp, pool, pg: PG, op) -> None:
+        """ECBackend::submit_transaction -> start_rmw: full writes encode
+        directly; partial writes first reconstruct the object (internal
+        gather), overlay, then re-encode only the affected stripes."""
+        codec = self._codec(pool)
+        k = codec.get_data_chunk_count()
+        n = codec.get_chunk_count()
+        reqid = (msg.client_id, msg.tid)
+        if self._dedup_resend(pg, reqid, msg):
+            return
+        up = pg.up
+        shard_osds = {s: up[s] for s in range(min(n, len(up)))
+                      if up[s] != CEPH_NOSD}
+        if len(shard_osds) < max(k, pool.min_size):
+            # below min_size the write could never be re-read
+            self._reply_err(msg, -11)
+            return
+        self.perf.inc("op_w")
+        existing = pg.log.index.get(msg.oid)
+        fresh = existing is None or existing.is_delete()
+        if op.op == OP_WRITEFULL or (fresh and op.offset == 0):
+            self._ec_apply_write(msg, pool, pg, op, old_data=b"",
+                                 replace=True)
+            return
+        if fresh:
+            # partial write to a fresh object: zero-fill base
+            self._ec_apply_write(msg, pool, pg, op, old_data=b"",
+                                 replace=False)
+            return
+        # read-modify-write: gather the current object, then continue.
+        # The object is gated (pg.rmw) so overlapping ops queue.
+        with self._lock:
+            pg.rmw.add(msg.oid)
+            self._recover_tid += 1
+            gid = (RECOVERY_CLIENT + self.osd_id, self._recover_tid)
+        si = self._ec_stripe_info(codec, pool)
+        cand = self._ec_shard_candidates(pg, n)
+        state = {"kind": "rmw", "msg": msg, "op": op, "pool": pool,
+                 "pgid": msg.pgid, "oid": msg.oid, "si": si,
+                 "shards": {}, "k": k, "active": set(), "cand": cand,
+                 "need": existing.version}
+        with self._lock:
+            self._ec_reads[gid] = state
+        self._ec_gather(gid, state)
+
+    def _ec_rmw_ready(self, state: dict, old_data: bytes) -> None:
+        """The rmw gather finished: overlay and apply.  Runs on a reply
+        dispatch thread, so the apply (version allocation + log append +
+        store commit) must retake the PG lock _handle_op holds on the
+        direct path."""
+        msg = state["msg"]
+        pg = self.pgs.get(state["pgid"])
+        if pg is None:
+            return
+        with self._lock:
+            self._ec_apply_write(msg, state["pool"], pg, state["op"],
+                                 old_data=old_data, replace=False)
+            pg.rmw.discard(msg.oid)
+            waiting = pg.waiting_for_missing.pop(msg.oid, [])
+        for m in waiting:
+            self._handle_op(m)
+
+    def _ec_apply_write(self, msg: MOSDOp, pool, pg: PG, op,
+                        old_data: bytes, replace: bool) -> None:
+        codec = self._codec(pool)
+        n = codec.get_chunk_count()
+        k = codec.get_data_chunk_count()
+        si = self._ec_stripe_info(codec, pool)
+        cid = self._pg_cid(pg.pgid)
+        reqid = (msg.client_id, msg.tid)
+        up = pg.up
+        shard_osds = {s: up[s] for s in range(min(n, len(up)))
+                      if up[s] != CEPH_NOSD}
+        # the rmw gather is asynchronous: re-check the min_size gate
+        # against the CURRENT up set before committing anything
+        if len(shard_osds) < max(k, pool.min_size):
+            self._reply_err(msg, -11)
+            return
+        if replace:
+            data = bytes(op.data)
+        else:
+            new_size = max(len(old_data), op.offset + len(op.data))
+            buf = bytearray(new_size)
+            buf[:len(old_data)] = old_data
+            buf[op.offset:op.offset + len(op.data)] = op.data
+            data = bytes(buf)
+        self.perf.inc("ec_encode_stripes")
+        if si is not None and not replace and old_data:
+            # ranged: encode ONLY the affected stripes (the batched
+            # device call covers [s0, s1)); only those columns travel
+            # on growth s1 from stripe_range already equals
+            # object_stripes(new_size): new_size = offset + len there
+            s0, s1 = si.stripe_range(op.offset, len(op.data))
+            window = np.frombuffer(
+                data[s0 * si.width:s1 * si.width], dtype=np.uint8)
+            stripes = si.split(window)
+            parity = np.asarray(codec.encode_chunks(stripes))
+            full = np.concatenate([stripes, parity], axis=1)
+            sub = {s: si.shard_column(full, s).tobytes()
+                   for s in range(n)}
+            shard_off = s0 * si.su
+            shard_len = si.shard_len(len(data))
+            truncate = False
+        else:
+            shards = self._ec_encode_object(codec, si, data)
+            shard_off, truncate = 0, True
+            shard_len = len(next(iter(shards.values()))) if shards else 0
+            sub = shards
+        reply = MOSDOpReply(tid=msg.tid, result=0, epoch=self.osdmap.epoch)
+        meta_t = Transaction()
+        entry = self._log_write(pg, meta_t, msg.oid, is_delete=False,
+                                reqid=reqid)
+        entry_blob = PG.encode_entry(entry)
+        v_attr = enc_version(entry.version)
+        size_attr = str(len(data)).encode()
+        from ceph_tpu.osd.ec_util import HashInfo
+        waiting = set()
+        for shard, osd in shard_osds.items():
+            if osd != self.osd_id:
+                waiting.add(osd)
+                continue
+            soid = f"{msg.oid}:{shard}"
+            new_shard, base_ok = self._patched_shard(
+                pg.pgid, msg.oid, shard, sub[shard], shard_off,
+                shard_len, truncate)
+            t = (Transaction().truncate(cid, soid, 0)
+                 .write(cid, soid, 0, new_shard)
+                 .setattr(cid, soid, "size", size_attr)
+                 .setattr(cid, soid, "_v", v_attr))
+            if base_ok:
+                t.setattr(cid, soid, "hinfo", HashInfo.compute(new_shard))
+            # corrupt base: keep the stale hinfo so the shard stays
+            # detected-bad until the scheduled repair rewrites it —
+            # rehashing would launder the corruption
+            t.ops.extend(meta_t.ops)
+            self.store.apply_transaction(t)
+        with self._lock:
+            if waiting:
+                self._in_flight[reqid] = _InFlight(msg, set(waiting),
+                                                   reply)
+        for shard, osd in shard_osds.items():
+            if osd == self.osd_id:
+                continue
+            con = self._osd_con(osd)
+            if con is None:
+                self._ack_shard(reqid, osd, -107)
+                continue
+            con.send_message(MOSDECSubOpWrite(
+                reqid=reqid, pgid=msg.pgid, oid=f"{msg.oid}:{shard}",
+                shard=shard, chunk=sub[shard], epoch=self.osdmap.epoch,
+                obj_size=len(data), entry=entry_blob,
+                offset=shard_off, shard_len=shard_len,
+                truncate=truncate))
+        if not waiting:
+            msg.connection.send_message(reply)
+
+    def _patched_shard(self, pgid, oid: str, shard: int, chunk: bytes,
+                       offset: int, shard_len: int,
+                       truncate: bool) -> tuple[bytes, bool]:
+        """(full post-write shard bytes, base_ok).  Whole replacements
+        are the chunk itself; ranged writes patch the existing shard in
+        memory.  The base is checksum-verified first: patching corrupt
+        bytes and rehashing would give the corruption a valid hinfo, so
+        a bad base keeps its stale hash (stays detected) and a repair is
+        scheduled."""
+        from ceph_tpu.osd.ec_util import HashInfo
+        if truncate:
+            return chunk, True
+        cid = f"{pgid[0]}.{pgid[1]}"
+        soid = f"{oid}:{shard}"
+        try:
+            old = self.store.read(cid, soid)
+        except KeyError:
+            old = b""
+        base_ok = HashInfo.matches(old, self._getattr_safe(cid, soid,
+                                                           "hinfo"))
+        if not base_ok:
+            dout("osd", 1, "osd.%d patching corrupt shard %s/%s; "
+                 "scheduling repair", self.osd_id, cid, soid)
+            pg = self.pgs.get(pgid)
+            if pg is not None:
+                self._recover_ec_object(pg, oid, dest_osd=self.osd_id,
+                                        dest_shard=shard)
+        buf = bytearray(max(shard_len, len(old)))
+        buf[:len(old)] = old
+        buf[offset:offset + len(chunk)] = chunk
+        out = bytes(buf[:shard_len]) if shard_len else bytes(buf)
+        return out, base_ok
 
     def _handle_ec_write(self, msg: MOSDECSubOpWrite) -> None:
         oid = msg.oid
         cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
         pg = self._get_pg(msg.pgid)
         entry = PG.decode_entry(msg.entry) if msg.entry else None
+        from ceph_tpu.osd.ec_util import HashInfo
         # atomic head-check + apply + append (see _handle_rep_op)
         result = 0
+        logical, _, shard_s = oid.rpartition(":")
         with self._lock:
             if entry is None or entry.version > pg.log.head:
+                new_shard, base_ok = self._patched_shard(
+                    msg.pgid, logical, int(shard_s), msg.chunk,
+                    msg.offset, msg.shard_len, msg.truncate)
                 t = (Transaction().truncate(cid, oid, 0)
-                     .write(cid, oid, 0, msg.chunk)
-                     .setattr(cid, oid, "size", str(msg.obj_size).encode()))
+                     .write(cid, oid, 0, new_shard)
+                     .setattr(cid, oid, "size",
+                              str(msg.obj_size).encode()))
+                if base_ok:
+                    t.setattr(cid, oid, "hinfo",
+                              HashInfo.compute(new_shard))
                 if entry is not None:
                     t.setattr(cid, oid, "_v", enc_version(entry.version))
                     t.touch(cid, PG.PGMETA)
@@ -1259,8 +1427,10 @@ class OSDDaemon(Dispatcher):
     def _handle_ec_write_reply(self, msg: MOSDECSubOpWriteReply) -> None:
         self._ack_shard(msg.reqid, msg.from_osd, msg.result)
 
-    def _start_ec_read(self, msg: MOSDOp, pool, up, cid: str) -> None:
-        """objects_read_and_reconstruct analog: gather k shards, decode."""
+    def _start_ec_read(self, msg: MOSDOp, pool, up, cid: str,
+                       op=None) -> None:
+        """objects_read_and_reconstruct analog: gather k shards, decode.
+        op carries the byte range (range reads slice the decode)."""
         codec = self._codec(pool)
         k = codec.get_data_chunk_count()
         n = codec.get_chunk_count()
@@ -1276,6 +1446,8 @@ class OSDDaemon(Dispatcher):
         entry = pg.log.index.get(msg.oid) if pg is not None else None
         state = {"kind": "client", "msg": msg, "pool": pool,
                  "pgid": msg.pgid, "oid": msg.oid,
+                 "off": op.offset if op is not None else 0,
+                 "len": op.length if op is not None else 0,
                  # the logged version pins the stripe: past-interval
                  # holders may serve stale chunks that must not be mixed
                  # into the decode
@@ -1330,33 +1502,54 @@ class OSDDaemon(Dispatcher):
         con.send_message(MOSDECSubOpRead(
             reqid=reqid, pgid=pgid, oid=oid, shard=shard))
 
-    def _ec_read_local(self, reqid, oid: str, cid: str, shard) -> None:
+    def _read_shard_verified(self, pgid, oid: str, shard):
+        """(chunk, size, ver) of a local shard, or None on absence OR a
+        HashInfo checksum mismatch — a corrupt shard is as good as
+        missing, and a repair reconstruct is scheduled (ECUtil HashInfo
+        semantics)."""
+        from ceph_tpu.osd.ec_util import HashInfo
+        cid = f"{pgid[0]}.{pgid[1]}"
+        soid = f"{oid}:{shard}"
         try:
-            chunk = self.store.read(cid, f"{oid}:{shard}")
-            size = int(self.store.getattr(cid, f"{oid}:{shard}", "size"))
-            ver = dec_version(self._getattr_safe(cid, f"{oid}:{shard}",
-                                                 "_v")) or EVERSION_ZERO
+            chunk = self.store.read(cid, soid)
+            size = int(self.store.getattr(cid, soid, "size"))
         except (KeyError, TypeError):
+            return None
+        hinfo = self._getattr_safe(cid, soid, "hinfo")
+        if not HashInfo.matches(chunk, hinfo):
+            dout("osd", 1, "osd.%d shard %s/%s failed checksum; "
+                 "scheduling repair", self.osd_id, cid, soid)
+            pg = self.pgs.get(pgid)
+            if pg is not None:
+                self._recover_ec_object(pg, oid, dest_osd=self.osd_id,
+                                        dest_shard=shard)
+            return None
+        ver = dec_version(self._getattr_safe(cid, soid, "_v")) \
+            or EVERSION_ZERO
+        return chunk, size, ver
+
+    def _ec_read_local(self, reqid, oid: str, cid: str, shard) -> None:
+        state = self._ec_reads.get(reqid)
+        pgid = state["pgid"] if state else tuple(
+            int(x) for x in cid.split("."))
+        got = self._read_shard_verified(pgid, oid, shard)
+        if got is None:
             self._ec_read_failed(reqid, shard)
             return
-        self._ec_read_done(reqid, shard, chunk, size, ver)
+        self._ec_read_done(reqid, shard, *got)
 
     def _handle_ec_read(self, msg: MOSDECSubOpRead) -> None:
-        cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
-        try:
-            chunk = self.store.read(cid, f"{msg.oid}:{msg.shard}")
-            size = int(self.store.getattr(cid, f"{msg.oid}:{msg.shard}",
-                                          "size"))
-            ver = dec_version(self._getattr_safe(
-                cid, f"{msg.oid}:{msg.shard}", "_v")) or EVERSION_ZERO
-            result = 0
-        except (KeyError, TypeError):
-            chunk, size, ver, result = b"", 0, EVERSION_ZERO, -2
+        got = self._read_shard_verified(msg.pgid, msg.oid, msg.shard)
+        if got is None:
+            msg.connection.send_message(MOSDECSubOpReadReply(
+                reqid=msg.reqid, shard=msg.shard, from_osd=self.osd_id,
+                result=-2, chunk=b""))
+            return
+        chunk, size, ver = got
         msg.connection.send_message(MOSDECSubOpReadReply(
             reqid=msg.reqid, shard=msg.shard, from_osd=self.osd_id,
-            result=result, ver=ver,
-            chunk=chunk + size.to_bytes(8, "little") if result == 0
-            else b""))
+            result=0, ver=ver,
+            chunk=chunk + size.to_bytes(8, "little")))
 
     def _handle_ec_read_reply(self, msg: MOSDECSubOpReadReply) -> None:
         if msg.result != 0:
@@ -1377,11 +1570,17 @@ class OSDDaemon(Dispatcher):
     def _ec_read_give_up(self, state: dict) -> None:
         if state["kind"] == "client":
             self._reply_err(state["msg"], -5)
-        else:
-            pg = self.pgs.get(state["pgid"])
+            return
+        pg = self.pgs.get(state["pgid"])
+        if state["kind"] == "rmw":
             if pg is not None:
                 with self._lock:
-                    pg.recovering.pop(state["oid"], None)
+                    pg.rmw.discard(state["oid"])
+            self._reply_err(state["msg"], -5)
+            return
+        if pg is not None:
+            with self._lock:
+                pg.recovering.pop(state["oid"], None)
 
     def _ec_read_done(self, reqid, shard: int, chunk: bytes,
                       size: int, ver) -> None:
@@ -1400,10 +1599,8 @@ class OSDDaemon(Dispatcher):
         if stale:
             self._ec_gather(reqid, state)
             return
-        codec = self._codec(state["pool"])
-        k = codec.get_data_chunk_count()
         try:
-            decoded = codec.decode(set(range(k)), dict(state["shards"]))
+            data = self._ec_decode_state(state)
         except IOError:
             # non-MDS codecs (shec) cannot decode from every k-subset:
             # widen the gather by one shard and keep going
@@ -1413,14 +1610,53 @@ class OSDDaemon(Dispatcher):
             return
         with self._lock:
             self._ec_reads.pop(reqid, None)
-        data = b"".join(decoded[i] for i in range(k))[:state["size"]]
         if state["kind"] == "client":
             msg = state["msg"]
+            off = state.get("off", 0)
+            length = state.get("len", 0)
+            data = data[off:off + length] if length else data[off:]
             msg.connection.send_message(MOSDOpReply(
                 tid=msg.tid, result=0, epoch=self.osdmap.epoch,
-                ops=[OSDOpField(OP_READ, 0, len(data), data)]))
+                ops=[OSDOpField(OP_READ, off, len(data), data)]))
+            return
+        if state["kind"] == "rmw":
+            self._ec_rmw_ready(state, data)
             return
         self._ec_recover_done(state, data)
+
+    def _ec_decode_state(self, state: dict) -> bytes:
+        """Gathered shards -> full object bytes.  Striped pools decode
+        all stripes in one batched device call; whole-object pools go
+        through the codec's own decode."""
+        pool = state["pool"]
+        codec = self._codec(pool)
+        k = codec.get_data_chunk_count()
+        si = self._ec_stripe_info(codec, pool)
+        size = state["size"]
+        shards = state["shards"]
+        if si is None:
+            decoded = codec.decode(set(range(k)), dict(shards))
+            return b"".join(decoded[i] for i in range(k))[:size]
+        shard_len = si.shard_len(size)
+        chosen = sorted(shards)[:k]
+        cols = []
+        for s in chosen:
+            b = shards[s]
+            if len(b) < shard_len:    # short shard: zero-extend
+                b = b + bytes(shard_len - len(b))
+            cols.append(np.frombuffer(b[:shard_len], dtype=np.uint8)
+                        .reshape(-1, si.su))
+        arr = np.stack(cols, axis=1)             # (S, k, su)
+        targets = [d for d in range(k) if d not in set(chosen)]
+        stripes = np.zeros((arr.shape[0], k, si.su), dtype=np.uint8)
+        for i, s in enumerate(chosen):
+            if s < k:
+                stripes[:, s, :] = arr[:, i, :]
+        if targets:
+            rec = np.asarray(codec.decode_chunks(chosen, arr, targets))
+            for idx, d in enumerate(targets):
+                stripes[:, d, :] = rec[:, idx, :]
+        return si.join(stripes).tobytes()[:size]
 
     def _ec_recover_done(self, state: dict, data: bytes) -> None:
         """Reconstructed the full object: re-encode and deliver the
@@ -1431,11 +1667,13 @@ class OSDDaemon(Dispatcher):
         need = state["need"]
         dest_shard = state["dest_shard"]
         codec = self._codec(pool)
-        n = codec.get_chunk_count()
-        chunks = codec.encode(set(range(n)), data)
+        si = self._ec_stripe_info(codec, pool)
+        chunks = self._ec_encode_object(codec, si, data)
         cid = f"{pgid[0]}.{pgid[1]}"
         shard_oid = f"{oid}:{dest_shard}"
-        attrs = {"size": str(len(data)).encode(), "_v": enc_version(need)}
+        from ceph_tpu.osd.ec_util import HashInfo
+        attrs = {"size": str(len(data)).encode(), "_v": enc_version(need),
+                 "hinfo": HashInfo.compute(chunks[dest_shard])}
         pg = self.pgs.get(pgid)
         if state["dest_osd"] == self.osd_id:
             t = (Transaction().truncate(cid, shard_oid, 0)

@@ -274,3 +274,95 @@ def test_shec_and_clay_pools_end_to_end():
         assert io2.read("c1") == b"clay-coupled-layers" * 64
     finally:
         c.stop()
+
+
+def test_ec_partial_write_rmw(cluster):
+    """OP_WRITE at arbitrary offsets on an EC pool round-trips through
+    the stripe-aligned read-modify-write pipeline (ECBackend start_rmw)."""
+    client = cluster.client()
+    pool = cluster.create_pool(client, pg_num=4, pool_type="erasure",
+                               k=2, m=1)
+    io = client.open_ioctx(pool)
+    base = bytearray(b"A" * 20000)
+    io.write_full("rmw", bytes(base))
+    # overwrite a range crossing stripe boundaries (stripe_unit 4096,
+    # width 8192)
+    io.write("rmw", b"B" * 5000, offset=6000)
+    base[6000:11000] = b"B" * 5000
+    assert io.read("rmw") == bytes(base)
+    # extend past the end (object grows, new stripes appear)
+    io.write("rmw", b"C" * 7000, offset=19000)
+    base = base[:19000] + b"C" * 7000
+    assert io.read("rmw") == bytes(base)
+    # partial write to a fresh object (zero-filled head)
+    io.write("rmw2", b"D" * 100, offset=9000)
+    got = io.read("rmw2")
+    assert got[:9000] == bytes(9000) and got[9000:] == b"D" * 100
+
+
+def test_ec_range_read(cluster):
+    client = cluster.client()
+    pool = cluster.create_pool(client, pg_num=4, pool_type="erasure",
+                               k=2, m=1)
+    io = client.open_ioctx(pool)
+    payload = bytes(range(256)) * 64          # 16 KiB, 2 stripes
+    io.write_full("rr", payload)
+    assert io.read("rr", length=100, offset=5000) == payload[5000:5100]
+    assert io.read("rr", length=0, offset=9000) == payload[9000:]
+
+
+def test_ec_corrupt_shard_detected_and_reconstructed(cluster):
+    """A flipped byte in a stored shard fails the HashInfo checksum: the
+    read reconstructs from the other shards and a repair rewrites the
+    bad copy (ECUtil HashInfo semantics)."""
+    client = cluster.client()
+    pool = cluster.create_pool(client, pg_num=4, pool_type="erasure",
+                               k=2, m=1)
+    io = client.open_ioctx(pool)
+    payload = b"integrity-matters" * 400
+    io.write_full("crc", payload)
+    time.sleep(0.2)
+    # find a stored shard and flip a byte behind the OSD's back
+    from ceph_tpu.client.rados import ceph_str_hash_rjenkins
+    from ceph_tpu.osd.osdmap import pg_to_pgid
+    m = cluster.mon.osdmap
+    pg = pg_to_pgid(ceph_str_hash_rjenkins("crc"), m.pools[pool].pg_num)
+    up, _p, _a, _ap = m.pg_to_up_acting_osds(pool, pg)
+    victim = cluster.osds[up[0]]
+    cid = f"{pool}.{pg}"
+    blob = bytearray(victim.store.read(cid, "crc:0"))
+    blob[7] ^= 0xFF
+    from ceph_tpu.objectstore import Transaction
+    t = Transaction().truncate(cid, "crc:0", 0).write(cid, "crc:0", 0,
+                                                      bytes(blob))
+    victim.store.apply_transaction(t)   # corrupt WITHOUT updating hinfo
+    # the read must still return correct bytes (reconstructed)
+    assert io.read("crc") == payload
+    # and the repair eventually rewrites the shard with a valid checksum
+    from ceph_tpu.osd.ec_util import HashInfo
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        cur = victim.store.read(cid, "crc:0")
+        hinfo = victim.store.getattr(cid, "crc:0", "hinfo")
+        if HashInfo.matches(cur, hinfo) and cur != bytes(blob):
+            break
+        time.sleep(0.1)
+    cur = victim.store.read(cid, "crc:0")
+    assert HashInfo.matches(cur, victim.store.getattr(cid, "crc:0",
+                                                      "hinfo"))
+    assert cur != bytes(blob), "corrupt shard never repaired"
+
+
+def test_ec_bitmatrix_technique_pool(cluster):
+    """Bitmatrix techniques need chunk % w == 0: the stripe unit rounds
+    up to the codec's alignment quantum (w=7 for liberation)."""
+    client = cluster.client()
+    pool = cluster.create_pool(client, pg_num=4, pool_type="erasure",
+                               k=2, m=2, technique="liberation")
+    io = client.open_ioctx(pool)
+    payload = b"w-aligned-stripes" * 700
+    io.write_full("lb", payload)
+    assert io.read("lb") == payload
+    io.write("lb", b"Z" * 3000, offset=5000)
+    want = payload[:5000] + b"Z" * 3000 + payload[8000:]
+    assert io.read("lb") == want
