@@ -539,10 +539,19 @@ class Monitor(Dispatcher):
                 rule = add_simple_rule(m.crush, -1, 0, "firstn")
                 size = int(cmd.get("size",
                                    self.ctx.conf.get("osd_pool_default_size")))
+            if "min_size" in cmd:
+                min_size = int(cmd["min_size"])
+            elif ptype == POOL_TYPE_ERASURE:
+                # k+1, not k: an EC write acked at exactly k live shards
+                # has zero redundancy margin — one more store loss is
+                # data loss (the thrasher caught this; real deployments
+                # default min_size = k+1 for the same reason)
+                min_size = min(data_chunks + 1, size)
+            else:
+                min_size = max(1, size - 1)
             m.pools[pool_id] = PGPool(
                 pool_id=pool_id, type=ptype, size=size,
-                min_size=max(1, size - 1) if ptype != POOL_TYPE_ERASURE
-                else data_chunks,
+                min_size=min_size,
                 crush_rule=rule, pg_num=pg_num, ec_profile=profile)
             result.append(pool_id)
         if not self._mutate(fn):

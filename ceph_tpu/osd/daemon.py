@@ -267,6 +267,17 @@ class OSDDaemon(Dispatcher):
             self._maybe_reboot()
             with self._lock:
                 pgs = list(self.pgs.values())
+                # rmw gathers have no client resend to rescue them: a
+                # lost shard-read reply would wedge the object behind
+                # pg.rmw forever — time them out here
+                stuck_rmw = [
+                    (gid, st) for gid, st in self._ec_reads.items()
+                    if st["kind"] == "rmw"
+                    and now - st.get("started", now) > 8.0]
+                for gid, _st in stuck_rmw:
+                    self._ec_reads.pop(gid, None)
+            for _gid, st in stuck_rmw:
+                self._ec_read_give_up(st)
             for pg in pgs:
                 self._tick_pg(pg, now)
         finally:
@@ -421,6 +432,7 @@ class OSDDaemon(Dispatcher):
             pg.peering_started = time.time()
             pg.peers = {}
             pg.recovering.clear()
+            pg.rmw.clear()   # interval change: in-flight rmw gathers die
             # ops queued against the old interval: requeue for re-check
             # after this round settles (clients also resend on map change)
             for ops in pg.waiting_for_missing.values():
@@ -987,6 +999,18 @@ class OSDDaemon(Dispatcher):
             tid=msg.tid, result=0, epoch=self.osdmap.epoch))
         return True
 
+    def _stale_retry(self, pg: PG, msg: MOSDOp) -> bool:
+        """An op the client has ALREADY MOVED PAST: its tid is older
+        than the object's newest logged op from the same client.  A
+        timed-out-and-abandoned write can stay queued (peering,
+        recovery gates) and land after a newer acked write — executing
+        it would roll the object back under an acked state.  Drop it
+        (Objecter per-object submission ordering, enforced OSD-side)."""
+        last = pg.log.index.get(msg.oid)
+        return (last is not None
+                and last.reqid[0] == msg.client_id
+                and msg.tid < last.reqid[1])
+
     def _log_write(self, pg: PG, t: Transaction, oid: str, is_delete: bool,
                    reqid) -> LogEntry:
         """Allocate a version, build the log entry, and fold the log append
@@ -1067,6 +1091,9 @@ class OSDDaemon(Dispatcher):
             return
         # write path: dedup, log, local commit, replica fan-out (issue_repop)
         if self._dedup_resend(pg, reqid, msg):
+            return
+        if self._stale_retry(pg, msg):
+            self._reply_err(msg, -125)   # ECANCELED: superseded op
             return
         self.perf.inc("op_w")
         t0 = time.time()
@@ -1175,17 +1202,26 @@ class OSDDaemon(Dispatcher):
         su = -(-su // quantum) * quantum
         return StripeInfo(k, su)
 
-    def _ec_encode_object(self, codec, si, data: bytes) -> dict[int, bytes]:
-        """Full object -> {shard: shard bytes}.  Striped pools lay shard
-        s out as column s of every stripe, with ALL stripes encoded in
-        one batched device call (the ECUtil::encode batch point)."""
+    @staticmethod
+    def _ec_encode_window(codec, si, data: bytes, s0: int,
+                          s1: int) -> dict[int, bytes]:
+        """Encode stripes [s0, s1) of `data` in one batched device call
+        (the ECUtil::encode batch point): {shard: column bytes}."""
         n = codec.get_chunk_count()
-        if si is None:
-            return codec.encode(set(range(n)), data)
-        stripes = si.split(np.frombuffer(data, dtype=np.uint8))
+        window = np.frombuffer(data[s0 * si.width:s1 * si.width],
+                               dtype=np.uint8)
+        stripes = si.split(window)
         parity = np.asarray(codec.encode_chunks(stripes))
         full = np.concatenate([stripes, parity], axis=1)   # (S, n, su)
         return {s: si.shard_column(full, s).tobytes() for s in range(n)}
+
+    def _ec_encode_object(self, codec, si, data: bytes) -> dict[int, bytes]:
+        """Full object -> {shard: shard bytes}."""
+        n = codec.get_chunk_count()
+        if si is None:
+            return codec.encode(set(range(n)), data)
+        return self._ec_encode_window(codec, si, data, 0,
+                                      si.object_stripes(len(data)))
 
     def _do_ec_op(self, msg: MOSDOp, pool, pg: PG) -> None:
         cid = self._pg_cid(pg.pgid)
@@ -1209,6 +1245,9 @@ class OSDDaemon(Dispatcher):
         n = codec.get_chunk_count()
         reqid = (msg.client_id, msg.tid)
         if self._dedup_resend(pg, reqid, msg):
+            return
+        if self._stale_retry(pg, msg):
+            self._reply_err(msg, -125)   # ECANCELED: superseded op
             return
         up = pg.up
         shard_osds = {s: up[s] for s in range(min(n, len(up)))
@@ -1240,7 +1279,8 @@ class OSDDaemon(Dispatcher):
         state = {"kind": "rmw", "msg": msg, "op": op, "pool": pool,
                  "pgid": msg.pgid, "oid": msg.oid, "si": si,
                  "shards": {}, "k": k, "active": set(), "cand": cand,
-                 "need": existing.version}
+                 "need": existing.version, "started": time.time(),
+                 "gid": gid}
         with self._lock:
             self._ec_reads[gid] = state
         self._ec_gather(gid, state)
@@ -1293,13 +1333,7 @@ class OSDDaemon(Dispatcher):
             # on growth s1 from stripe_range already equals
             # object_stripes(new_size): new_size = offset + len there
             s0, s1 = si.stripe_range(op.offset, len(op.data))
-            window = np.frombuffer(
-                data[s0 * si.width:s1 * si.width], dtype=np.uint8)
-            stripes = si.split(window)
-            parity = np.asarray(codec.encode_chunks(stripes))
-            full = np.concatenate([stripes, parity], axis=1)
-            sub = {s: si.shard_column(full, s).tobytes()
-                   for s in range(n)}
+            sub = self._ec_encode_window(codec, si, data, s0, s1)
             shard_off = s0 * si.su
             shard_len = si.shard_len(len(data))
             truncate = False
@@ -1324,16 +1358,19 @@ class OSDDaemon(Dispatcher):
             soid = f"{msg.oid}:{shard}"
             new_shard, base_ok = self._patched_shard(
                 pg.pgid, msg.oid, shard, sub[shard], shard_off,
-                shard_len, truncate)
-            t = (Transaction().truncate(cid, soid, 0)
+                shard_len, truncate,
+                expected_prior=entry.prior_version)
+            t = Transaction()
+            if base_ok:
+                (t.truncate(cid, soid, 0)
                  .write(cid, soid, 0, new_shard)
                  .setattr(cid, soid, "size", size_attr)
-                 .setattr(cid, soid, "_v", v_attr))
-            if base_ok:
-                t.setattr(cid, soid, "hinfo", HashInfo.compute(new_shard))
-            # corrupt base: keep the stale hinfo so the shard stays
-            # detected-bad until the scheduled repair rewrites it —
-            # rehashing would launder the corruption
+                 .setattr(cid, soid, "_v", v_attr)
+                 .setattr(cid, soid, "hinfo",
+                          HashInfo.compute(new_shard)))
+            # unusable base: the shard stays untouched with its stale
+            # version/hash (detected-bad everywhere) until the scheduled
+            # repair rewrites it; only the log entry lands now
             t.ops.extend(meta_t.ops)
             self.store.apply_transaction(t)
         with self._lock:
@@ -1357,14 +1394,16 @@ class OSDDaemon(Dispatcher):
             msg.connection.send_message(reply)
 
     def _patched_shard(self, pgid, oid: str, shard: int, chunk: bytes,
-                       offset: int, shard_len: int,
-                       truncate: bool) -> tuple[bytes, bool]:
+                       offset: int, shard_len: int, truncate: bool,
+                       expected_prior=None) -> tuple[bytes, bool]:
         """(full post-write shard bytes, base_ok).  Whole replacements
         are the chunk itself; ranged writes patch the existing shard in
-        memory.  The base is checksum-verified first: patching corrupt
-        bytes and rehashing would give the corruption a valid hinfo, so
-        a bad base keeps its stale hash (stays detected) and a repair is
-        scheduled."""
+        memory — but ONLY onto a trustworthy base: the old bytes must
+        pass their checksum AND sit at the write's prior_version (a
+        shard that silently missed an intermediate write must not be
+        patched into mixed-version content with a fresh valid hash).
+        A bad base is left untouched — its stale version/hash keep it
+        detected-bad in every gather — and a repair is scheduled."""
         from ceph_tpu.osd.ec_util import HashInfo
         if truncate:
             return chunk, True
@@ -1376,18 +1415,23 @@ class OSDDaemon(Dispatcher):
             old = b""
         base_ok = HashInfo.matches(old, self._getattr_safe(cid, soid,
                                                            "hinfo"))
+        if base_ok and expected_prior is not None:
+            have = dec_version(self._getattr_safe(cid, soid, "_v"))
+            base_ok = have == expected_prior
         if not base_ok:
-            dout("osd", 1, "osd.%d patching corrupt shard %s/%s; "
-                 "scheduling repair", self.osd_id, cid, soid)
+            dout("osd", 1, "osd.%d shard %s/%s base unusable for ranged "
+                 "write (corrupt or missed a prior write); scheduling "
+                 "repair", self.osd_id, cid, soid)
             pg = self.pgs.get(pgid)
             if pg is not None:
                 self._recover_ec_object(pg, oid, dest_osd=self.osd_id,
                                         dest_shard=shard)
+            return old, False
         buf = bytearray(max(shard_len, len(old)))
         buf[:len(old)] = old
         buf[offset:offset + len(chunk)] = chunk
         out = bytes(buf[:shard_len]) if shard_len else bytes(buf)
-        return out, base_ok
+        return out, True
 
     def _handle_ec_write(self, msg: MOSDECSubOpWrite) -> None:
         oid = msg.oid
@@ -1402,16 +1446,21 @@ class OSDDaemon(Dispatcher):
             if entry is None or entry.version > pg.log.head:
                 new_shard, base_ok = self._patched_shard(
                     msg.pgid, logical, int(shard_s), msg.chunk,
-                    msg.offset, msg.shard_len, msg.truncate)
-                t = (Transaction().truncate(cid, oid, 0)
+                    msg.offset, msg.shard_len, msg.truncate,
+                    expected_prior=(entry.prior_version
+                                    if entry is not None else None))
+                t = Transaction()
+                if base_ok:
+                    (t.truncate(cid, oid, 0)
                      .write(cid, oid, 0, new_shard)
                      .setattr(cid, oid, "size",
-                              str(msg.obj_size).encode()))
-                if base_ok:
-                    t.setattr(cid, oid, "hinfo",
-                              HashInfo.compute(new_shard))
+                              str(msg.obj_size).encode())
+                     .setattr(cid, oid, "hinfo",
+                              HashInfo.compute(new_shard)))
+                    if entry is not None:
+                        t.setattr(cid, oid, "_v",
+                                  enc_version(entry.version))
                 if entry is not None:
-                    t.setattr(cid, oid, "_v", enc_version(entry.version))
                     t.touch(cid, PG.PGMETA)
                     pg.record(entry)
                     t.omap_setkeys(cid, PG.PGMETA, {
@@ -1430,7 +1479,9 @@ class OSDDaemon(Dispatcher):
     def _start_ec_read(self, msg: MOSDOp, pool, up, cid: str,
                        op=None) -> None:
         """objects_read_and_reconstruct analog: gather k shards, decode.
-        op carries the byte range (range reads slice the decode)."""
+        op carries the byte range; today full shards travel and the
+        whole object decodes before slicing (ranged shard reads over
+        the wire are a known optimization, not yet done)."""
         codec = self._codec(pool)
         k = codec.get_data_chunk_count()
         n = codec.get_chunk_count()

@@ -1,0 +1,243 @@
+"""Thrasher — randomized fault injection under load
+(qa/tasks/ceph_manager.py:98 Thrasher analog).
+
+Drives a MiniCluster with a mixed replicated + EC workload while
+randomly killing/reviving OSDs and marking them out/in.  The workload
+tracks every ACKED write; during the storm reads may time out or return
+stale-epoch errors (retried), but an acked object must NEVER read back
+wrong bytes, and after the storm ends and the cluster heals, every
+acked object must be present and correct — the durability contract the
+reference earns with teuthology.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from ceph_tpu.tools.vstart import MiniCluster
+
+
+class Workload(threading.Thread):
+    """Continuous write/read/delete mix against one pool."""
+
+    def __init__(self, cluster: MiniCluster, pool: int, prefix: str,
+                 rng: random.Random, payload_scale: int = 2000):
+        super().__init__(daemon=True)
+        self.cluster = cluster
+        self.pool = pool
+        self.prefix = prefix
+        self.rng = rng
+        self.payload_scale = payload_scale
+        self.acked: dict[str, bytes | None] = {}  # None = deleted
+        #: full submission history per object (a timed-out write is
+        #: unacked but MAY land — reads returning any value at or after
+        #: the last acked submission are correct rados semantics)
+        self.submitted: dict[str, list[bytes | None]] = {}
+        self.acked_idx: dict[str, int] = {}
+        self.corruptions: list[str] = []
+        self.ops = 0
+        self.errors = 0
+        self._halt = threading.Event()  # Thread has a private _stop
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def run(self) -> None:
+        client = self.cluster.client(timeout=6.0)
+        io = client.open_ioctx(self.pool)
+        try:
+            while not self._halt.is_set():
+                oid = f"{self.prefix}{self.rng.randrange(24)}"
+                roll = self.rng.random()
+                hist = self.submitted.setdefault(oid, [])
+                try:
+                    if roll < 0.5:
+                        body = (f"{oid}-{self.ops}-".encode()
+                                * self.rng.randrange(
+                                    1, self.payload_scale))
+                        hist.append(body)
+                        io.write_full(oid, body)
+                        self.acked[oid] = body   # acked => durable
+                        self.acked_idx[oid] = len(hist) - 1
+                    elif roll < 0.9:
+                        if oid not in self.acked_idx:
+                            continue
+                        got = io.read(oid)
+                        if not self._acceptable(oid, got):
+                            self.corruptions.append(oid)
+                    else:
+                        if self.acked.get(oid) is None:
+                            continue
+                        hist.append(None)
+                        io.remove(oid)
+                        self.acked[oid] = None
+                        self.acked_idx[oid] = len(hist) - 1
+                    self.ops += 1
+                except (TimeoutError, OSError):
+                    # storms time ops out / error them; the op is not
+                    # acked, so no durability claim attaches — but it
+                    # may still land, hence the submission history
+                    self.errors += 1
+        finally:
+            client.shutdown()
+
+    def _acceptable(self, oid: str, got: bytes | None) -> bool:
+        """True iff `got` is the last acked value or any LATER submitted
+        one (unacked writes may land; going backwards past an acked
+        write, or returning bytes never written, is the failure)."""
+        idx = self.acked_idx.get(oid)
+        if idx is None:
+            return True
+        for v in self.submitted[oid][idx:]:
+            if got == v:
+                return True
+        return False
+
+    def final_verify(self, client) -> list[str]:
+        """After heal: every acked object at/after its acked state."""
+        io = client.open_ioctx(self.pool)
+        bad = []
+        for oid, idx in sorted(self.acked_idx.items()):
+            suffix = self.submitted[oid][idx:]
+            if all(v is None for v in suffix):
+                continue   # last acked state is deleted
+            for attempt in range(3):
+                got: bytes | None
+                try:
+                    got = io.read(oid)
+                except OSError:
+                    got = None     # absent: fine if a delete follows
+                except TimeoutError:
+                    time.sleep(1.0)
+                    continue
+                if self._acceptable(oid, got):
+                    break
+                time.sleep(1.0)
+            else:
+                bad.append(oid)
+        return bad
+
+
+class Thrasher:
+    def __init__(self, cluster: MiniCluster, seed: int = 0,
+                 min_up: int = 4, max_down: int = 1):
+        self.cluster = cluster
+        self.rng = random.Random(seed)
+        self.min_up = min_up
+        self.max_down = max_down
+        self.downed: list[int] = []
+        self.outed: list[int] = []
+        self.actions = 0
+
+    def _mon_cmd(self, cmd: dict) -> None:
+        client = self.cluster.clients[0]
+        try:
+            client.mon_command(cmd)
+        except (TimeoutError, OSError):
+            pass
+
+    def step(self) -> str:
+        roll = self.rng.random()
+        up = [i for i in self.cluster.osds if i not in self.downed]
+        if self.downed and (roll < 0.45 or len(self.downed)
+                            >= self.max_down):
+            osd = self.downed.pop(self.rng.randrange(len(self.downed)))
+            self.cluster.run_osd(osd)
+            self._mon_cmd({"prefix": "osd in", "id": str(osd)})
+            self.actions += 1
+            return f"revive osd.{osd}"
+        if roll < 0.7 and len(up) > self.min_up \
+                and len(self.downed) < self.max_down:
+            osd = self.rng.choice(up)
+            self.cluster.kill_osd(osd)
+            self._mon_cmd({"prefix": "osd down", "id": str(osd)})
+            self.downed.append(osd)
+            self.actions += 1
+            return f"kill osd.{osd}"
+        if self.outed:
+            osd = self.outed.pop()
+            self._mon_cmd({"prefix": "osd in", "id": str(osd)})
+            self.actions += 1
+            return f"in osd.{osd}"
+        candidates = [i for i in up if i not in self.outed]
+        if candidates and len(up) - len(self.outed) > self.min_up:
+            osd = self.rng.choice(candidates)
+            self._mon_cmd({"prefix": "osd out", "id": str(osd)})
+            self.outed.append(osd)
+            self.actions += 1
+            return f"out osd.{osd}"
+        return "noop"
+
+    def heal(self) -> None:
+        """Revive everything and bring every OSD back in."""
+        for osd in list(self.downed):
+            self.cluster.run_osd(osd)
+        self.downed.clear()
+        for osd in list(self.outed):
+            self._mon_cmd({"prefix": "osd in", "id": str(osd)})
+        self.outed.clear()
+
+
+def run_soak(duration: float = 25.0, seed: int = 7,
+             n_osds: int = 6, base_path: str = "") -> dict:
+    """The standalone soak: returns a result dict (the pytest wrapper
+    asserts).  OSDs are filestore-backed: kill_osd is PROCESS death with
+    the disk surviving, like the reference Thrasher — wiping stores
+    faster than recovery completes would lose data in any storage
+    system."""
+    if not base_path:
+        import tempfile
+        base_path = tempfile.mkdtemp(prefix="thrash-")
+    c = MiniCluster(n_osds=n_osds, ms_type="loopback",
+                    store_type="filestore",
+                    base_path=base_path, heartbeats=True).start()
+    try:
+        c.wait_for_osd_count(n_osds)
+        client = c.client(timeout=20.0)
+        rep = c.create_pool(client, pg_num=8, size=3)
+        ec = c.create_pool(client, pg_num=8, pool_type="erasure",
+                           k=2, m=2)
+        rng = random.Random(seed)
+        w1 = Workload(c, rep, "r", random.Random(seed + 1))
+        w2 = Workload(c, ec, "e", random.Random(seed + 2),
+                      payload_scale=400)
+        w1.start()
+        w2.start()
+        th = Thrasher(c, seed=seed)
+        deadline = time.time() + duration
+        log = []
+        while time.time() < deadline:
+            log.append(th.step())
+            time.sleep(rng.uniform(0.5, 1.5))
+        w1.stop()
+        w2.stop()
+        w1.join(timeout=30)
+        w2.join(timeout=30)
+        th.heal()
+        c.wait_for_osd_count(n_osds, timeout=30)
+        c.wait_for_epoch(c.mon.osdmap.epoch, timeout=30)
+        time.sleep(3.0)   # recovery settles
+        vclient = c.client(timeout=20.0)
+        bad1 = w1.final_verify(vclient)
+        bad2 = w2.final_verify(vclient)
+        return {
+            "actions": th.actions, "log": log,
+            "rep_ops": w1.ops, "ec_ops": w2.ops,
+            "rep_errors": w1.errors, "ec_errors": w2.errors,
+            "corruptions": w1.corruptions + w2.corruptions,
+            "lost_rep": bad1, "lost_ec": bad2,
+        }
+    finally:
+        c.stop()
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+    res = run_soak(duration=float(sys.argv[1]) if len(sys.argv) > 1
+                   else 25.0)
+    print(json.dumps({k: v for k, v in res.items() if k != "log"}))
+    sys.exit(1 if (res["corruptions"] or res["lost_rep"]
+                   or res["lost_ec"]) else 0)
