@@ -99,7 +99,8 @@ class RadosClient(Dispatcher):
     _id_lock = threading.Lock()
 
     def __init__(self, mon_addr: str, ctx: CephTpuContext | None = None,
-                 ms_type: str = "async", timeout: float = 10.0):
+                 ms_type: str = "async", timeout: float = 10.0,
+                 auth_key=None):
         with RadosClient._id_lock:
             self.client_id = RadosClient._next_client_id
             RadosClient._next_client_id += 1
@@ -117,6 +118,7 @@ class RadosClient(Dispatcher):
         self._cmd_waiters: dict[int, tuple[threading.Event, list]] = {}
         self.name = EntityName("client", self.client_id)
         self.msgr = Messenger.create(self.name, ms_type)
+        self.msgr.set_auth(auth_key)
         self.msgr.set_policy("osd", ConnectionPolicy.stateful_peer())
         self.msgr.set_policy("mon", ConnectionPolicy.stateful_peer())
         self.msgr.add_dispatcher_tail(self)

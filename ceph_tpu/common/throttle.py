@@ -14,6 +14,10 @@ class Throttle:
         self._cond = threading.Condition()
 
     @property
+    def max_amount(self) -> int:
+        return self._max
+
+    @property
     def current(self) -> int:
         with self._cond:
             return self._current

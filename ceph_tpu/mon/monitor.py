@@ -140,7 +140,7 @@ class Monitor(Dispatcher):
 
     def __init__(self, ctx: CephTpuContext | None = None, mon_id: int = 0,
                  store_path: str | None = None, ms_type: str = "async",
-                 addr: str = "127.0.0.1:0"):
+                 addr: str = "127.0.0.1:0", auth_key=None):
         self.ctx = ctx or CephTpuContext(f"mon.{mon_id}")
         self.mon_id = mon_id
         self.name = EntityName("mon", mon_id)
@@ -163,6 +163,7 @@ class Monitor(Dispatcher):
         self._fwd_waiting: dict[int, tuple] = {}
         self._stop = False
         self.msgr = Messenger.create(self.name, ms_type)
+        self.msgr.set_auth(auth_key)
         self.msgr.set_policy("client", ConnectionPolicy.lossy_client())
         self.msgr.set_policy("osd", ConnectionPolicy.stateful_server())
         self.msgr.set_policy("mon", ConnectionPolicy.stateful_peer())

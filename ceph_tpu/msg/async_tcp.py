@@ -7,14 +7,25 @@ portable structure for a multi-process vstart harness.  Protocol v1-lite
 
     banner          b"ceph_tpu v1\\n" both ways
     announce        length-prefixed str(entity_name) both ways
+    auth            [u8 mode][16B nonce] both ways, then an HMAC-SHA256
+                    proof over the peer's fresh nonce (cephx-lite: the
+                    src/auth/cephx challenge shape with a shared cluster
+                    key standing in for the ticket infrastructure; fresh
+                    nonces per connection give replay protection)
     frames          [u32 length][Message.encode() bytes]   (crc inside)
 
 Stateful policies reconnect on send failure and resend the queued backlog;
 lossy connections drop and notify ms_handle_reset (msg/Policy.h semantics).
+Hardening: frames above the policy byte cap are rejected, total in-dispatch
+bytes ride a Throttle (msg/Policy.h throttler analog), and dead accepted
+connections are reaped instead of leaking on reconnect storms.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
+import os
 import queue
 import socket
 import struct
@@ -27,6 +38,13 @@ from .messenger import Connection, ConnectionPolicy, EntityName, Messenger
 BANNER = b"ceph_tpu v1\n"
 _LEN = struct.Struct("<I")
 
+AUTH_NONE = 0
+AUTH_CEPHX = 1
+
+#: largest acceptable frame (DoS guard; the reference uses policy
+#: throttles plus osd_max_write_size-scale caps)
+MAX_FRAME = 256 << 20
+
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:
     buf = b""
@@ -38,7 +56,9 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
-def _handshake(sock: socket.socket, my_name: EntityName) -> EntityName:
+def _handshake(sock: socket.socket, my_name: EntityName,
+               auth_key: bytes | None,
+               auth_required: bool) -> EntityName:
     sock.sendall(BANNER)
     got = _read_exact(sock, len(BANNER))
     if got != BANNER:
@@ -46,7 +66,30 @@ def _handshake(sock: socket.socket, my_name: EntityName) -> EntityName:
     me = str(my_name).encode()
     sock.sendall(_LEN.pack(len(me)) + me)
     plen = _LEN.unpack(_read_exact(sock, _LEN.size))[0]
-    return EntityName.parse(_read_exact(sock, plen).decode())
+    if plen > 256:
+        raise ConnectionError("oversized name frame")
+    peer = EntityName.parse(_read_exact(sock, plen).decode())
+
+    # auth phase: mode + fresh nonce both ways, then mutual HMAC proofs
+    my_mode = AUTH_CEPHX if auth_key else AUTH_NONE
+    my_nonce = os.urandom(16)
+    sock.sendall(bytes([my_mode]) + my_nonce)
+    hdr = _read_exact(sock, 17)
+    peer_mode, peer_nonce = hdr[0], hdr[1:]
+    if auth_required and peer_mode != AUTH_CEPHX:
+        raise ConnectionError(f"peer {peer} refused authentication")
+    if my_mode == AUTH_CEPHX and peer_mode == AUTH_CEPHX:
+        # prove I hold the key over the PEER's nonce (never my own:
+        # fresh peer nonces are the replay protection)
+        proof = hmac.new(auth_key, peer_nonce + me,
+                         hashlib.sha256).digest()
+        sock.sendall(proof)
+        peer_proof = _read_exact(sock, 32)
+        want = hmac.new(auth_key, my_nonce + str(peer).encode(),
+                        hashlib.sha256).digest()
+        if not hmac.compare_digest(peer_proof, want):
+            raise ConnectionError(f"peer {peer} failed authentication")
+    return peer
 
 
 class TcpConnection(Connection):
@@ -99,8 +142,11 @@ class TcpConnection(Connection):
     def _connect(self) -> None:
         host, port = self.peer_addr.rsplit(":", 1)
         s = socket.create_connection((host, int(port)), timeout=10)
+        m = self.messenger
+        # keep the dial timeout through the handshake: a stalled or
+        # malicious peer must not wedge the writer thread forever
+        peer = _handshake(s, m.my_name, m.auth_key, m.auth_required)
         s.settimeout(None)
-        peer = _handshake(s, self.messenger.my_name)
         with self._lock:
             self._sock = s
         if self.peer_name is None:
@@ -122,6 +168,10 @@ class TcpConnection(Connection):
                         self._connect()
                         with self._lock:
                             sock = self._sock
+                    if sock is None:
+                        # the reader nulled it already (e.g. the peer
+                        # rejected us right after the handshake)
+                        raise OSError("connection lost before write")
                     sock.sendall(_LEN.pack(len(backlog[0])) + backlog[0])
                     backlog.pop(0)
                 except OSError:
@@ -142,6 +192,7 @@ class TcpConnection(Connection):
 
     def _read_loop(self) -> None:
         from ceph_tpu.common.logging import get_logger
+        throttle = self.messenger.dispatch_throttle
         try:
             while not self._down:
                 with self._lock:
@@ -149,16 +200,29 @@ class TcpConnection(Connection):
                 if sock is None:
                     return
                 frame_len = _LEN.unpack(_read_exact(sock, _LEN.size))[0]
+                if frame_len > MAX_FRAME:
+                    raise ConnectionError(
+                        f"oversized frame ({frame_len} bytes) from "
+                        f"{self.peer_name}")
+                # policy byte throttle BEFORE buffering the payload:
+                # acquiring after the read would leave buffered bytes
+                # unbounded (msg/Policy.h reads under the throttle)
+                throttled = throttle.get(min(frame_len,
+                                             throttle.max_amount))
                 data = _read_exact(sock, frame_len)
-                # a bad frame or handler bug must not kill the reader
                 try:
-                    msg = Message.decode(data)
-                    msg.connection = self
-                    self.messenger.deliver(msg)
-                except Exception:
-                    get_logger("ms").exception(
-                        "%s: dispatch failed for frame from %s",
-                        self.messenger.my_name, self.peer_name)
+                    # a bad frame or handler bug must not kill the reader
+                    try:
+                        msg = Message.decode(data)
+                        msg.connection = self
+                        self.messenger.deliver(msg)
+                    except Exception:
+                        get_logger("ms").exception(
+                            "%s: dispatch failed for frame from %s",
+                            self.messenger.my_name, self.peer_name)
+                finally:
+                    if throttled:
+                        throttle.put(min(frame_len, throttle.max_amount))
         except (ConnectionError, OSError):
             with self._lock:
                 self._sock = None
@@ -166,15 +230,44 @@ class TcpConnection(Connection):
                 if self.policy.lossy:
                     self._down = True
                 self.messenger.notify_reset(self)
+            self.messenger.reap(self)
 
 
 class AsyncMessenger(Messenger):
+    #: cap on bytes concurrently in dispatch (policy throttler analog)
+    DISPATCH_THROTTLE_BYTES = 512 << 20
+
     def __init__(self, name: EntityName):
         super().__init__(name)
         self._listener: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None
         self._conns: dict[str, TcpConnection] = {}
         self._stop = False
+        self.auth_key: bytes | None = None
+        self.auth_required = False
+        from ceph_tpu.common.throttle import Throttle
+        self.dispatch_throttle = Throttle(
+            f"msgr-dispatch:{name}", self.DISPATCH_THROTTLE_BYTES)
+
+    def set_auth(self, key: bytes | str | None,
+                 required: bool = True) -> None:
+        """Enable cephx-lite: all connections prove possession of the
+        shared cluster key during the handshake; with required=True an
+        un-keyed peer is rejected."""
+        if isinstance(key, str):
+            key = key.encode()
+        self.auth_key = key
+        self.auth_required = bool(key) and required
+
+    def reap(self, con: "TcpConnection") -> None:
+        """Drop a dead connection from the table (reconnect storms must
+        not accumulate dead accepted sessions)."""
+        if not con._down and not con.accepted:
+            return   # dialing connections self-heal; keep them
+        with self._lock:
+            for key, c in list(self._conns.items()):
+                if c is con:
+                    del self._conns[key]
 
     def bind(self, addr: str) -> None:
         host, port = addr.rsplit(":", 1)
@@ -203,7 +296,12 @@ class AsyncMessenger(Messenger):
 
     def _accept_one(self, sock: socket.socket) -> None:
         try:
-            peer = _handshake(sock, self.my_name)
+            # handshake-phase timeout: an unauthenticated peer that
+            # stalls mid-handshake must not leak a thread + fd
+            sock.settimeout(10)
+            peer = _handshake(sock, self.my_name, self.auth_key,
+                              self.auth_required)
+            sock.settimeout(None)
         except (ConnectionError, OSError):
             sock.close()
             return
@@ -211,7 +309,10 @@ class AsyncMessenger(Messenger):
         con = TcpConnection(self, f"{sock.getpeername()[0]}:0", peer,
                             policy, sock=sock, accepted=True)
         with self._lock:
+            old = self._conns.get(f"accepted:{peer}")
             self._conns[f"accepted:{peer}"] = con
+        if old is not None:
+            old.mark_down()   # reap the replaced session
 
     def shutdown(self) -> None:
         self._stop = True

@@ -116,6 +116,11 @@ class Messenger:
 
     # -- dispatcher chain (Messenger.h:337-352) -------------------------------
 
+    def set_auth(self, key, required: bool = True) -> None:
+        """cephx-lite shared-key authentication; only wire stacks
+        enforce it (in-process loopback peers are the same trust
+        domain)."""
+
     def add_dispatcher_head(self, d: Dispatcher) -> None:
         with self._lock:
             self._dispatchers.insert(0, d)

@@ -165,7 +165,7 @@ class OSDDaemon(Dispatcher):
                  ctx: CephTpuContext | None = None,
                  store_type: str = "memstore", store_path: str = "",
                  ms_type: str = "async", addr: str = "127.0.0.1:0",
-                 heartbeats: bool = True):
+                 heartbeats: bool = True, auth_key=None):
         self.osd_id = osd_id
         self.whoami = EntityName("osd", osd_id)
         self.ctx = ctx or CephTpuContext(f"osd.{osd_id}")
@@ -192,6 +192,7 @@ class OSDDaemon(Dispatcher):
         self.debug_drop_rep_ops = 0
 
         self.msgr = Messenger.create(self.whoami, ms_type)
+        self.msgr.set_auth(auth_key)
         self.msgr.set_policy("client", ConnectionPolicy.lossy_client())
         self.msgr.set_policy("osd", ConnectionPolicy.stateful_peer())
         self.msgr.set_policy("mon", ConnectionPolicy.stateful_peer())
@@ -432,7 +433,12 @@ class OSDDaemon(Dispatcher):
             pg.peering_started = time.time()
             pg.peers = {}
             pg.recovering.clear()
-            pg.rmw.clear()   # interval change: in-flight rmw gathers die
+            # interval change: in-flight rmw gathers die with the gate
+            pg.rmw.clear()
+            dead = [gid for gid, st in self._ec_reads.items()
+                    if st["kind"] == "rmw" and st["pgid"] == pg.pgid]
+            for gid in dead:
+                self._ec_reads.pop(gid, None)
             # ops queued against the old interval: requeue for re-check
             # after this round settles (clients also resend on map change)
             for ops in pg.waiting_for_missing.values():
@@ -1271,9 +1277,9 @@ class OSDDaemon(Dispatcher):
         # read-modify-write: gather the current object, then continue.
         # The object is gated (pg.rmw) so overlapping ops queue.
         with self._lock:
-            pg.rmw.add(msg.oid)
             self._recover_tid += 1
             gid = (RECOVERY_CLIENT + self.osd_id, self._recover_tid)
+            pg.rmw[msg.oid] = gid
         si = self._ec_stripe_info(codec, pool)
         cand = self._ec_shard_candidates(pg, n)
         state = {"kind": "rmw", "msg": msg, "op": op, "pool": pool,
@@ -1295,9 +1301,14 @@ class OSDDaemon(Dispatcher):
         if pg is None:
             return
         with self._lock:
+            if pg.rmw.get(msg.oid) != state.get("gid"):
+                # an interval change orphaned this gather; a newer one
+                # (or nobody) owns the gate now — applying pre-peering
+                # old_data here would overlay a stale base
+                return
             self._ec_apply_write(msg, state["pool"], pg, state["op"],
                                  old_data=old_data, replace=False)
-            pg.rmw.discard(msg.oid)
+            pg.rmw.pop(msg.oid, None)
             waiting = pg.waiting_for_missing.pop(msg.oid, [])
         for m in waiting:
             self._handle_op(m)
@@ -1626,7 +1637,8 @@ class OSDDaemon(Dispatcher):
         if state["kind"] == "rmw":
             if pg is not None:
                 with self._lock:
-                    pg.rmw.discard(state["oid"])
+                    if pg.rmw.get(state["oid"]) == state.get("gid"):
+                        pg.rmw.pop(state["oid"], None)
             self._reply_err(state["msg"], -5)
             return
         if pg is not None:

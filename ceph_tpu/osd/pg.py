@@ -222,9 +222,11 @@ class PG:
         #: objects currently being recovered: oid -> pull-issue timestamp
         #: (lets the tick re-issue pulls that were lost in flight)
         self.recovering: dict[str, float] = {}
-        #: objects with an EC read-modify-write in flight (ECBackend's
-        #: rmw pipeline serializes per object)
-        self.rmw: set[str] = set()
+        #: objects with an EC read-modify-write in flight, oid -> the
+        #: owning gather id (ECBackend's rmw pipeline serializes per
+        #: object; ownership keeps an orphaned pre-peering gather from
+        #: releasing or bypassing a newer gather's gate)
+        self.rmw: dict[str, tuple] = {}
         #: when the current peering round started (tick watchdog)
         self.peering_started = 0.0
         self.next_seq = 0

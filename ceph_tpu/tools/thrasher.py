@@ -107,11 +107,11 @@ class Workload(threading.Thread):
                 got: bytes | None
                 try:
                     got = io.read(oid)
-                except OSError:
-                    got = None     # absent: fine if a delete follows
-                except TimeoutError:
+                except TimeoutError:   # NB: subclass of OSError — first
                     time.sleep(1.0)
                     continue
+                except OSError:
+                    got = None     # absent: fine if a delete follows
                 if self._acceptable(oid, got):
                     break
                 time.sleep(1.0)

@@ -21,7 +21,8 @@ class MiniCluster:
 
     def __init__(self, n_osds: int = 3, ms_type: str = "async",
                  store_type: str = "memstore", base_path: str = "",
-                 heartbeats: bool = False, n_mons: int = 1):
+                 heartbeats: bool = False, n_mons: int = 1,
+                 auth_key=None):
         # namespace loopback addresses per cluster: sequential tests reuse
         # names like "mon.0", and a timer from a dying daemon of the
         # previous cluster must never reach this one
@@ -37,6 +38,7 @@ class MiniCluster:
         self.clients: list[RadosClient] = []
         self._n_initial = n_osds
         self._n_mons = n_mons
+        self.auth_key = auth_key
 
     @property
     def mon(self) -> Monitor:
@@ -69,7 +71,7 @@ class MiniCluster:
                 else f"{self._ns}mon.{mon_id}")
         path = (f"{self.base_path}/mon.{mon_id}" if self.base_path else None)
         mon = Monitor(mon_id=mon_id, ms_type=self.ms_type, addr=addr,
-                      store_path=path)
+                      store_path=path, auth_key=self.auth_key)
         if defer_monmap:
             mon.init(monmap=[])   # bind only; set_monmap comes later
         else:
@@ -95,7 +97,8 @@ class MiniCluster:
         path = (f"{self.base_path}/osd.{osd_id}" if self.base_path else "")
         osd = OSDDaemon(osd_id, self.mon_host, store_type=self.store_type,
                         store_path=path, ms_type=self.ms_type, addr=addr,
-                        heartbeats=self.heartbeats)
+                        heartbeats=self.heartbeats,
+                        auth_key=self.auth_key)
         osd.init()
         self.osds[osd_id] = osd
         return osd
@@ -107,7 +110,7 @@ class MiniCluster:
 
     def client(self, timeout: float = 10.0) -> RadosClient:
         c = RadosClient(self.mon_host, ms_type=self.ms_type,
-                        timeout=timeout)
+                        timeout=timeout, auth_key=self.auth_key)
         c.connect()
         self.clients.append(c)
         return c
