@@ -16,6 +16,8 @@ import threading
 from ceph_tpu.common.context import CephTpuContext
 from ceph_tpu.messages import MMonCommand, MMonCommandAck, MOSDMapMsg, MOSDOp
 from ceph_tpu.messages.osd_msgs import (
+    MWatchNotify, MWatchNotifyAck, OP_NOTIFY, OP_UNWATCH, OP_WATCH)
+from ceph_tpu.messages.osd_msgs import (
     OP_DELETE, OP_OMAP_GET, OP_OMAP_SET, OP_READ, OP_STAT, OP_WRITE,
     OP_WRITEFULL, OSDOpField)
 from ceph_tpu.mon.monitor import MMonSubscribe
@@ -116,6 +118,8 @@ class RadosClient(Dispatcher):
         self._next_tid = 1
         self._waiters: dict[int, _Waiter] = {}
         self._cmd_waiters: dict[int, tuple[threading.Event, list]] = {}
+        #: (pool, oid) -> watch callback(payload)
+        self._watch_cbs: dict[tuple, object] = {}
         self.name = EntityName("client", self.client_id)
         self.msgr = Messenger.create(self.name, ms_type)
         self.msgr.set_auth(auth_key)
@@ -159,6 +163,16 @@ class RadosClient(Dispatcher):
             if w is not None:
                 w.reply = msg
                 w.event.set()
+            return True
+        if isinstance(msg, MWatchNotify):
+            cb = self._watch_cbs.get((msg.pool, msg.oid))
+            if cb is not None:
+                try:
+                    cb(msg.payload)
+                finally:
+                    msg.connection.send_message(MWatchNotifyAck(
+                        pool=msg.pool, oid=msg.oid,
+                        notify_id=msg.notify_id))
             return True
         if isinstance(msg, MMonCommandAck):
             with self._lock:
@@ -242,14 +256,14 @@ class RadosClient(Dispatcher):
         con = self.msgr.connect_to(addr, EntityName("osd", primary))
         con.send_message(w.msg)
 
-    def operate(self, pool_id: int, oid: str, ops: list[OSDOpField]
-                ) -> MOSDOpReply:
+    def operate(self, pool_id: int, oid: str, ops: list[OSDOpField],
+                snapid: int = 0) -> MOSDOpReply:
         with self._lock:
             tid = self._next_tid
             self._next_tid += 1
             msg = MOSDOp(client_id=self.client_id, tid=tid,
                          pgid=(pool_id, 0), oid=oid, ops=ops,
-                         epoch=self.osdmap.epoch)
+                         epoch=self.osdmap.epoch, snapid=snapid)
             w = _Waiter(msg)
             self._waiters[tid] = w
         self._send_op(w)
@@ -290,10 +304,30 @@ class IoCtx:
         self.client.operate(self.pool_id, oid,
                             [OSDOpField(OP_WRITE, offset, len(data), data)])
 
-    def read(self, oid: str, length: int = 0, offset: int = 0) -> bytes:
+    def read(self, oid: str, length: int = 0, offset: int = 0,
+             snapid: int = 0) -> bytes:
         r = self.client.operate(self.pool_id, oid,
-                                [OSDOpField(OP_READ, offset, length)])
+                                [OSDOpField(OP_READ, offset, length)],
+                                snapid=snapid)
         return r.ops[0].data if r.ops else b""
+
+    def watch(self, oid: str, callback) -> None:
+        """Register for notifies on the object (librados watch; the
+        callback runs on the client's dispatch thread)."""
+        self.client._watch_cbs[(self.pool_id, oid)] = callback
+        self.client.operate(self.pool_id, oid,
+                            [OSDOpField(OP_WATCH, 0, 0)])
+
+    def unwatch(self, oid: str) -> None:
+        self.client._watch_cbs.pop((self.pool_id, oid), None)
+        self.client.operate(self.pool_id, oid,
+                            [OSDOpField(OP_UNWATCH, 0, 0)])
+
+    def notify(self, oid: str, payload: bytes = b"") -> None:
+        """Fan payload out to every watcher; returns once all acked
+        (librados notify)."""
+        self.client.operate(self.pool_id, oid,
+                            [OSDOpField(OP_NOTIFY, 0, 0, payload)])
 
     def remove(self, oid: str) -> None:
         self.client.operate(self.pool_id, oid, [OSDOpField(OP_DELETE)])

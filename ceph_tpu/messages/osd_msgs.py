@@ -20,6 +20,9 @@ OP_DELETE = 4
 OP_STAT = 5
 OP_OMAP_GET = 6
 OP_OMAP_SET = 7
+OP_WATCH = 8          # register this client for notifies on the object
+OP_UNWATCH = 9
+OP_NOTIFY = 10        # fan a payload out to every watcher, wait for acks
 
 
 @dataclass
@@ -54,7 +57,8 @@ class MOSDOp(Message):
 
     def __init__(self, client_id: int = 0, tid: int = 0,
                  pgid: tuple[int, int] = (0, 0), oid: str = "",
-                 ops: list[OSDOpField] | None = None, epoch: int = 0):
+                 ops: list[OSDOpField] | None = None, epoch: int = 0,
+                 snapid: int = 0):
         super().__init__()
         self.client_id = client_id
         self.tid = tid
@@ -62,12 +66,14 @@ class MOSDOp(Message):
         self.oid = oid
         self.ops = ops or []
         self.epoch = epoch
+        self.snapid = snapid    # v2: read as-of this pool snapshot
 
     def encode_payload(self, enc):
-        enc.versioned(1, 1, lambda e: (
+        enc.versioned(2, 1, lambda e: (
             e.u64(self.client_id), e.u64(self.tid), _enc_pgid(e, self.pgid),
             e.str(self.oid), e.u32(self.epoch),
-            e.list(self.ops, lambda e2, op: op.encode(e2))))
+            e.list(self.ops, lambda e2, op: op.encode(e2)),
+            e.u64(self.snapid)))
 
     def decode_payload(self, dec, version):
         def body(d, v):
@@ -77,7 +83,9 @@ class MOSDOp(Message):
             self.oid = d.str()
             self.epoch = d.u32()
             self.ops = d.list(OSDOpField.decode)
-        dec.versioned(1, body)
+            if v >= 2:
+                self.snapid = d.u64()
+        dec.versioned(2, body)
 
 
 @register_message
@@ -424,4 +432,115 @@ class MMonCommandAck(Message):
             self.tid = d.u64()
             self.result = d.s32()
             self.output = d.str()
+        dec.versioned(1, body)
+
+
+@register_message
+class MWatchNotify(Message):
+    """osd -> watching client: a notify fired on an object
+    (messages/MWatchNotify.h; CEPH_MSG_WATCH_NOTIFY)."""
+
+    TYPE = 44
+
+    def __init__(self, pool: int = 0, oid: str = "", notify_id: int = 0,
+                 payload: bytes = b""):
+        super().__init__()
+        self.pool = pool
+        self.oid = oid
+        self.notify_id = notify_id
+        self.payload = payload
+
+    def encode_payload(self, enc):
+        enc.versioned(1, 1, lambda e: (
+            e.s64(self.pool), e.str(self.oid), e.u64(self.notify_id),
+            e.bytes(self.payload)))
+
+    def decode_payload(self, dec, version):
+        def body(d, v):
+            self.pool = d.s64()
+            self.oid = d.str()
+            self.notify_id = d.u64()
+            self.payload = d.bytes()
+        dec.versioned(1, body)
+
+
+@register_message
+class MWatchNotifyAck(Message):
+    TYPE = 45
+
+    def __init__(self, pool: int = 0, oid: str = "", notify_id: int = 0):
+        super().__init__()
+        self.pool = pool
+        self.oid = oid
+        self.notify_id = notify_id
+
+    def encode_payload(self, enc):
+        enc.versioned(1, 1, lambda e: (
+            e.s64(self.pool), e.str(self.oid), e.u64(self.notify_id)))
+
+    def decode_payload(self, dec, version):
+        def body(d, v):
+            self.pool = d.s64()
+            self.oid = d.str()
+            self.notify_id = d.u64()
+        dec.versioned(1, body)
+
+
+@register_message
+class MOSDScrub(Message):
+    """primary -> replica: send your scrub map for this PG
+    (MOSDRepScrub analog)."""
+
+    TYPE = 120
+
+    def __init__(self, pgid: tuple[int, int] = (0, 0), scrub_id: int = 0,
+                 from_osd: int = 0):
+        super().__init__()
+        self.pgid = pgid
+        self.scrub_id = scrub_id
+        self.from_osd = from_osd
+
+    def encode_payload(self, enc):
+        enc.versioned(1, 1, lambda e: (
+            _enc_pgid(e, self.pgid), e.u64(self.scrub_id),
+            e.s32(self.from_osd)))
+
+    def decode_payload(self, dec, version):
+        def body(d, v):
+            self.pgid = _dec_pgid(d)
+            self.scrub_id = d.u64()
+            self.from_osd = d.s32()
+        dec.versioned(1, body)
+
+
+@register_message
+class MOSDScrubReply(Message):
+    """replica -> primary: {oid: (size, data_crc, omap_crc)}."""
+
+    TYPE = 121
+
+    def __init__(self, pgid: tuple[int, int] = (0, 0), scrub_id: int = 0,
+                 from_osd: int = 0, scrub_map: dict | None = None):
+        super().__init__()
+        self.pgid = pgid
+        self.scrub_id = scrub_id
+        self.from_osd = from_osd
+        self.scrub_map = scrub_map or {}
+
+    def encode_payload(self, enc):
+        enc.versioned(1, 1, lambda e: (
+            _enc_pgid(e, self.pgid), e.u64(self.scrub_id),
+            e.s32(self.from_osd),
+            e.map(self.scrub_map, lambda e2, k: e2.str(k),
+                  lambda e2, t: (e2.u64(t[0]), e2.u32(t[1]),
+                                 e2.u32(t[2])))))
+
+    def decode_payload(self, dec, version):
+        def body(d, v):
+            self.pgid = _dec_pgid(d)
+            self.scrub_id = d.u64()
+            self.from_osd = d.s32()
+            self.scrub_map = d.map(
+                lambda d2: d2.str(),
+                lambda d2: (d2.u64(), d2.u32(), d2.u32()))
         dec.versioned(1, body)

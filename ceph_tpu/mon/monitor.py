@@ -496,6 +496,32 @@ class Monitor(Dispatcher):
                 if not self._mutate(fn):
                     return "commit failed", -11
                 return "marked down", 0
+            if prefix == "osd pool mksnap":
+                pool_id = int(cmd["pool"])
+                name = str(cmd["snap"])
+
+                def fn(m: OSDMap):
+                    p = m.pools[pool_id]
+                    p.snap_seq += 1
+                    p.snaps[p.snap_seq] = name
+                if not self._mutate(fn):
+                    return "commit failed", -11
+                return json.dumps(
+                    {"snapid": self.osdmap.pools[pool_id].snap_seq}), 0
+            if prefix == "osd pool rmsnap":
+                pool_id = int(cmd["pool"])
+                name = str(cmd["snap"])
+
+                def fn(m: OSDMap):
+                    p = m.pools[pool_id]
+                    sid = next((s for s, n in p.snaps.items()
+                                if n == name), None)
+                    if sid is None:
+                        return False
+                    del p.snaps[sid]
+                if not self._mutate(fn):
+                    return "commit failed", -11
+                return "removed", 0
             if prefix == "osd getmap":
                 return json.dumps({"epoch": self.osdmap.epoch}), 0
             return f"unknown command {prefix!r}", -22

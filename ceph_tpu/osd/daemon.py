@@ -47,8 +47,9 @@ from ceph_tpu.messages import (
     MOSDECSubOpWriteReply, MOSDFailure, MOSDMapMsg, MOSDOp, MOSDOpReply,
     MOSDPing, MOSDRepOp, MOSDRepOpReply)
 from ceph_tpu.messages.osd_msgs import (
-    OP_DELETE, OP_OMAP_GET, OP_OMAP_SET, OP_READ, OP_STAT, OP_WRITE,
-    OP_WRITEFULL, OSDOpField)
+    OP_DELETE, OP_NOTIFY, OP_OMAP_GET, OP_OMAP_SET, OP_READ, OP_STAT,
+    OP_UNWATCH, OP_WATCH, OP_WRITE, OP_WRITEFULL, MOSDScrub,
+    MOSDScrubReply, MWatchNotify, MWatchNotifyAck, OSDOpField)
 from ceph_tpu.messages.peering_msgs import MOSDPGLog, MOSDPGNotify, MOSDPGQuery
 from ceph_tpu.mon.monitor import MMonSubscribe, MOSDBoot
 from ceph_tpu.msg.encoding import Decoder, Encoder
@@ -184,6 +185,15 @@ class OSDDaemon(Dispatcher):
         self._codecs: dict[int, object] = {}
         self._osd_addr_cache: dict[int, str] = {}
         self._hb_last: dict[int, float] = {}
+        #: (pgid, oid) -> {client_id: connection} (watch/notify; session
+        #: scope — the reference persists watchers in object_info)
+        self._watchers: dict[tuple, dict[int, object]] = {}
+        #: notify_id -> pending notify state
+        self._notifies: dict[int, dict] = {}
+        self._notify_seq = 0
+        #: scrub_id -> gathered scrub maps
+        self._scrubs: dict[int, dict] = {}
+        self._scrub_seq = 0
         self._hb_timer: threading.Timer | None = None
         self._tick_timer: threading.Timer | None = None
         self._heartbeats = heartbeats
@@ -915,6 +925,15 @@ class OSDDaemon(Dispatcher):
         if isinstance(msg, MOSDPGPush):
             self._handle_push(msg)
             return True
+        if isinstance(msg, MWatchNotifyAck):
+            self._handle_notify_ack(msg)
+            return True
+        if isinstance(msg, MOSDScrub):
+            self._handle_scrub(msg)
+            return True
+        if isinstance(msg, MOSDScrubReply):
+            self._handle_scrub_reply(msg)
+            return True
         return False
 
     def _handle_ping(self, msg: MOSDPing) -> None:
@@ -1066,8 +1085,12 @@ class OSDDaemon(Dispatcher):
                 t.omap_setkeys(cid, msg.oid, keys)
             elif op.op == OP_READ:
                 try:
+                    src_oid = msg.oid
+                    if msg.snapid:
+                        src_oid = self._resolve_snap(cid, msg.oid,
+                                                     msg.snapid)
                     data = self.store.read(
-                        cid, msg.oid, op.offset,
+                        cid, src_oid, op.offset,
                         op.length if op.length else None)
                     reply_ops.append(OSDOpField(OP_READ, op.offset,
                                                 len(data), data))
@@ -1088,6 +1111,19 @@ class OSDDaemon(Dispatcher):
                         OP_OMAP_GET, 0, 0, _encode_omap(omap)))
                 except KeyError:
                     result = -2
+            elif op.op == OP_WATCH:
+                with self._lock:
+                    self._watchers.setdefault(
+                        (msg.pgid, msg.oid), {})[msg.client_id] = \
+                        msg.connection
+                reply_ops.append(OSDOpField(OP_WATCH, 0, 0, b""))
+            elif op.op == OP_UNWATCH:
+                with self._lock:
+                    self._watchers.get((msg.pgid, msg.oid), {}).pop(
+                        msg.client_id, None)
+            elif op.op == OP_NOTIFY:
+                self._start_notify(msg, op)
+                return   # replied when watchers ack (or timeout)
             else:
                 result = -22
         if not is_write or result != 0:
@@ -1103,6 +1139,22 @@ class OSDDaemon(Dispatcher):
             return
         self.perf.inc("op_w")
         t0 = time.time()
+        # snapshot COW (PrimaryLogPG make_writeable): first write after
+        # a pool snap clones the pre-write object to "oid@snap_seq";
+        # the clone's covered snap interval is (from_seq, snap_seq]
+        if pool.snap_seq:
+            obj_sc = int(self._getattr_safe(cid, msg.oid, "snapc")
+                         or b"0")
+            if obj_sc < pool.snap_seq and self.store.exists(cid, msg.oid):
+                clone = f"{msg.oid}@{pool.snap_seq}"
+                pre = Transaction()
+                pre.clone(cid, msg.oid, clone)
+                pre.setattr(cid, clone, "from_seq", str(obj_sc).encode())
+                pre.ops.extend(t.ops)
+                t = pre
+            if not is_delete:
+                t.setattr(cid, msg.oid, "snapc",
+                          str(pool.snap_seq).encode())
         entry = self._log_write(pg, t, msg.oid, is_delete, reqid)
         if not is_delete:
             t.setattr(cid, msg.oid, "_v", enc_version(entry.version))
@@ -1753,6 +1805,198 @@ class OSDDaemon(Dispatcher):
                 pgid=pgid, oid=shard_oid, data=chunks[dest_shard],
                 attrs=attrs))
         self._peer_recovered(pg, state["dest_osd"], shard_oid)
+
+    # -- snapshots (PrimaryLogPG snap resolution) -----------------------------
+
+    def _resolve_snap(self, cid: str, oid: str, snapid: int) -> str:
+        """Object name serving a read as-of pool snapshot `snapid`: the
+        head if unchanged since, else the oldest clone whose covered
+        interval (from_seq, clone_seq] contains snapid."""
+        head_sc = self._getattr_safe(cid, oid, "snapc")
+        # "snapc" records the pool snap_seq at the last write: the head
+        # is the snap-s state only if last written BEFORE snap s existed
+        if self.store.exists(cid, oid) and int(head_sc or b"0") < snapid:
+            return oid
+        clones = []
+        for o in self.store.list_objects(cid):
+            if o.startswith(oid + "@"):
+                try:
+                    clones.append((int(o.rsplit("@", 1)[1]), o))
+                except ValueError:
+                    continue
+        for seq, name in sorted(clones):
+            if seq >= snapid:
+                frm = int(self._getattr_safe(cid, name, "from_seq")
+                          or b"0")
+                if frm < snapid:
+                    return name
+                break   # object did not exist at that snap
+        raise KeyError(f"{oid} has no state at snap {snapid}")
+
+    # -- watch / notify (PrimaryLogPG watch paths) ----------------------------
+
+    def _start_notify(self, msg: MOSDOp, op) -> None:
+        with self._lock:
+            watchers = dict(self._watchers.get((msg.pgid, msg.oid), {}))
+            watchers.pop(msg.client_id, None)   # not the notifier itself
+            if not watchers:
+                pass
+            else:
+                self._notify_seq += 1
+                nid = self._notify_seq
+                self._notifies[nid] = {
+                    "msg": msg, "waiting": set(watchers),
+                    "started": time.time()}
+        if not watchers:
+            msg.connection.send_message(MOSDOpReply(
+                tid=msg.tid, result=0, epoch=self.osdmap.epoch))
+            return
+        note = MWatchNotify(pool=msg.pgid[0], oid=msg.oid,
+                            notify_id=nid, payload=op.data)
+        for cid_, con in watchers.items():
+            con.send_message(note)
+
+    def _handle_notify_ack(self, msg: MWatchNotifyAck) -> None:
+        done = None
+        with self._lock:
+            st = self._notifies.get(msg.notify_id)
+            if st is None:
+                return
+            # the ack connection's peer is the watcher; match by any —
+            # acks are per notify_id, one per watcher
+            if st["waiting"]:
+                st["waiting"].pop()
+            if not st["waiting"]:
+                done = self._notifies.pop(msg.notify_id)
+        if done is not None:
+            m = done["msg"]
+            m.connection.send_message(MOSDOpReply(
+                tid=m.tid, result=0, epoch=self.osdmap.epoch))
+
+    # -- scrub (PG::scrub / chunky_scrub, collapsed) --------------------------
+
+    def _scrub_map(self, cid: str) -> dict:
+        """{oid: (size, data_crc, omap_crc)} for every object in the
+        collection (pgmeta excluded)."""
+        from ceph_tpu.osd.ec_util import shard_crc
+        out = {}
+        try:
+            oids = self.store.list_objects(cid)
+        except KeyError:
+            return out
+        for oid in oids:
+            if oid.startswith(PG.PGMETA):
+                continue
+            try:
+                data = self.store.read(cid, oid)
+                omap = self.store.omap_get(cid, oid)
+            except KeyError:
+                continue
+            oblob = repr(sorted(omap.items())).encode()
+            out[oid] = (len(data), shard_crc(data), shard_crc(oblob))
+        return out
+
+    def _handle_scrub(self, msg: MOSDScrub) -> None:
+        cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
+        con = msg.connection or self._osd_con(msg.from_osd)
+        if con:
+            con.send_message(MOSDScrubReply(
+                pgid=msg.pgid, scrub_id=msg.scrub_id,
+                from_osd=self.osd_id, scrub_map=self._scrub_map(cid)))
+
+    def _handle_scrub_reply(self, msg: MOSDScrubReply) -> None:
+        with self._lock:
+            st = self._scrubs.get(msg.scrub_id)
+            if st is None:
+                return
+            st["maps"][msg.from_osd] = msg.scrub_map
+            if set(st["maps"]) >= st["expect"]:
+                st["event"].set()
+
+    def scrub_pg(self, pgid: tuple[int, int],
+                 timeout: float = 15.0) -> dict:
+        """Primary-driven scrub: gather per-replica object maps, compare,
+        repair divergent copies (authority = the primary's logged state,
+        with the primary itself repairing via pull when IT diverges from
+        the quorum of its replicas)."""
+        pg = self.pgs.get(pgid)
+        if pg is None or pg.primary != self.osd_id:
+            raise ValueError(f"not primary for {pgid}")
+        cid = self._pg_cid(pgid)
+        pool = self.osdmap.pools.get(pgid[0])
+        peers = [o for o in pg.up if o != self.osd_id and o != CEPH_NOSD]
+        with self._lock:
+            self._scrub_seq += 1
+            sid = self._scrub_seq
+            st = {"maps": {self.osd_id: self._scrub_map(cid)},
+                  "expect": set(peers) | {self.osd_id},
+                  "event": threading.Event()}
+            self._scrubs[sid] = st
+        for o in peers:
+            con = self._osd_con(o)
+            if con:
+                con.send_message(MOSDScrub(pgid=pgid, scrub_id=sid,
+                                           from_osd=self.osd_id))
+        st["event"].wait(timeout)
+        with self._lock:
+            self._scrubs.pop(sid, None)
+        maps = st["maps"]
+        report = {"checked": 0, "inconsistent": [], "repaired": []}
+        all_oids = sorted({o for m in maps.values() for o in m})
+        if pool is not None and pool.is_erasure():
+            # EC: shards are per-osd; integrity is the hinfo sweep
+            for oid in all_oids:
+                report["checked"] += 1
+                logical = oid.rsplit(":", 1)[0] if ":" in oid else oid
+                got = self._read_shard_verified(
+                    pgid, logical, oid.rsplit(":", 1)[1])                     if ":" in oid else None
+                if ":" in oid and got is None:
+                    report["inconsistent"].append(oid)
+            return report
+        for oid in all_oids:
+            report["checked"] += 1
+            vals = {o: maps[o].get(oid) for o in maps}
+            want = vals.get(self.osd_id)
+            counts: dict = {}
+            for v in vals.values():
+                counts[v] = counts.get(v, 0) + 1
+            majority = max(counts, key=lambda v: counts[v])
+            if all(v == want for v in vals.values()):
+                continue
+            report["inconsistent"].append(oid)
+            if want == majority and want is not None:
+                # push the primary copy over divergent replicas
+                try:
+                    data = self.store.read(cid, oid)
+                    omap = self.store.omap_get(cid, oid)
+                except KeyError:
+                    continue
+                attrs = {}
+                v = self._getattr_safe(cid, oid, "_v")
+                if v:
+                    attrs["_v"] = v
+                for o, val in vals.items():
+                    if o == self.osd_id or val == want:
+                        continue
+                    con = self._osd_con(o)
+                    if con:
+                        con.send_message(MOSDPGPush(
+                            pgid=pgid, oid=oid, data=data, omap=omap,
+                            attrs=attrs))
+                        report["repaired"].append((oid, o))
+            else:
+                # the primary is the outlier: repull from a good peer
+                good = next((o for o, val in vals.items()
+                             if val == majority and o != self.osd_id),
+                            None)
+                ent = pg.log.index.get(oid)
+                if good is not None and ent is not None:
+                    with self._lock:
+                        pg.missing[oid] = MissingItem(need=ent.version)
+                        pg.state = STATE_RECOVERING
+                    self._pull_object(pg, oid, good)
+                    report["repaired"].append((oid, self.osd_id))
+        return report
 
     # -- peers ----------------------------------------------------------------
 

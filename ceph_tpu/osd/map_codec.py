@@ -152,6 +152,9 @@ def encode_osdmap(m: OSDMap) -> bytes:
             e2.u32(p.crush_rule).u32(p.pg_num).u32(p.pgp_num)
             e2.map(p.ec_profile, lambda e3, k: e3.str(k),
                    lambda e3, v: e3.str(str(v)))
+            e2.u64(p.snap_seq)
+            e2.map(p.snaps, lambda e3, k: e3.u64(k),
+                   lambda e3, v: e3.str(v))
 
         e.map(m.pools, lambda e2, k: e2.s64(k), enc_pool)
 
@@ -168,7 +171,7 @@ def encode_osdmap(m: OSDMap) -> bytes:
               lambda e2, v: e2.list(v, lambda e3, o: e3.s32(o)))
         e.map(m.primary_temp, enc_pgid_key, lambda e2, v: e2.s32(v))
 
-    enc.versioned(1, 1, body)
+    enc.versioned(2, 1, body)
     return enc.tobytes()
 
 
@@ -185,11 +188,16 @@ def decode_osdmap(data: bytes) -> OSDMap:
         osd_addrs = d.list(lambda d2: d2.str())
 
         def dec_pool(d2: Decoder) -> PGPool:
-            return PGPool(pool_id=d2.s64(), type=d2.u8(), size=d2.u32(),
-                          min_size=d2.u32(), crush_rule=d2.u32(),
-                          pg_num=d2.u32(), pgp_num=d2.u32(),
-                          ec_profile=d2.map(lambda d3: d3.str(),
-                                            lambda d3: d3.str()))
+            p = PGPool(pool_id=d2.s64(), type=d2.u8(), size=d2.u32(),
+                       min_size=d2.u32(), crush_rule=d2.u32(),
+                       pg_num=d2.u32(), pgp_num=d2.u32(),
+                       ec_profile=d2.map(lambda d3: d3.str(),
+                                         lambda d3: d3.str()))
+            if version >= 2:
+                p.snap_seq = d2.u64()
+                p.snaps = d2.map(lambda d3: d3.u64(),
+                                 lambda d3: d3.str())
+            return p
 
         def dec_pgid_key(d2: Decoder) -> tuple[int, int]:
             return (d2.s64(), d2.u32())

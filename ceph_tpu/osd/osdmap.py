@@ -68,6 +68,9 @@ class PGPool:
     pgp_num: int = 0  # 0 -> pg_num
     # erasure pools carry their code profile (pg_pool_t erasure_code_profile)
     ec_profile: dict = field(default_factory=dict)
+    # pool snapshots (pg_pool_t::snaps + snap_seq): snapid -> name
+    snap_seq: int = 0
+    snaps: dict = field(default_factory=dict)
 
     def __post_init__(self):
         if self.pgp_num == 0:
