@@ -16,7 +16,8 @@ import threading
 from ceph_tpu.common.context import CephTpuContext
 from ceph_tpu.messages import MMonCommand, MMonCommandAck, MOSDMapMsg, MOSDOp
 from ceph_tpu.messages.osd_msgs import (
-    MWatchNotify, MWatchNotifyAck, OP_NOTIFY, OP_UNWATCH, OP_WATCH)
+    MWatchNotify, MWatchNotifyAck, OP_CALL, OP_NOTIFY, OP_UNWATCH,
+    OP_WATCH)
 from ceph_tpu.messages.osd_msgs import (
     OP_DELETE, OP_OMAP_GET, OP_OMAP_SET, OP_READ, OP_STAT, OP_WRITE,
     OP_WRITEFULL, OSDOpField)
@@ -322,6 +323,14 @@ class IoCtx:
         self.client._watch_cbs.pop((self.pool_id, oid), None)
         self.client.operate(self.pool_id, oid,
                             [OSDOpField(OP_UNWATCH, 0, 0)])
+
+    def execute(self, oid: str, cls: str, method: str,
+                inp: bytes = b"") -> bytes:
+        """Run an in-OSD object class method (librados exec)."""
+        data = cls.encode() + b"\0" + method.encode() + b"\0" + inp
+        r = self.client.operate(self.pool_id, oid,
+                                [OSDOpField(OP_CALL, 0, 0, data)])
+        return r.ops[0].data if r.ops else b""
 
     def notify(self, oid: str, payload: bytes = b"") -> None:
         """Fan payload out to every watcher; returns once all acked

@@ -1,0 +1,75 @@
+"""Compressor plugin registry (src/compressor/ analog — the same
+named-plugin pattern as the erasure-code registry; the reference's QAT
+hook is the precedent for hardware-offloaded plugins behind this API).
+
+Plugins: zlib and lzma (stdlib-backed; the reference's
+snappy/zstd/lz4 are external libs this image doesn't carry) plus an
+identity "none".
+"""
+
+from __future__ import annotations
+
+import lzma
+import threading
+import zlib
+
+
+class Compressor:
+    name = "none"
+
+    def compress(self, data: bytes) -> bytes:
+        return data
+
+    def decompress(self, data: bytes) -> bytes:
+        return data
+
+
+class ZlibCompressor(Compressor):
+    name = "zlib"
+
+    def __init__(self, level: int = 5):
+        self.level = level
+
+    def compress(self, data: bytes) -> bytes:
+        return zlib.compress(data, self.level)
+
+    def decompress(self, data: bytes) -> bytes:
+        return zlib.decompress(data)
+
+
+class LzmaCompressor(Compressor):
+    name = "lzma"
+
+    def compress(self, data: bytes) -> bytes:
+        return lzma.compress(data)
+
+    def decompress(self, data: bytes) -> bytes:
+        return lzma.decompress(data)
+
+
+_LOCK = threading.Lock()
+_FACTORIES = {
+    "none": Compressor,
+    "zlib": ZlibCompressor,
+    "lzma": LzmaCompressor,
+}
+
+
+def register(name: str, factory) -> None:
+    with _LOCK:
+        _FACTORIES[name] = factory
+
+
+def create(name: str, **kw) -> Compressor:
+    """Compressor::create (compressor/Compressor.h:97)."""
+    with _LOCK:
+        factory = _FACTORIES.get(name)
+    if factory is None:
+        raise KeyError(f"compressor {name!r} unknown; "
+                       f"known: {sorted(_FACTORIES)}")
+    return factory(**kw)
+
+
+def names() -> list[str]:
+    with _LOCK:
+        return sorted(_FACTORIES)

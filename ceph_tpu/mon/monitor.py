@@ -511,6 +511,8 @@ class Monitor(Dispatcher):
             if prefix == "osd pool rmsnap":
                 pool_id = int(cmd["pool"])
                 name = str(cmd["snap"])
+                if name not in self.osdmap.pools[pool_id].snaps.values():
+                    return f"snap {name!r} does not exist", -2
 
                 def fn(m: OSDMap):
                     p = m.pools[pool_id]

@@ -47,8 +47,8 @@ from ceph_tpu.messages import (
     MOSDECSubOpWriteReply, MOSDFailure, MOSDMapMsg, MOSDOp, MOSDOpReply,
     MOSDPing, MOSDRepOp, MOSDRepOpReply)
 from ceph_tpu.messages.osd_msgs import (
-    OP_DELETE, OP_NOTIFY, OP_OMAP_GET, OP_OMAP_SET, OP_READ, OP_STAT,
-    OP_UNWATCH, OP_WATCH, OP_WRITE, OP_WRITEFULL, MOSDScrub,
+    OP_CALL, OP_DELETE, OP_NOTIFY, OP_OMAP_GET, OP_OMAP_SET, OP_READ,
+    OP_STAT, OP_UNWATCH, OP_WATCH, OP_WRITE, OP_WRITEFULL, MOSDScrub,
     MOSDScrubReply, MWatchNotify, MWatchNotifyAck, OSDOpField)
 from ceph_tpu.messages.peering_msgs import MOSDPGLog, MOSDPGNotify, MOSDPGQuery
 from ceph_tpu.mon.monitor import MMonSubscribe, MOSDBoot
@@ -287,6 +287,17 @@ class OSDDaemon(Dispatcher):
                     and now - st.get("started", now) > 8.0]
                 for gid, _st in stuck_rmw:
                     self._ec_reads.pop(gid, None)
+                # a dead watcher never acks: expire its notifies so the
+                # notifier gets its reply instead of a client timeout
+                stale_notifies = [
+                    nid for nid, st in self._notifies.items()
+                    if now - st.get("started", now) > 5.0]
+                expired = [self._notifies.pop(nid)
+                           for nid in stale_notifies]
+            for st in expired:
+                m = st["msg"]
+                m.connection.send_message(MOSDOpReply(
+                    tid=m.tid, result=0, epoch=self.osdmap.epoch))
             for _gid, st in stuck_rmw:
                 self._ec_read_give_up(st)
             for pg in pgs:
@@ -1124,6 +1135,26 @@ class OSDDaemon(Dispatcher):
             elif op.op == OP_NOTIFY:
                 self._start_notify(msg, op)
                 return   # replied when watchers ack (or timeout)
+            elif op.op == OP_CALL:
+                # in-OSD object class (ClassHandler::ClassMethod::exec)
+                from ceph_tpu import cls as _cls
+                try:
+                    cname, method, inp = op.data.split(b"\0", 2)
+                    handler = _cls.lookup(cname.decode(), method.decode())
+                    if handler is None:
+                        result = -95   # EOPNOTSUPP
+                    else:
+                        ctx = _cls.ClsContext(self.store, t, cid, msg.oid)
+                        out = handler(ctx, inp)
+                        if ctx.mutated:
+                            is_write = True
+                            is_delete = False
+                        reply_ops.append(OSDOpField(OP_CALL, 0, 0,
+                                                    out or b""))
+                except PermissionError:
+                    result = -13   # EACCES (e.g. cls_lock contention)
+                except Exception:
+                    result = -22
             else:
                 result = -22
         if not is_write or result != 0:
@@ -1160,7 +1191,8 @@ class OSDDaemon(Dispatcher):
             t.setattr(cid, msg.oid, "_v", enc_version(entry.version))
         self.store.apply_transaction(t)
         replicas = [o for o in up if o != self.osd_id and o != CEPH_NOSD]
-        reply = MOSDOpReply(tid=msg.tid, result=0, epoch=self.osdmap.epoch)
+        reply = MOSDOpReply(tid=msg.tid, result=0, epoch=self.osdmap.epoch,
+                            ops=reply_ops)
         if not replicas:
             self.perf.tinc("op_w_latency", time.time() - t0)
             msg.connection.send_message(reply)
@@ -1972,9 +2004,10 @@ class OSDDaemon(Dispatcher):
                 except KeyError:
                     continue
                 attrs = {}
-                v = self._getattr_safe(cid, oid, "_v")
-                if v:
-                    attrs["_v"] = v
+                for name in ("_v", "snapc", "from_seq"):
+                    v = self._getattr_safe(cid, oid, name)
+                    if v:
+                        attrs[name] = v
                 for o, val in vals.items():
                     if o == self.osd_id or val == want:
                         continue

@@ -1,0 +1,5 @@
+"""Client-side object services (src/osdc/ analog)."""
+
+from .striper import StripeLayout, Striper, StripedObject
+
+__all__ = ["StripeLayout", "Striper", "StripedObject"]

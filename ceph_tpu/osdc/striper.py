@@ -1,0 +1,138 @@
+"""Striper — RAID-0 of a byte stream over RADOS objects
+(src/osdc/Striper.cc + src/libradosstriper/ analog; the framework's
+"long-context" scaling primitive: one large logical stream spread over
+many independently-placed objects so reads/writes parallelize across
+PGs and OSDs).
+
+Layout follows file_layout_t: stripe_unit bytes per strip, stripe_count
+objects per stripe row, object_size bytes per object.  Logical offset →
+(object number, object offset) exactly as Striper::file_to_extents.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class StripeLayout:
+    """file_layout_t subset."""
+
+    stripe_unit: int = 1 << 16
+    stripe_count: int = 4
+    object_size: int = 1 << 22
+
+    def __post_init__(self):
+        if self.object_size % self.stripe_unit:
+            raise ValueError("object_size must be a multiple of "
+                             "stripe_unit")
+
+    def extents(self, offset: int, length: int):
+        """[(objno, obj_off, len)] covering [offset, offset+length)
+        (Striper::file_to_extents)."""
+        su, sc = self.stripe_unit, self.stripe_count
+        per_obj = self.object_size // su    # stripe units per object
+        out = []
+        pos = offset
+        end = offset + length
+        while pos < end:
+            blockno = pos // su
+            stripeno = blockno // sc
+            stripepos = blockno % sc
+            objectsetno = stripeno // per_obj
+            objectno = objectsetno * sc + stripepos
+            block_off = pos % su
+            obj_off = (stripeno % per_obj) * su + block_off
+            n = min(su - block_off, end - pos)
+            out.append((objectno, obj_off, n))
+            pos += n
+        return out
+
+
+class Striper:
+    """Pure layout math, shared by StripedObject / rbd."""
+
+    def __init__(self, layout: StripeLayout):
+        self.layout = layout
+
+    def object_name(self, prefix: str, objno: int) -> str:
+        return f"{prefix}.{objno:016x}"
+
+
+class StripedObject:
+    """A large logical object striped over an IoCtx
+    (libradosstriper surface: write/read/truncate-ish + size)."""
+
+    SIZE_KEY = "striper.size"
+
+    def __init__(self, ioctx, name: str,
+                 layout: StripeLayout | None = None):
+        self.io = ioctx
+        self.name = name
+        self.layout = layout or StripeLayout()
+        self.striper = Striper(self.layout)
+
+    def _size_obj(self) -> str:
+        return f"{self.name}.meta"
+
+    def size(self) -> int:
+        try:
+            omap = self.io.get_omap(self._size_obj())
+        except OSError:
+            return 0
+        blob = omap.get(self.SIZE_KEY)
+        return int(blob.decode()) if blob else 0
+
+    def _set_size(self, size: int) -> None:
+        self.io.set_omap(self._size_obj(),
+                         {self.SIZE_KEY: str(size).encode()})
+
+    def write(self, data: bytes, offset: int = 0) -> None:
+        pos = 0
+        for objno, obj_off, n in self.layout.extents(offset, len(data)):
+            self.io.write(self.striper.object_name(self.name, objno),
+                          data[pos:pos + n], offset=obj_off)
+            pos += n
+        if offset + len(data) > self.size():
+            self._set_size(offset + len(data))
+
+    def read(self, offset: int = 0, length: int = 0) -> bytes:
+        total = self.size()
+        if length <= 0 or offset + length > total:
+            length = max(0, total - offset)
+        parts = []
+        for objno, obj_off, n in self.layout.extents(offset, length):
+            try:
+                chunk = self.io.read(
+                    self.striper.object_name(self.name, objno),
+                    length=n, offset=obj_off)
+            except OSError:
+                chunk = b""
+            if len(chunk) < n:          # sparse hole: zero-fill
+                chunk = chunk + bytes(n - len(chunk))
+            parts.append(chunk)
+        return b"".join(parts)
+
+    def truncate(self, new_size: int) -> None:
+        """Zero the bytes beyond new_size and shrink the logical size
+        (discarded data must not resurface on a later grow)."""
+        total = self.size()
+        if new_size < total:
+            self.write(bytes(total - new_size), offset=new_size)
+        self._set_size(new_size)
+
+    def remove(self) -> None:
+        total = self.size()
+        seen = set()
+        for objno, _off, _n in self.layout.extents(0, max(total, 1)):
+            seen.add(objno)
+        for objno in seen:
+            try:
+                self.io.remove(self.striper.object_name(self.name,
+                                                        objno))
+            except OSError:
+                pass
+        try:
+            self.io.remove(self._size_obj())
+        except OSError:
+            pass

@@ -1,0 +1,116 @@
+"""librbd-lite — block images striped over RADOS objects
+(src/librbd/ analog: ImageRequest -> ObjectRequest over a striped
+layout; header object + rbd_data.<id>.<objno> data objects).
+
+An image is a fixed-size virtual block device: create/open/read/write
+at arbitrary byte offsets, resize, stat, remove, plus snapshot
+read-back riding the pool-snapshot machinery underneath.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ceph_tpu.osdc.striper import StripeLayout, StripedObject
+
+
+class Image:
+    HEADER_FMT = "rbd_header.{name}"
+    DATA_FMT = "rbd_data.{name}"
+
+    def __init__(self, ioctx, name: str):
+        self.io = ioctx
+        self.name = name
+        self._meta = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @classmethod
+    def create(cls, ioctx, name: str, size: int,
+               order: int = 22, stripe_unit: int = 1 << 16,
+               stripe_count: int = 4) -> "Image":
+        """order = log2(object size), like rbd create --order."""
+        header = cls.HEADER_FMT.format(name=name)
+        exists = True
+        try:
+            ioctx.stat(header)
+        except OSError:
+            exists = False
+        if exists:
+            raise FileExistsError(f"image {name!r} exists")
+        meta = {"size": size, "order": order,
+                "stripe_unit": stripe_unit,
+                "stripe_count": stripe_count}
+        ioctx.write_full(header, json.dumps(meta).encode())
+        img = cls(ioctx, name)
+        img._meta = meta
+        return img
+
+    def _load(self) -> dict:
+        if self._meta is None:
+            blob = self.io.read(self.HEADER_FMT.format(name=self.name))
+            self._meta = json.loads(blob.decode())
+        return self._meta
+
+    def _striped(self) -> StripedObject:
+        m = self._load()
+        layout = StripeLayout(stripe_unit=m["stripe_unit"],
+                              stripe_count=m["stripe_count"],
+                              object_size=1 << m["order"])
+        return StripedObject(self.io, self.DATA_FMT.format(name=self.name),
+                             layout)
+
+    # -- I/O ------------------------------------------------------------------
+
+    def stat(self) -> dict:
+        m = self._load()
+        return {"size": m["size"], "order": m["order"],
+                "stripe_unit": m["stripe_unit"],
+                "stripe_count": m["stripe_count"]}
+
+    def write(self, data: bytes, offset: int = 0) -> int:
+        m = self._load()
+        if offset + len(data) > m["size"]:
+            raise ValueError("write past end of image")
+        self._striped().write(data, offset)
+        return len(data)
+
+    def read(self, offset: int = 0, length: int = 0) -> bytes:
+        m = self._load()
+        if length <= 0 or offset + length > m["size"]:
+            length = max(0, m["size"] - offset)
+        data = self._striped().read(offset, length)
+        if len(data) < length:      # unwritten space reads as zeros
+            data = data + bytes(length - len(data))
+        return data
+
+    def resize(self, new_size: int) -> None:
+        m = self._load()
+        if new_size < m["size"]:
+            # shrink trims the discarded extent (real rbd semantics):
+            # growing back later must read zeros, not stale payload
+            self._striped().truncate(new_size)
+        m["size"] = new_size
+        self.io.write_full(self.HEADER_FMT.format(name=self.name),
+                           json.dumps(m).encode())
+
+    def remove(self) -> None:
+        self._striped().remove()
+        try:
+            self.io.remove(self.HEADER_FMT.format(name=self.name))
+        except OSError:
+            pass
+        self._meta = None
+
+
+def list_images(ioctx, probe: list[str]) -> list[str]:
+    """Images among candidate names (no pool listing primitive yet —
+    the reference keeps an rbd_directory object; callers track names)."""
+    out = []
+    for name in probe:
+        try:
+            ioctx.stat(Image.HEADER_FMT.format(name=name))
+            out.append(name)
+        except OSError:
+            continue
+    return out

@@ -1,0 +1,105 @@
+"""rgw-lite — object-gateway semantics over RADOS (src/rgw/ analog,
+collapsed to the storage mapping: buckets are omap index objects,
+gateway objects stripe over RADOS objects, metadata rides omap — the
+same rgw_rados.cc layout idea without the HTTP frontends).
+
+Surface: create/delete bucket, put/get/delete/list/head object, with
+optional transparent compression via the compressor registry.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from ceph_tpu import compressor as _compressor
+from ceph_tpu.osdc.striper import StripeLayout, StripedObject
+
+#: ONE layout for both put and get — a mismatch would remap logical
+#: offsets to different objects between write and read
+_LAYOUT = StripeLayout(stripe_unit=1 << 16, stripe_count=2,
+                       object_size=1 << 22)
+
+
+class Bucket:
+    INDEX_FMT = ".bucket.index.{name}"
+
+    def __init__(self, ioctx, name: str, compression: str = "none"):
+        self.io = ioctx
+        self.name = name
+        self.comp = _compressor.create(compression)
+        self.compression = compression
+
+    # -- bucket lifecycle -----------------------------------------------------
+
+    def create(self) -> "Bucket":
+        self.io.set_omap(self.INDEX_FMT.format(name=self.name),
+                         {".bucket.meta": json.dumps(
+                             {"created": time.time(),
+                              "compression": self.compression}).encode()})
+        return self
+
+    def exists(self) -> bool:
+        try:
+            self.io.stat(self.INDEX_FMT.format(name=self.name))
+            return True
+        except OSError:
+            return False
+
+    def delete(self) -> None:
+        if self.list():
+            raise OSError(39, "bucket not empty")   # ENOTEMPTY
+        self.io.remove(self.INDEX_FMT.format(name=self.name))
+
+    # -- objects --------------------------------------------------------------
+
+    def _data_name(self, key: str) -> str:
+        return f".bucket.data.{self.name}.{key}"
+
+    def put(self, key: str, data: bytes,
+            metadata: dict | None = None) -> None:
+        blob = self.comp.compress(data)
+        so = StripedObject(self.io, self._data_name(key), _LAYOUT)
+        so.remove()
+        so.write(blob)
+        entry = {"size": len(data), "stored": len(blob),
+                 "mtime": time.time(), "meta": metadata or {},
+                 "compression": self.comp.name}
+        self.io.set_omap(self.INDEX_FMT.format(name=self.name),
+                         {f"obj.{key}": json.dumps(entry).encode()})
+
+    def head(self, key: str) -> dict:
+        omap = self.io.get_omap(self.INDEX_FMT.format(name=self.name))
+        blob = omap.get(f"obj.{key}")
+        if not blob:          # absent, or the b"" deletion tombstone
+            raise KeyError(key)
+        return json.loads(blob.decode())
+
+    def get(self, key: str) -> bytes:
+        entry = self.head(key)
+        so = StripedObject(self.io, self._data_name(key), _LAYOUT)
+        raw = so.read(0, entry["stored"])
+        comp = _compressor.create(entry.get("compression", "none"))
+        return comp.decompress(raw[:entry["stored"]])
+
+    def delete_object(self, key: str) -> None:
+        self.head(key)   # KeyError if absent
+        StripedObject(self.io, self._data_name(key), _LAYOUT).remove()
+        # omap_rm via set of tombstone: the client API lacks rmkeys;
+        # store an explicit deletion marker and filter it in list()
+        self.io.set_omap(self.INDEX_FMT.format(name=self.name),
+                         {f"obj.{key}": b""})
+
+    def list(self, prefix: str = "") -> list[str]:
+        try:
+            omap = self.io.get_omap(self.INDEX_FMT.format(name=self.name))
+        except OSError:
+            return []
+        out = []
+        for k, v in omap.items():
+            if not k.startswith("obj.") or not v:
+                continue
+            key = k[4:]
+            if key.startswith(prefix):
+                out.append(key)
+        return sorted(out)

@@ -1,0 +1,168 @@
+"""Services tier on RADOS (VERDICT missing item 10): striper, rbd-lite
+block images, in-OSD object classes (cls), rgw-lite buckets, and the
+compressor plugin registry."""
+
+import json
+
+import pytest
+
+from ceph_tpu.osdc.striper import StripeLayout, StripedObject
+from ceph_tpu.tools.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osds=3, ms_type="loopback").start()
+    c.wait_for_osd_count(3)
+    try:
+        yield c
+    finally:
+        c.stop()
+
+
+@pytest.fixture(scope="module")
+def io(cluster):
+    client = cluster.client(timeout=15.0)
+    pool = cluster.create_pool(client, pg_num=8, size=3)
+    return client.open_ioctx(pool)
+
+
+class TestStriper:
+    def test_extent_math(self):
+        lay = StripeLayout(stripe_unit=16, stripe_count=2, object_size=32)
+        # 2 su per object; su 0->obj0, su 1->obj1, su 2->obj0(second),
+        # su 3->obj1(second), su 4->obj2...
+        assert lay.extents(0, 16) == [(0, 0, 16)]
+        assert lay.extents(16, 16) == [(1, 0, 16)]
+        assert lay.extents(32, 16) == [(0, 16, 16)]
+        assert lay.extents(64, 16) == [(2, 0, 16)]
+        assert lay.extents(8, 16) == [(0, 8, 8), (1, 0, 8)]
+
+    def test_striped_object_roundtrip(self, io):
+        so = StripedObject(io, "big",
+                           StripeLayout(stripe_unit=1024,
+                                        stripe_count=3,
+                                        object_size=4096))
+        payload = bytes(range(256)) * 64      # 16 KiB over many objects
+        so.write(payload)
+        assert so.size() == len(payload)
+        assert so.read() == payload
+        assert so.read(5000, 1000) == payload[5000:6000]
+        so.write(b"#" * 100, offset=2000)
+        want = payload[:2000] + b"#" * 100 + payload[2100:]
+        assert so.read() == want
+        so.remove()
+        assert so.size() == 0
+
+
+class TestRbd:
+    def test_image_lifecycle(self, io):
+        from ceph_tpu.rbd import Image, list_images
+        img = Image.create(io, "disk0", size=1 << 20, order=16)
+        assert img.stat()["size"] == 1 << 20
+        img.write(b"bootsector" * 51, offset=0)
+        img.write(b"data-at-512k", offset=512 * 1024)
+        assert img.read(0, 510) == (b"bootsector" * 51)
+        assert img.read(512 * 1024, 12) == b"data-at-512k"
+        # unwritten space reads as zeros
+        assert img.read(900 * 1024, 64) == bytes(64)
+        with pytest.raises(ValueError):
+            img.write(b"x", offset=1 << 20)
+        img.resize(2 << 20)
+        img.write(b"grown", offset=(1 << 20) + 5)
+        assert img.read((1 << 20) + 5, 5) == b"grown"
+        assert list_images(io, ["disk0", "nope"]) == ["disk0"]
+        img.remove()
+        assert list_images(io, ["disk0"]) == []
+
+
+class TestCls:
+    def test_lock_class(self, io):
+        io.write_full("locked", b"x")
+        out = io.execute("locked", "lock", "lock",
+                         json.dumps({"owner": "alice"}).encode())
+        assert out == b"{}"
+        info = json.loads(io.execute("locked", "lock", "info"))
+        assert info["holder"] == "alice"
+        # contention -> EACCES
+        with pytest.raises(OSError):
+            io.execute("locked", "lock", "lock",
+                       json.dumps({"owner": "bob"}).encode())
+        io.execute("locked", "lock", "unlock",
+                   json.dumps({"owner": "alice"}).encode())
+        assert json.loads(io.execute("locked", "lock",
+                                     "info"))["holder"] is None
+
+    def test_numops_and_version(self, io):
+        io.write_full("ctr", b"")
+        for want in (5, 8):
+            out = json.loads(io.execute(
+                "ctr", "numops", "add",
+                json.dumps({"key": "hits", "val": 5 if want == 5
+                            else 3}).encode()))
+            assert out["value"] == want
+        v1 = json.loads(io.execute("ctr", "version", "bump"))["ver"]
+        v2 = json.loads(io.execute("ctr", "version", "bump"))["ver"]
+        assert (v1, v2) == (1, 2)
+        # cls mutations replicate: read the omap through the data path
+        omap = io.get_omap("ctr")
+        assert omap["hits"] == b"8"
+
+    def test_unknown_class_errors(self, io):
+        io.write_full("u", b"x")
+        with pytest.raises(OSError):
+            io.execute("u", "no_such", "method")
+
+
+class TestRgw:
+    def test_bucket_object_lifecycle(self, io):
+        from ceph_tpu.rgw_lite import Bucket
+        b = Bucket(io, "photos", compression="zlib").create()
+        assert b.exists()
+        body = b"jpegjpegjpeg" * 500
+        b.put("2026/cat.jpg", body, metadata={"content-type":
+                                              "image/jpeg"})
+        b.put("2026/dog.jpg", b"woof")
+        b.put("notes.txt", b"hello")
+        assert b.get("2026/cat.jpg") == body
+        head = b.head("2026/cat.jpg")
+        assert head["size"] == len(body)
+        assert head["stored"] < len(body)      # compression worked
+        assert head["meta"]["content-type"] == "image/jpeg"
+        assert b.list() == ["2026/cat.jpg", "2026/dog.jpg", "notes.txt"]
+        assert b.list(prefix="2026/") == ["2026/cat.jpg", "2026/dog.jpg"]
+        b.delete_object("2026/dog.jpg")
+        assert b.list(prefix="2026/") == ["2026/cat.jpg"]
+        with pytest.raises(OSError):
+            b.delete()                         # not empty
+        for k in b.list():
+            b.delete_object(k)
+        b.delete()
+        assert not b.exists()
+
+
+class TestCompressor:
+    def test_registry_roundtrip(self):
+        from ceph_tpu import compressor
+        data = b"compressible " * 1000
+        for name in compressor.names():
+            c = compressor.create(name)
+            assert c.decompress(c.compress(data)) == data
+        with pytest.raises(KeyError):
+            compressor.create("snappy")
+
+    def test_custom_plugin_registration(self):
+        from ceph_tpu import compressor
+
+        class Rot13(compressor.Compressor):
+            name = "rot13"
+
+            def compress(self, data):
+                return bytes((b + 13) % 256 for b in data)
+
+            def decompress(self, data):
+                return bytes((b - 13) % 256 for b in data)
+
+        compressor.register("rot13", Rot13)
+        c = compressor.create("rot13")
+        assert c.decompress(c.compress(b"abc")) == b"abc"
