@@ -1,0 +1,148 @@
+"""Manager daemon — non-consensus cluster aggregation (src/mgr/ analog).
+
+OSDs stream MMgrReport (perf counters + per-PG states) on their tick;
+the mgr aggregates into the views the reference's mgr modules serve:
+cluster health/df summaries, a PG state histogram (the balancer input),
+and per-OSD op counters (prometheus-module shape, minus HTTP).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ceph_tpu.messages import MOSDMapMsg
+from ceph_tpu.msg.encoding import Decoder, Encoder
+from ceph_tpu.msg.message import Message, register_message
+from ceph_tpu.msg.messenger import (
+    ConnectionPolicy, Dispatcher, EntityName, Messenger)
+from ceph_tpu.osd.map_codec import decode_osdmap
+from ceph_tpu.osd.osdmap import OSDMap
+
+
+@register_message
+class MMgrReport(Message):
+    """osd -> mgr: perf counters + pg states (messages/MMgrReport.h)."""
+
+    TYPE = 0x701
+
+    def __init__(self, osd_id: int = 0, counters: dict | None = None,
+                 pg_states: dict | None = None, num_objects: int = 0,
+                 bytes_used: int = 0):
+        super().__init__()
+        self.osd_id = osd_id
+        self.counters = counters or {}
+        self.pg_states = pg_states or {}
+        self.num_objects = num_objects
+        self.bytes_used = bytes_used
+
+    def encode_payload(self, enc: Encoder):
+        enc.versioned(1, 1, lambda e: (
+            e.s32(self.osd_id),
+            e.map(self.counters, lambda e2, k: e2.str(k),
+                  lambda e2, v: e2.u64(int(v))),
+            e.map(self.pg_states, lambda e2, k: e2.str(k),
+                  lambda e2, v: e2.u32(v)),
+            e.u64(self.num_objects), e.u64(self.bytes_used)))
+
+    def decode_payload(self, dec: Decoder, version):
+        def body(d, v):
+            self.osd_id = d.s32()
+            self.counters = d.map(lambda d2: d2.str(),
+                                  lambda d2: d2.u64())
+            self.pg_states = d.map(lambda d2: d2.str(),
+                                   lambda d2: d2.u32())
+            self.num_objects = d.u64()
+            self.bytes_used = d.u64()
+        dec.versioned(1, body)
+
+
+class MgrDaemon(Dispatcher):
+    """DaemonServer + ActivePyModules, collapsed: collect reports,
+    serve aggregate views."""
+
+    def __init__(self, mon_addr: str, ms_type: str = "async",
+                 addr: str = "127.0.0.1:0", auth_key=None):
+        self.mon_addr = mon_addr
+        self.name = EntityName("mgr", 0)
+        self.osdmap = OSDMap()
+        self._lock = threading.Lock()
+        #: osd -> (last report time, MMgrReport)
+        self.reports: dict[int, tuple[float, MMgrReport]] = {}
+        self.msgr = Messenger.create(self.name, ms_type)
+        self.msgr.set_auth(auth_key)
+        self.msgr.set_policy("osd", ConnectionPolicy.stateful_server())
+        self.msgr.set_policy("mon", ConnectionPolicy.stateful_peer())
+        self.msgr.add_dispatcher_tail(self)
+        self._addr = addr
+
+    def init(self) -> None:
+        self.msgr.bind(self._addr)
+        self.msgr.start()
+        from ceph_tpu.mon.monitor import MMonSubscribe
+        for rank, a in enumerate(
+                [x for x in self.mon_addr.split(",") if x]):
+            con = self.msgr.connect_to(a, EntityName("mon", rank))
+            con.send_message(MMonSubscribe(name=str(self.name),
+                                           addr=self.msgr.my_addr))
+
+    def shutdown(self) -> None:
+        self.msgr.shutdown()
+
+    @property
+    def addr(self) -> str:
+        return self.msgr.my_addr
+
+    def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, MMgrReport):
+            with self._lock:
+                self.reports[msg.osd_id] = (time.time(), msg)
+            return True
+        if isinstance(msg, MOSDMapMsg):
+            self.osdmap = decode_osdmap(msg.map_blob)
+            return True
+        return False
+
+    # -- aggregate views (mgr module surface) ---------------------------------
+
+    def pg_summary(self) -> dict:
+        """PG state histogram across OSD reports (`ceph status` pgs)."""
+        out: dict[str, int] = {}
+        with self._lock:
+            for _t, rep in self.reports.values():
+                for state, n in rep.pg_states.items():
+                    out[state] = out.get(state, 0) + n
+        return out
+
+    def df(self) -> dict:
+        with self._lock:
+            return {
+                "total_objects": sum(r.num_objects
+                                     for _t, r in self.reports.values()),
+                "total_bytes_used": sum(
+                    r.bytes_used for _t, r in self.reports.values()),
+                "per_osd": {o: {"objects": r.num_objects,
+                                "bytes": r.bytes_used}
+                            for o, (_t, r) in self.reports.items()},
+            }
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {o: dict(r.counters)
+                    for o, (_t, r) in self.reports.items()}
+
+    def health(self, stale_after: float = 10.0) -> dict:
+        now = time.time()
+        with self._lock:
+            stale = [o for o, (t, _r) in self.reports.items()
+                     if now - t > stale_after]
+        checks = []
+        if stale:
+            checks.append({"check": "MGR_STALE_REPORTS", "osds": stale})
+        summary = self.pg_summary()
+        degraded = sum(n for s, n in summary.items()
+                       if s not in ("active", "replica"))
+        if degraded:
+            checks.append({"check": "PG_DEGRADED", "count": degraded})
+        return {"status": "HEALTH_OK" if not checks else "HEALTH_WARN",
+                "checks": checks}

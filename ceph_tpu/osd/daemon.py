@@ -166,7 +166,8 @@ class OSDDaemon(Dispatcher):
                  ctx: CephTpuContext | None = None,
                  store_type: str = "memstore", store_path: str = "",
                  ms_type: str = "async", addr: str = "127.0.0.1:0",
-                 heartbeats: bool = True, auth_key=None):
+                 heartbeats: bool = True, auth_key=None,
+                 mgr_addr: str | None = None):
         self.osd_id = osd_id
         self.whoami = EntityName("osd", osd_id)
         self.ctx = ctx or CephTpuContext(f"osd.{osd_id}")
@@ -174,6 +175,7 @@ class OSDDaemon(Dispatcher):
         #: reports go to every mon — the leader executes, peons ignore
         self.mon_addr = mon_addr
         self.mon_addrs = [a for a in mon_addr.split(",") if a]
+        self.mgr_addr = mgr_addr
         self.store = create_objectstore(store_type, store_path)
         self.osdmap = OSDMap()
         self._lock = threading.RLock()
@@ -272,10 +274,35 @@ class OSDDaemon(Dispatcher):
         self._tick_timer.daemon = True
         self._tick_timer.start()
 
+    def _mgr_report(self) -> None:
+        if not self.mgr_addr:
+            return
+        from ceph_tpu.mgr import MMgrReport
+        states: dict[str, int] = {}
+        n_obj = n_bytes = 0
+        with self._lock:
+            for pg in self.pgs.values():
+                states[pg.state] = states.get(pg.state, 0) + 1
+        for cid in self.store.list_collections():
+            try:
+                for oid in self.store.list_objects(cid):
+                    if oid.startswith(PG.PGMETA):
+                        continue
+                    n_obj += 1
+                    n_bytes += self.store.stat(cid, oid)["size"]
+            except KeyError:
+                continue
+        counters = dict(self.perf._u64)
+        con = self.msgr.connect_to(self.mgr_addr, EntityName("mgr", 0))
+        con.send_message(MMgrReport(
+            osd_id=self.osd_id, counters=counters, pg_states=states,
+            num_objects=n_obj, bytes_used=n_bytes))
+
     def _tick(self) -> None:
         try:
             now = time.time()
             self._maybe_reboot()
+            self._mgr_report()
             with self._lock:
                 pgs = list(self.pgs.values())
                 # rmw gathers have no client resend to rescue them: a

@@ -1,0 +1,84 @@
+"""The `ceph` CLI (src/ceph.in analog): argv -> JSON mon command ->
+leader (any mon forwards), printing the JSON/text reply.
+
+    python -m ceph_tpu.tools.ceph_cli -m 127.0.0.1:6789 status
+    python -m ceph_tpu.tools.ceph_cli -m ... osd tree
+    python -m ceph_tpu.tools.ceph_cli -m ... osd pool create pg_num=8 size=3
+    python -m ceph_tpu.tools.ceph_cli -m ... osd out 3
+    python -m ceph_tpu.tools.ceph_cli -m ... osd pool mksnap pool=1 snap=s1
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+#: prefix -> positional argument names (mirrors MonCommands.h schemas)
+COMMANDS = {
+    ("status",): [],
+    ("quorum_status",): [],
+    ("osd", "tree"): [],
+    ("osd", "getmap"): [],
+    ("osd", "pool", "create"): [],
+    ("osd", "pool", "set"): ["pool", "var", "val"],
+    ("osd", "pool", "mksnap"): [],
+    ("osd", "pool", "rmsnap"): [],
+    ("osd", "out"): ["id"],
+    ("osd", "in"): ["id"],
+    ("osd", "down"): ["id"],
+}
+
+
+def parse_command(words: list[str]) -> dict:
+    """Longest matching prefix wins; remaining words become positional
+    schema args or key=value pairs."""
+    for n in range(min(3, len(words)), 0, -1):
+        key = tuple(words[:n])
+        if key in COMMANDS:
+            cmd = {"prefix": " ".join(key)}
+            rest = words[n:]
+            schema = COMMANDS[key]
+            pos = 0
+            for w in rest:
+                if "=" in w:
+                    k, v = w.split("=", 1)
+                    cmd[k] = v
+                elif pos < len(schema):
+                    cmd[schema[pos]] = w
+                    pos += 1
+                else:
+                    raise ValueError(f"unexpected argument {w!r}")
+            return cmd
+    raise ValueError(f"unknown command {' '.join(words)!r}; known: "
+                     + ", ".join(" ".join(k) for k in sorted(COMMANDS)))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ceph")
+    ap.add_argument("-m", "--mon-host", required=True,
+                    help="comma-separated monitor addresses")
+    ap.add_argument("--timeout", type=float, default=15.0)
+    ap.add_argument("--auth-key", default=None)
+    ap.add_argument("words", nargs="+")
+    args = ap.parse_args(argv)
+    try:
+        cmd = parse_command(args.words)
+    except ValueError as e:
+        print(e, file=sys.stderr)
+        return 22
+    from ceph_tpu.client.rados import RadosClient
+    client = RadosClient(args.mon_host, timeout=args.timeout,
+                         auth_key=args.auth_key)
+    try:
+        client.msgr.bind("127.0.0.1:0")
+        client.msgr.start()
+        res, out = client.mon_command(cmd)
+        print(out)
+        return -res if res < 0 else res
+    finally:
+        client.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

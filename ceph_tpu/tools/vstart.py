@@ -39,6 +39,7 @@ class MiniCluster:
         self._n_initial = n_osds
         self._n_mons = n_mons
         self.auth_key = auth_key
+        self.mgr = None
 
     @property
     def mon(self) -> Monitor:
@@ -91,6 +92,17 @@ class MiniCluster:
         mon = self.mons.pop(mon_id)
         mon.shutdown()
 
+    def run_mgr(self):
+        """Start the manager; OSDs started AFTERWARDS stream reports
+        to it (restart existing ones to pick it up)."""
+        from ceph_tpu.mgr import MgrDaemon
+        addr = ("127.0.0.1:0" if self.ms_type == "async"
+                else f"{self._ns}mgr.0")
+        self.mgr = MgrDaemon(self.mon_host, ms_type=self.ms_type,
+                             addr=addr, auth_key=self.auth_key)
+        self.mgr.init()
+        return self.mgr
+
     def run_osd(self, osd_id: int) -> OSDDaemon:
         addr = (f"127.0.0.1:0" if self.ms_type == "async"
                 else f"{self._ns}osd.{osd_id}")
@@ -98,7 +110,8 @@ class MiniCluster:
         osd = OSDDaemon(osd_id, self.mon_host, store_type=self.store_type,
                         store_path=path, ms_type=self.ms_type, addr=addr,
                         heartbeats=self.heartbeats,
-                        auth_key=self.auth_key)
+                        auth_key=self.auth_key,
+                        mgr_addr=self.mgr.addr if self.mgr else None)
         osd.init()
         self.osds[osd_id] = osd
         return osd
@@ -121,6 +134,8 @@ class MiniCluster:
         for osd in list(self.osds.values()):
             osd.shutdown()
         self.osds.clear()
+        if self.mgr:
+            self.mgr.shutdown()
         for mon in list(self.mons.values()):
             mon.shutdown()
         self.mons.clear()

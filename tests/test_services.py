@@ -166,3 +166,56 @@ class TestCompressor:
         compressor.register("rot13", Rot13)
         c = compressor.create("rot13")
         assert c.decompress(c.compress(b"abc")) == b"abc"
+
+
+class TestMgrAndCli:
+    def test_mgr_aggregates_reports(self):
+        c = MiniCluster(n_osds=3, ms_type="loopback").start()
+        try:
+            c.run_mgr()
+            # restart osds so they pick up the mgr address
+            for i in list(c.osds):
+                c.kill_osd(i)
+                c.run_osd(i)
+            c.wait_for_osd_count(3)
+            client = c.client(timeout=15.0)
+            pool = c.create_pool(client, pg_num=8, size=3)
+            io = client.open_ioctx(pool)
+            for i in range(6):
+                io.write_full(f"m{i}", b"x" * 500)
+            import time as _t
+            deadline = _t.time() + 10
+            while _t.time() < deadline:
+                df = c.mgr.df()
+                if len(df["per_osd"]) == 3 and df["total_objects"] > 0:
+                    break
+                _t.sleep(0.2)
+            df = c.mgr.df()
+            assert len(df["per_osd"]) == 3
+            assert df["total_objects"] >= 6   # replicas count per-osd
+            assert c.mgr.pg_summary().get("active", 0) > 0
+            assert c.mgr.health()["status"] in ("HEALTH_OK",
+                                                "HEALTH_WARN")
+            ctrs = c.mgr.counters()
+            assert any(v.get("op_w", 0) > 0 for v in ctrs.values())
+        finally:
+            c.stop()
+
+    def test_ceph_cli_parses_and_runs(self):
+        from ceph_tpu.tools.ceph_cli import main, parse_command
+        cmd = parse_command(["osd", "pool", "create", "pg_num=8",
+                             "size=3"])
+        assert cmd == {"prefix": "osd pool create", "pg_num": "8",
+                       "size": "3"}
+        assert parse_command(["osd", "out", "3"]) == {
+            "prefix": "osd out", "id": "3"}
+        c = MiniCluster(n_osds=3, ms_type="async").start()
+        try:
+            c.wait_for_osd_count(3)
+            rc = main(["-m", c.mon_host, "status"])
+            assert rc == 0
+            rc = main(["-m", c.mon_host, "osd", "pool", "create",
+                       "pg_num=4", "size=2"])
+            assert rc == 0
+        finally:
+            c.stop()
