@@ -1,0 +1,129 @@
+"""ICI messenger stack — the device mesh as a transport behind the
+Messenger API (SURVEY §5's mapping: the reference's pluggable
+NetworkStack family {posix, rdma, dpdk} becomes {tcp, loopback, ICI},
+with the entity-addressed Messenger surface unchanged).
+
+Control frames (op headers, acks, maps, peering) ride the in-process
+queue exactly like the loopback stack.  BULK PAYLOADS — EC shard chunks
+in MOSDECSubOpWrite / MOSDECSubOpReadReply — are split out of the frame
+and moved through the jax device mesh instead: the sender places the
+chunk on the RECEIVER's device (jax.device_put — an ICI hop on real
+multi-chip hardware, a real cross-device placement on the CPU test
+mesh), and the frame carries only a token the receiver redeems.  The
+OSD daemons are completely unaware: the stack IS the abstraction, so
+the EC data path and the mesh data path are one code path.
+
+Device assignment: osd.N <-> jax.devices()[N % ndevices] — each OSD
+"owns" a mesh position, so a k+m shard fan-out lands one chunk per
+device, exactly the sharded-encode layout of parallel/sharded.py.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .loopback import LoopbackConnection, LoopbackMessenger
+from .message import Message
+from .messenger import EntityName
+
+_MARKER = b"\x00ICI\x00"
+
+
+class IciTransport:
+    """Process-wide staged-buffer registry (the 'wire' is device HBM)."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        import jax
+        self.jax = jax
+        self.devices = jax.devices()
+        self._bufs: dict[int, object] = {}
+        self._seq = 0
+        self._reg_lock = threading.Lock()
+        self.bytes_staged = 0
+        self.transfers = 0
+
+    @classmethod
+    def instance(cls) -> "IciTransport":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def device_for(self, name: EntityName):
+        idx = name.id if name.type == "osd" else 0
+        return self.devices[idx % len(self.devices)]
+
+    def stage(self, chunk: bytes, peer: EntityName) -> bytes:
+        """Place the payload on the peer's device; returns the token the
+        frame carries instead of the bytes."""
+        import jax.numpy as jnp
+        arr = jnp.asarray(np.frombuffer(chunk, dtype=np.uint8))
+        buf = self.jax.device_put(arr, self.device_for(peer))
+        with self._reg_lock:
+            self._seq += 1
+            token = self._seq
+            self._bufs[token] = buf
+            self.bytes_staged += len(chunk)
+            self.transfers += 1
+        return _MARKER + token.to_bytes(8, "little")
+
+    def redeem(self, blob: bytes) -> bytes:
+        token = int.from_bytes(blob[len(_MARKER):], "little")
+        with self._reg_lock:
+            buf = self._bufs.pop(token, None)
+        if buf is None:
+            raise KeyError(f"ici token {token} already redeemed")
+        return np.asarray(buf).tobytes()
+
+    @staticmethod
+    def is_token(blob: bytes) -> bool:
+        return blob.startswith(_MARKER)
+
+
+def _bulk_field(msg: Message):
+    """The bulk-payload attribute of data-plane messages, if any."""
+    from ceph_tpu.messages.osd_msgs import (
+        MOSDECSubOpReadReply, MOSDECSubOpWrite)
+    from ceph_tpu.osd.daemon import MOSDPGPush
+    if isinstance(msg, (MOSDECSubOpWrite, MOSDECSubOpReadReply)):
+        return "chunk"
+    if isinstance(msg, MOSDPGPush):
+        return "data"
+    return None
+
+
+class IciConnection(LoopbackConnection):
+    #: payloads below this stay in the control frame
+    BULK_THRESHOLD = 512
+
+    def send_message(self, msg: Message) -> None:
+        field = _bulk_field(msg)
+        if field is not None and self.peer_name is not None:
+            payload = getattr(msg, field)
+            if (len(payload) >= self.BULK_THRESHOLD
+                    and not IciTransport.is_token(payload)):
+                setattr(msg, field,
+                        IciTransport.instance().stage(payload,
+                                                      self.peer_name))
+        super().send_message(msg)
+
+
+class IciMessenger(LoopbackMessenger):
+    """Loopback control plane + device-mesh data plane."""
+
+    def _make_connection(self, addr: str, peer_name):
+        return IciConnection(self, addr, peer_name)
+
+    def deliver(self, msg: Message) -> bool:
+        field = _bulk_field(msg)
+        if field is not None:
+            payload = getattr(msg, field)
+            if IciTransport.is_token(payload):
+                setattr(msg, field,
+                        IciTransport.instance().redeem(payload))
+        return super().deliver(msg)

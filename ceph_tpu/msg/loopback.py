@@ -64,8 +64,11 @@ class LoopbackMessenger(Messenger):
             with _registry_lock:
                 _registry.pop(self.my_addr, None)
 
-    def connect_to(self, addr: str, peer_name: EntityName) -> Connection:
+    def _make_connection(self, addr: str, peer_name):
         return LoopbackConnection(self, addr, peer_name)
+
+    def connect_to(self, addr: str, peer_name: EntityName) -> Connection:
+        return self._make_connection(addr, peer_name)
 
     # -- internals ------------------------------------------------------------
 
@@ -82,8 +85,8 @@ class LoopbackMessenger(Messenger):
             # one bad frame or handler bug must not kill the delivery thread
             try:
                 msg = Message.decode(data)
-                msg.connection = LoopbackConnection(
-                    self, sender.my_addr, sender.my_name)
+                msg.connection = self._make_connection(
+                    sender.my_addr, sender.my_name)
                 self.deliver(msg)
             except Exception:
                 get_logger("ms").exception(

@@ -112,6 +112,9 @@ class Messenger:
         if mtype == "loopback":
             from .loopback import LoopbackMessenger
             return LoopbackMessenger(name, **kw)
+        if mtype == "ici":
+            from .ici import IciMessenger
+            return IciMessenger(name, **kw)
         raise ValueError(f"unknown messenger type {mtype!r}")
 
     # -- dispatcher chain (Messenger.h:337-352) -------------------------------
