@@ -1,0 +1,347 @@
+"""BlueStore-lite — a disk-backed object store in the BlueStore shape
+(src/os/bluestore/: raw block device + RocksDB metadata).
+
+Architecture mirrors the reference's split:
+
+  block file       object DATA lives in fixed-size extents of one flat
+                   file ("the raw device"), handed out by a bitmap
+                   allocator (BitmapAllocator analog) and returned on
+                   delete/overwrite-shrink — data is NOT resident in
+                   RAM, every read hits the block file.
+  KV (LogDB)       all METADATA — per-object extent maps, sizes, attrs,
+                   omap, collection membership — in the append-only KV
+                   store standing in for RocksDB, giving atomic
+                   transaction commits and replay-on-mount for free.
+
+A Transaction commits as: write data extents to the block file, fsync,
+then commit ONE KV transaction with every metadata mutation — the same
+ordering BlueStore's deferred/direct write paths guarantee (data is
+durable before the metadata that references it).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from .kv import LogDB
+from .objectstore import ObjectStore
+from .transaction import (
+    OP_CLONE, OP_MKCOLL, OP_OMAP_RMKEYS, OP_OMAP_SETKEYS, OP_REMOVE,
+    OP_RMCOLL, OP_SETATTR, OP_TOUCH, OP_TRUNCATE, OP_WRITE, OP_ZERO,
+    Transaction)
+
+BLOCK = 4096          # allocation unit ("min_alloc_size")
+
+
+class BitmapAllocator:
+    """Free-extent tracking over the block file
+    (os/bluestore/BitmapAllocator analog, byte-per-block granularity)."""
+
+    def __init__(self):
+        self._free: set[int] = set()
+        self._next = 0
+        self._lock = threading.Lock()
+
+    def allocate(self, n_blocks: int) -> list[int]:
+        with self._lock:
+            out = []
+            while self._free and len(out) < n_blocks:
+                out.append(self._free.pop())
+            while len(out) < n_blocks:
+                out.append(self._next)
+                self._next += 1
+            return sorted(out)
+
+    def release(self, blocks: list[int]) -> None:
+        with self._lock:
+            self._free.update(blocks)
+
+    def state(self) -> tuple[int, list[int]]:
+        with self._lock:
+            return self._next, sorted(self._free)
+
+    def restore(self, next_block: int, free: list[int]) -> None:
+        with self._lock:
+            self._next = next_block
+            self._free = set(free)
+
+
+def _okey(cid: str, oid: str) -> str:
+    return f"{cid}\x00{oid}"
+
+
+class BlueStoreLite(ObjectStore):
+    """ObjectStore on a block file + KV metadata."""
+
+    def __init__(self, path: str):
+        if not path:
+            raise ValueError("bluestore needs a directory path")
+        self.path = path
+        self._block_path = os.path.join(path, "block")
+        self._db = LogDB(os.path.join(path, "kv"))
+        self._alloc = BitmapAllocator()
+        self._f = None
+        self._lock = threading.RLock()
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def mkfs(self) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        open(self._block_path, "wb").close()
+        for p in (os.path.join(self.path, "kv"),):
+            if os.path.exists(p):
+                os.unlink(p)
+
+    def mkfs_if_needed(self) -> None:
+        if not os.path.exists(self._block_path):
+            self.mkfs()
+
+    def mount(self) -> None:
+        self._db.open()
+        self._f = open(self._block_path, "r+b")
+        st = self._db.get("meta", "allocator")
+        if st:
+            import json
+            d = json.loads(st.decode())
+            self._alloc.restore(d["next"], d["free"])
+
+    def umount(self) -> None:
+        if self._f is not None:
+            import json
+            nxt, free = self._alloc.state()
+            t = self._db.get_transaction()
+            t.set("meta", "allocator",
+                  json.dumps({"next": nxt, "free": free}).encode())
+            self._db.submit_transaction(t)
+            self._f.close()
+            self._f = None
+        self._db.close()
+
+    # -- metadata helpers -----------------------------------------------------
+
+    def _meta(self, cid: str, oid: str) -> dict | None:
+        blob = self._db.get("obj", _okey(cid, oid))
+        if blob is None:
+            return None
+        import json
+        return json.loads(blob.decode())
+
+    def _put_meta(self, kvt, cid: str, oid: str, meta: dict) -> None:
+        import json
+        kvt.set("obj", _okey(cid, oid), json.dumps(meta).encode())
+
+    @staticmethod
+    def _new_meta() -> dict:
+        return {"size": 0, "extents": [], "attrs": {}, "omap": {}}
+
+    # -- block I/O ------------------------------------------------------------
+
+    def _read_block(self, block: int) -> bytes:
+        self._f.seek(block * BLOCK)
+        data = self._f.read(BLOCK)
+        return data + bytes(BLOCK - len(data))
+
+    def _write_block(self, block: int, data: bytes) -> None:
+        self._f.seek(block * BLOCK)
+        self._f.write(data[:BLOCK].ljust(BLOCK, b"\x00"))
+
+    def _obj_read(self, meta: dict, offset: int, length: int) -> bytes:
+        out = bytearray()
+        end = min(offset + length, meta["size"])
+        pos = offset
+        while pos < end:
+            bi = pos // BLOCK
+            boff = pos % BLOCK
+            n = min(BLOCK - boff, end - pos)
+            if bi < len(meta["extents"]) and meta["extents"][bi] >= 0:
+                blk = self._read_block(meta["extents"][bi])
+                out += blk[boff:boff + n]
+            else:
+                out += bytes(n)     # hole
+            pos += n
+        return bytes(out)
+
+    def _obj_write(self, meta: dict, offset: int, data: bytes) -> None:
+        end = offset + len(data)
+        need_blocks = -(-max(end, meta["size"]) // BLOCK)
+        while len(meta["extents"]) < need_blocks:
+            meta["extents"].append(-1)
+        pos = offset
+        di = 0
+        while pos < end:
+            bi = pos // BLOCK
+            boff = pos % BLOCK
+            n = min(BLOCK - boff, end - pos)
+            if meta["extents"][bi] < 0:
+                meta["extents"][bi] = self._alloc.allocate(1)[0]
+                old = bytes(BLOCK)
+            else:
+                old = self._read_block(meta["extents"][bi])
+            patched = (old[:boff] + data[di:di + n]
+                       + old[boff + n:])
+            self._write_block(meta["extents"][bi], patched)
+            pos += n
+            di += n
+        meta["size"] = max(meta["size"], end)
+
+    def _obj_truncate(self, meta: dict, length: int) -> None:
+        if length < meta["size"]:
+            keep = -(-length // BLOCK) if length else 0
+            freed = [b for b in meta["extents"][keep:] if b >= 0]
+            if freed:
+                self._alloc.release(freed)
+            meta["extents"] = meta["extents"][:keep]
+            # zero the tail of the boundary block
+            if length % BLOCK and meta["extents"] \
+                    and meta["extents"][-1] >= 0:
+                blk = self._read_block(meta["extents"][-1])
+                self._write_block(meta["extents"][-1],
+                                  blk[:length % BLOCK])
+        meta["size"] = length
+
+    # -- transactions ---------------------------------------------------------
+
+    def queue_transactions(self, txns, on_commit=None) -> None:
+        with self._lock:
+            kvt = self._db.get_transaction()
+            cache: dict[tuple, dict | None] = {}
+
+            def get(cid, oid):
+                key = (cid, oid)
+                if key not in cache:
+                    cache[key] = self._meta(cid, oid)
+                return cache[key]
+
+            def ensure(cid, oid):
+                if self._db.get("coll", cid) is None \
+                        and ("__coll__", cid) not in cache:
+                    raise KeyError(f"no collection {cid!r}")
+                m = get(cid, oid)
+                if m is None:
+                    m = self._new_meta()
+                    cache[(cid, oid)] = m
+                return m
+
+            for t in txns:
+                for op in t.ops:
+                    if op.op == OP_MKCOLL:
+                        kvt.set("coll", op.cid, b"1")
+                        cache[("__coll__", op.cid)] = {}
+                    elif op.op == OP_RMCOLL:
+                        kvt.rmkey("coll", op.cid)
+                    elif op.op == OP_TOUCH:
+                        ensure(op.cid, op.oid)
+                    elif op.op == OP_WRITE:
+                        m = ensure(op.cid, op.oid)
+                        self._obj_write(m, op.offset, op.data)
+                    elif op.op == OP_ZERO:
+                        m = ensure(op.cid, op.oid)
+                        self._obj_write(m, op.offset,
+                                        bytes(op.length))
+                    elif op.op == OP_TRUNCATE:
+                        m = ensure(op.cid, op.oid)
+                        self._obj_truncate(m, op.length)
+                    elif op.op == OP_REMOVE:
+                        m = get(op.cid, op.oid)
+                        if m is not None:
+                            self._alloc.release(
+                                [b for b in m["extents"] if b >= 0])
+                        cache[(op.cid, op.oid)] = None
+                        kvt.rmkey("obj", _okey(op.cid, op.oid))
+                    elif op.op == OP_OMAP_SETKEYS:
+                        m = ensure(op.cid, op.oid)
+                        for k, v in op.keys.items():
+                            m["omap"][k] = v.hex()
+                    elif op.op == OP_OMAP_RMKEYS:
+                        m = ensure(op.cid, op.oid)
+                        for k in op.rmkeys:
+                            m["omap"].pop(k, None)
+                    elif op.op == OP_SETATTR:
+                        m = ensure(op.cid, op.oid)
+                        m["attrs"][op.name] = op.data.hex()
+                    elif op.op == OP_CLONE:
+                        m = get(op.cid, op.oid)
+                        if m is None:
+                            continue
+                        dst = self._new_meta()
+                        dst["size"] = m["size"]
+                        dst["attrs"] = dict(m["attrs"])
+                        dst["omap"] = dict(m["omap"])
+                        # COW-free simple clone: copy the data blocks
+                        for bi, src in enumerate(m["extents"]):
+                            if src < 0:
+                                dst["extents"].append(-1)
+                                continue
+                            nb = self._alloc.allocate(1)[0]
+                            self._write_block(nb,
+                                              self._read_block(src))
+                            dst["extents"].append(nb)
+                        cache[(op.cid, op.dest)] = dst
+            # data before metadata: fsync the block file, then ONE
+            # atomic KV commit referencing it
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            for (cid, oid), m in cache.items():
+                if cid == "__coll__":
+                    continue
+                if m is not None:
+                    self._put_meta(kvt, cid, oid, m)
+            self._db.submit_transaction(kvt)
+        if on_commit:
+            on_commit()
+
+    def apply_transaction(self, txn: Transaction) -> None:
+        self.queue_transactions([txn])
+
+    # -- reads ----------------------------------------------------------------
+
+    def _get_checked(self, cid: str, oid: str) -> dict:
+        if self._db.get("coll", cid) is None:
+            raise KeyError(f"no collection {cid!r}")
+        m = self._meta(cid, oid)
+        if m is None:
+            raise KeyError(f"no object {cid}/{oid}")
+        return m
+
+    def read(self, cid, oid, offset=0, length=None) -> bytes:
+        with self._lock:
+            m = self._get_checked(cid, oid)
+            if length is None:
+                length = m["size"] - offset
+            return self._obj_read(m, offset, max(0, length))
+
+    def stat(self, cid, oid) -> dict:
+        with self._lock:
+            return {"size": self._get_checked(cid, oid)["size"]}
+
+    def exists(self, cid, oid) -> bool:
+        with self._lock:
+            return (self._db.get("coll", cid) is not None
+                    and self._meta(cid, oid) is not None)
+
+    def list_objects(self, cid) -> list[str]:
+        with self._lock:
+            if self._db.get("coll", cid) is None:
+                raise KeyError(f"no collection {cid!r}")
+            prefix = f"{cid}\x00"
+            out = []
+            for k in self._db.get_range("obj"):
+                if k.startswith(prefix):
+                    out.append(k[len(prefix):])
+            return sorted(out)
+
+    def list_collections(self) -> list[str]:
+        with self._lock:
+            return sorted(self._db.get_range("coll"))
+
+    def omap_get(self, cid, oid) -> dict:
+        with self._lock:
+            m = self._get_checked(cid, oid)
+            return {k: bytes.fromhex(v) for k, v in m["omap"].items()}
+
+    def getattr(self, cid, oid, name):
+        with self._lock:
+            m = self._get_checked(cid, oid)
+            v = m["attrs"].get(name)
+            return bytes.fromhex(v) if v is not None else None

@@ -339,4 +339,7 @@ def create(store_type: str, path: str = "") -> ObjectStore:
         return MemStore(path)
     if store_type == "filestore":
         return FileStore(path)
+    if store_type == "bluestore":
+        from .bluestore import BlueStoreLite
+        return BlueStoreLite(path)
     raise ValueError(f"unknown objectstore type {store_type!r}")
