@@ -131,6 +131,12 @@ class MgrDaemon(Dispatcher):
             return {o: dict(r.counters)
                     for o, (_t, r) in self.reports.items()}
 
+    def balance_plan(self, **kw) -> list[dict]:
+        """Balancer module in upmap mode: mon commands that flatten the
+        per-OSD PG histogram of the mgr's current osdmap."""
+        from ceph_tpu.balancer import plan_commands
+        return plan_commands(self.osdmap, **kw)
+
     def health(self, stale_after: float = 10.0) -> dict:
         now = time.time()
         with self._lock:

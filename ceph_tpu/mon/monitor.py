@@ -524,6 +524,41 @@ class Monitor(Dispatcher):
                 if not self._mutate(fn):
                     return "commit failed", -11
                 return "removed", 0
+            if prefix == "osd pg-upmap-items":
+                pool_id, ps = (int(x) for x in
+                               str(cmd["pgid"]).split("."))
+                flat = [int(x) for x in cmd["id_pairs"]]
+                if len(flat) % 2:
+                    return "id_pairs must be from,to pairs", -22
+                pairs = [(flat[i], flat[i + 1])
+                         for i in range(0, len(flat), 2)]
+                if pool_id not in self.osdmap.pools:
+                    return f"pool {pool_id} does not exist", -2
+                if ps >= self.osdmap.pools[pool_id].pg_num:
+                    return f"pg {pool_id}.{ps} does not exist", -2
+                if not all(self.osdmap.exists(t) for _f, t in pairs):
+                    return "destination osd does not exist", -2
+
+                def fn(m: OSDMap):
+                    if pairs:
+                        m.pg_upmap_items[(pool_id, ps)] = pairs
+                    else:
+                        m.pg_upmap_items.pop((pool_id, ps), None)
+                if not self._mutate(fn):
+                    return "commit failed", -11
+                return json.dumps({"pgid": f"{pool_id}.{ps}",
+                                   "pairs": pairs}), 0
+            if prefix == "osd rm-pg-upmap-items":
+                pool_id, ps = (int(x) for x in
+                               str(cmd["pgid"]).split("."))
+                if (pool_id, ps) not in self.osdmap.pg_upmap_items:
+                    return "no upmap items for pg", -2
+
+                def fn(m: OSDMap):
+                    m.pg_upmap_items.pop((pool_id, ps), None)
+                if not self._mutate(fn):
+                    return "commit failed", -11
+                return "removed", 0
             if prefix == "osd getmap":
                 return json.dumps({"epoch": self.osdmap.epoch}), 0
             return f"unknown command {prefix!r}", -22

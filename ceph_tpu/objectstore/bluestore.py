@@ -6,22 +6,30 @@ Architecture mirrors the reference's split:
   block file       object DATA lives in fixed-size extents of one flat
                    file ("the raw device"), handed out by a bitmap
                    allocator (BitmapAllocator analog) and returned on
-                   delete/overwrite-shrink — data is NOT resident in
-                   RAM, every read hits the block file.
+                   delete/overwrite — data is NOT resident in RAM,
+                   every read hits the block file.
   KV (LogDB)       all METADATA — per-object extent maps, sizes, attrs,
                    omap, collection membership — in the append-only KV
                    store standing in for RocksDB, giving atomic
                    transaction commits and replay-on-mount for free.
 
-A Transaction commits as: write data extents to the block file, fsync,
-then commit ONE KV transaction with every metadata mutation — the same
-ordering BlueStore's deferred/direct write paths guarantee (data is
-durable before the metadata that references it).
+Crash consistency is BlueStore's: block-content updates are
+COPY-ON-WRITE (a patched block lands in a freshly allocated extent;
+the object's extent map flips to it only inside the KV commit), data
+is fsync'd before the ONE KV transaction that references it, and the
+displaced blocks return to the allocator only after that commit
+succeeds.  A crash anywhere leaves the old metadata pointing at
+untouched old blocks.  The allocator itself is never trusted from a
+snapshot: mount rebuilds the free list from the committed extent maps
+(BlueStore fsck/allocation-recovery analog), so a hard kill can never
+resurrect in-use blocks as free.
 """
 
 from __future__ import annotations
 
+import json
 import os
+import shutil
 import threading
 
 from .kv import LogDB
@@ -36,7 +44,7 @@ BLOCK = 4096          # allocation unit ("min_alloc_size")
 
 class BitmapAllocator:
     """Free-extent tracking over the block file
-    (os/bluestore/BitmapAllocator analog, byte-per-block granularity)."""
+    (os/bluestore/BitmapAllocator analog, block granularity)."""
 
     def __init__(self):
         self._free: set[int] = set()
@@ -56,10 +64,6 @@ class BitmapAllocator:
     def release(self, blocks: list[int]) -> None:
         with self._lock:
             self._free.update(blocks)
-
-    def state(self) -> tuple[int, list[int]]:
-        with self._lock:
-            return self._next, sorted(self._free)
 
     def restore(self, next_block: int, free: list[int]) -> None:
         with self._lock:
@@ -83,15 +87,20 @@ class BlueStoreLite(ObjectStore):
         self._alloc = BitmapAllocator()
         self._f = None
         self._lock = threading.RLock()
+        #: blocks displaced by the in-flight transaction batch; returned
+        #: to the allocator only after its KV commit lands
+        self._freed: list[int] = []
 
     # -- lifecycle ------------------------------------------------------------
 
     def mkfs(self) -> None:
         os.makedirs(self.path, exist_ok=True)
         open(self._block_path, "wb").close()
-        for p in (os.path.join(self.path, "kv"),):
-            if os.path.exists(p):
-                os.unlink(p)
+        kv = os.path.join(self.path, "kv")
+        if os.path.isdir(kv):
+            shutil.rmtree(kv)
+        elif os.path.exists(kv):
+            os.unlink(kv)
 
     def mkfs_if_needed(self) -> None:
         if not os.path.exists(self._block_path):
@@ -100,20 +109,19 @@ class BlueStoreLite(ObjectStore):
     def mount(self) -> None:
         self._db.open()
         self._f = open(self._block_path, "r+b")
-        st = self._db.get("meta", "allocator")
-        if st:
-            import json
-            d = json.loads(st.decode())
-            self._alloc.restore(d["next"], d["free"])
+        # rebuild the allocator from the committed extent maps — the
+        # only crash-safe source of truth (fsck-style recovery; a
+        # snapshot written at umount would be stale after a hard kill
+        # and hand out live blocks)
+        used: set[int] = set()
+        for blob in self._db.get_range("obj").values():
+            meta = json.loads(blob.decode())
+            used.update(b for b in meta["extents"] if b >= 0)
+        nxt = max(used) + 1 if used else 0
+        self._alloc.restore(nxt, sorted(set(range(nxt)) - used))
 
     def umount(self) -> None:
         if self._f is not None:
-            import json
-            nxt, free = self._alloc.state()
-            t = self._db.get_transaction()
-            t.set("meta", "allocator",
-                  json.dumps({"next": nxt, "free": free}).encode())
-            self._db.submit_transaction(t)
             self._f.close()
             self._f = None
         self._db.close()
@@ -124,11 +132,9 @@ class BlueStoreLite(ObjectStore):
         blob = self._db.get("obj", _okey(cid, oid))
         if blob is None:
             return None
-        import json
         return json.loads(blob.decode())
 
     def _put_meta(self, kvt, cid: str, oid: str, meta: dict) -> None:
-        import json
         kvt.set("obj", _okey(cid, oid), json.dumps(meta).encode())
 
     @staticmethod
@@ -173,31 +179,65 @@ class BlueStoreLite(ObjectStore):
             bi = pos // BLOCK
             boff = pos % BLOCK
             n = min(BLOCK - boff, end - pos)
-            if meta["extents"][bi] < 0:
-                meta["extents"][bi] = self._alloc.allocate(1)[0]
-                old = bytes(BLOCK)
+            old_block = meta["extents"][bi]
+            if boff == 0 and n == BLOCK:
+                patched = data[di:di + n]      # full block: no read
+            elif old_block >= 0:
+                old = self._read_block(old_block)
+                patched = old[:boff] + data[di:di + n] + old[boff + n:]
             else:
-                old = self._read_block(meta["extents"][bi])
-            patched = (old[:boff] + data[di:di + n]
-                       + old[boff + n:])
-            self._write_block(meta["extents"][bi], patched)
+                patched = bytes(boff) + data[di:di + n]
+            # COW: never touch a committed block in place — the old
+            # extent stays valid until the KV commit flips the map
+            nb = self._alloc.allocate(1)[0]
+            self._write_block(nb, patched)
+            meta["extents"][bi] = nb
+            if old_block >= 0:
+                self._freed.append(old_block)
             pos += n
             di += n
         meta["size"] = max(meta["size"], end)
 
+    def _obj_zero(self, meta: dict, offset: int, length: int) -> None:
+        """Punch holes instead of writing zeros: full blocks drop to
+        extent -1 (reads synthesize zeros), edges COW-patch."""
+        end = offset + length
+        pos = offset
+        while pos < end:
+            bi = pos // BLOCK
+            boff = pos % BLOCK
+            n = min(BLOCK - boff, end - pos)
+            if bi < len(meta["extents"]) and meta["extents"][bi] >= 0:
+                if boff == 0 and n == BLOCK:
+                    self._freed.append(meta["extents"][bi])
+                    meta["extents"][bi] = -1
+                else:
+                    old = self._read_block(meta["extents"][bi])
+                    nb = self._alloc.allocate(1)[0]
+                    self._write_block(nb, old[:boff] + bytes(n)
+                                      + old[boff + n:])
+                    self._freed.append(meta["extents"][bi])
+                    meta["extents"][bi] = nb
+            pos += n
+        if end > meta["size"]:
+            while len(meta["extents"]) < -(-end // BLOCK):
+                meta["extents"].append(-1)
+            meta["size"] = end
+
     def _obj_truncate(self, meta: dict, length: int) -> None:
         if length < meta["size"]:
             keep = -(-length // BLOCK) if length else 0
-            freed = [b for b in meta["extents"][keep:] if b >= 0]
-            if freed:
-                self._alloc.release(freed)
+            self._freed.extend(b for b in meta["extents"][keep:]
+                               if b >= 0)
             meta["extents"] = meta["extents"][:keep]
-            # zero the tail of the boundary block
+            # zero the tail of the boundary block (COW)
             if length % BLOCK and meta["extents"] \
                     and meta["extents"][-1] >= 0:
                 blk = self._read_block(meta["extents"][-1])
-                self._write_block(meta["extents"][-1],
-                                  blk[:length % BLOCK])
+                nb = self._alloc.allocate(1)[0]
+                self._write_block(nb, blk[:length % BLOCK])
+                self._freed.append(meta["extents"][-1])
+                meta["extents"][-1] = nb
         meta["size"] = length
 
     # -- transactions ---------------------------------------------------------
@@ -206,6 +246,12 @@ class BlueStoreLite(ObjectStore):
         with self._lock:
             kvt = self._db.get_transaction()
             cache: dict[tuple, dict | None] = {}
+            self._freed = []
+
+            def coll_exists(cid):
+                if ("__coll__", cid) in cache:
+                    return cache[("__coll__", cid)] is not None
+                return self._db.get("coll", cid) is not None
 
             def get(cid, oid):
                 key = (cid, oid)
@@ -214,8 +260,7 @@ class BlueStoreLite(ObjectStore):
                 return cache[key]
 
             def ensure(cid, oid):
-                if self._db.get("coll", cid) is None \
-                        and ("__coll__", cid) not in cache:
+                if not coll_exists(cid):
                     raise KeyError(f"no collection {cid!r}")
                 m = get(cid, oid)
                 if m is None:
@@ -223,13 +268,28 @@ class BlueStoreLite(ObjectStore):
                     cache[(cid, oid)] = m
                 return m
 
+            def drop(cid, oid):
+                m = get(cid, oid)
+                if m is not None:
+                    self._freed.extend(b for b in m["extents"]
+                                       if b >= 0)
+                cache[(cid, oid)] = None
+
             for t in txns:
                 for op in t.ops:
                     if op.op == OP_MKCOLL:
-                        kvt.set("coll", op.cid, b"1")
                         cache[("__coll__", op.cid)] = {}
                     elif op.op == OP_RMCOLL:
-                        kvt.rmkey("coll", op.cid)
+                        # purge the collection's objects too (MemStore
+                        # drops the whole dict; the backends must agree)
+                        prefix = f"{op.cid}\x00"
+                        for k in self._db.get_range("obj"):
+                            if k.startswith(prefix):
+                                drop(op.cid, k[len(prefix):])
+                        for (cid, oid), m in list(cache.items()):
+                            if cid == op.cid and m is not None:
+                                drop(cid, oid)
+                        cache[("__coll__", op.cid)] = None
                     elif op.op == OP_TOUCH:
                         ensure(op.cid, op.oid)
                     elif op.op == OP_WRITE:
@@ -237,18 +297,12 @@ class BlueStoreLite(ObjectStore):
                         self._obj_write(m, op.offset, op.data)
                     elif op.op == OP_ZERO:
                         m = ensure(op.cid, op.oid)
-                        self._obj_write(m, op.offset,
-                                        bytes(op.length))
+                        self._obj_zero(m, op.offset, op.length)
                     elif op.op == OP_TRUNCATE:
                         m = ensure(op.cid, op.oid)
                         self._obj_truncate(m, op.length)
                     elif op.op == OP_REMOVE:
-                        m = get(op.cid, op.oid)
-                        if m is not None:
-                            self._alloc.release(
-                                [b for b in m["extents"] if b >= 0])
-                        cache[(op.cid, op.oid)] = None
-                        kvt.rmkey("obj", _okey(op.cid, op.oid))
+                        drop(op.cid, op.oid)
                     elif op.op == OP_OMAP_SETKEYS:
                         m = ensure(op.cid, op.oid)
                         for k, v in op.keys.items():
@@ -262,14 +316,17 @@ class BlueStoreLite(ObjectStore):
                         m["attrs"][op.name] = op.data.hex()
                     elif op.op == OP_CLONE:
                         m = get(op.cid, op.oid)
-                        if m is None:
+                        if m is None:   # missing src: no-op (MemStore)
                             continue
+                        prev = get(op.cid, op.dest)
+                        if prev is not None:   # overwrite: free old
+                            self._freed.extend(
+                                b for b in prev["extents"] if b >= 0)
                         dst = self._new_meta()
                         dst["size"] = m["size"]
                         dst["attrs"] = dict(m["attrs"])
                         dst["omap"] = dict(m["omap"])
-                        # COW-free simple clone: copy the data blocks
-                        for bi, src in enumerate(m["extents"]):
+                        for src in m["extents"]:
                             if src < 0:
                                 dst["extents"].append(-1)
                                 continue
@@ -279,15 +336,30 @@ class BlueStoreLite(ObjectStore):
                             dst["extents"].append(nb)
                         cache[(op.cid, op.dest)] = dst
             # data before metadata: fsync the block file, then ONE
-            # atomic KV commit referencing it
+            # atomic KV commit referencing it.  Displaced blocks return
+            # to the allocator only after the commit — a crash (or an
+            # exception above) leaves old metadata over untouched old
+            # blocks; blocks this batch allocated then leak in-memory
+            # only, and the next mount's rebuild reclaims them.
             self._f.flush()
             os.fsync(self._f.fileno())
+            # the KV mutations come from the FINAL cache state, never
+            # eagerly per-op: a KV transaction applies sets before rms,
+            # so a remove+recreate of one key in a batch (recovery's
+            # replace-wholesale push) must collapse to a single set
             for (cid, oid), m in cache.items():
                 if cid == "__coll__":
-                    continue
-                if m is not None:
+                    if m is not None:
+                        kvt.set("coll", oid, b"1")
+                    else:
+                        kvt.rmkey("coll", oid)
+                elif m is not None:
                     self._put_meta(kvt, cid, oid, m)
+                else:
+                    kvt.rmkey("obj", _okey(cid, oid))
             self._db.submit_transaction(kvt)
+            self._alloc.release(self._freed)
+            self._freed = []
         if on_commit:
             on_commit()
 

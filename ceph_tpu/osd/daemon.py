@@ -181,6 +181,8 @@ class OSDDaemon(Dispatcher):
         self._lock = threading.RLock()
         self.pgs: dict[tuple[int, int], PG] = {}
         self._in_flight: dict[tuple[int, int], _InFlight] = {}
+        #: ops from clients ahead of our map; flushed on map advance
+        self._waiting_for_map: list[MOSDOp] = []
         #: reqid -> EC read/recovery state
         self._ec_reads: dict[tuple[int, int], dict] = {}
         self._recover_tid = 0
@@ -436,6 +438,13 @@ class OSDDaemon(Dispatcher):
         del oldmap
         dout("osd", 5, "osd.%d got map epoch %d", self.osd_id, newmap.epoch)
         self._scan_pgs()
+        with self._lock:
+            waiting = [m for m in self._waiting_for_map
+                       if m.epoch <= newmap.epoch]
+            self._waiting_for_map = [m for m in self._waiting_for_map
+                                     if m.epoch > newmap.epoch]
+        for m in waiting:
+            self._handle_op(m)
 
     def _pg_cid(self, pgid) -> str:
         return f"{pgid[0]}.{pgid[1]}"
@@ -991,6 +1000,14 @@ class OSDDaemon(Dispatcher):
         return up, acting_primary
 
     def _handle_op(self, msg: MOSDOp) -> None:
+        if msg.epoch > self.osdmap.epoch:
+            # client runs a newer map than us: park the op until our mon
+            # subscription catches us up (OSD::wait_for_new_map), never
+            # judge primaryship with a stale map
+            with self._lock:
+                if msg.epoch > self.osdmap.epoch:
+                    self._waiting_for_map.append(msg)
+                    return
         pool = self.osdmap.pools.get(msg.pgid[0])
         if pool is None:
             self._reply_err(msg, -2)
@@ -1013,6 +1030,15 @@ class OSDDaemon(Dispatcher):
         # slip into a waiting list just after its last flush ran
         with self._lock:
             pg = self.pgs.get(msg.pgid)
+            if pg is None and 0 <= msg.pgid[1] < pool.pg_num:
+                # op raced ahead of _scan_pgs creating this PG on the
+                # new map: create it, start its peering round now (the
+                # scan may already be past this pgid), park the op;
+                # activation flushes waiting_for_active
+                pg = self._get_pg(msg.pgid)
+                pg.waiting_for_active.append(msg)
+                self._start_peering(pg, up, primary)
+                return
             if pg is None or pg.state != STATE_ACTIVE:
                 if pg is not None:
                     pg.waiting_for_active.append(msg)

@@ -1,0 +1,169 @@
+"""Upmap balancer (mgr balancer module / OSDMap::calc_pg_upmaps): the
+optimizer flattens per-OSD PG counts via pg_upmap_items while
+preserving failure-domain separation, and its plan executes through
+the `osd pg-upmap-items` mon command path."""
+
+from ceph_tpu.balancer import (
+    calc_pg_upmaps, crush_parent, plan_commands, spread)
+from ceph_tpu.crush import build_flat_map, build_two_level_map
+from ceph_tpu.osd import OSDMap, PGPool
+from ceph_tpu.osd.osdmap import CEPH_NOSD, POOL_TYPE_REPLICATED
+
+
+def flat_cluster(n_osds=5, pg_num=64, size=3):
+    crush, _root, rule = build_flat_map(n_osds)
+    m = OSDMap(crush=crush)
+    m.set_max_osd(n_osds)
+    for o in range(n_osds):
+        m.mark_up(o)
+    m.pools[1] = PGPool(pool_id=1, type=POOL_TYPE_REPLICATED, size=size,
+                        crush_rule=rule, pg_num=pg_num)
+    return m
+
+
+def host_cluster(n_hosts=5, osds_per_host=2, pg_num=64, size=3):
+    crush, _root, rule = build_two_level_map(n_hosts, osds_per_host)
+    m = OSDMap(crush=crush)
+    n = n_hosts * osds_per_host
+    m.set_max_osd(n)
+    for o in range(n):
+        m.mark_up(o)
+    m.pools[1] = PGPool(pool_id=1, type=POOL_TYPE_REPLICATED, size=size,
+                        crush_rule=rule, pg_num=pg_num)
+    return m
+
+
+def apply_changes(m, changes):
+    for pgid, pairs in changes.items():
+        if pairs:
+            m.pg_upmap_items[pgid] = pairs
+        else:
+            m.pg_upmap_items.pop(pgid, None)
+
+
+class TestOptimizer:
+    def test_narrows_spread_on_flat_map(self):
+        m = flat_cluster()
+        lo0, hi0 = spread(m, 1)
+        changes = calc_pg_upmaps(m, max_deviation=1)
+        assert changes, "crush placement is never perfectly even"
+        apply_changes(m, changes)
+        lo1, hi1 = spread(m, 1)
+        assert hi1 - lo1 < hi0 - lo0
+        assert hi1 - lo1 <= 3      # near-flat after optimization
+
+    def test_mappings_stay_valid(self):
+        m = flat_cluster()
+        apply_changes(m, calc_pg_upmaps(m))
+        pool = m.pools[1]
+        for ps in range(pool.pg_num):
+            up, prim, _a, _ap = m.pg_to_up_acting_osds(1, ps)
+            assert len(up) == pool.size
+            assert len(set(up)) == pool.size, "duplicate osd in up set"
+            assert all(o != CEPH_NOSD for o in up)
+            assert prim in up
+
+    def test_host_failure_domain_preserved(self):
+        m = host_cluster()
+        changes = calc_pg_upmaps(m, max_deviation=1)
+        assert changes
+        apply_changes(m, changes)
+        pool = m.pools[1]
+        for ps in range(pool.pg_num):
+            up, _p, _a, _ap = m.pg_to_up_acting_osds(1, ps)
+            hosts = [crush_parent(m, o) for o in up]
+            assert len(set(hosts)) == len(up), \
+                f"pg 1.{ps} co-located on one host: {up}"
+        lo, hi = spread(m, 1)
+        assert hi - lo <= 3
+
+    def test_idempotent_when_balanced(self):
+        m = flat_cluster()
+        apply_changes(m, calc_pg_upmaps(m))
+        again = calc_pg_upmaps(m)
+        # a second pass finds (almost) nothing left to move
+        assert len(again) <= 2
+
+    def test_plan_command_shape(self):
+        m = flat_cluster()
+        cmds = plan_commands(m)
+        assert cmds
+        for c in cmds:
+            assert c["prefix"] == "osd pg-upmap-items"
+            assert len(c["id_pairs"]) % 2 == 0
+            pool_id, ps = c["pgid"].split(".")
+            assert int(pool_id) == 1
+            assert 0 <= int(ps) < 64
+
+
+class TestMonCommandPath:
+    def test_upmap_items_via_mon(self):
+        import time
+
+        from ceph_tpu.tools.vstart import MiniCluster
+        c = MiniCluster(n_osds=4, ms_type="loopback").start()
+        try:
+            c.wait_for_osd_count(4)
+            client = c.client(timeout=15.0)
+            pool_id = c.create_pool(client, pg_num=16, size=3)
+            io = client.open_ioctx(pool_id)
+            for i in range(8):
+                io.write_full(f"bal{i}", b"v" * 64)
+            # find a pg and a legal swap from its current up set
+            m = c.mon.osdmap
+            up, _p, _a, _ap = m.pg_to_up_acting_osds(pool_id, 0)
+            frm = up[0]
+            to = next(o for o in range(4) if o not in up)
+            rc, out = client.mon_command(
+                {"prefix": "osd pg-upmap-items",
+                 "pgid": f"{pool_id}.0", "id_pairs": [frm, to]})
+            assert rc == 0, out
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                up2, _p, _a, _ap = c.mon.osdmap.pg_to_up_acting_osds(
+                    pool_id, 0)
+                if to in up2 and frm not in up2:
+                    break
+                time.sleep(0.1)
+            assert to in up2 and frm not in up2, (up, up2)
+            # data written before the remap is still readable after
+            time.sleep(1.0)     # let OSDs peer on the new interval
+            for i in range(8):
+                assert io.read(f"bal{i}") == b"v" * 64
+            rc, out = client.mon_command(
+                {"prefix": "osd rm-pg-upmap-items",
+                 "pgid": f"{pool_id}.0"})
+            assert rc == 0, out
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if (pool_id, 0) not in c.mon.osdmap.pg_upmap_items:
+                    break
+                time.sleep(0.1)
+            assert (pool_id, 0) not in c.mon.osdmap.pg_upmap_items
+        finally:
+            c.stop()
+
+    def test_bad_upmap_rejected(self):
+        from ceph_tpu.tools.vstart import MiniCluster
+        c = MiniCluster(n_osds=3, ms_type="loopback").start()
+        try:
+            c.wait_for_osd_count(3)
+            client = c.client(timeout=15.0)
+            c.create_pool(client, pg_num=8, size=2)
+            rc, _ = client.mon_command(
+                {"prefix": "osd pg-upmap-items", "pgid": "99.0",
+                 "id_pairs": [0, 1]})
+            assert rc == -2
+            rc, _ = client.mon_command(
+                {"prefix": "osd pg-upmap-items", "pgid": "1.0",
+                 "id_pairs": [0, 77]})
+            assert rc == -2
+            rc, _ = client.mon_command(
+                {"prefix": "osd pg-upmap-items", "pgid": "1.0",
+                 "id_pairs": [0]})
+            assert rc == -22
+            rc, _ = client.mon_command(
+                {"prefix": "osd rm-pg-upmap-items", "pgid": "1.0"})
+            assert rc == -2
+        finally:
+            c.stop()

@@ -254,3 +254,85 @@ def test_bluestore_cluster_end_to_end(tmp_path):
         assert io2.read("e") == want
     finally:
         c.stop()
+
+
+def test_bluestore_crash_remount_allocator_safe(tmp_path):
+    """Hard-kill crash model: reopen WITHOUT umount.  The rebuilt
+    allocator must not hand out live blocks, and committed overwrites
+    must be intact (COW + fsck-style free-list rebuild)."""
+    from ceph_tpu.objectstore import create_objectstore
+    path = str(tmp_path / "bs3")
+    s = create_objectstore("bluestore", path)
+    s.mkfs_if_needed()
+    s.mount()
+    s.apply_transaction(Transaction().create_collection("c")
+                        .write("c", "a", 0, b"A" * 8192))
+    s.apply_transaction(Transaction().write("c", "a", 100, b"patch"))
+    # simulate a crash: drop the handles without umount bookkeeping
+    s._f.close()
+    s._db.close()
+    s2 = create_objectstore("bluestore", path)
+    s2.mkfs_if_needed()
+    s2.mount()
+    want = b"A" * 100 + b"patch" + b"A" * (8192 - 105)
+    assert s2.read("c", "a") == want
+    # new writes after the crash must not corrupt the survivor
+    s2.apply_transaction(Transaction().write("c", "b", 0, b"B" * 8192))
+    assert s2.read("c", "a") == want
+    assert s2.read("c", "b") == b"B" * 8192
+    s2.umount()
+
+
+def test_bluestore_rmcoll_purges_and_zero_punches_holes(tmp_path):
+    import os as _os
+    from ceph_tpu.objectstore import create_objectstore
+    path = str(tmp_path / "bs4")
+    s = create_objectstore("bluestore", path)
+    s.mkfs_if_needed()
+    s.mount()
+    s.apply_transaction(Transaction().create_collection("c")
+                        .write("c", "o", 0, b"x" * 16384))
+    # zero the middle: full blocks become holes, not zero-filled disk
+    size_before = _os.path.getsize(f"{path}/block")
+    s.apply_transaction(Transaction().zero("c", "o", 4096, 8192))
+    assert s.read("c", "o") == b"x" * 4096 + bytes(8192) + b"x" * 4096
+    assert _os.path.getsize(f"{path}/block") <= size_before + 2 * 4096
+    # rmcoll purges objects; recreating the collection finds it empty
+    s.apply_transaction(Transaction().remove_collection("c"))
+    s.apply_transaction(Transaction().create_collection("c"))
+    assert not s.exists("c", "o")
+    assert s.list_objects("c") == []
+    s.umount()
+
+
+def test_bluestore_remove_recreate_one_txn(tmp_path):
+    """Recovery's replace-wholesale push removes and rewrites the same
+    object in ONE transaction; the KV batch (sets-then-rms) must not
+    let the remove eat the recreate.  Same for collections."""
+    from ceph_tpu.objectstore import create_objectstore
+    path = str(tmp_path / "bs5")
+    s = create_objectstore("bluestore", path)
+    s.mkfs_if_needed()
+    s.mount()
+    s.apply_transaction(Transaction().create_collection("c")
+                        .write("c", "o", 0, b"old" * 2000))
+    s.apply_transaction(Transaction()
+                        .remove("c", "o")
+                        .write("c", "o", 0, b"new")
+                        .setattr("c", "o", "_v", b"9.9"))
+    assert s.read("c", "o") == b"new"
+    assert s.getattr("c", "o", "_v") == b"9.9"
+    s.apply_transaction(Transaction()
+                        .remove_collection("c")
+                        .create_collection("c")
+                        .write("c", "p", 0, b"fresh"))
+    assert s.list_objects("c") == ["p"]
+    # survives a remount (the KV really holds the final state)
+    s.umount()
+    s2 = create_objectstore("bluestore", path)
+    s2.mkfs_if_needed()
+    s2.mount()
+    assert not s2.exists("c", "o")
+    assert s2.read("c", "p") == b"fresh"
+    assert s2.list_objects("c") == ["p"]
+    s2.umount()
