@@ -1,0 +1,233 @@
+"""crushtool text-map grammar (CrushCompiler.cc): compile a hand-
+written map, decompile-recompile round trips, and mapping equivalence
+with builder-constructed maps."""
+
+import pytest
+
+from ceph_tpu.crush import build_two_level_map
+from ceph_tpu.crush.mapper_ref import crush_do_rule
+from ceph_tpu.crush.text import (
+    CompileError, CrushNames, compile_text, decompile)
+
+SAMPLE = """
+# begin crush map
+tunable choose_total_tries 50
+tunable chooseleaf_stable 1
+
+# devices
+device 0 osd.0 class hdd
+device 1 osd.1 class hdd
+device 2 osd.2 class ssd
+device 3 osd.3 class ssd
+device 4 osd.4 class hdd
+device 5 osd.5 class hdd
+
+# types
+type 0 osd
+type 1 host
+type 10 root
+
+# buckets
+host node-a {
+    id -2
+    alg straw2
+    hash 0  # rjenkins1
+    item osd.0 weight 1.000
+    item osd.1 weight 2.000
+}
+host node-b {
+    id -3
+    alg straw2
+    hash 0
+    item osd.2 weight 1.000
+    item osd.3 weight 1.000
+}
+host node-c {
+    id -4
+    alg straw2
+    hash 0
+    item osd.4 weight 1.000
+    item osd.5 weight 1.000
+}
+root default {
+    id -1
+    alg straw2
+    hash 0
+    item node-a weight 3.000
+    item node-b weight 2.000
+    item node-c weight 2.000
+}
+
+# rules
+rule replicated_rule {
+    id 0
+    type replicated
+    min_size 1
+    max_size 10
+    step take default
+    step chooseleaf firstn 0 type host
+    step emit
+}
+rule ec_rule {
+    id 1
+    type erasure
+    min_size 3
+    max_size 6
+    step set_chooseleaf_tries 5
+    step take default
+    step choose indep 0 type osd
+    step emit
+}
+# end crush map
+"""
+
+
+class TestCompile:
+    def test_sample_structure(self):
+        m, names = compile_text(SAMPLE)
+        assert m.max_devices == 6
+        assert names.classes == {0: "hdd", 1: "hdd", 2: "ssd",
+                                 3: "ssd", 4: "hdd", 5: "hdd"}
+        root = m.bucket(-1)
+        assert root is not None and root.items == [-2, -3, -4]
+        assert root.weight == 7 * 0x10000
+        a = m.bucket(-2)
+        assert a.item_weights == [0x10000, 0x20000]
+        assert names.items[-2] == "node-a"
+        assert m.tunables.choose_total_tries == 50
+        r = m.rules[0]
+        assert r.steps[0].arg1 == -1          # take default
+        assert r.steps[1].arg2 == 1           # type host
+        assert m.rules[1].steps[0].arg1 == 5  # set_chooseleaf_tries
+
+    def test_mapping_works(self):
+        m, _ = compile_text(SAMPLE)
+        for x in range(64):
+            out = crush_do_rule(m, 0, x, 3, [0x10000] * 6)
+            assert len(out) == 3
+            assert len(set(out)) == 3
+
+    def test_declaration_order_free(self):
+        # root first, hosts after — reference rejects this, we build
+        # children-first regardless
+        # move the root block before the host blocks
+        lines = SAMPLE.splitlines()
+        ri = next(i for i, l in enumerate(lines)
+                  if l.startswith("root default"))
+        re_ = next(i for i in range(ri, len(lines))
+                   if lines[i].strip() == "}") + 1
+        hi = next(i for i, l in enumerate(lines)
+                  if l.startswith("host node-a"))
+        root_blk = lines[ri:re_]
+        rest = lines[:ri] + lines[re_:]
+        lines2 = rest[:hi] + root_blk + rest[hi:]
+        m2, _ = compile_text("\n".join(lines2))
+        m1, _ = compile_text(SAMPLE)
+        for x in range(32):
+            assert crush_do_rule(m1, 0, x, 3, [0x10000] * 6) == \
+                crush_do_rule(m2, 0, x, 3, [0x10000] * 6)
+
+    def test_errors(self):
+        with pytest.raises(CompileError):
+            compile_text("tunable bogus_knob 1")
+        with pytest.raises(CompileError):
+            compile_text("host h { id -1 alg warp hash 0 }\ntype 1 host")
+        with pytest.raises(CompileError):
+            compile_text(SAMPLE + "\nrule bad { id 9 type replicated "
+                         "min_size 1 max_size 10 "
+                         "step take default class hdd step emit }")
+        with pytest.raises((CompileError, ValueError)):
+            compile_text("rule r { id 0 type replicated min_size 1 "
+                         "max_size 10 step take nonexistent step emit }")
+
+
+class TestRoundTrip:
+    def test_text_map_text(self):
+        m1, n1 = compile_text(SAMPLE)
+        text = decompile(m1, n1)
+        m2, n2 = compile_text(text)
+        assert n2.items == n1.items
+        assert n2.classes == n1.classes
+        assert m2.max_devices == m1.max_devices
+        for b1 in m1.buckets:
+            b2 = m2.bucket(b1.id)
+            assert b2.items == b1.items
+            assert b2.item_weights == b1.item_weights
+            assert (b2.alg, b2.type, b2.weight) == \
+                (b1.alg, b1.type, b1.weight)
+        for r1, r2 in zip(m1.rules, m2.rules):
+            assert [(s.op, s.arg1, s.arg2) for s in r1.steps] == \
+                [(s.op, s.arg1, s.arg2) for s in r2.steps]
+        for x in range(64):
+            assert crush_do_rule(m1, 0, x, 3, [0x10000] * 6) == \
+                crush_do_rule(m2, 0, x, 3, [0x10000] * 6)
+
+    def test_builder_map_survives(self):
+        crush, _root, rule = build_two_level_map(4, 3)
+        text = decompile(crush)       # synthesized names
+        m2, _ = compile_text(text)
+        w = [0x10000] * 12
+        for x in range(128):
+            assert crush_do_rule(crush, rule, x, 3, w) == \
+                crush_do_rule(m2, rule, x, 3, w)
+
+
+class TestCrushtoolCli:
+    def test_compile_decompile_tree_build(self, tmp_path):
+        from ceph_tpu.crush.mapper_ref import crush_do_rule
+        from ceph_tpu.tools import crushtool as ct
+        txt_path = tmp_path / "map.txt"
+        bin_path = str(tmp_path / "map.bin")
+        txt_path.write_text(SAMPLE)
+        assert ct.main(["-c", str(txt_path), "-o", bin_path]) == 0
+        m, names = ct.read_binary(bin_path)
+        assert names.items[-2] == "node-a"
+        out_path = tmp_path / "out.txt"
+        assert ct.main(["-d", bin_path, "-o", str(out_path)]) == 0
+        m2, _ = compile_text(out_path.read_text())
+        for x in range(32):
+            assert crush_do_rule(m, 0, x, 3, [0x10000] * 6) == \
+                crush_do_rule(m2, 0, x, 3, [0x10000] * 6)
+        tree = "\n".join(ct.tree_lines(m, names))
+        assert "root default" in tree and "host node-a" in tree
+        # --build layered map maps correctly at device failure domain
+        built = str(tmp_path / "b.bin")
+        assert ct.main(["--build", "--num-osds", "6", "host", "straw2",
+                        "2", "root", "straw2", "0", "-o", built]) == 0
+        bm, bn = ct.read_binary(built)
+        assert len([b for b in bm.buckets if b is not None]) == 4
+        for x in range(32):
+            out = crush_do_rule(bm, 0, x, 3, [0x10000] * 6)
+            assert len(set(out)) == 3
+
+
+class TestValidation:
+    def test_positive_bucket_id_rejected(self):
+        with pytest.raises(CompileError):
+            compile_text("type 1 host\nhost h { id 2 alg straw2 hash 0 }")
+
+    def test_duplicate_rule_id_rejected(self):
+        dup = ("rule a { id 0 type replicated min_size 1 max_size 10 "
+               "step emit }\n") * 2
+        with pytest.raises(CompileError):
+            compile_text(dup)
+
+    def test_duplicate_bucket_name_rejected(self):
+        with pytest.raises(CompileError):
+            compile_text("type 1 host\n"
+                         "host h { id -1 alg straw2 hash 0 }\n"
+                         "host h { id -2 alg straw2 hash 0 }")
+
+    def test_build_without_root_layer_reaches_all_osds(self, tmp_path):
+        from ceph_tpu.crush.mapper_ref import crush_do_rule
+        from ceph_tpu.tools import crushtool as ct
+        out = str(tmp_path / "x.bin")
+        assert ct.main(["--build", "--num-osds", "8", "host", "straw2",
+                        "2", "-o", out]) == 0
+        m, _ = ct.read_binary(out)
+        seen = set()
+        for x in range(512):
+            res = crush_do_rule(m, 0, x, 3, [0x10000] * 8)
+            assert len(set(res)) == 3
+            seen.update(res)
+        assert seen == set(range(8)), "implicit root left subtrees dark"
