@@ -561,6 +561,48 @@ class Monitor(Dispatcher):
                 return "removed", 0
             if prefix == "osd getmap":
                 return json.dumps({"epoch": self.osdmap.epoch}), 0
+            if prefix == "osd getcrushmap":
+                import base64
+                from ceph_tpu.msg.encoding import Encoder
+                from ceph_tpu.osd.map_codec import encode_crush
+                e = Encoder()
+                encode_crush(self.osdmap.crush, e)
+                return json.dumps({
+                    "epoch": self.osdmap.epoch,
+                    "names": self.osdmap.crush_names,
+                    "crush_b64":
+                        base64.b64encode(e.tobytes()).decode()}), 0
+            if prefix == "osd setcrushmap":
+                import base64
+                from ceph_tpu.msg.encoding import Decoder
+                from ceph_tpu.osd.map_codec import decode_crush
+                blob = base64.b64decode(cmd["crush_b64"])
+                try:
+                    crush = decode_crush(Decoder(blob))
+                except Exception as e:
+                    return f"cannot decode crush map: {e}", -22
+                # every pool's rule must survive (OSDMonitor
+                # prepare_newcrush validation)
+                for pid, p in self.osdmap.pools.items():
+                    r = (crush.rules[p.crush_rule]
+                         if 0 <= p.crush_rule < crush.max_rules
+                         else None)
+                    if r is None:
+                        return (f"pool {pid} references rule "
+                                f"{p.crush_rule} absent from new map"), -22
+                if crush.max_devices > self.osdmap.max_osd:
+                    return (f"crush map addresses {crush.max_devices} "
+                            f"devices but max_osd is "
+                            f"{self.osdmap.max_osd}"), -22
+
+                names = cmd.get("names") or {}
+
+                def fn(m: OSDMap):
+                    m.crush = crush
+                    m.crush_names = names
+                if not self._mutate(fn):
+                    return "commit failed", -11
+                return json.dumps({"epoch": self.osdmap.epoch}), 0
             return f"unknown command {prefix!r}", -22
         except (KeyError, ValueError, IndexError) as e:
             return f"command failed: {e}", -22

@@ -170,8 +170,13 @@ def encode_osdmap(m: OSDMap) -> bytes:
         e.map(m.pg_temp, enc_pgid_key,
               lambda e2, v: e2.list(v, lambda e3, o: e3.s32(o)))
         e.map(m.primary_temp, enc_pgid_key, lambda e2, v: e2.s32(v))
+        # v3: CRUSH name tables ride the map (the reference's binary
+        # crush carries type/name/rule maps; CrushWrapper name_map)
+        import json as _json
+        e.bytes(_json.dumps(m.crush_names).encode()
+                if m.crush_names else b"")
 
-    enc.versioned(2, 1, body)
+    enc.versioned(3, 1, body)
     return enc.tobytes()
 
 
@@ -209,7 +214,14 @@ def decode_osdmap(data: bytes) -> OSDMap:
             lambda d2: d2.list(lambda d3: (d3.s32(), d3.s32())))
         pg_temp = d.map(dec_pgid_key, lambda d2: d2.list(lambda d3: d3.s32()))
         primary_temp = d.map(dec_pgid_key, lambda d2: d2.s32())
+        crush_names = {}
+        if version >= 3:
+            import json as _json
+            blob = d.bytes()
+            if blob:
+                crush_names = _json.loads(blob.decode())
         return OSDMap(epoch=epoch, crush=crush, max_osd=max_osd,
+                      crush_names=crush_names,
                       osd_state=osd_state, osd_weight=osd_weight,
                       osd_primary_affinity=affinity, osd_addrs=osd_addrs,
                       pools=pools,

@@ -112,6 +112,9 @@ class OSDMap:
         field(default_factory=dict)
     pg_temp: dict[tuple[int, int], list[int]] = field(default_factory=dict)
     primary_temp: dict[tuple[int, int], int] = field(default_factory=dict)
+    #: CRUSH name side-tables (types/items/rules/classes, JSON-shaped —
+    #: CrushWrapper type_map/name_map analog), set via `osd setcrushmap`
+    crush_names: dict = field(default_factory=dict)
 
     # -- osd state ------------------------------------------------------------
 

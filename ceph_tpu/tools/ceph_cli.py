@@ -24,6 +24,8 @@ COMMANDS = {
     ("osd", "pool", "set"): ["pool", "var", "val"],
     ("osd", "pool", "mksnap"): [],
     ("osd", "pool", "rmsnap"): [],
+    ("osd", "getcrushmap"): [],
+    ("osd", "setcrushmap"): [],
     ("osd", "out"): ["id"],
     ("osd", "in"): ["id"],
     ("osd", "down"): ["id"],
@@ -60,6 +62,10 @@ def main(argv=None) -> int:
                     help="comma-separated monitor addresses")
     ap.add_argument("--timeout", type=float, default=15.0)
     ap.add_argument("--auth-key", default=None)
+    ap.add_argument("-i", "--infile",
+                    help="crush binary for setcrushmap")
+    ap.add_argument("-o", "--outfile",
+                    help="write getcrushmap output here")
     ap.add_argument("words", nargs="+")
     args = ap.parse_args(argv)
     try:
@@ -67,6 +73,26 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(e, file=sys.stderr)
         return 22
+    if cmd["prefix"] == "osd setcrushmap":
+        import base64
+        from ceph_tpu.tools.crushtool import read_binary as _rb
+        if not args.infile:
+            print("setcrushmap needs -i <crushtool binary>",
+                  file=sys.stderr)
+            return 22
+        from ceph_tpu.msg.encoding import Encoder
+        from ceph_tpu.osd.map_codec import encode_crush
+        try:
+            m, names = _rb(args.infile)   # validates framing + names
+        except (SystemExit, OSError, ValueError, KeyError,
+                EOFError) as e:
+            print(f"cannot read {args.infile}: {e}", file=sys.stderr)
+            return 22
+        e = Encoder()
+        encode_crush(m, e)
+        cmd["crush_b64"] = base64.b64encode(e.tobytes()).decode()
+        cmd["names"] = {"types": names.types, "items": names.items,
+                        "rules": names.rules, "classes": names.classes}
     from ceph_tpu.client.rados import RadosClient
     client = RadosClient(args.mon_host, timeout=args.timeout,
                          auth_key=args.auth_key)
@@ -74,7 +100,17 @@ def main(argv=None) -> int:
         client.msgr.bind("127.0.0.1:0")
         client.msgr.start()
         res, out = client.mon_command(cmd)
-        print(out)
+        if res == 0 and cmd["prefix"] == "osd getcrushmap" \
+                and args.outfile:
+            import base64, json
+            from ceph_tpu.tools.crushtool import write_binary_blob
+            reply = json.loads(out)
+            write_binary_blob(args.outfile,
+                              base64.b64decode(reply["crush_b64"]),
+                              reply.get("names") or {})
+            print(f"wrote {args.outfile}")
+        else:
+            print(out)
         return -res if res < 0 else res
     finally:
         client.shutdown()

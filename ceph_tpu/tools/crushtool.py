@@ -34,10 +34,19 @@ _MAGIC = b"CTPUCRSH"
 def write_binary(path: str, m, names: CrushNames) -> None:
     e = Encoder()
     encode_crush(m, e)
-    blob = e.tobytes()
-    nj = json.dumps({
+    write_binary_blob(path, e.tobytes(), {
         "types": names.types, "items": names.items,
-        "rules": names.rules, "classes": names.classes}).encode()
+        "rules": names.rules, "classes": names.classes})
+
+
+def write_binary_blob(path: str, blob: bytes, names_dict: dict) -> None:
+    """Frame an already-encoded crush blob (as fetched from the mon)
+    without a redundant decode/re-encode round."""
+    names_dict = {"types": names_dict.get("types") or {},
+                  "items": names_dict.get("items") or {},
+                  "rules": names_dict.get("rules") or {},
+                  "classes": names_dict.get("classes") or {}}
+    nj = json.dumps(names_dict).encode()
     with open(path, "wb") as f:
         f.write(_MAGIC + struct.pack("<II", len(blob), len(nj))
                 + blob + nj)
