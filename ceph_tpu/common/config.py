@@ -80,6 +80,8 @@ register_options([
            "seconds without ping before reporting failure"),
     Option("mon_osd_min_down_reporters", OPT_INT, 2,
            "distinct reporters before the mon marks an osd down"),
+    Option("osd_op_complaint_time", OPT_FLOAT, 30.0,
+           "age after which an in-flight op is a slow request"),
     Option("log_level", OPT_INT, 1, "default subsystem log level"),
     Option("ms_type", OPT_STR, "async",
            "messenger implementation: async | loopback"),

@@ -71,7 +71,8 @@ class Elector:
         #: retrying its own candidacy resets the better candidate's
         #: victory timer every cycle and the election never converges
         self.defer_to: int | None = None
-        self._lock = threading.RLock()
+        from ceph_tpu.common.lockdep import make_lock
+        self._lock = make_lock(f"Elector::lock({rank})")
 
     def majority(self) -> int:
         return self.n_mons // 2 + 1

@@ -146,7 +146,8 @@ class Monitor(Dispatcher):
         self.name = EntityName("mon", mon_id)
         self.db = LogDB(store_path) if store_path else MemDB()
         self.osdmap = OSDMap()
-        self._lock = threading.RLock()
+        from ceph_tpu.common.lockdep import make_lock
+        self._lock = make_lock(f"Monitor::lock({mon_id})")
         #: failure reports: failed_osd -> {reporter: report_time}
         self._failure_reports: dict[int, dict[int, float]] = {}
         #: subscriber name -> (addr, entity)
@@ -431,16 +432,40 @@ class Monitor(Dispatcher):
         self._mutate(fn)
 
     def _crush_add_osd(self, m: OSDMap, osd: int, weight: int) -> None:
-        root = m.crush.bucket(-1)
+        """Attach a booting osd to the map's hierarchy (the default
+        crush-location hook: straight under the root for flat maps, in
+        a fresh sibling bucket when the root holds buckets — so an
+        operator map injected via setcrushmap keeps its failure-domain
+        shape instead of gaining stray devices on a hardcoded -1)."""
+        crush = m.crush
+        referenced = {it for b in crush.buckets if b is not None
+                      for it in b.items}
+        root = next((b for b in crush.buckets
+                     if b is not None and b.id not in referenced), None)
         if root is None:
             # boot raced the bootstrap commit: create the root here
-            m.crush.add_bucket(
+            crush.add_bucket(
                 make_bucket(-1, CRUSH_BUCKET_STRAW2, 2, [], []))
-            root = m.crush.bucket(-1)
-        root.items.append(osd)
-        root.item_weights.append(weight)
-        root.weight += weight
-        m.crush.max_devices = max(m.crush.max_devices, osd + 1)
+            root = crush.bucket(-1)
+        child_buckets = [crush.bucket(it) for it in root.items if it < 0]
+        if child_buckets:
+            # hierarchical map: wrap the device in its own bucket of
+            # the same type as the root's children (host-per-osd)
+            proto = child_buckets[0]
+            nb = make_bucket(crush.next_bucket_id(), proto.alg,
+                             proto.type, [osd], [weight])
+            crush.add_bucket(nb)
+            names = m.crush_names.get("items")
+            if isinstance(names, dict):
+                names[str(nb.id)] = f"osd-{osd}-host"
+            root.items.append(nb.id)
+            root.item_weights.append(nb.weight)
+            root.weight += nb.weight
+        else:
+            root.items.append(osd)
+            root.item_weights.append(weight)
+            root.weight += weight
+        crush.max_devices = max(crush.max_devices, osd + 1)
 
     def _do_failure(self, msg: MOSDFailure) -> None:
         need = int(self.ctx.conf.get("mon_osd_min_down_reporters"))

@@ -100,7 +100,8 @@ class Paxos:
         self.on_commit = on_commit
         self.on_active = lambda: None   # leader finished collect phase
         self.request_election = request_election
-        self._lock = threading.RLock()
+        from ceph_tpu.common.lockdep import make_lock
+        self._lock = make_lock(f"Paxos::lock({rank})")
 
         self.state = STATE_RECOVERING
         self.is_leader = False

@@ -86,7 +86,8 @@ class BlueStoreLite(ObjectStore):
         self._db = LogDB(os.path.join(path, "kv"))
         self._alloc = BitmapAllocator()
         self._f = None
-        self._lock = threading.RLock()
+        from ceph_tpu.common.lockdep import make_lock
+        self._lock = make_lock(f"BlueStore::lock({path})")
         #: blocks displaced by the in-flight transaction batch; returned
         #: to the allocator only after its KV commit lands
         self._freed: list[int] = []

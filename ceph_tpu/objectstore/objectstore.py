@@ -93,7 +93,8 @@ class MemStore(ObjectStore):
     def __init__(self, path: str = ""):
         self.path = path
         self._colls: dict[str, dict[str, _Obj]] = {}
-        self._lock = threading.RLock()
+        from ceph_tpu.common.lockdep import make_lock
+        self._lock = make_lock(f"ObjectStore::lock({id(self)})")
         self._mounted = False
 
     def mkfs(self) -> None:

@@ -178,7 +178,8 @@ class OSDDaemon(Dispatcher):
         self.mgr_addr = mgr_addr
         self.store = create_objectstore(store_type, store_path)
         self.osdmap = OSDMap()
-        self._lock = threading.RLock()
+        from ceph_tpu.common.lockdep import make_lock
+        self._lock = make_lock(f"OSD::osd_lock({osd_id})")
         self.pgs: dict[tuple[int, int], PG] = {}
         self._in_flight: dict[tuple[int, int], _InFlight] = {}
         #: ops from clients ahead of our map; flushed on map advance
@@ -220,9 +221,18 @@ class OSDDaemon(Dispatcher):
                      .add_time_avg("op_w_latency")
                      .create_perf_counters())
         self.ctx.perf.add(self.perf)
+        from ceph_tpu.common.op_tracker import OpTracker
+        self.op_tracker = OpTracker(
+            complaint_time=float(
+                self.ctx.conf.get("osd_op_complaint_time")))
         self.ctx.admin.register_command(
             "dump_ops_in_flight",
-            lambda **kw: {"num": len(self._in_flight)}, "in-flight ops")
+            lambda **kw: self.op_tracker.dump_ops_in_flight(),
+            "in-flight client ops with event timelines")
+        self.ctx.admin.register_command(
+            "dump_historic_ops",
+            lambda **kw: self.op_tracker.dump_historic_ops(),
+            "recently completed + slowest ops")
         self.ctx.admin.register_command(
             "osd map epoch", lambda **kw: {"epoch": self.osdmap.epoch},
             "current map epoch")
@@ -305,6 +315,8 @@ class OSDDaemon(Dispatcher):
             now = time.time()
             self._maybe_reboot()
             self._mgr_report()
+            for warn in self.op_tracker.check_ops_in_flight():
+                dout("osd", 1, "osd.%d %s", self.osd_id, warn)
             with self._lock:
                 pgs = list(self.pgs.values())
                 # rmw gathers have no client resend to rescue them: a
@@ -325,7 +337,7 @@ class OSDDaemon(Dispatcher):
                            for nid in stale_notifies]
             for st in expired:
                 m = st["msg"]
-                m.connection.send_message(MOSDOpReply(
+                self._op_send_reply(m, MOSDOpReply(
                     tid=m.tid, result=0, epoch=self.osdmap.epoch))
             for _gid, st in stuck_rmw:
                 self._ec_read_give_up(st)
@@ -490,20 +502,45 @@ class OSDDaemon(Dispatcher):
             pg.peering_started = time.time()
             pg.peers = {}
             pg.recovering.clear()
-            # interval change: in-flight rmw gathers die with the gate
+            # interval change: in-flight rmw gathers die with the gate;
+            # their client ops requeue (re-executed post-activation)
             pg.rmw.clear()
             dead = [gid for gid, st in self._ec_reads.items()
                     if st["kind"] == "rmw" and st["pgid"] == pg.pgid]
             for gid in dead:
-                self._ec_reads.pop(gid, None)
+                st = self._ec_reads.pop(gid, None)
+                if st is not None and st.get("msg") is not None:
+                    trk = getattr(st["msg"], "_trk", None)
+                    if trk is not None:
+                        trk.mark_event(
+                            "rmw gather torn down: interval change")
+                    pg.waiting_for_active.append(st["msg"])
             # ops queued against the old interval: requeue for re-check
             # after this round settles (clients also resend on map change)
             for ops in pg.waiting_for_missing.values():
                 pg.waiting_for_active.extend(ops)
             pg.waiting_for_missing.clear()
+            # in-flight repops waiting on replicas from the OLD interval
+            # would hang forever on a dead peer's ack; the entry is in
+            # our log, peering converges the new replicas from it, so
+            # requeue the client op — post-activation it dedups against
+            # the log and acks (PrimaryLogPG on_change repop teardown)
+            stale_infs = [rid for rid, inf in self._in_flight.items()
+                          if inf.msg.pgid == pg.pgid]
+            for rid in stale_infs:
+                inf = self._in_flight.pop(rid)
+                trk = getattr(inf.msg, "_trk", None)
+                if trk is not None:
+                    trk.mark_event("repop torn down: interval change")
+                pg.waiting_for_active.append(inf.msg)
             if primary != self.osd_id:
                 pg.state = STATE_REPLICA
-                pg.waiting_for_active.clear()  # clients re-target
+                for m in pg.waiting_for_active:   # clients re-target
+                    trk = getattr(m, "_trk", None)
+                    if trk is not None:
+                        trk.mark_event("discarded: no longer primary")
+                        trk.finish()
+                pg.waiting_for_active.clear()
                 return
             self.perf.inc("peering_rounds")
             peers = [o for o in up
@@ -1000,12 +1037,20 @@ class OSDDaemon(Dispatcher):
         return up, acting_primary
 
     def _handle_op(self, msg: MOSDOp) -> None:
+        if getattr(msg, "_trk", None) is None:
+            kinds = ",".join(str(op.op) for op in msg.ops)
+            msg._trk = self.op_tracker.create_request(
+                f"osd_op(client.{msg.client_id}.{msg.tid} "
+                f"{msg.pgid[0]}.{msg.pgid[1]} {msg.oid} ops=[{kinds}])")
+        else:
+            msg._trk.mark_event("requeued")
         if msg.epoch > self.osdmap.epoch:
             # client runs a newer map than us: park the op until our mon
             # subscription catches us up (OSD::wait_for_new_map), never
             # judge primaryship with a stale map
             with self._lock:
                 if msg.epoch > self.osdmap.epoch:
+                    msg._trk.mark_event("waiting for newer osdmap")
                     self._waiting_for_map.append(msg)
                     return
         pool = self.osdmap.pools.get(msg.pgid[0])
@@ -1031,6 +1076,7 @@ class OSDDaemon(Dispatcher):
         with self._lock:
             pg = self.pgs.get(msg.pgid)
             if pg is None and 0 <= msg.pgid[1] < pool.pg_num:
+                msg._trk.mark_event("creating pg (raced map advance)")
                 # op raced ahead of _scan_pgs creating this PG on the
                 # new map: create it, start its peering round now (the
                 # scan may already be past this pgid), park the op;
@@ -1041,12 +1087,15 @@ class OSDDaemon(Dispatcher):
                 return
             if pg is None or pg.state != STATE_ACTIVE:
                 if pg is not None:
+                    msg._trk.mark_event(
+                        f"waiting for pg active (state={pg.state})")
                     pg.waiting_for_active.append(msg)
                 return
             is_write = any(op.op in (OP_WRITE, OP_WRITEFULL, OP_DELETE,
                                      OP_OMAP_SET) for op in msg.ops)
             if self._blocked_on_recovery(pg, msg.oid, is_write,
                                          pool.is_erasure()):
+                msg._trk.mark_event("waiting for missing object")
                 pg.waiting_for_missing.setdefault(msg.oid, []).append(msg)
                 return
             # execute under the lock: version allocation + log append +
@@ -1069,9 +1118,19 @@ class OSDDaemon(Dispatcher):
                 return any(oid in ps.missing for ps in pg.peers.values())
         return False
 
+    def _op_send_reply(self, msg: MOSDOp, reply: "MOSDOpReply") -> None:
+        """Single client-reply chokepoint: closes the op's TrackedOp
+        timeline (OpRequest lifecycle) and sends."""
+        trk = getattr(msg, "_trk", None)
+        if trk is not None:
+            trk.mark_event(f"reply result={reply.result}")
+            trk.finish()
+        msg.connection.send_message(reply)
+
     def _reply_err(self, msg: MOSDOp, code: int) -> None:
-        msg.connection.send_message(
-            MOSDOpReply(tid=msg.tid, result=code, epoch=self.osdmap.epoch))
+        self._op_send_reply(
+            msg, MOSDOpReply(tid=msg.tid, result=code,
+                             epoch=self.osdmap.epoch))
 
     def _dedup_resend(self, pg: PG, reqid, msg: MOSDOp) -> bool:
         """Client resent an op already in the log.  If the original is
@@ -1082,9 +1141,14 @@ class OSDDaemon(Dispatcher):
                 return False
             inf = self._in_flight.get(reqid)
             if inf is not None:
+                if inf.msg is not msg:   # tcp resends are fresh objects
+                    trk = getattr(inf.msg, "_trk", None)
+                    if trk is not None:
+                        trk.mark_event("superseded by client resend")
+                        trk.finish()
                 inf.msg = msg      # reply goes to the latest connection
                 return True
-        msg.connection.send_message(MOSDOpReply(
+        self._op_send_reply(msg, MOSDOpReply(
             tid=msg.tid, result=0, epoch=self.osdmap.epoch))
         return True
 
@@ -1211,7 +1275,7 @@ class OSDDaemon(Dispatcher):
             else:
                 result = -22
         if not is_write or result != 0:
-            msg.connection.send_message(MOSDOpReply(
+            self._op_send_reply(msg, MOSDOpReply(
                 tid=msg.tid, result=result, epoch=self.osdmap.epoch,
                 ops=reply_ops))
             return
@@ -1248,7 +1312,7 @@ class OSDDaemon(Dispatcher):
                             ops=reply_ops)
         if not replicas:
             self.perf.tinc("op_w_latency", time.time() - t0)
-            msg.connection.send_message(reply)
+            self._op_send_reply(msg, reply)
             return
         with self._lock:
             self._in_flight[reqid] = _InFlight(msg, set(replicas), reply)
@@ -1315,7 +1379,7 @@ class OSDDaemon(Dispatcher):
             if inf.waiting:
                 return
             del self._in_flight[reqid]
-        inf.msg.connection.send_message(inf.reply)
+        self._op_send_reply(inf.msg, inf.reply)
 
     # erasure pools ------------------------------------------------------------
 
@@ -1539,7 +1603,7 @@ class OSDDaemon(Dispatcher):
                 offset=shard_off, shard_len=shard_len,
                 truncate=truncate))
         if not waiting:
-            msg.connection.send_message(reply)
+            self._op_send_reply(msg, reply)
 
     def _patched_shard(self, pgid, oid: str, shard: int, chunk: bytes,
                        offset: int, shard_len: int, truncate: bool,
@@ -1815,7 +1879,7 @@ class OSDDaemon(Dispatcher):
             off = state.get("off", 0)
             length = state.get("len", 0)
             data = data[off:off + length] if length else data[off:]
-            msg.connection.send_message(MOSDOpReply(
+            self._op_send_reply(msg, MOSDOpReply(
                 tid=msg.tid, result=0, epoch=self.osdmap.epoch,
                 ops=[OSDOpField(OP_READ, off, len(data), data)]))
             return
@@ -1933,7 +1997,7 @@ class OSDDaemon(Dispatcher):
                     "msg": msg, "waiting": set(watchers),
                     "started": time.time()}
         if not watchers:
-            msg.connection.send_message(MOSDOpReply(
+            self._op_send_reply(msg, MOSDOpReply(
                 tid=msg.tid, result=0, epoch=self.osdmap.epoch))
             return
         note = MWatchNotify(pool=msg.pgid[0], oid=msg.oid,
@@ -1955,7 +2019,7 @@ class OSDDaemon(Dispatcher):
                 done = self._notifies.pop(msg.notify_id)
         if done is not None:
             m = done["msg"]
-            m.connection.send_message(MOSDOpReply(
+            self._op_send_reply(m, MOSDOpReply(
                 tid=m.tid, result=0, epoch=self.osdmap.epoch))
 
     # -- scrub (PG::scrub / chunky_scrub, collapsed) --------------------------

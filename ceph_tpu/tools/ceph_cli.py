@@ -84,8 +84,7 @@ def main(argv=None) -> int:
         from ceph_tpu.osd.map_codec import encode_crush
         try:
             m, names = _rb(args.infile)   # validates framing + names
-        except (SystemExit, OSError, ValueError, KeyError,
-                EOFError) as e:
+        except (SystemExit, Exception) as e:   # DecodeError/struct/...
             print(f"cannot read {args.infile}: {e}", file=sys.stderr)
             return 22
         e = Encoder()

@@ -316,3 +316,52 @@ class TestSetCrushmap:
                          "osd", "setcrushmap"]) == 22
         finally:
             c.stop()
+
+
+class TestBootAfterSetcrushmap:
+    def test_new_osd_joins_injected_hierarchy(self, tmp_path):
+        """A fresh osd booting after setcrushmap must land inside the
+        operator's failure-domain shape (crush-location hook default),
+        not on a hardcoded legacy root."""
+        import time
+
+        from ceph_tpu.balancer import crush_parent
+        from ceph_tpu.tools import crushtool as ct
+        from ceph_tpu.tools.ceph_cli import main as ceph
+        from ceph_tpu.tools.vstart import MiniCluster
+        c = MiniCluster(n_osds=6, ms_type="async").start()
+        try:
+            c.wait_for_osd_count(6)
+            client = c.client(timeout=15.0)
+            txt = tmp_path / "m.txt"
+            txt.write_text(SAMPLE)
+            binp = str(tmp_path / "m.bin")
+            assert ct.main(["-c", str(txt), "-o", binp]) == 0
+            assert ceph(["-m", c.mon_host, "-i", binp,
+                         "osd", "setcrushmap"]) == 0
+            # boot a 7th osd: it must appear under the injected root in
+            # its own host-type bucket (usable by the chooseleaf rule)
+            c.run_osd(6)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if c.mon.osdmap.is_up(6):
+                    break
+                time.sleep(0.1)
+            m = c.mon.osdmap
+            assert m.is_up(6)
+            parent = crush_parent(m, 6)
+            assert parent is not None, "osd.6 not in any bucket"
+            host = m.crush.bucket(parent)
+            assert host.type == m.crush.bucket(-2).type  # host type
+            gp = crush_parent(m, parent)
+            assert gp == -1                              # under root
+            # and it can receive data via the host-failure-domain rule
+            pool = c.create_pool(client, pg_num=16, size=3)
+            io = client.open_ioctx(pool)
+            for i in range(12):
+                io.write_full(f"j{i}", b"w" * 128)
+            from ceph_tpu.balancer import pool_pg_histogram
+            hist = pool_pg_histogram(c.mon.osdmap, pool)
+            assert 6 in hist, "booted osd receives no placements"
+        finally:
+            c.stop()

@@ -1,0 +1,167 @@
+"""OpTracker event timelines + lockdep lock-order checking (SURVEY §5
+aux subsystems: common/TrackedOp, osd/OpRequest, common/lockdep)."""
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.common.lockdep import (
+    DebugRLock, LockOrderError, enable, reset)
+from ceph_tpu.common.op_tracker import OpTracker
+
+
+class TestOpTracker:
+    def test_timeline_and_history(self):
+        trk = OpTracker(complaint_time=0.05,
+                        history_slow_threshold=0.01)
+        op = trk.create_request("osd_op(client.1.7 1.0 obj)")
+        op.mark_event("reached_pg")
+        d = trk.dump_ops_in_flight()
+        assert d["num_ops"] == 1
+        assert [e["event"] for e in d["ops"][0]["type_data"]["events"]] \
+            == ["initiated", "reached_pg"]
+        time.sleep(0.06)
+        assert any("slow request" in w
+                   for w in trk.check_ops_in_flight())
+        op.mark_event("commit_sent")
+        op.finish()
+        assert trk.dump_ops_in_flight()["num_ops"] == 0
+        h = trk.dump_historic_ops()
+        assert h["num_ops"] == 1
+        assert h["ops"][0]["duration"] >= 0.06
+        assert h["slowest"]                      # crossed slow threshold
+        assert trk.check_ops_in_flight() == []
+        op.finish()                              # idempotent
+
+    def test_history_ring_bounded(self):
+        trk = OpTracker(history_size=5, history_slow_threshold=99)
+        for i in range(12):
+            trk.create_request(f"op{i}").finish()
+        h = trk.dump_historic_ops()
+        assert h["num_ops"] == 5
+        assert h["ops"][0]["description"] == "op7"
+
+    def test_live_osd_exposes_tracked_ops(self):
+        from ceph_tpu.tools.vstart import MiniCluster
+        c = MiniCluster(n_osds=3, ms_type="loopback").start()
+        try:
+            c.wait_for_osd_count(3)
+            client = c.client(timeout=15.0)
+            pool = c.create_pool(client, pg_num=4, size=3)
+            io = client.open_ioctx(pool)
+            for i in range(4):
+                io.write_full(f"t{i}", b"x" * 128)
+            assert io.read("t0") == b"x" * 128
+            hist = {}
+            for d in c.osds.values():
+                hist.update({o["description"]: o for o in
+                             d.op_tracker.dump_historic_ops()["ops"]})
+            assert hist, "no completed ops recorded"
+            some = next(iter(hist.values()))
+            events = [e["event"] for e in some["type_data"]["events"]]
+            assert events[0] == "initiated"
+            assert any(e.startswith("reply result=") for e in events)
+            assert events[-1] == "done"
+            # nothing leaks in-flight once the cluster is quiescent
+            time.sleep(0.3)
+            for d in c.osds.values():
+                assert d.op_tracker.dump_ops_in_flight()["num_ops"] == 0
+        finally:
+            c.stop()
+
+
+class TestLockdep:
+    def setup_method(self):
+        reset()
+        enable(True)
+
+    def teardown_method(self):
+        enable(False)
+        reset()
+
+    def test_cycle_detected(self):
+        a, b = DebugRLock("a"), DebugRLock("b")
+        with a:
+            with b:
+                pass
+        with pytest.raises(LockOrderError):
+            with b:
+                with a:
+                    pass
+
+    def test_consistent_order_and_reentrancy_ok(self):
+        a, b = DebugRLock("x"), DebugRLock("y")
+        for _ in range(3):
+            with a:
+                with a:          # re-entrant: no self edge
+                    with b:
+                        pass
+
+    def test_three_lock_cycle(self):
+        a, b, c = (DebugRLock(n) for n in ("l1", "l2", "l3"))
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with pytest.raises(LockOrderError):
+            with c:
+                with a:
+                    pass
+
+    def test_threads_have_independent_held_stacks(self):
+        a, b = DebugRLock("t1"), DebugRLock("t2")
+        errs = []
+
+        def worker():
+            try:
+                with b:
+                    time.sleep(0.05)
+            except Exception as e:   # pragma: no cover
+                errs.append(e)
+
+        t = threading.Thread(target=worker)
+        with a:
+            t.start()
+            time.sleep(0.02)
+        t.join()
+        assert not errs
+
+
+class TestLockdepLiveCluster:
+    def test_daemon_lock_order_clean_under_workload(self):
+        """g_lockdep-style CI pass: run a replicated+EC workload with
+        every daemon lock order-checked; any cycle in
+        osd/mon/paxos/elector/store lock acquisition fails the test."""
+        from ceph_tpu.common import lockdep
+        lockdep.reset()
+        lockdep.enable(True)
+        try:
+            from ceph_tpu.tools.vstart import MiniCluster
+            c = MiniCluster(n_osds=4, ms_type="loopback",
+                            heartbeats=True).start()
+            try:
+                c.wait_for_osd_count(4)
+                client = c.client(timeout=30.0)
+                pool = c.create_pool(client, pg_num=8, size=3)
+                io = client.open_ioctx(pool)
+                for i in range(10):
+                    io.write_full(f"ld{i}", b"z" * 256)
+                for i in range(10):
+                    assert io.read(f"ld{i}") == b"z" * 256
+                # kill an osd; heartbeat failure reports mark it down
+                # and i/o proceeds on the survivors — exercising the
+                # peering/recovery/heartbeat lock paths under lockdep
+                c.kill_osd(0)
+                io.write_full("after-kill", b"k" * 64)
+                c.run_osd(0)
+                time.sleep(1.0)
+                assert io.read("after-kill") == b"k" * 64
+            finally:
+                c.stop()
+            assert lockdep.violations == [], lockdep.violations[0]
+        finally:
+            lockdep.enable(False)
+            lockdep.reset()
