@@ -80,8 +80,27 @@ register_options([
            "seconds without ping before reporting failure"),
     Option("mon_osd_min_down_reporters", OPT_INT, 2,
            "distinct reporters before the mon marks an osd down"),
+    Option("mon_osd_adjust_heartbeat_grace", OPT_INT, 1,
+           "scale the mark-down grace by the target's laggy history "
+           "(OSDMonitor.cc:2548-2572 analog)"),
+    Option("mon_osd_laggy_halflife", OPT_FLOAT, 3600.0,
+           "seconds for laggy history to decay by half"),
+    Option("mon_osd_laggy_weight", OPT_FLOAT, 0.3,
+           "weight of the newest laggy interval in the decaying average"),
+    Option("mon_osd_laggy_max_interval", OPT_FLOAT, 300.0,
+           "cap on a single recorded laggy interval (seconds)"),
     Option("osd_op_complaint_time", OPT_FLOAT, 30.0,
            "age after which an in-flight op is a slow request"),
+    Option("osd_map_renew_interval", OPT_FLOAT, 2.0,
+           "seconds between mon map-subscription renewals"),
+    Option("osd_op_queue", OPT_STR, "mclock",
+           "op scheduler: mclock (sharded QoS queue) | direct"),
+    Option("osd_op_num_shards", OPT_INT, 2,
+           "op queue shards (ops shard by pgid; per-PG order kept)"),
+    Option("osd_max_backfills", OPT_INT, 1,
+           "PGs an osd recovers concurrently (reservation slots)"),
+    Option("osd_recovery_max_active", OPT_INT, 3,
+           "in-flight object pulls per recovering PG"),
     Option("log_level", OPT_INT, 1, "default subsystem log level"),
     Option("ms_type", OPT_STR, "async",
            "messenger implementation: async | loopback"),

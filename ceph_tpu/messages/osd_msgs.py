@@ -350,17 +350,22 @@ class MOSDFailure(Message):
     TYPE = 51  # MSG_OSD_FAILURE
 
     def __init__(self, reporter: int = 0, failed_osd: int = 0,
-                 failed_for: float = 0.0, epoch: int = 0):
+                 failed_for: float = 0.0, epoch: int = 0,
+                 alive: bool = False):
         super().__init__()
         self.reporter = reporter
         self.failed_osd = failed_osd
         self.failed_for = failed_for
         self.epoch = epoch
+        #: v2: FLAG_ALIVE cancellation (messages/MOSDFailure.h if_osd_alive)
+        #: — the reporter heard from the peer again; retract my report
+        self.alive = alive
 
     def encode_payload(self, enc):
-        enc.versioned(1, 1, lambda e: (
+        enc.versioned(2, 1, lambda e: (
             e.s32(self.reporter), e.s32(self.failed_osd),
-            e.f64(self.failed_for), e.u32(self.epoch)))
+            e.f64(self.failed_for), e.u32(self.epoch),
+            e.u8(1 if self.alive else 0)))
 
     def decode_payload(self, dec, version):
         def body(d, v):
@@ -368,7 +373,8 @@ class MOSDFailure(Message):
             self.failed_osd = d.s32()
             self.failed_for = d.f64()
             self.epoch = d.u32()
-        dec.versioned(1, body)
+            self.alive = bool(d.u8()) if v >= 2 else False
+        dec.versioned(2, body)
 
 
 @register_message

@@ -67,23 +67,28 @@ class MOSDBoot(Message):
 
 @register_message
 class MMonSubscribe(Message):
-    """client/osd -> mon: send me map updates (MMonSubscribe analog)."""
+    """client/osd -> mon: send me map updates (MMonSubscribe analog).
+    v2: carries the subscriber's current epoch (the reference sub's
+    `start`) so a renewal from an up-to-date subscriber costs nothing."""
 
     TYPE = 15
 
-    def __init__(self, name: str = "", addr: str = ""):
+    def __init__(self, name: str = "", addr: str = "", epoch: int = 0):
         super().__init__()
         self.name = name
         self.addr = addr
+        self.epoch = epoch
 
     def encode_payload(self, enc: Encoder):
-        enc.versioned(1, 1, lambda e: (e.str(self.name), e.str(self.addr)))
+        enc.versioned(2, 1, lambda e: (e.str(self.name), e.str(self.addr),
+                                       e.u32(self.epoch)))
 
     def decode_payload(self, dec: Decoder, version: int):
         def body(d, v):
             self.name = d.str()
             self.addr = d.str()
-        dec.versioned(1, body)
+            self.epoch = d.u32() if v >= 2 else 0
+        dec.versioned(2, body)
 
 
 @register_message
@@ -135,6 +140,12 @@ class MMonForwardAck(Message):
         dec.versioned(1, body)
 
 
+def _referenced_bucket_ids(crush) -> set:
+    """Bucket/item ids that appear inside some bucket — i.e. everything
+    but the root(s).  Shared by root detection and parent lookup."""
+    return {it for b in crush.buckets if b is not None for it in b.items}
+
+
 class Monitor(Dispatcher):
     TICK_INTERVAL = 0.25
 
@@ -148,8 +159,10 @@ class Monitor(Dispatcher):
         self.osdmap = OSDMap()
         from ceph_tpu.common.lockdep import make_lock
         self._lock = make_lock(f"Monitor::lock({mon_id})")
-        #: failure reports: failed_osd -> {reporter: report_time}
-        self._failure_reports: dict[int, dict[int, float]] = {}
+        #: failure reports: failed_osd -> {reporter: (report_time,
+        #: failed_for)} — report_time expires stale reports, failed_for
+        #: is the reporter's observed silence when it filed
+        self._failure_reports: dict[int, dict[int, tuple[float, float]]] = {}
         #: subscriber name -> (addr, entity)
         self._subs: dict[str, tuple[str, EntityName]] = {}
         self._osd_addrs: dict[int, str] = {}
@@ -345,6 +358,8 @@ class Monitor(Dispatcher):
     # -- dispatch -------------------------------------------------------------
 
     def ms_dispatch(self, msg) -> bool:
+        if self._stop:
+            return True  # stopping mon answers nothing (zombie guard)
         if isinstance(msg, MMonElection):
             if self.elector:
                 self.elector.handle(msg)
@@ -378,8 +393,11 @@ class Monitor(Dispatcher):
                 entity = (msg.connection.peer_name
                           or EntityName.parse(msg.name))
                 self._subs[msg.name] = (msg.addr, entity)
-                epoch, blob = self.osdmap.epoch, encode_osdmap(self.osdmap)
-            if epoch > 0:
+                epoch = self.osdmap.epoch
+                # renewal from a current subscriber: nothing to send
+                blob = (encode_osdmap(self.osdmap)
+                        if epoch > msg.epoch else None)
+            if epoch > 0 and blob is not None:
                 con = self.msgr.connect_to(msg.addr, entity)
                 con.send_message(MOSDMapMsg(epoch=epoch, map_blob=blob))
             return True
@@ -422,8 +440,23 @@ class Monitor(Dispatcher):
             if osd >= m.max_osd:
                 m.set_max_osd(osd + 1)
             newly_known = not m.exists(osd)
+            was_down = m.exists(osd) and not m.is_up(osd)
             m.mark_up(osd, weight=m.osd_weight[osd] or 0x10000)
             m.osd_addrs[osd] = msg.addr
+            if was_down:
+                # a marked-down osd that boots right back was laggy, not
+                # dead: fold this episode into the decaying laggy history
+                # that check_failure uses to extend the grace
+                # (OSDMonitor::prepare_boot xinfo update)
+                xi = m.get_xinfo(osd)
+                if xi.down_stamp > 0:
+                    w = float(self.ctx.conf.get("mon_osd_laggy_weight"))
+                    cap = float(self.ctx.conf.get(
+                        "mon_osd_laggy_max_interval"))
+                    interval = min(time.time() - xi.down_stamp, cap)
+                    xi.laggy_interval = (
+                        w * interval + (1 - w) * xi.laggy_interval)
+                    xi.laggy_probability = w + (1 - w) * xi.laggy_probability
             if newly_known:
                 self._crush_add_osd(m, osd, 0x10000)
         with self._lock:
@@ -438,8 +471,7 @@ class Monitor(Dispatcher):
         operator map injected via setcrushmap keeps its failure-domain
         shape instead of gaining stray devices on a hardcoded -1)."""
         crush = m.crush
-        referenced = {it for b in crush.buckets if b is not None
-                      for it in b.items}
+        referenced = _referenced_bucket_ids(crush)
         root = next((b for b in crush.buckets
                      if b is not None and b.id not in referenced), None)
         if root is None:
@@ -467,16 +499,73 @@ class Monitor(Dispatcher):
             root.weight += weight
         crush.max_devices = max(crush.max_devices, osd + 1)
 
+    def _reporter_subtree(self, osd: int) -> int:
+        """The failure-domain key a reporter counts under: its immediate
+        parent bucket in the crush hierarchy (host level for two-level
+        maps — mon_osd_reporter_subtree_level semantics), or the osd id
+        itself on flat maps where the parent is the root."""
+        crush = self.osdmap.crush
+        referenced = _referenced_bucket_ids(crush)
+        for b in crush.buckets:
+            if b is not None and osd in b.items and b.id in referenced:
+                return b.id
+        return osd
+
+    def _failure_grace(self, osd: int, now: float) -> float:
+        """Adaptive grace (OSDMonitor::check_failure, OSDMonitor.cc:
+        2548-2572): an osd with a history of being marked down and
+        booting right back — laggy, not dead — earns extra grace
+        proportional to that history, decayed by time since last down."""
+        import math
+        grace = float(self.ctx.conf.get("osd_heartbeat_grace"))
+        if not int(self.ctx.conf.get("mon_osd_adjust_heartbeat_grace")):
+            return grace
+        xi = self.osdmap.get_xinfo(osd)
+        if xi.laggy_probability > 0 and xi.laggy_interval > 0:
+            halflife = float(self.ctx.conf.get("mon_osd_laggy_halflife"))
+            decay = math.exp(math.log(0.5) / halflife
+                             * max(now - xi.down_stamp, 0.0))
+            grace += decay * xi.laggy_interval * xi.laggy_probability
+        return grace
+
     def _do_failure(self, msg: MOSDFailure) -> None:
         need = int(self.ctx.conf.get("mon_osd_min_down_reporters"))
+        now = time.time()
         with self._lock:
+            if msg.alive:
+                # reporter heard from the peer again: retract its report
+                # (OSDMonitor::process_failure FLAG_ALIVE path)
+                reports = self._failure_reports.get(msg.failed_osd)
+                if reports:
+                    reports.pop(msg.reporter, None)
+                    if not reports:
+                        self._failure_reports.pop(msg.failed_osd, None)
+                return
             if not self.osdmap.is_up(msg.failed_osd):
                 return
             reports = self._failure_reports.setdefault(msg.failed_osd, {})
-            reports[msg.reporter] = time.time()
-            if len(reports) < need:
+            reports[msg.reporter] = (now, msg.failed_for)
+            # a report is only a live witness while its reporter is still
+            # up and it is fresh — a reporter that died after filing can
+            # never retract, and peers re-file every heartbeat tick, so
+            # anything older than a few grace periods is stale
+            # (check_failure cancels reports from down reporters)
+            expiry = 2 * float(self.ctx.conf.get("osd_heartbeat_grace"))
+            for r in [r for r, (t, _ff) in reports.items()
+                      if not self.osdmap.is_up(r) or now - t > expiry]:
+                del reports[r]
+            if not reports:
+                self._failure_reports.pop(msg.failed_osd, None)
                 return
-            # quorum of reporters: mark down (check_failure analog)
+            # reporters must span distinct failure domains
+            # (mon_osd_reporter_subtree_level: two osds on one host are
+            # one witness) and the peer must have been unreachable for
+            # the full — possibly laggy-extended — grace
+            subtrees = {self._reporter_subtree(r) for r in reports}
+            failed_for = max(ff for _t, ff in reports.values())
+            if (len(subtrees) < need
+                    or failed_for < self._failure_grace(msg.failed_osd, now)):
+                return
             self._failure_reports.pop(msg.failed_osd, None)
 
         def fn(m: OSDMap):

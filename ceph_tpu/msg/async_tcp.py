@@ -295,6 +295,9 @@ class AsyncMessenger(Messenger):
         self._accept_thread.start()
 
     def _accept_one(self, sock: socket.socket) -> None:
+        if self._stop:
+            sock.close()
+            return
         try:
             # handshake-phase timeout: an unauthenticated peer that
             # stalls mid-handshake must not leak a thread + fd
@@ -309,8 +312,17 @@ class AsyncMessenger(Messenger):
         con = TcpConnection(self, f"{sock.getpeername()[0]}:0", peer,
                             policy, sock=sock, accepted=True)
         with self._lock:
-            old = self._conns.get(f"accepted:{peer}")
-            self._conns[f"accepted:{peer}"] = con
+            if self._stop:
+                # raced shutdown(): it already swept _conns — a session
+                # registered now would live on as a zombie responder
+                stop = True
+            else:
+                stop = False
+                old = self._conns.get(f"accepted:{peer}")
+                self._conns[f"accepted:{peer}"] = con
+        if stop:
+            con.mark_down()
+            return
         if old is not None:
             old.mark_down()   # reap the replaced session
 
@@ -328,7 +340,12 @@ class AsyncMessenger(Messenger):
         key = f"{addr}/{peer_name}"
         with self._lock:
             con = self._conns.get(key)
-            if con is not None and con.is_connected():
+            # keep a live-or-dialing connection: its writer thread owns a
+            # backlog and self-heals stateful sessions.  Replacing a con
+            # that is merely mid-dial would orphan that backlog — queued
+            # messages black-hole while the caller talks to the new con
+            # (and each redial storms the peer's accepted-session table)
+            if con is not None and not con._down:
                 return con
             policy = self.policy_for(peer_name.type)
             con = TcpConnection(self, addr, peer_name, policy)

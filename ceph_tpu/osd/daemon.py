@@ -190,6 +190,10 @@ class OSDDaemon(Dispatcher):
         self._codecs: dict[int, object] = {}
         self._osd_addr_cache: dict[int, str] = {}
         self._hb_last: dict[int, float] = {}
+        #: peers I currently have failure reports filed against; a ping
+        #: from one triggers an alive-cancellation to the mons
+        self._failure_reported: set[int] = set()
+        self._last_sub_renew = 0.0
         #: (pgid, oid) -> {client_id: connection} (watch/notify; session
         #: scope — the reference persists watchers in object_info)
         self._watchers: dict[tuple, dict[int, object]] = {}
@@ -239,6 +243,40 @@ class OSDDaemon(Dispatcher):
         self.ctx.admin.register_command(
             "pg dump", lambda **kw: self._pg_dump(), "pg states")
 
+        # sharded op queue with mClock QoS (osd/OSD.h ShardedOpWQ over
+        # osd/mClock*): ops shard by pgid, classes arbitrate by
+        # reservation/weight/limit.  One worker per shard keeps per-PG
+        # FIFO order.  "direct" executes on dispatch threads (legacy).
+        from ceph_tpu.osd.op_queue import ShardedOpQueue
+        self._use_opwq = str(self.ctx.conf.get("osd_op_queue")) == "mclock"
+        self.opwq = (ShardedOpQueue(
+            self._opwq_handle,
+            n_shards=int(self.ctx.conf.get("osd_op_num_shards")),
+            name=f"osd.{osd_id}") if self._use_opwq else None)
+
+        # recovery reservations (AsyncReserver / osd_max_backfills): a PG
+        # needs a slot before pulling; pulls run in a bounded window
+        from ceph_tpu.osd.reserver import AsyncReserver
+        self.local_reserver = AsyncReserver(
+            int(self.ctx.conf.get("osd_max_backfills")),
+            name=f"osd.{osd_id}")
+        self.ctx.admin.register_command(
+            "dump_reservations", lambda **kw: self.local_reserver.dump(),
+            "recovery reservation slots")
+
+    def _opwq_handle(self, klass: str, item) -> None:
+        """Shard worker: run the dispatch handler bound at enqueue."""
+        handler, msg = item
+        handler(msg)
+
+    def _enqueue_op(self, klass: str, shard_key, handler, msg) -> None:
+        """Route through the sharded mClock queue (enqueue_op →
+        op_shardedwq → dequeue_op), or run inline when disabled."""
+        if self.opwq is not None:
+            self.opwq.enqueue(shard_key, klass, (handler, msg))
+        else:
+            handler(msg)
+
     def _pg_dump(self) -> dict:
         with self._lock:
             return {f"{p[0]}.{p[1]}": {
@@ -255,12 +293,7 @@ class OSDDaemon(Dispatcher):
         self._load_pgs()
         self.msgr.bind(self._addr)
         self.msgr.start()
-        for rank, addr in enumerate(self.mon_addrs):
-            mon = self.msgr.connect_to(addr, EntityName("mon", rank))
-            mon.send_message(MMonSubscribe(name=str(self.whoami),
-                                           addr=self.msgr.my_addr))
-            mon.send_message(MOSDBoot(osd_id=self.osd_id,
-                                      addr=self.msgr.my_addr))
+        self._maybe_reboot()
         if self._heartbeats:
             self._schedule_heartbeat()
         self._schedule_tick()
@@ -271,6 +304,8 @@ class OSDDaemon(Dispatcher):
             self._hb_timer.cancel()
         if self._tick_timer:
             self._tick_timer.cancel()
+        if self.opwq is not None:
+            self.opwq.shutdown()
         self.msgr.shutdown()
         self.store.umount()
 
@@ -314,6 +349,7 @@ class OSDDaemon(Dispatcher):
         try:
             now = time.time()
             self._maybe_reboot()
+            self._renew_map_subscription(now)
             self._mgr_report()
             for warn in self.op_tracker.check_ops_in_flight():
                 dout("osd", 1, "osd.%d %s", self.osd_id, warn)
@@ -346,6 +382,27 @@ class OSDDaemon(Dispatcher):
         finally:
             self._schedule_tick()
 
+    def _renew_map_subscription(self, now: float,
+                                force: bool = False) -> None:
+        """Periodically re-subscribe to the mon map stream (the
+        reference's MonClient renews subscriptions on an interval).  The
+        subscription carries our epoch, so a renewal from a current osd
+        costs the mon nothing; a stale osd — one that missed a commit
+        push in a connection hiccup — gets the map and converges instead
+        of monitoring peers against a stale view forever.  Forced
+        renewals (epoch gossip hits) keep a small floor so a ping storm
+        from many peers collapses into one subscribe."""
+        interval = float(self.ctx.conf.get("osd_map_renew_interval"))
+        floor = min(0.25, interval) if force else interval
+        if now - self._last_sub_renew < floor:
+            return
+        self._last_sub_renew = now
+        for rank, addr in enumerate(self.mon_addrs):
+            mon = self.msgr.connect_to(addr, EntityName("mon", rank))
+            mon.send_message(MMonSubscribe(name=str(self.whoami),
+                                           addr=self.msgr.my_addr,
+                                           epoch=self.osdmap.epoch))
+
     def _maybe_reboot(self) -> None:
         """Re-send MOSDBoot until the map shows us up at our address —
         the first boot can race the monitor election/bootstrap
@@ -356,10 +413,9 @@ class OSDDaemon(Dispatcher):
                   and m.osd_addrs[self.osd_id] == self.msgr.my_addr)
         if booted:
             return
+        self._renew_map_subscription(time.time(), force=True)
         for rank, addr in enumerate(self.mon_addrs):
             mon = self.msgr.connect_to(addr, EntityName("mon", rank))
-            mon.send_message(MMonSubscribe(name=str(self.whoami),
-                                           addr=self.msgr.my_addr))
             mon.send_message(MOSDBoot(osd_id=self.osd_id,
                                       addr=self.msgr.my_addr))
 
@@ -384,28 +440,23 @@ class OSDDaemon(Dispatcher):
                     and now - pg.peering_started > self.STUCK_AFTER):
                 restart = True   # a query/notify was lost; re-run the round
             elif pg.state == STATE_RECOVERING:
-                for oid in sorted(pg.missing):
-                    started = pg.recovering.get(oid)
-                    if started is None or now - started > self.STUCK_AFTER:
-                        pg.recovering.pop(oid, None)
+                # drop stuck pulls; the window refill below re-issues them
+                for oid, started in list(pg.recovering.items()):
+                    if now - started > self.STUCK_AFTER:
+                        del pg.recovering[oid]
                         repulls.append(oid)
         if restart:
             self._start_peering(pg, pg.up, pg.primary)
             return
-        if not repulls:
-            return
-        pool = self.osdmap.pools.get(pg.pgid[0])
-        ec = pool is not None and pool.is_erasure()
-        for oid in repulls:
-            if pg.primary == self.osd_id:
-                if ec:
-                    self._recover_ec_object(pg, oid, dest_osd=self.osd_id)
-                else:
-                    source = self._pick_source(pg, pg.missing[oid].need)
-                    if source is not None:
-                        self._pull_object(pg, oid, source)
+        if pg.state == STATE_RECOVERING:
+            if self.local_reserver.has(pg.pgid):
+                if repulls or pg.missing:
+                    self._start_recovery_ops(pg)
             else:
-                self._pull_object(pg, oid, pg.primary)
+                # reservation lost (e.g. restored-from-disk state or a
+                # cancelled slot): re-request it
+                self.local_reserver.request(
+                    pg.pgid, lambda: self._start_recovery_ops(pg))
 
     def _load_pgs(self) -> None:
         """Rebuild in-memory PG state from persisted pgmeta
@@ -493,6 +544,8 @@ class OSDDaemon(Dispatcher):
                     self._start_peering(pg, up, primary)
 
     def _start_peering(self, pg: PG, up: list[int], primary: int) -> None:
+        # interval change: the old interval's recovery slot is void
+        self.local_reserver.cancel(pg.pgid)
         with self._lock:
             if pg.up and pg.up != up:
                 self._merge_past_up(pg, [pg.up], new_up=up)
@@ -637,16 +690,18 @@ class OSDDaemon(Dispatcher):
             self._merge_past_up(pg, msg.info.past_up)
             self._pg_merge(pg, msg.entries)
             pg.info.last_epoch_started = msg.info.last_epoch_started
-            if pg.missing:
+            degraded = bool(pg.missing)
+            if degraded:
                 pg.state = STATE_RECOVERING
-                pulls = sorted(pg.missing)
             else:
                 pg.state = STATE_ACTIVE
-                pulls = []
                 self._persist_info(pg)
-        for oid in pulls:
-            self._pull_object(pg, oid, source=pg.primary,
-                              con=msg.connection)
+        if degraded:
+            # replica recovers behind its own reservation slot: pull-based
+            # recovery makes the puller the backfill target, so its local
+            # reserver plays the remote-reservation role too
+            self.local_reserver.request(
+                pg.pgid, lambda: self._start_recovery_ops(pg))
 
     def _pg_merge(self, pg: PG, entries: list[LogEntry]) -> None:
         """merge_log + on-disk application of its consequences."""
@@ -705,26 +760,45 @@ class OSDDaemon(Dispatcher):
 
     def _pg_recover_or_activate(self, pg: PG) -> None:
         """Primary with the authoritative log: recover own missing objects
-        first, then activate replicas."""
+        first (behind a reservation slot), then activate replicas."""
         with self._lock:
-            if pg.missing:
+            degraded = bool(pg.missing)
+            if degraded:
                 pg.state = STATE_RECOVERING
-                pulls = sorted(pg.missing)
-            else:
-                pulls = []
-        if pulls:
-            pool = self.osdmap.pools.get(pg.pgid[0])
-            ec = pool is not None and pool.is_erasure()
-            # the auth peer (or any peer at/after need) has current data
-            for oid in pulls:
+        if degraded:
+            self.local_reserver.request(
+                pg.pgid, lambda: self._start_recovery_ops(pg))
+            return
+        self._pg_activate(pg)
+
+    def _start_recovery_ops(self, pg: PG) -> None:
+        """Issue pulls up to the osd_recovery_max_active window
+        (PrimaryLogPG::start_recovery_ops analog).  Runs on reservation
+        grant and again as each object lands; recovery thus pipelines
+        with client I/O instead of thundering in one burst."""
+        pool = self.osdmap.pools.get(pg.pgid[0])
+        ec = pool is not None and pool.is_erasure()
+        window = int(self.ctx.conf.get("osd_recovery_max_active"))
+        with self._lock:
+            if pg.state != STATE_RECOVERING:
+                self.local_reserver.cancel(pg.pgid)
+                return
+            room = window - len(pg.recovering)
+            # capture need under the lock: a racing push can delete the
+            # missing entry before the sends below run
+            todo = [(oid, pg.missing[oid].need)
+                    for oid in sorted(pg.missing)
+                    if oid not in pg.recovering][:max(0, room)]
+        for oid, need in todo:
+            if pg.primary == self.osd_id:
                 if ec:
                     self._recover_ec_object(pg, oid, dest_osd=self.osd_id)
                 else:
-                    source = self._pick_source(pg, pg.missing[oid].need)
+                    source = self._pick_source(pg, need)
                     if source is not None:
                         self._pull_object(pg, oid, source)
-            return
-        self._pg_activate(pg)
+            else:
+                self._pull_object(pg, oid, pg.primary)
 
     def _pick_source(self, pg: PG, need) -> int | None:
         candidates = [o for o, ps in pg.peers.items()
@@ -850,6 +924,7 @@ class OSDDaemon(Dispatcher):
                           got_version) -> None:
         """My own missing object arrived; maybe finish recovery."""
         activate = False
+        done = False
         with self._lock:
             item = pg.missing.get(oid)
             if item is not None and (got_version is None
@@ -857,6 +932,7 @@ class OSDDaemon(Dispatcher):
                 del pg.missing[oid]
             pg.recovering.pop(oid, None)
             if not pg.missing and pg.state == STATE_RECOVERING:
+                done = True
                 if pg.primary == self.osd_id:
                     activate = True
                 else:
@@ -864,6 +940,10 @@ class OSDDaemon(Dispatcher):
             pg.info.last_complete = pg.complete_to()
             waiting = pg.waiting_for_missing.pop(oid, [])
         self._persist_info(pg)
+        if done:
+            self.local_reserver.cancel(pg.pgid)  # release the slot
+        elif pg.state == STATE_RECOVERING:
+            self._start_recovery_ops(pg)  # refill the pull window
         if activate:
             self._pg_activate(pg)
         for m in waiting:
@@ -955,38 +1035,56 @@ class OSDDaemon(Dispatcher):
                 # answers is as failed as one that stopped answering
                 last = self._hb_last.setdefault(peer, now)
                 if now - last > grace:
+                    self._failure_reported.add(peer)
                     for rank, addr in enumerate(self.mon_addrs):
                         mon = self.msgr.connect_to(
                             addr, EntityName("mon", rank))
                         mon.send_message(MOSDFailure(
                             reporter=self.osd_id, failed_osd=peer,
                             failed_for=now - last, epoch=m.epoch))
+            # forget peers the map marked down: a reported peer needs no
+            # cancellation anymore, and its grace clock must restart from
+            # scratch when it reboots — a stale _hb_last would instantly
+            # re-report a healthy rebooted osd with a huge failed_for
+            self._failure_reported = {p for p in self._failure_reported
+                                      if m.is_up(p)}
+            for p in [p for p in self._hb_last if not m.is_up(p)]:
+                del self._hb_last[p]
         finally:
             self._schedule_heartbeat()
 
     # -- dispatch -------------------------------------------------------------
 
     def ms_dispatch(self, msg) -> bool:
+        if self._stop:
+            # a stopping daemon answers nothing (OSD::ms_dispatch
+            # is_stopping): a zombie reply — e.g. a ping ack over a
+            # connection accepted mid-shutdown — would keep peers'
+            # liveness clocks fresh for a dead osd
+            return True
         if isinstance(msg, MOSDMapMsg):
             self._handle_map(msg)
             return True
+        # queued classes (enqueue_op → op_shardedwq → dequeue_op): work
+        # items shard by pgid and ride the mClock scheduler; replies and
+        # control-plane traffic dispatch inline (ms_fast_dispatch)
         if isinstance(msg, MOSDOp):
-            self._handle_op(msg)
+            self._enqueue_op("client", msg.pgid, self._handle_op, msg)
             return True
         if isinstance(msg, MOSDRepOp):
-            self._handle_rep_op(msg)
+            self._enqueue_op("subop", msg.pgid, self._handle_rep_op, msg)
             return True
         if isinstance(msg, MOSDRepOpReply):
             self._handle_rep_reply(msg)
             return True
         if isinstance(msg, MOSDECSubOpWrite):
-            self._handle_ec_write(msg)
+            self._enqueue_op("subop", msg.pgid, self._handle_ec_write, msg)
             return True
         if isinstance(msg, MOSDECSubOpWriteReply):
             self._handle_ec_write_reply(msg)
             return True
         if isinstance(msg, MOSDECSubOpRead):
-            self._handle_ec_read(msg)
+            self._enqueue_op("subop", msg.pgid, self._handle_ec_read, msg)
             return True
         if isinstance(msg, MOSDECSubOpReadReply):
             self._handle_ec_read_reply(msg)
@@ -1004,16 +1102,16 @@ class OSDDaemon(Dispatcher):
             self._handle_pg_log(msg)
             return True
         if isinstance(msg, MOSDPGPull):
-            self._handle_pull(msg)
+            self._enqueue_op("recovery", msg.pgid, self._handle_pull, msg)
             return True
         if isinstance(msg, MOSDPGPush):
-            self._handle_push(msg)
+            self._enqueue_op("recovery", msg.pgid, self._handle_push, msg)
             return True
         if isinstance(msg, MWatchNotifyAck):
             self._handle_notify_ack(msg)
             return True
         if isinstance(msg, MOSDScrub):
-            self._handle_scrub(msg)
+            self._enqueue_op("scrub", msg.pgid, self._handle_scrub, msg)
             return True
         if isinstance(msg, MOSDScrubReply):
             self._handle_scrub_reply(msg)
@@ -1022,6 +1120,19 @@ class OSDDaemon(Dispatcher):
 
     def _handle_ping(self, msg: MOSDPing) -> None:
         self._hb_last[msg.from_osd] = time.time()
+        if msg.epoch > self.osdmap.epoch:
+            # peer runs a newer map: catch up now (epoch gossip on the
+            # heartbeat channel — OSD map-sharing semantics)
+            self._renew_map_subscription(time.time(), force=True)
+        if msg.from_osd in self._failure_reported:
+            # the peer I reported as failed is talking again: retract
+            # (OSD::send_still_alive / MOSDFailure FLAG_ALIVE)
+            self._failure_reported.discard(msg.from_osd)
+            for rank, addr in enumerate(self.mon_addrs):
+                mon = self.msgr.connect_to(addr, EntityName("mon", rank))
+                mon.send_message(MOSDFailure(
+                    reporter=self.osd_id, failed_osd=msg.from_osd,
+                    epoch=self.osdmap.epoch, alive=True))
         if msg.op == MOSDPing.PING and msg.connection is not None:
             msg.connection.send_message(MOSDPing(
                 from_osd=self.osd_id, op=MOSDPing.PING_REPLY,
@@ -1069,6 +1180,8 @@ class OSDDaemon(Dispatcher):
             if msg.epoch < m.epoch and msg.connection is not None:
                 msg.connection.send_message(MOSDMapMsg(
                     epoch=m.epoch, map_blob=encode_osdmap(m)))
+            msg._trk.mark_event("dropped: not primary")
+            msg._trk.finish()
             return
         # check-and-enqueue must be atomic with the flush paths
         # (_pg_activate / _peer_recovered / _object_recovered), or an op can
@@ -1090,6 +1203,10 @@ class OSDDaemon(Dispatcher):
                     msg._trk.mark_event(
                         f"waiting for pg active (state={pg.state})")
                     pg.waiting_for_active.append(msg)
+                else:
+                    # pgid out of range for the pool: drop, close the op
+                    msg._trk.mark_event("dropped: pgid out of range")
+                    msg._trk.finish()
                 return
             is_write = any(op.op in (OP_WRITE, OP_WRITEFULL, OP_DELETE,
                                      OP_OMAP_SET) for op in msg.ops)

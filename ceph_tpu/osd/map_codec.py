@@ -8,7 +8,7 @@ from ceph_tpu.crush.types import (
     Bucket, ChooseArg, CrushMap, Rule, RuleStep, Tunables)
 from ceph_tpu.msg.encoding import Decoder, Encoder
 
-from .osdmap import OSDMap, PGPool
+from .osdmap import OSDMap, OSDXInfo, PGPool
 
 
 # -- crush ------------------------------------------------------------------
@@ -175,8 +175,12 @@ def encode_osdmap(m: OSDMap) -> bytes:
         import json as _json
         e.bytes(_json.dumps(m.crush_names).encode()
                 if m.crush_names else b"")
+        # v4: osd_xinfo laggy history (osd_xinfo_t vector)
+        e.list(m.osd_xinfo, lambda e2, x: (
+            e2.f64(x.down_stamp), e2.f64(x.laggy_probability),
+            e2.f64(x.laggy_interval)))
 
-    enc.versioned(3, 1, body)
+    enc.versioned(4, 1, body)
     return enc.tobytes()
 
 
@@ -220,8 +224,15 @@ def decode_osdmap(data: bytes) -> OSDMap:
             blob = d.bytes()
             if blob:
                 crush_names = _json.loads(blob.decode())
+        xinfo = []
+        if version >= 4:
+            xinfo = d.list(lambda d2: OSDXInfo(
+                down_stamp=d2.f64(), laggy_probability=d2.f64(),
+                laggy_interval=d2.f64()))
+        while len(xinfo) < max_osd:
+            xinfo.append(OSDXInfo())
         return OSDMap(epoch=epoch, crush=crush, max_osd=max_osd,
-                      crush_names=crush_names,
+                      crush_names=crush_names, osd_xinfo=xinfo,
                       osd_state=osd_state, osd_weight=osd_weight,
                       osd_primary_affinity=affinity, osd_addrs=osd_addrs,
                       pools=pools,

@@ -1,0 +1,188 @@
+"""Sharded op queue with mClock QoS scheduling.
+
+The reference pushes every op through a sharded work queue
+(osd/OSD.h:1725-1807 ShardedOpWQ over ShardedThreadPool,
+common/WorkQueue.h:619): ops shard by PG so one slow PG cannot head-of-line
+block the rest, and within a shard an mClock scheduler (osd/mClock*,
+dmclock submodule) arbitrates between op classes — client I/O, sub-ops,
+recovery, scrub, snap-trim — by (reservation, weight, limit) tags.
+
+This is that engine, reduced to its algorithmic core:
+
+  * `ShardedOpQueue(n_shards, n_workers_per_shard)` — items enqueue by a
+    shard key (the pgid), each shard owns an `MClockQueue` + worker
+    thread(s); per-(shard, class) FIFO order is preserved, which with
+    pg-keyed sharding gives the per-PG ordering the OSD requires.
+  * `MClockQueue` — dmclock tag math: each class k has a reservation
+    r_k (ops/s guaranteed), weight w_k (share of excess), limit l_k
+    (ops/s cap, 0 = none).  Each enqueued op gets tags
+        R_k = max(now, R_k_prev + 1/r_k)
+        L_k = max(now, L_k_prev + 1/l_k)
+        P_k = max(now, P_k_prev + 1/w_k)        (proportional tag)
+    Dequeue picks the earliest R-tag that is ≤ now (reservation phase);
+    otherwise the earliest P-tag among classes whose L-tag permits
+    (weight phase); otherwise the earliest R-tag (nothing eligible —
+    work-conserving fallback).
+
+dmclock reference: the mClock paper's tag rules as embodied in the
+reference's `osd_op_queue=mclock_*` options (common/options.cc).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ClassInfo:
+    """QoS parameters for one op class (dmclock ClientInfo analog)."""
+
+    reservation: float = 0.0   # guaranteed ops/s (0 = none)
+    weight: float = 1.0        # share of excess capacity
+    limit: float = 0.0         # ops/s cap (0 = unlimited)
+
+
+#: default op classes (osd_op_queue mclock profiles: client ops get
+#: weight-dominant service, recovery/scrub/snaptrim run in the excess)
+DEFAULT_CLASSES = {
+    "client": ClassInfo(reservation=0.0, weight=100.0, limit=0.0),
+    "subop": ClassInfo(reservation=0.0, weight=80.0, limit=0.0),
+    "recovery": ClassInfo(reservation=10.0, weight=10.0, limit=0.0),
+    "scrub": ClassInfo(reservation=0.0, weight=5.0, limit=100.0),
+    "snaptrim": ClassInfo(reservation=0.0, weight=5.0, limit=100.0),
+}
+
+
+@dataclass
+class _ClassState:
+    info: ClassInfo
+    q: deque = field(default_factory=deque)
+    r_tag: float = 0.0
+    p_tag: float = 0.0
+    l_tag: float = 0.0
+
+
+class MClockQueue:
+    """Single-shard mClock scheduler over named op classes."""
+
+    def __init__(self, classes: dict[str, ClassInfo] | None = None):
+        self._classes: dict[str, _ClassState] = {}
+        for name, info in (classes or DEFAULT_CLASSES).items():
+            self._classes[name] = _ClassState(info=info)
+        self._len = 0
+
+    def __len__(self) -> int:
+        return self._len
+
+    def enqueue(self, klass: str, item, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        st = self._classes.get(klass)
+        if st is None:
+            st = self._classes[klass] = _ClassState(info=ClassInfo())
+        i = st.info
+        if not st.q:
+            # idle class: tags restart from now (dmclock idle reset)
+            st.r_tag = now + (1.0 / i.reservation if i.reservation else 0.0)
+            st.p_tag = now + 1.0 / i.weight
+            st.l_tag = now + (1.0 / i.limit if i.limit else 0.0)
+        st.q.append(item)
+        self._len += 1
+
+    def _advance(self, st: _ClassState, now: float) -> None:
+        i = st.info
+        if i.reservation:
+            st.r_tag = max(now, st.r_tag + 1.0 / i.reservation)
+        if i.limit:
+            st.l_tag = max(now, st.l_tag + 1.0 / i.limit)
+        st.p_tag = max(now, st.p_tag + 1.0 / i.weight)
+
+    def dequeue(self, now: float | None = None):
+        """Return (class, item) or None if empty."""
+        now = time.monotonic() if now is None else now
+        backlogged = [(n, st) for n, st in self._classes.items() if st.q]
+        if not backlogged:
+            return None
+        # phase 1: honor reservations that are due
+        due = [(st.r_tag, n, st) for n, st in backlogged
+               if st.info.reservation and st.r_tag <= now]
+        if due:
+            _tag, name, st = min(due)
+            self._advance(st, now)
+            self._len -= 1
+            return name, st.q.popleft()
+        # phase 2: weight-proportional among classes under their limit
+        ok = [(st.p_tag, n, st) for n, st in backlogged
+              if not st.info.limit or st.l_tag <= now]
+        if ok:
+            _tag, name, st = min(ok)
+            self._advance(st, now)
+            self._len -= 1
+            return name, st.q.popleft()
+        # phase 3: everything limited — work-conserving: earliest limit tag
+        _tag, name, st = min((st.l_tag, n, st) for n, st in backlogged)
+        self._advance(st, now)
+        self._len -= 1
+        return name, st.q.popleft()
+
+
+class ShardedOpQueue:
+    """N independent mClock shards, each drained by worker thread(s).
+
+    Items shard by key (hash(pgid) % n_shards) so per-PG order is kept
+    and one stuck PG only wedges its shard (ShardedOpWQ semantics).
+    """
+
+    def __init__(self, handler, n_shards: int = 2,
+                 n_workers_per_shard: int = 1,
+                 classes: dict[str, ClassInfo] | None = None,
+                 name: str = "osd"):
+        self._handler = handler
+        self._n = max(1, n_shards)
+        self._shards = []
+        self._stop = False
+        self._threads: list[threading.Thread] = []
+        for s in range(self._n):
+            q = MClockQueue(classes)
+            cv = threading.Condition()
+            self._shards.append((q, cv))
+            for w in range(max(1, n_workers_per_shard)):
+                t = threading.Thread(
+                    target=self._worker, args=(q, cv),
+                    name=f"{name}-opwq-{s}.{w}", daemon=True)
+                t.start()
+                self._threads.append(t)
+
+    def enqueue(self, shard_key, klass: str, item) -> None:
+        q, cv = self._shards[hash(shard_key) % self._n]
+        with cv:
+            q.enqueue(klass, item)
+            cv.notify()
+
+    def shutdown(self) -> None:
+        self._stop = True
+        for _q, cv in self._shards:
+            with cv:
+                cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    def _worker(self, q: MClockQueue, cv: threading.Condition) -> None:
+        while True:
+            with cv:
+                while not self._stop and len(q) == 0:
+                    cv.wait(timeout=0.1)
+                if self._stop:
+                    return
+                got = q.dequeue()
+            if got is None:
+                continue
+            klass, item = got
+            try:
+                self._handler(klass, item)
+            except Exception:
+                from ceph_tpu.common.logging import get_logger
+                get_logger("osd").exception("opwq handler failed (%s)",
+                                            klass)

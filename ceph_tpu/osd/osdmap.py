@@ -95,6 +95,19 @@ class PGPool:
 
 
 @dataclass
+class OSDXInfo:
+    """osd_xinfo_t (src/osd/osd_types.h): laggy history the monitor uses
+    to scale the mark-down grace adaptively.  down_stamp is when the osd
+    was last marked down; laggy_probability/laggy_interval are decaying
+    averages of how often a marked-down osd turned out to be merely slow
+    (it booted again shortly after) and for how long."""
+
+    down_stamp: float = 0.0
+    laggy_probability: float = 0.0
+    laggy_interval: float = 0.0
+
+
+@dataclass
 class OSDMap:
     """The authoritative cluster map (src/osd/OSDMap.h:class OSDMap)."""
 
@@ -115,6 +128,8 @@ class OSDMap:
     #: CRUSH name side-tables (types/items/rules/classes, JSON-shaped —
     #: CrushWrapper type_map/name_map analog), set via `osd setcrushmap`
     crush_names: dict = field(default_factory=dict)
+    #: per-osd laggy history (osd_xinfo_t vector)
+    osd_xinfo: list[OSDXInfo] = field(default_factory=list)
 
     # -- osd state ------------------------------------------------------------
 
@@ -126,6 +141,14 @@ class OSDMap:
                           (self.osd_addrs, "")):
             while len(vec) < n:
                 vec.append(dflt)
+        while len(self.osd_xinfo) < n:
+            self.osd_xinfo.append(OSDXInfo())
+
+    def get_xinfo(self, osd: int) -> OSDXInfo:
+        if osd >= len(self.osd_xinfo):
+            while len(self.osd_xinfo) < max(self.max_osd, osd + 1):
+                self.osd_xinfo.append(OSDXInfo())
+        return self.osd_xinfo[osd]
 
     def is_up(self, osd: int) -> bool:
         return (0 <= osd < self.max_osd
@@ -140,7 +163,11 @@ class OSDMap:
         self.osd_weight[osd] = weight
 
     def mark_down(self, osd: int) -> None:
+        import time
         self.osd_state[osd] &= ~OSD_UP
+        # stamp for the laggy history (OSDMap Incremental down_at /
+        # osd_xinfo_t::down_stamp)
+        self.get_xinfo(osd).down_stamp = time.time()
 
     def mark_out(self, osd: int) -> None:
         self.osd_weight[osd] = 0

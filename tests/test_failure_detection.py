@@ -1,0 +1,283 @@
+"""Failure adjudication on the monitor: reporter quorum across failure
+domains, alive-cancellation, adaptive (laggy-aware) grace, and the xinfo
+laggy history — OSDMonitor::check_failure / process_failure semantics
+(src/mon/OSDMonitor.cc:2537-2572) at MiniCluster scale."""
+
+import time
+
+import pytest
+
+from ceph_tpu.messages import MOSDFailure
+from ceph_tpu.osd.map_codec import decode_osdmap, encode_osdmap
+from ceph_tpu.osd.osdmap import OSDMap, OSDXInfo
+from ceph_tpu.tools.vstart import MiniCluster
+
+
+@pytest.fixture
+def cluster():
+    c = MiniCluster(n_osds=3, ms_type="loopback").start()
+    c.wait_for_osd_count(3)
+    yield c
+    c.stop()
+
+
+def _inject_failure(mon, reporter, failed_osd, failed_for=100.0,
+                    alive=False):
+    mon._work_q.put(("failure", MOSDFailure(
+        reporter=reporter, failed_osd=failed_osd, failed_for=failed_for,
+        epoch=mon.osdmap.epoch, alive=alive), None))
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def test_single_reporter_does_not_mark_down(cluster):
+    mon = cluster.mon
+    _inject_failure(mon, reporter=0, failed_osd=2)
+    time.sleep(0.3)
+    assert mon.osdmap.is_up(2)
+    assert 2 in mon._failure_reports
+
+
+def test_reporter_quorum_marks_down(cluster):
+    mon = cluster.mon
+    _inject_failure(mon, reporter=0, failed_osd=2)
+    _inject_failure(mon, reporter=1, failed_osd=2)
+    assert _wait(lambda: not mon.osdmap.is_up(2)), \
+        "two distinct reporters should mark the osd down"
+    # down_stamp recorded for the laggy history
+    assert mon.osdmap.get_xinfo(2).down_stamp > 0
+
+
+def test_alive_report_cancels(cluster):
+    """A reporter that hears from the peer again retracts its report
+    (MOSDFailure FLAG_ALIVE); the half-filed failure never fires."""
+    mon = cluster.mon
+    _inject_failure(mon, reporter=0, failed_osd=2)
+    assert _wait(lambda: 2 in mon._failure_reports)
+    _inject_failure(mon, reporter=0, failed_osd=2, alive=True)
+    assert _wait(lambda: 2 not in mon._failure_reports)
+    # the second reporter alone is below quorum
+    _inject_failure(mon, reporter=1, failed_osd=2)
+    time.sleep(0.3)
+    assert mon.osdmap.is_up(2)
+
+
+def test_reporters_must_span_failure_domains(cluster):
+    """Two osds under the same host bucket are one witness
+    (mon_osd_reporter_subtree_level)."""
+    mon = cluster.mon
+    # construct a hierarchical map state: host0={0,1}, host1={2}
+    from ceph_tpu.crush.builder import make_bucket
+    from ceph_tpu.crush.types import CRUSH_BUCKET_STRAW2
+    with mon._lock:
+        m = mon.osdmap
+        m.crush.buckets = []
+        h0 = make_bucket(-2, CRUSH_BUCKET_STRAW2, 1, [0, 1],
+                         [0x10000, 0x10000])
+        h1 = make_bucket(-3, CRUSH_BUCKET_STRAW2, 1, [2], [0x10000])
+        root = make_bucket(-1, CRUSH_BUCKET_STRAW2, 2, [-2, -3],
+                           [h0.weight, h1.weight])
+        for b in (h0, h1, root):
+            m.crush.add_bucket(b)
+    assert mon._reporter_subtree(0) == -2
+    assert mon._reporter_subtree(1) == -2
+    assert mon._reporter_subtree(2) == -3
+    # reporters 0 and 1 share a host: not a quorum of failure domains
+    _inject_failure(mon, reporter=0, failed_osd=2)
+    _inject_failure(mon, reporter=1, failed_osd=2)
+    time.sleep(0.4)
+    assert mon.osdmap.is_up(2)
+
+
+def test_adaptive_grace_extends_with_laggy_history(cluster):
+    mon = cluster.mon
+    now = time.time()
+    base = float(mon.ctx.conf.get("osd_heartbeat_grace"))
+    xi = mon.osdmap.get_xinfo(2)
+    assert mon._failure_grace(2, now) == base
+    xi.laggy_probability = 0.5
+    xi.laggy_interval = 20.0
+    xi.down_stamp = now
+    g = mon._failure_grace(2, now)
+    assert g == pytest.approx(base + 10.0, rel=1e-3)
+    # the history decays: an episode half a halflife ago counts ~71%
+    halflife = float(mon.ctx.conf.get("mon_osd_laggy_halflife"))
+    xi.down_stamp = now - halflife
+    assert mon._failure_grace(2, now) == pytest.approx(base + 5.0, rel=1e-3)
+    # a report younger than the extended grace does not fire
+    xi.down_stamp = now
+    _inject_failure(mon, reporter=0, failed_osd=2, failed_for=base + 1)
+    _inject_failure(mon, reporter=1, failed_osd=2, failed_for=base + 1)
+    time.sleep(0.4)
+    assert mon.osdmap.is_up(2)
+    # but one older than it does
+    _inject_failure(mon, reporter=0, failed_osd=2, failed_for=base + 11)
+    assert _wait(lambda: not mon.osdmap.is_up(2))
+
+
+def test_laggy_history_accrues_on_reboot(cluster):
+    """An osd marked down that boots right back is laggy, not dead:
+    its xinfo decaying averages move (OSDMonitor::prepare_boot)."""
+    mon = cluster.mon
+    client = cluster.client()
+    rc, out = client.mon_command({"prefix": "osd down", "id": 2})
+    assert rc == 0, out
+    assert _wait(lambda: not mon.osdmap.is_up(2))
+    # the daemon is still alive; its tick re-sends MOSDBoot
+    assert _wait(lambda: mon.osdmap.is_up(2), timeout=10.0), \
+        "marked-down-but-alive osd never re-booted"
+    xi = mon.osdmap.get_xinfo(2)
+    assert xi.laggy_probability > 0
+    assert xi.laggy_interval >= 0
+
+
+def test_dead_reporters_do_not_count(cluster):
+    """A report whose reporter has since died is not a live witness:
+    one real reporter must not complete the quorum with a ghost."""
+    mon = cluster.mon
+    _inject_failure(mon, reporter=0, failed_osd=2)
+    assert _wait(lambda: 2 in mon._failure_reports)
+    # reporter 0 dies and is marked down
+    cluster.kill_osd(0)
+    client = cluster.client()
+    rc, out = client.mon_command({"prefix": "osd down", "id": 0})
+    assert rc == 0, out
+    assert _wait(lambda: not mon.osdmap.is_up(0))
+    # a single live reporter arrives: must NOT be quorum
+    _inject_failure(mon, reporter=1, failed_osd=2)
+    time.sleep(0.4)
+    assert mon.osdmap.is_up(2)
+
+
+def test_rebooted_peer_gets_fresh_grace_clock():
+    """After a peer is marked down, other osds drop its heartbeat state;
+    when it reboots they must not instantly re-report it with the stale
+    pre-crash timestamp (the down-flap loop)."""
+    c = MiniCluster(n_osds=3, ms_type="loopback", heartbeats=True).start()
+    try:
+        c.wait_for_osd_count(3)
+        for osd in c.osds.values():
+            osd.ctx.conf.set("osd_heartbeat_interval", 0.1)
+            osd.ctx.conf.set("osd_heartbeat_grace", 0.6)
+        observer = c.osds[0]
+        # first tick was scheduled with the default 1s interval
+        assert _wait(lambda: 2 in observer._hb_last, timeout=5.0)
+        c.kill_osd(2)
+        client = c.client()
+        rc, out = client.mon_command({"prefix": "osd down", "id": 2})
+        assert rc == 0, out
+        assert _wait(lambda: not c.mon.osdmap.is_up(2))
+        epoch = c.mon.osdmap.epoch
+        c.wait_for_epoch(epoch)
+        # the observer's next tick drops the dead peer's clock
+        assert _wait(lambda: 2 not in observer._hb_last, timeout=5.0), \
+            "observer kept the dead peer's stale heartbeat timestamp"
+        assert 2 not in observer._failure_reported
+        # peer reboots much later: clock restarts from first contact
+        c.run_osd(2)
+        c.wait_for_osd_count(3)
+        time.sleep(1.0)  # several grace periods of healthy pinging
+        assert c.mon.osdmap.is_up(2), \
+            "rebooted healthy osd was re-reported from stale state"
+    finally:
+        c.stop()
+
+
+def test_dead_daemon_answers_nothing():
+    """A shut-down osd must not keep answering pings over a connection
+    accepted mid-shutdown — a zombie replier keeps peers' liveness
+    clocks fresh for a dead osd and failure detection never fires
+    (OSD::ms_dispatch is_stopping semantics)."""
+    c = MiniCluster(n_osds=3, ms_type="async", heartbeats=True).start()
+    try:
+        c.wait_for_osd_count(3)
+        for osd in c.osds.values():
+            osd.ctx.conf.set("osd_heartbeat_interval", 0.1)
+            osd.ctx.conf.set("osd_heartbeat_grace", 0.5)
+        c.mon.ctx.conf.set("osd_heartbeat_grace", 0.5)
+        time.sleep(1.2)
+        victim = c.osds[2]
+        c.kill_osd(2)
+        # the victim object must be inert: no live accepted sessions
+        # may dispatch into it
+        from ceph_tpu.messages.osd_msgs import MOSDPing
+        assert victim.ms_dispatch(MOSDPing(from_osd=0)) is True  # swallowed
+        # peers' reports must now converge on a mark-down
+        assert _wait(lambda: not c.mon.osdmap.is_up(2), timeout=10.0), \
+            "dead osd never marked down (zombie replies?)"
+    finally:
+        c.stop()
+
+
+def test_stale_map_osd_catches_up():
+    """An osd that missed a map push converges via subscription renewal
+    (MonClient renew) instead of monitoring peers against a stale map."""
+    c = MiniCluster(n_osds=3, ms_type="loopback").start()
+    try:
+        c.wait_for_osd_count(3)
+        osd = c.osds[1]
+        for o in c.osds.values():
+            o.ctx.conf.set("osd_map_renew_interval", 0.2)
+        # simulate a missed push: regress osd1's map to epoch 0
+        from ceph_tpu.osd.osdmap import OSDMap
+        with osd._lock:
+            osd.osdmap = OSDMap(epoch=0)
+        assert _wait(lambda: osd.osdmap.epoch == c.mon.osdmap.epoch,
+                     timeout=5.0), "renewal never re-synced the stale osd"
+    finally:
+        c.stop()
+
+
+def test_xinfo_codec_roundtrip():
+    m = OSDMap()
+    m.set_max_osd(3)
+    m.mark_up(0)
+    m.osd_xinfo[1] = OSDXInfo(down_stamp=123.5, laggy_probability=0.3,
+                              laggy_interval=42.0)
+    m2 = decode_osdmap(encode_osdmap(m))
+    assert m2.osd_xinfo[1].down_stamp == 123.5
+    assert m2.osd_xinfo[1].laggy_probability == 0.3
+    assert m2.osd_xinfo[1].laggy_interval == 42.0
+    assert m2.osd_xinfo[0].down_stamp == 0.0
+
+
+def test_osd_sends_alive_cancellation():
+    """End-to-end: a transiently silent peer is reported, answers again,
+    and the reporter retracts — the mon's report table drains and the
+    peer is never marked down."""
+    c = MiniCluster(n_osds=3, ms_type="loopback", heartbeats=True).start()
+    try:
+        c.wait_for_osd_count(3)
+        for osd in c.osds.values():
+            osd.ctx.conf.set("osd_heartbeat_interval", 0.1)
+            osd.ctx.conf.set("osd_heartbeat_grace", 0.6)
+        # require 3 reporters so the two live peers can't complete quorum
+        c.mon.ctx.conf.set("mon_osd_min_down_reporters", 3)
+        time.sleep(0.5)
+        victim = c.osds[2]
+        # simulate a transient partition: the victim stops sending and
+        # answering pings (but stays booted)
+        victim._stop = True
+        if victim._hb_timer:
+            victim._hb_timer.cancel()
+        old = victim._handle_ping
+        victim._handle_ping = lambda msg: None
+        assert _wait(lambda: 2 in c.mon._failure_reports, timeout=5.0), \
+            "peers never reported the silent osd"
+        # partition heals
+        victim._handle_ping = old
+        victim._stop = False
+        victim._schedule_heartbeat()
+        victim._schedule_tick()
+        assert _wait(lambda: 2 not in c.mon._failure_reports, timeout=5.0), \
+            "alive cancellation never drained the report table"
+        assert c.mon.osdmap.is_up(2)
+    finally:
+        c.stop()

@@ -1,0 +1,129 @@
+"""mClock scheduler + sharded op queue (osd/mClock*, OSD.h ShardedOpWQ
+analog): tag math, class arbitration, per-key FIFO, and the OSD wired
+through it."""
+
+import threading
+import time
+
+from ceph_tpu.osd.op_queue import (
+    ClassInfo, MClockQueue, ShardedOpQueue)
+
+
+def test_fifo_within_class():
+    q = MClockQueue({"client": ClassInfo(weight=10.0)})
+    for i in range(20):
+        q.enqueue("client", i, now=0.0)
+    got = [q.dequeue(now=0.0)[1] for _ in range(20)]
+    assert got == list(range(20))
+
+
+def test_weight_dominant_class_drains_first():
+    q = MClockQueue({"heavy": ClassInfo(weight=100.0),
+                     "light": ClassInfo(weight=1.0)})
+    for i in range(10):
+        q.enqueue("heavy", f"h{i}", now=0.0)
+        q.enqueue("light", f"l{i}", now=0.0)
+    first10 = [q.dequeue(now=0.0)[0] for _ in range(10)]
+    # heavy p-tags advance by 1/100, light by 1: heavy runs 10:1
+    assert first10.count("heavy") >= 9
+
+
+def test_reservation_preempts_weight():
+    q = MClockQueue({"client": ClassInfo(weight=100.0),
+                     "recovery": ClassInfo(reservation=10.0, weight=1.0)})
+    q.enqueue("client", "c", now=0.0)
+    q.enqueue("recovery", "r", now=0.0)
+    # at t=0 the recovery reservation tag (0.1) is not yet due
+    assert q.dequeue(now=0.0)[0] == "client"
+    q.enqueue("client", "c2", now=0.15)
+    # at t=0.2 the reservation is due: recovery preempts the heavier class
+    assert q.dequeue(now=0.2)[0] == "recovery"
+
+
+def test_limit_caps_class_until_others_drain():
+    q = MClockQueue({"client": ClassInfo(weight=100.0),
+                     "scrub": ClassInfo(weight=5.0, limit=100.0)})
+    for i in range(5):
+        q.enqueue("client", f"c{i}", now=0.0)
+        q.enqueue("scrub", f"s{i}", now=0.0)
+    order = [q.dequeue(now=0.0)[0] for _ in range(10)]
+    # at frozen t=0 scrub's limit tag (0.01) never becomes eligible:
+    # clients drain first, scrubs only via the work-conserving fallback
+    assert order[:5] == ["client"] * 5
+    assert order[5:] == ["scrub"] * 5
+
+
+def test_idle_class_tag_reset():
+    q = MClockQueue({"a": ClassInfo(weight=1.0)})
+    q.enqueue("a", 1, now=0.0)
+    assert q.dequeue(now=0.0)[1] == 1
+    # long idle gap: tags must restart from now, not accumulate debt
+    q.enqueue("a", 2, now=100.0)
+    _, item = q.dequeue(now=100.0)
+    assert item == 2
+
+
+def test_sharded_queue_preserves_per_key_order():
+    seen: dict[str, list] = {"k0": [], "k1": []}
+    lock = threading.Lock()
+
+    def handler(klass, item):
+        key, seq = item
+        with lock:
+            seen[key].append(seq)
+
+    wq = ShardedOpQueue(handler, n_shards=2, name="test")
+    try:
+        for seq in range(200):
+            wq.enqueue("k0", "client", ("k0", seq))
+            wq.enqueue("k1", "client", ("k1", seq))
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            with lock:
+                if len(seen["k0"]) == 200 and len(seen["k1"]) == 200:
+                    break
+            time.sleep(0.01)
+        assert seen["k0"] == list(range(200))
+        assert seen["k1"] == list(range(200))
+    finally:
+        wq.shutdown()
+
+
+def test_handler_exception_does_not_kill_worker():
+    done = threading.Event()
+
+    def handler(klass, item):
+        if item == "boom":
+            raise RuntimeError("injected")
+        done.set()
+
+    wq = ShardedOpQueue(handler, n_shards=1, name="test")
+    try:
+        wq.enqueue("k", "client", "boom")
+        wq.enqueue("k", "client", "ok")
+        assert done.wait(timeout=5.0), "worker died on handler exception"
+    finally:
+        wq.shutdown()
+
+
+def test_cluster_io_rides_the_mclock_queue():
+    """Default osd_op_queue=mclock: client + EC I/O flow through the
+    sharded queue end-to-end."""
+    from ceph_tpu.tools.vstart import MiniCluster
+    c = MiniCluster(n_osds=6, ms_type="loopback").start()
+    try:
+        c.wait_for_osd_count(6)
+        assert all(o.opwq is not None for o in c.osds.values())
+        client = c.client(timeout=20.0)
+        pool = c.create_pool(client, pg_num=8, size=3)
+        io = client.open_ioctx(pool)
+        for i in range(12):
+            io.write_full(f"q{i}", f"mclock-{i}".encode() * 30)
+        for i in range(12):
+            assert io.read(f"q{i}") == f"mclock-{i}".encode() * 30
+        ec = c.create_pool(client, pg_num=4, pool_type="erasure", k=4, m=2)
+        io2 = client.open_ioctx(ec)
+        io2.write_full("eq", b"ec-through-the-queue" * 40)
+        assert io2.read("eq") == b"ec-through-the-queue" * 40
+    finally:
+        c.stop()
