@@ -1,0 +1,187 @@
+"""CephFS client (src/client/Client.cc + ceph-fuse surface, lite).
+
+Path operations go to the MDS over MClientRequest/MClientReply; file
+DATA never touches the MDS — it stripes straight into the data pool
+via the Striper, named by inode number, and the client reports the new
+size back with a setattr (standing in for the reference's size-tracking
+client caps).
+
+    fs = CephFS(mon_addr, mds_addr); fs.mount()
+    fs.mkdir("/a"); f = fs.open("/a/hello", "w"); f.write(b"hi"); f.close()
+    fs.listdir("/a"); fs.stat("/a/hello"); fs.rename(...); fs.unlink(...)
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ceph_tpu.client.rados import RadosClient
+from ceph_tpu.mds.server import MClientReply, MClientRequest
+from ceph_tpu.msg.messenger import (
+    ConnectionPolicy, Dispatcher, EntityName, Messenger)
+from ceph_tpu.osdc.striper import StripeLayout, StripedObject
+
+
+class CephFS(Dispatcher):
+    def __init__(self, mon_addr: str, mds_addr: str,
+                 ms_type: str = "async", timeout: float = 10.0,
+                 auth_key=None, client_id: int | None = None):
+        self.mds_addr = mds_addr
+        self.timeout = timeout
+        self.rados = RadosClient(mon_addr, ms_type=ms_type,
+                                 auth_key=auth_key)
+        cid = client_id if client_id is not None else self.rados.client_id
+        self.name = EntityName("client", 10000 + cid)
+        self.msgr = Messenger.create(self.name, ms_type)
+        self.msgr.set_auth(auth_key)
+        self.msgr.set_policy("mds", ConnectionPolicy.stateful_peer())
+        self.msgr.add_dispatcher_tail(self)
+        self._lock = threading.Lock()
+        self._next_tid = 1
+        self._waiters: dict[int, tuple[threading.Event, list]] = {}
+        self._data_pool: int | None = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def mount(self) -> None:
+        self.rados.connect()
+        if _is_tcp(self.msgr):
+            self.msgr.bind("127.0.0.1:0")
+        else:
+            self.msgr.bind(f"fsclient.{self.name.id}")
+        self.msgr.start()
+        st = self._request("statfs", {})
+        self._data_pool = st["data_pool"]
+        self.data_io = self.rados.open_ioctx(self._data_pool)
+
+    def unmount(self) -> None:
+        self.msgr.shutdown()
+        self.rados.shutdown()
+
+    # -- mds rpc --------------------------------------------------------------
+
+    def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, MClientReply):
+            with self._lock:
+                w = self._waiters.pop(msg.tid, None)
+            if w is not None:
+                w[1].append(msg)
+                w[0].set()
+            return True
+        return False
+
+    def _request(self, op: str, args: dict) -> dict:
+        with self._lock:
+            tid = self._next_tid
+            self._next_tid += 1
+            ev: tuple[threading.Event, list] = (threading.Event(), [])
+            self._waiters[tid] = ev
+        con = self.msgr.connect_to(self.mds_addr, EntityName("mds", 0))
+        con.send_message(MClientRequest(tid=tid, op=op, args=args))
+        if not ev[0].wait(self.timeout):
+            with self._lock:
+                self._waiters.pop(tid, None)
+            raise TimeoutError(f"mds request {op} timed out")
+        reply = ev[1][0]
+        if reply.result < 0:
+            raise OSError(-reply.result, f"{op} {args} failed")
+        return reply.out
+
+    # -- namespace ------------------------------------------------------------
+
+    def mkdir(self, path: str, mode: int = 0o755) -> None:
+        self._request("mkdir", {"path": path, "mode": mode})
+
+    def listdir(self, path: str) -> dict:
+        return self._request("readdir", {"path": path})["entries"]
+
+    def stat(self, path: str) -> dict:
+        return self._request("lookup", {"path": path})["inode"]
+
+    def unlink(self, path: str) -> None:
+        out = self._request("unlink", {"path": path})
+        # purge the file's striped data (the reference defers this to
+        # the MDS purge queue; the client is the data-pool actor here)
+        StripedObject(self.data_io, _data_name(out["ino"]),
+                      _LAYOUT).remove()
+
+    def rmdir(self, path: str) -> None:
+        self._request("rmdir", {"path": path})
+
+    def rename(self, src: str, dst: str) -> None:
+        self._request("rename", {"src": src, "dst": dst})
+
+    # -- file i/o -------------------------------------------------------------
+
+    def open(self, path: str, flags: str = "r") -> "File":
+        if "w" in flags or "a" in flags:
+            out = self._request("create", {"path": path})
+        else:
+            out = {"inode": self._request(
+                "lookup", {"path": path})["inode"]}
+        return File(self, out["inode"], append="a" in flags,
+                    truncate="w" in flags)
+
+
+_LAYOUT = StripeLayout(stripe_unit=1 << 16, stripe_count=4,
+                       object_size=1 << 22)
+
+
+def _data_name(ino: int) -> str:
+    return f"{ino:x}"
+
+
+def _is_tcp(msgr) -> bool:
+    from ceph_tpu.msg.async_tcp import AsyncMessenger
+    return isinstance(msgr, AsyncMessenger)
+
+
+class File:
+    """Open file handle: striped data I/O + size writeback on close."""
+
+    def __init__(self, fs: CephFS, inode: dict, append: bool = False,
+                 truncate: bool = False):
+        self.fs = fs
+        self.inode = inode
+        self.obj = StripedObject(fs.data_io, _data_name(inode["ino"]),
+                                 _LAYOUT)
+        if truncate and inode.get("size", 0) > 0:
+            self.obj.truncate(0)
+            self._set_size(0)
+        self.pos = inode.get("size", 0) if append else 0
+        self._dirty = False
+
+    def _set_size(self, size: int) -> None:
+        import time as _t
+        self.inode = self.fs._request(
+            "setattr", {"ino": self.inode["ino"], "size": size,
+                        "mtime": _t.time()})["inode"]
+
+    def write(self, data: bytes) -> int:
+        self.obj.write(data, offset=self.pos)
+        self.pos += len(data)
+        self._dirty = True
+        return len(data)
+
+    def read(self, length: int = 0) -> bytes:
+        size = self.inode.get("size", 0)
+        if length <= 0:
+            length = max(0, size - self.pos)
+        length = min(length, max(0, size - self.pos))
+        data = self.obj.read(self.pos, length)
+        self.pos += len(data)
+        return data
+
+    def seek(self, pos: int) -> None:
+        self.pos = pos
+
+    def close(self) -> None:
+        if self._dirty:
+            self._set_size(max(self.pos, self.inode.get("size", 0)))
+        self._dirty = False
+
+    def __enter__(self) -> "File":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -95,6 +95,40 @@ class _Waiter:
         self.reply: MOSDOpReply | None = None
 
 
+class AioCompletion:
+    """librados AioCompletion analog over a pending Objecter op."""
+
+    def __init__(self, client: "RadosClient", tid: int, waiter: _Waiter):
+        self.client = client
+        self.tid = tid
+        self._w = waiter
+
+    def is_complete(self) -> bool:
+        return self._w.event.is_set()
+
+    def wait_for_complete(self, timeout: float | None = None) -> bool:
+        return self._w.event.wait(timeout)
+
+    def get_return_value(self) -> int:
+        return self._w.reply.result if self._w.reply else -110  # ETIMEDOUT
+
+    @property
+    def reply(self) -> MOSDOpReply | None:
+        return self._w.reply
+
+    @property
+    def data(self) -> bytes:
+        r = self._w.reply
+        return r.ops[0].data if r and r.ops else b""
+
+    def cancel(self) -> None:
+        with self.client._lock:
+            self.client._waiters.pop(self.tid, None)
+        # wake any blocked waiter: a cancelled op never gets its reply
+        # (get_return_value reads -ETIMEDOUT from the missing reply)
+        self._w.event.set()
+
+
 class RadosClient(Dispatcher):
     """RadosClient + Objecter (librados/RadosClient.cc:229 connect)."""
 
@@ -257,8 +291,11 @@ class RadosClient(Dispatcher):
         con = self.msgr.connect_to(addr, EntityName("osd", primary))
         con.send_message(w.msg)
 
-    def operate(self, pool_id: int, oid: str, ops: list[OSDOpField],
-                snapid: int = 0) -> MOSDOpReply:
+    def aio_operate(self, pool_id: int, oid: str, ops: list[OSDOpField],
+                    snapid: int = 0) -> "AioCompletion":
+        """Submit without blocking (librados aio_*): returns a completion
+        the caller waits on.  In-flight completions resend on map change
+        like synchronous ops."""
         with self._lock:
             tid = self._next_tid
             self._next_tid += 1
@@ -268,13 +305,17 @@ class RadosClient(Dispatcher):
             w = _Waiter(msg)
             self._waiters[tid] = w
         self._send_op(w)
-        if not w.event.wait(self.timeout):
-            with self._lock:
-                self._waiters.pop(tid, None)
-            raise TimeoutError(f"op {tid} on {oid} timed out")
-        if w.reply.result < 0:
-            raise OSError(-w.reply.result, f"op on {oid} failed")
-        return w.reply
+        return AioCompletion(self, tid, w)
+
+    def operate(self, pool_id: int, oid: str, ops: list[OSDOpField],
+                snapid: int = 0) -> MOSDOpReply:
+        c = self.aio_operate(pool_id, oid, ops, snapid=snapid)
+        if not c.wait_for_complete(self.timeout):
+            c.cancel()
+            raise TimeoutError(f"op {c.tid} on {oid} timed out")
+        if c.get_return_value() < 0:
+            raise OSError(-c.get_return_value(), f"op on {oid} failed")
+        return c.reply
 
     # -- pools ----------------------------------------------------------------
 
@@ -300,6 +341,16 @@ class IoCtx:
     def write_full(self, oid: str, data: bytes) -> None:
         self.client.operate(self.pool_id, oid,
                             [OSDOpField(OP_WRITEFULL, 0, len(data), data)])
+
+    def aio_write_full(self, oid: str, data: bytes) -> "AioCompletion":
+        return self.client.aio_operate(
+            self.pool_id, oid, [OSDOpField(OP_WRITEFULL, 0, len(data),
+                                           data)])
+
+    def aio_read(self, oid: str, length: int = 0,
+                 offset: int = 0) -> "AioCompletion":
+        return self.client.aio_operate(
+            self.pool_id, oid, [OSDOpField(OP_READ, offset, length)])
 
     def write(self, oid: str, data: bytes, offset: int = 0) -> None:
         self.client.operate(self.pool_id, oid,

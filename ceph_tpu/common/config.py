@@ -101,6 +101,9 @@ register_options([
            "PGs an osd recovers concurrently (reservation slots)"),
     Option("osd_recovery_max_active", OPT_INT, 3,
            "in-flight object pulls per recovering PG"),
+    Option("osd_client_message_size_cap", OPT_INT, 256 << 20,
+           "bytes of op payloads queued in the sharded op queue before "
+           "dispatch threads block (front-door backpressure)"),
     Option("log_level", OPT_INT, 1, "default subsystem log level"),
     Option("ms_type", OPT_STR, "async",
            "messenger implementation: async | loopback"),

@@ -1,0 +1,5 @@
+"""ceph-mds analog: the CephFS metadata tier (src/mds/)."""
+
+from .server import MDSDaemon, MClientRequest, MClientReply  # noqa: F401
+
+__all__ = ["MDSDaemon", "MClientRequest", "MClientReply"]

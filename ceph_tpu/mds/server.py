@@ -1,0 +1,493 @@
+"""MDS daemon: journaled filesystem metadata over RADOS (src/mds/).
+
+The reference MDS keeps the namespace in a metadata pool — each
+directory fragment is a RADOS object whose omap maps dentry name to the
+encoded inode — and journals every mutation through osdc/Journaler
+before acking (MDLog EUpdate events), writing dirty dirfrags back
+lazily.  Crash recovery = load backing dirfrags + replay the journal
+tail (up:replay -> up:active, MDCache::rejoin machinery reduced to the
+single-MDS case).  File DATA never touches the MDS: clients stripe it
+straight to the data pool (Striper) and report the new size back
+(the reference tracks it via client caps; here an explicit setattr).
+
+Wire surface: MClientRequest/MClientReply (messages/MClientRequest.h,
+CEPH_MSG_CLIENT_REQUEST=24 / _REPLY=26) carrying json-ish op payloads.
+
+Object naming in the metadata pool:
+    dir.<ino:x>      dirfrag omap: name -> encoded dentry {ino, type}
+    inode.<ino:x>    omap: encoded inode attrs (mode, size, times)
+    mds.table        omap: next_ino
+    mdlog.*          the Journaler stream + head
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ceph_tpu.client.rados import RadosClient
+from ceph_tpu.common.context import CephTpuContext
+from ceph_tpu.common.logging import dout
+from ceph_tpu.msg.encoding import Decoder, Encoder
+from ceph_tpu.msg.message import Message, register_message
+from ceph_tpu.msg.messenger import (
+    ConnectionPolicy, Dispatcher, EntityName, Messenger)
+from ceph_tpu.osdc.journaler import Journaler
+
+ROOT_INO = 1
+
+S_IFDIR = 0o040000
+S_IFREG = 0o100000
+
+
+@register_message
+class MClientRequest(Message):
+    """fs client -> mds (CEPH_MSG_CLIENT_REQUEST=24)."""
+
+    TYPE = 24
+
+    def __init__(self, tid: int = 0, op: str = "", args: dict | None = None):
+        super().__init__()
+        self.tid = tid
+        self.op = op
+        self.args = args or {}
+
+    def encode_payload(self, enc: Encoder):
+        enc.versioned(1, 1, lambda e: (
+            e.u64(self.tid), e.str(self.op),
+            e.bytes(json.dumps(self.args).encode())))
+
+    def decode_payload(self, dec: Decoder, version: int):
+        def body(d, v):
+            self.tid = d.u64()
+            self.op = d.str()
+            self.args = json.loads(d.bytes().decode() or "{}")
+        dec.versioned(1, body)
+
+
+@register_message
+class MClientReply(Message):
+    """mds -> fs client (CEPH_MSG_CLIENT_REPLY=26)."""
+
+    TYPE = 26
+
+    def __init__(self, tid: int = 0, result: int = 0,
+                 out: dict | None = None):
+        super().__init__()
+        self.tid = tid
+        self.result = result
+        self.out = out or {}
+
+    def encode_payload(self, enc: Encoder):
+        enc.versioned(1, 1, lambda e: (
+            e.u64(self.tid), e.s32(self.result),
+            e.bytes(json.dumps(self.out).encode())))
+
+    def decode_payload(self, dec: Decoder, version: int):
+        def body(d, v):
+            self.tid = d.u64()
+            self.result = d.s32()
+            self.out = json.loads(d.bytes().decode() or "{}")
+        dec.versioned(1, body)
+
+
+class Inode:
+    __slots__ = ("ino", "mode", "size", "mtime")
+
+    def __init__(self, ino: int, mode: int, size: int = 0,
+                 mtime: float = 0.0):
+        self.ino = ino
+        self.mode = mode
+        self.size = size
+        self.mtime = mtime
+
+    def is_dir(self) -> bool:
+        return bool(self.mode & S_IFDIR)
+
+    def to_dict(self) -> dict:
+        return {"ino": self.ino, "mode": self.mode, "size": self.size,
+                "mtime": self.mtime}
+
+    @staticmethod
+    def from_dict(d: dict) -> "Inode":
+        return Inode(d["ino"], d["mode"], d.get("size", 0),
+                     d.get("mtime", 0.0))
+
+
+class MDSDaemon(Dispatcher):
+    """Single-rank MDS (the reference scales ranks via dirfrag export;
+    the namespace model below is rank-count agnostic)."""
+
+    def __init__(self, mon_addr: str, metadata_pool: int, data_pool: int,
+                 ctx: CephTpuContext | None = None, ms_type: str = "async",
+                 addr: str = "127.0.0.1:0", auth_key=None):
+        self.ctx = ctx or CephTpuContext("mds.0")
+        self.name = EntityName("mds", 0)
+        self.metadata_pool = metadata_pool
+        self.data_pool = data_pool
+        self._lock = threading.RLock()
+        #: ino -> Inode (inode cache; authoritative once loaded)
+        self._inodes: dict[int, Inode] = {}
+        #: ino -> {name: child_ino} (dirfrag cache)
+        self._dirs: dict[int, dict[int, object]] = {}
+        self._dirty_dirs: set[int] = set()
+        self._dirty_inodes: set[int] = set()
+        self._next_ino = ROOT_INO + 1
+        self._journaled_since_flush = 0
+        self.state = "boot"
+
+        self.objecter = RadosClient(mon_addr, ms_type=ms_type,
+                                    auth_key=auth_key)
+        self.msgr = Messenger.create(self.name, ms_type)
+        self.msgr.set_auth(auth_key)
+        self.msgr.set_policy("client", ConnectionPolicy.lossy_client())
+        self.msgr.add_dispatcher_tail(self)
+        self._addr = addr
+        self._stop = False
+        self.journal: Journaler | None = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def init(self) -> None:
+        self.objecter.connect()
+        self.meta_io = self.objecter.open_ioctx(self.metadata_pool)
+        self.journal = Journaler(self.meta_io, "mdlog")
+        self._load_or_mkfs()
+        self.state = "replay"
+        n = self.journal.replay(self._replay_entry)
+        dout("mds", 5, "mds.0 replayed %d journal events", n)
+        if n:
+            self._flush_dirty()
+            self.journal.trim()
+        self.state = "active"
+        self.msgr.bind(self._addr)
+        self.msgr.start()
+
+    def shutdown(self) -> None:
+        self._stop = True
+        with self._lock:
+            self._flush_dirty()
+            if self.journal is not None:
+                self.journal.trim()
+        self.msgr.shutdown()
+        self.objecter.shutdown()
+
+    @property
+    def addr(self) -> str:
+        return self.msgr.my_addr
+
+    def _load_or_mkfs(self) -> None:
+        try:
+            table = self.meta_io.get_omap("mds.table")
+            self._next_ino = int(table.get("next_ino", b"2").decode())
+            self.journal.open()
+        except OSError:
+            # fresh filesystem: root inode + empty journal
+            self._inodes[ROOT_INO] = Inode(ROOT_INO, S_IFDIR | 0o755)
+            self._dirs[ROOT_INO] = {}
+            self._dirty_dirs.add(ROOT_INO)
+            self._dirty_inodes.add(ROOT_INO)
+            self.journal.create()
+            self._flush_dirty()
+
+    # -- backing store (dirfrag omap objects) ---------------------------------
+
+    def _dir_obj(self, ino: int) -> str:
+        return f"dir.{ino:x}"
+
+    def _inode_obj(self, ino: int) -> str:
+        return f"inode.{ino:x}"
+
+    def _load_dir(self, ino: int) -> dict:
+        d = self._dirs.get(ino)
+        if d is not None:
+            return d
+        try:
+            omap = self.meta_io.get_omap(self._dir_obj(ino))
+            d = {name: int(v.decode()) for name, v in omap.items()}
+        except OSError:
+            d = {}
+        self._dirs[ino] = d
+        return d
+
+    def _load_inode(self, ino: int) -> Inode | None:
+        inode = self._inodes.get(ino)
+        if inode is not None:
+            return inode
+        try:
+            omap = self.meta_io.get_omap(self._inode_obj(ino))
+        except OSError:
+            return None
+        if "json" not in omap:
+            return None
+        inode = Inode.from_dict(json.loads(omap["json"].decode()))
+        self._inodes[ino] = inode
+        return inode
+
+    def _flush_dirty(self) -> None:
+        """Write dirty dirfrags/inodes back (MDCache::flush, the lazy
+        CDir commit), then persist the ino allocator."""
+        for ino in sorted(self._dirty_dirs):
+            d = self._dirs.get(ino, {})
+            # rewrite wholesale: dirfrags are small omaps here
+            try:
+                self.meta_io.remove(self._dir_obj(ino))
+            except OSError:
+                pass
+            self.meta_io.set_omap(
+                self._dir_obj(ino),
+                {name: str(child).encode() for name, child in d.items()})
+        self._dirty_dirs.clear()
+        for ino in sorted(self._dirty_inodes):
+            inode = self._inodes.get(ino)
+            if inode is None:
+                continue
+            self.meta_io.set_omap(
+                self._inode_obj(ino),
+                {"json": json.dumps(inode.to_dict()).encode()})
+        self._dirty_inodes.clear()
+        self.meta_io.set_omap(
+            "mds.table", {"next_ino": str(self._next_ino).encode()})
+
+    # -- journal (MDLog EUpdate) ----------------------------------------------
+
+    def _journal(self, event: dict) -> None:
+        self.journal.append_entry(json.dumps(event).encode())
+        self.journal.flush()
+
+    def _maybe_trim(self) -> None:
+        """Segment boundary (MDLog trim): write dirty state back, then
+        expire the journal.  MUST run only after the current event is
+        both journaled AND applied — trimming first would expire an
+        acked mutation that is in neither the journal nor the store."""
+        self._journaled_since_flush += 1
+        if self._journaled_since_flush >= 64:
+            self._flush_dirty()
+            self.journal.trim()
+            self._journaled_since_flush = 0
+
+    def _replay_entry(self, payload: bytes) -> None:
+        ev = json.loads(payload.decode())
+        self._apply(ev, replay=True)
+
+    # -- namespace mutations (journaled, replayable) --------------------------
+
+    def _apply(self, ev: dict, replay: bool = False) -> None:
+        """Apply one journaled event to the cache.  Must be idempotent:
+        replay re-applies events the backing store may already hold."""
+        kind = ev["e"]
+        if kind == "batch":
+            # one journal entry, several sub-events: the atomic EUpdate
+            # shape (rename's link+unlink must never tear)
+            for sub in ev["events"]:
+                self._apply(sub, replay=replay)
+            return
+        if kind == "alloc":
+            self._next_ino = max(self._next_ino, ev["next_ino"])
+            return
+        if kind == "link":
+            parent, name, ino = ev["parent"], ev["name"], ev["ino"]
+            self._load_dir(parent)[name] = ino
+            self._dirty_dirs.add(parent)
+            if "mode" in ev:
+                self._inodes[ino] = Inode(ino, ev["mode"], ev.get("size", 0),
+                                          ev.get("mtime", 0.0))
+                if self._inodes[ino].is_dir():
+                    self._dirs.setdefault(ino, {})
+                    self._dirty_dirs.add(ino)
+                self._dirty_inodes.add(ino)
+            return
+        if kind == "unlink":
+            parent, name = ev["parent"], ev["name"]
+            d = self._load_dir(parent)
+            ino = d.pop(name, None)
+            self._dirty_dirs.add(parent)
+            if ino is not None and ev.get("drop_inode"):
+                self._inodes.pop(ino, None)
+                self._dirs.pop(ino, None)
+                try:
+                    self.meta_io.remove(self._inode_obj(ino))
+                except OSError:
+                    pass
+                try:
+                    self.meta_io.remove(self._dir_obj(ino))
+                except OSError:
+                    pass
+            return
+        if kind == "setattr":
+            inode = self._load_inode(ev["ino"])
+            if inode is not None:
+                if "size" in ev:
+                    inode.size = ev["size"]
+                if "mtime" in ev:
+                    inode.mtime = ev["mtime"]
+                if "mode" in ev:
+                    inode.mode = ev["mode"]
+                self._dirty_inodes.add(inode.ino)
+            return
+        raise ValueError(f"unknown journal event {kind!r}")
+
+    def _mutate(self, ev: dict) -> None:
+        """Journal-then-apply (the EUpdate ordering: an acked mutation
+        is always recoverable), then maybe roll the segment."""
+        self._journal(ev)
+        self._apply(ev)
+        self._maybe_trim()
+
+    # -- path resolution ------------------------------------------------------
+
+    def _resolve(self, path: str) -> tuple[int | None, int | None, str]:
+        """path -> (parent_ino, ino, last_name); ino None if the leaf
+        does not exist, parent None if an intermediate is missing."""
+        parts = [p for p in path.split("/") if p]
+        cur = ROOT_INO
+        if not parts:
+            return None, ROOT_INO, "/"
+        for p in parts[:-1]:
+            child = self._load_dir(cur).get(p)
+            if child is None:
+                return None, None, parts[-1]
+            inode = self._load_inode(child)
+            if inode is None or not inode.is_dir():
+                return None, None, parts[-1]
+            cur = child
+        name = parts[-1]
+        return cur, self._load_dir(cur).get(name), name
+
+    # -- request handling -----------------------------------------------------
+
+    def ms_dispatch(self, msg) -> bool:
+        if self._stop:
+            return True
+        if isinstance(msg, MClientRequest):
+            try:
+                with self._lock:
+                    result, out = self._handle(msg.op, msg.args)
+            except Exception:
+                from ceph_tpu.common.logging import get_logger
+                get_logger("mds").exception("mds request %s failed", msg.op)
+                result, out = -5, {}
+            msg.connection.send_message(
+                MClientReply(tid=msg.tid, result=result, out=out))
+            return True
+        return False
+
+    def _handle(self, op: str, a: dict) -> tuple[int, dict]:
+        if op == "lookup":
+            parent, ino, _name = self._resolve(a["path"])
+            if ino is None:
+                return -2, {}
+            inode = self._load_inode(ino)
+            if inode is None:
+                return -2, {}
+            return 0, {"inode": inode.to_dict()}
+
+        if op == "mkdir":
+            parent, ino, name = self._resolve(a["path"])
+            if parent is None:
+                return -2, {}
+            if ino is not None:
+                return -17, {}  # EEXIST
+            new = self._alloc_ino()
+            self._mutate({"e": "link", "parent": parent, "name": name,
+                          "ino": new, "mode": S_IFDIR | a.get("mode", 0o755),
+                          "mtime": time.time()})
+            return 0, {"inode": self._inodes[new].to_dict()}
+
+        if op == "create":
+            parent, ino, name = self._resolve(a["path"])
+            if parent is None:
+                return -2, {}
+            if ino is not None:
+                inode = self._load_inode(ino)
+                if inode is None or inode.is_dir():
+                    return -21, {}  # EISDIR
+                return 0, {"inode": inode.to_dict(),
+                           "data_pool": self.data_pool}
+            new = self._alloc_ino()
+            self._mutate({"e": "link", "parent": parent, "name": name,
+                          "ino": new, "mode": S_IFREG | a.get("mode", 0o644),
+                          "size": 0, "mtime": time.time()})
+            return 0, {"inode": self._inodes[new].to_dict(),
+                       "data_pool": self.data_pool}
+
+        if op == "readdir":
+            _parent, ino, _name = self._resolve(a["path"])
+            if ino is None:
+                return -2, {}
+            inode = self._load_inode(ino)
+            if inode is None or not inode.is_dir():
+                return -20, {}  # ENOTDIR
+            out = {}
+            for name, child in sorted(self._load_dir(ino).items()):
+                ci = self._load_inode(child)
+                if ci is not None:
+                    out[name] = ci.to_dict()
+            return 0, {"entries": out}
+
+        if op == "unlink":
+            parent, ino, name = self._resolve(a["path"])
+            if parent is None or ino is None:
+                return -2, {}
+            inode = self._load_inode(ino)
+            if inode is not None and inode.is_dir():
+                return -21, {}
+            self._mutate({"e": "unlink", "parent": parent, "name": name,
+                          "drop_inode": True})
+            return 0, {"ino": ino}
+
+        if op == "rmdir":
+            parent, ino, name = self._resolve(a["path"])
+            if parent is None or ino is None:
+                return -2, {}
+            inode = self._load_inode(ino)
+            if inode is None or not inode.is_dir():
+                return -20, {}
+            if self._load_dir(ino):
+                return -39, {}  # ENOTEMPTY
+            self._mutate({"e": "unlink", "parent": parent, "name": name,
+                          "drop_inode": True})
+            return 0, {}
+
+        if op == "rename":
+            sp, sino, sname = self._resolve(a["src"])
+            if sp is None or sino is None:
+                return -2, {}
+            dp, dino, dname = self._resolve(a["dst"])
+            if dp is None:
+                return -2, {}
+            if dino is not None:
+                return -17, {}
+            # one atomic journal entry for link-at-dst + unlink-src (the
+            # reference's single EUpdate): a crash can never leave the
+            # inode reachable from both paths
+            self._mutate({"e": "batch", "events": [
+                {"e": "link", "parent": dp, "name": dname, "ino": sino},
+                {"e": "unlink", "parent": sp, "name": sname}]})
+            return 0, {"ino": sino}
+
+        if op == "setattr":
+            ev = {"e": "setattr", "ino": a["ino"]}
+            for k in ("size", "mtime", "mode"):
+                if k in a:
+                    ev[k] = a[k]
+            if self._load_inode(a["ino"]) is None:
+                return -2, {}
+            self._mutate(ev)
+            return 0, {"inode": self._inodes[a["ino"]].to_dict()}
+
+        if op == "statfs":
+            return 0, {"next_ino": self._next_ino,
+                       "data_pool": self.data_pool,
+                       "metadata_pool": self.metadata_pool}
+
+        return -22, {}
+
+    def _alloc_ino(self) -> int:
+        ino = self._next_ino
+        self._next_ino += 1
+        # journal the allocation so replay never re-issues a used ino
+        self._journal({"e": "alloc", "next_ino": self._next_ino})
+        self._maybe_trim()
+        return ino

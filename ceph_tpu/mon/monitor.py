@@ -504,12 +504,22 @@ class Monitor(Dispatcher):
         parent bucket in the crush hierarchy (host level for two-level
         maps — mon_osd_reporter_subtree_level semantics), or the osd id
         itself on flat maps where the parent is the root."""
+        return self._reporter_subtrees([osd])[osd]
+
+    def _reporter_subtrees(self, osds) -> dict[int, int]:
+        """Resolve many reporters in one pass over the bucket array
+        (peers re-file reports every heartbeat tick; per-reporter scans
+        would be O(reporters x buckets) per report)."""
         crush = self.osdmap.crush
         referenced = _referenced_bucket_ids(crush)
+        out = {o: o for o in osds}
+        want = set(osds)
         for b in crush.buckets:
-            if b is not None and osd in b.items and b.id in referenced:
-                return b.id
-        return osd
+            if b is None or b.id not in referenced:
+                continue
+            for o in want & set(b.items):
+                out[o] = b.id
+        return out
 
     def _failure_grace(self, osd: int, now: float) -> float:
         """Adaptive grace (OSDMonitor::check_failure, OSDMonitor.cc:
@@ -561,7 +571,7 @@ class Monitor(Dispatcher):
             # (mon_osd_reporter_subtree_level: two osds on one host are
             # one witness) and the peer must have been unreachable for
             # the full — possibly laggy-extended — grace
-            subtrees = {self._reporter_subtree(r) for r in reports}
+            subtrees = set(self._reporter_subtrees(list(reports)).values())
             failed_for = max(ff for _t, ff in reports.values())
             if (len(subtrees) < need
                     or failed_for < self._failure_grace(msg.failed_osd, now)):

@@ -56,6 +56,11 @@ class KeyValueDB:
         """All keys under a prefix, ordered."""
         raise NotImplementedError
 
+    def iterate(self, prefix: str | None = None):
+        """Ordered (prefix, key, value) triples — optionally filtered to
+        one prefix (KeyValueDB::get_iterator analog)."""
+        raise NotImplementedError
+
 
 class MemDB(KeyValueDB):
     def __init__(self):
@@ -77,6 +82,11 @@ class MemDB(KeyValueDB):
         with self._lock:
             return {k: v for (p, k), v in sorted(self._data.items())
                     if p == prefix}
+
+    def iterate(self, prefix=None):
+        with self._lock:
+            return [(p, k, v) for (p, k), v in sorted(self._data.items())
+                    if prefix is None or p == prefix]
 
 
 _FRAME = struct.Struct("<II")

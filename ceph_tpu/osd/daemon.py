@@ -41,6 +41,7 @@ import time
 from ceph_tpu.common.context import CephTpuContext
 from ceph_tpu.common.logging import dout
 from ceph_tpu.common.perf_counters import PerfCountersBuilder
+from ceph_tpu.common.throttle import Throttle
 from ceph_tpu.ec import registry_instance
 from ceph_tpu.messages import (
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
@@ -260,20 +261,47 @@ class OSDDaemon(Dispatcher):
         self.local_reserver = AsyncReserver(
             int(self.ctx.conf.get("osd_max_backfills")),
             name=f"osd.{osd_id}")
+        #: bytes queued in the op queue (osd_client_message_size_cap)
+        self._op_throttle = Throttle(
+            f"osd.{osd_id}-op-bytes",
+            int(self.ctx.conf.get("osd_client_message_size_cap")))
         self.ctx.admin.register_command(
             "dump_reservations", lambda **kw: self.local_reserver.dump(),
             "recovery reservation slots")
 
     def _opwq_handle(self, klass: str, item) -> None:
         """Shard worker: run the dispatch handler bound at enqueue."""
-        handler, msg = item
-        handler(msg)
+        handler, msg, cost = item
+        try:
+            handler(msg)
+        finally:
+            self._op_throttle.put(cost)
+
+    @staticmethod
+    def _op_cost(msg) -> int:
+        """Approximate queued-payload bytes (the data dominates)."""
+        cost = 256
+        for attr in ("data", "shard_data"):
+            v = getattr(msg, attr, None)
+            if v is not None:
+                cost += len(v)
+        for op in getattr(msg, "ops", ()) or ():
+            cost += len(getattr(op, "data", b"") or b"")
+        return cost
 
     def _enqueue_op(self, klass: str, shard_key, handler, msg) -> None:
         """Route through the sharded mClock queue (enqueue_op →
-        op_shardedwq → dequeue_op), or run inline when disabled."""
+        op_shardedwq → dequeue_op), or run inline when disabled.
+
+        Queued payload bytes ride a throttle (osd_client_message_size_cap
+        semantics): the messenger's dispatch throttle releases the moment
+        we enqueue, so without this a stuck shard would buffer peer
+        pushes/writes without bound.  get() blocks the dispatch thread —
+        exactly the backpressure the reference applies at the front door."""
         if self.opwq is not None:
-            self.opwq.enqueue(shard_key, klass, (handler, msg))
+            cost = min(self._op_cost(msg), self._op_throttle.max_amount)
+            self._op_throttle.get(cost)
+            self.opwq.enqueue(shard_key, klass, (handler, msg, cost))
         else:
             handler(msg)
 
@@ -382,6 +410,13 @@ class OSDDaemon(Dispatcher):
         finally:
             self._schedule_tick()
 
+    def _send_to_mons(self, make_msg) -> None:
+        """Send make_msg() to every monitor (reports are idempotent; the
+        leader executes, peons ignore)."""
+        for rank, addr in enumerate(self.mon_addrs):
+            mon = self.msgr.connect_to(addr, EntityName("mon", rank))
+            mon.send_message(make_msg())
+
     def _renew_map_subscription(self, now: float,
                                 force: bool = False) -> None:
         """Periodically re-subscribe to the mon map stream (the
@@ -397,11 +432,9 @@ class OSDDaemon(Dispatcher):
         if now - self._last_sub_renew < floor:
             return
         self._last_sub_renew = now
-        for rank, addr in enumerate(self.mon_addrs):
-            mon = self.msgr.connect_to(addr, EntityName("mon", rank))
-            mon.send_message(MMonSubscribe(name=str(self.whoami),
-                                           addr=self.msgr.my_addr,
-                                           epoch=self.osdmap.epoch))
+        self._send_to_mons(lambda: MMonSubscribe(
+            name=str(self.whoami), addr=self.msgr.my_addr,
+            epoch=self.osdmap.epoch))
 
     def _maybe_reboot(self) -> None:
         """Re-send MOSDBoot until the map shows us up at our address —
@@ -414,10 +447,8 @@ class OSDDaemon(Dispatcher):
         if booted:
             return
         self._renew_map_subscription(time.time(), force=True)
-        for rank, addr in enumerate(self.mon_addrs):
-            mon = self.msgr.connect_to(addr, EntityName("mon", rank))
-            mon.send_message(MOSDBoot(osd_id=self.osd_id,
-                                      addr=self.msgr.my_addr))
+        self._send_to_mons(lambda: MOSDBoot(osd_id=self.osd_id,
+                                            addr=self.msgr.my_addr))
 
     def _tick_pg(self, pg: PG, now: float) -> None:
         restart = False
@@ -537,6 +568,9 @@ class OSDDaemon(Dispatcher):
                     pg = self.pgs.get(pgid)
                     if pg and pg.state != STATE_INACTIVE:
                         pg.state = STATE_INACTIVE
+                        # no longer a member: a held/queued recovery slot
+                        # must not leak (it would wedge every later PG)
+                        self.local_reserver.cancel(pgid)
                     continue
                 pg = self._get_pg(pgid)
                 if pg.up != up or pg.primary != primary \
@@ -942,8 +976,13 @@ class OSDDaemon(Dispatcher):
         self._persist_info(pg)
         if done:
             self.local_reserver.cancel(pg.pgid)  # release the slot
-        elif pg.state == STATE_RECOVERING:
-            self._start_recovery_ops(pg)  # refill the pull window
+        elif (pg.state == STATE_RECOVERING
+              and self.local_reserver.has(pg.pgid)):
+            # refill the pull window — only while we still hold the
+            # slot; a stale push after an interval change must not
+            # bypass osd_max_backfills (the queued re-request's grant
+            # restarts the window instead)
+            self._start_recovery_ops(pg)
         if activate:
             self._pg_activate(pg)
         for m in waiting:
@@ -1036,12 +1075,9 @@ class OSDDaemon(Dispatcher):
                 last = self._hb_last.setdefault(peer, now)
                 if now - last > grace:
                     self._failure_reported.add(peer)
-                    for rank, addr in enumerate(self.mon_addrs):
-                        mon = self.msgr.connect_to(
-                            addr, EntityName("mon", rank))
-                        mon.send_message(MOSDFailure(
-                            reporter=self.osd_id, failed_osd=peer,
-                            failed_for=now - last, epoch=m.epoch))
+                    self._send_to_mons(lambda: MOSDFailure(
+                        reporter=self.osd_id, failed_osd=peer,
+                        failed_for=now - last, epoch=m.epoch))
             # forget peers the map marked down: a reported peer needs no
             # cancellation anymore, and its grace clock must restart from
             # scratch when it reboots — a stale _hb_last would instantly
@@ -1128,11 +1164,9 @@ class OSDDaemon(Dispatcher):
             # the peer I reported as failed is talking again: retract
             # (OSD::send_still_alive / MOSDFailure FLAG_ALIVE)
             self._failure_reported.discard(msg.from_osd)
-            for rank, addr in enumerate(self.mon_addrs):
-                mon = self.msgr.connect_to(addr, EntityName("mon", rank))
-                mon.send_message(MOSDFailure(
-                    reporter=self.osd_id, failed_osd=msg.from_osd,
-                    epoch=self.osdmap.epoch, alive=True))
+            self._send_to_mons(lambda: MOSDFailure(
+                reporter=self.osd_id, failed_osd=msg.from_osd,
+                epoch=self.osdmap.epoch, alive=True))
         if msg.op == MOSDPing.PING and msg.connection is not None:
             msg.connection.send_message(MOSDPing(
                 from_osd=self.osd_id, op=MOSDPing.PING_REPLY,

@@ -21,8 +21,9 @@ This is that engine, reduced to its algorithmic core:
         P_k = max(now, P_k_prev + 1/w_k)        (proportional tag)
     Dequeue picks the earliest R-tag that is ≤ now (reservation phase);
     otherwise the earliest P-tag among classes whose L-tag permits
-    (weight phase); otherwise the earliest R-tag (nothing eligible —
-    work-conserving fallback).
+    (weight phase); otherwise — every backlogged class limit-throttled —
+    the earliest L-tag (work-conserving fallback: serve whoever's cap
+    expires soonest rather than idle).
 
 dmclock reference: the mClock paper's tag rules as embodied in the
 reference's `osd_op_queue=mclock_*` options (common/options.cc).

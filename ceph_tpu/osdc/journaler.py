@@ -1,0 +1,107 @@
+"""Journaler: an append-only entry log striped over RADOS objects
+(src/osdc/Journaler.{h,cc} analog) — the MDS journals every metadata
+mutation through this before acking, and replays it after a crash.
+
+Layout mirrors the reference: a head object (`<name>.head`) persists
+{write_pos, expire_pos, layout params}; entries live in a byte stream
+striped over `<name>.<objno>` data objects (Striper layout), each entry
+framed [u32 len][payload][u32 crc32].  append_entry buffers; flush
+writes the buffer and then the head (data before head, so a torn flush
+replays short, never corrupt).  trim advances expire_pos and removes
+wholly-expired stream bytes from the head's view.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+from ceph_tpu.osdc.striper import StripeLayout, StripedObject
+
+_FRAME = struct.Struct("<I")
+
+
+class Journaler:
+    def __init__(self, ioctx, name: str,
+                 layout: StripeLayout | None = None):
+        self.io = ioctx
+        self.name = name
+        self.layout = layout or StripeLayout(stripe_unit=1 << 16,
+                                             stripe_count=1,
+                                             object_size=1 << 20)
+        self.stream = StripedObject(ioctx, name, self.layout)
+        self.write_pos = 0
+        self.expire_pos = 0
+        self._buf = bytearray()
+
+    def _head_obj(self) -> str:
+        return f"{self.name}.head"
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def create(self) -> None:
+        self.write_pos = 0
+        self.expire_pos = 0
+        self._write_head()
+
+    def open(self) -> None:
+        """Read the head (Journaler::recover)."""
+        omap = self.io.get_omap(self._head_obj())
+        self.write_pos = int(omap.get("write_pos", b"0").decode())
+        self.expire_pos = int(omap.get("expire_pos", b"0").decode())
+
+    def _write_head(self) -> None:
+        self.io.set_omap(self._head_obj(), {
+            "write_pos": str(self.write_pos).encode(),
+            "expire_pos": str(self.expire_pos).encode()})
+
+    # -- append side ----------------------------------------------------------
+
+    def append_entry(self, payload: bytes) -> int:
+        """Buffer one entry; returns its end position once flushed."""
+        self._buf += _FRAME.pack(len(payload))
+        self._buf += payload
+        self._buf += _FRAME.pack(zlib.crc32(payload))
+        return self.write_pos + len(self._buf)
+
+    def flush(self) -> None:
+        """Write buffered entries, then persist the head.  Data lands
+        before the head advance: a crash between the two replays the
+        old range — entries are re-applied, never half-read."""
+        if not self._buf:
+            return
+        data = bytes(self._buf)
+        self._buf.clear()
+        self.stream.write(data, offset=self.write_pos)
+        self.write_pos += len(data)
+        self._write_head()
+
+    # -- replay / trim --------------------------------------------------------
+
+    def replay(self, cb) -> int:
+        """Read entries in [expire_pos, write_pos), calling cb(payload)
+        for each (Journaler::try_read_entry loop).  Returns the count."""
+        n = 0
+        pos = self.expire_pos
+        while pos + _FRAME.size <= self.write_pos:
+            hdr = self.stream.read(pos, _FRAME.size)
+            (plen,) = _FRAME.unpack(hdr)
+            end = pos + _FRAME.size + plen + _FRAME.size
+            if end > self.write_pos:
+                break  # torn tail: flush never completed
+            payload = self.stream.read(pos + _FRAME.size, plen)
+            (crc,) = _FRAME.unpack(
+                self.stream.read(pos + _FRAME.size + plen, _FRAME.size))
+            if zlib.crc32(payload) != crc:
+                raise IOError(
+                    f"journal {self.name}: crc mismatch at {pos}")
+            cb(payload)
+            pos = end
+            n += 1
+        return n
+
+    def trim(self, upto: int | None = None) -> None:
+        """Expire everything before `upto` (default: all replayed/known
+        entries).  The backing store must already reflect them."""
+        self.expire_pos = self.write_pos if upto is None else upto
+        self._write_head()

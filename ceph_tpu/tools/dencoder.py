@@ -1,0 +1,181 @@
+"""ceph-dencoder analog: encode/decode round-trip and corpus checking
+for every registered wire type.
+
+The reference's ceph-dencoder (src/tools/ceph-dencoder/) lists each
+encodable type, round-trips sample instances, and verifies archived
+encodings from older versions still decode — the guard that keeps the
+wire format compatible forever.  Here:
+
+  * `list_types()`   — every registered Message type + core structs
+  * `roundtrip(t)`   — encode(sample) -> decode -> re-encode, bytes equal
+  * `create_corpus(dir)` / `check_corpus(dir)` — archive sample
+    encodings with the head version at creation time; a check decodes
+    every archived blob with current code (must succeed even across
+    version bumps) and byte-compares the re-encode only when the type's
+    head version is unchanged.
+
+Usage: python -m ceph_tpu.tools.dencoder list|roundtrip|create|check [dir]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ceph_tpu.msg.encoding import Decoder, Encoder
+from ceph_tpu.msg.message import _REGISTRY, Message
+
+
+def _import_catalog() -> None:
+    """Messages register on import; pull in every module that defines
+    wire types (the dlopen-all analog)."""
+    import ceph_tpu.messages  # noqa: F401
+    import ceph_tpu.messages.peering_msgs  # noqa: F401
+    import ceph_tpu.mon.monitor  # noqa: F401
+    import ceph_tpu.mon.elector  # noqa: F401
+    import ceph_tpu.mon.paxos  # noqa: F401
+    import ceph_tpu.osd.daemon  # noqa: F401
+    import ceph_tpu.mgr  # noqa: F401
+
+
+def _sample(cls) -> Message:
+    """Default-constructed sample instance (the reference generates
+    samples via each type's generate_test_instances)."""
+    return cls()
+
+
+def list_types() -> list[dict]:
+    _import_catalog()
+    out = []
+    for t, cls in sorted(_REGISTRY.items()):
+        out.append({"type": t, "name": cls.__name__,
+                    "head_version": cls.HEAD_VERSION,
+                    "compat_version": cls.COMPAT_VERSION})
+    return out
+
+
+def roundtrip(cls) -> None:
+    """encode -> decode -> re-encode must reproduce identical bytes."""
+    msg = _sample(cls)
+    wire = msg.encode()
+    back = Message.decode(wire)
+    wire2 = back.encode()
+    if wire != wire2:
+        raise AssertionError(
+            f"{cls.__name__}: re-encode differs "
+            f"({len(wire)} vs {len(wire2)} bytes)")
+
+
+def roundtrip_all() -> int:
+    _import_catalog()
+    for _t, cls in sorted(_REGISTRY.items()):
+        roundtrip(cls)
+    return len(_REGISTRY)
+
+
+# -- struct (non-message) round trips ----------------------------------------
+
+def struct_checks() -> list[str]:
+    """Core struct codecs: OSDMap/CrushMap survive encode/decode with
+    identical bytes (map_codec), like dencoder's non-message types."""
+    from ceph_tpu.crush import build_two_level_map
+    from ceph_tpu.osd.map_codec import decode_osdmap, encode_osdmap
+    from ceph_tpu.osd.osdmap import OSDMap, PGPool
+
+    checked = []
+    m = OSDMap()
+    m.set_max_osd(4)
+    for o in range(4):
+        m.mark_up(o)
+    crush, _root, rid = build_two_level_map(2, 2)
+    m.crush = crush
+    m.pools[1] = PGPool(pool_id=1, pg_num=8, crush_rule=rid)
+    blob = encode_osdmap(m)
+    blob2 = encode_osdmap(decode_osdmap(blob))
+    assert blob == blob2, "OSDMap re-encode differs"
+    checked.append("OSDMap")
+
+    from ceph_tpu.objectstore.transaction import Transaction
+    t = (Transaction().create_collection("1.0")
+         .write("1.0", "o", 0, b"x" * 32).setattr("1.0", "o", "_v", b"1"))
+    tb = t.encode()
+    tb2 = Transaction.decode(tb).encode()
+    assert tb == tb2, "Transaction re-encode differs"
+    checked.append("Transaction")
+    return checked
+
+
+# -- corpus ------------------------------------------------------------------
+
+def create_corpus(path: str) -> int:
+    _import_catalog()
+    os.makedirs(path, exist_ok=True)
+    meta = {}
+    for t, cls in sorted(_REGISTRY.items()):
+        wire = _sample(cls).encode()
+        with open(os.path.join(path, f"{cls.__name__}.bin"), "wb") as f:
+            f.write(wire)
+        meta[cls.__name__] = {"type": t, "head_version": cls.HEAD_VERSION}
+    with open(os.path.join(path, "corpus.json"), "w") as f:
+        json.dump(meta, f, indent=1, sort_keys=True)
+    return len(meta)
+
+
+def check_corpus(path: str) -> list[str]:
+    """Every archived blob must decode with current code; byte-stable
+    re-encode is enforced only while the head version is unchanged."""
+    _import_catalog()
+    with open(os.path.join(path, "corpus.json")) as f:
+        meta = json.load(f)
+    failures = []
+    by_name = {cls.__name__: cls for cls in _REGISTRY.values()}
+    for name, info in sorted(meta.items()):
+        cls = by_name.get(name)
+        if cls is None:
+            failures.append(f"{name}: type no longer registered")
+            continue
+        with open(os.path.join(path, f"{name}.bin"), "rb") as f:
+            wire = f.read()
+        try:
+            back = Message.decode(wire)
+        except Exception as e:
+            failures.append(f"{name}: archived encoding no longer "
+                            f"decodes: {e}")
+            continue
+        if (cls.HEAD_VERSION == info["head_version"]
+                and back.encode() != wire):
+            failures.append(f"{name}: re-encode of archived bytes differs "
+                            f"at unchanged head version")
+    return failures
+
+
+def main(argv=None) -> int:
+    import sys
+    argv = sys.argv[1:] if argv is None else argv
+    cmd = argv[0] if argv else "list"
+    if cmd == "list":
+        for row in list_types():
+            print("{type:4d} {name:28s} v{head_version}/"
+                  "c{compat_version}".format(**row))
+        return 0
+    if cmd == "roundtrip":
+        n = roundtrip_all()
+        checked = struct_checks()
+        print(f"{n} message types + {len(checked)} structs round-trip OK")
+        return 0
+    if cmd == "create":
+        n = create_corpus(argv[1])
+        print(f"archived {n} sample encodings")
+        return 0
+    if cmd == "check":
+        failures = check_corpus(argv[1])
+        for f in failures:
+            print(f"FAIL {f}")
+        print(f"{'FAILED' if failures else 'OK'}")
+        return 1 if failures else 0
+    print(__doc__)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,63 @@
+"""ceph-kvstore-tool analog: inspect/patch a KeyValueDB (LogDB) store —
+the mon store and BlueStore-lite metadata both live in this format.
+
+    list [PREFIX]            keys (and sizes)
+    get PREFIX KEY           value hexdump to stdout
+    set PREFIX KEY VALUEHEX  write a key
+    rm PREFIX KEY            delete a key
+    compact                  checkpoint the append log
+
+Usage: python -m ceph_tpu.tools.kvstore_tool PATH CMD [...]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from ceph_tpu.objectstore.kv import LogDB
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) < 2:
+        print(__doc__)
+        return 2
+    path, cmd, rest = argv[0], argv[1], argv[2:]
+    db = LogDB(path)
+    db.open()
+    try:
+        if cmd == "list":
+            prefix = rest[0] if rest else None
+            rows = [{"prefix": p, "key": k, "size": len(v)}
+                    for p, k, v in db.iterate(prefix)]
+            print(json.dumps(rows, indent=1))
+        elif cmd == "get":
+            v = db.get(rest[0], rest[1])
+            if v is None:
+                print("(absent)", file=sys.stderr)
+                return 1
+            print(v.hex())
+        elif cmd == "set":
+            t = db.get_transaction()
+            t.set(rest[0], rest[1], bytes.fromhex(rest[2]))
+            db.submit_transaction(t)
+            print(json.dumps({"set": rest[1]}))
+        elif cmd == "rm":
+            t = db.get_transaction()
+            t.rmkey(rest[0], rest[1])
+            db.submit_transaction(t)
+            print(json.dumps({"removed": rest[1]}))
+        elif cmd == "compact":
+            db.compact()
+            print(json.dumps({"compacted": path}))
+        else:
+            print(__doc__)
+            return 2
+        return 0
+    finally:
+        db.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

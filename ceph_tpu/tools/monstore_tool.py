@@ -1,0 +1,88 @@
+"""ceph-monstore-tool analog: inspect a monitor's Paxos store offline.
+
+The mon store is a LogDB with the "paxos" prefix holding versioned
+committed map blobs (v_1..v_last_committed) — the layout Paxos commits
+into (mon/paxos.py).  Ops:
+
+    dump                      last_committed + per-version blob sizes
+    get-osdmap [VERSION]      decoded osdmap summary (default: latest)
+    rewrite-last-committed N  truncate history to N (disaster recovery)
+
+Usage: python -m ceph_tpu.tools.monstore_tool PATH CMD [...]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from ceph_tpu.objectstore.kv import LogDB
+
+
+def _last_committed(db) -> int:
+    lc = db.get("paxos", "last_committed")
+    return int(lc.decode()) if lc else 0
+
+
+def dump(db) -> dict:
+    lc = _last_committed(db)
+    versions = {}
+    for v in range(1, lc + 1):
+        blob = db.get("paxos", f"v_{v}")
+        versions[v] = len(blob) if blob else None
+    return {"last_committed": lc, "versions": versions}
+
+
+def get_osdmap(db, version: int | None = None) -> dict:
+    from ceph_tpu.osd.map_codec import decode_osdmap
+    v = version or _last_committed(db)
+    blob = db.get("paxos", f"v_{v}")
+    if blob is None:
+        raise KeyError(f"no committed value at version {v}")
+    m = decode_osdmap(blob)
+    return {
+        "version": v, "epoch": m.epoch, "max_osd": m.max_osd,
+        "up_osds": [o for o in range(m.max_osd) if m.is_up(o)],
+        "pools": {p: {"pg_num": pool.pg_num, "size": pool.size,
+                      "type": pool.type} for p, pool in m.pools.items()},
+    }
+
+
+def rewrite_last_committed(db, n: int) -> dict:
+    lc = _last_committed(db)
+    if n > lc:
+        raise ValueError(f"cannot advance last_committed ({n} > {lc})")
+    t = db.get_transaction()
+    for v in range(n + 1, lc + 1):
+        t.rmkey("paxos", f"v_{v}")
+    t.set("paxos", "last_committed", str(n).encode())
+    db.submit_transaction(t)
+    return {"last_committed": n, "dropped": lc - n}
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) < 2:
+        print(__doc__)
+        return 2
+    path, cmd, rest = argv[0], argv[1], argv[2:]
+    db = LogDB(path)
+    db.open()
+    try:
+        if cmd == "dump":
+            print(json.dumps(dump(db), indent=1))
+        elif cmd == "get-osdmap":
+            v = int(rest[0]) if rest else None
+            print(json.dumps(get_osdmap(db, v), indent=1))
+        elif cmd == "rewrite-last-committed":
+            print(json.dumps(rewrite_last_committed(db, int(rest[0]))))
+        else:
+            print(__doc__)
+            return 2
+        return 0
+    finally:
+        db.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

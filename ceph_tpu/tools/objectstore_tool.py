@@ -1,0 +1,169 @@
+"""ceph-objectstore-tool analog: offline surgery on one OSD's store.
+
+The reference tool (src/tools/ceph_objectstore_tool.cc) opens a stopped
+OSD's ObjectStore and supports listing, PG info/log dumps, object byte
+get/set, and PG export/import — the disaster-recovery path for moving a
+PG off a dead OSD.  Same operation set here over the ObjectStore API:
+
+    --op list                           collections + objects
+    --op info     --pgid P.S            decoded pg info
+    --op log      --pgid P.S            decoded pg log entries
+    --op export   --pgid P.S --file F   PG -> portable blob
+    --op import   --file F              blob -> this store
+    --op get-bytes --pgid P.S --oid O   object data to stdout
+    --op rm-object --pgid P.S --oid O
+
+Usage: python -m ceph_tpu.tools.objectstore_tool --data-path PATH \
+          --type filestore|bluestore|memstore --op ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ceph_tpu.msg.encoding import Decoder, Encoder
+from ceph_tpu.objectstore import Transaction, create_objectstore
+from ceph_tpu.osd.pg import PG
+
+
+def _pg_cid(pgid: tuple[int, int]) -> str:
+    return f"{pgid[0]}.{pgid[1]}"
+
+
+def op_list(store) -> dict:
+    return {cid: store.list_objects(cid)
+            for cid in sorted(store.list_collections())}
+
+
+def op_info(store, pgid) -> dict:
+    meta = store.omap_get(_pg_cid(pgid), PG.PGMETA)
+    blob = meta.get("info")
+    if blob is None:
+        raise KeyError(f"pg {_pg_cid(pgid)} has no info")
+    info = PG.decode_info(blob)
+    return {"pgid": list(info.pgid), "last_update": list(info.last_update),
+            "last_complete": list(info.last_complete),
+            "last_epoch_started": info.last_epoch_started,
+            "past_up": info.past_up}
+
+
+def op_log(store, pgid) -> list[dict]:
+    meta = store.omap_get(_pg_cid(pgid), PG.PGMETA)
+    entries = []
+    for key in sorted(k for k in meta if k.startswith("log.")):
+        e = PG.decode_entry(meta[key])
+        entries.append({"version": list(e.version), "op": e.op,
+                        "oid": e.oid})
+    return entries
+
+
+def op_export(store, pgid, path: str) -> dict:
+    """Portable PG image: pgmeta omap + every object's data/omap/attrs
+    (the reference's export writes a typed section stream)."""
+    cid = _pg_cid(pgid)
+    if cid not in store.list_collections():
+        raise KeyError(f"pg {cid} not in store")
+    e = Encoder()
+
+    def body(enc: Encoder):
+        enc.s64(pgid[0]).u32(pgid[1])
+        meta = store.omap_get(cid, PG.PGMETA)
+        enc.map(meta, lambda e2, k: e2.str(k), lambda e2, v: e2.bytes(v))
+        oids = [o for o in store.list_objects(cid) if o != PG.PGMETA]
+        def enc_obj(e2: Encoder, oid: str):
+            e2.str(oid)
+            e2.bytes(store.read(cid, oid))
+            e2.map(store.omap_get(cid, oid), lambda e3, k: e3.str(k),
+                   lambda e3, v: e3.bytes(v))
+            attrs = {}
+            for name in ("_v",):
+                v = store.getattr(cid, oid, name)
+                if v is not None:
+                    attrs[name] = v
+            e2.map(attrs, lambda e3, k: e3.str(k), lambda e3, v: e3.bytes(v))
+        enc.list(oids, enc_obj)
+
+    e.versioned(1, 1, body)
+    blob = e.tobytes()
+    with open(path, "wb") as f:
+        f.write(blob)
+    return {"pgid": _pg_cid(pgid), "bytes": len(blob)}
+
+
+def op_import(store, path: str) -> dict:
+    with open(path, "rb") as f:
+        blob = f.read()
+    d = Decoder(blob)
+
+    def body(dd: Decoder, version: int):
+        pgid = (dd.s64(), dd.u32())
+        cid = _pg_cid(pgid)
+        meta = dd.map(lambda d2: d2.str(), lambda d2: d2.bytes())
+        t = Transaction()
+        if cid in store.list_collections():
+            raise ValueError(f"pg {cid} already present (remove first)")
+        t.create_collection(cid)
+        t.touch(cid, PG.PGMETA)
+        t.omap_setkeys(cid, PG.PGMETA, meta)
+        n = dd.u32()
+        for _ in range(n):
+            oid = dd.str()
+            data = dd.bytes()
+            omap = dd.map(lambda d2: d2.str(), lambda d2: d2.bytes())
+            attrs = dd.map(lambda d2: d2.str(), lambda d2: d2.bytes())
+            t.write(cid, oid, 0, data)
+            if omap:
+                t.omap_setkeys(cid, oid, omap)
+            for name, val in attrs.items():
+                t.setattr(cid, oid, name, val)
+        store.apply_transaction(t)
+        return {"pgid": cid, "objects": n}
+
+    return d.versioned(1, body)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ceph-objectstore-tool")
+    ap.add_argument("--data-path", required=True)
+    ap.add_argument("--type", default="filestore",
+                    choices=["memstore", "filestore", "bluestore"])
+    ap.add_argument("--op", required=True,
+                    choices=["list", "info", "log", "export", "import",
+                             "get-bytes", "rm-object"])
+    ap.add_argument("--pgid")
+    ap.add_argument("--oid")
+    ap.add_argument("--file")
+    args = ap.parse_args(argv)
+
+    store = create_objectstore(args.type, args.data_path)
+    store.mount()
+    try:
+        pgid = None
+        if args.pgid:
+            p, s = args.pgid.split(".")
+            pgid = (int(p), int(s))
+        if args.op == "list":
+            print(json.dumps(op_list(store), indent=1))
+        elif args.op == "info":
+            print(json.dumps(op_info(store, pgid), indent=1))
+        elif args.op == "log":
+            print(json.dumps(op_log(store, pgid), indent=1))
+        elif args.op == "export":
+            print(json.dumps(op_export(store, pgid, args.file)))
+        elif args.op == "import":
+            print(json.dumps(op_import(store, args.file)))
+        elif args.op == "get-bytes":
+            sys.stdout.buffer.write(store.read(_pg_cid(pgid), args.oid))
+        elif args.op == "rm-object":
+            store.apply_transaction(
+                Transaction().remove(_pg_cid(pgid), args.oid))
+            print(json.dumps({"removed": args.oid}))
+        return 0
+    finally:
+        store.umount()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -40,6 +40,7 @@ class MiniCluster:
         self._n_mons = n_mons
         self.auth_key = auth_key
         self.mgr = None
+        self.mds = None
 
     @property
     def mon(self) -> Monitor:
@@ -103,6 +104,23 @@ class MiniCluster:
         self.mgr.init()
         return self.mgr
 
+    def run_mds(self, metadata_pool: int, data_pool: int):
+        """Start the metadata server over the given pools (the `fs new
+        meta data` + ceph-mds step)."""
+        from ceph_tpu.mds import MDSDaemon
+        addr = ("127.0.0.1:0" if self.ms_type == "async"
+                else f"{self._ns}mds.0")
+        self.mds = MDSDaemon(self.mon_host, metadata_pool, data_pool,
+                             ms_type=self.ms_type, addr=addr,
+                             auth_key=self.auth_key)
+        self.mds.init()
+        return self.mds
+
+    def kill_mds(self) -> None:
+        mds = self.mds
+        self.mds = None
+        mds.shutdown()
+
     def run_osd(self, osd_id: int) -> OSDDaemon:
         addr = (f"127.0.0.1:0" if self.ms_type == "async"
                 else f"{self._ns}osd.{osd_id}")
@@ -131,6 +149,9 @@ class MiniCluster:
     def stop(self) -> None:
         for c in self.clients:
             c.shutdown()
+        if self.mds:
+            self.mds.shutdown()
+            self.mds = None
         for osd in list(self.osds.values()):
             osd.shutdown()
         self.osds.clear()

@@ -1,0 +1,226 @@
+"""CephFS-lite: Journaler over RADOS, MDS namespace ops, journal replay
+after an MDS crash, and striped file I/O through the FS client
+(src/osdc/Journaler.cc, src/mds/, src/client/Client.cc analogs)."""
+
+import time
+
+import pytest
+
+from ceph_tpu.cephfs import CephFS
+from ceph_tpu.osdc.journaler import Journaler
+from ceph_tpu.tools.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osds=3, ms_type="loopback").start()
+    c.wait_for_osd_count(3)
+    client = c.client(timeout=20.0)
+    meta = c.create_pool(client, pg_num=4, size=2)
+    data = c.create_pool(client, pg_num=8, size=2)
+    c.run_mds(meta, data)
+    yield c
+    c.stop()
+
+
+@pytest.fixture
+def fs(cluster):
+    f = CephFS(cluster.mon_host, cluster.mds.addr, ms_type="loopback")
+    f.mount()
+    yield f
+    f.unmount()
+
+
+# -- journaler ----------------------------------------------------------------
+
+def test_journaler_append_flush_replay(cluster):
+    client = cluster.client(timeout=20.0)
+    pool = cluster.create_pool(client, pg_num=4, size=2)
+    io = client.open_ioctx(pool)
+    j = Journaler(io, "jtest")
+    j.create()
+    for i in range(20):
+        j.append_entry(f"event-{i}".encode())
+    j.flush()
+    # a fresh journaler on the same stream replays everything
+    j2 = Journaler(io, "jtest")
+    j2.open()
+    assert j2.write_pos == j.write_pos
+    got = []
+    assert j2.replay(got.append) == 20
+    assert got == [f"event-{i}".encode() for i in range(20)]
+    # trim; replay is now empty
+    j2.trim()
+    j3 = Journaler(io, "jtest")
+    j3.open()
+    assert j3.replay(got.append) == 0
+
+
+def test_journaler_torn_tail_replays_short(cluster):
+    client = cluster.client(timeout=20.0)
+    pool = cluster.create_pool(client, pg_num=4, size=2)
+    io = client.open_ioctx(pool)
+    j = Journaler(io, "jtorn")
+    j.create()
+    j.append_entry(b"committed")
+    j.flush()
+    # simulate a torn flush: stream bytes appended, head never advanced
+    j.stream.write(b"\xff\xff\xff\xff garbage", offset=j.write_pos)
+    j2 = Journaler(io, "jtorn")
+    j2.open()
+    got = []
+    assert j2.replay(got.append) == 1
+    assert got == [b"committed"]
+
+
+# -- namespace ----------------------------------------------------------------
+
+def test_mkdir_create_readdir_stat(fs):
+    fs.mkdir("/a")
+    fs.mkdir("/a/b")
+    with fs.open("/a/b/hello.txt", "w") as f:
+        f.write(b"hello fs")
+    ents = fs.listdir("/a")
+    assert "b" in ents
+    ents = fs.listdir("/a/b")
+    assert list(ents) == ["hello.txt"]
+    st = fs.stat("/a/b/hello.txt")
+    assert st["size"] == 8
+    assert fs.stat("/a")["mode"] & 0o040000
+    with pytest.raises(OSError):
+        fs.mkdir("/a")          # EEXIST
+    with pytest.raises(OSError):
+        fs.stat("/nope/deep")   # ENOENT
+
+
+def test_file_io_roundtrip_and_append(fs):
+    payload = bytes(range(256)) * 1000   # 256 KB crosses stripe units
+    with fs.open("/big.bin", "w") as f:
+        f.write(payload)
+    with fs.open("/big.bin") as f:
+        assert f.read() == payload
+    with fs.open("/big.bin", "a") as f:
+        f.write(b"tail")
+    with fs.open("/big.bin") as f:
+        data = f.read()
+    assert data == payload + b"tail"
+    # partial read at offset
+    with fs.open("/big.bin") as f:
+        f.seek(1000)
+        assert f.read(16) == payload[1000:1016]
+
+
+def test_open_w_truncates(fs):
+    with fs.open("/trunc", "w") as f:
+        f.write(b"long original content")
+    with fs.open("/trunc", "w") as f:
+        f.write(b"new")
+    st = fs.stat("/trunc")
+    assert st["size"] == 3
+    with fs.open("/trunc") as f:
+        assert f.read() == b"new"
+
+
+def test_rename_unlink_rmdir(fs):
+    fs.mkdir("/mv")
+    with fs.open("/mv/one", "w") as f:
+        f.write(b"1")
+    fs.rename("/mv/one", "/mv/two")
+    assert list(fs.listdir("/mv")) == ["two"]
+    with fs.open("/mv/two") as f:
+        assert f.read() == b"1"
+    with pytest.raises(OSError):
+        fs.rmdir("/mv")         # ENOTEMPTY
+    fs.unlink("/mv/two")
+    fs.rmdir("/mv")
+    with pytest.raises(OSError):
+        fs.stat("/mv")
+
+
+def test_mds_restart_replays_journal(cluster):
+    """Metadata mutations survive an MDS crash: the journal replays on
+    startup (up:replay) and the namespace converges."""
+    fs = CephFS(cluster.mon_host, cluster.mds.addr, ms_type="loopback")
+    fs.mount()
+    fs.mkdir("/crash")
+    with fs.open("/crash/file", "w") as f:
+        f.write(b"survives")
+    meta, data = cluster.mds.metadata_pool, cluster.mds.data_pool
+    fs.unmount()
+    # hard kill: skip the clean-shutdown flush by not calling shutdown's
+    # flush path — emulate by discarding dirty state before stopping
+    cluster.mds._dirty_dirs.clear()
+    cluster.mds._dirty_inodes.clear()
+    cluster.mds.journal.trim_on_shutdown = False
+    # prevent the shutdown flush+trim from persisting anything
+    cluster.mds._flush_dirty = lambda: None
+    cluster.mds.journal.trim = lambda *a, **k: None
+    cluster.kill_mds()
+
+    cluster.run_mds(meta, data)
+    fs2 = CephFS(cluster.mon_host, cluster.mds.addr, ms_type="loopback")
+    fs2.mount()
+    assert "file" in fs2.listdir("/crash")
+    with fs2.open("/crash/file") as f:
+        assert f.read() == b"survives"
+    fs2.unmount()
+
+
+def test_segment_boundary_never_loses_acked_mutations(cluster):
+    """The 64-event segment roll must trim only AFTER the boundary event
+    is applied: every acked mkdir survives a crash right at the roll."""
+    fs = CephFS(cluster.mon_host, cluster.mds.addr, ms_type="loopback")
+    fs.mount()
+    fs.mkdir("/seg")
+    for i in range(70):   # crosses the 64-event segment boundary
+        fs.mkdir(f"/seg/d{i}")
+    meta, data = cluster.mds.metadata_pool, cluster.mds.data_pool
+    fs.unmount()
+    # hard crash: no clean-shutdown flush/trim
+    cluster.mds._flush_dirty = lambda: None
+    cluster.mds.journal.trim = lambda *a, **k: None
+    cluster.kill_mds()
+    cluster.run_mds(meta, data)
+    fs2 = CephFS(cluster.mon_host, cluster.mds.addr, ms_type="loopback")
+    fs2.mount()
+    ents = fs2.listdir("/seg")
+    assert sorted(ents) == sorted(f"d{i}" for i in range(70)), \
+        "acked mkdirs lost across the segment boundary"
+    fs2.unmount()
+
+
+def test_rename_journals_atomically(cluster):
+    """A rename is one journal entry: replay can never leave the inode
+    linked at both paths."""
+    fs = CephFS(cluster.mon_host, cluster.mds.addr, ms_type="loopback")
+    fs.mount()
+    fs.mkdir("/atomic")
+    with fs.open("/atomic/src", "w") as f:
+        f.write(b"x")
+    events = []
+    orig = cluster.mds._journal
+    cluster.mds._journal = lambda ev: (events.append(ev), orig(ev))[1]
+    fs.rename("/atomic/src", "/atomic/dst")
+    cluster.mds._journal = orig
+    renames = [e for e in events if e["e"] == "batch"]
+    assert len(renames) == 1, "rename must journal one atomic batch"
+    kinds = [s["e"] for s in renames[0]["events"]]
+    assert kinds == ["link", "unlink"]
+    fs.unmount()
+
+
+def test_two_clients_share_namespace(cluster):
+    a = CephFS(cluster.mon_host, cluster.mds.addr, ms_type="loopback")
+    b = CephFS(cluster.mon_host, cluster.mds.addr, ms_type="loopback")
+    a.mount()
+    b.mount()
+    try:
+        a.mkdir("/shared")
+        with a.open("/shared/x", "w") as f:
+            f.write(b"from-a")
+        with b.open("/shared/x") as f:
+            assert f.read() == b"from-a"
+        assert "x" in b.listdir("/shared")
+    finally:
+        a.unmount()
+        b.unmount()
