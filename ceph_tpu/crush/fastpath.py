@@ -69,8 +69,12 @@ from .types import (
 
 NONE = jnp.int32(CRUSH_ITEM_NONE)
 
-#: extra r-values beyond numrep precomputed in the first block
-DEFAULT_BLOCK = 12
+#: extra r-values beyond numrep precomputed in the first block.  6 covers
+#: every lane on healthy maps (ftotal beyond 6 needs seven consecutive
+#: collision/reject draws); the overflow cond recomputes with the full
+#: range when it ever does not, so this is a latency knob, not a
+#: correctness one.
+DEFAULT_BLOCK = 6
 
 
 @dataclass
@@ -266,14 +270,9 @@ class FastMapper:
                 self._pallas = PallasColumns(fr)
 
     def _winners_pallas(self, xs, reweight, R: int):
-        """host_win/leaf_win/leaf_bad via the fused kernels.  Pads the
-        batch to the 128-lane block quantum and returns (N, R) views."""
-        from ceph_tpu.ops.pallas_straw2 import BLOCK
+        """host_win/leaf_win/leaf_bad via the fused kernels (which pad
+        the batch to their block quantum internally); (N, R) views."""
         n = xs.shape[0]
-        pad = (-n) % BLOCK
-        if pad:
-            xs = jnp.concatenate(
-                [xs, jnp.zeros((pad,), dtype=xs.dtype)])
         pos, ids, bad = self._pallas.root_columns(xs, reweight, R)
         if self.fr.kind == "choose_flat":
             hw = lw = ids.T[:n]
@@ -284,6 +283,27 @@ class FastMapper:
             lw = lid.T[:n]
             lb = lbad.T[:n] != 0
         return hw, lw, lb
+
+    def _winners_pallas_fast(self, xs, reweight, R: int):
+        """Approx-filtered winners with the exact columns as the
+        certified fallback: if any (x, r) column had more than K items
+        inside the f32 error band, the whole batch re-runs exact —
+        bit-exactness is unconditional, the filter is only a schedule."""
+        n = xs.shape[0]
+        pos, ids, bad, ovf = self._pallas.root_columns_fast(
+            xs, reweight, R)
+        if self.fr.kind == "choose_flat":
+            fast = (ids.T[:n], ids.T[:n], bad.T[:n] != 0)
+            need_exact = jnp.any(ovf != 0)
+        else:
+            lid, lbad, ovf2 = self._pallas.leaf_columns_fast(
+                xs, pos, reweight, R)
+            fast = (ids.T[:n], lid.T[:n], lbad.T[:n] != 0)
+            need_exact = jnp.any(ovf != 0) | jnp.any(ovf2 != 0)
+        return jax.lax.cond(
+            need_exact,
+            lambda _: self._winners_pallas(xs, reweight, R),
+            lambda _: fast, None)
 
     def _winners(self, xs, reweight, R: int):
         """host_win/leaf_win/leaf_bad for r in [0, R): a fori_loop producing
@@ -335,13 +355,27 @@ class FastMapper:
             return jnp.full((n, result_max), NONE, dtype=jnp.int32)
         Rf = fr.tries + numrep
         R0 = min(numrep + block, Rf)
-        winners = (self._winners_pallas if self._pallas is not None
-                   else self._winners)
-        hw, lw, lb = winners(xs, reweight, R0)
+
+        def winners_for(R):
+            if self._pallas is None:
+                return self._winners
+            # the candidate-packed approx kernels (winners_pallas_fast)
+            # are bit-exact and interpret-verified, but the axon AOT
+            # backend compiles their two-phase program pathologically
+            # (minutes to never) at bulk shapes — opt-in only until the
+            # toolchain digests them
+            import os
+            from ceph_tpu.ops.pallas_straw2 import _KPACK
+            if (os.environ.get("CEPH_TPU_FAST_FILTER") == "1"
+                    and R * _KPACK <= 128):
+                return self._winners_pallas_fast
+            return self._winners_pallas
+
+        hw, lw, lb = winners_for(R0)(xs, reweight, R0)
         out_h, out_l, ovf = _consume(hw, lw, lb, numrep, fr.tries, R0, n)
 
         def slow(_):
-            hw2, lw2, lb2 = winners(xs, reweight, Rf)
+            hw2, lw2, lb2 = winners_for(Rf)(xs, reweight, Rf)
             oh, ol, _ = _consume(hw2, lw2, lb2, numrep, fr.tries, Rf, n)
             return oh, ol
 

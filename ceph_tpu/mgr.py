@@ -87,6 +87,9 @@ class MgrDaemon(Dispatcher):
                                            addr=self.msgr.my_addr))
 
     def shutdown(self) -> None:
+        if getattr(self, "_prom", None) is not None:
+            self._prom.shutdown()
+            self._prom.server_close()
         self.msgr.shutdown()
 
     @property
@@ -152,3 +155,69 @@ class MgrDaemon(Dispatcher):
             checks.append({"check": "PG_DEGRADED", "count": degraded})
         return {"status": "HEALTH_OK" if not checks else "HEALTH_WARN",
                 "checks": checks}
+
+    # -- prometheus module (src/pybind/mgr/prometheus analog) -----------------
+
+    def prometheus_text(self) -> str:
+        """The exporter's scrape payload: every aggregated counter and
+        gauge in the prometheus text exposition format."""
+        lines = [
+            "# HELP ceph_health_status cluster health (0=OK 1=WARN)",
+            "# TYPE ceph_health_status gauge",
+            f"ceph_health_status "
+            f"{0 if self.health()['status'] == 'HEALTH_OK' else 1}",
+        ]
+        m = self.osdmap
+        lines += [
+            "# TYPE ceph_osd_up gauge",
+            f"ceph_osd_up {sum(1 for o in range(m.max_osd) if m.is_up(o))}",
+            "# TYPE ceph_osd_in gauge",
+            f"ceph_osd_in {sum(1 for o in range(m.max_osd) if m.exists(o) and m.osd_weight[o] > 0)}",
+            "# TYPE ceph_osdmap_epoch gauge",
+            f"ceph_osdmap_epoch {m.epoch}",
+        ]
+        for state, n in sorted(self.pg_summary().items()):
+            lines.append(f'ceph_pg_states{{state="{state}"}} {n}')
+        df = self.df()
+        lines.append(f"ceph_cluster_total_objects {df['total_objects']}")
+        lines.append(f"ceph_cluster_bytes_used {df['total_bytes_used']}")
+        for osd, (_t, rep) in sorted(self.reports.items()):
+            for name, val in sorted(rep.counters.items()):
+                lines.append(
+                    f'ceph_osd_perf{{ceph_daemon="osd.{osd}",'
+                    f'counter="{name}"}} {int(val)}')
+        return "\n".join(lines) + "\n"
+
+    def serve_prometheus(self, port: int = 0) -> int:
+        """Start the HTTP exporter; returns the bound port (GET /metrics
+        — the mgr prometheus module's endpoint)."""
+        import http.server
+        import socketserver
+
+        mgr = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path not in ("/metrics", "/"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = mgr.prometheus_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._prom = Server(("127.0.0.1", port), Handler)
+        t = threading.Thread(target=self._prom.serve_forever, daemon=True)
+        t.start()
+        return self._prom.server_address[1]

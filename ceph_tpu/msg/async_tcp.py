@@ -12,7 +12,11 @@ portable structure for a multi-process vstart harness.  Protocol v1-lite
                     src/auth/cephx challenge shape with a shared cluster
                     key standing in for the ticket infrastructure; fresh
                     nonces per connection give replay protection)
-    frames          [u32 length][Message.encode() bytes]   (crc inside)
+    compression     [u8 offered-mode] both ways; effective mode is the
+                    min (0=off, 1=zlib) — msgr2 on-wire compression
+                    negotiation (src/msg/async/compression_*)
+    frames          [u32 length][u8 comp][Message.encode() bytes or its
+                    zlib stream]   (crc inside the message)
 
 Stateful policies reconnect on send failure and resend the queued backlog;
 lossy connections drop and notify ms_handle_reset (msg/Policy.h semantics).
@@ -31,6 +35,7 @@ import socket
 import struct
 import threading
 import time
+import zlib
 
 from .message import Message
 from .messenger import Connection, ConnectionPolicy, EntityName, Messenger
@@ -56,9 +61,18 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
+#: on-wire compression modes (msgr2 compression negotiation analog)
+COMP_NONE = 0
+COMP_ZLIB = 1
+
+#: frames below this many bytes ride uncompressed (header-dominated)
+COMP_THRESHOLD = 1024
+
+
 def _handshake(sock: socket.socket, my_name: EntityName,
                auth_key: bytes | None,
-               auth_required: bool) -> EntityName:
+               auth_required: bool,
+               comp_mode: int = COMP_NONE) -> tuple[EntityName, int]:
     sock.sendall(BANNER)
     got = _read_exact(sock, len(BANNER))
     if got != BANNER:
@@ -89,13 +103,17 @@ def _handshake(sock: socket.socket, my_name: EntityName,
                         hashlib.sha256).digest()
         if not hmac.compare_digest(peer_proof, want):
             raise ConnectionError(f"peer {peer} failed authentication")
-    return peer
+    # compression negotiation: both offer; min wins (off beats on)
+    sock.sendall(bytes([comp_mode]))
+    peer_comp = _read_exact(sock, 1)[0]
+    return peer, min(comp_mode, peer_comp)
 
 
 class TcpConnection(Connection):
     def __init__(self, messenger: "AsyncMessenger", peer_addr: str,
                  peer_name: EntityName | None, policy: ConnectionPolicy,
-                 sock: socket.socket | None = None, accepted: bool = False):
+                 sock: socket.socket | None = None, accepted: bool = False,
+                 comp: int = COMP_NONE):
         super().__init__(messenger, peer_addr)
         self.peer_name = peer_name
         self.policy = policy
@@ -103,6 +121,8 @@ class TcpConnection(Connection):
         # and wait for the initiator to reconnect (the reference server side
         # replaces the Connection on re-accept)
         self.accepted = accepted
+        #: negotiated on-wire compression mode for this session
+        self.comp = comp
         self._sock = sock
         self._sendq: queue.Queue = queue.Queue()
         self._down = False
@@ -117,7 +137,7 @@ class TcpConnection(Connection):
     def send_message(self, msg: Message) -> None:
         if self._down:
             return
-        self._sendq.put(msg.encode())
+        self._sendq.put(msg)
 
     def mark_down(self) -> None:
         self._down = True
@@ -145,7 +165,8 @@ class TcpConnection(Connection):
         m = self.messenger
         # keep the dial timeout through the handshake: a stalled or
         # malicious peer must not wedge the writer thread forever
-        peer = _handshake(s, m.my_name, m.auth_key, m.auth_required)
+        peer, self.comp = _handshake(s, m.my_name, m.auth_key,
+                                     m.auth_required, m.comp_mode)
         s.settimeout(None)
         with self._lock:
             self._sock = s
@@ -153,8 +174,18 @@ class TcpConnection(Connection):
             self.peer_name = peer
         self._start_reader()
 
+    def _frame(self, msg: Message) -> bytes:
+        """Encode + (maybe) compress one message into a wire frame."""
+        payload = msg.encode()
+        comp = COMP_NONE
+        if self.comp == COMP_ZLIB and len(payload) >= COMP_THRESHOLD:
+            z = zlib.compress(payload, 1)
+            if len(z) < len(payload):
+                comp, payload = COMP_ZLIB, z
+        return _LEN.pack(len(payload)) + bytes([comp]) + payload
+
     def _write_loop(self) -> None:
-        backlog: list[bytes] = []
+        backlog: list[Message] = []
         while not self._down:
             item = self._sendq.get()
             if item is None:
@@ -172,7 +203,9 @@ class TcpConnection(Connection):
                         # the reader nulled it already (e.g. the peer
                         # rejected us right after the handshake)
                         raise OSError("connection lost before write")
-                    sock.sendall(_LEN.pack(len(backlog[0])) + backlog[0])
+                    # frame at send time: the negotiated compression can
+                    # change across a reconnect
+                    sock.sendall(self._frame(backlog[0]))
                     backlog.pop(0)
                 except OSError:
                     with self._lock:
@@ -204,12 +237,30 @@ class TcpConnection(Connection):
                     raise ConnectionError(
                         f"oversized frame ({frame_len} bytes) from "
                         f"{self.peer_name}")
+                comp = _read_exact(sock, 1)[0]
                 # policy byte throttle BEFORE buffering the payload:
                 # acquiring after the read would leave buffered bytes
                 # unbounded (msg/Policy.h reads under the throttle)
-                throttled = throttle.get(min(frame_len,
-                                             throttle.max_amount))
+                charged = min(frame_len, throttle.max_amount)
+                throttled = throttle.get(charged)
                 data = _read_exact(sock, frame_len)
+                if comp == COMP_ZLIB:
+                    # bounded inflate: a hostile stream must not balloon
+                    # past the frame cap (zlib-bomb guard)
+                    d = zlib.decompressobj()
+                    data = d.decompress(data, MAX_FRAME)
+                    if d.unconsumed_tail:
+                        raise ConnectionError(
+                            f"decompressed frame exceeds cap from "
+                            f"{self.peer_name}")
+                    # the buffered-bytes bound must cover the INFLATED
+                    # size, not the wire size, or zlib frames bypass it
+                    # by the compression ratio
+                    if throttled and len(data) > frame_len:
+                        extra = min(len(data) - frame_len,
+                                    throttle.max_amount - charged)
+                        throttle.get(extra)
+                        charged += extra
                 try:
                     # a bad frame or handler bug must not kill the reader
                     try:
@@ -222,7 +273,7 @@ class TcpConnection(Connection):
                             self.messenger.my_name, self.peer_name)
                 finally:
                     if throttled:
-                        throttle.put(min(frame_len, throttle.max_amount))
+                        throttle.put(charged)
         except (ConnectionError, OSError):
             with self._lock:
                 self._sock = None
@@ -245,9 +296,17 @@ class AsyncMessenger(Messenger):
         self._stop = False
         self.auth_key: bytes | None = None
         self.auth_required = False
+        self.comp_mode = COMP_NONE
         from ceph_tpu.common.throttle import Throttle
         self.dispatch_throttle = Throttle(
             f"msgr-dispatch:{name}", self.DISPATCH_THROTTLE_BYTES)
+
+    def set_compression(self, mode: str | int) -> None:
+        """Offer on-wire compression (both peers must offer; min wins):
+        "zlib" or "none" (ms_compress_mode analog)."""
+        if isinstance(mode, str):
+            mode = {"none": COMP_NONE, "zlib": COMP_ZLIB}[mode]
+        self.comp_mode = int(mode)
 
     def set_auth(self, key: bytes | str | None,
                  required: bool = True) -> None:
@@ -302,15 +361,15 @@ class AsyncMessenger(Messenger):
             # handshake-phase timeout: an unauthenticated peer that
             # stalls mid-handshake must not leak a thread + fd
             sock.settimeout(10)
-            peer = _handshake(sock, self.my_name, self.auth_key,
-                              self.auth_required)
+            peer, comp = _handshake(sock, self.my_name, self.auth_key,
+                                    self.auth_required, self.comp_mode)
             sock.settimeout(None)
         except (ConnectionError, OSError):
             sock.close()
             return
         policy = self.policy_for(peer.type)
         con = TcpConnection(self, f"{sock.getpeername()[0]}:0", peer,
-                            policy, sock=sock, accepted=True)
+                            policy, sock=sock, accepted=True, comp=comp)
         with self._lock:
             if self._stop:
                 # raced shutdown(): it already swept _conns — a session

@@ -124,6 +124,10 @@ class Messenger:
         enforce it (in-process loopback peers are the same trust
         domain)."""
 
+    def set_compression(self, mode) -> None:
+        """On-wire frame compression offer; only wire stacks compress
+        (loopback/ici never serialize to a byte stream)."""
+
     def add_dispatcher_head(self, d: Dispatcher) -> None:
         with self._lock:
             self._dispatchers.insert(0, d)

@@ -49,9 +49,16 @@ from ceph_tpu.ops.crush_kernel import (
 _U32 = jnp.uint32
 _I32 = jnp.int32
 
-#: rows per grid step (TPU blocks need a 128-divisible last dim; VMEM
-#: stays small because table lookups are group-accumulated — see _lookup)
-BLOCK = 128
+#: rows per grid step (TPU blocks need a 128-divisible last dim).  512
+#: measures fastest on v5e for the bulk-mapping shapes: fewer grid steps
+#: amortize the per-step block/table traffic, and the (512, 128) slab
+#: temporaries still fit VMEM comfortably.
+BLOCK = 512
+
+#: batch rows per grid step for the candidate-filter kernels: their
+#: working set (approx bands + keys + 9 gathered operand planes) tops
+#: 16 MB VMEM at 512 rows
+CAND_BLOCK = 128
 
 
 def _bitlen_f32(v):
@@ -65,11 +72,12 @@ def _bitlen_f32(v):
 
 def _row_lookup(idx, row):
     """Per-lane table lookup: idx (B, S) i32 with values < S; row (S,)
-    i32 holding the table in its leading lanes.  Lowers to Mosaic's
+    shared table — or (B, S) per-row tables.  Lowers to Mosaic's
     tpu.dynamic_gather (take_along_axis on same-shaped 2-D operands) —
     a lane shuffle, with none of the one-hot matmul's VMEM or reshape
     trouble."""
-    x = jnp.broadcast_to(row[None, :], idx.shape)
+    x = (jnp.broadcast_to(row[None, :], idx.shape) if row.ndim == 1
+         else row)
     # raw lax.gather with i32 indices: jnp.take_along_axis promotes its
     # indices to i64 under x64, which Mosaic cannot lower.  These
     # dimension numbers are exactly the per-lane tpu.dynamic_gather
@@ -319,8 +327,363 @@ def _leaf_kernel(xs_ref, pos_ref, static_ref, rw_ref,
     _store_row(bad_ref, r, _is_out_scalar(rwv, wid, x).astype(_I32))
 
 
+# ---------------------------------------------------------------------------
+# approx-filter + packed-candidate exact verify (the fast path's fast path)
+# ---------------------------------------------------------------------------
+#
+# The exact column kernels above price every (x, item, r) triple at the
+# full ~200-op u32 pipeline.  The same certified-filter idea as
+# straw2_u32.straw2_choose_index_approx — a cheap f32 draw approximation
+# with a *measured* error bound narrows each (x, r) column to K candidate
+# items — but packed across r: all R columns' candidates (R*K <= ~40
+# rows) run through ONE exact sublane-oriented slab instead of R full
+# lane slabs.  Exactness is unconditional: any (x, r) with more than K
+# items inside the error band raises a flag and the caller re-runs the
+# exact column kernels (measured: does not fire at realistic weights).
+#
+# The ln error bound is measured against the integer crush_ln over the
+# full 16-bit domain USING THIS BACKEND'S OWN f32 log2 lowering (Mosaic's
+# approximation differs from XLA's), so the certificate holds for the
+# exact code path that runs.
+
+_K = 4
+
+
+def _ln_f32_pl(u):
+    xf = u.astype(_I32).astype(jnp.float32) + jnp.float32(1.0)
+    return jnp.log2(xf) * jnp.float32(2.0 ** 44)
+
+
+def _ln_bound_kernel(u_ref, out_ref):
+    out_ref[...] = _ln_f32_pl(u_ref[...].astype(_U32))
+
+
+@functools.lru_cache(maxsize=None)
+def _ln_f32_bound(interpret: bool) -> float:
+    """max |f32_ln(u) - crush_ln(u)| over every 16-bit u, with the f32
+    evaluated by the same Pallas lowering the filter kernel uses."""
+    from ceph_tpu.ops.crush_kernel import crush_ln
+    u = jnp.arange(65536, dtype=jnp.int32).reshape(128, 512)
+    approx = pl.pallas_call(
+        _ln_bound_kernel,
+        out_shape=jax.ShapeDtypeStruct((128, 512), jnp.float32),
+        interpret=interpret,
+    )(u)
+    exact = crush_ln(u.ravel().astype(jnp.uint32)).astype(jnp.float32)
+    return float(jnp.max(jnp.abs(approx.ravel() - exact)))
+
+
+def _approx_column(x, r, slab_ops, n_slabs, D):
+    """One cheap f32 column: per-slab (q_lo, q_hi) bands.  slab_ops(s) ->
+    (ids, wf, wz) with wf (B, 128) f32 weights, wz bool."""
+    bands = []
+    for s in range(n_slabs):
+        ids, wf, wz = slab_ops(s)
+        u = hash32_3(x[:, None], ids, r) & _U32(0xFFFF)
+        q = (jnp.float32(2.0 ** 48) - _ln_f32_pl(u)) / wf
+        # margin: measured ln bound + f32 representation of P (<= 2^25)
+        # + f32 division/weight-rounding relative error + floor-tie
+        # quantization
+        m = ((jnp.float32(D) + jnp.float32(2 ** 25)) / wf
+             + q * jnp.float32(2.0 ** -20) + jnp.float32(4.0))
+        big = jnp.float32(3.0e38)
+        q = jnp.where(wz, big, q)
+        m = jnp.where(wz, jnp.float32(0.0), m)
+        bands.append((q - m, q + m))
+    return bands
+
+
+def _sortable_f32(v):
+    """Monotone u32 key for f32 (standard float-sort transform)."""
+    bits = jax.lax.bitcast_convert_type(v, _U32)
+    neg = (bits >> 31) == _U32(1)
+    return jnp.where(neg, ~bits, bits | _U32(0x80000000))
+
+
+def _extract_candidates(bands, K):
+    """K candidate positions per row + the exactness certificate.
+
+    Selection: K rounds of a packed-key argmin (the key truncates the
+    f32 lower-bound's low 10 bits and carries the global position, so
+    one unsigned min per round yields value AND position).  The
+    certificate does not trust the selection order: after K rounds it
+    checks directly that every lane inside the error band of the
+    minimum upper bound was chosen — any miss raises the flag and the
+    caller re-runs the exact kernels.  Returns ([(B,) pos] * K, flag).
+    """
+    n_slabs = len(bands)
+    min_hi = None
+    for _lo, hi in bands:
+        h = jnp.min(hi, axis=1, keepdims=True)
+        min_hi = h if min_hi is None else jnp.minimum(min_hi, h)
+    los = [lo for lo, _ in bands]
+    orig_in_band = [lo <= min_hi for lo in los]
+    keys = []
+    for s, lo in enumerate(los):
+        b, width = lo.shape
+        gpos = (jax.lax.broadcasted_iota(_I32, (b, width), 1)
+                + _I32(s * 128)).astype(_U32)
+        keys.append((_sortable_f32(lo) & _U32(0xFFFFFC00)) | gpos)
+    chosen = [jnp.zeros_like(k, dtype=jnp.bool_) for k in keys]
+    big_key = _U32(0xFFFFFFFF)
+    positions = []
+    for _k in range(K):
+        best = None
+        for s in range(n_slabs):
+            m = _umin(keys[s], 1, False)
+            best = m if best is None else \
+                jnp.where(_ult(m, best), m, best)
+        pos = (best & _U32(0x3FF)).astype(_I32)          # (B,)
+        positions.append(pos)
+        for s in range(n_slabs):
+            b, width = keys[s].shape
+            gpos = (jax.lax.broadcasted_iota(_I32, (b, width), 1)
+                    + _I32(s * 128))
+            hit = gpos == pos[:, None]
+            keys[s] = jnp.where(hit, big_key, keys[s])
+            chosen[s] = chosen[s] | hit
+    missed = None
+    for s in range(n_slabs):
+        v = jnp.max(jnp.where(orig_in_band[s] & ~chosen[s], _I32(1),
+                              _I32(0)), axis=1)
+        missed = v if missed is None else jnp.maximum(missed, v)
+    return positions, missed
+
+
+#: candidate field order shared by the phase-1 and phase-2 kernels
+_FIELDS = ("pos", "ids", "wz", "off", "m0", "m1", "m2", "m3", "m4", "rw")
+
+#: candidate rows per column in the packed lane layout: K real
+#: candidates padded to the 8-lane segment quantum with dummies
+_KPACK = 8
+
+
+def _gather_packed(positions, row_of_slab, n_slabs):
+    """Gather one operand at all K candidate positions with ONE
+    dynamic_gather per slab: lane k of the result holds candidate k's
+    value (lanes >= K are garbage, masked later)."""
+    b = positions[0].shape[0]
+    lane = jax.lax.broadcasted_iota(_I32, (b, 128), 1)
+    gpos = jnp.zeros((b, 128), dtype=_I32)
+    for k, p in enumerate(positions):
+        gpos = jnp.where(lane == _I32(k), p[:, None], gpos)
+    out = None
+    for s in range(n_slabs):
+        local = jnp.clip(gpos - _I32(s * 128), _I32(0), _I32(127))
+        g = _row_lookup(local, row_of_slab(s))
+        in_slab = (gpos >= _I32(s * 128)) & (gpos < _I32((s + 1) * 128))
+        out = g if out is None else jnp.where(in_slab, g, out)
+    return out
+
+
+def _shift_to_segment(packed, r):
+    """Move lanes [0, KPACK) to lanes [r*KPACK, (r+1)*KPACK): a per-row
+    gather with a shifted index (garbage outside the segment, masked by
+    the caller's segment write)."""
+    b = packed.shape[0]
+    lane = jax.lax.broadcasted_iota(_I32, (b, 128), 1)
+    idx = jnp.clip(lane - (r * _I32(_KPACK))[None, None], _I32(0),
+                   _I32(127))
+    return _row_lookup(jnp.broadcast_to(idx, (b, 128)), packed)
+
+
+def _cand_root_kernel(xs_ref, ids_ref, wz_ref, wf_ref, magic_ref, off_ref,
+                      rw_ref, *out_refs, S, rh128, D):
+    """Phase 1, grid (n//B, R): approx-filter ONE root column, emit its
+    K candidates' operand fields as (KPACK, B) rows (+ the certificate
+    flag)."""
+    del rh128  # tables unused in the approx phase
+    r = pl.program_id(1)
+    x = xs_ref[0, :]
+    n_slabs = S // 128
+
+    def slab_ops(s):
+        sl = slice(s * 128, (s + 1) * 128)
+        return (ids_ref[0, sl][None, :],
+                wf_ref[0, sl][None, :],
+                wz_ref[0, sl][None, :] != 0)
+
+    bands = _approx_column(x, r.astype(_U32), slab_ops, n_slabs, D)
+    positions, missed = _extract_candidates(bands, _K)
+
+    def row_of(name):
+        def rows(s):
+            sl = slice(s * 128, (s + 1) * 128)
+            if name == "ids":
+                return ids_ref[0, sl]
+            if name == "wz":
+                return wz_ref[0, sl]
+            if name == "off":
+                return off_ref[0, sl]
+            if name == "rw":
+                return rw_ref[0, sl]
+            j = int(name[1])
+            return magic_ref[j, sl].astype(_I32)
+        return rows
+
+    _emit_fields(positions, row_of, out_refs, n_slabs, r, missed,
+                 x.shape[0])
+
+
+def _cand_leaf_kernel(xs_ref, pos_ref, static_ref, rw_ref, *out_refs,
+                      H, S, vary_r, rh128, D):
+    """Phase 1 for leaf columns: one-hot host-row fetch for this r, then
+    approx-filter + candidate emit (same output layout as root)."""
+    del rh128
+    r = pl.program_id(1)
+    if vary_r:
+        r_leaf = (r >> (vary_r - 1)).astype(_U32)
+    else:
+        r_leaf = _U32(0)
+    x = xs_ref[0, :]
+    iota_h = jax.lax.broadcasted_iota(_I32, (1, H), 1)
+    pos_r = pos_ref[pl.dslice(r, 1), :][0, :]
+    oh = jnp.where(pos_r[:, None] == iota_h, jnp.float32(1.0),
+                   jnp.float32(0.0))
+    rows = jnp.dot(oh, static_ref[...],
+                   preferred_element_type=jnp.float32,
+                   precision=jax.lax.Precision.HIGHEST)   # (B, 9*S)
+    rwrow = jnp.dot(oh, rw_ref[...],
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST)  # (B, S)
+
+    def col(base, s):
+        return rows[:, base * S + s * 128:base * S + (s + 1) * 128]
+
+    def slab_ops(s):
+        return (col(0, s).astype(_I32),
+                jnp.maximum(col(8, s), jnp.float32(1.0)),
+                col(1, s) != 0)
+
+    bands = _approx_column(x, r_leaf, slab_ops, S // 128, D)
+    positions, missed = _extract_candidates(bands, _K)
+
+    def row_of(name):
+        def rows_of(s):
+            if name == "ids":
+                return col(0, s).astype(_I32)
+            if name == "wz":
+                return col(1, s).astype(_I32)
+            if name == "off":
+                return col(2, s).astype(_I32)
+            if name == "rw":
+                return rwrow[:, s * 128:(s + 1) * 128].astype(_I32)
+            j = int(name[1])
+            return col(3 + j, s).astype(_I32)
+        return rows_of
+
+    _emit_fields(positions, row_of, out_refs, S // 128, r, missed,
+                 x.shape[0])
+
+
+def _emit_fields(positions, row_of, out_refs, n_slabs, r, missed, B):
+    """Pack the K candidates' operand fields into lanes [0, KPACK) with
+    one gather per field, shift them to this column's lane segment
+    [r*KPACK, ..), and merge into the revisited (B, 128) output blocks
+    (read-modify-write: the grid iterates r innermost, so the block
+    stays resident in VMEM across the whole lane sweep)."""
+    field_refs = out_refs[:len(_FIELDS)]
+    ovf_ref = out_refs[len(_FIELDS)]
+    lane = jax.lax.broadcasted_iota(_I32, (B, 128), 1)
+    in_seg = (lane >= (r * _I32(_KPACK))[None, None]) \
+        & (lane < ((r + 1) * _I32(_KPACK))[None, None])
+    dummies = {"pos": _I32(2 ** 31 - 1), "wz": _I32(1)}
+    for name, f_ref in zip(_FIELDS, field_refs):
+        if name == "pos":
+            packed = jnp.full((B, 128), dummies["pos"])
+            for k, p in enumerate(positions):
+                packed = jnp.where(lane == _I32(k), p[:, None], packed)
+        else:
+            packed = _gather_packed(positions, row_of(name), n_slabs)
+            # dummy padding rows (k in [K, KPACK)) must never win
+            packed = jnp.where(
+                (lane >= _I32(len(positions))) & (lane < _I32(_KPACK)),
+                dummies.get(name, _I32(0)), packed)
+        shifted = _shift_to_segment(packed, r)
+        f_ref[...] = jnp.where(in_seg, shifted, f_ref[...])
+    _store_row(ovf_ref, r, missed)
+
+
+def _verify_kernel(xs_ref, pos_ref, ids_ref, wz_ref, off_ref,
+                   m0_ref, m1_ref, m2_ref, m3_ref, m4_ref, rw_ref,
+                   rhlh_ref, ll_lo_ref, ll_hi_ref,
+                   wpos_ref, wid_ref, bad_ref,
+                   *, R, vary_r, want_bad, rh128):
+    """Phase 2, grid (n//B,): the exact pipeline over the lane-packed
+    candidate block (lane r*KPACK+k = candidate k of column r — the
+    layout phase 1 emits natively), then per-r segment winners."""
+    x = xs_ref[0, :]
+    B = x.shape[0]
+    tabs = (rhlh_ref, ll_lo_ref, ll_hi_ref, rh128)
+    lane = jax.lax.broadcasted_iota(_I32, (B, 128), 1)
+    valid = lane < _I32(R * _KPACK)
+    seg_r = lane // _I32(_KPACK)
+    if vary_r is None:
+        r_vec = jnp.where(valid, seg_r, _I32(0)).astype(_U32)
+    elif vary_r:
+        r_vec = jnp.where(valid, seg_r >> _I32(vary_r - 1),
+                          _I32(0)).astype(_U32)
+    else:
+        r_vec = jnp.zeros((B, 128), dtype=_U32)
+    ids_p = ids_ref[...]
+    wz_p = wz_ref[...]
+    off_p = off_ref[...]
+    pos_p = pos_ref[...]
+    magic_p = [m0_ref[...].astype(_U32), m1_ref[...].astype(_U32),
+               m2_ref[...].astype(_U32), m3_ref[...].astype(_U32),
+               m4_ref[...].astype(_U32)]
+    u = hash32_3(x[:, None], ids_p, r_vec) & _U32(0xFFFF)
+    p_hi, p_lo = _ln_p48_pl(u, *tabs[:3], tabs[3])
+    q_hi, q_lo = _magic_div_pl(p_hi, p_lo, magic_p, off_p)
+    bad = (wz_p != 0) | ~valid
+    q_hi = jnp.where(bad, _U32(0xFFFFFFFF), q_hi)
+    q_lo = jnp.where(bad, _U32(0xFFFFFFFF), q_lo)
+    rw_p = rw_ref[...]
+    for r in range(R):
+        m = (seg_r == _I32(r)) & valid
+        qh = jnp.where(m, q_hi, _U32(0xFFFFFFFF))
+        mh = _umin(qh, 1, True)
+        on_h = m & (qh == mh)
+        ql_m = jnp.where(on_h, q_lo, _U32(0xFFFFFFFF))
+        ml = _umin(ql_m, 1, True)
+        on = on_h & (ql_m == ml)
+        # ties resolve to the smallest ORIGINAL item position
+        pos_m = jnp.where(on, pos_p, _I32(2 ** 31 - 1))
+        minpos = jnp.min(pos_m, axis=1, keepdims=True)
+        first = on & (pos_p == minpos) & m
+        wid = jnp.sum(jnp.where(first, ids_p, _I32(0)), axis=1,
+                      dtype=_I32)
+        _store_row(wpos_ref, r, minpos[:, 0])
+        _store_row(wid_ref, r, wid)
+        if want_bad:
+            rwv = jnp.sum(jnp.where(first, rw_p, _I32(0)), axis=1,
+                          dtype=_I32)
+            _store_row(bad_ref, r,
+                       _is_out_scalar(rwv, wid, x).astype(_I32))
+        else:
+            _store_row(bad_ref, r, jnp.zeros_like(wid))
+
+
 def _pad_lanes(n: int) -> int:
     return max(128, -(-n // 128) * 128)
+
+
+def _pad_block(xs, *more):
+    """Pad 1-D xs (and the last axis of any extra arrays) to a multiple
+    of the batch block; returns (xs, padded_n, B, *more).  Small batches
+    use a lane-quantum block so tests and trickle calls don't pay the
+    bulk block's padding."""
+    n = xs.shape[0]
+    B = min(BLOCK, _pad_lanes(n))
+    pad = (-n) % B
+    if pad:
+        xs = jnp.concatenate([xs, jnp.zeros((pad,), dtype=xs.dtype)])
+        more = tuple(
+            jnp.concatenate(
+                [a, jnp.zeros((*a.shape[:-1], pad), dtype=a.dtype)],
+            axis=-1) for a in more)
+    out = (xs, n + pad, B)
+    return out + more if more else out
 
 
 @functools.lru_cache(maxsize=None)
@@ -365,6 +728,8 @@ class PallasColumns:
         self.tabs = (jnp.asarray(rh), jnp.asarray(ll_lo),
                      jnp.asarray(ll_hi))
 
+        self.root_wf = jnp.asarray(
+            np.maximum(w, 1).astype(np.float32)[None, :])
         if fr.leaf_ids is not None:
             H, S_l = fr.leaf_ids.shape
             Sp = _pad_lanes(S_l)
@@ -376,15 +741,27 @@ class PallasColumns:
             lw = np.zeros((Hp, Sp), dtype=np.int64)
             lw[:H, :S_l] = fr.leaf_w
             l_limbs, l_off = magic_tables(lw)
-            # packed static per-host fields, all exact in f32
+            # packed static per-host fields, all exact in f32 except the
+            # raw weight column (col 8), whose f32 rounding the approx
+            # filter's margin absorbs
             packed = np.concatenate([
                 lids.astype(np.float32),
                 (lw <= 0).astype(np.float32),
                 l_off.astype(np.float32),
-            ] + [l_limbs[..., j].astype(np.float32) for j in range(5)],
-                axis=1)                                # (Hp, 8*Sp)
+            ] + [l_limbs[..., j].astype(np.float32) for j in range(5)]
+              + [lw.astype(np.float32)],
+                axis=1)                                # (Hp, 9*Sp)
             self.leaf_static = jnp.asarray(packed)
             self.leaf_ids_np = lids                    # for reweight rows
+
+    @property
+    def D(self) -> float:
+        """Certified ln error bound for the approx filter — measured
+        lazily (a kernel compile + launch) since the filter is opt-in;
+        lru-cached per backend mode, and a python constant by the time
+        jit traces the filter kernels (property access runs eagerly in
+        the wrappers before pallas_call)."""
+        return _ln_f32_bound(self.interpret)
 
     @staticmethod
     def _fullspec(shape):
@@ -394,8 +771,8 @@ class PallasColumns:
 
     def root_columns(self, xs, reweight, R: int):
         """xs (N,) uint32 -> (pos, ids, bad) each (R, N) int32.
-        bad is meaningful only for flat rules (devices at level one)."""
-        n = xs.shape[0]
+        bad is meaningful only for flat rules (devices at level one).
+        Batches that are not a BLOCK multiple are zero-padded here."""
         S = self.S_root
         flat = self.fr.kind == "choose_flat"
         if flat:
@@ -403,7 +780,7 @@ class PallasColumns:
                 jnp.clip(self.root_ids[0], 0, len(reweight) - 1)][None, :]
         else:
             rw = jnp.zeros((1, S), dtype=jnp.int32)
-        B = BLOCK
+        xs, n, B = _pad_block(xs)
         grid = (n // B, R)     # r innermost: output blocks revisited
         outs = [jax.ShapeDtypeStruct((R, n), jnp.int32) for _ in range(3)]
         out_specs = [pl.BlockSpec((R, B), lambda i, r: (jnp.int32(0), i))
@@ -425,16 +802,131 @@ class PallasColumns:
           self.root_off, rw, rh, ll_lo, ll_hi)
         return pos, ids, bad
 
+    def _verify(self, xs_p, n, B, fields, R, vary_r, want_bad):
+        """Phase 2 glue: run the exact verify kernel over the (n, 128)
+        lane-packed candidate fields phase 1 emitted — no relayout
+        anywhere."""
+        del B
+        # the lane block must divide the padded batch exactly: a partial
+        # tail block would leave those winners as uninitialized garbage
+        Bv = 256 if n % 256 == 0 else 128
+        fs1 = lambda shape: pl.BlockSpec(
+            shape, lambda i: tuple(jnp.int32(0) for _ in shape),
+            memory_space=pltpu.VMEM)
+        rh, ll_lo, ll_hi = self.tabs
+        outs = [jax.ShapeDtypeStruct((R, n), jnp.int32) for _ in range(3)]
+        out_specs = [pl.BlockSpec((R, Bv), lambda i: (jnp.int32(0), i))
+                     for _ in range(3)]
+        return pl.pallas_call(
+            functools.partial(_verify_kernel, R=R, vary_r=vary_r,
+                              want_bad=want_bad, rh128=self.rh128),
+            grid=(n // Bv,),
+            out_shape=outs,
+            in_specs=[pl.BlockSpec((1, Bv), lambda i: (jnp.int32(0), i))]
+                     + [pl.BlockSpec((Bv, 128),
+                                     lambda i: (i, jnp.int32(0)))
+                        for _ in fields]
+                     + [fs1(rh.shape), fs1(ll_lo.shape), fs1(ll_hi.shape)],
+            out_specs=out_specs,
+            interpret=self.interpret,
+        )(xs_p[None, :], *fields, rh, ll_lo, ll_hi)
+
+    def root_columns_fast(self, xs, reweight, R: int):
+        """Approx-filtered root columns: (pos, ids, bad, ovf) with ovf
+        (n,) nonzero where the K-candidate certificate failed (caller
+        must re-run the exact kernels for the whole batch then)."""
+        S = self.S_root
+        flat = self.fr.kind == "choose_flat"
+        if flat:
+            rw = jnp.asarray(reweight).astype(jnp.int32)[
+                jnp.clip(self.root_ids[0], 0, len(reweight) - 1)][None, :]
+        else:
+            rw = jnp.zeros((1, S), dtype=jnp.int32)
+        xs, n, B = _pad_block(xs)
+        Bc = min(CAND_BLOCK, B)
+        fs1 = lambda shape: pl.BlockSpec(
+            shape, lambda i, r: tuple(jnp.int32(0) for _ in shape),
+            memory_space=pltpu.VMEM)
+        nf = len(_FIELDS)
+        outs = [jax.ShapeDtypeStruct((n, 128), jnp.int32)
+                for _ in range(nf)]
+        outs.append(jax.ShapeDtypeStruct((R, n), jnp.int32))
+        # candidate fields: lane-packed blocks revisited across the
+        # (innermost) r axis — phase 1 read-modify-writes its segment
+        out_specs = [pl.BlockSpec((Bc, 128), lambda i, r: (i, jnp.int32(0)))
+                     for _ in range(nf)]
+        out_specs.append(pl.BlockSpec((R, Bc), lambda i, r: (jnp.int32(0),
+                                                             i)))
+        res = pl.pallas_call(
+            functools.partial(_cand_root_kernel, S=S,
+                              rh128=self.rh128, D=self.D),
+            grid=(n // Bc, R),
+            out_shape=outs,
+            in_specs=[pl.BlockSpec((1, Bc),
+                                   lambda i, r: (jnp.int32(0), i)),
+                      fs1((1, S)), fs1((1, S)), fs1((1, S)), fs1((5, S)),
+                      fs1((1, S)), fs1((1, S))],
+            out_specs=out_specs,
+            interpret=self.interpret,
+        )(xs[None, :], self.root_ids, self.root_wz, self.root_wf,
+          self.root_magic, self.root_off, rw)
+        fields, ovf = res[:nf], res[nf]
+        pos, ids, bad = self._verify(xs, n, B, fields, R, vary_r=None,
+                                     want_bad=flat)
+        return pos, ids, bad, jnp.max(ovf, axis=0)
+
+    def leaf_columns_fast(self, xs, root_pos, reweight, R: int):
+        """Approx-filtered leaf columns: (leaf_id, leaf_bad, ovf)."""
+        rw_rows = jnp.asarray(reweight).astype(jnp.int32)[
+            jnp.clip(jnp.asarray(self.leaf_ids_np), 0,
+                     len(reweight) - 1)].astype(jnp.float32)
+        root_pos = root_pos[:, :xs.shape[0]]
+        xs, n, B, root_pos = _pad_block(xs, root_pos)
+        Bc = min(CAND_BLOCK, B)
+        fs1 = lambda shape: pl.BlockSpec(
+            shape, lambda i, r: tuple(jnp.int32(0) for _ in shape),
+            memory_space=pltpu.VMEM)
+        nf = len(_FIELDS)
+        outs = [jax.ShapeDtypeStruct((n, 128), jnp.int32)
+                for _ in range(nf)]
+        outs.append(jax.ShapeDtypeStruct((R, n), jnp.int32))
+        out_specs = [pl.BlockSpec((Bc, 128), lambda i, r: (i, jnp.int32(0)))
+                     for _ in range(nf)]
+        out_specs.append(pl.BlockSpec((R, Bc), lambda i, r: (jnp.int32(0),
+                                                             i)))
+        res = pl.pallas_call(
+            functools.partial(_cand_leaf_kernel, H=self.H, S=self.S_leaf,
+                              vary_r=self.fr.vary_r,
+                              rh128=self.rh128, D=self.D),
+            grid=(n // Bc, R),
+            out_shape=outs,
+            in_specs=[pl.BlockSpec((1, Bc),
+                                   lambda i, r: (jnp.int32(0), i)),
+                      pl.BlockSpec((R, Bc),
+                                   lambda i, r: (jnp.int32(0), i)),
+                      fs1(self.leaf_static.shape), fs1(rw_rows.shape)],
+            out_specs=out_specs,
+            interpret=self.interpret,
+        )(xs[None, :], root_pos, self.leaf_static, rw_rows)
+        fields, ovf = res[:nf], res[nf]
+        lid_pos, lid, lbad = self._verify(xs, n, B, fields, R,
+                                          vary_r=self.fr.vary_r,
+                                          want_bad=True)
+        del lid_pos
+        return lid, lbad, jnp.max(ovf, axis=0)
+
     def leaf_columns(self, xs, root_pos, reweight, R: int):
         """root winner positions -> (leaf_id, leaf_bad) each (R, N)."""
-        n = xs.shape[0]
         # reweight row per (host, slot): dynamic, built by XLA per call
         # (zero-padded slots never win the draw — wz masks them — so
         # their reweight value is irrelevant)
         rw_rows = jnp.asarray(reweight).astype(jnp.int32)[
             jnp.clip(jnp.asarray(self.leaf_ids_np), 0,
                      len(reweight) - 1)].astype(jnp.float32)
-        B = BLOCK
+        # root_pos comes back padded from root_columns; re-pad from the
+        # caller's batch width so both land on the same quantum
+        root_pos = root_pos[:, :xs.shape[0]]
+        xs, n, B, root_pos = _pad_block(xs, root_pos)
         grid = (n // B, R)
         outs = [jax.ShapeDtypeStruct((R, n), jnp.int32) for _ in range(2)]
         out_specs = [pl.BlockSpec((R, B), lambda i, r: (jnp.int32(0), i))

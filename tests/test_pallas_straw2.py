@@ -102,3 +102,30 @@ def test_pallas_flat_rule(skewed_map):
         ref_bad = np.asarray(
             is_out(rw, jnp.asarray(ref_id), xs)).astype(np.int32)
         assert (ref_bad == np.asarray(bad[r])).all()
+
+
+def test_fast_filter_columns_match_exact(skewed_map):
+    """The candidate-packed approx-filter kernels (experimental,
+    CEPH_TPU_FAST_FILTER): bit-identical to the exact column kernels
+    with a quiet certificate on skewed weights + reweights."""
+    crush_map, rid = skewed_map
+    fr = detect(crush_map, rid)
+    pc = PallasColumns(fr, interpret=True)
+    N, R = 256, 6
+    rng = np.random.default_rng(11)
+    xs = jnp.asarray(rng.integers(0, 2 ** 32, (N,), dtype=np.uint32))
+    n_osds = fr.max_devices
+    reweight = np.full(n_osds, 0x10000, dtype=np.int64)
+    reweight[rng.integers(0, n_osds, 5)] = 0
+    reweight[rng.integers(0, n_osds, 5)] = 0x4000
+    rw = jnp.asarray(reweight)
+    pos_e, ids_e, bad_e = pc.root_columns(xs, rw, R)
+    pos_f, ids_f, bad_f, ovf = pc.root_columns_fast(xs, rw, R)
+    assert int(jnp.sum(ovf)) == 0, "certificate fired on a healthy map"
+    assert (np.asarray(pos_e) == np.asarray(pos_f)).all()
+    assert (np.asarray(ids_e) == np.asarray(ids_f)).all()
+    lid_e, lbad_e = pc.leaf_columns(xs, pos_e, rw, R)
+    lid_f, lbad_f, ovf2 = pc.leaf_columns_fast(xs, pos_f, rw, R)
+    assert int(jnp.sum(ovf2)) == 0
+    assert (np.asarray(lid_e) == np.asarray(lid_f)).all()
+    assert (np.asarray(lbad_e) == np.asarray(lbad_f)).all()
