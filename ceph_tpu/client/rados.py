@@ -19,8 +19,8 @@ from ceph_tpu.messages.osd_msgs import (
     MWatchNotify, MWatchNotifyAck, OP_CALL, OP_NOTIFY, OP_UNWATCH,
     OP_WATCH)
 from ceph_tpu.messages.osd_msgs import (
-    OP_DELETE, OP_OMAP_GET, OP_OMAP_SET, OP_READ, OP_STAT, OP_WRITE,
-    OP_WRITEFULL, OSDOpField)
+    OP_DELETE, OP_OMAP_GET, OP_OMAP_RMKEYS, OP_OMAP_SET, OP_READ,
+    OP_STAT, OP_WRITE, OP_WRITEFULL, OSDOpField)
 from ceph_tpu.mon.monitor import MMonSubscribe
 from ceph_tpu.msg.encoding import Decoder, Encoder
 from ceph_tpu.msg.messenger import (
@@ -407,3 +407,10 @@ class IoCtx:
                                 [OSDOpField(OP_OMAP_GET)])
         return Decoder(r.ops[0].data).map(lambda d: d.str(),
                                           lambda d: d.bytes())
+
+    def rm_omap_keys(self, oid: str, keys: list[str]) -> None:
+        e = Encoder()
+        e.list(keys, lambda e2, k: e2.str(k))
+        self.client.operate(
+            self.pool_id, oid,
+            [OSDOpField(OP_OMAP_RMKEYS, 0, 0, e.tobytes())])

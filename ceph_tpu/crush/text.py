@@ -185,11 +185,14 @@ def compile_text(text: str) -> tuple[CrushMap, CrushNames]:
                     if op == "take":
                         target = take()
                         if peek() == "class":
-                            raise CompileError(
-                                "'step take ... class' needs the shadow "
-                                "hierarchy; not supported")
-                        steps.append(RuleStep(RULE_TAKE,
-                                              ("__name__", target)))
+                            take()
+                            cname = take()
+                            steps.append(RuleStep(
+                                RULE_TAKE,
+                                ("__name_class__", target, cname)))
+                        else:
+                            steps.append(RuleStep(RULE_TAKE,
+                                                  ("__name__", target)))
                     elif op == "emit":
                         steps.append(RuleStep(RULE_EMIT))
                     elif op in _SET_STEPS:
@@ -296,6 +299,12 @@ def compile_text(text: str) -> tuple[CrushMap, CrushNames]:
     for spec in pending:
         build(spec)
 
+    # device classes: build the shadow hierarchies (populate_classes)
+    # so class-qualified takes resolve to their shadow roots
+    if names.classes:
+        from .classes import populate_classes
+        populate_classes(m, dict(names.classes))
+
     # resolve deferred name references in rule steps
     for r in m.rules:
         if r is None:
@@ -303,6 +312,15 @@ def compile_text(text: str) -> tuple[CrushMap, CrushNames]:
         for s in r.steps:
             if isinstance(s.arg1, tuple) and s.arg1[0] == "__name__":
                 s.arg1 = names.item_id(s.arg1[1])
+            elif isinstance(s.arg1, tuple) \
+                    and s.arg1[0] == "__name_class__":
+                orig = names.item_id(s.arg1[1])
+                shadow = m.class_bucket.get((orig, s.arg1[2]))
+                if shadow is None:
+                    raise CompileError(
+                        f"no devices of class {s.arg1[2]!r} under "
+                        f"{s.arg1[1]!r}")
+                s.arg1 = shadow
             if isinstance(s.arg2, tuple) and s.arg2[0] == "__type__":
                 s.arg2 = names.type_id(s.arg2[1])
     return m, names
@@ -350,8 +368,12 @@ def decompile(m: CrushMap, names: CrushNames | None = None) -> str:
     for t in sorted(tids):
         out.append(f"type {t} {tname(t)}")
     out.append("\n# buckets")
-    # children before parents (the compiler requires it)
-    emitted: set[int] = set()
+    # children before parents (the compiler requires it); shadow buckets
+    # (device-class clones) are not listed — crushtool hides them and
+    # the compiler rebuilds them from the device class tags
+    from .classes import shadow_to_class
+    shadows = shadow_to_class(m)
+    emitted: set[int] = set(shadows)
 
     def emit_bucket(b) -> None:
         if b is None or b.id in emitted:
@@ -385,7 +407,12 @@ def decompile(m: CrushMap, names: CrushNames | None = None) -> str:
         out.append(f"\tmax_size {r.max_size}")
         for s in r.steps:
             if s.op == RULE_TAKE:
-                out.append(f"\tstep take {iname(s.arg1)}")
+                if s.arg1 in shadows:
+                    orig, cname = shadows[s.arg1]
+                    out.append(f"\tstep take {iname(orig)} "
+                               f"class {cname}")
+                else:
+                    out.append(f"\tstep take {iname(s.arg1)}")
             elif s.op == RULE_EMIT:
                 out.append("\tstep emit")
             elif s.op in _SET_NAMES:

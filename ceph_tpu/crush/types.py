@@ -19,6 +19,10 @@ CRUSH_BUCKET_STRAW2 = 5
 CRUSH_ITEM_UNDEF = 0x7FFFFFFE
 CRUSH_ITEM_NONE = 0x7FFFFFFF
 
+# device classes: shadow-bucket table (CrushWrapper class_bucket) keyed
+# (original bucket id, class name) -> shadow bucket id; see
+# crush/classes.py
+
 RULE_NOOP = 0
 RULE_TAKE = 1
 RULE_CHOOSE_FIRSTN = 2
@@ -115,6 +119,9 @@ class CrushMap:
     tunables: Tunables = field(default_factory=Tunables)
     # choose_args: name -> {bucket_index: ChooseArg}
     choose_args: dict = field(default_factory=dict)
+    #: device-class shadow buckets: (orig bucket id, class) -> shadow id
+    #: (CrushWrapper class_bucket; built by crush.classes)
+    class_bucket: dict = field(default_factory=dict)
 
     @property
     def max_buckets(self) -> int:

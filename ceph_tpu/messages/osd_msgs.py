@@ -24,6 +24,7 @@ OP_WATCH = 8          # register this client for notifies on the object
 OP_UNWATCH = 9
 OP_NOTIFY = 10        # fan a payload out to every watcher, wait for acks
 OP_CALL = 11          # in-OSD object class method (cls\0method\0input)
+OP_OMAP_RMKEYS = 12   # remove omap keys (Encoder str list in data)
 
 
 @dataclass

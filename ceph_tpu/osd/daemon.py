@@ -48,7 +48,8 @@ from ceph_tpu.messages import (
     MOSDECSubOpWriteReply, MOSDFailure, MOSDMapMsg, MOSDOp, MOSDOpReply,
     MOSDPing, MOSDRepOp, MOSDRepOpReply)
 from ceph_tpu.messages.osd_msgs import (
-    OP_CALL, OP_DELETE, OP_NOTIFY, OP_OMAP_GET, OP_OMAP_SET, OP_READ,
+    OP_CALL, OP_DELETE, OP_NOTIFY, OP_OMAP_GET, OP_OMAP_RMKEYS,
+    OP_OMAP_SET, OP_READ,
     OP_STAT, OP_UNWATCH, OP_WATCH, OP_WRITE, OP_WRITEFULL, MOSDScrub,
     MOSDScrubReply, MWatchNotify, MWatchNotifyAck, OSDOpField)
 from ceph_tpu.messages.peering_msgs import MOSDPGLog, MOSDPGNotify, MOSDPGQuery
@@ -1243,7 +1244,8 @@ class OSDDaemon(Dispatcher):
                     msg._trk.finish()
                 return
             is_write = any(op.op in (OP_WRITE, OP_WRITEFULL, OP_DELETE,
-                                     OP_OMAP_SET) for op in msg.ops)
+                                     OP_OMAP_SET, OP_OMAP_RMKEYS)
+                           for op in msg.ops)
             if self._blocked_on_recovery(pg, msg.oid, is_write,
                                          pool.is_erasure()):
                 msg._trk.mark_event("waiting for missing object")
@@ -1362,6 +1364,11 @@ class OSDDaemon(Dispatcher):
                 keys = _decode_omap(op.data)
                 t.touch(cid, msg.oid)
                 t.omap_setkeys(cid, msg.oid, keys)
+            elif op.op == OP_OMAP_RMKEYS:
+                is_write = True
+                is_delete = False
+                t.omap_rmkeys(cid, msg.oid,
+                              Decoder(op.data).list(lambda d: d.str()))
             elif op.op == OP_READ:
                 try:
                     src_oid = msg.oid

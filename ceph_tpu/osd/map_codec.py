@@ -68,8 +68,12 @@ def encode_crush(m: CrushMap, enc: Encoder) -> None:
         # choose_args ids are s64 in the reference (CrushWrapper.h:72);
         # v1 encoded them as strings, hence the struct version bump
         e.map(m.choose_args, lambda e2, k: e2.s64(int(k)), enc_choose_args)
+        # v3: device-class shadow table (CrushWrapper class_bucket)
+        e.map(m.class_bucket,
+              lambda e2, k: (e2.s32(k[0]), e2.str(k[1])),
+              lambda e2, v: e2.s32(v))
 
-    enc.versioned(2, 1, body)
+    enc.versioned(3, 1, body)
 
 
 def decode_crush(dec: Decoder) -> CrushMap:
@@ -127,11 +131,16 @@ def decode_crush(dec: Decoder) -> CrushMap:
             choose_args = {
                 int(k) if k.lstrip("-").isdigit() else k: v
                 for k, v in raw.items()}
+        class_bucket = {}
+        if version >= 3:
+            class_bucket = d.map(lambda d2: (d2.s32(), d2.str()),
+                                 lambda d2: d2.s32())
         m = CrushMap(buckets=buckets, rules=rules, max_devices=max_devices,
-                     tunables=t, choose_args=choose_args)
+                     tunables=t, choose_args=choose_args,
+                     class_bucket=class_bucket)
         return m
 
-    return dec.versioned(2, body)
+    return dec.versioned(3, body)
 
 
 # -- osdmap -----------------------------------------------------------------

@@ -96,16 +96,20 @@ class StripedObject:
         if offset + len(data) > self.size():
             self._set_size(offset + len(data))
 
-    def read(self, offset: int = 0, length: int = 0) -> bytes:
+    def read(self, offset: int = 0, length: int = 0,
+             snapid: int = 0) -> bytes:
+        """snapid reads each backing object as of that pool snapshot
+        (librados snap_set analog); pass an explicit length then — the
+        size object reflects the CURRENT size, not the snap's."""
         total = self.size()
-        if length <= 0 or offset + length > total:
+        if length <= 0 or offset + length > total and not snapid:
             length = max(0, total - offset)
         parts = []
         for objno, obj_off, n in self.layout.extents(offset, length):
             try:
                 chunk = self.io.read(
                     self.striper.object_name(self.name, objno),
-                    length=n, offset=obj_off)
+                    length=n, offset=obj_off, snapid=snapid)
             except OSError:
                 chunk = b""
             if len(chunk) < n:          # sparse hole: zero-fill

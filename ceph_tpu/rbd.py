@@ -3,8 +3,18 @@
 layout; header object + rbd_data.<id>.<objno> data objects).
 
 An image is a fixed-size virtual block device: create/open/read/write
-at arbitrary byte offsets, resize, stat, remove, plus snapshot
-read-back riding the pool-snapshot machinery underneath.
+at arbitrary byte offsets, resize, stat, remove.  On top of the basic
+I/O path:
+
+  * rbd_directory — pool-level image registry (librbd's rbd_directory
+    omap object), so `list_images` needs no name probes
+  * exclusive lock — the managed lock over the cls lock object class
+    on the header (librbd ManagedLock/ExclusiveLock): acquire/release/
+    break, and writes refuse while another owner holds it
+  * snapshots — snap_create/list/remove/rollback + read(snap=...),
+    riding pool snapshots namespaced per image (`rbd.<image>.<snap>`),
+    with the image size frozen in the header's snap table
+  * clone — flatten-style copy of a snapshot into a new image
 """
 
 from __future__ import annotations
@@ -12,6 +22,8 @@ from __future__ import annotations
 import json
 
 from ceph_tpu.osdc.striper import StripeLayout, StripedObject
+
+RBD_DIRECTORY = "rbd_directory"
 
 
 class Image:
@@ -40,8 +52,9 @@ class Image:
             raise FileExistsError(f"image {name!r} exists")
         meta = {"size": size, "order": order,
                 "stripe_unit": stripe_unit,
-                "stripe_count": stripe_count}
+                "stripe_count": stripe_count, "snaps": {}}
         ioctx.write_full(header, json.dumps(meta).encode())
+        ioctx.set_omap(RBD_DIRECTORY, {name: b"1"})
         img = cls(ioctx, name)
         img._meta = meta
         return img
@@ -72,20 +85,139 @@ class Image:
         m = self._load()
         if offset + len(data) > m["size"]:
             raise ValueError("write past end of image")
+        self._check_lock()
         self._striped().write(data, offset)
         return len(data)
 
-    def read(self, offset: int = 0, length: int = 0) -> bytes:
+    def read(self, offset: int = 0, length: int = 0,
+             snap: str | None = None) -> bytes:
         m = self._load()
-        if length <= 0 or offset + length > m["size"]:
-            length = max(0, m["size"] - offset)
-        data = self._striped().read(offset, length)
+        snapid = 0
+        size = m["size"]
+        if snap is not None:
+            ent = m.get("snaps", {}).get(snap)
+            if ent is None:
+                raise KeyError(f"no snapshot {snap!r}")
+            snapid, size = ent["snapid"], ent["size"]
+        if length <= 0 or offset + length > size:
+            length = max(0, size - offset)
+        data = self._striped().read(offset, length, snapid=snapid)
         if len(data) < length:      # unwritten space reads as zeros
             data = data + bytes(length - len(data))
         return data
 
+    # -- exclusive lock (librbd ManagedLock over cls lock) --------------------
+
+    def _header(self) -> str:
+        return self.HEADER_FMT.format(name=self.name)
+
+    def lock_acquire(self, owner: str) -> None:
+        self.io.execute(self._header(), "lock", "lock",
+                        json.dumps({"owner": owner}).encode())
+        self._owner = owner
+
+    def lock_release(self, owner: str | None = None) -> None:
+        self.io.execute(self._header(), "lock", "unlock",
+                        json.dumps({"owner": owner
+                                    or getattr(self, "_owner",
+                                               None)}).encode())
+        self._owner = None
+
+    def lock_info(self) -> dict:
+        return json.loads(self.io.execute(self._header(), "lock", "info"))
+
+    def break_lock(self) -> None:
+        """Steal a dead client's lock (rbd lock break)."""
+        holder = self.lock_info().get("holder")
+        if holder:
+            self.io.execute(self._header(), "lock", "unlock",
+                            json.dumps({"owner": holder}).encode())
+
+    def _check_lock(self) -> None:
+        """Writes respect an exclusive lock held by another owner.  A
+        handle that holds the lock itself skips the round trip (its
+        ownership stands until it releases; a concurrent break_lock is
+        the operator declaring this writer dead, as in the reference,
+        where the broken client is blocklisted).  Any other handle pays
+        one lock_info per write — correctness over latency here."""
+        if getattr(self, "_owner", None) is not None:
+            return
+        try:
+            holder = self.lock_info().get("holder")
+        except OSError:
+            holder = None
+        if holder is not None:
+            raise OSError(16, f"image locked by {holder!r}")  # EBUSY
+
+    # -- snapshots (pool snaps namespaced per image) --------------------------
+
+    def _save_meta(self, m: dict) -> None:
+        self.io.write_full(self._header(), json.dumps(m).encode())
+        self._meta = m
+
+    def snap_create(self, snap: str) -> int:
+        m = self._load()
+        if snap in m.get("snaps", {}):
+            raise FileExistsError(f"snapshot {snap!r} exists")
+        rc, out = self.io.client.mon_command({
+            "prefix": "osd pool mksnap", "pool": self.io.pool_id,
+            "snap": f"rbd.{self.name}.{snap}"})
+        if rc != 0:
+            raise OSError(-rc or 5, out)
+        snapid = json.loads(out)["snapid"]
+        m.setdefault("snaps", {})[snap] = {"snapid": snapid,
+                                           "size": m["size"]}
+        self._save_meta(m)
+        return snapid
+
+    def snap_list(self) -> dict:
+        return dict(self._load().get("snaps", {}))
+
+    def snap_remove(self, snap: str) -> None:
+        m = self._load()
+        if snap not in m.get("snaps", {}):
+            raise KeyError(f"no snapshot {snap!r}")
+        rc, out = self.io.client.mon_command({
+            "prefix": "osd pool rmsnap", "pool": self.io.pool_id,
+            "snap": f"rbd.{self.name}.{snap}"})
+        if rc != 0:
+            raise OSError(-rc or 5, out)
+        del m["snaps"][snap]
+        self._save_meta(m)
+
+    def snap_rollback(self, snap: str) -> None:
+        """Restore image content to the snapshot (rbd snap rollback —
+        object-by-object copy-back, librbd's simple_rollback)."""
+        m = self._load()
+        ent = m.get("snaps", {}).get(snap)
+        if ent is None:
+            raise KeyError(f"no snapshot {snap!r}")
+        self._check_lock()
+        data = self.read(0, ent["size"], snap=snap)
+        st = self._striped()
+        st.truncate(0)
+        st.write(data, 0)
+        m["size"] = ent["size"]
+        self._save_meta(m)
+
+    def clone(self, dst_name: str, snap: str) -> "Image":
+        """Copy a snapshot into a new image (clone + immediate flatten:
+        the lite model has no parent/child overlay chain)."""
+        m = self._load()
+        ent = m.get("snaps", {}).get(snap)
+        if ent is None:
+            raise KeyError(f"no snapshot {snap!r}")
+        dst = Image.create(self.io, dst_name, size=ent["size"],
+                           order=m["order"], stripe_unit=m["stripe_unit"],
+                           stripe_count=m["stripe_count"])
+        data = self.read(0, ent["size"], snap=snap)
+        if data.rstrip(b"\x00"):
+            dst.write(data, 0)
+        return dst
+
     def resize(self, new_size: int) -> None:
         m = self._load()
+        self._check_lock()
         if new_size < m["size"]:
             # shrink trims the discarded extent (real rbd semantics):
             # growing back later must read zeros, not stale payload
@@ -95,22 +227,37 @@ class Image:
                            json.dumps(m).encode())
 
     def remove(self) -> None:
+        # librbd refuses removal while snapshots exist: the pool snaps
+        # are only reachable through this header's name->snapid table
+        if self._load().get("snaps"):
+            raise OSError(16, "image has snapshots (remove them first)")
         self._striped().remove()
         try:
             self.io.remove(self.HEADER_FMT.format(name=self.name))
         except OSError:
             pass
+        try:
+            self.io.rm_omap_keys(RBD_DIRECTORY, [self.name])
+        except OSError:
+            pass
         self._meta = None
 
 
-def list_images(ioctx, probe: list[str]) -> list[str]:
-    """Images among candidate names (no pool listing primitive yet —
-    the reference keeps an rbd_directory object; callers track names)."""
-    out = []
-    for name in probe:
+def list_images(ioctx, probe: list[str] | None = None) -> list[str]:
+    """Pool image listing from the rbd_directory omap object, unioned
+    with probe hits (legacy images created before the directory existed
+    still appear, even once the directory object does)."""
+    found = set()
+    try:
+        found.update(ioctx.get_omap(RBD_DIRECTORY))
+    except OSError:
+        pass
+    for name in probe or []:
+        if name in found:
+            continue
         try:
             ioctx.stat(Image.HEADER_FMT.format(name=name))
-            out.append(name)
+            found.add(name)
         except OSError:
             continue
-    return out
+    return sorted(found)

@@ -133,9 +133,10 @@ class TestCompile:
         with pytest.raises(CompileError):
             compile_text("host h { id -1 alg warp hash 0 }\ntype 1 host")
         with pytest.raises(CompileError):
+            # a class no device carries has no shadow tree to take
             compile_text(SAMPLE + "\nrule bad { id 9 type replicated "
                          "min_size 1 max_size 10 "
-                         "step take default class hdd step emit }")
+                         "step take default class nvme step emit }")
         with pytest.raises((CompileError, ValueError)):
             compile_text("rule r { id 0 type replicated min_size 1 "
                          "max_size 10 step take nonexistent step emit }")
@@ -365,3 +366,117 @@ class TestBootAfterSetcrushmap:
             assert 6 in hist, "booted osd receives no placements"
         finally:
             c.stop()
+
+
+CLASS_RULES = """
+rule ssd_rule {
+    id 2
+    type replicated
+    min_size 1
+    max_size 10
+    step take default class ssd
+    step chooseleaf firstn 0 type host
+    step emit
+}
+rule hdd_rule {
+    id 3
+    type replicated
+    min_size 1
+    max_size 10
+    step take default class hdd
+    step choose firstn 0 type osd
+    step emit
+}
+"""
+
+
+class TestDeviceClasses:
+    """Shadow hierarchies (CrushWrapper populate_classes /
+    device_class_clone): class-qualified takes place only on devices of
+    that class, with the mapper itself class-unaware."""
+
+    def _compile(self):
+        text = SAMPLE.replace("# end crush map", CLASS_RULES
+                              + "\n# end crush map")
+        return compile_text(text)
+
+    def test_shadow_trees_built(self):
+        m, names = self._compile()
+        assert (-1, "ssd") in m.class_bucket
+        assert (-1, "hdd") in m.class_bucket
+        ssd_root = m.bucket(m.class_bucket[(-1, "ssd")])
+        # only node-b holds ssd devices; empty shadows dropped from items
+        assert len(ssd_root.items) == 1
+        ssd_host = m.bucket(ssd_root.items[0])
+        assert sorted(ssd_host.items) == [2, 3]
+        hdd_root = m.bucket(m.class_bucket[(-1, "hdd")])
+        hdd_devs = set()
+        for h in hdd_root.items:
+            hdd_devs.update(m.bucket(h).items)
+        assert hdd_devs == {0, 1, 4, 5}
+        # weights recompute bottom-up: hdd shadow skips the 2 ssd osds
+        assert hdd_root.weight == 5 * 0x10000
+
+    def test_class_rules_place_only_in_class(self):
+        m, _names = self._compile()
+        rw = [0x10000] * 6
+        for x in range(128):
+            out = crush_do_rule(m, 2, x, 2, rw)
+            assert out and set(out) <= {2, 3}, out
+            out = crush_do_rule(m, 3, x, 3, rw)
+            assert out and set(out) <= {0, 1, 4, 5}, out
+
+    def test_batched_mapper_class_rule(self):
+        """The TPU kernels need no class awareness: shadow trees are
+        ordinary buckets."""
+        import jax.numpy as jnp
+        import numpy as np
+        from ceph_tpu.crush.mapper_jax import BatchMapper
+        m, _names = self._compile()
+        bm = BatchMapper(m)
+        xs = jnp.asarray(np.arange(256, dtype=np.uint32))
+        rw = jnp.asarray(np.full(6, 0x10000, dtype=np.int64))
+        out = np.asarray(bm.do_rule(3, xs, 3, rw))
+        valid = out[out >= 0]
+        assert set(valid.tolist()) <= {0, 1, 4, 5}
+        for x in range(0, 256, 17):
+            ref = crush_do_rule(m, 3, x, 3, [0x10000] * 6)
+            got = [o for o in out[x] if o >= 0]
+            assert got == ref
+
+    def test_decompile_roundtrip_with_classes(self):
+        m, names = self._compile()
+        text2 = decompile(m, names)
+        assert "step take default class ssd" in text2
+        assert "step take default class hdd" in text2
+        # shadow buckets are hidden from the text form
+        assert text2.count("root default {") == 1
+        m2, names2 = compile_text(text2)
+        rw = [0x10000] * 6
+        for x in range(64):
+            assert crush_do_rule(m, 2, x, 2, rw) == \
+                crush_do_rule(m2, 2, x, 2, rw)
+            assert crush_do_rule(m, 3, x, 3, rw) == \
+                crush_do_rule(m2, 3, x, 3, rw)
+
+    def test_unknown_class_errors(self):
+        text = SAMPLE.replace(
+            "# end crush map",
+            "rule bad { id 2\n type replicated\n min_size 1\n"
+            " max_size 10\n step take default class nvme\n"
+            " step emit\n}\n# end crush map")
+        with pytest.raises(CompileError):
+            compile_text(text)
+
+    def test_codec_roundtrip_with_classes(self):
+        from ceph_tpu.msg.encoding import Decoder, Encoder
+        from ceph_tpu.osd.map_codec import decode_crush, encode_crush
+        m, _names = self._compile()
+        e = Encoder()
+        encode_crush(m, e)
+        m2 = decode_crush(Decoder(e.tobytes()))
+        assert m2.class_bucket == m.class_bucket
+        rw = [0x10000] * 6
+        for x in range(32):
+            assert crush_do_rule(m, 2, x, 2, rw) == \
+                crush_do_rule(m2, 2, x, 2, rw)

@@ -267,3 +267,123 @@ class TestIciStack:
         buf = t._bufs[int.from_bytes(token[5:], "little")]
         assert buf.devices() == {jax.devices()[1]}
         assert t.redeem(token) == b"x" * 4096
+
+
+class TestRbdAdvanced:
+    """rbd_directory, exclusive lock, snapshots, clone — the librbd
+    feature tier over the lite image."""
+
+    def test_directory_listing(self, io):
+        from ceph_tpu.rbd import Image, list_images
+        a = Image.create(io, "dir-a", size=1 << 16, order=16)
+        b = Image.create(io, "dir-b", size=1 << 16, order=16)
+        assert list_images(io) == ["dir-a", "dir-b"]
+        a.remove()
+        assert list_images(io) == ["dir-b"]
+        b.remove()
+        assert list_images(io) == []
+
+    def test_exclusive_lock(self, io):
+        import pytest
+        from ceph_tpu.rbd import Image
+        img = Image.create(io, "locked-img", size=1 << 16, order=16)
+        img.lock_acquire("writer-1")
+        img.write(b"mine", 0)   # owner writes fine
+        # a second handle must be refused
+        other = Image(io, "locked-img")
+        with pytest.raises(OSError) as ei:
+            other.write(b"stolen", 0)
+        assert ei.value.errno == 16
+        with pytest.raises(OSError):
+            other.resize(1 << 17)
+        # lock break lets the second handle take over
+        other.break_lock()
+        other.lock_acquire("writer-2")
+        other.write(b"taken", 0)
+        assert img.read(0, 5) == b"taken"
+        other.lock_release()
+        img.remove()
+
+    def test_snapshots_and_clone(self, io):
+        import pytest
+        from ceph_tpu.rbd import Image
+        img = Image.create(io, "snappy", size=1 << 16, order=16)
+        img.write(b"version-one", 0)
+        img.snap_create("v1")
+        img.write(b"VERSION-TWO", 0)
+        assert img.read(0, 11) == b"VERSION-TWO"
+        assert img.read(0, 11, snap="v1") == b"version-one"
+        assert "v1" in img.snap_list()
+        # clone from the snapshot sees v1 content, detached from src
+        c = img.clone("snappy-clone", "v1")
+        assert c.read(0, 11) == b"version-one"
+        c.write(b"clone-write", 0)
+        assert img.read(0, 11, snap="v1") == b"version-one"
+        # rollback restores v1 on the source
+        img.snap_rollback("v1")
+        assert img.read(0, 11) == b"version-one"
+        img.snap_remove("v1")
+        with pytest.raises(KeyError):
+            img.read(0, 4, snap="v1")
+        c.remove()
+        img.remove()
+
+
+class TestRbdReviewRegressions:
+    def test_lock_enforced_against_prior_writer(self, io):
+        """A handle that wrote before the lock existed must be refused
+        after another owner acquires it (no stale positive cache)."""
+        import pytest
+        from ceph_tpu.rbd import Image
+        img = Image.create(io, "cache-img", size=1 << 16, order=16)
+        img.write(b"pre-lock", 0)     # writes while unlocked
+        other = Image(io, "cache-img")
+        other.lock_acquire("B")
+        with pytest.raises(OSError):
+            img.write(b"post-lock", 0)
+        other.lock_release()
+        img.write(b"unlocked-again", 0)
+        img.remove()
+
+    def test_remove_refuses_with_snapshots(self, io):
+        import pytest
+        from ceph_tpu.rbd import Image
+        img = Image.create(io, "snapped", size=1 << 16, order=16)
+        img.write(b"x", 0)
+        img.snap_create("keep")
+        with pytest.raises(OSError):
+            img.remove()
+        img.snap_remove("keep")
+        img.remove()
+
+    def test_rm_omap_keys_with_newline_in_key(self, io):
+        io.write_full("omapped", b"")
+        io.set_omap("omapped", {"a\nb": b"1", "a": b"2", "b": b"3"})
+        io.rm_omap_keys("omapped", ["a\nb"])
+        assert io.get_omap("omapped") == {"a": b"2", "b": b"3"}
+
+    def test_list_images_merges_probe_hits(self, io):
+        import json as _json
+        from ceph_tpu.rbd import Image, list_images
+        # legacy image: header exists, no directory entry
+        io.write_full(Image.HEADER_FMT.format(name="legacy"),
+                      _json.dumps({"size": 16, "order": 16,
+                                   "stripe_unit": 1 << 16,
+                                   "stripe_count": 4,
+                                   "snaps": {}}).encode())
+        img = Image.create(io, "modern", size=1 << 16, order=16)
+        assert list_images(io, probe=["legacy"]) == ["legacy", "modern"]
+        img.remove()
+
+
+def test_populate_classes_idempotent():
+    from ceph_tpu.crush import build_two_level_map
+    from ceph_tpu.crush.classes import populate_classes
+    m, _root, _rid = build_two_level_map(4, 2)
+    dc = {i: ("ssd" if i % 2 else "hdd") for i in range(8)}
+    populate_classes(m, dc)
+    n_buckets = sum(1 for b in m.buckets if b is not None)
+    table = dict(m.class_bucket)
+    populate_classes(m, dc)   # refresh must not clone shadows-of-shadows
+    assert sum(1 for b in m.buckets if b is not None) == n_buckets
+    assert set(table) == set(m.class_bucket)
