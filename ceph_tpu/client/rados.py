@@ -89,8 +89,17 @@ def ceph_str_hash_rjenkins(s: bytes | str) -> int:
 
 
 class _Waiter:
-    def __init__(self, msg: MOSDOp):
+    def __init__(self, msg: MOSDOp, base_pool: int, is_write: bool,
+                 direct: bool = False):
         self.msg = msg
+        #: the pool the caller named — retargeting re-applies any
+        #: cache-tier overlay from this, not from a prior redirect
+        self.base_pool = base_pool
+        self.is_write = is_write
+        #: bypass cache-tier overlays (the tier agent's own I/O must
+        #: reach the pool it names, or flushes would loop back into
+        #: the cache and evict would destroy the only copy)
+        self.direct = direct
         self.event = threading.Event()
         self.reply: MOSDOpReply | None = None
 
@@ -269,10 +278,18 @@ class RadosClient(Dispatcher):
 
     # -- objecter -------------------------------------------------------------
 
-    def _calc_target(self, pool_id: int, oid: str) -> tuple[tuple[int, int],
-                                                            int]:
-        """osdc/Objecter.cc:2795 — object -> pg -> primary, client side."""
+    def _calc_target(self, pool_id: int, oid: str,
+                     is_write: bool = False,
+                     direct: bool = False) -> tuple[tuple[int, int],
+                                                    int]:
+        """osdc/Objecter.cc:2795 — object -> pg -> primary, client side.
+        Cache-tier overlays redirect here (Objecter _calc_target honors
+        pool.read_tier/write_tier): ops aimed at the base pool land on
+        the cache pool instead; the cache OSD promotes/flushes."""
         pool = self.osdmap.pools[pool_id]
+        tier = pool.write_tier if is_write else pool.read_tier
+        if not direct and tier >= 0 and tier in self.osdmap.pools:
+            pool_id, pool = tier, self.osdmap.pools[tier]
         ps = ceph_str_hash_rjenkins(oid)
         # reduce to the pg first (raw_pg_to_pg), THEN place — the osd receives
         # the reduced pg and must compute the identical mapping
@@ -282,7 +299,8 @@ class RadosClient(Dispatcher):
         return (pool_id, pgid), acting_primary
 
     def _send_op(self, w: _Waiter) -> None:
-        pgid, primary = self._calc_target(w.msg.pgid[0], w.msg.oid)
+        pgid, primary = self._calc_target(w.base_pool, w.msg.oid,
+                                          w.is_write, w.direct)
         w.msg.pgid = pgid
         w.msg.epoch = self.osdmap.epoch
         if primary == CEPH_NOSD:
@@ -292,24 +310,29 @@ class RadosClient(Dispatcher):
         con.send_message(w.msg)
 
     def aio_operate(self, pool_id: int, oid: str, ops: list[OSDOpField],
-                    snapid: int = 0) -> "AioCompletion":
+                    snapid: int = 0,
+                    direct: bool = False) -> "AioCompletion":
         """Submit without blocking (librados aio_*): returns a completion
         the caller waits on.  In-flight completions resend on map change
         like synchronous ops."""
+        is_write = any(op.op in (OP_WRITE, OP_WRITEFULL, OP_DELETE,
+                                 OP_OMAP_SET, OP_OMAP_RMKEYS)
+                       for op in ops)
         with self._lock:
             tid = self._next_tid
             self._next_tid += 1
             msg = MOSDOp(client_id=self.client_id, tid=tid,
                          pgid=(pool_id, 0), oid=oid, ops=ops,
                          epoch=self.osdmap.epoch, snapid=snapid)
-            w = _Waiter(msg)
+            w = _Waiter(msg, pool_id, is_write, direct)
             self._waiters[tid] = w
         self._send_op(w)
         return AioCompletion(self, tid, w)
 
     def operate(self, pool_id: int, oid: str, ops: list[OSDOpField],
-                snapid: int = 0) -> MOSDOpReply:
-        c = self.aio_operate(pool_id, oid, ops, snapid=snapid)
+                snapid: int = 0, direct: bool = False) -> MOSDOpReply:
+        c = self.aio_operate(pool_id, oid, ops, snapid=snapid,
+                             direct=direct)
         if not c.wait_for_complete(self.timeout):
             c.cancel()
             raise TimeoutError(f"op {c.tid} on {oid} timed out")
@@ -322,8 +345,8 @@ class RadosClient(Dispatcher):
     def pool_id_by_name(self, name_or_id) -> int:
         return int(name_or_id)
 
-    def open_ioctx(self, pool_id: int) -> "IoCtx":
-        return IoCtx(self, int(pool_id))
+    def open_ioctx(self, pool_id: int, direct: bool = False) -> "IoCtx":
+        return IoCtx(self, int(pool_id), direct=direct)
 
 
 def _is_tcp(msgr) -> bool:
@@ -334,83 +357,92 @@ def _is_tcp(msgr) -> bool:
 class IoCtx:
     """Pool I/O handle (librados IoCtx)."""
 
-    def __init__(self, client: RadosClient, pool_id: int):
+    def __init__(self, client: RadosClient, pool_id: int,
+                 direct: bool = False):
         self.client = client
         self.pool_id = pool_id
+        #: bypass cache-tier overlays (tier-agent internal I/O)
+        self.direct = direct
+
+    def _op(self, oid, ops, snapid=0):
+        return self.client.operate(self.pool_id, oid, ops,
+                                   snapid=snapid, direct=self.direct)
 
     def write_full(self, oid: str, data: bytes) -> None:
-        self.client.operate(self.pool_id, oid,
-                            [OSDOpField(OP_WRITEFULL, 0, len(data), data)])
+        self._op(oid, [OSDOpField(OP_WRITEFULL, 0, len(data), data)])
 
     def aio_write_full(self, oid: str, data: bytes) -> "AioCompletion":
         return self.client.aio_operate(
             self.pool_id, oid, [OSDOpField(OP_WRITEFULL, 0, len(data),
-                                           data)])
+                                           data)], direct=self.direct)
 
     def aio_read(self, oid: str, length: int = 0,
                  offset: int = 0) -> "AioCompletion":
         return self.client.aio_operate(
-            self.pool_id, oid, [OSDOpField(OP_READ, offset, length)])
+            self.pool_id, oid, [OSDOpField(OP_READ, offset, length)],
+            direct=self.direct)
 
     def write(self, oid: str, data: bytes, offset: int = 0) -> None:
-        self.client.operate(self.pool_id, oid,
-                            [OSDOpField(OP_WRITE, offset, len(data), data)])
+        self._op(oid, [OSDOpField(OP_WRITE, offset, len(data), data)])
 
     def read(self, oid: str, length: int = 0, offset: int = 0,
              snapid: int = 0) -> bytes:
-        r = self.client.operate(self.pool_id, oid,
-                                [OSDOpField(OP_READ, offset, length)],
-                                snapid=snapid)
+        r = self._op(oid, [OSDOpField(OP_READ, offset, length)],
+                     snapid=snapid)
         return r.ops[0].data if r.ops else b""
+
+    def _watch_keys(self, oid: str) -> list[tuple]:
+        """A cache-tier overlay redirects the watch to the cache pool,
+        whose OSD sends notifies stamped with ITS pool id — register
+        the callback under both keys so the lookup hits either way."""
+        keys = [(self.pool_id, oid)]
+        pool = self.client.osdmap.pools.get(self.pool_id)
+        if pool is not None and not self.direct and pool.write_tier >= 0:
+            keys.append((pool.write_tier, oid))
+        return keys
 
     def watch(self, oid: str, callback) -> None:
         """Register for notifies on the object (librados watch; the
         callback runs on the client's dispatch thread)."""
-        self.client._watch_cbs[(self.pool_id, oid)] = callback
-        self.client.operate(self.pool_id, oid,
-                            [OSDOpField(OP_WATCH, 0, 0)])
+        for k in self._watch_keys(oid):
+            self.client._watch_cbs[k] = callback
+        self._op(oid, [OSDOpField(OP_WATCH, 0, 0)])
 
     def unwatch(self, oid: str) -> None:
-        self.client._watch_cbs.pop((self.pool_id, oid), None)
-        self.client.operate(self.pool_id, oid,
-                            [OSDOpField(OP_UNWATCH, 0, 0)])
+        for k in self._watch_keys(oid):
+            self.client._watch_cbs.pop(k, None)
+        self._op(oid, [OSDOpField(OP_UNWATCH, 0, 0)])
 
     def execute(self, oid: str, cls: str, method: str,
                 inp: bytes = b"") -> bytes:
         """Run an in-OSD object class method (librados exec)."""
         data = cls.encode() + b"\0" + method.encode() + b"\0" + inp
-        r = self.client.operate(self.pool_id, oid,
-                                [OSDOpField(OP_CALL, 0, 0, data)])
+        r = self._op(oid, [OSDOpField(OP_CALL, 0, 0, data)])
         return r.ops[0].data if r.ops else b""
 
     def notify(self, oid: str, payload: bytes = b"") -> None:
         """Fan payload out to every watcher; returns once all acked
         (librados notify)."""
-        self.client.operate(self.pool_id, oid,
-                            [OSDOpField(OP_NOTIFY, 0, 0, payload)])
+        self._op(oid, [OSDOpField(OP_NOTIFY, 0, 0, payload)])
 
     def remove(self, oid: str) -> None:
-        self.client.operate(self.pool_id, oid, [OSDOpField(OP_DELETE)])
+        self._op(oid, [OSDOpField(OP_DELETE)])
 
     def stat(self, oid: str) -> dict:
-        r = self.client.operate(self.pool_id, oid, [OSDOpField(OP_STAT)])
+        r = self._op(oid, [OSDOpField(OP_STAT)])
         return {"size": r.ops[0].length}
 
     def set_omap(self, oid: str, keys: dict) -> None:
         e = Encoder()
         e.map(keys, lambda e2, k: e2.str(k), lambda e2, v: e2.bytes(v))
-        self.client.operate(self.pool_id, oid,
-                            [OSDOpField(OP_OMAP_SET, 0, 0, e.tobytes())])
+        self._op(oid, [OSDOpField(OP_OMAP_SET, 0, 0, e.tobytes())])
 
     def get_omap(self, oid: str) -> dict:
-        r = self.client.operate(self.pool_id, oid,
-                                [OSDOpField(OP_OMAP_GET)])
+        r = self._op(oid, [OSDOpField(OP_OMAP_GET)])
         return Decoder(r.ops[0].data).map(lambda d: d.str(),
                                           lambda d: d.bytes())
 
     def rm_omap_keys(self, oid: str, keys: list[str]) -> None:
         e = Encoder()
         e.list(keys, lambda e2, k: e2.str(k))
-        self.client.operate(
-            self.pool_id, oid,
-            [OSDOpField(OP_OMAP_RMKEYS, 0, 0, e.tobytes())])
+        self._op(oid, [OSDOpField(OP_OMAP_RMKEYS, 0, 0, e.tobytes())])

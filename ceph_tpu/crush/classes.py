@@ -31,8 +31,11 @@ def populate_classes(m: CrushMap, device_classes: dict[int, str]) -> None:
     """
     classes = sorted(set(device_classes.values()))
     # refresh: drop any previous shadow tree first — recloning on top of
-    # stale shadows would clone shadows-of-shadows and leak buckets
-    for sid in set(m.class_bucket.values()):
+    # stale shadows would clone shadows-of-shadows and leak buckets.
+    # Remember what each old shadow stood for so rules already resolved
+    # to a shadow id can be re-pointed after the rebuild (ids shift)
+    old_shadow_of = {sid: key for key, sid in m.class_bucket.items()}
+    for sid in old_shadow_of:
         idx = -1 - sid
         if 0 <= idx < len(m.buckets):
             m.buckets[idx] = None
@@ -66,14 +69,24 @@ def populate_classes(m: CrushMap, device_classes: dict[int, str]) -> None:
             m.class_bucket[(bid, cname)] = shadow.id
             return shadow.id
 
+        shadow_ids = set(m.class_bucket.values())
         for b in list(m.buckets):
             if b is not None and (b.id, cname) not in m.class_bucket \
-                    and not _is_shadow(m, b.id):
+                    and b.id not in shadow_ids:
                 clone(b.id)
+                shadow_ids = set(m.class_bucket.values())
 
-
-def _is_shadow(m: CrushMap, bid: int) -> bool:
-    return bid in {sid for sid in m.class_bucket.values()}
+    # re-point rules that resolved to a previous generation's shadow id:
+    # shadow ids shift across a refresh, and a stale TAKE would land on
+    # a freed slot (or, worse, another class's new shadow)
+    from .types import RULE_TAKE
+    for r in m.rules:
+        if r is None:
+            continue
+        for s in r.steps:
+            if s.op == RULE_TAKE and s.arg1 in old_shadow_of:
+                s.arg1 = m.class_bucket.get(old_shadow_of[s.arg1],
+                                            s.arg1)
 
 
 def shadow_to_class(m: CrushMap) -> dict[int, tuple[int, str]]:

@@ -630,8 +630,11 @@ class Monitor(Dispatcher):
                     p.snaps[p.snap_seq] = name
                 if not self._mutate(fn):
                     return "commit failed", -11
+                # epoch rides the reply so clients can barrier on map
+                # propagation before trusting snapshot isolation
                 return json.dumps(
-                    {"snapid": self.osdmap.pools[pool_id].snap_seq}), 0
+                    {"snapid": self.osdmap.pools[pool_id].snap_seq,
+                     "epoch": self.osdmap.epoch}), 0
             if prefix == "osd pool rmsnap":
                 pool_id = int(cmd["pool"])
                 name = str(cmd["snap"])
@@ -683,6 +686,76 @@ class Monitor(Dispatcher):
                 if not self._mutate(fn):
                     return "commit failed", -11
                 return "removed", 0
+            if prefix == "osd tier add":
+                base, cache = int(cmd["pool"]), int(cmd["tierpool"])
+                if base not in self.osdmap.pools \
+                        or cache not in self.osdmap.pools:
+                    return "no such pool", -2
+                if base == cache:
+                    return "a pool cannot be a tier of itself", -22
+                if self.osdmap.pools[cache].tier_of >= 0:
+                    return "tier pool already a tier", -22
+                if self.osdmap.pools[base].tier_of >= 0:
+                    return "base pool is itself a tier (no chains)", -22
+                if any(p.tier_of == cache
+                       for p in self.osdmap.pools.values()):
+                    return "tier pool has tiers of its own", -22
+                if self.osdmap.pools[cache].is_erasure():
+                    return "cache pool must be replicated", -22
+
+                def fn(m: OSDMap):
+                    m.pools[cache].tier_of = base
+                if not self._mutate(fn):
+                    return "commit failed", -11
+                return f"pool {cache} is now a tier of {base}", 0
+            if prefix == "osd tier cache-mode":
+                cache = int(cmd["pool"])
+                mode = str(cmd["mode"])
+                if mode not in ("none", "writeback"):
+                    return f"unknown cache mode {mode!r}", -22
+                if self.osdmap.pools[cache].tier_of < 0:
+                    return "pool is not a tier", -22
+
+                def fn(m: OSDMap):
+                    m.pools[cache].cache_mode = \
+                        "" if mode == "none" else mode
+                if not self._mutate(fn):
+                    return "commit failed", -11
+                return f"cache-mode {mode}", 0
+            if prefix == "osd tier set-overlay":
+                base, cache = int(cmd["pool"]), int(cmd["overlaypool"])
+                if self.osdmap.pools[cache].tier_of != base:
+                    return "overlay pool is not a tier of pool", -22
+
+                def fn(m: OSDMap):
+                    m.pools[base].read_tier = cache
+                    m.pools[base].write_tier = cache
+                if not self._mutate(fn):
+                    return "commit failed", -11
+                return json.dumps({"epoch": self.osdmap.epoch}), 0
+            if prefix == "osd tier remove-overlay":
+                base = int(cmd["pool"])
+
+                def fn(m: OSDMap):
+                    m.pools[base].read_tier = -1
+                    m.pools[base].write_tier = -1
+                if not self._mutate(fn):
+                    return "commit failed", -11
+                return json.dumps({"epoch": self.osdmap.epoch}), 0
+            if prefix == "osd tier remove":
+                base, cache = int(cmd["pool"]), int(cmd["tierpool"])
+                if self.osdmap.pools[cache].tier_of != base:
+                    return "pool is not a tier of base", -22
+                if self.osdmap.pools[base].write_tier == cache \
+                        or self.osdmap.pools[base].read_tier == cache:
+                    return "remove the overlay first", -16
+
+                def fn(m: OSDMap):
+                    m.pools[cache].tier_of = -1
+                    m.pools[cache].cache_mode = ""
+                if not self._mutate(fn):
+                    return "commit failed", -11
+                return "tier removed", 0
             if prefix == "osd getmap":
                 return json.dumps({"epoch": self.osdmap.epoch}), 0
             if prefix == "osd getcrushmap":
@@ -791,7 +864,12 @@ class Monitor(Dispatcher):
     def _cmd_pool_set(self, cmd) -> tuple[str, int]:
         def fn(m: OSDMap):
             pool = m.pools[int(cmd["pool"])]
-            setattr(pool, cmd["var"], int(cmd["val"]))
+            # coerce by the field's current type (int/float/str knobs)
+            cur = getattr(pool, cmd["var"])
+            cast = type(cur) if cur is not None else int
+            setattr(pool, cmd["var"],
+                    cast(cmd["val"]) if cast is not bool
+                    else cmd["val"] in ("1", "true", "True"))
         if not self._mutate(fn):
             return "commit failed", -11
         return "set", 0

@@ -162,6 +162,9 @@ class _InFlight:
 #: clients, whose ids are small monotonically assigned ints)
 RECOVERY_CLIENT = 0xFFFFFFFF00000000
 
+#: reqid client for the tier agent's guarded evict deletes
+TIER_AGENT_CLIENT = 0xFFFFFFFF00000001
+
 
 class OSDDaemon(Dispatcher):
     def __init__(self, osd_id: int, mon_addr: str,
@@ -212,6 +215,7 @@ class OSDDaemon(Dispatcher):
         #: fault injection (reference: OSD.h debug_heartbeat_drops_remaining)
         self.debug_drop_rep_ops = 0
 
+        self._auth_key = auth_key
         self.msgr = Messenger.create(self.whoami, ms_type)
         self.msgr.set_auth(auth_key)
         self.msgr.set_policy("client", ConnectionPolicy.lossy_client())
@@ -266,6 +270,21 @@ class OSDDaemon(Dispatcher):
         self._op_throttle = Throttle(
             f"osd.{osd_id}-op-bytes",
             int(self.ctx.conf.get("osd_client_message_size_cap")))
+
+        # cache-tier agent (PrimaryLogPG promote_object + TierAgent):
+        # promotions and flush/evict run on their own thread — they
+        # issue internal client ops that may land back on this OSD's own
+        # shard workers, so they must never run ON a shard worker
+        import queue as _queue
+        self._ms_type = ms_type
+        self._promoting: dict[tuple, list] = {}
+        self._agent_tid = 0
+        self._agent_q: "_queue.Queue" = _queue.Queue()
+        self._internal_client = None
+        self._agent_thread = threading.Thread(
+            target=self._agent_loop, name=f"osd.{osd_id}-tier-agent",
+            daemon=True)
+        self._agent_thread.start()
         self.ctx.admin.register_command(
             "dump_reservations", lambda **kw: self.local_reserver.dump(),
             "recovery reservation slots")
@@ -335,6 +354,9 @@ class OSDDaemon(Dispatcher):
             self._tick_timer.cancel()
         if self.opwq is not None:
             self.opwq.shutdown()
+        self._agent_q.put(None)
+        if self._internal_client is not None:
+            self._internal_client.shutdown()
         self.msgr.shutdown()
         self.store.umount()
 
@@ -379,6 +401,7 @@ class OSDDaemon(Dispatcher):
             now = time.time()
             self._maybe_reboot()
             self._renew_map_subscription(now)
+            self._agent_scan(now)
             self._mgr_report()
             for warn in self.op_tracker.check_ops_in_flight():
                 dout("osd", 1, "osd.%d %s", self.osd_id, warn)
@@ -1173,6 +1196,192 @@ class OSDDaemon(Dispatcher):
                 from_osd=self.osd_id, op=MOSDPing.PING_REPLY,
                 stamp=msg.stamp, epoch=self.osdmap.epoch))
 
+    # -- cache-tier agent (promotion + flush/evict) ---------------------------
+
+    def _is_internal(self, msg) -> bool:
+        """Ops from the tier agent's embedded client must not re-enter
+        the tier machinery (no promotion parking, no dirty stamp, no
+        delete write-through) — they ARE the machinery."""
+        c = self._internal_client
+        return c is not None and msg.client_id == c.client_id
+
+    def _internal_io(self, pool_id: int):
+        """Lazy internal RadosClient (the reference uses OSD-to-OSD
+        copy_from; an embedded client is the lite equivalent)."""
+        from ceph_tpu.client.rados import RadosClient
+        if self._internal_client is None:
+            c = RadosClient(self.mon_addr, ms_type=self._ms_type,
+                            timeout=8.0, auth_key=self._auth_key)
+            c.connect()
+            self._internal_client = c
+        # direct=True: agent I/O must hit the pool it names — a flush
+        # that followed the overlay would loop back into the cache
+        return self._internal_client.open_ioctx(pool_id, direct=True)
+
+    def _agent_loop(self) -> None:
+        from ceph_tpu.common.logging import get_logger
+        while not self._stop:
+            try:
+                job = self._agent_q.get(timeout=0.25)
+            except Exception:
+                continue
+            if job is None:
+                return
+            try:
+                if job[0] == "promote":
+                    self._do_promote(job[1], job[2], job[3])
+                elif job[0] == "base_delete":
+                    try:
+                        self._internal_io(job[2]).remove(job[1])
+                    except OSError:
+                        pass
+                elif job[0] == "flush":
+                    self._do_flush(job[1], job[2], job[3], job[4])
+            except Exception:
+                get_logger("osd").exception(
+                    "osd.%d tier agent job %s failed", self.osd_id,
+                    job[0])
+                if job[0] == "promote":
+                    self._promote_done(job[1], job[2], fail_rc=-11)
+
+    def _do_promote(self, pgid, oid: str, base_pool: int) -> None:
+        """Copy the object (or learn it is absent) from the base pool,
+        install it CLEAN in the cache via the replicated write path,
+        then re-dispatch the parked ops."""
+        io = self._internal_io(base_pool)
+        try:
+            data = io.read(oid)
+            omap = io.get_omap(oid)
+        except OSError:
+            # no base copy: the ops proceed against an absent object
+            # (reads -> ENOENT, creates -> fresh object)
+            self._promote_done(pgid, oid)
+            return
+        cache_io = self._internal_io(pgid[0])
+        try:
+            cache_io.write_full(oid, data)
+            if omap:
+                cache_io.set_omap(oid, omap)
+        except OSError:
+            # a half-installed promotion must not release parked ops:
+            # a partial write would then create a truncated object that
+            # the agent later flushes OVER the intact base copy
+            self._promote_done(pgid, oid, fail_rc=-11)  # EAGAIN
+            return
+        self._promote_done(pgid, oid)
+
+    def _promote_done(self, pgid, oid: str, fail_rc: int = 0) -> None:
+        with self._lock:
+            waiting = self._promoting.pop((pgid, oid), [])
+        for m in waiting:
+            if fail_rc:
+                self._reply_err(m, fail_rc)
+            else:
+                m._tier_checked = True
+                self._enqueue_op("client", m.pgid, self._handle_op, m)
+
+    def _do_flush(self, pgid, oid: str, base_pool: int,
+                  evict_only: bool) -> None:
+        """Writeback: push the dirty object to the base pool, then evict
+        it from the cache (the lite agent combines agent_maybe_flush +
+        agent_maybe_evict; a re-read re-promotes).  A client write that
+        races the flush keeps the object resident: the dirty stamp is
+        re-read before the evicting remove, and a changed (or appeared)
+        stamp aborts it — the next scan retries."""
+        cid = self._pg_cid(pgid)
+        stamp0 = self._getattr_safe(cid, oid, "_dirty")
+        if not evict_only:
+            if stamp0 is None:
+                return   # already flushed or vanished
+            try:
+                data = self.store.read(cid, oid)
+                omap = self.store.omap_get(cid, oid)
+            except KeyError:
+                return
+            base_io = self._internal_io(base_pool)
+            base_io.write_full(oid, data)
+            if omap:
+                base_io.set_omap(oid, omap)
+        self._evict_object(pgid, oid, stamp0)
+
+    def _evict_object(self, pgid, oid: str, stamp0) -> None:
+        """Guarded replicated delete: the dirty-stamp check and the
+        delete are ONE atomic step under the PG lock, so a client write
+        racing the agent can never be destroyed — it changes the stamp
+        and the evict aborts (the next scan retries)."""
+        with self._lock:
+            pg = self.pgs.get(pgid)
+            if (pg is None or pg.state != STATE_ACTIVE
+                    or pg.primary != self.osd_id):
+                return
+            cid = self._pg_cid(pgid)
+            if self._getattr_safe(cid, oid, "_dirty") != stamp0:
+                return   # raced a client write; keep the newer data
+            if not self.store.exists(cid, oid):
+                return
+            self._agent_tid += 1
+            reqid = (TIER_AGENT_CLIENT, self._agent_tid)
+            t = Transaction().remove(cid, oid)
+            entry = self._log_write(pg, t, oid, True, reqid)
+            self.store.apply_transaction(t)
+            up = pg.up
+            replicas = [o for o in up
+                        if o != self.osd_id and o != CEPH_NOSD]
+            if replicas:
+                fake = MOSDOp(client_id=TIER_AGENT_CLIENT,
+                              tid=self._agent_tid, pgid=pgid, oid=oid,
+                              ops=[OSDOpField(OP_DELETE)])
+                fake.connection = None
+                self._in_flight[reqid] = _InFlight(
+                    fake, set(replicas),
+                    MOSDOpReply(tid=self._agent_tid, result=0,
+                                epoch=self.osdmap.epoch))
+                blob = t.encode()
+                entry_blob = PG.encode_entry(entry)
+        for rep in replicas:
+            con = self._osd_con(rep)
+            if con is None:
+                self._ack_shard(reqid, rep, -107)
+                continue
+            con.send_message(MOSDRepOp(reqid=reqid, pgid=pgid, oid=oid,
+                                       txn=blob, pg_version=entry.version,
+                                       entry=entry_blob))
+
+    def _agent_scan(self, now: float) -> None:
+        """Tick-side: queue flush/evict work for cache PGs I lead."""
+        for pgid, pg in list(self.pgs.items()):
+            pool = self.osdmap.pools.get(pgid[0])
+            if (pool is None or pool.tier_of < 0
+                    or pool.cache_mode != "writeback"
+                    or pg.primary != self.osd_id
+                    or pg.state != STATE_ACTIVE):
+                continue
+            cid = self._pg_cid(pgid)
+            try:
+                oids = [o for o in self.store.list_objects(cid)
+                        if not o.startswith(PG.PGMETA) and "@" not in o]
+            except KeyError:
+                continue
+            n_queued = 0
+            for oid in oids:
+                if n_queued >= 8:
+                    break
+                dirty = self._getattr_safe(cid, oid, "_dirty")
+                if dirty is not None:
+                    if now - float(dirty) >= pool.cache_min_flush_age:
+                        self._agent_q.put(("flush", pgid, oid,
+                                           pool.tier_of, False))
+                        n_queued += 1
+            if pool.target_max_objects \
+                    and len(oids) > pool.target_max_objects:
+                for oid in oids:
+                    if n_queued >= 8:
+                        break
+                    if self._getattr_safe(cid, oid, "_dirty") is None:
+                        self._agent_q.put(("flush", pgid, oid,
+                                           pool.tier_of, True))
+                        n_queued += 1
+
     # -- op execution (PrimaryLogPG::do_op analog) ----------------------------
 
     def _pg_members(self, pgid) -> tuple[list[int], int]:
@@ -1251,6 +1460,24 @@ class OSDDaemon(Dispatcher):
                 msg._trk.mark_event("waiting for missing object")
                 pg.waiting_for_missing.setdefault(msg.oid, []).append(msg)
                 return
+            # cache tier: an op for an object this (cache) pool does not
+            # hold yet parks behind a promotion from the base pool
+            # (PrimaryLogPG::maybe_promote / promote_object)
+            if (pool.tier_of >= 0 and pool.cache_mode == "writeback"
+                    and not getattr(msg, "_tier_checked", False)
+                    and not self._is_internal(msg)
+                    and not self.store.exists(self._pg_cid(msg.pgid),
+                                              msg.oid)):
+                msg._trk.mark_event("waiting for promotion")
+                key = (msg.pgid, msg.oid)
+                waiting = self._promoting.get(key)
+                if waiting is not None:
+                    waiting.append(msg)
+                else:
+                    self._promoting[key] = [msg]
+                    self._agent_q.put(("promote", msg.pgid, msg.oid,
+                                       pool.tier_of))
+                return
             # execute under the lock: version allocation + log append +
             # store apply must be atomic against concurrent dispatch
             # threads (each connection has its own reader thread) and the
@@ -1259,6 +1486,13 @@ class OSDDaemon(Dispatcher):
                 self._do_ec_op(msg, pool, pg)
             else:
                 self._do_replicated_op(msg, pool, pg)
+                if pool.tier_of >= 0 and is_write \
+                        and not self._is_internal(msg) and any(
+                        op.op == OP_DELETE for op in msg.ops):
+                    # write-through for deletes: without it the base
+                    # copy would resurrect on the next promotion
+                    self._agent_q.put(("base_delete", msg.oid,
+                                       pool.tier_of))
 
     def _blocked_on_recovery(self, pg: PG, oid: str, is_write: bool,
                              ec: bool) -> bool:
@@ -1278,7 +1512,8 @@ class OSDDaemon(Dispatcher):
         if trk is not None:
             trk.mark_event(f"reply result={reply.result}")
             trk.finish()
-        msg.connection.send_message(reply)
+        if msg.connection is not None:
+            msg.connection.send_message(reply)
 
     def _reply_err(self, msg: MOSDOp, code: int) -> None:
         self._op_send_reply(
@@ -1464,6 +1699,12 @@ class OSDDaemon(Dispatcher):
         entry = self._log_write(pg, t, msg.oid, is_delete, reqid)
         if not is_delete:
             t.setattr(cid, msg.oid, "_v", enc_version(entry.version))
+            if pool.tier_of >= 0 and not self._is_internal(msg):
+                # cache tier: stamp dirtiness inside the SAME replicated
+                # txn (the flush agent reads the stamp's age); promotion
+                # installs (internal) stay clean
+                t.setattr(cid, msg.oid, "_dirty",
+                          str(time.time()).encode())
         self.store.apply_transaction(t)
         replicas = [o for o in up if o != self.osd_id and o != CEPH_NOSD]
         reply = MOSDOpReply(tid=msg.tid, result=0, epoch=self.osdmap.epoch,

@@ -164,6 +164,11 @@ def encode_osdmap(m: OSDMap) -> bytes:
             e2.u64(p.snap_seq)
             e2.map(p.snaps, lambda e3, k: e3.u64(k),
                    lambda e3, v: e3.str(v))
+            # v5: cache-tier fields (pg_pool_t tier_of/read_tier/...)
+            e2.s64(p.tier_of).s64(p.read_tier).s64(p.write_tier)
+            e2.str(p.cache_mode)
+            e2.u64(p.target_max_objects)
+            e2.f64(p.cache_min_flush_age)
 
         e.map(m.pools, lambda e2, k: e2.s64(k), enc_pool)
 
@@ -189,7 +194,7 @@ def encode_osdmap(m: OSDMap) -> bytes:
             e2.f64(x.down_stamp), e2.f64(x.laggy_probability),
             e2.f64(x.laggy_interval)))
 
-    enc.versioned(4, 1, body)
+    enc.versioned(5, 1, body)
     return enc.tobytes()
 
 
@@ -215,6 +220,13 @@ def decode_osdmap(data: bytes) -> OSDMap:
                 p.snap_seq = d2.u64()
                 p.snaps = d2.map(lambda d3: d3.u64(),
                                  lambda d3: d3.str())
+            if version >= 5:
+                p.tier_of = d2.s64()
+                p.read_tier = d2.s64()
+                p.write_tier = d2.s64()
+                p.cache_mode = d2.str()
+                p.target_max_objects = d2.u64()
+                p.cache_min_flush_age = d2.f64()
             return p
 
         def dec_pgid_key(d2: Decoder) -> tuple[int, int]:

@@ -71,6 +71,14 @@ class PGPool:
     # pool snapshots (pg_pool_t::snaps + snap_seq): snapid -> name
     snap_seq: int = 0
     snaps: dict = field(default_factory=dict)
+    # cache tiering (pg_pool_t tier fields): a cache pool fronts its
+    # tier_of base; the base's read/write_tier redirect the Objecter
+    tier_of: int = -1          # set on the CACHE pool
+    read_tier: int = -1        # set on the BASE pool (overlay)
+    write_tier: int = -1       # set on the BASE pool (overlay)
+    cache_mode: str = ""       # "" | "writeback"
+    target_max_objects: int = 0
+    cache_min_flush_age: float = 0.0
 
     def __post_init__(self):
         if self.pgp_num == 0:

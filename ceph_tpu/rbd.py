@@ -164,7 +164,13 @@ class Image:
             "snap": f"rbd.{self.name}.{snap}"})
         if rc != 0:
             raise OSError(-rc or 5, out)
-        snapid = json.loads(out)["snapid"]
+        reply = json.loads(out)
+        snapid = reply["snapid"]
+        # map-propagation barrier: a write issued right after this must
+        # carry the post-snap epoch, or a stale primary could skip the
+        # pre-write COW clone and silently corrupt the snapshot
+        if "epoch" in reply:
+            self.io.client.wait_for_epoch(reply["epoch"])
         m.setdefault("snaps", {})[snap] = {"snapid": snapid,
                                            "size": m["size"]}
         self._save_meta(m)
@@ -231,6 +237,7 @@ class Image:
         # are only reachable through this header's name->snapid table
         if self._load().get("snaps"):
             raise OSError(16, "image has snapshots (remove them first)")
+        self._check_lock()   # and while another owner holds the lock
         self._striped().remove()
         try:
             self.io.remove(self.HEADER_FMT.format(name=self.name))

@@ -29,6 +29,8 @@ COMMANDS = {
     ("osd", "out"): ["id"],
     ("osd", "in"): ["id"],
     ("osd", "down"): ["id"],
+    ("osd", "pg-upmap-items"): ["pgid", "*id_pairs"],
+    ("osd", "rm-pg-upmap-items"): ["pgid"],
 }
 
 
@@ -43,7 +45,10 @@ def parse_command(words: list[str]) -> dict:
             schema = COMMANDS[key]
             pos = 0
             for w in rest:
-                if "=" in w:
+                if pos < len(schema) and schema[pos].startswith("*"):
+                    # rest-list argument swallows remaining words
+                    cmd.setdefault(schema[pos][1:], []).append(w)
+                elif "=" in w:
                     k, v = w.split("=", 1)
                     cmd[k] = v
                 elif pos < len(schema):

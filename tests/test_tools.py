@@ -198,3 +198,21 @@ def test_monstore_tool_dump_and_osdmap(tmp_path):
             d["last_committed"] - 1
     finally:
         db.close()
+
+
+def test_objectstore_bench(tmp_path):
+    """fio-ObjectStore-engine analog: all phases run clean on every
+    backend."""
+    from ceph_tpu.tools.objectstore_bench import run
+    from ceph_tpu.objectstore import create_objectstore
+    for st_type in ("memstore", "bluestore"):
+        store = create_objectstore(st_type, str(tmp_path / st_type))
+        store.mkfs_if_needed()
+        store.mount()
+        try:
+            res = run(store, n_objects=64, obj_size=4096, n_threads=2)
+            for phase in ("write", "read", "overwrite", "delete"):
+                assert res[phase]["errors"] == 0, (st_type, phase)
+                assert res[phase]["iops"] > 0
+        finally:
+            store.umount()
