@@ -592,6 +592,26 @@ class Monitor(Dispatcher):
         try:
             if prefix == "status":
                 return json.dumps(self.status()), 0
+            if prefix == "health":
+                m = self.osdmap
+                checks = []
+                down = [o for o in range(m.max_osd)
+                        if m.exists(o) and not m.is_up(o)]
+                if down:
+                    checks.append({"check": "OSD_DOWN", "osds": down})
+                out_osds = [o for o in range(m.max_osd)
+                            if m.exists(o) and m.is_out(o)]
+                if out_osds:
+                    checks.append({"check": "OSD_OUT", "osds": out_osds})
+                # an election that has not converged means no live quorum
+                # RIGHT NOW (elector.quorum only records the last victory,
+                # which goes stale when a majority of mons die)
+                if self.elector is None or self.elector.electing:
+                    checks.append({"check": "MON_QUORUM_AT_RISK",
+                                   "last_quorum": self.quorum()})
+                return json.dumps({
+                    "status": "HEALTH_OK" if not checks
+                    else "HEALTH_WARN", "checks": checks}), 0
             if prefix == "quorum_status":
                 return json.dumps({
                     "quorum": self.quorum(),

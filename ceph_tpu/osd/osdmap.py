@@ -209,8 +209,12 @@ class OSDMap:
                     raw[raw.index(frm)] = to
         return raw
 
-    def _is_out(self, osd: int) -> bool:
+    def is_out(self, osd: int) -> bool:
+        """OSDMap::is_out — weight 0 means CRUSH never places here."""
         return not (0 <= osd < self.max_osd) or self.osd_weight[osd] == 0
+
+    # placement-pipeline internal alias
+    _is_out = is_out
 
     def _raw_to_up_osds(self, pool: PGPool, raw: list[int]
                         ) -> tuple[list[int], int]:

@@ -17,6 +17,7 @@ import sys
 #: prefix -> positional argument names (mirrors MonCommands.h schemas)
 COMMANDS = {
     ("status",): [],
+    ("health",): [],
     ("quorum_status",): [],
     ("osd", "tree"): [],
     ("osd", "getmap"): [],

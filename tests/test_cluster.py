@@ -366,3 +366,19 @@ def test_ec_bitmatrix_technique_pool(cluster):
     io.write("lb", b"Z" * 3000, offset=5000)
     want = payload[:5000] + b"Z" * 3000 + payload[8000:]
     assert io.read("lb") == want
+
+
+def test_health_command(cluster):
+    client = cluster.client()
+    import json
+    rc, out = client.mon_command({"prefix": "health"})
+    assert rc == 0
+    h = json.loads(out)
+    assert h["status"] == "HEALTH_OK" and h["checks"] == []
+    cluster.kill_osd(2)
+    rc, _ = client.mon_command({"prefix": "osd down", "id": 2})
+    assert rc == 0
+    rc, out = client.mon_command({"prefix": "health"})
+    h = json.loads(out)
+    assert h["status"] == "HEALTH_WARN"
+    assert {"check": "OSD_DOWN", "osds": [2]} in h["checks"]
