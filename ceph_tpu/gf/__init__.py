@@ -15,6 +15,7 @@ from .tables import (
     gf_inv,
     gf_pow,
     mul_table,
+    bit_matrix,
     nibble_bit_table,
 )
 from .matrix import (
@@ -33,6 +34,7 @@ __all__ = [
     "gf_inv",
     "gf_pow",
     "mul_table",
+    "bit_matrix",
     "nibble_bit_table",
     "gen_cauchy1_matrix",
     "gen_rs_vandermonde_matrix",

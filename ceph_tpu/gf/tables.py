@@ -5,18 +5,16 @@ with generator 2 — the same field the reference's EC plugins compute in (ISA-L
 gf-complete w=8; see SURVEY.md §2.1).  Tables are built once at import from first
 principles (repeated multiplication by the generator), not copied from anywhere.
 
-Two table families:
+Three table families:
 
 * exp/log and the dense 256x256 product table ``mul_table()`` — used by the numpy
   oracle plugin and by tests as the ground truth.
-* ``nibble_bit_table(coeff)`` — the TPU-kernel operand.  GF(2^8) multiplication by a
-  constant c is GF(2)-linear in the bits of the input byte, so a whole (m x k) coding
-  matrix can be flattened into one 0/1 matrix W with shape (k*32, m*8):  row index
-  enumerates (data-chunk j, nibble-half p, nibble-value n), column index enumerates
-  (parity-chunk i, output-bit r).  Encoding then is `one_hot(nibbles(data)) @ W mod 2`
-  — a plain matrix multiply that maps straight onto the TPU MXU.  This plays the role
-  ISA-L's ``ec_init_tables`` expanded-table form plays for PSHUFB
-  (reference: src/erasure-code/isa/ErasureCodeIsa.cc:118-130).
+* ``bit_matrix(coeff)`` — the TPU-kernel operand (see its docstring): the coding
+  matrix as a (k*8, m*8) GF(2) matrix, consumed by the fused Pallas/XLA MXU kernels
+  in ops.gf_kernel.
+* ``nibble_bit_table(coeff)`` — the earlier nibble one-hot operand, kept for the
+  round-1/2 kernel formulation's tests; superseded by bit_matrix for the kernels
+  (4x narrower expansion, full MXU lane utilization).
 """
 
 from __future__ import annotations
@@ -104,6 +102,30 @@ def _mul_table() -> np.ndarray:
 def mul_table() -> np.ndarray:
     """Dense 256x256 product table M[a, b] = a*b in GF(2^8).  64 KiB, read-only."""
     return _mul_table()
+
+
+def bit_matrix(coeff: np.ndarray) -> np.ndarray:
+    """Flatten a GF(2^8) coding matrix into a GF(2) bit matrix.
+
+    GF(2^8) multiplication by a constant c is GF(2)-linear in the bits of the
+    input byte: c * x = XOR_s bit_s(x) * (c * 2^s).  A whole (m, k) coding
+    matrix therefore becomes one 0/1 matrix W of shape (k*8, m*8):
+
+        W[j*8 + s, i*8 + r] = bit r of (coeff[i, j] * 2^s)
+
+    and encoding is ``bits(data) @ W mod 2`` — an integer matmul whose 8-wide
+    bit expansion is 4x narrower than the nibble one-hot form, which is what
+    lets the MXU kernel hit full lane utilization (see ops.gf_kernel).
+    Plays the role ISA-L's ``ec_init_tables`` expansion plays for PSHUFB
+    (reference: src/erasure-code/isa/ErasureCodeIsa.cc:118-130).
+    """
+    coeff = np.asarray(coeff, dtype=np.uint8)
+    m, k = coeff.shape
+    mt = _mul_table()
+    powers = (1 << np.arange(8)).astype(np.uint8)              # 2^s
+    prods = mt[coeff.T[:, None, :], powers[None, :, None]]     # (k, 8, m)
+    bits = (prods[..., None] >> np.arange(8)) & 1              # (k, 8, m, 8)
+    return bits.reshape(k * 8, m * 8).astype(np.uint8)
 
 
 def nibble_bit_table(coeff: np.ndarray) -> np.ndarray:

@@ -6,15 +6,27 @@ matrix-vector product applied independently to every byte column of a stripe, wh
 OSD invokes per 4-64 KiB stripe in a loop (src/osd/ECUtil.cc:120-159).  Here that whole
 loop is one batched device call.
 
-TPU-first design (not a translation): GF(2^8) multiplication by a constant is linear
+TPU-first design (not a translation).  GF(2^8) multiplication by a constant is linear
 over GF(2) in the bits of the input, so the coding matrix becomes a 0/1 matrix W of
-shape (k*32, m*8) (see ceph_tpu.gf.tables.nibble_bit_table) and encoding becomes
+shape (k*8, m*8) (ceph_tpu.gf.tables.bit_matrix) and encoding is
 
-    parity_bits = one_hot(nibbles(data)) @ W  (mod 2)
+    parity_bits = bits(data) @ W   (mod 2)
 
-— a single (S*B, k*32) x (k*32, m*8) matrix multiply that runs on the MXU, followed by
-a bit-pack.  No gathers, no scalar loops, static shapes; XLA fuses the nibble one-hot
-expansion and the bit-pack into the matmul's prologue/epilogue.
+an integer matrix multiply on the MXU whose ``& 1`` epilogue is the XOR reduction.
+Two executors share that formulation:
+
+* **Fused Pallas kernel** (TPU): per grid step, a block of stripes is loaded to VMEM,
+  bit-expanded on sublanes, lane-split into G=4 groups stacked on the contraction
+  axis, and multiplied against a block-diagonal W (G*k*8, G*m*8) int8 operand.  The
+  block-diagonal packing is the core trick: a plain (k*8, m*8) matmul uses m*8 = 32 of
+  the MXU's 128 output lanes (1/8 utilization — the measured ceiling of the previous
+  nibble one-hot kernel); four independent lane-groups sharing one matmul fill all 128.
+  Expansion, matmul and bit-pack all stay VMEM-resident — no HBM intermediates.
+  Measured (v5e-1, k=8 m=4, 4 KiB chunks, batch 2048): ~65-90 GB/s, 13-19x the
+  single-core C SIMD baseline.
+
+* **XLA path** (any backend; also the CPU-mesh test fallback): the same bits @ W
+  product tiled with lax.map so the 8x bit expansion stays in VMEM-scale working sets.
 
 Decode is the same kernel with a host-side inverted sub-matrix (tiny, k x k), exactly
 mirroring the reference's decode structure (ErasureCodeIsa.cc:150-310).
@@ -27,8 +39,10 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-from ceph_tpu.gf.tables import mul_table, nibble_bit_table
+from ceph_tpu.gf.tables import bit_matrix, mul_table
 
 
 # ---------------------------------------------------------------------------
@@ -51,80 +65,181 @@ def ec_encode_ref(coeff: np.ndarray, data: np.ndarray) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# JAX kernel
+# shared table prep
 # ---------------------------------------------------------------------------
 
-_BIT_WEIGHTS = np.arange(8, dtype=np.int32)
+_BITW = np.arange(8, dtype=np.int32)
 
-# Byte-rows of the one-hot matmul processed per tile.  The one-hot expansion is k*32
-# values per source byte, so an unbounded batch would inflate HBM ~64x (observed: a
-# 128 MiB encode tried to materialize 24 GiB).  Tiling keeps the expansion resident in
-# VMEM-scale working sets while the batch dimension streams.
-_TILE_ROWS = 1 << 15
+#: lane groups sharing one block-diagonal matmul in the Pallas kernel (fills
+#: the 128 MXU output lanes at m*8 = 32 outputs per group)
+_G = 4
+
+#: stripes per Pallas grid step (amortizes per-step pipeline overhead;
+#: measured best of {1, 4, 8} on v5e)
+_SB = 8
+
+#: byte-rows per XLA-path tile.  The bit expansion is k*8 int8 per source
+#: byte; tiling keeps it in VMEM-scale working sets while the batch streams
+#: (an untiled call materializes the expansion in HBM and halves throughput).
+_TILE_ROWS = 1 << 17
 
 
-def _encode_tile(w_bits: jax.Array, x: jax.Array, k: int, m: int,
-                 dot_dtype) -> jax.Array:
+def _blockdiag(wb: np.ndarray, g: int) -> np.ndarray:
+    """Block-diagonal stack of g copies of the (k*8, m*8) bit matrix."""
+    r, c = wb.shape
+    out = np.zeros((g * r, g * c), dtype=np.int8)
+    for i in range(g):
+        out[i * r:(i + 1) * r, i * c:(i + 1) * c] = wb
+    return out
+
+
+# ---------------------------------------------------------------------------
+# XLA executor (any backend)
+# ---------------------------------------------------------------------------
+
+def _xla_tile(w_bits: jax.Array, x: jax.Array, k: int, m: int,
+              dot_dtype) -> jax.Array:
     """x: (T, k) uint8 byte rows -> (T, m) uint8 parity bytes."""
     t = x.shape[0]
-    nib = jnp.concatenate([x & 0xF, (x >> 4) + 16], axis=-1)  # (T, 2k) in [0,32)
-    # One-hot against the 32 nibble rows of each data chunk.  Row layout of w_bits is
-    # (j, p, n): rows j*32..j*32+15 are chunk j's low-nibble values, +16..+31 high.
-    # The lo column one-hot occupies positions 0..15 and the (biased) hi column 16..31,
-    # so their sum is chunk j's combined 32-slot indicator with exactly two ones.
-    iota = jnp.arange(32, dtype=nib.dtype)
-    oh = (nib[:, :, None] == iota[None, None, :]).astype(dot_dtype)  # (T, 2k, 32)
-    oh = (oh[:, :k, :] + oh[:, k:, :]).reshape(t, k * 32)
+    bits = ((x[:, :, None].astype(jnp.int32) >> _BITW) & 1)
+    bits = bits.reshape(t, k * 8).astype(dot_dtype)
     acc = jax.lax.dot_general(
-        oh, w_bits.astype(dot_dtype),
+        bits, w_bits.astype(dot_dtype),
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32 if dot_dtype == jnp.bfloat16 else jnp.int32,
     )
-    bits = acc.astype(jnp.int32) & 1  # (T, m*8)
-    return jnp.sum(bits.reshape(t, m, 8) << _BIT_WEIGHTS, axis=-1).astype(jnp.uint8)
+    pb = acc.astype(jnp.int32) & 1  # (T, m*8)
+    return jnp.sum(pb.reshape(t, m, 8) << _BITW, axis=-1,
+                   dtype=jnp.int32).astype(jnp.uint8)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "m", "dot_dtype"))
-def _encode_impl(w_bits: jax.Array, data: jax.Array, *, k: int, m: int,
-                 dot_dtype: jnp.dtype) -> jax.Array:
-    """data: (S, k, B) uint8 -> parity (S, m, B) uint8."""
+def _encode_xla(w_bits: jax.Array, data: jax.Array, *, k: int, m: int,
+                dot_dtype=jnp.int8) -> jax.Array:
+    """data: (S, k, B) uint8 -> parity (S, m, B) uint8 via tiled bits @ W."""
     s, _, b = data.shape
     x = jnp.transpose(data, (0, 2, 1)).reshape(s * b, k)  # (SB, k)
     rows = s * b
     if rows <= _TILE_ROWS:
-        packed = _encode_tile(w_bits, x, k, m, dot_dtype)
+        packed = _xla_tile(w_bits, x, k, m, dot_dtype)
     else:
         pad = (-rows) % _TILE_ROWS
         if pad:
             x = jnp.concatenate([x, jnp.zeros((pad, k), dtype=x.dtype)])
         tiles = x.reshape(-1, _TILE_ROWS, k)
         packed = jax.lax.map(
-            lambda xt: _encode_tile(w_bits, xt, k, m, dot_dtype), tiles
+            lambda xt: _xla_tile(w_bits, xt, k, m, dot_dtype), tiles
         ).reshape(-1, m)[:rows]
     return jnp.transpose(packed.reshape(s, b, m), (0, 2, 1)).astype(jnp.uint8)
 
 
-def ec_encode_jax(coeff: np.ndarray, data, dot_dtype=jnp.bfloat16) -> jax.Array:
-    """One-shot encode (builds the bit table each call; use make_encoder for reuse)."""
+# ---------------------------------------------------------------------------
+# fused Pallas executor (TPU)
+# ---------------------------------------------------------------------------
+
+def _expand_bits(d: jax.Array, k: int) -> jax.Array:
+    """(k, B) uint8 -> (k*8, B) int8 bit planes: row j*8+t = bit t of chunk j."""
+    d32 = d.astype(jnp.int32)
+    rep = jnp.repeat(d32, 8, axis=0)
+    shifts = jnp.tile(jnp.arange(8, dtype=jnp.int32), k)[:, None]
+    return ((rep >> shifts) & 1).astype(jnp.int8)
+
+
+def _pallas_kernel(d_ref, w_ref, out_ref, *, k, m, g, bc, sb):
+    """One grid step: (sb, k, bc) uint8 -> (sb, m, bc) uint8 parity."""
+    bg = bc // g
+    outs = []
+    for s in range(sb):
+        bits = _expand_bits(d_ref[s], k)                     # (k8, bc) int8
+        bits4 = jnp.concatenate(
+            [bits[:, i * bg:(i + 1) * bg] for i in range(g)], axis=0)
+        acc = jax.lax.dot_general(
+            w_ref[...].T, bits4, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)                # (g*m8, bg)
+        pb = (acc.astype(jnp.int32) & 1).reshape(g, m, 8, bg)
+        bw = jnp.arange(8, dtype=jnp.int32)[None, None, :, None]
+        packed = jnp.sum(pb << bw, axis=2, dtype=jnp.int32)  # (g, m, bg)
+        outs.append(jnp.concatenate([packed[i] for i in range(g)], axis=1))
+    out_ref[...] = jnp.stack(outs).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "bc", "interpret"))
+def _encode_pallas(w_blk: jax.Array, data: jax.Array, *, k: int, m: int,
+                   bc: int, interpret: bool = False) -> jax.Array:
+    """data: (S, k, B) uint8 with S % _SB == 0 and B % bc == 0."""
+    s, _, b = data.shape
+    z = np.int32(0)  # concrete + 32-bit: neither a captured tracer under an
+    return pl.pallas_call(  # outer jit nor an i64 index under x64
+        functools.partial(_pallas_kernel, k=k, m=m, g=_G, bc=bc, sb=_SB),
+        grid=(s // _SB, b // bc),
+        in_specs=[
+            pl.BlockSpec((_SB, k, bc), lambda i, j: (i, z, j)),
+            pl.BlockSpec(w_blk.shape, lambda i, j: (z, z),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_SB, m, bc), lambda i, j: (i, z, j)),
+        out_shape=jax.ShapeDtypeStruct((s, m, b), jnp.uint8),
+        interpret=interpret,
+    )(data, w_blk)
+
+
+def _pick_bc(b: int) -> int | None:
+    """Lane-block width for the Pallas kernel: a divisor of B that is a
+    multiple of _G * 128 (each lane group needs >= one full vreg) and small
+    enough that per-stripe VMEM temporaries stay modest."""
+    for c in (4096, 2048, 1024, 512):
+        if b % c == 0:
+            return c
+    return None
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def _encode_dispatch(w_bits, w_blk, data, *, k, m, dot_dtype):
+    s, _, b = data.shape
+    bc = _pick_bc(b)
+    if w_blk is not None and bc is not None and jax.default_backend() == "tpu":
+        pad = (-s) % _SB
+        if pad:
+            data = jnp.concatenate(
+                [data, jnp.zeros((pad, k, b), dtype=data.dtype)])
+        out = _encode_pallas(w_blk, data, k=k, m=m, bc=bc)
+        return out[:s] if pad else out
+    return _encode_xla(w_bits, data, k=k, m=m, dot_dtype=dot_dtype)
+
+
+def ec_encode_jax(coeff: np.ndarray, data, dot_dtype=jnp.int8) -> jax.Array:
+    """One-shot encode (builds the bit tables each call; use make_encoder for reuse)."""
     coeff = np.asarray(coeff, dtype=np.uint8)
     m, k = coeff.shape
-    w = jnp.asarray(nibble_bit_table(coeff))
+    wb = bit_matrix(coeff)
+    w_bits = jnp.asarray(wb)
     data = jnp.asarray(data, dtype=jnp.uint8)
     squeeze = data.ndim == 2
     if squeeze:
         data = data[None]
-    out = _encode_impl(w, data, k=k, m=m, dot_dtype=dot_dtype)
+    # only pay the block-diagonal build + upload when the Pallas path can run
+    w_blk = (jnp.asarray(_blockdiag(wb, _G))
+             if jax.default_backend() == "tpu" and _pick_bc(data.shape[2])
+             else None)
+    out = _encode_dispatch(w_bits, w_blk, data, k=k, m=m, dot_dtype=dot_dtype)
     return out[0] if squeeze else out
 
 
-def make_encoder(coeff: np.ndarray, dot_dtype=jnp.bfloat16):
-    """Return a jitted encode(data (S,k,B) uint8) -> (S,m,B) with the table resident."""
+def make_encoder(coeff: np.ndarray, dot_dtype=jnp.int8):
+    """Return a jitted encode(data (S,k,B) uint8) -> (S,m,B) with tables resident."""
     coeff = np.asarray(coeff, dtype=np.uint8)
     m, k = coeff.shape
-    w = jax.device_put(jnp.asarray(nibble_bit_table(coeff)))
+    wb = bit_matrix(coeff)
+    w_bits = jax.device_put(jnp.asarray(wb))
+    w_blk = (jax.device_put(jnp.asarray(_blockdiag(wb, _G)))
+             if jax.default_backend() == "tpu" else None)
 
     def encode(data):
-        return _encode_impl(w, jnp.asarray(data, dtype=jnp.uint8),
-                            k=k, m=m, dot_dtype=dot_dtype)
+        return _encode_dispatch(w_bits, w_blk,
+                                jnp.asarray(data, dtype=jnp.uint8),
+                                k=k, m=m, dot_dtype=dot_dtype)
 
     return encode

@@ -40,8 +40,8 @@ def shard_map(f, **kwargs):
     return _shard_map(f, **kwargs)
 
 from ceph_tpu.gf.matrix import recovery_matrix
-from ceph_tpu.gf.tables import nibble_bit_table
-from ceph_tpu.ops.gf_kernel import _encode_impl
+from ceph_tpu.gf.tables import bit_matrix
+from ceph_tpu.ops.gf_kernel import _encode_xla as _encode_impl
 from ceph_tpu.ops.crush_kernel import flat_firstn
 
 
@@ -54,7 +54,7 @@ def sharded_encode(mesh, coeff: np.ndarray, data, dot_dtype=jnp.bfloat16):
     """
     coeff = np.asarray(coeff, dtype=np.uint8)
     m, k = coeff.shape
-    w = jnp.asarray(nibble_bit_table(coeff))
+    w = jnp.asarray(bit_matrix(coeff))
     spec = NamedSharding(mesh, P(("dp", "ec"), None, None))
     data = jax.device_put(jnp.asarray(data, dtype=jnp.uint8), spec)
     fn = jax.jit(
@@ -90,10 +90,10 @@ def make_cluster_step(mesh, gen: np.ndarray, ids, weights, reweight,
     if n_chunks % ec_size:
         raise ValueError(f"k+m={n_chunks} not divisible by ec axis {ec_size}")
     coding = gen[k:]
-    w_enc = jnp.asarray(nibble_bit_table(coding))
+    w_enc = jnp.asarray(bit_matrix(coding))
     chosen = [i for i in range(n_chunks) if i not in set(erasures)][:k]
     rmat = recovery_matrix(gen, chosen, list(erasures))
-    w_rec = jnp.asarray(nibble_bit_table(rmat))
+    w_rec = jnp.asarray(bit_matrix(rmat))
     n_lost = len(erasures)
     chosen_arr = jnp.asarray(chosen, dtype=jnp.int32)
     lost_arr = jnp.asarray(list(erasures), dtype=jnp.int32)
