@@ -269,42 +269,6 @@ class FastMapper:
                 # degrade to the slower XLA path
                 self._pallas = PallasColumns(fr)
 
-    def _winners_pallas(self, xs, reweight, R: int):
-        """host_win/leaf_win/leaf_bad via the fused kernels (which pad
-        the batch to their block quantum internally); (N, R) views."""
-        n = xs.shape[0]
-        pos, ids, bad = self._pallas.root_columns(xs, reweight, R)
-        if self.fr.kind == "choose_flat":
-            hw = lw = ids.T[:n]
-            lb = bad.T[:n] != 0
-        else:
-            lid, lbad = self._pallas.leaf_columns(xs, pos, reweight, R)
-            hw = ids.T[:n]
-            lw = lid.T[:n]
-            lb = lbad.T[:n] != 0
-        return hw, lw, lb
-
-    def _winners_pallas_fast(self, xs, reweight, R: int):
-        """Approx-filtered winners with the exact columns as the
-        certified fallback: if any (x, r) column had more than K items
-        inside the f32 error band, the whole batch re-runs exact —
-        bit-exactness is unconditional, the filter is only a schedule."""
-        n = xs.shape[0]
-        pos, ids, bad, ovf = self._pallas.root_columns_fast(
-            xs, reweight, R)
-        if self.fr.kind == "choose_flat":
-            fast = (ids.T[:n], ids.T[:n], bad.T[:n] != 0)
-            need_exact = jnp.any(ovf != 0)
-        else:
-            lid, lbad, ovf2 = self._pallas.leaf_columns_fast(
-                xs, pos, reweight, R)
-            fast = (ids.T[:n], lid.T[:n], lbad.T[:n] != 0)
-            need_exact = jnp.any(ovf != 0) | jnp.any(ovf2 != 0)
-        return jax.lax.cond(
-            need_exact,
-            lambda _: self._winners_pallas(xs, reweight, R),
-            lambda _: fast, None)
-
     def _winners(self, xs, reweight, R: int):
         """host_win/leaf_win/leaf_bad for r in [0, R): a fori_loop producing
         one r column per step (bounds the (N, H) ln-matmul intermediates to a
@@ -343,6 +307,125 @@ class FastMapper:
 
         return jax.lax.fori_loop(0, R, body, (hw0, lw0, lb0))
 
+    def _winners_cols(self, xs, reweight, R: int):
+        """(host_win, leaf_win, leaf_bad) in the native (R, n_padded)
+        column layout of the Pallas kernels (no transposes).
+
+        Root columns go through the fused approx-filter kernel when the
+        R columns' candidates fit one lane block; its certificate flag
+        (any column with more than K items inside the measured f32
+        error band) falls the whole batch back to the exact column
+        kernel, so bit-exactness is unconditional."""
+        pc = self._pallas
+        from ceph_tpu.ops.pallas_straw2 import _KPACK
+        if R * _KPACK <= 128 and 512 <= pc.S_root <= 1024:
+            # the approx filter narrows each column from S items to K
+            # candidates — a win only when S spans many slabs (big flat
+            # buckets); at host-count-sized roots the packing machinery
+            # costs more than the exact pipeline it saves (measured).
+            # Upper bound: the extractor packs item positions into 10
+            # bits (pallas_straw2._extract_candidates), so past 1024
+            # items the certificate would fire on every batch and the
+            # filter pass would be pure overhead
+            pos, ids, ovf = pc.froot_columns(xs, reweight, R)
+            pos, ids = jax.lax.cond(
+                jnp.any(ovf != 0),
+                lambda _: pc.root_columns(xs, reweight, R),
+                lambda _: (pos, ids), None)
+        else:
+            pos, ids = pc.root_columns(xs, reweight, R)
+        # the winner columns come back padded to the kernel block quantum
+        n_pad = ids.shape[1]
+        xs_pad = jnp.concatenate(
+            [xs, jnp.zeros((n_pad - xs.shape[0],), dtype=xs.dtype)]) \
+            if n_pad > xs.shape[0] else xs
+        if self.fr.kind == "choose_flat":
+            # is_out runs OUTSIDE the kernels: it is elementwise in
+            # (winner, x), one cheap XLA op over the columns — and the
+            # in-kernel variant hit a Mosaic miscompile (hash32_2 fed
+            # from the winner gather/sum pipeline went wrong for ~0.03%
+            # of lanes, compiled mode only; caught by TPU-vs-XLA
+            # cross-validation in round 3)
+            bad = is_out(reweight, ids, xs_pad[None, :])
+            return ids, ids, bad
+        lid = self._pallas.leaf_columns(xs, pos, R)
+        lbad = is_out(reweight, lid, xs_pad[None, :])
+        return ids, lid, lbad
+
+    #: minimum batch for the two-stage schedule; below it one pass at R0
+    #: is cheaper than the compaction plumbing
+    TWO_STAGE_MIN = 32768
+    #: stage-2 capacity: lanes whose ladder outran the stage-1 columns.
+    #: At realistic reject/collision rates the expected count is a few
+    #: hundred per 64Ki (p ~ fail^2 per lane); 4096 makes the capacity
+    #: overflow a tail-of-tail event, and the guard recomputes the whole
+    #: batch when it ever fires, so it costs latency, never correctness.
+    STAGE2_CAP = 4096
+
+    def _run_pallas(self, xs, reweight, result_max, numrep, R0, Rf):
+        """Winner columns and the consume ladder both on-device in their
+        native (R, N) layout — no transposes, no XLA while_loops.
+
+        Bulk batches run a two-stage schedule: stage 1 computes only
+        numrep+1 columns for every lane (covers lanes whose firstn
+        ladder saw at most one failure in the last replica — ~99% at
+        realistic maps), then gathers the overflowing lanes into one
+        compact STAGE2_CAP batch that gets the full R0 treatment.  The
+        placement for a given x is identical either way — the ladder is
+        deterministic in (x, columns) — so this is pure scheduling, the
+        oracle-equivalence property is untouched."""
+        from ceph_tpu.ops.pallas_straw2 import consume_columns
+        fr = self.fr
+        n = xs.shape[0]
+        interp = self._pallas.interpret
+
+        def attempt(xv, R):
+            m = xv.shape[0]
+            hw, lw, lb = self._winners_cols(xv, reweight, R)
+            oh, ol, ovf = consume_columns(
+                hw, lw, lb, numrep=numrep, tries=fr.tries, interpret=interp)
+            return oh[:, :m], ol[:, :m], ovf[:m]
+
+        def attempt_full(xv, R):
+            oh, ol, ovf = attempt(xv, R)
+            return jax.lax.cond(
+                jnp.any(ovf != 0),
+                lambda _: attempt(xv, Rf)[:2],
+                lambda _: (oh, ol), None)
+
+        R1 = numrep + 1
+        if n < self.TWO_STAGE_MIN or R1 >= R0:
+            out_h, out_l = attempt_full(xs, R0)
+        else:
+            oh1, ol1, ovf1 = attempt(xs, R1)
+            cap = self.STAGE2_CAP
+            need = ovf1 != 0
+            # overflowing lanes first, stable, then fillers
+            order = jnp.argsort(jnp.where(need, 0, 1), stable=True)
+            idx_c = order[:cap]
+            xs2 = xs[idx_c]
+
+            def merged(_):
+                oh2, ol2 = attempt_full(xs2, R0)
+                sel = need[idx_c][None, :]
+                oh = oh1.at[:, idx_c].set(
+                    jnp.where(sel, oh2, oh1[:, idx_c]))
+                ol = ol1.at[:, idx_c].set(
+                    jnp.where(sel, ol2, ol1[:, idx_c]))
+                return oh, ol
+
+            out_h, out_l = jax.lax.cond(
+                jnp.sum(need) > cap,
+                lambda _: attempt_full(xs, R0),
+                merged, None)
+        res = out_l if fr.kind == "chooseleaf" else out_h
+        res = _compact_rows(res.T)
+        if numrep < result_max:
+            res = jnp.concatenate(
+                [res, jnp.full((n, result_max - numrep), NONE,
+                               dtype=jnp.int32)], axis=1)
+        return res[:, :result_max]
+
     def run(self, xs, reweight, result_max: int,
             block: int = DEFAULT_BLOCK):
         """Full do_rule: returns (N, result_max) NONE-compacted placements."""
@@ -356,26 +439,14 @@ class FastMapper:
         Rf = fr.tries + numrep
         R0 = min(numrep + block, Rf)
 
-        def winners_for(R):
-            if self._pallas is None:
-                return self._winners
-            # the candidate-packed approx kernels (winners_pallas_fast)
-            # are bit-exact and interpret-verified, but the axon AOT
-            # backend compiles their two-phase program pathologically
-            # (minutes to never) at bulk shapes — opt-in only until the
-            # toolchain digests them
-            import os
-            from ceph_tpu.ops.pallas_straw2 import _KPACK
-            if (os.environ.get("CEPH_TPU_FAST_FILTER") == "1"
-                    and R * _KPACK <= 128):
-                return self._winners_pallas_fast
-            return self._winners_pallas
+        if self._pallas is not None:
+            return self._run_pallas(xs, reweight, result_max, numrep, R0, Rf)
 
-        hw, lw, lb = winners_for(R0)(xs, reweight, R0)
+        hw, lw, lb = self._winners(xs, reweight, R0)
         out_h, out_l, ovf = _consume(hw, lw, lb, numrep, fr.tries, R0, n)
 
         def slow(_):
-            hw2, lw2, lb2 = winners_for(Rf)(xs, reweight, Rf)
+            hw2, lw2, lb2 = self._winners(xs, reweight, Rf)
             oh, ol, _ = _consume(hw2, lw2, lb2, numrep, fr.tries, Rf, n)
             return oh, ol
 

@@ -186,16 +186,6 @@ def _first_min(q_hi, q_lo, ids):
     return min_hi[:, 0], min_lo[:, 0], pos, wid, first
 
 
-def _is_out_scalar(rw, item, x):
-    """is_out (mapper.c:424-438) for already-gathered reweight values;
-    all (B,) vectors."""
-    keep_full = rw >= _I32(0x10000)
-    zero = rw == 0
-    h = hash32_2(x, item.astype(_U32)) & _U32(0xFFFF)
-    keep_prob = h.astype(_I32) < rw
-    return ~(keep_full | ((~zero) & keep_prob))
-
-
 def _draw_slab(x, ids, wz, magic_planes, off, tabs, r):
     """One 128-lane slab of a straw2 column: (B,) x, (B, 128) item
     operands -> winner (q_hi, q_lo, pos, wid, first).  Slabs are 128 wide
@@ -244,11 +234,18 @@ def _store_row(ref, r, value):
     ref[pl.dslice(r, 1), :] = value[None, :]
 
 
-def _root_kernel(xs_ref, ids_ref, wz_ref, magic_ref, off_ref, rw_ref,
+def _root_kernel(xs_ref, ids_ref, wz_ref, magic_ref, off_ref,
                  rhlh_ref, ll_lo_ref, ll_hi_ref,
-                 pos_ref, id_ref, bad_ref, *, flat, S, rh128):
+                 pos_ref, id_ref, *, S, rh128):
     """Grid (n//B, R): one (block, r) column per step — r rides the grid
-    so the kernel stays small enough for Mosaic to compile quickly."""
+    so the kernel stays small enough for Mosaic to compile quickly.
+
+    is_out verdicts are NOT computed here: they are elementwise in
+    (winner, x) and run as one cheap XLA op over the output columns
+    (crush_kernel.is_out).  Keeping them out of the kernel also dodged a
+    real Mosaic miscompile: hash32_2 fed from the gather/sum winner
+    pipeline produced wrong values for ~0.03% of lanes (see r03 notes in
+    fastpath._winners_cols)."""
     r = pl.program_id(1)
     x = xs_ref[0, :]
     tabs = (rhlh_ref, ll_lo_ref, ll_hi_ref, rh128)
@@ -260,25 +257,17 @@ def _root_kernel(xs_ref, ids_ref, wz_ref, magic_ref, off_ref, rw_ref,
                 off_ref[0, sl][None, :])
 
     def rw_of(slab, first):
-        if not flat:
-            return jnp.zeros((x.shape[0],), dtype=_I32)
-        sl = slice(slab * 128, (slab + 1) * 128)
-        return jnp.sum(jnp.where(first, rw_ref[0, sl][None, :], _I32(0)),
-                       axis=1, dtype=_I32)
+        return jnp.zeros((x.shape[0],), dtype=_I32)
 
-    _qh, _ql, pos, wid, rwv = _column_over_slabs(
+    _qh, _ql, pos, wid, _rwv = _column_over_slabs(
         x, S, tabs, r.astype(_U32), operands, rw_of)
     _store_row(pos_ref, r, pos)
     _store_row(id_ref, r, wid)
-    if flat:
-        _store_row(bad_ref, r, _is_out_scalar(rwv, wid, x).astype(_I32))
-    else:
-        _store_row(bad_ref, r, jnp.zeros_like(pos))
 
 
-def _leaf_kernel(xs_ref, pos_ref, static_ref, rw_ref,
+def _leaf_kernel(xs_ref, pos_ref, static_ref,
                  rhlh_ref, ll_lo_ref, ll_hi_ref,
-                 id_ref, bad_ref, *, H, S, vary_r, rh128):
+                 id_ref, *, H, S, vary_r, rh128):
     r = pl.program_id(1)
     if vary_r:
         r_leaf = (r >> (vary_r - 1)).astype(_U32)
@@ -289,8 +278,8 @@ def _leaf_kernel(xs_ref, pos_ref, static_ref, rw_ref,
     tabs = (rhlh_ref, ll_lo_ref, ll_hi_ref, rh128)
     pos = pos_ref[pl.dslice(r, 1), :][0, :]   # this r's root winners
     # exact f32 one-hot row gather of the winning host's packed
-    # fields: [ids | wz | off | magic0..magic4] (each S wide) + the
-    # reweight row (dynamic) — a vectorized row gather on the MXU
+    # fields: [ids | wz | off | magic0..magic4] (each S wide) — a
+    # vectorized row gather on the MXU
     oh = jnp.where(pos[:, None] == iota, jnp.float32(1.0),
                    jnp.float32(0.0))
     # HIGHEST precision: the default TPU matmul truncates f32 operands
@@ -298,9 +287,6 @@ def _leaf_kernel(xs_ref, pos_ref, static_ref, rw_ref,
     rows = jnp.dot(oh, static_ref[...],
                    preferred_element_type=jnp.float32,
                    precision=jax.lax.Precision.HIGHEST)   # (B, 8*S)
-    rwrow = jnp.dot(oh, rw_ref[...],
-                    preferred_element_type=jnp.float32,
-                    precision=jax.lax.Precision.HIGHEST)  # (B, S)
 
     def operands(slab):
         sl = slice(slab * 128, (slab + 1) * 128)
@@ -316,15 +302,11 @@ def _leaf_kernel(xs_ref, pos_ref, static_ref, rw_ref,
                 .astype(_I32))
 
     def rw_of(slab, first):
-        sl = slice(slab * 128, (slab + 1) * 128)
-        return jnp.sum(
-            jnp.where(first, rwrow[:, sl].astype(_I32), _I32(0)),
-            axis=1, dtype=_I32)
+        return jnp.zeros((x.shape[0],), dtype=_I32)
 
-    _qh, _ql, _pos_l, wid, rwv = _column_over_slabs(
+    _qh, _ql, _pos_l, wid, _rwv = _column_over_slabs(
         x, S, tabs, r_leaf, operands, rw_of)
     _store_row(id_ref, r, wid)
-    _store_row(bad_ref, r, _is_out_scalar(rwv, wid, x).astype(_I32))
 
 
 # ---------------------------------------------------------------------------
@@ -451,7 +433,7 @@ def _extract_candidates(bands, K):
 
 
 #: candidate field order shared by the phase-1 and phase-2 kernels
-_FIELDS = ("pos", "ids", "wz", "off", "m0", "m1", "m2", "m3", "m4", "rw")
+_FIELDS = ("pos", "ids", "wz", "off", "m0", "m1", "m2", "m3", "m4")
 
 #: candidate rows per column in the packed lane layout: K real
 #: candidates padded to the 8-lane segment quantum with dummies
@@ -487,134 +469,12 @@ def _shift_to_segment(packed, r):
     return _row_lookup(jnp.broadcast_to(idx, (b, 128)), packed)
 
 
-def _cand_root_kernel(xs_ref, ids_ref, wz_ref, wf_ref, magic_ref, off_ref,
-                      rw_ref, *out_refs, S, rh128, D):
-    """Phase 1, grid (n//B, R): approx-filter ONE root column, emit its
-    K candidates' operand fields as (KPACK, B) rows (+ the certificate
-    flag)."""
-    del rh128  # tables unused in the approx phase
-    r = pl.program_id(1)
-    x = xs_ref[0, :]
-    n_slabs = S // 128
-
-    def slab_ops(s):
-        sl = slice(s * 128, (s + 1) * 128)
-        return (ids_ref[0, sl][None, :],
-                wf_ref[0, sl][None, :],
-                wz_ref[0, sl][None, :] != 0)
-
-    bands = _approx_column(x, r.astype(_U32), slab_ops, n_slabs, D)
-    positions, missed = _extract_candidates(bands, _K)
-
-    def row_of(name):
-        def rows(s):
-            sl = slice(s * 128, (s + 1) * 128)
-            if name == "ids":
-                return ids_ref[0, sl]
-            if name == "wz":
-                return wz_ref[0, sl]
-            if name == "off":
-                return off_ref[0, sl]
-            if name == "rw":
-                return rw_ref[0, sl]
-            j = int(name[1])
-            return magic_ref[j, sl].astype(_I32)
-        return rows
-
-    _emit_fields(positions, row_of, out_refs, n_slabs, r, missed,
-                 x.shape[0])
-
-
-def _cand_leaf_kernel(xs_ref, pos_ref, static_ref, rw_ref, *out_refs,
-                      H, S, vary_r, rh128, D):
-    """Phase 1 for leaf columns: one-hot host-row fetch for this r, then
-    approx-filter + candidate emit (same output layout as root)."""
-    del rh128
-    r = pl.program_id(1)
-    if vary_r:
-        r_leaf = (r >> (vary_r - 1)).astype(_U32)
-    else:
-        r_leaf = _U32(0)
-    x = xs_ref[0, :]
-    iota_h = jax.lax.broadcasted_iota(_I32, (1, H), 1)
-    pos_r = pos_ref[pl.dslice(r, 1), :][0, :]
-    oh = jnp.where(pos_r[:, None] == iota_h, jnp.float32(1.0),
-                   jnp.float32(0.0))
-    rows = jnp.dot(oh, static_ref[...],
-                   preferred_element_type=jnp.float32,
-                   precision=jax.lax.Precision.HIGHEST)   # (B, 9*S)
-    rwrow = jnp.dot(oh, rw_ref[...],
-                    preferred_element_type=jnp.float32,
-                    precision=jax.lax.Precision.HIGHEST)  # (B, S)
-
-    def col(base, s):
-        return rows[:, base * S + s * 128:base * S + (s + 1) * 128]
-
-    def slab_ops(s):
-        return (col(0, s).astype(_I32),
-                jnp.maximum(col(8, s), jnp.float32(1.0)),
-                col(1, s) != 0)
-
-    bands = _approx_column(x, r_leaf, slab_ops, S // 128, D)
-    positions, missed = _extract_candidates(bands, _K)
-
-    def row_of(name):
-        def rows_of(s):
-            if name == "ids":
-                return col(0, s).astype(_I32)
-            if name == "wz":
-                return col(1, s).astype(_I32)
-            if name == "off":
-                return col(2, s).astype(_I32)
-            if name == "rw":
-                return rwrow[:, s * 128:(s + 1) * 128].astype(_I32)
-            j = int(name[1])
-            return col(3 + j, s).astype(_I32)
-        return rows_of
-
-    _emit_fields(positions, row_of, out_refs, S // 128, r, missed,
-                 x.shape[0])
-
-
-def _emit_fields(positions, row_of, out_refs, n_slabs, r, missed, B):
-    """Pack the K candidates' operand fields into lanes [0, KPACK) with
-    one gather per field, shift them to this column's lane segment
-    [r*KPACK, ..), and merge into the revisited (B, 128) output blocks
-    (read-modify-write: the grid iterates r innermost, so the block
-    stays resident in VMEM across the whole lane sweep)."""
-    field_refs = out_refs[:len(_FIELDS)]
-    ovf_ref = out_refs[len(_FIELDS)]
-    lane = jax.lax.broadcasted_iota(_I32, (B, 128), 1)
-    in_seg = (lane >= (r * _I32(_KPACK))[None, None]) \
-        & (lane < ((r + 1) * _I32(_KPACK))[None, None])
-    dummies = {"pos": _I32(2 ** 31 - 1), "wz": _I32(1)}
-    for name, f_ref in zip(_FIELDS, field_refs):
-        if name == "pos":
-            packed = jnp.full((B, 128), dummies["pos"])
-            for k, p in enumerate(positions):
-                packed = jnp.where(lane == _I32(k), p[:, None], packed)
-        else:
-            packed = _gather_packed(positions, row_of(name), n_slabs)
-            # dummy padding rows (k in [K, KPACK)) must never win
-            packed = jnp.where(
-                (lane >= _I32(len(positions))) & (lane < _I32(_KPACK)),
-                dummies.get(name, _I32(0)), packed)
-        shifted = _shift_to_segment(packed, r)
-        f_ref[...] = jnp.where(in_seg, shifted, f_ref[...])
-    _store_row(ovf_ref, r, missed)
-
-
-def _verify_kernel(xs_ref, pos_ref, ids_ref, wz_ref, off_ref,
-                   m0_ref, m1_ref, m2_ref, m3_ref, m4_ref, rw_ref,
-                   rhlh_ref, ll_lo_ref, ll_hi_ref,
-                   wpos_ref, wid_ref, bad_ref,
-                   *, R, vary_r, want_bad, rh128):
-    """Phase 2, grid (n//B,): the exact pipeline over the lane-packed
-    candidate block (lane r*KPACK+k = candidate k of column r — the
-    layout phase 1 emits natively), then per-r segment winners."""
-    x = xs_ref[0, :]
+def _verify_packed(x, pos_p, ids_p, wz_p, off_p, magic_p, tabs,
+                   *, R, vary_r):
+    """The exact pipeline over a lane-packed candidate block (lane
+    r*KPACK+k = candidate k of column r), then per-r segment winners.
+    Returns two per-r lists of (B,) vectors: (wpos, wid)."""
     B = x.shape[0]
-    tabs = (rhlh_ref, ll_lo_ref, ll_hi_ref, rh128)
     lane = jax.lax.broadcasted_iota(_I32, (B, 128), 1)
     valid = lane < _I32(R * _KPACK)
     seg_r = lane // _I32(_KPACK)
@@ -625,20 +485,13 @@ def _verify_kernel(xs_ref, pos_ref, ids_ref, wz_ref, off_ref,
                           _I32(0)).astype(_U32)
     else:
         r_vec = jnp.zeros((B, 128), dtype=_U32)
-    ids_p = ids_ref[...]
-    wz_p = wz_ref[...]
-    off_p = off_ref[...]
-    pos_p = pos_ref[...]
-    magic_p = [m0_ref[...].astype(_U32), m1_ref[...].astype(_U32),
-               m2_ref[...].astype(_U32), m3_ref[...].astype(_U32),
-               m4_ref[...].astype(_U32)]
     u = hash32_3(x[:, None], ids_p, r_vec) & _U32(0xFFFF)
     p_hi, p_lo = _ln_p48_pl(u, *tabs[:3], tabs[3])
     q_hi, q_lo = _magic_div_pl(p_hi, p_lo, magic_p, off_p)
     bad = (wz_p != 0) | ~valid
     q_hi = jnp.where(bad, _U32(0xFFFFFFFF), q_hi)
     q_lo = jnp.where(bad, _U32(0xFFFFFFFF), q_lo)
-    rw_p = rw_ref[...]
+    wposs, wids = [], []
     for r in range(R):
         m = (seg_r == _I32(r)) & valid
         qh = jnp.where(m, q_hi, _U32(0xFFFFFFFF))
@@ -653,15 +506,150 @@ def _verify_kernel(xs_ref, pos_ref, ids_ref, wz_ref, off_ref,
         first = on & (pos_p == minpos) & m
         wid = jnp.sum(jnp.where(first, ids_p, _I32(0)), axis=1,
                       dtype=_I32)
-        _store_row(wpos_ref, r, minpos[:, 0])
-        _store_row(wid_ref, r, wid)
-        if want_bad:
-            rwv = jnp.sum(jnp.where(first, rw_p, _I32(0)), axis=1,
-                          dtype=_I32)
-            _store_row(bad_ref, r,
-                       _is_out_scalar(rwv, wid, x).astype(_I32))
-        else:
-            _store_row(bad_ref, r, jnp.zeros_like(wid))
+        wposs.append(minpos[:, 0])
+        wids.append(wid)
+    return wposs, wids
+
+
+def _froot_kernel(xs_ref, ids_ref, wz_ref, wf_ref, magic_ref, off_ref,
+                  rhlh_ref, ll_lo_ref, ll_hi_ref,
+                  pos_ref, id_ref, ovf_ref,
+                  *, S, R, rh128, D):
+    """Fused single-phase root columns: approx-filter every r column,
+    pack the K candidates of all R columns into one (B, 128) lane block
+    IN VMEM, run the exact pipeline once, emit per-r winners.
+
+    This replaces the two-phase root_columns_fast whose staged candidate
+    fields round-tripped ~10 (n, 128) i32 arrays through HBM between two
+    pallas_calls — the layout the AOT toolchain compiled pathologically.
+    One kernel, no staged state, same certificate: any (x, r) column
+    with more than K items inside the measured f32 error band raises the
+    overflow flag and the caller re-runs the exact column kernels."""
+    x = xs_ref[0, :]
+    B = x.shape[0]
+    n_slabs = S // 128
+    lane = jax.lax.broadcasted_iota(_I32, (B, 128), 1)
+    tabs = (rhlh_ref, ll_lo_ref, ll_hi_ref, rh128)
+
+    def slab_ops(s):
+        sl = slice(s * 128, (s + 1) * 128)
+        return (ids_ref[0, sl][None, :],
+                wf_ref[0, sl][None, :],
+                wz_ref[0, sl][None, :] != 0)
+
+    def row_of(name):
+        def rows(s):
+            sl = slice(s * 128, (s + 1) * 128)
+            if name == "ids":
+                return ids_ref[0, sl]
+            if name == "wz":
+                return wz_ref[0, sl]
+            if name == "off":
+                return off_ref[0, sl]
+            j = int(name[1])
+            return magic_ref[j, sl].astype(_I32)
+        return rows
+
+    packed = {name: jnp.full((B, 128), _I32(2 ** 31 - 1)) if name == "pos"
+              else jnp.zeros((B, 128), dtype=_I32) for name in _FIELDS}
+    missed_all = jnp.zeros((B,), dtype=_I32)
+    for r in range(R):
+        bands = _approx_column(x, _U32(r), slab_ops, n_slabs, D)
+        positions, missed = _extract_candidates(bands, _K)
+        missed_all = jnp.maximum(missed_all, missed)
+        in_seg = (lane >= _I32(r * _KPACK)) & (lane < _I32((r + 1) * _KPACK))
+        for name in _FIELDS:
+            if name == "pos":
+                pk = jnp.full((B, 128), _I32(2 ** 31 - 1))
+                for k, p in enumerate(positions):
+                    pk = jnp.where(lane == _I32(k), p[:, None], pk)
+            else:
+                pk = _gather_packed(positions, row_of(name), n_slabs)
+                pk = jnp.where(
+                    (lane >= _I32(len(positions))) & (lane < _I32(_KPACK)),
+                    _I32(1) if name == "wz" else _I32(0), pk)
+            shifted = _shift_to_segment(pk, _I32(r))
+            packed[name] = jnp.where(in_seg, shifted, packed[name])
+    magic_p = [packed[f"m{j}"].astype(_U32) for j in range(5)]
+    wposs, wids = _verify_packed(
+        x, packed["pos"], packed["ids"], packed["wz"], packed["off"],
+        magic_p, tabs, R=R, vary_r=None)
+    for r in range(R):
+        _store_row(pos_ref, r, wposs[r])
+        _store_row(id_ref, r, wids[r])
+    _store_row(ovf_ref, 0, missed_all)
+
+
+def _consume_kernel(hw_ref, lw_ref, lb_ref, outh_ref, outl_ref, ovf_ref,
+                    *, R, numrep, tries):
+    """The firstn ladder over precomputed winner columns, fully unrolled.
+
+    crush_choose_firstn (mapper.c:460-648) resets ftotal per replica and
+    draws with r = rep + ftotal; within one replica every attempt either
+    places (done) or fails (ftotal + 1), so an active lane at unroll step
+    i of replica rep has ftotal == i exactly — r = rep + i is a STATIC
+    row index into the winner columns.  That turns the XLA while_loop
+    ladder (46 ms at the 64Ki bulk shape — as expensive as the draws it
+    consumes) into ~numrep*R unrolled vector ops with no dynamic gathers.
+
+    Collision semantics: a candidate collides if its host or device id
+    equals ANY already-placed slot (earlier replicas only — the current
+    replica has not placed yet), matching _consume/mapper.c; NONE slots
+    (exhausted replicas) never match a real id.  Lanes that walk past the
+    last precomputed column while still active raise the overflow flag,
+    upon which the caller re-runs with the full r range."""
+    b = hw_ref.shape[1]
+    none_v = jnp.full((b,), _I32(0x7FFFFFFF))  # CRUSH_ITEM_NONE
+    sel_h = [none_v for _ in range(numrep)]
+    sel_l = [none_v for _ in range(numrep)]
+    ovf = jnp.zeros((b,), dtype=jnp.bool_)
+    for rep in range(numrep):
+        done = jnp.zeros((b,), dtype=jnp.bool_)
+        steps = min(tries, R - rep)
+        for i in range(steps):
+            r = rep + i
+            hb = hw_ref[r, :]
+            lf = lw_ref[r, :]
+            bad = lb_ref[r, :] != 0
+            for j in range(numrep):
+                bad = bad | (sel_h[j] == hb) | (sel_l[j] == lf)
+            place = ~done & ~bad
+            sel_h[rep] = jnp.where(place, hb, sel_h[rep])
+            sel_l[rep] = jnp.where(place, lf, sel_l[rep])
+            done = done | place
+            if i + 1 >= tries:
+                done = jnp.ones((b,), dtype=jnp.bool_)
+        # active lanes that ran out of columns (ft < tries): overflow
+        ovf = ovf | (~done if steps < tries else jnp.zeros((b,), jnp.bool_))
+    for rep in range(numrep):
+        _store_row(outh_ref, rep, sel_h[rep])
+        _store_row(outl_ref, rep, sel_l[rep])
+    _store_row(ovf_ref, 0, ovf.astype(jnp.int32))
+
+
+def consume_columns(hw, lw, lb, *, numrep: int, tries: int,
+                    interpret: bool = False):
+    """(R, N) winner columns -> (out_h, out_l, ovf): (numrep, N) int32
+    selections with NONE holes and an (N,) overflow flag."""
+    R, n = hw.shape
+    B = min(BLOCK, n)
+    z = np.int32(0)
+    col = lambda: pl.BlockSpec((R, B), lambda i: (z, i))
+    outs = [jax.ShapeDtypeStruct((numrep, n), jnp.int32),
+            jax.ShapeDtypeStruct((numrep, n), jnp.int32),
+            jax.ShapeDtypeStruct((1, n), jnp.int32)]
+    out_specs = [pl.BlockSpec((numrep, B), lambda i: (z, i)),
+                 pl.BlockSpec((numrep, B), lambda i: (z, i)),
+                 pl.BlockSpec((1, B), lambda i: (z, i))]
+    oh, ol, ovf = pl.pallas_call(
+        functools.partial(_consume_kernel, R=R, numrep=numrep, tries=tries),
+        grid=(n // B,),
+        out_shape=outs,
+        in_specs=[col(), col(), col()],
+        out_specs=out_specs,
+        interpret=interpret,
+    )(hw, lw, lb.astype(jnp.int32))
+    return oh, ol, ovf[0]
 
 
 def _pad_lanes(n: int) -> int:
@@ -770,170 +758,80 @@ class PallasColumns:
                             memory_space=pltpu.VMEM)
 
     def root_columns(self, xs, reweight, R: int):
-        """xs (N,) uint32 -> (pos, ids, bad) each (R, N) int32.
-        bad is meaningful only for flat rules (devices at level one).
+        """xs (N,) uint32 -> (pos, ids) each (R, N) int32.  is_out
+        verdicts are computed by the caller in XLA (elementwise).
         Batches that are not a BLOCK multiple are zero-padded here."""
+        del reweight
         S = self.S_root
-        flat = self.fr.kind == "choose_flat"
-        if flat:
-            rw = jnp.asarray(reweight).astype(jnp.int32)[
-                jnp.clip(self.root_ids[0], 0, len(reweight) - 1)][None, :]
-        else:
-            rw = jnp.zeros((1, S), dtype=jnp.int32)
         xs, n, B = _pad_block(xs)
         grid = (n // B, R)     # r innermost: output blocks revisited
-        outs = [jax.ShapeDtypeStruct((R, n), jnp.int32) for _ in range(3)]
-        out_specs = [pl.BlockSpec((R, B), lambda i, r: (jnp.int32(0), i))
-                     for _ in range(3)]
-        fs = self._fullspec
-        rh, ll_lo, ll_hi = self.tabs
-        pos, ids, bad = pl.pallas_call(
-            functools.partial(_root_kernel, flat=flat, S=S,
-                              rh128=self.rh128),
-            grid=grid,
-            out_shape=outs,
-            in_specs=[pl.BlockSpec((1, B), lambda i, r: (jnp.int32(0), i)),
-                      fs((1, S)), fs((1, S)), fs((5, S)), fs((1, S)),
-                      fs((1, S)), fs(rh.shape), fs(ll_lo.shape),
-                      fs(ll_hi.shape)],
-            out_specs=out_specs,
-            interpret=self.interpret,
-        )(xs[None, :], self.root_ids, self.root_wz, self.root_magic,
-          self.root_off, rw, rh, ll_lo, ll_hi)
-        return pos, ids, bad
-
-    def _verify(self, xs_p, n, B, fields, R, vary_r, want_bad):
-        """Phase 2 glue: run the exact verify kernel over the (n, 128)
-        lane-packed candidate fields phase 1 emitted — no relayout
-        anywhere."""
-        del B
-        # the lane block must divide the padded batch exactly: a partial
-        # tail block would leave those winners as uninitialized garbage
-        Bv = 256 if n % 256 == 0 else 128
-        fs1 = lambda shape: pl.BlockSpec(
-            shape, lambda i: tuple(jnp.int32(0) for _ in shape),
-            memory_space=pltpu.VMEM)
-        rh, ll_lo, ll_hi = self.tabs
-        outs = [jax.ShapeDtypeStruct((R, n), jnp.int32) for _ in range(3)]
-        out_specs = [pl.BlockSpec((R, Bv), lambda i: (jnp.int32(0), i))
-                     for _ in range(3)]
-        return pl.pallas_call(
-            functools.partial(_verify_kernel, R=R, vary_r=vary_r,
-                              want_bad=want_bad, rh128=self.rh128),
-            grid=(n // Bv,),
-            out_shape=outs,
-            in_specs=[pl.BlockSpec((1, Bv), lambda i: (jnp.int32(0), i))]
-                     + [pl.BlockSpec((Bv, 128),
-                                     lambda i: (i, jnp.int32(0)))
-                        for _ in fields]
-                     + [fs1(rh.shape), fs1(ll_lo.shape), fs1(ll_hi.shape)],
-            out_specs=out_specs,
-            interpret=self.interpret,
-        )(xs_p[None, :], *fields, rh, ll_lo, ll_hi)
-
-    def root_columns_fast(self, xs, reweight, R: int):
-        """Approx-filtered root columns: (pos, ids, bad, ovf) with ovf
-        (n,) nonzero where the K-candidate certificate failed (caller
-        must re-run the exact kernels for the whole batch then)."""
-        S = self.S_root
-        flat = self.fr.kind == "choose_flat"
-        if flat:
-            rw = jnp.asarray(reweight).astype(jnp.int32)[
-                jnp.clip(self.root_ids[0], 0, len(reweight) - 1)][None, :]
-        else:
-            rw = jnp.zeros((1, S), dtype=jnp.int32)
-        xs, n, B = _pad_block(xs)
-        Bc = min(CAND_BLOCK, B)
-        fs1 = lambda shape: pl.BlockSpec(
-            shape, lambda i, r: tuple(jnp.int32(0) for _ in shape),
-            memory_space=pltpu.VMEM)
-        nf = len(_FIELDS)
-        outs = [jax.ShapeDtypeStruct((n, 128), jnp.int32)
-                for _ in range(nf)]
-        outs.append(jax.ShapeDtypeStruct((R, n), jnp.int32))
-        # candidate fields: lane-packed blocks revisited across the
-        # (innermost) r axis — phase 1 read-modify-writes its segment
-        out_specs = [pl.BlockSpec((Bc, 128), lambda i, r: (i, jnp.int32(0)))
-                     for _ in range(nf)]
-        out_specs.append(pl.BlockSpec((R, Bc), lambda i, r: (jnp.int32(0),
-                                                             i)))
-        res = pl.pallas_call(
-            functools.partial(_cand_root_kernel, S=S,
-                              rh128=self.rh128, D=self.D),
-            grid=(n // Bc, R),
-            out_shape=outs,
-            in_specs=[pl.BlockSpec((1, Bc),
-                                   lambda i, r: (jnp.int32(0), i)),
-                      fs1((1, S)), fs1((1, S)), fs1((1, S)), fs1((5, S)),
-                      fs1((1, S)), fs1((1, S))],
-            out_specs=out_specs,
-            interpret=self.interpret,
-        )(xs[None, :], self.root_ids, self.root_wz, self.root_wf,
-          self.root_magic, self.root_off, rw)
-        fields, ovf = res[:nf], res[nf]
-        pos, ids, bad = self._verify(xs, n, B, fields, R, vary_r=None,
-                                     want_bad=flat)
-        return pos, ids, bad, jnp.max(ovf, axis=0)
-
-    def leaf_columns_fast(self, xs, root_pos, reweight, R: int):
-        """Approx-filtered leaf columns: (leaf_id, leaf_bad, ovf)."""
-        rw_rows = jnp.asarray(reweight).astype(jnp.int32)[
-            jnp.clip(jnp.asarray(self.leaf_ids_np), 0,
-                     len(reweight) - 1)].astype(jnp.float32)
-        root_pos = root_pos[:, :xs.shape[0]]
-        xs, n, B, root_pos = _pad_block(xs, root_pos)
-        Bc = min(CAND_BLOCK, B)
-        fs1 = lambda shape: pl.BlockSpec(
-            shape, lambda i, r: tuple(jnp.int32(0) for _ in shape),
-            memory_space=pltpu.VMEM)
-        nf = len(_FIELDS)
-        outs = [jax.ShapeDtypeStruct((n, 128), jnp.int32)
-                for _ in range(nf)]
-        outs.append(jax.ShapeDtypeStruct((R, n), jnp.int32))
-        out_specs = [pl.BlockSpec((Bc, 128), lambda i, r: (i, jnp.int32(0)))
-                     for _ in range(nf)]
-        out_specs.append(pl.BlockSpec((R, Bc), lambda i, r: (jnp.int32(0),
-                                                             i)))
-        res = pl.pallas_call(
-            functools.partial(_cand_leaf_kernel, H=self.H, S=self.S_leaf,
-                              vary_r=self.fr.vary_r,
-                              rh128=self.rh128, D=self.D),
-            grid=(n // Bc, R),
-            out_shape=outs,
-            in_specs=[pl.BlockSpec((1, Bc),
-                                   lambda i, r: (jnp.int32(0), i)),
-                      pl.BlockSpec((R, Bc),
-                                   lambda i, r: (jnp.int32(0), i)),
-                      fs1(self.leaf_static.shape), fs1(rw_rows.shape)],
-            out_specs=out_specs,
-            interpret=self.interpret,
-        )(xs[None, :], root_pos, self.leaf_static, rw_rows)
-        fields, ovf = res[:nf], res[nf]
-        lid_pos, lid, lbad = self._verify(xs, n, B, fields, R,
-                                          vary_r=self.fr.vary_r,
-                                          want_bad=True)
-        del lid_pos
-        return lid, lbad, jnp.max(ovf, axis=0)
-
-    def leaf_columns(self, xs, root_pos, reweight, R: int):
-        """root winner positions -> (leaf_id, leaf_bad) each (R, N)."""
-        # reweight row per (host, slot): dynamic, built by XLA per call
-        # (zero-padded slots never win the draw — wz masks them — so
-        # their reweight value is irrelevant)
-        rw_rows = jnp.asarray(reweight).astype(jnp.int32)[
-            jnp.clip(jnp.asarray(self.leaf_ids_np), 0,
-                     len(reweight) - 1)].astype(jnp.float32)
-        # root_pos comes back padded from root_columns; re-pad from the
-        # caller's batch width so both land on the same quantum
-        root_pos = root_pos[:, :xs.shape[0]]
-        xs, n, B, root_pos = _pad_block(xs, root_pos)
-        grid = (n // B, R)
         outs = [jax.ShapeDtypeStruct((R, n), jnp.int32) for _ in range(2)]
         out_specs = [pl.BlockSpec((R, B), lambda i, r: (jnp.int32(0), i))
                      for _ in range(2)]
         fs = self._fullspec
         rh, ll_lo, ll_hi = self.tabs
-        lid, lbad = pl.pallas_call(
+        pos, ids = pl.pallas_call(
+            functools.partial(_root_kernel, S=S, rh128=self.rh128),
+            grid=grid,
+            out_shape=outs,
+            in_specs=[pl.BlockSpec((1, B), lambda i, r: (jnp.int32(0), i)),
+                      fs((1, S)), fs((1, S)), fs((5, S)), fs((1, S)),
+                      fs(rh.shape), fs(ll_lo.shape), fs(ll_hi.shape)],
+            out_specs=out_specs,
+            interpret=self.interpret,
+        )(xs[None, :], self.root_ids, self.root_wz, self.root_magic,
+          self.root_off, rh, ll_lo, ll_hi)
+        return pos, ids
+
+    def froot_columns(self, xs, reweight, R: int):
+        """Fused single-phase filtered root columns: (pos, ids, ovf) —
+        one pallas_call, candidates packed in VMEM, is_out left to the
+        caller.  Requires R * _KPACK <= 128."""
+        del reweight
+        if R * _KPACK > 128:
+            raise ValueError(f"froot_columns: R={R} exceeds the lane pack")
+        S = self.S_root
+        D = self.D   # concrete before tracing
+        xs, n, B = _pad_block(xs)
+        Bc = 128   # 256 tops the 16M scoped-vmem limit (measured 16.22M)
+        z = np.int32(0)
+        fs1 = lambda shape: pl.BlockSpec(
+            shape, lambda i: tuple(z for _ in shape),
+            memory_space=pltpu.VMEM)
+        rh, ll_lo, ll_hi = self.tabs
+        outs = [jax.ShapeDtypeStruct((R, n), jnp.int32) for _ in range(2)]
+        outs.append(jax.ShapeDtypeStruct((1, n), jnp.int32))
+        out_specs = [pl.BlockSpec((R, Bc), lambda i: (z, i))
+                     for _ in range(2)]
+        out_specs.append(pl.BlockSpec((1, Bc), lambda i: (z, i)))
+        pos, ids, ovf = pl.pallas_call(
+            functools.partial(_froot_kernel, S=S, R=R,
+                              rh128=self.rh128, D=D),
+            grid=(n // Bc,),
+            out_shape=outs,
+            in_specs=[pl.BlockSpec((1, Bc), lambda i: (z, i)),
+                      fs1((1, S)), fs1((1, S)), fs1((1, S)), fs1((5, S)),
+                      fs1((1, S)),
+                      fs1(rh.shape), fs1(ll_lo.shape), fs1(ll_hi.shape)],
+            out_specs=out_specs,
+            interpret=self.interpret,
+        )(xs[None, :], self.root_ids, self.root_wz, self.root_wf,
+          self.root_magic, self.root_off, rh, ll_lo, ll_hi)
+        return pos, ids, ovf[0]
+
+    def leaf_columns(self, xs, root_pos, R: int):
+        """root winner positions -> leaf_id (R, N).  is_out verdicts are
+        computed by the caller in XLA (elementwise)."""
+        # root_pos comes back padded from root_columns; re-pad from the
+        # caller's batch width so both land on the same quantum
+        root_pos = root_pos[:, :xs.shape[0]]
+        xs, n, B, root_pos = _pad_block(xs, root_pos)
+        grid = (n // B, R)
+        outs = [jax.ShapeDtypeStruct((R, n), jnp.int32)]
+        out_specs = [pl.BlockSpec((R, B), lambda i, r: (jnp.int32(0), i))]
+        fs = self._fullspec
+        rh, ll_lo, ll_hi = self.tabs
+        (lid,) = pl.pallas_call(
             functools.partial(_leaf_kernel, H=self.H, S=self.S_leaf,
                               vary_r=self.fr.vary_r,
                               rh128=self.rh128),
@@ -941,10 +839,10 @@ class PallasColumns:
             out_shape=outs,
             in_specs=[pl.BlockSpec((1, B), lambda i, r: (jnp.int32(0), i)),
                       pl.BlockSpec((R, B), lambda i, r: (jnp.int32(0), i)),
-                      fs(self.leaf_static.shape), fs(rw_rows.shape),
+                      fs(self.leaf_static.shape),
                       fs(rh.shape), fs(ll_lo.shape), fs(ll_hi.shape)],
             out_specs=out_specs,
             interpret=self.interpret,
-        )(xs[None, :], root_pos, self.leaf_static, rw_rows,
+        )(xs[None, :], root_pos, self.leaf_static,
           rh, ll_lo, ll_hi)
-        return lid, lbad
+        return lid

@@ -188,3 +188,52 @@ def test_legacy_tunables_rejected():
     m.tunables = Tunables.legacy()
     with pytest.raises(ValueError, match="modern tunables"):
         BatchMapper(m)
+
+
+def test_two_stage_pallas_schedule_interpret():
+    """The two-stage _run_pallas schedule (R1 probe, argsort compaction,
+    scatter-merge, cap overflow guard) vs the XLA ladder, in interpret
+    mode — the TPU-only glue otherwise never runs in CI."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.crush.fastpath import FastMapper, detect
+    from ceph_tpu.ops.pallas_straw2 import PallasColumns
+
+    crush_map, _root, rid = build_two_level_map(20, 4)
+    # small tries -> small Rf fallback range: interpret-mode tracing of
+    # the full-range cond branch is minutes-slow at the default 51
+    crush_map.tunables.choose_total_tries = 7
+    wrng = np.random.default_rng(11)
+    for b in crush_map.buckets:
+        if b is not None and b.type == 1:
+            b.item_weights = [int(w) for w in
+                              wrng.integers(0x8000, 0x20000, b.size)]
+            b.weight = sum(b.item_weights)
+    root = crush_map.bucket(-1)
+    root.item_weights = [crush_map.bucket(h).weight for h in root.items]
+    root.weight = sum(root.item_weights)
+    fr = detect(crush_map, rid)
+    n_osds = 80
+    reweight = np.full(n_osds, 0x10000, dtype=np.int64)
+    reweight[::7] = 0x4000   # heavy rejection -> stage-2 lanes exist
+    reweight[::13] = 0
+    rw = jnp.asarray(reweight)
+    xs = jnp.asarray(np.random.default_rng(2).integers(
+        0, 2 ** 32, (1024,), dtype=np.uint32))
+
+    fm = FastMapper(fr)
+    fm._pallas = PallasColumns(fr, interpret=True)
+    fm.TWO_STAGE_MIN = 512     # force the two-stage path at test size
+    fm.STAGE2_CAP = 512
+    res_two = np.asarray(fm.run(xs, rw, 3))
+
+    fm_xla = FastMapper(fr)
+    fm_xla._pallas = None
+    res_xla = np.asarray(fm_xla.run(xs, rw, 3))
+    np.testing.assert_array_equal(res_two, res_xla)
+
+    # cap overflow guard: capacity 8 certainly overflows -> whole-batch
+    # recompute path, still exact
+    fm.STAGE2_CAP = 8
+    res_cap = np.asarray(fm.run(xs, rw, 3))
+    np.testing.assert_array_equal(res_cap, res_xla)
