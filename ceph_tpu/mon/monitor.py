@@ -882,17 +882,39 @@ class Monitor(Dispatcher):
         return f"pool {result[0]} created", 0
 
     def _cmd_pool_set(self, cmd) -> tuple[str, int]:
+        pool_id = int(cmd["pool"])
+        pool = self.osdmap.pools.get(pool_id)
+        if pool is None:
+            return f"pool {pool_id} does not exist", -2
+        var = cmd["var"]
+        # pg_num / pgp_num changes gate PG splits (OSDMonitor.cc pg_num
+        # handling): pg_num may only grow (children split from parents on
+        # the OSDs), and pgp_num — the placement seed modulus — may never
+        # exceed pg_num (children must exist before they can move)
+        if var == "pg_num":
+            new = int(cmd["val"])
+            if new < pool.pg_num:
+                return (f"pg_num {new} < current {pool.pg_num}: "
+                        "shrinking is not supported", -22)
+        elif var == "pgp_num":
+            new = int(cmd["val"])
+            if new > pool.pg_num:
+                return f"pgp_num {new} > pg_num {pool.pg_num}", -22
+            if new < pool.pgp_num:
+                return (f"pgp_num {new} < current {pool.pgp_num}: "
+                        "shrinking is not supported", -22)
+
         def fn(m: OSDMap):
-            pool = m.pools[int(cmd["pool"])]
+            p = m.pools[pool_id]
             # coerce by the field's current type (int/float/str knobs)
-            cur = getattr(pool, cmd["var"])
+            cur = getattr(p, var)
             cast = type(cur) if cur is not None else int
-            setattr(pool, cmd["var"],
+            setattr(p, var,
                     cast(cmd["val"]) if cast is not bool
                     else cmd["val"] in ("1", "true", "True"))
         if not self._mutate(fn):
             return "commit failed", -11
-        return "set", 0
+        return json.dumps({"epoch": self.osdmap.epoch}), 0
 
     def _cmd_osd_weight(self, osd: int, weight: int) -> tuple[str, int]:
         if not (0 <= osd < self.osdmap.max_osd):

@@ -35,8 +35,9 @@ import threading
 from .kv import LogDB
 from .objectstore import ObjectStore
 from .transaction import (
-    OP_CLONE, OP_MKCOLL, OP_OMAP_RMKEYS, OP_OMAP_SETKEYS, OP_REMOVE,
-    OP_RMCOLL, OP_SETATTR, OP_TOUCH, OP_TRUNCATE, OP_WRITE, OP_ZERO,
+    OP_CLONE, OP_COLL_MOVE, OP_MKCOLL, OP_OMAP_RMKEYS, OP_OMAP_SETKEYS,
+    OP_REMOVE, OP_RMCOLL, OP_SETATTR, OP_TOUCH, OP_TRUNCATE, OP_WRITE,
+    OP_ZERO,
     Transaction)
 
 BLOCK = 4096          # allocation unit ("min_alloc_size")
@@ -315,6 +316,19 @@ class BlueStoreLite(ObjectStore):
                     elif op.op == OP_SETATTR:
                         m = ensure(op.cid, op.oid)
                         m["attrs"][op.name] = op.data.hex()
+                    elif op.op == OP_COLL_MOVE:
+                        # metadata-only move: extents stay where they
+                        # are, the object record changes collections
+                        if not coll_exists(op.dest):
+                            raise KeyError(f"no collection {op.dest!r}")
+                        m = get(op.cid, op.oid)
+                        if m is not None:
+                            prev = get(op.dest, op.oid)
+                            if prev is not None:   # overwrite: free old
+                                self._freed.extend(
+                                    b for b in prev["extents"] if b >= 0)
+                            cache[(op.dest, op.oid)] = m
+                            cache[(op.cid, op.oid)] = None
                     elif op.op == OP_CLONE:
                         m = get(op.cid, op.oid)
                         if m is None:   # missing src: no-op (MemStore)

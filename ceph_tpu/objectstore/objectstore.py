@@ -16,8 +16,9 @@ import threading
 import zlib
 
 from .transaction import (
-    OP_CLONE, OP_MKCOLL, OP_OMAP_RMKEYS, OP_OMAP_SETKEYS, OP_REMOVE,
-    OP_RMCOLL, OP_SETATTR, OP_TOUCH, OP_TRUNCATE, OP_WRITE, OP_ZERO,
+    OP_CLONE, OP_COLL_MOVE, OP_MKCOLL, OP_OMAP_RMKEYS, OP_OMAP_SETKEYS,
+    OP_REMOVE, OP_RMCOLL, OP_SETATTR, OP_TOUCH, OP_TRUNCATE, OP_WRITE,
+    OP_ZERO,
     Transaction)
 
 
@@ -164,6 +165,13 @@ class MemStore(ObjectStore):
                 coll[op.dest] = src.clone()
         elif op.op == OP_SETATTR:
             coll.setdefault(op.oid, _Obj()).attrs[op.name] = op.data
+        elif op.op == OP_COLL_MOVE:
+            dest = c.get(op.dest)
+            if dest is None:
+                raise KeyError(f"no collection {op.dest!r}")
+            o = coll.pop(op.oid, None)
+            if o is not None:
+                dest[op.oid] = o
         else:
             raise ValueError(f"unknown transaction op {op.op}")
 

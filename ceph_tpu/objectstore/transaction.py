@@ -23,13 +23,14 @@ OP_MKCOLL = 8
 OP_RMCOLL = 9
 OP_CLONE = 10         # (dest_oid)
 OP_SETATTR = 11       # (name, value)
+OP_COLL_MOVE = 12     # (dest = destination collection)
 
 _OP_NAMES = {
     OP_TOUCH: "touch", OP_WRITE: "write", OP_ZERO: "zero",
     OP_TRUNCATE: "truncate", OP_REMOVE: "remove",
     OP_OMAP_SETKEYS: "omap_setkeys", OP_OMAP_RMKEYS: "omap_rmkeys",
     OP_MKCOLL: "mkcoll", OP_RMCOLL: "rmcoll", OP_CLONE: "clone",
-    OP_SETATTR: "setattr",
+    OP_SETATTR: "setattr", OP_COLL_MOVE: "coll_move",
 }
 
 
@@ -106,6 +107,15 @@ class Transaction:
                 ) -> "Transaction":
         self.ops.append(Op(OP_SETATTR, cid, oid, name=name,
                            data=bytes(value)))
+        return self
+
+    def collection_move(self, cid: str, oid: str, dest_cid: str
+                        ) -> "Transaction":
+        """Move an object (data + attrs + omap) to another collection —
+        the PG-split primitive (os/ObjectStore.h collection_move_rename /
+        split_collection analog; missing source is a no-op so replayed
+        split transactions stay idempotent)."""
+        self.ops.append(Op(OP_COLL_MOVE, cid, oid, dest=dest_cid))
         return self
 
     def append(self, other: "Transaction") -> "Transaction":

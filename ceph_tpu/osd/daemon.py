@@ -60,7 +60,8 @@ from ceph_tpu.msg.messenger import (
     ConnectionPolicy, Dispatcher, EntityName, Messenger)
 from ceph_tpu.objectstore import Transaction, create_objectstore
 from ceph_tpu.osd.map_codec import decode_osdmap, encode_osdmap
-from ceph_tpu.osd.osdmap import CEPH_NOSD, OSDMap
+from ceph_tpu.osd.osdmap import CEPH_NOSD, OSDMap, pg_to_pgid
+from ceph_tpu.client.rados import ceph_str_hash_rjenkins
 from ceph_tpu.osd.pg import (
     EVERSION_ZERO, LOG_DELETE, LOG_MODIFY, PG, LogEntry, MissingItem,
     PeerState, PGInfo, STATE_ACTIVE, STATE_GETINFO, STATE_GETLOG,
@@ -189,6 +190,9 @@ class OSDDaemon(Dispatcher):
         self._in_flight: dict[tuple[int, int], _InFlight] = {}
         #: ops from clients ahead of our map; flushed on map advance
         self._waiting_for_map: list[MOSDOp] = []
+        #: inter-OSD ops parked until our map/splits catch up:
+        #: (handler, msg) pairs replayed after the next map applies
+        self._waiting_subops: list = []
         #: reqid -> EC read/recovery state
         self._ec_reads: dict[tuple[int, int], dict] = {}
         self._recover_tid = 0
@@ -228,6 +232,7 @@ class OSDDaemon(Dispatcher):
                      .add_u64("op_w").add_u64("op_r").add_u64("op_rep")
                      .add_u64("ec_encode_stripes").add_u64("recovery_pulls")
                      .add_u64("peering_rounds").add_u64("log_entries")
+                     .add_u64("pg_splits")
                      .add_time_avg("op_w_latency")
                      .create_perf_counters())
         self.ctx.perf.add(self.perf)
@@ -539,6 +544,8 @@ class OSDDaemon(Dispatcher):
             if missing_blob:
                 pg.decode_missing(missing_blob)
             pg.next_seq = pg.log.head[1]
+            num_blob = meta.get("pg_num")
+            pg.split_num = (int(num_blob.decode()) if num_blob else 0)
             self.pgs[pgid] = pg
             dout("osd", 10, "osd.%d loaded pg %s: %d entries, head %s",
                  self.osd_id, cid, len(entries), pg.log.head)
@@ -555,14 +562,19 @@ class OSDDaemon(Dispatcher):
             self._codecs.clear()
         del oldmap
         dout("osd", 5, "osd.%d got map epoch %d", self.osd_id, newmap.epoch)
+        self._split_pgs(newmap)
         self._scan_pgs()
         with self._lock:
             waiting = [m for m in self._waiting_for_map
                        if m.epoch <= newmap.epoch]
             self._waiting_for_map = [m for m in self._waiting_for_map
                                      if m.epoch > newmap.epoch]
+            subops = self._waiting_subops
+            self._waiting_subops = []
         for m in waiting:
             self._handle_op(m)
+        for handler, m in subops:
+            handler(m)
 
     def _pg_cid(self, pgid) -> str:
         return f"{pgid[0]}.{pgid[1]}"
@@ -572,12 +584,246 @@ class OSDDaemon(Dispatcher):
             pg = self.pgs.get(pgid)
             if pg is None:
                 pg = PG(pgid)
+                pool = self.osdmap.pools.get(pgid[0])
+                pg.split_num = pool.pg_num if pool else 0
                 self.pgs[pgid] = pg
                 cid = self._pg_cid(pgid)
                 if cid not in self.store.list_collections():
                     self.store.apply_transaction(
-                        Transaction().create_collection(cid))
+                        Transaction().create_collection(cid)
+                        .touch(cid, PG.PGMETA)
+                        .omap_setkeys(cid, PG.PGMETA, {
+                            "pg_num": str(pg.split_num).encode()}))
             return pg
+
+    def _split_pending(self, pool_id: int) -> bool:
+        """True while some local PG of the pool has not been split to the
+        current pg_num — the window between installing a grown map and
+        _split_pgs finishing.  Caller holds self._lock."""
+        pool = self.osdmap.pools.get(pool_id)
+        if pool is None:
+            return False
+        return any(pgid[0] == pool_id
+                   and 0 < pg.split_num < pool.pg_num
+                   for pgid, pg in self.pgs.items())
+
+    def _park_subop(self, handler, msg, pool) -> bool:
+        """Park an inter-OSD op that references a PG layout our map or
+        local splits have not reached yet (require_same_or_newer_map
+        analog): a child pgid beyond our pg_num means the sender runs a
+        newer map; a pending split means applying now would target the
+        pre-split collection.  Parked ops replay after the next map's
+        split+scan completes."""
+        with self._lock:
+            if (msg.pgid[1] >= pool.pg_num
+                    or self._split_pending(msg.pgid[0])):
+                if len(self._waiting_subops) < 10000:
+                    self._waiting_subops.append((handler, msg))
+                return True
+        return False
+
+    @staticmethod
+    def _base_oid(oid: str, ec: bool) -> str:
+        """Logical object name of a store object: strips the "@snapseq"
+        clone suffix and, on EC pools, the ":shard" suffix — the name the
+        client hashed to place the object."""
+        base = oid.split("@", 1)[0]
+        if ec and ":" in base:
+            head, _, tail = base.rpartition(":")
+            if tail.isdigit():
+                return head
+        return base
+
+    def _split_pgs(self, newmap: OSDMap) -> None:
+        """Split local PGs whose persisted pg_num watermark is behind the
+        pool's (PG::split_into, src/osd/PG.cc:2575; collection split via
+        the store-level collection_move primitive, os/ObjectStore.h
+        split_collection).
+
+        Driven by the per-PG "pg_num" watermark in pgmeta, NOT by a map
+        diff: an OSD that was down across the pg_num change boots
+        straight into the new map with no old map to compare, and its
+        unsplit PGs (stale logs still interleaving the children's
+        entries) would diverge from every peer's trimmed history.  The
+        watermark also collapses multi-step growth seen at once
+        (8->16->32 while down) into a single partition by the final
+        pg_num.
+
+        Children adopt the objects, log entries and missing-set items
+        whose placement seed maps to them under the new pg_num; every
+        replica computes the identical partition (it is a pure function
+        of object names), so peering after the split converges exactly
+        as before it.  With pgp_num unchanged, a child's placement seed
+        stable_mod's back to its parent's, so children start colocated
+        with their parents and data only moves when pgp_num is raised —
+        the reference's two-step semantics."""
+        for pool_id, pool in newmap.pools.items():
+            with self._lock:
+                # a pgmeta without the watermark predates the split
+                # feature, when pg_num was immutable — such a store is by
+                # definition already consistent with the pg_num it was
+                # created under; adopt the current one (backfill, never
+                # exempt: a zero watermark would skip every future split)
+                legacy = [pgid for pgid in self.pgs
+                          if pgid[0] == pool_id
+                          and self.pgs[pgid].split_num == 0]
+                for pgid in legacy:
+                    self.pgs[pgid].split_num = pool.pg_num
+                    self.store.apply_transaction(
+                        Transaction().touch(self._pg_cid(pgid), PG.PGMETA)
+                        .omap_setkeys(self._pg_cid(pgid), PG.PGMETA,
+                                      {"pg_num":
+                                       str(pool.pg_num).encode()}))
+                stale = [(pgid, self.pgs[pgid].split_num)
+                         for pgid in self.pgs
+                         if pgid[0] == pool_id
+                         and 0 < self.pgs[pgid].split_num < pool.pg_num
+                         and pgid[1] < self.pgs[pgid].split_num]
+            for pgid, old_num in sorted(stale):
+                children = [c for c in range(old_num, pool.pg_num)
+                            if pg_to_pgid(c, old_num) == pgid[1]]
+                if children:
+                    self._split_one(pgid, children, pool)
+                else:
+                    with self._lock:
+                        pg = self.pgs.get(pgid)
+                        if pg is not None:
+                            pg.split_num = pool.pg_num
+                            self.store.apply_transaction(
+                                Transaction().omap_setkeys(
+                                    self._pg_cid(pgid), PG.PGMETA,
+                                    {"pg_num":
+                                     str(pool.pg_num).encode()}))
+
+    def _split_one(self, pgid, children: list[int], pool) -> None:
+        pool_id, pnum = pgid
+        ec = pool.is_erasure()
+        new_num = pool.pg_num
+        with self._lock:
+            parent = self.pgs.get(pgid)
+            if parent is None:
+                return
+            pcid = self._pg_cid(pgid)
+            t = Transaction()
+            child_cids = {}
+            for c in children:
+                ccid = self._pg_cid((pool_id, c))
+                child_cids[c] = ccid
+                if ccid not in self.store.list_collections():
+                    t.create_collection(ccid)
+                t.touch(ccid, PG.PGMETA)
+
+            def target_of(oid: str) -> int:
+                return pg_to_pgid(
+                    ceph_str_hash_rjenkins(self._base_oid(oid, ec)),
+                    new_num)
+
+            # 1) objects: move every store object whose seed now maps to
+            # a child (snap clones and EC shards travel with their base)
+            moved = 0
+            for oid in self.store.list_objects(pcid):
+                if oid.startswith(PG.PGMETA):
+                    continue
+                tgt = target_of(oid)
+                if tgt != pnum:
+                    t.collection_move(pcid, oid, child_cids[tgt])
+                    moved += 1
+
+            # 2) log + missing: partition by the same function
+            child_pgs: dict[int, PG] = {}
+            for c in children:
+                cpg = self.pgs.get((pool_id, c))
+                if cpg is None:
+                    cpg = PG((pool_id, c))
+                    self.pgs[(pool_id, c)] = cpg
+                child_pgs[c] = cpg
+            keep_entries, moved_keys = [], []
+            child_entries: dict[int, list] = {c: [] for c in children}
+            for e in parent.log.entries:
+                tgt = target_of(e.oid)
+                if tgt == pnum:
+                    keep_entries.append(e)
+                else:
+                    child_entries[tgt].append(e)
+                    moved_keys.append(PG.log_key(e.version))
+            parent.log.copy_from(keep_entries)
+            for c, cpg in child_pgs.items():
+                cpg.log.copy_from(child_entries[c])
+                # both sides keep the parent's last_update (PG::split_into
+                # copies info); new writes use the current (bumped) epoch,
+                # so version monotonicity holds on both
+                cpg.info.last_update = parent.info.last_update
+                cpg.info.last_epoch_started = \
+                    parent.info.last_epoch_started
+                cpg.info.past_up = [list(iv)
+                                    for iv in parent.info.past_up]
+                cpg.missing = {o: m for o, m in parent.missing.items()
+                               if target_of(o) == c}
+                cpg.state = STATE_INACTIVE
+            parent.missing = {o: m for o, m in parent.missing.items()
+                              if target_of(o) == pnum}
+            parent.info.last_complete = parent.complete_to()
+
+            # 3) in-flight writes against the pre-split layout die here:
+            # repops requeue their client op (post-split dispatch dedups
+            # against the log), EC rmw gathers tear down with the gate
+            # (the same on_change teardown _start_peering does)
+            stale_infs = [rid for rid, inf in self._in_flight.items()
+                          if inf.msg.pgid == pgid]
+            for rid in stale_infs:
+                inf = self._in_flight.pop(rid)
+                trk = getattr(inf.msg, "_trk", None)
+                if trk is not None:
+                    trk.mark_event("repop torn down: pg split")
+                parent.waiting_for_active.append(inf.msg)
+            parent.rmw.clear()
+            dead = [gid for gid, st in self._ec_reads.items()
+                    if st["kind"] == "rmw" and st["pgid"] == pgid]
+            for gid in dead:
+                st = self._ec_reads.pop(gid, None)
+                if st is not None and st.get("msg") is not None:
+                    parent.waiting_for_active.append(st["msg"])
+
+            # queued ops whose object moved: requeue on the child (the
+            # client also resends on the map change; the log dedups)
+            for c, cpg in child_pgs.items():
+                keep_waiting = []
+                for m in parent.waiting_for_active:
+                    (cpg.waiting_for_active
+                     if target_of(m.oid) == c else keep_waiting).append(m)
+                parent.waiting_for_active = keep_waiting
+            for o in list(parent.waiting_for_missing):
+                tgt = target_of(o)
+                if tgt != pnum:
+                    child_pgs[tgt].waiting_for_missing.setdefault(
+                        o, []).extend(parent.waiting_for_missing.pop(o))
+
+            # 4) persist the whole split atomically: child metadata, the
+            # object moves, and the parent's trimmed log in ONE txn
+            parent.split_num = new_num
+            if moved_keys:
+                t.omap_rmkeys(pcid, PG.PGMETA, moved_keys)
+            t.omap_setkeys(pcid, PG.PGMETA, {
+                "info": parent.encode_info(),
+                "missing": parent.encode_missing(),
+                "pg_num": str(new_num).encode()})
+            for c, cpg in child_pgs.items():
+                cpg.split_num = new_num
+                ccid = child_cids[c]
+                keys = {"info": cpg.encode_info(),
+                        "missing": cpg.encode_missing(),
+                        "pg_num": str(new_num).encode()}
+                for e in cpg.log.entries:
+                    keys[PG.log_key(e.version)] = PG.encode_entry(e)
+                t.omap_setkeys(ccid, PG.PGMETA, keys)
+            # the parent re-peers (cheap: same membership) so its
+            # requeued ops flush at activation; children peer as new PGs
+            parent.state = STATE_INACTIVE
+            self.store.apply_transaction(t)
+            self.perf.inc("pg_splits")
+            dout("osd", 3, "osd.%d split pg %s into %d children "
+                 "(%d objects moved)", self.osd_id, pgid, len(children),
+                 moved)
 
     def _scan_pgs(self) -> None:
         """On every new map: (re)start peering for PGs whose membership
@@ -916,6 +1162,11 @@ class OSDDaemon(Dispatcher):
                                         from_osd=self.osd_id))
 
     def _handle_pull(self, msg: MOSDPGPull) -> None:
+        pool = self.osdmap.pools.get(msg.pgid[0])
+        if pool is not None and self._park_subop(
+                self._handle_pull, msg, pool):
+            return
+
         cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
         pool = self.osdmap.pools.get(msg.pgid[0])
         pg = self.pgs.get(msg.pgid)
@@ -1412,6 +1663,20 @@ class OSDDaemon(Dispatcher):
         if pool is None:
             self._reply_err(msg, -2)
             return
+        # misdirected-op guard: after a PG split, a client on the old map
+        # still targets the parent pgid; executing there would strand the
+        # object in the wrong collection.  Drop and share our newer map —
+        # the client recomputes and resends (OSD::handle_op misdirected
+        # drop + maybe_share_map)
+        expect = pg_to_pgid(ceph_str_hash_rjenkins(msg.oid), pool.pg_num)
+        if expect != msg.pgid[1]:
+            m = self.osdmap
+            if msg.epoch < m.epoch and msg.connection is not None:
+                msg.connection.send_message(MOSDMapMsg(
+                    epoch=m.epoch, map_blob=encode_osdmap(m)))
+            msg._trk.mark_event("dropped: misdirected (stale pg mapping)")
+            msg._trk.finish()
+            return
         up, primary = self._pg_members(msg.pgid)
         if primary != self.osd_id:
             # not my op in this epoch: share my newer map with the stale
@@ -1432,6 +1697,14 @@ class OSDDaemon(Dispatcher):
         # slip into a waiting list just after its last flush ran
         with self._lock:
             pg = self.pgs.get(msg.pgid)
+            if pg is None and self._split_pending(msg.pgid[0]):
+                # between the new map installing and _split_pgs finishing:
+                # creating the child now would let a write land in a PG
+                # the imminent split is about to overwrite.  Park; the
+                # end of _handle_map replays us after split+scan
+                msg._trk.mark_event("waiting for pg split")
+                self._waiting_for_map.append(msg)
+                return
             if pg is None and 0 <= msg.pgid[1] < pool.pg_num:
                 msg._trk.mark_event("creating pg (raced map advance)")
                 # op raced ahead of _scan_pgs creating this PG on the
@@ -1735,6 +2008,19 @@ class OSDDaemon(Dispatcher):
 
     def _handle_rep_op(self, msg: MOSDRepOp) -> None:
         self.perf.inc("op_rep")
+        # a rep-op built before a PG split targets the parent; applying
+        # its transaction here would strand the object in the parent
+        # collection after this replica's own split.  Drop silently: the
+        # primary's repop stalls, its own split tears it down and the
+        # client's resend takes the post-split path
+        pool = self.osdmap.pools.get(msg.pgid[0])
+        if pool is not None:
+            if self._park_subop(self._handle_rep_op, msg, pool):
+                return
+            base = self._base_oid(msg.oid, pool.is_erasure())
+            if msg.oid and pg_to_pgid(ceph_str_hash_rjenkins(base),
+                                      pool.pg_num) != msg.pgid[1]:
+                return
         pg = self._get_pg(msg.pgid)
         entry = PG.decode_entry(msg.entry) if msg.entry else None
         # head-check, txn apply and log append must be one atomic step:
@@ -2045,6 +2331,15 @@ class OSDDaemon(Dispatcher):
         return out, True
 
     def _handle_ec_write(self, msg: MOSDECSubOpWrite) -> None:
+        pool = self.osdmap.pools.get(msg.pgid[0])
+        if pool is not None:
+            if self._park_subop(self._handle_ec_write, msg, pool):
+                return
+            base = self._base_oid(msg.oid, True)
+            if msg.oid and pg_to_pgid(ceph_str_hash_rjenkins(base),
+                                      pool.pg_num) != msg.pgid[1]:
+                return   # pre-split shard write: see _handle_rep_op
+
         oid = msg.oid
         cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
         pg = self._get_pg(msg.pgid)
@@ -2201,6 +2496,11 @@ class OSDDaemon(Dispatcher):
         self._ec_read_done(reqid, shard, *got)
 
     def _handle_ec_read(self, msg: MOSDECSubOpRead) -> None:
+        pool = self.osdmap.pools.get(msg.pgid[0])
+        if pool is not None and self._park_subop(
+                self._handle_ec_read, msg, pool):
+            return
+
         got = self._read_shard_verified(msg.pgid, msg.oid, msg.shard)
         if got is None:
             msg.connection.send_message(MOSDECSubOpReadReply(

@@ -230,6 +230,9 @@ class PG:
         #: when the current peering round started (tick watchdog)
         self.peering_started = 0.0
         self.next_seq = 0
+        #: pool pg_num this PG's collection was last created/split at —
+        #: persisted in pgmeta ("pg_num"); drives boot-time splits
+        self.split_num = 0
 
     # -- version allocation (primary) ------------------------------------
 

@@ -122,7 +122,9 @@ class Workload(threading.Thread):
 
 class Thrasher:
     def __init__(self, cluster: MiniCluster, seed: int = 0,
-                 min_up: int = 4, max_down: int = 1):
+                 min_up: int = 4, max_down: int = 1,
+                 pools: dict[int, int] | None = None,
+                 pg_num_max: int = 32):
         self.cluster = cluster
         self.rng = random.Random(seed)
         self.min_up = min_up
@@ -130,6 +132,12 @@ class Thrasher:
         self.downed: list[int] = []
         self.outed: list[int] = []
         self.actions = 0
+        #: pool -> current pg_num; the thrasher grows pg_num (PG split
+        #: under load) and trails pgp_num behind it, like the reference
+        #: Thrasher's thrash_pg_num (qa/tasks/ceph_manager.py)
+        self.pg_nums: dict[int, int] = dict(pools or {})
+        self.pgp_nums: dict[int, int] = dict(pools or {})
+        self.pg_num_max = pg_num_max
 
     def _mon_cmd(self, cmd: dict) -> None:
         client = self.cluster.clients[0]
@@ -141,6 +149,23 @@ class Thrasher:
     def step(self) -> str:
         roll = self.rng.random()
         up = [i for i in self.cluster.osds if i not in self.downed]
+        if self.pg_nums and roll < 0.15:
+            pool = self.rng.choice(sorted(self.pg_nums))
+            if self.pgp_nums[pool] < self.pg_nums[pool]:
+                self.pgp_nums[pool] = self.pg_nums[pool]
+                self._mon_cmd({"prefix": "osd pool set", "pool": pool,
+                               "var": "pgp_num",
+                               "val": str(self.pgp_nums[pool])})
+                self.actions += 1
+                return f"grow pgp_num pool.{pool} -> {self.pgp_nums[pool]}"
+            if self.pg_nums[pool] < self.pg_num_max:
+                self.pg_nums[pool] *= 2
+                self._mon_cmd({"prefix": "osd pool set", "pool": pool,
+                               "var": "pg_num",
+                               "val": str(self.pg_nums[pool])})
+                self.actions += 1
+                return f"grow pg_num pool.{pool} -> {self.pg_nums[pool]}"
+            roll = 0.15 + self.rng.random() * 0.85
         if self.downed and (roll < 0.45 or len(self.downed)
                             >= self.max_down):
             osd = self.downed.pop(self.rng.randrange(len(self.downed)))
@@ -205,7 +230,7 @@ def run_soak(duration: float = 25.0, seed: int = 7,
                       payload_scale=400)
         w1.start()
         w2.start()
-        th = Thrasher(c, seed=seed)
+        th = Thrasher(c, seed=seed, pools={rep: 8, ec: 8})
         deadline = time.time() + duration
         log = []
         while time.time() < deadline:

@@ -1,0 +1,79 @@
+"""Profile the CRUSH fast path components on TPU at the bench shape."""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import chained_rates, median_band
+from ceph_tpu.crush import build_two_level_map
+from ceph_tpu.crush.mapper_jax import BatchMapper
+
+
+def main():
+    crush_map, _root, rid = build_two_level_map(250, 40)
+    wrng = np.random.default_rng(42)
+    for b in crush_map.buckets:
+        if b is not None and b.type == 1:
+            b.item_weights = [int(w) for w in
+                              wrng.integers(0x8000, 0x20000, b.size)]
+            b.weight = sum(b.item_weights)
+    root = crush_map.bucket(-1)
+    root.item_weights = [crush_map.bucket(h).weight for h in root.items]
+    root.weight = sum(root.item_weights)
+
+    n_osds = 10000
+    reweight = np.full(n_osds, 0x10000, dtype=np.int64)
+    idx = wrng.permutation(n_osds)
+    reweight[idx[:1000]] = 0x8000
+    reweight[idx[1000:1200]] = 0
+
+    bm = BatchMapper(crush_map)
+    n_pgs, numrep = 65536, 3
+    rw = jnp.asarray(reweight)
+    rng = np.random.default_rng(0)
+    xs = jnp.asarray(rng.integers(0, 2**32, (n_pgs,), dtype=np.uint32))
+
+    fast = bm._fastpath(rid)
+    fm = fast
+    R0 = numrep + 6  # DEFAULT_BLOCK
+
+    pc = fm._pallas
+
+    def t_of(step, carry, n_lo=2, n_hi=8):
+        med, lo, hi = median_band(chained_rates(step, carry, n_lo, n_hi, reps=5))
+        return med
+
+    # root columns only
+    def root_step(x):
+        pos, ids = pc.root_columns(x, rw, R0)
+        return x ^ ids[0].astype(jnp.uint32)
+
+    jax.block_until_ready(root_step(xs))
+    t_root = t_of(root_step, xs)
+    print(f"root_columns R={R0}: {t_root*1e3:8.2f} ms  ({n_pgs/t_root/1e6:.3f} Mpps-equiv)")
+
+    # root + leaf
+    def rl_step(x):
+        pos, ids = pc.root_columns(x, rw, R0)
+        lid = pc.leaf_columns(x, pos, R0)
+        return x ^ lid[0].astype(jnp.uint32)
+
+    jax.block_until_ready(rl_step(xs))
+    t_rl = t_of(rl_step, xs)
+    print(f"root+leaf:          {t_rl*1e3:8.2f} ms  ({n_pgs/t_rl/1e6:.3f} Mpps-equiv)")
+
+    # full run (winners + consume + compact)
+    def full_step(x):
+        p = fm.run(x, rw, numrep)
+        return x ^ p[:, 0].astype(jnp.uint32)
+
+    jax.block_until_ready(full_step(xs))
+    t_full = t_of(full_step, xs)
+    print(f"full run:           {t_full*1e3:8.2f} ms  ({n_pgs/t_full/1e6:.3f} Mpps)")
+
+
+if __name__ == "__main__":
+    main()

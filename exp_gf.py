@@ -1,0 +1,141 @@
+"""Experiment: GF(2^8) encode kernel variants at the north-star shape.
+
+Compares the shipped nibble one-hot kernel against bit-matrix GF(2) designs:
+  v0  nibble one-hot bf16  (shipped): (T, k*32) @ (k*32, m*8)
+  v1  bit-rows int8:                  (T, k*8)  @ (k*8, m*8)
+  v2  bit-rows blockdiag-4 int8:      (T/4, k*32) @ blockdiag -> (T/4, m*32)
+  v3  v2 in bf16
+Shape: k=8 m=4, 4 KiB chunks, 2048 stripes (64 MiB per call).
+"""
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ceph_tpu.gf.tables import gf_mul, nibble_bit_table
+from ceph_tpu.ops.gf_kernel import _encode_xla as _encode_impl, ec_encode_ref
+from ceph_tpu.gf.matrix import gen_cauchy1_matrix
+from bench import chained_seconds_per_step
+
+K, M = 8, 4
+CHUNK = 4096
+STRIPES = 2048
+
+
+def bit_matrix(coeff: np.ndarray) -> np.ndarray:
+    """(k*8, m*8) GF(2) matrix: W[j*8+s, i*8+r] = bit r of coeff[i,j] * 2^s."""
+    coeff = np.asarray(coeff, dtype=np.uint8)
+    m, k = coeff.shape
+    w = np.zeros((k * 8, m * 8), dtype=np.uint8)
+    for i in range(m):
+        for j in range(k):
+            for s in range(8):
+                p = gf_mul(int(coeff[i, j]), 1 << s)
+                for r in range(8):
+                    w[j * 8 + s, i * 8 + r] = (p >> r) & 1
+    return w
+
+
+_BITW = np.arange(8, dtype=np.int32)
+TILE = 1 << 15
+
+
+def _tile_loop(x, fn, rows_out, group=1):
+    rows = x.shape[0]
+    t = TILE
+    if rows <= t:
+        return fn(x)
+    pad = (-rows) % t
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], dtype=x.dtype)])
+    tiles = x.reshape(-1, t, *x.shape[1:])
+    out = jax.lax.map(fn, tiles)
+    return out.reshape(-1, *out.shape[2:])[:rows]
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "dtype"))
+def enc_bits(w, data, *, k, m, dtype):
+    s, _, b = data.shape
+    x = jnp.transpose(data, (0, 2, 1)).reshape(s * b, k)
+
+    def tile(xt):
+        t = xt.shape[0]
+        bits = ((xt[:, :, None].astype(jnp.int32) >> _BITW) & 1)
+        bits = bits.reshape(t, k * 8).astype(dtype)
+        acc = jax.lax.dot_general(
+            bits, w.astype(dtype), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32 if dtype == jnp.bfloat16 else jnp.int32)
+        pb = acc.astype(jnp.int32) & 1
+        return jnp.sum(pb.reshape(t, m, 8) << _BITW, axis=-1).astype(jnp.uint8)
+
+    packed = _tile_loop(x, tile, s * b)
+    return jnp.transpose(packed.reshape(s, b, m), (0, 2, 1)).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "g", "dtype"))
+def enc_blockdiag(wblk, data, *, k, m, g, dtype):
+    s, _, b = data.shape
+    x = jnp.transpose(data, (0, 2, 1)).reshape(s * b, k)
+
+    def tile(xt):
+        t = xt.shape[0]
+        bits = ((xt[:, :, None].astype(jnp.int32) >> _BITW) & 1)
+        bits = bits.reshape(t // g, g * k * 8).astype(dtype)
+        acc = jax.lax.dot_general(
+            bits, wblk.astype(dtype), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32 if dtype == jnp.bfloat16 else jnp.int32)
+        pb = acc.astype(jnp.int32) & 1  # (t/g, g*m*8)
+        return jnp.sum(pb.reshape(t, m, 8) << _BITW, axis=-1).astype(jnp.uint8)
+
+    packed = _tile_loop(x, tile, s * b)
+    return jnp.transpose(packed.reshape(s, b, m), (0, 2, 1)).astype(jnp.uint8)
+
+
+def main():
+    gen = gen_cauchy1_matrix(K, M)
+    coding = gen[K:]
+    rng = np.random.default_rng(0)
+    data_np = rng.integers(0, 256, (STRIPES, K, CHUNK), dtype=np.uint8)
+    data = jnp.asarray(data_np)
+    data_bytes = STRIPES * K * CHUNK
+    ref = ec_encode_ref(coding, data_np[:4])
+
+    w_nib = jnp.asarray(nibble_bit_table(coding))
+    wb = bit_matrix(coding)
+    w_bits = jnp.asarray(wb)
+    g = 4
+    wblk_np = np.zeros((g * K * 8, g * M * 8), dtype=np.uint8)
+    for i in range(g):
+        wblk_np[i * K * 8:(i + 1) * K * 8, i * M * 8:(i + 1) * M * 8] = wb
+    w_blk = jnp.asarray(wblk_np)
+
+    variants = {
+        "v0_nibble_bf16": lambda d: _encode_impl(w_nib, d, k=K, m=M, dot_dtype=jnp.bfloat16),
+        "v1_bits_int8": lambda d: enc_bits(w_bits, d, k=K, m=M, dtype=jnp.int8),
+        "v1_bits_bf16": lambda d: enc_bits(w_bits, d, k=K, m=M, dtype=jnp.bfloat16),
+        "v2_blk4_int8": lambda d: enc_blockdiag(w_blk, d, k=K, m=M, g=g, dtype=jnp.int8),
+        "v3_blk4_bf16": lambda d: enc_blockdiag(w_blk, d, k=K, m=M, g=g, dtype=jnp.bfloat16),
+    }
+
+    for name, fn in variants.items():
+        try:
+            out = np.asarray(fn(data[:4]))
+            ok = np.array_equal(out, ref)
+        except Exception as e:
+            print(f"{name}: FAILED {type(e).__name__}: {e}")
+            continue
+
+        def step(d, fn=fn):
+            p = fn(d)
+            return d.at[0, 0, 0].set(p[0, 0, 0] ^ jnp.uint8(1))
+
+        t = chained_seconds_per_step(step, data)
+        print(f"{name}: {'OK ' if ok else 'BAD'} {data_bytes / t / 1e9:8.2f} GB/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""Experiment 2: tile sizes + transpose-free einsum GF bit-matrix encode."""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ceph_tpu.ops.gf_kernel import ec_encode_ref
+from ceph_tpu.gf.matrix import gen_cauchy1_matrix
+from bench import chained_seconds_per_step
+from exp_gf import bit_matrix, K, M, CHUNK, STRIPES
+
+_BITW = np.arange(8, dtype=np.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "dtype", "tile"))
+def enc_bits_tile(w, data, *, k, m, dtype, tile):
+    s, _, b = data.shape
+    x = jnp.transpose(data, (0, 2, 1)).reshape(s * b, k)
+
+    def body(xt):
+        t = xt.shape[0]
+        bits = ((xt[:, :, None].astype(jnp.int32) >> _BITW) & 1)
+        bits = bits.reshape(t, k * 8).astype(dtype)
+        acc = jax.lax.dot_general(
+            bits, w.astype(dtype), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32 if dtype == jnp.bfloat16 else jnp.int32)
+        pb = acc.astype(jnp.int32) & 1
+        return jnp.sum(pb.reshape(t, m, 8) << _BITW, axis=-1).astype(jnp.uint8)
+
+    rows = s * b
+    if tile == 0 or rows <= tile:
+        packed = body(x)
+    else:
+        packed = jax.lax.map(body, x.reshape(-1, tile, k)).reshape(rows, m)
+    return jnp.transpose(packed.reshape(s, b, m), (0, 2, 1)).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "dtype", "tile"))
+def enc_einsum(w, data, *, k, m, dtype, tile):
+    """No transpose: bits (S, k*8, B); out[s, y, b] = sum_x W[x,y] bits[s,x,b]."""
+    s, _, b = data.shape
+
+    def body(d):  # d (ts, k, B)
+        ts = d.shape[0]
+        bits = ((d[:, :, None, :].astype(jnp.int32) >> _BITW[None, None, :, None]) & 1)
+        bits = bits.reshape(ts, k * 8, b).astype(dtype)
+        acc = jax.lax.dot_general(
+            w.astype(dtype), bits, (((0,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32 if dtype == jnp.bfloat16 else jnp.int32)
+        # acc (m*8, ts, B)
+        pb = acc.astype(jnp.int32) & 1
+        out = jnp.sum(pb.reshape(m, 8, ts, b) << _BITW[None, :, None, None], axis=1)
+        return jnp.transpose(out, (1, 0, 2)).astype(jnp.uint8)  # (ts, m, B)
+
+    if tile == 0 or s <= tile:
+        return body(data)
+    return jax.lax.map(body, data.reshape(-1, tile, k, b)).reshape(s, m, b)
+
+
+def main():
+    gen = gen_cauchy1_matrix(K, M)
+    coding = gen[K:]
+    rng = np.random.default_rng(0)
+    data_np = rng.integers(0, 256, (STRIPES, K, CHUNK), dtype=np.uint8)
+    data = jnp.asarray(data_np)
+    data_bytes = STRIPES * K * CHUNK
+    ref = ec_encode_ref(coding, data_np[:4])
+    w_bits = jnp.asarray(bit_matrix(coding))
+
+    variants = {
+        "rows_int8_t17": lambda d: enc_bits_tile(w_bits, d, k=K, m=M, dtype=jnp.int8, tile=1 << 17),
+        "rows_int8_t19": lambda d: enc_bits_tile(w_bits, d, k=K, m=M, dtype=jnp.int8, tile=1 << 19),
+        "rows_int8_full": lambda d: enc_bits_tile(w_bits, d, k=K, m=M, dtype=jnp.int8, tile=0),
+        "einsum_int8_full": lambda d: enc_einsum(w_bits, d, k=K, m=M, dtype=jnp.int8, tile=0),
+        "einsum_int8_t256": lambda d: enc_einsum(w_bits, d, k=K, m=M, dtype=jnp.int8, tile=256),
+        "einsum_bf16_full": lambda d: enc_einsum(w_bits, d, k=K, m=M, dtype=jnp.bfloat16, tile=0),
+    }
+
+    for name, fn in variants.items():
+        try:
+            out = np.asarray(fn(data[:4]))
+            ok = np.array_equal(out, ref)
+
+            def step(d, fn=fn):
+                p = fn(d)
+                return d.at[0, 0, 0].set(p[0, 0, 0] ^ jnp.uint8(1))
+
+            t = chained_seconds_per_step(step, data)
+            print(f"{name}: {'OK ' if ok else 'BAD'} {data_bytes / t / 1e9:8.2f} GB/s")
+        except Exception as e:
+            print(f"{name}: FAILED {type(e).__name__}: {str(e)[:200]}")
+
+
+if __name__ == "__main__":
+    main()

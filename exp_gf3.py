@@ -1,0 +1,114 @@
+"""Experiment 3: fused Pallas GF(2) bit-matmul encode kernels.
+
+Per grid step: load a block of stripes (SB, k, B) uint8, expand bits on
+sublanes, lane-split into G groups stacked on the contraction sublanes
+(block-diagonal W fills all 128 MXU output lanes), one int8 matmul,
+pack parity bits, store (SB, m, B) uint8. No HBM intermediates.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ceph_tpu.ops.gf_kernel import ec_encode_ref
+from ceph_tpu.gf.matrix import gen_cauchy1_matrix
+from bench import chained_seconds_per_step
+from exp_gf import bit_matrix, K, M, CHUNK, STRIPES
+
+
+def _expand_bits(d, k, B):
+    """(k, B) uint8 -> (k*8, B) int8 bit planes (row j*8+t = bit t of chunk j)."""
+    d32 = d.astype(jnp.int32)
+    rep = jnp.repeat(d32, 8, axis=0)                      # (k*8, B)
+    shifts = jnp.tile(jnp.arange(8, dtype=jnp.int32), k)[:, None]
+    return ((rep >> shifts) & 1).astype(jnp.int8)
+
+
+def _kernel_blk(d_ref, w_ref, out_ref, *, k, m, g, B, sb, dot_dtype):
+    # d_ref (sb, k, B) uint8; w_ref (g*k*8, g*m*8) int8; out (sb, m, B) uint8
+    Bg = B // g
+    outs = []
+    for s in range(sb):
+        bits = _expand_bits(d_ref[s], k, B)               # (k8, B) int8
+        groups = [bits[:, i * Bg:(i + 1) * Bg] for i in range(g)]
+        bits4 = jnp.concatenate(groups, axis=0)           # (g*k8, Bg)
+        acc = jax.lax.dot_general(
+            w_ref[...].T.astype(dot_dtype), bits4.astype(dot_dtype),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32 if dot_dtype == jnp.int8 else jnp.float32,
+        )                                                  # (g*m8, Bg)
+        pb = acc.astype(jnp.int32) & 1
+        pb = pb.reshape(g, m, 8, Bg)
+        bw = jnp.arange(8, dtype=jnp.int32)[None, None, :, None]
+        packed = jnp.sum(pb << bw, axis=2, dtype=jnp.int32)  # (g, m, Bg)
+        par = jnp.concatenate([packed[i] for i in range(g)], axis=1)  # (m, B)
+        outs.append(par)
+    out_ref[...] = jnp.stack(outs).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "g", "sb", "dot"))
+def enc_pallas(wblk, data, *, k, m, g, sb, dot):
+    s, _, B = data.shape
+    dot_dtype = jnp.int8 if dot == "int8" else jnp.bfloat16
+    return pl.pallas_call(
+        functools.partial(_kernel_blk, k=k, m=m, g=g, B=B, sb=sb,
+                          dot_dtype=dot_dtype),
+        grid=(s // sb,),
+        in_specs=[
+            pl.BlockSpec((sb, k, B), lambda i: (i, jnp.int32(0), jnp.int32(0))),
+            pl.BlockSpec((g * k * 8, g * m * 8),
+                         lambda i: (jnp.int32(0), jnp.int32(0)),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((sb, m, B),
+                               lambda i: (i, jnp.int32(0), jnp.int32(0))),
+        out_shape=jax.ShapeDtypeStruct((s, m, B), jnp.uint8),
+    )(data, wblk)
+
+
+def main():
+    gen = gen_cauchy1_matrix(K, M)
+    coding = gen[K:]
+    rng = np.random.default_rng(0)
+    data_np = rng.integers(0, 256, (STRIPES, K, CHUNK), dtype=np.uint8)
+    data = jnp.asarray(data_np)
+    data_bytes = STRIPES * K * CHUNK
+    ref = ec_encode_ref(coding, data_np[:8])
+    wb = bit_matrix(coding)
+
+    def wblk_of(g):
+        w = np.zeros((g * K * 8, g * M * 8), dtype=np.int8)
+        for i in range(g):
+            w[i * K * 8:(i + 1) * K * 8, i * M * 8:(i + 1) * M * 8] = wb
+        return jnp.asarray(w)
+
+    variants = {}
+    for g in (4, 2, 1):
+        for sb in (1, 4, 8):
+            for dot in ("int8", "bf16"):
+                variants[f"pl_g{g}_sb{sb}_{dot}"] = functools.partial(
+                    lambda d, g, sb, dot, w: enc_pallas(w, d, k=K, m=M, g=g, sb=sb, dot=dot),
+                    g=g, sb=sb, dot=dot, w=wblk_of(g))
+
+    for name, fn in variants.items():
+        try:
+            out = np.asarray(fn(data[:8]))
+            ok = np.array_equal(out, ref)
+
+            def step(d, fn=fn):
+                p = fn(d)
+                return d.at[0, 0, 0].set(p[0, 0, 0] ^ jnp.uint8(1))
+
+            t = chained_seconds_per_step(step, data)
+            print(f"{name}: {'OK ' if ok else 'BAD'} {data_bytes / t / 1e9:8.2f} GB/s")
+        except Exception as e:
+            print(f"{name}: FAILED {type(e).__name__}: {str(e)[:160]}")
+
+
+if __name__ == "__main__":
+    main()

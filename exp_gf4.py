@@ -1,0 +1,71 @@
+"""Experiment 4: careful re-measurement of the top Pallas configs."""
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ceph_tpu.ops.gf_kernel import ec_encode_ref
+from ceph_tpu.gf.matrix import gen_cauchy1_matrix
+from exp_gf import bit_matrix, K, M, CHUNK, STRIPES
+from exp_gf3 import enc_pallas
+
+
+def measure(step_fn, carry, n_lo=4, n_hi=20, reps=5):
+    @functools.partial(jax.jit, static_argnames="n")
+    def loop(c, n):
+        c, _ = jax.lax.scan(lambda c, _: (step_fn(c), ()), c, None, length=n)
+        return jax.tree_util.tree_leaves(c)[0].ravel()[0]
+
+    jax.device_get(loop(carry, n_lo))
+    jax.device_get(loop(carry, n_hi))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.device_get(loop(carry, n_lo))
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        jax.device_get(loop(carry, n_hi))
+        t_hi = time.perf_counter() - t0
+        ts.append(max(t_hi - t_lo, 1e-9) / (n_hi - n_lo))
+    return ts
+
+
+def main():
+    gen = gen_cauchy1_matrix(K, M)
+    coding = gen[K:]
+    rng = np.random.default_rng(0)
+    data_np = rng.integers(0, 256, (STRIPES, K, CHUNK), dtype=np.uint8)
+    data = jnp.asarray(data_np)
+    data_bytes = STRIPES * K * CHUNK
+    ref = ec_encode_ref(coding, data_np)
+    wb = bit_matrix(coding)
+
+    def wblk_of(g):
+        w = np.zeros((g * K * 8, g * M * 8), dtype=np.int8)
+        for i in range(g):
+            w[i * K * 8:(i + 1) * K * 8, i * M * 8:(i + 1) * M * 8] = wb
+        return jnp.asarray(w)
+
+    for g, sb in [(4, 4), (4, 8), (2, 4), (1, 4), (2, 8)]:
+        w = wblk_of(g)
+        fn = lambda d, g=g, sb=sb, w=w: enc_pallas(w, d, k=K, m=M, g=g, sb=sb, dot="int8")
+        out = np.asarray(fn(data))
+        ok = np.array_equal(out, ref)
+
+        def step(d, fn=fn):
+            p = fn(d)
+            return d.at[0, 0, 0].set(p[0, 0, 0] ^ jnp.uint8(1))
+
+        ts = measure(step, data)
+        rates = sorted(data_bytes / t / 1e9 for t in ts)
+        med = rates[len(rates) // 2]
+        print(f"g{g}_sb{sb}: {'OK ' if ok else 'BAD'} med {med:7.2f} GB/s  "
+              f"[{rates[0]:.1f} .. {rates[-1]:.1f}]")
+
+
+if __name__ == "__main__":
+    main()
