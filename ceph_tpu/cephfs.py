@@ -132,8 +132,7 @@ def _data_name(ino: int) -> str:
 
 
 def _is_tcp(msgr) -> bool:
-    from ceph_tpu.msg.async_tcp import AsyncMessenger
-    return isinstance(msgr, AsyncMessenger)
+    return msgr.is_wire
 
 
 class File:

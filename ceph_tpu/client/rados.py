@@ -350,8 +350,7 @@ class RadosClient(Dispatcher):
 
 
 def _is_tcp(msgr) -> bool:
-    from ceph_tpu.msg.async_tcp import AsyncMessenger
-    return isinstance(msgr, AsyncMessenger)
+    return msgr.is_wire
 
 
 class IoCtx:

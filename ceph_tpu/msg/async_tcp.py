@@ -285,6 +285,8 @@ class TcpConnection(Connection):
 
 
 class AsyncMessenger(Messenger):
+    is_wire = True
+
     #: cap on bytes concurrently in dispatch (policy throttler analog)
     DISPATCH_THROTTLE_BYTES = 512 << 20
 

@@ -96,6 +96,10 @@ class Messenger:
     """Transport-agnostic base; create() picks the stack like
     Messenger::create(cct, type, ...)."""
 
+    #: True for stacks that serialize to a real byte stream and bind
+    #: host:port addresses (TCP); loopback/ici bind entity names
+    is_wire = False
+
     def __init__(self, name: EntityName):
         self.my_name = name
         self.my_addr: str | None = None
@@ -107,6 +111,12 @@ class Messenger:
     @staticmethod
     def create(name: EntityName, mtype: str = "async", **kw) -> "Messenger":
         if mtype == "async":
+            # the event-driven stack is the default AsyncMessenger, like
+            # the reference (epoll event centers); the thread-per-
+            # connection stack stays available as "threaded"
+            from .event_tcp import EventMessenger
+            return EventMessenger(name, **kw)
+        if mtype == "threaded":
             from .async_tcp import AsyncMessenger
             return AsyncMessenger(name, **kw)
         if mtype == "loopback":

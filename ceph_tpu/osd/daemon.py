@@ -841,6 +841,22 @@ class OSDDaemon(Dispatcher):
                         # no longer a member: a held/queued recovery slot
                         # must not leak (it would wedge every later PG)
                         self.local_reserver.cancel(pgid)
+                    # stray notify (PG stray semantics): we hold data for
+                    # a PG we are no longer (or never were) up for.  The
+                    # new primary may have NOTHING — a child remapped
+                    # onto fresh OSDs after pgp_num grew, or a wide
+                    # reshuffle — and only learns prior holders from
+                    # these notifies.
+                    if (pg is not None and primary != self.osd_id
+                            and primary != CEPH_NOSD
+                            and (pg.log.entries
+                                 or pg.info.last_update > EVERSION_ZERO)):
+                        con = self._osd_con(primary)
+                        if con:
+                            con.send_message(MOSDPGNotify(
+                                pgid=pgid,
+                                info=self._advertised_info(pg),
+                                epoch=m.epoch, from_osd=self.osd_id))
                     continue
                 pg = self._get_pg(pgid)
                 if pg.up != up or pg.primary != primary \
@@ -857,7 +873,8 @@ class OSDDaemon(Dispatcher):
             pg.primary = primary
             pg.peering_epoch = self.osdmap.epoch
             pg.peering_started = time.time()
-            pg.peers = {}
+            pg.peers = {o: PeerState(info=i)
+                        for o, i in pg.strays.items() if o not in up}
             pg.recovering.clear()
             # interval change: in-flight rmw gathers die with the gate;
             # their client ops requeue (re-executed post-activation)
@@ -946,26 +963,58 @@ class OSDDaemon(Dispatcher):
                 epoch=msg.epoch, from_osd=self.osd_id))
 
     def _handle_pg_notify(self, msg: MOSDPGNotify) -> None:
+        restart = False
         with self._lock:
             pg = self.pgs.get(msg.pgid)
-            if (pg is None or pg.state != STATE_GETINFO
-                    or msg.epoch != pg.peering_epoch):
+            if pg is None:
                 return
-            pg.peers[msg.from_osd] = PeerState(info=msg.info)
-            self._merge_past_up(pg, msg.info.past_up)
-            expected = [o for o in pg.up
-                        if o != self.osd_id and o != CEPH_NOSD]
-            if not all(o in pg.peers for o in expected):
-                return
-            # all infos in: pick the authoritative history
-            # (PG::find_best_info — longest last_update wins, self on ties)
-            best = max(expected,
-                       key=lambda o: pg.peers[o].info.last_update)
-            if pg.peers[best].info.last_update > pg.info.last_update:
-                pg.state = STATE_GETLOG
-                target = best
+            if msg.from_osd not in pg.up:
+                # a stray holder announced itself: record as a peering
+                # and recovery source
+                pg.strays[msg.from_osd] = msg.info
+                pg.peers.setdefault(msg.from_osd,
+                                    PeerState()).info = msg.info
+                self._merge_past_up(pg, msg.info.past_up)
+                if (pg.primary == self.osd_id
+                        and pg.state in (STATE_ACTIVE, STATE_RECOVERING)
+                        and msg.info.last_update > pg.info.last_update):
+                    # the stray has history we activated without (its
+                    # notify lost the race): re-peer with it as a source
+                    restart = True
+                if pg.state != STATE_GETINFO:
+                    pass_through = False
+                else:
+                    pass_through = True
             else:
-                target = None
+                if (pg.state != STATE_GETINFO
+                        or msg.epoch != pg.peering_epoch):
+                    return
+                pg.peers[msg.from_osd] = PeerState(info=msg.info)
+                self._merge_past_up(pg, msg.info.past_up)
+                pass_through = True
+            target = None
+            if pass_through and pg.state == STATE_GETINFO:
+                expected = [o for o in pg.up
+                            if o != self.osd_id and o != CEPH_NOSD]
+                if not all(o in pg.peers for o in expected):
+                    return
+                # all infos in: pick the authoritative history among up
+                # members AND strays (PG::find_best_info over the prior
+                # set — longest last_update wins, self on ties)
+                cands = {o: pg.peers[o].info for o in expected}
+                for o, i in pg.strays.items():
+                    cands.setdefault(o, i)
+                best = (max(cands, key=lambda o: cands[o].last_update)
+                        if cands else None)
+                if (best is not None
+                        and cands[best].last_update > pg.info.last_update):
+                    pg.state = STATE_GETLOG
+                    target = best
+            elif not restart:
+                return
+        if restart:
+            self._start_peering(pg, pg.up, pg.primary)
+            return
         if target is None:
             self._pg_recover_or_activate(pg)
             return

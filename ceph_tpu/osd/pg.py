@@ -216,6 +216,11 @@ class PG:
         self.missing: dict[str, MissingItem] = {}
         #: primary only: per-peer peering state
         self.peers: dict[int, PeerState] = {}
+        #: primary only: infos from STRAY osds — holders outside the up
+        #: set that announced data via notify (PG stray semantics).  A
+        #: remap with a disjoint new up set (e.g. children after
+        #: pgp_num growth) recovers from these.
+        self.strays: dict[int, "PGInfo"] = {}
         #: ops queued while not active / while an object recovers
         self.waiting_for_active: list = []
         self.waiting_for_missing: dict[str, list] = {}

@@ -186,3 +186,68 @@ def test_osdmap_codec_roundtrip():
     # placement identical through the codec
     for pg in range(32):
         assert back.pg_to_up_acting_osds(1, pg) == m.pg_to_up_acting_osds(1, pg)
+
+
+def test_event_stack_thread_count():
+    """The event-driven stack costs 2 messenger threads per daemon
+    regardless of connection count (the epoll-AsyncMessenger property
+    the threaded stack lacks: it spawns ~2 threads per connection)."""
+    import threading
+
+    from ceph_tpu.tools.vstart import MiniCluster
+
+    before = {t.name for t in threading.enumerate()}
+    c = MiniCluster(n_osds=10, ms_type="async", heartbeats=True).start()
+    try:
+        c.wait_for_osd_count(10)
+        client = c.client()
+        pool = c.create_pool(client, pg_num=16, size=3)
+        io = client.open_ioctx(pool)
+        for i in range(10):
+            io.write_full(f"o{i}", b"x" * 512)
+        # 10 osds + 1 mon + 1 client = 12 messengers; heartbeats mesh
+        # the osds all-to-all, so connections >> messengers
+        ms_threads = [t.name for t in threading.enumerate()
+                      if t.name.startswith("ms-") and t.name not in before]
+        n_daemons = 12
+        assert len(ms_threads) <= 2 * n_daemons, ms_threads
+        conns = sum(len(o.msgr._conns) for o in c.osds.values())
+        assert conns > 2 * 10, f"expected a meshed cluster, got {conns}"
+    finally:
+        c.stop()
+
+
+def test_event_and_threaded_stacks_interoperate():
+    """Same v1-lite wire protocol: a threaded-stack client talks to an
+    event-stack server and vice versa."""
+    import time as _t
+
+    from ceph_tpu.messages import MOSDPing
+    from ceph_tpu.msg.messenger import Dispatcher, EntityName, Messenger
+
+    for srv_type, cli_type in (("async", "threaded"),
+                               ("threaded", "async")):
+        got = []
+
+        class D(Dispatcher):
+            def ms_dispatch(self, msg):
+                got.append(msg)
+                return True
+
+        srv = Messenger.create(EntityName("osd", 7), srv_type)
+        srv.set_auth(b"sharedkey")
+        srv.add_dispatcher_tail(D())
+        srv.bind("127.0.0.1:0")
+        srv.start()
+        cli = Messenger.create(EntityName("client", 8), cli_type)
+        cli.set_auth(b"sharedkey")
+        cli.start()
+        con = cli.connect_to(srv.my_addr, EntityName("osd", 7))
+        for _ in range(3):
+            con.send_message(MOSDPing(from_osd=8, stamp=_t.time()))
+        deadline = _t.time() + 5
+        while len(got) < 3 and _t.time() < deadline:
+            _t.sleep(0.02)
+        assert len(got) == 3, f"{srv_type}<-{cli_type}: got {len(got)}"
+        cli.shutdown()
+        srv.shutdown()
