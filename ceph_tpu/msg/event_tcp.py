@@ -177,6 +177,9 @@ class EventConnection(Connection):
         s.setblocking(False)
         self.sock = s
         self.state = _CONNECTING
+        # fresh deadline per dial: covers both the TCP connect and the
+        # handshake (a redial must not inherit an expired deadline)
+        self.hs_deadline = time.monotonic() + 10.0
         try:
             rc = s.connect_ex((host, int(port)))
         except OSError:
@@ -393,7 +396,10 @@ class EventConnection(Connection):
         want = selectors.EVENT_READ if not self.messenger.paused else 0
         with self.messenger._lock:
             pending = bool(self.backlog)
-        if self.out_frames or pending or self.state == _CONNECTING:
+        # backlog counts only once OPEN: mid-handshake it cannot be
+        # framed yet, and write interest with nothing to write busy-spins
+        if self.out_frames or self.state == _CONNECTING or (
+                pending and self.state == _OPEN):
             want |= selectors.EVENT_WRITE
         if want == self._cur_want:
             return

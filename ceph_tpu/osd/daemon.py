@@ -873,6 +873,11 @@ class OSDDaemon(Dispatcher):
             pg.primary = primary
             pg.peering_epoch = self.osdmap.epoch
             pg.peering_started = time.time()
+            # drop strays the map says are gone: a dead stray with the
+            # best last_update would otherwise be chosen as the GETLOG
+            # target forever and wedge peering
+            pg.strays = {o: i for o, i in pg.strays.items()
+                         if self.osdmap.exists(o) and self.osdmap.is_up(o)}
             pg.peers = {o: PeerState(info=i)
                         for o, i in pg.strays.items() if o not in up}
             pg.recovering.clear()
@@ -971,15 +976,23 @@ class OSDDaemon(Dispatcher):
             if msg.from_osd not in pg.up:
                 # a stray holder announced itself: record as a peering
                 # and recovery source
+                prev = pg.strays.get(msg.from_osd)
                 pg.strays[msg.from_osd] = msg.info
                 pg.peers.setdefault(msg.from_osd,
                                     PeerState()).info = msg.info
                 self._merge_past_up(pg, msg.info.past_up)
                 if (pg.primary == self.osd_id
                         and pg.state in (STATE_ACTIVE, STATE_RECOVERING)
-                        and msg.info.last_update > pg.info.last_update):
+                        and msg.info.last_update > pg.info.last_update
+                        and (prev is None or prev.last_update
+                             < msg.info.last_update)):
                     # the stray has history we activated without (its
-                    # notify lost the race): re-peer with it as a source
+                    # notify lost the race): re-peer with it as a
+                    # source.  Guarded on NEW information: a stray whose
+                    # divergent tail the EC roll-forward trim already
+                    # rejected re-notifies the same info on every map
+                    # epoch, and restarting for it each time would
+                    # re-peer the PG forever
                     restart = True
                 if pg.state != STATE_GETINFO:
                     pass_through = False
@@ -1004,6 +1017,25 @@ class OSDDaemon(Dispatcher):
                 cands = {o: pg.peers[o].info for o in expected}
                 for o, i in pg.strays.items():
                     cands.setdefault(o, i)
+                # EC roll-forward bound (PGLog can_rollback_to collapsed
+                # to entry granularity): an entry held by fewer than k
+                # shard holders can neither be reconstructed nor have
+                # been acked (the client ack waits for ALL shard
+                # commits), so the authoritative history trims to the
+                # k-th highest last_update among known holders.  Without
+                # this, a torn write whose tail landed on one shard
+                # poisons recovery forever (gather: need > every
+                # reconstructable version).
+                pool = self.osdmap.pools.get(pg.pgid[0])
+                pg.ec_rollforward = None
+                if pool is not None and pool.is_erasure():
+                    lus = sorted(
+                        [pg.info.last_update]
+                        + [i.last_update for i in cands.values()],
+                        reverse=True)
+                    k = int(pool.ec_profile.get("k", 2))
+                    if len(lus) >= k:
+                        pg.ec_rollforward = lus[k - 1]
                 best = (max(cands, key=lambda o: cands[o].last_update)
                         if cands else None)
                 if (best is not None
@@ -1016,6 +1048,7 @@ class OSDDaemon(Dispatcher):
             self._start_peering(pg, pg.up, pg.primary)
             return
         if target is None:
+            self._ec_trim_log(pg)
             self._pg_recover_or_activate(pg)
             return
         con = self._osd_con(target)
@@ -1035,6 +1068,7 @@ class OSDDaemon(Dispatcher):
                     return
                 self._merge_past_up(pg, msg.info.past_up)
                 self._pg_merge(pg, msg.entries)
+                self._ec_trim_log(pg)
                 self._pg_recover_or_activate(pg)
                 return
             # ACTIVATE: primary's authoritative history
@@ -1056,9 +1090,10 @@ class OSDDaemon(Dispatcher):
             self.local_reserver.request(
                 pg.pgid, lambda: self._start_recovery_ops(pg))
 
-    def _pg_merge(self, pg: PG, entries: list[LogEntry]) -> None:
-        """merge_log + on-disk application of its consequences."""
-        cid = self._pg_cid(pg.pgid)
+    def _store_oid_fn(self, pg: PG):
+        """Shard-decorated store name for this OSD's copy of an object
+        (EC pools suffix the positional shard; one definition so merge,
+        trim and recovery address the same on-disk objects)."""
         pool = self.osdmap.pools.get(pg.pgid[0])
         ec = pool is not None and pool.is_erasure()
         myshard = pg.up.index(self.osd_id) if ec \
@@ -1066,6 +1101,12 @@ class OSDDaemon(Dispatcher):
 
         def store_oid(oid: str) -> str:
             return f"{oid}:{myshard}" if ec else oid
+        return store_oid
+
+    def _pg_merge(self, pg: PG, entries: list[LogEntry]) -> None:
+        """merge_log + on-disk application of its consequences."""
+        cid = self._pg_cid(pg.pgid)
+        store_oid = self._store_oid_fn(pg)
 
         def local_has(oid: str):
             return dec_version(self._getattr_safe(cid, store_oid(oid), "_v"))
@@ -1096,6 +1137,49 @@ class OSDDaemon(Dispatcher):
         dout("osd", 10,
              "osd.%d pg %s merged log: head %s, %d missing, %d removed",
              self.osd_id, cid, pg.log.head, len(to_recover), len(to_remove))
+
+    def _ec_trim_log(self, pg: PG) -> None:
+        """Rewind an EC pg's authoritative log to the roll-forward bound
+        computed during GETINFO (entries beyond it are unreconstructable
+        AND unacked — see _handle_pg_notify).  Runs on the primary before
+        activation, so replicas adopt the trimmed history uniformly and
+        their own divergent tails roll back through the normal merge."""
+        bound = getattr(pg, "ec_rollforward", None)
+        if bound is None or pg.log.head <= bound:
+            return
+        cid = self._pg_cid(pg.pgid)
+        store_oid = self._store_oid_fn(pg)
+        divergent = pg.log.rewind(bound)
+        t = Transaction().touch(cid, PG.PGMETA)
+        t.omap_rmkeys(cid, PG.PGMETA,
+                      [PG.log_key(e.version) for e in divergent])
+        seen: set[str] = set()
+        for e in reversed(divergent):
+            if e.oid in seen:
+                continue
+            seen.add(e.oid)
+            ae = pg.log.index.get(e.oid)
+            if ae is None or ae.is_delete():
+                pg.missing.pop(e.oid, None)
+                t.remove(cid, store_oid(e.oid))
+            else:
+                have = dec_version(self._getattr_safe(
+                    cid, store_oid(e.oid), "_v"))
+                if have == ae.version:
+                    pg.missing.pop(e.oid, None)
+                else:
+                    pg.missing[e.oid] = MissingItem(
+                        need=ae.version, have=have or EVERSION_ZERO)
+        pg.info.last_update = pg.log.head
+        pg.info.last_complete = pg.complete_to()
+        pg.next_seq = pg.log.head[1]
+        t.omap_setkeys(cid, PG.PGMETA, {
+            "info": pg.encode_info(),
+            "missing": pg.encode_missing()})
+        self.store.apply_transaction(t)
+        dout("osd", 3, "osd.%d pg %s ec-trimmed log to %s "
+             "(%d entries rolled back)", self.osd_id, cid, bound,
+             len(divergent))
 
     def _getattr_safe(self, cid, oid, name):
         try:

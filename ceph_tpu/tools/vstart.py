@@ -42,6 +42,10 @@ class MiniCluster:
         self.mgr = None
         self.mds = None
 
+    def _is_wire(self) -> bool:
+        """TCP-style stacks bind host:port; loopback/ici bind names."""
+        return self.ms_type not in ("loopback", "ici")
+
     @property
     def mon(self) -> Monitor:
         """A live monitor (prefer the leader — its map is freshest)."""
@@ -69,7 +73,7 @@ class MiniCluster:
         return self
 
     def run_mon(self, mon_id: int, defer_monmap: bool = False) -> Monitor:
-        addr = ("127.0.0.1:0" if self.ms_type == "async"
+        addr = ("127.0.0.1:0" if self._is_wire()
                 else f"{self._ns}mon.{mon_id}")
         path = (f"{self.base_path}/mon.{mon_id}" if self.base_path else None)
         mon = Monitor(mon_id=mon_id, ms_type=self.ms_type, addr=addr,
@@ -97,7 +101,7 @@ class MiniCluster:
         """Start the manager; OSDs started AFTERWARDS stream reports
         to it (restart existing ones to pick it up)."""
         from ceph_tpu.mgr import MgrDaemon
-        addr = ("127.0.0.1:0" if self.ms_type == "async"
+        addr = ("127.0.0.1:0" if self._is_wire()
                 else f"{self._ns}mgr.0")
         self.mgr = MgrDaemon(self.mon_host, ms_type=self.ms_type,
                              addr=addr, auth_key=self.auth_key)
@@ -108,7 +112,7 @@ class MiniCluster:
         """Start the metadata server over the given pools (the `fs new
         meta data` + ceph-mds step)."""
         from ceph_tpu.mds import MDSDaemon
-        addr = ("127.0.0.1:0" if self.ms_type == "async"
+        addr = ("127.0.0.1:0" if self._is_wire()
                 else f"{self._ns}mds.0")
         self.mds = MDSDaemon(self.mon_host, metadata_pool, data_pool,
                              ms_type=self.ms_type, addr=addr,
@@ -122,7 +126,7 @@ class MiniCluster:
         mds.shutdown()
 
     def run_osd(self, osd_id: int) -> OSDDaemon:
-        addr = (f"127.0.0.1:0" if self.ms_type == "async"
+        addr = (f"127.0.0.1:0" if self._is_wire()
                 else f"{self._ns}osd.{osd_id}")
         path = (f"{self.base_path}/osd.{osd_id}" if self.base_path else "")
         osd = OSDDaemon(osd_id, self.mon_host, store_type=self.store_type,

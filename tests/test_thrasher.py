@@ -13,3 +13,16 @@ def test_thrasher_soak(tmp_path):
     assert res["corruptions"] == [], res
     assert res["lost_rep"] == [], res
     assert res["lost_ec"] == [], res
+
+
+def test_thrasher_soak_torn_ec_write_seed(tmp_path):
+    """Regression: seed 14's storm tears an EC write across a kill (one
+    shard lands at version V, the rest stay at V-1); peering must trim
+    the authoritative log to the k-th highest holder last_update
+    (_ec_trim_log) or recovery livelocks needing an unreconstructable
+    version and the object reads as lost."""
+    res = run_soak(duration=18.0, seed=14, n_osds=6,
+                   base_path=str(tmp_path))
+    assert res["corruptions"] == [], res
+    assert res["lost_rep"] == [], res
+    assert res["lost_ec"] == [], res
