@@ -18,3 +18,14 @@ __all__ = [
     "CephTpuContext", "PerfCounters", "PerfCountersBuilder",
     "dout", "get_logger", "set_subsys_level", "AdminSocket", "Throttle",
 ]
+
+
+def free_port() -> int:
+    """Allocate an ephemeral localhost TCP port (bind/close; the usual
+    harness-grade race window applies)."""
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -976,20 +976,22 @@ class OSDDaemon(Dispatcher):
             if msg.from_osd not in pg.up:
                 # a stray holder announced itself: record as a peering
                 # and recovery source
-                prev = pg.strays.get(msg.from_osd)
                 pg.strays[msg.from_osd] = msg.info
                 pg.peers.setdefault(msg.from_osd,
                                     PeerState()).info = msg.info
                 self._merge_past_up(pg, msg.info.past_up)
+                considered = getattr(pg, "strays_considered", {})
                 if (pg.primary == self.osd_id
                         and pg.state in (STATE_ACTIVE, STATE_RECOVERING)
                         and msg.info.last_update > pg.info.last_update
-                        and (prev is None or prev.last_update
-                             < msg.info.last_update)):
+                        and msg.info.last_update
+                        > considered.get(msg.from_osd, EVERSION_ZERO)):
                     # the stray has history we activated without (its
-                    # notify lost the race): re-peer with it as a
-                    # source.  Guarded on NEW information: a stray whose
-                    # divergent tail the EC roll-forward trim already
+                    # notify lost the race — possibly arriving mid-
+                    # GETLOG, after the GETINFO snapshot): re-peer with
+                    # it as a source.  Guarded on info a completed
+                    # peering round has NOT already considered: a stray
+                    # whose divergent tail the EC roll-forward trim
                     # rejected re-notifies the same info on every map
                     # epoch, and restarting for it each time would
                     # re-peer the PG forever
@@ -1017,6 +1019,10 @@ class OSDDaemon(Dispatcher):
                 cands = {o: pg.peers[o].info for o in expected}
                 for o, i in pg.strays.items():
                     cands.setdefault(o, i)
+                # remember what this round evaluated: only genuinely
+                # NEWER stray info may trigger a post-activation re-peer
+                pg.strays_considered = {
+                    o: i.last_update for o, i in cands.items()}
                 # EC roll-forward bound (PGLog can_rollback_to collapsed
                 # to entry granularity): an entry held by fewer than k
                 # shard holders can neither be reconstructed nor have

@@ -1,0 +1,191 @@
+"""DCN story: the cluster data path across OS-process boundaries.
+
+The reference scales past one host with NCCL-less TCP messengers; the
+TPU-native equivalent (SURVEY.md §5) is a two-plane design:
+
+* data plane — `jax.distributed` multi-controller runtime: each process
+  owns its local devices (ICI domain), XLA collectives ride DCN between
+  processes.  One global `Mesh` spans every device of every process and
+  `jit` over sharded global arrays inserts the cross-process collectives
+  exactly as it inserts ICI ones inside a process.
+* control plane — the same TCP messenger stack the daemons use
+  (`msg/event_tcp.py`), carrying typed messages between processes.
+
+`run_dcn_pair(n)` is the executable proof: it spawns TWO worker
+processes, each with n/2 virtual CPU devices; the workers build the
+global 2-process mesh, run the batched GF(2^8) erasure encode over
+globally-sharded stripes with a cross-process reduction, verify the
+result against the host oracle, and then cross-check their digests over
+a TCP messenger session.  `__graft_entry__.dryrun_multichip` invokes it,
+so the driver exercises the multi-process path on every round.
+
+`pick_stack(peer_process, my_process)` is the SURVEY §5 routing rule the
+messenger family uses: same process -> "ici" (device-buffer handoff),
+different process -> "async" (TCP/DCN).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+
+def pick_stack(peer_process: int, my_process: int) -> str:
+    """Messenger stack per peer: ICI inside a process, TCP across."""
+    return "ici" if peer_process == my_process else "async"
+
+
+def run_dcn_pair(n_devices: int = 8, timeout: float = 240.0) -> None:
+    """Spawn the two-process mesh proof; raises on any failure."""
+    assert n_devices >= 2 and n_devices % 2 == 0, \
+        "need an even global device count of at least 2"
+    from ceph_tpu.common import free_port
+    coord = f"127.0.0.1:{free_port()}"
+    ms_port = free_port()
+    procs = []
+    env = dict(os.environ)
+    # the workers configure their own platform; a parent-forced platform
+    # (e.g. the test conftest's cpu pin) must not leak conflicting
+    # device counts into them
+    env.pop("XLA_FLAGS", None)
+    for pid in range(2):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "ceph_tpu.parallel.dcn",
+             "--coordinator", coord, "--num-processes", "2",
+             "--process-id", str(pid),
+             "--local-devices", str(n_devices // 2),
+             "--ms-port", str(ms_port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    deadline = time.time() + timeout
+    outs = []
+    for p in procs:
+        remaining = max(1.0, deadline - time.time())
+        try:
+            out, _ = p.communicate(timeout=remaining)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise TimeoutError("dcn worker timed out")
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"dcn worker {pid} failed (rc={p.returncode}):\n{out}")
+
+
+def worker_main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", required=True)
+    ap.add_argument("--num-processes", type=int, required=True)
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--local-devices", type=int, required=True)
+    ap.add_argument("--ms-port", type=int, required=True)
+    args = ap.parse_args(argv)
+
+    # platform setup MUST precede any jax backend initialization
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={args.local_devices}")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(args.coordinator, args.num_processes,
+                               args.process_id)
+    import functools
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import ceph_tpu  # noqa: F401  (x64 for the GF/CRUSH kernels)
+    from ceph_tpu.gf.matrix import gen_cauchy1_matrix
+    from ceph_tpu.gf.tables import bit_matrix
+    from ceph_tpu.ops.gf_kernel import _encode_xla, ec_encode_ref
+
+    n_global = args.num_processes * args.local_devices
+    devs = jax.devices()
+    assert len(devs) == n_global, (len(devs), n_global)
+    mesh = Mesh(np.array(devs), ("dp",))
+
+    # deterministic global workload; every process derives the same bytes
+    k, m, chunk = 4, 2, 256
+    stripes = 4 * n_global
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (stripes, k, chunk), dtype=np.uint8)
+    per_proc = stripes // args.num_processes
+    local = data[args.process_id * per_proc:
+                 (args.process_id + 1) * per_proc]
+    sharding = NamedSharding(mesh, P("dp", None, None))
+    arr = jax.make_array_from_process_local_data(sharding, local)
+
+    coding = gen_cauchy1_matrix(k, m)[k:]
+    w = jnp.asarray(bit_matrix(coding))
+    enc = functools.partial(_encode_xla, w, k=k, m=m)
+
+    # encode over the GLOBAL mesh; the jnp.sum is a cross-process
+    # all-reduce riding the DCN backend
+    total = int(jax.jit(
+        lambda d: jnp.sum(enc(d).astype(jnp.int64)))(arr))
+    expect = int(ec_encode_ref(coding, data).astype(np.int64).sum())
+    assert total == expect, (total, expect)
+
+    # control plane: cross-check digests over the TCP messenger
+    from ceph_tpu.messages import MMonCommand, MMonCommandAck
+    from ceph_tpu.msg.messenger import Dispatcher, EntityName, Messenger
+
+    stack = pick_stack(peer_process=1 - args.process_id,
+                       my_process=args.process_id)
+    assert stack == "async"
+    result = {}
+    if args.process_id == 0:
+        class D(Dispatcher):
+            def ms_dispatch(self, msg):
+                if isinstance(msg, MMonCommand):
+                    result["peer"] = msg.cmd
+                    msg.connection.send_message(MMonCommandAck(
+                        tid=msg.tid,
+                        result=0 if msg.cmd.get("total") == total else -1))
+                    return True
+                return False
+
+        ms = Messenger.create(EntityName("mon", 0), stack)
+        ms.add_dispatcher_tail(D())
+        ms.bind(f"127.0.0.1:{args.ms_port}")
+        ms.start()
+        deadline = time.time() + 60
+        while "peer" not in result and time.time() < deadline:
+            time.sleep(0.05)
+        ms.shutdown()
+        assert result.get("peer", {}).get("total") == total, result
+    else:
+        acked = {}
+
+        class D(Dispatcher):
+            def ms_dispatch(self, msg):
+                if isinstance(msg, MMonCommandAck):
+                    acked["rc"] = msg.result
+                    return True
+                return False
+
+        ms = Messenger.create(EntityName("osd", 1), stack)
+        ms.add_dispatcher_tail(D())
+        ms.start()
+        con = ms.connect_to(f"127.0.0.1:{args.ms_port}",
+                            EntityName("mon", 0))
+        con.send_message(MMonCommand(tid=1, cmd={
+            "total": total, "process": args.process_id,
+            "devices": n_global}))
+        deadline = time.time() + 60
+        while "rc" not in acked and time.time() < deadline:
+            time.sleep(0.05)
+        ms.shutdown()
+        assert acked.get("rc") == 0, acked
+    print(f"dcn worker {args.process_id}: global sum {total} over "
+          f"{n_global} devices in {args.num_processes} processes")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main())

@@ -1,0 +1,86 @@
+"""Daemon process entry point — the ceph-osd / ceph-mon `main()` analog.
+
+Each daemon runs as its own OS process over the TCP messenger stack
+(`python -m ceph_tpu.tools.daemon_main --role osd --id 2 ...`), the
+reference's deployment model (src/ceph_osd.cc, src/ceph_mon.cc; spawned
+by vstart.sh / qa/standalone/ceph-helpers.sh run_mon:437 run_osd:596).
+The process stays up until SIGTERM/SIGINT; SIGKILL models crash-death
+(the thrasher's kill mode) with the store surviving on disk.
+
+The mon's listen address must be pre-agreed (it IS the cluster's
+bootstrap identity), so `--addr` takes an explicit host:port; OSDs bind
+an ephemeral port and advertise it through MOSDBoot as usual.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ceph-tpu-daemon")
+    p.add_argument("--role", required=True,
+                   choices=["mon", "osd", "mgr", "mds"])
+    p.add_argument("--id", type=int, default=0)
+    p.add_argument("--addr", default="127.0.0.1:0",
+                   help="bind address (mons need an agreed host:port)")
+    p.add_argument("--mon-host", default="",
+                   help="comma-separated mon addresses")
+    p.add_argument("--monmap", default="",
+                   help="mon only: comma-separated monmap (all mons)")
+    p.add_argument("--store-type", default="filestore")
+    p.add_argument("--store-path", default="")
+    p.add_argument("--auth-key", default="")
+    p.add_argument("--heartbeats", action="store_true")
+    p.add_argument("--metadata-pool", type=int, default=1)
+    p.add_argument("--data-pool", type=int, default=2)
+    args = p.parse_args(argv)
+    auth_key = args.auth_key.encode() if args.auth_key else None
+
+    if args.role == "mon":
+        from ceph_tpu.mon import Monitor
+        d = Monitor(mon_id=args.id, ms_type="async", addr=args.addr,
+                    store_path=args.store_path or None, auth_key=auth_key)
+        d.init(monmap=[])
+        monmap = (args.monmap or args.addr).split(",")
+        # substitute my own resolved addr (port 0 binds resolve late)
+        monmap[args.id] = d.addr
+        d.set_monmap(monmap)
+    elif args.role == "osd":
+        from ceph_tpu.osd.daemon import OSDDaemon
+        d = OSDDaemon(args.id, args.mon_host, store_type=args.store_type,
+                      store_path=args.store_path, ms_type="async",
+                      addr=args.addr, heartbeats=args.heartbeats,
+                      auth_key=auth_key)
+        d.init()
+    elif args.role == "mgr":
+        from ceph_tpu.mgr import MgrDaemon
+        d = MgrDaemon(args.mon_host, ms_type="async", addr=args.addr,
+                      auth_key=auth_key)
+        d.init()
+    else:
+        from ceph_tpu.mds import MDSDaemon
+        d = MDSDaemon(args.mon_host, args.metadata_pool, args.data_pool,
+                      ms_type="async", addr=args.addr, auth_key=auth_key)
+        d.init()
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    # readiness marker for the spawning harness
+    sys.stdout.write(f"ready {args.role}.{args.id}\n")
+    sys.stdout.flush()
+    stop.wait()
+    d.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

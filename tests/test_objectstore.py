@@ -240,7 +240,7 @@ def test_bluestore_cluster_end_to_end(tmp_path):
                     base_path=str(tmp_path)).start()
     try:
         c.wait_for_osd_count(3)
-        client = c.client(timeout=15.0)
+        client = c.client(timeout=40.0)  # generous: suite runs fully loaded
         pool = c.create_pool(client, pg_num=4, size=3)
         io = client.open_ioctx(pool)
         io.write_full("b", b"bluestore-backed" * 100)
