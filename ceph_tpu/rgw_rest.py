@@ -1,0 +1,507 @@
+"""RGW S3 REST frontend — an authenticated HTTP gateway over rgw_lite.
+
+The reference's radosgw is an HTTP server (civetweb/asio frontends,
+src/rgw/rgw_asio_frontend.cc) that parses S3's REST dialect
+(src/rgw/rgw_rest_s3.cc), authenticates AWS signatures
+(src/rgw/rgw_auth_s3.cc), and maps operations onto the RADOS layout
+(src/rgw/rgw_rados.cc).  This module is that surface over the rgw_lite
+storage mapping, sized to the repo:
+
+* stdlib ThreadingHTTPServer frontend (the asio/civetweb analog)
+* AWS Signature V4: full canonical-request -> string-to-sign -> derived
+  signing key verification (UNSIGNED-PAYLOAD and sha256 payloads), with
+  access keys provisioned against the cluster's auth key material
+* bucket ops: PUT/DELETE/GET(list) with ListObjectsV2 pagination
+  (max-keys / continuation-token / IsTruncated)
+* object ops: PUT (with x-amz-meta-*), GET, HEAD, DELETE
+* multipart upload: initiate (POST ?uploads), UploadPart
+  (PUT ?partNumber&uploadId), complete (POST ?uploadId), abort
+  (DELETE ?uploadId) — parts staged as rgw_lite objects and
+  concatenated on complete (rgw_rest_s3.cc multipart flow)
+
+Error responses use the S3 XML error envelope with the usual codes
+(NoSuchBucket, NoSuchKey, SignatureDoesNotMatch, BucketNotEmpty...).
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import json
+import re
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ceph_tpu.rgw_lite import Bucket
+
+_EMPTY_SHA = hashlib.sha256(b"").hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# AWS Signature V4
+# ---------------------------------------------------------------------------
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sigv4_signing_key(secret: str, date: str, region: str,
+                      service: str = "s3") -> bytes:
+    k = _hmac(("AWS4" + secret).encode(), date)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    return _hmac(k, "aws4_request")
+
+
+def canonical_query(query: str) -> str:
+    pairs = urllib.parse.parse_qsl(query, keep_blank_values=True)
+    enc = [(urllib.parse.quote(k, safe="-_.~"),
+            urllib.parse.quote(v, safe="-_.~")) for k, v in pairs]
+    return "&".join(f"{k}={v}" for k, v in sorted(enc))
+
+
+def sign_request(method: str, path: str, query: str, headers: dict,
+                 payload_sha: str, access: str, secret: str,
+                 region: str = "default") -> str:
+    """Produce the Authorization header value for a request (used by the
+    server to verify and by test clients to sign)."""
+    amzdate = headers["x-amz-date"]
+    date = amzdate[:8]
+    signed = sorted(h.lower() for h in ("host", "x-amz-content-sha256",
+                                        "x-amz-date") if h in
+                    {k.lower() for k in headers})
+    canon_headers = "".join(
+        f"{h}:{_header(headers, h).strip()}\n" for h in signed)
+    creq = "\n".join([
+        method, urllib.parse.quote(path, safe="/-_.~"),
+        canonical_query(query), canon_headers, ";".join(signed),
+        payload_sha])
+    scope = f"{date}/{region}/s3/aws4_request"
+    sts = "\n".join(["AWS4-HMAC-SHA256", amzdate, scope,
+                     hashlib.sha256(creq.encode()).hexdigest()])
+    sig = hmac.new(sigv4_signing_key(secret, date, region), sts.encode(),
+                   hashlib.sha256).hexdigest()
+    return (f"AWS4-HMAC-SHA256 Credential={access}/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+
+
+def _header(headers: dict, name: str) -> str:
+    for k, v in headers.items():
+        if k.lower() == name.lower():
+            return v
+    return ""
+
+
+_AUTH_RE = re.compile(
+    r"AWS4-HMAC-SHA256 Credential=(?P<access>[^/]+)/(?P<date>\d{8})/"
+    r"(?P<region>[^/]+)/s3/aws4_request,\s*"
+    r"SignedHeaders=(?P<signed>[^,]+),\s*Signature=(?P<sig>[0-9a-f]+)")
+
+
+# ---------------------------------------------------------------------------
+# XML helpers (no external deps; S3's dialect is shallow)
+# ---------------------------------------------------------------------------
+
+def _x(tag: str, body: str) -> str:
+    return f"<{tag}>{body}</{tag}>"
+
+
+def _esc(s: str) -> str:
+    return (s.replace("&", "&amp;").replace("<", "&lt;")
+            .replace(">", "&gt;"))
+
+
+def _error_xml(code: str, message: str) -> bytes:
+    return (f'<?xml version="1.0" encoding="UTF-8"?>'
+            f'<Error>{_x("Code", code)}{_x("Message", _esc(message))}'
+            f"</Error>").encode()
+
+
+_ERR_STATUS = {"NoSuchBucket": 404, "NoSuchKey": 404, "NoSuchUpload": 404,
+               "BucketNotEmpty": 409, "BucketAlreadyExists": 409,
+               "SignatureDoesNotMatch": 403, "AccessDenied": 403,
+               "InvalidPart": 400, "MalformedXML": 400,
+               "InvalidArgument": 400}
+
+
+class S3Error(Exception):
+    def __init__(self, code: str, message: str = ""):
+        super().__init__(message or code)
+        self.code = code
+        self.status = _ERR_STATUS.get(code, 400)
+
+
+# ---------------------------------------------------------------------------
+# gateway
+# ---------------------------------------------------------------------------
+
+class S3Gateway:
+    """The op layer: S3 verbs -> rgw_lite buckets over one ioctx."""
+
+    MP_PREFIX = ".mp"
+
+    def __init__(self, ioctx, compression: str = "none"):
+        self.io = ioctx
+        self.compression = compression
+        self._lock = threading.Lock()
+
+    def _bucket(self, name: str, must_exist: bool = True) -> Bucket:
+        b = Bucket(self.io, name, compression=self.compression)
+        if must_exist and not b.exists():
+            raise S3Error("NoSuchBucket", name)
+        return b
+
+    # -- buckets -------------------------------------------------------------
+
+    def create_bucket(self, name: str) -> None:
+        b = Bucket(self.io, name, compression=self.compression)
+        if b.exists():
+            raise S3Error("BucketAlreadyExists", name)
+        b.create()
+
+    def delete_bucket(self, name: str) -> None:
+        b = self._bucket(name)
+        try:
+            b.delete()
+        except OSError:
+            raise S3Error("BucketNotEmpty", name)
+
+    def list_objects(self, name: str, prefix: str, max_keys: int,
+                     token: str) -> tuple[list[tuple[str, dict]], str]:
+        """ListObjectsV2: (entries, next_token); '' token = done."""
+        b = self._bucket(name)
+        keys = [k for k in b.list(prefix=prefix)
+                if not k.startswith(self.MP_PREFIX)]
+        if token:
+            keys = [k for k in keys if k > token]
+        page = keys[:max_keys]
+        next_token = page[-1] if len(keys) > max_keys else ""
+        out = []
+        for k in page:
+            try:
+                out.append((k, b.head(k)))
+            except KeyError:
+                continue
+        return out, next_token
+
+    # -- objects -------------------------------------------------------------
+
+    def put_object(self, bucket: str, key: str, data: bytes,
+                   metadata: dict) -> str:
+        b = self._bucket(bucket)
+        b.put(key, data, metadata=metadata)
+        return hashlib.md5(data).hexdigest()
+
+    def get_object(self, bucket: str, key: str) -> tuple[bytes, dict]:
+        b = self._bucket(bucket)
+        try:
+            head = b.head(key)
+            return b.get(key), head
+        except KeyError:
+            raise S3Error("NoSuchKey", key)
+
+    def head_object(self, bucket: str, key: str) -> dict:
+        try:
+            return self._bucket(bucket).head(key)
+        except KeyError:
+            raise S3Error("NoSuchKey", key)
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        try:
+            self._bucket(bucket).delete_object(key)
+        except KeyError:
+            pass   # S3 DELETE is idempotent
+
+    # -- multipart -----------------------------------------------------------
+
+    def _mp_key(self, upload_id: str, part: int | None = None) -> str:
+        base = f"{self.MP_PREFIX}.{upload_id}"
+        return base if part is None else f"{base}.{part:05d}"
+
+    def initiate_multipart(self, bucket: str, key: str,
+                           metadata: dict) -> str:
+        with self._lock:
+            b = self._bucket(bucket)
+            upload_id = hashlib.sha1(
+                f"{bucket}/{key}/{time.time_ns()}".encode()).hexdigest()[:16]
+            b.put(self._mp_key(upload_id), json.dumps(
+                {"key": key, "meta": metadata}).encode())
+            return upload_id
+
+    def _mp_manifest(self, b: Bucket, upload_id: str) -> dict:
+        try:
+            return json.loads(b.get(self._mp_key(upload_id)).decode())
+        except KeyError:
+            raise S3Error("NoSuchUpload", upload_id)
+
+    def upload_part(self, bucket: str, key: str, upload_id: str,
+                    part: int, data: bytes) -> str:
+        b = self._bucket(bucket)
+        self._mp_manifest(b, upload_id)
+        b.put(self._mp_key(upload_id, part), data)
+        return hashlib.md5(data).hexdigest()
+
+    def complete_multipart(self, bucket: str, key: str, upload_id: str,
+                           parts: list[tuple[int, str]]) -> str:
+        # serialized: complete reads parts then deletes them; two racing
+        # completes (or a racing abort) must not interleave
+        with self._lock:
+            return self._complete_locked(bucket, key, upload_id, parts)
+
+    def _complete_locked(self, bucket: str, key: str, upload_id: str,
+                         parts: list[tuple[int, str]]) -> str:
+        b = self._bucket(bucket)
+        manifest = self._mp_manifest(b, upload_id)
+        chunks = []
+        for num, etag in parts:
+            try:
+                data = b.get(self._mp_key(upload_id, num))
+            except KeyError:
+                raise S3Error("InvalidPart", f"part {num} missing")
+            if etag and hashlib.md5(data).hexdigest() != etag.strip('"'):
+                raise S3Error("InvalidPart", f"part {num} etag mismatch")
+            chunks.append(data)
+        whole = b"".join(chunks)
+        b.put(key, whole, metadata=manifest.get("meta") or {})
+        self._abort_locked(b, upload_id)
+        return hashlib.md5(whole).hexdigest()
+
+    def abort_multipart(self, bucket: str, key: str,
+                        upload_id: str) -> None:
+        with self._lock:
+            self._abort_locked(self._bucket(bucket), upload_id)
+
+    def _abort_locked(self, b: Bucket, upload_id: str) -> None:
+        for k in b.list(prefix=f"{self.MP_PREFIX}.{upload_id}"):
+            try:
+                b.delete_object(k)
+            except KeyError:
+                pass
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "ceph-tpu-rgw/1.0"
+
+    def log_message(self, fmt, *args):   # quiet
+        pass
+
+    # -- auth ----------------------------------------------------------------
+
+    def _authenticate(self, body: bytes) -> None:
+        srv: "RgwRestServer" = self.server.rgw     # type: ignore
+        auth = self.headers.get("Authorization", "")
+        m = _AUTH_RE.match(auth)
+        if not m:
+            raise S3Error("AccessDenied", "missing or malformed auth")
+        secret = srv.keys.get(m.group("access"))
+        if secret is None:
+            raise S3Error("AccessDenied", "unknown access key")
+        payload_sha = self.headers.get("x-amz-content-sha256",
+                                       "UNSIGNED-PAYLOAD")
+        if payload_sha != "UNSIGNED-PAYLOAD":
+            # the signature only binds the HEADER value; the body must
+            # match it or a captured signature could carry any payload
+            if hashlib.sha256(body).hexdigest() != payload_sha:
+                raise S3Error("SignatureDoesNotMatch",
+                              "payload hash mismatch")
+        amzdate = self.headers.get("x-amz-date", "")
+        if not re.match(r"\d{8}T\d{6}Z$", amzdate):
+            raise S3Error("AccessDenied", "missing or malformed x-amz-date")
+        parsed = urllib.parse.urlsplit(self.path)
+        hdrs = {"host": self.headers.get("Host", ""),
+                "x-amz-date": amzdate,
+                "x-amz-content-sha256": payload_sha}
+        expect = sign_request(self.command, parsed.path, parsed.query,
+                              hdrs, payload_sha, m.group("access"),
+                              secret, m.group("region"))
+        want_sig = _AUTH_RE.match(expect).group("sig")
+        if not hmac.compare_digest(want_sig, m.group("sig")):
+            raise S3Error("SignatureDoesNotMatch", "bad signature")
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _respond(self, status: int, body: bytes = b"",
+                 headers: dict | None = None) -> None:
+        self.send_response(status)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body and self.command != "HEAD":
+            self.wfile.write(body)
+
+    def _dispatch(self) -> None:
+        gw: S3Gateway = self.server.rgw.gateway     # type: ignore
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        try:
+            self._authenticate(body)
+            parsed = urllib.parse.urlsplit(self.path)
+            q = dict(urllib.parse.parse_qsl(parsed.query,
+                                            keep_blank_values=True))
+            parts = parsed.path.lstrip("/").split("/", 1)
+            bucket = urllib.parse.unquote(parts[0])
+            key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+            self._route(gw, self.command, bucket, key, q, body)
+        except S3Error as e:
+            self._respond(e.status, _error_xml(e.code, str(e)),
+                          {"Content-Type": "application/xml"})
+        except Exception as e:   # pragma: no cover
+            self._respond(500, _error_xml("InternalError", repr(e)),
+                          {"Content-Type": "application/xml"})
+
+    do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _dispatch
+
+    # -- routing -------------------------------------------------------------
+
+    def _route(self, gw: S3Gateway, method: str, bucket: str, key: str,
+               q: dict, body: bytes) -> None:
+        if not bucket:
+            raise S3Error("InvalidArgument", "service-level ops: none")
+        if not key:
+            return self._route_bucket(gw, method, bucket, q)
+        if method == "POST" and "uploads" in q:
+            meta = self._meta_headers()
+            uid = gw.initiate_multipart(bucket, key, meta)
+            xml = ('<?xml version="1.0" encoding="UTF-8"?>'
+                   "<InitiateMultipartUploadResult>"
+                   + _x("Bucket", _esc(bucket)) + _x("Key", _esc(key))
+                   + _x("UploadId", uid)
+                   + "</InitiateMultipartUploadResult>").encode()
+            return self._respond(200, xml)
+        if method == "PUT" and "uploadId" in q and "partNumber" in q:
+            etag = gw.upload_part(bucket, key, q["uploadId"],
+                                  int(q["partNumber"]), body)
+            return self._respond(200, b"", {"ETag": f'"{etag}"'})
+        if method == "POST" and "uploadId" in q:
+            text = body.decode(errors="replace")
+            parts = []
+            for block in re.findall(r"<Part>(.*?)</Part>", text, re.S):
+                num = re.search(r"<PartNumber>\s*(\d+)\s*</PartNumber>",
+                                block)
+                if num is None:
+                    raise S3Error("MalformedXML", "part without number")
+                et = re.search(
+                    r"<ETag>\s*(?:&quot;|\")?([0-9a-f]+)", block)
+                parts.append((int(num.group(1)),
+                              et.group(1) if et else ""))
+            if not parts:
+                raise S3Error("MalformedXML", "no parts")
+            etag = gw.complete_multipart(bucket, key, q["uploadId"],
+                                         parts)
+            xml = ('<?xml version="1.0" encoding="UTF-8"?>'
+                   "<CompleteMultipartUploadResult>"
+                   + _x("Key", _esc(key)) + _x("ETag", f'"{etag}"')
+                   + "</CompleteMultipartUploadResult>").encode()
+            return self._respond(200, xml)
+        if method == "DELETE" and "uploadId" in q:
+            gw.abort_multipart(bucket, key, q["uploadId"])
+            return self._respond(204)
+        if method == "PUT":
+            etag = gw.put_object(bucket, key, body, self._meta_headers())
+            return self._respond(200, b"", {"ETag": f'"{etag}"'})
+        if method == "GET":
+            data, head = gw.get_object(bucket, key)
+            hdrs = {"Content-Type": "application/octet-stream",
+                    "ETag": f'"{hashlib.md5(data).hexdigest()}"'}
+            for mk, mv in (head.get("meta") or {}).items():
+                hdrs[f"x-amz-meta-{mk}"] = mv
+            return self._respond(200, data, hdrs)
+        if method == "HEAD":
+            head = gw.head_object(bucket, key)
+            return self._respond(200, b"", {
+                "Content-Length-Hint": str(head["size"])})
+        if method == "DELETE":
+            gw.delete_object(bucket, key)
+            return self._respond(204)
+        raise S3Error("InvalidArgument", f"unsupported {method}")
+
+    def _route_bucket(self, gw: S3Gateway, method: str, bucket: str,
+                      q: dict) -> None:
+        if method == "PUT":
+            gw.create_bucket(bucket)
+            return self._respond(200)
+        if method == "DELETE":
+            gw.delete_bucket(bucket)
+            return self._respond(204)
+        if method == "GET":
+            max_keys = max(1, min(int(q.get("max-keys", 1000)), 1000))
+            entries, next_token = gw.list_objects(
+                bucket, q.get("prefix", ""), max_keys,
+                q.get("continuation-token", ""))
+            items = "".join(
+                "<Contents>" + _x("Key", _esc(k))
+                + _x("Size", str(h.get("size", 0)))
+                + _x("LastModified", datetime.datetime.fromtimestamp(
+                    h.get("mtime", 0),
+                    datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ"))
+                + "</Contents>"
+                for k, h in entries)
+            xml = ('<?xml version="1.0" encoding="UTF-8"?>'
+                   "<ListBucketResult>"
+                   + _x("Name", _esc(bucket))
+                   + _x("KeyCount", str(len(entries)))
+                   + _x("IsTruncated", "true" if next_token else "false")
+                   + (_x("NextContinuationToken", _esc(next_token))
+                      if next_token else "")
+                   + items + "</ListBucketResult>").encode()
+            return self._respond(200, xml,
+                                 {"Content-Type": "application/xml"})
+        raise S3Error("InvalidArgument", f"unsupported {method} on bucket")
+
+    def _meta_headers(self) -> dict:
+        return {k[len("x-amz-meta-"):]: v for k, v in self.headers.items()
+                if k.lower().startswith("x-amz-meta-")}
+
+
+class RgwRestServer:
+    """The radosgw daemon shell: HTTP frontend + gateway + key table.
+
+    Access keys are provisioned from cluster auth material:
+    ``add_key(access, secret)``; with a cephx-lite cluster key,
+    ``provision_from_cephx(key)`` derives a deterministic S3 credential
+    pair from it (the AuthMonitor-issues-rgw-credentials analog).
+    """
+
+    def __init__(self, ioctx, addr: str = "127.0.0.1:0",
+                 compression: str = "none"):
+        self.gateway = S3Gateway(ioctx, compression=compression)
+        self.keys: dict[str, str] = {}
+        host, port = addr.rsplit(":", 1)
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.rgw = self          # type: ignore
+        self._thread: threading.Thread | None = None
+
+    @property
+    def addr(self) -> str:
+        h, p = self._httpd.server_address[:2]
+        return f"{h}:{p}"
+
+    def add_key(self, access: str, secret: str) -> None:
+        self.keys[access] = secret
+
+    def provision_from_cephx(self, cluster_key: bytes | str
+                             ) -> tuple[str, str]:
+        if isinstance(cluster_key, str):
+            cluster_key = cluster_key.encode()
+        access = "AK" + hashlib.sha256(b"rgw-access" + cluster_key
+                                       ).hexdigest()[:18].upper()
+        secret = hashlib.sha256(b"rgw-secret" + cluster_key).hexdigest()
+        self.add_key(access, secret)
+        return access, secret
+
+    def start(self) -> "RgwRestServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="rgw-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -46,6 +46,11 @@ def main(argv=None) -> int:
                     store_path=args.store_path or None, auth_key=auth_key)
         d.init(monmap=[])
         monmap = (args.monmap or args.addr).split(",")
+        if args.id >= len(monmap):
+            print(f"error: --id {args.id} outside the {len(monmap)}-entry "
+                  "monmap (pass --monmap with every mon's address)",
+                  file=sys.stderr)
+            return 2
         # substitute my own resolved addr (port 0 binds resolve late)
         monmap[args.id] = d.addr
         d.set_monmap(monmap)

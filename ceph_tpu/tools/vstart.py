@@ -9,6 +9,7 @@ drives (SURVEY.md §4 tier 3).
 
 from __future__ import annotations
 
+import threading
 import time
 
 from ceph_tpu.client import RadosClient
@@ -229,10 +230,20 @@ class ProcCluster:
         cmd += extra
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                 stderr=subprocess.DEVNULL, text=True)
-        # wait for the readiness line so boot races don't flake tests
-        line = proc.stdout.readline()
+        # wait for the readiness line (bounded: a wedged daemon must
+        # fail the harness, not hang it), then keep the pipe drained so
+        # later daemon output cannot fill the buffer and block it
+        import selectors
+        sel = selectors.DefaultSelector()
+        sel.register(proc.stdout, selectors.EVENT_READ)
+        line = ""
+        if sel.select(timeout=60.0):
+            line = proc.stdout.readline()
+        sel.close()
         if not line.startswith("ready"):
+            proc.kill()
             raise RuntimeError(f"{role}.{rid} failed to start: {line!r}")
+        threading.Thread(target=proc.stdout.read, daemon=True).start()
         self.procs[f"{role}.{rid}"] = proc
         return proc
 
