@@ -155,7 +155,8 @@ class MDSDaemon(Dispatcher):
         self.journal = Journaler(self.meta_io, "mdlog")
         self._load_or_mkfs()
         self.state = "replay"
-        n = self.journal.replay(self._replay_entry)
+        n = self.journal.replay(
+            lambda payload, _pos: self._replay_entry(payload))
         dout("mds", 5, "mds.0 replayed %d journal events", n)
         if n:
             self._flush_dirty()

@@ -78,11 +78,14 @@ class Journaler:
 
     # -- replay / trim --------------------------------------------------------
 
-    def replay(self, cb) -> int:
-        """Read entries in [expire_pos, write_pos), calling cb(payload)
-        for each (Journaler::try_read_entry loop).  Returns the count."""
+    def replay(self, cb, start_pos: int | None = None) -> int:
+        """Read entries in [start_pos or expire_pos, write_pos), calling
+        cb(payload, end_pos) — end_pos is the entry's end offset, the
+        resume token a mirror client persists (Journaler::try_read_entry
+        loop; client positions are how rbd-mirror tracks progress).
+        Returns the count."""
         n = 0
-        pos = self.expire_pos
+        pos = self.expire_pos if start_pos is None else start_pos
         while pos + _FRAME.size <= self.write_pos:
             hdr = self.stream.read(pos, _FRAME.size)
             (plen,) = _FRAME.unpack(hdr)
@@ -95,7 +98,7 @@ class Journaler:
             if zlib.crc32(payload) != crc:
                 raise IOError(
                     f"journal {self.name}: crc mismatch at {pos}")
-            cb(payload)
+            cb(payload, end)
             pos = end
             n += 1
         return n

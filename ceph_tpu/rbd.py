@@ -19,11 +19,17 @@ I/O path:
 
 from __future__ import annotations
 
+import binascii
 import json
 
+from ceph_tpu.osdc.journaler import Journaler
 from ceph_tpu.osdc.striper import StripeLayout, StripedObject
 
 RBD_DIRECTORY = "rbd_directory"
+
+#: image feature bits (librbd feature flags; journaling gates the
+#: write-ahead event journal that rbd-mirror replays)
+FEATURE_JOURNALING = "journaling"
 
 
 class Image:
@@ -40,8 +46,11 @@ class Image:
     @classmethod
     def create(cls, ioctx, name: str, size: int,
                order: int = 22, stripe_unit: int = 1 << 16,
-               stripe_count: int = 4) -> "Image":
-        """order = log2(object size), like rbd create --order."""
+               stripe_count: int = 4, primary: bool = True,
+               features: list[str] | None = None) -> "Image":
+        """order = log2(object size), like rbd create --order.
+        primary=False creates a demoted replication target atomically
+        (no primary window for a mirror-daemon crash to leave open)."""
         header = cls.HEADER_FMT.format(name=name)
         exists = True
         try:
@@ -52,7 +61,8 @@ class Image:
             raise FileExistsError(f"image {name!r} exists")
         meta = {"size": size, "order": order,
                 "stripe_unit": stripe_unit,
-                "stripe_count": stripe_count, "snaps": {}}
+                "stripe_count": stripe_count, "snaps": {},
+                "features": list(features or []), "primary": primary}
         ioctx.write_full(header, json.dumps(meta).encode())
         ioctx.set_omap(RBD_DIRECTORY, {name: b"1"})
         img = cls(ioctx, name)
@@ -73,21 +83,125 @@ class Image:
         return StripedObject(self.io, self.DATA_FMT.format(name=self.name),
                              layout)
 
+    # -- features / journaling (librbd/Journal.h:43 analog) -------------------
+
+    JOURNAL_FMT = "journal_rbd.{name}"
+
+    def features(self) -> list[str]:
+        return list(self._load().get("features", []))
+
+    def feature_enable(self, feature: str) -> None:
+        m = self._load()
+        feats = m.setdefault("features", [])
+        if feature in feats:
+            return
+        feats.append(feature)
+        if feature == FEATURE_JOURNALING:
+            j = self._journal()
+            try:
+                j.open()
+            except OSError:
+                j.create()
+        self._save_meta(m)
+
+    def feature_disable(self, feature: str) -> None:
+        m = self._load()
+        if feature in m.get("features", []):
+            m["features"].remove(feature)
+            self._save_meta(m)
+
+    def _journal(self) -> Journaler:
+        return Journaler(self.io, self.JOURNAL_FMT.format(name=self.name))
+
+    def _journal_event(self, event: dict) -> None:
+        """Write-ahead: mutations on a journaled image append the event
+        and flush BEFORE touching image data (librbd Journal ordering);
+        rbd-mirror replays these on the peer cluster.  Events carry
+        absolute offsets/states so replay is idempotent."""
+        if FEATURE_JOURNALING not in self._load().get("features", []):
+            return
+        j = self._journal()
+        try:
+            j.open()
+        except OSError:
+            j.create()   # feature set at create-time (mirror targets)
+        j.append_entry(json.dumps(event).encode())
+        j.flush()
+
+    # -- primary / demote (rbd mirror promote/demote) -------------------------
+
+    def is_primary(self) -> bool:
+        return bool(self._load().get("primary", True))
+
+    def promote(self) -> None:
+        m = self._load()
+        m["primary"] = True
+        self._save_meta(m)
+
+    def demote(self) -> None:
+        """Non-primary images are read-only replication targets; only
+        the mirror daemon's replay applies to them (mirror_apply)."""
+        m = self._load()
+        m["primary"] = False
+        self._save_meta(m)
+
+    def _check_primary(self) -> None:
+        # re-read the header: another handle (the mirror daemon, an
+        # operator CLI) may have demoted us — librbd learns this through
+        # its header watch; here a read per gated mutation is the analog
+        self._meta = None
+        if not self._load().get("primary", True):
+            raise OSError(30, f"image {self.name!r} is non-primary "
+                              "(demoted mirror target)")  # EROFS
+
     # -- I/O ------------------------------------------------------------------
 
     def stat(self) -> dict:
         m = self._load()
         return {"size": m["size"], "order": m["order"],
                 "stripe_unit": m["stripe_unit"],
-                "stripe_count": m["stripe_count"]}
+                "stripe_count": m["stripe_count"],
+                "features": list(m.get("features", [])),
+                "primary": m.get("primary", True)}
 
     def write(self, data: bytes, offset: int = 0) -> int:
+        self._check_primary()   # refreshes the header cache too
         m = self._load()
         if offset + len(data) > m["size"]:
             raise ValueError("write past end of image")
         self._check_lock()
+        self._journal_event({"op": "write", "off": offset,
+                             "data": binascii.hexlify(data).decode()})
         self._striped().write(data, offset)
         return len(data)
+
+    def mirror_apply(self, event: dict) -> None:
+        """Apply one replayed journal event (rbd-mirror's Replayer):
+        bypasses the primary gate — replication IS how a demoted image
+        changes — but still respects sizes and is idempotent."""
+        op = event["op"]
+        if op == "write":
+            data = binascii.unhexlify(event["data"])
+            m = self._load()
+            end = event["off"] + len(data)
+            if end > m["size"]:
+                m["size"] = end
+                self._save_meta(m)
+            self._striped().write(data, event["off"])
+        elif op == "resize":
+            m = self._load()
+            if event["size"] < m["size"]:
+                self._striped().truncate(event["size"])
+            m["size"] = event["size"]
+            self._save_meta(m)
+        elif op == "snap_create":
+            if event["snap"] not in self.snap_list():
+                self.snap_create(event["snap"])
+        elif op == "snap_remove":
+            if event["snap"] in self.snap_list():
+                self.snap_remove(event["snap"])
+        else:
+            raise ValueError(f"unknown journal event {op!r}")
 
     def read(self, offset: int = 0, length: int = 0,
              snap: str | None = None) -> bytes:
@@ -174,6 +288,11 @@ class Image:
         m.setdefault("snaps", {})[snap] = {"snapid": snapid,
                                            "size": m["size"]}
         self._save_meta(m)
+        # journal AFTER the mon op succeeds: a failed snap must never
+        # replay onto the mirror (the reverse window — snap taken, crash
+        # before journaling — loses only the mirror's copy of the snap,
+        # the recoverable direction)
+        self._journal_event({"op": "snap_create", "snap": snap})
         return snapid
 
     def snap_list(self) -> dict:
@@ -190,6 +309,7 @@ class Image:
             raise OSError(-rc or 5, out)
         del m["snaps"][snap]
         self._save_meta(m)
+        self._journal_event({"op": "snap_remove", "snap": snap})
 
     def snap_rollback(self, snap: str) -> None:
         """Restore image content to the snapshot (rbd snap rollback —
@@ -222,15 +342,16 @@ class Image:
         return dst
 
     def resize(self, new_size: int) -> None:
+        self._check_primary()
         m = self._load()
         self._check_lock()
+        self._journal_event({"op": "resize", "size": new_size})
         if new_size < m["size"]:
             # shrink trims the discarded extent (real rbd semantics):
             # growing back later must read zeros, not stale payload
             self._striped().truncate(new_size)
         m["size"] = new_size
-        self.io.write_full(self.HEADER_FMT.format(name=self.name),
-                           json.dumps(m).encode())
+        self._save_meta(m)
 
     def remove(self) -> None:
         # librbd refuses removal while snapshots exist: the pool snaps

@@ -1,0 +1,126 @@
+"""rbd-mirror — journal-based cross-cluster image replication
+(src/tools/rbd_mirror/ + librbd/Journal.h analog).
+
+A journaled primary image appends every mutation to its per-image
+Journaler before applying (rbd.Image._journal_event).  The mirror
+daemon tails that journal from a second cluster and replays events onto
+a demoted (non-primary) copy of the image:
+
+* the replay position is persisted ON THE MIRROR cluster after every
+  applied event (`rbd_mirror.<image>` omap — the journal client
+  position rbd-mirror registers), so a daemon crash mid-replay resumes
+  exactly where it stopped; events carry absolute offsets/states, so
+  an event re-applied across the crash window is idempotent
+* the mirror image is created on first contact and demoted — writes to
+  it are refused until promotion
+* failover = demote the old primary (or it is simply dead), promote the
+  mirror copy (Image.promote), point clients at it; failback runs the
+  same machinery the other way
+* after a full replay the daemon trims the primary journal up to the
+  mirrored position (the journal client expiry that bounds journal
+  growth in the reference)
+"""
+
+from __future__ import annotations
+
+import json
+
+from ceph_tpu.osdc.journaler import Journaler
+from ceph_tpu.rbd import FEATURE_JOURNALING, Image
+
+
+class MirrorDaemon:
+    """Replays journaled images from a primary ioctx to a mirror ioctx."""
+
+    STATE_FMT = "rbd_mirror.{name}"
+
+    def __init__(self, src_ioctx, dst_ioctx, trim: bool = True):
+        self.src = src_ioctx
+        self.dst = dst_ioctx
+        self.trim = trim
+
+    # -- position bookkeeping (on the MIRROR cluster) -------------------------
+
+    def _position(self, name: str) -> int:
+        try:
+            omap = self.dst.get_omap(self.STATE_FMT.format(name=name))
+        except OSError:
+            return 0
+        return int(omap.get("pos", b"0").decode())
+
+    def _save_position(self, name: str, pos: int) -> None:
+        self.dst.set_omap(self.STATE_FMT.format(name=name),
+                          {"pos": str(pos).encode()})
+
+    # -- replay ---------------------------------------------------------------
+
+    def _mirror_image(self, name: str, src_img: Image) -> Image:
+        try:
+            st = src_img.stat()
+            # created demoted AND journaled in one header write: no
+            # primary window for a crash to leave open, and a later
+            # promotion journals its own writes so failback
+            # (MirrorDaemon(dst, src)) replicates them straight back
+            return Image.create(self.dst, name, size=st["size"],
+                                order=st["order"],
+                                stripe_unit=st["stripe_unit"],
+                                stripe_count=st["stripe_count"],
+                                primary=False,
+                                features=[FEATURE_JOURNALING])
+        except FileExistsError:
+            return Image(self.dst, name)
+
+    def replay_image(self, name: str, max_events: int | None = None) -> int:
+        """Tail one image's journal; returns events applied.
+        ``max_events`` exists for crash-window tests."""
+        src_img = Image(self.src, name)
+        if FEATURE_JOURNALING not in src_img.features():
+            return 0
+        dst_img = self._mirror_image(name, src_img)
+        if dst_img.is_primary():
+            # split-brain guard: never replay onto a promoted image
+            # (rbd-mirror refuses and flags the pair for resync)
+            return 0
+        j = Journaler(self.src, Image.JOURNAL_FMT.format(name=name))
+        j.open()
+        start = self._position(name)
+        applied = 0
+
+        class _Stop(Exception):
+            pass
+
+        def apply(payload: bytes, end_pos: int) -> None:
+            nonlocal applied
+            if max_events is not None and applied >= max_events:
+                raise _Stop()
+            dst_img.mirror_apply(json.loads(payload.decode()))
+            # position AFTER apply: a crash between the two re-applies
+            # this (idempotent) event instead of skipping it
+            self._save_position(name, end_pos)
+            applied += 1
+
+        try:
+            j.replay(apply, start_pos=start)
+        except _Stop:
+            pass
+        if self.trim and applied and max_events is None:
+            j.trim(upto=self._position(name))
+        return applied
+
+    def run_once(self, images: list[str] | None = None) -> dict[str, int]:
+        """One replay sweep over the pool's journaled images."""
+        from ceph_tpu.rbd import list_images
+        out = {}
+        for name in images or list_images(self.src):
+            out[name] = self.replay_image(name)
+        return out
+
+
+def promote(ioctx, name: str) -> None:
+    """Failover: make the mirror copy writable (rbd mirror image promote)."""
+    Image(ioctx, name).promote()
+
+
+def demote(ioctx, name: str) -> None:
+    """Make an image a replication target (rbd mirror image demote)."""
+    Image(ioctx, name).demote()

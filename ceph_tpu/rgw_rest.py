@@ -75,8 +75,11 @@ def sign_request(method: str, path: str, query: str, headers: dict,
                     {k.lower() for k in headers})
     canon_headers = "".join(
         f"{h}:{_header(headers, h).strip()}\n" for h in signed)
+    # S3's no-double-encode rule: the canonical URI is the path exactly
+    # as sent on the wire (already percent-encoded by the client); both
+    # signer and verifier must use it verbatim or encoded keys 403
     creq = "\n".join([
-        method, urllib.parse.quote(path, safe="/-_.~"),
+        method, path,
         canonical_query(query), canon_headers, ";".join(signed),
         payload_sha])
     scope = f"{date}/{region}/s3/aws4_request"
@@ -174,7 +177,7 @@ class S3Gateway:
         """ListObjectsV2: (entries, next_token); '' token = done."""
         b = self._bucket(name)
         keys = [k for k in b.list(prefix=prefix)
-                if not k.startswith(self.MP_PREFIX)]
+                if not k.startswith(self.MP_PREFIX + ".")]
         if token:
             keys = [k for k in keys if k > token]
         page = keys[:max_keys]
@@ -191,6 +194,10 @@ class S3Gateway:
 
     def put_object(self, bucket: str, key: str, data: bytes,
                    metadata: dict) -> str:
+        if key.startswith(self.MP_PREFIX + "."):
+            raise S3Error("InvalidArgument",
+                          f"key prefix {self.MP_PREFIX!r}. is reserved "
+                          "for multipart staging")
         b = self._bucket(bucket)
         b.put(key, data, metadata=metadata)
         return hashlib.md5(data).hexdigest()

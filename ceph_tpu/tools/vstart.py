@@ -231,15 +231,26 @@ class ProcCluster:
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                 stderr=subprocess.DEVNULL, text=True)
         # wait for the readiness line (bounded: a wedged daemon must
-        # fail the harness, not hang it), then keep the pipe drained so
-        # later daemon output cannot fill the buffer and block it
+        # fail the harness, not hang it — including one that emits a
+        # partial line), then keep the pipe drained so later daemon
+        # output cannot fill the buffer and block it
+        import os as _os
         import selectors
+        fd = proc.stdout.fileno()
+        _os.set_blocking(fd, False)
         sel = selectors.DefaultSelector()
         sel.register(proc.stdout, selectors.EVENT_READ)
-        line = ""
-        if sel.select(timeout=60.0):
-            line = proc.stdout.readline()
+        buf = b""
+        deadline = time.time() + 60.0
+        while b"\n" not in buf and time.time() < deadline:
+            if sel.select(timeout=max(0.05, deadline - time.time())):
+                chunk = _os.read(fd, 4096)
+                if not chunk:
+                    break
+                buf += chunk
         sel.close()
+        _os.set_blocking(fd, True)
+        line = buf.split(b"\n", 1)[0].decode(errors="replace")
         if not line.startswith("ready"):
             proc.kill()
             raise RuntimeError(f"{role}.{rid} failed to start: {line!r}")

@@ -47,13 +47,13 @@ def test_journaler_append_flush_replay(cluster):
     j2.open()
     assert j2.write_pos == j.write_pos
     got = []
-    assert j2.replay(got.append) == 20
+    assert j2.replay(lambda p, _e: got.append(p)) == 20
     assert got == [f"event-{i}".encode() for i in range(20)]
     # trim; replay is now empty
     j2.trim()
     j3 = Journaler(io, "jtest")
     j3.open()
-    assert j3.replay(got.append) == 0
+    assert j3.replay(lambda p, _e: got.append(p)) == 0
 
 
 def test_journaler_torn_tail_replays_short(cluster):
@@ -69,7 +69,7 @@ def test_journaler_torn_tail_replays_short(cluster):
     j2 = Journaler(io, "jtorn")
     j2.open()
     got = []
-    assert j2.replay(got.append) == 1
+    assert j2.replay(lambda p, _e: got.append(p)) == 1
     assert got == [b"committed"]
 
 

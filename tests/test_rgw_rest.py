@@ -176,3 +176,22 @@ def test_bucket_errors(s3):
     assert status == 409 and b"BucketNotEmpty" in xml
     status, xml, _ = s3.request("PUT", "/full")
     assert status == 409 and b"BucketAlreadyExists" in xml
+
+
+def test_encoded_object_keys(s3):
+    """Keys needing percent-encoding sign and round-trip (the S3
+    no-double-encode canonical URI rule)."""
+    s3.request("PUT", "/enc")
+    path = "/enc/" + urllib.parse.quote("report 2026/α.txt", safe="")
+    status, _, _ = s3.request("PUT", path, body=b"spaced")
+    assert status == 200
+    status, got, _ = s3.request("GET", path)
+    assert status == 200 and got == b"spaced"
+    status, xml, _ = s3.request("GET", "/enc", query="list-type=2")
+    assert "report 2026/α.txt" in xml.decode()
+
+
+def test_reserved_multipart_prefix_rejected(s3):
+    s3.request("PUT", "/resv")
+    status, xml, _ = s3.request("PUT", "/resv/.mp.sneaky", body=b"x")
+    assert status == 400 and b"InvalidArgument" in xml
