@@ -30,7 +30,11 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import struct
 import threading
+import zlib
+
+_WAL_HDR = struct.Struct("<II")   # block index, intra-block offset
 
 from .kv import LogDB
 from .objectstore import ObjectStore
@@ -41,6 +45,10 @@ from .transaction import (
     Transaction)
 
 BLOCK = 4096          # allocation unit ("min_alloc_size")
+
+#: deferred-write entries per object before they fold into blocks
+#: (bluestore_prefer_deferred_size-style knob, entry-count flavored)
+WAL_MAX = 16
 
 
 class BitmapAllocator:
@@ -92,6 +100,21 @@ class BlueStoreLite(ObjectStore):
         #: blocks displaced by the in-flight transaction batch; returned
         #: to the allocator only after its KV commit lands
         self._freed: list[int] = []
+        #: whether the in-flight batch wrote any block (a pure deferred-
+        #: write batch skips the block-file fsync entirely — the whole
+        #: point of the WAL path: one KV commit, no data syncs)
+        self._block_dirty = False
+        #: deferred-write entries of the in-flight batch, per object key:
+        #: committed as individual "wal" column keys alongside the meta
+        #: (RocksDB deferred-write keys in the reference) — NOT inlined
+        #: into the meta blob, which would make every commit rewrite the
+        #: accumulated patch bytes
+        self._wal_pending: dict[str, list] = {}
+        self._wal_rms: list[str] = []
+        #: okey -> sorted committed wal keys (avoids a store-wide column
+        #: scan per read of a WAL-bearing object); rebuilt at mount,
+        #: maintained at commit
+        self._wal_index: dict[str, list[str]] = {}
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -121,6 +144,10 @@ class BlueStoreLite(ObjectStore):
             used.update(b for b in meta["extents"] if b >= 0)
         nxt = max(used) + 1 if used else 0
         self._alloc.restore(nxt, sorted(set(range(nxt)) - used))
+        self._wal_index = {}
+        for k in sorted(self._db.get_range("wal")):
+            okey = k.rsplit("\x00", 1)[0]
+            self._wal_index.setdefault(okey, []).append(k)
 
     def umount(self) -> None:
         if self._f is not None:
@@ -141,7 +168,8 @@ class BlueStoreLite(ObjectStore):
 
     @staticmethod
     def _new_meta() -> dict:
-        return {"size": 0, "extents": [], "attrs": {}, "omap": {}}
+        return {"size": 0, "extents": [], "attrs": {}, "omap": {},
+                "csum": [], "wal_n": 0, "wal_seq": 0}
 
     # -- block I/O ------------------------------------------------------------
 
@@ -150,28 +178,131 @@ class BlueStoreLite(ObjectStore):
         data = self._f.read(BLOCK)
         return data + bytes(BLOCK - len(data))
 
+    def _read_verified(self, block: int, crc) -> bytes:
+        """Read + verify a block against its stored crc32 (BlueStore
+        verifies every blob checksum on read; None = legacy/no csum)."""
+        data = self._read_block(block)
+        if crc is not None and zlib.crc32(data) != crc:
+            raise IOError(
+                f"bluestore checksum mismatch on block {block}: "
+                f"stored {crc:#x}, computed {zlib.crc32(data):#x}")
+        return data
+
+    @staticmethod
+    def _csums(meta: dict) -> list:
+        cs = meta.setdefault("csum", [])
+        while len(cs) < len(meta["extents"]):
+            cs.append(None)
+        return cs
+
+    def _patch_block(self, meta: dict, bi: int, boff: int,
+                     chunk: bytes) -> None:
+        """COW-patch one block and update its checksum.  The extent map
+        grows with holes as needed — a truncate-extended region has
+        size > extents coverage, and deferred writes may land there."""
+        while len(meta["extents"]) <= bi:
+            meta["extents"].append(-1)
+        cs = self._csums(meta)
+        old_block = meta["extents"][bi]
+        if boff == 0 and len(chunk) == BLOCK:
+            patched = chunk
+        elif old_block >= 0:
+            old = self._read_verified(old_block, cs[bi])
+            patched = old[:boff] + chunk + old[boff + len(chunk):]
+        else:
+            patched = bytes(boff) + chunk
+        padded = patched[:BLOCK].ljust(BLOCK, b"\x00")
+        nb = self._alloc.allocate(1)[0]
+        self._write_block(nb, padded)
+        meta["extents"][bi] = nb
+        cs[bi] = zlib.crc32(padded)
+        if old_block >= 0:
+            self._freed.append(old_block)
+
+    def _wal_key(self, okey: str, seq: int) -> str:
+        return f"{okey}\x00{seq:010d}"
+
+    def _wal_entries(self, okey: str, meta: dict) -> list:
+        """Deferred entries for one object, oldest first: committed KV
+        keys plus this batch's pending ones."""
+        if not meta.get("wal_n"):
+            return []
+        out = []
+        for k in self._wal_index.get(okey, []):
+            v = self._db.get("wal", k)
+            if v is None:
+                continue
+            bi, boff = _WAL_HDR.unpack_from(v)
+            out.append((k, bi, boff, v[_WAL_HDR.size:]))
+        for seq, bi, boff, data in self._wal_pending.get(okey, []):
+            out.append((None, bi, boff, data))
+        return out
+
+    def _fold_wal(self, okey: str, meta: dict) -> None:
+        """Apply deferred small-write entries to their blocks (the WAL
+        drain, BlueStore's _deferred_submit).  Runs before any
+        non-deferrable mutation so block-level operations always see
+        folded content; the entry keys are deleted in the same commit
+        that persists the patched extent map."""
+        for key, bi, boff, data in self._wal_entries(okey, meta):
+            self._patch_block(meta, bi, boff, data)
+            if key is not None:
+                self._wal_rms.append(key)
+        self._wal_pending.pop(okey, None)
+        meta["wal_n"] = 0
+
     def _write_block(self, block: int, data: bytes) -> None:
         self._f.seek(block * BLOCK)
         self._f.write(data[:BLOCK].ljust(BLOCK, b"\x00"))
+        self._block_dirty = True
 
-    def _obj_read(self, meta: dict, offset: int, length: int) -> bytes:
+    def _obj_read(self, okey: str, meta: dict, offset: int,
+                  length: int) -> bytes:
         out = bytearray()
         end = min(offset + length, meta["size"])
+        cs = meta.get("csum") or []
         pos = offset
         while pos < end:
             bi = pos // BLOCK
             boff = pos % BLOCK
             n = min(BLOCK - boff, end - pos)
             if bi < len(meta["extents"]) and meta["extents"][bi] >= 0:
-                blk = self._read_block(meta["extents"][bi])
+                blk = self._read_verified(
+                    meta["extents"][bi],
+                    cs[bi] if bi < len(cs) else None)
                 out += blk[boff:boff + n]
             else:
                 out += bytes(n)     # hole
             pos += n
+        # overlay deferred writes (newer than the blocks, in WAL order;
+        # WAL bytes are covered by the KV log's own crc framing)
+        for _key, wbi, wboff, wdata in self._wal_entries(okey, meta):
+            wstart = wbi * BLOCK + wboff
+            lo = max(wstart, offset)
+            hi = min(wstart + len(wdata), end)
+            if lo < hi:
+                out[lo - offset:hi - offset] = \
+                    wdata[lo - wstart:hi - wstart]
         return bytes(out)
 
-    def _obj_write(self, meta: dict, offset: int, data: bytes) -> None:
+    def _obj_write(self, okey: str, meta: dict, offset: int,
+                   data: bytes) -> None:
         end = offset + len(data)
+        # deferred small write (BlueStore deferred/WAL path): a strictly
+        # partial single-block overwrite inside the current size lands
+        # as a KV-journaled patch — no block read, no block write, no
+        # data fsync on the commit path; reads overlay it and it folds
+        # into the block once the entry count tops WAL_MAX
+        if (0 < len(data) < BLOCK and end <= meta["size"]
+                and offset // BLOCK == (end - 1) // BLOCK):
+            seq = meta["wal_seq"] = meta.get("wal_seq", 0) + 1
+            self._wal_pending.setdefault(okey, []).append(
+                (seq, offset // BLOCK, offset % BLOCK, bytes(data)))
+            meta["wal_n"] = meta.get("wal_n", 0) + 1
+            if meta["wal_n"] > WAL_MAX:
+                self._fold_wal(okey, meta)
+            return
+        self._fold_wal(okey, meta)
         need_blocks = -(-max(end, meta["size"]) // BLOCK)
         while len(meta["extents"]) < need_blocks:
             meta["extents"].append(-1)
@@ -181,28 +312,19 @@ class BlueStoreLite(ObjectStore):
             bi = pos // BLOCK
             boff = pos % BLOCK
             n = min(BLOCK - boff, end - pos)
-            old_block = meta["extents"][bi]
-            if boff == 0 and n == BLOCK:
-                patched = data[di:di + n]      # full block: no read
-            elif old_block >= 0:
-                old = self._read_block(old_block)
-                patched = old[:boff] + data[di:di + n] + old[boff + n:]
-            else:
-                patched = bytes(boff) + data[di:di + n]
-            # COW: never touch a committed block in place — the old
-            # extent stays valid until the KV commit flips the map
-            nb = self._alloc.allocate(1)[0]
-            self._write_block(nb, patched)
-            meta["extents"][bi] = nb
-            if old_block >= 0:
-                self._freed.append(old_block)
+            # COW via the checksum-maintaining patcher: the old extent
+            # stays valid until the KV commit flips the map
+            self._patch_block(meta, bi, boff, data[di:di + n])
             pos += n
             di += n
         meta["size"] = max(meta["size"], end)
 
-    def _obj_zero(self, meta: dict, offset: int, length: int) -> None:
+    def _obj_zero(self, okey: str, meta: dict, offset: int,
+                  length: int) -> None:
         """Punch holes instead of writing zeros: full blocks drop to
         extent -1 (reads synthesize zeros), edges COW-patch."""
+        self._fold_wal(okey, meta)
+        cs = self._csums(meta)
         end = offset + length
         pos = offset
         while pos < end:
@@ -213,42 +335,132 @@ class BlueStoreLite(ObjectStore):
                 if boff == 0 and n == BLOCK:
                     self._freed.append(meta["extents"][bi])
                     meta["extents"][bi] = -1
+                    cs[bi] = None
                 else:
-                    old = self._read_block(meta["extents"][bi])
-                    nb = self._alloc.allocate(1)[0]
-                    self._write_block(nb, old[:boff] + bytes(n)
-                                      + old[boff + n:])
-                    self._freed.append(meta["extents"][bi])
-                    meta["extents"][bi] = nb
+                    self._patch_block(meta, bi, boff, bytes(n))
             pos += n
         if end > meta["size"]:
             while len(meta["extents"]) < -(-end // BLOCK):
                 meta["extents"].append(-1)
+                cs.append(None)
             meta["size"] = end
 
-    def _obj_truncate(self, meta: dict, length: int) -> None:
+    def _obj_truncate(self, okey: str, meta: dict, length: int) -> None:
+        self._fold_wal(okey, meta)
         if length < meta["size"]:
             keep = -(-length // BLOCK) if length else 0
             self._freed.extend(b for b in meta["extents"][keep:]
                                if b >= 0)
+            cs = self._csums(meta)
             meta["extents"] = meta["extents"][:keep]
+            meta["csum"] = cs[:keep]
             # zero the tail of the boundary block (COW)
             if length % BLOCK and meta["extents"] \
                     and meta["extents"][-1] >= 0:
-                blk = self._read_block(meta["extents"][-1])
-                nb = self._alloc.allocate(1)[0]
-                self._write_block(nb, blk[:length % BLOCK])
-                self._freed.append(meta["extents"][-1])
-                meta["extents"][-1] = nb
+                tail = length % BLOCK
+                self._patch_block(meta, len(meta["extents"]) - 1, tail,
+                                  bytes(BLOCK - tail))
         meta["size"] = length
 
     # -- transactions ---------------------------------------------------------
+
+    def _apply_one(self, op, cache, coll_exists, get, ensure,
+                   drop) -> None:
+        """Apply a single transaction op against the batch cache."""
+        if op.op == OP_MKCOLL:
+            cache[("__coll__", op.cid)] = {}
+        elif op.op == OP_RMCOLL:
+            # purge the collection's objects too (MemStore
+            # drops the whole dict; the backends must agree)
+            prefix = f"{op.cid}\x00"
+            for k in self._db.get_range("obj"):
+                if k.startswith(prefix):
+                    drop(op.cid, k[len(prefix):])
+            for (cid, oid), m in list(cache.items()):
+                if cid == op.cid and m is not None:
+                    drop(cid, oid)
+            cache[("__coll__", op.cid)] = None
+        elif op.op == OP_TOUCH:
+            ensure(op.cid, op.oid)
+        elif op.op == OP_WRITE:
+            m = ensure(op.cid, op.oid)
+            self._obj_write(_okey(op.cid, op.oid), m,
+                            op.offset, op.data)
+        elif op.op == OP_ZERO:
+            m = ensure(op.cid, op.oid)
+            self._obj_zero(_okey(op.cid, op.oid), m,
+                           op.offset, op.length)
+        elif op.op == OP_TRUNCATE:
+            m = ensure(op.cid, op.oid)
+            self._obj_truncate(_okey(op.cid, op.oid), m,
+                               op.length)
+        elif op.op == OP_REMOVE:
+            drop(op.cid, op.oid)
+        elif op.op == OP_OMAP_SETKEYS:
+            m = ensure(op.cid, op.oid)
+            for k, v in op.keys.items():
+                m["omap"][k] = v.hex()
+        elif op.op == OP_OMAP_RMKEYS:
+            m = ensure(op.cid, op.oid)
+            for k in op.rmkeys:
+                m["omap"].pop(k, None)
+        elif op.op == OP_SETATTR:
+            m = ensure(op.cid, op.oid)
+            m["attrs"][op.name] = op.data.hex()
+        elif op.op == OP_COLL_MOVE:
+            # metadata-only move: extents stay where they
+            # are, the object record changes collections
+            if not coll_exists(op.dest):
+                raise KeyError(f"no collection {op.dest!r}")
+            m = get(op.cid, op.oid)
+            if m is not None:
+                # fold before moving: wal keys are addressed
+                # by the SOURCE collection
+                self._fold_wal(_okey(op.cid, op.oid), m)
+                prev = get(op.dest, op.oid)
+                if prev is not None:   # overwrite: free old
+                    self._freed.extend(
+                        b for b in prev["extents"] if b >= 0)
+                cache[(op.dest, op.oid)] = m
+                cache[(op.cid, op.oid)] = None
+        elif op.op == OP_CLONE:
+            m = get(op.cid, op.oid)
+            if m is None:   # missing src: no-op (MemStore)
+                return
+            prev = get(op.cid, op.dest)
+            if prev is not None:   # overwrite: free old
+                self._freed.extend(
+                    b for b in prev["extents"] if b >= 0)
+            self._fold_wal(_okey(op.cid, op.oid), m)
+            cs = self._csums(m)
+            dst = self._new_meta()
+            dst["size"] = m["size"]
+            dst["attrs"] = dict(m["attrs"])
+            dst["omap"] = dict(m["omap"])
+            for bi, src in enumerate(m["extents"]):
+                if src < 0:
+                    dst["extents"].append(-1)
+                    dst["csum"].append(None)
+                    continue
+                nb = self._alloc.allocate(1)[0]
+                self._write_block(
+                    nb, self._read_verified(src, cs[bi]))
+                dst["extents"].append(nb)
+                dst["csum"].append(cs[bi])
+            cache[(op.cid, op.dest)] = dst
+
 
     def queue_transactions(self, txns, on_commit=None) -> None:
         with self._lock:
             kvt = self._db.get_transaction()
             cache: dict[tuple, dict | None] = {}
+            # per-batch state starts clean and is DISCARDED on failure:
+            # an aborted transaction's deferred writes or freed blocks
+            # must never leak into the next commit (blocks the aborted
+            # batch COW-allocated leak until the next mount's rebuild)
             self._freed = []
+            self._wal_pending = {}
+            self._wal_rms = []
 
             def coll_exists(cid):
                 if ("__coll__", cid) in cache:
@@ -275,89 +487,39 @@ class BlueStoreLite(ObjectStore):
                 if m is not None:
                     self._freed.extend(b for b in m["extents"]
                                        if b >= 0)
+                    okey = _okey(cid, oid)
+                    for key, *_ in self._wal_entries(okey, m):
+                        if key is not None:
+                            self._wal_rms.append(key)
+                    self._wal_pending.pop(okey, None)
                 cache[(cid, oid)] = None
 
-            for t in txns:
-                for op in t.ops:
-                    if op.op == OP_MKCOLL:
-                        cache[("__coll__", op.cid)] = {}
-                    elif op.op == OP_RMCOLL:
-                        # purge the collection's objects too (MemStore
-                        # drops the whole dict; the backends must agree)
-                        prefix = f"{op.cid}\x00"
-                        for k in self._db.get_range("obj"):
-                            if k.startswith(prefix):
-                                drop(op.cid, k[len(prefix):])
-                        for (cid, oid), m in list(cache.items()):
-                            if cid == op.cid and m is not None:
-                                drop(cid, oid)
-                        cache[("__coll__", op.cid)] = None
-                    elif op.op == OP_TOUCH:
-                        ensure(op.cid, op.oid)
-                    elif op.op == OP_WRITE:
-                        m = ensure(op.cid, op.oid)
-                        self._obj_write(m, op.offset, op.data)
-                    elif op.op == OP_ZERO:
-                        m = ensure(op.cid, op.oid)
-                        self._obj_zero(m, op.offset, op.length)
-                    elif op.op == OP_TRUNCATE:
-                        m = ensure(op.cid, op.oid)
-                        self._obj_truncate(m, op.length)
-                    elif op.op == OP_REMOVE:
-                        drop(op.cid, op.oid)
-                    elif op.op == OP_OMAP_SETKEYS:
-                        m = ensure(op.cid, op.oid)
-                        for k, v in op.keys.items():
-                            m["omap"][k] = v.hex()
-                    elif op.op == OP_OMAP_RMKEYS:
-                        m = ensure(op.cid, op.oid)
-                        for k in op.rmkeys:
-                            m["omap"].pop(k, None)
-                    elif op.op == OP_SETATTR:
-                        m = ensure(op.cid, op.oid)
-                        m["attrs"][op.name] = op.data.hex()
-                    elif op.op == OP_COLL_MOVE:
-                        # metadata-only move: extents stay where they
-                        # are, the object record changes collections
-                        if not coll_exists(op.dest):
-                            raise KeyError(f"no collection {op.dest!r}")
-                        m = get(op.cid, op.oid)
-                        if m is not None:
-                            prev = get(op.dest, op.oid)
-                            if prev is not None:   # overwrite: free old
-                                self._freed.extend(
-                                    b for b in prev["extents"] if b >= 0)
-                            cache[(op.dest, op.oid)] = m
-                            cache[(op.cid, op.oid)] = None
-                    elif op.op == OP_CLONE:
-                        m = get(op.cid, op.oid)
-                        if m is None:   # missing src: no-op (MemStore)
-                            continue
-                        prev = get(op.cid, op.dest)
-                        if prev is not None:   # overwrite: free old
-                            self._freed.extend(
-                                b for b in prev["extents"] if b >= 0)
-                        dst = self._new_meta()
-                        dst["size"] = m["size"]
-                        dst["attrs"] = dict(m["attrs"])
-                        dst["omap"] = dict(m["omap"])
-                        for src in m["extents"]:
-                            if src < 0:
-                                dst["extents"].append(-1)
-                                continue
-                            nb = self._alloc.allocate(1)[0]
-                            self._write_block(nb,
-                                              self._read_block(src))
-                            dst["extents"].append(nb)
-                        cache[(op.cid, op.dest)] = dst
+            def apply_ops():
+                for t in txns:
+                    for op in t.ops:
+                        self._apply_one(op, cache, coll_exists, get,
+                                        ensure, drop)
+
+            try:
+                apply_ops()
+            except Exception:
+                self._freed = []
+                self._wal_pending = {}
+                self._wal_rms = []
+                self._block_dirty = False
+                raise
             # data before metadata: fsync the block file, then ONE
             # atomic KV commit referencing it.  Displaced blocks return
             # to the allocator only after the commit — a crash (or an
             # exception above) leaves old metadata over untouched old
             # blocks; blocks this batch allocated then leak in-memory
-            # only, and the next mount's rebuild reclaims them.
-            self._f.flush()
-            os.fsync(self._f.fileno())
+            # only, and the next mount's rebuild reclaims them.  A batch
+            # of pure deferred writes touched no block, so it pays no
+            # data fsync at all (the KV commit carries the WAL bytes).
+            if self._block_dirty:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                self._block_dirty = False
             # the KV mutations come from the FINAL cache state, never
             # eagerly per-op: a KV transaction applies sets before rms,
             # so a remove+recreate of one key in a batch (recovery's
@@ -372,7 +534,25 @@ class BlueStoreLite(ObjectStore):
                     self._put_meta(kvt, cid, oid, m)
                 else:
                     kvt.rmkey("obj", _okey(cid, oid))
+            new_wal_keys: dict[str, list[str]] = {}
+            for okey, entries in self._wal_pending.items():
+                for seq, bi, boff, data in entries:
+                    k = self._wal_key(okey, seq)
+                    kvt.set("wal", k, _WAL_HDR.pack(bi, boff) + data)
+                    new_wal_keys.setdefault(okey, []).append(k)
+            for key in self._wal_rms:
+                kvt.rmkey("wal", key)
             self._db.submit_transaction(kvt)
+            # index maintenance AFTER the commit landed
+            for key in self._wal_rms:
+                okey = key.rsplit("\x00", 1)[0]
+                lst = self._wal_index.get(okey)
+                if lst and key in lst:
+                    lst.remove(key)
+            for okey, keys in new_wal_keys.items():
+                self._wal_index.setdefault(okey, []).extend(keys)
+            self._wal_pending = {}
+            self._wal_rms = []
             self._alloc.release(self._freed)
             self._freed = []
         if on_commit:
@@ -396,7 +576,8 @@ class BlueStoreLite(ObjectStore):
             m = self._get_checked(cid, oid)
             if length is None:
                 length = m["size"] - offset
-            return self._obj_read(m, offset, max(0, length))
+            return self._obj_read(_okey(cid, oid), m, offset,
+                                  max(0, length))
 
     def stat(self, cid, oid) -> dict:
         with self._lock:

@@ -136,6 +136,12 @@ class MOSDPGPush(Message):
         dec.versioned(1, body)
 
 
+#: scrub-map sentinel for a copy whose read failed checksum
+#: verification; shaped like the (size, data_crc, omap_crc) triple so it
+#: rides MOSDScrubReply's fixed wire format
+SCRUB_CORRUPT = (2 ** 64 - 1, 0, 0)
+
+
 def enc_version(v: tuple[int, int]) -> bytes:
     return f"{v[0]}.{v[1]}".encode()
 
@@ -2879,6 +2885,14 @@ class OSDDaemon(Dispatcher):
                 omap = self.store.omap_get(cid, oid)
             except KeyError:
                 continue
+            except IOError:
+                # store-level checksum mismatch (bluestore verifies
+                # every block on read): a distinct sentinel — wire-
+                # compatible with the (size, crc, crc) triple — diverges
+                # from every healthy map entry, so the compare pass
+                # repairs this copy from a clean peer
+                out[oid] = SCRUB_CORRUPT
+                continue
             oblob = repr(sorted(omap.items())).encode()
             out[oid] = (len(data), shard_crc(data), shard_crc(oblob))
         return out
@@ -2951,12 +2965,13 @@ class OSDDaemon(Dispatcher):
             if all(v == want for v in vals.values()):
                 continue
             report["inconsistent"].append(oid)
-            if want == majority and want is not None:
+            if want == majority and want is not None \
+                    and want != SCRUB_CORRUPT:
                 # push the primary copy over divergent replicas
                 try:
                     data = self.store.read(cid, oid)
                     omap = self.store.omap_get(cid, oid)
-                except KeyError:
+                except (KeyError, IOError):
                     continue
                 attrs = {}
                 for name in ("_v", "snapc", "from_seq"):
@@ -2973,10 +2988,20 @@ class OSDDaemon(Dispatcher):
                             attrs=attrs))
                         report["repaired"].append((oid, o))
             else:
-                # the primary is the outlier: repull from a good peer
-                good = next((o for o, val in vals.items()
-                             if val == majority and o != self.osd_id),
-                            None)
+                # the primary is the outlier (divergent or corrupt):
+                # repull from a healthy peer — never from a copy whose
+                # own read failed checksum verification, even when the
+                # corrupt copies happen to form the majority
+                healthy = {o: val for o, val in vals.items()
+                           if o != self.osd_id and val is not None
+                           and val != SCRUB_CORRUPT}
+                hcounts: dict = {}
+                for val in healthy.values():
+                    hcounts[val] = hcounts.get(val, 0) + 1
+                best_val = (max(hcounts, key=lambda v: hcounts[v])
+                            if hcounts else None)
+                good = next((o for o, val in healthy.items()
+                             if val == best_val), None)
                 ent = pg.log.index.get(oid)
                 if good is not None and ent is not None:
                     with self._lock:

@@ -196,10 +196,10 @@ class Image:
             self._save_meta(m)
         elif op == "snap_create":
             if event["snap"] not in self.snap_list():
-                self.snap_create(event["snap"])
+                self._snap_create_internal(event["snap"])
         elif op == "snap_remove":
             if event["snap"] in self.snap_list():
-                self.snap_remove(event["snap"])
+                self._snap_remove_internal(event["snap"])
         else:
             raise ValueError(f"unknown journal event {op!r}")
 
@@ -270,6 +270,20 @@ class Image:
         self._meta = m
 
     def snap_create(self, snap: str) -> int:
+        self._check_primary()
+        snapid = self._snap_create_internal(snap)
+        # journal AFTER the mon op succeeds: a failed snap must never
+        # replay onto the mirror (the reverse window — snap taken, crash
+        # before journaling — loses only the mirror's copy of the snap,
+        # the recoverable direction)
+        self._journal_event({"op": "snap_create", "snap": snap})
+        return snapid
+
+    def _snap_create_internal(self, snap: str) -> int:
+        """Snapshot without the primary gate or journaling: the public
+        path wraps this; mirror replay (mirror_apply) calls it directly
+        so replicated snaps neither re-journal on the target nor bounce
+        off its demoted state."""
         m = self._load()
         if snap in m.get("snaps", {}):
             raise FileExistsError(f"snapshot {snap!r} exists")
@@ -288,17 +302,17 @@ class Image:
         m.setdefault("snaps", {})[snap] = {"snapid": snapid,
                                            "size": m["size"]}
         self._save_meta(m)
-        # journal AFTER the mon op succeeds: a failed snap must never
-        # replay onto the mirror (the reverse window — snap taken, crash
-        # before journaling — loses only the mirror's copy of the snap,
-        # the recoverable direction)
-        self._journal_event({"op": "snap_create", "snap": snap})
         return snapid
 
     def snap_list(self) -> dict:
         return dict(self._load().get("snaps", {}))
 
     def snap_remove(self, snap: str) -> None:
+        self._check_primary()
+        self._snap_remove_internal(snap)
+        self._journal_event({"op": "snap_remove", "snap": snap})
+
+    def _snap_remove_internal(self, snap: str) -> None:
         m = self._load()
         if snap not in m.get("snaps", {}):
             raise KeyError(f"no snapshot {snap!r}")
@@ -309,11 +323,11 @@ class Image:
             raise OSError(-rc or 5, out)
         del m["snaps"][snap]
         self._save_meta(m)
-        self._journal_event({"op": "snap_remove", "snap": snap})
 
     def snap_rollback(self, snap: str) -> None:
         """Restore image content to the snapshot (rbd snap rollback —
         object-by-object copy-back, librbd's simple_rollback)."""
+        self._check_primary()
         m = self._load()
         ent = m.get("snaps", {}).get(snap)
         if ent is None:

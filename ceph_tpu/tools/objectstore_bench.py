@@ -56,6 +56,11 @@ def run(store, n_objects: int, obj_size: int, n_threads: int) -> dict:
         Transaction().write(cid, f"o{i}", obj_size // 2,
                             payload[:obj_size // 2])),
           bytes_per_op=obj_size // 2)
+    # small sub-block overwrites: the deferred-write (WAL) fast path on
+    # bluestore — a 512 B patch inside an existing block
+    phase("small_overwrite", lambda i: store.apply_transaction(
+        Transaction().write(cid, f"o{i}", 1024, payload[:512])),
+          bytes_per_op=512)
     phase("delete", lambda i: store.apply_transaction(
         Transaction().remove(cid, f"o{i}")))
     results["config"] = {"objects": n_objects, "size": obj_size,
