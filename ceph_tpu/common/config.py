@@ -149,6 +149,20 @@ class Config:
             for cb in observers:
                 cb(name, new)
 
+    def rm(self, name: str, source: str) -> None:
+        """Retract a layer's value (the mon config-db analog of
+        `ceph config rm`); observers fire if the effective value moves."""
+        with self._lock:
+            self._lookup(name)
+            old = self.get(name)
+            layers = self._values.get(name, {})
+            layers.pop(source, None)
+            new = self.get(name)
+            observers = list(self._observers.get(name, []))
+        if new != old:
+            for cb in observers:
+                cb(name, new)
+
     def load_file(self, path: str) -> None:
         """JSON config file (the ceph.conf layer)."""
         with open(path) as f:

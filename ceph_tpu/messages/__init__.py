@@ -24,6 +24,7 @@ from .osd_msgs import (
     MOSDRepOp,
     MOSDRepOpReply,
     MMonCommand,
+    MPGStats,
     MMonCommandAck,
     OSDOpField,
 )
@@ -33,5 +34,6 @@ __all__ = [
     "MOSDECSubOpWrite", "MOSDECSubOpWriteReply",
     "MOSDECSubOpRead", "MOSDECSubOpReadReply",
     "MOSDPing", "MOSDFailure", "MOSDMapMsg",
-    "MMonCommand", "MMonCommandAck", "OSDOpField",
+    "MMonCommand",
+    "MPGStats", "MMonCommandAck", "OSDOpField",
 ]

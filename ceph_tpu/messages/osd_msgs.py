@@ -399,6 +399,38 @@ class MOSDMapMsg(Message):
 
 
 @register_message
+class MPGStats(Message):
+    """Per-OSD PG state summary for mon health (the pre-luminous
+    MPGStats / PGMonitor flow: primaries report, the mon aggregates
+    PG_DEGRADED-class checks from it)."""
+
+    TYPE = 87  # MSG_PGSTATS
+
+    def __init__(self, osd_id: int = 0, states: dict | None = None,
+                 degraded_objects: int = 0, stamp: float = 0.0):
+        super().__init__()
+        self.osd_id = osd_id
+        self.states = states or {}      # pg state -> count (primary pgs)
+        self.degraded_objects = degraded_objects
+        self.stamp = stamp
+
+    def encode_payload(self, enc):
+        enc.versioned(1, 1, lambda e: (
+            e.u32(self.osd_id),
+            e.map(self.states, lambda e2, k: e2.str(k),
+                  lambda e2, v: e2.u32(v)),
+            e.u64(self.degraded_objects), e.f64(self.stamp)))
+
+    def decode_payload(self, dec, version):
+        def body(d, v):
+            self.osd_id = d.u32()
+            self.states = d.map(lambda d2: d2.str(), lambda d2: d2.u32())
+            self.degraded_objects = d.u64()
+            self.stamp = d.f64()
+        dec.versioned(1, body)
+
+
+@register_message
 class MMonCommand(Message):
     TYPE = 50  # MSG_MON_COMMAND
 

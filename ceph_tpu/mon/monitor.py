@@ -31,6 +31,7 @@ from ceph_tpu.common.logging import dout
 from ceph_tpu.crush.builder import add_simple_rule, make_bucket
 from ceph_tpu.crush.types import CRUSH_BUCKET_STRAW2, CrushMap
 from ceph_tpu.messages import (
+    MPGStats,
     MMonCommand, MMonCommandAck, MOSDFailure, MOSDMapMsg)
 from ceph_tpu.messages.osd_msgs import MOSDPing
 from ceph_tpu.mon.elector import Elector, MMonElection
@@ -165,6 +166,8 @@ class Monitor(Dispatcher):
         self._failure_reports: dict[int, dict[int, tuple[float, float]]] = {}
         #: subscriber name -> (addr, entity)
         self._subs: dict[str, tuple[str, EntityName]] = {}
+        #: latest MPGStats per reporting OSD (PG_DEGRADED health feed)
+        self._pg_stats: dict[int, dict] = {}
         self._osd_addrs: dict[int, str] = {}
         self.monmap: list[str] = []
         self.elector: Elector | None = None
@@ -401,6 +404,13 @@ class Monitor(Dispatcher):
                 con = self.msgr.connect_to(msg.addr, entity)
                 con.send_message(MOSDMapMsg(epoch=epoch, map_blob=blob))
             return True
+        if isinstance(msg, MPGStats):
+            with self._lock:
+                self._pg_stats[msg.osd_id] = {
+                    "states": dict(msg.states),
+                    "degraded_objects": msg.degraded_objects,
+                    "received": time.time()}
+            return True
         if isinstance(msg, MOSDFailure):
             self._work_q.put(("failure", msg, None))
             return True
@@ -592,26 +602,18 @@ class Monitor(Dispatcher):
         try:
             if prefix == "status":
                 return json.dumps(self.status()), 0
-            if prefix == "health":
-                m = self.osdmap
-                checks = []
-                down = [o for o in range(m.max_osd)
-                        if m.exists(o) and not m.is_up(o)]
-                if down:
-                    checks.append({"check": "OSD_DOWN", "osds": down})
-                out_osds = [o for o in range(m.max_osd)
-                            if m.exists(o) and m.is_out(o)]
-                if out_osds:
-                    checks.append({"check": "OSD_OUT", "osds": out_osds})
-                # an election that has not converged means no live quorum
-                # RIGHT NOW (elector.quorum only records the last victory,
-                # which goes stale when a majority of mons die)
-                if self.elector is None or self.elector.electing:
-                    checks.append({"check": "MON_QUORUM_AT_RISK",
-                                   "last_quorum": self.quorum()})
-                return json.dumps({
-                    "status": "HEALTH_OK" if not checks
-                    else "HEALTH_WARN", "checks": checks}), 0
+            if prefix in ("health", "health detail"):
+                return json.dumps(self._health_report(
+                    detail=(prefix == "health detail"
+                            or cmd.get("detail")))), 0
+            if prefix == "config set":
+                return self._cmd_config_set(cmd)
+            if prefix == "config get":
+                return self._cmd_config_get(cmd)
+            if prefix == "config rm":
+                return self._cmd_config_rm(cmd)
+            if prefix == "config dump":
+                return json.dumps(self.osdmap.config_db), 0
             if prefix == "quorum_status":
                 return json.dumps({
                     "quorum": self.quorum(),
@@ -880,6 +882,118 @@ class Monitor(Dispatcher):
         if not self._mutate(fn):
             return "commit failed", -11
         return f"pool {result[0]} created", 0
+
+    # -- central config-db (mon/ConfigMonitor.h:13 analog) --------------------
+
+    def _cmd_config_set(self, cmd) -> tuple[str, int]:
+        import json
+        who = str(cmd.get("who", "global"))
+        name = str(cmd["name"])
+        value = str(cmd["value"])
+
+        def fn(m: OSDMap):
+            sec = m.config_db.setdefault(who, {})
+            if sec.get(name) == value:
+                return False
+            sec[name] = value
+        if not self._mutate(fn):
+            return "commit failed", -11
+        return json.dumps({"epoch": self.osdmap.epoch}), 0
+
+    def _cmd_config_get(self, cmd) -> tuple[str, int]:
+        import json
+        who = str(cmd.get("who", "global"))
+        sec = self.osdmap.config_db.get(who, {})
+        if "name" in cmd:
+            name = str(cmd["name"])
+            if name not in sec:
+                return f"no config {name!r} for {who!r}", -2
+            return str(sec[name]), 0
+        return json.dumps(sec), 0
+
+    def _cmd_config_rm(self, cmd) -> tuple[str, int]:
+        import json
+        who = str(cmd.get("who", "global"))
+        name = str(cmd["name"])
+
+        def fn(m: OSDMap):
+            sec = m.config_db.get(who, {})
+            if name not in sec:
+                return False
+            del sec[name]
+            if not sec:
+                m.config_db.pop(who, None)
+        if not self._mutate(fn):
+            return "commit failed", -11
+        return json.dumps({"epoch": self.osdmap.epoch}), 0
+
+    # -- health framework (mon/HealthMonitor.h:22 analog) ---------------------
+
+    #: pg-stat reports older than this are ignored (the sender is dead
+    #: or wedged; OSD_DOWN covers it)
+    PG_STATS_STALE = 30.0
+
+    def _health_report(self, detail: bool = False) -> dict:
+        import time as _time
+        m = self.osdmap
+        checks = []
+
+        def check(name, summary, details, **extra):
+            c = {"check": name, "summary": summary, **extra}
+            if detail:
+                c["detail"] = details
+            checks.append(c)
+
+        down = [o for o in range(m.max_osd)
+                if m.exists(o) and not m.is_up(o)]
+        if down:
+            check("OSD_DOWN", f"{len(down)} osds down",
+                  [f"osd.{o} is down" for o in down], osds=down)
+        out_osds = [o for o in range(m.max_osd)
+                    if m.exists(o) and m.is_out(o)]
+        if out_osds:
+            check("OSD_OUT", f"{len(out_osds)} osds out",
+                  [f"osd.{o} is out" for o in out_osds], osds=out_osds)
+        # MON_DOWN: monmap members absent from the current quorum
+        if self.elector is not None and self.monmap:
+            q = set(self.quorum())
+            missing = [r for r in range(len(self.monmap)) if r not in q]
+            if missing and not self.elector.electing:
+                check("MON_DOWN",
+                      f"{len(missing)} mons down",
+                      [f"mon.{r} is not in quorum" for r in missing],
+                      mons=missing)
+        if self.elector is None or self.elector.electing:
+            check("MON_QUORUM_AT_RISK", "election in progress",
+                  [f"last quorum {self.quorum()}"],
+                  last_quorum=self.quorum())
+        # PG_DEGRADED from the MPGStats feed (primaries report)
+        now = _time.time()
+        with self._lock:
+            stats = {o: st for o, st in self._pg_stats.items()
+                     if now - st["received"] < self.PG_STATS_STALE
+                     and m.exists(o) and m.is_up(o)}
+        not_active = {}
+        degraded_objects = 0
+        for o, st in stats.items():
+            degraded_objects += st["degraded_objects"]
+            for state, n in st["states"].items():
+                if state != "active" and n:
+                    not_active[state] = not_active.get(state, 0) + n
+        if not_active or degraded_objects:
+            total = sum(not_active.values())
+            check("PG_DEGRADED",
+                  f"{total} pgs not active; "
+                  f"{degraded_objects} objects degraded",
+                  [f"{n} pgs {state}" for state, n in
+                   sorted(not_active.items())]
+                  + [f"osd.{o}: {st['degraded_objects']} degraded objects"
+                     for o, st in sorted(stats.items())
+                     if st["degraded_objects"]],
+                  pgs_not_active=total,
+                  degraded_objects=degraded_objects)
+        return {"status": "HEALTH_OK" if not checks else "HEALTH_WARN",
+                "checks": checks}
 
     def _cmd_pool_set(self, cmd) -> tuple[str, int]:
         pool_id = int(cmd["pool"])

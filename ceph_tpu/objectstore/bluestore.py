@@ -115,6 +115,11 @@ class BlueStoreLite(ObjectStore):
         #: scan per read of a WAL-bearing object); rebuilt at mount,
         #: maintained at commit
         self._wal_index: dict[str, list[str]] = {}
+        #: store-global WAL key sequence: per-meta counters reset when
+        #: an object is removed+recreated in one batch, and a reused key
+        #: would collide with its own pending deletion inside the same
+        #: KV transaction (sets apply before rms)
+        self._wal_seq = 0
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -145,9 +150,11 @@ class BlueStoreLite(ObjectStore):
         nxt = max(used) + 1 if used else 0
         self._alloc.restore(nxt, sorted(set(range(nxt)) - used))
         self._wal_index = {}
+        self._wal_seq = 0
         for k in sorted(self._db.get_range("wal")):
-            okey = k.rsplit("\x00", 1)[0]
+            okey, _, seq = k.rpartition("\x00")
             self._wal_index.setdefault(okey, []).append(k)
+            self._wal_seq = max(self._wal_seq, int(seq))
 
     def umount(self) -> None:
         if self._f is not None:
@@ -238,6 +245,16 @@ class BlueStoreLite(ObjectStore):
             out.append((None, bi, boff, data))
         return out
 
+    def _purge_wal(self, okey: str, meta: dict | None) -> None:
+        """Queue every WAL entry of an object (committed + pending) for
+        deletion — overwriting or dropping a destination must not leave
+        stale deferred bytes to overlay the new content."""
+        for k in self._wal_index.get(okey, []):
+            self._wal_rms.append(k)
+        self._wal_pending.pop(okey, None)
+        if meta is not None:
+            meta["wal_n"] = 0
+
     def _fold_wal(self, okey: str, meta: dict) -> None:
         """Apply deferred small-write entries to their blocks (the WAL
         drain, BlueStore's _deferred_submit).  Runs before any
@@ -295,9 +312,10 @@ class BlueStoreLite(ObjectStore):
         # into the block once the entry count tops WAL_MAX
         if (0 < len(data) < BLOCK and end <= meta["size"]
                 and offset // BLOCK == (end - 1) // BLOCK):
-            seq = meta["wal_seq"] = meta.get("wal_seq", 0) + 1
+            self._wal_seq += 1
             self._wal_pending.setdefault(okey, []).append(
-                (seq, offset // BLOCK, offset % BLOCK, bytes(data)))
+                (self._wal_seq, offset // BLOCK, offset % BLOCK,
+                 bytes(data)))
             meta["wal_n"] = meta.get("wal_n", 0) + 1
             if meta["wal_n"] > WAL_MAX:
                 self._fold_wal(okey, meta)
@@ -428,9 +446,10 @@ class BlueStoreLite(ObjectStore):
             if m is None:   # missing src: no-op (MemStore)
                 return
             prev = get(op.cid, op.dest)
-            if prev is not None:   # overwrite: free old
+            if prev is not None:   # overwrite: free old + its WAL
                 self._freed.extend(
                     b for b in prev["extents"] if b >= 0)
+                self._purge_wal(_okey(op.cid, op.dest), prev)
             self._fold_wal(_okey(op.cid, op.oid), m)
             cs = self._csums(m)
             dst = self._new_meta()
@@ -487,11 +506,7 @@ class BlueStoreLite(ObjectStore):
                 if m is not None:
                     self._freed.extend(b for b in m["extents"]
                                        if b >= 0)
-                    okey = _okey(cid, oid)
-                    for key, *_ in self._wal_entries(okey, m):
-                        if key is not None:
-                            self._wal_rms.append(key)
-                    self._wal_pending.pop(okey, None)
+                    self._purge_wal(_okey(cid, oid), m)
                 cache[(cid, oid)] = None
 
             def apply_ops():

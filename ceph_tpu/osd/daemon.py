@@ -44,6 +44,7 @@ from ceph_tpu.common.perf_counters import PerfCountersBuilder
 from ceph_tpu.common.throttle import Throttle
 from ceph_tpu.ec import registry_instance
 from ceph_tpu.messages import (
+    MPGStats,
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply, MOSDFailure, MOSDMapMsg, MOSDOp, MOSDOpReply,
     MOSDPing, MOSDRepOp, MOSDRepOpReply)
@@ -414,6 +415,12 @@ class OSDDaemon(Dispatcher):
             self._renew_map_subscription(now)
             self._agent_scan(now)
             self._mgr_report()
+            # PG state summary to the mons (MPGStats flow): feeds the
+            # PG_DEGRADED health check
+            states, degraded = self._pg_stats_summary()
+            self._send_to_mons(lambda: MPGStats(
+                osd_id=self.osd_id, states=states,
+                degraded_objects=degraded, stamp=now))
             for warn in self.op_tracker.check_ops_in_flight():
                 dout("osd", 1, "osd.%d %s", self.osd_id, warn)
             with self._lock:
@@ -505,6 +512,14 @@ class OSDDaemon(Dispatcher):
                     and pg.state in (STATE_GETINFO, STATE_GETLOG)
                     and now - pg.peering_started > self.STUCK_AFTER):
                 restart = True   # a query/notify was lost; re-run the round
+            elif (pg.primary == self.osd_id
+                    and pg.state == STATE_INACTIVE
+                    and (pg.waiting_for_active or pg.waiting_for_missing)
+                    and now - pg.peering_started > self.STUCK_AFTER):
+                # ops parked on a primary that never started (or lost)
+                # its peering round — e.g. an op racing a pg-split scan
+                # under load: kick the round rather than strand them
+                restart = True
             elif pg.state == STATE_RECOVERING:
                 # drop stuck pulls; the window refill below re-issues them
                 for oid, started in list(pg.recovering.items()):
@@ -568,6 +583,7 @@ class OSDDaemon(Dispatcher):
             self._codecs.clear()
         del oldmap
         dout("osd", 5, "osd.%d got map epoch %d", self.osd_id, newmap.epoch)
+        self._apply_config_db(newmap)
         self._split_pgs(newmap)
         self._scan_pgs()
         with self._lock:
@@ -581,6 +597,55 @@ class OSDDaemon(Dispatcher):
             self._handle_op(m)
         for handler, m in subops:
             handler(m)
+
+    def _apply_config_db(self, m: OSDMap) -> None:
+        """Fold the map's central config-db into this daemon's config
+        at the "mon" source layer (ConfigMonitor push -> md_config_t
+        observers): global < osd < osd.N precedence, with retraction
+        when a key leaves the db."""
+        desired: dict[str, str] = {}
+        for section in ("global", "osd", f"osd.{self.osd_id}"):
+            desired.update(m.config_db.get(section, {}))
+        applied = getattr(self, "_mon_config_applied", set())
+        for name in applied - set(desired):
+            try:
+                self.ctx.conf.rm(name, "mon")
+            except (KeyError, ValueError):
+                pass
+        for name, value in desired.items():
+            try:
+                self.ctx.conf.set(name, value, source="mon")
+            except (KeyError, ValueError):
+                dout("osd", 5, "osd.%d ignoring unknown config %s",
+                     self.osd_id, name)
+        self._mon_config_applied = set(desired)
+
+    def _pg_stats_summary(self) -> tuple[dict, int]:
+        """(state -> count over primary PGs, degraded object count).
+
+        Primaries are judged against the CURRENT map, not the cached
+        pg.primary: a PG remapped away leaves a stale local object in
+        state "inactive" that must not count as degraded forever."""
+        states: dict[str, int] = {}
+        degraded = 0
+        with self._lock:
+            pgids = list(self.pgs)
+        for pgid in pgids:
+            pool = self.osdmap.pools.get(pgid[0])
+            if pool is None or not (0 <= pgid[1] < pool.pg_num):
+                continue
+            _up, primary = self._pg_members(pgid)
+            if primary != self.osd_id:
+                continue
+            with self._lock:
+                pg = self.pgs.get(pgid)
+                if pg is None:
+                    continue
+                states[pg.state] = states.get(pg.state, 0) + 1
+                degraded += len(pg.missing)
+                for ps in pg.peers.values():
+                    degraded += len(ps.missing)
+        return states, degraded
 
     def _pg_cid(self, pgid) -> str:
         return f"{pgid[0]}.{pgid[1]}"
@@ -2965,9 +3030,18 @@ class OSDDaemon(Dispatcher):
             if all(v == want for v in vals.values()):
                 continue
             report["inconsistent"].append(oid)
-            if want == majority and want is not None \
-                    and want != SCRUB_CORRUPT:
-                # push the primary copy over divergent replicas
+            # authority = the most common HEALTHY value (checksum-failed
+            # copies can never be authoritative, even as a majority)
+            healthy = {o: val for o, val in vals.items()
+                       if val is not None and val != SCRUB_CORRUPT}
+            hcounts: dict = {}
+            for val in healthy.values():
+                hcounts[val] = hcounts.get(val, 0) + 1
+            hmaj = max(hcounts, key=lambda v: (hcounts[v], v == want)) \
+                if hcounts else None
+            if want == hmaj and want is not None:
+                # the primary agrees with the healthy majority: push its
+                # copy over every divergent (or corrupt) replica
                 try:
                     data = self.store.read(cid, oid)
                     omap = self.store.omap_get(cid, oid)
@@ -2989,19 +3063,10 @@ class OSDDaemon(Dispatcher):
                         report["repaired"].append((oid, o))
             else:
                 # the primary is the outlier (divergent or corrupt):
-                # repull from a healthy peer — never from a copy whose
-                # own read failed checksum verification, even when the
-                # corrupt copies happen to form the majority
-                healthy = {o: val for o, val in vals.items()
-                           if o != self.osd_id and val is not None
-                           and val != SCRUB_CORRUPT}
-                hcounts: dict = {}
-                for val in healthy.values():
-                    hcounts[val] = hcounts.get(val, 0) + 1
-                best_val = (max(hcounts, key=lambda v: hcounts[v])
-                            if hcounts else None)
+                # repull from a healthy peer holding the healthy-majority
+                # value
                 good = next((o for o, val in healthy.items()
-                             if val == best_val), None)
+                             if val == hmaj and o != self.osd_id), None)
                 ent = pg.log.index.get(oid)
                 if good is not None and ent is not None:
                     with self._lock:

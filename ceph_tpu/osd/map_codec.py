@@ -193,8 +193,11 @@ def encode_osdmap(m: OSDMap) -> bytes:
         e.list(m.osd_xinfo, lambda e2, x: (
             e2.f64(x.down_stamp), e2.f64(x.laggy_probability),
             e2.f64(x.laggy_interval)))
+        # v6: central config-db (ConfigMonitor key space)
+        e.bytes(_json.dumps(m.config_db).encode() if m.config_db
+                else b"")
 
-    enc.versioned(5, 1, body)
+    enc.versioned(6, 1, body)
     return enc.tobytes()
 
 
@@ -252,7 +255,14 @@ def decode_osdmap(data: bytes) -> OSDMap:
                 laggy_interval=d2.f64()))
         while len(xinfo) < max_osd:
             xinfo.append(OSDXInfo())
+        config_db = {}
+        if version >= 6:
+            import json as _json
+            blob = d.bytes()
+            if blob:
+                config_db = _json.loads(blob.decode())
         return OSDMap(epoch=epoch, crush=crush, max_osd=max_osd,
+                      config_db=config_db,
                       crush_names=crush_names, osd_xinfo=xinfo,
                       osd_state=osd_state, osd_weight=osd_weight,
                       osd_primary_affinity=affinity, osd_addrs=osd_addrs,

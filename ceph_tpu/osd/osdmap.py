@@ -127,6 +127,10 @@ class OSDMap:
     osd_primary_affinity: list[int] = field(default_factory=list)
     osd_addrs: list[str] = field(default_factory=list)   # entity_addr_t
     pools: dict[int, PGPool] = field(default_factory=dict)
+    #: central config database (mon/ConfigMonitor.h analog): section
+    #: ("global" / "osd" / "osd.3" / "mon" ...) -> {option: value-str};
+    #: replicated with the map, applied by daemons via config observers
+    config_db: dict = field(default_factory=dict)
     # overrides
     pg_upmap: dict[tuple[int, int], list[int]] = field(default_factory=dict)
     pg_upmap_items: dict[tuple[int, int], list[tuple[int, int]]] = \

@@ -18,6 +18,11 @@ import sys
 COMMANDS = {
     ("status",): [],
     ("health",): [],
+    ("health", "detail"): [],
+    ("config", "set"): ["who", "name", "value"],
+    ("config", "get"): ["who", "name"],
+    ("config", "rm"): ["who", "name"],
+    ("config", "dump"): [],
     ("quorum_status",): [],
     ("osd", "tree"): [],
     ("osd", "getmap"): [],

@@ -233,8 +233,23 @@ def run_soak(duration: float = 25.0, seed: int = 7,
         th = Thrasher(c, seed=seed, pools={rep: 8, ec: 8})
         deadline = time.time() + duration
         log = []
+        health_seen: set[str] = set()
+
+        def sample_health() -> None:
+            import json as _json
+            try:
+                rc, out = client.mon_command({"prefix": "health"})
+                if rc == 0:
+                    h = _json.loads(out)
+                    health_seen.add(h["status"])
+                    for ch in h["checks"]:
+                        health_seen.add(ch["check"])
+            except (TimeoutError, OSError, ValueError):
+                pass
+
         while time.time() < deadline:
             log.append(th.step())
+            sample_health()
             time.sleep(rng.uniform(0.5, 1.5))
         w1.stop()
         w2.stop()
@@ -245,10 +260,27 @@ def run_soak(duration: float = 25.0, seed: int = 7,
         c.wait_for_epoch(c.mon.osdmap.epoch, timeout=30)
         time.sleep(3.0)   # recovery settles
         vclient = c.client(timeout=20.0)
+        # health must transition: WARN during the storm, OK after heal
+        import json as _json
+        final_health = ""
+        hdl = time.time() + 30
+        while time.time() < hdl:
+            try:
+                rc, out = vclient.mon_command({"prefix": "health"})
+            except (TimeoutError, OSError):
+                time.sleep(0.5)
+                continue
+            if rc == 0:
+                final_health = _json.loads(out)["status"]
+                if final_health == "HEALTH_OK":
+                    break
+            time.sleep(0.5)
         bad1 = w1.final_verify(vclient)
         bad2 = w2.final_verify(vclient)
         return {
             "actions": th.actions, "log": log,
+            "health_seen": sorted(health_seen),
+            "final_health": final_health,
             "rep_ops": w1.ops, "ec_ops": w2.ops,
             "rep_errors": w1.errors, "ec_errors": w2.errors,
             "corruptions": w1.corruptions + w2.corruptions,

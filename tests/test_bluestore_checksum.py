@@ -194,3 +194,58 @@ def test_deferred_write_into_truncate_extended_region(tmp_path):
         assert len(data) == 8192
     finally:
         st.umount()
+
+
+def test_scrub_pushes_over_corrupt_majority(bluestore_cluster):
+    """A healthy primary facing TWO corrupt replicas pushes its copy —
+    corrupt copies are never authoritative, even as a majority."""
+    c, client, pool, io = bluestore_cluster
+    body = b"only-healthy-copy" * 300
+    io.write_full("sole", body)
+    pgid, up, prim = _holder_pg(c, pool, "sole")
+    cid = f"{pgid[0]}.{pgid[1]}"
+    replicas = [o for o in up if o != prim]
+    for r in replicas:
+        _corrupt_block(c.osds[r].store, cid, "sole")
+    report = c.osds[prim].scrub_pg(pgid)
+    repaired_to = {o for oid, o in report["repaired"] if oid == "sole"}
+    assert set(replicas) <= repaired_to, report
+    import time
+    for r in replicas:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                if c.osds[r].store.read(cid, "sole") == body:
+                    break
+            except IOError:
+                pass
+            time.sleep(0.1)
+        assert c.osds[r].store.read(cid, "sole") == body
+
+
+def test_clone_overwrite_purges_destination_wal(tmp_path):
+    """Cloning over an object with committed deferred writes must purge
+    them — stale WAL bytes overlaying the clone was live corruption."""
+    st = create_objectstore("bluestore", str(tmp_path / "bs"))
+    st.mkfs_if_needed()
+    st.mount()
+    try:
+        st.apply_transaction(Transaction().create_collection("c.0"))
+        st.apply_transaction(Transaction().write("c.0", "dst", 0,
+                                                 b"\x11" * 8192))
+        st.apply_transaction(Transaction().write("c.0", "dst", 200,
+                                                 b"OLDWAL"))
+        st.apply_transaction(Transaction().write("c.0", "src", 0,
+                                                 b"\x22" * 8192))
+        st.apply_transaction(Transaction().clone("c.0", "src", "dst"))
+        assert st.read("c.0", "dst") == b"\x22" * 8192
+        # and a remove+recreate in ONE batch keeps its new deferred write
+        st.apply_transaction(
+            Transaction().remove("c.0", "dst")
+            .write("c.0", "dst", 0, b"\x33" * 8192)
+            .write("c.0", "dst", 100, b"FRESH!"))
+        data = st.read("c.0", "dst")
+        assert data[100:106] == b"FRESH!"
+        assert data[0:100] == b"\x33" * 100
+    finally:
+        st.umount()

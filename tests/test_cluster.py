@@ -381,4 +381,11 @@ def test_health_command(cluster):
     rc, out = client.mon_command({"prefix": "health"})
     h = json.loads(out)
     assert h["status"] == "HEALTH_WARN"
-    assert {"check": "OSD_DOWN", "osds": [2]} in h["checks"]
+    osd_down = next(c for c in h["checks"] if c["check"] == "OSD_DOWN")
+    assert osd_down["osds"] == [2]
+    assert "summary" in osd_down
+    # the detail variant carries per-item lines
+    rc, out = client.mon_command({"prefix": "health detail"})
+    h = json.loads(out)
+    dd = next(c for c in h["checks"] if c["check"] == "OSD_DOWN")
+    assert dd["detail"] == ["osd.2 is down"]

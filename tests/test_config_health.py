@@ -1,0 +1,177 @@
+"""Mon central config-db + structured health checks
+(mon/ConfigMonitor.h:13 and mon/HealthMonitor.h:22 analogs): `ceph
+config set` persists through Paxos and pushes to live daemons via the
+config observer machinery; health checks are structured and transition
+with cluster state.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from ceph_tpu.tools.vstart import MiniCluster
+
+
+def _health(client, detail=False):
+    rc, out = client.mon_command(
+        {"prefix": "health detail" if detail else "health"})
+    assert rc == 0, out
+    return json.loads(out)
+
+
+def _checks(h):
+    return {c["check"] for c in h["checks"]}
+
+
+def test_config_set_propagates_to_live_osd():
+    c = MiniCluster(n_osds=3).start()
+    try:
+        c.wait_for_osd_count(3)
+        client = c.client()
+        # default before the change
+        assert int(c.osds[1].ctx.conf.get("osd_recovery_max_active")) != 7
+        rc, out = client.mon_command({
+            "prefix": "config set", "who": "osd",
+            "name": "osd_recovery_max_active", "value": "7"})
+        assert rc == 0, out
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if all(int(o.ctx.conf.get("osd_recovery_max_active")) == 7
+                   for o in c.osds.values()):
+                break
+            time.sleep(0.05)
+        for o in c.osds.values():
+            assert int(o.ctx.conf.get("osd_recovery_max_active")) == 7
+
+        # per-daemon section outranks the type section
+        rc, _ = client.mon_command({
+            "prefix": "config set", "who": "osd.1",
+            "name": "osd_recovery_max_active", "value": "9"})
+        assert rc == 0
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if int(c.osds[1].ctx.conf.get("osd_recovery_max_active")) == 9:
+                break
+            time.sleep(0.05)
+        assert int(c.osds[1].ctx.conf.get("osd_recovery_max_active")) == 9
+        assert int(c.osds[0].ctx.conf.get("osd_recovery_max_active")) == 7
+
+        # config get / dump read back the persisted db
+        rc, out = client.mon_command({
+            "prefix": "config get", "who": "osd",
+            "name": "osd_recovery_max_active"})
+        assert rc == 0 and out == "7"
+        rc, out = client.mon_command({"prefix": "config dump"})
+        assert json.loads(out)["osd.1"]["osd_recovery_max_active"] == "9"
+
+        # rm retracts; daemons fall back to the type section / default
+        rc, _ = client.mon_command({
+            "prefix": "config rm", "who": "osd.1",
+            "name": "osd_recovery_max_active"})
+        assert rc == 0
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if int(c.osds[1].ctx.conf.get("osd_recovery_max_active")) == 7:
+                break
+            time.sleep(0.05)
+        assert int(c.osds[1].ctx.conf.get("osd_recovery_max_active")) == 7
+    finally:
+        c.stop()
+
+
+def test_config_survives_mon_restart(tmp_path):
+    c = MiniCluster(n_osds=1, base_path=str(tmp_path)).start()
+    try:
+        c.wait_for_osd_count(1)
+        client = c.client()
+        rc, _ = client.mon_command({
+            "prefix": "config set", "who": "global",
+            "name": "osd_heartbeat_interval", "value": "2.5"})
+        assert rc == 0
+        c.kill_mon(0)
+        c.run_mon(0)
+        # the restarted mon binds a fresh port; dial it anew (clients
+        # normally learn new monmaps from surviving quorum members)
+        rc, out = -1, ""
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                c2 = c.client()
+                rc, out = c2.mon_command({
+                    "prefix": "config get", "who": "global",
+                    "name": "osd_heartbeat_interval"})
+                if rc == 0 and out == "2.5":
+                    break
+            except (TimeoutError, OSError):
+                pass
+            time.sleep(0.2)
+        assert rc == 0 and out == "2.5"
+    finally:
+        c.stop()
+
+
+def test_health_osd_down_and_pg_degraded_transitions():
+    c = MiniCluster(n_osds=3, heartbeats=True).start()
+    try:
+        c.wait_for_osd_count(3)
+        client = c.client(timeout=20.0)
+        pool = c.create_pool(client, pg_num=8, size=3)
+        io = client.open_ioctx(pool)
+        for i in range(20):
+            io.write_full(f"h{i}", b"data" * 100)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if _health(client)["status"] == "HEALTH_OK":
+                break
+            time.sleep(0.2)
+        assert _health(client)["status"] == "HEALTH_OK"
+
+        c.kill_osd(2)
+        deadline = time.time() + 30
+        seen = set()
+        while time.time() < deadline:
+            h = _health(client)
+            seen |= _checks(h)
+            if "OSD_DOWN" in seen:
+                break
+            time.sleep(0.3)
+        assert "OSD_DOWN" in seen
+        hd = _health(client, detail=True)
+        dd = next(ch for ch in hd["checks"] if ch["check"] == "OSD_DOWN")
+        assert "osd.2 is down" in dd["detail"]
+
+        # revive: health returns to OK (degraded clears as recovery ends)
+        c.run_osd(2)
+        deadline = time.time() + 40
+        while time.time() < deadline:
+            if _health(client)["status"] == "HEALTH_OK":
+                break
+            time.sleep(0.3)
+        assert _health(client)["status"] == "HEALTH_OK"
+    finally:
+        c.stop()
+
+
+def test_health_mon_down():
+    c = MiniCluster(n_osds=1, n_mons=3).start()
+    try:
+        c.wait_for_osd_count(1)
+        client = c.client(timeout=20.0)
+        assert _health(client)["status"] == "HEALTH_OK"
+        c.kill_mon(2)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                h = _health(client)
+            except (TimeoutError, OSError):
+                time.sleep(0.3)
+                continue
+            if "MON_DOWN" in _checks(h):
+                break
+            time.sleep(0.3)
+        assert "MON_DOWN" in _checks(_health(client))
+    finally:
+        c.stop()

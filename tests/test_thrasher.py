@@ -13,6 +13,10 @@ def test_thrasher_soak(tmp_path):
     assert res["corruptions"] == [], res
     assert res["lost_rep"] == [], res
     assert res["lost_ec"] == [], res
+    # structured health transitioned during the storm and recovered
+    assert "HEALTH_WARN" in res["health_seen"], res["health_seen"]
+    assert "OSD_DOWN" in res["health_seen"], res["health_seen"]
+    assert res["final_health"] == "HEALTH_OK", res["final_health"]
 
 
 def test_thrasher_soak_torn_ec_write_seed(tmp_path):
