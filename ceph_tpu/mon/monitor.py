@@ -890,6 +890,12 @@ class Monitor(Dispatcher):
         who = str(cmd.get("who", "global"))
         name = str(cmd["name"])
         value = str(cmd["value"])
+        # reject unknown option names up front (the reference's config
+        # set does): a typo silently persisted-but-never-applied is the
+        # worst operator experience
+        from ceph_tpu.common.config import OPTIONS
+        if name not in OPTIONS:
+            return f"unknown config option {name!r}", -22
 
         def fn(m: OSDMap):
             sec = m.config_db.setdefault(who, {})

@@ -21,6 +21,7 @@ device, exactly the sharded-encode layout of parallel/sharded.py.
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 
@@ -32,20 +33,53 @@ _MARKER = b"\x00ICI\x00"
 
 
 class IciTransport:
-    """Process-wide staged-buffer registry (the 'wire' is device HBM)."""
+    """Process-wide staged-buffer registry (the 'wire' is device HBM).
+
+    Lifecycle hardening: every staged buffer carries a deadline.  A
+    buffer nobody redeems (its frame was dropped with a dying daemon)
+    reaps after TTL seconds — device memory cannot leak to lost
+    messages.  A REDEEMED buffer lingers for GRACE seconds before
+    reaping, so a stateful connection resending its backlog (frames
+    already delivered once) can redeem the same token again instead of
+    erroring; after the grace the resent frame is dropped like any
+    transport loss and the op-level retry repairs it."""
 
     _instance = None
     _lock = threading.Lock()
+
+    #: seconds an unredeemed staged buffer survives (message lost)
+    TTL = 30.0
+    #: seconds a redeemed buffer stays redeemable (resend window)
+    GRACE = 10.0
 
     def __init__(self):
         import jax
         self.jax = jax
         self.devices = jax.devices()
-        self._bufs: dict[int, object] = {}
+        self._bufs: dict[int, dict] = {}
         self._seq = 0
         self._reg_lock = threading.Lock()
-        self.bytes_staged = 0
-        self.transfers = 0
+        self.bytes_staged = 0      # cumulative
+        self.transfers = 0         # cumulative
+    # gauge: currently staged, unredeemed
+
+    def outstanding(self) -> tuple[int, int]:
+        """(buffers, bytes) staged and not yet redeemed (after a reap)."""
+        now = time.monotonic()
+        with self._reg_lock:
+            self._reap_locked(now)
+            live = [e for e in self._bufs.values()
+                    if e["redeemed_at"] is None]
+            return len(live), sum(e["nbytes"] for e in live)
+
+    def _reap_locked(self, now: float) -> None:
+        dead = [t for t, e in self._bufs.items()
+                if (e["redeemed_at"] is not None
+                    and now - e["redeemed_at"] > self.GRACE)
+                or (e["redeemed_at"] is None
+                    and now - e["staged_at"] > self.TTL)]
+        for t in dead:
+            del self._bufs[t]
 
     @classmethod
     def instance(cls) -> "IciTransport":
@@ -64,20 +98,28 @@ class IciTransport:
         import jax.numpy as jnp
         arr = jnp.asarray(np.frombuffer(chunk, dtype=np.uint8))
         buf = self.jax.device_put(arr, self.device_for(peer))
+        now = time.monotonic()
         with self._reg_lock:
+            self._reap_locked(now)
             self._seq += 1
             token = self._seq
-            self._bufs[token] = buf
+            self._bufs[token] = {"buf": buf, "nbytes": len(chunk),
+                                 "staged_at": now, "redeemed_at": None}
             self.bytes_staged += len(chunk)
             self.transfers += 1
         return _MARKER + token.to_bytes(8, "little")
 
     def redeem(self, blob: bytes) -> bytes:
         token = int.from_bytes(blob[len(_MARKER):], "little")
+        now = time.monotonic()
         with self._reg_lock:
-            buf = self._bufs.pop(token, None)
+            self._reap_locked(now)
+            entry = self._bufs.get(token)
+            if entry is not None and entry["redeemed_at"] is None:
+                entry["redeemed_at"] = now
+            buf = entry["buf"] if entry is not None else None
         if buf is None:
-            raise KeyError(f"ici token {token} already redeemed")
+            raise KeyError(f"ici token {token} expired or unknown")
         return np.asarray(buf).tobytes()
 
     @staticmethod
@@ -124,6 +166,14 @@ class IciMessenger(LoopbackMessenger):
         if field is not None:
             payload = getattr(msg, field)
             if IciTransport.is_token(payload):
-                setattr(msg, field,
-                        IciTransport.instance().redeem(payload))
+                try:
+                    setattr(msg, field,
+                            IciTransport.instance().redeem(payload))
+                except KeyError:
+                    # the staged buffer expired (sender died long ago or
+                    # the resend window closed): transport loss — drop
+                    # the frame, the op-level retry resends fresh bytes
+                    from ceph_tpu.common.logging import dout
+                    dout("ms", 5, "ici: dropping frame with expired token")
+                    return True
         return super().deliver(msg)

@@ -249,7 +249,7 @@ class BlueStoreLite(ObjectStore):
         """Queue every WAL entry of an object (committed + pending) for
         deletion — overwriting or dropping a destination must not leave
         stale deferred bytes to overlay the new content."""
-        for k in self._wal_index.get(okey, []):
+        for k in self._wal_index.pop(okey, []):
             self._wal_rms.append(k)
         self._wal_pending.pop(okey, None)
         if meta is not None:
@@ -436,9 +436,10 @@ class BlueStoreLite(ObjectStore):
                 # by the SOURCE collection
                 self._fold_wal(_okey(op.cid, op.oid), m)
                 prev = get(op.dest, op.oid)
-                if prev is not None:   # overwrite: free old
+                if prev is not None:   # overwrite: free old + its WAL
                     self._freed.extend(
                         b for b in prev["extents"] if b >= 0)
+                    self._purge_wal(_okey(op.dest, op.oid), prev)
                 cache[(op.dest, op.oid)] = m
                 cache[(op.cid, op.oid)] = None
         elif op.op == OP_CLONE:

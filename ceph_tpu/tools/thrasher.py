@@ -124,7 +124,7 @@ class Thrasher:
     def __init__(self, cluster: MiniCluster, seed: int = 0,
                  min_up: int = 4, max_down: int = 1,
                  pools: dict[int, int] | None = None,
-                 pg_num_max: int = 32):
+                 pg_num_max: int = 32, thrash_mons: bool = False):
         self.cluster = cluster
         self.rng = random.Random(seed)
         self.min_up = min_up
@@ -138,6 +138,9 @@ class Thrasher:
         self.pg_nums: dict[int, int] = dict(pools or {})
         self.pgp_nums: dict[int, int] = dict(pools or {})
         self.pg_num_max = pg_num_max
+        #: mon currently killed (at most one: quorum of 3 needs 2)
+        self.thrash_mons = thrash_mons
+        self.downed_mon: int | None = None
 
     def _mon_cmd(self, cmd: dict) -> None:
         client = self.cluster.clients[0]
@@ -149,6 +152,20 @@ class Thrasher:
     def step(self) -> str:
         roll = self.rng.random()
         up = [i for i in self.cluster.osds if i not in self.downed]
+        if self.thrash_mons and len(self.cluster.mons) + (
+                1 if self.downed_mon is not None else 0) >= 3:
+            if self.downed_mon is not None and roll < 0.2:
+                mon = self.downed_mon
+                self.downed_mon = None
+                self.cluster.run_mon(mon)
+                self.actions += 1
+                return f"revive mon.{mon}"
+            if self.downed_mon is None and roll < 0.1:
+                mon = self.rng.choice(sorted(self.cluster.mons))
+                self.cluster.kill_mon(mon)
+                self.downed_mon = mon
+                self.actions += 1
+                return f"kill mon.{mon}"
         if self.pg_nums and roll < 0.15:
             pool = self.rng.choice(sorted(self.pg_nums))
             if self.pgp_nums[pool] < self.pg_nums[pool]:
@@ -197,6 +214,9 @@ class Thrasher:
 
     def heal(self) -> None:
         """Revive everything and bring every OSD back in."""
+        if self.downed_mon is not None:
+            self.cluster.run_mon(self.downed_mon)
+            self.downed_mon = None
         for osd in list(self.downed):
             self.cluster.run_osd(osd)
         self.downed.clear()
@@ -206,7 +226,9 @@ class Thrasher:
 
 
 def run_soak(duration: float = 25.0, seed: int = 7,
-             n_osds: int = 6, base_path: str = "") -> dict:
+             n_osds: int = 6, base_path: str = "",
+             ms_type: str = "loopback", n_mons: int = 1,
+             thrash_mons: bool = False) -> dict:
     """The standalone soak: returns a result dict (the pytest wrapper
     asserts).  OSDs are filestore-backed: kill_osd is PROCESS death with
     the disk surviving, like the reference Thrasher — wiping stores
@@ -215,8 +237,12 @@ def run_soak(duration: float = 25.0, seed: int = 7,
     if not base_path:
         import tempfile
         base_path = tempfile.mkdtemp(prefix="thrash-")
-    c = MiniCluster(n_osds=n_osds, ms_type="loopback",
-                    store_type="filestore",
+    ici_t = None
+    if ms_type == "ici":
+        from ceph_tpu.msg.ici import IciTransport
+        ici_t = IciTransport.instance()
+    c = MiniCluster(n_osds=n_osds, ms_type=ms_type,
+                    store_type="filestore", n_mons=n_mons,
                     base_path=base_path, heartbeats=True).start()
     try:
         c.wait_for_osd_count(n_osds)
@@ -230,7 +256,8 @@ def run_soak(duration: float = 25.0, seed: int = 7,
                       payload_scale=400)
         w1.start()
         w2.start()
-        th = Thrasher(c, seed=seed, pools={rep: 8, ec: 8})
+        th = Thrasher(c, seed=seed, pools={rep: 8, ec: 8},
+                      thrash_mons=thrash_mons)
         deadline = time.time() + duration
         log = []
         health_seen: set[str] = set()
@@ -277,10 +304,22 @@ def run_soak(duration: float = 25.0, seed: int = 7,
             time.sleep(0.5)
         bad1 = w1.final_verify(vclient)
         bad2 = w2.final_verify(vclient)
+        ici_outstanding = None
+        if ici_t is not None:
+            # staged buffers must all be redeemed or reaped: wait out
+            # the resend grace + loss TTL, then read the gauge
+            hdl = time.time() + ici_t.TTL + ici_t.GRACE + 2
+            while time.time() < hdl:
+                n, nbytes = ici_t.outstanding()
+                if n == 0:
+                    break
+                time.sleep(0.5)
+            ici_outstanding = ici_t.outstanding()
         return {
             "actions": th.actions, "log": log,
             "health_seen": sorted(health_seen),
             "final_health": final_health,
+            "ici_outstanding": ici_outstanding,
             "rep_ops": w1.ops, "ec_ops": w2.ops,
             "rep_errors": w1.errors, "ec_errors": w2.errors,
             "corruptions": w1.corruptions + w2.corruptions,

@@ -249,3 +249,40 @@ def test_clone_overwrite_purges_destination_wal(tmp_path):
         assert data[0:100] == b"\x33" * 100
     finally:
         st.umount()
+
+
+def test_coll_move_overwrite_purges_destination_wal(tmp_path):
+    """collection_move over an object with committed deferred writes
+    purges them (same contract as clone; reproduced corrupting reads)."""
+    st = create_objectstore("bluestore", str(tmp_path / "bs"))
+    st.mkfs_if_needed()
+    st.mount()
+    try:
+        st.apply_transaction(Transaction().create_collection("a")
+                             .create_collection("b"))
+        st.apply_transaction(Transaction().write("b", "o", 0,
+                                                 b"\x11" * 8192))
+        st.apply_transaction(Transaction().write("b", "o", 200,
+                                                 b"OLDWAL"))
+        st.apply_transaction(Transaction().write("a", "o", 0,
+                                                 b"\x22" * 8192))
+        st.apply_transaction(Transaction().collection_move("a", "o", "b"))
+        st.apply_transaction(Transaction().write("b", "o", 100, b"new"))
+        data = st.read("b", "o")
+        assert data[200:206] == b"\x22" * 6
+        assert data[100:103] == b"new"
+        # purge must also cover the same-batch remove+recreate+fold path
+        st.apply_transaction(Transaction().write("b", "p", 0,
+                                                 b"\x44" * 8192))
+        st.apply_transaction(Transaction().write("b", "p", 200,
+                                                 b"GHOSTS"))
+        st.apply_transaction(
+            Transaction().remove("b", "p")
+            .write("b", "p", 0, b"\x55" * 8192)
+            .write("b", "p", 100, b"ok")
+            .write("b", "p", 4096, b"\x66" * 4096))
+        data = st.read("b", "p")
+        assert data[200:206] == b"\x55" * 6
+        assert data[100:102] == b"ok"
+    finally:
+        st.umount()

@@ -264,8 +264,8 @@ class TestIciStack:
             import pytest as _pytest
             _pytest.skip("single-device backend")
         token = t.stage(b"x" * 4096, EntityName("osd", 1))
-        buf = t._bufs[int.from_bytes(token[5:], "little")]
-        assert buf.devices() == {jax.devices()[1]}
+        entry = t._bufs[int.from_bytes(token[5:], "little")]
+        assert entry["buf"].devices() == {jax.devices()[1]}
         assert t.redeem(token) == b"x" * 4096
 
 
