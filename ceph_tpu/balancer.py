@@ -109,8 +109,12 @@ def calc_pg_upmaps(osdmap: OSDMap, pool_ids: list[int] | None = None,
         while budget > 0:
             over = max(cands, key=lambda o: counts[o])
             under = min(cands, key=lambda o: counts[o])
+            # iterate until BOTH tails are inside the deviation target
+            # (OSDMap::calc_pg_upmaps loops on max deviation, with
+            # retries; stopping when either side looked fine left the
+            # other tail unbalanced)
             if counts[over] - mean <= max_deviation \
-                    or mean - counts[under] <= max_deviation:
+                    and mean - counts[under] <= max_deviation:
                 break
             moved = False
             for ps, _pos in sorted(hist.get(over, [])):
@@ -166,6 +170,44 @@ def plan_commands(osdmap: OSDMap, **kw) -> list[dict]:
             cmds.append({"prefix": "osd rm-pg-upmap-items",
                          "pgid": f"{pool_id}.{ps}"})
     return cmds
+
+
+def reweight_by_utilization(osdmap: OSDMap, oload: int = 120,
+                            max_change: float = 0.05,
+                            max_osds: int = 4) -> list[tuple[int, float]]:
+    """The classic alternative to upmap: nudge the reweight of the most
+    overloaded OSDs down (mon `osd reweight-by-utilization`,
+    OSDMonitor::reweight_by_utilization semantics with PG count standing
+    in for byte utilization).
+
+    Only OSDs loaded above oload% of the mean are touched, each by at
+    most max_change of full weight, at most max_osds per invocation —
+    the reference's gradual, bounded adjustment so one run can never
+    destabilize the cluster.  Returns [(osd, new_weight_float)] with
+    weights in [0, 1] (16.16-scaled by the caller / mon command).
+    """
+    cands = _candidate_osds(osdmap)
+    if len(cands) < 2:
+        return []
+    counts: dict[int, int] = {o: 0 for o in cands}
+    for pool_id in osdmap.pools:
+        for o, placements in pool_pg_histogram(osdmap, pool_id).items():
+            if o in counts:
+                counts[o] += len(placements)
+    mean = sum(counts.values()) / len(cands)
+    if mean <= 0:
+        return []
+    threshold = mean * oload / 100.0
+    over = sorted((o for o in cands if counts[o] > threshold),
+                  key=lambda o: -counts[o])[:max_osds]
+    out = []
+    for o in over:
+        cur = osdmap.osd_weight[o] / 0x10000
+        target = cur * mean / counts[o]
+        new = max(cur - max_change, target, 0.0)
+        if new < cur:
+            out.append((o, round(new, 4)))
+    return out
 
 
 def spread(osdmap: OSDMap, pool_id: int) -> tuple[int, int]:

@@ -97,6 +97,18 @@ register_options([
            "op scheduler: mclock (sharded QoS queue) | direct"),
     Option("osd_op_num_shards", OPT_INT, 2,
            "op queue shards (ops shard by pgid; per-PG order kept)"),
+    Option("osd_mclock_per_client", OPT_INT, 1,
+           "tag client ops per client id (dmclock client-class QoS) "
+           "instead of one aggregate client class"),
+    Option("osd_mclock_client_reservation", OPT_FLOAT, 0.0,
+           "per-client guaranteed ops/s (dmclock reservation; 0 = none)"),
+    Option("osd_mclock_client_weight", OPT_FLOAT, 100.0,
+           "per-client share of excess capacity (dmclock weight)"),
+    Option("osd_mclock_client_limit", OPT_FLOAT, 0.0,
+           "per-client ops/s cap (dmclock limit; 0 = unlimited)"),
+    Option("osd_op_queue_max_client_backlog", OPT_INT, 512,
+           "client ops queued per shard before dispatch backpressure "
+           "blocks the intake (peer/recovery classes are never gated)"),
     Option("osd_max_backfills", OPT_INT, 1,
            "PGs an osd recovers concurrently (reservation slots)"),
     Option("osd_recovery_max_active", OPT_INT, 3,

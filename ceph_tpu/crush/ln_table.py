@@ -23,8 +23,10 @@ load-bearing ways that changed placement forever once deployed:
 
 The deviations are reproduced here as explicit override data with the indices spelled
 out, because matching deployed-placement behaviour requires them.  (Verified
-programmatically against the reference checkout during development; see
-tests/test_crush_ln.py golden vectors.)
+programmatically against the reference checkout during development; the
+exhaustive 16-bit validation lives in tests/test_crush_kernel.py
+test_crush_ln_exhaustive_16bit and the range/monotonicity golden checks
+in tests/test_crush_ref.py.)
 """
 
 from __future__ import annotations

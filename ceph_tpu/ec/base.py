@@ -147,10 +147,18 @@ class ErasureCode(ErasureCodeInterface):
         return {i: allc[i] for i in want_to_encode}
 
     def encode_chunks(self, data_chunks):
-        """(S, k, B) uint8 -> (S, m, B) uint8 on the selected runtime."""
+        """(S, k, B) uint8 -> (S, m, B) uint8 on the selected runtime.
+
+        runtime "tpu" runs the batched MXU kernel, "native" the in-repo
+        single-core C SIMD encode (the ISA-L-class plugin proper — same
+        role as the reference's isa plugin on hosts without the device),
+        and "cpu" the numpy oracle (verification)."""
         coding = self.generator[self.k:]
         if self.runtime == "cpu":
             return ec_encode_ref(coding, np.asarray(data_chunks))
+        if self.runtime == "native":
+            from ceph_tpu.native import ec_encode_native
+            return ec_encode_native(coding, np.asarray(data_chunks))
         if self._encoder is None:
             from ceph_tpu.ops.gf_kernel import make_encoder
             self._encoder = make_encoder(coding)
@@ -173,6 +181,9 @@ class ErasureCode(ErasureCodeInterface):
         rmat = self._recovery(tuple(chosen), tuple(targets))
         if self.runtime == "cpu":
             return ec_encode_ref(rmat, np.asarray(chunks))
+        if self.runtime == "native":
+            from ceph_tpu.native import ec_encode_native
+            return ec_encode_native(rmat, np.asarray(chunks))
         from ceph_tpu.ops.gf_kernel import ec_encode_jax
         return ec_encode_jax(rmat, np.asarray(chunks, dtype=np.uint8))
 

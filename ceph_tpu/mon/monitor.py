@@ -626,6 +626,24 @@ class Monitor(Dispatcher):
                 return self._cmd_pool_set(cmd)
             if prefix == "osd tree":
                 return json.dumps(self._cmd_tree()), 0
+            if prefix == "osd reweight":
+                w = float(cmd["weight"])
+                if not 0.0 <= w <= 1.0:
+                    return "weight must be in [0, 1]", -22
+                return self._cmd_osd_weight(int(cmd["id"]),
+                                            int(w * 0x10000))
+            if prefix == "osd reweight-by-utilization":
+                from ceph_tpu.balancer import reweight_by_utilization
+                plan = reweight_by_utilization(
+                    self.osdmap, oload=int(cmd.get("oload", 120)))
+
+                def fn(m: OSDMap):
+                    for o, w in plan:
+                        m.osd_weight[o] = int(w * 0x10000)
+                if plan and not self._mutate(fn):
+                    return "commit failed", -11
+                return json.dumps({"reweighted": [
+                    {"osd": o, "weight": w} for o, w in plan]}), 0
             if prefix == "osd out":
                 return self._cmd_osd_weight(int(cmd["id"]), 0)
             if prefix == "osd in":
@@ -896,6 +914,11 @@ class Monitor(Dispatcher):
         from ceph_tpu.common.config import OPTIONS
         if name not in OPTIONS:
             return f"unknown config option {name!r}", -22
+        try:
+            OPTIONS[name].cast(value)
+        except (ValueError, TypeError):
+            return (f"invalid value {value!r} for {name!r} "
+                    f"({OPTIONS[name].type})"), -22
 
         def fn(m: OSDMap):
             sec = m.config_db.setdefault(who, {})

@@ -265,12 +265,24 @@ class OSDDaemon(Dispatcher):
         # osd/mClock*): ops shard by pgid, classes arbitrate by
         # reservation/weight/limit.  One worker per shard keeps per-PG
         # FIFO order.  "direct" executes on dispatch threads (legacy).
-        from ceph_tpu.osd.op_queue import ShardedOpQueue
+        from ceph_tpu.osd.op_queue import ClassInfo, ShardedOpQueue
         self._use_opwq = str(self.ctx.conf.get("osd_op_queue")) == "mclock"
+        self._mclock_per_client = bool(int(
+            self.ctx.conf.get("osd_mclock_per_client")))
         self.opwq = (ShardedOpQueue(
             self._opwq_handle,
             n_shards=int(self.ctx.conf.get("osd_op_num_shards")),
-            name=f"osd.{osd_id}") if self._use_opwq else None)
+            name=f"osd.{osd_id}",
+            client_template=ClassInfo(
+                reservation=float(self.ctx.conf.get(
+                    "osd_mclock_client_reservation")),
+                weight=float(self.ctx.conf.get(
+                    "osd_mclock_client_weight")),
+                limit=float(self.ctx.conf.get(
+                    "osd_mclock_client_limit"))),
+            max_client_backlog=int(self.ctx.conf.get(
+                "osd_op_queue_max_client_backlog")))
+            if self._use_opwq else None)
 
         # recovery reservations (AsyncReserver / osd_max_backfills): a PG
         # needs a slot before pulling; pulls run in a bounded window
@@ -308,6 +320,14 @@ class OSDDaemon(Dispatcher):
             handler(msg)
         finally:
             self._op_throttle.put(cost)
+
+    def _client_class(self, msg) -> str:
+        """dmclock class for a client op: per-client tag streams when
+        osd_mclock_per_client is on (mClockClientQueue), else one
+        aggregate class (mClockOpClassQueue)."""
+        if self._mclock_per_client:
+            return f"client.{getattr(msg, 'client_id', 0)}"
+        return "client"
 
     @staticmethod
     def _op_cost(msg) -> int:
@@ -1590,7 +1610,8 @@ class OSDDaemon(Dispatcher):
         # items shard by pgid and ride the mClock scheduler; replies and
         # control-plane traffic dispatch inline (ms_fast_dispatch)
         if isinstance(msg, MOSDOp):
-            self._enqueue_op("client", msg.pgid, self._handle_op, msg)
+            self._enqueue_op(self._client_class(msg), msg.pgid,
+                             self._handle_op, msg)
             return True
         if isinstance(msg, MOSDRepOp):
             self._enqueue_op("subop", msg.pgid, self._handle_rep_op, msg)
@@ -1739,7 +1760,8 @@ class OSDDaemon(Dispatcher):
                 self._reply_err(m, fail_rc)
             else:
                 m._tier_checked = True
-                self._enqueue_op("client", m.pgid, self._handle_op, m)
+                self._enqueue_op(self._client_class(m), m.pgid,
+                                 self._handle_op, m)
 
     def _do_flush(self, pgid, oid: str, base_pool: int,
                   evict_only: bool) -> None:

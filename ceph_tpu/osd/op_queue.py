@@ -67,22 +67,51 @@ class _ClassState:
 
 
 class MClockQueue:
-    """Single-shard mClock scheduler over named op classes."""
+    """Single-shard mClock scheduler over named op classes.
 
-    def __init__(self, classes: dict[str, ClassInfo] | None = None):
+    Client ops may be tagged per client ("client.<id>" class names,
+    mClockClientQueue analog): each client gets its own dmclock tag
+    stream from the ``client_template`` (reservation/weight/limit), so
+    one chatty client cannot starve the rest — the per-client
+    reservations/limits the reference's dmclock client queue provides.
+    Idle per-client classes are pruned so the table stays bounded."""
+
+    #: idle per-client classes older than this are dropped
+    CLIENT_IDLE_PRUNE = 60.0
+
+    def __init__(self, classes: dict[str, ClassInfo] | None = None,
+                 client_template: ClassInfo | None = None):
         self._classes: dict[str, _ClassState] = {}
         for name, info in (classes or DEFAULT_CLASSES).items():
             self._classes[name] = _ClassState(info=info)
+        self.client_template = client_template
+        self._client_last_seen: dict[str, float] = {}
         self._len = 0
 
     def __len__(self) -> int:
         return self._len
 
+    def class_backlog(self, prefix: str) -> int:
+        """Queued items across classes matching the prefix."""
+        return sum(len(st.q) for n, st in self._classes.items()
+                   if n == prefix or n.startswith(prefix + "."))
+
     def enqueue(self, klass: str, item, now: float | None = None) -> None:
         now = time.monotonic() if now is None else now
         st = self._classes.get(klass)
         if st is None:
-            st = self._classes[klass] = _ClassState(info=ClassInfo())
+            if klass.startswith("client.") and self.client_template:
+                info = ClassInfo(
+                    reservation=self.client_template.reservation,
+                    weight=self.client_template.weight,
+                    limit=self.client_template.limit)
+            else:
+                info = ClassInfo()
+            st = self._classes[klass] = _ClassState(info=info)
+        if klass.startswith("client."):
+            self._client_last_seen[klass] = now
+            if len(self._client_last_seen) % 64 == 0:
+                self._prune_clients(now)
         i = st.info
         if not st.q:
             # idle class: tags restart from now (dmclock idle reset)
@@ -91,6 +120,14 @@ class MClockQueue:
             st.l_tag = now + (1.0 / i.limit if i.limit else 0.0)
         st.q.append(item)
         self._len += 1
+
+    def _prune_clients(self, now: float) -> None:
+        stale = [n for n, seen in self._client_last_seen.items()
+                 if now - seen > self.CLIENT_IDLE_PRUNE
+                 and not self._classes[n].q]
+        for n in stale:
+            del self._classes[n]
+            del self._client_last_seen[n]
 
     def _advance(self, st: _ClassState, now: float) -> None:
         i = st.info
@@ -139,14 +176,22 @@ class ShardedOpQueue:
     def __init__(self, handler, n_shards: int = 2,
                  n_workers_per_shard: int = 1,
                  classes: dict[str, ClassInfo] | None = None,
-                 name: str = "osd"):
+                 name: str = "osd",
+                 client_template: ClassInfo | None = None,
+                 max_client_backlog: int = 0):
         self._handler = handler
         self._n = max(1, n_shards)
         self._shards = []
         self._stop = False
+        #: client-intake cap per shard (0 = unbounded): enqueue of a
+        #: "client" / "client.N" op BLOCKS while the shard's client
+        #: backlog is at the cap — dispatch-side backpressure, while
+        #: peer/recovery classes always flow (the reference gates client
+        #: intake with throttles end-to-end; sub-ops must not deadlock)
+        self.max_client_backlog = max_client_backlog
         self._threads: list[threading.Thread] = []
         for s in range(self._n):
-            q = MClockQueue(classes)
+            q = MClockQueue(classes, client_template=client_template)
             cv = threading.Condition()
             self._shards.append((q, cv))
             for w in range(max(1, n_workers_per_shard)):
@@ -159,6 +204,12 @@ class ShardedOpQueue:
     def enqueue(self, shard_key, klass: str, item) -> None:
         q, cv = self._shards[hash(shard_key) % self._n]
         with cv:
+            if self.max_client_backlog and (
+                    klass == "client" or klass.startswith("client.")):
+                while (not self._stop and
+                       q.class_backlog("client")
+                       >= self.max_client_backlog):
+                    cv.wait(timeout=0.5)
             q.enqueue(klass, item)
             cv.notify()
 
@@ -180,6 +231,9 @@ class ShardedOpQueue:
                 got = q.dequeue()
             if got is None:
                 continue
+            if self.max_client_backlog:
+                with cv:
+                    cv.notify_all()   # wake intake blocked at the cap
             klass, item = got
             try:
                 self._handler(klass, item)

@@ -307,14 +307,15 @@ def run_soak(duration: float = 25.0, seed: int = 7,
         ici_outstanding = None
         if ici_t is not None:
             # staged buffers must all be redeemed or reaped: wait out
-            # the resend grace + loss TTL, then read the gauge
+            # the resend grace + loss TTL.  Keep the reading that hit
+            # zero — re-sampling could catch a buffer a still-running
+            # daemon staged a moment later
             hdl = time.time() + ici_t.TTL + ici_t.GRACE + 2
-            while time.time() < hdl:
-                n, nbytes = ici_t.outstanding()
-                if n == 0:
+            while True:
+                ici_outstanding = ici_t.outstanding()
+                if ici_outstanding[0] == 0 or time.time() >= hdl:
                     break
                 time.sleep(0.5)
-            ici_outstanding = ici_t.outstanding()
         return {
             "actions": th.actions, "log": log,
             "health_seen": sorted(health_seen),

@@ -167,3 +167,44 @@ class TestMonCommandPath:
             assert rc == -2
         finally:
             c.stop()
+
+
+def _skewed_map():
+    """A flat map with skewed CRUSH weights -> skewed PG counts."""
+    m = flat_cluster(n_osds=6, pg_num=128, size=3)
+    root = m.crush.bucket(-1)
+    root.item_weights = [0x40000, 0x10000, 0x10000, 0x10000,
+                         0x10000, 0x8000]
+    root.weight = sum(root.item_weights)
+    return m
+
+
+def test_calc_pg_upmaps_converges_both_tails():
+    """One invocation flattens BOTH tails to within max_deviation —
+    the stop condition must not quit when only one side looks fine."""
+    m = _skewed_map()
+    before = spread(m, 1)
+    changes = calc_pg_upmaps(m, max_deviation=1, max_optimizations=2048)
+    apply_changes(m, changes)
+    lo, hi = spread(m, 1)
+    assert hi - lo < before[1] - before[0]
+    assert hi - lo <= 3, (before, (lo, hi))
+
+
+def test_reweight_by_utilization():
+    from ceph_tpu.balancer import (pool_pg_histogram,
+                                   reweight_by_utilization)
+
+    m = _skewed_map()
+    plan = reweight_by_utilization(m, oload=110)
+    assert plan, "skewed map should yield reweights"
+    for o, w in plan:
+        assert 0.0 <= w < 1.0
+    # the nudged osds were genuinely the overloaded ones
+    counts = {}
+    for pool_id in m.pools:
+        for o, pl in pool_pg_histogram(m, pool_id).items():
+            counts[o] = counts.get(o, 0) + len(pl)
+    mean = sum(counts.values()) / max(1, len(counts))
+    for o, _w in plan:
+        assert counts.get(o, 0) > mean

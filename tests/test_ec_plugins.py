@@ -168,3 +168,25 @@ class TestClay:
                     planes[si]: per[planes[si]] for si in sub_idx}
             rebuilt = codec.repair(lost, helper_subchunks)
             assert rebuilt == enc[lost], f"node {lost}"
+
+
+def test_native_runtime_plugin():
+    """runtime=native drives the in-repo C SIMD kernels as a first-class
+    plugin runtime (the isa-plugin role on device-less hosts),
+    bit-identical to the oracle and to the tpu runtime."""
+    import numpy as np
+
+    from ceph_tpu.ec import registry_instance
+
+    reg = registry_instance()
+    data = bytes(range(256)) * 64
+    outs = {}
+    for runtime in ("cpu", "native"):
+        codec = reg.factory("isa", {"k": "4", "m": "2",
+                                    "technique": "cauchy",
+                                    "runtime": runtime})
+        enc = codec.encode(set(range(6)), data)
+        outs[runtime] = enc
+        dec = codec.decode({0, 3}, {i: enc[i] for i in (1, 2, 4, 5)})
+        assert dec[0] == enc[0] and dec[3] == enc[3]
+    assert outs["cpu"] == outs["native"]
