@@ -614,6 +614,30 @@ class Monitor(Dispatcher):
                 return self._cmd_config_rm(cmd)
             if prefix == "config dump":
                 return json.dumps(self.osdmap.config_db), 0
+            if prefix == "auth get-or-create":
+                return self._cmd_auth_get_or_create(cmd)
+            if prefix in ("auth get", "auth print-key"):
+                ent = str(cmd["entity"])
+                key = self.osdmap.auth_db.get(ent)
+                if key is None:
+                    return f"no key for {ent!r}", -2
+                if prefix == "auth print-key":
+                    return key, 0
+                return self._keyring(ent, key), 0
+            if prefix == "auth ls":
+                return json.dumps(sorted(self.osdmap.auth_db)), 0
+            if prefix == "auth del":
+                ent = str(cmd["entity"])
+                if ent not in self.osdmap.auth_db:
+                    return f"no key for {ent!r}", -2
+
+                def fn(m: OSDMap):
+                    if ent not in m.auth_db:
+                        return False
+                    del m.auth_db[ent]
+                if not self._mutate(fn):
+                    return "commit failed", -11
+                return "removed", 0
             if prefix == "quorum_status":
                 return json.dumps({
                     "quorum": self.quorum(),
@@ -900,6 +924,32 @@ class Monitor(Dispatcher):
         if not self._mutate(fn):
             return "commit failed", -11
         return f"pool {result[0]} created", 0
+
+    # -- auth key table (mon/AuthMonitor analog) ------------------------------
+
+    @staticmethod
+    def _keyring(entity: str, key: str) -> str:
+        """The keyring file shape `ceph auth get` emits."""
+        return f"[{entity}]\n\tkey = {key}\n"
+
+    def _cmd_auth_get_or_create(self, cmd) -> tuple[str, int]:
+        """Issue (or return the existing) key for an entity — the
+        AuthMonitor's create-or-fetch flow.  Keys are random per entity
+        and replicate through Paxos with the map."""
+        import base64
+        import os as _os
+        ent = str(cmd["entity"])
+        existing = self.osdmap.auth_db.get(ent)
+        if existing is not None:
+            return self._keyring(ent, existing), 0
+        newkey = base64.b64encode(_os.urandom(16)).decode()
+
+        def fn(m: OSDMap):
+            # another proposer may have won the race; keep the winner
+            m.auth_db.setdefault(ent, newkey)
+        if not self._mutate(fn):
+            return "commit failed", -11
+        return self._keyring(ent, self.osdmap.auth_db[ent]), 0
 
     # -- central config-db (mon/ConfigMonitor.h:13 analog) --------------------
 

@@ -353,7 +353,15 @@ class OSDDaemon(Dispatcher):
         if self.opwq is not None:
             cost = min(self._op_cost(msg), self._op_throttle.max_amount)
             self._op_throttle.get(cost)
-            self.opwq.enqueue(shard_key, klass, (handler, msg, cost))
+            if not self.opwq.enqueue(shard_key, klass,
+                                     (handler, msg, cost)):
+                # client backlog cap: refuse (no reply) — the client's
+                # timeout resend retries once the shard drains
+                self._op_throttle.put(cost)
+                trk = getattr(msg, "_trk", None)
+                if trk is not None:
+                    trk.mark_event("refused: client backlog at cap")
+                    trk.finish()
         else:
             handler(msg)
 

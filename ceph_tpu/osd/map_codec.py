@@ -196,8 +196,11 @@ def encode_osdmap(m: OSDMap) -> bytes:
         # v6: central config-db (ConfigMonitor key space)
         e.bytes(_json.dumps(m.config_db).encode() if m.config_db
                 else b"")
+        # v7: auth key table (AuthMonitor key space)
+        e.bytes(_json.dumps(m.auth_db).encode() if m.auth_db
+                else b"")
 
-    enc.versioned(6, 1, body)
+    enc.versioned(7, 1, body)
     return enc.tobytes()
 
 
@@ -256,13 +259,18 @@ def decode_osdmap(data: bytes) -> OSDMap:
         while len(xinfo) < max_osd:
             xinfo.append(OSDXInfo())
         config_db = {}
+        auth_db = {}
         if version >= 6:
             import json as _json
             blob = d.bytes()
             if blob:
                 config_db = _json.loads(blob.decode())
+            if version >= 7:
+                blob = d.bytes()
+                if blob:
+                    auth_db = _json.loads(blob.decode())
         return OSDMap(epoch=epoch, crush=crush, max_osd=max_osd,
-                      config_db=config_db,
+                      config_db=config_db, auth_db=auth_db,
                       crush_names=crush_names, osd_xinfo=xinfo,
                       osd_state=osd_state, osd_weight=osd_weight,
                       osd_primary_affinity=affinity, osd_addrs=osd_addrs,

@@ -86,6 +86,7 @@ class MClockQueue:
             self._classes[name] = _ClassState(info=info)
         self.client_template = client_template
         self._client_last_seen: dict[str, float] = {}
+        self._enq_count = 0
         self._len = 0
 
     def __len__(self) -> int:
@@ -110,13 +111,15 @@ class MClockQueue:
             st = self._classes[klass] = _ClassState(info=info)
         if klass.startswith("client."):
             self._client_last_seen[klass] = now
-            if len(self._client_last_seen) % 64 == 0:
+            self._enq_count += 1
+            if self._enq_count % 256 == 0:
                 self._prune_clients(now)
         i = st.info
         if not st.q:
-            # idle class: tags restart from now (dmclock idle reset)
+            # idle class: tags restart from now (dmclock idle reset);
+            # weight 0 is treated as the minimum share, not a crash
             st.r_tag = now + (1.0 / i.reservation if i.reservation else 0.0)
-            st.p_tag = now + 1.0 / i.weight
+            st.p_tag = now + 1.0 / max(i.weight, 1e-6)
             st.l_tag = now + (1.0 / i.limit if i.limit else 0.0)
         st.q.append(item)
         self._len += 1
@@ -135,7 +138,7 @@ class MClockQueue:
             st.r_tag = max(now, st.r_tag + 1.0 / i.reservation)
         if i.limit:
             st.l_tag = max(now, st.l_tag + 1.0 / i.limit)
-        st.p_tag = max(now, st.p_tag + 1.0 / i.weight)
+        st.p_tag = max(now, st.p_tag + 1.0 / max(i.weight, 1e-6))
 
     def dequeue(self, now: float | None = None):
         """Return (class, item) or None if empty."""
@@ -201,17 +204,27 @@ class ShardedOpQueue:
                 t.start()
                 self._threads.append(t)
 
-    def enqueue(self, shard_key, klass: str, item) -> None:
+    def enqueue(self, shard_key, klass: str, item) -> bool:
+        """Queue an item; returns False when a CLIENT op is refused at
+        the per-shard backlog cap.  Refusal (not blocking) is the
+        backpressure mechanism: the caller runs on the daemon's single
+        messenger dispatch thread, and blocking it on one wedged shard
+        would gate heartbeats, sub-ops and map updates for every healthy
+        PG.  A refused client op gets no reply; the client's timeout
+        resend retries it (and dedups against the log if it already
+        landed) — the reference's front-door throttles achieve the same
+        per-client pushback via per-connection reader blocking, which a
+        shared dispatch thread cannot afford."""
         q, cv = self._shards[hash(shard_key) % self._n]
         with cv:
-            if self.max_client_backlog and (
-                    klass == "client" or klass.startswith("client.")):
-                while (not self._stop and
-                       q.class_backlog("client")
-                       >= self.max_client_backlog):
-                    cv.wait(timeout=0.5)
+            if (self.max_client_backlog
+                    and (klass == "client" or klass.startswith("client."))
+                    and q.class_backlog("client")
+                    >= self.max_client_backlog):
+                return False
             q.enqueue(klass, item)
             cv.notify()
+        return True
 
     def shutdown(self) -> None:
         self._stop = True
@@ -231,9 +244,6 @@ class ShardedOpQueue:
                 got = q.dequeue()
             if got is None:
                 continue
-            if self.max_client_backlog:
-                with cv:
-                    cv.notify_all()   # wake intake blocked at the cap
             klass, item = got
             try:
                 self._handler(klass, item)

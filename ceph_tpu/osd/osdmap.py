@@ -131,6 +131,9 @@ class OSDMap:
     #: ("global" / "osd" / "osd.3" / "mon" ...) -> {option: value-str};
     #: replicated with the map, applied by daemons via config observers
     config_db: dict = field(default_factory=dict)
+    #: auth key table (mon/AuthMonitor analog): entity ("client.admin",
+    #: "osd.3", ...) -> base64 key; issued by `auth get-or-create`
+    auth_db: dict = field(default_factory=dict)
     # overrides
     pg_upmap: dict[tuple[int, int], list[int]] = field(default_factory=dict)
     pg_upmap_items: dict[tuple[int, int], list[tuple[int, int]]] = \

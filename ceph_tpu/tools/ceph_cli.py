@@ -23,6 +23,11 @@ COMMANDS = {
     ("config", "get"): ["who", "name"],
     ("config", "rm"): ["who", "name"],
     ("config", "dump"): [],
+    ("auth", "get-or-create"): ["entity"],
+    ("auth", "get"): ["entity"],
+    ("auth", "print-key"): ["entity"],
+    ("auth", "ls"): [],
+    ("auth", "del"): ["entity"],
     ("quorum_status",): [],
     ("osd", "tree"): [],
     ("osd", "getmap"): [],
@@ -32,6 +37,8 @@ COMMANDS = {
     ("osd", "pool", "rmsnap"): [],
     ("osd", "getcrushmap"): [],
     ("osd", "setcrushmap"): [],
+    ("osd", "reweight"): ["id", "weight"],
+    ("osd", "reweight-by-utilization"): [],
     ("osd", "out"): ["id"],
     ("osd", "in"): ["id"],
     ("osd", "down"): ["id"],

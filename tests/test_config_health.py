@@ -175,3 +175,55 @@ def test_health_mon_down():
         assert "MON_DOWN" in _checks(_health(client))
     finally:
         c.stop()
+
+
+def test_auth_key_management(tmp_path):
+    """AuthMonitor analog: get-or-create issues a stable random key,
+    replicated through Paxos, surviving mon restart; ls/del round out
+    the table."""
+    c = MiniCluster(n_osds=1, base_path=str(tmp_path)).start()
+    try:
+        c.wait_for_osd_count(1)
+        client = c.client()
+        rc, kr = client.mon_command({"prefix": "auth get-or-create",
+                                     "entity": "client.alice"})
+        assert rc == 0 and kr.startswith("[client.alice]"), kr
+        key = kr.split("key = ")[1].strip()
+        # idempotent: same key back
+        rc, kr2 = client.mon_command({"prefix": "auth get-or-create",
+                                      "entity": "client.alice"})
+        assert rc == 0 and kr2 == kr
+        rc, pk = client.mon_command({"prefix": "auth print-key",
+                                     "entity": "client.alice"})
+        assert rc == 0 and pk == key
+        client.mon_command({"prefix": "auth get-or-create",
+                            "entity": "osd.5"})
+        rc, out = client.mon_command({"prefix": "auth ls"})
+        assert rc == 0 and json.loads(out) == ["client.alice", "osd.5"]
+
+        # persists across mon restart
+        c.kill_mon(0)
+        c.run_mon(0)
+        deadline = time.time() + 15
+        pk2 = None
+        while time.time() < deadline:
+            try:
+                rc, pk2 = c.client().mon_command(
+                    {"prefix": "auth print-key", "entity": "client.alice"})
+                if rc == 0:
+                    break
+            except (TimeoutError, OSError):
+                pass
+            time.sleep(0.2)
+        assert pk2 == key
+
+        c2 = c.client()
+        rc, _ = c2.mon_command({"prefix": "auth del",
+                                "entity": "osd.5"})
+        assert rc == 0
+        rc, out = c2.mon_command({"prefix": "auth ls"})
+        assert json.loads(out) == ["client.alice"]
+        rc, _ = c2.mon_command({"prefix": "auth get", "entity": "osd.5"})
+        assert rc == -2
+    finally:
+        c.stop()
