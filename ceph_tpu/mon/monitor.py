@@ -285,9 +285,11 @@ class Monitor(Dispatcher):
                 return
             self.osdmap = newmap
             subs = list(self._subs.values())
+        # never fan the paxos value out: it carries the auth key table
+        pub = encode_osdmap(newmap)
         for addr, entity in subs:
             con = self.msgr.connect_to(addr, entity)
-            con.send_message(MOSDMapMsg(epoch=newmap.epoch, map_blob=blob))
+            con.send_message(MOSDMapMsg(epoch=newmap.epoch, map_blob=pub))
 
     def _schedule_tick(self) -> None:
         if self._stop:
@@ -341,11 +343,14 @@ class Monitor(Dispatcher):
         if not self.is_leader():
             return False
         with self._lock:
-            m = decode_osdmap(encode_osdmap(self.osdmap))
+            m = decode_osdmap(encode_osdmap(self.osdmap, with_auth=True))
         if fn(m) is False:
             return True  # nothing to do
         m.epoch += 1
-        blob = encode_osdmap(m)
+        # the paxos value is mon-internal: it is the ONE encoding that
+        # carries the auth key table (peons/restarts restore it from
+        # here); every client/OSD-facing broadcast re-encodes stripped
+        blob = encode_osdmap(m, with_auth=True)
         return self.paxos.propose_and_wait(blob)
 
     def _do_bootstrap(self) -> None:

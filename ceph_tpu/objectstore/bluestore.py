@@ -235,7 +235,13 @@ class BlueStoreLite(ObjectStore):
         if not meta.get("wal_n"):
             return []
         out = []
+        # keys this batch already queued for deletion (a purge from an
+        # overwrite/remove earlier in the SAME batch) are dead: a
+        # recreated object at the same okey must not overlay them
+        dead = set(self._wal_rms)
         for k in self._wal_index.get(okey, []):
+            if k in dead:
+                continue
             v = self._db.get("wal", k)
             if v is None:
                 continue
@@ -248,8 +254,12 @@ class BlueStoreLite(ObjectStore):
     def _purge_wal(self, okey: str, meta: dict | None) -> None:
         """Queue every WAL entry of an object (committed + pending) for
         deletion — overwriting or dropping a destination must not leave
-        stale deferred bytes to overlay the new content."""
-        for k in self._wal_index.pop(okey, []):
+        stale deferred bytes to overlay the new content.  _wal_index is
+        NOT touched here: all index maintenance happens after the KV
+        commit lands, so ANY pre-commit failure (a later op in the
+        batch, the fsync, the KV submit itself) leaves committed
+        deferred writes readable — nothing was deleted."""
+        for k in self._wal_index.get(okey, []):
             self._wal_rms.append(k)
         self._wal_pending.pop(okey, None)
         if meta is not None:

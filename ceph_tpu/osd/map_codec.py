@@ -145,7 +145,13 @@ def decode_crush(dec: Decoder) -> CrushMap:
 
 # -- osdmap -----------------------------------------------------------------
 
-def encode_osdmap(m: OSDMap) -> bytes:
+def encode_osdmap(m: OSDMap, *, with_auth: bool = False) -> bytes:
+    """with_auth gates the AuthMonitor key table: ONLY the mon-internal
+    paxos value / mon store carries it (reference: auth key material
+    lives in the AuthMonitor's own paxos service, never in the OSDMap
+    clients subscribe to).  Every broadcast path — MOSDMapMsg fan-out,
+    subscription replies, OSD maybe_share_map — uses the default
+    stripped form, so no client ever sees another entity's secret."""
     enc = Encoder()
 
     def body(e: Encoder):
@@ -196,9 +202,9 @@ def encode_osdmap(m: OSDMap) -> bytes:
         # v6: central config-db (ConfigMonitor key space)
         e.bytes(_json.dumps(m.config_db).encode() if m.config_db
                 else b"")
-        # v7: auth key table (AuthMonitor key space)
-        e.bytes(_json.dumps(m.auth_db).encode() if m.auth_db
-                else b"")
+        # v7: auth key table (AuthMonitor key space) — mon-internal only
+        e.bytes(_json.dumps(m.auth_db).encode()
+                if (with_auth and m.auth_db) else b"")
 
     enc.versioned(7, 1, body)
     return enc.tobytes()

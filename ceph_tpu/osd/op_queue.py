@@ -176,6 +176,10 @@ class ShardedOpQueue:
     and one stuck PG only wedges its shard (ShardedOpWQ semantics).
     """
 
+    #: tagged clients together may queue up to this many times the
+    #: per-client cap before the shard refuses all client intake
+    CLIENT_AGGREGATE_FACTOR = 16
+
     def __init__(self, handler, n_shards: int = 2,
                  n_workers_per_shard: int = 1,
                  classes: dict[str, ClassInfo] | None = None,
@@ -218,10 +222,25 @@ class ShardedOpQueue:
         q, cv = self._shards[hash(shard_key) % self._n]
         with cv:
             if (self.max_client_backlog
-                    and (klass == "client" or klass.startswith("client."))
-                    and q.class_backlog("client")
-                    >= self.max_client_backlog):
-                return False
+                    and (klass == "client" or klass.startswith("client."))):
+                # with per-client tagging the cap is PER CLIENT class:
+                # one chatty client hitting its cap must not refuse every
+                # other client's intake (that would re-create exactly the
+                # head-of-line blocking the per-client dmclock tags
+                # remove); untagged "client" ops keep the aggregate cap.
+                # A larger aggregate ceiling still bounds total shard
+                # memory — without it N distinct client ids could queue
+                # N x cap items between them
+                if (klass.startswith("client.")
+                        and q.class_backlog(klass)
+                        >= self.max_client_backlog):
+                    return False
+                total_cap = (self.max_client_backlog
+                             if klass == "client"
+                             else self.max_client_backlog
+                             * self.CLIENT_AGGREGATE_FACTOR)
+                if q.class_backlog("client") >= total_cap:
+                    return False
             q.enqueue(klass, item)
             cv.notify()
         return True

@@ -200,6 +200,11 @@ class Image:
         elif op == "snap_remove":
             if event["snap"] in self.snap_list():
                 self._snap_remove_internal(event["snap"])
+        elif op == "snap_rollback":
+            # the target rolls back against ITS copy of the snapshot
+            # (created by the replayed snap_create at the same journal
+            # position, so contents match the primary's at rollback time)
+            self._snap_rollback_internal(event["snap"])
         else:
             raise ValueError(f"unknown journal event {op!r}")
 
@@ -326,13 +331,23 @@ class Image:
 
     def snap_rollback(self, snap: str) -> None:
         """Restore image content to the snapshot (rbd snap rollback —
-        object-by-object copy-back, librbd's simple_rollback)."""
+        object-by-object copy-back, librbd's simple_rollback).  On a
+        journaled image the rollback is journaled like any other mutation
+        (write-ahead, before the data moves): the mirror replays it
+        against its own replicated snapshot, so the pair stays converged
+        instead of silently diverging on an unjournaled full rewrite."""
         self._check_primary()
+        if snap not in self._load().get("snaps", {}):
+            raise KeyError(f"no snapshot {snap!r}")
+        self._check_lock()
+        self._journal_event({"op": "snap_rollback", "snap": snap})
+        self._snap_rollback_internal(snap)
+
+    def _snap_rollback_internal(self, snap: str) -> None:
         m = self._load()
         ent = m.get("snaps", {}).get(snap)
         if ent is None:
             raise KeyError(f"no snapshot {snap!r}")
-        self._check_lock()
         data = self.read(0, ent["size"], snap=snap)
         st = self._striped()
         st.truncate(0)

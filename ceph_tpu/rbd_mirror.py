@@ -23,6 +23,7 @@ a demoted (non-primary) copy of the image:
 
 from __future__ import annotations
 
+import binascii
 import json
 
 from ceph_tpu.osdc.journaler import Journaler
@@ -51,6 +52,61 @@ class MirrorDaemon:
     def _save_position(self, name: str, pos: int) -> None:
         self.dst.set_omap(self.STATE_FMT.format(name=name),
                           {"pos": str(pos).encode()})
+
+    # -- resync flag (rbd mirror image resync analog) -------------------------
+
+    def needs_resync(self, name: str) -> bool:
+        try:
+            omap = self.dst.get_omap(self.STATE_FMT.format(name=name))
+        except OSError:
+            return False
+        return omap.get("needs_resync", b"0") == b"1"
+
+    def _mark_resync(self, name: str) -> None:
+        self.dst.set_omap(self.STATE_FMT.format(name=name),
+                          {"needs_resync": b"1"})
+
+    def resync_image(self, name: str) -> None:
+        """Re-bootstrap the mirror copy from the primary and clear the
+        resync flag (rbd mirror image resync).  A true re-bootstrap:
+        the mirror's snapshots and data are discarded, the primary's
+        snapshot HISTORY is rebuilt in snapid order (content at each
+        snap copied, then snapped), and finally the head content lands.
+        The journal position snapshots BEFORE the copy: events appended
+        during it replay afterwards (idempotent), events before it are
+        superseded by the copied state."""
+        src_img = Image(self.src, name)
+        dst_img = self._mirror_image(name, src_img)
+        if dst_img.is_primary():
+            raise OSError(16, f"cannot resync promoted image {name!r}")
+        j = Journaler(self.src, Image.JOURNAL_FMT.format(name=name))
+        j.open()
+        pos = j.write_pos
+
+        def copy_state(size: int, snap: str | None) -> None:
+            # zero slate first: truncating to 0 discards stale mirror
+            # bytes, so skipped all-zero chunks really read back zero
+            dst_img.mirror_apply({"op": "resize", "size": 0})
+            dst_img.mirror_apply({"op": "resize", "size": size})
+            step = 1 << 22
+            for off in range(0, size, step):
+                chunk = src_img.read(off, min(step, size - off),
+                                     snap=snap)
+                if chunk.rstrip(b"\x00"):
+                    dst_img.mirror_apply({
+                        "op": "write", "off": off,
+                        "data": binascii.hexlify(chunk).decode()})
+
+        for snap in list(dst_img.snap_list()):
+            dst_img.mirror_apply({"op": "snap_remove", "snap": snap})
+        for snap, ent in sorted(src_img.snap_list().items(),
+                                key=lambda kv: kv[1]["snapid"]):
+            copy_state(ent["size"], snap)
+            dst_img.mirror_apply({"op": "snap_create", "snap": snap})
+        copy_state(src_img.stat()["size"], None)
+        self.dst.set_omap(self.STATE_FMT.format(name=name),
+                          {"pos": str(pos).encode(),
+                           "needs_resync": b"0"})
 
     # -- replay ---------------------------------------------------------------
 
@@ -81,6 +137,10 @@ class MirrorDaemon:
             # split-brain guard: never replay onto a promoted image
             # (rbd-mirror refuses and flags the pair for resync)
             return 0
+        if self.needs_resync(name):
+            # a poison event already wedged this image: replay stays
+            # paused until the operator (or a caller) runs resync_image
+            return 0
         j = Journaler(self.src, Image.JOURNAL_FMT.format(name=name))
         j.open()
         start = self._position(name)
@@ -93,7 +153,17 @@ class MirrorDaemon:
             nonlocal applied
             if max_events is not None and applied >= max_events:
                 raise _Stop()
-            dst_img.mirror_apply(json.loads(payload.decode()))
+            try:
+                dst_img.mirror_apply(json.loads(payload.decode()))
+            except (KeyError, ValueError):
+                # a deterministic semantic failure (e.g. rollback to a
+                # snapshot the mirror never received — one taken before
+                # journaling was enabled): retrying can never converge.
+                # Flag the image for resync and pause ITS replay; the
+                # sweep must keep serving every other image (the
+                # reference marks the pair split-brained the same way)
+                self._mark_resync(name)
+                raise _Stop()
             # position AFTER apply: a crash between the two re-applies
             # this (idempotent) event instead of skipping it
             self._save_position(name, end_pos)

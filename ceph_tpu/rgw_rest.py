@@ -127,7 +127,7 @@ _ERR_STATUS = {"NoSuchBucket": 404, "NoSuchKey": 404, "NoSuchUpload": 404,
                "BucketNotEmpty": 409, "BucketAlreadyExists": 409,
                "SignatureDoesNotMatch": 403, "AccessDenied": 403,
                "InvalidPart": 400, "MalformedXML": 400,
-               "InvalidArgument": 400}
+               "InvalidArgument": 400, "RequestTimeTooSkewed": 403}
 
 
 class S3Error(Exception):
@@ -318,6 +318,19 @@ class _Handler(BaseHTTPRequestHandler):
         amzdate = self.headers.get("x-amz-date", "")
         if not re.match(r"\d{8}T\d{6}Z$", amzdate):
             raise S3Error("AccessDenied", "missing or malformed x-amz-date")
+        # freshness: AWS rejects requests outside a ~15-minute skew
+        # window — without it any captured signature replays forever
+        skew = getattr(srv, "max_skew", 900.0)
+        if skew is not None:
+            try:
+                ts = datetime.datetime.strptime(
+                    amzdate, "%Y%m%dT%H%M%SZ").replace(
+                    tzinfo=datetime.timezone.utc).timestamp()
+            except ValueError:   # 8+6 digits but not a real timestamp
+                raise S3Error("AccessDenied", "malformed x-amz-date")
+            if abs(srv.clock() - ts) > skew:
+                raise S3Error("RequestTimeTooSkewed",
+                              "request time too skewed")
         parsed = urllib.parse.urlsplit(self.path)
         hdrs = {"host": self.headers.get("Host", ""),
                 "x-amz-date": amzdate,
@@ -475,9 +488,14 @@ class RgwRestServer:
     """
 
     def __init__(self, ioctx, addr: str = "127.0.0.1:0",
-                 compression: str = "none"):
+                 compression: str = "none",
+                 max_skew: float | None = 900.0, clock=time.time):
         self.gateway = S3Gateway(ioctx, compression=compression)
         self.keys: dict[str, str] = {}
+        #: SigV4 freshness window in seconds (AWS: 15 min); None
+        #: disables the check.  clock is injectable for tests.
+        self.max_skew = max_skew
+        self.clock = clock
         host, port = addr.rsplit(":", 1)
         self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
         self._httpd.rgw = self          # type: ignore

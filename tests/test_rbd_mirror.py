@@ -126,3 +126,76 @@ def test_failback_after_failover(two_clusters):
     home = Image(src, "fb")
     assert home.read(1024, 56) == b"failover-write" * 4
     assert home.read(0, 64) == b"original" * 8
+
+
+def test_snap_rollback_replicates(two_clusters):
+    """A journaled rollback replays on the mirror (advisor r3: an
+    unjournaled rollback silently diverged the pair forever)."""
+    src, dst = two_clusters
+    img = Image.create(src, "rb", size=1 << 16)
+    img.feature_enable(FEATURE_JOURNALING)
+    img.write(b"keep-this" * 8, 0)
+    img.snap_create("good")
+    img.write(b"SCRIBBLE!" * 8, 0)
+    md = MirrorDaemon(src, dst)
+    md.run_once(["rb"])
+    mirror = Image(dst, "rb")
+    assert mirror.read(0, 72) == b"SCRIBBLE!" * 8
+
+    img.snap_rollback("good")
+    assert img.read(0, 72) == b"keep-this" * 8
+    md.run_once(["rb"])
+    # the mirror rolled back against ITS replicated snapshot
+    assert Image(dst, "rb").read(0, 72) == b"keep-this" * 8
+    # and subsequent writes land on a converged base
+    img.write(b"after-rollback", 4096)
+    md.run_once(["rb"])
+    assert Image(dst, "rb").read(4096, 14) == b"after-rollback"
+
+
+def test_poison_event_flags_resync_not_wedge(two_clusters):
+    """A rollback to a snapshot the mirror never received (taken before
+    journaling was enabled) must not wedge replication: the image is
+    flagged for resync, other images keep replicating, and resync
+    re-bootstraps the copy."""
+    src, dst = two_clusters
+    img = Image.create(src, "poison", size=1 << 16)
+    img.write(b"pre-journal" * 4, 0)
+    img.snap_create("old")          # NOT journaled: feature off
+    img.feature_enable(FEATURE_JOURNALING)
+    img.write(b"journaled-bytes", 1024)
+    healthy = Image.create(src, "healthy", size=1 << 16)
+    healthy.feature_enable(FEATURE_JOURNALING)
+    healthy.write(b"fine", 0)
+
+    md = MirrorDaemon(src, dst)
+    md.run_once()
+    img.snap_rollback("old")        # journaled; mirror lacks "old"
+    healthy.write(b"more", 512)
+    out = md.run_once()
+    # the healthy image replicated; the poisoned one flagged, not raised
+    assert out["healthy"] == 1
+    assert md.needs_resync("poison")
+    assert Image(dst, "healthy").read(512, 4) == b"more"
+    # paused until resync: further sweeps apply nothing to it
+    assert md.run_once()["poison"] == 0
+
+    md.resync_image("poison")
+    assert not md.needs_resync("poison")
+    assert Image(dst, "poison").read(0, 44) == b"pre-journal" * 4
+    # the journaled write that replicated pre-rollback is stale mirror
+    # state now (the primary rolled it back): resync must have wiped it
+    assert Image(dst, "poison").read(1024, 14) == bytes(14)
+    # resync rebuilt the snapshot history: a LATER rollback to the
+    # once-missing snap now replays instead of re-poisoning the pair
+    assert "old" in Image(dst, "poison").snap_list()
+    img.write(b"scribble", 0)
+    md.run_once()
+    img.snap_rollback("old")
+    md.run_once()
+    assert not md.needs_resync("poison")
+    assert Image(dst, "poison").read(0, 44) == b"pre-journal" * 4
+    # replication resumes normally after resync
+    img.write(b"back-in-business", 2048)
+    md.run_once()
+    assert Image(dst, "poison").read(2048, 16) == b"back-in-business"
