@@ -1,10 +1,29 @@
 """CephFS client (src/client/Client.cc + ceph-fuse surface, lite).
 
 Path operations go to the MDS over MClientRequest/MClientReply; file
-DATA never touches the MDS — it stripes straight into the data pool
-via the Striper, named by inode number, and the client reports the new
-size back with a setattr (standing in for the reference's size-tracking
-client caps).
+DATA never touches the MDS — it stripes straight into the data pool via
+the Striper, named by inode number.
+
+Coherence rides client capabilities (Client.cc's cap handling against
+mds/Locker.cc, reduced to the same observable contract):
+
+  * the client opens a SESSION with the MDS (MClientSession) and renews
+    it on a timer; a client that dies is evicted and its caps/locks
+    evaporate server-side
+  * open() asks for cap bits (rd / rd|wr|cache|buffer); the MDS grants
+    what the sharing situation allows
+  * holding BUFFER, writes are buffered locally (dirty extents + size)
+    and flushed lazily; holding CACHE, attrs are trusted from cache
+  * an MClientCaps revoke makes the client FLUSH dirty data before
+    acking — that ordering is what makes a second client's stat/read
+    see the first client's buffered writes (POSIX coherence)
+  * without CACHE (sync mode: mixed readers+writers), every read
+    refreshes attrs from the MDS and every write reports its size —
+    exactly the reference's synchronous-I/O lock state
+
+File locks (flock / fcntl ranges) are MDS-arbitrated via setlk/getlk/
+flock ops; blocking requests park server-side until the conflicting
+lock drops.
 
     fs = CephFS(mon_addr, mds_addr); fs.mount()
     fs.mkdir("/a"); f = fs.open("/a/hello", "w"); f.write(b"hi"); f.close()
@@ -14,12 +33,40 @@ client caps).
 from __future__ import annotations
 
 import threading
+import time
 
 from ceph_tpu.client.rados import RadosClient
-from ceph_tpu.mds.server import MClientReply, MClientRequest
+from ceph_tpu.mds.caps import BUFFER, CACHE, WANT_READ, WANT_WRITE, WR
+from ceph_tpu.mds.flock import F_RDLCK, F_UNLCK, F_WRLCK
+from ceph_tpu.mds.server import (
+    MClientCaps, MClientReply, MClientRequest, MClientSession)
 from ceph_tpu.msg.messenger import (
     ConnectionPolicy, Dispatcher, EntityName, Messenger)
 from ceph_tpu.osdc.striper import StripeLayout, StripedObject
+
+#: dirty buffered bytes per inode before a forced writeback
+MAX_DIRTY = 4 << 20
+
+
+class _CapState:
+    """Per-inode client cap state (Client::Inode + CapSnap, lite)."""
+
+    __slots__ = ("ino", "caps", "inode", "attr_fresh", "size", "mtime",
+                 "dirty", "dirty_bytes", "nopen", "wb_lock")
+
+    def __init__(self, ino: int):
+        self.ino = ino
+        self.caps = 0
+        self.inode: dict = {}
+        self.attr_fresh = False
+        self.size = 0
+        self.mtime = 0.0
+        self.dirty: list[tuple[int, bytes]] = []   # buffered writes
+        self.dirty_bytes = 0
+        self.nopen = 0
+        #: serializes writebacks so two flushers can never reorder
+        #: overlapping extents (older batch landing over a newer one)
+        self.wb_lock = threading.Lock()
 
 
 class CephFS(Dispatcher):
@@ -31,15 +78,30 @@ class CephFS(Dispatcher):
         self.rados = RadosClient(mon_addr, ms_type=ms_type,
                                  auth_key=auth_key)
         cid = client_id if client_id is not None else self.rados.client_id
+        self.client_id = cid
         self.name = EntityName("client", 10000 + cid)
         self.msgr = Messenger.create(self.name, ms_type)
         self.msgr.set_auth(auth_key)
         self.msgr.set_policy("mds", ConnectionPolicy.stateful_peer())
         self.msgr.add_dispatcher_tail(self)
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         self._next_tid = 1
         self._waiters: dict[int, tuple[threading.Event, list]] = {}
         self._data_pool: int | None = None
+        self._caps: dict[int, _CapState] = {}
+        #: serializes open vs last-close so a concurrent open can never
+        #: interleave with a cap_release and orphan its cap state
+        self._oc_lock = threading.Lock()
+        self._next_fh = 1
+        #: last known ino per opened path (open-timeout cancel guard)
+        self._path_ino: dict[str, int] = {}
+        #: highest cap seq processed per ino — survives missing cap
+        #: state, so an open reply racing an already-processed revoke
+        #: never reinstalls the stale (higher) grant
+        self._cap_seq_seen: dict[int, int] = {}
+        self._renew_timer: threading.Timer | None = None
+        self._stop = False
+        self._evicted = False
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -50,35 +112,109 @@ class CephFS(Dispatcher):
         else:
             self.msgr.bind(f"fsclient.{self.name.id}")
         self.msgr.start()
+        self._session("request_open")
         st = self._request("statfs", {})
         self._data_pool = st["data_pool"]
         self.data_io = self.rados.open_ioctx(self._data_pool)
+        self._schedule_renew()
 
     def unmount(self) -> None:
+        self._stop = True
+        if self._renew_timer:
+            self._renew_timer.cancel()
+        with self._lock:
+            states = list(self._caps.values())
+        for st in states:
+            try:
+                self._flush_state(st)
+            except (OSError, TimeoutError):
+                # teardown is best-effort; per-file errors were the
+                # owner's to observe via fsync/close
+                pass
+        try:
+            self._session("request_close")
+        except (OSError, TimeoutError):
+            pass
         self.msgr.shutdown()
         self.rados.shutdown()
+
+    def _schedule_renew(self) -> None:
+        if self._stop:
+            return
+        self._renew_timer = threading.Timer(2.0, self._renew)
+        self._renew_timer.daemon = True
+        self._renew_timer.start()
+
+    def _renew(self) -> None:
+        try:
+            con = self.msgr.connect_to(self.mds_addr, EntityName("mds", 0))
+            con.send_message(MClientSession(op="renew",
+                                            client=self.client_id))
+        except OSError:
+            pass
+        finally:
+            self._schedule_renew()
 
     # -- mds rpc --------------------------------------------------------------
 
     def ms_dispatch(self, msg) -> bool:
-        if isinstance(msg, MClientReply):
+        if isinstance(msg, MClientReply) or (
+                isinstance(msg, MClientSession)
+                and msg.op in ("open_ack", "close_ack")):
             with self._lock:
                 w = self._waiters.pop(msg.tid, None)
             if w is not None:
                 w[1].append(msg)
                 w[0].set()
             return True
+        if isinstance(msg, MClientSession):
+            if msg.op == "evicted":
+                # the MDS killed our session (we stalled past a revoke
+                # grace): caps are void, buffered data is dead — the
+                # reference blocklists the client; ops now fail until
+                # a remount
+                with self._lock:
+                    self._evicted = True
+                    for st in self._caps.values():
+                        st.caps = 0
+                        st.dirty.clear()
+                        st.dirty_bytes = 0
+                    self._caps.clear()
+                    self._cap_seq_seen.clear()
+            return True
+        if isinstance(msg, MClientCaps):
+            self._handle_caps(msg)
+            return True
         return False
 
-    def _request(self, op: str, args: dict) -> dict:
+    def _alloc_tid(self):
         with self._lock:
             tid = self._next_tid
             self._next_tid += 1
             ev: tuple[threading.Event, list] = (threading.Event(), [])
             self._waiters[tid] = ev
+        return tid, ev
+
+    def _session(self, op: str) -> None:
+        tid, ev = self._alloc_tid()
+        con = self.msgr.connect_to(self.mds_addr, EntityName("mds", 0))
+        con.send_message(MClientSession(tid=tid, op=op,
+                                        client=self.client_id))
+        if not ev[0].wait(self.timeout):
+            with self._lock:
+                self._waiters.pop(tid, None)
+            raise TimeoutError(f"mds session {op} timed out")
+
+    def _request(self, op: str, args: dict,
+                 timeout: float | None = None) -> dict:
+        if self._evicted:
+            raise OSError(108, "session evicted by mds (remount)")
+        args = dict(args)
+        args.setdefault("client", self.client_id)
+        tid, ev = self._alloc_tid()
         con = self.msgr.connect_to(self.mds_addr, EntityName("mds", 0))
         con.send_message(MClientRequest(tid=tid, op=op, args=args))
-        if not ev[0].wait(self.timeout):
+        if not ev[0].wait(self.timeout if timeout is None else timeout):
             with self._lock:
                 self._waiters.pop(tid, None)
             raise TimeoutError(f"mds request {op} timed out")
@@ -86,6 +222,110 @@ class CephFS(Dispatcher):
         if reply.result < 0:
             raise OSError(-reply.result, f"{op} {args} failed")
         return reply.out
+
+    # -- capability handling ---------------------------------------------------
+
+    def _state(self, ino: int) -> _CapState:
+        st = self._caps.get(ino)
+        if st is None:
+            st = self._caps[ino] = _CapState(ino)
+        return st
+
+    def _handle_caps(self, msg: MClientCaps) -> None:
+        """Async cap traffic from the MDS (revoke/grant).  Revoke order:
+        downgrade the caps FIRST (under the lock — a racing write then
+        takes the sync path), flush whatever was buffered up to that
+        point, and only then ack.  A write therefore either lands in
+        the flushed buffer or runs synchronously after the downgrade —
+        never invisibly in between."""
+        size = -1
+        mtime = 0.0
+        need_flush = False
+        with self._lock:
+            st = self._caps.get(msg.ino)
+            if msg.seq:
+                self._cap_seq_seen[msg.ino] = max(
+                    self._cap_seq_seen.get(msg.ino, 0), msg.seq)
+            if msg.op == "grant":
+                if st is not None and msg.seq >= \
+                        self._cap_seq_seen.get(msg.ino, 0):
+                    st.caps = msg.caps
+                return
+            if msg.op == "invalidated":
+                # the inode was unlinked under us: caps are void and
+                # buffered data has nowhere to go — drop it; subsequent
+                # ops on live handles surface ENOENT.  The server-side
+                # seq generation died with the grant, so forget ours.
+                if st is not None:
+                    st.caps = 0
+                    st.dirty.clear()
+                    st.dirty_bytes = 0
+                    st.attr_fresh = False
+                self._cap_seq_seen.pop(msg.ino, None)
+                return
+            if msg.op != "revoke":
+                return
+            if st is not None:
+                lost = st.caps & ~msg.caps
+                st.caps = msg.caps
+                if lost & CACHE:
+                    st.attr_fresh = False
+                need_flush = bool(lost & BUFFER)
+        if st is not None and need_flush:
+            self._writeback(st)
+            with self._lock:
+                size, mtime = st.size, st.mtime
+        con = self.msgr.connect_to(self.mds_addr, EntityName("mds", 0))
+        con.send_message(MClientCaps(
+            op="ack", ino=msg.ino, seq=msg.seq, client=self.client_id,
+            size=size, mtime=mtime))
+
+    def _writeback(self, st: _CapState) -> None:
+        """Write buffered extents to RADOS (data only — the size rides
+        the cap ack or an explicit setattr).  The dirty list is SWAPPED
+        out under the client lock, so concurrent writes land on the new
+        list (flushed by the next writeback, never lost); wb_lock keeps
+        two flushers from landing overlapping batches out of order."""
+        with st.wb_lock:
+            with self._lock:
+                extents = st.dirty
+                st.dirty = []
+                st.dirty_bytes = 0
+            if not extents:
+                return
+            obj = StripedObject(self.data_io, _data_name(st.ino),
+                                _LAYOUT)
+            for off, data in extents:
+                obj.write(data, offset=off)
+
+    def _flush_state(self, st: _CapState) -> None:
+        """Full writeback + synchronous size/mtime report (close/fsync
+        path — Client::_flush + check_caps)."""
+        if not st.dirty and st.size <= st.inode.get("size", 0):
+            return
+        self._writeback(st)
+        # a failed size report MUST surface (fsync/close return the
+        # error in POSIX — swallowing it would report success for
+        # writes another client can never see)
+        inode = self._request(
+            "setattr", {"ino": st.ino, "size": st.size,
+                        "grow": True,
+                        "mtime": st.mtime or time.time()})["inode"]
+        self._apply_inode(st, inode)
+
+    def _apply_inode(self, st: _CapState, inode: dict) -> None:
+        """Install server-reported attrs under the lock; a buffered
+        write racing this keeps its (larger) local size."""
+        with self._lock:
+            st.inode = inode
+            st.size = max(inode.get("size", 0),
+                          st.size if st.dirty else 0)
+            st.attr_fresh = True
+
+    def _refresh_attrs(self, st: _CapState) -> None:
+        """Sync mode (no CACHE): ask the MDS for the truth."""
+        self._apply_inode(
+            st, self._request("getattr", {"ino": st.ino})["inode"])
 
     # -- namespace ------------------------------------------------------------
 
@@ -96,10 +336,21 @@ class CephFS(Dispatcher):
         return self._request("readdir", {"path": path})["entries"]
 
     def stat(self, path: str) -> dict:
-        return self._request("lookup", {"path": path})["inode"]
+        inode = self._request("lookup", {"path": path})["inode"]
+        # our OWN buffered size is more recent than the MDS's answer
+        # (the MDS only recalls OTHER clients' buffers for a stat)
+        with self._lock:
+            st = self._caps.get(inode.get("ino"))
+            if st is not None and st.caps & BUFFER:
+                inode["size"] = max(inode.get("size", 0), st.size)
+                inode["mtime"] = max(inode.get("mtime", 0.0), st.mtime)
+        return inode
 
     def unlink(self, path: str) -> None:
         out = self._request("unlink", {"path": path})
+        with self._lock:
+            self._caps.pop(out["ino"], None)
+            self._cap_seq_seen.pop(out["ino"], None)
         # purge the file's striped data (the reference defers this to
         # the MDS purge queue; the client is the data-pool actor here)
         StripedObject(self.data_io, _data_name(out["ino"]),
@@ -114,13 +365,71 @@ class CephFS(Dispatcher):
     # -- file i/o -------------------------------------------------------------
 
     def open(self, path: str, flags: str = "r") -> "File":
-        if "w" in flags or "a" in flags:
-            out = self._request("create", {"path": path})
-        else:
-            out = {"inode": self._request(
-                "lookup", {"path": path})["inode"]}
-        return File(self, out["inode"], append="a" in flags,
-                    truncate="w" in flags)
+        writing = "w" in flags or "a" in flags
+        wanted = WANT_WRITE if writing else WANT_READ
+        with self._oc_lock:
+            try:
+                out = self._request("open", {"path": path,
+                                             "wanted": wanted,
+                                             "create": writing})
+            except TimeoutError:
+                # withdraw the server-side wanted/grant registration our
+                # abandoned open may have left (else the ino is stuck in
+                # sync mode) — but never while we hold live handles on
+                # it, whose grant a release would wrongly drop
+                known = self._path_ino.get(path)
+                st0 = self._caps.get(known) if known is not None else None
+                if st0 is None or st0.nopen <= 0:
+                    try:
+                        self._request("open_cancel", {"path": path},
+                                      timeout=5.0)
+                    except (OSError, TimeoutError):
+                        pass
+                raise
+            ino = out["inode"]["ino"]
+            self._path_ino[path] = ino
+            with self._lock:
+                st = self._state(ino)
+                # install the grant ONLY if no newer revoke has been
+                # processed since the server stamped it (a revoke can
+                # overtake us between the reply event and this install)
+                if out.get("cap_seq", 0) >= \
+                        self._cap_seq_seen.get(ino, 0):
+                    st.caps = out["caps"]
+                st.inode = out["inode"]
+                st.attr_fresh = True
+                if not st.dirty:
+                    st.size = out["inode"].get("size", 0)
+                    st.mtime = out["inode"].get("mtime", 0.0)
+                st.nopen += 1
+                fh = self._next_fh
+                self._next_fh += 1
+        f = File(self, st, fh, append="a" in flags, writable=writing)
+        if "w" in flags and st.size > 0:
+            f.truncate(0)
+        return f
+
+    def _close_file(self, st: _CapState) -> None:
+        flush_err = None
+        try:
+            self._flush_state(st)
+        except (OSError, TimeoutError) as e:
+            flush_err = e       # surface AFTER the handle bookkeeping
+        with self._oc_lock:
+            with self._lock:
+                st.nopen -= 1
+                last = st.nopen <= 0
+                if last:
+                    self._caps.pop(st.ino, None)
+                    # the release ends this grant's seq generation
+                    self._cap_seq_seen.pop(st.ino, None)
+            if last:
+                try:
+                    self._request("cap_release", {"ino": st.ino})
+                except (OSError, TimeoutError):
+                    pass
+        if flush_err is not None:
+            raise flush_err
 
 
 _LAYOUT = StripeLayout(stripe_unit=1 << 16, stripe_count=4,
@@ -136,51 +445,180 @@ def _is_tcp(msgr) -> bool:
 
 
 class File:
-    """Open file handle: striped data I/O + size writeback on close."""
+    """Open file handle: cap-gated striped data I/O.
 
-    def __init__(self, fs: CephFS, inode: dict, append: bool = False,
-                 truncate: bool = False):
+    With BUFFER: writes buffer locally and flush on close / revoke /
+    high-water.  With CACHE: attrs trusted from cache.  Without either
+    (sync mode), writes hit RADOS + report size immediately and reads
+    refresh attrs from the MDS — two clients mixing reads and writes
+    therefore always see POSIX-coherent data.
+    """
+
+    def __init__(self, fs: CephFS, state: _CapState, fh: int,
+                 append: bool = False, writable: bool = False):
         self.fs = fs
-        self.inode = inode
-        self.obj = StripedObject(fs.data_io, _data_name(inode["ino"]),
+        self.state = state
+        self.fh = fh
+        self.writable = writable
+        self.obj = StripedObject(fs.data_io, _data_name(state.ino),
                                  _LAYOUT)
-        if truncate and inode.get("size", 0) > 0:
-            self.obj.truncate(0)
-            self._set_size(0)
-        self.pos = inode.get("size", 0) if append else 0
-        self._dirty = False
+        self.pos = state.size if append else 0
+        self._closed = False
+        self._flocked = False
+        self._lockfed = False
 
-    def _set_size(self, size: int) -> None:
-        import time as _t
-        self.inode = self.fs._request(
-            "setattr", {"ino": self.inode["ino"], "size": size,
-                        "mtime": _t.time()})["inode"]
+    @property
+    def inode(self) -> dict:
+        return self.state.inode
+
+    def truncate(self, size: int) -> None:
+        # truncate is always SYNCHRONOUS to the MDS (plain, shrinking
+        # setattr) — a buffered size report is grow-only and could
+        # never undo the old length
+        if not self.writable:
+            raise OSError(9, "file not open for writing")  # EBADF
+        st = self.state
+        self.obj.truncate(size)
+        with self.fs._lock:
+            # clip straddling extents to the new size (dropping them
+            # whole would lose their in-range bytes)
+            clipped = []
+            for o, d in st.dirty:
+                if o >= size:
+                    continue
+                clipped.append((o, d[:size - o] if o + len(d) > size
+                                else d))
+            st.dirty = clipped
+            st.dirty_bytes = sum(len(d) for _o, d in clipped)
+            st.size = size
+            st.mtime = time.time()
+        self.fs._apply_inode(st, self.fs._request(
+            "setattr", {"ino": st.ino, "size": size,
+                        "mtime": st.mtime})["inode"])
 
     def write(self, data: bytes) -> int:
-        self.obj.write(data, offset=self.pos)
+        if not self.writable:
+            raise OSError(9, "file not open for writing")  # EBADF
+        st = self.state
+        with self.fs._lock:
+            buffered = bool(st.caps & BUFFER)
+            if buffered:
+                st.dirty.append((self.pos, bytes(data)))
+                st.dirty_bytes += len(data)
+                st.size = max(st.size, self.pos + len(data))
+                st.mtime = time.time()
+        if buffered:
+            if st.dirty_bytes > MAX_DIRTY:
+                self.fs._flush_state(st)
+        else:
+            # sync mode: data through, size reported immediately
+            # (grow-only: the MDS keeps the max across all writers)
+            self.obj.write(data, offset=self.pos)
+            self.fs._apply_inode(st, self.fs._request(
+                "setattr", {"ino": st.ino, "size": self.pos + len(data),
+                            "grow": True,
+                            "mtime": time.time()})["inode"])
         self.pos += len(data)
-        self._dirty = True
         return len(data)
 
     def read(self, length: int = 0) -> bytes:
-        size = self.inode.get("size", 0)
-        if length <= 0:
-            length = max(0, size - self.pos)
-        length = min(length, max(0, size - self.pos))
-        data = self.obj.read(self.pos, length)
-        self.pos += len(data)
-        return data
+        st = self.state
+        if not st.caps & CACHE or not st.attr_fresh:
+            self.fs._refresh_attrs(st)
+        # wb_lock excludes an in-flight writeback (whose extents are in
+        # neither st.dirty nor RADOS yet); under it, an extent is
+        # either in the snapshot (overlaid below, newest wins) or was
+        # fully written back before our RADOS read started
+        with st.wb_lock:
+            with self.fs._lock:
+                size = st.size
+                dirty = list(st.dirty)
+            if length <= 0:
+                length = max(0, size - self.pos)
+            length = min(length, max(0, size - self.pos))
+            data = bytearray(self.obj.read(self.pos, length))
+        if len(data) < length:      # unwritten space reads as zeros
+            data += bytes(length - len(data))
+        # overlay this client's own buffered writes
+        for off, blob in dirty:
+            lo = max(off, self.pos)
+            hi = min(off + len(blob), self.pos + length)
+            if lo < hi:
+                data[lo - self.pos:hi - self.pos] = \
+                    blob[lo - off:hi - off]
+        self.pos += length
+        return bytes(data)
 
     def seek(self, pos: int) -> None:
         self.pos = pos
 
+    def fsync(self) -> None:
+        self.fs._flush_state(self.state)
+
+    # -- locks ----------------------------------------------------------------
+
+    def lockf(self, ltype: int, start: int = 0, length: int = 0,
+              wait: bool = False) -> None:
+        """fcntl byte-range lock (F_SETLK / F_SETLKW with wait=True).
+        Owner scope is the CLIENT (posix: process-wide)."""
+        self.fs._request(
+            "setlk", {"ino": self.state.ino,
+                      "owner": f"p{self.fs.client_id}",
+                      "type": ltype, "start": start, "len": length,
+                      "wait": wait},
+            timeout=300.0 if wait else None)
+        if ltype != F_UNLCK:
+            self._lockfed = True
+
+    def getlk(self, ltype: int, start: int = 0,
+              length: int = 0) -> dict | None:
+        return self.fs._request(
+            "getlk", {"ino": self.state.ino,
+                      "owner": f"p{self.fs.client_id}",
+                      "type": ltype, "start": start,
+                      "len": length})["lock"]
+
+    def flock(self, ltype: int, wait: bool = False) -> None:
+        """BSD flock; owner scope is THIS handle."""
+        self.fs._request(
+            "flock", {"ino": self.state.ino,
+                      "owner": f"h{self.fs.client_id}.{self.fh}",
+                      "type": ltype, "wait": wait},
+            timeout=300.0 if wait else None)
+        self._flocked = ltype != F_UNLCK
+
     def close(self) -> None:
-        if self._dirty:
-            self._set_size(max(self.pos, self.inode.get("size", 0)))
-        self._dirty = False
+        if self._closed:
+            return
+        self._closed = True
+        if self._flocked:
+            # a handle-scoped flock dies with the handle
+            try:
+                self.fs._request(
+                    "flock", {"ino": self.state.ino,
+                              "owner":
+                              f"h{self.fs.client_id}.{self.fh}",
+                              "type": F_UNLCK})
+            except (OSError, TimeoutError):
+                pass
+        if self._lockfed:
+            # POSIX: closing ANY descriptor of the file drops the
+            # process's fcntl locks on it (whole-file unlock)
+            try:
+                self.fs._request(
+                    "setlk", {"ino": self.state.ino,
+                              "owner": f"p{self.fs.client_id}",
+                              "type": F_UNLCK, "start": 0, "len": 0})
+            except (OSError, TimeoutError):
+                pass
+        self.fs._close_file(self.state)
 
     def __enter__(self) -> "File":
         return self
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+__all__ = ["CephFS", "File", "F_RDLCK", "F_WRLCK", "F_UNLCK",
+           "WANT_READ", "WANT_WRITE", "BUFFER", "CACHE", "WR"]

@@ -1,0 +1,230 @@
+"""Client capability logic (src/mds/Locker.cc:1-5357 + Capability.h,
+reduced to the coherence-bearing core).
+
+The reference's Locker runs a lock-state machine per inode (simplelock/
+filelock/scatterlock) whose OBSERVABLE effect on clients is: which cap
+bits each client may hold given who else has the file open.  This module
+keeps exactly that observable contract and drops the internal lock-state
+gearing:
+
+  bits (CEPH_CAP_* reduced):
+    RD      may read file data directly from RADOS
+    WR      may write file data directly to RADOS
+    CACHE   may trust cached attrs (size/mtime) without asking the MDS
+            (Fc — "cache" — plus the As/Fs shared-attr caps folded in)
+    BUFFER  may buffer dirty data + size locally and flush lazily
+            (Fb — write-back is only legal while held)
+
+  issue rules (Locker::issue_caps / file_eval observable behaviour):
+    - a LONE opener gets everything it wants (loner: Fcb granted)
+    - multiple openers, all readers -> RD|CACHE for everyone
+    - any writer among multiple openers -> RD|WR only (sync mode:
+      every read/write hits RADOS, sizes flow through the MDS)
+
+Revocation is a seq-numbered round trip: the table records what each
+client must drop; the server sends MClientCaps(revoke) and the request
+that needed the revoke waits until every ack lands (clients flush dirty
+data BEFORE acking — that ordering is the whole POSIX-coherence story).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+RD = 1
+WR = 2
+CACHE = 4
+BUFFER = 8
+ALL = RD | WR | CACHE | BUFFER
+
+#: what an opener asks for by mode (Client::get_caps wanted sets)
+WANT_READ = RD | CACHE
+WANT_WRITE = RD | WR | CACHE | BUFFER
+
+
+def caps_str(bits: int) -> str:
+    """'rwcb'-style render (Capability::string analog) for logs/tests."""
+    return "".join(ch for bit, ch in ((RD, "r"), (WR, "w"),
+                                      (CACHE, "c"), (BUFFER, "b"))
+                   if bits & bit) or "-"
+
+
+@dataclass
+class CapGrant:
+    """One client's capability on one inode."""
+
+    issued: int = 0          # bits the client currently holds
+    wanted: int = 0          # bits the client asked for (re-eval input)
+    pending: int = 0         # bits being revoked DOWN TO (revoke in flight)
+    seq: int = 0             # revoke round-trip pairing
+
+
+@dataclass
+class InoCaps:
+    grants: dict[int, CapGrant] = field(default_factory=dict)
+
+
+class CapTable:
+    """Pure cap bookkeeping for the MDS (no I/O, unit-testable).
+
+    The server drives it with three calls:
+      open_want(ino, client, wanted)  -> (granted | None, revokes)
+         None means revokes are in flight: park the request and retry
+         after acks.  revokes = [(client, new_caps, seq), ...] to send.
+      ack(ino, client, seq)           -> True when that revoke completed
+      release(ino, client)            -> regrants for remaining holders
+    """
+
+    def __init__(self):
+        self._inos: dict[int, InoCaps] = {}
+
+    # -- introspection -------------------------------------------------------
+
+    def holders(self, ino: int) -> dict[int, int]:
+        ic = self._inos.get(ino)
+        if not ic:
+            return {}
+        return {c: g.issued for c, g in ic.grants.items()}
+
+    def issued(self, ino: int, client: int) -> int:
+        ic = self._inos.get(ino)
+        if not ic or client not in ic.grants:
+            return 0
+        return ic.grants[client].issued
+
+    def grant_seq(self, ino: int, client: int) -> int:
+        ic = self._inos.get(ino)
+        if not ic or client not in ic.grants:
+            return 0
+        return ic.grants[client].seq
+
+    # -- the issue rule ------------------------------------------------------
+
+    @staticmethod
+    def _allowed(wants: dict[int, int]) -> int:
+        """Max bits ANY holder may keep given everyone's wanted mode."""
+        if len(wants) <= 1:
+            return ALL
+        if any(w & WR for w in wants.values()):
+            return RD | WR          # mixed access: fully synchronous
+        return RD | CACHE           # shared read-only: cacheable
+
+    def _revoke_to(self, ic: InoCaps, client: int,
+                   new_caps: int) -> tuple[int, int, int] | None:
+        g = ic.grants[client]
+        target = g.issued & new_caps
+        if not g.issued & ~new_caps:
+            return None             # nothing to drop
+        if g.pending == target and g.seq:
+            return None             # identical revoke already in flight
+        g.pending = target
+        g.seq += 1
+        return (client, target, g.seq)
+
+    def open_want(self, ino: int, client: int, wanted: int
+                  ) -> tuple[int | None, list[tuple[int, int, int]]]:
+        ic = self._inos.setdefault(ino, InoCaps())
+        me = ic.grants.setdefault(client, CapGrant())
+        me.wanted |= wanted
+        wants = {c: g.wanted for c, g in ic.grants.items()}
+        allowed = self._allowed(wants)
+        revokes = []
+        for c, g in ic.grants.items():
+            if c == client:
+                continue
+            r = self._revoke_to(ic, c, allowed)
+            if r:
+                revokes.append(r)
+        if any(g.seq and g.pending != g.issued
+               for c, g in ic.grants.items() if c != client):
+            # someone still holds more than allowed: caller parks
+            return None, revokes
+        if me.seq and me.pending != me.issued:
+            # MY OWN earlier revoke is still in flight: granting now
+            # would bump the seq and orphan that ack — park until it
+            # lands (the ack reruns us)
+            return None, revokes
+        me.issued = me.wanted & allowed
+        me.pending = me.issued
+        me.seq += 1     # stamp the grant: the client installs it only
+        return me.issued, revokes   # if no NEWER revoke was processed
+
+    def recall(self, ino: int, bits: int, exclude: int | None = None
+               ) -> list[tuple[int, int, int]]:
+        """Revoke `bits` from every holder (e.g. BUFFER before a stat
+        answers, so the size is fresh).  Returns revokes to send; empty
+        means nothing outstanding — proceed."""
+        ic = self._inos.get(ino)
+        if not ic:
+            return []
+        revokes = []
+        for c, g in ic.grants.items():
+            if c == exclude or not g.issued & bits:
+                continue
+            r = self._revoke_to(ic, c, g.issued & ~bits)
+            if r:
+                revokes.append(r)
+        return revokes
+
+    def pending_revokes(self, ino: int, exclude: int | None = None) -> bool:
+        ic = self._inos.get(ino)
+        if not ic:
+            return False
+        return any(g.seq and g.pending != g.issued
+                   for c, g in ic.grants.items() if c != exclude)
+
+    def ack(self, ino: int, client: int, seq: int) -> bool:
+        """Client confirmed the revoke (after flushing).  Stale seqs
+        (an older round trip racing a newer revoke) are ignored."""
+        ic = self._inos.get(ino)
+        if not ic or client not in ic.grants:
+            return False
+        g = ic.grants[client]
+        if seq != g.seq:
+            return False
+        g.issued = g.pending
+        return True
+
+    def force_drop(self, ino: int, client: int) -> None:
+        """Evict one client's grant without an ack (dead session)."""
+        ic = self._inos.get(ino)
+        if ic:
+            ic.grants.pop(client, None)
+            if not ic.grants:
+                del self._inos[ino]
+
+    def release(self, ino: int, client: int
+                ) -> list[tuple[int, int, int]]:
+        """Client closed its last handle: drop its grant and compute
+        UPGRADES for the remaining holders (a now-lone writer gets its
+        buffer/cache back — Locker's eval on cap release).  Returns
+        [(client, new_caps, seq)] grants to send (no ack needed:
+        granting more never needs a flush)."""
+        ic = self._inos.get(ino)
+        if not ic:
+            return []
+        ic.grants.pop(client, None)
+        if not ic.grants:
+            del self._inos[ino]
+            return []
+        wants = {c: g.wanted for c, g in ic.grants.items()}
+        allowed = self._allowed(wants)
+        grants = []
+        for c, g in ic.grants.items():
+            new = g.wanted & allowed
+            if new & ~g.issued and not (g.seq and g.pending != g.issued):
+                g.issued = new
+                g.pending = new
+                g.seq += 1      # cap-change ordering token (clients
+                grants.append((c, new, g.seq))  # drop stale installs)
+        return grants
+
+    def drop_client(self, client: int) -> list[int]:
+        """Session death: drop every grant; returns touched inos (the
+        caller re-evals waiters/upgrades on each)."""
+        touched = []
+        for ino in list(self._inos):
+            if client in self._inos[ino].grants:
+                touched.append(ino)
+                self.force_drop(ino, client)
+        return touched

@@ -1,0 +1,149 @@
+"""File locking state (src/mds/flock.{h,cc} ceph_lock_state_t analog).
+
+Two lock families, both arbitrated by the MDS that owns the inode:
+
+  * POSIX/fcntl byte-range locks: per (client, owner-token) — a later
+    lock by the same owner REPLACES its overlap (split/merge semantics:
+    locking [0,10) exclusive then [4,6) shared leaves three segments);
+    unlock punches holes.
+  * BSD flock: whole-file, per file HANDLE (owner token carries the
+    handle id), shared/exclusive, upgrade/downgrade by re-locking.
+
+Blocking waiters queue here as opaque tokens; the server re-runs them
+when anything is removed.  All state drops when a session dies —
+exactly the reference's behaviour on client eviction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+F_RDLCK = 0
+F_WRLCK = 1
+F_UNLCK = 2
+
+EOF = 1 << 62      # "to end of file" sentinel (len=0 in fcntl terms)
+
+
+@dataclass
+class Lock:
+    client: int
+    owner: str          # fcntl: process-wide token; flock: handle token
+    type: int           # F_RDLCK | F_WRLCK
+    start: int
+    end: int            # exclusive
+
+
+def _overlap(a: Lock, start: int, end: int) -> bool:
+    return a.start < end and start < a.end
+
+
+class LockState:
+    """Lock table for ONE inode."""
+
+    def __init__(self):
+        self.posix: list[Lock] = []
+        self.flock: list[Lock] = []
+
+    # -- conflict checks -----------------------------------------------------
+
+    def posix_conflict(self, client: int, owner: str, ltype: int,
+                       start: int, end: int) -> Lock | None:
+        if ltype == F_UNLCK:
+            return None
+        for lk in self.posix:
+            if (lk.client, lk.owner) == (client, owner):
+                continue            # own locks never conflict
+            if not _overlap(lk, start, end):
+                continue
+            if ltype == F_WRLCK or lk.type == F_WRLCK:
+                return lk
+        return None
+
+    def flock_conflict(self, client: int, owner: str,
+                       ltype: int) -> Lock | None:
+        if ltype == F_UNLCK:
+            return None
+        for lk in self.flock:
+            if (lk.client, lk.owner) == (client, owner):
+                continue
+            if ltype == F_WRLCK or lk.type == F_WRLCK:
+                return lk
+        return None
+
+    # -- mutation ------------------------------------------------------------
+
+    def posix_set(self, client: int, owner: str, ltype: int,
+                  start: int, end: int) -> bool:
+        """Apply F_SETLK once conflicts are clear; returns False on
+        conflict (caller decides EAGAIN vs block)."""
+        if ltype != F_UNLCK and \
+                self.posix_conflict(client, owner, ltype, start, end):
+            return False
+        # carve the range out of this owner's existing locks (split)
+        kept: list[Lock] = []
+        for lk in self.posix:
+            if (lk.client, lk.owner) != (client, owner) \
+                    or not _overlap(lk, start, end):
+                kept.append(lk)
+                continue
+            if lk.start < start:
+                kept.append(Lock(client, owner, lk.type, lk.start, start))
+            if end < lk.end:
+                kept.append(Lock(client, owner, lk.type, end, lk.end))
+        if ltype != F_UNLCK:
+            kept.append(Lock(client, owner, ltype, start, end))
+            # coalesce adjacent same-type segments of this owner
+            kept = self._merge(kept, client, owner)
+        self.posix = kept
+        return True
+
+    @staticmethod
+    def _merge(locks: list[Lock], client: int, owner: str) -> list[Lock]:
+        mine = sorted((lk for lk in locks
+                       if (lk.client, lk.owner) == (client, owner)),
+                      key=lambda lk: lk.start)
+        rest = [lk for lk in locks
+                if (lk.client, lk.owner) != (client, owner)]
+        out: list[Lock] = []
+        for lk in mine:
+            if out and out[-1].type == lk.type and out[-1].end >= lk.start:
+                out[-1].end = max(out[-1].end, lk.end)
+            else:
+                out.append(lk)
+        return rest + out
+
+    def flock_set(self, client: int, owner: str, ltype: int) -> bool:
+        if ltype != F_UNLCK and \
+                self.flock_conflict(client, owner, ltype):
+            return False
+        self.flock = [lk for lk in self.flock
+                      if (lk.client, lk.owner) != (client, owner)]
+        if ltype != F_UNLCK:
+            self.flock.append(Lock(client, owner, ltype, 0, EOF))
+        return True
+
+    def getlk(self, client: int, owner: str, ltype: int,
+              start: int, end: int) -> dict | None:
+        """F_GETLK: first conflicting lock, or None if it would fit."""
+        lk = self.posix_conflict(client, owner, ltype, start, end)
+        if lk is None:
+            return None
+        return {"client": lk.client, "owner": lk.owner, "type": lk.type,
+                "start": lk.start,
+                "len": 0 if lk.end >= EOF else lk.end - lk.start}
+
+    def drop_client(self, client: int) -> bool:
+        """Session death / unmount: every lock evaporates."""
+        before = len(self.posix) + len(self.flock)
+        self.posix = [lk for lk in self.posix if lk.client != client]
+        self.flock = [lk for lk in self.flock if lk.client != client]
+        return len(self.posix) + len(self.flock) != before
+
+    def empty(self) -> bool:
+        return not self.posix and not self.flock
+
+
+def fcntl_range(start: int, length: int) -> tuple[int, int]:
+    """fcntl's (l_start, l_len) -> [start, end); len 0 = to EOF."""
+    return start, (EOF if length == 0 else start + length)

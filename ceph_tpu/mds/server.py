@@ -29,6 +29,9 @@ import time
 from ceph_tpu.client.rados import RadosClient
 from ceph_tpu.common.context import CephTpuContext
 from ceph_tpu.common.logging import dout
+from ceph_tpu.mds.caps import BUFFER, CapTable, caps_str
+from ceph_tpu.mds.flock import (
+    F_UNLCK, LockState, fcntl_range)
 from ceph_tpu.msg.encoding import Decoder, Encoder
 from ceph_tpu.msg.message import Message, register_message
 from ceph_tpu.msg.messenger import (
@@ -92,6 +95,80 @@ class MClientReply(Message):
         dec.versioned(1, body)
 
 
+@register_message
+class MClientSession(Message):
+    """Session lifecycle, client <-> mds (CEPH_MSG_CLIENT_SESSION=22):
+    request_open / open_ack / renew / request_close / close_ack."""
+
+    TYPE = 22
+
+    def __init__(self, tid: int = 0, op: str = "", client: int = 0):
+        super().__init__()
+        self.tid = tid
+        self.op = op
+        self.client = client
+
+    def encode_payload(self, enc: Encoder):
+        enc.versioned(1, 1, lambda e: (
+            e.u64(self.tid), e.str(self.op), e.u64(self.client)))
+
+    def decode_payload(self, dec: Decoder, version: int):
+        def body(d, v):
+            self.tid = d.u64()
+            self.op = d.str()
+            self.client = d.u64()
+        dec.versioned(1, body)
+
+
+@register_message
+class MClientCaps(Message):
+    """Capability traffic (CEPH_MSG_CLIENT_CAPS=0x310).
+
+    mds -> client: op 'revoke' (drop to `caps`, ack after flushing),
+    'grant' (upgrade, no ack), 'invalidated' (inode unlinked).
+    client -> mds: op 'ack' (revoke done — flushed size/mtime ride
+    along), 'release' (last close)."""
+
+    TYPE = 0x310
+
+    def __init__(self, op: str = "", ino: int = 0, caps: int = 0,
+                 seq: int = 0, client: int = 0, size: int = -1,
+                 mtime: float = 0.0):
+        super().__init__()
+        self.op = op
+        self.ino = ino
+        self.caps = caps
+        self.seq = seq
+        self.client = client
+        self.size = size
+        self.mtime = mtime
+
+    def encode_payload(self, enc: Encoder):
+        enc.versioned(1, 1, lambda e: (
+            e.str(self.op), e.u64(self.ino), e.u32(self.caps),
+            e.u64(self.seq), e.u64(self.client), e.s64(self.size),
+            e.f64(self.mtime)))
+
+    def decode_payload(self, dec: Decoder, version: int):
+        def body(d, v):
+            self.op = d.str()
+            self.ino = d.u64()
+            self.caps = d.u32()
+            self.seq = d.u64()
+            self.client = d.u64()
+            self.size = d.s64()
+            self.mtime = d.f64()
+        dec.versioned(1, body)
+
+
+class _Park(Exception):
+    """Request must wait for cap acks / lock release on this ino
+    (the reference's MDSCacheObject add_waiter, as control flow)."""
+
+    def __init__(self, ino: int):
+        self.ino = ino
+
+
 class Inode:
     __slots__ = ("ino", "mode", "size", "mtime")
 
@@ -136,6 +213,25 @@ class MDSDaemon(Dispatcher):
         self._next_ino = ROOT_INO + 1
         self._journaled_since_flush = 0
         self.state = "boot"
+        #: client sessions: client id -> {"con", "last_seen"}
+        self._sessions: dict[int, dict] = {}
+        #: capability table (Locker/Capability state)
+        self.caps = CapTable()
+        #: per-ino lock tables (flock.cc ceph_lock_state_t)
+        self._locks: dict[int, LockState] = {}
+        #: requests parked on an ino (waiting for cap acks / locks)
+        self._parked: dict[int, list] = {}
+        #: (ino, client) -> send time of the oldest un-acked revoke
+        self._revoke_sent: dict[tuple[int, int], float] = {}
+        #: grace before a silent revoke target / session is evicted
+        self.revoke_grace = 4.0
+        self.session_grace = 8.0
+        #: parked requests older than this are answered with an error
+        #: (EAGAIN for blocking locks) instead of lingering: the client
+        #: RPC gives up before this, and granting a lock to a waiter
+        #: that stopped waiting would orphan it forever
+        self.park_ttl = 240.0
+        self._tick_timer: threading.Timer | None = None
 
         self.objecter = RadosClient(mon_addr, ms_type=ms_type,
                                     auth_key=auth_key)
@@ -164,9 +260,100 @@ class MDSDaemon(Dispatcher):
         self.state = "active"
         self.msgr.bind(self._addr)
         self.msgr.start()
+        self._schedule_tick()
+
+    def _schedule_tick(self) -> None:
+        if self._stop:
+            return
+        self._tick_timer = threading.Timer(1.0, self._tick)
+        self._tick_timer.daemon = True
+        self._tick_timer.start()
+
+    def _tick(self) -> None:
+        try:
+            now = time.time()
+            with self._lock:
+                # silent revoke targets: the client never acked (dead or
+                # wedged) — evict the WHOLE session, exactly like the
+                # reference's session-kill on cap-revoke timeout.  A
+                # half-evicted client that kept buffering while another
+                # client was granted would corrupt the file underneath
+                # the new holder.
+                for (ino, client), t0 in list(self._revoke_sent.items()):
+                    if now - t0 > self.revoke_grace:
+                        dout("mds", 1, "mds cap revoke timeout: evicting "
+                             "session of client.%d (ino %d)", client, ino)
+                        s = self._sessions.get(client)
+                        if s is not None:
+                            # tell the client it is dead to us: it must
+                            # drop caps/dirty state and remount
+                            s["con"].send_message(MClientSession(
+                                op="evicted", client=client))
+                        self._evict_client(client)
+                # stale sessions: no renew within the grace -> full evict
+                for client, s in list(self._sessions.items()):
+                    if now - s["last_seen"] > self.session_grace:
+                        dout("mds", 1, "mds session timeout: evicting "
+                             "client.%d", client)
+                        self._evict_client(client)
+                # expired parked requests: answer instead of lingering —
+                # the client's RPC already gave up, and granting a lock
+                # to an absent waiter would orphan it
+                expired = []
+                for ino, msgs in list(self._parked.items()):
+                    keep = []
+                    for m in msgs:
+                        if now - m._parked_at > self.park_ttl:
+                            expired.append(m)
+                        else:
+                            keep.append(m)
+                    if keep:
+                        self._parked[ino] = keep
+                    else:
+                        del self._parked[ino]
+            for m in expired:
+                err = -11 if m.op in ("setlk", "flock") else -110
+                if m.op == "open":
+                    # the opener gave up long ago (client RPC timeout <
+                    # park_ttl): un-register its wanted bits or the ino
+                    # would be stuck in sync mode forever.  ONLY when
+                    # the client holds no issued caps — releasing a
+                    # grant backing a live handle from an earlier open
+                    # would hand exclusivity to someone else while this
+                    # client still buffers under it.
+                    with self._lock:
+                        _p, ino, _n = self._resolve(m.args["path"])
+                        cl = int(m.args.get("client", -1))
+                        if ino is not None \
+                                and self.caps.issued(ino, cl) == 0:
+                            self._do_release(ino, cl)
+                            self._rerun(ino)
+                m.connection.send_message(
+                    MClientReply(tid=m.tid, result=err, out={}))
+        finally:
+            self._schedule_tick()
+
+    def _evict_client(self, client: int) -> None:
+        """Drop every trace of a client: session, caps, locks —
+        then re-run anything that was waiting on it."""
+        self._sessions.pop(client, None)
+        touched = set(self.caps.drop_client(client))
+        for (ino, c) in list(self._revoke_sent):
+            if c == client:
+                del self._revoke_sent[(ino, c)]
+        for ino, ls in list(self._locks.items()):
+            if ls.drop_client(client):
+                touched.add(ino)
+            if ls.empty():
+                del self._locks[ino]
+        for ino in touched:
+            self._upgrade_after_release(ino)
+            self._rerun(ino)
 
     def shutdown(self) -> None:
         self._stop = True
+        if self._tick_timer:
+            self._tick_timer.cancel()
         with self._lock:
             self._flush_dirty()
             if self.journal is not None:
@@ -320,7 +507,14 @@ class MDSDaemon(Dispatcher):
             inode = self._load_inode(ev["ino"])
             if inode is not None:
                 if "size" in ev:
-                    inode.size = ev["size"]
+                    # size WRITEBACK is grow-only (a writer reporting
+                    # how far it has written must never undo another
+                    # client's longer write); only an explicit truncate
+                    # carries plain size
+                    if ev.get("grow"):
+                        inode.size = max(inode.size, ev["size"])
+                    else:
+                        inode.size = ev["size"]
                 if "mtime" in ev:
                     inode.mtime = ev["mtime"]
                 if "mode" in ev:
@@ -362,19 +556,141 @@ class MDSDaemon(Dispatcher):
         if self._stop:
             return True
         if isinstance(msg, MClientRequest):
-            try:
-                with self._lock:
-                    result, out = self._handle(msg.op, msg.args)
-            except Exception:
-                from ceph_tpu.common.logging import get_logger
-                get_logger("mds").exception("mds request %s failed", msg.op)
-                result, out = -5, {}
-            msg.connection.send_message(
-                MClientReply(tid=msg.tid, result=result, out=out))
+            self._handle_request(msg)
+            return True
+        if isinstance(msg, MClientSession):
+            self._handle_session(msg)
+            return True
+        if isinstance(msg, MClientCaps):
+            self._handle_caps_msg(msg)
             return True
         return False
 
+    def _handle_request(self, msg) -> None:
+        try:
+            with self._lock:
+                if "client" in msg.args:
+                    s = self._sessions.get(int(msg.args["client"]))
+                    if s is not None:
+                        s["last_seen"] = time.time()
+                        s["con"] = msg.connection
+                result, out = self._handle(msg.op, msg.args)
+                # reply INSIDE the lock: a grant reply must hit the wire
+                # before any revoke a competing request issues against
+                # it (per-connection FIFO then guarantees the client
+                # installs the grant before seeing the revoke)
+                msg.connection.send_message(
+                    MClientReply(tid=msg.tid, result=result, out=out))
+            return
+        except _Park as p:
+            # request waits for cap acks / lock release on this ino;
+            # re-dispatched verbatim when the state changes
+            if not hasattr(msg, "_parked_at"):
+                msg._parked_at = time.time()
+            with self._lock:
+                self._parked.setdefault(p.ino, []).append(msg)
+            return
+        except Exception:
+            from ceph_tpu.common.logging import get_logger
+            get_logger("mds").exception("mds request %s failed", msg.op)
+            result, out = -5, {}
+        msg.connection.send_message(
+            MClientReply(tid=msg.tid, result=result, out=out))
+
+    def _rerun(self, ino: int) -> None:
+        """Re-dispatch every request parked on an ino (waiters fire on
+        any cap/lock state change there)."""
+        msgs = self._parked.pop(ino, [])
+        for m in msgs:
+            self._handle_request(m)
+
+    # -- sessions --------------------------------------------------------------
+
+    def _handle_session(self, msg: MClientSession) -> None:
+        with self._lock:
+            if msg.op == "request_open":
+                self._sessions[msg.client] = {
+                    "con": msg.connection, "last_seen": time.time()}
+                msg.connection.send_message(MClientSession(
+                    tid=msg.tid, op="open_ack", client=msg.client))
+            elif msg.op == "renew":
+                s = self._sessions.get(msg.client)
+                if s is not None:
+                    s["last_seen"] = time.time()
+                    s["con"] = msg.connection
+            elif msg.op == "request_close":
+                self._evict_client(msg.client)
+                msg.connection.send_message(MClientSession(
+                    tid=msg.tid, op="close_ack", client=msg.client))
+
+    # -- capability traffic ----------------------------------------------------
+
+    def _send_caps(self, client: int, m: MClientCaps) -> bool:
+        s = self._sessions.get(client)
+        if s is None:
+            # no session to talk to: the grant is unrecallable — drop it
+            self.caps.force_drop(m.ino, client)
+            return False
+        s["con"].send_message(m)
+        return True
+
+    def _issue_revokes(self, ino: int, revokes) -> None:
+        now = time.time()
+        for client, new_caps, seq in revokes:
+            dout("mds", 10, "mds revoking ino %d client.%d -> %s",
+                 ino, client, caps_str(new_caps))
+            if self._send_caps(client, MClientCaps(
+                    op="revoke", ino=ino, caps=new_caps, seq=seq,
+                    client=client)):
+                self._revoke_sent.setdefault((ino, client), now)
+
+    def _handle_caps_msg(self, msg: MClientCaps) -> None:
+        with self._lock:
+            if msg.op == "ack":
+                if self.caps.ack(msg.ino, msg.client, msg.seq):
+                    self._revoke_sent.pop((msg.ino, msg.client), None)
+                if msg.size >= 0:
+                    # flushed dirty metadata rides the ack (journaled
+                    # like any setattr so replay keeps it; grow-only —
+                    # writeback never truncates)
+                    if self._load_inode(msg.ino) is not None:
+                        self._mutate({"e": "setattr", "ino": msg.ino,
+                                      "size": msg.size, "grow": True,
+                                      "mtime": msg.mtime})
+            elif msg.op == "release":
+                self._do_release(msg.ino, msg.client)
+            else:
+                return
+            # rerun INSIDE the lock: outside it, the tick thread's
+            # parked-list rewrite could re-insert a request this rerun
+            # already dispatched (double lock grant)
+            self._rerun(msg.ino)
+
+    def _do_release(self, ino: int, client: int) -> None:
+        for c, new_caps, seq in self.caps.release(ino, client):
+            self._send_caps(c, MClientCaps(
+                op="grant", ino=ino, caps=new_caps, seq=seq, client=c))
+        self._revoke_sent.pop((ino, client), None)
+
+    def _upgrade_after_release(self, ino: int) -> None:
+        """Re-evaluate an ino after a holder vanished (release path is
+        _do_release; this one serves evictions)."""
+        for c, new_caps, seq in self.caps.release(ino, -1):
+            self._send_caps(c, MClientCaps(
+                op="grant", ino=ino, caps=new_caps, seq=seq, client=c))
+
+    def _fresh_inode(self, ino: int, requester: int | None) -> None:
+        """Before answering attrs: recall BUFFER from every OTHER
+        holder so the size answered is the truth (Locker file_eval
+        before a stat — the stat-sees-latest-write coherence rule)."""
+        revokes = self.caps.recall(ino, BUFFER, exclude=requester)
+        if revokes:
+            self._issue_revokes(ino, revokes)
+        if self.caps.pending_revokes(ino, exclude=requester):
+            raise _Park(ino)
+
     def _handle(self, op: str, a: dict) -> tuple[int, dict]:
+        client = int(a.get("client", -1))
         if op == "lookup":
             parent, ino, _name = self._resolve(a["path"])
             if ino is None:
@@ -382,7 +698,102 @@ class MDSDaemon(Dispatcher):
             inode = self._load_inode(ino)
             if inode is None:
                 return -2, {}
+            if not inode.is_dir():
+                # stat must see the latest write: flush buffered
+                # writers first (parks until their acks land)
+                self._fresh_inode(ino, requester=client)
+                inode = self._load_inode(ino)
             return 0, {"inode": inode.to_dict()}
+
+        if op == "getattr":
+            inode = self._load_inode(a["ino"])
+            if inode is None:
+                return -2, {}
+            if not inode.is_dir():
+                self._fresh_inode(inode.ino, requester=client)
+                inode = self._load_inode(inode.ino)
+            return 0, {"inode": inode.to_dict()}
+
+        if op == "open":
+            # create-if-needed + capability issue (the Locker half of
+            # Server::handle_client_open)
+            parent, ino, name = self._resolve(a["path"])
+            created = False
+            if ino is None:
+                if parent is None:
+                    return -2, {}
+                if not a.get("create"):
+                    return -2, {}
+                ino = self._alloc_ino()
+                self._mutate({"e": "link", "parent": parent, "name": name,
+                              "ino": ino,
+                              "mode": S_IFREG | a.get("mode", 0o644),
+                              "size": 0, "mtime": time.time()})
+                created = True
+            inode = self._load_inode(ino)
+            if inode is None:
+                return -2, {}
+            if inode.is_dir():
+                return -21, {}  # EISDIR
+            granted, revokes = self.caps.open_want(
+                ino, client, int(a["wanted"]))
+            if revokes:
+                self._issue_revokes(ino, revokes)
+            if granted is None:
+                raise _Park(ino)
+            return 0, {"inode": inode.to_dict(), "caps": granted,
+                       "cap_seq": self.caps.grant_seq(ino, client),
+                       "created": created, "data_pool": self.data_pool}
+
+        if op == "cap_release":
+            # synchronous form of MClientCaps 'release' (close path
+            # wants the upgrade side effects ordered before its return)
+            self._do_release(a["ino"], client)
+            self._rerun(a["ino"])
+            return 0, {}
+
+        if op == "open_cancel":
+            # the client's open RPC timed out: withdraw whatever grant/
+            # wanted registration the (possibly still-parked) open left,
+            # so the ino does not stay in sync mode for a ghost
+            parent, ino, _name = self._resolve(a["path"])
+            if ino is not None:
+                self._do_release(ino, client)
+                self._rerun(ino)
+            return 0, {}
+
+        if op in ("setlk", "flock"):
+            ino = a["ino"]
+            if self._load_inode(ino) is None:
+                return -2, {}
+            ls = self._locks.setdefault(ino, LockState())
+            owner = str(a["owner"])
+            ltype = int(a["type"])
+            if op == "setlk":
+                start, end = fcntl_range(int(a.get("start", 0)),
+                                         int(a.get("len", 0)))
+                ok = ls.posix_set(client, owner, ltype, start, end)
+            else:
+                ok = ls.flock_set(client, owner, ltype)
+            if ok:
+                if ltype == F_UNLCK and ls.empty():
+                    del self._locks[ino]
+                # ANY successful change can unblock a waiter (unlock,
+                # but also a WRLCK->RDLCK downgrade or a range shrink)
+                self._rerun(ino)
+                return 0, {}
+            if a.get("wait"):
+                raise _Park(ino)        # F_SETLKW / LOCK_EX blocking
+            return -11, {}              # EAGAIN
+
+        if op == "getlk":
+            ls = self._locks.get(a["ino"])
+            if ls is None:
+                return 0, {"lock": None}
+            start, end = fcntl_range(int(a.get("start", 0)),
+                                     int(a.get("len", 0)))
+            return 0, {"lock": ls.getlk(client, str(a["owner"]),
+                                        int(a["type"]), start, end)}
 
         if op == "mkdir":
             parent, ino, name = self._resolve(a["path"])
@@ -436,6 +847,7 @@ class MDSDaemon(Dispatcher):
                 return -21, {}
             self._mutate({"e": "unlink", "parent": parent, "name": name,
                           "drop_inode": True})
+            self._drop_ino_state(ino)
             return 0, {"ino": ino}
 
         if op == "rmdir":
@@ -470,11 +882,15 @@ class MDSDaemon(Dispatcher):
 
         if op == "setattr":
             ev = {"e": "setattr", "ino": a["ino"]}
-            for k in ("size", "mtime", "mode"):
+            for k in ("size", "mtime", "mode", "grow"):
                 if k in a:
                     ev[k] = a[k]
             if self._load_inode(a["ino"]) is None:
                 return -2, {}
+            if "size" in a:
+                # a size change (truncate / size writeback) must not
+                # race a buffered writer: flush them first
+                self._fresh_inode(a["ino"], requester=client)
             self._mutate(ev)
             return 0, {"inode": self._inodes[a["ino"]].to_dict()}
 
@@ -484,6 +900,19 @@ class MDSDaemon(Dispatcher):
                        "metadata_pool": self.metadata_pool}
 
         return -22, {}
+
+    def _drop_ino_state(self, ino: int) -> None:
+        """Unlinked inode: its caps and locks evaporate; surviving
+        holders are TOLD (op 'invalidated') so they stop buffering
+        against purged data; anything parked re-runs (and sees
+        ENOENT)."""
+        for c in list(self.caps.holders(ino)):
+            self._send_caps(c, MClientCaps(
+                op="invalidated", ino=ino, caps=0, client=c))
+            self.caps.force_drop(ino, c)
+            self._revoke_sent.pop((ino, c), None)
+        self._locks.pop(ino, None)
+        self._rerun(ino)
 
     def _alloc_ino(self) -> int:
         ino = self._next_ino

@@ -1,0 +1,149 @@
+"""Unit tests for the pure cap-issue and lock-state machines
+(Locker.cc / flock.cc observable-behaviour analogs, no I/O)."""
+
+from ceph_tpu.mds.caps import (
+    ALL, BUFFER, CACHE, RD, WANT_READ, WANT_WRITE, WR, CapTable, caps_str)
+from ceph_tpu.mds.flock import (
+    EOF, F_RDLCK, F_UNLCK, F_WRLCK, LockState, fcntl_range)
+
+
+# -- caps -------------------------------------------------------------------
+
+def test_lone_writer_gets_everything():
+    t = CapTable()
+    granted, revokes = t.open_want(7, 1, WANT_WRITE)
+    assert granted == WANT_WRITE and revokes == []
+    assert caps_str(granted) == "rwcb"
+
+
+def test_shared_readers_keep_cache():
+    t = CapTable()
+    g1, r1 = t.open_want(7, 1, WANT_READ)
+    g2, r2 = t.open_want(7, 2, WANT_READ)
+    assert g1 == RD | CACHE and g2 == RD | CACHE
+    assert r1 == [] and r2 == []
+
+
+def test_writer_joining_reader_forces_sync():
+    t = CapTable()
+    t.open_want(7, 1, WANT_READ)
+    granted, revokes = t.open_want(7, 2, WANT_WRITE)
+    # reader must drop CACHE first: grant parks until the ack
+    # (seq 1 was the reader's own grant stamp; the revoke bumps to 2)
+    assert granted is None
+    assert revokes == [(1, RD, 2)]
+    assert t.ack(7, 1, 2)
+    granted, revokes = t.open_want(7, 2, WANT_WRITE)
+    assert granted == (RD | WR) and revokes == []
+    assert t.issued(7, 1) == RD
+
+
+def test_reader_joining_buffered_writer_flushes_it():
+    t = CapTable()
+    t.open_want(7, 1, WANT_WRITE)          # lone writer: rwcb
+    granted, revokes = t.open_want(7, 2, WANT_READ)
+    assert granted is None
+    assert revokes == [(1, RD | WR, 2)]    # drop cache+buffer -> flush
+    assert t.ack(7, 1, 2)
+    granted, _ = t.open_want(7, 2, WANT_READ)
+    assert granted == RD                    # sync read while writer live
+    assert t.issued(7, 1) == RD | WR
+
+
+def test_release_upgrades_remaining_lone_writer():
+    t = CapTable()
+    t.open_want(7, 1, WANT_WRITE)
+    _, rv = t.open_want(7, 2, WANT_READ)
+    t.ack(7, 1, rv[0][2])
+    t.open_want(7, 2, WANT_READ)
+    grants = t.release(7, 2)
+    # buffer/cache handed back, with a fresh ordering seq
+    assert [(c, caps) for c, caps, _s in grants] == [(1, WANT_WRITE)]
+    assert t.issued(7, 1) == WANT_WRITE
+
+
+def test_stale_ack_ignored_and_force_drop():
+    t = CapTable()
+    t.open_want(7, 1, WANT_WRITE)
+    _, rv = t.open_want(7, 2, WANT_READ)
+    assert not t.ack(7, 1, 99)             # wrong seq
+    assert t.pending_revokes(7, exclude=2)
+    t.force_drop(7, 1)                     # dead session eviction
+    assert not t.pending_revokes(7, exclude=2)
+    granted, _ = t.open_want(7, 2, WANT_READ)
+    assert granted == WANT_READ            # now the lone holder
+
+
+def test_recall_buffer_for_stat():
+    t = CapTable()
+    t.open_want(7, 1, WANT_WRITE)
+    revokes = t.recall(7, BUFFER)
+    assert revokes == [(1, RD | WR | CACHE, 2)]
+    assert t.pending_revokes(7)
+    t.ack(7, 1, 2)
+    assert not t.pending_revokes(7)
+    assert t.recall(7, BUFFER) == []       # idempotent once dropped
+
+
+def test_drop_client_touches_inos():
+    t = CapTable()
+    t.open_want(1, 5, WANT_WRITE)
+    t.open_want(2, 5, WANT_READ)
+    assert sorted(t.drop_client(5)) == [1, 2]
+    assert t.holders(1) == {}
+
+
+# -- posix ranges -----------------------------------------------------------
+
+def test_posix_split_and_merge():
+    s = LockState()
+    assert s.posix_set(1, "p1", F_WRLCK, *fcntl_range(0, 10))
+    # same owner re-locks the middle shared: 3 segments
+    assert s.posix_set(1, "p1", F_RDLCK, *fcntl_range(4, 2))
+    segs = sorted(((lk.start, lk.end, lk.type) for lk in s.posix))
+    assert segs == [(0, 4, F_WRLCK), (4, 6, F_RDLCK), (6, 10, F_WRLCK)]
+    # unlock punches a hole
+    assert s.posix_set(1, "p1", F_UNLCK, *fcntl_range(2, 6))
+    segs = sorted(((lk.start, lk.end, lk.type) for lk in s.posix))
+    assert segs == [(0, 2, F_WRLCK), (8, 10, F_WRLCK)]
+
+
+def test_posix_conflicts():
+    s = LockState()
+    s.posix_set(1, "a", F_WRLCK, *fcntl_range(0, 10))
+    assert not s.posix_set(2, "b", F_RDLCK, *fcntl_range(5, 1))
+    assert s.posix_set(2, "b", F_RDLCK, *fcntl_range(10, 5))
+    # shared locks coexist; a writer is blocked by either
+    s.posix_set(1, "a", F_UNLCK, *fcntl_range(0, 10))
+    assert s.posix_set(1, "a", F_RDLCK, *fcntl_range(0, 5))
+    assert s.posix_set(2, "b", F_RDLCK, *fcntl_range(0, 5))
+    assert not s.posix_set(3, "c", F_WRLCK, *fcntl_range(0, 1))
+    got = s.getlk(3, "c", F_WRLCK, *fcntl_range(0, 1))
+    assert got is not None and got["type"] == F_RDLCK
+
+
+def test_len0_means_to_eof():
+    s = LockState()
+    s.posix_set(1, "a", F_WRLCK, *fcntl_range(100, 0))
+    assert not s.posix_set(2, "b", F_WRLCK, *fcntl_range(10 ** 9, 1))
+    assert s.posix_set(2, "b", F_WRLCK, *fcntl_range(0, 100))
+    got = s.getlk(2, "b", F_WRLCK, *fcntl_range(100, 1))
+    assert got["len"] == 0                 # EOF lock reports len 0
+
+
+def test_flock_upgrade_and_handle_scope():
+    s = LockState()
+    assert s.flock_set(1, "h1", F_RDLCK)
+    assert s.flock_set(2, "h2", F_RDLCK)   # shared coexists
+    assert not s.flock_set(1, "h1", F_WRLCK)  # upgrade blocked by h2
+    assert s.flock_set(2, "h2", F_UNLCK)   # handle close -> unlock
+    assert s.flock_set(1, "h1", F_WRLCK)   # now upgrades (replaces)
+    assert len(s.flock) == 1 and s.flock[0].type == F_WRLCK
+
+
+def test_drop_client_clears_both_families():
+    s = LockState()
+    s.posix_set(1, "a", F_WRLCK, *fcntl_range(0, 10))
+    s.flock_set(1, "h", F_WRLCK)
+    assert s.drop_client(1)
+    assert s.empty()

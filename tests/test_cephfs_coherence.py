@@ -1,0 +1,299 @@
+"""CephFS client capabilities, POSIX coherence, and file locking
+(Locker.cc / flock.cc observable behaviour through two live clients).
+
+The contract under test: whatever caching/buffering a client does under
+its granted caps, a SECOND client's reads/stats always see the latest
+acked write — because the MDS revokes conflicting caps (forcing a
+flush) before answering.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.cephfs import BUFFER, CACHE, CephFS, F_RDLCK, F_WRLCK, WR
+from ceph_tpu.tools.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osds=3, ms_type="loopback").start()
+    c.wait_for_osd_count(3)
+    client = c.client(timeout=20.0)
+    meta = c.create_pool(client, pg_num=4, size=2)
+    data = c.create_pool(client, pg_num=8, size=2)
+    c.run_mds(meta, data)
+    yield c
+    c.stop()
+
+
+@pytest.fixture
+def two_fs(cluster):
+    a = CephFS(cluster.mon_host, cluster.mds.addr, ms_type="loopback",
+               client_id=71)
+    b = CephFS(cluster.mon_host, cluster.mds.addr, ms_type="loopback",
+               client_id=72)
+    a.mount()
+    b.mount()
+    yield a, b
+    a.unmount()
+    b.unmount()
+
+
+# -- capability grants -------------------------------------------------------
+
+def test_lone_writer_buffers_then_flushes_on_close(two_fs):
+    a, _b = two_fs
+    f = a.open("/lone", "w")
+    assert f.state.caps & BUFFER      # lone opener: full caps
+    f.write(b"buffered!")
+    # our own stat sees our buffered size (client-side overlay; the
+    # MDS recalls only OTHER clients' buffers)
+    assert a.stat("/lone")["size"] == 9
+    f.close()
+    assert a.stat("/lone")["size"] == 9
+
+
+def test_stat_from_other_client_recalls_buffer(two_fs):
+    a, b = two_fs
+    f = a.open("/statme", "w")
+    f.write(b"x" * 1000)
+    # A holds BUFFER: size is dirty client-side only.  B's stat must
+    # force A's flush before answering (the coherence rule).
+    st = b.stat("/statme")
+    assert st["size"] == 1000
+    f.close()
+
+
+def test_second_reader_shares_cache(two_fs):
+    a, b = two_fs
+    with a.open("/shared", "w") as f:
+        f.write(b"data")
+    fa = a.open("/shared", "r")
+    fb = b.open("/shared", "r")
+    assert fa.state.caps & CACHE
+    assert fb.state.caps & CACHE
+    assert not fb.state.caps & WR
+    fa.close()
+    fb.close()
+
+
+def test_mixed_writer_reader_goes_sync_and_coherent(two_fs):
+    a, b = two_fs
+    fw = a.open("/mixed", "w")
+    fw.write(b"first-version")          # buffered (lone writer)
+    fr = b.open("/mixed", "r")
+    # B's open revoked A's buffer: A flushed, both are in sync mode now
+    assert not fw.state.caps & BUFFER
+    assert not fr.state.caps & CACHE
+    assert fr.read() == b"first-version"
+    # sync mode: every subsequent write is immediately visible
+    fw.seek(0)
+    fw.write(b"SECON")
+    fr.seek(0)
+    assert fr.read() == b"SECON-version"
+    fw.close()
+    fr.close()
+
+
+def test_interleaved_writes_two_clients_coherent(two_fs):
+    """Conflicting writers on one file: all I/O degrades to sync and
+    each client's reads see the other's latest write."""
+    a, b = two_fs
+    fa = a.open("/both", "w")
+    fb = b.open("/both", "w")
+    assert not fa.state.caps & BUFFER and not fb.state.caps & BUFFER
+    for i in range(5):
+        fa.seek(i * 10)
+        fa.write(f"A{i:04d}x".encode())
+        fb.seek(i * 10 + 5)
+        fb.write(f"B{i:04d}".encode())
+        fa.seek(i * 10)
+        got_a = fa.read(11)
+        assert got_a[5:10] == f"B{i:04d}".encode(), (i, got_a)
+    fb.seek(0)
+    assert fb.read(5) == b"A0000"   # B sees A's writes too
+    fa.close()
+    fb.close()
+
+
+def test_writer_upgraded_back_when_reader_leaves(two_fs):
+    a, b = two_fs
+    fw = a.open("/upgrade", "w")
+    fr = b.open("/upgrade", "r")
+    assert not fw.state.caps & BUFFER   # shared: sync
+    fr.close()
+    deadline = time.time() + 5
+    while not fw.state.caps & BUFFER and time.time() < deadline:
+        time.sleep(0.05)
+    # Locker re-evals on release: the now-lone writer buffers again
+    assert fw.state.caps & BUFFER
+    fw.write(b"fast again")
+    fw.close()
+
+
+def test_dead_client_evicted_not_wedged(cluster):
+    """A SIGKILL'd client (no unmount, no acks) must not block others:
+    the MDS evicts it on session/revoke timeout."""
+    dead = CephFS(cluster.mon_host, cluster.mds.addr,
+                  ms_type="loopback", client_id=80)
+    dead.mount()
+    f = dead.open("/zombie", "w")
+    f.write(b"never flushed")
+    # simulate SIGKILL: drop the messengers without close/unmount
+    dead._stop = True
+    if dead._renew_timer:
+        dead._renew_timer.cancel()
+    dead.msgr.shutdown()
+    dead.rados.shutdown()
+
+    live = CephFS(cluster.mon_host, cluster.mds.addr,
+                  ms_type="loopback", client_id=81)
+    live.mount()
+    try:
+        t0 = time.time()
+        st = live.stat("/zombie")       # parks until eviction fires
+        assert time.time() - t0 < cluster.mds.revoke_grace + 8
+        # the zombie's buffered data is lost (never flushed) — size is
+        # whatever the MDS had acked: 0.  Crucially we got an answer.
+        assert st["size"] == 0
+        with live.open("/zombie", "w") as g:
+            g.write(b"new owner")
+        assert live.stat("/zombie")["size"] == 9
+    finally:
+        live.unmount()
+
+
+# -- locks -------------------------------------------------------------------
+
+def test_fcntl_ranges_across_clients(two_fs):
+    a, b = two_fs
+    with a.open("/lockf", "w") as f:
+        f.write(b"z" * 100)
+    fa = a.open("/lockf", "r")
+    fb = b.open("/lockf", "r")
+    fa.lockf(F_WRLCK, 0, 50)
+    with pytest.raises(OSError):        # EAGAIN
+        fb.lockf(F_WRLCK, 40, 20)
+    fb.lockf(F_WRLCK, 50, 50)           # disjoint: fine
+    got = fb.getlk(F_WRLCK, 0, 10)
+    assert got is not None and got["type"] == F_WRLCK
+    fa.lockf(F_UNLCK := 2, 0, 50)
+    fb.lockf(F_WRLCK, 0, 50)            # now free
+    fa.close()
+    fb.close()
+
+
+def test_blocking_lock_granted_on_unlock(two_fs):
+    a, b = two_fs
+    with a.open("/lockw", "w") as f:
+        f.write(b"z" * 10)
+    fa = a.open("/lockw", "r")
+    fb = b.open("/lockw", "r")
+    fa.lockf(F_WRLCK, 0, 10)
+    got_it = threading.Event()
+
+    def blocked():
+        fb.lockf(F_WRLCK, 0, 10, wait=True)
+        got_it.set()
+
+    t = threading.Thread(target=blocked, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    assert not got_it.is_set()          # genuinely blocked
+    fa.lockf(2, 0, 10)                  # unlock
+    assert got_it.wait(5), "blocked locker never woke"
+    fa.close()
+    fb.close()
+
+
+def test_flock_whole_file_and_handle_close_release(two_fs):
+    a, b = two_fs
+    with a.open("/flk", "w") as f:
+        f.write(b"z")
+    fa = a.open("/flk", "r")
+    fb = b.open("/flk", "r")
+    fa.flock(F_WRLCK)
+    with pytest.raises(OSError):
+        fb.flock(F_RDLCK)
+    fa.close()                          # handle close releases flock
+    fb.flock(F_WRLCK)                   # now acquirable
+    fb.close()
+
+
+def test_lock_released_on_client_death(cluster):
+    dead = CephFS(cluster.mon_host, cluster.mds.addr,
+                  ms_type="loopback", client_id=90)
+    dead.mount()
+    with dead.open("/dlock", "w") as f:
+        f.write(b"z")
+    fd = dead.open("/dlock", "r")
+    fd.lockf(F_WRLCK, 0, 1)
+    dead._stop = True
+    if dead._renew_timer:
+        dead._renew_timer.cancel()
+    dead.msgr.shutdown()
+    dead.rados.shutdown()
+
+    live = CephFS(cluster.mon_host, cluster.mds.addr,
+                  ms_type="loopback", client_id=91)
+    live.mount()
+    try:
+        fl = live.open("/dlock", "r")
+        # blocks until the dead session is evicted, then grants
+        fl.lockf(F_WRLCK, 0, 1, wait=True)
+        fl.close()
+    finally:
+        live.unmount()
+
+
+def test_stalled_client_session_killed_and_notified(cluster):
+    """A live client that ignores revokes past the grace loses its whole
+    session (reference: session kill + blocklist on revoke timeout): the
+    MDS notifies it, its caps die, and the other client proceeds."""
+    stall = CephFS(cluster.mon_host, cluster.mds.addr,
+                   ms_type="loopback", client_id=95)
+    stall.mount()
+    f = stall.open("/stall", "w")
+    f.write(b"never acked")
+    # wedge the client: it silently drops every cap message
+    stall._handle_caps = lambda msg: None
+
+    live = CephFS(cluster.mon_host, cluster.mds.addr,
+                  ms_type="loopback", client_id=96)
+    live.mount()
+    try:
+        st = live.stat("/stall")       # parks until the eviction
+        assert st["size"] == 0         # unflushed buffer died with it
+        deadline = time.time() + 5
+        while not stall._evicted and time.time() < deadline:
+            time.sleep(0.05)
+        assert stall._evicted          # the kill was notified
+        with pytest.raises(OSError):
+            stall.stat("/stall")       # evicted session refuses ops
+    finally:
+        live.unmount()
+        stall._stop = True
+        if stall._renew_timer:
+            stall._renew_timer.cancel()
+        stall.msgr.shutdown()
+        stall.rados.shutdown()
+
+
+def test_unlink_invalidates_other_holders(two_fs):
+    """Unlinking a file another client has open+buffered notifies that
+    holder: its caps are void, buffered data is dropped, and its close
+    surfaces an error instead of silently recreating purged data."""
+    a, b = two_fs
+    f = a.open("/doomed", "w")
+    f.write(b"soon gone")
+    b.unlink("/doomed")
+    deadline = time.time() + 5
+    while f.state.caps and time.time() < deadline:
+        time.sleep(0.05)
+    assert f.state.caps == 0 and not f.state.dirty
+    with pytest.raises(OSError):
+        f.close()                      # size report hits ENOENT
