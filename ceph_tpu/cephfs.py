@@ -70,10 +70,13 @@ class _CapState:
 
 
 class CephFS(Dispatcher):
-    def __init__(self, mon_addr: str, mds_addr: str,
+    def __init__(self, mon_addr: str, mds_addr: str | None = None,
                  ms_type: str = "async", timeout: float = 10.0,
                  auth_key=None, client_id: int | None = None):
+        #: None = resolve the active MDS from the mon's FSMap (and
+        #: fail over to its successor when it dies)
         self.mds_addr = mds_addr
+        self._auto_mds = mds_addr is None
         self.timeout = timeout
         self.rados = RadosClient(mon_addr, ms_type=ms_type,
                                  auth_key=auth_key)
@@ -90,7 +93,13 @@ class CephFS(Dispatcher):
         self._data_pool: int | None = None
         self._caps: dict[int, _CapState] = {}
         #: serializes open vs last-close so a concurrent open can never
-        #: interleave with a cap_release and orphan its cap state
+        #: interleave with a cap_release and orphan its cap state.
+        #: Deliberately client-wide (the reference holds client_lock
+        #: across whole ops too): an open parked behind another
+        #: client's revoke stalls this mount's other opens for up to
+        #: revoke_grace — bounded, rare, and safe; a per-ino scope
+        #: can't exclude the close because open learns the ino only
+        #: from the reply
         self._oc_lock = threading.Lock()
         self._next_fh = 1
         #: last known ino per opened path (open-timeout cancel guard)
@@ -107,6 +116,8 @@ class CephFS(Dispatcher):
 
     def mount(self) -> None:
         self.rados.connect()
+        if self._auto_mds:
+            self.mds_addr = self._resolve_mds()
         if _is_tcp(self.msgr):
             self.msgr.bind("127.0.0.1:0")
         else:
@@ -117,6 +128,49 @@ class CephFS(Dispatcher):
         self._data_pool = st["data_pool"]
         self.data_io = self.rados.open_ioctx(self._data_pool)
         self._schedule_renew()
+
+    def _resolve_mds(self, rank: int = 0, timeout: float = 20.0,
+                     not_addr: str | None = None) -> str:
+        """Active MDS address for a rank, from the FSMap the mon
+        publishes on the cluster map.  With not_addr, prefer a
+        DIFFERENT address (the one that just timed out is probably the
+        dead daemon still listed while the mon's grace runs); fall back
+        to it only once the wait expires."""
+        deadline = time.time() + timeout
+        last = None
+        while time.time() < deadline:
+            fs = self.rados.osdmap.fs_db
+            ent = (fs or {}).get("ranks", {}).get(str(rank))
+            if ent:
+                last = ent["addr"]
+                if not_addr is None or last != not_addr:
+                    return last
+            time.sleep(0.1)
+        if last is not None:
+            return last     # unchanged: the MDS may just be slow
+        raise TimeoutError(f"no active mds rank {rank} in fsmap")
+
+    def _failover(self) -> bool:
+        """An MDS request timed out: find the (possibly new) active
+        rank, re-open our session there, and reassert the caps we hold
+        (Client::handle_mds_map reconnect)."""
+        try:
+            new = self._resolve_mds(not_addr=self.mds_addr)
+            self.mds_addr = new
+            self._session("request_open")
+            with self._lock:
+                entries = [{"ino": st.ino, "caps": st.caps,
+                            "size": st.size, "mtime": st.mtime}
+                           for st in self._caps.values() if st.caps]
+                # the new rank's seq generation starts fresh: stale
+                # high-water marks would silently drop its grants
+                self._cap_seq_seen.clear()
+            if entries:
+                self._request("cap_reassert", {"caps": entries},
+                              _retry=False)
+            return True
+        except (OSError, TimeoutError):
+            return False
 
     def unmount(self) -> None:
         self._stop = True
@@ -206,7 +260,8 @@ class CephFS(Dispatcher):
             raise TimeoutError(f"mds session {op} timed out")
 
     def _request(self, op: str, args: dict,
-                 timeout: float | None = None) -> dict:
+                 timeout: float | None = None,
+                 _retry: bool = True) -> dict:
         if self._evicted:
             raise OSError(108, "session evicted by mds (remount)")
         args = dict(args)
@@ -217,6 +272,9 @@ class CephFS(Dispatcher):
         if not ev[0].wait(self.timeout if timeout is None else timeout):
             with self._lock:
                 self._waiters.pop(tid, None)
+            if self._auto_mds and _retry and not self._stop \
+                    and self._failover():
+                return self._request(op, args, timeout, _retry=False)
             raise TimeoutError(f"mds request {op} timed out")
         reply = ev[1][0]
         if reply.result < 0:

@@ -185,6 +185,17 @@ class CapTable:
         g.issued = g.pending
         return True
 
+    def reassert(self, ino: int, client: int, caps: int) -> None:
+        """Failover rejoin: install a client-asserted grant wholesale
+        (the new rank has no cap state; within the reconnect window the
+        clients' view IS the truth — reference MDCache::rejoin)."""
+        ic = self._inos.setdefault(ino, InoCaps())
+        g = ic.grants.setdefault(client, CapGrant())
+        g.issued = caps
+        g.wanted = caps
+        g.pending = caps
+        g.seq = max(g.seq, 1)
+
     def force_drop(self, ino: int, client: int) -> None:
         """Evict one client's grant without an ack (dead session)."""
         ic = self._inos.get(ino)

@@ -196,13 +196,27 @@ class MDSDaemon(Dispatcher):
     """Single-rank MDS (the reference scales ranks via dirfrag export;
     the namespace model below is rank-count agnostic)."""
 
-    def __init__(self, mon_addr: str, metadata_pool: int, data_pool: int,
+    RECONNECT_GRACE = 2.0
+    BEACON_INTERVAL = 1.0
+
+    def __init__(self, mon_addr: str, metadata_pool: int | None = None,
+                 data_pool: int | None = None,
                  ctx: CephTpuContext | None = None, ms_type: str = "async",
-                 addr: str = "127.0.0.1:0", auth_key=None):
-        self.ctx = ctx or CephTpuContext("mds.0")
+                 addr: str = "127.0.0.1:0", auth_key=None,
+                 gid: int | None = None):
+        import os as _os
+        self.gid = gid if gid is not None else \
+            int.from_bytes(_os.urandom(6), "big")
+        self.mon_addr = mon_addr
+        self.rank: int | None = None
+        self.ctx = ctx or CephTpuContext(f"mds.{self.gid}")
         self.name = EntityName("mds", 0)
         self.metadata_pool = metadata_pool
         self.data_pool = data_pool
+        #: 0 = no reconnect window; else: until this time, cap-granting
+        #: client ops park while old clients reassert (MDS rejoin)
+        self._reconnect_until = 0.0
+        self._beacon_timer: threading.Timer | None = None
         self._lock = threading.RLock()
         #: ino -> Inode (inode cache; authoritative once loaded)
         self._inodes: dict[int, Inode] = {}
@@ -246,7 +260,10 @@ class MDSDaemon(Dispatcher):
     # -- lifecycle ------------------------------------------------------------
 
     def init(self) -> None:
+        """Direct single-MDS bring-up (no FSMap registration): rank 0,
+        journal 'mdlog'.  The FSMap path is init_standby()."""
         self.objecter.connect()
+        self.rank = 0
         self.meta_io = self.objecter.open_ioctx(self.metadata_pool)
         self.journal = Journaler(self.meta_io, "mdlog")
         self._load_or_mkfs()
@@ -262,6 +279,75 @@ class MDSDaemon(Dispatcher):
         self.msgr.start()
         self._schedule_tick()
 
+    def init_standby(self) -> None:
+        """FSMap bring-up: register with the mon via beacons and wait
+        for a rank (MDSMonitor assignment); standbys idle until a
+        failover promotes them."""
+        self.objecter.connect()
+        self.msgr.bind(self._addr)
+        self.msgr.start()
+        self.state = "standby"
+        self._schedule_tick()
+        self._beacon()
+
+    def _beacon(self) -> None:
+        if self._stop:
+            return
+        from ceph_tpu.mon.monitor import MMDSBeacon
+        # fan out to EVERY mon (mon_addr is comma-separated): only the
+        # leader assigns ranks, and any mon may be the leader
+        for i, addr in enumerate(self.mon_addr.split(",")):
+            try:
+                con = self.msgr.connect_to(addr.strip(),
+                                           EntityName("mon", i))
+                con.send_message(MMDSBeacon(
+                    gid=self.gid, addr=self.msgr.my_addr,
+                    state=self.state,
+                    rank=-1 if self.rank is None else self.rank))
+            except OSError:
+                continue
+        self._beacon_timer = threading.Timer(self.BEACON_INTERVAL,
+                                             self._beacon)
+        self._beacon_timer.daemon = True
+        self._beacon_timer.start()
+
+    def _activate(self, rank: int) -> None:
+        """Standby promoted to a rank: replay that rank's journal and
+        open a reconnect window for the old clients' cap reasserts."""
+        # the pool ids live in the FSMap; our objecter's first map
+        # subscription may still be in flight — wait for it (outside
+        # the lock: map delivery needs the objecter's dispatch)
+        deadline = time.time() + 10.0
+        while not self.objecter.osdmap.fs_db and time.time() < deadline:
+            time.sleep(0.05)
+        with self._lock:
+            if self.rank is not None:
+                return
+            fs = self.objecter.osdmap.fs_db
+            if not fs:
+                dout("mds", 0, "mds gid %d: no fsmap in objecter map, "
+                     "cannot activate", self.gid)
+                return
+            self.rank = rank
+            if self.metadata_pool is None:
+                self.metadata_pool = fs["metadata_pool"]
+            if self.data_pool is None:
+                self.data_pool = fs["data_pool"]
+            self.meta_io = self.objecter.open_ioctx(self.metadata_pool)
+            self.journal = Journaler(self.meta_io, f"mdlog.{rank}")
+            self.state = "replay"
+            self._load_or_mkfs()
+            n = self.journal.replay(
+                lambda payload, _pos: self._replay_entry(payload))
+            dout("mds", 1, "mds gid %d rank %d: replayed %d events",
+                 self.gid, rank, n)
+            if n:
+                self._flush_dirty()
+                self.journal.trim()
+            self._reconnect_until = time.time() + self.RECONNECT_GRACE
+            self.state = "active"
+            self._rerun(0)      # requests that arrived pre-activation
+
     def _schedule_tick(self) -> None:
         if self._stop:
             return
@@ -273,6 +359,9 @@ class MDSDaemon(Dispatcher):
         try:
             now = time.time()
             with self._lock:
+                if self._reconnect_until and now >= self._reconnect_until:
+                    self._reconnect_until = 0.0
+                    self._rerun(0)
                 # silent revoke targets: the client never acked (dead or
                 # wedged) — evict the WHOLE session, exactly like the
                 # reference's session-kill on cap-revoke timeout.  A
@@ -354,9 +443,11 @@ class MDSDaemon(Dispatcher):
         self._stop = True
         if self._tick_timer:
             self._tick_timer.cancel()
+        if self._beacon_timer:
+            self._beacon_timer.cancel()
         with self._lock:
-            self._flush_dirty()
             if self.journal is not None:
+                self._flush_dirty()
                 self.journal.trim()
         self.msgr.shutdown()
         self.objecter.shutdown()
@@ -366,17 +457,25 @@ class MDSDaemon(Dispatcher):
         return self.msgr.my_addr
 
     def _load_or_mkfs(self) -> None:
+        fresh_fs = False
         try:
             table = self.meta_io.get_omap("mds.table")
             self._next_ino = int(table.get("next_ino", b"2").decode())
+        except OSError:
+            fresh_fs = True
+        # the journal is PER RANK: its absence does not mean the fs is
+        # fresh (a second active rank starts with an empty journal over
+        # an existing namespace)
+        try:
             self.journal.open()
         except OSError:
-            # fresh filesystem: root inode + empty journal
+            self.journal.create()
+        if fresh_fs:
+            # fresh filesystem: root inode
             self._inodes[ROOT_INO] = Inode(ROOT_INO, S_IFDIR | 0o755)
             self._dirs[ROOT_INO] = {}
             self._dirty_dirs.add(ROOT_INO)
             self._dirty_inodes.add(ROOT_INO)
-            self.journal.create()
             self._flush_dirty()
 
     # -- backing store (dirfrag omap objects) ---------------------------------
@@ -564,6 +663,12 @@ class MDSDaemon(Dispatcher):
         if isinstance(msg, MClientCaps):
             self._handle_caps_msg(msg)
             return True
+        from ceph_tpu.mon.monitor import MMDSBeacon
+        if isinstance(msg, MMDSBeacon):       # mon ack
+            if msg.state == "ack" and msg.rank >= 0 \
+                    and self.rank is None:
+                self._activate(msg.rank)
+            return True
         return False
 
     def _handle_request(self, msg) -> None:
@@ -691,6 +796,34 @@ class MDSDaemon(Dispatcher):
 
     def _handle(self, op: str, a: dict) -> tuple[int, dict]:
         client = int(a.get("client", -1))
+        if self.state != "active":
+            # the FSMap can point clients here before activation
+            # completes (or while we are a standby a stale client
+            # still targets): hold the request, activation reruns it
+            raise _Park(0)
+        if self._reconnect_until and op not in ("cap_reassert", "statfs"):
+            if time.time() < self._reconnect_until:
+                # reconnect window after a takeover: hold client ops
+                # until the old clients reasserted their caps (ino 0 is
+                # the window's wait key; the tick releases it)
+                raise _Park(0)
+            self._reconnect_until = 0.0
+            self._rerun(0)
+
+        if op == "cap_reassert":
+            # failover rejoin: a surviving client re-asserts the caps
+            # (and buffered size) it held under the dead rank — trusted
+            # within the window, like the reference's reconnect phase
+            for ent in a.get("caps", []):
+                self.caps.reassert(int(ent["ino"]), client,
+                                   int(ent["caps"]))
+                if ent.get("size", -1) >= 0 and \
+                        self._load_inode(int(ent["ino"])) is not None:
+                    self._mutate({"e": "setattr", "ino": int(ent["ino"]),
+                                  "size": int(ent["size"]), "grow": True,
+                                  "mtime": float(ent.get("mtime", 0.0))})
+            return 0, {}
+
         if op == "lookup":
             parent, ino, _name = self._resolve(a["path"])
             if ino is None:

@@ -141,6 +141,38 @@ class MMonForwardAck(Message):
         dec.versioned(1, body)
 
 
+@register_message
+class MMDSBeacon(Message):
+    """mds <-> mon liveness + rank assignment (messages/MMDSBeacon.h).
+    mds -> mon: gid/addr/state/load every beacon interval.
+    mon -> mds (ack): the rank this gid holds (-1 = standby)."""
+
+    TYPE = 100  # MSG_MDS_BEACON
+
+    def __init__(self, gid: int = 0, addr: str = "", state: str = "",
+                 rank: int = -1, load: float = 0.0):
+        super().__init__()
+        self.gid = gid
+        self.addr = addr
+        self.state = state
+        self.rank = rank
+        self.load = load
+
+    def encode_payload(self, enc: Encoder):
+        enc.versioned(1, 1, lambda e: (
+            e.u64(self.gid), e.str(self.addr), e.str(self.state),
+            e.s32(self.rank), e.f64(self.load)))
+
+    def decode_payload(self, dec: Decoder, version: int):
+        def body(d, v):
+            self.gid = d.u64()
+            self.addr = d.str()
+            self.state = d.str()
+            self.rank = d.s32()
+            self.load = d.f64()
+        dec.versioned(1, body)
+
+
 def _referenced_bucket_ids(crush) -> set:
     """Bucket/item ids that appear inside some bucket — i.e. everything
     but the root(s).  Shared by root detection and parent lookup."""
@@ -168,6 +200,14 @@ class Monitor(Dispatcher):
         self._subs: dict[str, tuple[str, EntityName]] = {}
         #: latest MPGStats per reporting OSD (PG_DEGRADED health feed)
         self._pg_stats: dict[int, dict] = {}
+        #: mds gid -> (last beacon time, addr, load) — mon-local
+        #: liveness (the FSMap itself is paxos state on the map)
+        self._mds_beacons: dict[int, tuple[float, str, float]] = {}
+        #: when this mon started watching beacons as leader: a gid we
+        #: have NEVER heard from is only dead once a full grace has
+        #: passed since then (a freshly-elected/restarted leader must
+        #: not fail every healthy rank on its first tick)
+        self._mds_watch_since: float | None = None
         self._osd_addrs: dict[int, str] = {}
         self.monmap: list[str] = []
         self.elector: Elector | None = None
@@ -263,6 +303,7 @@ class Monitor(Dispatcher):
     def _on_election_win(self, epoch: int, quorum: list[int]) -> None:
         dout("mon", 5, "mon.%d won election epoch %d quorum %s",
              self.mon_id, epoch, quorum)
+        self._mds_watch_since = None    # fresh grace for every rank
         self.paxos.leader_init(epoch, quorum)
 
     def _on_election_lose(self, epoch: int, leader: int,
@@ -304,8 +345,87 @@ class Monitor(Dispatcher):
                 self.elector.tick()
             if self.paxos:
                 self.paxos.tick()
+            if self.is_leader() and self.osdmap.fs_db:
+                self._check_mds_failures()
         finally:
             self._schedule_tick()
+
+    # -- FSMap / MDS cluster (MDSMonitor analog) ------------------------------
+
+    MDS_BEACON_GRACE = 6.0
+
+    def _check_mds_failures(self) -> None:
+        """Leader tick: a rank whose gid stopped beaconing is failed;
+        promote a standby into it (MDSMonitor::maybe_replace_gid)."""
+        now = time.time()
+        if self._mds_watch_since is None:
+            self._mds_watch_since = now
+        fs = self.osdmap.fs_db
+        dead = []
+        for rank, ent in fs.get("ranks", {}).items():
+            seen = self._mds_beacons.get(ent["gid"])
+            t0 = seen[0] if seen is not None else self._mds_watch_since
+            if now - t0 > self.MDS_BEACON_GRACE:
+                dead.append((rank, ent["gid"]))
+        if not dead:
+            return
+        self._work_q.put(("mds_failover", dead, None))
+
+    def _do_mds_failover(self, dead: list) -> None:
+        def fn(m: OSDMap):
+            fs = m.fs_db
+            if not fs:
+                return False
+            changed = False
+            for rank, gid in dead:
+                ent = fs.get("ranks", {}).get(rank)
+                if ent is None or ent["gid"] != gid:
+                    continue    # already replaced
+                del fs["ranks"][rank]
+                changed = True
+                if fs.get("standbys"):
+                    nxt = fs["standbys"].pop(0)
+                    fs["ranks"][rank] = nxt
+                    dout("mon", 1, "fsmap: rank %s failed (gid %d), "
+                         "promoting gid %d", rank, gid, nxt["gid"])
+                else:
+                    dout("mon", 1, "fsmap: rank %s failed (gid %d), "
+                         "no standby", rank, gid)
+            return changed     # False = no paxos round for a stale item
+        self._mutate(fn)
+
+    def _do_mds_beacon(self, msg) -> None:
+        """Worker-thread half: FSMap mutations for a new/boot gid."""
+        def fn(m: OSDMap):
+            fs = m.fs_db
+            if not fs:
+                return False
+            ranks = fs.setdefault("ranks", {})
+            standbys = fs.setdefault("standbys", [])
+            known = {e["gid"] for e in ranks.values()} | \
+                    {e["gid"] for e in standbys}
+            if msg.gid in known:
+                return False
+            ent = {"gid": msg.gid, "addr": msg.addr}
+            for r in range(int(fs.get("max_mds", 1))):
+                if str(r) not in ranks:
+                    ranks[str(r)] = ent
+                    dout("mon", 2, "fsmap: gid %d -> rank %d",
+                         msg.gid, r)
+                    return None
+            standbys.append(ent)
+            return None
+        self._mutate(fn)
+
+    def _beacon_ack(self, msg) -> None:
+        fs = self.osdmap.fs_db
+        rank = -1
+        for r, ent in fs.get("ranks", {}).items():
+            if ent["gid"] == msg.gid:
+                rank = int(r)
+                break
+        msg.connection.send_message(MMDSBeacon(
+            gid=msg.gid, addr=msg.addr, state="ack", rank=rank))
 
     # -- the mutation path (worker thread only) -------------------------------
 
@@ -332,6 +452,10 @@ class Monitor(Dispatcher):
                     self._do_boot(payload)
                 elif kind == "failure":
                     self._do_failure(payload)
+                elif kind == "mds_beacon":
+                    self._do_mds_beacon(payload)
+                elif kind == "mds_failover":
+                    self._do_mds_failover(payload)
             except Exception:
                 from ceph_tpu.common.logging import get_logger
                 get_logger("mon").exception("mon.%d work item failed",
@@ -418,6 +542,19 @@ class Monitor(Dispatcher):
             return True
         if isinstance(msg, MOSDFailure):
             self._work_q.put(("failure", msg, None))
+            return True
+        if isinstance(msg, MMDSBeacon):
+            with self._lock:
+                self._mds_beacons[msg.gid] = (time.time(), msg.addr,
+                                              msg.load)
+                fs = self.osdmap.fs_db
+                known = bool(fs) and any(
+                    e["gid"] == msg.gid
+                    for e in list(fs.get("ranks", {}).values())
+                    + fs.get("standbys", []))
+            if fs and not known and self.is_leader():
+                self._work_q.put(("mds_beacon", msg, None))
+            self._beacon_ack(msg)
             return True
         if isinstance(msg, MOSDPing):
             return True  # mon liveness probe, nothing to do
@@ -643,6 +780,36 @@ class Monitor(Dispatcher):
                 if not self._mutate(fn):
                     return "commit failed", -11
                 return "removed", 0
+            if prefix == "fs new":
+                return self._cmd_fs_new(cmd)
+            if prefix == "fs status":
+                fs = dict(self.osdmap.fs_db)
+                now = time.time()
+                with self._lock:
+                    fs["beacons"] = {
+                        str(g): round(now - t[0], 2)
+                        for g, t in self._mds_beacons.items()}
+                return json.dumps(fs), 0
+            if prefix == "fs set":
+                if str(cmd.get("var")) != "max_mds":
+                    return "only max_mds is settable", -22
+                n = int(cmd["val"])
+                if n < 1:
+                    return "max_mds must be >= 1", -22
+
+                def fn(m: OSDMap):
+                    if not m.fs_db:
+                        return False
+                    m.fs_db["max_mds"] = n
+                    # grow: promote standbys into the new ranks now
+                    ranks = m.fs_db.setdefault("ranks", {})
+                    sb = m.fs_db.setdefault("standbys", [])
+                    for r in range(n):
+                        if str(r) not in ranks and sb:
+                            ranks[str(r)] = sb.pop(0)
+                if not self._mutate(fn):
+                    return "commit failed", -11
+                return json.dumps({"max_mds": n}), 0
             if prefix == "quorum_status":
                 return json.dumps({
                     "quorum": self.quorum(),
@@ -872,6 +1039,28 @@ class Monitor(Dispatcher):
             return f"unknown command {prefix!r}", -22
         except (KeyError, ValueError, IndexError) as e:
             return f"command failed: {e}", -22
+
+    def _cmd_fs_new(self, cmd) -> tuple[str, int]:
+        """`ceph fs new <name> <metadata_pool> <data_pool>`
+        (MDSMonitor's filesystem creation)."""
+        import json
+        name = str(cmd.get("fs_name", "cephfs"))
+        meta = int(cmd["metadata"])
+        data = int(cmd["data"])
+        if meta not in self.osdmap.pools or data not in self.osdmap.pools:
+            return "metadata/data pool does not exist", -2
+        if self.osdmap.fs_db:
+            return f"filesystem {self.osdmap.fs_db['name']!r} exists", -17
+
+        def fn(m: OSDMap):
+            if m.fs_db:
+                return False
+            m.fs_db = {"name": name, "max_mds": 1,
+                       "metadata_pool": meta, "data_pool": data,
+                       "ranks": {}, "standbys": []}
+        if not self._mutate(fn):
+            return "commit failed", -11
+        return json.dumps({"fs_name": name}), 0
 
     def _cmd_pool_create(self, cmd) -> tuple[str, int]:
         result: list[int] = []

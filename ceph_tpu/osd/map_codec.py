@@ -205,8 +205,10 @@ def encode_osdmap(m: OSDMap, *, with_auth: bool = False) -> bytes:
         # v7: auth key table (AuthMonitor key space) — mon-internal only
         e.bytes(_json.dumps(m.auth_db).encode()
                 if (with_auth and m.auth_db) else b"")
+        # v8: FSMap (MDSMonitor FSMap) — public, clients route by it
+        e.bytes(_json.dumps(m.fs_db).encode() if m.fs_db else b"")
 
-    enc.versioned(7, 1, body)
+    enc.versioned(8, 1, body)
     return enc.tobytes()
 
 
@@ -266,6 +268,7 @@ def decode_osdmap(data: bytes) -> OSDMap:
             xinfo.append(OSDXInfo())
         config_db = {}
         auth_db = {}
+        fs_db = {}
         if version >= 6:
             import json as _json
             blob = d.bytes()
@@ -275,8 +278,12 @@ def decode_osdmap(data: bytes) -> OSDMap:
                 blob = d.bytes()
                 if blob:
                     auth_db = _json.loads(blob.decode())
+            if version >= 8:
+                blob = d.bytes()
+                if blob:
+                    fs_db = _json.loads(blob.decode())
         return OSDMap(epoch=epoch, crush=crush, max_osd=max_osd,
-                      config_db=config_db, auth_db=auth_db,
+                      config_db=config_db, auth_db=auth_db, fs_db=fs_db,
                       crush_names=crush_names, osd_xinfo=xinfo,
                       osd_state=osd_state, osd_weight=osd_weight,
                       osd_primary_affinity=affinity, osd_addrs=osd_addrs,

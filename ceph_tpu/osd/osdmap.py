@@ -134,6 +134,10 @@ class OSDMap:
     #: auth key table (mon/AuthMonitor analog): entity ("client.admin",
     #: "osd.3", ...) -> base64 key; issued by `auth get-or-create`
     auth_db: dict = field(default_factory=dict)
+    #: FSMap (mon/MDSMonitor FSMap analog): {"name", "max_mds",
+    #: "metadata_pool", "data_pool", "ranks": {rank-str: {"gid",
+    #: "addr"}}, "standbys": [{"gid", "addr"}]} — empty until `fs new`
+    fs_db: dict = field(default_factory=dict)
     # overrides
     pg_upmap: dict[tuple[int, int], list[int]] = field(default_factory=dict)
     pg_upmap_items: dict[tuple[int, int], list[tuple[int, int]]] = \

@@ -42,6 +42,10 @@ class MiniCluster:
         self.auth_key = auth_key
         self.mgr = None
         self.mds = None
+        self.fs_mds: list = []
+        #: monotonic: a crashed daemon's loopback name is NEVER reused
+        #: (len(fs_mds) would rebind a live daemon's address)
+        self._fs_mds_seq = 0
 
     def _is_wire(self) -> bool:
         """TCP-style stacks bind host:port; loopback/ici bind names."""
@@ -121,6 +125,35 @@ class MiniCluster:
         self.mds.init()
         return self.mds
 
+    def run_fs_mds(self, n: int = 1):
+        """FSMap mode: start n beaconing MDS daemons; the mon assigns
+        ranks (up to max_mds), the rest idle as standbys.  Run `fs new`
+        first."""
+        from ceph_tpu.mds import MDSDaemon
+        out = []
+        for i in range(n):
+            idx = self._fs_mds_seq
+            self._fs_mds_seq += 1
+            addr = ("127.0.0.1:0" if self._is_wire()
+                    else f"{self._ns}mds.g{idx}")
+            d = MDSDaemon(self.mon_host, ms_type=self.ms_type,
+                          addr=addr, auth_key=self.auth_key)
+            d.init_standby()
+            self.fs_mds.append(d)
+            out.append(d)
+        return out
+
+    def crash_fs_mds(self, d) -> None:
+        """SIGKILL-style: no flush, no journal trim, no goodbye."""
+        d._stop = True
+        for t in (d._tick_timer, d._beacon_timer):
+            if t:
+                t.cancel()
+        d.msgr.shutdown()
+        d.objecter.shutdown()
+        if d in self.fs_mds:
+            self.fs_mds.remove(d)
+
     def kill_mds(self) -> None:
         mds = self.mds
         self.mds = None
@@ -157,6 +190,9 @@ class MiniCluster:
         if self.mds:
             self.mds.shutdown()
             self.mds = None
+        for d in list(self.fs_mds):
+            d.shutdown()
+        self.fs_mds = []
         for osd in list(self.osds.values()):
             osd.shutdown()
         self.osds.clear()

@@ -1,0 +1,132 @@
+"""FSMap in the mon + MDS beacons + standby promotion + client
+failover with cap reassert (mon/MDSMonitor.cc + MMDSBeacon + client
+reconnect analogs)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ceph_tpu.cephfs import CephFS
+from ceph_tpu.mds.caps import BUFFER
+from ceph_tpu.tools.vstart import MiniCluster
+
+
+@pytest.fixture
+def fs_cluster():
+    c = MiniCluster(n_osds=3, ms_type="loopback").start()
+    try:
+        c.wait_for_osd_count(3)
+        client = c.client(timeout=20.0)
+        meta = c.create_pool(client, pg_num=4, size=2)
+        data = c.create_pool(client, pg_num=8, size=2)
+        rc, out = client.mon_command({"prefix": "fs new",
+                                      "fs_name": "cephfs",
+                                      "metadata": meta, "data": data})
+        assert rc == 0, out
+        yield c, client
+    finally:
+        c.stop()
+
+
+def _wait_rank0(client, timeout=15.0, not_gid=None):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        fs = client.osdmap.fs_db
+        ent = (fs or {}).get("ranks", {}).get("0")
+        if ent and (not_gid is None or ent["gid"] != not_gid):
+            return ent
+        time.sleep(0.1)
+    raise AssertionError("rank 0 never (re)filled")
+
+
+def test_fs_new_and_rank_assignment(fs_cluster):
+    c, client = fs_cluster
+    mds, standby = c.run_fs_mds(2)
+    ent = _wait_rank0(client)
+    # one daemon got rank 0, the other parked as standby
+    active = mds if ent["gid"] == mds.gid else standby
+    other = standby if active is mds else mds
+    deadline = time.time() + 10
+    while active.rank is None and time.time() < deadline:
+        time.sleep(0.05)
+    assert active.rank == 0 and active.state == "active"
+    assert other.rank is None and other.state == "standby"
+    rc, out = client.mon_command({"prefix": "fs status"})
+    assert rc == 0 and "ranks" in out
+
+
+def test_failover_promotes_standby_and_client_survives(fs_cluster):
+    c, client = fs_cluster
+    c.run_fs_mds(2)
+    ent0 = _wait_rank0(client)
+
+    fs = CephFS(c.mon_host, ms_type="loopback", client_id=301)
+    fs.mount()                       # auto-resolves rank 0 from FSMap
+    try:
+        fs.mkdir("/surv")
+        f = fs.open("/surv/file", "w")
+        f.write(b"pre-failover data")
+        f.close()                    # flushed + journaled on rank 0
+        f2 = fs.open("/surv/file", "w")
+        assert f2.state.caps & BUFFER
+        f2.write(b"POST", )          # buffered under held caps
+
+        # SIGKILL the active MDS: no flush, no goodbye
+        active = next(d for d in c.fs_mds if d.gid == ent0["gid"])
+        c.crash_fs_mds(active)
+        ent1 = _wait_rank0(client, timeout=20.0, not_gid=ent0["gid"])
+        assert ent1["gid"] != ent0["gid"]
+
+        # the client's next MDS op fails over, reasserts caps, and the
+        # replayed journal preserves everything acked: the open-"w"
+        # truncate to 0 was journaled, and the buffered 4-byte write
+        # rides the reassert
+        st = fs.stat("/surv/file")
+        assert st["size"] == 4
+        f2.write(b"-and-more")       # caps still usable post-reassert
+        f2.close()
+        assert fs.stat("/surv/file")["size"] == 13
+        got = fs.open("/surv/file").read()
+        assert got == b"POST-and-more"
+        assert fs.listdir("/")       # namespace intact
+    finally:
+        fs.unmount()
+
+
+def test_standby_keeps_beaconing_and_refills(fs_cluster):
+    """After a failover consumes the standby, a NEW daemon joining
+    becomes the next standby; a second failover promotes it too."""
+    c, client = fs_cluster
+    c.run_fs_mds(2)
+    ent0 = _wait_rank0(client)
+    active0 = next(d for d in c.fs_mds if d.gid == ent0["gid"])
+    c.crash_fs_mds(active0)
+    ent1 = _wait_rank0(client, timeout=20.0, not_gid=ent0["gid"])
+
+    c.run_fs_mds(1)                  # late joiner becomes standby
+    active1 = next(d for d in c.fs_mds if d.gid == ent1["gid"])
+    c.crash_fs_mds(active1)
+    ent2 = _wait_rank0(client, timeout=20.0, not_gid=ent1["gid"])
+    assert ent2["gid"] not in (ent0["gid"], ent1["gid"])
+
+
+def test_fsmap_with_three_mons():
+    """Beacons must reach the leader wherever it is (they fan out to
+    every mon in the comma-separated mon host list)."""
+    c = MiniCluster(n_osds=2, ms_type="loopback", n_mons=3).start()
+    try:
+        c.wait_for_osd_count(2)
+        client = c.client(timeout=20.0)
+        meta = c.create_pool(client, pg_num=4, size=2)
+        data = c.create_pool(client, pg_num=4, size=2)
+        rc, out = client.mon_command({"prefix": "fs new",
+                                      "fs_name": "cephfs",
+                                      "metadata": meta, "data": data})
+        assert rc == 0, out
+        c.run_fs_mds(1)
+        ent = _wait_rank0(client)
+        assert ent["gid"] == c.fs_mds[0].gid
+    finally:
+        c.stop()
