@@ -1,0 +1,5 @@
+"""Authentication (src/auth/ analog): the cephx ticket protocol."""
+
+from ceph_tpu.auth.cephx import (  # noqa: F401
+    KeyServer, Ticket, TicketKeyring, derive_session_key,
+    mint_ticket, validate_ticket)

@@ -52,11 +52,12 @@ class _CapState:
     """Per-inode client cap state (Client::Inode + CapSnap, lite)."""
 
     __slots__ = ("ino", "caps", "inode", "attr_fresh", "size", "mtime",
-                 "dirty", "dirty_bytes", "nopen", "wb_lock")
+                 "dirty", "dirty_bytes", "nopen", "wb_lock", "rank")
 
     def __init__(self, ino: int):
         self.ino = ino
         self.caps = 0
+        self.rank = 0       # authoritative mds rank for this ino
         self.inode: dict = {}
         self.attr_fresh = False
         self.size = 0
@@ -72,19 +73,26 @@ class _CapState:
 class CephFS(Dispatcher):
     def __init__(self, mon_addr: str, mds_addr: str | None = None,
                  ms_type: str = "async", timeout: float = 10.0,
-                 auth_key=None, client_id: int | None = None):
+                 auth_key=None, client_id: int | None = None,
+                 cephx: tuple[str, str] | None = None):
         #: None = resolve the active MDS from the mon's FSMap (and
         #: fail over to its successor when it dies)
         self.mds_addr = mds_addr
         self._auto_mds = mds_addr is None
         self.timeout = timeout
         self.rados = RadosClient(mon_addr, ms_type=ms_type,
-                                 auth_key=auth_key)
+                                 auth_key=auth_key, cephx=cephx)
         cid = client_id if client_id is not None else self.rados.client_id
         self.client_id = cid
         self.name = EntityName("client", 10000 + cid)
         self.msgr = Messenger.create(self.name, ms_type)
         self.msgr.set_auth(auth_key)
+        if cephx is not None:
+            from ceph_tpu.auth.cephx import TicketKeyring
+            from ceph_tpu.auth.handshake import CephxConfig
+            self.msgr.set_auth_cephx(CephxConfig(
+                entity=cephx[0], key=cephx[1],
+                keyring=TicketKeyring(self.rados._fetch_ticket)))
         self.msgr.set_policy("mds", ConnectionPolicy.stateful_peer())
         self.msgr.add_dispatcher_tail(self)
         self._lock = threading.RLock()
@@ -108,6 +116,11 @@ class CephFS(Dispatcher):
         #: state, so an open reply racing an already-processed revoke
         #: never reinstalls the stale (higher) grant
         self._cap_seq_seen: dict[int, int] = {}
+        #: multi-active routing: cached rank addrs, opened sessions,
+        #: and last-known authoritative rank per path
+        self._rank_addr: dict[int, str] = {}
+        self._have_session: set[int] = set()
+        self._path_rank: dict[str, int] = {}
         self._renew_timer: threading.Timer | None = None
         self._stop = False
         self._evicted = False
@@ -118,16 +131,30 @@ class CephFS(Dispatcher):
         self.rados.connect()
         if self._auto_mds:
             self.mds_addr = self._resolve_mds()
+        self._rank_addr[0] = self.mds_addr
         if _is_tcp(self.msgr):
             self.msgr.bind("127.0.0.1:0")
         else:
             self.msgr.bind(f"fsclient.{self.name.id}")
         self.msgr.start()
-        self._session("request_open")
+        self._ensure_session(0)
         st = self._request("statfs", {})
         self._data_pool = st["data_pool"]
         self.data_io = self.rados.open_ioctx(self._data_pool)
         self._schedule_renew()
+
+    def _addr_of(self, rank: int) -> str:
+        addr = self._rank_addr.get(rank)
+        if addr is None:
+            addr = self._resolve_mds(rank=rank)
+            self._rank_addr[rank] = addr
+        return addr
+
+    def _ensure_session(self, rank: int) -> None:
+        if rank in self._have_session:
+            return
+        self._session("request_open", rank=rank)
+        self._have_session.add(rank)
 
     def _resolve_mds(self, rank: int = 0, timeout: float = 20.0,
                      not_addr: str | None = None) -> str:
@@ -150,24 +177,33 @@ class CephFS(Dispatcher):
             return last     # unchanged: the MDS may just be slow
         raise TimeoutError(f"no active mds rank {rank} in fsmap")
 
-    def _failover(self) -> bool:
-        """An MDS request timed out: find the (possibly new) active
-        rank, re-open our session there, and reassert the caps we hold
-        (Client::handle_mds_map reconnect)."""
+    def _failover(self, rank: int = 0) -> bool:
+        """An MDS request timed out: find the rank's (possibly new)
+        daemon, re-open our session there, and reassert the caps we
+        hold under that rank (Client::handle_mds_map reconnect)."""
         try:
-            new = self._resolve_mds(not_addr=self.mds_addr)
-            self.mds_addr = new
-            self._session("request_open")
+            new = self._resolve_mds(rank=rank,
+                                    not_addr=self._rank_addr.get(rank))
+            self._rank_addr[rank] = new
+            if rank == 0:
+                self.mds_addr = new
+            self._have_session.discard(rank)
+            self._ensure_session(rank)
             with self._lock:
                 entries = [{"ino": st.ino, "caps": st.caps,
                             "size": st.size, "mtime": st.mtime}
-                           for st in self._caps.values() if st.caps]
+                           for st in self._caps.values()
+                           if st.caps and st.rank == rank]
                 # the new rank's seq generation starts fresh: stale
-                # high-water marks would silently drop its grants
-                self._cap_seq_seen.clear()
+                # high-water marks would silently drop its grants.
+                # Clear for EVERY ino homed on this rank — including
+                # fully-revoked ones (caps==0) we don't reassert
+                for st in self._caps.values():
+                    if st.rank == rank:
+                        self._cap_seq_seen.pop(st.ino, None)
             if entries:
                 self._request("cap_reassert", {"caps": entries},
-                              _retry=False)
+                              _retry=False, rank=rank)
             return True
         except (OSError, TimeoutError):
             return False
@@ -185,10 +221,11 @@ class CephFS(Dispatcher):
                 # teardown is best-effort; per-file errors were the
                 # owner's to observe via fsync/close
                 pass
-        try:
-            self._session("request_close")
-        except (OSError, TimeoutError):
-            pass
+        for rank in list(self._have_session):
+            try:
+                self._session("request_close", rank=rank)
+            except (OSError, TimeoutError):
+                pass
         self.msgr.shutdown()
         self.rados.shutdown()
 
@@ -201,11 +238,16 @@ class CephFS(Dispatcher):
 
     def _renew(self) -> None:
         try:
-            con = self.msgr.connect_to(self.mds_addr, EntityName("mds", 0))
-            con.send_message(MClientSession(op="renew",
-                                            client=self.client_id))
-        except OSError:
-            pass
+            for rank in list(self._have_session):
+                try:
+                    con = self.msgr.connect_to(self._addr_of(rank),
+                                               EntityName("mds", 0))
+                    con.send_message(MClientSession(
+                        op="renew", client=self.client_id))
+                except (OSError, TimeoutError):
+                    # one dead rank must not starve the OTHER ranks'
+                    # renewals (they would evict a healthy client)
+                    continue
         finally:
             self._schedule_renew()
 
@@ -249,9 +291,10 @@ class CephFS(Dispatcher):
             self._waiters[tid] = ev
         return tid, ev
 
-    def _session(self, op: str) -> None:
+    def _session(self, op: str, rank: int = 0) -> None:
         tid, ev = self._alloc_tid()
-        con = self.msgr.connect_to(self.mds_addr, EntityName("mds", 0))
+        con = self.msgr.connect_to(self._addr_of(rank),
+                                   EntityName("mds", 0))
         con.send_message(MClientSession(tid=tid, op=op,
                                         client=self.client_id))
         if not ev[0].wait(self.timeout):
@@ -259,27 +302,67 @@ class CephFS(Dispatcher):
                 self._waiters.pop(tid, None)
             raise TimeoutError(f"mds session {op} timed out")
 
+    @staticmethod
+    def _normpath(path: str) -> str:
+        return "/" + "/".join(p for p in path.split("/") if p)
+
+    def _start_rank(self, op: str, args: dict) -> int:
+        if "path" in args:
+            return self._path_rank.get(self._normpath(args["path"]), 0)
+        if "ino" in args:
+            st = self._caps.get(args["ino"])
+            if st is not None:
+                return st.rank
+        return 0
+
     def _request(self, op: str, args: dict,
                  timeout: float | None = None,
-                 _retry: bool = True) -> dict:
+                 _retry: bool = True, rank: int | None = None) -> dict:
+        """MDS RPC with multi-active routing: start at the last-known
+        authoritative rank and follow 'forward' replies (a request that
+        lands on the wrong rank after a subtree export is redirected,
+        like the reference's MClientRequestForward)."""
         if self._evicted:
             raise OSError(108, "session evicted by mds (remount)")
         args = dict(args)
         args.setdefault("client", self.client_id)
-        tid, ev = self._alloc_tid()
-        con = self.msgr.connect_to(self.mds_addr, EntityName("mds", 0))
-        con.send_message(MClientRequest(tid=tid, op=op, args=args))
-        if not ev[0].wait(self.timeout if timeout is None else timeout):
+        if rank is None:
+            rank = self._start_rank(op, args)
+        hops = 0
+        while True:
+            self._ensure_session(rank)
+            tid, ev = self._alloc_tid()
+            con = self.msgr.connect_to(self._addr_of(rank),
+                                       EntityName("mds", 0))
+            con.send_message(MClientRequest(tid=tid, op=op, args=args))
+            if not ev[0].wait(self.timeout if timeout is None
+                              else timeout):
+                with self._lock:
+                    self._waiters.pop(tid, None)
+                if self._auto_mds and _retry and not self._stop \
+                        and self._failover(rank):
+                    _retry = False
+                    continue
+                raise TimeoutError(f"mds request {op} timed out")
+            reply = ev[1][0]
+            fwd = reply.out.get("forward") if reply.result == 0 else None
+            if fwd is not None:
+                hops += 1
+                if hops > 4:
+                    raise OSError(40, f"{op}: mds forward loop")
+                rank = int(fwd)
+                continue
+            if reply.result < 0:
+                raise OSError(-reply.result, f"{op} {args} failed")
+            # remember who answered: path hints + the ino's home rank
             with self._lock:
-                self._waiters.pop(tid, None)
-            if self._auto_mds and _retry and not self._stop \
-                    and self._failover():
-                return self._request(op, args, timeout, _retry=False)
-            raise TimeoutError(f"mds request {op} timed out")
-        reply = ev[1][0]
-        if reply.result < 0:
-            raise OSError(-reply.result, f"{op} {args} failed")
-        return reply.out
+                if "path" in args:
+                    self._path_rank[self._normpath(args["path"])] = rank
+                if "ino" in args:
+                    st = self._caps.get(args["ino"])
+                    if st is not None:
+                        st.rank = rank
+            return reply.out
 
     # -- capability handling ---------------------------------------------------
 
@@ -333,8 +416,9 @@ class CephFS(Dispatcher):
             self._writeback(st)
             with self._lock:
                 size, mtime = st.size, st.mtime
-        con = self.msgr.connect_to(self.mds_addr, EntityName("mds", 0))
-        con.send_message(MClientCaps(
+        # ack over the connection the revoke came in on: with multiple
+        # active ranks, only the sender knows this revoke's seq
+        msg.connection.send_message(MClientCaps(
             op="ack", ino=msg.ino, seq=msg.seq, client=self.client_id,
             size=size, mtime=mtime))
 
@@ -420,6 +504,20 @@ class CephFS(Dispatcher):
     def rename(self, src: str, dst: str) -> None:
         self._request("rename", {"src": src, "dst": dst})
 
+    def export_dir(self, path: str, to_rank: int) -> dict:
+        """Delegate a subtree to another active MDS rank (the manual
+        `setfattr ceph.dir.pin` / Migrator export_dir surface)."""
+        out = self._request("export_dir", {"path": path,
+                                           "to": to_rank},
+                            timeout=60.0)
+        # our path hints under that subtree are stale now
+        norm = self._normpath(path)
+        with self._lock:
+            for p in list(self._path_rank):
+                if p == norm or p.startswith(norm + "/"):
+                    self._path_rank[p] = to_rank
+        return out
+
     # -- file i/o -------------------------------------------------------------
 
     def open(self, path: str, flags: str = "r") -> "File":
@@ -459,6 +557,7 @@ class CephFS(Dispatcher):
                 if not st.dirty:
                     st.size = out["inode"].get("size", 0)
                     st.mtime = out["inode"].get("mtime", 0.0)
+                st.rank = self._path_rank.get(self._normpath(path), 0)
                 st.nopen += 1
                 fh = self._next_fh
                 self._next_fh += 1

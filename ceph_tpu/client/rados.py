@@ -146,7 +146,7 @@ class RadosClient(Dispatcher):
 
     def __init__(self, mon_addr: str, ctx: CephTpuContext | None = None,
                  ms_type: str = "async", timeout: float = 10.0,
-                 auth_key=None):
+                 auth_key=None, cephx: tuple[str, str] | None = None):
         with RadosClient._id_lock:
             self.client_id = RadosClient._next_client_id
             RadosClient._next_client_id += 1
@@ -167,24 +167,76 @@ class RadosClient(Dispatcher):
         self.name = EntityName("client", self.client_id)
         self.msgr = Messenger.create(self.name, ms_type)
         self.msgr.set_auth(auth_key)
+        if cephx is not None:
+            # per-entity credentials: entity-secret proof to mons,
+            # mon-granted tickets to every service
+            from ceph_tpu.auth.cephx import TicketKeyring
+            from ceph_tpu.auth.handshake import CephxConfig
+            entity, secret = cephx
+            self.auth_entity = entity
+            self.msgr.set_auth_cephx(CephxConfig(
+                entity=entity, key=secret,
+                keyring=TicketKeyring(self._fetch_ticket)))
+        else:
+            self.auth_entity = None
         self.msgr.set_policy("osd", ConnectionPolicy.stateful_peer())
         self.msgr.set_policy("mon", ConnectionPolicy.stateful_peer())
         self.msgr.add_dispatcher_tail(self)
 
+    def _fetch_ticket(self, service: str):
+        """TicketKeyring callback: one mon round trip per refresh."""
+        from ceph_tpu.auth.cephx import ticket_from_json
+        try:
+            rc, out = self.mon_command({"prefix": "auth get-ticket",
+                                        "service": service})
+        except (OSError, TimeoutError):
+            return None
+        return ticket_from_json(out) if rc == 0 else None
+
     # -- lifecycle ------------------------------------------------------------
+
+    #: re-subscribe cadence: map pushes ride the mon-side session, so a
+    #: dropped session must be re-established or the client goes stale
+    SUB_RENEW = 5.0
 
     def connect(self) -> None:
         self.msgr.bind("127.0.0.1:0") if _is_tcp(self.msgr) else \
             self.msgr.bind(f"client.{self.client_id}")
         self.msgr.start()
+        self._subscribe()
+        if not self._map_event.wait(self.timeout):
+            raise TimeoutError("no OSDMap from mon")
+        self._sub_timer: threading.Timer | None = None
+        self._schedule_sub_renew()
+
+    def _subscribe(self) -> None:
+        with self._lock:
+            epoch = self.osdmap.epoch
         for rank, addr in enumerate(self.mon_addrs):
             mon = self.msgr.connect_to(addr, EntityName("mon", rank))
             mon.send_message(MMonSubscribe(name=str(self.name),
-                                           addr=self.msgr.my_addr))
-        if not self._map_event.wait(self.timeout):
-            raise TimeoutError("no OSDMap from mon")
+                                           addr=self.msgr.my_addr,
+                                           epoch=epoch))
+
+    def _schedule_sub_renew(self) -> None:
+        if getattr(self, "_stopped", False):
+            return
+        self._sub_timer = threading.Timer(self.SUB_RENEW, self._sub_renew)
+        self._sub_timer.daemon = True
+        self._sub_timer.start()
+
+    def _sub_renew(self) -> None:
+        try:
+            self._subscribe()
+        except OSError:
+            pass
+        finally:
+            self._schedule_sub_renew()
 
     def shutdown(self) -> None:
+        self._stopped = True
+        if getattr(self, "_sub_timer", None) is not None:
+            self._sub_timer.cancel()
         self.msgr.shutdown()
 
     # -- dispatch -------------------------------------------------------------

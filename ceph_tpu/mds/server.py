@@ -29,9 +29,10 @@ import time
 from ceph_tpu.client.rados import RadosClient
 from ceph_tpu.common.context import CephTpuContext
 from ceph_tpu.common.logging import dout
+from ceph_tpu.mds.caps import ALL as ALL_CAPS
 from ceph_tpu.mds.caps import BUFFER, CapTable, caps_str
 from ceph_tpu.mds.flock import (
-    F_UNLCK, LockState, fcntl_range)
+    EOF, F_UNLCK, Lock, LockState, fcntl_range)
 from ceph_tpu.msg.encoding import Decoder, Encoder
 from ceph_tpu.msg.message import Message, register_message
 from ceph_tpu.msg.messenger import (
@@ -161,6 +162,36 @@ class MClientCaps(Message):
         dec.versioned(1, body)
 
 
+@register_message
+class MMDSExport(Message):
+    """mds -> mds subtree handoff (Migrator MExportDir reduced): the
+    exporter has flushed everything and committed the new authority in
+    the shared subtree table; this message moves the un-flushable
+    in-memory state (file locks) and tells the importer to drop its
+    caches of the subtree."""
+
+    TYPE = 530
+
+    def __init__(self, path: str = "", from_rank: int = -1,
+                 locks_blob: bytes = b""):
+        super().__init__()
+        self.path = path
+        self.from_rank = from_rank
+        self.locks_blob = locks_blob
+
+    def encode_payload(self, enc: Encoder):
+        enc.versioned(1, 1, lambda e: (
+            e.str(self.path), e.s32(self.from_rank),
+            e.bytes(self.locks_blob)))
+
+    def decode_payload(self, dec: Decoder, version: int):
+        def body(d, v):
+            self.path = d.str()
+            self.from_rank = d.s32()
+            self.locks_blob = d.bytes()
+        dec.versioned(1, body)
+
+
 class _Park(Exception):
     """Request must wait for cap acks / lock release on this ino
     (the reference's MDSCacheObject add_waiter, as control flow)."""
@@ -170,26 +201,30 @@ class _Park(Exception):
 
 
 class Inode:
-    __slots__ = ("ino", "mode", "size", "mtime")
+    __slots__ = ("ino", "mode", "size", "mtime", "parent")
 
     def __init__(self, ino: int, mode: int, size: int = 0,
-                 mtime: float = 0.0):
+                 mtime: float = 0.0, parent: int = 0):
         self.ino = ino
         self.mode = mode
         self.size = size
         self.mtime = mtime
+        #: primary-link backpointer (no hardlinks here): lets a rank
+        #: reconstruct an ino's path, so ino-op authority survives a
+        #: restart (the in-memory exported-ino map alone would not)
+        self.parent = parent
 
     def is_dir(self) -> bool:
         return bool(self.mode & S_IFDIR)
 
     def to_dict(self) -> dict:
         return {"ino": self.ino, "mode": self.mode, "size": self.size,
-                "mtime": self.mtime}
+                "mtime": self.mtime, "parent": self.parent}
 
     @staticmethod
     def from_dict(d: dict) -> "Inode":
         return Inode(d["ino"], d["mode"], d.get("size", 0),
-                     d.get("mtime", 0.0))
+                     d.get("mtime", 0.0), d.get("parent", 0))
 
 
 class MDSDaemon(Dispatcher):
@@ -203,7 +238,8 @@ class MDSDaemon(Dispatcher):
                  data_pool: int | None = None,
                  ctx: CephTpuContext | None = None, ms_type: str = "async",
                  addr: str = "127.0.0.1:0", auth_key=None,
-                 gid: int | None = None):
+                 gid: int | None = None,
+                 cephx: tuple[str, str] | None = None):
         import os as _os
         self.gid = gid if gid is not None else \
             int.from_bytes(_os.urandom(6), "big")
@@ -245,12 +281,42 @@ class MDSDaemon(Dispatcher):
         #: RPC gives up before this, and granting a lock to a waiter
         #: that stopped waiting would orphan it forever
         self.park_ttl = 240.0
+        #: multi-active state (subtree delegation, MDBalancer reduced)
+        self._subtrees: dict[str, int] | None = None
+        self._subtrees_ts = 0.0
+        #: subtree roots currently being exported: ops under them park
+        self._frozen: dict[str, int] = {}       # path -> root ino
+        #: inos whose authority moved away: ino -> new rank
+        self._exported_inos: dict[int, int] = {}
+        #: per-top-level-path request counters + a decayed rate
+        self._req_counts: dict[str, int] = {}
+        self._load_rate = 0.0
+        self._load_window = 0
+        #: balancer hint from the mon (least-loaded rank + its load)
+        self._bal_rank = -1
+        self._bal_load = 0.0
+        #: my load must exceed min*factor + floor before auto-exporting
+        self.bal_factor = 4.0
+        self.bal_floor = 50.0
+        self.bal_auto = False
+        self._bal_tick = 0
+        #: an auto-export parked on cap recalls, retried each bal tick
+        self._pending_export: tuple[str, int] | None = None
         self._tick_timer: threading.Timer | None = None
 
         self.objecter = RadosClient(mon_addr, ms_type=ms_type,
-                                    auth_key=auth_key)
+                                    auth_key=auth_key, cephx=cephx)
         self.msgr = Messenger.create(self.name, ms_type)
         self.msgr.set_auth(auth_key)
+        self._cephx = cephx
+        if cephx is not None:
+            from ceph_tpu.auth.cephx import TicketKeyring
+            from ceph_tpu.auth.handshake import CephxConfig
+            self._rotating: dict[int, str] = {}
+            self.msgr.set_auth_cephx(CephxConfig(
+                entity=cephx[0], key=cephx[1],
+                keyring=TicketKeyring(self.objecter._fetch_ticket),
+                service="mds", rotating=lambda: self._rotating))
         self.msgr.set_policy("client", ConnectionPolicy.lossy_client())
         self.msgr.add_dispatcher_tail(self)
         self._addr = addr
@@ -259,10 +325,20 @@ class MDSDaemon(Dispatcher):
 
     # -- lifecycle ------------------------------------------------------------
 
+    def _refresh_rotating(self) -> None:
+        if self._cephx is None:
+            return
+        rc, out = self.objecter.mon_command(
+            {"prefix": "auth rotating", "service": "mds"})
+        if rc == 0:
+            self._rotating = {int(g): k
+                              for g, k in json.loads(out).items()}
+
     def init(self) -> None:
         """Direct single-MDS bring-up (no FSMap registration): rank 0,
         journal 'mdlog'.  The FSMap path is init_standby()."""
         self.objecter.connect()
+        self._refresh_rotating()
         self.rank = 0
         self.meta_io = self.objecter.open_ioctx(self.metadata_pool)
         self.journal = Journaler(self.meta_io, "mdlog")
@@ -284,6 +360,7 @@ class MDSDaemon(Dispatcher):
         for a rank (MDSMonitor assignment); standbys idle until a
         failover promotes them."""
         self.objecter.connect()
+        self._refresh_rotating()
         self.msgr.bind(self._addr)
         self.msgr.start()
         self.state = "standby"
@@ -294,6 +371,9 @@ class MDSDaemon(Dispatcher):
         if self._stop:
             return
         from ceph_tpu.mon.monitor import MMDSBeacon
+        # decayed request rate rides the beacon (MDBalancer load)
+        self._load_rate = 0.7 * self._load_rate + 0.3 * self._load_window
+        self._load_window = 0
         # fan out to EVERY mon (mon_addr is comma-separated): only the
         # leader assigns ranks, and any mon may be the leader
         for i, addr in enumerate(self.mon_addr.split(",")):
@@ -302,7 +382,7 @@ class MDSDaemon(Dispatcher):
                                            EntityName("mon", i))
                 con.send_message(MMDSBeacon(
                     gid=self.gid, addr=self.msgr.my_addr,
-                    state=self.state,
+                    state=self.state, load=self._load_rate,
                     rank=-1 if self.rank is None else self.rank))
             except OSError:
                 continue
@@ -311,28 +391,24 @@ class MDSDaemon(Dispatcher):
         self._beacon_timer.daemon = True
         self._beacon_timer.start()
 
-    def _activate(self, rank: int) -> None:
+    def _activate(self, rank: int, meta_pool: int = -1,
+                  data_pool: int = -1) -> None:
         """Standby promoted to a rank: replay that rank's journal and
-        open a reconnect window for the old clients' cap reasserts."""
-        # the pool ids live in the FSMap; our objecter's first map
-        # subscription may still be in flight — wait for it (outside
-        # the lock: map delivery needs the objecter's dispatch)
-        deadline = time.time() + 10.0
-        while not self.objecter.osdmap.fs_db and time.time() < deadline:
-            time.sleep(0.05)
+        open a reconnect window for the old clients' cap reasserts.
+        The pool ids ride the beacon ack, so activation needs no wait
+        on our own (possibly lagging) map subscription."""
         with self._lock:
             if self.rank is not None:
                 return
-            fs = self.objecter.osdmap.fs_db
-            if not fs:
-                dout("mds", 0, "mds gid %d: no fsmap in objecter map, "
-                     "cannot activate", self.gid)
-                return
-            self.rank = rank
             if self.metadata_pool is None:
-                self.metadata_pool = fs["metadata_pool"]
+                if meta_pool < 0:
+                    return      # stale ack with no pools: next beacon
+                self.metadata_pool = meta_pool
             if self.data_pool is None:
-                self.data_pool = fs["data_pool"]
+                if data_pool < 0:
+                    return
+                self.data_pool = data_pool
+            self.rank = rank
             self.meta_io = self.objecter.open_ioctx(self.metadata_pool)
             self.journal = Journaler(self.meta_io, f"mdlog.{rank}")
             self.state = "replay"
@@ -362,6 +438,9 @@ class MDSDaemon(Dispatcher):
                 if self._reconnect_until and now >= self._reconnect_until:
                     self._reconnect_until = 0.0
                     self._rerun(0)
+                self._bal_tick += 1
+                if self._bal_tick % 5 == 0:
+                    self._maybe_autobalance()
                 # silent revoke targets: the client never acked (dead or
                 # wedged) — evict the WHOLE session, exactly like the
                 # reference's session-kill on cap-revoke timeout.  A
@@ -400,6 +479,13 @@ class MDSDaemon(Dispatcher):
                         self._parked[ino] = keep
                     else:
                         del self._parked[ino]
+            if self._cephx is not None and self._bal_tick % 60 == 0:
+                # rotating-key refresh OUTSIDE the lock: it is a mon
+                # round trip over the objecter
+                try:
+                    self._refresh_rotating()
+                except (OSError, TimeoutError):
+                    pass
             for m in expired:
                 err = -11 if m.op in ("setlk", "flock") else -110
                 if m.op == "open":
@@ -456,13 +542,27 @@ class MDSDaemon(Dispatcher):
     def addr(self) -> str:
         return self.msgr.my_addr
 
+    def _ino_table_key(self) -> str:
+        return ("next_ino" if not self.rank
+                else f"next_ino.{self.rank}")
+
+    def _ino_base(self) -> int:
+        """Each rank allocates from its own ino space (the reference's
+        per-MDS InoTable prealloc ranges): two active ranks must never
+        mint the same ino."""
+        return 2 if not self.rank else (self.rank << 44)
+
     def _load_or_mkfs(self) -> None:
-        fresh_fs = False
+        self._next_ino = self._ino_base()
+        fresh_fs = True
         try:
             table = self.meta_io.get_omap("mds.table")
-            self._next_ino = int(table.get("next_ino", b"2").decode())
+            fresh_fs = False
+            self._next_ino = int(table.get(
+                self._ino_table_key(),
+                str(self._ino_base()).encode()).decode())
         except OSError:
-            fresh_fs = True
+            pass
         # the journal is PER RANK: its absence does not mean the fs is
         # fresh (a second active rank starts with an empty journal over
         # an existing namespace)
@@ -470,8 +570,9 @@ class MDSDaemon(Dispatcher):
             self.journal.open()
         except OSError:
             self.journal.create()
-        if fresh_fs:
-            # fresh filesystem: root inode
+        if fresh_fs and not self.rank:
+            # fresh filesystem: ONLY rank 0 creates the root (a second
+            # rank joining early must not race it; its reads are lazy)
             self._inodes[ROOT_INO] = Inode(ROOT_INO, S_IFDIR | 0o755)
             self._dirs[ROOT_INO] = {}
             self._dirty_dirs.add(ROOT_INO)
@@ -534,8 +635,10 @@ class MDSDaemon(Dispatcher):
                 self._inode_obj(ino),
                 {"json": json.dumps(inode.to_dict()).encode()})
         self._dirty_inodes.clear()
+        # omap sets merge: each rank maintains its own allocator key
         self.meta_io.set_omap(
-            "mds.table", {"next_ino": str(self._next_ino).encode()})
+            "mds.table",
+            {self._ino_table_key(): str(self._next_ino).encode()})
 
     # -- journal (MDLog EUpdate) ----------------------------------------------
 
@@ -579,11 +682,18 @@ class MDSDaemon(Dispatcher):
             self._dirty_dirs.add(parent)
             if "mode" in ev:
                 self._inodes[ino] = Inode(ino, ev["mode"], ev.get("size", 0),
-                                          ev.get("mtime", 0.0))
+                                          ev.get("mtime", 0.0),
+                                          parent=parent)
                 if self._inodes[ino].is_dir():
                     self._dirs.setdefault(ino, {})
                     self._dirty_dirs.add(ino)
                 self._dirty_inodes.add(ino)
+            else:
+                # plain link (rename target): move the backpointer
+                inode = self._load_inode(ino)
+                if inode is not None and inode.parent != parent:
+                    inode.parent = parent
+                    self._dirty_inodes.add(ino)
             return
         if kind == "unlink":
             parent, name = ev["parent"], ev["name"]
@@ -629,6 +739,273 @@ class MDSDaemon(Dispatcher):
         self._apply(ev)
         self._maybe_trim()
 
+    # -- subtree authority (Migrator/MDBalancer reduced) ----------------------
+
+    SUBTREE_OBJ = "mds.subtrees"
+    SUBTREE_TTL = 2.0
+
+    @staticmethod
+    def _norm(path: str) -> str:
+        return "/" + "/".join(p for p in path.split("/") if p)
+
+    def _load_subtrees(self, force: bool = False) -> dict[str, int]:
+        now = time.time()
+        if (not force and self._subtrees is not None
+                and now - self._subtrees_ts < self.SUBTREE_TTL):
+            return self._subtrees
+        try:
+            omap = self.meta_io.get_omap(self.SUBTREE_OBJ)
+            self._subtrees = {k: int(v.decode()) for k, v in
+                              omap.items() if k != "__version__"}
+        except OSError:
+            self._subtrees = {}
+        self._subtrees_ts = now
+        return self._subtrees
+
+    def _authority(self, path: str) -> int:
+        """Rank owning a path: deepest delegated prefix wins; the root
+        default is rank 0 (dirfrag auth, reduced to path prefixes)."""
+        norm = self._norm(path)
+        best, bestlen = 0, 0
+        for pref, r in self._load_subtrees().items():
+            if norm == pref or norm.startswith(pref + "/") \
+                    or pref == "/":
+                if len(pref) > bestlen:
+                    best, bestlen = r, len(pref)
+        return best
+
+    def _check_path_authority(self, path: str,
+                              allow_frozen: bool = False):
+        """Returns a forward reply for a path that is not ours, parks
+        if it is mid-export, else None (ours: proceed).  Also feeds the
+        per-subtree load counters.  allow_frozen is for the export op
+        itself — it IS the freezer and must re-enter."""
+        if not allow_frozen:
+            for pref, root_ino in self._frozen.items():
+                norm = self._norm(path)
+                if norm == pref or norm.startswith(pref + "/"):
+                    raise _Park(root_ino)
+        r = self._authority(path)
+        if r != self.rank:
+            return 0, {"forward": r}
+        norm = self._norm(path)
+        top = "/" + norm.split("/")[1] if norm != "/" else "/"
+        self._req_counts[top] = self._req_counts.get(top, 0) + 1
+        self._load_window += 1
+        return None
+
+    def _ino_path(self, ino: int) -> str | None:
+        """Reconstruct an ino's path via parent backpointers (name is
+        found by scanning the parent dirfrag — no hardlinks here)."""
+        parts: list[str] = []
+        cur = ino
+        for _ in range(64):         # depth bound
+            if cur == ROOT_INO:
+                return "/" + "/".join(reversed(parts))
+            inode = self._load_inode(cur)
+            if inode is None or not inode.parent:
+                return None
+            name = next((n for n, c in
+                         self._load_dir(inode.parent).items()
+                         if c == cur), None)
+            if name is None:
+                return None
+            parts.append(name)
+            cur = inode.parent
+        return None
+
+    def _check_ino_authority(self, ino: int):
+        fwd = self._exported_inos.get(ino)
+        if fwd is not None:
+            return 0, {"forward": fwd}
+        # durable check: a restarted rank has an empty _exported_inos,
+        # but the subtree table + parent backpointers survive
+        if self._load_subtrees():
+            path = self._ino_path(ino)
+            if path is not None:
+                r = self._authority(path)
+                if r != self.rank:
+                    self._exported_inos[ino] = r    # cache
+                    return 0, {"forward": r}
+        return None
+
+    def _subtree_inos(self, root_ino: int) -> list[int]:
+        """Every ino under a directory (recursive walk of the shared
+        dirfrags)."""
+        out = []
+        stack = [root_ino]
+        while stack:
+            cur = stack.pop()
+            for _name, child in self._load_dir(cur).items():
+                out.append(child)
+                inode = self._load_inode(child)
+                if inode is not None and inode.is_dir():
+                    stack.append(child)
+        return out
+
+    def _do_export(self, path: str, to_rank: int) -> tuple[int, dict]:
+        """Export a subtree to another rank (Migrator::export_dir,
+        reduced).  Phases: freeze -> recall every cap to nothing and
+        flush (so NOTHING dirty or delegated remains) -> commit the new
+        authority in the shared table -> hand the lock state to the
+        importer -> drop local state and forward from now on.
+        Re-entered via the park/retry machinery while recalls drain."""
+        norm = self._norm(path)
+        _p, root_ino, _n = self._resolve(path)
+        if root_ino is None:
+            return -2, {}
+        inode = self._load_inode(root_ino)
+        if inode is None or not inode.is_dir():
+            return -20, {}
+        fs = self.objecter.osdmap.fs_db
+        if str(to_rank) not in (fs or {}).get("ranks", {}):
+            return -22, {}
+        if to_rank == self.rank:
+            return 0, {"noop": True}
+        self._frozen[norm] = root_ino
+        try:
+            inos = self._subtree_inos(root_ino)
+            pending_ino = None
+            for ino in inos:
+                revokes = self.caps.recall(ino, ALL_CAPS)
+                if revokes:
+                    self._issue_revokes(ino, revokes)
+                if pending_ino is None \
+                        and self.caps.pending_revokes(ino):
+                    pending_ino = ino
+            if pending_ino is not None:
+                # park on a PENDING ino: its ack (or revoke-timeout
+                # eviction) re-runs us, and we re-check the rest.
+                # Deliberately still frozen: re-entry needs it.
+                raise _Park(pending_ino)
+            # everything is flushed client-side; persist our state
+            self._flush_dirty()
+            self.journal.trim()
+            # COMMIT POINT: the shared table now names the importer
+            table = {k: str(v).encode()
+                     for k, v in
+                     self._load_subtrees(force=True).items()}
+            table[norm] = str(to_rank).encode()
+            self.meta_io.set_omap(self.SUBTREE_OBJ, table)
+            self._subtrees = None       # re-read next time
+        except _Park:
+            raise
+        except Exception:
+            # pre/at-commit failure: unfreeze and let waiters re-run
+            # (the table either still names us, or — if the omap write
+            # landed before raising — the durable authority check
+            # forwards from now on; both are consistent states)
+            del self._frozen[norm]
+            self._rerun(root_ino)
+            raise
+        # post-commit: the export MUST complete — the table already
+        # names the importer.  The lock handoff is best-effort (a dead
+        # importer loses in-memory locks, exactly like an MDS failover
+        # does); everything else is local.
+        locks = {}
+        for ino in inos:
+            ls = self._locks.pop(ino, None)
+            if ls is not None and not ls.empty():
+                locks[str(ino)] = {
+                    "posix": [[k.client, k.owner, k.type, k.start,
+                               k.end] for k in ls.posix],
+                    "flock": [[k.client, k.owner, k.type] for k in
+                              ls.flock]}
+        try:
+            ent = fs["ranks"][str(to_rank)]
+            con = self.msgr.connect_to(ent["addr"],
+                                       EntityName("mds", 0))
+            con.send_message(MMDSExport(
+                path=norm, from_rank=self.rank,
+                locks_blob=json.dumps(locks).encode()))
+        except OSError:
+            dout("mds", 0, "export %s: lock handoff to rank %d failed "
+                 "(locks dropped, like a failover)", norm, to_rank)
+        # drop grants (clients re-open at the importer on next need)
+        for ino in inos:
+            for c in list(self.caps.holders(ino)):
+                self._send_caps(c, MClientCaps(
+                    op="invalidated", ino=ino, caps=0, client=c))
+                self.caps.force_drop(ino, c)
+                self._revoke_sent.pop((ino, c), None)
+            self._exported_inos[ino] = to_rank
+        self._exported_inos[root_ino] = to_rank
+        # drop ONLY the subtree's cached state (it was flushed above;
+        # the rest of the cache is still ours and still hot)
+        for ino in [root_ino] + inos:
+            self._inodes.pop(ino, None)
+            self._dirs.pop(ino, None)
+        self._req_counts.pop("/" + norm.split("/")[1], None)
+        del self._frozen[norm]
+        self._rerun(root_ino)
+        for ino in inos:
+            self._rerun(ino)
+        dout("mds", 1, "mds rank %s exported %s -> rank %d (%d inos)",
+             self.rank, norm, to_rank, len(inos))
+        return 0, {"inos": len(inos)}
+
+    def _maybe_autobalance(self) -> None:
+        """MDBalancer reduced: when my request rate dwarfs the least-
+        loaded rank's (the mon computes the hint into beacon acks),
+        export my hottest top-level subtree to it."""
+        if not (self.bal_auto and self.rank is not None
+                and self.state == "active"):
+            return
+        if self._pending_export is not None:
+            # an auto-export parked on cap recalls: it MUST be retried
+            # past the load gates (the freeze itself kills the load
+            # signal) or the subtree would stay frozen forever
+            path, to_rank = self._pending_export
+            try:
+                self._do_export(path, to_rank)
+                self._pending_export = None
+            except _Park:
+                pass
+            except OSError:
+                self._pending_export = None
+            return
+        if self._bal_rank < 0 or self._bal_rank == self.rank:
+            return
+        if self._load_rate <= (self.bal_factor * self._bal_load
+                               + self.bal_floor):
+            return
+        cands = {p: n for p, n in self._req_counts.items() if p != "/"}
+        if not cands:
+            return
+        hot = max(cands, key=lambda p: cands[p])
+        try:
+            self._do_export(hot, self._bal_rank)
+        except _Park:
+            self._pending_export = (hot, self._bal_rank)
+        except OSError:
+            pass
+
+    def _handle_export_msg(self, msg: MMDSExport) -> None:
+        """Importer side: install the handed-over locks and drop any
+        cached view of the subtree (reload from the shared pool)."""
+        with self._lock:
+            locks = json.loads(msg.locks_blob.decode() or "{}")
+            for ino_s, st in locks.items():
+                ls = self._locks.setdefault(int(ino_s), LockState())
+                ls.posix = [Lock(*row) for row in st.get("posix", [])]
+                ls.flock = [Lock(c, o, t, 0, EOF)
+                            for c, o, t in st.get("flock", [])]
+            # OUR dirty state must land before the cache drop, or the
+            # next flush would rewrite those dirfrags from empty caches
+            self._flush_dirty()
+            self._inodes.clear()
+            self._dirs.clear()
+            self._subtrees = None
+            # inos under the imported subtree are OURS again even if a
+            # past export of the same subtree recorded them as gone
+            norm = self._norm(msg.path)
+            _p, root_ino, _n = self._resolve(msg.path)
+            if root_ino is not None:
+                for ino in [root_ino] + self._subtree_inos(root_ino):
+                    self._exported_inos.pop(ino, None)
+            dout("mds", 1, "mds rank %s imported %s from rank %d",
+                 self.rank, msg.path, msg.from_rank)
+
     # -- path resolution ------------------------------------------------------
 
     def _resolve(self, path: str) -> tuple[int | None, int | None, str]:
@@ -663,11 +1040,17 @@ class MDSDaemon(Dispatcher):
         if isinstance(msg, MClientCaps):
             self._handle_caps_msg(msg)
             return True
+        if isinstance(msg, MMDSExport):
+            self._handle_export_msg(msg)
+            return True
         from ceph_tpu.mon.monitor import MMDSBeacon
         if isinstance(msg, MMDSBeacon):       # mon ack
+            self._bal_rank = getattr(msg, "bal_rank", -1)
+            self._bal_load = getattr(msg, "bal_load", 0.0)
             if msg.state == "ack" and msg.rank >= 0 \
                     and self.rank is None:
-                self._activate(msg.rank)
+                self._activate(msg.rank, meta_pool=msg.meta_pool,
+                               data_pool=msg.data_pool)
             return True
         return False
 
@@ -809,6 +1192,36 @@ class MDSDaemon(Dispatcher):
                 raise _Park(0)
             self._reconnect_until = 0.0
             self._rerun(0)
+
+        # multi-active authority: path ops forward to the delegated
+        # rank; ino ops forward once the ino's subtree was exported
+        if op in ("lookup", "mkdir", "create", "open", "readdir",
+                  "unlink", "rmdir", "export_dir"):
+            fwd = self._check_path_authority(
+                a["path"], allow_frozen=(op == "export_dir"))
+            if fwd is not None:
+                return fwd
+        elif op == "rename":
+            fa = self._check_path_authority(a["src"])
+            if fa is not None:
+                return fa
+            if self._authority(a["dst"]) != self.rank:
+                # cross-subtree rename: the reference migrates; here it
+                # is an honest EXDEV (callers copy+unlink)
+                return -18, {}
+            norm_src = self._norm(a["src"])
+            for pref in self._load_subtrees():
+                if pref == norm_src or pref.startswith(norm_src + "/"):
+                    # renaming a delegation root (or an ancestor of
+                    # one) would silently orphan the delegation
+                    return -16, {}
+        elif "ino" in a and op != "cap_reassert":
+            fwd = self._check_ino_authority(int(a["ino"]))
+            if fwd is not None:
+                return fwd
+
+        if op == "export_dir":
+            return self._do_export(a["path"], int(a["to"]))
 
         if op == "cap_reassert":
             # failover rejoin: a surviving client re-asserts the caps
@@ -994,6 +1407,12 @@ class MDSDaemon(Dispatcher):
                 return -39, {}  # ENOTEMPTY
             self._mutate({"e": "unlink", "parent": parent, "name": name,
                           "drop_inode": True})
+            norm = self._norm(a["path"])
+            if norm in self._load_subtrees(force=True):
+                # removing a delegation root retires its table entry
+                # (omap sets merge — deletion needs an explicit rm)
+                self.meta_io.rm_omap_keys(self.SUBTREE_OBJ, [norm])
+                self._subtrees = None
             return 0, {}
 
         if op == "rename":

@@ -62,7 +62,8 @@ class MgrDaemon(Dispatcher):
     serve aggregate views."""
 
     def __init__(self, mon_addr: str, ms_type: str = "async",
-                 addr: str = "127.0.0.1:0", auth_key=None):
+                 addr: str = "127.0.0.1:0", auth_key=None,
+                 cephx: tuple[str, str] | None = None):
         self.mon_addr = mon_addr
         self.name = EntityName("mgr", 0)
         self.osdmap = OSDMap()
@@ -71,14 +72,72 @@ class MgrDaemon(Dispatcher):
         self.reports: dict[int, tuple[float, MMgrReport]] = {}
         self.msgr = Messenger.create(self.name, ms_type)
         self.msgr.set_auth(auth_key)
+        self._cephx = cephx
+        self._rotating: dict[int, str] = {}
+        self._rotating_at = 0.0
+        self._moncmd_tid = 0
+        self._moncmd_waiters: dict[int, object] = {}
+        if cephx is not None:
+            from ceph_tpu.auth.cephx import TicketKeyring
+            from ceph_tpu.auth.handshake import CephxConfig
+            self.msgr.set_auth_cephx(CephxConfig(
+                entity=cephx[0], key=cephx[1],
+                keyring=TicketKeyring(lambda svc: None),
+                service="mgr", rotating=lambda: self._rotating))
         self.msgr.set_policy("osd", ConnectionPolicy.stateful_server())
         self.msgr.set_policy("mon", ConnectionPolicy.stateful_peer())
         self.msgr.add_dispatcher_tail(self)
         self._addr = addr
 
+    def _mon_cmd(self, cmd: dict, timeout: float = 8.0):
+        import queue as _q
+        with self._lock:
+            self._moncmd_tid += 1
+            tid = self._moncmd_tid
+            q: _q.Queue = _q.Queue()
+            self._moncmd_waiters[tid] = q
+        from ceph_tpu.messages import MMonCommand
+        try:
+            for rank, a in enumerate(
+                    [x for x in self.mon_addr.split(",") if x]):
+                con = self.msgr.connect_to(a, EntityName("mon", rank))
+                con.send_message(MMonCommand(tid=tid, cmd=dict(cmd)))
+            try:
+                return q.get(timeout=timeout)
+            except _q.Empty:
+                return -110, "timeout"
+        finally:
+            with self._lock:
+                self._moncmd_waiters.pop(tid, None)
+
+    def _refresh_rotating(self) -> None:
+        import json as _json
+        rc, out = self._mon_cmd({"prefix": "auth rotating",
+                                 "service": "mgr"})
+        if rc == 0:
+            self._rotating = {int(g): k
+                              for g, k in _json.loads(out).items()}
+            self._rotating_at = time.time()
+
+    def _rotating_tick(self) -> None:
+        """Timer thread — NEVER the dispatch thread: the refresh blocks
+        on a mon ack that only the dispatch thread can deliver."""
+        if getattr(self, "_stopped", False):
+            return
+        try:
+            self._refresh_rotating()
+        except (OSError, TimeoutError):
+            pass
+        self._rot_timer = threading.Timer(60.0, self._rotating_tick)
+        self._rot_timer.daemon = True
+        self._rot_timer.start()
+
     def init(self) -> None:
         self.msgr.bind(self._addr)
         self.msgr.start()
+        self._rot_timer = None
+        if self._cephx is not None:
+            self._rotating_tick()
         from ceph_tpu.mon.monitor import MMonSubscribe
         for rank, a in enumerate(
                 [x for x in self.mon_addr.split(",") if x]):
@@ -87,6 +146,9 @@ class MgrDaemon(Dispatcher):
                                            addr=self.msgr.my_addr))
 
     def shutdown(self) -> None:
+        self._stopped = True
+        if getattr(self, "_rot_timer", None) is not None:
+            self._rot_timer.cancel()
         if getattr(self, "_prom", None) is not None:
             self._prom.shutdown()
             self._prom.server_close()
@@ -97,6 +159,13 @@ class MgrDaemon(Dispatcher):
         return self.msgr.my_addr
 
     def ms_dispatch(self, msg) -> bool:
+        from ceph_tpu.messages import MMonCommandAck
+        if isinstance(msg, MMonCommandAck):
+            with self._lock:
+                q = self._moncmd_waiters.get(msg.tid)
+            if q is not None:
+                q.put((msg.result, msg.output))
+            return True
         if isinstance(msg, MMgrReport):
             with self._lock:
                 self.reports[msg.osd_id] = (time.time(), msg)

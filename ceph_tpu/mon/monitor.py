@@ -150,18 +150,31 @@ class MMDSBeacon(Message):
     TYPE = 100  # MSG_MDS_BEACON
 
     def __init__(self, gid: int = 0, addr: str = "", state: str = "",
-                 rank: int = -1, load: float = 0.0):
+                 rank: int = -1, load: float = 0.0,
+                 bal_rank: int = -1, bal_load: float = 0.0,
+                 meta_pool: int = -1, data_pool: int = -1):
         super().__init__()
         self.gid = gid
         self.addr = addr
         self.state = state
         self.rank = rank
         self.load = load
+        #: acks carry the balancer hint: least-loaded active rank
+        self.bal_rank = bal_rank
+        self.bal_load = bal_load
+        #: acks also carry the fs pools, so an assigned rank can
+        #: activate immediately without waiting on its own map
+        #: subscription (a cross-channel dependency that stalls under
+        #: load)
+        self.meta_pool = meta_pool
+        self.data_pool = data_pool
 
     def encode_payload(self, enc: Encoder):
-        enc.versioned(1, 1, lambda e: (
+        enc.versioned(2, 1, lambda e: (
             e.u64(self.gid), e.str(self.addr), e.str(self.state),
-            e.s32(self.rank), e.f64(self.load)))
+            e.s32(self.rank), e.f64(self.load),
+            e.s32(self.bal_rank), e.f64(self.bal_load),
+            e.s64(self.meta_pool), e.s64(self.data_pool)))
 
     def decode_payload(self, dec: Decoder, version: int):
         def body(d, v):
@@ -170,7 +183,12 @@ class MMDSBeacon(Message):
             self.state = d.str()
             self.rank = d.s32()
             self.load = d.f64()
-        dec.versioned(1, body)
+            if v >= 2:
+                self.bal_rank = d.s32()
+                self.bal_load = d.f64()
+                self.meta_pool = d.s64()
+                self.data_pool = d.s64()
+        dec.versioned(2, body)
 
 
 def _referenced_bucket_ids(crush) -> set:
@@ -184,7 +202,9 @@ class Monitor(Dispatcher):
 
     def __init__(self, ctx: CephTpuContext | None = None, mon_id: int = 0,
                  store_path: str | None = None, ms_type: str = "async",
-                 addr: str = "127.0.0.1:0", auth_key=None):
+                 addr: str = "127.0.0.1:0", auth_key=None,
+                 cephx_keyring: dict | None = None,
+                 cephx_rotation: float = 3600.0):
         self.ctx = ctx or CephTpuContext(f"mon.{mon_id}")
         self.mon_id = mon_id
         self.name = EntityName("mon", mon_id)
@@ -197,7 +217,9 @@ class Monitor(Dispatcher):
         #: is the reporter's observed silence when it filed
         self._failure_reports: dict[int, dict[int, tuple[float, float]]] = {}
         #: subscriber name -> (addr, entity)
-        self._subs: dict[str, tuple[str, EntityName]] = {}
+        #: subscriber -> (addr, entity, session connection): pushes
+        #: ride the session the subscriber authenticated
+        self._subs: dict[str, tuple] = {}
         #: latest MPGStats per reporting OSD (PG_DEGRADED health feed)
         self._pg_stats: dict[int, dict] = {}
         #: mds gid -> (last beacon time, addr, load) — mon-local
@@ -224,6 +246,19 @@ class Monitor(Dispatcher):
         self.msgr.set_policy("client", ConnectionPolicy.lossy_client())
         self.msgr.set_policy("osd", ConnectionPolicy.stateful_server())
         self.msgr.set_policy("mon", ConnectionPolicy.stateful_peer())
+        #: per-entity cephx: the seed keyring (mon keys + client.admin)
+        #: bootstraps auth before the first map commit; after that the
+        #: paxos-replicated auth_db is authoritative
+        self._cephx_seed = dict(cephx_keyring or {})
+        self.cephx_rotation = cephx_rotation
+        if cephx_keyring is not None:
+            from ceph_tpu.auth.cephx import TicketKeyring
+            from ceph_tpu.auth.handshake import CephxConfig
+            self.msgr.set_auth_cephx(CephxConfig(
+                entity=f"mon.{mon_id}",
+                key=self._cephx_seed.get(f"mon.{mon_id}", ""),
+                keyring=TicketKeyring(self._self_ticket),
+                auth_lookup=self._auth_lookup))
         self.msgr.add_dispatcher_tail(self)
         self._addr = addr
         self.ctx.admin.register_command(
@@ -328,9 +363,9 @@ class Monitor(Dispatcher):
             subs = list(self._subs.values())
         # never fan the paxos value out: it carries the auth key table
         pub = encode_osdmap(newmap)
-        for addr, entity in subs:
-            con = self.msgr.connect_to(addr, entity)
-            con.send_message(MOSDMapMsg(epoch=newmap.epoch, map_blob=pub))
+        for _addr, _entity, con in subs:
+            con.send_message(MOSDMapMsg(epoch=newmap.epoch,
+                                        map_blob=pub))
 
     def _schedule_tick(self) -> None:
         if self._stop:
@@ -347,8 +382,26 @@ class Monitor(Dispatcher):
                 self.paxos.tick()
             if self.is_leader() and self.osdmap.fs_db:
                 self._check_mds_failures()
+            if self.is_leader():
+                self._maybe_rotate_service_keys()
         finally:
             self._schedule_tick()
+
+    def _maybe_rotate_service_keys(self) -> None:
+        """Leader: advance stale service-key generations (KeyServer
+        rotation) through paxos so every mon grants/validates alike."""
+        svc = self.osdmap.auth_db.get("__svc__")
+        if not svc:
+            return
+        now = time.time()
+        stale = any(now - s.get("rotated_at", 0) >= self.cephx_rotation
+                    for s in svc.values())
+        if not stale:
+            return
+
+        def fn(m: OSDMap):
+            return self._keyserver(m.auth_db).maybe_rotate() or False
+        self._work_q.put(("rotate_keys", fn, None))
 
     # -- FSMap / MDS cluster (MDSMonitor analog) ------------------------------
 
@@ -420,12 +473,19 @@ class Monitor(Dispatcher):
     def _beacon_ack(self, msg) -> None:
         fs = self.osdmap.fs_db
         rank = -1
-        for r, ent in fs.get("ranks", {}).items():
-            if ent["gid"] == msg.gid:
-                rank = int(r)
-                break
+        bal_rank, bal_load = -1, 0.0
+        with self._lock:
+            for r, ent in fs.get("ranks", {}).items():
+                if ent["gid"] == msg.gid:
+                    rank = int(r)
+                load = self._mds_beacons.get(ent["gid"], (0, "", 0.0))[2]
+                if bal_rank < 0 or load < bal_load:
+                    bal_rank, bal_load = int(r), load
         msg.connection.send_message(MMDSBeacon(
-            gid=msg.gid, addr=msg.addr, state="ack", rank=rank))
+            gid=msg.gid, addr=msg.addr, state="ack", rank=rank,
+            bal_rank=bal_rank, bal_load=bal_load,
+            meta_pool=fs.get("metadata_pool", -1) if fs else -1,
+            data_pool=fs.get("data_pool", -1) if fs else -1))
 
     # -- the mutation path (worker thread only) -------------------------------
 
@@ -456,6 +516,8 @@ class Monitor(Dispatcher):
                     self._do_mds_beacon(payload)
                 elif kind == "mds_failover":
                     self._do_mds_failover(payload)
+                elif kind == "rotate_keys":
+                    self._mutate(payload)
             except Exception:
                 from ceph_tpu.common.logging import get_logger
                 get_logger("mon").exception("mon.%d work item failed",
@@ -477,6 +539,32 @@ class Monitor(Dispatcher):
         blob = encode_osdmap(m, with_auth=True)
         return self.paxos.propose_and_wait(blob)
 
+    def _auth_lookup(self, entity: str):
+        """Entity secret for the handshake: the committed auth_db once
+        it exists, the static seed keyring before bootstrap (the
+        reference's mon keyring file)."""
+        db = self.osdmap.auth_db
+        if db:
+            key = db.get(entity)
+            return key if isinstance(key, str) else None
+        return self._cephx_seed.get(entity)
+
+    def _self_ticket(self, service: str):
+        """The mon dials services too (map pushes): it grants itself a
+        ticket from its own key server."""
+        svc_state = self.osdmap.auth_db.get("__svc__")
+        if svc_state is None:
+            return None
+        ks = self._keyserver({"__svc__": svc_state})
+        if service not in ks.SERVICES:
+            return None
+        return ks.grant(service, f"mon.{self.mon_id}")
+
+    def _keyserver(self, auth_db: dict):
+        from ceph_tpu.auth.cephx import KeyServer
+        return KeyServer(auth_db.setdefault("__svc__", {}),
+                         rotation_period=self.cephx_rotation)
+
     def _do_bootstrap(self) -> None:
         if self.paxos.last_committed > 0:
             return
@@ -485,6 +573,12 @@ class Monitor(Dispatcher):
             m.crush = CrushMap()
             m.crush.add_bucket(
                 make_bucket(-1, CRUSH_BUCKET_STRAW2, 2, [], []))
+            if self._cephx_seed:
+                # commit the seed + fresh rotating service keys
+                m.auth_db.update(self._cephx_seed)
+                ks = self._keyserver(m.auth_db)
+                for svc in ks.SERVICES:
+                    ks._svc(svc)
         self._mutate(fn)
 
     # -- dispatch -------------------------------------------------------------
@@ -504,6 +598,13 @@ class Monitor(Dispatcher):
             self._handle_command_msg(msg)
             return True
         if isinstance(msg, MMonForward):
+            # only a fellow mon may forward (it attests the original
+            # caller's identity inside the blob; a client sending this
+            # directly could forge any identity)
+            if self._cephx_seed:
+                ent = getattr(msg.connection, "auth_entity", None)
+                if not (ent or "").startswith("mon."):
+                    return True
             import json
             cmd = json.loads(msg.cmd_blob.decode())
             self._work_q.put(("cmd", cmd,
@@ -524,14 +625,19 @@ class Monitor(Dispatcher):
             with self._lock:
                 entity = (msg.connection.peer_name
                           or EntityName.parse(msg.name))
-                self._subs[msg.name] = (msg.addr, entity)
+                # map pushes ride the SUBSCRIBER'S OWN connection (the
+                # session it authenticated): dialing its listener back
+                # would need credentials no one holds for "client"
+                # targets, and a fake push must be impossible anyway
+                self._subs[msg.name] = (msg.addr, entity,
+                                        msg.connection)
                 epoch = self.osdmap.epoch
                 # renewal from a current subscriber: nothing to send
                 blob = (encode_osdmap(self.osdmap)
                         if epoch > msg.epoch else None)
             if epoch > 0 and blob is not None:
-                con = self.msgr.connect_to(msg.addr, entity)
-                con.send_message(MOSDMapMsg(epoch=epoch, map_blob=blob))
+                msg.connection.send_message(
+                    MOSDMapMsg(epoch=epoch, map_blob=blob))
             return True
         if isinstance(msg, MPGStats):
             with self._lock:
@@ -561,6 +667,12 @@ class Monitor(Dispatcher):
         return False
 
     def _handle_command_msg(self, msg: MMonCommand) -> None:
+        # the AUTHENTICATED identity comes from the connection's cephx
+        # handshake, never from the command body (strip spoof attempts)
+        msg.cmd.pop("_auth_entity", None)
+        ent = getattr(msg.connection, "auth_entity", None)
+        if ent is not None:
+            msg.cmd["_auth_entity"] = ent
         if self.is_leader():
             self._work_q.put(("cmd", msg.cmd,
                               (msg.connection, msg.tid, None)))
@@ -738,10 +850,24 @@ class Monitor(Dispatcher):
 
     # -- command table (MonCommands.h analog; worker thread) ------------------
 
+    #: with cephx identities, these need client.admin (minimal caps
+    #: floor; the reference's MonCap grammar is richer)
+    ADMIN_ONLY = ("auth get-or-create", "auth del", "auth ls",
+                  "auth get", "auth print-key", "config set",
+                  "config rm", "osd setcrushmap")
+
     def handle_command(self, cmd: dict) -> tuple[str, int]:
         import json
         prefix = cmd.get("prefix", "")
+        ent = cmd.get("_auth_entity")
+        if ent is not None and ent != "client.admin" \
+                and prefix in self.ADMIN_ONLY:
+            return f"entity {ent!r} not authorized for {prefix!r}", -13
         try:
+            if prefix == "auth get-ticket":
+                return self._cmd_auth_get_ticket(cmd)
+            if prefix == "auth rotating":
+                return self._cmd_auth_rotating(cmd)
             if prefix == "status":
                 return json.dumps(self.status()), 0
             if prefix in ("health", "health detail"):
@@ -761,13 +887,15 @@ class Monitor(Dispatcher):
             if prefix in ("auth get", "auth print-key"):
                 ent = str(cmd["entity"])
                 key = self.osdmap.auth_db.get(ent)
-                if key is None:
+                if not isinstance(key, str):
                     return f"no key for {ent!r}", -2
                 if prefix == "auth print-key":
                     return key, 0
                 return self._keyring(ent, key), 0
             if prefix == "auth ls":
-                return json.dumps(sorted(self.osdmap.auth_db)), 0
+                return json.dumps(sorted(
+                    e for e, v in self.osdmap.auth_db.items()
+                    if isinstance(v, str))), 0   # not the key server
             if prefix == "auth del":
                 ent = str(cmd["entity"])
                 if ent not in self.osdmap.auth_db:
@@ -1039,6 +1167,44 @@ class Monitor(Dispatcher):
             return f"unknown command {prefix!r}", -22
         except (KeyError, ValueError, IndexError) as e:
             return f"command failed: {e}", -22
+
+    def _cmd_auth_get_ticket(self, cmd) -> tuple[str, int]:
+        """Ticket grant (CephxServiceHandler): the caller's cephx
+        identity gets a ticket for one service — unless the entity has
+        been deleted, which is how `auth del` cuts future access."""
+        ent = cmd.get("_auth_entity")
+        if ent is None:
+            return "no authenticated identity on this connection", -13
+        db = self.osdmap.auth_db
+        if (db.get(ent) is None or not isinstance(db.get(ent), str)) \
+                and self._cephx_seed.get(ent) is None:
+            return f"entity {ent!r} unknown or revoked", -13
+        service = str(cmd.get("service", ""))
+        svc_state = self.osdmap.auth_db.get("__svc__")
+        if svc_state is None:
+            return "cephx key server not initialized", -22
+        ks = self._keyserver({"__svc__": svc_state})
+        if service not in ks.SERVICES:
+            return f"unknown service {service!r}", -22
+        from ceph_tpu.auth.cephx import ticket_to_json
+        return ticket_to_json(ks.grant(service, ent)), 0
+
+    def _cmd_auth_rotating(self, cmd) -> tuple[str, int]:
+        """Rotating service keys for a service DAEMON (its validation
+        material).  Only daemons of that service (or admin) may fetch."""
+        import json
+        ent = cmd.get("_auth_entity")
+        service = str(cmd.get("service", ""))
+        if ent is not None and ent != "client.admin" \
+                and not ent.startswith(service + "."):
+            return f"entity {ent!r} may not read {service!r} keys", -13
+        svc_state = self.osdmap.auth_db.get("__svc__")
+        if svc_state is None:
+            return "cephx key server not initialized", -22
+        ks = self._keyserver({"__svc__": svc_state})
+        if service not in ks.SERVICES:
+            return f"unknown service {service!r}", -22
+        return json.dumps(ks.rotating_keys(service)), 0
 
     def _cmd_fs_new(self, cmd) -> tuple[str, int]:
         """`ceph fs new <name> <metadata_pool> <data_pool>`

@@ -72,7 +72,13 @@ COMP_THRESHOLD = 1024
 def _handshake(sock: socket.socket, my_name: EntityName,
                auth_key: bytes | None,
                auth_required: bool,
-               comp_mode: int = COMP_NONE) -> tuple[EntityName, int]:
+               comp_mode: int = COMP_NONE,
+               cephx=None, accepted: bool = False,
+               peer_type: str = ""
+               ) -> tuple[EntityName, int, str | None]:
+    from ceph_tpu.auth.handshake import (
+        AUTH_CEPHX_ENTITY, AUTH_CEPHX_TICKET, accept_ticket,
+        entity_proof, proof as sess_proof, ticket_for)
     sock.sendall(BANNER)
     got = _read_exact(sock, len(BANNER))
     if got != BANNER:
@@ -84,29 +90,97 @@ def _handshake(sock: socket.socket, my_name: EntityName,
         raise ConnectionError("oversized name frame")
     peer = EntityName.parse(_read_exact(sock, plen).decode())
 
-    # auth phase: mode + fresh nonce both ways, then mutual HMAC proofs
-    my_mode = AUTH_CEPHX if auth_key else AUTH_NONE
+    # auth phase: mode + fresh nonce both ways, then mutual proofs
+    if cephx is not None:
+        my_mode = (cephx.acceptor_mode() if accepted
+                   else cephx.initiator_mode(peer_type or peer.type))
+    else:
+        my_mode = AUTH_CEPHX if auth_key else AUTH_NONE
     my_nonce = os.urandom(16)
     sock.sendall(bytes([my_mode]) + my_nonce)
     hdr = _read_exact(sock, 17)
     peer_mode, peer_nonce = hdr[0], hdr[1:]
-    if auth_required and peer_mode != AUTH_CEPHX:
-        raise ConnectionError(f"peer {peer} refused authentication")
-    if my_mode == AUTH_CEPHX and peer_mode == AUTH_CEPHX:
-        # prove I hold the key over the PEER's nonce (never my own:
-        # fresh peer nonces are the replay protection)
-        proof = hmac.new(auth_key, peer_nonce + me,
-                         hashlib.sha256).digest()
-        sock.sendall(proof)
-        peer_proof = _read_exact(sock, 32)
-        want = hmac.new(auth_key, my_nonce + str(peer).encode(),
-                        hashlib.sha256).digest()
-        if not hmac.compare_digest(peer_proof, want):
-            raise ConnectionError(f"peer {peer} failed authentication")
+    auth_entity: str | None = None
+    if cephx is not None:
+        if not accepted:
+            if my_mode == AUTH_CEPHX_TICKET:
+                t = ticket_for(cephx, peer_type or peer.type)
+                if t is None:
+                    raise ConnectionError(
+                        f"no ticket for {peer_type or peer.type}")
+                blob = t.blob()
+                sock.sendall(_LEN.pack(len(blob)) + blob
+                             + sess_proof(t.session_key, peer_nonce,
+                                          t.entity))
+                skey = t.session_key
+            elif my_mode == AUTH_CEPHX_ENTITY:
+                ent = cephx.entity.encode()
+                sock.sendall(_LEN.pack(len(ent)) + ent
+                             + entity_proof(cephx.key, peer_nonce,
+                                            cephx.entity))
+                skey = cephx.key.encode()
+            else:
+                skey = None
+            if skey is not None:
+                peer_proof = _read_exact(sock, 32)
+                want = hmac.new(skey, my_nonce + str(peer).encode(),
+                                hashlib.sha256).digest()
+                if not hmac.compare_digest(peer_proof, want):
+                    raise ConnectionError(
+                        f"peer {peer} failed cephx proof")
+        else:
+            if peer_mode in (AUTH_CEPHX_TICKET, AUTH_CEPHX_ENTITY):
+                clen = _LEN.unpack(_read_exact(sock, _LEN.size))[0]
+                if clen > 4096:
+                    raise ConnectionError("oversized auth credential")
+                cred = _read_exact(sock, clen)
+                if peer_mode == AUTH_CEPHX_TICKET:
+                    got2 = accept_ticket(cephx, cred)
+                    if got2 is None:
+                        raise ConnectionError(
+                            f"peer {peer} invalid/expired ticket")
+                    auth_entity, skey = got2
+                else:
+                    auth_entity = cred.decode()
+                    key = (cephx.auth_lookup(auth_entity)
+                           if cephx.auth_lookup else
+                           (cephx.key if auth_entity == cephx.entity
+                            else None))
+                    if key is None:
+                        raise ConnectionError(
+                            f"unknown or revoked entity {auth_entity!r}")
+                    skey = key.encode()
+                peer_proof = _read_exact(sock, 32)
+                want = hmac.new(skey,
+                                my_nonce + auth_entity.encode(),
+                                hashlib.sha256).digest()
+                if not hmac.compare_digest(peer_proof, want):
+                    raise ConnectionError(
+                        f"peer {peer} failed cephx proof")
+                sock.sendall(hmac.new(skey, peer_nonce + me,
+                                      hashlib.sha256).digest())
+            elif cephx.required:
+                raise ConnectionError(
+                    f"peer {peer} auth mode {peer_mode} not acceptable")
+    else:
+        if auth_required and peer_mode != AUTH_CEPHX:
+            raise ConnectionError(f"peer {peer} refused authentication")
+        if my_mode == AUTH_CEPHX and peer_mode == AUTH_CEPHX:
+            # prove I hold the key over the PEER's nonce (never my own:
+            # fresh peer nonces are the replay protection)
+            proof = hmac.new(auth_key, peer_nonce + me,
+                             hashlib.sha256).digest()
+            sock.sendall(proof)
+            peer_proof = _read_exact(sock, 32)
+            want = hmac.new(auth_key, my_nonce + str(peer).encode(),
+                            hashlib.sha256).digest()
+            if not hmac.compare_digest(peer_proof, want):
+                raise ConnectionError(
+                    f"peer {peer} failed authentication")
     # compression negotiation: both offer; min wins (off beats on)
     sock.sendall(bytes([comp_mode]))
     peer_comp = _read_exact(sock, 1)[0]
-    return peer, min(comp_mode, peer_comp)
+    return peer, min(comp_mode, peer_comp), auth_entity
 
 
 class TcpConnection(Connection):
@@ -165,8 +239,10 @@ class TcpConnection(Connection):
         m = self.messenger
         # keep the dial timeout through the handshake: a stalled or
         # malicious peer must not wedge the writer thread forever
-        peer, self.comp = _handshake(s, m.my_name, m.auth_key,
-                                     m.auth_required, m.comp_mode)
+        peer, self.comp, _ent = _handshake(
+            s, m.my_name, m.auth_key, m.auth_required, m.comp_mode,
+            cephx=m.cephx, accepted=False,
+            peer_type=self.peer_name.type if self.peer_name else "")
         s.settimeout(None)
         with self._lock:
             self._sock = s
@@ -298,6 +374,8 @@ class AsyncMessenger(Messenger):
         self._stop = False
         self.auth_key: bytes | None = None
         self.auth_required = False
+        #: per-entity cephx config; supersedes the shared-key handshake
+        self.cephx = None
         self.comp_mode = COMP_NONE
         from ceph_tpu.common.throttle import Throttle
         self.dispatch_throttle = Throttle(
@@ -319,6 +397,9 @@ class AsyncMessenger(Messenger):
             key = key.encode()
         self.auth_key = key
         self.auth_required = bool(key) and required
+
+    def set_auth_cephx(self, config) -> None:
+        self.cephx = config
 
     def reap(self, con: "TcpConnection") -> None:
         """Drop a dead connection from the table (reconnect storms must
@@ -363,8 +444,9 @@ class AsyncMessenger(Messenger):
             # handshake-phase timeout: an unauthenticated peer that
             # stalls mid-handshake must not leak a thread + fd
             sock.settimeout(10)
-            peer, comp = _handshake(sock, self.my_name, self.auth_key,
-                                    self.auth_required, self.comp_mode)
+            peer, comp, auth_entity = _handshake(
+                sock, self.my_name, self.auth_key, self.auth_required,
+                self.comp_mode, cephx=self.cephx, accepted=True)
             sock.settimeout(None)
         except (ConnectionError, OSError):
             sock.close()
@@ -372,6 +454,7 @@ class AsyncMessenger(Messenger):
         policy = self.policy_for(peer.type)
         con = TcpConnection(self, f"{sock.getpeername()[0]}:0", peer,
                             policy, sock=sock, accepted=True, comp=comp)
+        con.auth_entity = auth_entity
         with self._lock:
             if self._stop:
                 # raced shutdown(): it already swept _conns — a session

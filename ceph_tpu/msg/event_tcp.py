@@ -48,6 +48,10 @@ import threading
 import time
 import zlib
 
+from ceph_tpu.auth.handshake import (
+    AUTH_CEPHX_ENTITY, AUTH_CEPHX_TICKET, accept_ticket, entity_proof,
+    proof as _sess_proof, ticket_for)
+
 from .async_tcp import (
     AUTH_CEPHX, AUTH_NONE, BANNER, COMP_NONE, COMP_THRESHOLD, COMP_ZLIB,
     MAX_FRAME)
@@ -93,6 +97,13 @@ class EventConnection(Connection):
         self.hs_stage = "banner"
         self.hs_nonce = b""
         self.hs_peer_mode = AUTH_NONE
+        self.hs_session: bytes | None = None   # cephx session/entity key
+        self.hs_peer_nonce = b""
+        self.hs_my_mode = AUTH_NONE
+        self.hs_eff = AUTH_NONE
+        #: authenticated cephx identity (e.g. "client.admin") — distinct
+        #: from the transport-level peer_name instance
+        self.auth_entity: str | None = None
         self.reconnect_at = 0.0
         #: interest cache: last mask set on the selector (0 = not
         #: registered) — skips no-op epoll_ctl syscalls
@@ -152,6 +163,8 @@ class EventConnection(Connection):
             with self.messenger._lock:
                 self.backlog.extendleft(reversed(salvage))
         self.hs_stage = "banner"
+        self.hs_session = None
+        self.auth_entity = None
         if self._down:
             self.state = _CLOSED
             return
@@ -213,7 +226,14 @@ class EventConnection(Connection):
         m = self.messenger
         me = str(m.my_name).encode()
         self.hs_nonce = os.urandom(16)
-        my_mode = AUTH_CEPHX if m.auth_key else AUTH_NONE
+        if m.cephx is not None:
+            my_mode = (m.cephx.acceptor_mode() if self.accepted
+                       else m.cephx.initiator_mode(
+                           self.peer_name.type if self.peer_name
+                           else ""))
+        else:
+            my_mode = AUTH_CEPHX if m.auth_key else AUTH_NONE
+        self.hs_my_mode = my_mode
         self.out_frames.append((BANNER + _LEN.pack(len(me)) + me
                                 + bytes([my_mode]) + self.hs_nonce, None))
 
@@ -249,33 +269,66 @@ class EventConnection(Connection):
             if len(self.inbuf) < 17:
                 return False
             self.hs_peer_mode = self.inbuf[0]
-            peer_nonce = bytes(self.inbuf[1:17])
+            self.hs_peer_nonce = bytes(self.inbuf[1:17])
             del self.inbuf[:17]
-            if m.auth_required and self.hs_peer_mode != AUTH_CEPHX:
-                raise ConnectionError(
-                    f"peer {self.peer_name} refused authentication")
-            both = (m.auth_key is not None
-                    and self.hs_peer_mode == AUTH_CEPHX)
-            if both:
-                me = str(m.my_name).encode()
-                self.out_frames.append((
-                    hmac.new(m.auth_key, peer_nonce + me,
-                             hashlib.sha256).digest(), None))
-                self.hs_stage = "proof"
+            if m.cephx is not None:
+                self._hs_cephx_start()
             else:
-                self.out_frames.append((bytes([m.comp_mode]), None))
-                self.hs_stage = "comp"
+                if m.auth_required and self.hs_peer_mode != AUTH_CEPHX:
+                    raise ConnectionError(
+                        f"peer {self.peer_name} refused authentication")
+                both = (m.auth_key is not None
+                        and self.hs_peer_mode == AUTH_CEPHX)
+                if both:
+                    me = str(m.my_name).encode()
+                    self.out_frames.append((
+                        hmac.new(m.auth_key, self.hs_peer_nonce + me,
+                                 hashlib.sha256).digest(), None))
+                    self.hs_stage = "proof"
+                else:
+                    self.out_frames.append((bytes([m.comp_mode]), None))
+                    self.hs_stage = "comp"
+        if self.hs_stage == "cred":        # acceptor: [len][credential]
+            if len(self.inbuf) < _LEN.size:
+                return False
+            clen = _LEN.unpack(bytes(self.inbuf[:_LEN.size]))[0]
+            if clen > 4096:
+                raise ConnectionError("oversized auth credential")
+            if len(self.inbuf) < _LEN.size + clen:
+                return False
+            cred = bytes(self.inbuf[_LEN.size:_LEN.size + clen])
+            del self.inbuf[:_LEN.size + clen]
+            self._hs_cephx_cred(cred)      # sets hs_session or raises
+            self.hs_stage = "proof"
         if self.hs_stage == "proof":
             if len(self.inbuf) < 32:
                 return False
             peer_proof = bytes(self.inbuf[:32])
             del self.inbuf[:32]
-            want = hmac.new(self.messenger.auth_key,
-                            self.hs_nonce + str(self.peer_name).encode(),
-                            hashlib.sha256).digest()
-            if not hmac.compare_digest(peer_proof, want):
-                raise ConnectionError(
-                    f"peer {self.peer_name} failed authentication")
+            if self.hs_session is not None:     # cephx ticket/entity
+                # initiator proved over MY nonce + the auth identity;
+                # I prove back over ITS nonce + my transport name
+                ident = (self.auth_entity if self.accepted
+                         else str(self.peer_name))
+                want = hmac.new(self.hs_session,
+                                self.hs_nonce + ident.encode(),
+                                hashlib.sha256).digest()
+                if not hmac.compare_digest(peer_proof, want):
+                    raise ConnectionError(
+                        f"peer {self.peer_name} failed cephx proof")
+                if self.accepted:
+                    self.out_frames.append((hmac.new(
+                        self.hs_session,
+                        self.hs_peer_nonce + str(m.my_name).encode(),
+                        hashlib.sha256).digest(), None))
+            else:                               # legacy shared key
+                want = hmac.new(
+                    self.messenger.auth_key,
+                    self.hs_nonce + str(self.peer_name).encode(),
+                    hashlib.sha256).digest()
+                if not hmac.compare_digest(peer_proof, want):
+                    raise ConnectionError(
+                        f"peer {self.peer_name} failed authentication")
             self.out_frames.append(
                 (bytes([self.messenger.comp_mode]), None))
             self.hs_stage = "comp"
@@ -290,6 +343,75 @@ class EventConnection(Connection):
                 self.messenger.register_accepted(self)
             self.hs_stage = "done"
         return True
+
+    # -- cephx handshake halves ------------------------------------------------
+
+    def _hs_cephx_start(self) -> None:
+        """Head exchanged under a cephx config: initiator emits its
+        credential + proof; acceptor waits for them."""
+        m = self.messenger
+        cfg = m.cephx
+        if not self.accepted:
+            eff = self.hs_my_mode
+            if eff == AUTH_CEPHX_TICKET:
+                t = ticket_for(cfg, self.peer_name.type
+                               if self.peer_name else "")
+                if t is None:
+                    raise ConnectionError(
+                        f"no ticket for service "
+                        f"{self.peer_name.type if self.peer_name else '?'}")
+                self.hs_session = t.session_key
+                blob = t.blob()
+                pf = _sess_proof(self.hs_session, self.hs_peer_nonce,
+                                 t.entity)
+                self.out_frames.append(
+                    (_LEN.pack(len(blob)) + blob + pf, None))
+                self.hs_stage = "proof"
+            elif eff == AUTH_CEPHX_ENTITY:
+                self.hs_session = cfg.key.encode()
+                ent = cfg.entity.encode()
+                pf = entity_proof(cfg.key, self.hs_peer_nonce,
+                                  cfg.entity)
+                self.out_frames.append(
+                    (_LEN.pack(len(ent)) + ent + pf, None))
+                self.hs_stage = "proof"
+            else:
+                self.out_frames.append((bytes([m.comp_mode]), None))
+                self.hs_stage = "comp"
+        else:
+            eff = self.hs_peer_mode
+            if eff in (AUTH_CEPHX_TICKET, AUTH_CEPHX_ENTITY):
+                self.hs_eff = eff
+                self.hs_stage = "cred"
+            elif eff == AUTH_NONE and not cfg.required:
+                self.out_frames.append((bytes([m.comp_mode]), None))
+                self.hs_stage = "comp"
+            else:
+                raise ConnectionError(
+                    f"peer {self.peer_name} auth mode {eff} "
+                    "not acceptable")
+
+    def _hs_cephx_cred(self, cred: bytes) -> None:
+        cfg = self.messenger.cephx
+        if self.hs_eff == AUTH_CEPHX_TICKET:
+            got = accept_ticket(cfg, cred)
+            if got is None:
+                raise ConnectionError(
+                    f"peer {self.peer_name} presented an invalid/"
+                    "expired ticket")
+            self.auth_entity, self.hs_session = got
+        else:
+            entity = cred.decode()
+            key = None
+            if cfg.auth_lookup is not None:
+                key = cfg.auth_lookup(entity)
+            elif entity == cfg.entity:
+                key = cfg.key
+            if key is None:
+                raise ConnectionError(
+                    f"unknown or revoked entity {entity!r}")
+            self.auth_entity = entity
+            self.hs_session = key.encode()
 
     # -- frame I/O ------------------------------------------------------------
 
@@ -438,6 +560,9 @@ class EventMessenger(Messenger):
         self._stop = False
         self.auth_key: bytes | None = None
         self.auth_required = False
+        #: per-entity cephx config (tickets / entity secrets); when set
+        #: it supersedes the legacy shared-key handshake
+        self.cephx = None
         self.comp_mode = COMP_NONE
         self.paused = False
         #: accepted connections still mid-handshake (not yet in _conns):
@@ -465,6 +590,9 @@ class EventMessenger(Messenger):
             key = key.encode()
         self.auth_key = key
         self.auth_required = bool(key) and required
+
+    def set_auth_cephx(self, config) -> None:
+        self.cephx = config
 
     # -- loop plumbing --------------------------------------------------------
 

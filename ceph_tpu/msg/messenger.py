@@ -66,6 +66,9 @@ class Connection:
         self.messenger = messenger
         self.peer_addr = peer_addr
         self.peer_name: EntityName | None = None
+        #: cephx-authenticated identity (e.g. "client.admin"), set by
+        #: wire handshakes; None on unauthenticated/loopback links
+        self.auth_entity: str | None = None
 
     def send_message(self, msg: Message) -> None:
         raise NotImplementedError
@@ -133,6 +136,11 @@ class Messenger:
         """cephx-lite shared-key authentication; only wire stacks
         enforce it (in-process loopback peers are the same trust
         domain)."""
+
+    def set_auth_cephx(self, config) -> None:
+        """Per-entity cephx (tickets + entity secrets, a CephxConfig);
+        only wire stacks enforce it — in-process loopback peers are the
+        same trust domain."""
 
     def set_compression(self, mode) -> None:
         """On-wire frame compression offer; only wire stacks compress

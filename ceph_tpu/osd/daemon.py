@@ -180,7 +180,8 @@ class OSDDaemon(Dispatcher):
                  store_type: str = "memstore", store_path: str = "",
                  ms_type: str = "async", addr: str = "127.0.0.1:0",
                  heartbeats: bool = True, auth_key=None,
-                 mgr_addr: str | None = None):
+                 mgr_addr: str | None = None,
+                 cephx: tuple[str, str] | None = None):
         self.osd_id = osd_id
         self.whoami = EntityName("osd", osd_id)
         self.ctx = ctx or CephTpuContext(f"osd.{osd_id}")
@@ -227,8 +228,23 @@ class OSDDaemon(Dispatcher):
         self.debug_drop_rep_ops = 0
 
         self._auth_key = auth_key
+        self._cephx = cephx
         self.msgr = Messenger.create(self.whoami, ms_type)
         self.msgr.set_auth(auth_key)
+        #: mon-command waiters for the daemon's own admin RPCs
+        #: (rotating-key refresh, ticket grants)
+        self._moncmd_tid = 0
+        self._moncmd_waiters: dict[int, tuple] = {}
+        if cephx is not None:
+            from ceph_tpu.auth.cephx import TicketKeyring
+            from ceph_tpu.auth.handshake import CephxConfig
+            #: gen -> service key; validates peer/client tickets
+            self._rotating: dict[int, str] = {}
+            self._rotating_at = 0.0
+            self.msgr.set_auth_cephx(CephxConfig(
+                entity=cephx[0], key=cephx[1],
+                keyring=TicketKeyring(self._fetch_ticket),
+                service="osd", rotating=lambda: self._rotating))
         self.msgr.set_policy("client", ConnectionPolicy.lossy_client())
         self.msgr.set_policy("osd", ConnectionPolicy.stateful_peer())
         self.msgr.set_policy("mon", ConnectionPolicy.stateful_peer())
@@ -381,6 +397,9 @@ class OSDDaemon(Dispatcher):
         self._load_pgs()
         self.msgr.bind(self._addr)
         self.msgr.start()
+        if self._cephx is not None:
+            # validation material BEFORE peers/clients connect
+            self._refresh_rotating()
         self._maybe_reboot()
         if self._heartbeats:
             self._schedule_heartbeat()
@@ -436,10 +455,57 @@ class OSDDaemon(Dispatcher):
             osd_id=self.osd_id, counters=counters, pg_states=states,
             num_objects=n_obj, bytes_used=n_bytes))
 
+    ROTATING_REFRESH = 60.0
+
+    def _mon_cmd(self, cmd: dict, timeout: float = 8.0
+                 ) -> tuple[int, str]:
+        """Small daemon-side mon command RPC (rotating keys, tickets)."""
+        import json as _json
+        import queue as _queue
+        with self._lock:
+            self._moncmd_tid += 1
+            tid = self._moncmd_tid
+            q: _queue.Queue = _queue.Queue()
+            self._moncmd_waiters[tid] = q
+        from ceph_tpu.messages import MMonCommand
+        try:
+            for rank, addr in enumerate(self.mon_addrs):
+                con = self.msgr.connect_to(addr, EntityName("mon", rank))
+                con.send_message(MMonCommand(tid=tid, cmd=dict(cmd)))
+            try:
+                return q.get(timeout=timeout)
+            except _queue.Empty:
+                return -110, "mon command timed out"
+        finally:
+            with self._lock:
+                self._moncmd_waiters.pop(tid, None)
+
+    def _refresh_rotating(self) -> None:
+        import json as _json
+        rc, out = self._mon_cmd({"prefix": "auth rotating",
+                                 "service": "osd"})
+        if rc == 0:
+            self._rotating = {int(g): k
+                              for g, k in _json.loads(out).items()}
+            self._rotating_at = time.time()
+
+    def _fetch_ticket(self, service: str):
+        from ceph_tpu.auth.cephx import ticket_from_json
+        rc, out = self._mon_cmd({"prefix": "auth get-ticket",
+                                 "service": service})
+        return ticket_from_json(out) if rc == 0 else None
+
     def _tick(self) -> None:
         try:
             now = time.time()
             self._maybe_reboot()
+            if self._cephx is not None \
+                    and now - self._rotating_at > self.ROTATING_REFRESH:
+                self._rotating_at = now     # before: no retry storm
+                try:
+                    self._refresh_rotating()
+                except (OSError, TimeoutError):
+                    pass
             self._renew_map_subscription(now)
             self._agent_scan(now)
             self._mgr_report()
@@ -1613,6 +1679,13 @@ class OSDDaemon(Dispatcher):
             return True
         if isinstance(msg, MOSDMapMsg):
             self._handle_map(msg)
+            return True
+        from ceph_tpu.messages import MMonCommandAck
+        if isinstance(msg, MMonCommandAck):
+            with self._lock:
+                q = self._moncmd_waiters.get(msg.tid)
+            if q is not None:
+                q.put((msg.result, msg.output))
             return True
         # queued classes (enqueue_op → op_shardedwq → dequeue_op): work
         # items shard by pgid and ride the mClock scheduler; replies and

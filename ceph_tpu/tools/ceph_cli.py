@@ -29,6 +29,9 @@ COMMANDS = {
     ("auth", "ls"): [],
     ("auth", "del"): ["entity"],
     ("quorum_status",): [],
+    ("fs", "new"): ["fs_name", "metadata", "data"],
+    ("fs", "status"): [],
+    ("fs", "set"): ["var", "val"],
     ("osd", "tree"): [],
     ("osd", "getmap"): [],
     ("osd", "pool", "create"): [],

@@ -23,7 +23,7 @@ class MiniCluster:
     def __init__(self, n_osds: int = 3, ms_type: str = "async",
                  store_type: str = "memstore", base_path: str = "",
                  heartbeats: bool = False, n_mons: int = 1,
-                 auth_key=None):
+                 auth_key=None, cephx: bool = False):
         # namespace loopback addresses per cluster: sequential tests reuse
         # names like "mon.0", and a timer from a dying daemon of the
         # previous cluster must never reach this one
@@ -40,6 +40,16 @@ class MiniCluster:
         self._n_initial = n_osds
         self._n_mons = n_mons
         self.auth_key = auth_key
+        #: full cephx mode: per-entity keys + tickets (wire stacks).
+        #: The seed keyring (mon keys + admin) is generated here — the
+        #: `ceph-authtool` bootstrap step
+        self.cephx = cephx
+        self.keyring: dict[str, str] = {}
+        if cephx:
+            from ceph_tpu.auth.cephx import new_secret
+            for i in range(n_mons):
+                self.keyring[f"mon.{i}"] = new_secret()
+            self.keyring["client.admin"] = new_secret()
         self.mgr = None
         self.mds = None
         self.fs_mds: list = []
@@ -82,7 +92,8 @@ class MiniCluster:
                 else f"{self._ns}mon.{mon_id}")
         path = (f"{self.base_path}/mon.{mon_id}" if self.base_path else None)
         mon = Monitor(mon_id=mon_id, ms_type=self.ms_type, addr=addr,
-                      store_path=path, auth_key=self.auth_key)
+                      store_path=path, auth_key=self.auth_key,
+                      cephx_keyring=self.keyring if self.cephx else None)
         if defer_monmap:
             mon.init(monmap=[])   # bind only; set_monmap comes later
         else:
@@ -108,8 +119,13 @@ class MiniCluster:
         from ceph_tpu.mgr import MgrDaemon
         addr = ("127.0.0.1:0" if self._is_wire()
                 else f"{self._ns}mgr.0")
+        cephx = None
+        if self.cephx:
+            key = self.keyring.get("mgr.0") or self.provision_key("mgr.0")
+            cephx = ("mgr.0", key)
         self.mgr = MgrDaemon(self.mon_host, ms_type=self.ms_type,
-                             addr=addr, auth_key=self.auth_key)
+                             addr=addr, auth_key=self.auth_key,
+                             cephx=cephx)
         self.mgr.init()
         return self.mgr
 
@@ -119,9 +135,13 @@ class MiniCluster:
         from ceph_tpu.mds import MDSDaemon
         addr = ("127.0.0.1:0" if self._is_wire()
                 else f"{self._ns}mds.0")
+        cephx = None
+        if self.cephx:
+            key = self.keyring.get("mds.0") or self.provision_key("mds.0")
+            cephx = ("mds.0", key)
         self.mds = MDSDaemon(self.mon_host, metadata_pool, data_pool,
                              ms_type=self.ms_type, addr=addr,
-                             auth_key=self.auth_key)
+                             auth_key=self.auth_key, cephx=cephx)
         self.mds.init()
         return self.mds
 
@@ -136,8 +156,14 @@ class MiniCluster:
             self._fs_mds_seq += 1
             addr = ("127.0.0.1:0" if self._is_wire()
                     else f"{self._ns}mds.g{idx}")
+            cephx = None
+            if self.cephx:
+                ent = f"mds.{idx}"
+                key = self.keyring.get(ent) or self.provision_key(ent)
+                cephx = (ent, key)
             d = MDSDaemon(self.mon_host, ms_type=self.ms_type,
-                          addr=addr, auth_key=self.auth_key)
+                          addr=addr, auth_key=self.auth_key,
+                          cephx=cephx)
             d.init_standby()
             self.fs_mds.append(d)
             out.append(d)
@@ -159,14 +185,31 @@ class MiniCluster:
         self.mds = None
         mds.shutdown()
 
+    def provision_key(self, entity: str) -> str:
+        """`ceph auth get-or-create` as admin; returns the secret."""
+        admin = self.client()
+        rc, out = admin.mon_command({"prefix": "auth get-or-create",
+                                     "entity": entity})
+        assert rc == 0, out
+        rc, key = admin.mon_command({"prefix": "auth print-key",
+                                     "entity": entity})
+        assert rc == 0, key
+        self.keyring[entity] = key
+        return key
+
     def run_osd(self, osd_id: int) -> OSDDaemon:
         addr = (f"127.0.0.1:0" if self._is_wire()
                 else f"{self._ns}osd.{osd_id}")
         path = (f"{self.base_path}/osd.{osd_id}" if self.base_path else "")
+        cephx = None
+        if self.cephx:
+            ent = f"osd.{osd_id}"
+            key = self.keyring.get(ent) or self.provision_key(ent)
+            cephx = (ent, key)
         osd = OSDDaemon(osd_id, self.mon_host, store_type=self.store_type,
                         store_path=path, ms_type=self.ms_type, addr=addr,
                         heartbeats=self.heartbeats,
-                        auth_key=self.auth_key,
+                        auth_key=self.auth_key, cephx=cephx,
                         mgr_addr=self.mgr.addr if self.mgr else None)
         osd.init()
         self.osds[osd_id] = osd
@@ -178,8 +221,20 @@ class MiniCluster:
         osd.shutdown()
 
     def client(self, timeout: float = 10.0) -> RadosClient:
+        cephx = (("client.admin", self.keyring["client.admin"])
+                 if self.cephx else None)
         c = RadosClient(self.mon_host, ms_type=self.ms_type,
-                        timeout=timeout, auth_key=self.auth_key)
+                        timeout=timeout, auth_key=self.auth_key,
+                        cephx=cephx)
+        c.connect()
+        self.clients.append(c)
+        return c
+
+    def client_as(self, entity: str, key: str,
+                  timeout: float = 10.0) -> RadosClient:
+        """A client with SPECIFIC cephx credentials (not admin)."""
+        c = RadosClient(self.mon_host, ms_type=self.ms_type,
+                        timeout=timeout, cephx=(entity, key))
         c.connect()
         self.clients.append(c)
         return c

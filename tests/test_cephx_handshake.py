@@ -1,0 +1,200 @@
+"""cephx handshake over the wire messenger (CephxProtocol on the
+AsyncConnection auth phase): ticket mode to services, entity-secret
+mode to mons, rejection of forged/expired/revoked credentials."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ceph_tpu.auth.cephx import KeyServer, Ticket, TicketKeyring, mint_ticket
+from ceph_tpu.auth.handshake import CephxConfig
+from ceph_tpu.messages import MOSDPing
+from ceph_tpu.msg.messenger import EntityName, Messenger
+
+
+class Sink:
+    def __init__(self):
+        self.got = []
+
+    def ms_dispatch(self, msg):
+        self.got.append(msg)
+        return True
+
+    def ms_handle_reset(self, con):
+        pass
+
+    def ms_handle_remote_reset(self, con):
+        pass
+
+
+MS_TYPE = "async"
+
+
+def mk(name, cfg=None):
+    m = Messenger.create(EntityName(*name), MS_TYPE)
+    if cfg is not None:
+        m.set_auth_cephx(cfg)
+    m.bind("127.0.0.1:0")
+    m.start()
+    return m
+
+
+def wait_got(sink, n=1, timeout=5.0):
+    deadline = time.time() + timeout
+    while len(sink.got) < n and time.time() < deadline:
+        time.sleep(0.02)
+    return len(sink.got) >= n
+
+
+@pytest.fixture
+def ks():
+    return KeyServer()
+
+
+def service_messenger(ks, name=("osd", 1), service="osd"):
+    cfg = CephxConfig(service=service,
+                      rotating=lambda: ks.rotating_keys(service))
+    m = mk(name, cfg)
+    sink = Sink()
+    m.add_dispatcher_tail(sink)
+    return m, sink
+
+
+def test_ticket_handshake_grants_access(ks):
+    server, sink = service_messenger(ks)
+    kr = TicketKeyring(lambda svc: ks.grant(svc, "client.alice"))
+    client = mk(("client", 7), CephxConfig(entity="client.alice",
+                                           keyring=kr))
+    try:
+        con = client.connect_to(server.my_addr, EntityName("osd", 1))
+        con.send_message(MOSDPing(from_osd=7, op=MOSDPing.PING))
+        assert wait_got(sink), "ticketed client failed to get through"
+        # the service knows WHO this is (authorization identity)
+        acc = next(iter(server._conns.values()), None) or \
+            next(iter(server._accepting), None)
+        ents = {c.auth_entity for c in server._conns.values()}
+        assert "client.alice" in ents
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_no_ticket_rejected(ks):
+    server, sink = service_messenger(ks)
+    client = mk(("client", 8))          # no auth at all
+    try:
+        con = client.connect_to(server.my_addr, EntityName("osd", 1))
+        con.send_message(MOSDPing(from_osd=8, op=MOSDPing.PING))
+        time.sleep(1.0)
+        assert sink.got == []
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_forged_ticket_rejected(ks):
+    server, sink = service_messenger(ks)
+    ks.grant("osd", "seed")             # init generation 1
+    forged = mint_ticket("osd", "client.evil", 1, "not-the-service-key")
+    kr = TicketKeyring(lambda svc: forged)
+    client = mk(("client", 9), CephxConfig(entity="client.evil",
+                                           keyring=kr))
+    try:
+        con = client.connect_to(server.my_addr, EntityName("osd", 1))
+        con.send_message(MOSDPing(from_osd=9, op=MOSDPing.PING))
+        time.sleep(1.0)
+        assert sink.got == []
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_expired_ticket_rejected_then_fresh_works(ks):
+    server, sink = service_messenger(ks)
+    state = {"ttl": -1.0}               # born expired
+    kr = TicketKeyring(lambda svc: ks.grant(svc, "client.t",
+                                            ttl=state["ttl"]))
+    client = mk(("client", 10), CephxConfig(entity="client.t",
+                                            keyring=kr))
+    try:
+        con = client.connect_to(server.my_addr, EntityName("osd", 1))
+        con.send_message(MOSDPing(from_osd=10, op=MOSDPing.PING))
+        time.sleep(1.0)
+        assert sink.got == []
+        # a fresh ticket heals the connection on its reconnect cycle
+        state["ttl"] = 60.0
+        kr.invalidate()
+        deadline = time.time() + 8
+        while not sink.got and time.time() < deadline:
+            time.sleep(0.1)
+        assert sink.got, "fresh ticket never got through"
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_rotation_kills_old_generation(ks):
+    server, sink = service_messenger(ks)
+    old = ks.grant("osd", "client.r")   # gen 1
+    from ceph_tpu.auth.cephx import LIVE_GENERATIONS
+    for _ in range(LIVE_GENERATIONS):
+        ks.rotate_now("osd")
+    kr = TicketKeyring(lambda svc: old)     # stuck with the old ticket
+    client = mk(("client", 11), CephxConfig(entity="client.r",
+                                            keyring=kr))
+    try:
+        con = client.connect_to(server.my_addr, EntityName("osd", 1))
+        con.send_message(MOSDPing(from_osd=11, op=MOSDPing.PING))
+        time.sleep(1.0)
+        assert sink.got == []
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_entity_mode_to_mon_and_revocation(ks):
+    db = {"client.alice": "alicekey", "osd.1": "osdkey"}
+    mon = mk(("mon", 0), CephxConfig(
+        entity="mon.0", key="monkey",
+        auth_lookup=lambda e: db.get(e)))
+    sink = Sink()
+    mon.add_dispatcher_tail(sink)
+    alice = mk(("client", 12), CephxConfig(entity="client.alice",
+                                           key="alicekey"))
+    mallory = mk(("client", 13), CephxConfig(entity="client.alice",
+                                             key="wrongkey"))
+    try:
+        con = alice.connect_to(mon.my_addr, EntityName("mon", 0))
+        con.send_message(MOSDPing(from_osd=12, op=MOSDPing.PING))
+        assert wait_got(sink)
+        ents = {c.auth_entity for c in mon._conns.values()}
+        assert "client.alice" in ents
+
+        n0 = len(sink.got)
+        con2 = mallory.connect_to(mon.my_addr, EntityName("mon", 0))
+        con2.send_message(MOSDPing(from_osd=13, op=MOSDPing.PING))
+        time.sleep(1.0)
+        assert len(sink.got) == n0      # wrong key: nothing arrives
+
+        # REVOCATION: delete alice; her next reconnect dies at lookup
+        del db["client.alice"]
+        con.mark_down()
+        con3 = alice.connect_to(mon.my_addr, EntityName("mon", 0))
+        con3.send_message(MOSDPing(from_osd=12, op=MOSDPing.PING))
+        time.sleep(1.0)
+        assert len(sink.got) == n0      # revoked entity locked out
+    finally:
+        alice.shutdown()
+        mallory.shutdown()
+        mon.shutdown()
+
+
+def test_ticket_and_entity_on_threaded_stack(ks, monkeypatch):
+    """The threaded (blocking) stack speaks the same cephx dialect."""
+    import tests.test_cephx_handshake as me
+    monkeypatch.setattr(me, "MS_TYPE", "threaded")
+    test_ticket_handshake_grants_access(ks)
+    test_no_ticket_rejected(KeyServer())
+    test_entity_mode_to_mon_and_revocation(KeyServer())

@@ -1,0 +1,112 @@
+"""cephx ticket protocol unit tests (CephxProtocol.h observable
+behaviour: mint/validate, forgery, expiry, rotation, keyring refresh)."""
+
+import time
+
+from ceph_tpu.auth.cephx import (
+    LIVE_GENERATIONS, KeyServer, TicketKeyring, mint_ticket,
+    validate_ticket)
+
+
+def test_mint_validate_roundtrip():
+    ks = KeyServer()
+    t = ks.grant("osd", "client.admin")
+    got = validate_ticket(t.blob(), "osd", ks.rotating_keys("osd"))
+    assert got is not None
+    entity, skey = got
+    assert entity == "client.admin"
+    assert skey == t.session_key       # both sides derive the same key
+
+
+def test_wrong_service_and_tamper_rejected():
+    ks = KeyServer()
+    t = ks.grant("osd", "client.x")
+    assert validate_ticket(t.blob(), "mds",
+                           ks.rotating_keys("mds")) is None
+    evil = t.blob().replace(b"client.x", b"client.root")
+    assert validate_ticket(evil, "osd", ks.rotating_keys("osd")) is None
+    assert validate_ticket(b"garbage", "osd",
+                           ks.rotating_keys("osd")) is None
+
+
+def test_forged_ticket_without_service_key():
+    ks = KeyServer()
+    ks.grant("osd", "x")                    # init the service
+    forged = mint_ticket("osd", "client.evil", 1, "attackerkey")
+    assert validate_ticket(forged.blob(), "osd",
+                           ks.rotating_keys("osd")) is None
+
+
+def test_expiry():
+    ks = KeyServer()
+    t = ks.grant("osd", "c", ttl=0.1)
+    assert validate_ticket(t.blob(), "osd", ks.rotating_keys("osd"),
+                           now=time.time() + 1) is None
+
+
+def test_rotation_keeps_live_generations():
+    ks = KeyServer(rotation_period=0.0)
+    t1 = ks.grant("osd", "c")               # signed with gen 1
+    # a service that fetched keys BEFORE any rotation already holds the
+    # next generation — the property that makes rotation hitless
+    pre_rotation_keys = ks.rotating_keys("osd")
+    assert set(pre_rotation_keys) == {1, 2}
+    ks.rotate_now("osd")                    # cur=2, keys {1,2,3}
+    t2 = ks.grant("osd", "c")
+    assert t2.gen == 2
+    assert validate_ticket(t2.blob(), "osd",
+                           pre_rotation_keys) is not None
+    keys = ks.rotating_keys("osd")
+    assert len(keys) == LIVE_GENERATIONS
+    # the gen-1 ticket still validates for one period (prev is live)
+    assert validate_ticket(t1.blob(), "osd", keys) is not None
+    ks.rotate_now("osd")                    # cur=3, keys {2,3,4}
+    # now gen 1 rotated out: the old ticket is dead
+    assert validate_ticket(t1.blob(), "osd",
+                           ks.rotating_keys("osd")) is None
+
+
+def test_state_survives_restart():
+    ks = KeyServer()
+    t = ks.grant("mds", "c")
+    ks2 = KeyServer(state=dict(ks.state))   # "restarted" mon
+    assert validate_ticket(t.blob(), "mds",
+                           ks2.rotating_keys("mds")) is not None
+
+
+def test_keyring_refreshes_before_expiry():
+    ks = KeyServer()
+    calls = []
+
+    def fetch(service):
+        calls.append(service)
+        return ks.grant(service, "c", ttl=100.0)
+
+    kr = TicketKeyring(fetch)
+    t0 = kr.get("osd", now=0.0)
+    assert t0 is not None and calls == ["osd"]
+    # well within ttl: cached
+    assert kr.get("osd", now=10.0) is t0
+    assert calls == ["osd"]
+    # less than 25% of TICKET_TTL left: refreshed
+    kr.get("osd", now=t0.expiry - 1.0)
+    assert calls == ["osd", "osd"]
+
+
+def test_keyring_survives_fetch_failure():
+    ks = KeyServer()
+    good = ks.grant("osd", "c", ttl=100.0)
+    state = {"fail": False}
+
+    def fetch(service):
+        if state["fail"]:
+            return None
+        return good
+
+    kr = TicketKeyring(fetch)
+    assert kr.get("osd", now=0.0) is good
+    state["fail"] = True
+    # refresh fails but the old ticket is still valid: keep using it
+    assert kr.get("osd", now=good.expiry - 1.0) is good
+    # once truly expired and unfetchable: None
+    assert kr.get("osd", now=good.expiry + 1.0) is None

@@ -48,8 +48,8 @@ def test_fs_new_and_rank_assignment(fs_cluster):
     # one daemon got rank 0, the other parked as standby
     active = mds if ent["gid"] == mds.gid else standby
     other = standby if active is mds else mds
-    deadline = time.time() + 10
-    while active.rank is None and time.time() < deadline:
+    deadline = time.time() + 20
+    while active.state != "active" and time.time() < deadline:
         time.sleep(0.05)
     assert active.rank == 0 and active.state == "active"
     assert other.rank is None and other.state == "standby"
