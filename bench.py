@@ -38,10 +38,18 @@ import time
 import numpy as np
 
 
-def chained_rates(step_fn, carry, n_lo: int = 4, n_hi: int = 16,
-                  reps: int = 7) -> list[float]:
-    """Per-step seconds samples, each a d(time)/d(iterations) difference of
-    one n_lo and one n_hi chained run (dispatch/transfer overhead cancels)."""
+def chained_rates(step_fn, carry, n_lo: int = 8, n_hi: int = 48,
+                  reps: int = 5, inner: int = 5) -> list[float]:
+    """Per-step seconds samples, robust against tunnel stalls.
+
+    The tunnel's noise is ADDITIVE-POSITIVE (ack stalls, transfer
+    hiccups), so each sample differences the MIN over `inner` timed
+    runs of each iteration count — min-filtering converges on the true
+    time where a single-pair difference can be dominated by one stall
+    (round 3's band spanned 6x; a stall pair can even produce a
+    near-zero difference, i.e. an absurd rate).  lo/hi runs alternate
+    so a stall burst hits both counts, not just one side, and the wide
+    n_hi - n_lo spread divides whatever residue remains."""
     import jax
 
     @functools.partial(jax.jit, static_argnames="n")
@@ -50,32 +58,43 @@ def chained_rates(step_fn, carry, n_lo: int = 4, n_hi: int = 16,
         leaf = jax.tree_util.tree_leaves(c)[0]
         return leaf.ravel()[0]
 
+    def timed(n):
+        t0 = time.perf_counter()
+        jax.device_get(loop(carry, n))
+        return time.perf_counter() - t0
+
     jax.device_get(loop(carry, n_lo))  # compile
     jax.device_get(loop(carry, n_hi))
+    for _ in range(2):                 # clock/thermal warm-up
+        timed(n_hi)
     out = []
     for _ in range(reps):
-        t0 = time.perf_counter()
-        jax.device_get(loop(carry, n_lo))
-        t_lo = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        jax.device_get(loop(carry, n_hi))
-        t_hi = time.perf_counter() - t0
-        d = (t_hi - t_lo) / (n_hi - n_lo)
+        ts_lo, ts_hi = [], []
+        for _ in range(inner):
+            ts_lo.append(timed(n_lo))
+            ts_hi.append(timed(n_hi))
+        d = (min(ts_hi) - min(ts_lo)) / (n_hi - n_lo)
         # a non-positive difference is clock noise; fall back to the full
         # n_hi run amortized per step — that INCLUDES dispatch overhead, so
         # it can only understate the rate, never inflate the headline
-        out.append(d if d > 2e-9 else t_hi / n_hi)
+        out.append(d if d > 2e-9 else min(ts_hi) / n_hi)
     return out
 
 
 def median_band(samples: list[float]):
-    """(median, lo, hi) of the samples."""
+    """(median, lo, hi): the band is TRIMMED when there are >= 5
+    samples (drop the single best and worst) — with a heavy-tailed
+    tunnel, min/max report one outlier stall or one fluke near-zero
+    difference, not the kernel.  The trim is symmetric, so it cannot
+    bias the band in the flattering direction only."""
     s = sorted(samples)
+    if len(s) >= 5:
+        return s[len(s) // 2], s[1], s[-2]
     return s[len(s) // 2], s[0], s[-1]
 
 
-def chained_seconds_per_step(step_fn, carry, n_lo: int = 4, n_hi: int = 16,
-                             reps: int = 7) -> float:
+def chained_seconds_per_step(step_fn, carry, n_lo: int = 8, n_hi: int = 48,
+                             reps: int = 5) -> float:
     return median_band(chained_rates(step_fn, carry, n_lo, n_hi, reps))[0]
 
 
@@ -157,7 +176,8 @@ def main() -> None:
         return x ^ p[:, 0].astype(jnp.uint32)
 
     t_crush, t_crush_min, t_crush_max = median_band(
-        chained_rates(crush_step, xs, n_lo=2, n_hi=8, reps=5))
+        chained_rates(crush_step, xs, n_lo=4, n_hi=24, reps=5,
+                      inner=4))
     crush_mpps = n_pgs / t_crush / 1e6
 
     # single-core C baselines (ceph_tpu/native): ISA-L-class SIMD encode and

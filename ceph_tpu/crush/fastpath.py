@@ -257,9 +257,19 @@ class FastMapper:
             self.leaf_magic = jnp.asarray(lm)
             self.leaf_off = jnp.asarray(lo)
         # the fused Pallas column kernels (2.5x the XLA path on this
-        # backend); TPU-only — the CPU mesh tests keep the XLA path
+        # backend); TPU-only — the CPU mesh tests keep the XLA path.
+        # The gate honors jax.default_device(<tpu>) too: a multi-
+        # platform process (cpu default + tpu reachable) running under
+        # that context IS on the tpu even though default_backend()
+        # still says cpu
         self._pallas = None
-        if jax.default_backend() == "tpu":
+        _dd = getattr(jax.config, "jax_default_device", None)
+        if _dd is not None:
+            # jax.default_device accepts a Device OR a platform string
+            on_tpu = getattr(_dd, "platform", str(_dd)) == "tpu"
+        else:
+            on_tpu = jax.default_backend() == "tpu"
+        if on_tpu:
             try:
                 from ceph_tpu.ops.pallas_straw2 import PallasColumns
             except ImportError:   # pragma: no cover

@@ -395,19 +395,30 @@ class MDSDaemon(Dispatcher):
                   data_pool: int = -1) -> None:
         """Standby promoted to a rank: replay that rank's journal and
         open a reconnect window for the old clients' cap reasserts.
-        The pool ids ride the beacon ack, so activation needs no wait
-        on our own (possibly lagging) map subscription."""
+        The pool IDS ride the beacon ack (no fsmap wait), but the
+        objecter still needs a map CONTAINING those pools to route the
+        journal I/O — wait for it briefly; on timeout leave rank unset
+        so the next beacon ack retries instead of wedging half-active."""
+        mp = self.metadata_pool if self.metadata_pool is not None \
+            else meta_pool
+        dp = self.data_pool if self.data_pool is not None else data_pool
+        if mp < 0 or dp < 0:
+            return              # stale ack with no pools: next beacon
+        deadline = time.time() + 8.0
+        while time.time() < deadline:
+            pools = self.objecter.osdmap.pools
+            if mp in pools and dp in pools:
+                break
+            time.sleep(0.05)
+        else:
+            dout("mds", 1, "mds gid %d: fs pools not in objecter map "
+                 "yet; retrying on next beacon", self.gid)
+            return
         with self._lock:
             if self.rank is not None:
                 return
-            if self.metadata_pool is None:
-                if meta_pool < 0:
-                    return      # stale ack with no pools: next beacon
-                self.metadata_pool = meta_pool
-            if self.data_pool is None:
-                if data_pool < 0:
-                    return
-                self.data_pool = data_pool
+            self.metadata_pool = mp
+            self.data_pool = dp
             self.rank = rank
             self.meta_io = self.objecter.open_ioctx(self.metadata_pool)
             self.journal = Journaler(self.meta_io, f"mdlog.{rank}")

@@ -75,60 +75,51 @@ class MgrDaemon(Dispatcher):
         self._cephx = cephx
         self._rotating: dict[int, str] = {}
         self._rotating_at = 0.0
-        self._moncmd_tid = 0
-        self._moncmd_waiters: dict[int, object] = {}
+        from ceph_tpu.common.moncmd import MonCommander
+        self.mon_cmd = MonCommander(
+            self.msgr, [x for x in mon_addr.split(",") if x])
         if cephx is not None:
             from ceph_tpu.auth.cephx import TicketKeyring
             from ceph_tpu.auth.handshake import CephxConfig
             self.msgr.set_auth_cephx(CephxConfig(
                 entity=cephx[0], key=cephx[1],
-                keyring=TicketKeyring(lambda svc: None),
+                keyring=TicketKeyring(self.mon_cmd.fetch_ticket),
                 service="mgr", rotating=lambda: self._rotating))
         self.msgr.set_policy("osd", ConnectionPolicy.stateful_server())
         self.msgr.set_policy("mon", ConnectionPolicy.stateful_peer())
         self.msgr.add_dispatcher_tail(self)
         self._addr = addr
 
-    def _mon_cmd(self, cmd: dict, timeout: float = 8.0):
-        import queue as _q
-        with self._lock:
-            self._moncmd_tid += 1
-            tid = self._moncmd_tid
-            q: _q.Queue = _q.Queue()
-            self._moncmd_waiters[tid] = q
-        from ceph_tpu.messages import MMonCommand
-        try:
-            for rank, a in enumerate(
-                    [x for x in self.mon_addr.split(",") if x]):
-                con = self.msgr.connect_to(a, EntityName("mon", rank))
-                con.send_message(MMonCommand(tid=tid, cmd=dict(cmd)))
-            try:
-                return q.get(timeout=timeout)
-            except _q.Empty:
-                return -110, "timeout"
-        finally:
-            with self._lock:
-                self._moncmd_waiters.pop(tid, None)
-
     def _refresh_rotating(self) -> None:
-        import json as _json
-        rc, out = self._mon_cmd({"prefix": "auth rotating",
-                                 "service": "mgr"})
-        if rc == 0:
-            self._rotating = {int(g): k
-                              for g, k in _json.loads(out).items()}
+        keys = self.mon_cmd.fetch_rotating("mgr")
+        if keys is not None:
+            self._rotating = keys
             self._rotating_at = time.time()
 
-    def _rotating_tick(self) -> None:
-        """Timer thread — NEVER the dispatch thread: the refresh blocks
-        on a mon ack that only the dispatch thread can deliver."""
+    def _subscribe(self) -> None:
+        from ceph_tpu.mon.monitor import MMonSubscribe
+        for rank, a in enumerate(
+                [x for x in self.mon_addr.split(",") if x]):
+            con = self.msgr.connect_to(a, EntityName("mon", rank))
+            con.send_message(MMonSubscribe(name=str(self.name),
+                                           addr=self.msgr.my_addr,
+                                           epoch=self.osdmap.epoch))
+
+    def _renew_tick(self) -> None:
+        """Timer thread — NEVER the dispatch thread: the rotating
+        refresh blocks on a mon ack only the dispatch thread delivers.
+        Also renews the map subscription: pushes ride the mon-side
+        session, so a dropped session must be re-established."""
         if getattr(self, "_stopped", False):
             return
         try:
-            self._refresh_rotating()
+            self._subscribe()
+            if self._cephx is not None \
+                    and time.time() - self._rotating_at > 55.0:
+                self._refresh_rotating()
         except (OSError, TimeoutError):
             pass
-        self._rot_timer = threading.Timer(60.0, self._rotating_tick)
+        self._rot_timer = threading.Timer(5.0, self._renew_tick)
         self._rot_timer.daemon = True
         self._rot_timer.start()
 
@@ -137,13 +128,8 @@ class MgrDaemon(Dispatcher):
         self.msgr.start()
         self._rot_timer = None
         if self._cephx is not None:
-            self._rotating_tick()
-        from ceph_tpu.mon.monitor import MMonSubscribe
-        for rank, a in enumerate(
-                [x for x in self.mon_addr.split(",") if x]):
-            con = self.msgr.connect_to(a, EntityName("mon", rank))
-            con.send_message(MMonSubscribe(name=str(self.name),
-                                           addr=self.msgr.my_addr))
+            self._refresh_rotating()
+        self._renew_tick()
 
     def shutdown(self) -> None:
         self._stopped = True
@@ -161,10 +147,7 @@ class MgrDaemon(Dispatcher):
     def ms_dispatch(self, msg) -> bool:
         from ceph_tpu.messages import MMonCommandAck
         if isinstance(msg, MMonCommandAck):
-            with self._lock:
-                q = self._moncmd_waiters.get(msg.tid)
-            if q is not None:
-                q.put((msg.result, msg.output))
+            self.mon_cmd.handle_ack(msg)
             return True
         if isinstance(msg, MMgrReport):
             with self._lock:

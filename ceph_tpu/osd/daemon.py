@@ -231,10 +231,9 @@ class OSDDaemon(Dispatcher):
         self._cephx = cephx
         self.msgr = Messenger.create(self.whoami, ms_type)
         self.msgr.set_auth(auth_key)
-        #: mon-command waiters for the daemon's own admin RPCs
-        #: (rotating-key refresh, ticket grants)
-        self._moncmd_tid = 0
-        self._moncmd_waiters: dict[int, tuple] = {}
+        from ceph_tpu.common.moncmd import MonCommander
+        #: the daemon's own admin RPC path (rotating keys, tickets)
+        self.mon_cmd = MonCommander(self.msgr, self.mon_addrs)
         if cephx is not None:
             from ceph_tpu.auth.cephx import TicketKeyring
             from ceph_tpu.auth.handshake import CephxConfig
@@ -243,7 +242,7 @@ class OSDDaemon(Dispatcher):
             self._rotating_at = 0.0
             self.msgr.set_auth_cephx(CephxConfig(
                 entity=cephx[0], key=cephx[1],
-                keyring=TicketKeyring(self._fetch_ticket),
+                keyring=TicketKeyring(self.mon_cmd.fetch_ticket),
                 service="osd", rotating=lambda: self._rotating))
         self.msgr.set_policy("client", ConnectionPolicy.lossy_client())
         self.msgr.set_policy("osd", ConnectionPolicy.stateful_peer())
@@ -457,43 +456,11 @@ class OSDDaemon(Dispatcher):
 
     ROTATING_REFRESH = 60.0
 
-    def _mon_cmd(self, cmd: dict, timeout: float = 8.0
-                 ) -> tuple[int, str]:
-        """Small daemon-side mon command RPC (rotating keys, tickets)."""
-        import json as _json
-        import queue as _queue
-        with self._lock:
-            self._moncmd_tid += 1
-            tid = self._moncmd_tid
-            q: _queue.Queue = _queue.Queue()
-            self._moncmd_waiters[tid] = q
-        from ceph_tpu.messages import MMonCommand
-        try:
-            for rank, addr in enumerate(self.mon_addrs):
-                con = self.msgr.connect_to(addr, EntityName("mon", rank))
-                con.send_message(MMonCommand(tid=tid, cmd=dict(cmd)))
-            try:
-                return q.get(timeout=timeout)
-            except _queue.Empty:
-                return -110, "mon command timed out"
-        finally:
-            with self._lock:
-                self._moncmd_waiters.pop(tid, None)
-
     def _refresh_rotating(self) -> None:
-        import json as _json
-        rc, out = self._mon_cmd({"prefix": "auth rotating",
-                                 "service": "osd"})
-        if rc == 0:
-            self._rotating = {int(g): k
-                              for g, k in _json.loads(out).items()}
+        keys = self.mon_cmd.fetch_rotating("osd")
+        if keys is not None:
+            self._rotating = keys
             self._rotating_at = time.time()
-
-    def _fetch_ticket(self, service: str):
-        from ceph_tpu.auth.cephx import ticket_from_json
-        rc, out = self._mon_cmd({"prefix": "auth get-ticket",
-                                 "service": service})
-        return ticket_from_json(out) if rc == 0 else None
 
     def _tick(self) -> None:
         try:
@@ -1682,10 +1649,7 @@ class OSDDaemon(Dispatcher):
             return True
         from ceph_tpu.messages import MMonCommandAck
         if isinstance(msg, MMonCommandAck):
-            with self._lock:
-                q = self._moncmd_waiters.get(msg.tid)
-            if q is not None:
-                q.put((msg.result, msg.output))
+            self.mon_cmd.handle_ack(msg)
             return True
         # queued classes (enqueue_op → op_shardedwq → dequeue_op): work
         # items shard by pgid and ride the mClock scheduler; replies and

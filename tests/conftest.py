@@ -17,7 +17,16 @@ import jax  # noqa: E402
 
 # config.update, not the env var: the environment exports JAX_PLATFORMS=axon (the
 # real TPU tunnel) and the plugin outranks an env override, but tests need the
-# virtual 8-device CPU mesh
-jax.config.update("jax_platforms", os.environ.get("CEPH_TPU_TEST_PLATFORM", "cpu"))
+# virtual 8-device CPU mesh.  When a TPU platform IS advertised by the
+# environment, expose it ALONGSIDE cpu ("cpu,axon": cpu stays the default
+# backend) so the compiled-TPU cross-validation gate runs by default on TPU
+# hosts instead of being silently skipped — that suite is the only thing that
+# catches Mosaic compiled-path miscompiles (round 3's is_out bug).
+_plat = os.environ.get("CEPH_TPU_TEST_PLATFORM")
+if _plat is None:
+    _env = os.environ.get("JAX_PLATFORMS", "")
+    _tpu = next((p for p in ("axon", "tpu") if p in _env.split(",")), None)
+    _plat = f"cpu,{_tpu}" if _tpu else "cpu"
+jax.config.update("jax_platforms", _plat)
 
 import ceph_tpu  # noqa: E402,F401  (enables x64 before tests create arrays)
