@@ -26,7 +26,7 @@ from ceph_tpu.msg.encoding import Decoder, Encoder
 from ceph_tpu.msg.messenger import (
     ConnectionPolicy, Dispatcher, EntityName, Messenger)
 from ceph_tpu.messages import MOSDOpReply
-from ceph_tpu.osd.map_codec import decode_osdmap
+from ceph_tpu.osd.map_codec import advance_map
 from ceph_tpu.osd.osdmap import CEPH_NOSD, OSDMap, pg_to_pgid
 
 _M32 = 0xFFFFFFFF
@@ -244,11 +244,18 @@ class RadosClient(Dispatcher):
     def ms_dispatch(self, msg) -> bool:
         if isinstance(msg, MOSDMapMsg):
             with self._lock:
-                newmap = decode_osdmap(msg.map_blob)
-                if newmap.epoch <= self.osdmap.epoch:
-                    return True
-                self.osdmap = newmap
-                pending = list(self._waiters.values())
+                newmap, gapped = advance_map(self.osdmap, msg)
+                if newmap is None:
+                    if not gapped:
+                        return True
+                else:
+                    self.osdmap = newmap
+                    pending = list(self._waiters.values())
+            if gapped:
+                # deltas don't connect to our epoch: ask the mon to
+                # backfill (it sends the chain or a full map)
+                self._subscribe()
+                return True
             self._map_event.set()
             for w in pending:   # resend on map change (Objecter semantics)
                 self._send_op(w)

@@ -380,22 +380,38 @@ class MOSDFailure(Message):
 
 @register_message
 class MOSDMapMsg(Message):
-    TYPE = 41  # MSG_OSD_MAP
+    """Map distribution (messages/MOSDMap.h): carries EITHER a full map
+    blob OR a contiguous run of incremental blobs [(epoch, inc)] — the
+    reference's maps/incremental_maps pair, reduced to one-or-the-other
+    (full maps only on backfill/gap, deltas for normal churn)."""
 
-    def __init__(self, epoch: int = 0, map_blob: bytes = b""):
+    TYPE = 41  # MSG_OSD_MAP
+    HEAD_VERSION = 2       # v2: incremental blobs ride along
+
+    def __init__(self, epoch: int = 0, map_blob: bytes = b"",
+                 incs: list | None = None):
         super().__init__()
         self.epoch = epoch
         self.map_blob = map_blob  # OSDMap encoded via osd.map_codec
+        #: [(epoch, inc_blob)] ascending, contiguous; applies to a map
+        #: at incs[0][0] - 1
+        self.incs = incs or []
 
     def encode_payload(self, enc):
-        enc.versioned(1, 1, lambda e: (e.u32(self.epoch),
-                                       e.bytes(self.map_blob)))
+        def body(e):
+            e.u32(self.epoch)
+            e.bytes(self.map_blob)
+            e.list(self.incs, lambda e2, p: (e2.u32(p[0]),
+                                             e2.bytes(p[1])))
+        enc.versioned(2, 1, body)
 
     def decode_payload(self, dec, version):
         def body(d, v):
             self.epoch = d.u32()
             self.map_blob = d.bytes()
-        dec.versioned(1, body)
+            self.incs = (d.list(lambda d2: (d2.u32(), d2.bytes()))
+                         if v >= 2 else [])
+        dec.versioned(2, body)
 
 
 @register_message

@@ -16,7 +16,7 @@ from ceph_tpu.msg.encoding import Decoder, Encoder
 from ceph_tpu.msg.message import Message, register_message
 from ceph_tpu.msg.messenger import (
     ConnectionPolicy, Dispatcher, EntityName, Messenger)
-from ceph_tpu.osd.map_codec import decode_osdmap
+from ceph_tpu.osd.map_codec import advance_map
 from ceph_tpu.osd.osdmap import OSDMap
 
 
@@ -154,7 +154,11 @@ class MgrDaemon(Dispatcher):
                 self.reports[msg.osd_id] = (time.time(), msg)
             return True
         if isinstance(msg, MOSDMapMsg):
-            self.osdmap = decode_osdmap(msg.map_blob)
+            newmap, gapped = advance_map(self.osdmap, msg)
+            if newmap is not None:
+                self.osdmap = newmap
+            elif gapped:
+                self._subscribe()
             return True
         return False
 

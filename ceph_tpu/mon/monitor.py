@@ -220,6 +220,9 @@ class Monitor(Dispatcher):
         #: subscriber -> (addr, entity, session connection): pushes
         #: ride the session the subscriber authenticated
         self._subs: dict[str, tuple] = {}
+        #: epoch -> encoded OSDMap::Incremental (each mon rebuilds this
+        #: deterministically at commit; trimmed to INC_HISTORY)
+        self._inc_history: dict[int, bytes] = {}
         #: latest MPGStats per reporting OSD (PG_DEGRADED health feed)
         self._pg_stats: dict[int, dict] = {}
         #: mds gid -> (last beacon time, addr, load) — mon-local
@@ -353,19 +356,39 @@ class Monitor(Dispatcher):
         if self.paxos.last_committed == 0:
             self._work_q.put(("bootstrap", None, None))
 
+    #: incremental history depth (the mon's map trimming: subscribers
+    #: gapped further back than this get a full map)
+    INC_HISTORY = 500
+
     def _on_paxos_commit(self, version: int, blob: bytes) -> None:
-        """Every quorum member applies committed maps identically."""
+        """Every quorum member applies committed maps identically, and
+        each builds the SAME incremental locally (deterministic diff of
+        consecutive committed maps) — no extra paxos state needed."""
+        from ceph_tpu.osd.map_codec import diff_osdmap, encode_incremental
         newmap = decode_osdmap(blob)
         with self._lock:
             if newmap.epoch <= self.osdmap.epoch:
                 return
+            old = self.osdmap
             self.osdmap = newmap
+            inc_blob = None
+            if newmap.epoch == old.epoch + 1 and old.epoch > 0:
+                inc_blob = encode_incremental(diff_osdmap(old, newmap))
+                self._inc_history[newmap.epoch] = inc_blob
+                for e in list(self._inc_history):
+                    if e <= newmap.epoch - self.INC_HISTORY:
+                        del self._inc_history[e]
             subs = list(self._subs.values())
-        # never fan the paxos value out: it carries the auth key table
-        pub = encode_osdmap(newmap)
+        if inc_blob is not None:
+            # normal churn: O(delta) bytes per subscriber per epoch
+            msg = MOSDMapMsg(epoch=newmap.epoch,
+                             incs=[(newmap.epoch, inc_blob)])
+        else:
+            # never fan the paxos value out: it carries the auth keys
+            msg = MOSDMapMsg(epoch=newmap.epoch,
+                             map_blob=encode_osdmap(newmap))
         for _addr, _entity, con in subs:
-            con.send_message(MOSDMapMsg(epoch=newmap.epoch,
-                                        map_blob=pub))
+            con.send_message(msg)
 
     def _schedule_tick(self) -> None:
         if self._stop:
@@ -632,12 +655,24 @@ class Monitor(Dispatcher):
                 self._subs[msg.name] = (msg.addr, entity,
                                         msg.connection)
                 epoch = self.osdmap.epoch
-                # renewal from a current subscriber: nothing to send
-                blob = (encode_osdmap(self.osdmap)
-                        if epoch > msg.epoch else None)
-            if epoch > 0 and blob is not None:
-                msg.connection.send_message(
-                    MOSDMapMsg(epoch=epoch, map_blob=blob))
+                reply = None
+                if epoch > 0 and epoch > msg.epoch:
+                    # catch the subscriber up with deltas when its gap
+                    # is covered by history; full map otherwise
+                    wanted = range(msg.epoch + 1, epoch + 1)
+                    if msg.epoch > 0 and all(
+                            e in self._inc_history for e in wanted):
+                        reply = MOSDMapMsg(
+                            epoch=epoch,
+                            incs=[(e, self._inc_history[e])
+                                  for e in wanted])
+                    else:
+                        reply = MOSDMapMsg(
+                            epoch=epoch,
+                            map_blob=encode_osdmap(self.osdmap))
+                # (renewal from a current subscriber: nothing to send)
+            if reply is not None:
+                msg.connection.send_message(reply)
             return True
         if isinstance(msg, MPGStats):
             with self._lock:

@@ -60,7 +60,7 @@ from ceph_tpu.msg.message import Message, register_message
 from ceph_tpu.msg.messenger import (
     ConnectionPolicy, Dispatcher, EntityName, Messenger)
 from ceph_tpu.objectstore import Transaction, create_objectstore
-from ceph_tpu.osd.map_codec import decode_osdmap, encode_osdmap
+from ceph_tpu.osd.map_codec import advance_map, encode_osdmap
 from ceph_tpu.osd.osdmap import CEPH_NOSD, OSDMap, pg_to_pgid
 from ceph_tpu.client.rados import ceph_str_hash_rjenkins
 from ceph_tpu.osd.pg import (
@@ -635,13 +635,19 @@ class OSDDaemon(Dispatcher):
     # -- map handling ---------------------------------------------------------
 
     def _handle_map(self, msg: MOSDMapMsg) -> None:
-        newmap = decode_osdmap(msg.map_blob)
         with self._lock:
-            if newmap.epoch <= self.osdmap.epoch:
+            newmap, gapped = advance_map(self.osdmap, msg)
+            if newmap is None and not gapped:
                 return
-            oldmap = self.osdmap
-            self.osdmap = newmap
-            self._codecs.clear()
+            if newmap is not None:
+                oldmap = self.osdmap
+                self.osdmap = newmap
+                self._codecs.clear()
+        if gapped:
+            # we were down across trimmed epochs: request a backfill
+            # (OSD::handle_osd_map request_full analog)
+            self._renew_map_subscription(time.time(), force=True)
+            return
         del oldmap
         dout("osd", 5, "osd.%d got map epoch %d", self.osd_id, newmap.epoch)
         self._apply_config_db(newmap)

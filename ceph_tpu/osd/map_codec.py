@@ -145,6 +145,52 @@ def decode_crush(dec: Decoder) -> CrushMap:
 
 # -- osdmap -----------------------------------------------------------------
 
+# ONE pool/pgid codec serves the full map AND the incremental: a field
+# added to one but not the other would make delta-built maps silently
+# diverge from backfilled ones.
+
+def _enc_pool(e2: Encoder, p: PGPool) -> None:
+    e2.s64(p.pool_id).u8(p.type).u32(p.size).u32(p.min_size)
+    e2.u32(p.crush_rule).u32(p.pg_num).u32(p.pgp_num)
+    e2.map(p.ec_profile, lambda e3, k: e3.str(k),
+           lambda e3, v: e3.str(str(v)))
+    e2.u64(p.snap_seq)
+    e2.map(p.snaps, lambda e3, k: e3.u64(k), lambda e3, v: e3.str(v))
+    # v5: cache-tier fields (pg_pool_t tier_of/read_tier/...)
+    e2.s64(p.tier_of).s64(p.read_tier).s64(p.write_tier)
+    e2.str(p.cache_mode)
+    e2.u64(p.target_max_objects)
+    e2.f64(p.cache_min_flush_age)
+
+
+def _dec_pool(d2: Decoder, version: int = 999) -> PGPool:
+    p = PGPool(pool_id=d2.s64(), type=d2.u8(), size=d2.u32(),
+               min_size=d2.u32(), crush_rule=d2.u32(),
+               pg_num=d2.u32(), pgp_num=d2.u32(),
+               ec_profile=d2.map(lambda d3: d3.str(),
+                                 lambda d3: d3.str()))
+    if version >= 2:
+        p.snap_seq = d2.u64()
+        p.snaps = d2.map(lambda d3: d3.u64(), lambda d3: d3.str())
+    if version >= 5:
+        p.tier_of = d2.s64()
+        p.read_tier = d2.s64()
+        p.write_tier = d2.s64()
+        p.cache_mode = d2.str()
+        p.target_max_objects = d2.u64()
+        p.cache_min_flush_age = d2.f64()
+    return p
+
+
+def _enc_pgid(e2: Encoder, k) -> None:
+    e2.s64(k[0])
+    e2.u32(k[1])
+
+
+def _dec_pgid(d2: Decoder):
+    return (d2.s64(), d2.u32())
+
+
 def encode_osdmap(m: OSDMap, *, with_auth: bool = False) -> bytes:
     """with_auth gates the AuthMonitor key table: ONLY the mon-internal
     paxos value / mon store carries it (reference: auth key material
@@ -162,34 +208,16 @@ def encode_osdmap(m: OSDMap, *, with_auth: bool = False) -> bytes:
         e.list(m.osd_primary_affinity, lambda e2, v: e2.u32(v))
         e.list(m.osd_addrs, lambda e2, v: e2.str(v))
 
-        def enc_pool(e2: Encoder, p: PGPool):
-            e2.s64(p.pool_id).u8(p.type).u32(p.size).u32(p.min_size)
-            e2.u32(p.crush_rule).u32(p.pg_num).u32(p.pgp_num)
-            e2.map(p.ec_profile, lambda e3, k: e3.str(k),
-                   lambda e3, v: e3.str(str(v)))
-            e2.u64(p.snap_seq)
-            e2.map(p.snaps, lambda e3, k: e3.u64(k),
-                   lambda e3, v: e3.str(v))
-            # v5: cache-tier fields (pg_pool_t tier_of/read_tier/...)
-            e2.s64(p.tier_of).s64(p.read_tier).s64(p.write_tier)
-            e2.str(p.cache_mode)
-            e2.u64(p.target_max_objects)
-            e2.f64(p.cache_min_flush_age)
+        e.map(m.pools, lambda e2, k: e2.s64(k), _enc_pool)
 
-        e.map(m.pools, lambda e2, k: e2.s64(k), enc_pool)
-
-        def enc_pgid_key(e2: Encoder, k: tuple[int, int]):
-            e2.s64(k[0])
-            e2.u32(k[1])
-
-        e.map(m.pg_upmap, enc_pgid_key,
+        e.map(m.pg_upmap, _enc_pgid,
               lambda e2, v: e2.list(v, lambda e3, o: e3.s32(o)))
-        e.map(m.pg_upmap_items, enc_pgid_key,
+        e.map(m.pg_upmap_items, _enc_pgid,
               lambda e2, v: e2.list(v, lambda e3, p: (e3.s32(p[0]),
                                                       e3.s32(p[1]))))
-        e.map(m.pg_temp, enc_pgid_key,
+        e.map(m.pg_temp, _enc_pgid,
               lambda e2, v: e2.list(v, lambda e3, o: e3.s32(o)))
-        e.map(m.primary_temp, enc_pgid_key, lambda e2, v: e2.s32(v))
+        e.map(m.primary_temp, _enc_pgid, lambda e2, v: e2.s32(v))
         # v3: CRUSH name tables ride the map (the reference's binary
         # crush carries type/name/rule maps; CrushWrapper name_map)
         import json as _json
@@ -212,6 +240,223 @@ def encode_osdmap(m: OSDMap, *, with_auth: bool = False) -> bytes:
     return enc.tobytes()
 
 
+# -- incremental osdmap (OSDMap::Incremental, src/osd/OSDMap.h:353) ---------
+#
+# The mon publishes DELTAS for normal churn: an incremental carries only
+# what changed between epoch-1 and epoch, daemons apply them in sequence,
+# and full maps ship only to gapped/backfilling subscribers.  A 10k-OSD
+# map is ~hundreds of KB; marking one osd down is tens of bytes.
+#
+# Layout choice vs the reference: pg_temp/primary_temp/upmap changes
+# carry the full new value per KEY (remove = empty), pools ship whole
+# per changed pool id, and a changed CRUSH ships whole (as in the
+# reference — crush deltas aren't worth the complexity).  The small
+# JSON side-tables (config/fs/crush-names) ship whole when changed.
+
+_SENTINEL = object()
+
+
+def diff_osdmap(old: OSDMap, new: OSDMap) -> dict:
+    """Compute the incremental old -> new (epochs must be adjacent or
+    at least ordered; the inc is tagged with new.epoch)."""
+    import json as _json
+    inc: dict = {"epoch": new.epoch}
+    if new.max_osd != old.max_osd:
+        inc["max_osd"] = new.max_osd
+    for field_, name in (("osd_state", "state"),
+                        ("osd_weight", "weight"),
+                        ("osd_primary_affinity", "affinity"),
+                        ("osd_addrs", "addrs")):
+        ov, nv = getattr(old, field_), getattr(new, field_)
+        changes = {i: nv[i] for i in range(len(nv))
+                   if i >= len(ov) or ov[i] != nv[i]}
+        if changes:
+            inc[name] = changes
+    pools = {}
+    for pid, p in new.pools.items():
+        if pid not in old.pools or old.pools[pid] != p:
+            pools[pid] = p
+    gone = [pid for pid in old.pools if pid not in new.pools]
+    if pools:
+        inc["pools"] = pools
+    if gone:
+        inc["old_pools"] = gone
+    for attr in ("pg_temp", "primary_temp", "pg_upmap",
+                 "pg_upmap_items"):
+        ov, nv = getattr(old, attr), getattr(new, attr)
+        changes = {k: v for k, v in nv.items()
+                   if ov.get(k, _SENTINEL) != v}
+        removes = [k for k in ov if k not in nv]
+        if changes or removes:
+            inc[attr] = (changes, removes)
+    if old.osd_xinfo != new.osd_xinfo:
+        xch = {i: new.osd_xinfo[i] for i in range(len(new.osd_xinfo))
+               if i >= len(old.osd_xinfo)
+               or old.osd_xinfo[i] != new.osd_xinfo[i]}
+        if xch:
+            inc["xinfo"] = xch
+    # whole-structure deltas (cheap to compare, small to ship)
+    enc_old = Encoder()
+    encode_crush(old.crush, enc_old)
+    enc_new = Encoder()
+    encode_crush(new.crush, enc_new)
+    if enc_old.tobytes() != enc_new.tobytes():
+        inc["crush"] = enc_new.tobytes()
+    for attr in ("config_db", "fs_db", "crush_names"):
+        if getattr(old, attr) != getattr(new, attr):
+            inc[attr] = _json.dumps(getattr(new, attr))
+    return inc
+
+
+def apply_incremental(m: OSDMap, inc: dict) -> None:
+    """Apply one decoded incremental IN PLACE (OSD::handle_osd_map's
+    apply_incremental).  inc['epoch'] must be m.epoch + 1."""
+    import json as _json
+    if inc["epoch"] != m.epoch + 1:
+        raise ValueError(
+            f"incremental {inc['epoch']} onto map {m.epoch}")
+    if "max_osd" in inc:
+        m.set_max_osd(inc["max_osd"])
+    for name, attr in (("state", "osd_state"), ("weight", "osd_weight"),
+                       ("affinity", "osd_primary_affinity"),
+                       ("addrs", "osd_addrs")):
+        vec = getattr(m, attr)
+        for i, v in inc.get(name, {}).items():
+            while len(vec) <= i:
+                vec.append(0 if attr != "osd_addrs" else "")
+            vec[i] = v
+    for pid, p in inc.get("pools", {}).items():
+        m.pools[pid] = p
+    for pid in inc.get("old_pools", []):
+        m.pools.pop(pid, None)
+    for attr in ("pg_temp", "primary_temp", "pg_upmap",
+                 "pg_upmap_items"):
+        if attr in inc:
+            changes, removes = inc[attr]
+            d = getattr(m, attr)
+            d.update(changes)
+            for k in removes:
+                d.pop(k, None)
+    for i, x in inc.get("xinfo", {}).items():
+        while len(m.osd_xinfo) <= i:
+            m.osd_xinfo.append(OSDXInfo())
+        m.osd_xinfo[i] = x
+    if "crush" in inc:
+        m.crush = decode_crush(Decoder(inc["crush"]))
+    for attr in ("config_db", "fs_db", "crush_names"):
+        if attr in inc:
+            setattr(m, attr, _json.loads(inc[attr]))
+    m.epoch = inc["epoch"]
+
+
+def encode_incremental(inc: dict) -> bytes:
+    enc = Encoder()
+
+    def body(e: Encoder):
+        e.u32(inc["epoch"])
+        e.s32(inc.get("max_osd", -1))
+        for name in ("state", "weight", "affinity"):
+            e.map(inc.get(name, {}), lambda e2, k: e2.u32(k),
+                  lambda e2, v: e2.u64(v))
+        e.map(inc.get("addrs", {}), lambda e2, k: e2.u32(k),
+              lambda e2, v: e2.str(v))
+        e.map(inc.get("pools", {}), lambda e2, k: e2.s64(k), _enc_pool)
+        e.list(inc.get("old_pools", []), lambda e2, v: e2.s64(v))
+        for attr, enc_v in (
+                ("pg_temp", lambda e2, v: e2.list(
+                    v, lambda e3, o: e3.s32(o))),
+                ("primary_temp", lambda e2, v: e2.s32(v)),
+                ("pg_upmap", lambda e2, v: e2.list(
+                    v, lambda e3, o: e3.s32(o))),
+                ("pg_upmap_items", lambda e2, v: e2.list(
+                    v, lambda e3, p: (e3.s32(p[0]), e3.s32(p[1]))))):
+            changes, removes = inc.get(attr, ({}, []))
+            e.map(changes, _enc_pgid, enc_v)
+            e.list(removes, _enc_pgid)
+        e.map(inc.get("xinfo", {}), lambda e2, k: e2.u32(k),
+              lambda e2, x: (e2.f64(x.down_stamp),
+                             e2.f64(x.laggy_probability),
+                             e2.f64(x.laggy_interval)))
+        e.bytes(inc.get("crush", b""))
+        for attr in ("config_db", "fs_db", "crush_names"):
+            has = attr in inc
+            e.u8(1 if has else 0)
+            if has:
+                e.bytes(inc[attr].encode())
+
+    enc.versioned(1, 1, body)
+    return enc.tobytes()
+
+
+def decode_incremental(data: bytes) -> dict:
+    dec = Decoder(data)
+
+    def body(d: Decoder, version: int) -> dict:
+        inc: dict = {"epoch": d.u32()}
+        mo = d.s32()
+        if mo >= 0:
+            inc["max_osd"] = mo
+        for name in ("state", "weight", "affinity"):
+            ch = d.map(lambda d2: d2.u32(), lambda d2: d2.u64())
+            if ch:
+                inc[name] = ch
+        ch = d.map(lambda d2: d2.u32(), lambda d2: d2.str())
+        if ch:
+            inc["addrs"] = ch
+        pools = d.map(lambda d2: d2.s64(), _dec_pool)
+        if pools:
+            inc["pools"] = pools
+        old_pools = d.list(lambda d2: d2.s64())
+        if old_pools:
+            inc["old_pools"] = old_pools
+        for attr, dec_v in (
+                ("pg_temp", lambda d2: d2.list(lambda d3: d3.s32())),
+                ("primary_temp", lambda d2: d2.s32()),
+                ("pg_upmap", lambda d2: d2.list(lambda d3: d3.s32())),
+                ("pg_upmap_items", lambda d2: d2.list(
+                    lambda d3: (d3.s32(), d3.s32())))):
+            changes = d.map(_dec_pgid, dec_v)
+            removes = d.list(_dec_pgid)
+            if changes or removes:
+                inc[attr] = (changes, removes)
+        xinfo = d.map(lambda d2: d2.u32(),
+                      lambda d2: OSDXInfo(down_stamp=d2.f64(),
+                                          laggy_probability=d2.f64(),
+                                          laggy_interval=d2.f64()))
+        if xinfo:
+            inc["xinfo"] = xinfo
+        crush = d.bytes()
+        if crush:
+            inc["crush"] = crush
+        for attr in ("config_db", "fs_db", "crush_names"):
+            if d.u8():
+                inc[attr] = d.bytes().decode()
+        return inc
+
+    return dec.versioned(1, body)
+
+
+def advance_map(cur: OSDMap, msg) -> tuple[OSDMap | None, bool]:
+    """Apply an MOSDMapMsg (full or incremental) to the current map:
+    returns (new map | None, gapped).  gapped=True means the deltas
+    don't connect to our epoch — the caller re-subscribes with its
+    epoch and the mon backfills (OSD::handle_osd_map's request_full)."""
+    if msg.map_blob:
+        new = decode_osdmap(msg.map_blob)
+        return (new, False) if new.epoch > cur.epoch else (None, False)
+    if not msg.incs:
+        return None, False
+    incs = [(e, b) for e, b in msg.incs if e > cur.epoch]
+    if not incs:
+        return None, False
+    if incs[0][0] != cur.epoch + 1 or cur.epoch == 0:
+        return None, True
+    new = cur.copy()
+    for _e, b in incs:
+        apply_incremental(new, decode_incremental(b))
+    return new, False
+
+
 def decode_osdmap(data: bytes) -> OSDMap:
     dec = Decoder(data)
 
@@ -224,35 +469,14 @@ def decode_osdmap(data: bytes) -> OSDMap:
         affinity = d.list(lambda d2: d2.u32())
         osd_addrs = d.list(lambda d2: d2.str())
 
-        def dec_pool(d2: Decoder) -> PGPool:
-            p = PGPool(pool_id=d2.s64(), type=d2.u8(), size=d2.u32(),
-                       min_size=d2.u32(), crush_rule=d2.u32(),
-                       pg_num=d2.u32(), pgp_num=d2.u32(),
-                       ec_profile=d2.map(lambda d3: d3.str(),
-                                         lambda d3: d3.str()))
-            if version >= 2:
-                p.snap_seq = d2.u64()
-                p.snaps = d2.map(lambda d3: d3.u64(),
-                                 lambda d3: d3.str())
-            if version >= 5:
-                p.tier_of = d2.s64()
-                p.read_tier = d2.s64()
-                p.write_tier = d2.s64()
-                p.cache_mode = d2.str()
-                p.target_max_objects = d2.u64()
-                p.cache_min_flush_age = d2.f64()
-            return p
-
-        def dec_pgid_key(d2: Decoder) -> tuple[int, int]:
-            return (d2.s64(), d2.u32())
-
-        pools = d.map(lambda d2: d2.s64(), dec_pool)
-        pg_upmap = d.map(dec_pgid_key, lambda d2: d2.list(lambda d3: d3.s32()))
+        pools = d.map(lambda d2: d2.s64(),
+                      lambda d2: _dec_pool(d2, version))
+        pg_upmap = d.map(_dec_pgid, lambda d2: d2.list(lambda d3: d3.s32()))
         pg_upmap_items = d.map(
-            dec_pgid_key,
+            _dec_pgid,
             lambda d2: d2.list(lambda d3: (d3.s32(), d3.s32())))
-        pg_temp = d.map(dec_pgid_key, lambda d2: d2.list(lambda d3: d3.s32()))
-        primary_temp = d.map(dec_pgid_key, lambda d2: d2.s32())
+        pg_temp = d.map(_dec_pgid, lambda d2: d2.list(lambda d3: d3.s32()))
+        primary_temp = d.map(_dec_pgid, lambda d2: d2.s32())
         crush_names = {}
         if version >= 3:
             import json as _json

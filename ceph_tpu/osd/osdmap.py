@@ -150,6 +150,24 @@ class OSDMap:
     #: per-osd laggy history (osd_xinfo_t vector)
     osd_xinfo: list[OSDXInfo] = field(default_factory=list)
 
+    def copy(self) -> "OSDMap":
+        """Cheap structural copy for incremental application: the
+        mutable containers are duplicated one level deep; their VALUES
+        are never mutated in place by apply_incremental (changed
+        entries are replaced wholesale), so sharing them is safe — and
+        ~100x cheaper than an encode/decode round trip on a 10k-OSD
+        map."""
+        import copy as _copy
+        m = _copy.copy(self)
+        for attr in ("osd_state", "osd_weight", "osd_primary_affinity",
+                     "osd_addrs", "osd_xinfo"):
+            setattr(m, attr, list(getattr(self, attr)))
+        for attr in ("pools", "pg_upmap", "pg_upmap_items", "pg_temp",
+                     "primary_temp", "config_db", "auth_db", "fs_db",
+                     "crush_names"):
+            setattr(m, attr, dict(getattr(self, attr)))
+        return m
+
     # -- osd state ------------------------------------------------------------
 
     def set_max_osd(self, n: int) -> None:
