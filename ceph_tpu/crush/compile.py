@@ -7,10 +7,11 @@ negative items index buckets at -1-id, exactly the reference layout
 (crush/crush.h:354 crush_map.buckets).
 
 Batchability contract (checked at compile time, ValueError otherwise):
-  * every bucket is straw2 — the modern default (the reference converts maps
-    to straw2 for the same reason: deterministic O(size) draws, no per-call
-    permutation state).  Other algs run through the scalar oracle fallback
-    (ceph_tpu.crush.mapper_ref / OSDMapMapping's scalar path).
+  * every bucket is straw2 or tree — the two stateless draw algorithms
+    (deterministic per (x, r), no per-call permutation workspace).  Uniform,
+    list and legacy-straw buckets run through the scalar oracle fallback
+    (ceph_tpu.crush.mapper_ref / OSDMapMapping's scalar path): uniform's perm
+    cache is inherently sequential state.
   * modern tunables: choose_local_tries=0 and choose_local_fallback_tries=0
     (the jewel+ profile, Tunables defaults) — the legacy local-retry ladder
     (mapper.c:497-503) and perm fallback are scalar-only.
@@ -22,7 +23,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from .types import CRUSH_BUCKET_STRAW2, CrushMap
+from .types import CRUSH_BUCKET_STRAW2, CRUSH_BUCKET_TREE, CrushMap
 
 
 @dataclass
@@ -36,8 +37,12 @@ class CompiledCrushMap:
     bucket_id: np.ndarray      # (B,) int32  — crush bucket id (negative)
     bucket_type: np.ndarray    # (B,) int32
     bucket_size: np.ndarray    # (B,) int32
+    bucket_alg: np.ndarray     # (B,) int32  — CRUSH_BUCKET_{STRAW2,TREE}
     items: np.ndarray          # (B, S) int32, padded with INT32_MIN
     weights: np.ndarray        # (B, S) int64 16.16, padded with 0
+    n_nodes: np.ndarray        # (B,) int32  — tree node count (0 if !tree)
+    node_weights: np.ndarray   # (B, T) int64 — tree per-node weights
+    has_tree: bool             # any tree bucket present
     tunables_tries: int        # choose_total_tries + 1 (mapper.c:906)
     vary_r: int
     stable: int
@@ -56,33 +61,49 @@ def compile_map(m: CrushMap) -> CompiledCrushMap:
             "profiles")
     n = len(m.buckets)
     sizes = []
+    node_counts = []
     for b in m.buckets:
         if b is None:
             sizes.append(0)
+            node_counts.append(0)
             continue
-        if b.alg != CRUSH_BUCKET_STRAW2:
+        if b.alg == CRUSH_BUCKET_TREE:
+            node_counts.append(len(b.node_weights))
+        elif b.alg == CRUSH_BUCKET_STRAW2:
+            node_counts.append(0)
+        else:
             raise ValueError(
-                f"batched mapper supports straw2 buckets only; bucket "
-                f"{b.id} has alg {b.alg} — use the scalar oracle")
+                f"batched mapper supports straw2 and tree buckets only; "
+                f"bucket {b.id} has alg {b.alg} — use the scalar oracle")
         sizes.append(b.size)
     s_max = max(sizes, default=1) or 1
+    t_max = max(node_counts, default=0) or 1
     bucket_id = np.zeros(n, dtype=np.int32)
     bucket_type = np.zeros(n, dtype=np.int32)
     bucket_size = np.zeros(n, dtype=np.int32)
+    bucket_alg = np.zeros(n, dtype=np.int32)
     items = np.full((n, s_max), np.iinfo(np.int32).min, dtype=np.int32)
     weights = np.zeros((n, s_max), dtype=np.int64)
+    n_nodes = np.zeros(n, dtype=np.int32)
+    node_weights = np.zeros((n, t_max), dtype=np.int64)
     for idx, b in enumerate(m.buckets):
         if b is None:
             continue
         bucket_id[idx] = b.id
         bucket_type[idx] = b.type
         bucket_size[idx] = b.size
+        bucket_alg[idx] = b.alg
         items[idx, :b.size] = b.items
         weights[idx, :b.size] = b.item_weights
+        if b.alg == CRUSH_BUCKET_TREE:
+            n_nodes[idx] = len(b.node_weights)
+            node_weights[idx, :len(b.node_weights)] = b.node_weights
     return CompiledCrushMap(
         n_buckets=n, max_size=s_max, max_devices=m.max_devices,
         bucket_id=bucket_id, bucket_type=bucket_type, bucket_size=bucket_size,
-        items=items, weights=weights,
+        bucket_alg=bucket_alg, items=items, weights=weights,
+        n_nodes=n_nodes, node_weights=node_weights,
+        has_tree=bool((bucket_alg == CRUSH_BUCKET_TREE).any()),
         tunables_tries=t.choose_total_tries + 1,
         vary_r=t.chooseleaf_vary_r, stable=t.chooseleaf_stable,
         descend_once=t.chooseleaf_descend_once,

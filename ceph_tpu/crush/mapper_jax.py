@@ -29,10 +29,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ceph_tpu.ops.crush_kernel import is_out
+from ceph_tpu.ops.crush_kernel import hash32_4, is_out
 
 from .compile import CompiledCrushMap, compile_map
 from .types import (
+    CRUSH_BUCKET_TREE,
     CRUSH_ITEM_NONE,
     RULE_CHOOSE_FIRSTN,
     RULE_CHOOSE_INDEP,
@@ -59,8 +60,12 @@ class _Arrays:
         self.bucket_id = jnp.asarray(c.bucket_id)
         self.bucket_type = jnp.asarray(c.bucket_type)
         self.bucket_size = jnp.asarray(c.bucket_size)
+        self.bucket_alg = jnp.asarray(c.bucket_alg)
         self.items = jnp.asarray(c.items)
         self.weights = jnp.asarray(c.weights)
+        self.n_nodes = jnp.asarray(c.n_nodes)
+        self.node_weights = jnp.asarray(c.node_weights)
+        self.has_tree = c.has_tree
         self.n_buckets = c.n_buckets
         self.max_devices = c.max_devices
 
@@ -76,14 +81,54 @@ def _straw2_draws_per_row(x, items_row, r, w_row):
     return jnp.where(w > 0, draw, jnp.int64(S64_MIN))
 
 
+def _tree_winner(a: _Arrays, cur: jax.Array, x: jax.Array,
+                 r: jax.Array) -> jax.Array:
+    """Tree-bucket winner: weighted binary descent from the root node
+    (num_nodes/2) to a leaf (odd node; leaf i at node 2i+1), semantics of
+    mapper.c:195-222.  Lanes whose bucket is not a tree terminate at node 1
+    immediately; the caller selects them out by alg."""
+    is_tree = a.bucket_alg[cur] == jnp.int32(CRUSH_BUCKET_TREE)
+    n0 = (a.n_nodes[cur] >> 1).astype(jnp.uint32)
+    n0 = jnp.where(is_tree & (n0 > 0), n0, jnp.uint32(1))
+    bid = a.bucket_id[cur].astype(jnp.uint32)
+
+    def cond(n):
+        return jnp.any((n & 1) == 0)
+
+    def body(n):
+        live = (n & 1) == 0
+        rows = a.node_weights[cur]                 # (N, T)
+        safe = jnp.minimum(n, jnp.uint32(rows.shape[1] - 1)).astype(jnp.int32)
+        w = jnp.take_along_axis(rows, safe[:, None], axis=1)[:, 0]
+        h = hash32_4(x, n, r, bid).astype(jnp.uint64)
+        t = (h * w.astype(jnp.uint64)) >> jnp.uint64(32)
+        half = (n & (~n + jnp.uint32(1))) >> 1     # 1 << (h-1)
+        left = n - half
+        lsafe = jnp.minimum(
+            left, jnp.uint32(rows.shape[1] - 1)).astype(jnp.int32)
+        lw = jnp.take_along_axis(rows, lsafe[:, None], axis=1)[:, 0]
+        nxt = jnp.where(t < lw.astype(jnp.uint64), left, n + half)
+        return jnp.where(live, nxt, n)
+
+    n = jax.lax.while_loop(cond, body, n0)
+    leaf = (n >> 1).astype(jnp.int32)
+    leaf = jnp.minimum(leaf, jnp.int32(a.items.shape[1] - 1))
+    return jnp.take_along_axis(a.items[cur], leaf[:, None], axis=1)[:, 0]
+
+
 def _winner(a: _Arrays, cur: jax.Array, x: jax.Array, r: jax.Array) -> jax.Array:
-    """Straw2 winner of bucket index ``cur`` for each lane (first max wins,
-    mapper.c:361-384; choose_args overrides are scalar-path only)."""
+    """Winner of bucket index ``cur`` for each lane: straw2 argmax (first max
+    wins, mapper.c:361-384; choose_args overrides are scalar-path only), or
+    tree descent for tree buckets when the map has any."""
     items_row = a.items[cur]                      # (N, S)
     w_row = a.weights[cur]                        # (N, S) — padding weight 0
     d = _straw2_draws_per_row(x, items_row, r, w_row)
     pos = jnp.argmax(d, axis=-1)
-    return jnp.take_along_axis(items_row, pos[:, None], axis=1)[:, 0]
+    s2 = jnp.take_along_axis(items_row, pos[:, None], axis=1)[:, 0]
+    if not a.has_tree:
+        return s2
+    tw = _tree_winner(a, cur, x, r)
+    return jnp.where(a.bucket_alg[cur] == jnp.int32(CRUSH_BUCKET_TREE), tw, s2)
 
 
 def _widx(a: _Arrays, item: jax.Array) -> jax.Array:

@@ -74,7 +74,7 @@ def lib() -> ctypes.CDLL:
                 ctypes.POINTER(ctypes.c_uint32), ctypes.c_long, ctypes.c_int,
                 ctypes.POINTER(ctypes.c_uint32), ctypes.c_int,
                 ctypes.POINTER(ctypes.c_int32)]
-            L.crush_batch_c.restype = None
+            L.crush_batch_c.restype = ctypes.c_int
             _LIB = L
         return _LIB
 
@@ -106,9 +106,9 @@ CRUSH_ITEM_NONE = 0x7FFFFFFF
 def _map_blob(crush_map) -> np.ndarray:
     """Serialize a crush.types.CrushMap into the int64 blob crush_init eats."""
     from ceph_tpu.crush.ln_table import lh_table, ll_table, rh_table
-    from ceph_tpu.crush.types import CRUSH_BUCKET_STRAW
+    from ceph_tpu.crush.types import CRUSH_BUCKET_STRAW, CRUSH_BUCKET_TREE
 
-    words: list[int] = [0xCB01, crush_map.max_devices,
+    words: list[int] = [0xCB02, crush_map.max_devices,
                        crush_map.max_buckets, crush_map.max_rules]
     words += [getattr(crush_map.tunables, f) for f in _TUNABLE_FIELDS]
     for b in crush_map.buckets:
@@ -122,6 +122,9 @@ def _map_blob(crush_map) -> np.ndarray:
         else:
             words += list(b.item_weights) if b.item_weights \
                 else [b.item_weight] * b.size
+        if b.alg == CRUSH_BUCKET_TREE:
+            words += [len(b.node_weights)]
+            words += list(b.node_weights)
     for r in crush_map.rules:
         if r is None:
             words.append(0)
@@ -142,10 +145,6 @@ class CrushBaseline:
     time) — the single-core number the batched TPU engine must beat."""
 
     def __init__(self, crush_map):
-        from ceph_tpu.crush.types import CRUSH_BUCKET_TREE
-        for b in crush_map.buckets:
-            if b is not None and b.alg == CRUSH_BUCKET_TREE:
-                raise NativeUnavailable("tree buckets unsupported in baseline")
         self._blob = _map_blob(crush_map)
         self._h = lib().crush_init(
             self._blob.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
@@ -172,6 +171,10 @@ class CrushBaseline:
             self._h, ruleno, x & 0xFFFFFFFF,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), result_max,
             w.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), len(w))
+        if n < 0:
+            raise ValueError(
+                f"result_max {result_max} exceeds the C baseline's "
+                f"working-set capacity ({self.result_max_limit})")
         return [int(v) for v in out[:n]]
 
     def do_rule_batch(self, ruleno: int, xs: np.ndarray, result_max: int,
@@ -180,10 +183,14 @@ class CrushBaseline:
         xs = np.ascontiguousarray(xs, dtype=np.uint32)
         w = np.ascontiguousarray(weights, dtype=np.uint32)
         out = np.empty((len(xs), result_max), dtype=np.int32)
-        lib().crush_batch_c(
+        rc = lib().crush_batch_c(
             self._h, ruleno,
             xs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), len(xs),
             result_max,
             w.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), len(w),
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc < 0:
+            raise ValueError(
+                f"result_max {result_max} exceeds the C baseline's "
+                f"working-set capacity ({self.result_max_limit})")
         return out

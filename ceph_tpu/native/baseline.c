@@ -182,6 +182,9 @@ typedef struct {
     int32_t *items;
     int64_t *weights;      /* 16.16 fixed point */
     int64_t *sums;         /* list alg cumulative weights */
+    /* tree alg: weights at binary-tree nodes, leaves at odd indices */
+    int n_nodes;
+    int64_t *node_weights;
     /* workspace (bucket_perm_choose) */
     uint32_t perm_x, perm_n;
     int32_t *perm;
@@ -208,7 +211,7 @@ static cbucket *map_bucket(cmap *m, int id) {
 
 void *crush_init(const int64_t *blob) {
     const int64_t *p = blob;
-    if (*p++ != 0xCB01) return NULL;
+    if (*p++ != 0xCB02) return NULL;
     cmap *m = calloc(1, sizeof(cmap));
     m->max_devices = (int)*p++;
     m->n_buckets = (int)*p++;
@@ -233,6 +236,12 @@ void *crush_init(const int64_t *blob) {
         for (int j = 0; j < b->size; j++) {
             acc += b->weights[j];
             b->sums[j] = acc;
+        }
+        if (b->alg == ALG_TREE) {
+            b->n_nodes = (int)*p++;
+            b->node_weights = malloc(
+                sizeof(int64_t) * (b->n_nodes ? b->n_nodes : 1));
+            for (int j = 0; j < b->n_nodes; j++) b->node_weights[j] = *p++;
         }
     }
     m->rules = calloc(m->n_rules ? m->n_rules : 1, sizeof(crule));
@@ -261,6 +270,7 @@ void crush_free(void *h) {
         free(m->buckets[i].items);
         free(m->buckets[i].weights);
         free(m->buckets[i].sums);
+        free(m->buckets[i].node_weights);
         free(m->buckets[i].perm);
     }
     for (int i = 0; i < m->n_rules; i++) free(m->rules[i].steps);
@@ -369,13 +379,30 @@ static int32_t bucket_straw_choose(cbucket *b, uint32_t x, uint32_t r) {
     return b->items[high];
 }
 
+static int32_t bucket_tree_choose(cbucket *b, uint32_t x, uint32_t r) {
+    /* descend from the root (num_nodes/2) to a leaf (odd node); leaf i
+       lives at node 2i+1 (mapper.c:195-222 semantics) */
+    uint32_t n = (uint32_t)b->n_nodes >> 1;
+    while (!(n & 1)) {
+        uint64_t w = (uint64_t)b->node_weights[n];
+        uint64_t t =
+            ((uint64_t)hash32_4(x, n, r, (uint32_t)b->id) * w) >> 32;
+        uint32_t half = (n & (~n + 1u)) >> 1;  /* 1 << (h-1) */
+        uint32_t left = n - half;
+        if (t < (uint64_t)b->node_weights[left]) n = left;
+        else n += half;
+    }
+    return b->items[n >> 1];
+}
+
 static int32_t crush_bucket_choose(cmap *m, cbucket *b, uint32_t x, uint32_t r) {
     switch (b->alg) {
     case ALG_UNIFORM: return bucket_perm_choose(b, x, r);
     case ALG_LIST:    return bucket_list_choose(b, x, r);
+    case ALG_TREE:    return bucket_tree_choose(b, x, r);
     case ALG_STRAW:   return bucket_straw_choose(b, x, r);
     case ALG_STRAW2:  return bucket_straw2_choose(m, b, x, r);
-    default:          return b->items[0]; /* tree unsupported in baseline */
+    default:          return ITEM_NONE; /* unknown alg: terminal reject */
     }
 }
 
@@ -551,17 +578,19 @@ static void reset_work(cmap *m) {
     }
 }
 
-/* Returns number of results; out must hold result_max entries. */
+/* Returns number of results (out must hold result_max entries), or -1 on
+ * result_max beyond the fixed working-set capacity — never a silent empty
+ * answer for an over-large request. */
 int crush_do_rule_c(void *h, int ruleno, uint32_t x, int32_t *out,
                     int result_max, const uint32_t *weight, int nweight) {
     cmap *m = h;
+    int32_t w[64], o[64], c[64], o_sub[64], c_sub[64];
+    if (result_max > 64) return -1;
     if (ruleno < 0 || ruleno >= m->n_rules || !m->rules[ruleno].present)
         return 0;
     crule *rule = &m->rules[ruleno];
     reset_work(m);
 
-    int32_t w[64], o[64], c[64], o_sub[64], c_sub[64];
-    if (result_max > 64) return 0;
     int wsize = 0, nres = 0;
 
     int choose_tries = (int)m->tun[2] + 1;
@@ -661,14 +690,17 @@ int crush_do_rule_c(void *h, int ruleno, uint32_t x, int32_t *out,
 }
 
 /* Batch driver: the ParallelPGMapper workload on one core.  out is
- * (nx, result_max) int32, NONE-padded. */
-void crush_batch_c(void *h, int ruleno, const uint32_t *xs, long nx,
-                   int result_max, const uint32_t *weight, int nweight,
-                   int32_t *out) {
+ * (nx, result_max) int32, NONE-padded.  Returns 0, or -1 on an over-large
+ * result_max (mirrors crush_do_rule_c). */
+int crush_batch_c(void *h, int ruleno, const uint32_t *xs, long nx,
+                  int result_max, const uint32_t *weight, int nweight,
+                  int32_t *out) {
+    if (result_max > 64) return -1;
     for (long i = 0; i < nx; i++) {
         int32_t *row = out + i * result_max;
         int n = crush_do_rule_c(h, ruleno, xs[i], row, result_max,
                                 weight, nweight);
         for (int j = n; j < result_max; j++) row[j] = ITEM_NONE;
     }
+    return 0;
 }

@@ -85,6 +85,26 @@ def hash32_3(a, b, c):
     return h
 
 
+def hash32_4(a, b, c, d):
+    """crush_hash32_4 (hash.c:68-84), elementwise over broadcast uint32
+    arrays — the draw hash of tree and list buckets."""
+    a = jnp.asarray(a).astype(_U32)
+    b = jnp.asarray(b).astype(_U32)
+    c = jnp.asarray(c).astype(_U32)
+    d = jnp.asarray(d).astype(_U32)
+    a, b, c, d = jnp.broadcast_arrays(a, b, c, d)
+    h = jnp.uint32(CRUSH_HASH_SEED) ^ a ^ b ^ c ^ d
+    x = _const(h, 231232)
+    y = _const(h, 1232)
+    a, b, h = _mix(a, b, h)
+    c, d, h = _mix(c, d, h)
+    a, x, h = _mix(a, x, h)
+    y, b, h = _mix(y, b, h)
+    c, x, h = _mix(c, x, h)
+    y, d, h = _mix(y, d, h)
+    return h
+
+
 # ---------------------------------------------------------------------------
 # crush_ln — 2^44*log2(x+1) in 48-bit fixed point (mapper.c:248-290)
 # ---------------------------------------------------------------------------

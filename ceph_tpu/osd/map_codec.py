@@ -295,12 +295,12 @@ def diff_osdmap(old: OSDMap, new: OSDMap) -> dict:
                or old.osd_xinfo[i] != new.osd_xinfo[i]}
         if xch:
             inc["xinfo"] = xch
-    # whole-structure deltas (cheap to compare, small to ship)
-    enc_old = Encoder()
-    encode_crush(old.crush, enc_old)
-    enc_new = Encoder()
-    encode_crush(new.crush, enc_new)
-    if enc_old.tobytes() != enc_new.tobytes():
+    # whole-structure deltas: compare structurally (dataclass equality)
+    # first — encoding runs only when the crush map actually changed, not
+    # on every epoch commit under the mon lock
+    if old.crush is not new.crush and old.crush != new.crush:
+        enc_new = Encoder()
+        encode_crush(new.crush, enc_new)
         inc["crush"] = enc_new.tobytes()
     for attr in ("config_db", "fs_db", "crush_names"):
         if getattr(old, attr) != getattr(new, attr):

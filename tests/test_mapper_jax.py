@@ -237,3 +237,37 @@ def test_two_stage_pallas_schedule_interpret():
     fm.STAGE2_CAP = 8
     res_cap = np.asarray(fm.run(xs, rw, 3))
     np.testing.assert_array_equal(res_cap, res_xla)
+
+
+# -- tree buckets (batched descent vs the scalar oracle) ---------------------
+
+def test_tree_hosts_chooseleaf_firstn():
+    from ceph_tpu.crush.types import CRUSH_BUCKET_TREE
+    m, _root, rid = build_two_level_map(8, 4, host_alg=CRUSH_BUCKET_TREE)
+    assert_matches(m, rid, 3, [0x10000] * 32)
+
+
+def test_tree_root_flat_firstn_and_indep():
+    from ceph_tpu.crush.types import CRUSH_BUCKET_TREE
+    m, _root, rid = build_flat_map(17, alg=CRUSH_BUCKET_TREE)
+    assert_matches(m, rid, 3, [0x10000] * 17)
+    assert_matches(m, 1, 5, [0x10000] * 17)
+
+
+def test_tree_nonuniform_weights_and_reweight():
+    from ceph_tpu.crush.types import CRUSH_BUCKET_TREE
+    wrng = np.random.default_rng(42)
+    weights = [int(w) for w in wrng.integers(0x4000, 0x30000, 21)]
+    m, _root, rid = build_flat_map(21, weights=weights,
+                                   alg=CRUSH_BUCKET_TREE)
+    reweight = [int(w) for w in wrng.integers(0, 0x10001, 21)]
+    reweight[2] = 0
+    assert_matches(m, rid, 4, reweight)
+
+
+def test_mixed_straw2_root_tree_hosts():
+    # straw2 root over tree host buckets: both algs inside one descent
+    from ceph_tpu.crush.types import CRUSH_BUCKET_TREE
+    m, _root, rid = build_two_level_map(
+        6, 5, host_alg=CRUSH_BUCKET_TREE, root_alg=CRUSH_BUCKET_STRAW2)
+    assert_matches(m, rid, 3, [0x10000] * 30)

@@ -103,3 +103,33 @@ def test_crush_c_batch_matches_scalar():
             assert got == want
     finally:
         cb.close()
+
+
+def test_crush_c_tree_buckets():
+    # tree host buckets under a straw2 root, and a pure tree root: the C
+    # descent (bucket_tree_choose) must match the oracle's mapper.c:195-222
+    from ceph_tpu.crush.types import CRUSH_BUCKET_TREE
+    m, _root, rid = build_two_level_map(8, 4, host_alg=CRUSH_BUCKET_TREE)
+    _compare(m, rid, range(256), 3, [0x10000] * 32)
+
+    rng = np.random.default_rng(3)
+    weights = [int(w) for w in rng.integers(0x4000, 0x30000, 19)]
+    from ceph_tpu.crush import build_flat_map as _bfm
+    m2, _root2, rid2 = _bfm(19, weights=weights, alg=CRUSH_BUCKET_TREE)
+    rw = [int(w) for w in rng.integers(0, 0x10001, 19)]
+    _compare(m2, rid2, range(256), 3, rw)
+
+
+def test_crush_c_result_max_guard_raises():
+    # result_max beyond the fixed 64-slot working set must be a loud error,
+    # never a silent empty result
+    m, _root, rid = build_flat_map(8)
+    cb = CrushBaseline(m)
+    try:
+        with pytest.raises(ValueError):
+            cb.do_rule(rid, 1, 65, [0x10000] * 8)
+        with pytest.raises(ValueError):
+            cb.do_rule_batch(rid, np.arange(4, dtype=np.uint32), 65,
+                             np.full(8, 0x10000, dtype=np.uint32))
+    finally:
+        cb.close()
