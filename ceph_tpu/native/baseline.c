@@ -382,6 +382,10 @@ static int32_t bucket_straw_choose(cbucket *b, uint32_t x, uint32_t r) {
 static int32_t bucket_tree_choose(cbucket *b, uint32_t x, uint32_t r) {
     /* descend from the root (num_nodes/2) to a leaf (odd node); leaf i
        lives at node 2i+1 (mapper.c:195-222 semantics) */
+    if (b->n_nodes < 2 || b->size == 0)
+        return ITEM_NONE;  /* degenerate tree: terminal reject (callers
+                              already guard size==0; belt and braces —
+                              n=0 would loop forever below) */
     uint32_t n = (uint32_t)b->n_nodes >> 1;
     while (!(n & 1)) {
         uint64_t w = (uint64_t)b->node_weights[n];

@@ -255,6 +255,7 @@ class OSDDaemon(Dispatcher):
                      .add_u64("ec_encode_stripes").add_u64("recovery_pulls")
                      .add_u64("peering_rounds").add_u64("log_entries")
                      .add_u64("pg_splits")
+                     .add_u64("ec_rmw_gather").add_u64("ec_rmw_pipelined")
                      .add_time_avg("op_w_latency")
                      .create_perf_counters())
         self.ctx.perf.add(self.perf)
@@ -493,8 +494,12 @@ class OSDDaemon(Dispatcher):
                     (gid, st) for gid, st in self._ec_reads.items()
                     if st["kind"] == "rmw"
                     and now - st.get("started", now) > 8.0]
-                for gid, _st in stuck_rmw:
+                for gid, st in stuck_rmw:
                     self._ec_reads.pop(gid, None)
+                    # fail atomically under this lock (see _rmw_fail):
+                    # releasing first would let a new write reclaim the
+                    # gate ahead of the queued older writes
+                    self._rmw_fail(st)
                 # a dead watcher never acks: expire its notifies so the
                 # notifier gets its reply instead of a client timeout
                 stale_notifies = [
@@ -506,8 +511,6 @@ class OSDDaemon(Dispatcher):
                 m = st["msg"]
                 self._op_send_reply(m, MOSDOpReply(
                     tid=m.tid, result=0, epoch=self.osdmap.epoch))
-            for _gid, st in stuck_rmw:
-                self._ec_read_give_up(st)
             for pg in pgs:
                 self._tick_pg(pg, now)
         finally:
@@ -918,9 +921,8 @@ class OSDDaemon(Dispatcher):
             dead = [gid for gid, st in self._ec_reads.items()
                     if st["kind"] == "rmw" and st["pgid"] == pgid]
             for gid in dead:
-                st = self._ec_reads.pop(gid, None)
-                if st is not None and st.get("msg") is not None:
-                    parent.waiting_for_active.append(st["msg"])
+                self._requeue_rmw_state(self._ec_reads.pop(gid, None),
+                                        parent)
 
             # queued ops whose object moved: requeue on the child (the
             # client also resends on the map change; the log dedups)
@@ -1025,13 +1027,9 @@ class OSDDaemon(Dispatcher):
             dead = [gid for gid, st in self._ec_reads.items()
                     if st["kind"] == "rmw" and st["pgid"] == pg.pgid]
             for gid in dead:
-                st = self._ec_reads.pop(gid, None)
-                if st is not None and st.get("msg") is not None:
-                    trk = getattr(st["msg"], "_trk", None)
-                    if trk is not None:
-                        trk.mark_event(
-                            "rmw gather torn down: interval change")
-                    pg.waiting_for_active.append(st["msg"])
+                self._requeue_rmw_state(
+                    self._ec_reads.pop(gid, None), pg,
+                    event="rmw gather torn down: interval change")
             # ops queued against the old interval: requeue for re-check
             # after this round settles (clients also resend on map change)
             for ops in pg.waiting_for_missing.values():
@@ -2011,8 +2009,16 @@ class OSDDaemon(Dispatcher):
             is_write = any(op.op in (OP_WRITE, OP_WRITEFULL, OP_DELETE,
                                      OP_OMAP_SET, OP_OMAP_RMKEYS)
                            for op in msg.ops)
+            # pure EC writes ride the per-object write pipeline instead of
+            # parking behind an in-flight rmw gather (ExtentCache analog,
+            # src/osd/ExtentCache.h:1-491): _ec_write_op chains them onto
+            # the gather's projected content
+            ec_pipelinable = (pool.is_erasure() and bool(msg.ops)
+                              and all(op.op in (OP_WRITE, OP_WRITEFULL)
+                                      for op in msg.ops))
             if self._blocked_on_recovery(pg, msg.oid, is_write,
-                                         pool.is_erasure()):
+                                         pool.is_erasure(),
+                                         rmw_ok=ec_pipelinable):
                 msg._trk.mark_event("waiting for missing object")
                 pg.waiting_for_missing.setdefault(msg.oid, []).append(msg)
                 return
@@ -2051,11 +2057,18 @@ class OSDDaemon(Dispatcher):
                                        pool.tier_of))
 
     def _blocked_on_recovery(self, pg: PG, oid: str, is_write: bool,
-                             ec: bool) -> bool:
+                             ec: bool, rmw_ok: bool = False) -> bool:
         """Block ops on objects still being recovered
-        (PrimaryLogPG objects_blocked_on_recovery semantics)."""
+        (PrimaryLogPG objects_blocked_on_recovery semantics).  rmw_ok
+        lets pipelinable EC writes through an in-flight rmw gather —
+        they join the gather's write queue instead of parking — but ONLY
+        while nothing non-pipelinable is already parked on the object:
+        jumping a parked read/delete would break per-object op order."""
         with self._lock:
-            if oid in pg.missing or oid in pg.recovering or oid in pg.rmw:
+            if oid in pg.missing or oid in pg.recovering:
+                return True
+            if oid in pg.rmw and not (rmw_ok
+                                      and not pg.waiting_for_missing.get(oid)):
                 return True
             if is_write or ec:
                 return any(oid in ps.missing for ps in pg.peers.values())
@@ -2432,32 +2445,57 @@ class OSDDaemon(Dispatcher):
             self._reply_err(msg, -11)
             return
         self.perf.inc("op_w")
-        existing = pg.log.index.get(msg.oid)
-        fresh = existing is None or existing.is_delete()
-        if op.op == OP_WRITEFULL or (fresh and op.offset == 0):
-            self._ec_apply_write(msg, pool, pg, op, old_data=b"",
-                                 replace=True)
-            return
-        if fresh:
-            # partial write to a fresh object: zero-fill base
-            self._ec_apply_write(msg, pool, pg, op, old_data=b"",
-                                 replace=False)
-            return
-        # read-modify-write: gather the current object, then continue.
-        # The object is gated (pg.rmw) so overlapping ops queue.
         with self._lock:
+            # ONE critical section from queue-join check through gate
+            # install and state registration: a second writer must see
+            # either no gate, or a fully-registered live gather — never
+            # a gate whose state isn't in _ec_reads yet.  (Callers
+            # already hold this RLock via _handle_op's dispatch block;
+            # taking it here makes the invariant local.)
+            #
+            # Per-object write pipeline (ExtentCache reduced,
+            # src/osd/ExtentCache.h:1-491): while an rmw gather is in
+            # flight for this object, later writes — partial OR full —
+            # join its queue in arrival order and will overlay onto the
+            # gather's projected content with no second disk/shard read
+            gid0 = pg.rmw.get(msg.oid)
+            if gid0 is not None:
+                st0 = self._ec_reads.get(gid0)
+                if st0 is not None and st0.get("kind") == "rmw":
+                    st0.setdefault("queue", []).append((msg, op))
+                    self.perf.inc("ec_rmw_pipelined")
+                    trk = getattr(msg, "_trk", None)
+                    if trk is not None:
+                        trk.mark_event("pipelined behind rmw gather")
+                    return
+                # stale gate from a torn-down gather: reclaim it
+                pg.rmw.pop(msg.oid, None)
+            existing = pg.log.index.get(msg.oid)
+            fresh = existing is None or existing.is_delete()
+            if op.op == OP_WRITEFULL or fresh:
+                if op.op == OP_WRITEFULL or op.offset == 0:
+                    self._ec_apply_write(msg, pool, pg, op, old_data=b"",
+                                         replace=True)
+                else:
+                    # partial write to a fresh object: zero-fill base
+                    self._ec_apply_write(msg, pool, pg, op, old_data=b"",
+                                         replace=False)
+                return
+            # read-modify-write: gather the current object, then
+            # continue.  The object is gated (pg.rmw); overlapping reads
+            # park, further writes join this gather's pipeline queue
             self._recover_tid += 1
             gid = (RECOVERY_CLIENT + self.osd_id, self._recover_tid)
             pg.rmw[msg.oid] = gid
-        si = self._ec_stripe_info(codec, pool)
-        cand = self._ec_shard_candidates(pg, n)
-        state = {"kind": "rmw", "msg": msg, "op": op, "pool": pool,
-                 "pgid": msg.pgid, "oid": msg.oid, "si": si,
-                 "shards": {}, "k": k, "active": set(), "cand": cand,
-                 "need": existing.version, "started": time.time(),
-                 "gid": gid}
-        with self._lock:
+            si = self._ec_stripe_info(codec, pool)
+            cand = self._ec_shard_candidates(pg, n)
+            state = {"kind": "rmw", "msg": msg, "op": op, "pool": pool,
+                     "pgid": msg.pgid, "oid": msg.oid, "si": si,
+                     "shards": {}, "k": k, "active": set(), "cand": cand,
+                     "need": existing.version, "started": time.time(),
+                     "gid": gid, "queue": []}
             self._ec_reads[gid] = state
+        self.perf.inc("ec_rmw_gather")
         self._ec_gather(gid, state)
 
     def _ec_rmw_ready(self, state: dict, old_data: bytes) -> None:
@@ -2468,22 +2506,67 @@ class OSDDaemon(Dispatcher):
         msg = state["msg"]
         pg = self.pgs.get(state["pgid"])
         if pg is None:
+            # the PG left this OSD entirely (remap/removal): clients
+            # resend on the map change, so no reply/requeue here
+            with self._lock:
+                self._ec_reads.pop(state.get("gid"), None)
             return
         with self._lock:
+            if self._ec_reads.get(state.get("gid")) is not state:
+                # the stuck-rmw watchdog or a teardown path claimed this
+                # gather while the decode ran (popping it from _ec_reads
+                # is the claim): it already replied/requeued — applying
+                # here too would double-complete the op
+                return
             if pg.rmw.get(msg.oid) != state.get("gid"):
                 # an interval change orphaned this gather; a newer one
                 # (or nobody) owns the gate now — applying pre-peering
-                # old_data here would overlay a stale base
+                # old_data here would overlay a stale base.  Head and
+                # pipelined writes requeue (never silently dropped);
+                # post-activation dispatch dedups against the log
+                self._ec_reads.pop(state.get("gid"), None)
+                self._requeue_rmw_state(
+                    state, pg, event="rmw gather orphaned: gate lost")
                 return
-            self._ec_apply_write(msg, state["pool"], pg, state["op"],
-                                 old_data=old_data, replace=False)
+            projected = self._ec_apply_write(msg, state["pool"], pg,
+                                             state["op"],
+                                             old_data=old_data,
+                                             replace=False)
+            base = old_data if projected is None else projected
+            # drain the write pipeline: each queued write overlays onto
+            # the previous write's projected content — ONE gather serves
+            # the whole burst (the ExtentCache win).  New arrivals keep
+            # appending under this same lock until the queue runs dry.
+            q = state.get("queue") or []
+            while q:
+                m2, op2 = q.pop(0)
+                # a map-change resend of an op already drained earlier in
+                # this queue is in the log now: dedup it here exactly like
+                # the direct path would, or it would apply twice
+                if self._dedup_resend(pg, (m2.client_id, m2.tid), m2):
+                    continue
+                if self._stale_retry(pg, m2):
+                    self._reply_err(m2, -125)
+                    continue
+                replace2 = op2.op == OP_WRITEFULL
+                nxt = self._ec_apply_write(
+                    m2, state["pool"], pg, op2,
+                    old_data=b"" if replace2 else base,
+                    replace=replace2)
+                if nxt is not None:
+                    base = nxt
             pg.rmw.pop(msg.oid, None)
+            self._ec_reads.pop(state.get("gid"), None)
             waiting = pg.waiting_for_missing.pop(msg.oid, [])
         for m in waiting:
             self._handle_op(m)
 
     def _ec_apply_write(self, msg: MOSDOp, pool, pg: PG, op,
-                        old_data: bytes, replace: bool) -> None:
+                        old_data: bytes, replace: bool) -> bytes | None:
+        """Apply one EC write (encode + local commit + shard fan-out).
+        Returns the full post-write object content — the projected base
+        the rmw pipeline chains the next queued write onto — or None if
+        the write was refused (reply already sent)."""
         codec = self._codec(pool)
         n = codec.get_chunk_count()
         k = codec.get_data_chunk_count()
@@ -2497,7 +2580,7 @@ class OSDDaemon(Dispatcher):
         # against the CURRENT up set before committing anything
         if len(shard_osds) < max(k, pool.min_size):
             self._reply_err(msg, -11)
-            return
+            return None
         if replace:
             data = bytes(op.data)
         else:
@@ -2572,6 +2655,7 @@ class OSDDaemon(Dispatcher):
                 truncate=truncate))
         if not waiting:
             self._op_send_reply(msg, reply)
+        return data
 
     def _patched_shard(self, pgid, oid: str, shard: int, chunk: bytes,
                        offset: int, shard_len: int, truncate: bool,
@@ -2720,6 +2804,11 @@ class OSDDaemon(Dispatcher):
                 if pick is None:
                     del self._ec_reads[reqid]
                     give_up = True
+                    if state["kind"] == "rmw":
+                        # fail while still holding the lock (_rmw_fail
+                        # contract: no gate-reclaim window)
+                        self._rmw_fail(state)
+                        return
                 else:
                     give_up = False
                     osd = state["cand"][pick].pop(0)
@@ -2813,20 +2902,49 @@ class OSDDaemon(Dispatcher):
         self._ec_gather(reqid, state)
 
     def _ec_read_give_up(self, state: dict) -> None:
+        """Terminal gather failure for client reads and recovery pulls.
+        rmw gathers go through _rmw_fail instead (atomically, under the
+        lock that popped them)."""
         if state["kind"] == "client":
             self._reply_err(state["msg"], -5)
             return
         pg = self.pgs.get(state["pgid"])
-        if state["kind"] == "rmw":
-            if pg is not None:
-                with self._lock:
-                    if pg.rmw.get(state["oid"]) == state.get("gid"):
-                        pg.rmw.pop(state["oid"], None)
-            self._reply_err(state["msg"], -5)
-            return
         if pg is not None:
             with self._lock:
                 pg.recovering.pop(state["oid"], None)
+
+    def _rmw_fail(self, state: dict) -> None:
+        """Fail an rmw gather whose state the CALLER just popped from
+        _ec_reads, while STILL HOLDING self._lock: the gate release, the
+        head's error reply, and the re-dispatch of pipelined writes all
+        land before any new write can observe the stale gate — a new
+        write slipping in between would reclaim the gate and apply ahead
+        of the older queued writes (per-object order inversion)."""
+        pg = self.pgs.get(state["pgid"])
+        if pg is not None and pg.rmw.get(state["oid"]) == state.get("gid"):
+            pg.rmw.pop(state["oid"], None)
+        self._reply_err(state["msg"], -5)
+        # pipelined writes re-dispatch in order: the first starts a fresh
+        # gather and the rest join its queue, all under this lock
+        for m2, _op2 in state.get("queue") or []:
+            self._handle_op(m2)
+
+    def _requeue_rmw_state(self, st: dict | None, dest_pg: PG,
+                           event: str | None = None) -> None:
+        """Requeue a torn-down rmw gather's client op and its pipelined
+        queue onto dest_pg.waiting_for_active (caller holds the lock;
+        split and interval-change teardown share this)."""
+        if st is None:
+            return
+        m = st.get("msg")
+        if m is not None:
+            if event:
+                trk = getattr(m, "_trk", None)
+                if trk is not None:
+                    trk.mark_event(event)
+            dest_pg.waiting_for_active.append(m)
+        for m2, _op2 in st.get("queue") or []:
+            dest_pg.waiting_for_active.append(m2)
 
     def _ec_read_done(self, reqid, shard: int, chunk: bytes,
                       size: int, ver) -> None:
@@ -2854,6 +2972,13 @@ class OSDDaemon(Dispatcher):
                 state["k"] = len(state["shards"]) + 1
             self._ec_gather(reqid, state)
             return
+        if state["kind"] == "rmw":
+            # the rmw state stays registered in _ec_reads until the
+            # pipeline drain completes: a write arriving in this window
+            # must find it live and join its queue, not mistake the gate
+            # for a torn-down gather and usurp it (_ec_rmw_ready pops)
+            self._ec_rmw_ready(state, data)
+            return
         with self._lock:
             self._ec_reads.pop(reqid, None)
         if state["kind"] == "client":
@@ -2864,9 +2989,6 @@ class OSDDaemon(Dispatcher):
             self._op_send_reply(msg, MOSDOpReply(
                 tid=msg.tid, result=0, epoch=self.osdmap.epoch,
                 ops=[OSDOpField(OP_READ, off, len(data), data)]))
-            return
-        if state["kind"] == "rmw":
-            self._ec_rmw_ready(state, data)
             return
         self._ec_recover_done(state, data)
 

@@ -228,9 +228,12 @@ class PG:
         #: (lets the tick re-issue pulls that were lost in flight)
         self.recovering: dict[str, float] = {}
         #: objects with an EC read-modify-write in flight, oid -> the
-        #: owning gather id (ECBackend's rmw pipeline serializes per
-        #: object; ownership keeps an orphaned pre-peering gather from
-        #: releasing or bypassing a newer gather's gate)
+        #: owning gather id.  Ownership keeps an orphaned pre-peering
+        #: gather from releasing or bypassing a newer gather's gate.
+        #: Later writes to a gated object do NOT serialize on it: they
+        #: join the gather state's "queue" and overlay in order onto its
+        #: projected content (the ExtentCache pipeline reduced,
+        #: src/osd/ExtentCache.h:1-491)
         self.rmw: dict[str, tuple] = {}
         #: when the current peering round started (tick watchdog)
         self.peering_started = 0.0
