@@ -374,6 +374,11 @@ class RadosClient(Dispatcher):
         """Submit without blocking (librados aio_*): returns a completion
         the caller waits on.  In-flight completions resend on map change
         like synchronous ops."""
+        if "\x1d" in oid:
+            # the GROUP SEPARATOR is reserved for the OSD's internal
+            # snap-clone store names (osd.daemon.CLONE_SEP); allowing it
+            # through would let a client oid impersonate a clone
+            raise ValueError("object names may not contain \\x1d")
         is_write = any(op.op in (OP_WRITE, OP_WRITEFULL, OP_DELETE,
                                  OP_OMAP_SET, OP_OMAP_RMKEYS)
                        for op in ops)

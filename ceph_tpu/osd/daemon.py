@@ -168,6 +168,15 @@ class _InFlight:
 
 #: client_id used by internal EC recovery reads (cannot collide with real
 #: clients, whose ids are small monotonically assigned ints)
+#: store-name suffix for snapshot clones: head + CLONE_SEP + snap_seq.
+#: The GROUP SEPARATOR control char keeps internal clone names out of
+#: the client oid namespace — a client oid may contain "@" freely (rgw
+#: versioned data objects do), but control characters are rejected at
+#: the librados layer, so the suffix can never be ambiguous.  (The
+#: reference separates oid and snap structurally in hobject_t,
+#: src/common/hobject.h; this is the flattened-string equivalent.)
+CLONE_SEP = "\x1d@"
+
 RECOVERY_CLIENT = 0xFFFFFFFF00000000
 
 #: reqid client for the tier agent's guarded evict deletes
@@ -765,10 +774,12 @@ class OSDDaemon(Dispatcher):
 
     @staticmethod
     def _base_oid(oid: str, ec: bool) -> str:
-        """Logical object name of a store object: strips the "@snapseq"
-        clone suffix and, on EC pools, the ":shard" suffix — the name the
-        client hashed to place the object."""
-        base = oid.split("@", 1)[0]
+        """Logical object name of a store object: strips the CLONE_SEP
+        snap-clone suffix and, on EC pools, the ":shard" suffix — the
+        name the client hashed to place the object.  The shard strip is
+        safe for client names containing ":" because the OSD appends
+        exactly one suffix and rpartition takes the rightmost."""
+        base = oid.split(CLONE_SEP, 1)[0]
         if ec and ":" in base:
             head, _, tail = base.rpartition(":")
             if tail.isdigit():
@@ -1891,7 +1902,8 @@ class OSDDaemon(Dispatcher):
             cid = self._pg_cid(pgid)
             try:
                 oids = [o for o in self.store.list_objects(cid)
-                        if not o.startswith(PG.PGMETA) and "@" not in o]
+                        if not o.startswith(PG.PGMETA)
+                        and CLONE_SEP not in o]
             except KeyError:
                 continue
             n_queued = 0
@@ -2250,13 +2262,13 @@ class OSDDaemon(Dispatcher):
         self.perf.inc("op_w")
         t0 = time.time()
         # snapshot COW (PrimaryLogPG make_writeable): first write after
-        # a pool snap clones the pre-write object to "oid@snap_seq";
+        # a pool snap clones the pre-write object to oid+CLONE_SEP+seq;
         # the clone's covered snap interval is (from_seq, snap_seq]
         if pool.snap_seq:
             obj_sc = int(self._getattr_safe(cid, msg.oid, "snapc")
                          or b"0")
             if obj_sc < pool.snap_seq and self.store.exists(cid, msg.oid):
-                clone = f"{msg.oid}@{pool.snap_seq}"
+                clone = f"{msg.oid}{CLONE_SEP}{pool.snap_seq}"
                 pre = Transaction()
                 pre.clone(cid, msg.oid, clone)
                 pre.setattr(cid, clone, "from_seq", str(obj_sc).encode())
@@ -3072,9 +3084,9 @@ class OSDDaemon(Dispatcher):
             return oid
         clones = []
         for o in self.store.list_objects(cid):
-            if o.startswith(oid + "@"):
+            if o.startswith(oid + CLONE_SEP):
                 try:
-                    clones.append((int(o.rsplit("@", 1)[1]), o))
+                    clones.append((int(o.rsplit(CLONE_SEP, 1)[1]), o))
                 except ValueError:
                     continue
         for seq, name in sorted(clones):

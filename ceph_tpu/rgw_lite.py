@@ -4,7 +4,10 @@ gateway objects stripe over RADOS objects, metadata rides omap — the
 same rgw_rados.cc layout idea without the HTTP frontends).
 
 Surface: create/delete bucket, put/get/delete/list/head object, with
-optional transparent compression via the compressor registry.
+optional transparent compression via the compressor registry; S3 object
+versioning (rgw_rados versioned-object semantics: per-version omap
+entries + a current pointer, delete markers, null versions while
+suspended — src/rgw/rgw_rados.cc RGWRados::Object versioning paths).
 """
 
 from __future__ import annotations
@@ -32,12 +35,34 @@ class Bucket:
 
     # -- bucket lifecycle -----------------------------------------------------
 
-    def create(self) -> "Bucket":
+    def create(self, owner: str = "") -> "Bucket":
         self.io.set_omap(self.INDEX_FMT.format(name=self.name),
                          {".bucket.meta": json.dumps(
                              {"created": time.time(),
+                              "owner": owner,
                               "compression": self.compression}).encode()})
         return self
+
+    def get_meta(self, key: str, default=None):
+        """One field of the bucket metadata record."""
+        try:
+            omap = self.io.get_omap(self.INDEX_FMT.format(name=self.name))
+        except OSError:
+            return default
+        blob = omap.get(".bucket.meta")
+        if not blob:
+            return default
+        return json.loads(blob.decode()).get(key, default)
+
+    def set_meta(self, key: str, value) -> None:
+        omap = self.io.get_omap(self.INDEX_FMT.format(name=self.name))
+        meta = json.loads(omap[".bucket.meta"].decode())
+        if value is None:
+            meta.pop(key, None)
+        else:
+            meta[key] = value
+        self.io.set_omap(self.INDEX_FMT.format(name=self.name),
+                         {".bucket.meta": json.dumps(meta).encode()})
 
     def exists(self) -> bool:
         try:
@@ -47,52 +72,224 @@ class Bucket:
             return False
 
     def delete(self) -> None:
-        if self.list():
+        if self.list() or any(True for _ in self.list_versions()):
             raise OSError(39, "bucket not empty")   # ENOTEMPTY
         self.io.remove(self.INDEX_FMT.format(name=self.name))
 
+    # -- versioning state -----------------------------------------------------
+
+    #: "" (never enabled) | "Enabled" | "Suspended" — S3's three states
+    def versioning(self) -> str:
+        return self.get_meta("versioning", "") or ""
+
+    def set_versioning(self, status: str) -> None:
+        self.set_meta("versioning", status)
+
     # -- objects --------------------------------------------------------------
 
-    def _data_name(self, key: str) -> str:
-        return f".bucket.data.{self.name}.{key}"
+    VSEP = "\x00"   # key/version separator in omap index keys
+    DSEP = "\x1e"   # key/version separator in data object names: a
+    #                 client key may contain "@" freely; RECORD SEPARATOR
+    #                 cannot appear in keys (rejected at the gateway)
 
-    def put(self, key: str, data: bytes,
-            metadata: dict | None = None) -> None:
+    def _data_name(self, key: str, vid: str | None = None) -> str:
+        base = f".bucket.data.{self.name}.{key}"
+        return base if not vid else f"{base}{self.DSEP}{vid}"
+
+    def _data_so(self, key: str, entry: dict) -> StripedObject:
+        """The striped data object an index entry points at.  data_vid
+        tracks where the BYTES live: a pre-versioning object promoted to
+        the null version keeps its bytes at the base name (data_vid
+        None) even though its version_id is "null"."""
+        vid = entry.get("data_vid", entry.get("version_id"))
+        return StripedObject(self.io, self._data_name(key, vid), _LAYOUT)
+
+    def _vkey(self, key: str, vid: str) -> str:
+        return f"ver.{key}{self.VSEP}{vid}"
+
+    def _index(self) -> dict:
+        return self.io.get_omap(self.INDEX_FMT.format(name=self.name))
+
+    def _preserve_preversioning(self, key: str, updates: dict) -> None:
+        """S3 keeps an object written BEFORE versioning was ever enabled
+        as the addressable null version: promote it into the version
+        index on the first versioned op touching its key."""
+        cur = self.current_entry(key)
+        if cur is not None and "version_id" not in cur:
+            cur["version_id"] = "null"
+            cur["data_vid"] = None      # bytes stay at the base name
+            updates[self._vkey(key, "null")] = json.dumps(cur).encode()
+
+    def _drop_null_version(self, key: str, updates: dict) -> None:
+        """Replacing THE null version (suspended put / null marker):
+        its data — wherever it lives — goes away.  A pre-versioning
+        object that was never promoted into the version index IS the
+        null version; its base-name data goes too."""
+        old = self._index().get(self._vkey(key, "null"))
+        if old:
+            e = json.loads(old.decode())
+            if not e.get("delete_marker"):
+                self._data_so(key, e).remove()
+            return
+        cur = self.current_entry(key)
+        if cur is not None and "version_id" not in cur:
+            StripedObject(self.io, self._data_name(key), _LAYOUT).remove()
+
+    def put(self, key: str, data: bytes, metadata: dict | None = None,
+            clock=time.time, unversioned: bool = False) -> dict:
+        """Write an object; under versioning each put lands as a NEW
+        version (a unique id, Enabled) or as THE null version
+        (Suspended).  unversioned=True forces the classic single-slot
+        path (internal staging like multipart parts must never grow
+        version chains).  Returns the index entry written."""
+        status = "" if unversioned else self.versioning()
+        vid = None
+        updates: dict = {}
+        if status == "Enabled":
+            vid = f"{time.time_ns():020d}"
+            self._preserve_preversioning(key, updates)
+        elif status == "Suspended":
+            vid = "null"
+            self._drop_null_version(key, updates)
         blob = self.comp.compress(data)
-        so = StripedObject(self.io, self._data_name(key), _LAYOUT)
-        so.remove()
+        so = StripedObject(self.io, self._data_name(key, vid), _LAYOUT)
+        so.remove()   # null-version rewrite (or unversioned overwrite)
         so.write(blob)
         entry = {"size": len(data), "stored": len(blob),
-                 "mtime": time.time(), "meta": metadata or {},
+                 "mtime": clock(), "meta": metadata or {},
                  "compression": self.comp.name}
-        self.io.set_omap(self.INDEX_FMT.format(name=self.name),
-                         {f"obj.{key}": json.dumps(entry).encode()})
+        if vid is not None:
+            entry["version_id"] = vid
+            updates[self._vkey(key, vid)] = json.dumps(entry).encode()
+        updates[f"obj.{key}"] = json.dumps(entry).encode()
+        self.io.set_omap(self.INDEX_FMT.format(name=self.name), updates)
+        return entry
 
-    def head(self, key: str) -> dict:
-        omap = self.io.get_omap(self.INDEX_FMT.format(name=self.name))
-        blob = omap.get(f"obj.{key}")
-        if not blob:          # absent, or the b"" deletion tombstone
-            raise KeyError(key)
+    def current_entry(self, key: str) -> dict | None:
+        """The current index entry — may be a delete marker — or None."""
+        blob = self._index().get(f"obj.{key}")
+        if not blob:
+            return None
         return json.loads(blob.decode())
 
-    def get(self, key: str) -> bytes:
-        entry = self.head(key)
-        so = StripedObject(self.io, self._data_name(key), _LAYOUT)
-        raw = so.read(0, entry["stored"])
+    def head(self, key: str, vid: str | None = None) -> dict:
+        if vid is None:
+            entry = self.current_entry(key)
+        else:
+            blob = self._index().get(self._vkey(key, vid))
+            entry = json.loads(blob.decode()) if blob else None
+        if entry is None or entry.get("delete_marker"):
+            raise KeyError(key)
+        return entry
+
+    def get(self, key: str, vid: str | None = None) -> bytes:
+        entry = self.head(key, vid)
+        raw = self._data_so(key, entry).read(0, entry["stored"])
         comp = _compressor.create(entry.get("compression", "none"))
         return comp.decompress(raw[:entry["stored"]])
 
-    def delete_object(self, key: str) -> None:
+    def delete_object(self, key: str, vid: str | None = None,
+                      clock=time.time, unversioned: bool = False) -> dict:
+        """S3 delete semantics.  Unversioned: drop data, tombstone the
+        index entry.  Versioned without a version id: lay down a delete
+        marker (data untouched).  With a version id: permanently remove
+        exactly that version and recompute the current pointer.
+        unversioned=True hard-deletes regardless of bucket state (for
+        internal staging objects).  Returns {"delete_marker": bool,
+        "version_id": str|None}."""
+        status = "" if unversioned else self.versioning()
+        index_oid = self.INDEX_FMT.format(name=self.name)
+        if vid is not None:
+            blob = self._index().get(self._vkey(key, vid))
+            if not blob:
+                return {"delete_marker": False, "version_id": vid}
+            entry = json.loads(blob.decode())
+            if not entry.get("delete_marker"):
+                self._data_so(key, entry).remove()
+            self.io.rm_omap_keys(index_oid, [self._vkey(key, vid)])
+            cur = self.current_entry(key)
+            if cur is not None and cur.get("version_id") == vid:
+                self._repoint_current(key)
+            return {"delete_marker": bool(entry.get("delete_marker")),
+                    "version_id": vid}
+        if status in ("Enabled", "Suspended"):
+            updates: dict = {}
+            if status == "Enabled":
+                mvid = f"{time.time_ns():020d}"
+                # a marker over a pre-versioning object preserves it as
+                # the addressable null version (S3 semantics)
+                self._preserve_preversioning(key, updates)
+            else:
+                mvid = "null"
+                # a null delete marker REPLACES the null version
+                self._drop_null_version(key, updates)
+            marker = {"delete_marker": True, "version_id": mvid,
+                      "mtime": clock(), "size": 0, "meta": {}}
+            updates[self._vkey(key, mvid)] = json.dumps(marker).encode()
+            updates[f"obj.{key}"] = json.dumps(marker).encode()
+            self.io.set_omap(index_oid, updates)
+            return {"delete_marker": True, "version_id": mvid}
         self.head(key)   # KeyError if absent
         StripedObject(self.io, self._data_name(key), _LAYOUT).remove()
-        # omap_rm via set of tombstone: the client API lacks rmkeys;
-        # store an explicit deletion marker and filter it in list()
-        self.io.set_omap(self.INDEX_FMT.format(name=self.name),
-                         {f"obj.{key}": b""})
+        # tombstone (b"") rather than key removal: a reader paging the
+        # index mid-delete sees a consistent "absent" value
+        self.io.set_omap(index_oid, {f"obj.{key}": b""})
+        return {"delete_marker": False, "version_id": None}
+
+    def _repoint_current(self, key: str) -> None:
+        """The current version was permanently removed: newest surviving
+        version (by id; marker or not) becomes current, else tombstone."""
+        vers = self.versions_of(key)
+        index_oid = self.INDEX_FMT.format(name=self.name)
+        if vers:
+            newest = vers[0]
+            self.io.set_omap(index_oid, {
+                f"obj.{key}": json.dumps(newest).encode()})
+        else:
+            self.io.set_omap(index_oid, {f"obj.{key}": b""})
+
+    def versions_of(self, key: str) -> list[dict]:
+        """All surviving versions of one key, newest first ("null" sorts
+        by its mtime against the timestamp ids)."""
+        prefix = f"ver.{key}{self.VSEP}"
+        out = []
+        for k, v in self._index().items():
+            if k.startswith(prefix) and v:
+                out.append(json.loads(v.decode()))
+        out.sort(key=lambda e: (e.get("mtime", 0),
+                                e.get("version_id", "")), reverse=True)
+        return out
+
+    def list_versions(self, prefix: str = ""):
+        """Iterate (key, entry, is_latest) over every surviving version,
+        keys ascending, versions newest-first within a key."""
+        try:
+            omap = self._index()
+        except OSError:
+            return
+        by_key: dict[str, list[dict]] = {}
+        for k, v in omap.items():
+            if not k.startswith("ver.") or not v:
+                continue
+            key = k[4:].split(self.VSEP, 1)[0]
+            if key.startswith(prefix):
+                by_key.setdefault(key, []).append(json.loads(v.decode()))
+        for key in sorted(by_key):
+            vers = sorted(by_key[key],
+                          key=lambda e: (e.get("mtime", 0),
+                                         e.get("version_id", "")),
+                          reverse=True)
+            # current pointer from the SAME omap snapshot (one fetch
+            # for the whole listing, not one per key)
+            cur_blob = omap.get(f"obj.{key}")
+            cur = json.loads(cur_blob.decode()) if cur_blob else None
+            cur_vid = cur.get("version_id") if cur else None
+            for e in vers:
+                yield key, e, e.get("version_id") == cur_vid
 
     def list(self, prefix: str = "") -> list[str]:
         try:
-            omap = self.io.get_omap(self.INDEX_FMT.format(name=self.name))
+            omap = self._index()
         except OSError:
             return []
         out = []
@@ -100,6 +297,7 @@ class Bucket:
             if not k.startswith("obj.") or not v:
                 continue
             key = k[4:]
-            if key.startswith(prefix):
+            if key.startswith(prefix) \
+                    and not json.loads(v.decode()).get("delete_marker"):
                 out.append(key)
         return sorted(out)

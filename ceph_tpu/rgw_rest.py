@@ -127,7 +127,8 @@ _ERR_STATUS = {"NoSuchBucket": 404, "NoSuchKey": 404, "NoSuchUpload": 404,
                "BucketNotEmpty": 409, "BucketAlreadyExists": 409,
                "SignatureDoesNotMatch": 403, "AccessDenied": 403,
                "InvalidPart": 400, "MalformedXML": 400,
-               "InvalidArgument": 400, "RequestTimeTooSkewed": 403}
+               "InvalidArgument": 400, "RequestTimeTooSkewed": 403,
+               "NoSuchLifecycleConfiguration": 404}
 
 
 class S3Error(Exception):
@@ -145,11 +146,22 @@ class S3Gateway:
     """The op layer: S3 verbs -> rgw_lite buckets over one ioctx."""
 
     MP_PREFIX = ".mp"
+    #: all bucket names live in one registry omap (the rgw metadata-pool
+    #: bucket listing, rgw_metadata.cc reduced) so service-level ops and
+    #: the lifecycle agent can enumerate buckets
+    REGISTRY = ".buckets.registry"
 
-    def __init__(self, ioctx, compression: str = "none"):
+    def __init__(self, ioctx, compression: str = "none", clock=time.time):
         self.io = ioctx
         self.compression = compression
+        self.clock = clock
         self._lock = threading.Lock()
+
+    @staticmethod
+    def _check_name(s: str, what: str) -> None:
+        if any(ord(c) < 0x20 for c in s):
+            raise S3Error("InvalidArgument",
+                          f"control character in {what}")
 
     def _bucket(self, name: str, must_exist: bool = True) -> Bucket:
         b = Bucket(self.io, name, compression=self.compression)
@@ -159,11 +171,81 @@ class S3Gateway:
 
     # -- buckets -------------------------------------------------------------
 
-    def create_bucket(self, name: str) -> None:
+    def create_bucket(self, name: str, owner: str = "",
+                      acl: str = "private") -> None:
+        self._check_name(name, "bucket name")
         b = Bucket(self.io, name, compression=self.compression)
         if b.exists():
             raise S3Error("BucketAlreadyExists", name)
-        b.create()
+        b.create(owner=owner)
+        if acl != "private":
+            b.set_meta("acl", acl)
+        self.io.set_omap(self.REGISTRY, {name: b"1"})
+
+    # -- versioning / lifecycle / acl ----------------------------------------
+
+    def get_versioning(self, name: str) -> str:
+        return self._bucket(name).versioning()
+
+    def set_versioning(self, name: str, status: str) -> None:
+        if status not in ("Enabled", "Suspended"):
+            raise S3Error("IllegalVersioningConfigurationException", status)
+        self._bucket(name).set_versioning(status)
+
+    def get_lifecycle(self, name: str) -> list[dict]:
+        lc = self._bucket(name).get_meta("lifecycle")
+        if not lc:
+            raise S3Error("NoSuchLifecycleConfiguration", name)
+        return lc
+
+    def set_lifecycle(self, name: str, rules: list[dict]) -> None:
+        for r in rules:
+            if not (r.get("expiration_days") or
+                    r.get("noncurrent_days")):
+                raise S3Error("MalformedXML", "rule without an action")
+        self._bucket(name).set_meta("lifecycle", rules)
+
+    def delete_lifecycle(self, name: str) -> None:
+        self._bucket(name).set_meta("lifecycle", None)
+
+    def get_acl(self, name: str) -> tuple[str, str]:
+        b = self._bucket(name)
+        return (b.get_meta("acl", "private") or "private",
+                b.get_meta("owner", "") or "")
+
+    def set_acl(self, name: str, acl: str) -> None:
+        if acl not in ("private", "public-read", "public-read-write",
+                       "authenticated-read"):
+            raise S3Error("InvalidArgument", f"unsupported canned acl {acl}")
+        self._bucket(name).set_meta("acl", acl)
+
+    def authorize(self, name: str, principal: str | None,
+                  write: bool) -> None:
+        """Canned-ACL evaluation (rgw_acl.cc verify_permission reduced):
+        owner always passes; other AUTHENTICATED principals read under
+        authenticated-read/public-read; anonymous reads need public-read;
+        non-owner writes need public-read-write."""
+        b = self._bucket(name)
+        acl = b.get_meta("acl", "private") or "private"
+        owner = b.get_meta("owner", "") or ""
+        if principal is not None and (not owner or principal == owner):
+            return
+        if acl == "public-read-write":
+            return
+        if write:
+            raise S3Error("AccessDenied", "write requires ownership")
+        if acl == "public-read":
+            return
+        if acl == "authenticated-read" and principal is not None:
+            return
+        raise S3Error("AccessDenied", name)
+
+    def authorize_owner(self, name: str, principal: str | None) -> None:
+        """Bucket-configuration ops (versioning/lifecycle/acl/delete):
+        owner only — canned ACLs never delegate these."""
+        owner = self._bucket(name).get_meta("owner", "") or ""
+        if principal is None or (owner and principal != owner):
+            raise S3Error("AccessDenied", "bucket owner only")
 
     def delete_bucket(self, name: str) -> None:
         b = self._bucket(name)
@@ -171,6 +253,10 @@ class S3Gateway:
             b.delete()
         except OSError:
             raise S3Error("BucketNotEmpty", name)
+        try:
+            self.io.rm_omap_keys(self.REGISTRY, [name])
+        except OSError:
+            pass
 
     def list_objects(self, name: str, prefix: str, max_keys: int,
                      token: str) -> tuple[list[tuple[str, dict]], str]:
@@ -193,34 +279,133 @@ class S3Gateway:
     # -- objects -------------------------------------------------------------
 
     def put_object(self, bucket: str, key: str, data: bytes,
-                   metadata: dict) -> str:
+                   metadata: dict) -> tuple[str, str | None]:
+        """Returns (etag, version_id-or-None)."""
+        self._check_name(key, "object key")
         if key.startswith(self.MP_PREFIX + "."):
             raise S3Error("InvalidArgument",
                           f"key prefix {self.MP_PREFIX!r}. is reserved "
                           "for multipart staging")
         b = self._bucket(bucket)
-        b.put(key, data, metadata=metadata)
-        return hashlib.md5(data).hexdigest()
+        entry = b.put(key, data, metadata=metadata, clock=self.clock)
+        return hashlib.md5(data).hexdigest(), entry.get("version_id")
 
-    def get_object(self, bucket: str, key: str) -> tuple[bytes, dict]:
+    def get_object(self, bucket: str, key: str,
+                   vid: str | None = None) -> tuple[bytes, dict]:
         b = self._bucket(bucket)
         try:
-            head = b.head(key)
-            return b.get(key), head
+            head = b.head(key, vid)
+            return b.get(key, vid), head
         except KeyError:
             raise S3Error("NoSuchKey", key)
 
-    def head_object(self, bucket: str, key: str) -> dict:
+    def head_object(self, bucket: str, key: str,
+                    vid: str | None = None) -> dict:
         try:
-            return self._bucket(bucket).head(key)
+            return self._bucket(bucket).head(key, vid)
         except KeyError:
             raise S3Error("NoSuchKey", key)
 
-    def delete_object(self, bucket: str, key: str) -> None:
+    def delete_object(self, bucket: str, key: str,
+                      vid: str | None = None) -> dict:
         try:
-            self._bucket(bucket).delete_object(key)
+            return self._bucket(bucket).delete_object(
+                key, vid, clock=self.clock)
         except KeyError:
-            pass   # S3 DELETE is idempotent
+            # S3 DELETE is idempotent
+            return {"delete_marker": False, "version_id": None}
+
+    def list_versions(self, name: str, prefix: str, max_keys: int,
+                      key_marker: str = "",
+                      vid_marker: str = "") -> tuple[list, bool]:
+        """ListObjectVersions: ([(key, entry, is_latest)], truncated).
+        Rows order (key asc, version newest-first); resume after the
+        (key-marker, version-id-marker) pair like S3."""
+        b = self._bucket(name)
+        rows = [r for r in b.list_versions(prefix=prefix)
+                if not r[0].startswith(self.MP_PREFIX + ".")]
+        if key_marker:
+            # resume POSITIONALLY after the marker row: versions order
+            # within a key is by mtime, so a lexicographic version-id
+            # comparison would skip "null" ids across page boundaries
+            idx = next((i for i, (k, e, _l) in enumerate(rows)
+                        if k == key_marker
+                        and e.get("version_id", "") == vid_marker), None)
+            if idx is not None:
+                rows = rows[idx + 1:]
+            else:
+                rows = [r for r in rows if r[0] > key_marker]
+        return rows[:max_keys], len(rows) > max_keys
+
+    # -- lifecycle agent (rgw_lc.cc RGWLC::process reduced) -------------------
+
+    def lifecycle_pass(self, bucket_names: list[str] | None = None) -> dict:
+        """One expiration sweep over buckets carrying lifecycle config.
+        Current objects past expiration_days expire the S3 way (delete
+        marker under versioning, hard delete otherwise); noncurrent
+        versions past noncurrent_days are permanently removed.  Returns
+        counters for observability/tests."""
+        stats = {"expired": 0, "noncurrent_removed": 0, "buckets": 0}
+        names = (bucket_names if bucket_names is not None
+                 else self._buckets_with_lc())
+        now = self.clock()
+        for name in names:
+            try:
+                b = self._bucket(name)
+            except S3Error:
+                continue
+            rules = b.get_meta("lifecycle") or []
+            if not rules:
+                continue
+            stats["buckets"] += 1
+            with self._lock:
+                for rule in rules:
+                    if rule.get("status", "Enabled") != "Enabled":
+                        continue
+                    self._apply_lc_rule(b, rule, now, stats)
+        return stats
+
+    def _buckets_with_lc(self) -> list[str]:
+        try:
+            return sorted(self.io.get_omap(self.REGISTRY))
+        except OSError:
+            return []
+
+    def _apply_lc_rule(self, b: Bucket, rule: dict, now: float,
+                       stats: dict) -> None:
+        prefix = rule.get("prefix", "")
+        exp_days = rule.get("expiration_days")
+        nc_days = rule.get("noncurrent_days")
+        day = 86400.0
+        if exp_days:
+            for key in b.list(prefix=prefix):
+                if key.startswith(self.MP_PREFIX + "."):
+                    continue
+                try:
+                    entry = b.head(key)
+                except KeyError:
+                    continue
+                if now - entry.get("mtime", now) >= exp_days * day:
+                    b.delete_object(key, clock=self.clock)
+                    stats["expired"] += 1
+        if nc_days:
+            # NoncurrentDays counts from the moment a version BECAME
+            # noncurrent — the write time of its successor — not from
+            # its own mtime (S3 semantics, rgw_lc.cc pass through
+            # next_mtime)
+            by_key: dict[str, list[dict]] = {}
+            for key, entry, _latest in b.list_versions(prefix=prefix):
+                if not key.startswith(self.MP_PREFIX + "."):
+                    by_key.setdefault(key, []).append(entry)
+            for key, rows in by_key.items():     # rows newest-first
+                succ_mtime = None
+                for entry in rows:
+                    if succ_mtime is not None \
+                            and now - succ_mtime >= nc_days * day:
+                        b.delete_object(key, entry.get("version_id"),
+                                        clock=self.clock)
+                        stats["noncurrent_removed"] += 1
+                    succ_mtime = entry.get("mtime", now)
 
     # -- multipart -----------------------------------------------------------
 
@@ -230,12 +415,14 @@ class S3Gateway:
 
     def initiate_multipart(self, bucket: str, key: str,
                            metadata: dict) -> str:
+        self._check_name(key, "object key")
         with self._lock:
             b = self._bucket(bucket)
             upload_id = hashlib.sha1(
                 f"{bucket}/{key}/{time.time_ns()}".encode()).hexdigest()[:16]
             b.put(self._mp_key(upload_id), json.dumps(
-                {"key": key, "meta": metadata}).encode())
+                {"key": key, "meta": metadata}).encode(),
+                  unversioned=True)
             return upload_id
 
     def _mp_manifest(self, b: Bucket, upload_id: str) -> dict:
@@ -248,7 +435,7 @@ class S3Gateway:
                     part: int, data: bytes) -> str:
         b = self._bucket(bucket)
         self._mp_manifest(b, upload_id)
-        b.put(self._mp_key(upload_id, part), data)
+        b.put(self._mp_key(upload_id, part), data, unversioned=True)
         return hashlib.md5(data).hexdigest()
 
     def complete_multipart(self, bucket: str, key: str, upload_id: str,
@@ -272,7 +459,8 @@ class S3Gateway:
                 raise S3Error("InvalidPart", f"part {num} etag mismatch")
             chunks.append(data)
         whole = b"".join(chunks)
-        b.put(key, whole, metadata=manifest.get("meta") or {})
+        b.put(key, whole, metadata=manifest.get("meta") or {},
+              clock=self.clock)
         self._abort_locked(b, upload_id)
         return hashlib.md5(whole).hexdigest()
 
@@ -284,7 +472,7 @@ class S3Gateway:
     def _abort_locked(self, b: Bucket, upload_id: str) -> None:
         for k in b.list(prefix=f"{self.MP_PREFIX}.{upload_id}"):
             try:
-                b.delete_object(k)
+                b.delete_object(k, unversioned=True)
             except KeyError:
                 pass
 
@@ -298,12 +486,18 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- auth ----------------------------------------------------------------
 
-    def _authenticate(self, body: bytes) -> None:
+    def _authenticate(self, body: bytes) -> str | None:
+        """Verify SigV4 and return the principal (access key id), or
+        None for an anonymous request — per-bucket ACLs decide what an
+        anonymous principal may do (rgw allows unsigned requests through
+        to policy evaluation the same way)."""
         srv: "RgwRestServer" = self.server.rgw     # type: ignore
         auth = self.headers.get("Authorization", "")
+        if not auth:
+            return None
         m = _AUTH_RE.match(auth)
         if not m:
-            raise S3Error("AccessDenied", "missing or malformed auth")
+            raise S3Error("AccessDenied", "malformed auth")
         secret = srv.keys.get(m.group("access"))
         if secret is None:
             raise S3Error("AccessDenied", "unknown access key")
@@ -341,6 +535,7 @@ class _Handler(BaseHTTPRequestHandler):
         want_sig = _AUTH_RE.match(expect).group("sig")
         if not hmac.compare_digest(want_sig, m.group("sig")):
             raise S3Error("SignatureDoesNotMatch", "bad signature")
+        return m.group("access")
 
     # -- plumbing ------------------------------------------------------------
 
@@ -359,14 +554,14 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
         try:
-            self._authenticate(body)
+            principal = self._authenticate(body)
             parsed = urllib.parse.urlsplit(self.path)
             q = dict(urllib.parse.parse_qsl(parsed.query,
                                             keep_blank_values=True))
             parts = parsed.path.lstrip("/").split("/", 1)
             bucket = urllib.parse.unquote(parts[0])
             key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
-            self._route(gw, self.command, bucket, key, q, body)
+            self._route(gw, self.command, bucket, key, q, body, principal)
         except S3Error as e:
             self._respond(e.status, _error_xml(e.code, str(e)),
                           {"Content-Type": "application/xml"})
@@ -379,11 +574,17 @@ class _Handler(BaseHTTPRequestHandler):
     # -- routing -------------------------------------------------------------
 
     def _route(self, gw: S3Gateway, method: str, bucket: str, key: str,
-               q: dict, body: bytes) -> None:
+               q: dict, body: bytes, principal: str | None) -> None:
         if not bucket:
             raise S3Error("InvalidArgument", "service-level ops: none")
         if not key:
-            return self._route_bucket(gw, method, bucket, q)
+            return self._route_bucket(gw, method, bucket, q, body,
+                                      principal)
+        # canned-ACL gate: reads need read access, everything else write
+        if method in ("GET", "HEAD"):
+            gw.authorize(bucket, principal, write=False)
+        else:
+            gw.authorize(bucket, principal, write=True)
         if method == "POST" and "uploads" in q:
             meta = self._meta_headers()
             uid = gw.initiate_multipart(bucket, key, meta)
@@ -421,34 +622,113 @@ class _Handler(BaseHTTPRequestHandler):
         if method == "DELETE" and "uploadId" in q:
             gw.abort_multipart(bucket, key, q["uploadId"])
             return self._respond(204)
+        vid = q.get("versionId") or None
         if method == "PUT":
-            etag = gw.put_object(bucket, key, body, self._meta_headers())
-            return self._respond(200, b"", {"ETag": f'"{etag}"'})
+            etag, put_vid = gw.put_object(bucket, key, body,
+                                          self._meta_headers())
+            hdrs = {"ETag": f'"{etag}"'}
+            if put_vid:
+                hdrs["x-amz-version-id"] = put_vid
+            return self._respond(200, b"", hdrs)
         if method == "GET":
-            data, head = gw.get_object(bucket, key)
+            data, head = gw.get_object(bucket, key, vid)
             hdrs = {"Content-Type": "application/octet-stream",
                     "ETag": f'"{hashlib.md5(data).hexdigest()}"'}
+            if head.get("version_id"):
+                hdrs["x-amz-version-id"] = head["version_id"]
             for mk, mv in (head.get("meta") or {}).items():
                 hdrs[f"x-amz-meta-{mk}"] = mv
             return self._respond(200, data, hdrs)
         if method == "HEAD":
-            head = gw.head_object(bucket, key)
+            head = gw.head_object(bucket, key, vid)
             return self._respond(200, b"", {
                 "Content-Length-Hint": str(head["size"])})
         if method == "DELETE":
-            gw.delete_object(bucket, key)
-            return self._respond(204)
+            res = gw.delete_object(bucket, key, vid)
+            hdrs = {}
+            if res.get("delete_marker"):
+                hdrs["x-amz-delete-marker"] = "true"
+            if res.get("version_id"):
+                hdrs["x-amz-version-id"] = res["version_id"]
+            return self._respond(204, b"", hdrs)
         raise S3Error("InvalidArgument", f"unsupported {method}")
 
+    _LC_RULE_RE = re.compile(r"<Rule>(.*?)</Rule>", re.S)
+
     def _route_bucket(self, gw: S3Gateway, method: str, bucket: str,
-                      q: dict) -> None:
+                      q: dict, body: bytes,
+                      principal: str | None) -> None:
+        if "versioning" in q:
+            if method == "PUT":
+                gw.authorize_owner(bucket, principal)
+                m = re.search(r"<Status>\s*(\w+)\s*</Status>",
+                              body.decode(errors="replace"))
+                if not m:
+                    raise S3Error("MalformedXML", "no Status")
+                gw.set_versioning(bucket, m.group(1))
+                return self._respond(200)
+            if method == "GET":
+                gw.authorize(bucket, principal, write=False)
+                status = gw.get_versioning(bucket)
+                xml = ('<?xml version="1.0" encoding="UTF-8"?>'
+                       "<VersioningConfiguration>"
+                       + (_x("Status", status) if status else "")
+                       + "</VersioningConfiguration>").encode()
+                return self._respond(200, xml,
+                                     {"Content-Type": "application/xml"})
+            raise S3Error("InvalidArgument",
+                          f"unsupported {method} on ?versioning")
+        if "lifecycle" in q:
+            gw.authorize_owner(bucket, principal)
+            if method == "PUT":
+                gw.set_lifecycle(bucket, self._parse_lc(body))
+                return self._respond(200)
+            if method == "GET":
+                rules = gw.get_lifecycle(bucket)
+                return self._respond(200, self._lc_xml(rules),
+                                     {"Content-Type": "application/xml"})
+            if method == "DELETE":
+                gw.delete_lifecycle(bucket)
+                return self._respond(204)
+            raise S3Error("InvalidArgument",
+                          f"unsupported {method} on ?lifecycle")
+        if "acl" in q:
+            if method == "PUT":
+                gw.authorize_owner(bucket, principal)
+                canned = self.headers.get("x-amz-acl", "")
+                if not canned:
+                    raise S3Error("InvalidArgument",
+                                  "only canned x-amz-acl supported")
+                gw.set_acl(bucket, canned)
+                return self._respond(200)
+            if method == "GET":
+                gw.authorize_owner(bucket, principal)
+                acl, owner = gw.get_acl(bucket)
+                xml = ('<?xml version="1.0" encoding="UTF-8"?>'
+                       "<AccessControlPolicy>"
+                       + _x("Owner", _x("ID", _esc(owner)))
+                       + _x("CannedAcl", _esc(acl))
+                       + "</AccessControlPolicy>").encode()
+                return self._respond(200, xml,
+                                     {"Content-Type": "application/xml"})
+            raise S3Error("InvalidArgument",
+                          f"unsupported {method} on ?acl")
+        if method == "GET" and "versions" in q:
+            gw.authorize(bucket, principal, write=False)
+            return self._respond_versions(gw, bucket, q)
         if method == "PUT":
-            gw.create_bucket(bucket)
+            if principal is None:
+                raise S3Error("AccessDenied",
+                              "anonymous bucket creation")
+            gw.create_bucket(bucket, owner=principal,
+                             acl=self.headers.get("x-amz-acl", "private"))
             return self._respond(200)
         if method == "DELETE":
+            gw.authorize_owner(bucket, principal)
             gw.delete_bucket(bucket)
             return self._respond(204)
         if method == "GET":
+            gw.authorize(bucket, principal, write=False)
             max_keys = max(1, min(int(q.get("max-keys", 1000)), 1000))
             entries, next_token = gw.list_objects(
                 bucket, q.get("prefix", ""), max_keys,
@@ -477,6 +757,86 @@ class _Handler(BaseHTTPRequestHandler):
         return {k[len("x-amz-meta-"):]: v for k, v in self.headers.items()
                 if k.lower().startswith("x-amz-meta-")}
 
+    def _respond_versions(self, gw: S3Gateway, bucket: str,
+                          q: dict) -> None:
+        max_keys = max(1, min(int(q.get("max-keys", 1000)), 1000))
+        rows, truncated = gw.list_versions(
+            bucket, q.get("prefix", ""), max_keys,
+            q.get("key-marker", ""), q.get("version-id-marker", ""))
+        items = []
+        for key, e, latest in rows:
+            tag = "DeleteMarker" if e.get("delete_marker") else "Version"
+            items.append(
+                f"<{tag}>" + _x("Key", _esc(key))
+                + _x("VersionId", _esc(e.get("version_id", "null")))
+                + _x("IsLatest", "true" if latest else "false")
+                + _x("Size", str(e.get("size", 0)))
+                + _x("LastModified", datetime.datetime.fromtimestamp(
+                    e.get("mtime", 0), datetime.timezone.utc).strftime(
+                    "%Y-%m-%dT%H:%M:%SZ"))
+                + f"</{tag}>")
+        nxt = ""
+        if truncated and rows:
+            lk, le, _ = rows[-1]
+            nxt = (_x("NextKeyMarker", _esc(lk))
+                   + _x("NextVersionIdMarker",
+                        _esc(le.get("version_id", ""))))
+        xml = ('<?xml version="1.0" encoding="UTF-8"?>'
+               "<ListVersionsResult>"
+               + _x("Name", _esc(bucket))
+               + _x("IsTruncated", "true" if truncated else "false")
+               + nxt
+               + "".join(items) + "</ListVersionsResult>").encode()
+        self._respond(200, xml, {"Content-Type": "application/xml"})
+
+    def _parse_lc(self, body: bytes) -> list[dict]:
+        """Reduced lifecycle XML: Rule{ID, Prefix|Filter/Prefix, Status,
+        Expiration/Days, NoncurrentVersionExpiration/NoncurrentDays}."""
+        text = body.decode(errors="replace")
+        rules = []
+        for block in self._LC_RULE_RE.findall(text):
+            rule: dict = {}
+            m = re.search(r"<ID>\s*(.*?)\s*</ID>", block, re.S)
+            if m:
+                rule["id"] = m.group(1)
+            m = re.search(r"<Prefix>\s*(.*?)\s*</Prefix>", block, re.S)
+            rule["prefix"] = m.group(1) if m else ""
+            m = re.search(r"<Status>\s*(\w+)\s*</Status>", block)
+            rule["status"] = m.group(1) if m else "Enabled"
+            m = re.search(r"<Expiration>.*?<Days>\s*(\d+)\s*</Days>.*?"
+                          r"</Expiration>", block, re.S)
+            if m:
+                rule["expiration_days"] = int(m.group(1))
+            m = re.search(r"<NoncurrentVersionExpiration>.*?"
+                          r"<NoncurrentDays>\s*(\d+)\s*</NoncurrentDays>"
+                          r".*?</NoncurrentVersionExpiration>", block, re.S)
+            if m:
+                rule["noncurrent_days"] = int(m.group(1))
+            rules.append(rule)
+        if not rules:
+            raise S3Error("MalformedXML", "no lifecycle rules")
+        return rules
+
+    @staticmethod
+    def _lc_xml(rules: list[dict]) -> bytes:
+        blocks = []
+        for r in rules:
+            b = "<Rule>"
+            if r.get("id"):
+                b += _x("ID", _esc(r["id"]))
+            b += _x("Prefix", _esc(r.get("prefix", "")))
+            b += _x("Status", r.get("status", "Enabled"))
+            if r.get("expiration_days"):
+                b += _x("Expiration", _x("Days",
+                                         str(r["expiration_days"])))
+            if r.get("noncurrent_days"):
+                b += _x("NoncurrentVersionExpiration",
+                        _x("NoncurrentDays", str(r["noncurrent_days"])))
+            blocks.append(b + "</Rule>")
+        return ('<?xml version="1.0" encoding="UTF-8"?>'
+                "<LifecycleConfiguration>" + "".join(blocks)
+                + "</LifecycleConfiguration>").encode()
+
 
 class RgwRestServer:
     """The radosgw daemon shell: HTTP frontend + gateway + key table.
@@ -489,13 +849,21 @@ class RgwRestServer:
 
     def __init__(self, ioctx, addr: str = "127.0.0.1:0",
                  compression: str = "none",
-                 max_skew: float | None = 900.0, clock=time.time):
-        self.gateway = S3Gateway(ioctx, compression=compression)
+                 max_skew: float | None = 900.0, clock=time.time,
+                 lc_interval: float | None = None):
+        self.gateway = S3Gateway(ioctx, compression=compression,
+                                 clock=clock)
         self.keys: dict[str, str] = {}
         #: SigV4 freshness window in seconds (AWS: 15 min); None
         #: disables the check.  clock is injectable for tests.
         self.max_skew = max_skew
         self.clock = clock
+        #: lifecycle agent cadence (rgw_lc.cc lc_thread); None = manual
+        #: (call gateway.lifecycle_pass() — what the tests do with a
+        #: fake clock)
+        self.lc_interval = lc_interval
+        self._lc_stop = threading.Event()
+        self._lc_thread: threading.Thread | None = None
         host, port = addr.rsplit(":", 1)
         self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
         self._httpd.rgw = self          # type: ignore
@@ -523,9 +891,23 @@ class RgwRestServer:
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="rgw-http", daemon=True)
         self._thread.start()
+        if self.lc_interval:
+            self._lc_thread = threading.Thread(
+                target=self._lc_loop, name="rgw-lc", daemon=True)
+            self._lc_thread.start()
         return self
 
+    def _lc_loop(self) -> None:
+        while not self._lc_stop.wait(self.lc_interval):
+            try:
+                self.gateway.lifecycle_pass()
+            except Exception:   # agent must survive transient pool errors
+                pass
+
     def shutdown(self) -> None:
+        self._lc_stop.set()
+        if self._lc_thread is not None:
+            self._lc_thread.join(timeout=5)
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread:
