@@ -27,6 +27,41 @@ class StripeLayout:
             raise ValueError("object_size must be a multiple of "
                              "stripe_unit")
 
+    def num_objects(self, size: int) -> int:
+        """Backing objects covering a logical size (object-map width).
+        Within a partial object set the first ceil(rem/su) stripe units
+        land on min(sc, that) distinct objects."""
+        if size <= 0:
+            return 0
+        su, sc = self.stripe_unit, self.stripe_count
+        set_bytes = self.object_size * sc
+        full_sets, rem = divmod(size, set_bytes)
+        n = full_sets * sc
+        if rem:
+            blocks = -(-rem // su)
+            n += min(sc, blocks)
+        return n
+
+    def object_logical_extents(self, objno: int, size: int):
+        """[(logical_off, len)] of the bytes objno backs, clamped to the
+        image size — the inverse of extents() at stripe-unit granularity
+        (Striper::extent_to_file).  Adjacent units are coalesced."""
+        su, sc = self.stripe_unit, self.stripe_count
+        per_obj = self.object_size // su
+        objectsetno, stripepos = divmod(objno, sc)
+        out: list[tuple[int, int]] = []
+        for u in range(per_obj):
+            stripeno = objectsetno * per_obj + u
+            logical = (stripeno * sc + stripepos) * su
+            if logical >= size:
+                break
+            n = min(su, size - logical)
+            if out and out[-1][0] + out[-1][1] == logical:
+                out[-1] = (out[-1][0], out[-1][1] + n)
+            else:
+                out.append((logical, n))
+        return out
+
     def extents(self, offset: int, length: int):
         """[(objno, obj_off, len)] covering [offset, offset+length)
         (Striper::file_to_extents)."""

@@ -28,8 +28,11 @@ from ceph_tpu.osdc.striper import StripeLayout, StripedObject
 RBD_DIRECTORY = "rbd_directory"
 
 #: image feature bits (librbd feature flags; journaling gates the
-#: write-ahead event journal that rbd-mirror replays)
+#: write-ahead event journal that rbd-mirror replays; object-map keeps
+#: the per-object allocation bitmap, fast-diff derives diffs from it)
 FEATURE_JOURNALING = "journaling"
+FEATURE_OBJECT_MAP = "object-map"
+FEATURE_FAST_DIFF = "fast-diff"
 
 
 class Image:
@@ -67,6 +70,14 @@ class Image:
         ioctx.set_omap(RBD_DIRECTORY, {name: b"1"})
         img = cls(ioctx, name)
         img._meta = meta
+        if FEATURE_OBJECT_MAP in (features or []):
+            # feature present from birth (clone inheritance, mirror
+            # targets): the map object must exist even before the first
+            # write, or du/diff on the fresh image error out
+            from ceph_tpu.rbd_object_map import ObjectMap
+            om = ObjectMap(ioctx, name)
+            om.resize(img._striped().layout.num_objects(size))
+            om.save()
         return img
 
     def _load(self) -> dict:
@@ -95,6 +106,9 @@ class Image:
         feats = m.setdefault("features", [])
         if feature in feats:
             return
+        if feature == FEATURE_FAST_DIFF \
+                and FEATURE_OBJECT_MAP not in feats:
+            raise ValueError("fast-diff requires object-map")
         feats.append(feature)
         if feature == FEATURE_JOURNALING:
             j = self._journal()
@@ -103,12 +117,26 @@ class Image:
             except OSError:
                 j.create()
         self._save_meta(m)
+        if feature == FEATURE_OBJECT_MAP:
+            # build the map from reality on enable (ObjectMap<I>::open
+            # falls back to a rebuild when the map object is absent)
+            self.rebuild_object_map()
 
     def feature_disable(self, feature: str) -> None:
         m = self._load()
         if feature in m.get("features", []):
+            if feature == FEATURE_OBJECT_MAP \
+                    and FEATURE_FAST_DIFF in m["features"]:
+                raise ValueError("disable fast-diff first")
             m["features"].remove(feature)
             self._save_meta(m)
+            if feature == FEATURE_OBJECT_MAP:
+                from ceph_tpu.rbd_object_map import ObjectMap
+                ObjectMap(self.io, self.name).remove()
+                for ent in m.get("snaps", {}).values():
+                    ObjectMap(self.io, self.name,
+                              ent["snapid"]).remove()
+                self._om_invalidate()
 
     def _journal(self) -> Journaler:
         return Journaler(self.io, self.JOURNAL_FMT.format(name=self.name))
@@ -164,6 +192,52 @@ class Image:
                 "features": list(m.get("features", [])),
                 "primary": m.get("primary", True)}
 
+    def _om_invalidate(self) -> None:
+        self._om_cache = None
+
+    def _om_enabled(self) -> bool:
+        return FEATURE_OBJECT_MAP in self._load().get("features", [])
+
+    def _om_load(self, snapid: int = 0):
+        from ceph_tpu.rbd_object_map import ObjectMap
+        try:
+            return ObjectMap.load(self.io, self.name, snapid)
+        except (OSError, ValueError):
+            return None
+
+    def _om_mark_write(self, offset: int, length: int) -> None:
+        """Write-ahead map update: touched objects go EXISTS before any
+        data byte lands (ObjectMap::aio_update pre-write) — a crash
+        between map and data can only over-report.  A missing/corrupt
+        map is REBUILT from the backing objects first: silently starting
+        a fresh empty map here would under-report every earlier write
+        and turn a later clone/export-diff into data loss."""
+        if not self._om_enabled() or length <= 0:
+            return
+        from ceph_tpu.rbd_object_map import OBJECT_EXISTS
+        om = getattr(self, "_om_cache", None)
+        if om is None:
+            om = self._om_load()
+            if om is None:
+                self.rebuild_object_map()
+                om = self._om_load()
+                if om is None:
+                    return   # map stays absent; du/diff will error loudly
+        st = self._striped()
+        dirty = False
+        for objno, _off, _n in st.layout.extents(offset, length):
+            if om.get(objno) != OBJECT_EXISTS:
+                om.set(objno, OBJECT_EXISTS)
+                dirty = True
+        if dirty:
+            om.save()
+        # the exclusive-lock holder owns the map (librbd keeps it in
+        # memory under the lock); lockless handles reload per write
+        if getattr(self, "_owner", None) is not None:
+            self._om_cache = om
+        else:
+            self._om_cache = None
+
     def write(self, data: bytes, offset: int = 0) -> int:
         self._check_primary()   # refreshes the header cache too
         m = self._load()
@@ -172,6 +246,7 @@ class Image:
         self._check_lock()
         self._journal_event({"op": "write", "off": offset,
                              "data": binascii.hexlify(data).decode()})
+        self._om_mark_write(offset, len(data))
         self._striped().write(data, offset)
         return len(data)
 
@@ -187,6 +262,7 @@ class Image:
             if end > m["size"]:
                 m["size"] = end
                 self._save_meta(m)
+            self._om_mark_write(event["off"], len(data))
             self._striped().write(data, event["off"])
         elif op == "resize":
             m = self._load()
@@ -241,6 +317,7 @@ class Image:
                                     or getattr(self, "_owner",
                                                None)}).encode())
         self._owner = None
+        self._om_invalidate()
 
     def lock_info(self) -> dict:
         return json.loads(self.io.execute(self._header(), "lock", "info"))
@@ -307,6 +384,13 @@ class Image:
         m.setdefault("snaps", {})[snap] = {"snapid": snapid,
                                            "size": m["size"]}
         self._save_meta(m)
+        if self._om_enabled():
+            om = self._om_load()
+            if om is not None:
+                # freeze the map under the snap; head EXISTS demote to
+                # EXISTS_CLEAN so fast-diff can tell dirty from clean
+                om.snapshot_copy(snapid)
+                self._om_invalidate()
         return snapid
 
     def snap_list(self) -> dict:
@@ -326,8 +410,36 @@ class Image:
             "snap": f"rbd.{self.name}.{snap}"})
         if rc != 0:
             raise OSError(-rc or 5, out)
+        snapid = m["snaps"][snap]["snapid"]
         del m["snaps"][snap]
         self._save_meta(m)
+        from ceph_tpu.rbd_object_map import (
+            OBJECT_EXISTS, OBJECT_EXISTS_CLEAN, OBJECT_PENDING,
+            ObjectMap)
+        if self._om_enabled():
+            # the removed map's dirty bits record "changed since the
+            # PREVIOUS snap"; fold them into the next-younger map (or
+            # the head) so a later diff spanning this hole still sees
+            # the rewrite (the reference re-flags clean objects the
+            # same way when a snap in the middle goes away)
+            gone = self._om_load(snapid)
+            if gone is not None:
+                younger = [e["snapid"] for e in m["snaps"].values()
+                           if e["snapid"] > snapid]
+                heir = self._om_load(min(younger)) if younger \
+                    else self._om_load()
+                if heir is not None:
+                    dirty = False
+                    for objno in range(gone.n_objs):
+                        if gone.get(objno) in (OBJECT_EXISTS,
+                                               OBJECT_PENDING) \
+                                and heir.get(objno) \
+                                == OBJECT_EXISTS_CLEAN:
+                            heir.set(objno, OBJECT_EXISTS)
+                            dirty = True
+                    if dirty:
+                        heir.save()
+            ObjectMap(self.io, self.name, snapid).remove()
 
     def snap_rollback(self, snap: str) -> None:
         """Restore image content to the snapshot (rbd snap rollback —
@@ -350,6 +462,7 @@ class Image:
             raise KeyError(f"no snapshot {snap!r}")
         data = self.read(0, ent["size"], snap=snap)
         st = self._striped()
+        self._om_mark_write(0, max(ent["size"], m["size"]))
         st.truncate(0)
         st.write(data, 0)
         m["size"] = ent["size"]
@@ -357,14 +470,33 @@ class Image:
 
     def clone(self, dst_name: str, snap: str) -> "Image":
         """Copy a snapshot into a new image (clone + immediate flatten:
-        the lite model has no parent/child overlay chain)."""
+        the lite model has no parent/child overlay chain).  With an
+        object map on the source, only the snapshot's PRESENT extents
+        are read and copied — a lightly-written multi-GiB snapshot
+        clones in O(written), the deep-copy object-map fast path."""
         m = self._load()
         ent = m.get("snaps", {}).get(snap)
         if ent is None:
             raise KeyError(f"no snapshot {snap!r}")
+        inherit = [f for f in m.get("features", [])
+                   if f in (FEATURE_OBJECT_MAP, FEATURE_FAST_DIFF)]
         dst = Image.create(self.io, dst_name, size=ent["size"],
                            order=m["order"], stripe_unit=m["stripe_unit"],
-                           stripe_count=m["stripe_count"])
+                           stripe_count=m["stripe_count"],
+                           features=inherit)
+        extents = None
+        if self._om_enabled():
+            try:
+                extents = self.diff(to_snap=snap)
+            except (OSError, KeyError):
+                extents = None   # no/invalid snap map: full copy below
+        if extents is not None:
+            for off, ln, exists in extents:
+                if exists:
+                    data = self.read(off, ln, snap=snap)
+                    if data.rstrip(b"\x00"):
+                        dst.write(data, off)
+            return dst
         data = self.read(0, ent["size"], snap=snap)
         if data.rstrip(b"\x00"):
             dst.write(data, 0)
@@ -381,6 +513,148 @@ class Image:
             self._striped().truncate(new_size)
         m["size"] = new_size
         self._save_meta(m)
+        if self._om_enabled():
+            om = self._om_load()
+            if om is not None:
+                st = self._striped()
+                om.resize(st.layout.num_objects(new_size))
+                om.save()
+            self._om_invalidate()
+
+    # -- object map / fast-diff (src/librbd/object_map/) ----------------------
+
+    def rebuild_object_map(self) -> int:
+        """Reconstruct the allocation bitmap from the actual backing
+        objects (object_map::RebuildRequest — what `rbd object-map
+        rebuild` and scrub-on-corruption run).  Returns objects found."""
+        from ceph_tpu.rbd_object_map import OBJECT_EXISTS, ObjectMap
+        m = self._load()
+        st = self._striped()
+        om = ObjectMap(self.io, self.name)
+        om.resize(st.layout.num_objects(m["size"]))
+        found = 0
+        for objno in range(om.n_objs):
+            try:
+                self.io.stat(st.striper.object_name(st.name, objno))
+            except OSError:
+                continue
+            om.set(objno, OBJECT_EXISTS)
+            found += 1
+        om.flags = 0     # rebuilt: the map is trustworthy again
+        om.save()
+        self._om_invalidate()
+        return found
+
+    def _om_for(self, snap: str | None):
+        """(ObjectMap, size) as of a snapshot name or the head; raises
+        if the map is missing/corrupt (callers rebuild or fall back)."""
+        m = self._load()
+        if snap is None:
+            om = self._om_load()
+            size = m["size"]
+        else:
+            ent = m.get("snaps", {}).get(snap)
+            if ent is None:
+                raise KeyError(f"no snapshot {snap!r}")
+            om = self._om_load(ent["snapid"])
+            size = ent["size"]
+        if om is None:
+            raise OSError(5, "object map missing or corrupt "
+                             "(run rebuild_object_map)")
+        if om.flags & 1:
+            raise OSError(5, "object map flagged invalid")
+        return om, size
+
+    def diff(self, from_snap: str | None = None,
+             to_snap: str | None = None) -> list[tuple[int, int, bool]]:
+        """Fast-diff: [(offset, length, exists)] logical extents that
+        changed between from_snap (None = the beginning) and to_snap
+        (None = head), computed ENTIRELY from object maps — no data
+        object is read or stat'ed (DiffRequest semantics).  Walks every
+        snapshot map in (from, to]: each map's EXISTS bits are "dirty
+        since the previous snap", so intermediate rewrites are caught."""
+        from ceph_tpu.rbd_object_map import diff_objnos
+        m = self._load()
+        snaps = m.get("snaps", {})
+        from_id = snaps[from_snap]["snapid"] if from_snap else 0
+        to_id = (snaps[to_snap]["snapid"] if to_snap
+                 else float("inf"))
+        to_om, to_size = self._om_for(to_snap)
+        from_om = self._om_for(from_snap)[0] if from_snap else None
+        chain = []
+        if from_snap:
+            for _name, ent in sorted(snaps.items(),
+                                     key=lambda kv: kv[1]["snapid"]):
+                sid = ent["snapid"]
+                if from_id < sid and sid < to_id:
+                    om = self._om_load(sid)
+                    if om is not None:
+                        chain.append(om)
+        chain.append(to_om)
+        st = self._striped()
+        out: list[tuple[int, int, bool]] = []
+        for objno, exists in sorted(
+                diff_objnos(from_om, chain).items()):
+            for off, ln in st.layout.object_logical_extents(
+                    objno, to_size):
+                out.append((off, ln, exists))
+        out.sort()
+        return out
+
+    def du(self, snap: str | None = None) -> dict:
+        """Object-granular space usage from the map alone (`rbd du`
+        with fast-diff: no per-object stats)."""
+        om, size = self._om_for(snap)
+        obj_size = 1 << self._load()["order"]
+        present = om.count()
+        return {"size": size, "used_objects": present,
+                "provisioned_objects": om.n_objs,
+                "used_bytes": min(present * obj_size, size)}
+
+    def export_diff(self, from_snap: str | None = None,
+                    to_snap: str | None = None) -> bytes:
+        """Serialized changed-extent stream (`rbd export-diff`): header
+        json line + per-extent records, readable by import_diff on any
+        image.  Reads ONLY the changed extents' data."""
+        recs = []
+        m = self._load()
+        to_size = (m["size"] if to_snap is None
+                   else m["snaps"][to_snap]["size"])
+        for off, ln, exists in self.diff(from_snap, to_snap):
+            if exists:
+                data = self.read(off, ln, snap=to_snap)
+                recs.append({"off": off, "len": ln,
+                             "data": binascii.hexlify(data).decode()})
+            else:
+                recs.append({"off": off, "len": ln, "zero": True})
+        return json.dumps({"v": 1, "size": to_size,
+                           "from": from_snap, "to": to_snap,
+                           "extents": recs}).encode()
+
+    def import_diff(self, blob: bytes) -> int:
+        """Apply an export_diff stream (`rbd import-diff`).  An
+        incremental stream (one exported with from_snap) names its base
+        snapshot; the target must HOLD that snapshot or the apply is
+        refused — applying a delta onto the wrong base silently yields
+        a frankenimage (the reference embeds and checks the start snap
+        the same way).  Returns bytes written."""
+        doc = json.loads(blob.decode())
+        m = self._load()
+        base = doc.get("from")
+        if base and base not in m.get("snaps", {}):
+            raise ValueError(
+                f"diff stream is incremental from snapshot {base!r}, "
+                f"which this image does not have")
+        if doc["size"] != m["size"]:
+            self.resize(doc["size"])
+        written = 0
+        for rec in doc["extents"]:
+            if rec.get("zero"):
+                self.write(bytes(rec["len"]), rec["off"])
+            else:
+                self.write(binascii.unhexlify(rec["data"]), rec["off"])
+            written += rec["len"]
+        return written
 
     def remove(self) -> None:
         # librbd refuses removal while snapshots exist: the pool snaps
@@ -388,6 +662,8 @@ class Image:
         if self._load().get("snaps"):
             raise OSError(16, "image has snapshots (remove them first)")
         self._check_lock()   # and while another owner holds the lock
+        from ceph_tpu.rbd_object_map import ObjectMap
+        ObjectMap(self.io, self.name).remove()
         self._striped().remove()
         try:
             self.io.remove(self.HEADER_FMT.format(name=self.name))

@@ -43,16 +43,20 @@ class Bucket:
                               "compression": self.compression}).encode()})
         return self
 
-    def get_meta(self, key: str, default=None):
-        """One field of the bucket metadata record."""
+    def meta_all(self) -> dict:
+        """The parsed bucket metadata record ({} when absent) — ONE
+        omap fetch; callers needing several fields use this instead of
+        repeated get_meta round trips."""
         try:
             omap = self.io.get_omap(self.INDEX_FMT.format(name=self.name))
         except OSError:
-            return default
+            return {}
         blob = omap.get(".bucket.meta")
-        if not blob:
-            return default
-        return json.loads(blob.decode()).get(key, default)
+        return json.loads(blob.decode()) if blob else {}
+
+    def get_meta(self, key: str, default=None):
+        """One field of the bucket metadata record."""
+        return self.meta_all().get(key, default)
 
     def set_meta(self, key: str, value) -> None:
         omap = self.io.get_omap(self.INDEX_FMT.format(name=self.name))
@@ -178,6 +182,11 @@ class Bucket:
         else:
             blob = self._index().get(self._vkey(key, vid))
             entry = json.loads(blob.decode()) if blob else None
+            if entry is None and vid == "null":
+                # un-promoted pre-versioning object IS the null version
+                cur = self.current_entry(key)
+                if cur is not None and "version_id" not in cur:
+                    entry = cur
         if entry is None or entry.get("delete_marker"):
             raise KeyError(key)
         return entry
@@ -197,21 +206,39 @@ class Bucket:
         unversioned=True hard-deletes regardless of bucket state (for
         internal staging objects).  Returns {"delete_marker": bool,
         "version_id": str|None}."""
-        status = "" if unversioned else self.versioning()
         index_oid = self.INDEX_FMT.format(name=self.name)
         if vid is not None:
-            blob = self._index().get(self._vkey(key, vid))
+            # ONE index snapshot serves the whole removal (lookup,
+            # current-pointer check, repoint) instead of three fetches
+            idx = self._index()
+            status = "" if unversioned else (
+                json.loads(idx[".bucket.meta"].decode())
+                .get("versioning", "") if ".bucket.meta" in idx else "")
+            blob = idx.get(self._vkey(key, vid))
             if not blob:
+                cur_blob = idx.get(f"obj.{key}")
+                cur = json.loads(cur_blob.decode()) if cur_blob else None
+                if vid == "null" and cur is not None \
+                        and "version_id" not in cur:
+                    # un-promoted pre-versioning object IS the null
+                    # version: deleting it by id hard-deletes it
+                    StripedObject(self.io, self._data_name(key),
+                                  _LAYOUT).remove()
+                    self.io.set_omap(index_oid, {f"obj.{key}": b""})
+                    return {"delete_marker": False, "version_id": vid}
                 return {"delete_marker": False, "version_id": vid}
             entry = json.loads(blob.decode())
             if not entry.get("delete_marker"):
                 self._data_so(key, entry).remove()
             self.io.rm_omap_keys(index_oid, [self._vkey(key, vid)])
-            cur = self.current_entry(key)
+            del idx[self._vkey(key, vid)]
+            cur_blob = idx.get(f"obj.{key}")
+            cur = json.loads(cur_blob.decode()) if cur_blob else None
             if cur is not None and cur.get("version_id") == vid:
-                self._repoint_current(key)
+                self._repoint_current(key, idx)
             return {"delete_marker": bool(entry.get("delete_marker")),
                     "version_id": vid}
+        status = "" if unversioned else self.versioning()
         if status in ("Enabled", "Suspended"):
             updates: dict = {}
             if status == "Enabled":
@@ -236,10 +263,10 @@ class Bucket:
         self.io.set_omap(index_oid, {f"obj.{key}": b""})
         return {"delete_marker": False, "version_id": None}
 
-    def _repoint_current(self, key: str) -> None:
+    def _repoint_current(self, key: str, idx: dict | None = None) -> None:
         """The current version was permanently removed: newest surviving
         version (by id; marker or not) becomes current, else tombstone."""
-        vers = self.versions_of(key)
+        vers = self.versions_of(key, idx=idx)
         index_oid = self.INDEX_FMT.format(name=self.name)
         if vers:
             newest = vers[0]
@@ -248,12 +275,13 @@ class Bucket:
         else:
             self.io.set_omap(index_oid, {f"obj.{key}": b""})
 
-    def versions_of(self, key: str) -> list[dict]:
+    def versions_of(self, key: str, idx: dict | None = None) -> list[dict]:
         """All surviving versions of one key, newest first ("null" sorts
-        by its mtime against the timestamp ids)."""
+        by its mtime against the timestamp ids).  idx reuses a caller's
+        index snapshot."""
         prefix = f"ver.{key}{self.VSEP}"
         out = []
-        for k, v in self._index().items():
+        for k, v in (idx if idx is not None else self._index()).items():
             if k.startswith(prefix) and v:
                 out.append(json.loads(v.decode()))
         out.sort(key=lambda e: (e.get("mtime", 0),

@@ -209,9 +209,8 @@ class S3Gateway:
         self._bucket(name).set_meta("lifecycle", None)
 
     def get_acl(self, name: str) -> tuple[str, str]:
-        b = self._bucket(name)
-        return (b.get_meta("acl", "private") or "private",
-                b.get_meta("owner", "") or "")
+        meta = self._bucket(name).meta_all()
+        return (meta.get("acl") or "private", meta.get("owner") or "")
 
     def set_acl(self, name: str, acl: str) -> None:
         if acl not in ("private", "public-read", "public-read-write",
@@ -225,9 +224,9 @@ class S3Gateway:
         owner always passes; other AUTHENTICATED principals read under
         authenticated-read/public-read; anonymous reads need public-read;
         non-owner writes need public-read-write."""
-        b = self._bucket(name)
-        acl = b.get_meta("acl", "private") or "private"
-        owner = b.get_meta("owner", "") or ""
+        meta = self._bucket(name).meta_all()   # one index fetch
+        acl = meta.get("acl") or "private"
+        owner = meta.get("owner") or ""
         if principal is not None and (not owner or principal == owner):
             return
         if acl == "public-read-write":
@@ -243,7 +242,7 @@ class S3Gateway:
     def authorize_owner(self, name: str, principal: str | None) -> None:
         """Bucket-configuration ops (versioning/lifecycle/acl/delete):
         owner only — canned ACLs never delegate these."""
-        owner = self._bucket(name).get_meta("owner", "") or ""
+        owner = self._bucket(name).meta_all().get("owner") or ""
         if principal is None or (owner and principal != owner):
             raise S3Error("AccessDenied", "bucket owner only")
 
