@@ -20,30 +20,58 @@ from ceph_tpu.osd.map_codec import advance_map
 from ceph_tpu.osd.osdmap import OSDMap
 
 
+def _enc_pg_stat(e: Encoder, st: dict) -> None:
+    e.str(st.get("state", ""))
+    e.list(st.get("up", []), lambda e2, v: e2.s32(v))
+    e.u64(st.get("num_objects", 0))
+    e.u64(st.get("bytes", 0))
+    e.u64(st.get("missing", 0))
+    e.u64(st.get("log_size", 0))
+    lh = st.get("log_head", (0, 0))
+    lt = st.get("log_tail", (0, 0))
+    e.u64(lh[0]).u64(lh[1]).u64(lt[0]).u64(lt[1])
+
+
+def _dec_pg_stat(d: Decoder) -> dict:
+    return {"state": d.str(),
+            "up": d.list(lambda d2: d2.s32()),
+            "num_objects": d.u64(), "bytes": d.u64(),
+            "missing": d.u64(), "log_size": d.u64(),
+            "log_head": (d.u64(), d.u64()),
+            "log_tail": (d.u64(), d.u64())}
+
+
 @register_message
 class MMgrReport(Message):
-    """osd -> mgr: perf counters + pg states (messages/MMgrReport.h)."""
+    """osd -> mgr: perf counters + pg states (messages/MMgrReport.h).
+    v2 adds per-PG stat records for the PGs this osd leads — the pg_dump
+    / pg ls / iostat feed (pg_stat_t reduced); v1 peers interoperate,
+    they just feed the histogram views only."""
 
     TYPE = 0x701
 
     def __init__(self, osd_id: int = 0, counters: dict | None = None,
                  pg_states: dict | None = None, num_objects: int = 0,
-                 bytes_used: int = 0):
+                 bytes_used: int = 0, pg_stats: dict | None = None):
         super().__init__()
         self.osd_id = osd_id
         self.counters = counters or {}
         self.pg_states = pg_states or {}
         self.num_objects = num_objects
         self.bytes_used = bytes_used
+        #: pgid-str -> per-PG stat record (primary PGs only)
+        self.pg_stats = pg_stats or {}
 
     def encode_payload(self, enc: Encoder):
-        enc.versioned(1, 1, lambda e: (
+        enc.versioned(2, 1, lambda e: (
             e.s32(self.osd_id),
             e.map(self.counters, lambda e2, k: e2.str(k),
                   lambda e2, v: e2.u64(int(v))),
             e.map(self.pg_states, lambda e2, k: e2.str(k),
                   lambda e2, v: e2.u32(v)),
-            e.u64(self.num_objects), e.u64(self.bytes_used)))
+            e.u64(self.num_objects), e.u64(self.bytes_used),
+            e.map(self.pg_stats, lambda e2, k: e2.str(k),
+                  _enc_pg_stat)))
 
     def decode_payload(self, dec: Decoder, version):
         def body(d, v):
@@ -54,7 +82,9 @@ class MMgrReport(Message):
                                    lambda d2: d2.u32())
             self.num_objects = d.u64()
             self.bytes_used = d.u64()
-        dec.versioned(1, body)
+            if v >= 2:
+                self.pg_stats = d.map(lambda d2: d2.str(), _dec_pg_stat)
+        dec.versioned(2, body)
 
 
 class MgrDaemon(Dispatcher):
@@ -70,6 +100,10 @@ class MgrDaemon(Dispatcher):
         self._lock = threading.Lock()
         #: osd -> (last report time, MMgrReport)
         self.reports: dict[int, tuple[float, MMgrReport]] = {}
+        #: osd -> (time, counters) of the PREVIOUS report (iostat rates)
+        self._prev_counters: dict[int, tuple[float, dict]] = {}
+        #: last balancer optimize outcome (balancer status)
+        self._balancer_last: dict = {}
         self.msgr = Messenger.create(self.name, ms_type)
         self.msgr.set_auth(auth_key)
         self._cephx = cephx
@@ -151,6 +185,12 @@ class MgrDaemon(Dispatcher):
             return True
         if isinstance(msg, MMgrReport):
             with self._lock:
+                prev = self.reports.get(msg.osd_id)
+                if prev is not None:
+                    # keep one older counter sample per osd: the iostat
+                    # rate window (current - previous) / dt
+                    self._prev_counters[msg.osd_id] = (
+                        prev[0], dict(prev[1].counters))
                 self.reports[msg.osd_id] = (time.time(), msg)
             return True
         if isinstance(msg, MOSDMapMsg):
@@ -194,7 +234,102 @@ class MgrDaemon(Dispatcher):
         """Balancer module in upmap mode: mon commands that flatten the
         per-OSD PG histogram of the mgr's current osdmap."""
         from ceph_tpu.balancer import plan_commands
-        return plan_commands(self.osdmap, **kw)
+        cmds = plan_commands(self.osdmap, **kw)
+        self._balancer_last = {"time": time.time(),
+                               "commands": len(cmds),
+                               "pool_spread": self._pool_spread_scores()}
+        return cmds
+
+    def _pool_spread_scores(self) -> dict:
+        from ceph_tpu.balancer import spread
+        scores = {}
+        for pid in self.osdmap.pools:
+            lo, hi = spread(self.osdmap, pid)
+            scores[pid] = {"min": lo, "max": hi}
+        return scores
+
+    def balancer_status(self) -> dict:
+        """`ceph balancer status` shape: mode, the last optimize
+        outcome, and the current per-pool PG spread score."""
+        return {"mode": "upmap", "active": True,
+                "last_optimize": dict(self._balancer_last),
+                "pool_spread": self._pool_spread_scores()}
+
+    # -- pg introspection (DaemonServer `pg dump` / `pg ls`) ------------------
+
+    def _pg_rows(self) -> list[dict]:
+        """Merged per-PG records across osd reports; when two osds both
+        claim a pg (a remap race window) the NEWEST report wins."""
+        best: dict[str, tuple[float, int, dict]] = {}
+        with self._lock:
+            for osd, (t, rep) in self.reports.items():
+                for pgid, st in (rep.pg_stats or {}).items():
+                    cur = best.get(pgid)
+                    if cur is None or t > cur[0]:
+                        best[pgid] = (t, osd, st)
+        rows = []
+        for pgid, (t, osd, st) in best.items():
+            row = dict(st)
+            row["pgid"] = pgid
+            row["reported_by"] = osd
+            row["stamp"] = t
+            rows.append(row)
+        rows.sort(key=lambda r: tuple(
+            int(x) for x in r["pgid"].split(".")))
+        return rows
+
+    def pg_dump(self) -> dict:
+        """`ceph pg dump` (DaemonServer::_handle_pg_dump reduced):
+        every PG's state/acting/usage/log bounds plus per-osd totals."""
+        rows = self._pg_rows()
+        with self._lock:
+            osd_stats = {o: {"num_objects": r.num_objects,
+                             "bytes_used": r.bytes_used,
+                             "stamp": t}
+                         for o, (t, r) in self.reports.items()}
+        return {"pg_stats": rows, "osd_stats": osd_stats,
+                "num_pgs": len(rows)}
+
+    def pg_ls(self, pool: int | None = None,
+              states: list[str] | None = None) -> list[dict]:
+        """`ceph pg ls [pool] [states...]`."""
+        rows = self._pg_rows()
+        if pool is not None:
+            rows = [r for r in rows
+                    if int(r["pgid"].split(".")[0]) == pool]
+        if states:
+            rows = [r for r in rows if r["state"] in states]
+        return rows
+
+    # -- iostat module (src/pybind/mgr/iostat analog) -------------------------
+
+    def iostat(self) -> dict:
+        """Cluster I/O rates from successive report counter samples:
+        per-osd and total wr/rd ops per second over each osd's last
+        report interval."""
+        out: dict = {"osds": {}, "total_wr_ops_s": 0.0,
+                     "total_rd_ops_s": 0.0}
+        with self._lock:
+            for osd, (t, rep) in self.reports.items():
+                prev = self._prev_counters.get(osd)
+                if prev is None:
+                    continue
+                pt, pc = prev
+                dt = t - pt
+                if dt <= 0:
+                    continue
+                wr = (rep.counters.get("op_w", 0)
+                      - pc.get("op_w", 0)) / dt
+                rd = (rep.counters.get("op_r", 0)
+                      - pc.get("op_r", 0)) / dt
+                out["osds"][osd] = {"wr_ops_s": round(max(wr, 0.0), 3),
+                                    "rd_ops_s": round(max(rd, 0.0), 3),
+                                    "interval_s": round(dt, 3)}
+                out["total_wr_ops_s"] += max(wr, 0.0)
+                out["total_rd_ops_s"] += max(rd, 0.0)
+        out["total_wr_ops_s"] = round(out["total_wr_ops_s"], 3)
+        out["total_rd_ops_s"] = round(out["total_rd_ops_s"], 3)
+        return out
 
     def health(self, stale_after: float = 10.0) -> dict:
         now = time.time()

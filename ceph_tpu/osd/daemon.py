@@ -449,20 +449,51 @@ class OSDDaemon(Dispatcher):
         with self._lock:
             for pg in self.pgs.values():
                 states[pg.state] = states.get(pg.state, 0) + 1
+        per_cid: dict[str, tuple[int, int]] = {}
         for cid in self.store.list_collections():
+            c_obj = c_bytes = 0
             try:
                 for oid in self.store.list_objects(cid):
                     if oid.startswith(PG.PGMETA):
                         continue
-                    n_obj += 1
-                    n_bytes += self.store.stat(cid, oid)["size"]
+                    c_obj += 1
+                    c_bytes += self.store.stat(cid, oid)["size"]
             except KeyError:
                 continue
+            per_cid[cid] = (c_obj, c_bytes)
+            n_obj += c_obj
+            n_bytes += c_bytes
+        # per-PG stat records for the PGs this osd leads (pg_stat_t
+        # reduced): state, acting set, store usage, log bounds — the
+        # mgr's `pg dump` / `pg ls` truth
+        pg_stats: dict[str, dict] = {}
+        with self._lock:
+            pgids = list(self.pgs)
+        for pgid in pgids:
+            pool = self.osdmap.pools.get(pgid[0])
+            if pool is None or not (0 <= pgid[1] < pool.pg_num):
+                continue
+            _up, primary = self._pg_members(pgid)
+            if primary != self.osd_id:
+                continue
+            with self._lock:
+                pg = self.pgs.get(pgid)
+                if pg is None:
+                    continue
+                c_obj, c_bytes = per_cid.get(self._pg_cid(pgid), (0, 0))
+                tail = (pg.log.entries[0].version if pg.log.entries
+                        else pg.log.head)
+                pg_stats[f"{pgid[0]}.{pgid[1]}"] = {
+                    "state": pg.state, "up": list(pg.up),
+                    "num_objects": c_obj, "bytes": c_bytes,
+                    "missing": len(pg.missing),
+                    "log_size": len(pg.log.entries),
+                    "log_head": pg.log.head, "log_tail": tail}
         counters = dict(self.perf._u64)
         con = self.msgr.connect_to(self.mgr_addr, EntityName("mgr", 0))
         con.send_message(MMgrReport(
             osd_id=self.osd_id, counters=counters, pg_states=states,
-            num_objects=n_obj, bytes_used=n_bytes))
+            num_objects=n_obj, bytes_used=n_bytes, pg_stats=pg_stats))
 
     ROTATING_REFRESH = 60.0
 

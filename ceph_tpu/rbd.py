@@ -439,6 +439,7 @@ class Image:
                             dirty = True
                     if dirty:
                         heir.save()
+                    self._om_invalidate()
             ObjectMap(self.io, self.name, snapid).remove()
 
     def snap_rollback(self, snap: str) -> None:
@@ -588,8 +589,14 @@ class Image:
                 sid = ent["snapid"]
                 if from_id < sid and sid < to_id:
                     om = self._om_load(sid)
-                    if om is not None:
-                        chain.append(om)
+                    if om is None:
+                        # a lost intermediate map would silently drop
+                        # rewrites made in its window: fail loudly like
+                        # the endpoint maps do
+                        raise OSError(
+                            5, f"object map for snapshot id {sid} "
+                               "missing or corrupt")
+                    chain.append(om)
         chain.append(to_om)
         st = self._striped()
         out: list[tuple[int, int, bool]] = []

@@ -211,9 +211,6 @@ class Bucket:
             # ONE index snapshot serves the whole removal (lookup,
             # current-pointer check, repoint) instead of three fetches
             idx = self._index()
-            status = "" if unversioned else (
-                json.loads(idx[".bucket.meta"].decode())
-                .get("versioning", "") if ".bucket.meta" in idx else "")
             blob = idx.get(self._vkey(key, vid))
             if not blob:
                 cur_blob = idx.get(f"obj.{key}")
