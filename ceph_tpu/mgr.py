@@ -49,6 +49,8 @@ class MMgrReport(Message):
     they just feed the histogram views only."""
 
     TYPE = 0x701
+    HEAD_VERSION = 2
+    COMPAT_VERSION = 1
 
     def __init__(self, osd_id: int = 0, counters: dict | None = None,
                  pg_states: dict | None = None, num_objects: int = 0,
@@ -74,6 +76,10 @@ class MMgrReport(Message):
                   _enc_pg_stat)))
 
     def decode_payload(self, dec: Decoder, version):
+        # decode constructs via __new__: every field needs a default
+        # here, v1 payloads carry no pg_stats
+        self.pg_stats = {}
+
         def body(d, v):
             self.osd_id = d.s32()
             self.counters = d.map(lambda d2: d2.str(),
@@ -242,9 +248,10 @@ class MgrDaemon(Dispatcher):
 
     def _pool_spread_scores(self) -> dict:
         from ceph_tpu.balancer import spread
+        m = self.osdmap          # snapshot: dispatch may swap the map
         scores = {}
-        for pid in self.osdmap.pools:
-            lo, hi = spread(self.osdmap, pid)
+        for pid in list(m.pools):
+            lo, hi = spread(m, pid)
             scores[pid] = {"min": lo, "max": hi}
         return scores
 
@@ -309,8 +316,14 @@ class MgrDaemon(Dispatcher):
         report interval."""
         out: dict = {"osds": {}, "total_wr_ops_s": 0.0,
                      "total_rd_ops_s": 0.0}
+        now = time.time()
         with self._lock:
             for osd, (t, rep) in self.reports.items():
+                if now - t > 10.0:
+                    # a dead osd's last interval is not a current rate:
+                    # stale reporters drop out instead of reporting
+                    # their final rate forever
+                    continue
                 prev = self._prev_counters.get(osd)
                 if prev is None:
                     continue

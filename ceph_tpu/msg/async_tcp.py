@@ -61,6 +61,8 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
+from ceph_tpu.msg.features import FEAT_FRAME as _FEAT
+
 #: on-wire compression modes (msgr2 compression negotiation analog)
 COMP_NONE = 0
 COMP_ZLIB = 1
@@ -74,11 +76,18 @@ def _handshake(sock: socket.socket, my_name: EntityName,
                auth_required: bool,
                comp_mode: int = COMP_NONE,
                cephx=None, accepted: bool = False,
-               peer_type: str = ""
-               ) -> tuple[EntityName, int, str | None]:
+               peer_type: str = "",
+               features: int | None = None,
+               required_fn=None,
+               ) -> tuple[EntityName, int, str | None, int]:
     from ceph_tpu.auth.handshake import (
         AUTH_CEPHX_ENTITY, AUTH_CEPHX_TICKET, accept_ticket,
         entity_proof, proof as sess_proof, ticket_for)
+    from ceph_tpu.msg.features import (
+        FEATURE_WIRE_COMPRESSION, REQUIRED_DEFAULT, SUPPORTED_FEATURES,
+        check_compat)
+    if features is None:
+        features = SUPPORTED_FEATURES
     sock.sendall(BANNER)
     got = _read_exact(sock, len(BANNER))
     if got != BANNER:
@@ -89,6 +98,15 @@ def _handshake(sock: socket.socket, my_name: EntityName,
     if plen > 256:
         raise ConnectionError("oversized name frame")
     peer = EntityName.parse(_read_exact(sock, plen).decode())
+
+    # feature negotiation (ceph_features.h / Policy::features_required):
+    # both advertise (supported, required-of-this-peer-type); unmet
+    # requirements reject the session here, before auth or any message
+    my_req = (required_fn(peer.type) if required_fn
+              else REQUIRED_DEFAULT)
+    sock.sendall(_FEAT.pack(features, my_req))
+    pf, pr = _FEAT.unpack(_read_exact(sock, _FEAT.size))
+    common = check_compat(str(peer), features, my_req, pf, pr)
 
     # auth phase: mode + fresh nonce both ways, then mutual proofs
     if cephx is not None:
@@ -177,10 +195,14 @@ def _handshake(sock: socket.socket, my_name: EntityName,
             if not hmac.compare_digest(peer_proof, want):
                 raise ConnectionError(
                     f"peer {peer} failed authentication")
-    # compression negotiation: both offer; min wins (off beats on)
+    # compression negotiation: both offer; min wins (off beats on).
+    # DEGRADE path: a peer without the wire-compression feature gets
+    # uncompressed frames regardless of offers
+    if not common & FEATURE_WIRE_COMPRESSION:
+        comp_mode = COMP_NONE
     sock.sendall(bytes([comp_mode]))
     peer_comp = _read_exact(sock, 1)[0]
-    return peer, min(comp_mode, peer_comp), auth_entity
+    return peer, min(comp_mode, peer_comp), auth_entity, common
 
 
 class TcpConnection(Connection):
@@ -239,10 +261,11 @@ class TcpConnection(Connection):
         m = self.messenger
         # keep the dial timeout through the handshake: a stalled or
         # malicious peer must not wedge the writer thread forever
-        peer, self.comp, _ent = _handshake(
+        peer, self.comp, _ent, self.features = _handshake(
             s, m.my_name, m.auth_key, m.auth_required, m.comp_mode,
             cephx=m.cephx, accepted=False,
-            peer_type=self.peer_name.type if self.peer_name else "")
+            peer_type=self.peer_name.type if self.peer_name else "",
+            features=m.local_features, required_fn=m.required_for)
         s.settimeout(None)
         with self._lock:
             self._sock = s
@@ -444,9 +467,11 @@ class AsyncMessenger(Messenger):
             # handshake-phase timeout: an unauthenticated peer that
             # stalls mid-handshake must not leak a thread + fd
             sock.settimeout(10)
-            peer, comp, auth_entity = _handshake(
+            peer, comp, auth_entity, feat = _handshake(
                 sock, self.my_name, self.auth_key, self.auth_required,
-                self.comp_mode, cephx=self.cephx, accepted=True)
+                self.comp_mode, cephx=self.cephx, accepted=True,
+                features=self.local_features,
+                required_fn=self.required_for)
             sock.settimeout(None)
         except (ConnectionError, OSError):
             sock.close()
@@ -455,6 +480,7 @@ class AsyncMessenger(Messenger):
         con = TcpConnection(self, f"{sock.getpeername()[0]}:0", peer,
                             policy, sock=sock, accepted=True, comp=comp)
         con.auth_entity = auth_entity
+        con.features = feat
         with self._lock:
             if self._stop:
                 # raced shutdown(): it already swept _conns — a session

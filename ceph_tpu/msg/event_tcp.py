@@ -60,6 +60,8 @@ from .messenger import Connection, ConnectionPolicy, EntityName, Messenger
 
 _LEN = struct.Struct("<I")
 
+from .features import FEAT_FRAME as _FEAT  # noqa: E402
+
 # connection states
 _CONNECTING = "connecting"
 _HANDSHAKE = "handshake"
@@ -218,9 +220,11 @@ class EventConnection(Connection):
 
     # -- handshake state machine ---------------------------------------------
     # Outgoing bytes per direction (matching async_tcp._handshake):
-    #   banner | [len]name | [mode][nonce16] | (proof32 if both cephx) |
-    #   [comp1] — each side's stream is fixed once the peer's auth mode
-    #   is known, so both sides can emit eagerly and parse statefully.
+    #   banner | [len]name | [feat16] | [mode][nonce16] |
+    #   (proof32 if both cephx) | [comp1] — each side's stream is fixed
+    #   once the peer's auth mode is known, so both sides can emit
+    #   eagerly and parse statefully.  feat16 = (supported u64,
+    #   required u64); unmet requirements abort the handshake.
 
     def _emit_handshake_head(self) -> None:
         m = self.messenger
@@ -234,8 +238,21 @@ class EventConnection(Connection):
         else:
             my_mode = AUTH_CEPHX if m.auth_key else AUTH_NONE
         self.hs_my_mode = my_mode
-        self.out_frames.append((BANNER + _LEN.pack(len(me)) + me
-                                + bytes([my_mode]) + self.hs_nonce, None))
+        # stream: banner | name | feat | mode+nonce.  The feat frame's
+        # required bits depend on the PEER type: an initiator that knows
+        # who it dialed emits everything eagerly; an acceptor (or a dial
+        # to an unnamed peer) defers feat+mode+nonce until the peer's
+        # name arrives so the frames stay in stream order
+        self.out_frames.append((BANNER + _LEN.pack(len(me)) + me, None))
+        if not self.accepted and self.peer_name is not None:
+            self._emit_feat_auth(self.peer_name.type)
+
+    def _emit_feat_auth(self, peer_type: str) -> None:
+        m = self.messenger
+        self.hs_my_req = m.required_for(peer_type)
+        self.out_frames.append(
+            (_FEAT.pack(m.local_features, self.hs_my_req)
+             + bytes([self.hs_my_mode]) + self.hs_nonce, None))
 
     def _hs_step(self) -> bool:
         """Consume handshake bytes from inbuf; True on progress.
@@ -264,6 +281,21 @@ class EventConnection(Connection):
                 self.peer_name = peer
             if self.accepted:
                 self.policy = m.policy_for(peer.type)
+                self._emit_feat_auth(peer.type)
+            elif not hasattr(self, "hs_my_req"):
+                # dialed without a known peer name: the feat+auth frames
+                # were deferred to now
+                self._emit_feat_auth(peer.type)
+            self.hs_stage = "feat"
+        if self.hs_stage == "feat":
+            if len(self.inbuf) < _FEAT.size:
+                return False
+            pf, pr = _FEAT.unpack(bytes(self.inbuf[:_FEAT.size]))
+            del self.inbuf[:_FEAT.size]
+            from ceph_tpu.msg.features import check_compat
+            self.features = check_compat(
+                str(self.peer_name), m.local_features, self.hs_my_req,
+                pf, pr)
             self.hs_stage = "auth"
         if self.hs_stage == "auth":
             if len(self.inbuf) < 17:
@@ -337,7 +369,11 @@ class EventConnection(Connection):
                 return False
             peer_comp = self.inbuf[0]
             del self.inbuf[:1]
-            self.comp = min(self.messenger.comp_mode, peer_comp)
+            from ceph_tpu.msg.features import FEATURE_WIRE_COMPRESSION
+            my_comp = (self.messenger.comp_mode
+                       if self.features & FEATURE_WIRE_COMPRESSION
+                       else COMP_NONE)
+            self.comp = min(my_comp, peer_comp)
             self.state = _OPEN
             if self.accepted:
                 self.messenger.register_accepted(self)

@@ -1,0 +1,73 @@
+"""Wire feature bits (include/ceph_features.h + msg/Policy.h analog).
+
+Every connection handshake exchanges (supported, required) 64-bit
+vectors right after the transport names.  A peer that lacks bits I
+REQUIRE — or that requires bits I lack — is rejected cleanly at
+handshake with a reason, before any message flows: the rolling-upgrade
+contract.  Optional capabilities degrade instead: both sides compute
+``common = mine & theirs`` and consult it per capability (wire
+compression is the first consumer — offered zlib degrades to none
+against a peer without FEATURE_WIRE_COMPRESSION, like msgr2's
+compression negotiation falling back).
+
+Bits are append-only, never recycled (the reference retired bits by
+parking them on CEPH_FEATURE_RESERVED rather than reuse).
+"""
+
+from __future__ import annotations
+
+import struct
+
+FEATURE_BASE = 1 << 0               # the v1 framing itself
+FEATURE_WIRE_COMPRESSION = 1 << 1   # negotiated zlib frames
+FEATURE_CEPHX_TICKETS = 1 << 2      # ticket-based cephx handshakes
+FEATURE_INCREMENTAL_MAPS = 1 << 3   # MOSDMapMsg incremental payloads
+FEATURE_PG_STATS_V2 = 1 << 4        # MMgrReport v2 per-PG records
+FEATURE_EC_RMW_PIPELINE = 1 << 5    # pipelined EC overlapping writes
+
+#: everything this build speaks
+SUPPORTED_FEATURES = (FEATURE_BASE | FEATURE_WIRE_COMPRESSION
+                      | FEATURE_CEPHX_TICKETS | FEATURE_INCREMENTAL_MAPS
+                      | FEATURE_PG_STATS_V2 | FEATURE_EC_RMW_PIPELINE)
+
+#: handshake frame: (supported u64, required u64) — ONE definition
+#: shared by both TCP stacks; they must parse each other byte-exact
+FEAT_FRAME = struct.Struct("<QQ")
+
+#: the floor every peer must speak (Policy::features_required baseline)
+REQUIRED_DEFAULT = FEATURE_BASE
+
+_NAMES = {
+    FEATURE_BASE: "base",
+    FEATURE_WIRE_COMPRESSION: "wire-compression",
+    FEATURE_CEPHX_TICKETS: "cephx-tickets",
+    FEATURE_INCREMENTAL_MAPS: "incremental-maps",
+    FEATURE_PG_STATS_V2: "pg-stats-v2",
+    FEATURE_EC_RMW_PIPELINE: "ec-rmw-pipeline",
+}
+
+
+def feature_names(bits: int) -> str:
+    """Human-readable bit list for handshake reject messages."""
+    out = [name for bit, name in sorted(_NAMES.items()) if bits & bit]
+    extra = bits & ~sum(_NAMES)
+    if extra:
+        out.append(f"unknown({extra:#x})")
+    return ",".join(out) or "none"
+
+
+def check_compat(peer: str, mine: int, my_required: int,
+                 peer_supported: int, peer_required: int) -> int:
+    """Validate mutual feature requirements; returns the common feature
+    set or raises ConnectionError with the missing bits named."""
+    missing = my_required & ~peer_supported
+    if missing:
+        raise ConnectionError(
+            f"peer {peer} lacks required features "
+            f"[{feature_names(missing)}]")
+    lacking = peer_required & ~mine
+    if lacking:
+        raise ConnectionError(
+            f"peer {peer} requires features I lack "
+            f"[{feature_names(lacking)}]")
+    return mine & peer_supported

@@ -43,6 +43,9 @@ class ConnectionPolicy:
     server: bool = False
     resend_on_reconnect: bool = True
     throttler_bytes: Throttle | None = None
+    #: extra feature bits this peer type MUST speak
+    #: (Policy::features_required; FEATURE_BASE is always required)
+    features_required: int = 0
 
     @staticmethod
     def lossy_client() -> "ConnectionPolicy":
@@ -69,6 +72,10 @@ class Connection:
         #: cephx-authenticated identity (e.g. "client.admin"), set by
         #: wire handshakes; None on unauthenticated/loopback links
         self.auth_entity: str | None = None
+        #: negotiated feature intersection; wire handshakes overwrite,
+        #: in-process transports (loopback/ici) keep the full local set
+        from ceph_tpu.msg.features import SUPPORTED_FEATURES
+        self.features: int = SUPPORTED_FEATURES
 
     def send_message(self, msg: Message) -> None:
         raise NotImplementedError
@@ -109,6 +116,10 @@ class Messenger:
         self._dispatchers: list[Dispatcher] = []
         self._policies: dict[str, ConnectionPolicy] = {}
         self._default_policy = ConnectionPolicy()
+        from ceph_tpu.msg.features import SUPPORTED_FEATURES
+        #: what this endpoint advertises; tests shrink it to simulate
+        #: an old peer
+        self.local_features: int = SUPPORTED_FEATURES
         self._lock = threading.RLock()
 
     @staticmethod
@@ -193,6 +204,13 @@ class Messenger:
     def policy_for(self, peer_type: str) -> ConnectionPolicy:
         with self._lock:
             return self._policies.get(peer_type, self._default_policy)
+
+    def required_for(self, peer_type: str) -> int:
+        """Feature bits a peer of this type must speak: the global
+        floor plus the per-type policy's features_required."""
+        from ceph_tpu.msg.features import REQUIRED_DEFAULT
+        return REQUIRED_DEFAULT | self.policy_for(
+            peer_type).features_required
 
     # -- transport lifecycle --------------------------------------------------
 

@@ -1,0 +1,170 @@
+"""Wire feature bits (ceph_features.h / msg/Policy.h analog): the
+handshake exchanges (supported, required) vectors on both TCP stacks;
+unmet requirements reject cleanly before any message flows, optional
+capabilities degrade (wire compression), and the default path
+interoperates at the full feature set."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ceph_tpu.msg.features import (
+    FEATURE_BASE,
+    FEATURE_WIRE_COMPRESSION,
+    SUPPORTED_FEATURES,
+    check_compat,
+    feature_names,
+)
+from ceph_tpu.msg.message import Message, register_message
+from ceph_tpu.msg.messenger import (
+    ConnectionPolicy, Dispatcher, EntityName, Messenger)
+
+
+@register_message
+class MPing2(Message):
+    TYPE = 0x7f01
+
+    def __init__(self, n: int = 0):
+        super().__init__()
+        self.n = n
+
+    def encode_payload(self, enc):
+        enc.u32(self.n)
+
+    def decode_payload(self, dec, version):
+        self.n = dec.u32()
+
+
+class Sink(Dispatcher):
+    def __init__(self):
+        self.got = []
+
+    def ms_dispatch(self, msg):
+        if isinstance(msg, MPing2):
+            self.got.append(msg)
+            return True
+        return False
+
+
+def _pair(ms_type: str, a_kw=None, b_kw=None):
+    a = Messenger.create(EntityName("client", 1), ms_type)
+    b = Messenger.create(EntityName("osd", 7), ms_type)
+    for m, kw in ((a, a_kw or {}), (b, b_kw or {})):
+        for k, v in kw.items():
+            setattr(m, k, v)
+    sink = Sink()
+    b.add_dispatcher_tail(sink)
+    b.bind("127.0.0.1:0")
+    b.start()
+    a.start()
+    return a, b, sink
+
+
+STACKS = ["threaded", "async"]
+
+
+def test_check_compat_unit():
+    assert check_compat("x", 0b111, 0b001, 0b011, 0b001) == 0b011
+    with pytest.raises(ConnectionError):
+        check_compat("x", 0b001, 0b010, 0b001, 0b001)  # they lack mine
+    with pytest.raises(ConnectionError):
+        check_compat("x", 0b001, 0b001, 0b011, 0b010)  # I lack theirs
+    assert "wire-compression" in feature_names(FEATURE_WIRE_COMPRESSION)
+
+
+@pytest.mark.parametrize("ms_type", STACKS)
+def test_full_feature_peers_interoperate(ms_type):
+    a, b, sink = _pair(ms_type)
+    try:
+        con = a.connect_to(b.my_addr, EntityName("osd", 7))
+        con.send_message(MPing2(5))
+        deadline = time.time() + 5
+        while time.time() < deadline and not sink.got:
+            time.sleep(0.02)
+        assert sink.got and sink.got[0].n == 5
+        assert con.features == SUPPORTED_FEATURES
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+@pytest.mark.parametrize("ms_type", STACKS)
+def test_old_peer_cleanly_rejected(ms_type):
+    # B is an "old" build lacking a bit A's osd-policy requires: the
+    # handshake must fail cleanly — no message flows, no hang
+    a, b, sink = _pair(
+        ms_type, b_kw={"local_features": FEATURE_BASE})
+    novel = 1 << 20
+    a.local_features = SUPPORTED_FEATURES | novel
+    a.set_policy("osd", ConnectionPolicy(features_required=novel))
+    try:
+        con = a.connect_to(b.my_addr, EntityName("osd", 7))
+        con.send_message(MPing2(9))
+        time.sleep(1.0)
+        assert sink.got == []
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+@pytest.mark.parametrize("ms_type", STACKS)
+def test_peer_requiring_what_i_lack_rejected(ms_type):
+    # the acceptor requires a bit the initiator lacks: also rejected
+    novel = 1 << 21
+    a, b, sink = _pair(ms_type)
+    b.local_features = SUPPORTED_FEATURES | novel
+    b.set_policy("client", ConnectionPolicy(features_required=novel))
+    try:
+        con = a.connect_to(b.my_addr, EntityName("osd", 7))
+        con.send_message(MPing2(3))
+        time.sleep(1.0)
+        assert sink.got == []
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+@pytest.mark.parametrize("ms_type", STACKS)
+def test_compression_degrades_without_feature(ms_type):
+    # both OFFER zlib, but B lacks the wire-compression feature bit:
+    # the session degrades to uncompressed and still delivers
+    a, b, sink = _pair(
+        ms_type,
+        b_kw={"local_features":
+              SUPPORTED_FEATURES & ~FEATURE_WIRE_COMPRESSION})
+    a.set_compression("zlib")
+    b.set_compression("zlib")
+    try:
+        con = a.connect_to(b.my_addr, EntityName("osd", 7))
+        con.send_message(MPing2(11))
+        deadline = time.time() + 5
+        while time.time() < deadline and not sink.got:
+            time.sleep(0.02)
+        assert sink.got and sink.got[0].n == 11
+        from ceph_tpu.msg.async_tcp import COMP_NONE
+        assert con.comp == COMP_NONE
+        assert not con.features & FEATURE_WIRE_COMPRESSION
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+@pytest.mark.parametrize("ms_type", STACKS)
+def test_compression_still_negotiates_with_feature(ms_type):
+    a, b, sink = _pair(ms_type)
+    a.set_compression("zlib")
+    b.set_compression("zlib")
+    try:
+        con = a.connect_to(b.my_addr, EntityName("osd", 7))
+        con.send_message(MPing2(2))
+        deadline = time.time() + 5
+        while time.time() < deadline and not sink.got:
+            time.sleep(0.02)
+        assert sink.got
+        from ceph_tpu.msg.async_tcp import COMP_ZLIB
+        assert con.comp == COMP_ZLIB
+    finally:
+        a.shutdown()
+        b.shutdown()
