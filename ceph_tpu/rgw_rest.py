@@ -155,7 +155,15 @@ class S3Gateway:
         self.io = ioctx
         self.compression = compression
         self.clock = clock
+        #: multisite: when True, mutations append bucket datalog records
+        #: a ZoneSyncAgent replays on the secondary (rgw_datalog analog)
+        self.datalog_enabled = False
         self._lock = threading.Lock()
+
+    def _datalog(self, bucket: str, op: str, key: str) -> None:
+        if self.datalog_enabled:
+            from ceph_tpu.rgw_sync import datalog_append
+            datalog_append(self, bucket, op, key, clock=self.clock)
 
     @staticmethod
     def _check_name(s: str, what: str) -> None:
@@ -286,7 +294,15 @@ class S3Gateway:
                           f"key prefix {self.MP_PREFIX!r}. is reserved "
                           "for multipart staging")
         b = self._bucket(bucket)
-        entry = b.put(key, data, metadata=metadata, clock=self.clock)
+        if self.datalog_enabled:
+            # apply + log under one lock: a racing put/delete pair must
+            # log in the order it applied, or replay diverges the peer
+            with self._lock:
+                entry = b.put(key, data, metadata=metadata,
+                              clock=self.clock)
+                self._datalog(bucket, "put", key)
+        else:
+            entry = b.put(key, data, metadata=metadata, clock=self.clock)
         return hashlib.md5(data).hexdigest(), entry.get("version_id")
 
     def get_object(self, bucket: str, key: str,
@@ -308,11 +324,18 @@ class S3Gateway:
     def delete_object(self, bucket: str, key: str,
                       vid: str | None = None) -> dict:
         try:
-            return self._bucket(bucket).delete_object(
-                key, vid, clock=self.clock)
+            if self.datalog_enabled:
+                with self._lock:
+                    out = self._bucket(bucket).delete_object(
+                        key, vid, clock=self.clock)
+                    self._datalog(bucket, "delete", key)
+            else:
+                out = self._bucket(bucket).delete_object(
+                    key, vid, clock=self.clock)
         except KeyError:
             # S3 DELETE is idempotent
             return {"delete_marker": False, "version_id": None}
+        return out
 
     def list_versions(self, name: str, prefix: str, max_keys: int,
                       key_marker: str = "",
@@ -386,6 +409,7 @@ class S3Gateway:
                     continue
                 if now - entry.get("mtime", now) >= exp_days * day:
                     b.delete_object(key, clock=self.clock)
+                    self._datalog(b.name, "delete", key)
                     stats["expired"] += 1
         if nc_days:
             # NoncurrentDays counts from the moment a version BECAME
@@ -460,6 +484,7 @@ class S3Gateway:
         whole = b"".join(chunks)
         b.put(key, whole, metadata=manifest.get("meta") or {},
               clock=self.clock)
+        self._datalog(bucket, "put", key)
         self._abort_locked(b, upload_id)
         return hashlib.md5(whole).hexdigest()
 

@@ -1,0 +1,291 @@
+"""RGW Swift frontend — the OpenStack Object Storage dialect over the
+same S3Gateway/rgw_lite storage mapping (src/rgw/rgw_rest_swift.cc +
+rgw_swift_auth.cc analog).
+
+Surface (the Swift v1 core the reference serves):
+
+  * TempAuth-style v1.0 auth: ``GET /auth/v1.0`` with X-Auth-User /
+    X-Auth-Key returns X-Auth-Token + X-Storage-Url; tokens are HMACs
+    over the account with an expiry, verified statelessly
+  * account: ``GET /v1/AUTH_<acct>`` lists containers (text or JSON)
+  * container: PUT (create), DELETE (must be empty), GET (list objects,
+    prefix/marker/limit paging, text or JSON), HEAD (object count)
+  * object: PUT (with X-Object-Meta-*), GET, HEAD, DELETE; COPY via
+    X-Copy-From
+
+Buckets are shared with the S3 frontend one-to-one: a container created
+here is a bucket there (the reference stores both dialects over the
+same rgw_rados layout).  Swift-created containers are owned by the
+authenticated account and private by default.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ceph_tpu.rgw_rest import S3Error, S3Gateway
+
+TOKEN_TTL = 3600.0
+
+
+class SwiftRestServer:
+    """The Swift-dialect HTTP shell around an S3Gateway."""
+
+    def __init__(self, ioctx=None, addr: str = "127.0.0.1:0",
+                 gateway: S3Gateway | None = None, clock=time.time,
+                 token_ttl: float = TOKEN_TTL):
+        if gateway is None:
+            gateway = S3Gateway(ioctx, clock=clock)
+        self.gateway = gateway
+        self.clock = clock
+        self.token_ttl = token_ttl
+        #: account -> swift key (X-Auth-User "acct:user" uses acct part)
+        self.accounts: dict[str, str] = {}
+        self._token_secret = hashlib.sha256(
+            b"swift-token" + str(id(self)).encode()).digest()
+        host, port = addr.rsplit(":", 1)
+        self._httpd = ThreadingHTTPServer((host, int(port)), _SwiftHandler)
+        self._httpd.swift = self           # type: ignore
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @property
+    def addr(self) -> str:
+        h, p = self._httpd.server_address[:2]
+        return f"{h}:{p}"
+
+    def start(self) -> "SwiftRestServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="rgw-swift",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- accounts / tokens ----------------------------------------------------
+
+    def add_account(self, account: str, key: str) -> None:
+        self.accounts[account] = key
+
+    def issue_token(self, account: str) -> str:
+        exp = int(self.clock() + self.token_ttl)
+        mac = hmac.new(self._token_secret,
+                       f"{account}:{exp}".encode(),
+                       hashlib.sha256).hexdigest()[:32]
+        return f"AUTH_tk_{account}_{exp}_{mac}"
+
+    def verify_token(self, token: str) -> str | None:
+        """Account name for a valid unexpired token, else None."""
+        if not token.startswith("AUTH_tk_"):
+            return None
+        try:
+            body = token[len("AUTH_tk_"):]
+            account, exp_s, mac = body.rsplit("_", 2)
+            exp = int(exp_s)
+        except ValueError:
+            return None
+        want = hmac.new(self._token_secret,
+                        f"{account}:{exp}".encode(),
+                        hashlib.sha256).hexdigest()[:32]
+        if not hmac.compare_digest(mac, want):
+            return None
+        if self.clock() > exp:
+            return None
+        return account
+
+
+class _SwiftHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "ceph-tpu-rgw-swift/1.0"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    # -- plumbing -------------------------------------------------------------
+
+    def _respond(self, status: int, body: bytes = b"",
+                 headers: dict | None = None) -> None:
+        self.send_response(status)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body and self.command != "HEAD":
+            self.wfile.write(body)
+
+    def _dispatch(self) -> None:
+        srv: SwiftRestServer = self.server.swift     # type: ignore
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        parsed = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(parsed.query,
+                                        keep_blank_values=True))
+        path = urllib.parse.unquote(parsed.path)
+        try:
+            if path == "/auth/v1.0":
+                return self._auth(srv)
+            if not path.startswith("/v1/AUTH_"):
+                return self._respond(404, b"not a swift path")
+            rest = path[len("/v1/AUTH_"):]
+            parts = rest.split("/", 2)
+            account = parts[0]
+            container = parts[1] if len(parts) > 1 else ""
+            obj = parts[2] if len(parts) > 2 else ""
+            token = self.headers.get("X-Auth-Token", "")
+            principal = srv.verify_token(token)
+            if principal is None or principal != account:
+                return self._respond(401, b"invalid or expired token")
+            if not container:
+                return self._account(srv, account, q)
+            if not obj:
+                return self._container(srv, account, container, q)
+            return self._object(srv, account, container, obj, body)
+        except S3Error as e:
+            code = {"NoSuchBucket": 404, "NoSuchKey": 404,
+                    "BucketNotEmpty": 409,
+                    "BucketAlreadyExists": 202,   # swift PUT is idempotent
+                    "AccessDenied": 403}.get(e.code, 400)
+            if code == 202:
+                return self._respond(202)
+            return self._respond(code, str(e).encode())
+        except Exception as e:   # pragma: no cover
+            return self._respond(500, repr(e).encode())
+
+    do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _dispatch
+
+    # -- auth -----------------------------------------------------------------
+
+    def _auth(self, srv: SwiftRestServer) -> None:
+        user = self.headers.get("X-Auth-User", "")
+        key = self.headers.get("X-Auth-Key", "")
+        account = user.split(":", 1)[0]
+        want = srv.accounts.get(account)
+        if want is None or not hmac.compare_digest(want, key):
+            return self._respond(401, b"bad credentials")
+        token = srv.issue_token(account)
+        host = self.headers.get("Host", srv.addr)
+        self._respond(200, b"", {
+            "X-Auth-Token": token,
+            "X-Storage-Token": token,
+            "X-Storage-Url": f"http://{host}/v1/AUTH_{account}"})
+
+    # -- account --------------------------------------------------------------
+
+    def _acct_buckets(self, srv: SwiftRestServer, account: str
+                      ) -> list[str]:
+        gw = srv.gateway
+        try:
+            names = sorted(gw.io.get_omap(gw.REGISTRY))
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            try:
+                meta = gw._bucket(n).meta_all()
+            except S3Error:
+                continue
+            if meta.get("owner") == f"swift:{account}":
+                out.append(n)
+        return out
+
+    def _account(self, srv: SwiftRestServer, account: str,
+                 q: dict) -> None:
+        if self.command not in ("GET", "HEAD"):
+            return self._respond(405)
+        names = self._acct_buckets(srv, account)
+        if q.get("format") == "json":
+            body = json.dumps([{"name": n} for n in names]).encode()
+            ctype = "application/json"
+        else:
+            body = ("\n".join(names) + ("\n" if names else "")).encode()
+            ctype = "text/plain"
+        self._respond(200 if names else 204, body, {
+            "Content-Type": ctype,
+            "X-Account-Container-Count": str(len(names))})
+
+    # -- container ------------------------------------------------------------
+
+    def _container(self, srv: SwiftRestServer, account: str,
+                   name: str, q: dict) -> None:
+        gw = srv.gateway
+        principal = f"swift:{account}"
+        if self.command == "PUT":
+            try:
+                gw.create_bucket(name, owner=principal)
+                return self._respond(201)
+            except S3Error as e:
+                if e.code == "BucketAlreadyExists":
+                    return self._respond(202)   # idempotent in swift
+                raise
+        gw.authorize_owner(name, principal)
+        if self.command == "DELETE":
+            gw.delete_bucket(name)
+            return self._respond(204)
+        if self.command in ("GET", "HEAD"):
+            limit = max(1, min(int(q.get("limit", 10000)), 10000))
+            entries, _tok = gw.list_objects(
+                name, q.get("prefix", ""), limit, q.get("marker", ""))
+            if q.get("format") == "json":
+                rows = [{"name": k, "bytes": h.get("size", 0),
+                         "last_modified": h.get("mtime", 0)}
+                        for k, h in entries]
+                body = json.dumps(rows).encode()
+                ctype = "application/json"
+            else:
+                body = ("\n".join(k for k, _h in entries)
+                        + ("\n" if entries else "")).encode()
+                ctype = "text/plain"
+            return self._respond(200 if entries else 204, body, {
+                "Content-Type": ctype,
+                "X-Container-Object-Count": str(len(entries))})
+        self._respond(405)
+
+    # -- object ---------------------------------------------------------------
+
+    def _object(self, srv: SwiftRestServer, account: str,
+                container: str, obj: str, body: bytes) -> None:
+        gw = srv.gateway
+        principal = f"swift:{account}"
+        gw.authorize_owner(container, principal)
+        if self.command == "PUT":
+            src = self.headers.get("X-Copy-From", "")
+            if src:
+                sc, _, so = src.lstrip("/").partition("/")
+                # the SOURCE needs read authorization too — without it
+                # any authenticated account could exfiltrate another
+                # account's private data via copy
+                gw.authorize(sc, principal, write=False)
+                data, head = gw.get_object(sc, so)
+                gw.put_object(container, obj, data,
+                              dict(head.get("meta") or {}))
+                return self._respond(201)
+            meta = {k[len("X-Object-Meta-"):]: v
+                    for k, v in self.headers.items()
+                    if k.lower().startswith("x-object-meta-")}
+            etag, _vid = gw.put_object(container, obj, body, meta)
+            return self._respond(201, b"", {"ETag": etag})
+        if self.command in ("GET", "HEAD"):
+            data, head = gw.get_object(container, obj)
+            hdrs = {"Content-Type": "application/octet-stream",
+                    "ETag": hashlib.md5(data).hexdigest()}
+            for mk, mv in (head.get("meta") or {}).items():
+                hdrs[f"X-Object-Meta-{mk}"] = mv
+            if self.command == "HEAD":
+                hdrs["Content-Length-Hint"] = str(head.get("size", 0))
+                return self._respond(200, b"", hdrs)
+            return self._respond(200, data, hdrs)
+        if self.command == "DELETE":
+            gw.head_object(container, obj)   # swift 404s a missing obj
+            gw.delete_object(container, obj)
+            return self._respond(204)
+        self._respond(405)

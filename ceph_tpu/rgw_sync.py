@@ -1,0 +1,220 @@
+"""RGW multisite sync — one-way zone replication over bucket datalogs
+(src/rgw/rgw_data_sync.cc + rgw_datalog.h, reduced to the pull model).
+
+Every mutating gateway op appends a record to the bucket's DATALOG
+(omap keys ``log.<ns-timestamp>`` in the bucket index, so the log rides
+the same replicated/EC pool as the data).  A ``ZoneSyncAgent`` on the
+SECONDARY zone polls the primary's registry + datalogs and replays:
+
+  * full sync on first contact (no marker): copy every current object
+  * incremental after: apply each log record past the stored marker —
+    put re-reads the object from the source, delete deletes; markers
+    persist in the secondary's ``.sync.status`` omap object, so a
+    restarted agent resumes where it left off (sync-status markers,
+    rgw_data_sync.cc's incremental marker window)
+  * processed log entries older than a retention window are trimmed on
+    the PRIMARY by the agent (single-peer trim; the reference keeps
+    per-peer markers before trimming — multiple secondaries would need
+    the same)
+
+Replays are idempotent (puts overwrite, deletes tolerate absence), so
+crash-and-restart in mid-window is safe: the marker only advances after
+the record applied."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ceph_tpu.rgw_rest import S3Error, S3Gateway
+
+DATALOG_PREFIX = "log."
+SYNC_STATUS_OID = ".sync.status"
+
+
+def datalog_append(gateway: S3Gateway, bucket: str, op: str, key: str,
+                   clock=time.time) -> None:
+    """One mutation record; ns timestamps keep keys unique + ordered."""
+    rec = {"op": op, "key": key, "t": clock()}
+    gateway.io.set_omap(
+        f".bucket.index.{bucket}",
+        {f"{DATALOG_PREFIX}{time.time_ns():020d}":
+         json.dumps(rec).encode()})
+
+
+def datalog_entries(gateway: S3Gateway, bucket: str,
+                    marker: str = "") -> list[tuple[str, dict]]:
+    """Ordered (log_key, record) past `marker`."""
+    try:
+        omap = gateway.io.get_omap(f".bucket.index.{bucket}")
+    except OSError:
+        return []
+    out = []
+    for k, v in omap.items():
+        if k.startswith(DATALOG_PREFIX) and v and k > marker:
+            out.append((k, json.loads(v.decode())))
+    out.sort()
+    return out
+
+
+def datalog_trim(gateway: S3Gateway, bucket: str, upto: str) -> int:
+    """Drop log records with key <= upto; returns how many."""
+    try:
+        omap = gateway.io.get_omap(f".bucket.index.{bucket}")
+    except OSError:
+        return 0
+    dead = [k for k in omap
+            if k.startswith(DATALOG_PREFIX) and k <= upto]
+    if dead:
+        gateway.io.rm_omap_keys(f".bucket.index.{bucket}", dead)
+    return len(dead)
+
+
+class ZoneSyncAgent:
+    """Pull-replays a primary zone's buckets into a secondary zone."""
+
+    def __init__(self, source: S3Gateway, target: S3Gateway,
+                 interval: float = 1.0, trim: bool = True):
+        self.src = source
+        self.dst = target
+        self.interval = interval
+        self.trim = trim
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- markers --------------------------------------------------------------
+
+    def _markers(self) -> dict:
+        try:
+            omap = self.dst.io.get_omap(SYNC_STATUS_OID)
+        except OSError:
+            return {}
+        return {k: v.decode() for k, v in omap.items()}
+
+    def _set_marker(self, bucket: str, marker: str) -> None:
+        self.dst.io.set_omap(SYNC_STATUS_OID, {bucket: marker.encode()})
+
+    # -- one pass -------------------------------------------------------------
+
+    def sync_once(self) -> dict:
+        """One full poll over the source registry.  Returns counters."""
+        stats = {"buckets": 0, "full_copied": 0, "applied": 0,
+                 "trimmed": 0, "errors": 0}
+        try:
+            names = sorted(self.src.io.get_omap(self.src.REGISTRY))
+        except OSError:
+            return stats
+        markers = self._markers()
+        for name in names:
+            try:
+                stats["buckets"] += 1
+                self._sync_bucket(name, markers.get(name), stats)
+            except (S3Error, OSError):
+                stats["errors"] += 1
+        # a bucket we have a marker for that vanished from the source
+        # registry was deleted on the primary: propagate the removal
+        for name in set(markers) - set(names):
+            try:
+                self._remove_bucket(name)
+                stats["applied"] += 1
+            except (S3Error, OSError):
+                stats["errors"] += 1
+        return stats
+
+    def _remove_bucket(self, name: str) -> None:
+        try:
+            b = self.dst._bucket(name)
+        except S3Error:
+            b = None
+        if b is not None:
+            for key in b.list():
+                try:
+                    b.delete_object(key, unversioned=True)
+                except KeyError:
+                    pass
+            self.dst.delete_bucket(name)
+        try:
+            self.dst.io.rm_omap_keys(SYNC_STATUS_OID, [name])
+        except OSError:
+            pass
+
+    def _ensure_bucket(self, name: str) -> None:
+        meta = None
+        try:
+            meta = self.src._bucket(name).meta_all()
+        except S3Error:
+            pass
+        try:
+            self.dst.create_bucket(name,
+                                   owner=(meta or {}).get("owner", ""))
+        except S3Error as e:
+            if e.code != "BucketAlreadyExists":
+                raise
+
+    def _copy_object(self, bucket: str, key: str) -> bool:
+        try:
+            data, head = self.src.get_object(bucket, key)
+        except S3Error:
+            return False    # deleted since the log record: skip
+        b = self.dst._bucket(bucket)
+        b.put(key, data, metadata=dict(head.get("meta") or {}),
+              clock=self.dst.clock, unversioned=True)
+        return True
+
+    def _sync_bucket(self, name: str, marker: str | None,
+                     stats: dict) -> None:
+        self._ensure_bucket(name)
+        if marker is None:
+            # FULL SYNC: snapshot the log head first — records landing
+            # during the copy replay afterwards, none are lost
+            entries = datalog_entries(self.src, name)
+            head = entries[-1][0] if entries else ""
+            src_b = self.src._bucket(name)
+            for key in src_b.list():
+                if key.startswith(self.src.MP_PREFIX + "."):
+                    continue
+                if self._copy_object(name, key):
+                    stats["full_copied"] += 1
+            self._set_marker(name, head or "log.")
+            marker = head or "log."
+            return
+        for log_key, rec in datalog_entries(self.src, name, marker):
+            op, key = rec.get("op"), rec.get("key", "")
+            if op == "put":
+                if self._copy_object(name, key):
+                    stats["applied"] += 1
+            elif op == "delete":
+                try:
+                    self.dst._bucket(name).delete_object(
+                        key, unversioned=True)
+                except (KeyError, S3Error):
+                    pass
+                stats["applied"] += 1
+            # marker advances only AFTER the record applied: a crash
+            # here replays this record again (idempotent), never skips
+            self._set_marker(name, log_key)
+            marker = log_key
+        if self.trim and marker and marker != "log.":
+            stats["trimmed"] += datalog_trim(self.src, name, marker)
+
+    # -- background loop ------------------------------------------------------
+
+    def start(self) -> "ZoneSyncAgent":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="rgw-zone-sync",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.sync_once()
+            except Exception:    # survive transient pool errors
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -87,6 +87,11 @@ def test_oversized_frame_rejected():
         s.sendall(struct.pack("<I", len(me)) + me)
         plen = struct.unpack("<I", s.recv(4))[0]
         s.recv(plen)
+        # feature negotiation frame (supported, required)
+        from ceph_tpu.msg.features import (
+            FEAT_FRAME, FEATURE_BASE, SUPPORTED_FEATURES)
+        s.sendall(FEAT_FRAME.pack(SUPPORTED_FEATURES, FEATURE_BASE))
+        s.recv(FEAT_FRAME.size)
         s.sendall(bytes(17))          # auth: mode none + zero nonce
         s.recv(17)
         # claim a 1 GiB frame: the reader must drop the connection, not
