@@ -288,6 +288,32 @@ class RadosClient(Dispatcher):
 
     # -- mon commands ---------------------------------------------------------
 
+    def mgr_command(self, cmd: dict) -> tuple[int, str]:
+        """Route a mgr-tier command (pg dump / iostat / balancer ...):
+        discover the active mgr through the mon, then send the command
+        envelope straight to it (the reference's mgr command re-target)."""
+        import json as _json
+        import time as _time
+        rc, out = self.mon_command({"prefix": "mgr dump"})
+        if rc != 0:
+            return rc, out
+        addr = _json.loads(out).get("addr", "")
+        if not addr:
+            return -2, "no active mgr"
+        with self._lock:
+            tid = self._next_tid
+            self._next_tid += 1
+            ev: tuple[threading.Event, list] = (threading.Event(), [])
+            self._cmd_waiters[tid] = ev
+        con = self.msgr.connect_to(addr, EntityName("mgr", 0))
+        con.send_message(MMonCommand(tid=tid, cmd=cmd))
+        if ev[0].wait(self.timeout):
+            ack = ev[1][0]
+            return ack.result, ack.output
+        with self._lock:
+            self._cmd_waiters.pop(tid, None)
+        return -110, "mgr command timed out"
+
     def mon_command(self, cmd: dict) -> tuple[int, str]:
         """Cycle through the monitors until the overall deadline: a mon
         may be dead, electing, or between leaders — transient windows

@@ -185,9 +185,18 @@ class MgrDaemon(Dispatcher):
         return self.msgr.my_addr
 
     def ms_dispatch(self, msg) -> bool:
-        from ceph_tpu.messages import MMonCommandAck
+        from ceph_tpu.messages import (
+            MMonCommand, MMonCommandAck)
         if isinstance(msg, MMonCommandAck):
             self.mon_cmd.handle_ack(msg)
+            return True
+        if isinstance(msg, MMonCommand):
+            # the mgr serves its own command tier (DaemonServer
+            # handle_command): clients re-target here after `mgr dump`
+            out, rc = self._handle_command(msg.cmd)
+            if msg.connection is not None:
+                msg.connection.send_message(MMonCommandAck(
+                    tid=msg.tid, result=rc, output=out))
             return True
         if isinstance(msg, MMgrReport):
             with self._lock:
@@ -207,6 +216,34 @@ class MgrDaemon(Dispatcher):
                 self._subscribe()
             return True
         return False
+
+    # -- command tier (DaemonServer::handle_command reduced) ------------------
+
+    def _handle_command(self, cmd: dict) -> tuple[str, int]:
+        import json as _json
+        prefix = cmd.get("prefix", "")
+        try:
+            if prefix == "pg dump":
+                return _json.dumps(self.pg_dump()), 0
+            if prefix == "pg ls":
+                pool = cmd.get("pool")
+                states = cmd.get("states") or None
+                if isinstance(states, str):
+                    states = [states]
+                return _json.dumps(self.pg_ls(
+                    pool=int(pool) if pool is not None else None,
+                    states=states)), 0
+            if prefix == "iostat":
+                return _json.dumps(self.iostat()), 0
+            if prefix == "balancer status":
+                return _json.dumps(self.balancer_status()), 0
+            if prefix == "balancer optimize":
+                return _json.dumps({"commands": self.balance_plan()}), 0
+            if prefix == "telemetry show":
+                return _json.dumps(self.telemetry_report()), 0
+            return f"unknown mgr command {prefix!r}", -22
+        except Exception as e:
+            return f"mgr command failed: {e!r}", -22
 
     # -- aggregate views (mgr module surface) ---------------------------------
 
@@ -343,6 +380,35 @@ class MgrDaemon(Dispatcher):
         out["total_wr_ops_s"] = round(out["total_wr_ops_s"], 3)
         out["total_rd_ops_s"] = round(out["total_rd_ops_s"], 3)
         return out
+
+    # -- telemetry module (src/pybind/mgr/telemetry analog) -------------------
+
+    def telemetry_report(self) -> dict:
+        """Anonymized cluster-shape report (`ceph telemetry show`): no
+        object names, no addresses — counts, sizes, states, pool shapes
+        and daemon versions only, like the reference's opt-in payload."""
+        m = self.osdmap
+        pools = []
+        for pid, p in m.pools.items():
+            pools.append({
+                "pool": pid, "pg_num": p.pg_num,
+                "type": ("erasure" if p.is_erasure() else "replicated"),
+                "size": getattr(p, "size", 0),
+                "cache_tier": p.tier_of >= 0})
+        df = self.df()
+        return {
+            "report_version": 1,
+            "osd": {"count": sum(1 for o in range(m.max_osd)
+                                 if m.exists(o)),
+                    "up": sum(1 for o in range(m.max_osd)
+                              if m.is_up(o))},
+            "osdmap_epoch": m.epoch,
+            "pools": pools,
+            "pg_states": self.pg_summary(),
+            "usage": {"total_objects": df["total_objects"],
+                      "total_bytes_used": df["total_bytes_used"]},
+            "health": self.health()["status"],
+        }
 
     def health(self, stale_after: float = 10.0) -> dict:
         now = time.time()

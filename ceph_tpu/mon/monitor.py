@@ -979,6 +979,19 @@ class Monitor(Dispatcher):
                     "leader": self.elector.leader if self.elector else None,
                     "election_epoch": self.elector.epoch
                     if self.elector else 0}), 0
+            if prefix == "mgr dump":
+                # active mgr discovery (MgrMonitor::dump reduced): the
+                # mgr's map subscription carries its dialable address;
+                # clients re-target mgr-tier commands (pg dump, iostat)
+                # at it, like the reference's mgr command routing
+                with self._lock:
+                    mgrs = {n: s[0] for n, s in self._subs.items()
+                            if n.startswith("mgr.")}
+                if not mgrs:
+                    return json.dumps({"addr": ""}), 0
+                name = sorted(mgrs)[0]
+                return json.dumps({"active_name": name,
+                                   "addr": mgrs[name]}), 0
             if prefix == "osd pool create":
                 return self._cmd_pool_create(cmd)
             if prefix == "osd pool set":

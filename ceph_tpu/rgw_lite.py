@@ -140,7 +140,8 @@ class Bucket:
             StripedObject(self.io, self._data_name(key), _LAYOUT).remove()
 
     def put(self, key: str, data: bytes, metadata: dict | None = None,
-            clock=time.time, unversioned: bool = False) -> dict:
+            clock=time.time, unversioned: bool = False,
+            etag: str | None = None) -> dict:
         """Write an object; under versioning each put lands as a NEW
         version (a unique id, Enabled) or as THE null version
         (Suspended).  unversioned=True forces the classic single-slot
@@ -162,6 +163,8 @@ class Bucket:
         entry = {"size": len(data), "stored": len(blob),
                  "mtime": clock(), "meta": metadata or {},
                  "compression": self.comp.name}
+        if etag is not None:
+            entry["etag"] = etag
         if vid is not None:
             entry["version_id"] = vid
             updates[self._vkey(key, vid)] = json.dumps(entry).encode()

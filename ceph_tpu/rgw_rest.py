@@ -159,6 +159,15 @@ class S3Gateway:
         #: a ZoneSyncAgent replays on the secondary (rgw_datalog analog)
         self.datalog_enabled = False
         self._lock = threading.Lock()
+        self._bucket_locks: dict[str, threading.Lock] = {}
+
+    def _block(self, bucket: str) -> threading.Lock:
+        """Per-bucket mutation lock: apply+datalog ordering is a
+        PER-BUCKET invariant — one global lock would serialize every
+        object write across all buckets."""
+        with self._lock:
+            return self._bucket_locks.setdefault(bucket,
+                                                 threading.Lock())
 
     def _datalog(self, bucket: str, op: str, key: str) -> None:
         if self.datalog_enabled:
@@ -188,7 +197,7 @@ class S3Gateway:
         b.create(owner=owner)
         if acl != "private":
             b.set_meta("acl", acl)
-        self.io.set_omap(self.REGISTRY, {name: b"1"})
+        self.io.set_omap(self.REGISTRY, {name: (owner or "-").encode()})
 
     # -- versioning / lifecycle / acl ----------------------------------------
 
@@ -294,16 +303,19 @@ class S3Gateway:
                           f"key prefix {self.MP_PREFIX!r}. is reserved "
                           "for multipart staging")
         b = self._bucket(bucket)
+        etag = hashlib.md5(data).hexdigest()
         if self.datalog_enabled:
-            # apply + log under one lock: a racing put/delete pair must
-            # log in the order it applied, or replay diverges the peer
-            with self._lock:
+            # apply + log under the BUCKET's lock: a racing put/delete
+            # pair on one key must log in the order it applied, or
+            # replay diverges the peer
+            with self._block(bucket):
                 entry = b.put(key, data, metadata=metadata,
-                              clock=self.clock)
+                              clock=self.clock, etag=etag)
                 self._datalog(bucket, "put", key)
         else:
-            entry = b.put(key, data, metadata=metadata, clock=self.clock)
-        return hashlib.md5(data).hexdigest(), entry.get("version_id")
+            entry = b.put(key, data, metadata=metadata,
+                          clock=self.clock, etag=etag)
+        return etag, entry.get("version_id")
 
     def get_object(self, bucket: str, key: str,
                    vid: str | None = None) -> tuple[bytes, dict]:
@@ -325,10 +337,23 @@ class S3Gateway:
                       vid: str | None = None) -> dict:
         try:
             if self.datalog_enabled:
-                with self._lock:
-                    out = self._bucket(bucket).delete_object(
-                        key, vid, clock=self.clock)
-                    self._datalog(bucket, "delete", key)
+                with self._block(bucket):
+                    b = self._bucket(bucket)
+                    out = b.delete_object(key, vid, clock=self.clock)
+                    # the peer mirrors CURRENT objects only: log what
+                    # happened to the current object, not the verb.  A
+                    # version-targeted delete can repoint the current
+                    # (including an undelete when a marker is removed)
+                    # or leave it untouched — replay by re-copy then;
+                    # only a key whose current is gone/marked replays
+                    # as a delete
+                    cur = b.current_entry(key)
+                    present = (cur is not None
+                               and not cur.get("delete_marker"))
+                    if present and vid is not None:
+                        self._datalog(bucket, "put", key)
+                    elif not present:
+                        self._datalog(bucket, "delete", key)
             else:
                 out = self._bucket(bucket).delete_object(
                     key, vid, clock=self.clock)
@@ -380,7 +405,7 @@ class S3Gateway:
             if not rules:
                 continue
             stats["buckets"] += 1
-            with self._lock:
+            with self._block(name):
                 for rule in rules:
                     if rule.get("status", "Enabled") != "Enabled":
                         continue

@@ -153,10 +153,7 @@ class _SwiftHandler(BaseHTTPRequestHandler):
         except S3Error as e:
             code = {"NoSuchBucket": 404, "NoSuchKey": 404,
                     "BucketNotEmpty": 409,
-                    "BucketAlreadyExists": 202,   # swift PUT is idempotent
                     "AccessDenied": 403}.get(e.code, 400)
-            if code == 202:
-                return self._respond(202)
             return self._respond(code, str(e).encode())
         except Exception as e:   # pragma: no cover
             return self._respond(500, repr(e).encode())
@@ -183,20 +180,15 @@ class _SwiftHandler(BaseHTTPRequestHandler):
 
     def _acct_buckets(self, srv: SwiftRestServer, account: str
                       ) -> list[str]:
+        # ONE registry read: owners live in the registry values, so an
+        # account listing does not fetch every bucket's index
         gw = srv.gateway
         try:
-            names = sorted(gw.io.get_omap(gw.REGISTRY))
+            reg = gw.io.get_omap(gw.REGISTRY)
         except OSError:
             return []
-        out = []
-        for n in names:
-            try:
-                meta = gw._bucket(n).meta_all()
-            except S3Error:
-                continue
-            if meta.get("owner") == f"swift:{account}":
-                out.append(n)
-        return out
+        want = f"swift:{account}".encode()
+        return sorted(n for n, owner in reg.items() if owner == want)
 
     def _account(self, srv: SwiftRestServer, account: str,
                  q: dict) -> None:
@@ -274,15 +266,23 @@ class _SwiftHandler(BaseHTTPRequestHandler):
                     if k.lower().startswith("x-object-meta-")}
             etag, _vid = gw.put_object(container, obj, body, meta)
             return self._respond(201, b"", {"ETag": etag})
-        if self.command in ("GET", "HEAD"):
-            data, head = gw.get_object(container, obj)
+        if self.command == "HEAD":
+            # metadata only — never read/decompress the body for HEAD
+            head = gw.head_object(container, obj)
             hdrs = {"Content-Type": "application/octet-stream",
-                    "ETag": hashlib.md5(data).hexdigest()}
+                    "Content-Length-Hint": str(head.get("size", 0))}
+            if head.get("etag"):
+                hdrs["ETag"] = head["etag"]
             for mk, mv in (head.get("meta") or {}).items():
                 hdrs[f"X-Object-Meta-{mk}"] = mv
-            if self.command == "HEAD":
-                hdrs["Content-Length-Hint"] = str(head.get("size", 0))
-                return self._respond(200, b"", hdrs)
+            return self._respond(200, b"", hdrs)
+        if self.command == "GET":
+            data, head = gw.get_object(container, obj)
+            hdrs = {"Content-Type": "application/octet-stream",
+                    "ETag": head.get("etag")
+                    or hashlib.md5(data).hexdigest()}
+            for mk, mv in (head.get("meta") or {}).items():
+                hdrs[f"X-Object-Meta-{mk}"] = mv
             return self._respond(200, data, hdrs)
         if self.command == "DELETE":
             gw.head_object(container, obj)   # swift 404s a missing obj

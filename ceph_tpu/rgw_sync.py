@@ -35,12 +35,14 @@ SYNC_STATUS_OID = ".sync.status"
 
 def datalog_append(gateway: S3Gateway, bucket: str, op: str, key: str,
                    clock=time.time) -> None:
-    """One mutation record; ns timestamps keep keys unique + ordered."""
+    """One mutation record.  Keys order by the INJECTED clock (so a
+    simulated clock controls ordering and trim windows in tests) with a
+    wall-clock ns tiebreaker for uniqueness under a frozen clock."""
     rec = {"op": op, "key": key, "t": clock()}
-    gateway.io.set_omap(
-        f".bucket.index.{bucket}",
-        {f"{DATALOG_PREFIX}{time.time_ns():020d}":
-         json.dumps(rec).encode()})
+    k = (f"{DATALOG_PREFIX}{int(clock() * 1e9):020d}"
+         f".{time.time_ns() % 1_000_000_000:09d}")
+    gateway.io.set_omap(f".bucket.index.{bucket}",
+                        {k: json.dumps(rec).encode()})
 
 
 def datalog_entries(gateway: S3Gateway, bucket: str,

@@ -47,7 +47,19 @@ COMMANDS = {
     ("osd", "down"): ["id"],
     ("osd", "pg-upmap-items"): ["pgid", "*id_pairs"],
     ("osd", "rm-pg-upmap-items"): ["pgid"],
+    ("mgr", "dump"): [],
+    ("pg", "dump"): [],
+    ("pg", "ls"): ["pool"],
+    ("iostat",): [],
+    ("balancer", "status"): [],
+    ("balancer", "optimize"): [],
+    ("telemetry", "show"): [],
 }
+
+#: prefixes served by the active MGR (re-targeted via `mgr dump`),
+#: like the reference's mgr command routing
+MGR_COMMANDS = {"pg dump", "pg ls", "iostat", "balancer status",
+                "balancer optimize", "telemetry show"}
 
 
 def parse_command(words: list[str]) -> dict:
@@ -119,7 +131,10 @@ def main(argv=None) -> int:
     try:
         client.msgr.bind("127.0.0.1:0")
         client.msgr.start()
-        res, out = client.mon_command(cmd)
+        if cmd["prefix"] in MGR_COMMANDS:
+            res, out = client.mgr_command(cmd)
+        else:
+            res, out = client.mon_command(cmd)
         if res == 0 and cmd["prefix"] == "osd getcrushmap" \
                 and args.outfile:
             import base64, json

@@ -145,3 +145,33 @@ def test_lifecycle_expiry_propagates(zones):
     from ceph_tpu.rgw_rest import S3Error
     with pytest.raises(S3Error):
         dst.get_object("lc", "old")
+
+
+def test_version_targeted_delete_does_not_nuke_secondary(zones):
+    # review scenario: deleting a NONCURRENT version must not replay as
+    # a hard delete of the secondary's current object; removing a
+    # delete marker (undelete) must restore the object on the peer
+    src, dst = zones
+    src.create_bucket("verz", owner="o")
+    src.set_versioning("verz", "Enabled")
+    agent = ZoneSyncAgent(src, dst)
+    agent.sync_once()
+    _, v1 = src.put_object("verz", "k", b"gen-one", {})
+    _, v2 = src.put_object("verz", "k", b"gen-two", {})
+    agent.sync_once()
+    assert dst.get_object("verz", "k")[0] == b"gen-two"
+    # delete the NONCURRENT v1: secondary must keep gen-two
+    src.delete_object("verz", "k", vid=v1)
+    agent.sync_once()
+    assert dst.get_object("verz", "k")[0] == b"gen-two"
+    # marker (plain delete) removes it from the peer...
+    res = src.delete_object("verz", "k")
+    assert res["delete_marker"]
+    agent.sync_once()
+    from ceph_tpu.rgw_rest import S3Error
+    with pytest.raises(S3Error):
+        dst.get_object("verz", "k")
+    # ...and removing the marker (undelete) restores it
+    src.delete_object("verz", "k", vid=res["version_id"])
+    agent.sync_once()
+    assert dst.get_object("verz", "k")[0] == b"gen-two"
