@@ -518,9 +518,44 @@ class CephFS(Dispatcher):
                     self._path_rank[p] = to_rank
         return out
 
+    # -- snapshots (mkdir .snap analog, explicit verbs) -----------------------
+
+    def mksnap(self, path: str, name: str) -> int:
+        """Snapshot the directory subtree at `path` (the reference's
+        `mkdir dir/.snap/name`).  Returns the pool snapid backing it."""
+        return self._request("mksnap", {"path": path,
+                                        "snap": name})["snapid"]
+
+    def rmsnap(self, path: str, name: str) -> None:
+        self._request("rmsnap", {"path": path, "snap": name})
+
+    def listsnaps(self, path: str) -> dict:
+        return self._request("lssnap", {"path": path})["snaps"]
+
+    # -- quotas (ceph.quota vxattr surface) -----------------------------------
+
+    def set_quota(self, path: str, max_bytes: int = 0,
+                  max_files: int = 0) -> None:
+        """setfattr ceph.quota.max_bytes/max_files analog; 0 clears.
+        Enforcement is MDS-side at create and size-report time, so
+        buffered writers can overshoot until their flush — the same
+        approximate enforcement the reference documents."""
+        self._request("setquota", {"path": path, "max_bytes": max_bytes,
+                                   "max_files": max_files})
+
+    def get_quota(self, path: str) -> dict:
+        return self._request("getquota", {"path": path})
+
     # -- file i/o -------------------------------------------------------------
 
-    def open(self, path: str, flags: str = "r") -> "File":
+    def open(self, path: str, flags: str = "r"):
+        if "/.snap/" in self._normpath(path):
+            if "w" in flags or "a" in flags:
+                raise OSError(30, "snapshots are read-only")  # EROFS
+            out = self._request("open", {"path": path,
+                                         "wanted": WANT_READ,
+                                         "create": False})
+            return SnapFile(self, out["inode"], out["snapid"])
         writing = "w" in flags or "a" in flags
         wanted = WANT_WRITE if writing else WANT_READ
         with self._oc_lock:
@@ -599,6 +634,57 @@ def _data_name(ino: int) -> str:
 
 def _is_tcp(msgr) -> bool:
     return msgr.is_wire
+
+
+class SnapFile:
+    """Read-only handle on a file inside a directory snapshot: attrs
+    come frozen from the snapshot record, data from pool-snapshot reads
+    at the snapshot's snapid — no capabilities involved, the content is
+    immutable by construction."""
+
+    def __init__(self, fs: "CephFS", inode: dict, snapid: int):
+        self.fs = fs
+        self._inode = dict(inode)
+        self.snapid = snapid
+        self.obj = StripedObject(fs.data_io, _data_name(inode["ino"]),
+                                 _LAYOUT)
+        self.pos = 0
+
+    @property
+    def inode(self) -> dict:
+        return dict(self._inode)
+
+    def read(self, length: int = 0) -> bytes:
+        size = self._inode.get("size", 0)
+        if length <= 0 or self.pos + length > size:
+            length = max(0, size - self.pos)
+        if length <= 0:
+            # frozen EOF: StripedObject's length<=0 fallback would
+            # substitute the CURRENT size and read past the snapshot
+            return b""
+        data = self.obj.read(self.pos, length, snapid=self.snapid)
+        if len(data) < length:
+            data += bytes(length - len(data))
+        self.pos += length
+        return bytes(data)
+
+    def seek(self, pos: int) -> None:
+        self.pos = pos
+
+    def write(self, data: bytes) -> int:
+        raise OSError(30, "snapshots are read-only")   # EROFS
+
+    def truncate(self, size: int) -> None:
+        raise OSError(30, "snapshots are read-only")
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "SnapFile":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
 
 
 class File:

@@ -30,7 +30,7 @@ from ceph_tpu.client.rados import RadosClient
 from ceph_tpu.common.context import CephTpuContext
 from ceph_tpu.common.logging import dout
 from ceph_tpu.mds.caps import ALL as ALL_CAPS
-from ceph_tpu.mds.caps import BUFFER, CapTable, caps_str
+from ceph_tpu.mds.caps import BUFFER, WR, CapTable, caps_str
 from ceph_tpu.mds.flock import (
     EOF, F_UNLCK, Lock, LockState, fcntl_range)
 from ceph_tpu.msg.encoding import Decoder, Encoder
@@ -201,10 +201,12 @@ class _Park(Exception):
 
 
 class Inode:
-    __slots__ = ("ino", "mode", "size", "mtime", "parent")
+    __slots__ = ("ino", "mode", "size", "mtime", "parent",
+                 "quota_bytes", "quota_files")
 
     def __init__(self, ino: int, mode: int, size: int = 0,
-                 mtime: float = 0.0, parent: int = 0):
+                 mtime: float = 0.0, parent: int = 0,
+                 quota_bytes: int = 0, quota_files: int = 0):
         self.ino = ino
         self.mode = mode
         self.size = size
@@ -213,18 +215,27 @@ class Inode:
         #: reconstruct an ino's path, so ino-op authority survives a
         #: restart (the in-memory exported-ino map alone would not)
         self.parent = parent
+        #: directory quotas (ceph.quota.max_bytes / max_files vxattrs);
+        #: 0 = unlimited
+        self.quota_bytes = quota_bytes
+        self.quota_files = quota_files
 
     def is_dir(self) -> bool:
         return bool(self.mode & S_IFDIR)
 
     def to_dict(self) -> dict:
-        return {"ino": self.ino, "mode": self.mode, "size": self.size,
-                "mtime": self.mtime, "parent": self.parent}
+        d = {"ino": self.ino, "mode": self.mode, "size": self.size,
+             "mtime": self.mtime, "parent": self.parent}
+        if self.quota_bytes or self.quota_files:
+            d["quota_bytes"] = self.quota_bytes
+            d["quota_files"] = self.quota_files
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "Inode":
         return Inode(d["ino"], d["mode"], d.get("size", 0),
-                     d.get("mtime", 0.0), d.get("parent", 0))
+                     d.get("mtime", 0.0), d.get("parent", 0),
+                     d.get("quota_bytes", 0), d.get("quota_files", 0))
 
 
 class MDSDaemon(Dispatcher):
@@ -739,7 +750,30 @@ class MDSDaemon(Dispatcher):
                     inode.mtime = ev["mtime"]
                 if "mode" in ev:
                     inode.mode = ev["mode"]
+                if "quota_bytes" in ev:
+                    inode.quota_bytes = int(ev["quota_bytes"])
+                if "quota_files" in ev:
+                    inode.quota_files = int(ev["quota_files"])
                 self._dirty_inodes.add(inode.ino)
+            return
+        if kind == "mksnap":
+            # directory snapshot (snaprealm reduced): the frozen subtree
+            # metadata persists under snap.<ino>; file DATA as of the
+            # snapshot is served by pool-snapshot reads at ev["snapid"]
+            recs = self._load_snaps(ev["ino"])
+            recs[ev["name"]] = {"snapid": ev["snapid"],
+                                "created": ev.get("created", 0.0),
+                                "tree": ev["tree"]}
+            self.meta_io.set_omap(
+                self._snap_obj(ev["ino"]),
+                {"json": json.dumps(recs).encode()})
+            return
+        if kind == "rmsnap":
+            recs = self._load_snaps(ev["ino"])
+            if recs.pop(ev["name"], None) is not None:
+                self.meta_io.set_omap(
+                    self._snap_obj(ev["ino"]),
+                    {"json": json.dumps(recs).encode()})
             return
         raise ValueError(f"unknown journal event {kind!r}")
 
@@ -749,6 +783,211 @@ class MDSDaemon(Dispatcher):
         self._journal(ev)
         self._apply(ev)
         self._maybe_trim()
+
+    # -- quotas (ceph.quota.max_bytes/max_files vxattrs reduced) --------------
+
+    def _quota_roots(self, ino: int):
+        """Quota-bearing ancestor dirs of ino, nearest first (the
+        snaprealm-style walk up primary-link backpointers)."""
+        seen = set()
+        cur = self._load_inode(ino)
+        while cur is not None and cur.ino not in seen:
+            seen.add(cur.ino)
+            if cur.is_dir() and (cur.quota_bytes or cur.quota_files):
+                yield cur
+            if cur.ino == ROOT_INO:
+                return
+            cur = self._load_inode(cur.parent)
+
+    def _subtree_usage(self, ino: int) -> tuple[int, int]:
+        """(bytes, entries) under a dir — a walk, not cached rstats:
+        quota checks here are O(subtree), the honest trade at this
+        scale (the reference maintains recursive statistics)."""
+        nbytes = nfiles = 0
+        stack = [ino]
+        seen = set()
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            for _name, child in self._load_dir(cur).items():
+                ci = self._load_inode(child)
+                if ci is None:
+                    continue
+                nfiles += 1
+                if ci.is_dir():
+                    stack.append(child)
+                else:
+                    nbytes += ci.size
+        return nbytes, nfiles
+
+    def _check_quota(self, at_ino: int, add_files: int = 0,
+                     add_bytes: int = 0) -> bool:
+        """True iff adding (files, bytes) under at_ino stays within
+        every enclosing quota (Client::check_quota_condition)."""
+        for root in self._quota_roots(at_ino):
+            used_b, used_f = self._subtree_usage(root.ino)
+            if root.quota_files and add_files \
+                    and used_f + add_files > root.quota_files:
+                return False
+            if root.quota_bytes and add_bytes \
+                    and used_b + add_bytes > root.quota_bytes:
+                return False
+        return True
+
+    # -- snapshots (snaprealm/SnapServer reduced) -----------------------------
+
+    def _snap_obj(self, ino: int) -> str:
+        return f"snap.{ino:x}"
+
+    def _load_snaps(self, ino: int) -> dict:
+        try:
+            omap = self.meta_io.get_omap(self._snap_obj(ino))
+        except OSError:
+            return {}
+        blob = omap.get("json")
+        return json.loads(blob.decode()) if blob else {}
+
+    @staticmethod
+    def _split_snap_path(path: str) -> tuple[str, str, str] | None:
+        """('/d', 's1', 'rest/of/path') for '/d/.snap/s1/rest', or None
+        for a live path.  '/d/.snap' itself returns ('/d', '', '')."""
+        parts = [p for p in path.split("/") if p]
+        if ".snap" not in parts:
+            return None
+        i = parts.index(".snap")
+        dirpath = "/" + "/".join(parts[:i])
+        snap = parts[i + 1] if len(parts) > i + 1 else ""
+        rest = "/".join(parts[i + 2:])
+        return dirpath, snap, rest
+
+    def _freeze_tree(self, ino: int, client: int) -> dict:
+        """Frozen metadata of the subtree rooted at ino: relpath ->
+        inode dict ('' = the root dir).  Buffered writers are recalled
+        first so frozen sizes are the truth (may _Park; reruns)."""
+        tree: dict[str, dict] = {}
+        stack = [("", ino)]
+        while stack:
+            rel, cur = stack.pop()
+            inode = self._load_inode(cur)
+            if inode is None:
+                continue
+            if not inode.is_dir():
+                self._fresh_inode(cur, requester=client)
+                inode = self._load_inode(cur)
+            tree[rel] = inode.to_dict()
+            if inode.is_dir():
+                for name, child in self._load_dir(cur).items():
+                    stack.append((f"{rel}/{name}".lstrip("/"), child))
+        return tree
+
+    def _do_mksnap(self, a: dict) -> tuple[int, dict]:
+        client = int(a.get("client", -1))
+        name = a.get("snap", "")
+        if not name or "/" in name or name.startswith("."):
+            return -22, {}
+        _parent, ino, _n = self._resolve(a["path"])
+        if ino is None:
+            return -2, {}
+        inode = self._load_inode(ino)
+        if inode is None or not inode.is_dir():
+            return -20, {}   # ENOTDIR
+        if name in self._load_snaps(ino):
+            return -17, {}   # EEXIST
+        # freeze metadata FIRST (parks until buffers flushed), then take
+        # the pool snapshot: data written after the freeze point but
+        # before the pool snap can only make the snapshot NEWER than the
+        # frozen sizes claim, never truncate it
+        tree = self._freeze_tree(ino, client)
+        rc, out = self.objecter.mon_command({
+            "prefix": "osd pool mksnap", "pool": self.data_pool,
+            "snap": f"cephfs.{ino:x}.{name}"})
+        if rc != 0:
+            return rc if rc < 0 else -5, {}
+        reply = json.loads(out)
+        if "epoch" in reply:
+            self.objecter.wait_for_epoch(reply["epoch"])
+        self._mutate({"e": "mksnap", "ino": ino, "name": name,
+                      "snapid": reply["snapid"], "tree": tree,
+                      "created": time.time()})
+        return 0, {"snapid": reply["snapid"]}
+
+    def _do_rmsnap(self, a: dict) -> tuple[int, dict]:
+        name = a.get("snap", "")
+        _parent, ino, _n = self._resolve(a["path"])
+        if ino is None:
+            return -2, {}
+        if name not in self._load_snaps(ino):
+            return -2, {}
+        rc, _out = self.objecter.mon_command({
+            "prefix": "osd pool rmsnap", "pool": self.data_pool,
+            "snap": f"cephfs.{ino:x}.{name}"})
+        # ENOENT from the mon is fine: a crash between rmsnap halves
+        self._mutate({"e": "rmsnap", "ino": ino, "name": name})
+        return 0, {}
+
+    def _snap_record(self, path: str) -> tuple[int, dict, str, dict] | None:
+        """(dir_ino, snap_record, rest, tree) for a .snap path whose
+        snapshot exists, else None."""
+        sp = self._split_snap_path(path)
+        if sp is None:
+            return None
+        dirpath, snap, rest = sp
+        _parent, ino, _n = self._resolve(dirpath)
+        if ino is None:
+            return None
+        recs = self._load_snaps(ino)
+        rec = recs.get(snap)
+        if rec is None:
+            return None
+        return ino, rec, rest, rec["tree"]
+
+    def _handle_snap_path(self, op: str, a: dict) -> tuple[int, dict]:
+        """Read-only ops under dir/.snap/... served from frozen trees."""
+        path = a["path"]
+        sp = self._split_snap_path(path)
+        dirpath, snap, rest = sp
+        if not snap:
+            # dir/.snap listing: snapshot names as directory entries
+            _parent, ino, _n = self._resolve(dirpath)
+            if ino is None:
+                return -2, {}
+            if op == "readdir":
+                recs = self._load_snaps(ino)
+                return 0, {"entries": {n: {"snapid": r["snapid"],
+                                           "created": r["created"]}
+                                       for n, r in recs.items()}}
+            return -22, {}
+        found = self._snap_record(path)
+        if found is None:
+            return -2, {}
+        _ino, rec, rest, tree = found
+        entry = tree.get(rest)
+        if entry is None:
+            return -2, {}
+        if op in ("lookup", "getattr"):
+            return 0, {"inode": dict(entry), "snapid": rec["snapid"]}
+        if op == "open":
+            if a.get("create") or (int(a.get("wanted", 0))
+                                   & (WR | BUFFER)):
+                return -30, {}   # EROFS: snapshots are immutable
+            # no capabilities: the content is frozen, nothing to revoke
+            return 0, {"inode": dict(entry), "snapid": rec["snapid"],
+                       "caps": 0, "cap_seq": 0}
+        if op == "readdir":
+            prefix = rest + "/" if rest else ""
+            out = {}
+            for rel, ent in tree.items():
+                if rel == rest or not rel.startswith(prefix):
+                    continue
+                tail = rel[len(prefix):]
+                if "/" not in tail:
+                    out[tail] = {"ino": ent.get("ino"),
+                                 "dir": bool(ent.get("mode", 0)
+                                             & S_IFDIR)}
+            return 0, {"entries": out}
+        return -30, {}   # any mutation under .snap
 
     # -- subtree authority (Migrator/MDBalancer reduced) ----------------------
 
@@ -1207,11 +1446,57 @@ class MDSDaemon(Dispatcher):
         # multi-active authority: path ops forward to the delegated
         # rank; ino ops forward once the ino's subtree was exported
         if op in ("lookup", "mkdir", "create", "open", "readdir",
-                  "unlink", "rmdir", "export_dir"):
+                  "unlink", "rmdir", "export_dir", "mksnap", "rmsnap",
+                  "lssnap", "setquota", "getquota"):
             fwd = self._check_path_authority(
                 a["path"], allow_frozen=(op == "export_dir"))
             if fwd is not None:
                 return fwd
+        # read-only views into directory snapshots (dir/.snap/...):
+        # SEGMENT-based detection — a component merely prefixed
+        # ".snap" (".snapshots") is an ordinary name
+        if "path" in a and self._split_snap_path(
+                self._norm(a["path"])) is not None:
+            if op in ("lookup", "open", "readdir", "getattr"):
+                return self._handle_snap_path(op, a)
+            if op in ("mkdir", "create", "unlink", "rmdir", "setattr",
+                      "rename", "mksnap", "rmsnap", "setquota"):
+                return -30, {}   # EROFS: snapshots are immutable
+        if op == "mksnap":
+            return self._do_mksnap(a)
+        if op == "rmsnap":
+            return self._do_rmsnap(a)
+        if op == "setquota":
+            _p, qino, _n = self._resolve(a["path"])
+            if qino is None:
+                return -2, {}
+            qi = self._load_inode(qino)
+            if qi is None or not qi.is_dir():
+                return -20, {}
+            self._mutate({"e": "setattr", "ino": qino,
+                          "quota_bytes": int(a.get("max_bytes", 0)),
+                          "quota_files": int(a.get("max_files", 0))})
+            return 0, {}
+        if op == "getquota":
+            _p, qino, _n = self._resolve(a["path"])
+            if qino is None:
+                return -2, {}
+            qi = self._load_inode(qino)
+            if qi is None:
+                return -2, {}
+            used_b, used_f = self._subtree_usage(qino) \
+                if qi.is_dir() else (qi.size, 0)
+            return 0, {"max_bytes": qi.quota_bytes,
+                       "max_files": qi.quota_files,
+                       "used_bytes": used_b, "used_files": used_f}
+        if op == "lssnap":
+            _p, sino, _n = self._resolve(a["path"])
+            if sino is None:
+                return -2, {}
+            return 0, {"snaps": {n: {"snapid": r["snapid"],
+                                     "created": r["created"]}
+                                 for n, r in
+                                 self._load_snaps(sino).items()}}
         elif op == "rename":
             fa = self._check_path_authority(a["src"])
             if fa is not None:
@@ -1281,6 +1566,8 @@ class MDSDaemon(Dispatcher):
                     return -2, {}
                 if not a.get("create"):
                     return -2, {}
+                if not self._check_quota(parent, add_files=1):
+                    return -122, {}   # EDQUOT
                 ino = self._alloc_ino()
                 self._mutate({"e": "link", "parent": parent, "name": name,
                               "ino": ino,
@@ -1358,6 +1645,8 @@ class MDSDaemon(Dispatcher):
                 return -2, {}
             if ino is not None:
                 return -17, {}  # EEXIST
+            if not self._check_quota(parent, add_files=1):
+                return -122, {}   # EDQUOT
             new = self._alloc_ino()
             self._mutate({"e": "link", "parent": parent, "name": name,
                           "ino": new, "mode": S_IFDIR | a.get("mode", 0o755),
@@ -1374,6 +1663,8 @@ class MDSDaemon(Dispatcher):
                     return -21, {}  # EISDIR
                 return 0, {"inode": inode.to_dict(),
                            "data_pool": self.data_pool}
+            if not self._check_quota(parent, add_files=1):
+                return -122, {}   # EDQUOT
             new = self._alloc_ino()
             self._mutate({"e": "link", "parent": parent, "name": name,
                           "ino": new, "mode": S_IFREG | a.get("mode", 0o644),
@@ -1454,6 +1745,11 @@ class MDSDaemon(Dispatcher):
                 # a size change (truncate / size writeback) must not
                 # race a buffered writer: flush them first
                 self._fresh_inode(a["ino"], requester=client)
+                cur = self._load_inode(a["ino"])
+                delta = int(a["size"]) - (cur.size if cur else 0)
+                if delta > 0 and not self._check_quota(
+                        a["ino"], add_bytes=delta):
+                    return -122, {}   # EDQUOT
             self._mutate(ev)
             return 0, {"inode": self._inodes[a["ino"]].to_dict()}
 

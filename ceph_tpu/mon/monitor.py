@@ -985,8 +985,11 @@ class Monitor(Dispatcher):
                 # clients re-target mgr-tier commands (pg dump, iostat)
                 # at it, like the reference's mgr command routing
                 with self._lock:
+                    # skip subscriptions whose session died: a dead
+                    # mgr's address must not be served as active
                     mgrs = {n: s[0] for n, s in self._subs.items()
-                            if n.startswith("mgr.")}
+                            if n.startswith("mgr.")
+                            and not getattr(s[2], "_down", False)}
                 if not mgrs:
                     return json.dumps({"addr": ""}), 0
                 name = sorted(mgrs)[0]

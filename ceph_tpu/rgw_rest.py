@@ -508,7 +508,8 @@ class S3Gateway:
             chunks.append(data)
         whole = b"".join(chunks)
         b.put(key, whole, metadata=manifest.get("meta") or {},
-              clock=self.clock)
+              clock=self.clock,
+              etag=hashlib.md5(whole).hexdigest())
         self._datalog(bucket, "put", key)
         self._abort_locked(b, upload_id)
         return hashlib.md5(whole).hexdigest()

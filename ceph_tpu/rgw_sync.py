@@ -23,6 +23,7 @@ the record applied."""
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
@@ -30,6 +31,7 @@ import time
 from ceph_tpu.rgw_rest import S3Error, S3Gateway
 
 DATALOG_PREFIX = "log."
+_APPEND_SEQ = itertools.count()
 SYNC_STATUS_OID = ".sync.status"
 
 
@@ -39,8 +41,10 @@ def datalog_append(gateway: S3Gateway, bucket: str, op: str, key: str,
     simulated clock controls ordering and trim windows in tests) with a
     wall-clock ns tiebreaker for uniqueness under a frozen clock."""
     rec = {"op": op, "key": key, "t": clock()}
+    # tiebreaker is a process-monotonic counter: a wall-clock-derived
+    # one wraps and can reorder records sharing the primary key
     k = (f"{DATALOG_PREFIX}{int(clock() * 1e9):020d}"
-         f".{time.time_ns() % 1_000_000_000:09d}")
+         f".{next(_APPEND_SEQ) % 1_000_000_000:09d}")
     gateway.io.set_omap(f".bucket.index.{bucket}",
                         {k: json.dumps(rec).encode()})
 
@@ -159,9 +163,12 @@ class ZoneSyncAgent:
             data, head = self.src.get_object(bucket, key)
         except S3Error:
             return False    # deleted since the log record: skip
+        import hashlib
         b = self.dst._bucket(bucket)
         b.put(key, data, metadata=dict(head.get("meta") or {}),
-              clock=self.dst.clock, unversioned=True)
+              clock=self.dst.clock, unversioned=True,
+              etag=head.get("etag")
+              or hashlib.md5(data).hexdigest())
         return True
 
     def _sync_bucket(self, name: str, marker: str | None,

@@ -1,0 +1,190 @@
+"""CephFS directory snapshots (snaprealm/SnapServer reduced): frozen
+subtree metadata + pool-snapshot data reads through dir/.snap paths,
+immutability, unlink survival, rmsnap, and MDS crash replay."""
+
+from __future__ import annotations
+
+import pytest
+
+from ceph_tpu.cephfs import CephFS
+from ceph_tpu.tools.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osds=3, ms_type="loopback").start()
+    c.wait_for_osd_count(3)
+    client = c.client(timeout=20.0)
+    meta = c.create_pool(client, pg_num=4, size=2)
+    data = c.create_pool(client, pg_num=8, size=2)
+    c.run_mds(meta, data)
+    c._fs_pools = (meta, data)
+    yield c
+    c.stop()
+
+
+@pytest.fixture
+def fs(cluster):
+    f = CephFS(cluster.mon_host, cluster.mds.addr, ms_type="loopback")
+    f.mount()
+    yield f
+    f.unmount()
+
+
+def test_snapshot_freezes_content(fs):
+    fs.mkdir("/snapd")
+    with fs.open("/snapd/a.txt", "w") as f:
+        f.write(b"generation one")
+    fs.mkdir("/snapd/sub")
+    with fs.open("/snapd/sub/b.txt", "w") as f:
+        f.write(b"nested")
+    snapid = fs.mksnap("/snapd", "s1")
+    assert snapid > 0
+    assert "s1" in fs.listsnaps("/snapd")
+
+    # mutate the live tree: overwrite, append, new file
+    with fs.open("/snapd/a.txt", "w") as f:
+        f.write(b"generation TWO is longer")
+    with fs.open("/snapd/new.txt", "w") as f:
+        f.write(b"born later")
+
+    # the snapshot still serves generation one
+    with fs.open("/snapd/.snap/s1/a.txt") as f:
+        assert f.read() == b"generation one"
+    with fs.open("/snapd/.snap/s1/sub/b.txt") as f:
+        assert f.read() == b"nested"
+    # and the live tree serves the new world
+    with fs.open("/snapd/a.txt") as f:
+        assert f.read() == b"generation TWO is longer"
+
+    # frozen listing has no new.txt; live listing does
+    snap_entries = fs.listdir("/snapd/.snap/s1")
+    assert set(snap_entries) == {"a.txt", "sub"}
+    assert "new.txt" in fs.listdir("/snapd")
+    # .snap listing names the snapshots
+    assert "s1" in fs.listdir("/snapd/.snap")
+    # stat through the snap path reports the frozen size
+    assert fs.stat("/snapd/.snap/s1/a.txt")["size"] == \
+        len(b"generation one")
+
+
+def test_snapshot_survives_unlink(fs):
+    fs.mkdir("/keep")
+    with fs.open("/keep/doomed.txt", "w") as f:
+        f.write(b"still here after unlink")
+    fs.mksnap("/keep", "before")
+    fs.unlink("/keep/doomed.txt")
+    with pytest.raises(OSError):
+        fs.stat("/keep/doomed.txt")
+    with fs.open("/keep/.snap/before/doomed.txt") as f:
+        assert f.read() == b"still here after unlink"
+
+
+def test_snapshots_are_immutable(fs):
+    fs.mkdir("/ro")
+    with fs.open("/ro/f", "w") as f:
+        f.write(b"x")
+    fs.mksnap("/ro", "s")
+    with pytest.raises(OSError):
+        fs.open("/ro/.snap/s/f", "w")
+    f = fs.open("/ro/.snap/s/f")
+    with pytest.raises(OSError):
+        f.write(b"nope")
+    with pytest.raises(OSError):
+        fs.unlink("/ro/.snap/s/f")
+    with pytest.raises(OSError):
+        fs.mkdir("/ro/.snap/s/newdir")
+
+
+def test_rmsnap_and_errors(fs):
+    fs.mkdir("/life")
+    with fs.open("/life/f", "w") as f:
+        f.write(b"v")
+    fs.mksnap("/life", "s1")
+    with pytest.raises(OSError):
+        fs.mksnap("/life", "s1")        # EEXIST
+    with pytest.raises(OSError):
+        fs.mksnap("/nonexistent", "s")  # ENOENT
+    fs.rmsnap("/life", "s1")
+    assert fs.listsnaps("/life") == {}
+    with pytest.raises(OSError):
+        fs.open("/life/.snap/s1/f")
+    with pytest.raises(OSError):
+        fs.rmsnap("/life", "s1")        # already gone
+
+
+def test_snapshot_survives_mds_restart(cluster, fs):
+    fs.mkdir("/dur")
+    with fs.open("/dur/f", "w") as f:
+        f.write(b"durable content")
+    fs.mksnap("/dur", "keeper")
+    with fs.open("/dur/f", "w") as f:
+        f.write(b"changed after snap")
+    # crash + restart the MDS (suppress the shutdown flush so the
+    # journal itself must carry the snapshot records)
+    cluster.mds._flush_dirty = lambda: None
+    cluster.mds.journal.trim = lambda *a, **k: None
+    cluster.kill_mds()
+    cluster.run_mds(*cluster._fs_pools)
+    f2 = CephFS(cluster.mon_host, cluster.mds.addr, ms_type="loopback")
+    f2.mount()
+    try:
+        assert "keeper" in f2.listsnaps("/dur")
+        with f2.open("/dur/.snap/keeper/f") as fh:
+            assert fh.read() == b"durable content"
+    finally:
+        f2.unmount()
+
+
+# -- quotas (sharing the module cluster) --------------------------------------
+
+def test_quota_max_files(fs):
+    fs.mkdir("/q1")
+    fs.set_quota("/q1", max_files=3)
+    fs.mkdir("/q1/d1")                     # 1
+    with fs.open("/q1/f1", "w") as f:      # 2
+        f.write(b"x")
+    with fs.open("/q1/d1/f2", "w") as f:   # 3 (nested counts)
+        f.write(b"y")
+    with pytest.raises(OSError) as ei:
+        fs.open("/q1/f3", "w")
+    assert ei.value.errno == 122           # EDQUOT
+    with pytest.raises(OSError):
+        fs.mkdir("/q1/d2")
+    # freeing an entry unblocks creation
+    fs.unlink("/q1/f1")
+    with fs.open("/q1/f3", "w") as f:
+        f.write(b"z")
+    q = fs.get_quota("/q1")
+    assert q["max_files"] == 3 and q["used_files"] == 3
+
+
+def test_quota_max_bytes(fs):
+    fs.mkdir("/q2")
+    fs.set_quota("/q2", max_bytes=1000)
+    with fs.open("/q2/a", "w") as f:
+        f.write(b"A" * 600)
+    # second write pushing past 1000 bytes is refused at flush/report
+    with pytest.raises(OSError) as ei:
+        with fs.open("/q2/b", "w") as f:
+            f.write(b"B" * 600)
+    assert ei.value.errno == 122
+    # clearing the quota lifts the limit
+    fs.set_quota("/q2", max_bytes=0)
+    with fs.open("/q2/c", "w") as f:
+        f.write(b"C" * 600)
+    q = fs.get_quota("/q2")
+    assert q["max_bytes"] == 0
+
+
+def test_dot_snap_prefixed_names_are_ordinary(fs):
+    # ".snapshots" is a normal directory name — only the exact ".snap"
+    # segment is magic
+    fs.mkdir("/backups")
+    fs.mkdir("/backups/.snapshots")
+    with fs.open("/backups/.snapshots/f", "w") as f:
+        f.write(b"ordinary file")
+    with fs.open("/backups/.snapshots/f") as f:
+        assert f.read() == b"ordinary file"
+    assert "f" in fs.listdir("/backups/.snapshots")
+    fs.unlink("/backups/.snapshots/f")
