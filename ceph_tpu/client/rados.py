@@ -293,11 +293,14 @@ class RadosClient(Dispatcher):
         discover the active mgr through the mon, then send the command
         envelope straight to it (the reference's mgr command re-target)."""
         import json as _json
-        import time as _time
-        rc, out = self.mon_command({"prefix": "mgr dump"})
-        if rc != 0:
-            return rc, out
-        addr = _json.loads(out).get("addr", "")
+        mgr_db = self.osdmap.mgr_db or {}
+        addr = mgr_db.get("addr", "")
+        if not addr:
+            # pre-mgr_db mons: fall back to asking
+            rc, out = self.mon_command({"prefix": "mgr dump"})
+            if rc != 0:
+                return rc, out
+            addr = _json.loads(out).get("addr", "")
         if not addr:
             return -2, "no active mgr"
         with self._lock:

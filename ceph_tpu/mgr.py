@@ -99,9 +99,10 @@ class MgrDaemon(Dispatcher):
 
     def __init__(self, mon_addr: str, ms_type: str = "async",
                  addr: str = "127.0.0.1:0", auth_key=None,
-                 cephx: tuple[str, str] | None = None):
+                 cephx: tuple[str, str] | None = None, mgr_id: int = 0):
         self.mon_addr = mon_addr
-        self.name = EntityName("mgr", 0)
+        self.mgr_id = mgr_id
+        self.name = EntityName("mgr", mgr_id)
         self.osdmap = OSDMap()
         self._lock = threading.Lock()
         #: osd -> (last report time, MMgrReport)

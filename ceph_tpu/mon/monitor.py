@@ -387,8 +387,8 @@ class Monitor(Dispatcher):
             # never fan the paxos value out: it carries the auth keys
             msg = MOSDMapMsg(epoch=newmap.epoch,
                              map_blob=encode_osdmap(newmap))
-        for _addr, _entity, con in subs:
-            con.send_message(msg)
+        for sub in subs:
+            sub[2].send_message(msg)
 
     def _schedule_tick(self) -> None:
         if self._stop:
@@ -407,8 +407,44 @@ class Monitor(Dispatcher):
                 self._check_mds_failures()
             if self.is_leader():
                 self._maybe_rotate_service_keys()
+                self._check_mgr_map()
         finally:
             self._schedule_tick()
+
+    MGR_SUB_GRACE = 12.0
+
+    def _live_mgr_subs(self) -> dict:
+        """mgr.* subscriptions whose session is up AND recently
+        renewed (subscribers renew every ~5 s)."""
+        now = time.time()
+        with self._lock:
+            return {n: s[0] for n, s in self._subs.items()
+                    if n.startswith("mgr.")
+                    and not getattr(s[2], "_down", False)
+                    and now - (s[3] if len(s) > 3 else now)
+                    < self.MGR_SUB_GRACE}
+
+    def _check_mgr_map(self) -> None:
+        """Publish/maintain the active-mgr record (MgrMonitor
+        reduced): keep the current active while it lives; promote the
+        first live standby when it dies; clear when none remain.  OSDs
+        and clients learn the change through their map subscription."""
+        live = self._live_mgr_subs()
+        cur = self.osdmap.mgr_db
+        if cur and live.get(cur.get("active_name")) == cur.get("addr"):
+            return
+        if not live and not cur:
+            return
+        desired: dict = {}
+        if live:
+            name = sorted(live)[0]
+            desired = {"active_name": name, "addr": live[name]}
+
+        def fn(m: OSDMap, desired=desired):
+            if m.mgr_db == desired:
+                return False
+            m.mgr_db = desired
+        self._work_q.put(("mgr_map", fn, None))
 
     def _maybe_rotate_service_keys(self) -> None:
         """Leader: advance stale service-key generations (KeyServer
@@ -539,7 +575,7 @@ class Monitor(Dispatcher):
                     self._do_mds_beacon(payload)
                 elif kind == "mds_failover":
                     self._do_mds_failover(payload)
-                elif kind == "rotate_keys":
+                elif kind in ("rotate_keys", "mgr_map"):
                     self._mutate(payload)
             except Exception:
                 from ceph_tpu.common.logging import get_logger
@@ -653,7 +689,7 @@ class Monitor(Dispatcher):
                 # would need credentials no one holds for "client"
                 # targets, and a fake push must be impossible anyway
                 self._subs[msg.name] = (msg.addr, entity,
-                                        msg.connection)
+                                        msg.connection, time.time())
                 epoch = self.osdmap.epoch
                 reply = None
                 if epoch > 0 and epoch > msg.epoch:
@@ -984,12 +1020,9 @@ class Monitor(Dispatcher):
                 # mgr's map subscription carries its dialable address;
                 # clients re-target mgr-tier commands (pg dump, iostat)
                 # at it, like the reference's mgr command routing
-                with self._lock:
-                    # skip subscriptions whose session died: a dead
-                    # mgr's address must not be served as active
-                    mgrs = {n: s[0] for n, s in self._subs.items()
-                            if n.startswith("mgr.")
-                            and not getattr(s[2], "_down", False)}
+                if self.osdmap.mgr_db:
+                    return json.dumps(self.osdmap.mgr_db), 0
+                mgrs = self._live_mgr_subs()
                 if not mgrs:
                     return json.dumps({"addr": ""}), 0
                 name = sorted(mgrs)[0]

@@ -441,8 +441,17 @@ class OSDDaemon(Dispatcher):
         self._tick_timer.start()
 
     def _mgr_report(self) -> None:
-        if not self.mgr_addr:
+        # the map's active-mgr record (MgrMap) wins; the static
+        # constructor address is the pre-mgr_db fallback
+        mgr_db = self.osdmap.mgr_db or {}
+        mgr_addr = mgr_db.get("addr") or self.mgr_addr
+        if not mgr_addr:
             return
+        mgr_name = mgr_db.get("active_name", "mgr.0")
+        try:
+            mgr_rank = int(mgr_name.split(".")[1])
+        except (IndexError, ValueError):
+            mgr_rank = 0
         from ceph_tpu.mgr import MMgrReport
         states: dict[str, int] = {}
         n_obj = n_bytes = 0
@@ -490,7 +499,7 @@ class OSDDaemon(Dispatcher):
                     "log_size": len(pg.log.entries),
                     "log_head": pg.log.head, "log_tail": tail}
         counters = dict(self.perf._u64)
-        con = self.msgr.connect_to(self.mgr_addr, EntityName("mgr", 0))
+        con = self.msgr.connect_to(mgr_addr, EntityName("mgr", mgr_rank))
         con.send_message(MMgrReport(
             osd_id=self.osd_id, counters=counters, pg_states=states,
             num_objects=n_obj, bytes_used=n_bytes, pg_stats=pg_stats))

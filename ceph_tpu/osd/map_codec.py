@@ -235,8 +235,10 @@ def encode_osdmap(m: OSDMap, *, with_auth: bool = False) -> bytes:
                 if (with_auth and m.auth_db) else b"")
         # v8: FSMap (MDSMonitor FSMap) — public, clients route by it
         e.bytes(_json.dumps(m.fs_db).encode() if m.fs_db else b"")
+        # v9: active-mgr record (MgrMap) — OSDs/clients re-target by it
+        e.bytes(_json.dumps(m.mgr_db).encode() if m.mgr_db else b"")
 
-    enc.versioned(8, 1, body)
+    enc.versioned(9, 1, body)
     return enc.tobytes()
 
 
@@ -302,7 +304,8 @@ def diff_osdmap(old: OSDMap, new: OSDMap) -> dict:
         enc_new = Encoder()
         encode_crush(new.crush, enc_new)
         inc["crush"] = enc_new.tobytes()
-    for attr in ("config_db", "fs_db", "crush_names"):
+    for attr in ("config_db", "fs_db", "crush_names",
+                 "mgr_db"):
         if getattr(old, attr) != getattr(new, attr):
             inc[attr] = _json.dumps(getattr(new, attr))
     return inc
@@ -343,7 +346,8 @@ def apply_incremental(m: OSDMap, inc: dict) -> None:
         m.osd_xinfo[i] = x
     if "crush" in inc:
         m.crush = decode_crush(Decoder(inc["crush"]))
-    for attr in ("config_db", "fs_db", "crush_names"):
+    for attr in ("config_db", "fs_db", "crush_names",
+                 "mgr_db"):
         if attr in inc:
             setattr(m, attr, _json.loads(inc[attr]))
     m.epoch = inc["epoch"]
@@ -378,7 +382,8 @@ def encode_incremental(inc: dict) -> bytes:
                              e2.f64(x.laggy_probability),
                              e2.f64(x.laggy_interval)))
         e.bytes(inc.get("crush", b""))
-        for attr in ("config_db", "fs_db", "crush_names"):
+        for attr in ("config_db", "fs_db", "crush_names",
+                 "mgr_db"):
             has = attr in inc
             e.u8(1 if has else 0)
             if has:
@@ -428,7 +433,8 @@ def decode_incremental(data: bytes) -> dict:
         crush = d.bytes()
         if crush:
             inc["crush"] = crush
-        for attr in ("config_db", "fs_db", "crush_names"):
+        for attr in ("config_db", "fs_db", "crush_names",
+                 "mgr_db"):
             if d.u8():
                 inc[attr] = d.bytes().decode()
         return inc
@@ -493,6 +499,7 @@ def decode_osdmap(data: bytes) -> OSDMap:
         config_db = {}
         auth_db = {}
         fs_db = {}
+        mgr_db = {}
         if version >= 6:
             import json as _json
             blob = d.bytes()
@@ -506,8 +513,13 @@ def decode_osdmap(data: bytes) -> OSDMap:
                 blob = d.bytes()
                 if blob:
                     fs_db = _json.loads(blob.decode())
+            if version >= 9:
+                blob = d.bytes()
+                if blob:
+                    mgr_db = _json.loads(blob.decode())
         return OSDMap(epoch=epoch, crush=crush, max_osd=max_osd,
                       config_db=config_db, auth_db=auth_db, fs_db=fs_db,
+                      mgr_db=mgr_db,
                       crush_names=crush_names, osd_xinfo=xinfo,
                       osd_state=osd_state, osd_weight=osd_weight,
                       osd_primary_affinity=affinity, osd_addrs=osd_addrs,

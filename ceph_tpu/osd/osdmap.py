@@ -147,6 +147,10 @@ class OSDMap:
     #: CRUSH name side-tables (types/items/rules/classes, JSON-shaped —
     #: CrushWrapper type_map/name_map analog), set via `osd setcrushmap`
     crush_names: dict = field(default_factory=dict)
+    #: active-mgr record published to every subscriber (MgrMap reduced):
+    #: {"active_name": "mgr.0", "addr": "..."} — OSDs stream reports to
+    #: it; clients re-target mgr-tier commands at it
+    mgr_db: dict = field(default_factory=dict)
     #: per-osd laggy history (osd_xinfo_t vector)
     osd_xinfo: list[OSDXInfo] = field(default_factory=list)
 
@@ -164,7 +168,7 @@ class OSDMap:
             setattr(m, attr, list(getattr(self, attr)))
         for attr in ("pools", "pg_upmap", "pg_upmap_items", "pg_temp",
                      "primary_temp", "config_db", "auth_db", "fs_db",
-                     "crush_names"):
+                     "crush_names", "mgr_db"):
             setattr(m, attr, dict(getattr(self, attr)))
         return m
 

@@ -113,21 +113,39 @@ class MiniCluster:
         mon = self.mons.pop(mon_id)
         mon.shutdown()
 
-    def run_mgr(self):
-        """Start the manager; OSDs started AFTERWARDS stream reports
-        to it (restart existing ones to pick it up)."""
+    def run_mgr(self, mgr_id: int = 0):
+        """Start a manager; OSDs started AFTERWARDS stream reports to
+        the one the mon names active (restart existing ones to pick it
+        up).  Additional mgr_ids are standbys the mon promotes when the
+        active's session dies."""
         from ceph_tpu.mgr import MgrDaemon
         addr = ("127.0.0.1:0" if self._is_wire()
-                else f"{self._ns}mgr.0")
+                else f"{self._ns}mgr.{mgr_id}")
         cephx = None
         if self.cephx:
-            key = self.keyring.get("mgr.0") or self.provision_key("mgr.0")
-            cephx = ("mgr.0", key)
-        self.mgr = MgrDaemon(self.mon_host, ms_type=self.ms_type,
-                             addr=addr, auth_key=self.auth_key,
-                             cephx=cephx)
-        self.mgr.init()
-        return self.mgr
+            who = f"mgr.{mgr_id}"
+            key = self.keyring.get(who) or self.provision_key(who)
+            cephx = (who, key)
+        mgr = MgrDaemon(self.mon_host, ms_type=self.ms_type,
+                        addr=addr, auth_key=self.auth_key,
+                        cephx=cephx, mgr_id=mgr_id)
+        mgr.init()
+        self.mgrs = getattr(self, "mgrs", {})
+        self.mgrs[mgr_id] = mgr
+        if mgr_id == 0 or self.mgr is None:
+            self.mgr = mgr
+        return mgr
+
+    def kill_mgr(self, mgr_id: int = 0):
+        mgr = self.mgrs.pop(mgr_id, None) if getattr(self, "mgrs", None) \
+            else None
+        if mgr is None:
+            mgr, self.mgr = self.mgr, None
+        if mgr is not None:
+            if self.mgr is mgr:
+                self.mgr = next(iter(getattr(self, "mgrs", {}).values()),
+                                None)
+            mgr.shutdown()
 
     def run_mds(self, metadata_pool: int, data_pool: int):
         """Start the metadata server over the given pools (the `fs new

@@ -172,3 +172,51 @@ def test_mgr_command_routing_and_telemetry():
         assert rc == -22
     finally:
         c.stop()
+
+
+def test_mgr_standby_failover():
+    # MgrMap reduced: the mon publishes the active mgr in the map; when
+    # it dies, a standby is promoted and OSD reports + client commands
+    # re-target without restarts
+    c = MiniCluster(n_osds=2, ms_type="async").start()
+    try:
+        c.run_mgr(0)
+        c.run_mgr(1)            # standby
+        for oid in list(c.osds):
+            c.kill_osd(oid)
+            c.run_osd(oid)
+        c.wait_for_osd_count(2)
+        client = c.client(timeout=20.0)
+        pool = c.create_pool(client, pg_num=4, size=2)
+        io = client.open_ioctx(pool)
+        import json as _json
+        # active published in the map and serving reports
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            io.write_full("fo", b"x")
+            rc, out = client.mon_command({"prefix": "mgr dump"})
+            if rc == 0 and _json.loads(out).get("active_name") == "mgr.0" \
+                    and c.mgrs[0].reports:
+                break
+            time.sleep(0.3)
+        assert c.mgrs[0].reports, "active mgr never got reports"
+
+        standby = c.mgrs[1]
+        c.kill_mgr(0)
+        # the mon must promote mgr.1 and OSD reports must land there
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            io.write_full("fo2", b"y")
+            rc, out = client.mon_command({"prefix": "mgr dump"})
+            if rc == 0 and _json.loads(out).get("active_name") == "mgr.1" \
+                    and standby.reports:
+                break
+            time.sleep(0.5)
+        rc, out = client.mon_command({"prefix": "mgr dump"})
+        assert _json.loads(out).get("active_name") == "mgr.1", out
+        assert standby.reports, "standby never received OSD reports"
+        # and mgr-tier commands flow to the new active
+        rc, out = client.mgr_command({"prefix": "pg dump"})
+        assert rc == 0, out
+    finally:
+        c.stop()
