@@ -52,7 +52,8 @@ class _CapState:
     """Per-inode client cap state (Client::Inode + CapSnap, lite)."""
 
     __slots__ = ("ino", "caps", "inode", "attr_fresh", "size", "mtime",
-                 "dirty", "dirty_bytes", "nopen", "wb_lock", "rank")
+                 "dirty", "dirty_bytes", "nopen", "wb_lock", "rank",
+                 "inflight")
 
     def __init__(self, ino: int):
         self.ino = ino
@@ -65,6 +66,10 @@ class _CapState:
         self.dirty: list[tuple[int, bytes]] = []   # buffered writes
         self.dirty_bytes = 0
         self.nopen = 0
+        #: direct RADOS writes in flight under WR (Client::get_caps /
+        #: put_caps references): a WR revoke ack waits for these to
+        #: drain, so mksnap can never complete mid-write
+        self.inflight = 0
         #: serializes writebacks so two flushers can never reorder
         #: overlapping extents (older batch landing over a newer one)
         self.wb_lock = threading.Lock()
@@ -116,6 +121,14 @@ class CephFS(Dispatcher):
         #: state, so an open reply racing an already-processed revoke
         #: never reinstalls the stale (higher) grant
         self._cap_seq_seen: dict[int, int] = {}
+        #: osdmap epoch this client must reach before direct RADOS data
+        #: writes (Client::set_cap_epoch_barrier): rides open replies
+        #: and cap messages; bumped by the MDS at mksnap so post-snap
+        #: writes carry the new pool snap_seq and the OSD clones
+        self._osd_epoch_barrier = 0
+        #: signaled when an in-flight direct write drains (revoke acks
+        #: for WR wait on it)
+        self._inflight_cv = threading.Condition(self._lock)
         #: multi-active routing: cached rank addrs, opened sessions,
         #: and last-known authoritative rank per path
         self._rank_addr: dict[int, str] = {}
@@ -383,6 +396,9 @@ class CephFS(Dispatcher):
         mtime = 0.0
         need_flush = False
         with self._lock:
+            self._osd_epoch_barrier = max(
+                self._osd_epoch_barrier,
+                getattr(msg, "epoch_barrier", 0))
             st = self._caps.get(msg.ino)
             if msg.seq:
                 self._cap_seq_seen[msg.ino] = max(
@@ -412,6 +428,14 @@ class CephFS(Dispatcher):
                 if lost & CACHE:
                     st.attr_fresh = False
                 need_flush = bool(lost & BUFFER)
+                if lost & WR:
+                    # drain in-flight direct writes BEFORE acking: the
+                    # MDS treats our ack as "this client writes no
+                    # more", and mksnap's pool snapshot happens right
+                    # after — an op still in flight would race it.
+                    # Writers time out, so the drain is bounded.
+                    while st.inflight > 0:
+                        self._inflight_cv.wait(timeout=1.0)
         if st is not None and need_flush:
             self._writeback(st)
             with self._lock:
@@ -422,6 +446,66 @@ class CephFS(Dispatcher):
             op="ack", ino=msg.ino, seq=msg.seq, client=self.client_id,
             size=size, mtime=mtime))
 
+    def _install_grant(self, ino: int, out: dict) -> None:
+        """Install a caps+barrier reply (open / cap_want) under the
+        lock: the grant lands ONLY if no newer revoke was processed
+        since the server stamped it, and the epoch barrier merges
+        grow-only."""
+        with self._lock:
+            st = self._caps.get(ino)
+            if st is not None and out.get("cap_seq", 0) >= \
+                    self._cap_seq_seen.get(ino, 0):
+                st.caps = out["caps"]
+            self._osd_epoch_barrier = max(
+                self._osd_epoch_barrier,
+                out.get("epoch_barrier", 0))
+
+    def _pre_data_write(self, st: _CapState) -> None:
+        """Gate a DIRECT RADOS data write (sync-mode write/truncate)
+        and take an in-flight reference (Client::get_caps):
+
+        1. re-acquire WR if it was recalled (mksnap's freeze strips WR
+           from every holder — the round-trip here is what hands us the
+           post-snapshot epoch barrier),
+        2. wait for our osdmap to reach the barrier, so the op's
+           SnapContext stamp carries the new pool snap_seq and the OSD
+           copy-on-writes the pre-snapshot data, and
+        3. atomically (WR still held + barrier reached) bump
+           st.inflight — a WR revoke ack then WAITS for the write to
+           drain, so mksnap can never complete around an op in flight.
+
+        The caller MUST pair with _post_data_write in a finally.
+        Buffered (Fb) flushes do NOT re-acquire WR — flushing under a
+        revoke is legal and precedes the snapshot by construction —
+        they only honor the barrier (see _writeback)."""
+        while True:
+            self._wait_epoch_barrier()
+            with self._lock:
+                if (st.caps & WR) and self.rados.osdmap.epoch >= \
+                        self._osd_epoch_barrier:
+                    st.inflight += 1
+                    return
+                need_caps = not (st.caps & WR)
+            if need_caps:
+                out = self._request("cap_want", {"ino": st.ino,
+                                                 "wanted": WANT_WRITE},
+                                    rank=st.rank)
+                self._install_grant(st.ino, out)
+                if not (st.caps & WR):
+                    time.sleep(0.01)   # mixed-mode revoke still settling
+            # else: the barrier moved under us — loop and wait again
+
+    def _post_data_write(self, st: _CapState) -> None:
+        with self._lock:
+            st.inflight -= 1
+            if st.inflight <= 0:
+                self._inflight_cv.notify_all()
+
+    def _wait_epoch_barrier(self) -> None:
+        barrier = self._osd_epoch_barrier
+        if barrier and self.rados.osdmap.epoch < barrier:
+            self.rados.wait_for_epoch(barrier)
+
     def _writeback(self, st: _CapState) -> None:
         """Write buffered extents to RADOS (data only — the size rides
         the cap ack or an explicit setattr).  The dirty list is SWAPPED
@@ -429,6 +513,10 @@ class CephFS(Dispatcher):
         list (flushed by the next writeback, never lost); wb_lock keeps
         two flushers from landing overlapping batches out of order."""
         with st.wb_lock:
+            # barrier BEFORE the swap: a failed wait (mon unreachable)
+            # must leave the dirty list intact for the next flusher,
+            # not silently drop it
+            self._wait_epoch_barrier()
             with self._lock:
                 extents = st.dirty
                 st.dirty = []
@@ -581,12 +669,7 @@ class CephFS(Dispatcher):
             self._path_ino[path] = ino
             with self._lock:
                 st = self._state(ino)
-                # install the grant ONLY if no newer revoke has been
-                # processed since the server stamped it (a revoke can
-                # overtake us between the reply event and this install)
-                if out.get("cap_seq", 0) >= \
-                        self._cap_seq_seen.get(ino, 0):
-                    st.caps = out["caps"]
+                self._install_grant(ino, out)
                 st.inode = out["inode"]
                 st.attr_fresh = True
                 if not st.dirty:
@@ -721,7 +804,11 @@ class File:
         if not self.writable:
             raise OSError(9, "file not open for writing")  # EBADF
         st = self.state
-        self.obj.truncate(size)
+        self.fs._pre_data_write(st)
+        try:
+            self.obj.truncate(size)
+        finally:
+            self.fs._post_data_write(st)
         with self.fs._lock:
             # clip straddling extents to the new size (dropping them
             # whole would lose their in-range bytes)
@@ -756,7 +843,11 @@ class File:
         else:
             # sync mode: data through, size reported immediately
             # (grow-only: the MDS keeps the max across all writers)
-            self.obj.write(data, offset=self.pos)
+            self.fs._pre_data_write(st)
+            try:
+                self.obj.write(data, offset=self.pos)
+            finally:
+                self.fs._post_data_write(st)
             self.fs._apply_inode(st, self.fs._request(
                 "setattr", {"ino": st.ino, "size": self.pos + len(data),
                             "grow": True,

@@ -391,6 +391,17 @@ class RadosClient(Dispatcher):
                                           w.is_write, w.direct)
         w.msg.pgid = pgid
         w.msg.epoch = self.osdmap.epoch
+        if w.is_write:
+            # SnapContext stamp (Objecter rides the op's snapc, not the
+            # server map): re-stamped on every (re)send from the pool
+            # the op actually TARGETS this time (pgid[0]) — snap_seq is
+            # monotone WITHIN a pool, but a retarget (cache tier added/
+            # removed mid-op) crosses into an independent snap_seq
+            # namespace, so carrying a max() across sends would
+            # over-stamp the object's snapc there
+            pool = self.osdmap.pools.get(pgid[0])
+            if pool is not None:
+                w.msg.write_snapc = pool.snap_seq
         if primary == CEPH_NOSD:
             return  # no primary this epoch; resent on next map
         addr = self.osdmap.osd_addrs[primary]

@@ -131,10 +131,11 @@ class MClientCaps(Message):
     along), 'release' (last close)."""
 
     TYPE = 0x310
+    HEAD_VERSION = 2       # v2: epoch_barrier rides every cap message
 
     def __init__(self, op: str = "", ino: int = 0, caps: int = 0,
                  seq: int = 0, client: int = 0, size: int = -1,
-                 mtime: float = 0.0):
+                 mtime: float = 0.0, epoch_barrier: int = 0):
         super().__init__()
         self.op = op
         self.ino = ino
@@ -143,12 +144,18 @@ class MClientCaps(Message):
         self.client = client
         self.size = size
         self.mtime = mtime
+        #: v2: osdmap epoch the client must reach before issuing direct
+        #: RADOS writes under these caps (the reference's cap
+        #: epoch_barrier, src/messages/MClientCaps.h osd_epoch_barrier
+        #: + Client::set_cap_epoch_barrier) — orders post-mksnap writes
+        #: after the snapshot's pool epoch
+        self.epoch_barrier = epoch_barrier
 
     def encode_payload(self, enc: Encoder):
-        enc.versioned(1, 1, lambda e: (
+        enc.versioned(2, 1, lambda e: (
             e.str(self.op), e.u64(self.ino), e.u32(self.caps),
             e.u64(self.seq), e.u64(self.client), e.s64(self.size),
-            e.f64(self.mtime)))
+            e.f64(self.mtime), e.u32(self.epoch_barrier)))
 
     def decode_payload(self, dec: Decoder, version: int):
         def body(d, v):
@@ -159,7 +166,8 @@ class MClientCaps(Message):
             self.client = d.u64()
             self.size = d.s64()
             self.mtime = d.f64()
-        dec.versioned(1, body)
+            self.epoch_barrier = d.u32() if v >= 2 else 0
+        dec.versioned(2, body)
 
 
 @register_message
@@ -284,6 +292,10 @@ class MDSDaemon(Dispatcher):
         self._parked: dict[int, list] = {}
         #: (ino, client) -> send time of the oldest un-acked revoke
         self._revoke_sent: dict[tuple[int, int], float] = {}
+        #: osdmap epoch every WR-cap holder must reach before direct
+        #: data writes (bumped by mksnap; rides cap grants and open
+        #: replies — the reference's Locker osd_epoch_barrier)
+        self._osd_epoch_barrier = 0
         #: grace before a silent revoke target / session is evicted
         self.revoke_grace = 4.0
         self.session_grace = 8.0
@@ -759,7 +771,11 @@ class MDSDaemon(Dispatcher):
         if kind == "mksnap":
             # directory snapshot (snaprealm reduced): the frozen subtree
             # metadata persists under snap.<ino>; file DATA as of the
-            # snapshot is served by pool-snapshot reads at ev["snapid"]
+            # snapshot is served by pool-snapshot reads at ev["snapid"].
+            # The epoch barrier survives restart with the journal: a
+            # replayed MDS keeps gating re-grants on the snap's epoch
+            self._osd_epoch_barrier = max(self._osd_epoch_barrier,
+                                          int(ev.get("epoch", 0)))
             recs = self._load_snaps(ev["ino"])
             recs[ev["name"]] = {"snapid": ev["snapid"],
                                 "created": ev.get("created", 0.0),
@@ -862,10 +878,16 @@ class MDSDaemon(Dispatcher):
         rest = "/".join(parts[i + 2:])
         return dirpath, snap, rest
 
-    def _freeze_tree(self, ino: int, client: int) -> dict:
+    def _freeze_tree(self, ino: int, client: int,
+                     revoke_wr: bool = False) -> dict:
         """Frozen metadata of the subtree rooted at ino: relpath ->
         inode dict ('' = the root dir).  Buffered writers are recalled
-        first so frozen sizes are the truth (may _Park; reruns)."""
+        first so frozen sizes are the truth (may _Park; reruns).
+
+        With revoke_wr (the mksnap path), WR is recalled from EVERY
+        holder — the snapshotting client included — so any write after
+        the snapshot requires a cap round-trip, which hands the writer
+        the new osd epoch barrier before it may touch RADOS again."""
         tree: dict[str, dict] = {}
         stack = [("", ino)]
         while stack:
@@ -874,7 +896,14 @@ class MDSDaemon(Dispatcher):
             if inode is None:
                 continue
             if not inode.is_dir():
-                self._fresh_inode(cur, requester=client)
+                if revoke_wr:
+                    revokes = self.caps.recall(cur, WR | BUFFER)
+                    if revokes:
+                        self._issue_revokes(cur, revokes)
+                    if self.caps.pending_revokes(cur):
+                        raise _Park(cur)
+                else:
+                    self._fresh_inode(cur, requester=client)
                 inode = self._load_inode(cur)
             tree[rel] = inode.to_dict()
             if inode.is_dir():
@@ -895,11 +924,12 @@ class MDSDaemon(Dispatcher):
             return -20, {}   # ENOTDIR
         if name in self._load_snaps(ino):
             return -17, {}   # EEXIST
-        # freeze metadata FIRST (parks until buffers flushed), then take
-        # the pool snapshot: data written after the freeze point but
-        # before the pool snap can only make the snapshot NEWER than the
-        # frozen sizes claim, never truncate it
-        tree = self._freeze_tree(ino, client)
+        # freeze metadata FIRST (parks until buffers flushed AND every
+        # WR holder dropped its cap — subsequent writes require a cap
+        # round-trip), then take the pool snapshot: data written after
+        # the freeze point but before the pool snap can only make the
+        # snapshot NEWER than the frozen sizes claim, never truncate it
+        tree = self._freeze_tree(ino, client, revoke_wr=True)
         rc, out = self.objecter.mon_command({
             "prefix": "osd pool mksnap", "pool": self.data_pool,
             "snap": f"cephfs.{ino:x}.{name}"})
@@ -908,9 +938,16 @@ class MDSDaemon(Dispatcher):
         reply = json.loads(out)
         if "epoch" in reply:
             self.objecter.wait_for_epoch(reply["epoch"])
+            # every cap re-grant from here on carries this barrier:
+            # writers wait for their osdmap to reach the snap's epoch
+            # (and so stamp ops with the new snap_seq) before touching
+            # RADOS — closing the COW race with OSDs on older maps
+            self._osd_epoch_barrier = max(self._osd_epoch_barrier,
+                                          reply["epoch"])
         self._mutate({"e": "mksnap", "ino": ino, "name": name,
                       "snapid": reply["snapid"], "tree": tree,
-                      "created": time.time()})
+                      "created": time.time(),
+                      "epoch": reply.get("epoch", 0)})
         return 0, {"snapid": reply["snapid"]}
 
     def _do_rmsnap(self, a: dict) -> tuple[int, dict]:
@@ -923,7 +960,13 @@ class MDSDaemon(Dispatcher):
         rc, _out = self.objecter.mon_command({
             "prefix": "osd pool rmsnap", "pool": self.data_pool,
             "snap": f"cephfs.{ino:x}.{name}"})
-        # ENOENT from the mon is fine: a crash between rmsnap halves
+        # ONLY ENOENT from the mon is fine (a crash between rmsnap
+        # halves left the pool snap already gone); any other failure
+        # must surface BEFORE the record that names the pool snapshot
+        # is dropped — otherwise the snap and its clones leak with no
+        # retry path
+        if rc not in (0, -2):
+            return rc if rc < 0 else -5, {}
         self._mutate({"e": "rmsnap", "ino": ino, "name": name})
         return 0, {}
 
@@ -1369,6 +1412,10 @@ class MDSDaemon(Dispatcher):
             # no session to talk to: the grant is unrecallable — drop it
             self.caps.force_drop(m.ino, client)
             return False
+        # every cap message carries the current barrier: an async
+        # re-grant of WR must not hand a client write permission
+        # without also handing it the epoch it must reach first
+        m.epoch_barrier = max(m.epoch_barrier, self._osd_epoch_barrier)
         s["con"].send_message(m)
         return True
 
@@ -1587,7 +1634,26 @@ class MDSDaemon(Dispatcher):
                 raise _Park(ino)
             return 0, {"inode": inode.to_dict(), "caps": granted,
                        "cap_seq": self.caps.grant_seq(ino, client),
-                       "created": created, "data_pool": self.data_pool}
+                       "created": created, "data_pool": self.data_pool,
+                       "epoch_barrier": self._osd_epoch_barrier}
+
+        if op == "cap_want":
+            # cap re-acquisition after a revoke (Client::get_caps): a
+            # writer whose WR was recalled — e.g. by mksnap's freeze —
+            # round-trips here before touching RADOS again, and leaves
+            # with the current epoch barrier
+            ino = a["ino"]
+            if self._load_inode(ino) is None:
+                return -2, {}
+            granted, revokes = self.caps.open_want(
+                ino, client, int(a["wanted"]))
+            if revokes:
+                self._issue_revokes(ino, revokes)
+            if granted is None:
+                raise _Park(ino)
+            return 0, {"caps": granted,
+                       "cap_seq": self.caps.grant_seq(ino, client),
+                       "epoch_barrier": self._osd_epoch_barrier}
 
         if op == "cap_release":
             # synchronous form of MClientCaps 'release' (close path

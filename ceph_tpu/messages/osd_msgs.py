@@ -56,11 +56,12 @@ def _dec_pgid(dec: Decoder) -> tuple[int, int]:
 @register_message
 class MOSDOp(Message):
     TYPE = 42  # MSG_OSD_OP
+    HEAD_VERSION = 3       # v3: write_snapc (writer-side SnapContext)
 
     def __init__(self, client_id: int = 0, tid: int = 0,
                  pgid: tuple[int, int] = (0, 0), oid: str = "",
                  ops: list[OSDOpField] | None = None, epoch: int = 0,
-                 snapid: int = 0):
+                 snapid: int = 0, write_snapc: int = 0):
         super().__init__()
         self.client_id = client_id
         self.tid = tid
@@ -69,13 +70,19 @@ class MOSDOp(Message):
         self.ops = ops or []
         self.epoch = epoch
         self.snapid = snapid    # v2: read as-of this pool snapshot
+        #: v3: pool snap_seq in the WRITER's osdmap (the SnapContext the
+        #: reference carries in every MOSDOp, src/messages/MOSDOp.h
+        #: snapc) — the OSD clones against max(this, its own map), so a
+        #: writer that learned of a snapshot before the serving OSD did
+        #: still gets copy-on-write
+        self.write_snapc = write_snapc
 
     def encode_payload(self, enc):
-        enc.versioned(2, 1, lambda e: (
+        enc.versioned(3, 1, lambda e: (
             e.u64(self.client_id), e.u64(self.tid), _enc_pgid(e, self.pgid),
             e.str(self.oid), e.u32(self.epoch),
             e.list(self.ops, lambda e2, op: op.encode(e2)),
-            e.u64(self.snapid)))
+            e.u64(self.snapid), e.u64(self.write_snapc)))
 
     def decode_payload(self, dec, version):
         def body(d, v):
@@ -85,9 +92,9 @@ class MOSDOp(Message):
             self.oid = d.str()
             self.epoch = d.u32()
             self.ops = d.list(OSDOpField.decode)
-            if v >= 2:
-                self.snapid = d.u64()
-        dec.versioned(2, body)
+            self.snapid = d.u64() if v >= 2 else 0
+            self.write_snapc = d.u64() if v >= 3 else 0
+        dec.versioned(3, body)
 
 
 @register_message

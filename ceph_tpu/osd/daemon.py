@@ -2303,12 +2303,18 @@ class OSDDaemon(Dispatcher):
         t0 = time.time()
         # snapshot COW (PrimaryLogPG make_writeable): first write after
         # a pool snap clones the pre-write object to oid+CLONE_SEP+seq;
-        # the clone's covered snap interval is (from_seq, snap_seq]
-        if pool.snap_seq:
+        # the clone's covered snap interval is (from_seq, snap_seq].
+        # The effective seq is max(my map, the op's SnapContext): a
+        # writer that learned of the snapshot before this OSD's map
+        # caught up still triggers the clone (the reference orders this
+        # through the per-op snapc, src/osd/PrimaryLogPG.cc
+        # make_writeable)
+        eff_seq = max(pool.snap_seq, getattr(msg, "write_snapc", 0))
+        if eff_seq:
             obj_sc = int(self._getattr_safe(cid, msg.oid, "snapc")
                          or b"0")
-            if obj_sc < pool.snap_seq and self.store.exists(cid, msg.oid):
-                clone = f"{msg.oid}{CLONE_SEP}{pool.snap_seq}"
+            if obj_sc < eff_seq and self.store.exists(cid, msg.oid):
+                clone = f"{msg.oid}{CLONE_SEP}{eff_seq}"
                 pre = Transaction()
                 pre.clone(cid, msg.oid, clone)
                 pre.setattr(cid, clone, "from_seq", str(obj_sc).encode())
@@ -2316,7 +2322,7 @@ class OSDDaemon(Dispatcher):
                 t = pre
             if not is_delete:
                 t.setattr(cid, msg.oid, "snapc",
-                          str(pool.snap_seq).encode())
+                          str(eff_seq).encode())
         entry = self._log_write(pg, t, msg.oid, is_delete, reqid)
         if not is_delete:
             t.setattr(cid, msg.oid, "_v", enc_version(entry.version))

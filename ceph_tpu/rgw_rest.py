@@ -244,7 +244,10 @@ class S3Gateway:
         meta = self._bucket(name).meta_all()   # one index fetch
         acl = meta.get("acl") or "private"
         owner = meta.get("owner") or ""
-        if principal is not None and (not owner or principal == owner):
+        # an EMPTY owner matches nobody: a bucket whose ownership is
+        # unknown (e.g. replicated before its meta resolved) must not
+        # become world-owned — access then flows from the ACL alone
+        if principal is not None and owner and principal == owner:
             return
         if acl == "public-read-write":
             return
@@ -260,7 +263,7 @@ class S3Gateway:
         """Bucket-configuration ops (versioning/lifecycle/acl/delete):
         owner only — canned ACLs never delegate these."""
         owner = self._bucket(name).meta_all().get("owner") or ""
-        if principal is None or (owner and principal != owner):
+        if principal is None or not owner or principal != owner:
             raise S3Error("AccessDenied", "bucket owner only")
 
     def delete_bucket(self, name: str) -> None:
@@ -381,7 +384,20 @@ class S3Gateway:
             if idx is not None:
                 rows = rows[idx + 1:]
             else:
-                rows = [r for r in rows if r[0] > key_marker]
+                # the marker row was deleted between pages.  Timestamp
+                # version ids (20-digit time_ns) order with mtime, so
+                # "after the marker" = a numerically-smaller id in the
+                # newest-first stream; a "null" marker/row defeats that
+                # comparison, so those keep the whole key — possibly
+                # re-serving a version, never silently dropping one
+                def _after(k, e):
+                    if k != key_marker:
+                        return k > key_marker
+                    vid = e.get("version_id", "")
+                    if vid_marker.isdigit() and vid.isdigit():
+                        return vid < vid_marker
+                    return True
+                rows = [r for r in rows if _after(r[0], r[1])]
         return rows[:max_keys], len(rows) > max_keys
 
     # -- lifecycle agent (rgw_lc.cc RGWLC::process reduced) -------------------

@@ -24,6 +24,7 @@ from __future__ import annotations
 import hashlib
 import hmac
 import json
+import os
 import threading
 import time
 import urllib.parse
@@ -47,8 +48,10 @@ class SwiftRestServer:
         self.token_ttl = token_ttl
         #: account -> swift key (X-Auth-User "acct:user" uses acct part)
         self.accounts: dict[str, str] = {}
-        self._token_secret = hashlib.sha256(
-            b"swift-token" + str(id(self)).encode()).digest()
+        # per-server random key (rgw_swift_auth's server-held secret):
+        # a captured token must not let an attacker brute-force the key
+        # offline and mint tokens for other accounts
+        self._token_secret = os.urandom(32)
         host, port = addr.rsplit(":", 1)
         self._httpd = ThreadingHTTPServer((host, int(port)), _SwiftHandler)
         self._httpd.swift = self           # type: ignore

@@ -146,17 +146,26 @@ class ZoneSyncAgent:
             pass
 
     def _ensure_bucket(self, name: str) -> None:
-        meta = None
+        # the source meta read must SUCCEED before we create: replicating
+        # a bucket with owner "" would leave it unowned on the secondary
+        # (authorize treats an empty owner as matching nobody, so the
+        # bucket's config ops would be dead) — propagate instead; the
+        # per-bucket sync loop retries next cycle
+        meta = self.src._bucket(name).meta_all()
+        owner = meta.get("owner", "")
         try:
-            meta = self.src._bucket(name).meta_all()
-        except S3Error:
-            pass
-        try:
-            self.dst.create_bucket(name,
-                                   owner=(meta or {}).get("owner", ""))
+            self.dst.create_bucket(name, owner=owner)
         except S3Error as e:
             if e.code != "BucketAlreadyExists":
                 raise
+            # repair path: a bucket replicated before its owner was
+            # known (or whose owner changed at the source) gets the
+            # source's owner backfilled — an empty owner matches nobody
+            # in authorize, so leaving it would strand the bucket's
+            # config ops forever
+            b = self.dst._bucket(name)
+            if b.meta_all().get("owner", "") != owner:
+                b.set_meta("owner", owner)
 
     def _copy_object(self, bucket: str, key: str) -> bool:
         try:

@@ -1,0 +1,216 @@
+"""Regression tests for the round-4 advisor findings: the mksnap COW
+race (a WR-caps holder writing right after mksnap must not overwrite the
+head in place), rmsnap swallowing non-ENOENT mon errors, the Swift
+TempAuth token secret being derived from a heap address, empty bucket
+owners granting ownership to every authenticated principal, and
+ListObjectVersions dropping entries when the pagination marker row was
+deleted between pages."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ceph_tpu.cephfs import CephFS
+from ceph_tpu.rgw_rest import S3Error, S3Gateway
+from ceph_tpu.tools.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osds=3, ms_type="loopback").start()
+    c.wait_for_osd_count(3)
+    client = c.client(timeout=20.0)
+    meta = c.create_pool(client, pg_num=4, size=2)
+    data = c.create_pool(client, pg_num=8, size=2)
+    c.run_mds(meta, data)
+    c._fs_pools = (meta, data)
+    yield c
+    c.stop()
+
+
+@pytest.fixture
+def fs(cluster):
+    f = CephFS(cluster.mon_host, cluster.mds.addr, ms_type="loopback")
+    f.mount()
+    yield f
+    f.unmount()
+
+
+# -- mksnap COW race --------------------------------------------------------
+
+def test_write_through_open_handle_after_mksnap_preserves_snapshot(fs):
+    """The medium finding: a client holding WR/BUFFER caps across mksnap
+    writes right after it.  mksnap's freeze must recall WR from EVERY
+    holder, and the re-acquisition round-trip must hand the writer the
+    post-snapshot epoch barrier — so the post-snap write COWs the head
+    instead of silently corrupting the snapshot."""
+    gen1 = b"generation one"
+    gen2 = b" THEN generation two"
+    fs.mkdir("/cowrace")
+    f = fs.open("/cowrace/f.txt", "w")
+    f.write(gen1)
+    # handle stays OPEN across the snapshot
+    fs.mksnap("/cowrace", "s1")
+    # the freeze stripped WR|BUFFER from this holder: the next write has
+    # to re-acquire caps (cap_want) and honor the epoch barrier
+    f.write(gen2)
+    f.close()
+    with fs.open("/cowrace/.snap/s1/f.txt") as snap:
+        assert snap.read() == gen1
+    with fs.open("/cowrace/f.txt") as live:
+        assert live.read() == gen1 + gen2
+
+
+def test_osd_clones_on_op_snapc_ahead_of_its_map(cluster):
+    """A writer whose osdmap already carries a pool snapshot must get
+    copy-on-write even from an OSD whose own map does not yet: the op's
+    SnapContext stamp (MOSDOp.write_snapc) wins over the server map."""
+    client = cluster.client(timeout=20.0)
+    pool = cluster.create_pool(client, pg_num=4, size=2)
+    io = client.open_ioctx(pool)
+    io.write_full("racer", b"pre-snapshot state")
+    # simulate "client learned of snap 1 before the OSDs": bump ONLY the
+    # client's view of the pool snap_seq
+    client.osdmap.pools[pool].snap_seq = 1
+    for osd in cluster.osds.values():
+        assert osd.osdmap.pools[pool].snap_seq == 0
+    io.write_full("racer", b"post-snapshot state")
+    # the pre-write state must have been cloned at seq 1
+    assert io.read("racer", 64, snapid=1) == b"pre-snapshot state"
+    assert io.read("racer", 64) == b"post-snapshot state"
+
+
+# -- rmsnap error propagation ----------------------------------------------
+
+def test_rmsnap_mon_failure_keeps_snap_record(cluster, fs):
+    fs.mkdir("/rmfail")
+    with fs.open("/rmfail/a.txt", "w") as f:
+        f.write(b"snapped")
+    fs.mksnap("/rmfail", "keepme")
+    mds = cluster.mds
+    real = mds.objecter.mon_command
+    calls = {"n": 0}
+
+    def flaky(cmd):
+        if cmd.get("prefix") == "osd pool rmsnap" and calls["n"] == 0:
+            calls["n"] += 1
+            return -110, b""    # ETIMEDOUT
+        return real(cmd)
+
+    mds.objecter.mon_command = flaky
+    try:
+        with pytest.raises(OSError):
+            fs.rmsnap("/rmfail", "keepme")
+        # the record that names the pool snapshot must survive the
+        # failure (else the snap + clones leak unreferenced)
+        assert "keepme" in fs.listsnaps("/rmfail")
+        with fs.open("/rmfail/.snap/keepme/a.txt") as f:
+            assert f.read() == b"snapped"
+        # and the retry succeeds
+        fs.rmsnap("/rmfail", "keepme")
+        assert "keepme" not in fs.listsnaps("/rmfail")
+    finally:
+        mds.objecter.mon_command = real
+
+
+# -- swift token secret ----------------------------------------------------
+
+def test_swift_token_secret_is_random():
+    from ceph_tpu.rgw_swift import SwiftRestServer
+
+    a = SwiftRestServer(gateway=S3Gateway.__new__(S3Gateway))
+    b = SwiftRestServer(gateway=S3Gateway.__new__(S3Gateway))
+    try:
+        assert len(a._token_secret) == 32
+        assert a._token_secret != b._token_secret
+    finally:
+        a._httpd.server_close()
+        b._httpd.server_close()
+
+
+# -- empty bucket owner ----------------------------------------------------
+
+def test_empty_bucket_owner_matches_nobody(cluster):
+    client = cluster.client(timeout=20.0)
+    pool = cluster.create_pool(client, pg_num=4, size=2)
+    gw = S3Gateway(client.open_ioctx(pool))
+    gw.create_bucket("unowned", owner="")
+    # an authenticated principal is NOT the owner of an ownerless bucket
+    with pytest.raises(S3Error):
+        gw.authorize("unowned", "mallory", write=True)
+    with pytest.raises(S3Error):
+        gw.authorize_owner("unowned", "mallory")
+    # private + ownerless: reads denied too
+    with pytest.raises(S3Error):
+        gw.authorize("unowned", "mallory", write=False)
+    # a real owner still passes
+    gw.create_bucket("owned", owner="alice")
+    gw.authorize("owned", "alice", write=True)
+    gw.authorize_owner("owned", "alice")
+
+
+def test_sync_never_creates_ownerless_bucket(cluster):
+    from ceph_tpu.rgw_sync import ZoneSyncAgent
+
+    client = cluster.client(timeout=20.0)
+    p1 = cluster.create_pool(client, pg_num=4, size=2)
+    p2 = cluster.create_pool(client, pg_num=4, size=2)
+    src = S3Gateway(client.open_ioctx(p1))
+    dst = S3Gateway(client.open_ioctx(p2))
+    agent = ZoneSyncAgent(src, dst)
+    src.create_bucket("b1", owner="alice")
+    # meta read failure must PROPAGATE, not create an ownerless bucket
+    real = src._bucket
+
+    def broken(name, must_exist=True):
+        raise S3Error("InternalError", "transient")
+
+    src._bucket = broken
+    try:
+        with pytest.raises(S3Error):
+            agent._ensure_bucket("b1")
+    finally:
+        src._bucket = real
+    with pytest.raises(S3Error):
+        dst._bucket("b1")
+    # healthy path replicates the owner
+    agent._ensure_bucket("b1")
+    assert dst._bucket("b1").meta_all().get("owner") == "alice"
+    # repair path: a pre-existing destination bucket stranded with an
+    # empty owner (replicated under the old code) gets backfilled
+    dst._bucket("b1").set_meta("owner", "")
+    agent._ensure_bucket("b1")
+    assert dst._bucket("b1").meta_all().get("owner") == "alice"
+
+
+# -- ListObjectVersions marker deletion ------------------------------------
+
+def test_list_versions_survives_deleted_marker_row(cluster):
+    client = cluster.client(timeout=20.0)
+    pool = cluster.create_pool(client, pg_num=4, size=2)
+    gw = S3Gateway(client.open_ioctx(pool))
+    gw.create_bucket("pager", owner="alice")
+    gw.set_versioning("pager", "Enabled")
+    vids = []
+    for i in range(3):
+        _etag, vid = gw.put_object("pager", "key-a", f"v{i}".encode(), {})
+        vids.append(vid)
+        time.sleep(0.002)   # distinct time_ns ids / mtimes
+    gw.put_object("pager", "key-b", b"other", {})
+    page1, truncated = gw.list_versions("pager", "", 1)
+    assert truncated and len(page1) == 1
+    marker_key, marker_entry, _ = page1[0]
+    marker_vid = marker_entry["version_id"]
+    assert marker_key == "key-a" and marker_vid == vids[2]
+    # delete the marker row between pages
+    gw.delete_object("pager", "key-a", vid=marker_vid)
+    rest, _ = gw.list_versions("pager", "", 100,
+                               key_marker=marker_key,
+                               vid_marker=marker_vid)
+    keys = [(k, e["version_id"]) for k, e, _l in rest]
+    # the surviving older versions of the marker key must still list
+    assert ("key-a", vids[0]) in keys
+    assert ("key-a", vids[1]) in keys
+    assert any(k == "key-b" for k, _v in keys)
