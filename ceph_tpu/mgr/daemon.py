@@ -1,17 +1,29 @@
 """Manager daemon — non-consensus cluster aggregation (src/mgr/ analog).
 
 OSDs stream MMgrReport (perf counters + per-PG states) on their tick;
-the mgr aggregates into the views the reference's mgr modules serve:
-cluster health/df summaries, a PG state histogram (the balancer input),
-and per-OSD op counters (prometheus-module shape, minus HTTP).
+the mgr aggregates into cluster-state views and hosts the MODULE
+ecosystem that serves them (src/mgr/ActivePyModules.cc + DaemonServer,
+see ceph_tpu.mgr.module).
+
+Multi-mgr: every mgr beacons to the mon (MMgrBeacon); the mon's MgrMap
+(osdmap.mgr_db) names ONE active and lists the rest as standbys.  A
+standby runs no modules and receives no reports; when the active's
+beacon dies the mon promotes a standby, OSDs re-target their reports by
+the new map, and the promoted mgr loads the same module set from the
+mon-persisted config — mgr state is deliberately mon-side only, which
+is what makes failover a pure promotion (MgrMonitor.cc:47-120).
 """
 
 from __future__ import annotations
 
+import json
+import queue
 import threading
 import time
 
+from ceph_tpu.common.logging import dout
 from ceph_tpu.messages import MOSDMapMsg
+from ceph_tpu.mgr.module import ModuleHost
 from ceph_tpu.msg.encoding import Decoder, Encoder
 from ceph_tpu.msg.message import Message, register_message
 from ceph_tpu.msg.messenger import (
@@ -93,8 +105,40 @@ class MMgrReport(Message):
         dec.versioned(2, body)
 
 
+@register_message
+class MMgrBeacon(Message):
+    """mgr -> mon liveness + standby registration
+    (messages/MMgrBeacon.h:25): name, dialable addr, active-readiness,
+    and the module list the mon publishes in the MgrMap."""
+
+    TYPE = 0x702
+
+    def __init__(self, name: str = "", addr: str = "",
+                 available: bool = True,
+                 modules: list[str] | None = None):
+        super().__init__()
+        self.name = name
+        self.addr = addr
+        self.available = available
+        self.modules = modules or []
+
+    def encode_payload(self, enc: Encoder):
+        enc.versioned(1, 1, lambda e: (
+            e.str(self.name), e.str(self.addr),
+            e.u8(1 if self.available else 0),
+            e.list(self.modules, lambda e2, m: e2.str(m))))
+
+    def decode_payload(self, dec: Decoder, version):
+        def body(d, v):
+            self.name = d.str()
+            self.addr = d.str()
+            self.available = bool(d.u8())
+            self.modules = d.list(lambda d2: d2.str())
+        dec.versioned(1, body)
+
+
 class MgrDaemon(Dispatcher):
-    """DaemonServer + ActivePyModules, collapsed: collect reports,
+    """DaemonServer + ActivePyModules: collect reports, host modules,
     serve aggregate views."""
 
     def __init__(self, mon_addr: str, ms_type: str = "async",
@@ -109,8 +153,16 @@ class MgrDaemon(Dispatcher):
         self.reports: dict[int, tuple[float, MMgrReport]] = {}
         #: osd -> (time, counters) of the PREVIOUS report (iostat rates)
         self._prev_counters: dict[int, tuple[float, dict]] = {}
-        #: last balancer optimize outcome (balancer status)
-        self._balancer_last: dict = {}
+        self.host = ModuleHost(self)
+        self._active = False
+        #: work the DISPATCH thread must never do itself (module
+        #: start/stop, command handling): those paths block on mon
+        #: round-trips whose acks only the dispatch thread delivers —
+        #: doing them inline would deadlock until the timeout
+        self._work_q: queue.Queue = queue.Queue()
+        #: config-key read-through cache (a mon round-trip per
+        #: get_store would otherwise dominate module ticks)
+        self._store_cache: dict[str, tuple[float, object]] = {}
         self.msgr = Messenger.create(self.name, ms_type)
         self.msgr.set_auth(auth_key)
         self._cephx = cephx
@@ -145,12 +197,17 @@ class MgrDaemon(Dispatcher):
             con.send_message(MMonSubscribe(name=str(self.name),
                                            addr=self.msgr.my_addr,
                                            epoch=self.osdmap.epoch))
+            con.send_message(MMgrBeacon(
+                name=str(self.name), addr=self.msgr.my_addr,
+                available=True,
+                modules=sorted(self.host.modules)))
 
     def _renew_tick(self) -> None:
         """Timer thread — NEVER the dispatch thread: the rotating
         refresh blocks on a mon ack only the dispatch thread delivers.
-        Also renews the map subscription: pushes ride the mon-side
-        session, so a dropped session must be re-established."""
+        Also renews the map subscription + beacon: pushes ride the
+        mon-side session, so a dropped session must be
+        re-established."""
         if getattr(self, "_stopped", False):
             return
         try:
@@ -158,6 +215,12 @@ class MgrDaemon(Dispatcher):
             if self._cephx is not None \
                     and time.time() - self._rotating_at > 55.0:
                 self._refresh_rotating()
+            if self._active:
+                # module ticks run on the WORKER: a slow tick (mon
+                # round-trips during an election) must never delay the
+                # next beacon past the mon's grace and demote a
+                # healthy active
+                self._work_q.put(("tick", None))
         except (OSError, TimeoutError):
             pass
         self._rot_timer = threading.Timer(5.0, self._renew_tick)
@@ -168,6 +231,10 @@ class MgrDaemon(Dispatcher):
         self.msgr.bind(self._addr)
         self.msgr.start()
         self._rot_timer = None
+        self._worker = threading.Thread(target=self._work_loop,
+                                        name=f"{self.name}-work",
+                                        daemon=True)
+        self._worker.start()
         if self._cephx is not None:
             self._refresh_rotating()
         self._renew_tick()
@@ -176,14 +243,71 @@ class MgrDaemon(Dispatcher):
         self._stopped = True
         if getattr(self, "_rot_timer", None) is not None:
             self._rot_timer.cancel()
-        if getattr(self, "_prom", None) is not None:
-            self._prom.shutdown()
-            self._prom.server_close()
+        if getattr(self, "_worker", None) is not None:
+            self._work_q.put(None)
+            self._worker.join(timeout=2.0)
+        self.host.stop_all()
         self.msgr.shutdown()
+
+    def _work_loop(self) -> None:
+        while True:
+            item = self._work_q.get()
+            if item is None or getattr(self, "_stopped", False):
+                return
+            kind, payload = item
+            try:
+                if kind == "activation":
+                    # apply only if the flag still agrees (a demote
+                    # queued behind a promote supersedes it)
+                    if payload and self._active:
+                        self.host.start_all()
+                    elif not payload and not self._active:
+                        self.host.stop_all()
+                elif kind == "tick":
+                    if self._active:
+                        self.host.tick()
+                elif kind == "cmd":
+                    msg = payload
+                    out, rc = self._handle_command(msg.cmd)
+                    if msg.connection is not None:
+                        from ceph_tpu.messages import MMonCommandAck
+                        msg.connection.send_message(MMonCommandAck(
+                            tid=msg.tid, result=rc, output=out))
+            except Exception as e:   # pragma: no cover
+                dout("mgr", 0, "mgr worker %s failed: %r", kind, e)
 
     @property
     def addr(self) -> str:
         return self.msgr.my_addr
+
+    # -- active/standby (MgrMap-driven) ---------------------------------------
+
+    @property
+    def is_active(self) -> bool:
+        return self._active
+
+    def _check_activation(self) -> None:
+        """Compare the map's MgrMap against my name; load/unload the
+        module set on the transition.  An EMPTY MgrMap (pre-first-
+        publish, or no mon leader) counts as active: single-mgr
+        clusters must serve before the map exists, and the mon
+        publishes within a tick of the first beacon."""
+        db = self.osdmap.mgr_db or {}
+        want = (not db) or db.get("active_name") == str(self.name)
+        if want and not self._active:
+            self._active = True
+            dout("mgr", 1, "%s taking over as ACTIVE", self.name)
+            self._work_q.put(("activation", True))
+        elif not want and self._active:
+            self._active = False
+            dout("mgr", 1, "%s demoted to standby", self.name)
+            self._work_q.put(("activation", False))
+
+    def module_should_stop(self, inst) -> bool:
+        return getattr(self, "_stopped", False) \
+            or self.host.should_stop(inst)
+
+    # -- dispatch -------------------------------------------------------------
 
     def ms_dispatch(self, msg) -> bool:
         from ceph_tpu.messages import (
@@ -193,11 +317,11 @@ class MgrDaemon(Dispatcher):
             return True
         if isinstance(msg, MMonCommand):
             # the mgr serves its own command tier (DaemonServer
-            # handle_command): clients re-target here after `mgr dump`
-            out, rc = self._handle_command(msg.cmd)
-            if msg.connection is not None:
-                msg.connection.send_message(MMonCommandAck(
-                    tid=msg.tid, result=rc, output=out))
+            # handle_command): clients re-target here after `mgr dump`.
+            # Handled on the WORKER thread — command paths may call
+            # back into the mon (config-key), whose acks this dispatch
+            # thread must stay free to deliver
+            self._work_q.put(("cmd", msg))
             return True
         if isinstance(msg, MMgrReport):
             with self._lock:
@@ -208,45 +332,154 @@ class MgrDaemon(Dispatcher):
                     self._prev_counters[msg.osd_id] = (
                         prev[0], dict(prev[1].counters))
                 self.reports[msg.osd_id] = (time.time(), msg)
+            self.host.notify_all("pg_stats", msg.osd_id)
             return True
         if isinstance(msg, MOSDMapMsg):
             newmap, gapped = advance_map(self.osdmap, msg)
             if newmap is not None:
                 self.osdmap = newmap
+                self._check_activation()
+                self.host.notify_all("osd_map", newmap.epoch)
             elif gapped:
                 self._subscribe()
             return True
         return False
 
+    # -- module-facing state API (ActivePyModules::get_python) ----------------
+
+    def get(self, data_name: str):
+        """Named cluster-state snapshots modules program against."""
+        if data_name == "osd_map":
+            return self.osdmap
+        if data_name == "pg_summary":
+            return self.pg_summary()
+        if data_name == "pg_dump":
+            return self.pg_dump()
+        if data_name == "df":
+            return self.df()
+        if data_name == "counters":
+            return self.counters()
+        if data_name == "health":
+            return self.health()
+        if data_name == "io_samples":
+            with self._lock:
+                return {"current": {o: (t, dict(r.counters))
+                                    for o, (t, r) in
+                                    self.reports.items()},
+                        "prev": dict(self._prev_counters)}
+        raise KeyError(f"unknown mgr data {data_name!r}")
+
+    # -- persisted KV (config-key through the mon) ----------------------------
+
+    STORE_CACHE_TTL = 2.0
+
+    def get_store(self, key: str, default=None):
+        now = time.time()
+        hit = self._store_cache.get(key)
+        if hit is not None and now - hit[0] < self.STORE_CACHE_TTL:
+            return default if hit[1] is None else hit[1]
+        try:
+            rc, out = self.mon_cmd.cmd({"prefix": "config-key get",
+                                        "key": key})
+        except (OSError, TimeoutError):
+            return default if hit is None or hit[1] is None else hit[1]
+        val = out if rc == 0 else None
+        self._store_cache[key] = (now, val)
+        return default if val is None else val
+
+    def set_store(self, key: str, value) -> None:
+        if value is None:
+            self.mon_cmd.cmd({"prefix": "config-key rm", "key": key})
+        else:
+            self.mon_cmd.cmd({"prefix": "config-key set", "key": key,
+                              "value": str(value)})
+        self._store_cache[key] = (time.time(),
+                                  None if value is None else str(value))
+
     # -- command tier (DaemonServer::handle_command reduced) ------------------
 
     def _handle_command(self, cmd: dict) -> tuple[str, int]:
-        import json as _json
         prefix = cmd.get("prefix", "")
         try:
             if prefix == "pg dump":
-                return _json.dumps(self.pg_dump()), 0
+                return json.dumps(self.pg_dump()), 0
             if prefix == "pg ls":
                 pool = cmd.get("pool")
                 states = cmd.get("states") or None
                 if isinstance(states, str):
                     states = [states]
-                return _json.dumps(self.pg_ls(
+                return json.dumps(self.pg_ls(
                     pool=int(pool) if pool is not None else None,
                     states=states)), 0
-            if prefix == "iostat":
-                return _json.dumps(self.iostat()), 0
-            if prefix == "balancer status":
-                return _json.dumps(self.balancer_status()), 0
-            if prefix == "balancer optimize":
-                return _json.dumps({"commands": self.balance_plan()}), 0
-            if prefix == "telemetry show":
-                return _json.dumps(self.telemetry_report()), 0
+            if prefix == "mgr module ls":
+                return json.dumps({
+                    "enabled_modules": self.host.enabled_set(),
+                    "loaded_modules": sorted(self.host.modules),
+                    "available_modules": ModuleHost.available()}), 0
+            if prefix == "mgr module enable":
+                return self._cmd_module_enable(str(cmd["module"]))
+            if prefix == "mgr module disable":
+                return self._cmd_module_disable(str(cmd["module"]))
+            out = self.host.handle_command(cmd)
+            if out is not None:
+                return out
+            # modules answer their commands even on a mgr driven
+            # directly in tests (never promoted): load on demand.  A
+            # stale name in the stored enabled list (module removed
+            # upgrade-side) must not break routing for the rest
+            for name in self.host.enabled_set():
+                try:
+                    cls = ModuleHost.resolve(name)
+                except ImportError:
+                    continue
+                if any(c["prefix"] == prefix for c in cls.COMMANDS):
+                    return self._module(name).handle_command(cmd)
             return f"unknown mgr command {prefix!r}", -22
         except Exception as e:
             return f"mgr command failed: {e!r}", -22
 
-    # -- aggregate views (mgr module surface) ---------------------------------
+    def _cmd_module_enable(self, name: str) -> tuple[str, int]:
+        try:
+            ModuleHost.resolve(name)
+        except ImportError as e:
+            return f"no such module {name!r}: {e}", -2
+        enabled = self._stored_modules()
+        if name not in enabled:
+            enabled.append(name)
+            self.set_store("mgr/modules", json.dumps(enabled))
+        if self._active and not self.host.load(name):
+            return f"module {name!r} failed to load", -22
+        return json.dumps({"enabled": enabled}), 0
+
+    def _cmd_module_disable(self, name: str) -> tuple[str, int]:
+        if name in ModuleHost.ALWAYS_ON:
+            return f"module {name!r} is always on", -22
+        enabled = self._stored_modules()
+        if name in enabled:
+            enabled.remove(name)
+            self.set_store("mgr/modules", json.dumps(enabled))
+        self.host.unload(name)
+        return json.dumps({"enabled": enabled}), 0
+
+    def _stored_modules(self) -> list[str]:
+        raw = self.get_store("mgr/modules")
+        if not raw:
+            return []
+        try:
+            return list(json.loads(raw))
+        except (ValueError, TypeError):
+            return []
+
+    def _module(self, name: str):
+        """Module instance, loading on demand (tests drive view methods
+        on a mgr that was never promoted)."""
+        inst = self.host.modules.get(name)
+        if inst is None:
+            self.host.load(name)
+            inst = self.host.modules[name]
+        return inst
+
+    # -- aggregate views (DaemonServer altitude: not module features) ---------
 
     def pg_summary(self) -> dict:
         """PG state histogram across OSD reports (`ceph status` pgs)."""
@@ -273,32 +506,6 @@ class MgrDaemon(Dispatcher):
         with self._lock:
             return {o: dict(r.counters)
                     for o, (_t, r) in self.reports.items()}
-
-    def balance_plan(self, **kw) -> list[dict]:
-        """Balancer module in upmap mode: mon commands that flatten the
-        per-OSD PG histogram of the mgr's current osdmap."""
-        from ceph_tpu.balancer import plan_commands
-        cmds = plan_commands(self.osdmap, **kw)
-        self._balancer_last = {"time": time.time(),
-                               "commands": len(cmds),
-                               "pool_spread": self._pool_spread_scores()}
-        return cmds
-
-    def _pool_spread_scores(self) -> dict:
-        from ceph_tpu.balancer import spread
-        m = self.osdmap          # snapshot: dispatch may swap the map
-        scores = {}
-        for pid in list(m.pools):
-            lo, hi = spread(m, pid)
-            scores[pid] = {"min": lo, "max": hi}
-        return scores
-
-    def balancer_status(self) -> dict:
-        """`ceph balancer status` shape: mode, the last optimize
-        outcome, and the current per-pool PG spread score."""
-        return {"mode": "upmap", "active": True,
-                "last_optimize": dict(self._balancer_last),
-                "pool_spread": self._pool_spread_scores()}
 
     # -- pg introspection (DaemonServer `pg dump` / `pg ls`) ------------------
 
@@ -346,71 +553,6 @@ class MgrDaemon(Dispatcher):
             rows = [r for r in rows if r["state"] in states]
         return rows
 
-    # -- iostat module (src/pybind/mgr/iostat analog) -------------------------
-
-    def iostat(self) -> dict:
-        """Cluster I/O rates from successive report counter samples:
-        per-osd and total wr/rd ops per second over each osd's last
-        report interval."""
-        out: dict = {"osds": {}, "total_wr_ops_s": 0.0,
-                     "total_rd_ops_s": 0.0}
-        now = time.time()
-        with self._lock:
-            for osd, (t, rep) in self.reports.items():
-                if now - t > 10.0:
-                    # a dead osd's last interval is not a current rate:
-                    # stale reporters drop out instead of reporting
-                    # their final rate forever
-                    continue
-                prev = self._prev_counters.get(osd)
-                if prev is None:
-                    continue
-                pt, pc = prev
-                dt = t - pt
-                if dt <= 0:
-                    continue
-                wr = (rep.counters.get("op_w", 0)
-                      - pc.get("op_w", 0)) / dt
-                rd = (rep.counters.get("op_r", 0)
-                      - pc.get("op_r", 0)) / dt
-                out["osds"][osd] = {"wr_ops_s": round(max(wr, 0.0), 3),
-                                    "rd_ops_s": round(max(rd, 0.0), 3),
-                                    "interval_s": round(dt, 3)}
-                out["total_wr_ops_s"] += max(wr, 0.0)
-                out["total_rd_ops_s"] += max(rd, 0.0)
-        out["total_wr_ops_s"] = round(out["total_wr_ops_s"], 3)
-        out["total_rd_ops_s"] = round(out["total_rd_ops_s"], 3)
-        return out
-
-    # -- telemetry module (src/pybind/mgr/telemetry analog) -------------------
-
-    def telemetry_report(self) -> dict:
-        """Anonymized cluster-shape report (`ceph telemetry show`): no
-        object names, no addresses — counts, sizes, states, pool shapes
-        and daemon versions only, like the reference's opt-in payload."""
-        m = self.osdmap
-        pools = []
-        for pid, p in m.pools.items():
-            pools.append({
-                "pool": pid, "pg_num": p.pg_num,
-                "type": ("erasure" if p.is_erasure() else "replicated"),
-                "size": getattr(p, "size", 0),
-                "cache_tier": p.tier_of >= 0})
-        df = self.df()
-        return {
-            "report_version": 1,
-            "osd": {"count": sum(1 for o in range(m.max_osd)
-                                 if m.exists(o)),
-                    "up": sum(1 for o in range(m.max_osd)
-                              if m.is_up(o))},
-            "osdmap_epoch": m.epoch,
-            "pools": pools,
-            "pg_states": self.pg_summary(),
-            "usage": {"total_objects": df["total_objects"],
-                      "total_bytes_used": df["total_bytes_used"]},
-            "health": self.health()["status"],
-        }
-
     def health(self, stale_after: float = 10.0) -> dict:
         now = time.time()
         with self._lock:
@@ -427,68 +569,24 @@ class MgrDaemon(Dispatcher):
         return {"status": "HEALTH_OK" if not checks else "HEALTH_WARN",
                 "checks": checks}
 
-    # -- prometheus module (src/pybind/mgr/prometheus analog) -----------------
+    # -- module-feature delegates (pre-framework API kept working) ------------
+
+    def iostat(self) -> dict:
+        return self._module("iostat").rates()
+
+    def balance_plan(self, **kw) -> list[dict]:
+        return self._module("balancer").plan(**kw)
+
+    def balancer_status(self) -> dict:
+        return self._module("balancer").status()
+
+    def telemetry_report(self) -> dict:
+        return self._module("telemetry").report()
 
     def prometheus_text(self) -> str:
-        """The exporter's scrape payload: every aggregated counter and
-        gauge in the prometheus text exposition format."""
-        lines = [
-            "# HELP ceph_health_status cluster health (0=OK 1=WARN)",
-            "# TYPE ceph_health_status gauge",
-            f"ceph_health_status "
-            f"{0 if self.health()['status'] == 'HEALTH_OK' else 1}",
-        ]
-        m = self.osdmap
-        lines += [
-            "# TYPE ceph_osd_up gauge",
-            f"ceph_osd_up {sum(1 for o in range(m.max_osd) if m.is_up(o))}",
-            "# TYPE ceph_osd_in gauge",
-            f"ceph_osd_in {sum(1 for o in range(m.max_osd) if m.exists(o) and m.osd_weight[o] > 0)}",
-            "# TYPE ceph_osdmap_epoch gauge",
-            f"ceph_osdmap_epoch {m.epoch}",
-        ]
-        for state, n in sorted(self.pg_summary().items()):
-            lines.append(f'ceph_pg_states{{state="{state}"}} {n}')
-        df = self.df()
-        lines.append(f"ceph_cluster_total_objects {df['total_objects']}")
-        lines.append(f"ceph_cluster_bytes_used {df['total_bytes_used']}")
-        for osd, (_t, rep) in sorted(self.reports.items()):
-            for name, val in sorted(rep.counters.items()):
-                lines.append(
-                    f'ceph_osd_perf{{ceph_daemon="osd.{osd}",'
-                    f'counter="{name}"}} {int(val)}')
-        return "\n".join(lines) + "\n"
+        return self._module("prometheus").scrape_text()
 
     def serve_prometheus(self, port: int = 0) -> int:
         """Start the HTTP exporter; returns the bound port (GET /metrics
         — the mgr prometheus module's endpoint)."""
-        import http.server
-        import socketserver
-
-        mgr = self
-
-        class Handler(http.server.BaseHTTPRequestHandler):
-            def do_GET(self):
-                if self.path not in ("/metrics", "/"):
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                body = mgr.prometheus_text().encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, *a):
-                pass
-
-        class Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        self._prom = Server(("127.0.0.1", port), Handler)
-        t = threading.Thread(target=self._prom.serve_forever, daemon=True)
-        t.start()
-        return self._prom.server_address[1]
+        return self._module("prometheus").start_server(port)

@@ -1,0 +1,100 @@
+"""Prometheus exporter module (src/pybind/mgr/prometheus analog): every
+aggregated counter and gauge in the text exposition format, served over
+HTTP on the module's configured port."""
+
+from __future__ import annotations
+
+import http.server
+import socketserver
+import threading
+
+from ceph_tpu.mgr.module import MgrModule
+
+
+class Module(MgrModule):
+    NAME = "prometheus"
+    MODULE_OPTIONS = [{"name": "server_port", "default": 0}]
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self._httpd: socketserver.ThreadingTCPServer | None = None
+        self._port = 0
+
+    # -- payload --------------------------------------------------------------
+
+    def scrape_text(self) -> str:
+        lines = [
+            "# HELP ceph_health_status cluster health (0=OK 1=WARN)",
+            "# TYPE ceph_health_status gauge",
+            f"ceph_health_status "
+            f"{0 if self.get('health')['status'] == 'HEALTH_OK' else 1}",
+        ]
+        m = self.get_osdmap()
+        lines += [
+            "# TYPE ceph_osd_up gauge",
+            f"ceph_osd_up "
+            f"{sum(1 for o in range(m.max_osd) if m.is_up(o))}",
+            "# TYPE ceph_osd_in gauge",
+            f"ceph_osd_in "
+            f"{sum(1 for o in range(m.max_osd) if m.exists(o) and m.osd_weight[o] > 0)}",
+            "# TYPE ceph_osdmap_epoch gauge",
+            f"ceph_osdmap_epoch {m.epoch}",
+        ]
+        for state, n in sorted(self.get("pg_summary").items()):
+            lines.append(f'ceph_pg_states{{state="{state}"}} {n}')
+        df = self.get("df")
+        lines.append(f"ceph_cluster_total_objects {df['total_objects']}")
+        lines.append(f"ceph_cluster_bytes_used {df['total_bytes_used']}")
+        for osd, counters in sorted(self.get("counters").items()):
+            for name, val in sorted(counters.items()):
+                lines.append(
+                    f'ceph_osd_perf{{ceph_daemon="osd.{osd}",'
+                    f'counter="{name}"}} {int(val)}')
+        return "\n".join(lines) + "\n"
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start_server(self, port: int | None = None) -> int:
+        """Bind + serve; returns the bound port (GET /metrics)."""
+        if self._httpd is not None:
+            return self._port
+        if port is None:
+            port = int(self.get_module_option("server_port", 0))
+        module = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path not in ("/metrics", "/"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = module.scrape_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._httpd = Server(("127.0.0.1", port), Handler)
+        self._port = self._httpd.server_address[1]
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             name="mgr-prometheus-http", daemon=True)
+        t.start()
+        return self._port
+
+    def start(self) -> None:
+        self.start_server()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -28,6 +28,10 @@ import time
 
 from ceph_tpu.common.context import CephTpuContext
 from ceph_tpu.common.logging import dout
+# top-level, not lazy: a STANDALONE mon process must have type 0x702 in
+# the message registry before the first beacon frame arrives, or every
+# beacon is dropped at decode and failover silently degrades
+from ceph_tpu.mgr.daemon import MMgrBeacon
 from ceph_tpu.crush.builder import add_simple_rule, make_bucket
 from ceph_tpu.crush.types import CRUSH_BUCKET_STRAW2, CrushMap
 from ceph_tpu.messages import (
@@ -228,6 +232,9 @@ class Monitor(Dispatcher):
         #: mds gid -> (last beacon time, addr, load) — mon-local
         #: liveness (the FSMap itself is paxos state on the map)
         self._mds_beacons: dict[int, tuple[float, str, float]] = {}
+        #: mgr name -> (time, addr, con, available, modules) — mon-local
+        #: liveness feeding the MgrMap (MgrMonitor beacon table)
+        self._mgr_beacons: dict[str, tuple] = {}
         #: when this mon started watching beacons as leader: a gid we
         #: have NEVER heard from is only dead once a full grace has
         #: passed since then (a freshly-elected/restarted leader must
@@ -424,21 +431,61 @@ class Monitor(Dispatcher):
                     and now - (s[3] if len(s) > 3 else now)
                     < self.MGR_SUB_GRACE}
 
+    #: beacons renew every ~5 s: the grace spans two-plus periods so a
+    #: single starved timer tick (1-core hosts) never demotes a healthy
+    #: active; matches MGR_SUB_GRACE so the two liveness sources agree
+    MGR_BEACON_GRACE = 12.0
+
+    def _live_mgrs(self) -> dict[str, dict]:
+        """name -> {addr, modules} for every mgr whose beacon is fresh
+        and whose session is up (a SIGKILLed mgr's dead connection
+        drops it instantly, without waiting out the grace).  Plain
+        mgr.* subscriptions count as beacons too, so an older mgr that
+        never beacons still registers — reusing the last-known module
+        list, never wiping it (a map whose only change is modules
+        flapping to [] would churn paxos epochs for nothing)."""
+        now = time.time()
+        out: dict[str, dict] = {}
+        with self._lock:
+            for n, b in self._mgr_beacons.items():
+                if not getattr(b[2], "_down", False) and b[3] \
+                        and now - b[0] < self.MGR_BEACON_GRACE:
+                    out[n] = {"addr": b[1], "modules": b[4]}
+            known = {n: b[4] for n, b in self._mgr_beacons.items()}
+        for n, addr in self._live_mgr_subs().items():
+            out.setdefault(n, {"addr": addr,
+                               "modules": known.get(n, [])})
+        return out
+
     def _check_mgr_map(self) -> None:
-        """Publish/maintain the active-mgr record (MgrMonitor
-        reduced): keep the current active while it lives; promote the
-        first live standby when it dies; clear when none remain.  OSDs
-        and clients learn the change through their map subscription."""
-        live = self._live_mgr_subs()
+        """Publish/maintain the MgrMap (MgrMonitor.cc:47-120 reduced):
+        keep the current active while its beacon lives; promote the
+        first live standby when it dies; list the rest as standbys.
+        OSDs and clients learn the change through their map
+        subscription; a promoted standby sees itself named and loads
+        its module set (see MgrDaemon._check_activation)."""
+        live = self._live_mgrs()
         cur = self.osdmap.mgr_db
-        if cur and live.get(cur.get("active_name")) == cur.get("addr"):
-            return
         if not live and not cur:
             return
         desired: dict = {}
         if live:
-            name = sorted(live)[0]
-            desired = {"active_name": name, "addr": live[name]}
+            cur_name = (cur or {}).get("active_name")
+            if cur_name in live \
+                    and live[cur_name]["addr"] == cur.get("addr"):
+                name = cur_name          # incumbent keeps the role
+            else:
+                name = sorted(live)[0]   # promotion
+            desired = {
+                "active_name": name,
+                "addr": live[name]["addr"],
+                "modules": live[name]["modules"],
+                "standbys": [{"name": n, "addr": live[n]["addr"]}
+                             for n in sorted(live) if n != name],
+            }
+
+        if self.osdmap.mgr_db == desired:
+            return
 
         def fn(m: OSDMap, desired=desired):
             if m.mgr_db == desired:
@@ -735,6 +782,12 @@ class Monitor(Dispatcher):
             return True
         if isinstance(msg, MOSDPing):
             return True  # mon liveness probe, nothing to do
+        if isinstance(msg, MMgrBeacon):
+            with self._lock:
+                self._mgr_beacons[msg.name] = (
+                    time.time(), msg.addr, msg.connection,
+                    msg.available, list(msg.modules))
+            return True
         return False
 
     def _handle_command_msg(self, msg: MMonCommand) -> None:
@@ -953,6 +1006,9 @@ class Monitor(Dispatcher):
                 return self._cmd_config_rm(cmd)
             if prefix == "config dump":
                 return json.dumps(self.osdmap.config_db), 0
+            if prefix in ("config-key set", "config-key get",
+                          "config-key rm", "config-key dump"):
+                return self._cmd_config_key(prefix, cmd)
             if prefix == "auth get-or-create":
                 return self._cmd_auth_get_or_create(cmd)
             if prefix in ("auth get", "auth print-key"):
@@ -1419,6 +1475,44 @@ class Monitor(Dispatcher):
             if sec.get(name) == value:
                 return False
             sec[name] = value
+        if not self._mutate(fn):
+            return "commit failed", -11
+        return json.dumps({"epoch": self.osdmap.epoch}), 0
+
+    def _cmd_config_key(self, prefix: str, cmd) -> tuple[str, int]:
+        """Arbitrary KV through paxos (mon/ConfigKeyService analog):
+        free-form keys, unlike `config set`'s option registry — the mgr
+        module store (module config, enabled-module list) lives here,
+        which is what lets a promoted standby find it."""
+        import json
+        KV = "__kv__"
+        if prefix == "config-key dump":
+            return json.dumps(self.osdmap.config_db.get(KV, {})), 0
+        key = str(cmd["key"])
+        if prefix == "config-key get":
+            sec = self.osdmap.config_db.get(KV, {})
+            if key not in sec:
+                return f"no such key {key!r}", -2
+            return sec[key], 0
+        if prefix == "config-key set":
+            value = str(cmd.get("value", ""))
+
+            def fn(m: OSDMap):
+                sec = m.config_db.setdefault(KV, {})
+                if sec.get(key) == value:
+                    return False
+                sec[key] = value
+            if not self._mutate(fn):
+                return "commit failed", -11
+            return json.dumps({"epoch": self.osdmap.epoch}), 0
+        # config-key rm
+        def fn(m: OSDMap):
+            sec = m.config_db.get(KV, {})
+            if key not in sec:
+                return False
+            del sec[key]
+            if not sec:
+                m.config_db.pop(KV, None)
         if not self._mutate(fn):
             return "commit failed", -11
         return json.dumps({"epoch": self.osdmap.epoch}), 0

@@ -48,18 +48,28 @@ COMMANDS = {
     ("osd", "pg-upmap-items"): ["pgid", "*id_pairs"],
     ("osd", "rm-pg-upmap-items"): ["pgid"],
     ("mgr", "dump"): [],
+    ("mgr", "module", "ls"): [],
+    ("mgr", "module", "enable"): ["module"],
+    ("mgr", "module", "disable"): ["module"],
     ("pg", "dump"): [],
     ("pg", "ls"): ["pool"],
     ("iostat",): [],
     ("balancer", "status"): [],
     ("balancer", "optimize"): [],
     ("telemetry", "show"): [],
+    ("osd", "pool", "autoscale-status"): [],
+    ("config-key", "set"): ["key", "value"],
+    ("config-key", "get"): ["key"],
+    ("config-key", "rm"): ["key"],
+    ("config-key", "dump"): [],
 }
 
 #: prefixes served by the active MGR (re-targeted via `mgr dump`),
 #: like the reference's mgr command routing
 MGR_COMMANDS = {"pg dump", "pg ls", "iostat", "balancer status",
-                "balancer optimize", "telemetry show"}
+                "balancer optimize", "telemetry show",
+                "mgr module ls", "mgr module enable",
+                "mgr module disable", "osd pool autoscale-status"}
 
 
 def parse_command(words: list[str]) -> dict:

@@ -1,0 +1,150 @@
+"""Mgr module framework + multi-mgr failover (pybind/mgr/mgr_module.py
++ MgrMonitor.cc analogs): modules load by name from mon-persisted
+config, module config/state lives mon-side (config-key), the MgrMap
+names an active and standbys, killing the active promotes a standby
+that still answers pg dump, and pg_autoscaler grows a filling pool's
+pg_num autonomously."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from ceph_tpu.mgr import MgrDaemon, ModuleHost
+from ceph_tpu.tools.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osds=3, ms_type="loopback").start()
+    c.wait_for_osd_count(3)
+    client = c.client(timeout=20.0)
+    # a pool with data, so pg dump has rows to serve
+    pool = c.create_pool(client, pg_num=8, size=2)
+    io = client.open_ioctx(pool)
+    io.write_full("seed", b"mgr-module-test")
+    yield c
+    c.stop()
+
+
+def _wait(pred, timeout=20.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def test_module_framework_load_enable_disable(cluster):
+    mgr = cluster.run_mgr(0)
+    client = cluster.client(timeout=20.0)
+    try:
+        # the mon names it active; always-on modules load
+        assert _wait(lambda: mgr.is_active)
+        assert _wait(lambda: set(ModuleHost.ALWAYS_ON)
+                     <= set(mgr.host.modules))
+        # enable-by-name persists in the MON config-key store
+        out, rc = mgr._handle_command({"prefix": "mgr module enable",
+                                       "module": "pg_autoscaler"})
+        assert rc == 0, out
+        assert "pg_autoscaler" in mgr.host.modules
+        rc2, raw = client.mon_command({"prefix": "config-key get",
+                                       "key": "mgr/modules"})
+        assert rc2 == 0 and "pg_autoscaler" in json.loads(raw)
+        # module ls names enabled + available
+        out, rc = mgr._handle_command({"prefix": "mgr module ls"})
+        ls = json.loads(out)
+        assert "pg_autoscaler" in ls["loaded_modules"]
+        assert "prometheus" in ls["available_modules"]
+        # a bogus module is refused, not crashed on
+        _out, rc = mgr._handle_command({"prefix": "mgr module enable",
+                                        "module": "nope"})
+        assert rc == -2
+        # module commands route through the host's prefix table
+        out, rc = mgr._handle_command(
+            {"prefix": "osd pool autoscale-status"})
+        assert rc == 0 and "pools" in json.loads(out)
+        # always-on modules cannot be disabled; others can
+        _out, rc = mgr._handle_command({"prefix": "mgr module disable",
+                                        "module": "balancer"})
+        assert rc == -22
+        out, rc = mgr._handle_command({"prefix": "mgr module disable",
+                                       "module": "pg_autoscaler"})
+        assert rc == 0
+        assert "pg_autoscaler" not in mgr.host.modules
+    finally:
+        cluster.kill_mgr(0)
+
+
+def test_standby_promotion_on_active_death(cluster):
+    client = cluster.client(timeout=20.0)
+    mgr0 = cluster.run_mgr(0)
+    assert _wait(lambda: mgr0.is_active)
+    mgr1 = cluster.run_mgr(1)
+    try:
+        # the MgrMap names mgr.0 active with mgr.1 standby
+        def map_settled():
+            db = client.osdmap.mgr_db or {}
+            return (db.get("active_name") == "mgr.0"
+                    and [s["name"] for s in db.get("standbys", [])]
+                    == ["mgr.1"])
+        assert _wait(map_settled), client.osdmap.mgr_db
+        assert not mgr1.is_active and not mgr1.host.modules
+        # kill the active: the mon promotes the standby, which loads
+        # the module set and starts answering
+        cluster.kill_mgr(0)
+        assert _wait(lambda: (client.osdmap.mgr_db or {})
+                     .get("active_name") == "mgr.1", timeout=30.0), \
+            client.osdmap.mgr_db
+        assert _wait(lambda: mgr1.is_active)
+        assert _wait(lambda: set(ModuleHost.ALWAYS_ON)
+                     <= set(mgr1.host.modules))
+        # OSDs re-target reports at the promoted mgr: pg dump refills
+        assert _wait(lambda: mgr1.pg_dump()["num_pgs"] > 0,
+                     timeout=30.0)
+        # and the mgr command tier answers through the new active
+        res, out = client.mgr_command({"prefix": "iostat"})
+        assert res == 0
+    finally:
+        cluster.kill_mgr(1)
+
+
+def test_pg_autoscaler_grows_filling_pool(cluster):
+    client = cluster.client(timeout=20.0)
+    pool = cluster.create_pool(client, pg_num=2, size=2)
+    io = client.open_ioctx(pool)
+    for i in range(24):
+        io.write_full(f"fill-{i}", b"x" * 4096)
+    mgr = cluster.run_mgr(0)
+    try:
+        assert _wait(lambda: mgr.is_active)
+        # configure a small budget through the module-option store
+        # (mon-side config-key), then enable the module — from here on
+        # everything is autonomous: host tick -> maybe_scale -> mon
+        # `osd pool set pg_num` -> PG splits on the OSDs
+        mgr.set_store("mgr/pg_autoscaler/target_pgs_per_osd", 8)
+        mgr.set_store("mgr/pg_autoscaler/sleep_interval", 1.0)
+        out, rc = mgr._handle_command({"prefix": "mgr module enable",
+                                       "module": "pg_autoscaler"})
+        assert rc == 0, out
+        # wait for the report feed, then for the autonomous growth
+        assert _wait(lambda: mgr.pg_dump()["num_pgs"] > 0, timeout=30.0)
+        assert _wait(
+            lambda: client.osdmap.pools.get(pool) is not None
+            and client.osdmap.pools[pool].pg_num > 2, timeout=45.0), \
+            f"pg_num still {client.osdmap.pools[pool].pg_num}"
+        grown = client.osdmap.pools[pool].pg_num
+        assert grown >= 8
+        # autoscale-status reports what it did
+        out, rc = mgr._handle_command(
+            {"prefix": "osd pool autoscale-status"})
+        rows = {r["pool"]: r for r in json.loads(out)["pools"]}
+        assert rows[pool]["pg_num"] >= 8 or \
+            rows[pool]["action"] == "grown"
+        # data stays reachable across the splits
+        assert io.read("fill-0", 16) == b"x" * 16
+    finally:
+        cluster.kill_mgr(0)
