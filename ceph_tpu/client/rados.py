@@ -210,9 +210,10 @@ class RadosClient(Dispatcher):
         self._schedule_sub_renew()
 
     def _subscribe(self) -> None:
+        from ceph_tpu.common.moncmd import mon_targets
         with self._lock:
             epoch = self.osdmap.epoch
-        for rank, addr in enumerate(self.mon_addrs):
+        for rank, addr in mon_targets(self.osdmap, self.mon_addrs):
             mon = self.msgr.connect_to(addr, EntityName("mon", rank))
             mon.send_message(MMonSubscribe(name=str(self.name),
                                            addr=self.msgr.my_addr,
@@ -324,8 +325,9 @@ class RadosClient(Dispatcher):
         import time as _time
         deadline = _time.time() + self.timeout
         last_exc: Exception | None = None
+        from ceph_tpu.common.moncmd import mon_targets
         while True:
-            for rank, addr in enumerate(self.mon_addrs):
+            for rank, addr in mon_targets(self.osdmap, self.mon_addrs):
                 remaining = deadline - _time.time()
                 if remaining <= 0:
                     raise last_exc if last_exc \

@@ -13,13 +13,34 @@ import threading
 from ceph_tpu.msg.messenger import EntityName
 
 
+def mon_targets(osdmap, static_addrs: list[str]) -> list[tuple[int, str]]:
+    """(rank, addr) list every mon consumer should iterate: the
+    COMMITTED monmap first (daemons follow `mon add/rm` instead of
+    dying with their boot-time mon list), then any statically-
+    configured address the map does not cover — a committed entry can
+    go stale when a mon restarts on a fresh ephemeral port, and the
+    static fallback is what lets the consumer still reach it."""
+    mons = (getattr(osdmap, "mon_db", None) or {}).get("mons") or {}
+    out = sorted(((int(r), a) for r, a in mons.items()),
+                 key=lambda kv: kv[0])
+    known = {a for _r, a in out}
+    out.extend((r, a) for r, a in enumerate(static_addrs)
+               if a not in known)
+    return out
+
+
 class MonCommander:
-    def __init__(self, msgr, mon_addrs: list[str]):
+    def __init__(self, msgr, mon_addrs: list[str], osdmap_fn=None):
         self.msgr = msgr
         self.mon_addrs = mon_addrs
+        self._osdmap_fn = osdmap_fn
         self._lock = threading.Lock()
         self._tid = 0
         self._waiters: dict[int, queue.Queue] = {}
+
+    def _targets(self) -> list[tuple[int, str]]:
+        return mon_targets(self._osdmap_fn() if self._osdmap_fn
+                           else None, self.mon_addrs)
 
     def cmd(self, cmd: dict, timeout: float = 8.0) -> tuple[int, str]:
         from ceph_tpu.messages import MMonCommand
@@ -29,7 +50,7 @@ class MonCommander:
             q: queue.Queue = queue.Queue()
             self._waiters[tid] = q
         try:
-            for rank, addr in enumerate(self.mon_addrs):
+            for rank, addr in self._targets():
                 con = self.msgr.connect_to(addr.strip(),
                                            EntityName("mon", rank))
                 con.send_message(MMonCommand(tid=tid, cmd=dict(cmd)))

@@ -170,7 +170,8 @@ class MgrDaemon(Dispatcher):
         self._rotating_at = 0.0
         from ceph_tpu.common.moncmd import MonCommander
         self.mon_cmd = MonCommander(
-            self.msgr, [x for x in mon_addr.split(",") if x])
+            self.msgr, [x for x in mon_addr.split(",") if x],
+            osdmap_fn=lambda: self.osdmap)
         if cephx is not None:
             from ceph_tpu.auth.cephx import TicketKeyring
             from ceph_tpu.auth.handshake import CephxConfig
@@ -190,8 +191,10 @@ class MgrDaemon(Dispatcher):
             self._rotating_at = time.time()
 
     def _subscribe(self) -> None:
+        from ceph_tpu.common.moncmd import mon_targets
         from ceph_tpu.mon.monitor import MMonSubscribe
-        for rank, a in enumerate(
+        for rank, a in mon_targets(
+                self.osdmap,
                 [x for x in self.mon_addr.split(",") if x]):
             con = self.msgr.connect_to(a, EntityName("mon", rank))
             con.send_message(MMonSubscribe(name=str(self.name),

@@ -53,11 +53,16 @@ class MMonElection(Message):
 class Elector:
     ELECTION_TIMEOUT = 1.0
 
-    def __init__(self, rank: int, n_mons: int, send_fn, on_win, on_lose):
+    def __init__(self, rank: int, ranks, send_fn, on_win, on_lose):
         """send_fn(rank, MMonElection); on_win(epoch, quorum);
-        on_lose(epoch, leader, quorum)."""
+        on_lose(epoch, leader, quorum).
+
+        ranks: the monmap's member ranks — an int n (ranks 0..n-1, the
+        static-monmap convenience) or an explicit list (runtime
+        membership leaves holes after `mon rm`)."""
         self.rank = rank
-        self.n_mons = n_mons
+        self.ranks = (sorted(ranks) if not isinstance(ranks, int)
+                      else list(range(ranks)))
         self.send = send_fn
         self.on_win = on_win
         self.on_lose = on_lose
@@ -75,7 +80,13 @@ class Elector:
         self._lock = make_lock(f"Elector::lock({rank})")
 
     def majority(self) -> int:
-        return self.n_mons // 2 + 1
+        return len(self.ranks) // 2 + 1
+
+    def set_ranks(self, ranks: list[int]) -> None:
+        """Runtime membership change (monmap epoch bump): the next
+        election runs over the new member set."""
+        with self._lock:
+            self.ranks = sorted(ranks)
 
     # -- entry points ---------------------------------------------------------
 
@@ -89,10 +100,10 @@ class Elector:
             self.acked_me = {self.rank}
             self.expire_at = time.time() + self.ELECTION_TIMEOUT
             epoch = self.epoch
-        if self.n_mons == 1:
+        if self.ranks == [self.rank]:
             self._declare_victory()
             return
-        for r in range(self.n_mons):
+        for r in self.ranks:
             if r != self.rank:
                 self.send(r, MMonElection(op=MMonElection.PROPOSE,
                                           epoch=epoch, rank=self.rank))
@@ -119,7 +130,7 @@ class Elector:
         elif declare:
             self._declare_victory()
         elif retry:
-            for r in range(self.n_mons):
+            for r in self.ranks:
                 if r != self.rank:
                     self.send(r, MMonElection(op=MMonElection.PROPOSE,
                                               epoch=epoch, rank=self.rank))
@@ -186,7 +197,7 @@ class Elector:
             # election before deferring): adopt it, the ack still counts
             self.epoch = max(self.epoch, msg.epoch)
             self.acked_me.add(msg.rank)
-            if len(self.acked_me) == self.n_mons:
+            if self.acked_me >= set(self.ranks):
                 declare = True   # everyone answered: no need to wait
         if declare:
             self._declare_victory()

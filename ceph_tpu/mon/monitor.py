@@ -97,6 +97,59 @@ class MMonSubscribe(Message):
 
 
 @register_message
+class MMonProbe(Message):
+    """mon <-> mon bootstrap probing + store sync
+    (messages/MMonProbe.h:22 + Monitor.cc:1186-1400 probe,
+    :1560-1740 sync, reduced):
+
+      PROBE      joiner -> any known mon: who is in the monmap?
+      REPLY      member -> joiner: committed monmap + my paxos tail pos
+      SYNC       joiner -> member: my store ends at `last_committed`,
+                 ship me the tail
+      SYNC_DATA  member -> joiner: paxos values (full snapshots) +
+                 last_committed; the joiner installs them and only THEN
+                 enters elections
+    """
+
+    TYPE = 67  # MSG_MON_PROBE
+
+    PROBE = 1
+    REPLY = 2
+    SYNC = 3
+    SYNC_DATA = 4
+
+    def __init__(self, op: int = 0, rank: int = -1, addr: str = "",
+                 mon_db: dict | None = None, last_committed: int = 0,
+                 values: dict[int, bytes] | None = None):
+        super().__init__()
+        self.op = op
+        self.rank = rank
+        self.addr = addr
+        self.mon_db = mon_db or {}
+        self.last_committed = last_committed
+        self.values = values or {}
+
+    def encode_payload(self, enc: Encoder):
+        enc.versioned(1, 1, lambda e: (
+            e.u8(self.op), e.s32(self.rank), e.str(self.addr),
+            e.bytes(json.dumps(self.mon_db).encode()),
+            e.u64(self.last_committed),
+            e.map(self.values, lambda e2, k: e2.u64(k),
+                  lambda e2, v: e2.bytes(v))))
+
+    def decode_payload(self, dec: Decoder, version: int):
+        def body(d, v):
+            self.op = d.u8()
+            self.rank = d.s32()
+            self.addr = d.str()
+            self.mon_db = json.loads(d.bytes().decode() or "{}")
+            self.last_committed = d.u64()
+            self.values = d.map(lambda d2: d2.u64(),
+                                lambda d2: d2.bytes())
+        dec.versioned(1, body)
+
+
+@register_message
 class MMonForward(Message):
     """peon -> leader: relayed client command (messages/MForward.h)."""
 
@@ -241,7 +294,20 @@ class Monitor(Dispatcher):
         #: not fail every healthy rank on its first tick)
         self._mds_watch_since: float | None = None
         self._osd_addrs: dict[int, str] = {}
-        self.monmap: list[str] = []
+        #: rank -> address.  Runtime membership (`mon add/rm`) keeps
+        #: this in lockstep with the committed mon_db; `mon rm` leaves
+        #: rank holes, hence a dict rather than a list
+        self.monmap: dict[int, str] = {}
+        #: committed monmap epoch this mon has reconfigured to
+        self.monmap_epoch = 0
+        #: probing mode (Monitor.cc bootstrap/probe): seed addrs we ask
+        #: for the authoritative monmap until we find ourselves in it
+        self._probe_addrs: list[str] = []
+        self._probe_synced = False
+        self._pending_join: dict | None = None
+        #: rank -> addr of members removed by `mon rm` (in-flight
+        #: fan-outs — notably their own removal COMMIT — still reach them)
+        self._retired_mons: dict[int, str] = {}
         self.elector: Elector | None = None
         self.paxos: Paxos | None = None
         self._tick_timer: threading.Timer | None = None
@@ -276,26 +342,44 @@ class Monitor(Dispatcher):
 
     # -- lifecycle ------------------------------------------------------------
 
-    def init(self, monmap: list[str] | None = None) -> None:
+    def init(self, monmap: list[str] | None = None,
+             probe: list[str] | None = None) -> None:
+        """probe: addresses of an EXISTING cluster to join instead of
+        forming a quorum from a static monmap (Monitor.cc bootstrap/
+        probe).  The mon stays out of elections until a probe reply
+        shows its rank in the committed monmap; a wiped store is
+        re-synced from the quorum's paxos tail first."""
         if isinstance(self.db, LogDB):
             self.db.open()
         self.msgr.bind(self._addr)
         self.msgr.start()
         self._worker = threading.Thread(target=self._work_loop, daemon=True)
         self._worker.start()
-        if monmap:
+        if probe:
+            self._probe_addrs = list(probe)
+            self._schedule_tick()
+            self._send_probes()
+        elif monmap:
             self.set_monmap(monmap)
-        elif monmap is None and self.monmap == []:
+        elif monmap is None and not self.monmap:
             # single-mon convenience: I am the whole quorum
             # (monmap=[] defers: caller will set_monmap once every mon
             # in the cluster has bound its address)
             self.set_monmap([self.addr])
 
-    def set_monmap(self, addrs: list[str]) -> None:
+    def set_monmap(self, addrs) -> None:
         """Fix the monitor cluster membership and start electing.
-        Must run after init() (our own address must be known)."""
-        self.monmap = list(addrs)
-        self.elector = Elector(self.mon_id, len(addrs), self._send_mon,
+        Must run after init() (our own address must be known).
+        addrs: list (ranks 0..n-1) or rank->addr dict."""
+        if isinstance(addrs, dict):
+            self.monmap = {int(r): a for r, a in addrs.items() if a}
+        else:
+            # empty entries are rank-hole padding (a list monmap after
+            # `mon rm`/sparse add): a phantom rank would inflate the
+            # election majority with a peer that can never ack
+            self.monmap = {r: a for r, a in enumerate(addrs) if a}
+        self.elector = Elector(self.mon_id, sorted(self.monmap),
+                               self._send_mon,
                                self._on_election_win, self._on_election_lose)
         self.paxos = Paxos(self.mon_id, self.db, self._send_mon,
                            self._on_paxos_commit, self._request_election)
@@ -332,11 +416,160 @@ class Monitor(Dispatcher):
     # -- mon-to-mon plumbing --------------------------------------------------
 
     def _send_mon(self, rank: int, msg) -> None:
-        if not (0 <= rank < len(self.monmap)):
+        addr = self.monmap.get(rank) or self._retired_mons.get(rank)
+        if addr is None:
             return
-        con = self.msgr.connect_to(self.monmap[rank],
-                                   EntityName("mon", rank))
+        con = self.msgr.connect_to(addr, EntityName("mon", rank))
         con.send_message(msg)
+
+    # -- runtime membership (Monitor.cc probe/sync + MonmapMonitor) -----------
+
+    #: values shipped per store sync: each is a full map snapshot, so
+    #: the tail only needs to cover realistic election-window lag
+    SYNC_TAIL = 50
+
+    _addr_fix_last = 0.0
+
+    def _maybe_fix_my_addr(self) -> None:
+        """A restarted mon can come back on a fresh ephemeral port
+        while the committed monmap still names its old one — re-commit
+        the entry through the ordinary `mon add` path so every consumer
+        of the map finds the live address again."""
+        db = self.osdmap.mon_db
+        if not db or self.elector is None or self.elector.electing:
+            return
+        mine = db.get("mons", {}).get(str(self.mon_id))
+        if mine is None or mine == self.addr:
+            return
+        now = time.time()
+        if now - self._addr_fix_last < 2.0:
+            return
+        self._addr_fix_last = now
+        cmd = {"prefix": "mon add", "id": self.mon_id,
+               "addr": self.addr}
+        if self.is_leader():
+            self._work_q.put(("cmd", cmd, None))
+        elif self.elector.leader is not None:
+            self._send_mon(self.elector.leader,
+                           MMonCommand(tid=0, cmd=cmd))
+
+    def _current_mon_db(self) -> dict:
+        """The committed monmap, or one synthesized from the static
+        config (clusters bootstrapped before mon_db existed)."""
+        if self.osdmap.mon_db:
+            return self.osdmap.mon_db
+        return {"epoch": 0, "mons": {str(r): a
+                                     for r, a in self.monmap.items()}}
+
+    def _stored_lc(self) -> int:
+        lc = self.db.get("paxos", "last_committed")
+        return int(lc.decode()) if lc else 0
+
+    def _send_probes(self) -> None:
+        self._probe_last = time.time()
+        for a in self._probe_addrs:
+            try:
+                con = self.msgr.connect_to(a, EntityName("mon", 0))
+                con.send_message(MMonProbe(
+                    op=MMonProbe.PROBE, rank=self.mon_id,
+                    addr=self.addr))
+            except OSError:
+                continue
+
+    def _handle_probe(self, msg: MMonProbe) -> None:
+        if msg.op == MMonProbe.PROBE:
+            # member side: hand the joiner the authoritative monmap and
+            # my paxos position (any member may answer, like the
+            # reference's probe)
+            msg.connection.send_message(MMonProbe(
+                op=MMonProbe.REPLY, rank=self.mon_id, addr=self.addr,
+                mon_db=self._current_mon_db(),
+                last_committed=self._stored_lc()))
+            return
+        if msg.op == MMonProbe.SYNC:
+            values: dict[int, bytes] = {}
+            lc = self._stored_lc()
+            lo = max(msg.last_committed + 1, lc - self.SYNC_TAIL + 1, 1)
+            for v in range(lo, lc + 1):
+                blob = self.db.get("paxos", f"v_{v}")
+                if blob is not None:
+                    values[v] = blob
+            msg.connection.send_message(MMonProbe(
+                op=MMonProbe.SYNC_DATA, rank=self.mon_id,
+                addr=self.addr, last_committed=lc, values=values))
+            return
+        if self.elector is not None or not self._probe_addrs:
+            return      # only an un-joined prober consumes replies
+        if msg.op == MMonProbe.REPLY:
+            mons = {int(r): a for r, a in
+                    msg.mon_db.get("mons", {}).items()}
+            if mons.get(self.mon_id) != self.addr:
+                return  # not (yet) a member: keep probing for mon add
+            self._pending_join = msg.mon_db
+            if self._stored_lc() < msg.last_committed \
+                    and not self._probe_synced:
+                # wiped/fresh store: pull the paxos tail BEFORE
+                # electing (a rank-0 joiner winning with an empty
+                # store would roll the cluster back)
+                msg.connection.send_message(MMonProbe(
+                    op=MMonProbe.SYNC, rank=self.mon_id,
+                    addr=self.addr,
+                    last_committed=self._stored_lc()))
+                return
+            self._finish_join(msg.mon_db)
+            return
+        if msg.op == MMonProbe.SYNC_DATA:
+            t = self.db.get_transaction()
+            for v in sorted(msg.values):
+                t.set("paxos", f"v_{v}", msg.values[v])
+            t.set("paxos", "last_committed",
+                  str(msg.last_committed).encode())
+            self.db.submit_transaction(t)
+            self._probe_synced = True
+            dout("mon", 1, "mon.%d store-synced to v%d (%d values)",
+                 self.mon_id, msg.last_committed, len(msg.values))
+            join = getattr(self, "_pending_join", None)
+            if join:
+                self._finish_join(join)
+
+    def _finish_join(self, mon_db: dict) -> None:
+        dout("mon", 1, "mon.%d joining: monmap e%d %s", self.mon_id,
+             mon_db.get("epoch", 0), mon_db.get("mons"))
+        self._probe_addrs = []
+        self._probe_synced = False
+        self.monmap_epoch = int(mon_db.get("epoch", 0))
+        self.set_monmap({int(r): a
+                         for r, a in mon_db.get("mons", {}).items()})
+
+    def _maybe_reconfigure(self, mon_db: dict) -> None:
+        """A committed monmap with a newer epoch reconfigures this
+        member: update peers, resize the elector, re-elect.  A mon that
+        finds itself REMOVED goes quiet (the reference's removed mon
+        shuts down; ours parks so the operator can stop it)."""
+        if not mon_db or int(mon_db.get("epoch", 0)) <= self.monmap_epoch:
+            return
+        mons = {int(r): a for r, a in mon_db.get("mons", {}).items()}
+        self.monmap_epoch = int(mon_db.get("epoch", 0))
+        if mons == self.monmap:
+            return
+        # keep removed members dialable: the COMMIT carrying their own
+        # removal fans out AFTER this reconfigure runs on the leader —
+        # dropping the address here would strand them in the old map
+        for r, a in self.monmap.items():
+            if r not in mons:
+                self._retired_mons[r] = a
+        self.monmap = mons
+        if self.mon_id not in mons:
+            dout("mon", 1, "mon.%d removed from monmap e%d — going "
+                 "quiet", self.mon_id, self.monmap_epoch)
+            self.elector = None
+            self.paxos = None
+            return
+        dout("mon", 1, "mon.%d monmap e%d -> members %s", self.mon_id,
+             self.monmap_epoch, sorted(mons))
+        if self.elector is not None:
+            self.elector.set_ranks(sorted(mons))
+            self._request_election()
 
     def _request_election(self) -> None:
         # one election at a time: restarting every liveness tick would
@@ -386,6 +619,7 @@ class Monitor(Dispatcher):
                     if e <= newmap.epoch - self.INC_HISTORY:
                         del self._inc_history[e]
             subs = list(self._subs.values())
+        self._maybe_reconfigure(newmap.mon_db)
         if inc_blob is not None:
             # normal churn: O(delta) bytes per subscriber per epoch
             msg = MOSDMapMsg(epoch=newmap.epoch,
@@ -400,12 +634,21 @@ class Monitor(Dispatcher):
     def _schedule_tick(self) -> None:
         if self._stop:
             return
+        if self._tick_timer is not None:
+            # idempotent: a joiner schedules during probing and again
+            # via set_monmap on join — never run two timer chains
+            self._tick_timer.cancel()
         self._tick_timer = threading.Timer(self.TICK_INTERVAL, self._tick)
         self._tick_timer.daemon = True
         self._tick_timer.start()
 
+    _probe_last = 0.0
+
     def _tick(self) -> None:
         try:
+            if self._probe_addrs and self.elector is None:
+                if time.time() - self._probe_last > 1.0:
+                    self._send_probes()
             if self.elector:
                 self.elector.tick()
             if self.paxos:
@@ -415,6 +658,7 @@ class Monitor(Dispatcher):
             if self.is_leader():
                 self._maybe_rotate_service_keys()
                 self._check_mgr_map()
+            self._maybe_fix_my_addr()
         finally:
             self._schedule_tick()
 
@@ -679,6 +923,12 @@ class Monitor(Dispatcher):
             m.crush = CrushMap()
             m.crush.add_bucket(
                 make_bucket(-1, CRUSH_BUCKET_STRAW2, 2, [], []))
+            # seed the committed monmap from the static boot config so
+            # `mon add/rm` has a base to mutate and probing joiners get
+            # an authoritative member set
+            m.mon_db = {"epoch": 1,
+                        "mons": {str(r): a
+                                 for r, a in self.monmap.items()}}
             if self._cephx_seed:
                 # commit the seed + fresh rotating service keys
                 m.auth_db.update(self._cephx_seed)
@@ -692,6 +942,9 @@ class Monitor(Dispatcher):
     def ms_dispatch(self, msg) -> bool:
         if self._stop:
             return True  # stopping mon answers nothing (zombie guard)
+        if isinstance(msg, MMonProbe):
+            self._handle_probe(msg)
+            return True
         if isinstance(msg, MMonElection):
             if self.elector:
                 self.elector.handle(msg)
@@ -978,14 +1231,18 @@ class Monitor(Dispatcher):
     #: floor; the reference's MonCap grammar is richer)
     ADMIN_ONLY = ("auth get-or-create", "auth del", "auth ls",
                   "auth get", "auth print-key", "config set",
-                  "config rm", "osd setcrushmap")
+                  "config rm", "osd setcrushmap",
+                  "mon add", "mon rm")
 
     def handle_command(self, cmd: dict) -> tuple[str, int]:
         import json
         prefix = cmd.get("prefix", "")
         ent = cmd.get("_auth_entity")
         if ent is not None and ent != "client.admin" \
+                and not ent.startswith("mon.") \
                 and prefix in self.ADMIN_ONLY:
+            # mon.* passes: a restarted mon re-commits its own address
+            # through `mon add` (_maybe_fix_my_addr)
             return f"entity {ent!r} not authorized for {prefix!r}", -13
         try:
             if prefix == "auth get-ticket":
@@ -1071,6 +1328,15 @@ class Monitor(Dispatcher):
                     "leader": self.elector.leader if self.elector else None,
                     "election_epoch": self.elector.epoch
                     if self.elector else 0}), 0
+            if prefix == "mon dump":
+                db = self._current_mon_db()
+                return json.dumps({"epoch": db.get("epoch", 0),
+                                   "mons": db.get("mons", {}),
+                                   "quorum": self.quorum()}), 0
+            if prefix == "mon add":
+                return self._cmd_mon_add(cmd)
+            if prefix == "mon rm":
+                return self._cmd_mon_rm(cmd)
             if prefix == "mgr dump":
                 # active mgr discovery (MgrMonitor::dump reduced): the
                 # mgr's map subscription carries its dialable address;
@@ -1479,6 +1745,52 @@ class Monitor(Dispatcher):
             return "commit failed", -11
         return json.dumps({"epoch": self.osdmap.epoch}), 0
 
+    def _cmd_mon_add(self, cmd) -> tuple[str, int]:
+        """`ceph mon add <id> <addr>` (MonmapMonitor::preprocess_join
+        reduced): commit the grown monmap; every member reconfigures on
+        the commit, and the probing joiner finds itself in the REPLY."""
+        import json
+        rank = int(cmd["id"])
+        addr = str(cmd["addr"])
+        base = self._current_mon_db()
+        mons = dict(base.get("mons", {}))
+        if mons.get(str(rank)) == addr:
+            return json.dumps({"epoch": base.get("epoch", 0)}), 0
+
+        def fn(m: OSDMap):
+            db = m.mon_db or self._current_mon_db()
+            ms = dict(db.get("mons", {}))
+            if ms.get(str(rank)) == addr:
+                return False
+            ms[str(rank)] = addr
+            m.mon_db = {"epoch": int(db.get("epoch", 0)) + 1,
+                        "mons": ms}
+        if not self._mutate(fn):
+            return "commit failed", -11
+        return json.dumps({"epoch": self.osdmap.mon_db.get("epoch", 0),
+                           "mons": self.osdmap.mon_db.get("mons")}), 0
+
+    def _cmd_mon_rm(self, cmd) -> tuple[str, int]:
+        import json
+        rank = int(cmd["id"])
+        base = self._current_mon_db()
+        if str(rank) not in base.get("mons", {}):
+            return f"mon.{rank} not in monmap", -2
+        if len(base.get("mons", {})) <= 1:
+            return "refusing to remove the last monitor", -22
+
+        def fn(m: OSDMap):
+            db = m.mon_db or self._current_mon_db()
+            ms = dict(db.get("mons", {}))
+            if ms.pop(str(rank), None) is None:
+                return False
+            m.mon_db = {"epoch": int(db.get("epoch", 0)) + 1,
+                        "mons": ms}
+        if not self._mutate(fn):
+            return "commit failed", -11
+        return json.dumps({"epoch": self.osdmap.mon_db.get("epoch", 0),
+                           "mons": self.osdmap.mon_db.get("mons")}), 0
+
     def _cmd_config_key(self, prefix: str, cmd) -> tuple[str, int]:
         """Arbitrary KV through paxos (mon/ConfigKeyService analog):
         free-form keys, unlike `config set`'s option registry — the mgr
@@ -1574,7 +1886,7 @@ class Monitor(Dispatcher):
         # MON_DOWN: monmap members absent from the current quorum
         if self.elector is not None and self.monmap:
             q = set(self.quorum())
-            missing = [r for r in range(len(self.monmap)) if r not in q]
+            missing = [r for r in sorted(self.monmap) if r not in q]
             if missing and not self.elector.electing:
                 check("MON_DOWN",
                       f"{len(missing)} mons down",

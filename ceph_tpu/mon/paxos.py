@@ -33,6 +33,7 @@ from ceph_tpu.msg.message import Message, register_message
 @register_message
 class MMonPaxos(Message):
     TYPE = 66  # MSG_MON_PAXOS
+    HEAD_VERSION = 3       # v3: sync flag (store-sync jump on COMMIT)
 
     COLLECT = 1
     LAST = 2
@@ -46,7 +47,7 @@ class MMonPaxos(Message):
                  last_committed: int = 0, version: int = 0,
                  value: bytes = b"",
                  values: dict[int, bytes] | None = None,
-                 pending_epoch: int = 0):
+                 pending_epoch: int = 0, sync: int = 0):
         super().__init__()
         self.op = op
         self.epoch = epoch          # election epoch (proposal ordering)
@@ -56,15 +57,19 @@ class MMonPaxos(Message):
         self.value = value          # uncommitted value (LAST/BEGIN)
         self.values = values or {}  # committed catch-up payload
         self.pending_epoch = pending_epoch  # epoch the pending was accepted
+        #: v3 (COMMIT only): the sender's history starts above the
+        #: receiver's tail — the receiver may JUMP to these values
+        #: (legal: every value is a full-state snapshot, not a delta)
+        self.sync = sync
 
     def encode_payload(self, enc: Encoder):
-        enc.versioned(2, 1, lambda e: (
+        enc.versioned(3, 1, lambda e: (
             e.u8(self.op), e.u32(self.epoch), e.s32(self.rank),
             e.u64(self.last_committed), e.u64(self.version),
             e.bytes(self.value),
             e.map(self.values, lambda e2, k: e2.u64(k),
                   lambda e2, v: e2.bytes(v)),
-            e.u32(self.pending_epoch)))
+            e.u32(self.pending_epoch), e.u8(self.sync)))
 
     def decode_payload(self, dec: Decoder, version):
         def body(d, v):
@@ -75,9 +80,9 @@ class MMonPaxos(Message):
             self.version = d.u64()
             self.value = d.bytes()
             self.values = d.map(lambda d2: d2.u64(), lambda d2: d2.bytes())
-            if v >= 2:
-                self.pending_epoch = d.u32()
-        dec.versioned(2, body)
+            self.pending_epoch = d.u32() if v >= 2 else 0
+            self.sync = d.u8() if v >= 3 else 0
+        dec.versioned(3, body)
 
 
 STATE_RECOVERING = "recovering"
@@ -302,11 +307,16 @@ class Paxos:
             if self.pending is not None:
                 reply.version, reply.value = self.pending[:2]
                 reply.pending_epoch = self.pending[2]
-            # catch the new leader up on commits it missed
+            # catch the new leader up on commits it missed; a store-
+            # synced peon with a gap ships its contiguous tail flagged
+            # sync so the leader may jump (values are full snapshots)
             for v in range(msg.last_committed + 1, self.last_committed + 1):
                 blob = self.get(v)
                 if blob is not None:
                     reply.values[v] = blob
+                else:
+                    reply.sync = 1
+                    reply.values.clear()
         self.send(msg.rank, reply)
 
     def _handle_begin(self, msg: MMonPaxos) -> None:
@@ -323,7 +333,15 @@ class Paxos:
     def _handle_commit(self, msg: MMonPaxos) -> None:
         commits: list[tuple[int, bytes]] = []
         with self._lock:
-            for v in sorted(msg.values):
+            ordered = sorted(msg.values)
+            if msg.sync and ordered and ordered[0] > \
+                    self.last_committed + 1:
+                # store-sync jump (Monitor.cc sync_start reduced): the
+                # sender's history starts above our tail, and every
+                # value is a full snapshot — adopt its tail wholesale.
+                # Our own pre-jump history stays valid below the gap.
+                self.last_committed = ordered[0] - 1
+            for v in ordered:
                 if v == self.last_committed + 1:
                     blob = msg.values[v]
                     self._store_commit(v, blob)
@@ -354,8 +372,13 @@ class Paxos:
         with self._lock:
             if not self.is_leader or self.state != STATE_RECOVERING:
                 return
-            # adopt commits newer than mine
-            for v in sorted(msg.values):
+            # adopt commits newer than mine (jump over the gap when the
+            # peon's synced history starts above my tail)
+            ordered = sorted(msg.values)
+            if msg.sync and ordered and ordered[0] > \
+                    self.last_committed + 1:
+                self.last_committed = ordered[0] - 1
+            for v in ordered:
                 if v == self.last_committed + 1:
                     self._store_commit(v, msg.values[v])
                     self.last_committed = v
@@ -463,15 +486,24 @@ class Paxos:
     # -- introspection --------------------------------------------------------
 
     def catch_up_peon(self, rank: int, from_version: int) -> None:
-        """Ship committed values [from_version+1 .. last_committed]."""
+        """Ship committed values [from_version+1 .. last_committed].
+        A leader whose own history starts above from_version (it store-
+        synced into the cluster) ships what it has with the sync flag,
+        and the peon jumps — correct because values are full
+        snapshots."""
         with self._lock:
             values = {}
+            missing = False
             for v in range(from_version + 1, self.last_committed + 1):
                 blob = self.get(v)
                 if blob is not None:
                     values[v] = blob
+                else:
+                    missing = True
+                    values.clear()   # ship only the contiguous tail
             epoch, lc = self.epoch, self.last_committed
         if values:
             self.send(rank, MMonPaxos(op=MMonPaxos.COMMIT, epoch=epoch,
                                       rank=self.rank, last_committed=lc,
-                                      values=values))
+                                      values=values,
+                                      sync=1 if missing else 0))

@@ -242,7 +242,8 @@ class OSDDaemon(Dispatcher):
         self.msgr.set_auth(auth_key)
         from ceph_tpu.common.moncmd import MonCommander
         #: the daemon's own admin RPC path (rotating keys, tickets)
-        self.mon_cmd = MonCommander(self.msgr, self.mon_addrs)
+        self.mon_cmd = MonCommander(self.msgr, self.mon_addrs,
+                                    osdmap_fn=lambda: self.osdmap)
         if cephx is not None:
             from ceph_tpu.auth.cephx import TicketKeyring
             from ceph_tpu.auth.handshake import CephxConfig
@@ -567,8 +568,11 @@ class OSDDaemon(Dispatcher):
 
     def _send_to_mons(self, make_msg) -> None:
         """Send make_msg() to every monitor (reports are idempotent; the
-        leader executes, peons ignore)."""
-        for rank, addr in enumerate(self.mon_addrs):
+        leader executes, peons ignore).  Targets follow the COMMITTED
+        monmap when one exists, so runtime `mon add/rm` re-points the
+        daemon without a restart."""
+        from ceph_tpu.common.moncmd import mon_targets
+        for rank, addr in mon_targets(self.osdmap, self.mon_addrs):
             mon = self.msgr.connect_to(addr, EntityName("mon", rank))
             mon.send_message(make_msg())
 

@@ -237,8 +237,10 @@ def encode_osdmap(m: OSDMap, *, with_auth: bool = False) -> bytes:
         e.bytes(_json.dumps(m.fs_db).encode() if m.fs_db else b"")
         # v9: active-mgr record (MgrMap) — OSDs/clients re-target by it
         e.bytes(_json.dumps(m.mgr_db).encode() if m.mgr_db else b"")
+        # v10: monitor membership (MonMap) — mon add/rm rides paxos
+        e.bytes(_json.dumps(m.mon_db).encode() if m.mon_db else b"")
 
-    enc.versioned(9, 1, body)
+    enc.versioned(10, 1, body)
     return enc.tobytes()
 
 
@@ -305,7 +307,7 @@ def diff_osdmap(old: OSDMap, new: OSDMap) -> dict:
         encode_crush(new.crush, enc_new)
         inc["crush"] = enc_new.tobytes()
     for attr in ("config_db", "fs_db", "crush_names",
-                 "mgr_db"):
+                 "mgr_db", "mon_db"):
         if getattr(old, attr) != getattr(new, attr):
             inc[attr] = _json.dumps(getattr(new, attr))
     return inc
@@ -347,7 +349,7 @@ def apply_incremental(m: OSDMap, inc: dict) -> None:
     if "crush" in inc:
         m.crush = decode_crush(Decoder(inc["crush"]))
     for attr in ("config_db", "fs_db", "crush_names",
-                 "mgr_db"):
+                 "mgr_db", "mon_db"):
         if attr in inc:
             setattr(m, attr, _json.loads(inc[attr]))
     m.epoch = inc["epoch"]
@@ -383,13 +385,13 @@ def encode_incremental(inc: dict) -> bytes:
                              e2.f64(x.laggy_interval)))
         e.bytes(inc.get("crush", b""))
         for attr in ("config_db", "fs_db", "crush_names",
-                 "mgr_db"):
+                     "mgr_db", "mon_db"):   # mon_db: v2
             has = attr in inc
             e.u8(1 if has else 0)
             if has:
                 e.bytes(inc[attr].encode())
 
-    enc.versioned(1, 1, body)
+    enc.versioned(2, 1, body)
     return enc.tobytes()
 
 
@@ -433,8 +435,10 @@ def decode_incremental(data: bytes) -> dict:
         crush = d.bytes()
         if crush:
             inc["crush"] = crush
-        for attr in ("config_db", "fs_db", "crush_names",
-                 "mgr_db"):
+        side = ["config_db", "fs_db", "crush_names", "mgr_db"]
+        if version >= 2:
+            side.append("mon_db")
+        for attr in side:
             if d.u8():
                 inc[attr] = d.bytes().decode()
         return inc
@@ -500,6 +504,7 @@ def decode_osdmap(data: bytes) -> OSDMap:
         auth_db = {}
         fs_db = {}
         mgr_db = {}
+        mon_db = {}
         if version >= 6:
             import json as _json
             blob = d.bytes()
@@ -517,9 +522,13 @@ def decode_osdmap(data: bytes) -> OSDMap:
                 blob = d.bytes()
                 if blob:
                     mgr_db = _json.loads(blob.decode())
+            if version >= 10:
+                blob = d.bytes()
+                if blob:
+                    mon_db = _json.loads(blob.decode())
         return OSDMap(epoch=epoch, crush=crush, max_osd=max_osd,
                       config_db=config_db, auth_db=auth_db, fs_db=fs_db,
-                      mgr_db=mgr_db,
+                      mgr_db=mgr_db, mon_db=mon_db,
                       crush_names=crush_names, osd_xinfo=xinfo,
                       osd_state=osd_state, osd_weight=osd_weight,
                       osd_primary_affinity=affinity, osd_addrs=osd_addrs,

@@ -151,6 +151,12 @@ class OSDMap:
     #: {"active_name": "mgr.0", "addr": "..."} — OSDs stream reports to
     #: it; clients re-target mgr-tier commands at it
     mgr_db: dict = field(default_factory=dict)
+    #: monitor membership (MonMap analog): {"epoch": N, "mons":
+    #: {rank-str: addr}} — committed through paxos like any map, so
+    #: `mon add/rm` reconfigures every quorum member identically and a
+    #: probing joiner learns the authoritative member set.  Empty on
+    #: clusters bootstrapped with a static monmap before first commit
+    mon_db: dict = field(default_factory=dict)
     #: per-osd laggy history (osd_xinfo_t vector)
     osd_xinfo: list[OSDXInfo] = field(default_factory=list)
 
@@ -168,7 +174,7 @@ class OSDMap:
             setattr(m, attr, list(getattr(self, attr)))
         for attr in ("pools", "pg_upmap", "pg_upmap_items", "pg_temp",
                      "primary_temp", "config_db", "auth_db", "fs_db",
-                     "crush_names", "mgr_db"):
+                     "crush_names", "mgr_db", "mon_db"):
             setattr(m, attr, dict(getattr(self, attr)))
         return m
 

@@ -29,6 +29,9 @@ COMMANDS = {
     ("auth", "ls"): [],
     ("auth", "del"): ["entity"],
     ("quorum_status",): [],
+    ("mon", "dump"): [],
+    ("mon", "add"): ["id", "addr"],
+    ("mon", "rm"): ["id"],
     ("fs", "new"): ["fs_name", "metadata", "data"],
     ("fs", "status"): [],
     ("fs", "set"): ["var", "val"],

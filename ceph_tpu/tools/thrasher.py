@@ -157,6 +157,17 @@ class Thrasher:
             if self.downed_mon is not None and roll < 0.2:
                 mon = self.downed_mon
                 self.downed_mon = None
+                if self.rng.random() < 0.5:
+                    # mon REPLACE: revive with a WIPED store — the
+                    # probe + store-sync path must rebuild it from the
+                    # quorum (Monitor.cc sync_start)
+                    try:
+                        self.cluster.replace_mon(mon)
+                        self.actions += 1
+                        return f"replace mon.{mon} (wiped store)"
+                    except (TimeoutError, RuntimeError):
+                        self.downed_mon = mon   # retry next step
+                        return f"replace mon.{mon} pending"
                 self.cluster.run_mon(mon)
                 self.actions += 1
                 return f"revive mon.{mon}"

@@ -113,6 +113,84 @@ class MiniCluster:
         mon = self.mons.pop(mon_id)
         mon.shutdown()
 
+    def add_mon(self, mon_id: int, timeout: float = 30.0) -> Monitor:
+        """GROW the mon cluster at runtime (`ceph mon add` + probe):
+        the new mon starts probing the existing quorum, the membership
+        commits through paxos, and this returns once the joiner has
+        entered the committed monmap and elections settled."""
+        import json as _json
+        import time as _time
+        addr = ("127.0.0.1:0" if self._is_wire()
+                else f"{self._ns}mon.{mon_id}")
+        path = (f"{self.base_path}/mon.{mon_id}" if self.base_path
+                else None)
+        seeds = [m.addr for m in self.mons.values()]
+        mon = Monitor(mon_id=mon_id, ms_type=self.ms_type, addr=addr,
+                      store_path=path, auth_key=self.auth_key,
+                      cephx_keyring=self.keyring if self.cephx else None)
+        mon.init(probe=seeds)
+        client = self.client(timeout=20.0)
+        rc, out = client.mon_command({"prefix": "mon add",
+                                      "id": mon_id, "addr": mon.addr})
+        if rc != 0:
+            mon.shutdown()
+            raise RuntimeError(f"mon add failed: {out}")
+        self.mons[mon_id] = mon
+        while len(self.monmap) <= mon_id:
+            self.monmap.append("")
+        self.monmap[mon_id] = mon.addr
+        deadline = _time.time() + timeout
+        while _time.time() < deadline:
+            if mon.elector is not None and not mon.elector.electing \
+                    and mon.mon_id in (mon.quorum() or []):
+                return mon
+            _time.sleep(0.1)
+        self.mons.pop(mon_id, None)
+        mon.shutdown()
+        raise TimeoutError(
+            f"mon.{mon_id} did not join quorum: elector="
+            f"{mon.elector is not None}, quorum={mon.quorum()}")
+
+    def replace_mon(self, mon_id: int, timeout: float = 30.0) -> Monitor:
+        """Kill a mon, WIPE its store, and rejoin it via probe +
+        store-sync (the dead-mon-replacement flow: the fresh store pulls
+        the paxos tail from the quorum before electing)."""
+        import shutil
+        import time as _time
+        if mon_id in self.mons:
+            self.kill_mon(mon_id)
+        path = (f"{self.base_path}/mon.{mon_id}" if self.base_path
+                else None)
+        if path:
+            shutil.rmtree(path, ignore_errors=True)
+        addr = ("127.0.0.1:0" if self._is_wire()
+                else f"{self._ns}mon.{mon_id}")
+        seeds = [m.addr for m in self.mons.values()]
+        mon = Monitor(mon_id=mon_id, ms_type=self.ms_type, addr=addr,
+                      store_path=path, auth_key=self.auth_key,
+                      cephx_keyring=self.keyring if self.cephx else None)
+        mon.init(probe=seeds)
+        if self._is_wire():
+            # the wiped mon's new ephemeral port must replace the old
+            # monmap entry before the probe can match it
+            client = self.client(timeout=20.0)
+            client.mon_command({"prefix": "mon add", "id": mon_id,
+                                "addr": mon.addr})
+        self.mons[mon_id] = mon
+        if mon_id < len(self.monmap):
+            self.monmap[mon_id] = mon.addr
+        deadline = _time.time() + timeout
+        while _time.time() < deadline:
+            if mon.elector is not None and not mon.elector.electing:
+                return mon
+            _time.sleep(0.1)
+        # clean up the half-joined mon: leaving it registered (and its
+        # threads running) would let a later run_mon bind a SECOND
+        # monitor over the same address/store
+        self.mons.pop(mon_id, None)
+        mon.shutdown()
+        raise TimeoutError(f"replaced mon.{mon_id} did not rejoin")
+
     def run_mgr(self, mgr_id: int = 0):
         """Start a manager; OSDs started AFTERWARDS stream reports to
         the one the mon names active (restart existing ones to pick it

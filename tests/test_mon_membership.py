@@ -1,0 +1,152 @@
+"""Runtime monitor membership (Monitor.cc:1186-1400 probe, :1560-1740
+store sync, MonmapMonitor reduced): growing 1→3 mons on a live cluster
+under I/O, killing + wiping a mon and watching it probe + store-sync +
+rejoin quorum, and removing a mon."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.tools.vstart import MiniCluster
+
+
+def _wait(pred, timeout=30.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def test_grow_one_to_three_mons_under_io():
+    c = MiniCluster(n_osds=3, n_mons=1, ms_type="loopback").start()
+    try:
+        c.wait_for_osd_count(3)
+        client = c.client(timeout=20.0)
+        pool = c.create_pool(client, pg_num=4, size=2)
+        io = client.open_ioctx(pool)
+        io.write_full("pre-grow", b"written before the grow")
+
+        # background I/O across the whole membership change
+        stop = threading.Event()
+        errors: list = []
+        written = [0]
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                try:
+                    io.write_full(f"grow-{i}", f"v{i}".encode())
+                    written[0] = i
+                    i += 1
+                except Exception as e:   # noqa: BLE001
+                    errors.append(e)
+                    return
+                time.sleep(0.02)
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            c.add_mon(1)
+            c.add_mon(2)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert not errors, errors
+        assert written[0] > 0
+
+        # all three mons agree on membership and quorum
+        rc, out = client.mon_command({"prefix": "mon dump"})
+        assert rc == 0
+        dump = json.loads(out)
+        assert set(dump["mons"]) == {"0", "1", "2"}
+        assert _wait(lambda: all(
+            sorted(m.quorum()) == [0, 1, 2] for m in c.mons.values()))
+        # data written during the grow is all there
+        for i in range(0, written[0] + 1, max(written[0] // 5, 1)):
+            assert io.read(f"grow-{i}", 32) == f"v{i}".encode()
+
+        # paxos survives losing the original mon: 2 of 3 is quorum
+        c.kill_mon(0)
+        client2 = c.client(timeout=20.0)
+        assert _wait(lambda: client2.mon_command(
+            {"prefix": "quorum_status"})[0] == 0
+            and set(json.loads(client2.mon_command(
+                {"prefix": "quorum_status"})[1])["quorum"]) == {1, 2})
+        # a paxos MUTATION still commits on the survivor quorum
+        pool2 = c.create_pool(client2, pg_num=4, size=2)
+        io2 = client2.open_ioctx(pool2)
+        io2.write_full("post-kill", b"quorum of two")
+        assert io2.read("post-kill", 32) == b"quorum of two"
+    finally:
+        c.stop()
+
+
+def test_wiped_mon_store_syncs_and_rejoins():
+    c = MiniCluster(n_osds=3, n_mons=3, ms_type="loopback").start()
+    try:
+        c.wait_for_osd_count(3)
+        client = c.client(timeout=20.0)
+        pool = c.create_pool(client, pg_num=4, size=2)
+        io = client.open_ioctx(pool)
+        io.write_full("durable", b"survives the wipe")
+        # build paxos history beyond the sync tail, so the rejoin is a
+        # genuine JUMP sync (tail only), not a full-history replay
+        for m in c.mons.values():
+            m.SYNC_TAIL = 5
+        for i in range(8):
+            client.mon_command({"prefix": "config-key set",
+                                "key": f"churn/{i}", "value": str(i)})
+        lead = next(m for m in c.mons.values() if m.is_leader())
+        lc_before = lead.paxos.last_committed
+        assert lc_before > 8
+
+        replaced = c.replace_mon(2)
+        # the wiped store pulled the tail: its history STARTS above v1
+        assert replaced.paxos.last_committed >= lc_before
+        assert replaced.db.get("paxos", "v_1") is None
+        assert _wait(lambda: sorted(replaced.quorum()) == [0, 1, 2])
+        # and it serves the synced state
+        assert _wait(lambda: replaced.osdmap.epoch
+                     >= lead.osdmap.epoch - 1)
+        assert replaced.osdmap.mon_db.get("mons", {}).keys() \
+            == {"0", "1", "2"}
+        # cluster still fully functional incl. the replaced mon as a
+        # paxos participant: kill a DIFFERENT mon; {replaced, other}
+        # must still commit mutations
+        c.kill_mon(0)
+        client2 = c.client(timeout=20.0)
+        assert _wait(lambda: client2.mon_command(
+            {"prefix": "config-key set", "key": "after",
+             "value": "wipe"})[0] == 0)
+        assert io.read("durable", 32) == b"survives the wipe"
+    finally:
+        c.stop()
+
+
+def test_mon_rm_shrinks_quorum():
+    c = MiniCluster(n_osds=0, n_mons=3, ms_type="loopback").start()
+    try:
+        client = c.client(timeout=20.0)
+        assert _wait(lambda: client.mon_command(
+            {"prefix": "quorum_status"})[0] == 0)
+        rc, out = client.mon_command({"prefix": "mon rm", "id": 2})
+        assert rc == 0, out
+        # survivors reconfigure to {0,1}; the removed mon goes quiet
+        assert _wait(lambda: all(
+            sorted(c.mons[i].monmap) == [0, 1] for i in (0, 1)))
+        assert _wait(lambda: c.mons[2].elector is None)
+        assert _wait(lambda: sorted(c.mons[0].quorum()) == [0, 1])
+        # removing the last-but-one is allowed; removing the LAST is not
+        rc, _ = client.mon_command({"prefix": "mon rm", "id": 1})
+        assert rc == 0
+        assert _wait(lambda: c.mons[0].quorum() == [0])
+        rc, out = client.mon_command({"prefix": "mon rm", "id": 0})
+        assert rc == -22
+    finally:
+        c.stop()
