@@ -26,6 +26,8 @@ import queue
 import threading
 import time
 
+from ceph_tpu.common.clog import (
+    MLog, PRIO_INFO, PRIO_WARN, LogStore)
 from ceph_tpu.common.context import CephTpuContext
 from ceph_tpu.common.logging import dout
 # top-level, not lazy: a STANDALONE mon process must have type 0x702 in
@@ -288,6 +290,13 @@ class Monitor(Dispatcher):
         #: mgr name -> (time, addr, con, available, modules) — mon-local
         #: liveness feeding the MgrMap (MgrMonitor beacon table)
         self._mgr_beacons: dict[str, tuple] = {}
+        #: central cluster log (LogMonitor analog): every mon persists
+        #: the fanned-out MLog stream and serves `ceph log last`
+        self.logstore = LogStore(self.db)
+        self._clog_seq = 0
+        self._mgr_logged_active: str | None = None
+        self._health_log_status: str | None = None
+        self._health_log_last = 0.0
         #: when this mon started watching beacons as leader: a gid we
         #: have NEVER heard from is only dead once a full grace has
         #: passed since then (a freshly-elected/restarted leader must
@@ -428,6 +437,43 @@ class Monitor(Dispatcher):
     #: the tail only needs to cover realistic election-window lag
     SYNC_TAIL = 50
 
+    def _clog(self, prio: int, fmt: str, *args) -> None:
+        """Mon-originated cluster-log entry: persist locally, fan to
+        peer mons (LogMonitor logging its own events)."""
+        from ceph_tpu.common.clog import make_entry
+        with self._lock:
+            self._clog_seq += 1
+            ent = make_entry(self._clog_seq, prio,
+                             (fmt % args) if args else fmt)
+        name = f"mon.{self.mon_id}"
+        self.logstore.append(name, [ent])
+        for r in list(self.monmap):
+            if r != self.mon_id:
+                self._send_mon(r, MLog(name=name, entries=[ent]))
+
+    def _check_health_transition(self) -> None:
+        """Leader: log HEALTH_OK <-> HEALTH_WARN transitions (the
+        reference's health-to-clog bridge)."""
+        now = time.time()
+        if now - self._health_log_last < 2.0:
+            return
+        self._health_log_last = now
+        try:
+            rep = self._health_report()
+        except Exception:
+            return
+        status = rep["status"]
+        if status == self._health_log_status:
+            return
+        prev = self._health_log_status
+        self._health_log_status = status
+        if prev is None and status == "HEALTH_OK":
+            return      # boot into OK is not a transition
+        detail = "; ".join(c.get("summary", c.get("check", ""))
+                           for c in rep.get("checks", [])) or "all clear"
+        self._clog(PRIO_WARN if status != "HEALTH_OK" else PRIO_INFO,
+                   "health %s -> %s (%s)", prev or "?", status, detail)
+
     _addr_fix_last = 0.0
 
     def _maybe_fix_my_addr(self) -> None:
@@ -567,6 +613,9 @@ class Monitor(Dispatcher):
             return
         dout("mon", 1, "mon.%d monmap e%d -> members %s", self.mon_id,
              self.monmap_epoch, sorted(mons))
+        if self.is_leader():
+            self._clog(PRIO_INFO, "monmap e%d: members %s",
+                       self.monmap_epoch, sorted(mons))
         if self.elector is not None:
             self.elector.set_ranks(sorted(mons))
             self._request_election()
@@ -658,6 +707,7 @@ class Monitor(Dispatcher):
             if self.is_leader():
                 self._maybe_rotate_service_keys()
                 self._check_mgr_map()
+                self._check_health_transition()
             self._maybe_fix_my_addr()
         finally:
             self._schedule_tick()
@@ -730,12 +780,31 @@ class Monitor(Dispatcher):
 
         if self.osdmap.mgr_db == desired:
             return
+        old_active = (cur or {}).get("active_name")
+        new_active = desired.get("active_name")
 
         def fn(m: OSDMap, desired=desired):
             if m.mgr_db == desired:
                 return False
             m.mgr_db = desired
-        self._work_q.put(("mgr_map", fn, None))
+
+        def log_after():
+            # runs after the mutation: log only a transition that
+            # actually COMMITTED, deduped against the last logged
+            # active (pending paxos rounds re-enqueue this every tick)
+            if self.osdmap.mgr_db != desired \
+                    or old_active == new_active \
+                    or self._mgr_logged_active == new_active:
+                return
+            self._mgr_logged_active = new_active
+            if new_active is None:
+                self._clog(PRIO_WARN, "no active mgr (last was %s)",
+                           old_active)
+            else:
+                self._clog(PRIO_INFO, "mgr %s is now active%s",
+                           new_active,
+                           f" (was {old_active})" if old_active else "")
+        self._work_q.put(("mgr_map", (fn, log_after), None))
 
     def _maybe_rotate_service_keys(self) -> None:
         """Leader: advance stale service-key generations (KeyServer
@@ -867,7 +936,12 @@ class Monitor(Dispatcher):
                 elif kind == "mds_failover":
                     self._do_mds_failover(payload)
                 elif kind in ("rotate_keys", "mgr_map"):
-                    self._mutate(payload)
+                    if isinstance(payload, tuple):
+                        fn, after = payload
+                        self._mutate(fn)
+                        after()
+                    else:
+                        self._mutate(payload)
             except Exception:
                 from ceph_tpu.common.logging import get_logger
                 get_logger("mon").exception("mon.%d work item failed",
@@ -1041,6 +1115,9 @@ class Monitor(Dispatcher):
                     time.time(), msg.addr, msg.connection,
                     msg.available, list(msg.modules))
             return True
+        if isinstance(msg, MLog):
+            self.logstore.append(msg.name, msg.entries)
+            return True
         return False
 
     def _handle_command_msg(self, msg: MMonCommand) -> None:
@@ -1103,7 +1180,11 @@ class Monitor(Dispatcher):
         with self._lock:
             self._osd_addrs[msg.osd_id] = msg.addr
             self._failure_reports.pop(msg.osd_id, None)
-        self._mutate(fn)
+        was_up = self.osdmap.is_up(msg.osd_id)
+        if self._mutate(fn) and not was_up \
+                and self.osdmap.is_up(msg.osd_id):
+            self._clog(PRIO_INFO, "osd.%d boot (%s)", msg.osd_id,
+                       msg.addr)
 
     def _crush_add_osd(self, m: OSDMap, osd: int, weight: int) -> None:
         """Attach a booting osd to the map's hierarchy (the default
@@ -1223,7 +1304,11 @@ class Monitor(Dispatcher):
             if not m.is_up(msg.failed_osd):
                 return False
             m.mark_down(msg.failed_osd)
-        self._mutate(fn)
+        if self._mutate(fn) and not self.osdmap.is_up(msg.failed_osd):
+            self._clog(PRIO_WARN,
+                       "osd.%d marked down (%d reporters from %d "
+                       "subtrees, failed for %.1fs)", msg.failed_osd,
+                       len(reports), len(subtrees), failed_for)
 
     # -- command table (MonCommands.h analog; worker thread) ------------------
 
@@ -1328,6 +1413,17 @@ class Monitor(Dispatcher):
                     "leader": self.elector.leader if self.elector else None,
                     "election_epoch": self.elector.epoch
                     if self.elector else 0}), 0
+            if prefix == "log last":
+                n = int(cmd.get("num", 100))
+                return json.dumps(self.logstore.last(
+                    n, channel=cmd.get("channel"),
+                    min_prio=int(cmd.get("level", 0)))), 0
+            if prefix == "log":
+                # operator-injected entry (`ceph log "..."`), fanned
+                # like any daemon's
+                self._clog(PRIO_INFO, "%s",
+                           str(cmd.get("message", "")))
+                return "{}", 0
             if prefix == "mon dump":
                 db = self._current_mon_db()
                 return json.dumps({"epoch": db.get("epoch", 0),

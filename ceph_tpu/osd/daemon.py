@@ -240,10 +240,17 @@ class OSDDaemon(Dispatcher):
         self._cephx = cephx
         self.msgr = Messenger.create(self.whoami, ms_type)
         self.msgr.set_auth(auth_key)
-        from ceph_tpu.common.moncmd import MonCommander
+        from ceph_tpu.common.moncmd import MonCommander, mon_targets
         #: the daemon's own admin RPC path (rotating keys, tickets)
         self.mon_cmd = MonCommander(self.msgr, self.mon_addrs,
                                     osdmap_fn=lambda: self.osdmap)
+        from ceph_tpu.common.clog import ClusterLogClient
+        #: central cluster log handle (LogClient): operator-significant
+        #: events (boot, pg recovered) batch to every mon
+        self.clog = ClusterLogClient(
+            self.msgr,
+            lambda: mon_targets(self.osdmap, self.mon_addrs),
+            f"osd.{osd_id}")
         if cephx is not None:
             from ceph_tpu.auth.cephx import TicketKeyring
             from ceph_tpu.auth.handshake import CephxConfig
@@ -527,6 +534,7 @@ class OSDDaemon(Dispatcher):
             self._renew_map_subscription(now)
             self._agent_scan(now)
             self._mgr_report()
+            self.clog.flush()
             # PG state summary to the mons (MPGStats flow): feeds the
             # PG_DEGRADED health check
             states, degraded = self._pg_stats_summary()
@@ -1581,6 +1589,8 @@ class OSDDaemon(Dispatcher):
         self._persist_info(pg)
         if done:
             self.local_reserver.cancel(pg.pgid)  # release the slot
+            self.clog.info("pg %d.%d recovered on osd.%d",
+                           pg.pgid[0], pg.pgid[1], self.osd_id)
         elif (pg.state == STATE_RECOVERING
               and self.local_reserver.has(pg.pgid)):
             # refill the pull window — only while we still hold the

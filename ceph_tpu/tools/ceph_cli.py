@@ -30,6 +30,8 @@ COMMANDS = {
     ("auth", "del"): ["entity"],
     ("quorum_status",): [],
     ("mon", "dump"): [],
+    ("log", "last"): ["num"],
+    ("log",): ["message"],
     ("mon", "add"): ["id", "addr"],
     ("mon", "rm"): ["id"],
     ("fs", "new"): ["fs_name", "metadata", "data"],
