@@ -14,7 +14,12 @@ I/O path:
   * snapshots — snap_create/list/remove/rollback + read(snap=...),
     riding pool snapshots namespaced per image (`rbd.<image>.<snap>`),
     with the image size frozen in the header's snap table
-  * clone — flatten-style copy of a snapshot into a new image
+  * clone — COW layering (CloneRequest/CopyupRequest): a child links
+    to a PROTECTED parent@snap and shares its objects; reads fall
+    through to the parent, the first write to an object copies it up,
+    and `flatten` severs the link.  Child snapshots freeze their own
+    parent record, so flatten/resize of the head never rewrites what a
+    snap could see
 """
 
 from __future__ import annotations
@@ -26,6 +31,9 @@ from ceph_tpu.osdc.journaler import Journaler
 from ceph_tpu.osdc.striper import StripeLayout, StripedObject
 
 RBD_DIRECTORY = "rbd_directory"
+#: pool-level parent@snap -> [child image names] registry (the
+#: reference's rbd_children object)
+RBD_CHILDREN = "rbd_children"
 
 #: image feature bits (librbd feature flags; journaling gates the
 #: write-ahead event journal that rbd-mirror replays; object-map keeps
@@ -247,6 +255,7 @@ class Image:
         self._journal_event({"op": "write", "off": offset,
                              "data": binascii.hexlify(data).decode()})
         self._om_mark_write(offset, len(data))
+        self._copyup(offset, len(data))
         self._striped().write(data, offset)
         return len(data)
 
@@ -296,6 +305,15 @@ class Image:
             snapid, size = ent["snapid"], ent["size"]
         if length <= 0 or offset + length > size:
             length = max(0, size - offset)
+        # clone layering: a SNAP read uses the parent record frozen in
+        # that snap entry (flatten/shrink only rewrite the head's);
+        # a head read uses the live head record
+        if snap is not None:
+            prec = m.get("snaps", {}).get(snap, {}).get("parent")
+        else:
+            prec = m.get("parent")
+        if prec:
+            return self._clone_read(offset, length, snapid, prec)
         data = self._striped().read(offset, length, snapid=snapid)
         if len(data) < length:      # unwritten space reads as zeros
             data = data + bytes(length - len(data))
@@ -381,8 +399,13 @@ class Image:
         # pre-write COW clone and silently corrupt the snapshot
         if "epoch" in reply:
             self.io.client.wait_for_epoch(reply["epoch"])
-        m.setdefault("snaps", {})[snap] = {"snapid": snapid,
-                                           "size": m["size"]}
+        ent = {"snapid": snapid, "size": m["size"]}
+        if m.get("parent"):
+            # freeze the parent linkage AS OF this snapshot: a later
+            # flatten or shrink (which rewrites the head's parent
+            # record) must never change what this snap reads
+            ent["parent"] = dict(m["parent"])
+        m.setdefault("snaps", {})[snap] = ent
         self._save_meta(m)
         if self._om_enabled():
             om = self._om_load()
@@ -405,14 +428,26 @@ class Image:
         m = self._load()
         if snap not in m.get("snaps", {}):
             raise KeyError(f"no snapshot {snap!r}")
+        if m["snaps"][snap].get("protected"):
+            raise OSError(16, f"snapshot {snap!r} is protected "
+                          "(unprotect first)")   # EBUSY
         rc, out = self.io.client.mon_command({
             "prefix": "osd pool rmsnap", "pool": self.io.pool_id,
             "snap": f"rbd.{self.name}.{snap}"})
         if rc != 0:
             raise OSError(-rc or 5, out)
         snapid = m["snaps"][snap]["snapid"]
+        removed_prec = m["snaps"][snap].get("parent")
         del m["snaps"][snap]
         self._save_meta(m)
+        if removed_prec and not m.get("parent") \
+                and not any(e.get("parent")
+                            for e in m.get("snaps", {}).values()):
+            # the last parent-referencing snap of a flattened clone is
+            # gone: nothing of this image reads the parent any more —
+            # release the children registration that blocked unprotect
+            Image(self.io, removed_prec["image"])._unregister_child(
+                removed_prec["snap"], self.name)
         from ceph_tpu.rbd_object_map import (
             OBJECT_EXISTS, OBJECT_EXISTS_CLEAN, OBJECT_PENDING,
             ObjectMap)
@@ -469,39 +504,228 @@ class Image:
         m["size"] = ent["size"]
         self._save_meta(m)
 
-    def clone(self, dst_name: str, snap: str) -> "Image":
-        """Copy a snapshot into a new image (clone + immediate flatten:
-        the lite model has no parent/child overlay chain).  With an
-        object map on the source, only the snapshot's PRESENT extents
-        are read and copied — a lightly-written multi-GiB snapshot
-        clones in O(written), the deep-copy object-map fast path."""
+    # -- snapshot protection + COW clone layering -----------------------------
+    # (src/librbd/image/CloneRequest.cc:80-220 parent linkage,
+    #  src/librbd/io/CopyupRequest.cc:120-260 first-write copy-up,
+    #  src/librbd/Operations.cc snap_protect/unprotect/flatten)
+
+    def snap_protect(self, snap: str) -> None:
+        """Mark a snapshot clone-able: children may link to it, and it
+        cannot be removed until unprotected (which in turn requires no
+        children)."""
+        self._check_primary()
         m = self._load()
         ent = m.get("snaps", {}).get(snap)
         if ent is None:
             raise KeyError(f"no snapshot {snap!r}")
+        ent["protected"] = True
+        self._save_meta(m)
+
+    def snap_unprotect(self, snap: str) -> None:
+        self._check_primary()
+        m = self._load()
+        ent = m.get("snaps", {}).get(snap)
+        if ent is None:
+            raise KeyError(f"no snapshot {snap!r}")
+        if self.list_children(snap):
+            raise OSError(16, f"snapshot {snap!r} has children")  # EBUSY
+        ent["protected"] = False
+        self._save_meta(m)
+
+    def snap_is_protected(self, snap: str) -> bool:
+        ent = self._load().get("snaps", {}).get(snap)
+        if ent is None:
+            raise KeyError(f"no snapshot {snap!r}")
+        return bool(ent.get("protected"))
+
+    @staticmethod
+    def _children_key(parent: str, snap: str) -> str:
+        return f"{parent}@{snap}"
+
+    def list_children(self, snap: str) -> list[str]:
+        """Child images cloned from parent@snap (rbd children)."""
+        try:
+            omap = self.io.get_omap(RBD_CHILDREN)
+        except OSError:
+            return []
+        blob = omap.get(self._children_key(self.name, snap))
+        return json.loads(blob.decode()) if blob else []
+
+    def _register_child(self, snap: str, child: str) -> None:
+        kids = self.list_children(snap)
+        if child not in kids:
+            kids.append(child)
+            self.io.set_omap(RBD_CHILDREN, {
+                self._children_key(self.name, snap):
+                json.dumps(kids).encode()})
+
+    def _unregister_child(self, snap: str, child: str) -> None:
+        kids = self.list_children(snap)
+        if child in kids:
+            kids.remove(child)
+            key = self._children_key(self.name, snap)
+            if kids:
+                self.io.set_omap(RBD_CHILDREN, {
+                    key: json.dumps(kids).encode()})
+            else:
+                try:
+                    self.io.rm_omap_keys(RBD_CHILDREN, [key])
+                except OSError:
+                    pass
+
+    def _parent(self) -> tuple["Image", str, int] | None:
+        """(parent image, parent snap, overlap bytes) for a clone."""
+        p = self._load().get("parent")
+        if not p:
+            return None
+        return Image(self.io, p["image"]), p["snap"], int(p["overlap"])
+
+    def _obj_name(self, objno: int) -> str:
+        st = self._striped()
+        return st.striper.object_name(
+            self.DATA_FMT.format(name=self.name), objno)
+
+    def _obj_exists(self, objno: int) -> bool:
+        try:
+            self.io.stat(self._obj_name(objno))
+            return True
+        except OSError:
+            return False
+
+    def _copyup(self, offset: int, length: int) -> None:
+        """First write to a clone-backed object pulls the parent's
+        bytes for that WHOLE object into the child first (CopyupRequest
+        ordering: copy-up, then the client write overwrites its part) —
+        after which reads of the object's other ranges come from the
+        child, never a torn child/parent mix."""
+        parent = self._parent()
+        if parent is None or length <= 0:
+            return
+        parent_img, psnap, overlap = parent
+        m = self._load()
+        st = self._striped()
+        span = min(overlap, m["size"])
+        end = offset + length
+        touched = {objno for objno, _o, _n in
+                   st.layout.extents(offset, length)}
+        for objno in sorted(touched):
+            if self._obj_exists(objno):
+                continue
+            extents = st.layout.object_logical_extents(objno, span)
+            if all(offset <= lo and lo + ln <= end
+                   for lo, ln in extents):
+                # the incoming write fully covers this object's bytes:
+                # nothing parent-backed survives it (CopyupRequest's
+                # full-overwrite fast path)
+                continue
+            for log_off, ln in extents:
+                data = parent_img.read(log_off, ln, snap=psnap)
+                # all-zero parent bytes need no object: reads keep
+                # falling through to the parent's zeros, and a rerun
+                # of this copy-up is idempotent
+                if data.rstrip(b"\x00"):
+                    st.write(data, log_off)
+
+    def _clone_read(self, offset: int, length: int, snapid: int,
+                    prec: dict) -> bytes:
+        """Clone read path: objects the child has are served locally;
+        missing objects (or objects with no state at the requested
+        child snap) read THROUGH to parent@snap, clipped to the
+        overlap (beyond it the clone reads zeros).  prec is the parent
+        record governing THIS read (the head's, or the one frozen in
+        the child snap being read)."""
+        parent_img = Image(self.io, prec["image"])
+        psnap, overlap = prec["snap"], int(prec["overlap"])
+        st = self._striped()
+        parts: list[bytes] = []
+        pos = offset
+        for objno, obj_off, n in st.layout.extents(offset, length):
+            chunk: bytes | None = None
+            if self._obj_exists(objno):
+                try:
+                    chunk = self.io.read(self._obj_name(objno),
+                                         length=n, offset=obj_off,
+                                         snapid=snapid)
+                except OSError:
+                    chunk = None    # no state at that child snap
+            if chunk is None:
+                if pos < overlap:
+                    pn = min(n, overlap - pos)
+                    chunk = parent_img.read(pos, pn, snap=psnap)
+                else:
+                    chunk = b""
+            if len(chunk) < n:
+                chunk = chunk + bytes(n - len(chunk))
+            parts.append(chunk)
+            pos += n
+        return b"".join(parts)
+
+    def clone(self, dst_name: str, snap: str) -> "Image":
+        """COW clone (CloneRequest.cc): the child links to
+        parent@snap and shares its objects — no data is copied.  Reads
+        fall through to the parent; the first write to an object
+        copies it up (see _copyup); `flatten` severs the link.  The
+        snapshot must be PROTECTED first (and stays unremovable while
+        children exist)."""
+        m = self._load()
+        ent = m.get("snaps", {}).get(snap)
+        if ent is None:
+            raise KeyError(f"no snapshot {snap!r}")
+        if not ent.get("protected"):
+            raise OSError(22, f"snapshot {snap!r} is not protected")
         inherit = [f for f in m.get("features", [])
                    if f in (FEATURE_OBJECT_MAP, FEATURE_FAST_DIFF)]
         dst = Image.create(self.io, dst_name, size=ent["size"],
                            order=m["order"], stripe_unit=m["stripe_unit"],
                            stripe_count=m["stripe_count"],
                            features=inherit)
-        extents = None
-        if self._om_enabled():
-            try:
-                extents = self.diff(to_snap=snap)
-            except (OSError, KeyError):
-                extents = None   # no/invalid snap map: full copy below
-        if extents is not None:
-            for off, ln, exists in extents:
-                if exists:
-                    data = self.read(off, ln, snap=snap)
-                    if data.rstrip(b"\x00"):
-                        dst.write(data, off)
-            return dst
-        data = self.read(0, ent["size"], snap=snap)
-        if data.rstrip(b"\x00"):
-            dst.write(data, 0)
+        dm = dst._load()
+        dm["parent"] = {"image": self.name, "snap": snap,
+                        "snapid": ent["snapid"],
+                        "overlap": ent["size"]}
+        dst._save_meta(dm)
+        self._register_child(snap, dst_name)
         return dst
+
+    def flatten(self) -> int:
+        """Copy every still-parent-backed object into the child's HEAD
+        and sever the head's parent link (librbd flatten — the explicit
+        end of thin provisioning).  Returns objects materialized.
+
+        Child snapshots keep the parent record frozen at their
+        creation, so their view survives the flatten — and while any
+        such snap exists the child stays in the parent's children
+        registry, keeping unprotect refused (the reference's
+        snapshots-remain-clones semantics)."""
+        parent = self._parent()
+        if parent is None:
+            return 0
+        self._check_primary()
+        self._check_lock()
+        parent_img, psnap, overlap = parent
+        m = self._load()
+        st = self._striped()
+        span = min(overlap, m["size"])
+        copied = 0
+        for objno in range(st.layout.num_objects(span)):
+            if self._obj_exists(objno):
+                continue
+            wrote = False
+            for log_off, ln in st.layout.object_logical_extents(
+                    objno, span):
+                data = parent_img.read(log_off, ln, snap=psnap)
+                if data.rstrip(b"\x00"):
+                    self._om_mark_write(log_off, ln)
+                    st.write(data, log_off)
+                    wrote = True
+            if wrote:
+                copied += 1
+        del m["parent"]
+        self._save_meta(m)
+        if not any(e.get("parent") for e in
+                   m.get("snaps", {}).values()):
+            parent_img._unregister_child(psnap, self.name)
+        return copied
 
     def resize(self, new_size: int) -> None:
         self._check_primary()
@@ -512,6 +736,11 @@ class Image:
             # shrink trims the discarded extent (real rbd semantics):
             # growing back later must read zeros, not stale payload
             self._striped().truncate(new_size)
+            # a clone shrunk below its parent overlap must never grow
+            # back into parent bytes it discarded
+            p = m.get("parent")
+            if p and new_size < int(p["overlap"]):
+                p["overlap"] = new_size
         m["size"] = new_size
         self._save_meta(m)
         if self._om_enabled():
@@ -669,6 +898,10 @@ class Image:
         if self._load().get("snaps"):
             raise OSError(16, "image has snapshots (remove them first)")
         self._check_lock()   # and while another owner holds the lock
+        parent = self._parent()
+        if parent is not None:
+            parent_img, psnap, _ov = parent
+            parent_img._unregister_child(psnap, self.name)
         from ceph_tpu.rbd_object_map import ObjectMap
         ObjectMap(self.io, self.name).remove()
         self._striped().remove()

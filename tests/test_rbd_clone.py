@@ -1,0 +1,202 @@
+"""RBD COW clone layering (librbd/image/CloneRequest.cc:80-220 +
+io/CopyupRequest.cc:120-260 analogs): protect -> clone -> read-through
+-> copy-up on first write -> flatten severs; children bookkeeping gates
+unprotect; thin provisioning proven by pool object counts."""
+
+from __future__ import annotations
+
+import pytest
+
+from ceph_tpu.rbd import FEATURE_OBJECT_MAP, Image
+from ceph_tpu.tools.vstart import MiniCluster
+
+MiB = 1 << 20
+
+
+@pytest.fixture(scope="module")
+def rig():
+    c = MiniCluster(n_osds=3, ms_type="loopback").start()
+    c.wait_for_osd_count(3)
+    client = c.client(timeout=20.0)
+    pool = c.create_pool(client, pg_num=8, size=2)
+    yield {"cluster": c, "client": client, "pool": pool,
+           "io": client.open_ioctx(pool)}
+    c.stop()
+
+
+def _pool_objects(rig) -> int:
+    n = 0
+    for osd in rig["cluster"].osds.values():
+        for cid in osd.store.list_collections():
+            if cid.startswith(f"{rig['pool']}."):
+                n += sum(1 for _ in osd.store.list_objects(cid))
+    return n
+
+
+def test_clone_requires_protection(rig):
+    img = Image.create(rig["io"], "golden0", size=1 * MiB, order=18)
+    img.write(b"base", 0)
+    img.snap_create("s")
+    with pytest.raises(OSError):
+        img.clone("never", "s")
+    img.snap_protect("s")
+    assert img.snap_is_protected("s")
+    c = img.clone("ok-child", "s")
+    assert c.read(0, 4) == b"base"
+
+
+def test_ten_clones_share_golden_objects(rig):
+    """Thin provisioning: 10 clones of a written golden image add only
+    header/metadata objects to the pool — none of the parent's data
+    objects are copied until someone writes."""
+    io = rig["io"]
+    img = Image.create(io, "golden", size=8 * MiB, order=20,
+                       stripe_unit=1 << 16, stripe_count=2)
+    img.write(b"G" * (2 * MiB), 0)          # a few data objects
+    img.write(b"tail", 6 * MiB)
+    img.snap_create("base")
+    img.snap_protect("base")
+    before = _pool_objects(rig)
+    clones = [img.clone(f"child-{i}", "base") for i in range(10)]
+    added = _pool_objects(rig) - before
+    # each clone adds its header (x2 replicas) plus shared registry
+    # objects — NO data objects (the golden image's 2 MiB of data
+    # would be ~4 objects x 2 replicas x 10 clones if copied)
+    assert added <= 10 * 2 + 6, added
+    # every clone reads the golden content through the parent
+    for c in clones:
+        assert c.read(0, 8) == b"G" * 8
+        assert c.read(6 * MiB, 4) == b"tail"
+        assert c.read(7 * MiB, 4) == b"\x00" * 4   # sparse stays sparse
+    assert sorted(img.list_children("base")) == sorted(
+        f"child-{i}" for i in range(10))
+
+
+def test_copyup_touches_only_written_objects(rig):
+    io = rig["io"]
+    img = Image.create(io, "golden2", size=8 * MiB, order=20,
+                       stripe_unit=1 << 16, stripe_count=2)
+    img.write(b"A" * (4 * MiB), 0)
+    img.snap_create("base")
+    img.snap_protect("base")
+    child = img.clone("cow-child", "base")
+    before = _pool_objects(rig)
+    # one small write: exactly the touched object(s) copy up
+    child.write(b"child!", 100)
+    added = _pool_objects(rig) - before
+    # the write covers ONE 1 MiB object (order=20): copy-up creates
+    # that object (replicated size=2 counts it twice) plus the striped
+    # size-meta object — not the 4 MiB of parent data
+    assert added <= 6, added
+    # read after copy-up: child part + parent-backed remainder intact
+    assert child.read(100, 6) == b"child!"
+    assert child.read(0, 100) == b"A" * 100      # same object, copied up
+    assert child.read(2 * MiB, 8) == b"A" * 8    # still parent-backed
+    # the PARENT snapshot is untouched
+    assert img.read(100, 6, snap="base") == b"A" * 6
+    assert img.read(0, 8) == b"A" * 8
+
+
+def test_unprotect_refused_while_children_then_flatten(rig):
+    io = rig["io"]
+    img = Image.create(io, "golden3", size=2 * MiB, order=19)
+    img.write(b"golden-three", 0)
+    img.snap_create("base")
+    img.snap_protect("base")
+    child = img.clone("flat-child", "base")
+    with pytest.raises(OSError):
+        img.snap_unprotect("base")
+    with pytest.raises(OSError):
+        img.snap_remove("base")
+    copied = child.flatten()
+    assert copied >= 1
+    # severed: content survives parent snapshot removal
+    assert img.list_children("base") == []
+    img.snap_unprotect("base")
+    img.snap_remove("base")
+    assert child.read(0, 12) == b"golden-three"
+    # child can re-write freely (no parent anymore)
+    child.write(b"post-flatten", 0)
+    assert child.read(0, 12) == b"post-flatten"
+
+
+def test_clone_remove_deregisters_child(rig):
+    io = rig["io"]
+    img = Image.create(io, "golden4", size=1 * MiB, order=18)
+    img.write(b"x" * 4096, 0)
+    img.snap_create("s")
+    img.snap_protect("s")
+    c = img.clone("doomed-child", "s")
+    assert img.list_children("s") == ["doomed-child"]
+    c.remove()
+    assert img.list_children("s") == []
+    img.snap_unprotect("s")     # now allowed
+
+
+def test_child_snap_view_survives_flatten_and_shrink(rig):
+    """A child snapshot freezes its parent record: flatten (which
+    severs only the HEAD link) and head shrink (which clamps only the
+    HEAD overlap) must not change what the snap reads — and the child
+    stays registered (unprotect refused) while such a snap exists."""
+    io = rig["io"]
+    img = Image.create(io, "golden6", size=4 * MiB, order=20)
+    img.write(b"Q" * (2 * MiB), 0)
+    img.snap_create("base")
+    img.snap_protect("base")
+    child = img.clone("frozen-child", "base")
+    child.write(b"c1", 0)
+    child.snap_create("cs")          # parent-backed beyond object 0
+    child.flatten()
+    # the pre-flatten snap still reads parent-backed ranges
+    assert child.read(1 * MiB + 16, 4, snap="cs") == b"Q" * 4
+    assert child.read(0, 2, snap="cs") == b"c1"
+    # flatten kept the child registered: a snap still references the
+    # parent, so unprotect stays refused
+    assert img.list_children("base") == ["frozen-child"]
+    with pytest.raises(OSError):
+        img.snap_unprotect("base")
+    # head shrink must not retroactively truncate the snap's view
+    child.resize(1 * MiB)
+    assert child.read(1 * MiB + 16, 4, snap="cs") == b"Q" * 4
+    # removing the last parent-referencing snap releases the parent
+    child.snap_remove("cs")
+    assert img.list_children("base") == []
+    img.snap_unprotect("base")
+
+
+def test_flatten_maintains_object_map(rig):
+    """Flatten's materialized objects must land in the object map, or
+    fast-diff/export-diff silently drop them."""
+    io = rig["io"]
+    img = Image.create(io, "golden7", size=2 * MiB, order=19,
+                       features=[FEATURE_OBJECT_MAP])
+    img.write(b"OMDATA" * 100, 0)
+    img.snap_create("base")
+    img.snap_protect("base")
+    child = img.clone("om-flat-child", "base")
+    child.flatten()
+    blob = child.export_diff()
+    fresh = Image.create(io, "om-flat-restore", size=2 * MiB, order=19)
+    fresh.import_diff(blob)
+    assert fresh.read(0, 12) == b"OMDATA" * 2
+    img.snap_unprotect("base")
+
+
+def test_clone_snapshot_and_object_map(rig):
+    """Clone with inherited object map: snapshots on the CHILD freeze
+    its copied-up state; reads at the child snap still fall through to
+    the parent for untouched objects."""
+    io = rig["io"]
+    img = Image.create(io, "golden5", size=4 * MiB, order=20,
+                       features=[FEATURE_OBJECT_MAP])
+    img.write(b"P" * (1 * MiB), 0)
+    img.snap_create("base")
+    img.snap_protect("base")
+    child = img.clone("snap-child", "base")
+    child.write(b"c1", 0)                    # copy-up object 0
+    child.snap_create("cs")
+    child.write(b"c2", 0)
+    assert child.read(0, 2) == b"c2"
+    assert child.read(0, 2, snap="cs") == b"c1"
+    # untouched range at the child snap: parent content
+    assert child.read(512 * 1024, 4, snap="cs") == b"P" * 4

@@ -124,6 +124,7 @@ def test_clone_copies_o_written(rig):
     img.feature_enable(FEATURE_OBJECT_MAP)
     img.write(b"only" * 1024, 5 * MiB)
     img.snap_create("base")
+    img.snap_protect("base")
     io.reset()
     dst = img.clone("om4-child", "base")
     # data reads proportional to WRITTEN extents (1 object's stripe

@@ -314,7 +314,9 @@ class TestRbdAdvanced:
         assert img.read(0, 11) == b"VERSION-TWO"
         assert img.read(0, 11, snap="v1") == b"version-one"
         assert "v1" in img.snap_list()
-        # clone from the snapshot sees v1 content, detached from src
+        # COW clone from the protected snapshot sees v1 content; its
+        # writes copy-up and never touch the parent
+        img.snap_protect("v1")
         c = img.clone("snappy-clone", "v1")
         assert c.read(0, 11) == b"version-one"
         c.write(b"clone-write", 0)
@@ -322,6 +324,13 @@ class TestRbdAdvanced:
         # rollback restores v1 on the source
         img.snap_rollback("v1")
         assert img.read(0, 11) == b"version-one"
+        # protected + child: removal refused until flatten + unprotect
+        with pytest.raises(OSError):
+            img.snap_remove("v1")
+        with pytest.raises(OSError):
+            img.snap_unprotect("v1")
+        c.flatten()
+        img.snap_unprotect("v1")
         img.snap_remove("v1")
         with pytest.raises(KeyError):
             img.read(0, 4, snap="v1")
