@@ -618,13 +618,23 @@ class Image:
                 # nothing parent-backed survives it (CopyupRequest's
                 # full-overwrite fast path)
                 continue
-            for log_off, ln in extents:
-                data = parent_img.read(log_off, ln, snap=psnap)
-                # all-zero parent bytes need no object: reads keep
-                # falling through to the parent's zeros, and a rerun
-                # of this copy-up is idempotent
-                if data.rstrip(b"\x00"):
-                    st.write(data, log_off)
+            self._materialize_object(st, extents, parent_img, psnap)
+
+    def _materialize_object(self, st, extents, parent_img,
+                            psnap: str, mark_om: bool = False) -> bool:
+        """Pull one object's parent-backed bytes into the child (the
+        shared copy-up/flatten loop).  All-zero parent bytes create no
+        object — reads keep falling through to the parent's zeros, and
+        a rerun is idempotent.  Returns True if anything was written."""
+        wrote = False
+        for log_off, ln in extents:
+            data = parent_img.read(log_off, ln, snap=psnap)
+            if data.rstrip(b"\x00"):
+                if mark_om:
+                    self._om_mark_write(log_off, ln)
+                st.write(data, log_off)
+                wrote = True
+        return wrote
 
     def _clone_read(self, offset: int, length: int, snapid: int,
                     prec: dict) -> bytes:
@@ -710,15 +720,9 @@ class Image:
         for objno in range(st.layout.num_objects(span)):
             if self._obj_exists(objno):
                 continue
-            wrote = False
-            for log_off, ln in st.layout.object_logical_extents(
-                    objno, span):
-                data = parent_img.read(log_off, ln, snap=psnap)
-                if data.rstrip(b"\x00"):
-                    self._om_mark_write(log_off, ln)
-                    st.write(data, log_off)
-                    wrote = True
-            if wrote:
+            if self._materialize_object(
+                    st, st.layout.object_logical_extents(objno, span),
+                    parent_img, psnap, mark_om=True):
                 copied += 1
         del m["parent"]
         self._save_meta(m)

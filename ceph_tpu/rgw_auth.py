@@ -1,0 +1,270 @@
+"""RGW object-level authorization: ACL grant lists, bucket policy
+documents, and CORS rules (src/rgw/rgw_acl.h:34-120 ACLGrant,
+src/rgw/rgw_iam_policy.cc:620-880 evaluator, src/rgw/rgw_cors.cc).
+
+Pure logic, no I/O — the gateway stores grant lists / policy JSON /
+CORS rules in bucket metadata and object index entries, and routes
+every data-path request through :func:`evaluate`:
+
+  1. bucket POLICY first: an explicit Deny ends it; an explicit Allow
+     grants without consulting ACLs (the reference's policy-over-ACL
+     precedence);
+  2. otherwise the ACL grant list — the OBJECT's if it has one, else
+     the bucket's (canned ACL names expand to grant lists, so the
+     pre-grant canned behaviour is the same table evaluated the same
+     way);
+  3. the owner always passes.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+
+# -- permissions (rgw_acl.h RGW_PERM_*) -------------------------------------
+
+READ = "READ"
+WRITE = "WRITE"
+READ_ACP = "READ_ACP"
+WRITE_ACP = "WRITE_ACP"
+FULL_CONTROL = "FULL_CONTROL"
+_PERMS = (READ, WRITE, READ_ACP, WRITE_ACP, FULL_CONTROL)
+
+#: group grantees (ACLGroupTypeEnum): every principal incl. anonymous /
+#: every authenticated principal
+ALL_USERS = "*"
+AUTH_USERS = "authenticated"
+
+
+def canned_grants(canned: str, owner: str) -> list[dict]:
+    """Expand a canned ACL name into its grant list
+    (rgw_acl_s3.cc create_canned)."""
+    out = []
+    if owner:
+        out.append({"grantee": owner, "permission": FULL_CONTROL})
+    if canned == "public-read":
+        out.append({"grantee": ALL_USERS, "permission": READ})
+    elif canned == "public-read-write":
+        out.append({"grantee": ALL_USERS, "permission": READ})
+        out.append({"grantee": ALL_USERS, "permission": WRITE})
+    elif canned == "authenticated-read":
+        out.append({"grantee": AUTH_USERS, "permission": READ})
+    # "private": owner only
+    return out
+
+
+def validate_grants(grants: list[dict]) -> list[dict]:
+    out = []
+    for g in grants:
+        grantee = str(g.get("grantee", ""))
+        perm = str(g.get("permission", "")).upper().replace("-", "_")
+        if not grantee:
+            raise ValueError("grant without grantee")
+        if perm not in _PERMS:
+            raise ValueError(f"unknown permission {perm!r}")
+        out.append({"grantee": grantee, "permission": perm})
+    return out
+
+
+def _grantee_matches(grantee: str, principal: str | None) -> bool:
+    if grantee == ALL_USERS:
+        return True
+    if grantee == AUTH_USERS:
+        return principal is not None
+    return principal is not None and grantee == principal
+
+
+def acl_allows(grants: list[dict], owner: str,
+               principal: str | None, perm: str) -> bool:
+    """One grant table lookup (RGWAccessControlPolicy::verify_permission
+    reduced): the owner has FULL_CONTROL implicitly; FULL_CONTROL
+    implies every permission."""
+    if principal is not None and owner and principal == owner:
+        return True
+    for g in grants:
+        if g["permission"] not in (perm, FULL_CONTROL):
+            continue
+        if _grantee_matches(g["grantee"], principal):
+            return True
+    return False
+
+
+# -- bucket policy (rgw_iam_policy reduced) ---------------------------------
+
+class PolicyError(ValueError):
+    pass
+
+
+class BucketPolicy:
+    """Parsed policy document: Version + Statement list of
+    {Effect, Principal, Action, Resource} — the Allow/Deny x
+    Principal/Action/Resource core of the reference's IAM engine
+    (Condition clauses are out of scope)."""
+
+    #: the actions the gateway actually evaluates; parse() refuses a
+    #: pattern that can never match any of them
+    ACTIONS = ("s3:GetObject", "s3:PutObject", "s3:DeleteObject",
+               "s3:ListBucket", "s3:GetObjectAcl", "s3:PutObjectAcl")
+
+    def __init__(self, statements: list[dict]):
+        self.statements = statements
+
+    @classmethod
+    def parse(cls, doc: str | bytes | dict) -> "BucketPolicy":
+        if isinstance(doc, (str, bytes)):
+            try:
+                doc = json.loads(doc)
+            except ValueError as e:
+                raise PolicyError(f"malformed policy JSON: {e}")
+        if not isinstance(doc, dict) or "Statement" not in doc:
+            raise PolicyError("policy needs a Statement list")
+        stmts = doc["Statement"]
+        if isinstance(stmts, dict):
+            stmts = [stmts]
+        if not isinstance(stmts, list) \
+                or not all(isinstance(s, dict) for s in stmts):
+            raise PolicyError("Statement must be an object list")
+        parsed = []
+        for s in stmts:
+            effect = s.get("Effect")
+            if effect not in ("Allow", "Deny"):
+                raise PolicyError(f"bad Effect {effect!r}")
+            principal = s.get("Principal", {})
+            if principal == "*":
+                principals = [ALL_USERS]
+            elif isinstance(principal, dict):
+                aws = principal.get("AWS", [])
+                principals = [aws] if isinstance(aws, str) else list(aws)
+            else:
+                raise PolicyError("bad Principal")
+            actions = s.get("Action", [])
+            if isinstance(actions, str):
+                actions = [actions]
+            for a in actions:
+                # a pattern matching NO known action is a typo, and the
+                # statement it gates would be permanently inert — an
+                # operator's Deny that does nothing is worse than an
+                # error at PUT time
+                if not any(fnmatch.fnmatchcase(known, a)
+                           for known in cls.ACTIONS):
+                    raise PolicyError(f"unknown action {a!r}")
+            resources = s.get("Resource", [])
+            if isinstance(resources, str):
+                resources = [resources]
+            parsed.append({"effect": effect, "principals": principals,
+                           "actions": actions, "resources": resources})
+        return cls(parsed)
+
+    @staticmethod
+    def _principal_matches(principals: list[str],
+                           principal: str | None) -> bool:
+        return any(p == ALL_USERS
+                   or (principal is not None and p == principal)
+                   for p in principals)
+
+    @staticmethod
+    def _action_matches(actions: list[str], action: str) -> bool:
+        return any(fnmatch.fnmatchcase(action, pat) for pat in actions)
+
+    @staticmethod
+    def _resource_matches(resources: list[str], bucket: str,
+                          key: str | None) -> bool:
+        arn = f"arn:aws:s3:::{bucket}" + (f"/{key}" if key else "")
+        return any(fnmatch.fnmatchcase(arn, pat) for pat in resources)
+
+    def evaluate(self, principal: str | None, action: str,
+                 bucket: str, key: str | None = None) -> str | None:
+        """'Deny' | 'Allow' | None (no statement matched).  Deny wins
+        over Allow (rgw_iam_policy's eval order)."""
+        verdict: str | None = None
+        for s in self.statements:
+            if not self._principal_matches(s["principals"], principal):
+                continue
+            if not self._action_matches(s["actions"], action):
+                continue
+            if not self._resource_matches(s["resources"], bucket, key):
+                continue
+            if s["effect"] == "Deny":
+                return "Deny"
+            verdict = "Allow"
+        return verdict
+
+
+# -- combined decision (rgw_op.cc verify_permission order) ------------------
+
+def evaluate(policy: BucketPolicy | None, grants: list[dict],
+             owner: str, principal: str | None, perm: str,
+             action: str, bucket: str, key: str | None = None) -> bool:
+    if policy is not None:
+        verdict = policy.evaluate(principal, action, bucket, key)
+        if verdict == "Deny":
+            return False
+        if verdict == "Allow":
+            return True
+    return acl_allows(grants, owner, principal, perm)
+
+
+# -- CORS (rgw_cors.cc reduced) ---------------------------------------------
+
+class CorsRule:
+    def __init__(self, origins: list[str], methods: list[str],
+                 headers: list[str] | None = None, max_age: int = 0):
+        self.origins = origins
+        self.methods = [m.upper() for m in methods]
+        self.headers = [h.lower() for h in (headers or [])]
+        self.max_age = max_age
+
+    def origin_matches(self, origin: str) -> bool:
+        # exact or *-wildcard origins ("https://*.example.com", "*")
+        return any(fnmatch.fnmatchcase(origin, pat)
+                   for pat in self.origins)
+
+    def allows(self, origin: str, method: str,
+               req_headers: list[str] | None = None) -> bool:
+        if not self.origin_matches(origin):
+            return False
+        if method.upper() not in self.methods:
+            return False
+        for h in req_headers or []:
+            h = h.strip().lower()
+            if not h:
+                continue
+            if h not in self.headers and "*" not in self.headers:
+                return False
+        return True
+
+    def to_dict(self) -> dict:
+        return {"origins": self.origins, "methods": self.methods,
+                "headers": self.headers, "max_age": self.max_age}
+
+
+class CorsConfig:
+    def __init__(self, rules: list[CorsRule]):
+        self.rules = rules
+
+    @classmethod
+    def from_rules(cls, rules: list[dict]) -> "CorsConfig":
+        out = []
+        for r in rules:
+            methods = [m.upper() for m in r.get("methods", [])]
+            for m in methods:
+                if m not in ("GET", "PUT", "POST", "DELETE", "HEAD"):
+                    raise ValueError(f"bad CORS method {m!r}")
+            if not r.get("origins"):
+                raise ValueError("CORS rule without origins")
+            out.append(CorsRule(list(r["origins"]), methods,
+                                list(r.get("headers", [])),
+                                int(r.get("max_age", 0))))
+        return cls(out)
+
+    def match(self, origin: str, method: str,
+              req_headers: list[str] | None = None) -> CorsRule | None:
+        """First rule allowing the request (RGWCORSConfiguration::
+        host_name_rule + is_rule_applicable)."""
+        for r in self.rules:
+            if r.allows(origin, method, req_headers):
+                return r
+        return None
+
+    def to_rules(self) -> list[dict]:
+        return [r.to_dict() for r in self.rules]

@@ -43,15 +43,18 @@ class Bucket:
                               "compression": self.compression}).encode()})
         return self
 
-    def meta_all(self) -> dict:
+    def meta_all(self, idx: dict | None = None) -> dict:
         """The parsed bucket metadata record ({} when absent) — ONE
         omap fetch; callers needing several fields use this instead of
-        repeated get_meta round trips."""
-        try:
-            omap = self.io.get_omap(self.INDEX_FMT.format(name=self.name))
-        except OSError:
-            return {}
-        blob = omap.get(".bucket.meta")
+        repeated get_meta round trips.  idx reuses a caller's index
+        snapshot (authorize fetches it once per request)."""
+        if idx is None:
+            try:
+                idx = self.io.get_omap(
+                    self.INDEX_FMT.format(name=self.name))
+            except OSError:
+                return {}
+        blob = idx.get(".bucket.meta")
         return json.loads(blob.decode()) if blob else {}
 
     def get_meta(self, key: str, default=None):
@@ -141,7 +144,7 @@ class Bucket:
 
     def put(self, key: str, data: bytes, metadata: dict | None = None,
             clock=time.time, unversioned: bool = False,
-            etag: str | None = None) -> dict:
+            etag: str | None = None, owner: str | None = None) -> dict:
         """Write an object; under versioning each put lands as a NEW
         version (a unique id, Enabled) or as THE null version
         (Suspended).  unversioned=True forces the classic single-slot
@@ -165,6 +168,10 @@ class Bucket:
                  "compression": self.comp.name}
         if etag is not None:
             entry["etag"] = etag
+        if owner is not None:
+            # the uploader (rgw_acl object owner): object-ACL ops are
+            # gated on it, not on the bucket owner
+            entry["owner"] = owner
         if vid is not None:
             entry["version_id"] = vid
             updates[self._vkey(key, vid)] = json.dumps(entry).encode()
@@ -172,22 +179,62 @@ class Bucket:
         self.io.set_omap(self.INDEX_FMT.format(name=self.name), updates)
         return entry
 
-    def current_entry(self, key: str) -> dict | None:
+    def update_entry(self, key: str, fields: dict,
+                     vid: str | None = None) -> dict:
+        """Merge fields into an index entry (object-ACL writes).  The
+        versioned row and — when it IS the current — the obj.<key> row
+        update together, so listings and direct reads agree."""
+        idx = self._index()
+        cur_blob = idx.get(f"obj.{key}")
+        cur = json.loads(cur_blob.decode()) if cur_blob else None
+        if vid is None:
+            if cur is None or cur.get("delete_marker"):
+                raise KeyError(key)
+            ent, is_current = cur, True
+            vid = cur.get("version_id")
+        else:
+            blob = idx.get(self._vkey(key, vid))
+            if blob is None and vid == "null" and cur is not None \
+                    and "version_id" not in cur:
+                # un-promoted pre-versioning object IS the null
+                # version (same fallback head() applies)
+                ent, is_current = cur, True
+                vid = None
+            elif blob is None:
+                raise KeyError(f"{key}@{vid}")
+            else:
+                ent = json.loads(blob.decode())
+                is_current = (cur is not None
+                              and cur.get("version_id") == vid)
+        ent.update(fields)
+        updates = {}
+        if vid is not None:
+            updates[self._vkey(key, vid)] = json.dumps(ent).encode()
+        if is_current:
+            updates[f"obj.{key}"] = json.dumps(ent).encode()
+        self.io.set_omap(self.INDEX_FMT.format(name=self.name), updates)
+        return ent
+
+    def current_entry(self, key: str,
+                      idx: dict | None = None) -> dict | None:
         """The current index entry — may be a delete marker — or None."""
-        blob = self._index().get(f"obj.{key}")
+        blob = (idx if idx is not None
+                else self._index()).get(f"obj.{key}")
         if not blob:
             return None
         return json.loads(blob.decode())
 
-    def head(self, key: str, vid: str | None = None) -> dict:
+    def head(self, key: str, vid: str | None = None,
+             idx: dict | None = None) -> dict:
         if vid is None:
-            entry = self.current_entry(key)
+            entry = self.current_entry(key, idx=idx)
         else:
-            blob = self._index().get(self._vkey(key, vid))
+            blob = (idx if idx is not None
+                    else self._index()).get(self._vkey(key, vid))
             entry = json.loads(blob.decode()) if blob else None
             if entry is None and vid == "null":
                 # un-promoted pre-versioning object IS the null version
-                cur = self.current_entry(key)
+                cur = self.current_entry(key, idx=idx)
                 if cur is not None and "version_id" not in cur:
                     entry = cur
         if entry is None or entry.get("delete_marker"):

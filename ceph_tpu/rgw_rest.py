@@ -128,7 +128,11 @@ _ERR_STATUS = {"NoSuchBucket": 404, "NoSuchKey": 404, "NoSuchUpload": 404,
                "SignatureDoesNotMatch": 403, "AccessDenied": 403,
                "InvalidPart": 400, "MalformedXML": 400,
                "InvalidArgument": 400, "RequestTimeTooSkewed": 403,
-               "NoSuchLifecycleConfiguration": 404}
+               "NoSuchLifecycleConfiguration": 404,
+               "NoSuchBucketPolicy": 404,
+               "NoSuchCORSConfiguration": 404,
+               "MalformedPolicy": 400, "MalformedACLError": 400,
+               "AccessForbidden": 403}
 
 
 class S3Error(Exception):
@@ -233,31 +237,138 @@ class S3Gateway:
         if acl not in ("private", "public-read", "public-read-write",
                        "authenticated-read"):
             raise S3Error("InvalidArgument", f"unsupported canned acl {acl}")
-        self._bucket(name).set_meta("acl", acl)
+        b = self._bucket(name)
+        b.set_meta("acl", acl)
+        # a canned reset REPLACES any explicit grant list — leaving
+        # stale grants behind would let `x-amz-acl: private` silently
+        # keep the bucket public
+        b.set_meta("grants", None)
+
+    def _bucket_grants(self, meta: dict) -> list[dict]:
+        """The bucket's effective grant list: explicit grants when set,
+        else the canned ACL expanded (rgw_acl.h ACLGrant table)."""
+        from ceph_tpu import rgw_auth
+        blob = meta.get("grants")
+        if blob:
+            return json.loads(blob)
+        return rgw_auth.canned_grants(meta.get("acl") or "private",
+                                      meta.get("owner") or "")
+
+    def get_policy(self, name: str) -> str | None:
+        return self._bucket(name).meta_all().get("policy")
+
+    def set_policy(self, name: str, doc: str) -> None:
+        from ceph_tpu import rgw_auth
+        try:
+            rgw_auth.BucketPolicy.parse(doc)     # validate up front
+        except rgw_auth.PolicyError as e:
+            raise S3Error("MalformedPolicy", str(e))
+        self._bucket(name).set_meta("policy", doc)
+
+    def delete_policy(self, name: str) -> None:
+        self._bucket(name).set_meta("policy", None)
+
+    def set_bucket_grants(self, name: str, grants: list[dict]) -> None:
+        from ceph_tpu import rgw_auth
+        try:
+            grants = rgw_auth.validate_grants(grants)
+        except ValueError as e:
+            raise S3Error("InvalidArgument", str(e))
+        self._bucket(name).set_meta("grants", json.dumps(grants))
+
+    def get_bucket_grants(self, name: str) -> list[dict]:
+        return self._bucket_grants(self._bucket(name).meta_all())
+
+    def set_object_grants(self, bucket: str, key: str,
+                          grants: list[dict],
+                          vid: str | None = None) -> None:
+        from ceph_tpu import rgw_auth
+        try:
+            grants = rgw_auth.validate_grants(grants)
+        except ValueError as e:
+            raise S3Error("InvalidArgument", str(e))
+        try:
+            self._bucket(bucket).update_entry(
+                key, {"acl_grants": grants}, vid=vid)
+        except KeyError:
+            raise S3Error("NoSuchKey", key)
+
+    def get_cors(self, name: str) -> list[dict]:
+        blob = self._bucket(name).meta_all().get("cors")
+        return json.loads(blob) if blob else []
+
+    def set_cors(self, name: str, rules: list[dict]) -> None:
+        from ceph_tpu import rgw_auth
+        try:
+            rgw_auth.CorsConfig.from_rules(rules)    # validate
+        except ValueError as e:
+            raise S3Error("InvalidArgument", str(e))
+        self._bucket(name).set_meta("cors", json.dumps(rules))
+
+    def delete_cors(self, name: str) -> None:
+        self._bucket(name).set_meta("cors", None)
+
+    def cors_match(self, name: str, origin: str, method: str,
+                   req_headers: list[str] | None = None):
+        from ceph_tpu import rgw_auth
+        rules = self.get_cors(name)
+        if not rules:
+            return None
+        return rgw_auth.CorsConfig.from_rules(rules).match(
+            origin, method, req_headers)
 
     def authorize(self, name: str, principal: str | None,
-                  write: bool) -> None:
-        """Canned-ACL evaluation (rgw_acl.cc verify_permission reduced):
-        owner always passes; other AUTHENTICATED principals read under
-        authenticated-read/public-read; anonymous reads need public-read;
-        non-owner writes need public-read-write."""
-        meta = self._bucket(name).meta_all()   # one index fetch
-        acl = meta.get("acl") or "private"
+                  write: bool, key: str | None = None,
+                  action: str | None = None,
+                  vid: str | None = None) -> None:
+        """Full data-path authorization (rgw_op.cc verify_permission):
+        bucket POLICY first (explicit Deny ends it, Allow grants), then
+        the ACL grant table — the OBJECT's own grants for object reads
+        when it has them (of the ADDRESSED version, so per-version ACLs
+        enforce), else the bucket's (canned ACLs expand into the same
+        table).  An EMPTY owner matches nobody: a bucket whose
+        ownership is unknown must not become world-owned."""
+        from ceph_tpu import rgw_auth
+        b = self._bucket(name)
+        try:
+            idx = b._index()       # ONE omap fetch serves meta + entry
+        except OSError:
+            idx = {}
+        meta = b.meta_all(idx=idx)
         owner = meta.get("owner") or ""
-        # an EMPTY owner matches nobody: a bucket whose ownership is
-        # unknown (e.g. replicated before its meta resolved) must not
-        # become world-owned — access then flows from the ACL alone
-        if principal is not None and owner and principal == owner:
-            return
-        if acl == "public-read-write":
-            return
-        if write:
-            raise S3Error("AccessDenied", "write requires ownership")
-        if acl == "public-read":
-            return
-        if acl == "authenticated-read" and principal is not None:
-            return
-        raise S3Error("AccessDenied", name)
+        if action is None:
+            if key is not None:
+                action = "s3:PutObject" if write else "s3:GetObject"
+            else:
+                action = "s3:PutObject" if write else "s3:ListBucket"
+        policy = None
+        if meta.get("policy"):
+            try:
+                policy = rgw_auth.BucketPolicy.parse(meta["policy"])
+            except rgw_auth.PolicyError:
+                policy = None   # unparseable stored policy: ACLs rule
+        grants = self._bucket_grants(meta)
+        obj_owner = owner
+        if key is not None:
+            try:
+                ent = b.head(key, vid, idx=idx)
+            except (KeyError, S3Error):
+                ent = None
+            if ent:
+                if ent.get("acl_grants"):
+                    grants = ent["acl_grants"]
+                if ent.get("owner"):
+                    obj_owner = ent["owner"]
+        perm = {"s3:GetObjectAcl": rgw_auth.READ_ACP,
+                "s3:PutObjectAcl": rgw_auth.WRITE_ACP}.get(
+            action, rgw_auth.WRITE if write else rgw_auth.READ)
+        if not rgw_auth.evaluate(policy, grants,
+                                 obj_owner if key is not None
+                                 else owner,
+                                 principal, perm, action, name,
+                                 key=key):
+            raise S3Error("AccessDenied", f"{action} {name}"
+                          + (f"/{key}" if key else ""))
 
     def authorize_owner(self, name: str, principal: str | None) -> None:
         """Bucket-configuration ops (versioning/lifecycle/acl/delete):
@@ -298,7 +409,8 @@ class S3Gateway:
     # -- objects -------------------------------------------------------------
 
     def put_object(self, bucket: str, key: str, data: bytes,
-                   metadata: dict) -> tuple[str, str | None]:
+                   metadata: dict,
+                   owner: str | None = None) -> tuple[str, str | None]:
         """Returns (etag, version_id-or-None)."""
         self._check_name(key, "object key")
         if key.startswith(self.MP_PREFIX + "."):
@@ -313,11 +425,11 @@ class S3Gateway:
             # replay diverges the peer
             with self._block(bucket):
                 entry = b.put(key, data, metadata=metadata,
-                              clock=self.clock, etag=etag)
+                              clock=self.clock, etag=etag, owner=owner)
                 self._datalog(bucket, "put", key)
         else:
             entry = b.put(key, data, metadata=metadata,
-                          clock=self.clock, etag=etag)
+                          clock=self.clock, etag=etag, owner=owner)
         return etag, entry.get("version_id")
 
     def get_object(self, bucket: str, key: str,
@@ -605,10 +717,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- plumbing ------------------------------------------------------------
 
+    _cors_hdrs: dict | None = None
+
     def _respond(self, status: int, body: bytes = b"",
                  headers: dict | None = None) -> None:
         self.send_response(status)
-        for k, v in (headers or {}).items():
+        merged = dict(self._cors_hdrs or {})
+        merged.update(headers or {})
+        for k, v in merged.items():
             self.send_header(k, v)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
@@ -619,6 +735,7 @@ class _Handler(BaseHTTPRequestHandler):
         gw: S3Gateway = self.server.rgw.gateway     # type: ignore
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
+        self._cors_hdrs = None   # per-request (keep-alive reuses us)
         try:
             principal = self._authenticate(body)
             parsed = urllib.parse.urlsplit(self.path)
@@ -636,6 +753,7 @@ class _Handler(BaseHTTPRequestHandler):
                           {"Content-Type": "application/xml"})
 
     do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _dispatch
+    do_OPTIONS = _dispatch
 
     # -- routing -------------------------------------------------------------
 
@@ -643,14 +761,37 @@ class _Handler(BaseHTTPRequestHandler):
                q: dict, body: bytes, principal: str | None) -> None:
         if not bucket:
             raise S3Error("InvalidArgument", "service-level ops: none")
+        if method == "OPTIONS":
+            # CORS preflight (rgw_cors: unauthenticated by design)
+            return self._preflight(gw, bucket)
+        # simple CORS: a matching rule decorates the ACTUAL response
+        origin = self.headers.get("Origin")
+        if origin:
+            try:
+                if gw.cors_match(bucket, origin, method) is not None:
+                    self._cors_hdrs = {
+                        "Access-Control-Allow-Origin": origin,
+                        "Vary": "Origin"}
+            except S3Error:
+                pass
         if not key:
             return self._route_bucket(gw, method, bucket, q, body,
                                       principal)
-        # canned-ACL gate: reads need read access, everything else write
+        if "acl" in q:
+            return self._route_object_acl(gw, method, bucket, key, q,
+                                          body, principal)
+        # grant-table gate (policy evaluated inside): reads need READ,
+        # everything else WRITE — on the ADDRESSED version's grants
+        # when the object carries its own
+        avid = q.get("versionId") or None
         if method in ("GET", "HEAD"):
-            gw.authorize(bucket, principal, write=False)
+            gw.authorize(bucket, principal, write=False, key=key,
+                         vid=avid)
+        elif method == "DELETE":
+            gw.authorize(bucket, principal, write=True, key=key,
+                         action="s3:DeleteObject", vid=avid)
         else:
-            gw.authorize(bucket, principal, write=True)
+            gw.authorize(bucket, principal, write=True, key=key)
         if method == "POST" and "uploads" in q:
             meta = self._meta_headers()
             uid = gw.initiate_multipart(bucket, key, meta)
@@ -691,7 +832,8 @@ class _Handler(BaseHTTPRequestHandler):
         vid = q.get("versionId") or None
         if method == "PUT":
             etag, put_vid = gw.put_object(bucket, key, body,
-                                          self._meta_headers())
+                                          self._meta_headers(),
+                                          owner=principal)
             hdrs = {"ETag": f'"{etag}"'}
             if put_vid:
                 hdrs["x-amz-version-id"] = put_vid
@@ -761,24 +903,73 @@ class _Handler(BaseHTTPRequestHandler):
         if "acl" in q:
             if method == "PUT":
                 gw.authorize_owner(bucket, principal)
+                grants = self._parse_grants(body)
+                if grants is not None:
+                    gw.set_bucket_grants(bucket, grants)
+                    return self._respond(200)
                 canned = self.headers.get("x-amz-acl", "")
                 if not canned:
                     raise S3Error("InvalidArgument",
-                                  "only canned x-amz-acl supported")
+                                  "need grants or canned x-amz-acl")
                 gw.set_acl(bucket, canned)
                 return self._respond(200)
             if method == "GET":
                 gw.authorize_owner(bucket, principal)
-                acl, owner = gw.get_acl(bucket)
-                xml = ('<?xml version="1.0" encoding="UTF-8"?>'
-                       "<AccessControlPolicy>"
-                       + _x("Owner", _x("ID", _esc(owner)))
-                       + _x("CannedAcl", _esc(acl))
-                       + "</AccessControlPolicy>").encode()
-                return self._respond(200, xml,
-                                     {"Content-Type": "application/xml"})
+                _acl, owner = gw.get_acl(bucket)
+                grants = gw.get_bucket_grants(bucket)
+                return self._respond(
+                    200, self._grants_xml(grants, owner),
+                    {"Content-Type": "application/xml"})
             raise S3Error("InvalidArgument",
                           f"unsupported {method} on ?acl")
+        if "policy" in q:
+            gw.authorize_owner(bucket, principal)
+            if method == "PUT":
+                gw.set_policy(bucket, body.decode(errors="replace"))
+                return self._respond(204)
+            if method == "GET":
+                doc = gw.get_policy(bucket)
+                if not doc:
+                    raise S3Error("NoSuchBucketPolicy", bucket)
+                return self._respond(200, doc.encode(),
+                                     {"Content-Type":
+                                      "application/json"})
+            if method == "DELETE":
+                gw.delete_policy(bucket)
+                return self._respond(204)
+            raise S3Error("InvalidArgument",
+                          f"unsupported {method} on ?policy")
+        if "cors" in q:
+            gw.authorize_owner(bucket, principal)
+            if method == "PUT":
+                gw.set_cors(bucket, self._parse_cors(body))
+                return self._respond(200)
+            if method == "GET":
+                rules = gw.get_cors(bucket)
+                if not rules:
+                    raise S3Error("NoSuchCORSConfiguration", bucket)
+                items = "".join(
+                    "<CORSRule>"
+                    + "".join(_x("AllowedOrigin", _esc(o))
+                              for o in r["origins"])
+                    + "".join(_x("AllowedMethod", m)
+                              for m in r["methods"])
+                    + "".join(_x("AllowedHeader", _esc(h))
+                              for h in r.get("headers", []))
+                    + (_x("MaxAgeSeconds", str(r["max_age"]))
+                       if r.get("max_age") else "")
+                    + "</CORSRule>" for r in rules)
+                xml = ('<?xml version="1.0" encoding="UTF-8"?>'
+                       "<CORSConfiguration>" + items
+                       + "</CORSConfiguration>").encode()
+                return self._respond(200, xml,
+                                     {"Content-Type":
+                                      "application/xml"})
+            if method == "DELETE":
+                gw.delete_cors(bucket)
+                return self._respond(204)
+            raise S3Error("InvalidArgument",
+                          f"unsupported {method} on ?cors")
         if method == "GET" and "versions" in q:
             gw.authorize(bucket, principal, write=False)
             return self._respond_versions(gw, bucket, q)
@@ -818,6 +1009,174 @@ class _Handler(BaseHTTPRequestHandler):
             return self._respond(200, xml,
                                  {"Content-Type": "application/xml"})
         raise S3Error("InvalidArgument", f"unsupported {method} on bucket")
+
+    # -- CORS (rgw_cors.cc) ---------------------------------------------------
+
+    def _preflight(self, gw: S3Gateway, bucket: str) -> None:
+        origin = self.headers.get("Origin", "")
+        want_method = self.headers.get("Access-Control-Request-Method",
+                                       "")
+        want_headers = [h.strip() for h in
+                        (self.headers.get(
+                            "Access-Control-Request-Headers") or ""
+                         ).split(",") if h.strip()]
+        if not origin or not want_method:
+            raise S3Error("InvalidArgument",
+                          "preflight needs Origin + "
+                          "Access-Control-Request-Method")
+        rule = gw.cors_match(bucket, origin, want_method, want_headers)
+        if rule is None:
+            return self._respond(
+                403, _error_xml("AccessForbidden",
+                                "CORSResponse: no matching rule"),
+                {"Content-Type": "application/xml"})
+        hdrs = {"Access-Control-Allow-Origin": origin,
+                "Access-Control-Allow-Methods": ", ".join(rule.methods),
+                "Vary": "Origin"}
+        if want_headers:
+            hdrs["Access-Control-Allow-Headers"] = ", ".join(
+                want_headers)
+        if rule.max_age:
+            hdrs["Access-Control-Max-Age"] = str(rule.max_age)
+        return self._respond(200, b"", hdrs)
+
+    _CORS_RULE_RE = re.compile(r"<CORSRule>(.*?)</CORSRule>", re.S)
+
+    def _parse_cors(self, body: bytes) -> list[dict]:
+        txt = body.decode(errors="replace")
+        rules = []
+        for block in self._CORS_RULE_RE.findall(txt):
+            age = re.search(r"<MaxAgeSeconds>\s*(\d+)", block)
+            rules.append({
+                "origins": re.findall(
+                    r"<AllowedOrigin>\s*([^<]+?)\s*</AllowedOrigin>",
+                    block),
+                "methods": re.findall(
+                    r"<AllowedMethod>\s*([^<]+?)\s*</AllowedMethod>",
+                    block),
+                "headers": re.findall(
+                    r"<AllowedHeader>\s*([^<]+?)\s*</AllowedHeader>",
+                    block),
+                "max_age": int(age.group(1)) if age else 0,
+            })
+        if not rules:
+            raise S3Error("MalformedXML", "no CORSRule")
+        return rules
+
+    # -- ACL grants (rgw_acl_s3.cc parsing, reduced) --------------------------
+
+    _GRANT_HDRS = {"x-amz-grant-read": "READ",
+                   "x-amz-grant-write": "WRITE",
+                   "x-amz-grant-read-acp": "READ_ACP",
+                   "x-amz-grant-write-acp": "WRITE_ACP",
+                   "x-amz-grant-full-control": "FULL_CONTROL"}
+
+    @staticmethod
+    def _group_grantee(uri: str) -> str:
+        """Map a group URI to its grantee — ONLY the two groups we
+        implement; an unknown group must be refused, never silently
+        widened to AllUsers."""
+        if uri.endswith("/AuthenticatedUsers"):
+            return "authenticated"
+        if uri.endswith("/AllUsers"):
+            return "*"
+        raise S3Error("InvalidArgument",
+                      f"unsupported grantee group {uri!r}")
+
+    @classmethod
+    def _grantee_of(cls, token: str) -> str:
+        token = token.strip().strip('"')
+        if token.startswith("id="):
+            return token[3:].strip('"')
+        if token.startswith("uri="):
+            return cls._group_grantee(token[4:])
+        return token
+
+    def _parse_grants(self, body: bytes) -> list[dict] | None:
+        """Grant list from an XML AccessControlPolicy body or the
+        x-amz-grant-* headers; None when neither is present (caller
+        falls back to the canned x-amz-acl header)."""
+        txt = body.decode(errors="replace")
+        if "<Grant>" in txt:
+            grants = []
+            for block in re.findall(r"<Grant>(.*?)</Grant>", txt, re.S):
+                perm = re.search(
+                    r"<Permission>\s*([A-Z_]+)\s*</Permission>", block)
+                idm = re.search(r"<ID>\s*([^<]+?)\s*</ID>", block)
+                uri = re.search(r"<URI>\s*([^<]+?)\s*</URI>", block)
+                if perm is None or (idm is None and uri is None):
+                    raise S3Error("MalformedACLError",
+                                  "grant needs Permission + grantee")
+                if uri is not None:
+                    grantee = self._group_grantee(uri.group(1))
+                else:
+                    grantee = idm.group(1)
+                grants.append({"grantee": grantee,
+                               "permission": perm.group(1)})
+            return grants
+        grants = []
+        for hdr, perm in self._GRANT_HDRS.items():
+            v = self.headers.get(hdr)
+            if not v:
+                continue
+            for token in v.split(","):
+                if token.strip():
+                    grants.append({"grantee": self._grantee_of(token),
+                                   "permission": perm})
+        return grants or None
+
+    @staticmethod
+    def _grants_xml(grants: list[dict], owner: str) -> bytes:
+        items = "".join(
+            "<Grant><Grantee>"
+            + (_x("URI", "http://acs.amazonaws.com/groups/global/"
+                  + ("AllUsers" if g["grantee"] == "*"
+                     else "AuthenticatedUsers"))
+               if g["grantee"] in ("*", "authenticated")
+               else _x("ID", _esc(g["grantee"])))
+            + "</Grantee>" + _x("Permission", g["permission"])
+            + "</Grant>"
+            for g in grants)
+        return ('<?xml version="1.0" encoding="UTF-8"?>'
+                "<AccessControlPolicy>"
+                + _x("Owner", _x("ID", _esc(owner)))
+                + _x("AccessControlList", items)
+                + "</AccessControlPolicy>").encode()
+
+    def _route_object_acl(self, gw: S3Gateway, method: str,
+                          bucket: str, key: str, q: dict, body: bytes,
+                          principal: str | None) -> None:
+        """GET/PUT /bucket/key?acl — per-OBJECT grant lists
+        (rgw_acl.h: a second user gets access to one object without
+        the bucket going public)."""
+        vid = q.get("versionId") or None
+        if method == "GET":
+            gw.authorize(bucket, principal, write=False, key=key,
+                         action="s3:GetObjectAcl", vid=vid)
+            ent = gw.head_object(bucket, key, vid)
+            owner = ent.get("owner") \
+                or gw._bucket(bucket).meta_all().get("owner") or ""
+            grants = ent.get("acl_grants") \
+                or [{"grantee": owner, "permission": "FULL_CONTROL"}]
+            return self._respond(200, self._grants_xml(grants, owner),
+                                 {"Content-Type": "application/xml"})
+        if method == "PUT":
+            gw.authorize(bucket, principal, write=True, key=key,
+                         action="s3:PutObjectAcl", vid=vid)
+            grants = self._parse_grants(body)
+            if grants is None:
+                canned = self.headers.get("x-amz-acl", "")
+                if not canned:
+                    raise S3Error("InvalidArgument",
+                                  "no grants and no canned acl")
+                from ceph_tpu import rgw_auth
+                ent = gw.head_object(bucket, key, vid)
+                owner = ent.get("owner") \
+                    or gw._bucket(bucket).meta_all().get("owner") or ""
+                grants = rgw_auth.canned_grants(canned, owner)
+            gw.set_object_grants(bucket, key, grants, vid=vid)
+            return self._respond(200)
+        raise S3Error("InvalidArgument", f"unsupported {method} on ?acl")
 
     def _meta_headers(self) -> dict:
         return {k[len("x-amz-meta-"):]: v for k, v in self.headers.items()

@@ -307,7 +307,10 @@ def test_canned_acl_enforcement(rig):
         "x-amz-acl": "public-read"})[0] == 200
     assert anon.request("GET", "/private-b/secret.txt")[0] == 200
     st, body, _ = owner.request("GET", "/private-b", "acl")
-    assert st == 200 and b"public-read" in body
+    # the canned ACL reads back as its expanded grant list (real S3
+    # AccessControlPolicy shape): AllUsers READ + owner FULL_CONTROL
+    assert st == 200 and b"AllUsers" in body and b">READ<" in body \
+        and b"FULL_CONTROL" in body
 
     # bucket config stays owner-only: versioning flip by other = denied
     assert other.request(
