@@ -578,6 +578,8 @@ class CephFS(Dispatcher):
 
     def unlink(self, path: str) -> None:
         out = self._request("unlink", {"path": path})
+        if not out.get("removed", True):
+            return   # hardlinks remain: the inode (and data) live on
         with self._lock:
             self._caps.pop(out["ino"], None)
             self._cap_seq_seen.pop(out["ino"], None)
@@ -585,6 +587,11 @@ class CephFS(Dispatcher):
         # the MDS purge queue; the client is the data-pool actor here)
         StripedObject(self.data_io, _data_name(out["ino"]),
                       _LAYOUT).remove()
+
+    def link(self, src: str, dst: str) -> dict:
+        """Hardlink: a second name for an existing file (POSIX link(2);
+        MDS-side remote dentries).  Returns the inode (nlink bumped)."""
+        return self._request("link", {"src": src, "dst": dst})["inode"]
 
     def rmdir(self, path: str) -> None:
         self._request("rmdir", {"path": path})

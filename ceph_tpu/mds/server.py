@@ -210,40 +210,56 @@ class _Park(Exception):
 
 class Inode:
     __slots__ = ("ino", "mode", "size", "mtime", "parent",
-                 "quota_bytes", "quota_files")
+                 "quota_bytes", "quota_files", "remote_links")
 
     def __init__(self, ino: int, mode: int, size: int = 0,
                  mtime: float = 0.0, parent: int = 0,
-                 quota_bytes: int = 0, quota_files: int = 0):
+                 quota_bytes: int = 0, quota_files: int = 0,
+                 remote_links: list | None = None):
         self.ino = ino
         self.mode = mode
         self.size = size
         self.mtime = mtime
-        #: primary-link backpointer (no hardlinks here): lets a rank
-        #: reconstruct an ino's path, so ino-op authority survives a
-        #: restart (the in-memory exported-ino map alone would not)
+        #: PRIMARY-link backpointer (CDentry linkage, the primary
+        #: dentry): lets a rank reconstruct an ino's path, so ino-op
+        #: authority survives a restart (the in-memory exported-ino map
+        #: alone would not)
         self.parent = parent
         #: directory quotas (ceph.quota.max_bytes / max_files vxattrs);
         #: 0 = unlimited
         self.quota_bytes = quota_bytes
         self.quota_files = quota_files
+        #: REMOTE dentries (CDentry.h:77-90 linkage_t remote_ino,
+        #: inverted): [parent_ino, name] of every hardlink beyond the
+        #: primary.  nlink derives from it, and unlinking the primary
+        #: promotes the first pair (the reference's re-homing via
+        #: backtrace)
+        self.remote_links: list[list] = remote_links or []
 
     def is_dir(self) -> bool:
         return bool(self.mode & S_IFDIR)
 
+    @property
+    def nlink(self) -> int:
+        return 1 + len(self.remote_links)
+
     def to_dict(self) -> dict:
         d = {"ino": self.ino, "mode": self.mode, "size": self.size,
-             "mtime": self.mtime, "parent": self.parent}
+             "mtime": self.mtime, "parent": self.parent,
+             "nlink": self.nlink}
         if self.quota_bytes or self.quota_files:
             d["quota_bytes"] = self.quota_bytes
             d["quota_files"] = self.quota_files
+        if self.remote_links:
+            d["remote_links"] = [list(p) for p in self.remote_links]
         return d
 
     @staticmethod
     def from_dict(d: dict) -> "Inode":
         return Inode(d["ino"], d["mode"], d.get("size", 0),
                      d.get("mtime", 0.0), d.get("parent", 0),
-                     d.get("quota_bytes", 0), d.get("quota_files", 0))
+                     d.get("quota_bytes", 0), d.get("quota_files", 0),
+                     [list(p) for p in d.get("remote_links", [])])
 
 
 class MDSDaemon(Dispatcher):
@@ -636,7 +652,14 @@ class MDSDaemon(Dispatcher):
     def _load_inode(self, ino: int) -> Inode | None:
         inode = self._inodes.get(ino)
         if inode is not None:
-            return inode
+            if inode.remote_links and ino not in self._dirty_inodes:
+                # HARDLINKED inodes are shared across ranks (a remote
+                # dentry's subtree may be exported): serve them from
+                # the store, not a possibly-stale cache — the mutating
+                # rank writes them through (see link/unlink handlers)
+                self._inodes.pop(ino, None)
+            else:
+                return inode
         try:
             omap = self.meta_io.get_omap(self._inode_obj(ino))
         except OSError:
@@ -722,6 +745,15 @@ class MDSDaemon(Dispatcher):
                     self._dirs.setdefault(ino, {})
                     self._dirty_dirs.add(ino)
                 self._dirty_inodes.add(ino)
+            elif ev.get("remote"):
+                # hardlink: a REMOTE dentry — the primary backpointer
+                # stays put; idempotent on replay (pair set-semantics)
+                inode = self._load_inode(ino)
+                if inode is not None \
+                        and [parent, name] not in inode.remote_links:
+                    inode.remote_links.append([parent, name])
+                    self._dirty_inodes.add(ino)
+                    self._flush_hardlinked = True
             else:
                 # plain link (rename target): move the backpointer
                 inode = self._load_inode(ino)
@@ -734,7 +766,32 @@ class MDSDaemon(Dispatcher):
             d = self._load_dir(parent)
             ino = d.pop(name, None)
             self._dirty_dirs.add(parent)
-            if ino is not None and ev.get("drop_inode"):
+            if ino is None:
+                return
+            inode = self._load_inode(ino)
+            if inode is not None and [parent, name] in \
+                    inode.remote_links:
+                # removing a remote dentry: the inode survives at its
+                # primary (and drop_inode means drop-if-LAST-link)
+                inode.remote_links.remove([parent, name])
+                self._dirty_inodes.add(ino)
+                self._flush_hardlinked = True
+                return
+            if inode is not None and inode.remote_links \
+                    and inode.parent == parent \
+                    and ev.get("drop_inode"):
+                # unlinking the PRIMARY with hardlinks remaining:
+                # re-home the inode onto its first remote dentry
+                # (MDCache remote-link promotion via backtrace).
+                # ONLY on a real unlink — a rename's batch unlink
+                # (no drop_inode) merely moved the dentry and removes
+                # no link
+                np, _nn = inode.remote_links.pop(0)
+                inode.parent = np
+                self._dirty_inodes.add(ino)
+                self._flush_hardlinked = True
+                return
+            if ev.get("drop_inode"):
                 self._inodes.pop(ino, None)
                 self._dirs.pop(ino, None)
                 try:
@@ -749,6 +806,10 @@ class MDSDaemon(Dispatcher):
         if kind == "setattr":
             inode = self._load_inode(ev["ino"])
             if inode is not None:
+                if inode.remote_links:
+                    # size/mode writebacks on a hardlinked inode must
+                    # write through like any other shared-inode change
+                    self._flush_hardlinked = True
                 if "size" in ev:
                     # size WRITEBACK is grow-only (a writer reporting
                     # how far it has written must never undo another
@@ -793,11 +854,20 @@ class MDSDaemon(Dispatcher):
             return
         raise ValueError(f"unknown journal event {kind!r}")
 
+    #: set by _apply when a mutation touched a HARDLINKED inode: those
+    #: are cross-rank shared through the store (see _load_inode), so
+    #: the mutating rank must write them through immediately — a
+    #: deferred flush would let another rank read a stale copy
+    _flush_hardlinked = False
+
     def _mutate(self, ev: dict) -> None:
         """Journal-then-apply (the EUpdate ordering: an acked mutation
         is always recoverable), then maybe roll the segment."""
         self._journal(ev)
         self._apply(ev)
+        if self._flush_hardlinked:
+            self._flush_hardlinked = False
+            self._flush_dirty()
         self._maybe_trim()
 
     # -- quotas (ceph.quota.max_bytes/max_files vxattrs reduced) --------------
@@ -1088,8 +1158,9 @@ class MDSDaemon(Dispatcher):
         return None
 
     def _ino_path(self, ino: int) -> str | None:
-        """Reconstruct an ino's path via parent backpointers (name is
-        found by scanning the parent dirfrag — no hardlinks here)."""
+        """Reconstruct an ino's path via PRIMARY parent backpointers
+        (name found by scanning the parent dirfrag; a hardlinked inode
+        resolves to its primary path — the reference's backtrace)."""
         parts: list[str] = []
         cur = ino
         for _ in range(64):         # depth bound
@@ -1544,13 +1615,13 @@ class MDSDaemon(Dispatcher):
                                      "created": r["created"]}
                                  for n, r in
                                  self._load_snaps(sino).items()}}
-        elif op == "rename":
+        elif op in ("rename", "link"):
             fa = self._check_path_authority(a["src"])
             if fa is not None:
                 return fa
             if self._authority(a["dst"]) != self.rank:
-                # cross-subtree rename: the reference migrates; here it
-                # is an honest EXDEV (callers copy+unlink)
+                # cross-subtree rename/link: the reference migrates;
+                # here it is an honest EXDEV (callers copy+unlink)
                 return -18, {}
             norm_src = self._norm(a["src"])
             for pref in self._load_subtrees():
@@ -1759,10 +1830,42 @@ class MDSDaemon(Dispatcher):
             inode = self._load_inode(ino)
             if inode is not None and inode.is_dir():
                 return -21, {}
+            had_links = inode is not None and bool(inode.remote_links)
             self._mutate({"e": "unlink", "parent": parent, "name": name,
                           "drop_inode": True})
-            self._drop_ino_state(ino)
-            return 0, {"ino": ino}
+            # no store re-read: with links the inode survived
+            # (re-homed or pair-removed); without, drop_inode took it
+            removed = inode is None or not had_links
+            if removed:
+                # last link gone: caps/locks die with the inode.  With
+                # hardlinks remaining the inode re-homed and open
+                # handles stay valid (POSIX unlink semantics)
+                self._drop_ino_state(ino)
+            return 0, {"ino": ino, "removed": removed}
+
+        if op == "link":
+            # hardlink (CDentry.h:77-90 remote dentries): a second
+            # name for an existing file inode, possibly in another
+            # directory; nlink derives from the remote-link table
+            sp, sino, _sn = self._resolve(a["src"])
+            if sp is None or sino is None:
+                return -2, {}
+            inode = self._load_inode(sino)
+            if inode is None:
+                return -2, {}
+            if inode.is_dir():
+                return -1, {}    # EPERM: no directory hardlinks
+            dp, dino, dname = self._resolve(a["dst"])
+            if dp is None:
+                return -2, {}
+            if dino is not None:
+                return -17, {}   # EEXIST
+            if not self._check_quota(dp, add_files=1):
+                return -122, {}  # EDQUOT
+            self._mutate({"e": "link", "parent": dp, "name": dname,
+                          "ino": sino, "remote": True})
+            return 0, {"ino": sino,
+                       "inode": self._load_inode(sino).to_dict()}
 
         if op == "rmdir":
             parent, ino, name = self._resolve(a["path"])
@@ -1794,9 +1897,14 @@ class MDSDaemon(Dispatcher):
                 return -17, {}
             # one atomic journal entry for link-at-dst + unlink-src (the
             # reference's single EUpdate): a crash can never leave the
-            # inode reachable from both paths
+            # inode reachable from both paths.  Renaming a REMOTE
+            # dentry moves the remote pair, never the backpointer
+            s_inode = self._load_inode(sino)
+            remote = (s_inode is not None
+                      and [sp, sname] in s_inode.remote_links)
             self._mutate({"e": "batch", "events": [
-                {"e": "link", "parent": dp, "name": dname, "ino": sino},
+                {"e": "link", "parent": dp, "name": dname, "ino": sino,
+                 **({"remote": True} if remote else {})},
                 {"e": "unlink", "parent": sp, "name": sname}]})
             return 0, {"ino": sino}
 

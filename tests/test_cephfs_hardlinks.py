@@ -1,0 +1,211 @@
+"""CephFS hardlinks (CDentry.h:77-90 remote dentries + backtrace
+re-homing): link() across directories, nlink accounting, unlinking the
+primary re-homes the inode, data survives until the last link, journal
+replay across an MDS crash, and cross-rank export of a directory
+holding remote dentries."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ceph_tpu.cephfs import CephFS
+from ceph_tpu.tools.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osds=3, ms_type="loopback").start()
+    c.wait_for_osd_count(3)
+    client = c.client(timeout=20.0)
+    meta = c.create_pool(client, pg_num=4, size=2)
+    data = c.create_pool(client, pg_num=8, size=2)
+    c.run_mds(meta, data)
+    c._fs_pools = (meta, data)
+    yield c
+    c.stop()
+
+
+@pytest.fixture
+def fs(cluster):
+    f = CephFS(cluster.mon_host, cluster.mds.addr, ms_type="loopback")
+    f.mount()
+    yield f
+    f.unmount()
+
+
+def test_link_across_directories_and_nlink(fs):
+    fs.mkdir("/hl")
+    fs.mkdir("/hl/a")
+    fs.mkdir("/hl/b")
+    with fs.open("/hl/a/orig.txt", "w") as f:
+        f.write(b"one inode, two names")
+    assert fs.stat("/hl/a/orig.txt")["nlink"] == 1
+    inode = fs.link("/hl/a/orig.txt", "/hl/b/alias.txt")
+    assert inode["nlink"] == 2
+    # both names resolve to the SAME inode and data
+    sa = fs.stat("/hl/a/orig.txt")
+    sb = fs.stat("/hl/b/alias.txt")
+    assert sa["ino"] == sb["ino"]
+    assert sa["nlink"] == sb["nlink"] == 2
+    with fs.open("/hl/b/alias.txt") as f:
+        assert f.read() == b"one inode, two names"
+    # a write through one name is visible through the other
+    with fs.open("/hl/b/alias.txt", "w") as f:
+        f.write(b"rewritten via alias!")
+    with fs.open("/hl/a/orig.txt") as f:
+        assert f.read() == b"rewritten via alias!"
+    # directories cannot be hardlinked; duplicate names refused
+    with pytest.raises(OSError):
+        fs.link("/hl/a", "/hl/b/dir-link")
+    with pytest.raises(OSError):
+        fs.link("/hl/a/orig.txt", "/hl/b/alias.txt")
+    # readdir shows both dentries
+    assert "alias.txt" in fs.listdir("/hl/b")
+
+
+def test_unlink_primary_rehomes_inode(fs):
+    fs.mkdir("/rh")
+    fs.mkdir("/rh/d1")
+    fs.mkdir("/rh/d2")
+    with fs.open("/rh/d1/primary", "w") as f:
+        f.write(b"survives the primary unlink")
+    fs.link("/rh/d1/primary", "/rh/d2/second")
+    fs.link("/rh/d1/primary", "/rh/d2/third")
+    assert fs.stat("/rh/d2/third")["nlink"] == 3
+    # unlink the PRIMARY: the inode re-homes to a remote dentry
+    fs.unlink("/rh/d1/primary")
+    with pytest.raises(OSError):
+        fs.stat("/rh/d1/primary")
+    assert fs.stat("/rh/d2/second")["nlink"] == 2
+    with fs.open("/rh/d2/second") as f:
+        assert f.read() == b"survives the primary unlink"
+    # drop the re-homed primary too: the LAST link still serves
+    fs.unlink("/rh/d2/second")
+    assert fs.stat("/rh/d2/third")["nlink"] == 1
+    with fs.open("/rh/d2/third") as f:
+        assert f.read() == b"survives the primary unlink"
+    # last unlink drops inode + data
+    ino = fs.stat("/rh/d2/third")["ino"]
+    fs.unlink("/rh/d2/third")
+    with pytest.raises(OSError):
+        fs.stat("/rh/d2/third")
+    from ceph_tpu.cephfs import _data_name
+    from ceph_tpu.osdc.striper import StripedObject
+    from ceph_tpu.cephfs import _LAYOUT
+    assert StripedObject(fs.data_io, _data_name(ino),
+                         _LAYOUT).size() == 0
+
+
+def test_rename_of_remote_dentry_keeps_primary(fs):
+    fs.mkdir("/rn")
+    fs.mkdir("/rn/x")
+    fs.mkdir("/rn/y")
+    with fs.open("/rn/x/base", "w") as f:
+        f.write(b"rename me by alias")
+    fs.link("/rn/x/base", "/rn/y/alias")
+    fs.rename("/rn/y/alias", "/rn/y/alias2")
+    assert fs.stat("/rn/y/alias2")["nlink"] == 2
+    # the primary is untouched: unlinking the renamed alias leaves it
+    fs.unlink("/rn/y/alias2")
+    assert fs.stat("/rn/x/base")["nlink"] == 1
+    with fs.open("/rn/x/base") as f:
+        assert f.read() == b"rename me by alias"
+
+
+def test_rename_of_primary_keeps_link_accounting(fs):
+    """Renaming the PRIMARY dentry (same dir or across dirs) moves the
+    name only — it removes no link, so the re-home machinery must not
+    fire and later unlinks must still resolve correctly."""
+    fs.mkdir("/rp")
+    fs.mkdir("/rp/d")
+    with fs.open("/rp/d/a", "w") as f:
+        f.write(b"primary rename")
+    fs.link("/rp/d/a", "/rp/d/alias")
+    # same-directory rename of the primary
+    fs.rename("/rp/d/a", "/rp/d/b")
+    assert fs.stat("/rp/d/b")["nlink"] == 2
+    assert fs.stat("/rp/d/alias")["nlink"] == 2
+    # unlink the renamed primary: re-home onto the alias, data intact
+    fs.unlink("/rp/d/b")
+    assert fs.stat("/rp/d/alias")["nlink"] == 1
+    with fs.open("/rp/d/alias") as f:
+        assert f.read() == b"primary rename"
+    # and the last unlink really removes it
+    fs.unlink("/rp/d/alias")
+    with pytest.raises(OSError):
+        fs.stat("/rp/d/alias")
+
+
+def test_hardlinks_survive_mds_crash_replay(cluster, fs):
+    fs.mkdir("/dur2")
+    fs.mkdir("/dur2/p")
+    fs.mkdir("/dur2/q")
+    with fs.open("/dur2/p/file", "w") as f:
+        f.write(b"journaled linkage")
+    fs.link("/dur2/p/file", "/dur2/q/linked")
+    fs.unlink("/dur2/p/file")     # re-home journaled too
+    # crash + restart (suppress the flush so the JOURNAL must carry
+    # the remote-link records)
+    cluster.mds._flush_dirty = lambda: None
+    cluster.mds.journal.trim = lambda *a, **k: None
+    cluster.kill_mds()
+    cluster.run_mds(*cluster._fs_pools)
+    f2 = CephFS(cluster.mon_host, cluster.mds.addr, ms_type="loopback")
+    f2.mount()
+    try:
+        st = f2.stat("/dur2/q/linked")
+        assert st["nlink"] == 1
+        with f2.open("/dur2/q/linked") as fh:
+            assert fh.read() == b"journaled linkage"
+    finally:
+        f2.unmount()
+
+
+def test_remote_dentries_cross_rank_export():
+    c = MiniCluster(n_osds=3, ms_type="loopback").start()
+    try:
+        c.wait_for_osd_count(3)
+        client = c.client(timeout=20.0)
+        meta = c.create_pool(client, pg_num=4, size=2)
+        data = c.create_pool(client, pg_num=8, size=2)
+        rc, out = client.mon_command({
+            "prefix": "fs new", "fs_name": "cephfs",
+            "metadata": meta, "data": data})
+        assert rc == 0, out
+        rc, out = client.mon_command({"prefix": "fs set",
+                                      "var": "max_mds", "val": 2})
+        assert rc == 0, out
+        c.run_fs_mds(2)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if len((client.osdmap.fs_db or {}).get("ranks", {})) == 2:
+                break
+            time.sleep(0.1)
+        fs = CephFS(c.mon_host, ms_type="loopback", client_id=601)
+        fs.mount()
+        try:
+            fs.mkdir("/exp")
+            fs.mkdir("/exp/inner")
+            fs.mkdir("/keep")
+            with fs.open("/keep/target", "w") as f:
+                f.write(b"primary stays on rank 0")
+            fs.link("/keep/target", "/exp/inner/remote-name")
+            # export the subtree HOLDING the remote dentry to rank 1;
+            # the primary's home dir stays behind
+            fs.export_dir("/exp", 1)
+            st = fs.stat("/exp/inner/remote-name")
+            assert st["nlink"] == 2
+            with fs.open("/exp/inner/remote-name") as f:
+                assert f.read() == b"primary stays on rank 0"
+            # and the linkage still works both ways after the export
+            fs.unlink("/keep/target")
+            st = fs.stat("/exp/inner/remote-name")
+            assert st["nlink"] == 1
+            with fs.open("/exp/inner/remote-name") as f:
+                assert f.read() == b"primary stays on rank 0"
+        finally:
+            fs.unmount()
+    finally:
+        c.stop()
