@@ -7,11 +7,14 @@ negative items index buckets at -1-id, exactly the reference layout
 (crush/crush.h:354 crush_map.buckets).
 
 Batchability contract (checked at compile time, ValueError otherwise):
-  * every bucket is straw2 or tree — the two stateless draw algorithms
-    (deterministic per (x, r), no per-call permutation workspace).  Uniform,
-    list and legacy-straw buckets run through the scalar oracle fallback
-    (ceph_tpu.crush.mapper_ref / OSDMapMapping's scalar path): uniform's perm
-    cache is inherently sequential state.
+  * every bucket is straw2, tree, or uniform.  Straw2/tree are stateless
+    draws; uniform's permutation CACHE (crush_work_bucket) is sequential
+    state, but the permutation itself is a pure function of (x, r,
+    bucket id) — the batched mapper recomputes the Fisher-Yates prefix
+    per lane (mapper.c:73-138), so mixed uniform/straw2 maps (the
+    "identical hosts" layout) stay on the fast path.  List and legacy
+    straw buckets run through the scalar oracle fallback
+    (ceph_tpu.crush.mapper_ref / OSDMapMapping's scalar path).
   * modern tunables: choose_local_tries=0 and choose_local_fallback_tries=0
     (the jewel+ profile, Tunables defaults) — the legacy local-retry ladder
     (mapper.c:497-503) and perm fallback are scalar-only.
@@ -23,7 +26,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from .types import CRUSH_BUCKET_STRAW2, CRUSH_BUCKET_TREE, CrushMap
+from .types import (
+    CRUSH_BUCKET_STRAW2, CRUSH_BUCKET_TREE, CRUSH_BUCKET_UNIFORM, CrushMap)
 
 
 @dataclass
@@ -43,6 +47,8 @@ class CompiledCrushMap:
     n_nodes: np.ndarray        # (B,) int32  — tree node count (0 if !tree)
     node_weights: np.ndarray   # (B, T) int64 — tree per-node weights
     has_tree: bool             # any tree bucket present
+    has_uniform: bool          # any uniform bucket present
+    max_uniform_size: int      # largest uniform bucket (perm loop bound)
     tunables_tries: int        # choose_total_tries + 1 (mapper.c:906)
     vary_r: int
     stable: int
@@ -69,12 +75,13 @@ def compile_map(m: CrushMap) -> CompiledCrushMap:
             continue
         if b.alg == CRUSH_BUCKET_TREE:
             node_counts.append(len(b.node_weights))
-        elif b.alg == CRUSH_BUCKET_STRAW2:
+        elif b.alg in (CRUSH_BUCKET_STRAW2, CRUSH_BUCKET_UNIFORM):
             node_counts.append(0)
         else:
             raise ValueError(
-                f"batched mapper supports straw2 and tree buckets only; "
-                f"bucket {b.id} has alg {b.alg} — use the scalar oracle")
+                f"batched mapper supports straw2, tree and uniform "
+                f"buckets; bucket {b.id} has alg {b.alg} — use the "
+                f"scalar oracle")
         sizes.append(b.size)
     s_max = max(sizes, default=1) or 1
     t_max = max(node_counts, default=0) or 1
@@ -94,7 +101,12 @@ def compile_map(m: CrushMap) -> CompiledCrushMap:
         bucket_size[idx] = b.size
         bucket_alg[idx] = b.alg
         items[idx, :b.size] = b.items
-        weights[idx, :b.size] = b.item_weights
+        if b.alg == CRUSH_BUCKET_UNIFORM and not b.item_weights:
+            # uniform buckets carry ONE shared item weight
+            # (crush_bucket_uniform.item_weight)
+            weights[idx, :b.size] = b.item_weight
+        else:
+            weights[idx, :b.size] = b.item_weights
         if b.alg == CRUSH_BUCKET_TREE:
             n_nodes[idx] = len(b.node_weights)
             node_weights[idx, :len(b.node_weights)] = b.node_weights
@@ -104,6 +116,11 @@ def compile_map(m: CrushMap) -> CompiledCrushMap:
         bucket_alg=bucket_alg, items=items, weights=weights,
         n_nodes=n_nodes, node_weights=node_weights,
         has_tree=bool((bucket_alg == CRUSH_BUCKET_TREE).any()),
+        has_uniform=bool(((bucket_alg == CRUSH_BUCKET_UNIFORM)
+                          & (bucket_size > 0)).any()),
+        max_uniform_size=int(bucket_size[
+            bucket_alg == CRUSH_BUCKET_UNIFORM].max()
+            if (bucket_alg == CRUSH_BUCKET_UNIFORM).any() else 0),
         tunables_tries=t.choose_total_tries + 1,
         vary_r=t.chooseleaf_vary_r, stable=t.chooseleaf_stable,
         descend_once=t.chooseleaf_descend_once,

@@ -34,6 +34,7 @@ from ceph_tpu.ops.crush_kernel import hash32_4, is_out
 from .compile import CompiledCrushMap, compile_map
 from .types import (
     CRUSH_BUCKET_TREE,
+    CRUSH_BUCKET_UNIFORM,
     CRUSH_ITEM_NONE,
     RULE_CHOOSE_FIRSTN,
     RULE_CHOOSE_INDEP,
@@ -66,6 +67,8 @@ class _Arrays:
         self.n_nodes = jnp.asarray(c.n_nodes)
         self.node_weights = jnp.asarray(c.node_weights)
         self.has_tree = c.has_tree
+        self.has_uniform = c.has_uniform
+        self.max_uniform_size = c.max_uniform_size
         self.n_buckets = c.n_buckets
         self.max_devices = c.max_devices
 
@@ -116,19 +119,69 @@ def _tree_winner(a: _Arrays, cur: jax.Array, x: jax.Array,
     return jnp.take_along_axis(a.items[cur], leaf[:, None], axis=1)[:, 0]
 
 
+def _uniform_winner(a: _Arrays, cur: jax.Array, x: jax.Array,
+                    r: jax.Array) -> jax.Array:
+    """Uniform-bucket winner (bucket_perm_choose, mapper.c:73-138): the
+    permutation is a pure function of (x, bucket id) — each lane
+    recomputes the Fisher-Yates prefix up to pr = r % size instead of
+    consulting the reference's sequential perm cache, which is what
+    makes uniform batchable at all.  Lanes whose bucket is not uniform
+    compute garbage the caller selects away by alg."""
+    from ceph_tpu.ops.crush_kernel import hash32_3
+    size = jnp.maximum(a.bucket_size[cur], 1)          # (N,)
+    pr = (r.astype(jnp.uint32)
+          % size.astype(jnp.uint32)).astype(jnp.int32)
+    bid = a.bucket_id[cur].astype(jnp.uint32)
+    # loop bound: the largest UNIFORM bucket, not the map-wide widest
+    # bucket (a straw2 root with hundreds of hosts would otherwise
+    # multiply this loop's masked work for nothing)
+    s_max = min(a.items.shape[1], max(a.max_uniform_size, 1))
+    n = cur.shape[0]
+    cols = jnp.arange(s_max, dtype=jnp.int32)[None, :]  # (1, S)
+    perm0 = jnp.broadcast_to(cols, (n, s_max)).astype(jnp.int32)
+
+    def body(p, perm):
+        p32 = jnp.int32(p)
+        # swap only while building the prefix (p <= pr) and while a
+        # swap can matter (p < size-1); i == 0 swaps in place (no-op)
+        live = (p32 <= pr) & (p32 < size - 1)
+        span = jnp.maximum(size - p32, 1).astype(jnp.uint32)
+        i = (hash32_3(x, bid, jnp.uint32(p))
+             % span).astype(jnp.int32)              # (N,)
+        idx = p32 + i
+        val_p = perm[:, p]
+        val_i = jnp.take_along_axis(perm, idx[:, None], axis=1)[:, 0]
+        at_p = cols == p32
+        at_i = cols == idx[:, None]
+        swapped = jnp.where(at_i, val_p[:, None], perm)
+        swapped = jnp.where(at_p, val_i[:, None], swapped)
+        return jnp.where(live[:, None], swapped, perm)
+
+    perm = jax.lax.fori_loop(0, s_max, body, perm0)
+    s = jnp.take_along_axis(perm, pr[:, None], axis=1)[:, 0]
+    return jnp.take_along_axis(a.items[cur], s[:, None], axis=1)[:, 0]
+
+
 def _winner(a: _Arrays, cur: jax.Array, x: jax.Array, r: jax.Array) -> jax.Array:
     """Winner of bucket index ``cur`` for each lane: straw2 argmax (first max
-    wins, mapper.c:361-384; choose_args overrides are scalar-path only), or
-    tree descent for tree buckets when the map has any."""
+    wins, mapper.c:361-384; choose_args overrides are scalar-path only),
+    tree descent for tree buckets, or the recomputed uniform permutation
+    — when the map contains those algs at all."""
     items_row = a.items[cur]                      # (N, S)
     w_row = a.weights[cur]                        # (N, S) — padding weight 0
     d = _straw2_draws_per_row(x, items_row, r, w_row)
     pos = jnp.argmax(d, axis=-1)
-    s2 = jnp.take_along_axis(items_row, pos[:, None], axis=1)[:, 0]
-    if not a.has_tree:
-        return s2
-    tw = _tree_winner(a, cur, x, r)
-    return jnp.where(a.bucket_alg[cur] == jnp.int32(CRUSH_BUCKET_TREE), tw, s2)
+    out = jnp.take_along_axis(items_row, pos[:, None], axis=1)[:, 0]
+    if a.has_tree:
+        tw = _tree_winner(a, cur, x, r)
+        out = jnp.where(
+            a.bucket_alg[cur] == jnp.int32(CRUSH_BUCKET_TREE), tw, out)
+    if a.has_uniform:
+        uw = _uniform_winner(a, cur, x, r)
+        out = jnp.where(
+            a.bucket_alg[cur] == jnp.int32(CRUSH_BUCKET_UNIFORM),
+            uw, out)
+    return out
 
 
 def _widx(a: _Arrays, item: jax.Array) -> jax.Array:
@@ -141,9 +194,17 @@ def _wtype(a: _Arrays, item: jax.Array) -> jax.Array:
     return jnp.where(item < 0, a.bucket_type[_widx(a, item)], 0)
 
 
-def _descend(a: _Arrays, x, start, r, want_type, active):
+def _descend(a: _Arrays, x, start, r, want_type, active,
+             ftotal=None, numrep: int = 0):
     """One full descent: from per-lane ``start`` bucket, draw and follow
     sub-buckets until an item of ``want_type`` (or a terminal failure).
+
+    With ftotal/numrep given (the INDEP path), ``r`` is the BASE
+    (rep + parent_r) and the retry offset is recomputed PER BUCKET on
+    the way down: uniform buckets whose size divides numrep use
+    (numrep+1)*ftotal instead of numrep*ftotal (mapper.c:720-728's
+    "be careful" — without it the same permutation slot repeats on
+    every retry and the position wedges).
 
     Returns (item, fail_perm, fail_retry):
       item       winner of want_type where neither failure flag is set
@@ -155,9 +216,19 @@ def _descend(a: _Arrays, x, start, r, want_type, active):
         return jnp.any(s[3])
 
     def body(s):
-        item, perm, retry, live, cur = s
+        item, perm, retry, live, cur, rlast = s
         empty = a.bucket_size[cur] == 0
-        win = _winner(a, cur, x, r)
+        if ftotal is None:
+            rr = r
+        else:
+            mult = jnp.int32(numrep)
+            if a.has_uniform and numrep > 0:
+                special = ((a.bucket_alg[cur]
+                            == jnp.int32(CRUSH_BUCKET_UNIFORM))
+                           & (a.bucket_size[cur] % numrep == 0))
+                mult = jnp.where(special, mult + 1, mult)
+            rr = r + mult * ftotal
+        win = _winner(a, cur, x, rr)
         wt = _wtype(a, win)
         oob = (win >= 0) & (win >= a.max_devices)
         reached = ~empty & ~oob & (wt == want_type)
@@ -168,15 +239,21 @@ def _descend(a: _Arrays, x, start, r, want_type, active):
         item = jnp.where(live & reached, win, item)
         perm = perm | new_perm
         retry = retry | new_retry
+        # the r actually used at the level that produced the winner:
+        # the indep chooseleaf recursion inherits it as parent_r
+        rlast = jnp.where(live, jnp.broadcast_to(rr, rlast.shape),
+                          rlast)
         cur = jnp.where(descend, _widx(a, win), cur)
         live = descend
-        return item, perm, retry, live, cur
+        return item, perm, retry, live, cur, rlast
 
     item0 = jnp.full_like(start, CRUSH_ITEM_NONE)
     f = jnp.zeros_like(active)
+    r0 = jnp.broadcast_to(jnp.asarray(r, jnp.int32),
+                          start.shape).astype(jnp.int32)
     out = jax.lax.while_loop(
-        cond, body, (item0, f, f, active, start))
-    return out[0], out[1], out[2]
+        cond, body, (item0, f, f, active, start, r0))
+    return out[0], out[1], out[2], out[5]
 
 
 def _leaf_firstn(a: _Arrays, x, host_item, sub_r, leaf_out, rep, tries,
@@ -192,7 +269,7 @@ def _leaf_firstn(a: _Arrays, x, host_item, sub_r, leaf_out, rep, tries,
     def body(s):
         leaf, ftotal, live = s
         r = sub_r + ftotal
-        item, perm, retry = _descend(a, x, start, r, 0, live)
+        item, perm, retry, _rl = _descend(a, x, start, r, 0, live)
         got = live & ~perm & ~retry
         collide = jnp.zeros_like(live)
         if rep > 0:
@@ -229,7 +306,8 @@ def _choose_firstn(a: _Arrays, x, start, numrep, want_type, tries,
         def body(s, rep=rep):
             sel, leaf_sel, ftotal, live = s
             r = rep + ftotal
-            item, perm, retry = _descend(a, x, start, r, want_type, live)
+            item, perm, retry, _rl = _descend(a, x, start, r, want_type,
+                                              live)
             got = live & ~perm & ~retry
             collide = jnp.any(out == item[:, None], axis=1) if numrep > 1 \
                 else jnp.zeros_like(live)
@@ -276,8 +354,9 @@ def _leaf_indep(a: _Arrays, x, host_item, rep: int, parent_r, numrep_mult,
 
     def body(s):
         leaf, ftotal, live = s
-        r = rep + parent_r + numrep_mult * ftotal
-        item, perm, retry = _descend(a, x, start, r, 0, live)
+        item, perm, retry, _rl = _descend(a, x, start, rep + parent_r,
+                                          0, live, ftotal=ftotal,
+                                          numrep=numrep_mult)
         got = live & ~perm & ~retry
         rejected = is_out(reweight, item, x)
         placed = got & ~rejected
@@ -310,16 +389,18 @@ def _choose_indep(a: _Arrays, x, start, left, numrep_mult, want_type, tries,
         out, leaf_out, undef, ftotal = s
         for rep in range(left):
             live = undef[:, rep]
-            r = jnp.full((n,), rep, jnp.int32) + numrep_mult * ftotal
-            item, perm, retry = _descend(a, x, start, r, want_type, live)
+            base = jnp.full((n,), rep, jnp.int32)
+            item, perm, retry, host_r = _descend(
+                a, x, start, base, want_type, live,
+                ftotal=ftotal, numrep=numrep_mult)
             got = live & ~perm & ~retry
             collide = jnp.any(out == item[:, None], axis=1)
             reject = jnp.zeros_like(live)
             leaf = jnp.full_like(item, CRUSH_ITEM_NONE)
             if recurse_to_leaf:
                 leaf, leaf_ok = _leaf_indep(
-                    a, x, item, rep, r, numrep_mult, recurse_tries,
-                    reweight, got & ~collide)
+                    a, x, item, rep, host_r, numrep_mult,
+                    recurse_tries, reweight, got & ~collide)
                 reject = got & ~collide & ~leaf_ok
             if want_type == 0:
                 reject = reject | (got & is_out(reweight, item, x))

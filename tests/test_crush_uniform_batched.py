@@ -1,0 +1,114 @@
+"""Batched CRUSH uniform buckets (bucket_perm_choose, mapper.c:73-138):
+the Fisher-Yates permutation recomputed per lane must match the scalar
+oracle bit-for-bit on mixed uniform/straw2 maps — the "identical hosts"
+layout — for firstn AND indep (including mapper.c:720-728's uniform
+retry-offset special case), under reweight rejections and device
+counts that exercise retries."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import mapper_ref
+from ceph_tpu.crush.builder import add_simple_rule, make_bucket
+from ceph_tpu.crush.compile import compile_map
+from ceph_tpu.crush.mapper_jax import BatchMapper
+from ceph_tpu.crush.types import (
+    CRUSH_BUCKET_LIST, CRUSH_BUCKET_STRAW2, CRUSH_BUCKET_UNIFORM,
+    CrushMap)
+
+N_X = 3000
+
+
+def _mixed_map(n_hosts=4, devs_per_host=4, uniform_hosts=True):
+    """root (straw2) -> hosts (uniform: the identical-chassis layout)
+    -> devices."""
+    m = CrushMap()
+    hosts = []
+    dev = 0
+    for h in range(n_hosts):
+        items = list(range(dev, dev + devs_per_host))
+        dev += devs_per_host
+        alg = CRUSH_BUCKET_UNIFORM if uniform_hosts \
+            else CRUSH_BUCKET_STRAW2
+        b = make_bucket(-(2 + h), alg, 1, items,
+                        [0x10000] * devs_per_host)
+        m.add_bucket(b)
+        hosts.append(b.id)
+    root = make_bucket(-1, CRUSH_BUCKET_STRAW2, 10, hosts,
+                       [0x10000 * devs_per_host] * n_hosts)
+    m.add_bucket(root)
+    m.max_devices = dev
+    return m, dev
+
+
+def _assert_oracle_equal(m, rno, ndev, result_max, weights=None):
+    weights = weights or [0x10000] * ndev
+    bm = BatchMapper(m)
+    xs = np.arange(N_X, dtype=np.int64)
+    got = np.asarray(bm.do_rule(rno, xs, result_max, weights))
+    rule = m.rules[rno]
+    from ceph_tpu.crush.types import RULE_CHOOSE_INDEP, \
+        RULE_CHOOSELEAF_INDEP
+    indep = any(s.op in (RULE_CHOOSE_INDEP, RULE_CHOOSELEAF_INDEP)
+                for s in rule.steps)
+    for k in range(N_X):
+        ref = mapper_ref.crush_do_rule(m, rno, k, result_max, weights)
+        if indep:
+            mine = list(got[k][:len(ref)])
+            assert mine == ref, (k, mine, ref)
+        else:
+            mine = [v for v in got[k] if v >= 0]
+            assert mine == ref, (k, mine, ref)
+
+
+def test_uniform_firstn_chooseleaf_matches_oracle():
+    m, ndev = _mixed_map()
+    rno = add_simple_rule(m, -1, 1, mode="firstn")
+    _assert_oracle_equal(m, rno, ndev, 3)
+
+
+def test_uniform_indep_matches_oracle():
+    # devs_per_host == 4 and numrep 4 exercises the size %% numrep == 0
+    # uniform retry-offset special case (mapper.c:720-728)
+    m, ndev = _mixed_map(n_hosts=5, devs_per_host=4)
+    rno = add_simple_rule(m, -1, 1, mode="indep")
+    _assert_oracle_equal(m, rno, ndev, 4)
+
+
+def test_uniform_with_reweight_rejections():
+    m, ndev = _mixed_map()
+    rno = add_simple_rule(m, -1, 1, mode="firstn")
+    weights = [0x10000] * ndev
+    weights[2] = 0          # out device: forces retries through perm
+    weights[9] = 0x8000     # half-weight: probabilistic rejection
+    _assert_oracle_equal(m, rno, ndev, 3, weights)
+
+
+def test_pure_uniform_flat_rule():
+    """Uniform bucket as the direct choose target (type-0 domain)."""
+    m = CrushMap()
+    b = make_bucket(-1, CRUSH_BUCKET_UNIFORM, 1, list(range(7)),
+                    [0x10000] * 7)
+    m.add_bucket(b)
+    m.max_devices = 7
+    rno = add_simple_rule(m, -1, 0, mode="firstn")
+    _assert_oracle_equal(m, rno, 7, 3)
+
+
+def test_uniform_sizes_not_dividing_numrep():
+    # size 5 hosts with numrep 3: pr wraps differently per r
+    m, ndev = _mixed_map(n_hosts=3, devs_per_host=5)
+    rno = add_simple_rule(m, -1, 1, mode="firstn")
+    _assert_oracle_equal(m, rno, ndev, 3)
+
+
+def test_list_buckets_still_refused():
+    m = CrushMap()
+    b = make_bucket(-1, CRUSH_BUCKET_LIST, 1, [0, 1, 2],
+                    [0x10000] * 3)
+    m.add_bucket(b)
+    m.max_devices = 3
+    with pytest.raises(ValueError):
+        compile_map(m)
