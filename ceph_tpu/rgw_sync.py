@@ -12,10 +12,12 @@ SECONDARY zone polls the primary's registry + datalogs and replays:
     persist in the secondary's ``.sync.status`` omap object, so a
     restarted agent resumes where it left off (sync-status markers,
     rgw_data_sync.cc's incremental marker window)
-  * processed log entries older than a retention window are trimmed on
-    the PRIMARY by the agent (single-peer trim; the reference keeps
-    per-peer markers before trimming — multiple secondaries would need
-    the same)
+  * processed log entries are trimmed on the PRIMARY only below the
+    MINIMUM marker across every registered peer zone: each agent
+    publishes its per-bucket progress into the primary's ``.sync.peers``
+    omap (the reference's per-shard sync-status objects,
+    rgw_data_sync.cc), so a second secondary syncing slower never loses
+    records to the faster one's trim
 
 Replays are idempotent (puts overwrite, deletes tolerate absence), so
 crash-and-restart in mid-window is safe: the marker only advances after
@@ -27,12 +29,16 @@ import itertools
 import json
 import threading
 import time
+import uuid
 
 from ceph_tpu.rgw_rest import S3Error, S3Gateway
 
 DATALOG_PREFIX = "log."
 _APPEND_SEQ = itertools.count()
 SYNC_STATUS_OID = ".sync.status"
+#: PRIMARY-side per-peer progress registry: "<zone>\x00<bucket>" ->
+#: marker; trim floors at the minimum across peers
+SYNC_PEERS_OID = ".sync.peers"
 
 
 def datalog_append(gateway: S3Gateway, bucket: str, op: str, key: str,
@@ -77,15 +83,36 @@ def datalog_trim(gateway: S3Gateway, bucket: str, upto: str) -> int:
     return len(dead)
 
 
+def remove_peer(source: S3Gateway, zone_id: str) -> int:
+    """Drop every .sync.peers row of a zone (decommission); returns
+    rows removed.  Run against the PRIMARY when a secondary is retired
+    so its frozen markers stop pinning the trim floor."""
+    try:
+        omap = source.io.get_omap(SYNC_PEERS_OID)
+    except OSError:
+        return 0
+    dead = [k for k in omap if k.split("\x00", 1)[0] == zone_id]
+    if dead:
+        source.io.rm_omap_keys(SYNC_PEERS_OID, dead)
+    return len(dead)
+
+
 class ZoneSyncAgent:
     """Pull-replays a primary zone's buckets into a secondary zone."""
 
     def __init__(self, source: S3Gateway, target: S3Gateway,
-                 interval: float = 1.0, trim: bool = True):
+                 interval: float = 1.0, trim: bool = True,
+                 zone_id: str | None = None):
         self.src = source
         self.dst = target
         self.interval = interval
         self.trim = trim
+        #: unique per secondary zone: keys this agent's rows in the
+        #: primary's peer-progress registry.  MUST be stable across
+        #: agent restarts for real deployments (pass it explicitly);
+        #: the default is unique so two anonymous agents can never
+        #: share a row and trim each other's unapplied records
+        self.zone_id = zone_id or f"zone-{uuid.uuid4().hex[:12]}"
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -101,6 +128,41 @@ class ZoneSyncAgent:
     def _set_marker(self, bucket: str, marker: str) -> None:
         self.dst.io.set_omap(SYNC_STATUS_OID, {bucket: marker.encode()})
 
+    def _publish_progress(self, bucket: str, marker: str) -> None:
+        """Report this zone's marker to the PRIMARY (rgw_data_sync's
+        sync-status objects): the trim floor for every peer."""
+        try:
+            self.src.io.set_omap(
+                SYNC_PEERS_OID,
+                {f"{self.zone_id}\x00{bucket}": marker.encode()})
+        except OSError:
+            pass    # progress publication is advisory; retried next pass
+
+    def _peer_rows(self) -> dict[str, str]:
+        """The whole peer registry, ONE omap fetch per pass."""
+        try:
+            omap = self.src.io.get_omap(SYNC_PEERS_OID)
+        except OSError:
+            return {}
+        return {k: v.decode() for k, v in omap.items()}
+
+    @staticmethod
+    def _peer_trim_floor(peers: dict[str, str],
+                         bucket: str) -> str | None:
+        """Minimum marker across every peer registered for the bucket —
+        trimming above it would lose records a slower secondary still
+        needs.  None = no peer registered (no trim)."""
+        markers = [v for k, v in peers.items()
+                   if k.split("\x00", 1)[1:] == [bucket]]
+        return min(markers) if markers else None
+
+    def deregister(self) -> None:
+        """Retire this zone from the primary's peer registry (the
+        operator's decommission step): a dead peer's rows would
+        otherwise pin every bucket's trim floor forever and the
+        primary datalogs would grow without bound."""
+        remove_peer(self.src, self.zone_id)
+
     # -- one pass -------------------------------------------------------------
 
     def sync_once(self) -> dict:
@@ -112,10 +174,12 @@ class ZoneSyncAgent:
         except OSError:
             return stats
         markers = self._markers()
+        peers = self._peer_rows()
         for name in names:
             try:
                 stats["buckets"] += 1
-                self._sync_bucket(name, markers.get(name), stats)
+                self._sync_bucket(name, markers.get(name), stats,
+                                  peers)
             except (S3Error, OSError):
                 stats["errors"] += 1
         # a bucket we have a marker for that vanished from the source
@@ -142,6 +206,11 @@ class ZoneSyncAgent:
             self.dst.delete_bucket(name)
         try:
             self.dst.io.rm_omap_keys(SYNC_STATUS_OID, [name])
+        except OSError:
+            pass
+        try:
+            self.src.io.rm_omap_keys(
+                SYNC_PEERS_OID, [f"{self.zone_id}\x00{name}"])
         except OSError:
             pass
 
@@ -181,13 +250,18 @@ class ZoneSyncAgent:
         return True
 
     def _sync_bucket(self, name: str, marker: str | None,
-                     stats: dict) -> None:
+                     stats: dict, peers: dict[str, str]) -> None:
         self._ensure_bucket(name)
         if marker is None:
             # FULL SYNC: snapshot the log head first — records landing
-            # during the copy replay afterwards, none are lost
+            # during the copy replay afterwards, none are lost.
+            # Register with the primary BEFORE copying: a concurrent
+            # fast peer computing its trim floor during our copy must
+            # already see us, or it trims records our post-head replay
+            # still needs
             entries = datalog_entries(self.src, name)
             head = entries[-1][0] if entries else ""
+            self._publish_progress(name, head or "log.")
             src_b = self.src._bucket(name)
             for key in src_b.list():
                 if key.startswith(self.src.MP_PREFIX + "."):
@@ -213,8 +287,18 @@ class ZoneSyncAgent:
             # here replays this record again (idempotent), never skips
             self._set_marker(name, log_key)
             marker = log_key
+        # publish ONCE per pass (a lagging published marker only makes
+        # the trim floor conservative, never lossy)
+        self._publish_progress(name, marker)
         if self.trim and marker and marker != "log.":
-            stats["trimmed"] += datalog_trim(self.src, name, marker)
+            # overlay our fresh marker on the pass-start snapshot: the
+            # floor always reflects OUR true progress, peers' may lag
+            # one pass (conservative, never lossy)
+            peers = dict(peers)
+            peers[f"{self.zone_id}\x00{name}"] = marker
+            floor = self._peer_trim_floor(peers, name)
+            if floor and floor != "log.":
+                stats["trimmed"] += datalog_trim(self.src, name, floor)
 
     # -- background loop ------------------------------------------------------
 

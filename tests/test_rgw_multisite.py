@@ -88,6 +88,47 @@ def test_datalog_trimmed_after_sync(zones):
     assert dst.get_object("loggy", "k4")[0] == b"x"
 
 
+def test_two_secondaries_converge_despite_trim(zones):
+    """Per-peer trim floor (rgw_data_sync sync-status): a FAST secondary
+    trimming the datalog must never drop records a SLOW secondary has
+    not applied yet — trim stops at min(peer markers)."""
+    src, fast_dst = zones
+    c3 = MiniCluster(n_osds=3).start()
+    try:
+        c3.wait_for_osd_count(3)
+        io3 = c3.client().open_ioctx(
+            c3.create_pool(c3.client(), pg_num=4, size=2))
+        slow_dst = S3Gateway(io3)
+        src.create_bucket("shared", owner="o")
+        fast = ZoneSyncAgent(src, fast_dst, zone_id="zone-fast")
+        slow = ZoneSyncAgent(src, slow_dst, zone_id="zone-slow")
+        # both register (full sync at empty log)
+        fast.sync_once()
+        slow.sync_once()
+        # writes land; only the FAST one syncs (and tries to trim)
+        for i in range(6):
+            src.put_object("shared", f"k{i}", f"v{i}".encode(), {})
+        fast.sync_once()
+        assert fast_dst.get_object("shared", "k5")[0] == b"v5"
+        # the records the slow peer still needs SURVIVED the trim
+        assert len(datalog_entries(src, "shared")) == 6
+        # more writes, another fast pass — still floored by the slow peer
+        src.put_object("shared", "late", b"straggler", {})
+        fast.sync_once()
+        assert len(datalog_entries(src, "shared")) == 7
+        # the slow peer catches up from the intact log
+        slow.sync_once()
+        for i in range(6):
+            assert slow_dst.get_object(
+                "shared", f"k{i}")[0] == f"v{i}".encode()
+        assert slow_dst.get_object("shared", "late")[0] == b"straggler"
+        # with BOTH peers past the records, the next pass trims
+        fast.sync_once()
+        assert datalog_entries(src, "shared") == []
+    finally:
+        c3.stop()
+
+
 def test_background_agent_converges(zones):
     src, dst = zones
     src.create_bucket("auto", owner="o")
