@@ -36,6 +36,12 @@ class CephTpuContext:
             "config get",
             lambda name, **kw: {name: self.conf.get(name)},
             "get one option")
+        from ceph_tpu.common import tracing
+        self.admin.register_command(
+            "dump_traces",
+            lambda trace_id=None, **kw: tracing.dump(
+                int(trace_id) if trace_id else None),
+            "stitched cross-daemon trace timelines")
 
 
 _default: CephTpuContext | None = None

@@ -19,7 +19,7 @@ import time
 
 class TrackedOp:
     __slots__ = ("tracker", "description", "initiated_at", "events",
-                 "_done")
+                 "_done", "trace_id")
 
     def __init__(self, tracker: "OpTracker", description: str):
         self.tracker = tracker
@@ -28,9 +28,20 @@ class TrackedOp:
         self.events: list[tuple[float, str]] = [(self.initiated_at,
                                                  "initiated")]
         self._done = False
+        # ops created while handling a traced message JOIN the trace:
+        # their per-op events become cross-daemon span events too
+        from ceph_tpu.common import tracing
+        self.trace_id = tracing.current()
+        if self.trace_id:
+            tracing.record(tracker.daemon, f"op {description}",
+                           self.trace_id)
 
     def mark_event(self, event: str) -> None:
         self.events.append((time.time(), event))
+        if self.trace_id:
+            from ceph_tpu.common import tracing
+            tracing.record(self.tracker.daemon,
+                           f"{self.description}: {event}", self.trace_id)
 
     def finish(self) -> None:
         if not self._done:
@@ -48,13 +59,16 @@ class TrackedOp:
 
     def dump(self) -> dict:
         t0 = self.initiated_at
-        return {"description": self.description,
-                "initiated_at": t0,
-                "age": round(self.age, 6),
-                "duration": round(self.duration, 6),
-                "type_data": {"events": [
-                    {"time": round(t - t0, 6), "event": e}
-                    for t, e in self.events]}}
+        d = {"description": self.description,
+             "initiated_at": t0,
+             "age": round(self.age, 6),
+             "duration": round(self.duration, 6),
+             "type_data": {"events": [
+                 {"time": round(t - t0, 6), "event": e}
+                 for t, e in self.events]}}
+        if self.trace_id:
+            d["trace_id"] = self.trace_id
+        return d
 
 
 class OpTracker:
@@ -63,7 +77,10 @@ class OpTracker:
     def __init__(self, complaint_time: float = 30.0,
                  history_size: int = 20,
                  history_slow_size: int = 20,
-                 history_slow_threshold: float = 1.0):
+                 history_slow_threshold: float = 1.0,
+                 daemon: str = "?"):
+        #: span-event attribution for traced ops (common/tracing)
+        self.daemon = daemon
         self.complaint_time = complaint_time
         self.history_size = history_size
         self.history_slow_size = history_slow_size

@@ -1,0 +1,104 @@
+"""Cross-daemon trace spans (src/tracing/oprequest.tp +
+src/common/zipkin_trace.h analogs, redesigned for this runtime).
+
+A trace id rides the message frame (a flagged header extension, see
+msg.message): the client opens a trace around an op, every message the
+handling thread sends while dispatching inherits the id, and every
+daemon records (trace_id, daemon, event, t) span events into its
+process-local ring.  One EC write therefore leaves a reconstructible
+client → primary → shard timeline; ``dump(trace_id)`` stitches the
+events time-ordered, and daemons expose the same via the admin socket
+(``dump_traces``).
+
+Propagation is THREAD-SCOPED: the dispatch loop sets the current trace
+for the duration of handling a traced message, so synchronous fan-out
+(the op pipeline) is covered; work handed to timers/workers starts
+untraced unless it re-enters with trace_ctx.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+_tls = threading.local()
+_lock = threading.Lock()
+#: (trace_id, daemon, event, t) ring — per process; every in-process
+#: daemon shares it (multi-process daemons each hold their own and the
+#: operator stitches admin-socket dumps)
+_events: deque = deque(maxlen=20000)
+
+
+def new_trace_id() -> int:
+    return int.from_bytes(os.urandom(8), "big") >> 1 or 1
+
+
+def current() -> int:
+    return getattr(_tls, "trace_id", 0)
+
+
+def set_current(trace_id: int) -> int:
+    """Install trace_id as the thread's current; returns the previous
+    (restore it via set_current when done)."""
+    prev = getattr(_tls, "trace_id", 0)
+    _tls.trace_id = trace_id
+    return prev
+
+
+@contextmanager
+def trace_ctx(trace_id: int | None = None):
+    """Open (or join) a trace for the calling thread."""
+    tid = trace_id or new_trace_id()
+    prev = set_current(tid)
+    try:
+        yield tid
+    finally:
+        set_current(prev)
+
+
+def record(daemon: str, event: str, trace_id: int | None = None) -> None:
+    tid = trace_id if trace_id is not None else current()
+    if not tid:
+        return
+    with _lock:
+        _events.append((tid, daemon, event, time.time()))
+
+
+def stamp(msg, daemon: str) -> None:
+    """Transport send hook: a message sent by a thread holding a trace
+    inherits the id (once), and the send is recorded as a span event.
+    Runs on the CALLER's thread — transports that encode later on an
+    event loop still carry the id because it is stored on the message."""
+    if getattr(msg, "trace_id", 0):
+        return
+    tid = current()
+    if not tid:
+        return
+    msg.trace_id = tid
+    record(daemon, f"tx {type(msg).__name__}", tid)
+
+
+def events(trace_id: int) -> list[dict]:
+    with _lock:
+        snap = list(_events)
+    return [{"daemon": d, "event": e, "t": t}
+            for tid, d, e, t in snap if tid == trace_id]
+
+
+def dump(trace_id: int | None = None) -> list[dict]:
+    """Stitched timeline(s), time-ordered — the admin-socket payload."""
+    with _lock:
+        snap = list(_events)
+    rows = [{"trace_id": tid, "daemon": d, "event": e, "t": t}
+            for tid, d, e, t in snap
+            if trace_id is None or tid == trace_id]
+    rows.sort(key=lambda r: r["t"])
+    return rows
+
+
+def trace_ids() -> list[int]:
+    with _lock:
+        return sorted({tid for tid, *_ in _events})

@@ -233,6 +233,12 @@ class TcpConnection(Connection):
     def send_message(self, msg: Message) -> None:
         if self._down:
             return
+        from ceph_tpu.common import tracing
+        from ceph_tpu.msg.features import FEATURE_TRACE
+        if self.features & FEATURE_TRACE:
+            # NEVER emit the trace header extension against a peer
+            # that did not negotiate it (features.py's invariant)
+            tracing.stamp(msg, str(self.messenger.my_name))
         self._sendq.put(msg)
 
     def mark_down(self) -> None:

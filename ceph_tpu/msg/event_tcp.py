@@ -123,6 +123,12 @@ class EventConnection(Connection):
     def send_message(self, msg: Message) -> None:
         if self._down:
             return
+        from ceph_tpu.common import tracing
+        from ceph_tpu.msg.features import FEATURE_TRACE
+        if self.features & FEATURE_TRACE:
+            # NEVER emit the trace header extension against a peer
+            # that did not negotiate it (features.py's invariant)
+            tracing.stamp(msg, str(self.messenger.my_name))
         m = self.messenger
         with m._lock:
             if self._down:

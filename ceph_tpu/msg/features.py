@@ -24,11 +24,13 @@ FEATURE_CEPHX_TICKETS = 1 << 2      # ticket-based cephx handshakes
 FEATURE_INCREMENTAL_MAPS = 1 << 3   # MOSDMapMsg incremental payloads
 FEATURE_PG_STATS_V2 = 1 << 4        # MMgrReport v2 per-PG records
 FEATURE_EC_RMW_PIPELINE = 1 << 5    # pipelined EC overlapping writes
+FEATURE_TRACE = 1 << 6              # frame-header trace extension
 
 #: everything this build speaks
 SUPPORTED_FEATURES = (FEATURE_BASE | FEATURE_WIRE_COMPRESSION
                       | FEATURE_CEPHX_TICKETS | FEATURE_INCREMENTAL_MAPS
-                      | FEATURE_PG_STATS_V2 | FEATURE_EC_RMW_PIPELINE)
+                      | FEATURE_PG_STATS_V2 | FEATURE_EC_RMW_PIPELINE
+                      | FEATURE_TRACE)
 
 #: handshake frame: (supported u64, required u64) — ONE definition
 #: shared by both TCP stacks; they must parse each other byte-exact

@@ -25,6 +25,8 @@ class LoopbackConnection(Connection):
     def send_message(self, msg: Message) -> None:
         if self._down:
             return
+        from ceph_tpu.common import tracing
+        tracing.stamp(msg, str(self.messenger.my_name))
         with _registry_lock:
             peer = _registry.get(self.peer_addr)
         if peer is None:

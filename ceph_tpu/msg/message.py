@@ -15,8 +15,13 @@ from .encoding import Decoder, DecodeError, Encoder
 
 _REGISTRY: dict[int, type] = {}
 
-_HEADER = struct.Struct("<IHBBQ I")   # type, reserved, ver, compat, seq, len
+_HEADER = struct.Struct("<IHBBQ I")   # type, flags, ver, compat, seq, len
 _FOOTER = struct.Struct("<I")         # crc32 of payload
+#: header flag bit 0: a trace extension (trace_id u64) follows the
+#: fixed header — untraced frames are byte-identical to the
+#: pre-tracing format, so archived corpora still decode/re-encode
+_FLAG_TRACE = 0x1
+_TRACE_EXT = struct.Struct("<Q")
 
 
 def register_message(cls):
@@ -39,6 +44,10 @@ class Message:
         self.seq = 0
         #: filled by the messenger on receive: the Connection it arrived on
         self.connection = None
+        #: cross-daemon trace span id (0 = untraced); rides the frame
+        #: header extension and propagates through dispatch threads
+        #: (common/tracing)
+        self.trace_id = 0
 
     # subclasses implement:
     def encode_payload(self, enc: Encoder) -> None:
@@ -53,16 +62,25 @@ class Message:
         enc = Encoder()
         self.encode_payload(enc)
         payload = enc.tobytes()
-        header = _HEADER.pack(self.TYPE, 0, self.HEAD_VERSION,
+        tid = getattr(self, "trace_id", 0)
+        flags = _FLAG_TRACE if tid else 0
+        header = _HEADER.pack(self.TYPE, flags, self.HEAD_VERSION,
                               self.COMPAT_VERSION, self.seq, len(payload))
-        return header + payload + _FOOTER.pack(zlib.crc32(payload))
+        ext = _TRACE_EXT.pack(tid) if tid else b""
+        return header + ext + payload + _FOOTER.pack(zlib.crc32(payload))
 
     @staticmethod
     def decode(data: bytes) -> "Message":
         if len(data) < _HEADER.size + _FOOTER.size:
             raise DecodeError("short message frame")
-        mtype, _r, ver, compat, seq, plen = _HEADER.unpack_from(data, 0)
+        mtype, flags, ver, compat, seq, plen = _HEADER.unpack_from(data, 0)
         start = _HEADER.size
+        trace_id = 0
+        if flags & _FLAG_TRACE:
+            if len(data) < start + _TRACE_EXT.size:
+                raise DecodeError("truncated trace extension")
+            (trace_id,) = _TRACE_EXT.unpack_from(data, start)
+            start += _TRACE_EXT.size
         if len(data) < start + plen + _FOOTER.size:
             raise DecodeError("truncated payload")
         payload = data[start:start + plen]
@@ -79,6 +97,7 @@ class Message:
         msg = cls.__new__(cls)
         Message.__init__(msg)
         msg.seq = seq
+        msg.trace_id = trace_id
         msg.decode_payload(Decoder(payload), ver)
         return msg
 

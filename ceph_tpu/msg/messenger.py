@@ -174,6 +174,15 @@ class Messenger:
             size = msg.frame_size()
             policy.throttler_bytes.get(size)
             tb = (policy.throttler_bytes, size)
+        tid = getattr(msg, "trace_id", 0)
+        prev_trace = 0
+        if tid:
+            # the handling thread JOINS the trace: everything it sends
+            # while dispatching inherits the id (common/tracing.stamp)
+            from ceph_tpu.common import tracing
+            tracing.record(str(self.my_name),
+                           f"rx {type(msg).__name__}", tid)
+            prev_trace = tracing.set_current(tid)
         try:
             with self._lock:
                 chain = list(self._dispatchers)
@@ -182,6 +191,9 @@ class Messenger:
                     return True
             return False
         finally:
+            if tid:
+                from ceph_tpu.common import tracing
+                tracing.set_current(prev_trace)
             if tb:
                 tb[0].put(tb[1])
 

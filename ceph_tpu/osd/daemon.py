@@ -279,7 +279,8 @@ class OSDDaemon(Dispatcher):
         from ceph_tpu.common.op_tracker import OpTracker
         self.op_tracker = OpTracker(
             complaint_time=float(
-                self.ctx.conf.get("osd_op_complaint_time")))
+                self.ctx.conf.get("osd_op_complaint_time")),
+            daemon=f"osd.{osd_id}")
         self.ctx.admin.register_command(
             "dump_ops_in_flight",
             lambda **kw: self.op_tracker.dump_ops_in_flight(),
@@ -347,11 +348,17 @@ class OSDDaemon(Dispatcher):
             "recovery reservation slots")
 
     def _opwq_handle(self, klass: str, item) -> None:
-        """Shard worker: run the dispatch handler bound at enqueue."""
+        """Shard worker: run the dispatch handler bound at enqueue.
+        The worker JOINS the op's trace (the dispatch thread's
+        thread-local died at the queue boundary; the id lives on the
+        message)."""
         handler, msg, cost = item
+        from ceph_tpu.common import tracing
+        prev = tracing.set_current(getattr(msg, "trace_id", 0))
         try:
             handler(msg)
         finally:
+            tracing.set_current(prev)
             self._op_throttle.put(cost)
 
     def _client_class(self, msg) -> str:
@@ -1990,6 +1997,17 @@ class OSDDaemon(Dispatcher):
         return up, acting_primary
 
     def _handle_op(self, msg: MOSDOp) -> None:
+        # replayed ops (map-advance, recovery waiters, promote-done)
+        # run on whatever thread flushed them: re-join the op's trace
+        # from the message so the fan-out stays attributed
+        tid = getattr(msg, "trace_id", 0)
+        from ceph_tpu.common import tracing
+        if tid and tracing.current() != tid:
+            prev = tracing.set_current(tid)
+            try:
+                return self._handle_op(msg)
+            finally:
+                tracing.set_current(prev)
         if getattr(msg, "_trk", None) is None:
             kinds = ",".join(str(op.op) for op in msg.ops)
             msg._trk = self.op_tracker.create_request(
