@@ -458,6 +458,13 @@ class EventConnection(Connection):
     # -- frame I/O ------------------------------------------------------------
 
     def _frame(self, msg: Message) -> bytes:
+        if getattr(self.messenger, "ici_wire", False):
+            from ceph_tpu.msg.features import FEATURE_ICI_TOKENS
+            if self.features & FEATURE_ICI_TOKENS:
+                # ici-wire data plane: the bulk payload moves through
+                # the device transfer engine; the frame carries a token
+                from ceph_tpu.msg.ici import maybe_stage
+                maybe_stage(msg, self.peer_name)
         payload = msg.encode()
         comp = COMP_NONE
         if self.comp == COMP_ZLIB and len(payload) >= COMP_THRESHOLD:

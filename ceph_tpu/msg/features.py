@@ -25,6 +25,9 @@ FEATURE_INCREMENTAL_MAPS = 1 << 3   # MOSDMapMsg incremental payloads
 FEATURE_PG_STATS_V2 = 1 << 4        # MMgrReport v2 per-PG records
 FEATURE_EC_RMW_PIPELINE = 1 << 5    # pipelined EC overlapping writes
 FEATURE_TRACE = 1 << 6              # frame-header trace extension
+#: advertised ONLY by ici-wire messengers (not in SUPPORTED_FEATURES):
+#: the peer can redeem staged-buffer tokens for bulk payloads
+FEATURE_ICI_TOKENS = 1 << 7
 
 #: everything this build speaks
 SUPPORTED_FEATURES = (FEATURE_BASE | FEATURE_WIRE_COMPRESSION

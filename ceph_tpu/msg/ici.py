@@ -7,15 +7,30 @@ Control frames (op headers, acks, maps, peering) ride the in-process
 queue exactly like the loopback stack.  BULK PAYLOADS — EC shard chunks
 in MOSDECSubOpWrite / MOSDECSubOpReadReply — are split out of the frame
 and moved through the jax device mesh instead: the sender places the
-chunk on the RECEIVER's device (jax.device_put — an ICI hop on real
-multi-chip hardware, a real cross-device placement on the CPU test
-mesh), and the frame carries only a token the receiver redeems.  The
-OSD daemons are completely unaware: the stack IS the abstraction, so
-the EC data path and the mesh data path are one code path.
+chunk on a device and the frame carries only a token the receiver
+redeems.  The OSD daemons are completely unaware: the stack IS the
+abstraction, so the EC data path and the mesh data path are one code
+path.
 
-Device assignment: osd.N <-> jax.devices()[N % ndevices] — each OSD
-"owns" a mesh position, so a k+m shard fan-out lands one chunk per
-device, exactly the sharded-encode layout of parallel/sharded.py.
+Two deployment shapes, one token protocol:
+
+* IN-PROCESS (``IciMessenger``, loopback control plane): the sender
+  places the chunk directly on the RECEIVER's device (jax.device_put —
+  an ICI hop on real multi-chip hardware) and the receiver redeems from
+  the shared registry.
+* CROSS-PROCESS (``IciWireMessenger``, TCP control plane): each process
+  runs a ``jax.experimental.transfer`` server over its local backend
+  (the DCN/ICI point-to-point engine).  The sender stages the chunk on
+  its OWN device and registers it for pull; the token carries the
+  sender's transfer-server address, and the receiving process pulls the
+  buffer device-to-device — the RDMA-READ shape of the reference's
+  RDMAStack (src/msg/async/rdma/RDMAStack.h), with the transfer server
+  standing where the RDMA verbs stack stands.  Peers that did not
+  negotiate FEATURE_ICI_TOKENS get plain inline frames (TCP fallback).
+
+Device assignment: osd.N <-> local_devices[N % n] — each OSD "owns" a
+mesh position, so a k+m shard fan-out lands one chunk per device,
+exactly the sharded-encode layout of parallel/sharded.py.
 """
 
 from __future__ import annotations
@@ -30,6 +45,9 @@ from .message import Message
 from .messenger import EntityName
 
 _MARKER = b"\x00ICI\x00"
+#: cross-process token: marker + u64 token + u64 nbytes + u16 addr-len
+#: + transfer-server address (the sender's pull endpoint)
+_MARKER_X = b"\x00ICX\x00"
 
 
 class IciTransport:
@@ -61,6 +79,15 @@ class IciTransport:
         self._reg_lock = threading.Lock()
         self.bytes_staged = 0      # cumulative
         self.transfers = 0         # cumulative
+        #: cross-process pull endpoint (enable_wire)
+        self._server = None
+        self.server_addr = ""
+        self._peer_conns: dict[str, object] = {}
+        self.pulls = 0             # cumulative cross-process redeems
+        #: (addr, token) -> pull time: a remote registration is ONE-
+        #: shot, so a resent frame must fail fast as transport loss —
+        #: re-pulling a consumed uuid could block the dispatch thread
+        self._pulled: dict[tuple[str, int], float] = {}
     # gauge: currently staged, unredeemed
 
     def outstanding(self) -> tuple[int, int]:
@@ -92,12 +119,63 @@ class IciTransport:
         idx = name.id if name.type == "osd" else 0
         return self.devices[idx % len(self.devices)]
 
+    # -- cross-process pull endpoint (RDMAStack analog) -----------------------
+
+    _wire_lock = threading.Lock()
+
+    def enable_wire(self) -> str:
+        """Start this process's jax transfer server (idempotent).
+        Raises on backends without the transfer engine — callers fall
+        back to plain TCP frames then ("fall back to TCP only when no
+        shared mesh exists")."""
+        with self._wire_lock:   # created UNDER the lock: a concurrent
+            # caller must never leak a second bound server
+            if self._server is not None:
+                return self.server_addr
+            from jax.experimental import transfer
+            dev = self.jax.local_devices()[0]
+            # explicit socket transport addresses: the default local
+            # bulk transport only moves bytes within one process —
+            # peers in OTHER processes need the TCP bulk path
+            server = transfer.start_transfer_server(
+                dev.client, "127.0.0.1:0",
+                transport_addresses=["127.0.0.1:0"])
+            self._server = server
+            self.server_addr = server.address()
+            return self.server_addr
+
+    @property
+    def wire_enabled(self) -> bool:
+        return self._server is not None
+
+    #: wire mode: the transfer server's one-shot pull registrations
+    #: cannot be cancelled, so a lost frame pins its buffer until
+    #: process exit.  The leak is BOUNDED: past this many outstanding
+    #: unredeemed bytes, staging refuses and the payload rides the TCP
+    #: frame inline instead (the documented fallback)
+    WIRE_STAGE_CAP = 256 << 20
+
+    def can_stage(self, nbytes: int) -> bool:
+        if self._server is None:
+            return True      # in-process buffers reap on TTL
+        _n, outstanding = self.outstanding()
+        return outstanding + nbytes <= self.WIRE_STAGE_CAP
+
     def stage(self, chunk: bytes, peer: EntityName) -> bytes:
-        """Place the payload on the peer's device; returns the token the
-        frame carries instead of the bytes."""
+        """Place the payload on a device; returns the token the frame
+        carries instead of the bytes.
+
+        In-process: the chunk lands on the PEER's device (the ICI hop
+        happens at stage time).  Wire mode: it lands on a LOCAL device
+        and is registered for pull — the hop happens when the receiving
+        process redeems (RDMA READ)."""
         import jax.numpy as jnp
         arr = jnp.asarray(np.frombuffer(chunk, dtype=np.uint8))
-        buf = self.jax.device_put(arr, self.device_for(peer))
+        if self._server is not None:
+            dev = self.jax.local_devices()[0]
+        else:
+            dev = self.device_for(peer)
+        buf = self.jax.device_put(arr, dev)
         now = time.monotonic()
         with self._reg_lock:
             self._reap_locked(now)
@@ -107,10 +185,27 @@ class IciTransport:
                                  "staged_at": now, "redeemed_at": None}
             self.bytes_staged += len(chunk)
             self.transfers += 1
+        if self._server is not None:
+            self._server.await_pull(token, [buf])
+            addr = self.server_addr.encode()
+            return (_MARKER_X + token.to_bytes(8, "little")
+                    + len(chunk).to_bytes(8, "little")
+                    + len(addr).to_bytes(2, "little") + addr)
         return _MARKER + token.to_bytes(8, "little")
 
     def redeem(self, blob: bytes) -> bytes:
-        token = int.from_bytes(blob[len(_MARKER):], "little")
+        if blob.startswith(_MARKER_X):
+            off = len(_MARKER_X)
+            token = int.from_bytes(blob[off:off + 8], "little")
+            nbytes = int.from_bytes(blob[off + 8:off + 16], "little")
+            alen = int.from_bytes(blob[off + 16:off + 18], "little")
+            addr = blob[off + 18:off + 18 + alen].decode()
+            if addr != self.server_addr:
+                return self._pull(addr, token, nbytes)
+            # our own process staged it: the registry is authoritative
+            # (and survives the one-shot pull registration)
+        token = int.from_bytes(blob[len(_MARKER):len(_MARKER) + 8],
+                               "little")
         now = time.monotonic()
         with self._reg_lock:
             self._reap_locked(now)
@@ -122,9 +217,46 @@ class IciTransport:
             raise KeyError(f"ici token {token} expired or unknown")
         return np.asarray(buf).tobytes()
 
+    def _pull(self, addr: str, token: int, nbytes: int) -> bytes:
+        """Cross-process redemption: a device-to-device pull from the
+        staging process's transfer server (one-shot, like an RDMA READ
+        of a posted buffer; a resend that re-pulls is transport loss
+        and the op-level retry repairs it)."""
+        if self._server is None:
+            raise KeyError(
+                f"ici token from {addr}: no local transfer server")
+        from jax.sharding import SingleDeviceSharding
+        now = time.monotonic()
+        with self._reg_lock:
+            for k in [k for k, t in self._pulled.items()
+                      if now - t > self.GRACE]:
+                del self._pulled[k]
+            if (addr, token) in self._pulled:
+                raise KeyError(
+                    f"ici token {token} from {addr} already pulled "
+                    "(one-shot): resend is transport loss")
+            self._pulled[(addr, token)] = now
+            conn = self._peer_conns.get(addr)
+        if conn is None:
+            conn = self._server.connect(addr)
+            with self._reg_lock:
+                self._peer_conns.setdefault(addr, conn)
+                conn = self._peer_conns[addr]
+        spec = self.jax.ShapeDtypeStruct(
+            (nbytes,), np.uint8,
+            sharding=SingleDeviceSharding(self.jax.local_devices()[0]))
+        try:
+            out = conn.pull(token, [spec])
+            data = np.asarray(out[0]).tobytes()
+        except Exception as e:
+            raise KeyError(f"ici pull {token} from {addr}: {e}")
+        with self._reg_lock:
+            self.pulls += 1
+        return data
+
     @staticmethod
     def is_token(blob: bytes) -> bool:
-        return blob.startswith(_MARKER)
+        return blob.startswith(_MARKER) or blob.startswith(_MARKER_X)
 
 
 def _bulk_field(msg: Message):
@@ -139,19 +271,49 @@ def _bulk_field(msg: Message):
     return None
 
 
-class IciConnection(LoopbackConnection):
-    #: payloads below this stay in the control frame
-    BULK_THRESHOLD = 512
+#: payloads below this stay in the control frame
+BULK_THRESHOLD = 512
 
+
+def maybe_stage(msg: Message, peer_name) -> None:
+    """Replace a bulk payload with a staged-buffer token (idempotent;
+    shared by the in-process and wire stacks)."""
+    field = _bulk_field(msg)
+    if field is None or peer_name is None:
+        return
+    payload = getattr(msg, field)
+    if (len(payload) >= BULK_THRESHOLD
+            and not IciTransport.is_token(payload)):
+        t = IciTransport.instance()
+        if t.can_stage(len(payload)):
+            setattr(msg, field, t.stage(payload, peer_name))
+        # else: past the wire staging cap — the payload rides the
+        # frame inline (TCP fallback), bounding the unreapable
+        # one-shot registrations a lossy peer can pin
+
+
+def maybe_redeem(msg: Message) -> bool:
+    """Swap a token back for its bytes before dispatch; False = the
+    staged buffer is gone (transport loss — caller drops the frame and
+    the op-level retry resends fresh bytes)."""
+    field = _bulk_field(msg)
+    if field is None:
+        return True
+    payload = getattr(msg, field)
+    if not IciTransport.is_token(payload):
+        return True
+    try:
+        setattr(msg, field, IciTransport.instance().redeem(payload))
+        return True
+    except KeyError:
+        from ceph_tpu.common.logging import dout
+        dout("ms", 5, "ici: dropping frame with expired token")
+        return False
+
+
+class IciConnection(LoopbackConnection):
     def send_message(self, msg: Message) -> None:
-        field = _bulk_field(msg)
-        if field is not None and self.peer_name is not None:
-            payload = getattr(msg, field)
-            if (len(payload) >= self.BULK_THRESHOLD
-                    and not IciTransport.is_token(payload)):
-                setattr(msg, field,
-                        IciTransport.instance().stage(payload,
-                                                      self.peer_name))
+        maybe_stage(msg, self.peer_name)
         super().send_message(msg)
 
 
@@ -162,18 +324,35 @@ class IciMessenger(LoopbackMessenger):
         return IciConnection(self, addr, peer_name)
 
     def deliver(self, msg: Message) -> bool:
-        field = _bulk_field(msg)
-        if field is not None:
-            payload = getattr(msg, field)
-            if IciTransport.is_token(payload):
-                try:
-                    setattr(msg, field,
-                            IciTransport.instance().redeem(payload))
-                except KeyError:
-                    # the staged buffer expired (sender died long ago or
-                    # the resend window closed): transport loss — drop
-                    # the frame, the op-level retry resends fresh bytes
-                    from ceph_tpu.common.logging import dout
-                    dout("ms", 5, "ici: dropping frame with expired token")
-                    return True
+        if not maybe_redeem(msg):
+            return True
         return super().deliver(msg)
+
+
+def make_wire_messenger(name, **kw):
+    """TCP control plane + transfer-server data plane: the CROSS-PROCESS
+    ici stack (the reference's RDMAStack role — a real inter-node bulk
+    transport behind the same Messenger API).  Reached via
+    Messenger.create("ici-wire"); raises when the jax backend has no
+    transfer engine, so the operator falls back to plain TCP explicitly
+    rather than silently losing the data plane.
+
+    A thin subclass of the event-driven TCP messenger: bulk payloads
+    tokenize at the frame point for peers that negotiated
+    FEATURE_ICI_TOKENS (event_tcp._frame), and tokens are redeemed —
+    possibly a cross-process device pull — before dispatch."""
+    from ceph_tpu.msg.event_tcp import EventMessenger
+    from ceph_tpu.msg.features import FEATURE_ICI_TOKENS
+
+    class IciWireMessenger(EventMessenger):
+        ici_wire = True
+
+        def deliver(self, msg: Message) -> bool:
+            if not maybe_redeem(msg):
+                return True
+            return EventMessenger.deliver(self, msg)
+
+    IciTransport.instance().enable_wire()   # raises if unsupported
+    m = IciWireMessenger(name, **kw)
+    m.local_features |= FEATURE_ICI_TOKENS
+    return m

@@ -139,6 +139,11 @@ class Messenger:
         if mtype == "ici":
             from .ici import IciMessenger
             return IciMessenger(name, **kw)
+        if mtype == "ici-wire":
+            # cross-process: TCP control plane, transfer-server bulk
+            # data plane (msg/ici.make_wire_messenger)
+            from .ici import make_wire_messenger
+            return make_wire_messenger(name, **kw)
         raise ValueError(f"unknown messenger type {mtype!r}")
 
     # -- dispatcher chain (Messenger.h:337-352) -------------------------------

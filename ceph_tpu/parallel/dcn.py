@@ -103,7 +103,9 @@ def worker_main(argv=None) -> int:
 
     # platform setup MUST precede any jax backend initialization
     os.environ["XLA_FLAGS"] = (
-        f"--xla_force_host_platform_device_count={args.local_devices}")
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count="
+        f"{args.local_devices}").strip()
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.distributed.initialize(args.coordinator, args.num_processes,
@@ -146,9 +148,33 @@ def worker_main(argv=None) -> int:
     expect = int(ec_encode_ref(coding, data).astype(np.int64).sum())
     assert total == expect, (total, expect)
 
-    # control plane: cross-check digests over the TCP messenger
+    # control plane: cross-check digests over the TCP messenger.
+    # data plane #2: each worker also stages a bulk chunk in its
+    # IciTransport wire mode and hands the TOKEN to the peer, which
+    # redeems it with a cross-process device pull — the ici-wire
+    # messenger's EC-shard path exercised at the transport level
     from ceph_tpu.messages import MMonCommand, MMonCommandAck
+    from ceph_tpu.msg.ici import IciTransport
     from ceph_tpu.msg.messenger import Dispatcher, EntityName, Messenger
+
+    ici = IciTransport.instance()
+    try:
+        ici.enable_wire()
+        my_chunk = bytes([args.process_id]) * 65536
+        my_token = ici.stage(my_chunk,
+                             EntityName("osd", 1 - args.process_id))
+    except Exception:
+        # backend without the transfer engine: the control-plane proof
+        # still runs; token fields stay empty and both sides skip
+        my_token = b""
+
+    def check_peer_token(tok_hex: str, peer_pid: int) -> bool:
+        if not (my_token and tok_hex):
+            return True     # transfer engine unavailable: skip
+        data = ici.redeem(bytes.fromhex(tok_hex))
+        assert data == bytes([peer_pid]) * 65536, len(data)
+        assert ici.pulls >= 1     # it really crossed processes
+        return True
 
     stack = pick_stack(peer_process=1 - args.process_id,
                        my_process=args.process_id)
@@ -158,10 +184,20 @@ def worker_main(argv=None) -> int:
         class D(Dispatcher):
             def ms_dispatch(self, msg):
                 if isinstance(msg, MMonCommand):
-                    result["peer"] = msg.cmd
+                    if msg.cmd.get("done"):
+                        # the peer finished its pull of OUR token: we
+                        # may tear the transfer server down now
+                        result["done"] = True
+                        return True
+                    ok = (msg.cmd.get("total") == total
+                          and check_peer_token(
+                              msg.cmd.get("token", ""), 1))
                     msg.connection.send_message(MMonCommandAck(
-                        tid=msg.tid,
-                        result=0 if msg.cmd.get("total") == total else -1))
+                        tid=msg.tid, result=0 if ok else -1,
+                        output=my_token.hex()))
+                    # publish only AFTER the pull + ack: the main
+                    # thread must not shut us down mid-handshake
+                    result["peer"] = msg.cmd
                     return True
                 return False
 
@@ -169,11 +205,13 @@ def worker_main(argv=None) -> int:
         ms.add_dispatcher_tail(D())
         ms.bind(f"127.0.0.1:{args.ms_port}")
         ms.start()
+        want = {"peer"} | ({"done"} if my_token else set())
         deadline = time.time() + 60
-        while "peer" not in result and time.time() < deadline:
+        while not want <= result.keys() and time.time() < deadline:
             time.sleep(0.05)
         ms.shutdown()
         assert result.get("peer", {}).get("total") == total, result
+        assert not my_token or result.get("done"), result
     else:
         acked = {}
 
@@ -181,6 +219,7 @@ def worker_main(argv=None) -> int:
             def ms_dispatch(self, msg):
                 if isinstance(msg, MMonCommandAck):
                     acked["rc"] = msg.result
+                    acked["token"] = msg.output
                     return True
                 return False
 
@@ -191,10 +230,14 @@ def worker_main(argv=None) -> int:
                             EntityName("mon", 0))
         con.send_message(MMonCommand(tid=1, cmd={
             "total": total, "process": args.process_id,
-            "devices": n_global}))
+            "devices": n_global, "token": my_token.hex()}))
         deadline = time.time() + 60
         while "rc" not in acked and time.time() < deadline:
             time.sleep(0.05)
+        assert check_peer_token(acked.get("token", ""), 0)
+        # release the stager: our pull of its token is complete
+        con.send_message(MMonCommand(tid=2, cmd={"done": 1}))
+        time.sleep(0.2)     # let the frame flush before teardown
         ms.shutdown()
         assert acked.get("rc") == 0, acked
     print(f"dcn worker {args.process_id}: global sum {total} over "

@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import signal
 import sys
+import os
 import threading
 
 
@@ -31,6 +32,14 @@ def main(argv=None) -> int:
                    help="comma-separated mon addresses")
     p.add_argument("--monmap", default="",
                    help="mon only: comma-separated monmap (all mons)")
+    p.add_argument("--ms-type", default="async",
+                   help="messenger stack; 'ici' selects the cross-"
+                        "process ici-wire stack (TCP control plane + "
+                        "device transfer data plane)")
+    p.add_argument("--jax-cpu-devices", type=int, default=0,
+                   help="force the cpu platform with N local devices "
+                        "BEFORE jax initializes (the virtual-mesh test "
+                        "tier; production uses the real backend)")
     p.add_argument("--store-type", default="filestore")
     p.add_argument("--store-path", default="")
     p.add_argument("--auth-key", default="")
@@ -39,6 +48,14 @@ def main(argv=None) -> int:
     p.add_argument("--data-pool", type=int, default=2)
     args = p.parse_args(argv)
     auth_key = args.auth_key.encode() if args.auth_key else None
+    if args.jax_cpu_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count="
+            f"{args.jax_cpu_devices}").strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    ms_type = "ici-wire" if args.ms_type == "ici" else args.ms_type
 
     if args.role == "mon":
         from ceph_tpu.mon import Monitor
@@ -57,7 +74,7 @@ def main(argv=None) -> int:
     elif args.role == "osd":
         from ceph_tpu.osd.daemon import OSDDaemon
         d = OSDDaemon(args.id, args.mon_host, store_type=args.store_type,
-                      store_path=args.store_path, ms_type="async",
+                      store_path=args.store_path, ms_type=ms_type,
                       addr=args.addr, heartbeats=args.heartbeats,
                       auth_key=auth_key)
         d.init()

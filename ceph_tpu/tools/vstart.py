@@ -392,12 +392,20 @@ class ProcCluster:
     """
 
     def __init__(self, n_osds: int = 3, n_mons: int = 1,
-                 base_path: str = "", auth_key: str = ""):
+                 base_path: str = "", auth_key: str = "",
+                 ms_type: str = "async", jax_cpu_devices: int = 0):
         import tempfile
         self.n_osds = n_osds
         self.n_mons = n_mons
         self.base_path = base_path or tempfile.mkdtemp(prefix="proccluster-")
         self.auth_key = auth_key
+        #: OSD messenger stack: "ici" = cross-process ici-wire (TCP
+        #: control plane + device transfer data plane); OSD processes
+        #: then pin a cpu backend with jax_cpu_devices local devices
+        #: (the virtual-mesh tier; real deployments use the real chips)
+        self.ms_type = ms_type
+        self.jax_cpu_devices = jax_cpu_devices or (
+            2 if ms_type == "ici" else 0)
         self.procs: dict[str, object] = {}   # "mon.0" / "osd.2" -> Popen
         self.mon_addrs: list[str] = []
         self.clients: list[RadosClient] = []
@@ -458,8 +466,12 @@ class ProcCluster:
         return self
 
     def run_osd(self, osd_id: int):
-        return self._spawn("osd", osd_id,
-                           ["--mon-host", self.mon_host, "--heartbeats"])
+        extra = ["--mon-host", self.mon_host, "--heartbeats"]
+        if self.ms_type != "async":
+            extra += ["--ms-type", self.ms_type]
+        if self.jax_cpu_devices:
+            extra += ["--jax-cpu-devices", str(self.jax_cpu_devices)]
+        return self._spawn("osd", osd_id, extra)
 
     def kill_osd(self, osd_id: int) -> None:
         """SIGKILL — crash-grade process death (Thrasher kill_osd)."""

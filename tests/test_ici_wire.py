@@ -1,0 +1,113 @@
+"""Cross-process ICI data plane (msg/ici wire mode — the RDMAStack
+role): multi-process OSDs run ms_type=ici end-to-end, EC shard payloads
+tokenize and move through per-process jax transfer servers (device
+pulls across OS processes), with TCP as the negotiated fallback."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ceph_tpu.tools.vstart import ProcCluster
+
+
+def _cpu_jax_available() -> bool:
+    """The wire data plane needs the jax transfer engine on the cpu
+    backend — probe in a subprocess so this process's jax stays
+    untouched."""
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from ceph_tpu.msg.ici import IciTransport\n"
+        "IciTransport.instance().enable_wire()\n"   # the REAL path
+        "print('ok')\n")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=120)
+        return "ok" in out.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+pytestmark = pytest.mark.skipif(
+    not _cpu_jax_available(),
+    reason="jax transfer engine unavailable on the cpu backend")
+
+
+def test_two_process_token_pull():
+    """The transport primitive on its own: process A stages, process B
+    redeems — a device-to-device pull across OS processes."""
+    worker = (
+        "import sys, os\n"
+        "os.environ['XLA_FLAGS'] = "
+        "'--xla_force_host_platform_device_count=2'\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from ceph_tpu.msg.ici import IciTransport\n"
+        "from ceph_tpu.msg.messenger import EntityName\n"
+        "t = IciTransport.instance()\n"
+        "t.enable_wire()\n"
+        "mode = sys.argv[1]\n"
+        "if mode == 'stage':\n"
+        "    tok = t.stage(bytes(range(256)) * 64, EntityName('osd', 1))\n"
+        "    sys.stdout.write(tok.hex() + '\\n')\n"
+        "    sys.stdout.flush()\n"
+        "    sys.stdin.readline()   # hold until the peer pulled\n"
+        "else:\n"
+        "    tok = bytes.fromhex(sys.stdin.readline().strip())\n"
+        "    data = t.redeem(tok)\n"
+        "    assert data == bytes(range(256)) * 64, len(data)\n"
+        "    assert t.pulls == 1\n"
+        "    print('pulled', len(data))\n")
+    a = subprocess.Popen([sys.executable, "-c", worker, "stage"],
+                         stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                         text=True)
+    tok_line = a.stdout.readline()
+    assert tok_line.strip(), "stager produced no token"
+    b = subprocess.run([sys.executable, "-c", worker, "redeem"],
+                       input=tok_line, capture_output=True, text=True,
+                       timeout=120)
+    a.stdin.write("done\n")
+    a.stdin.close()
+    a.wait(timeout=30)
+    assert b.returncode == 0, b.stderr
+    assert "pulled 16384" in b.stdout
+
+
+def test_multiprocess_cluster_ec_over_ici(tmp_path):
+    """The verdict's acceptance bar: the multi-process vstart tier runs
+    ms_type=ici end-to-end — every OSD a separate OS process, EC shard
+    payloads moving as transfer-server tokens between them."""
+    c = ProcCluster(n_osds=4, base_path=str(tmp_path),
+                    ms_type="ici").start()
+    try:
+        client = c.client()
+        c.wait_for_osd_count(4)
+        pool = c.create_pool(client, pg_num=1, pool_type="erasure",
+                             k=2, m=1)
+        io = client.open_ioctx(pool)
+        payload = bytes(range(256)) * 128        # 32 KiB: well past
+        io.write_full("ici-obj", payload)        # BULK_THRESHOLD
+        assert io.read("ici-obj", len(payload)) == payload
+        # a second object and an overwrite keep the tokens flowing
+        io.write_full("ici-obj2", payload[::-1])
+        io.write_full("ici-obj", payload[:16384])
+        assert io.read("ici-obj2", len(payload)) == payload[::-1]
+        assert io.read("ici-obj", 16384) == payload[:16384]
+        # degraded read after a SIGKILL: recovery pushes also ride the
+        # wire stack
+        c.kill_osd(3)
+        deadline = time.time() + 60
+        got = None
+        while time.time() < deadline:
+            try:
+                got = io.read("ici-obj2", len(payload))
+                if got == payload[::-1]:
+                    break
+            except (TimeoutError, OSError):
+                pass
+            time.sleep(0.5)
+        assert got == payload[::-1]
+    finally:
+        c.stop()
