@@ -153,6 +153,18 @@ class MgrDaemon(Dispatcher):
         self.reports: dict[int, tuple[float, MMgrReport]] = {}
         #: osd -> (time, counters) of the PREVIOUS report (iostat rates)
         self._prev_counters: dict[int, tuple[float, dict]] = {}
+        #: INCREMENTAL pg-row aggregation (the reference keeps
+        #: pg_stat_t deltas, not per-query rebuilds): pgid -> (stamp,
+        #: reporting osd, stat record), folded in at report intake so
+        #: `pg dump` at 1M-PG scale is a snapshot, not an O(cluster)
+        #: rebuild per query
+        self._pg_best: dict[str, tuple[float, int, dict]] = {}
+        #: osd -> pgids its latest report claimed: a pg absent from an
+        #: osd's NEWER report (moved away / pool deleted) retires from
+        #: the aggregate unless another osd claims it, so pg dump never
+        #: serves permanent ghost rows
+        self._pg_claims: dict[int, set] = {}
+        self._pg_rows_cache: list[dict] | None = None
         self.host = ModuleHost(self)
         self._active = False
         #: work the DISPATCH thread must never do itself (module
@@ -327,6 +339,7 @@ class MgrDaemon(Dispatcher):
             self._work_q.put(("cmd", msg))
             return True
         if isinstance(msg, MMgrReport):
+            now = time.time()
             with self._lock:
                 prev = self.reports.get(msg.osd_id)
                 if prev is not None:
@@ -334,7 +347,26 @@ class MgrDaemon(Dispatcher):
                     # rate window (current - previous) / dt
                     self._prev_counters[msg.osd_id] = (
                         prev[0], dict(prev[1].counters))
-                self.reports[msg.osd_id] = (time.time(), msg)
+                self.reports[msg.osd_id] = (now, msg)
+                # fold this osd's per-PG records into the aggregate
+                # (newest report wins a contended pgid); rows this osd
+                # STOPPED claiming retire unless someone else owns them
+                changed = False
+                claims = set((msg.pg_stats or {}))
+                for pgid in self._pg_claims.get(msg.osd_id,
+                                                set()) - claims:
+                    cur = self._pg_best.get(pgid)
+                    if cur is not None and cur[1] == msg.osd_id:
+                        del self._pg_best[pgid]
+                        changed = True
+                self._pg_claims[msg.osd_id] = claims
+                for pgid, st in (msg.pg_stats or {}).items():
+                    cur = self._pg_best.get(pgid)
+                    if cur is None or now >= cur[0]:
+                        self._pg_best[pgid] = (now, msg.osd_id, st)
+                        changed = True
+                if changed:
+                    self._pg_rows_cache = None
             self.host.notify_all("pg_stats", msg.osd_id)
             return True
         if isinstance(msg, MOSDMapMsg):
@@ -513,25 +545,25 @@ class MgrDaemon(Dispatcher):
     # -- pg introspection (DaemonServer `pg dump` / `pg ls`) ------------------
 
     def _pg_rows(self) -> list[dict]:
-        """Merged per-PG records across osd reports; when two osds both
-        claim a pg (a remap race window) the NEWEST report wins."""
-        best: dict[str, tuple[float, int, dict]] = {}
+        """Merged per-PG records, maintained INCREMENTALLY at report
+        intake (newest report wins a contended pgid — the remap race
+        window) and served from a cache a new report invalidates."""
         with self._lock:
-            for osd, (t, rep) in self.reports.items():
-                for pgid, st in (rep.pg_stats or {}).items():
-                    cur = best.get(pgid)
-                    if cur is None or t > cur[0]:
-                        best[pgid] = (t, osd, st)
-        rows = []
-        for pgid, (t, osd, st) in best.items():
-            row = dict(st)
-            row["pgid"] = pgid
-            row["reported_by"] = osd
-            row["stamp"] = t
-            rows.append(row)
-        rows.sort(key=lambda r: tuple(
-            int(x) for x in r["pgid"].split(".")))
-        return rows
+            if self._pg_rows_cache is not None:
+                # COPIES out: callers annotate rows (modules do), and a
+                # shared cache must never be mutated under them
+                return [dict(r) for r in self._pg_rows_cache]
+            rows = []
+            for pgid, (t, osd, st) in self._pg_best.items():
+                row = dict(st)
+                row["pgid"] = pgid
+                row["reported_by"] = osd
+                row["stamp"] = t
+                rows.append(row)
+            rows.sort(key=lambda r: tuple(
+                int(x) for x in r["pgid"].split(".")))
+            self._pg_rows_cache = rows
+            return [dict(r) for r in rows]
 
     def pg_dump(self) -> dict:
         """`ceph pg dump` (DaemonServer::_handle_pg_dump reduced):

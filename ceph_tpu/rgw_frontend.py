@@ -1,0 +1,356 @@
+"""Event-driven HTTP frontend for the object gateway
+(src/rgw/rgw_asio_frontend.cc analog).
+
+The reference's beast frontend is an async I/O loop feeding a bounded
+executor pool; the stdlib ThreadingHTTPServer it replaces here is
+thread-per-connection.  Same split, same discipline as the repo's
+event-driven messenger (msg/event_tcp):
+
+* ONE event-loop thread owns every socket: accepts, reads, parses
+  HTTP/1.1 frames (request line + headers + Content-Length body), and
+  writes responses — sockets are single-threaded by construction;
+* a BOUNDED worker pool runs the request handlers (they do RADOS I/O
+  and must never block the loop); finished responses return to the
+  loop over a wakeup pipe;
+* keep-alive by default; one request in flight per connection (a
+  pipelined second request waits buffered until the response flushes,
+  which is how the reference's beast sessions sequence too).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import selectors
+import socket
+import threading
+from dataclasses import dataclass, field
+
+
+class CIMap(dict):
+    """Case-insensitive header map (stores the wire casing, matches
+    any)."""
+
+    def __init__(self, items=()):
+        super().__init__()
+        self._lower: dict[str, str] = {}
+        for k, v in items:
+            self[k] = v
+
+    def __setitem__(self, k, v):
+        low = k.lower()
+        old = self._lower.get(low)
+        if old is not None:
+            super().__delitem__(old)
+        self._lower[low] = k
+        super().__setitem__(k, v)
+
+    def get(self, k, default=None):
+        real = self._lower.get(k.lower())
+        return super().get(real, default) if real is not None else default
+
+    def __contains__(self, k):
+        return k.lower() in self._lower
+
+
+@dataclass
+class HttpRequest:
+    method: str
+    target: str            # path?query, as received
+    headers: CIMap
+    body: bytes
+
+
+@dataclass
+class _ConnState:
+    sock: socket.socket
+    inbuf: bytearray = field(default_factory=bytearray)
+    outbuf: bytearray = field(default_factory=bytearray)
+    busy: bool = False     # a request is with the workers
+    close_after: bool = False
+    dead: bool = False
+    read_eof: bool = False   # client half-closed (SHUT_WR): finish
+    #                          the in-flight response, then close
+    sent_100: bool = False   # interim 100 Continue emitted
+
+
+_MAX_HEADER = 64 << 10
+_MAX_BODY = 512 << 20
+
+
+class AsyncHttpFrontend:
+    """handler(req: HttpRequest) -> (status:int, headers:dict,
+    body:bytes), run on a worker thread."""
+
+    REASONS = {200: "OK", 204: "No Content", 400: "Bad Request",
+               403: "Forbidden", 404: "Not Found", 409: "Conflict",
+               411: "Length Required", 500: "Internal Server Error",
+               501: "Not Implemented"}
+
+    def __init__(self, handler, addr: str = "127.0.0.1:0",
+                 workers: int = 8):
+        self.handler = handler
+        host, port = addr.rsplit(":", 1)
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(128)
+        self._listener.setblocking(False)
+        self.sel = selectors.DefaultSelector()
+        self._wake_r, self._wake_w = os.pipe()
+        os.set_blocking(self._wake_r, False)
+        self._work_q: queue.Queue = queue.Queue()
+        self._done_q: queue.Queue = queue.Queue()
+        self._stop = False
+        self._threads: list[threading.Thread] = []
+        self.n_workers = workers
+
+    @property
+    def addr(self) -> str:
+        h, p = self._listener.getsockname()[:2]
+        return f"{h}:{p}"
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "AsyncHttpFrontend":
+        t = threading.Thread(target=self._loop, name="rgw-http-loop",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        for i in range(self.n_workers):
+            w = threading.Thread(target=self._worker,
+                                 name=f"rgw-http-w{i}", daemon=True)
+            w.start()
+            self._threads.append(w)
+        return self
+
+    def stop(self) -> None:
+        self._stop = True
+        for _ in range(self.n_workers):
+            self._work_q.put(None)
+        os.write(self._wake_w, b"x")
+        for t in self._threads:
+            t.join(timeout=5)
+        try:
+            self._listener.close()
+        finally:
+            self.sel.close()
+            os.close(self._wake_r)
+            os.close(self._wake_w)
+
+    # -- event loop (single thread owns every socket) -------------------------
+
+    def _loop(self) -> None:
+        sel = self.sel
+        sel.register(self._listener, selectors.EVENT_READ, "accept")
+        sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        while not self._stop:
+            for key, events in sel.select(timeout=0.5):
+                if key.data == "accept":
+                    self._accept()
+                elif key.data == "wake":
+                    try:
+                        os.read(self._wake_r, 4096)
+                    except OSError:
+                        pass
+                    self._drain_done()
+                else:
+                    self._service(key.data, key.fileobj, events)
+        # teardown: close every connection socket
+        for key in list(self.sel.get_map().values()):
+            if isinstance(key.data, _ConnState):
+                try:
+                    key.fileobj.close()
+                except OSError:
+                    pass
+
+    def _accept(self) -> None:
+        while True:
+            try:
+                sock, _peer = self._listener.accept()
+            except (BlockingIOError, OSError):
+                return
+            sock.setblocking(False)
+            st = _ConnState(sock)
+            self.sel.register(sock, selectors.EVENT_READ, st)
+
+    def _close(self, st: _ConnState) -> None:
+        if st.dead:
+            return
+        st.dead = True
+        try:
+            self.sel.unregister(st.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            st.sock.close()
+        except OSError:
+            pass
+
+    def _service(self, st: _ConnState, sock, events) -> None:
+        if events & selectors.EVENT_READ:
+            try:
+                while True:
+                    chunk = sock.recv(64 << 10)
+                    if chunk == b"":
+                        # half-close: a legal HTTP pattern — the client
+                        # sent its request and shut down its write
+                        # side; serve the in-flight response first
+                        st.read_eof = True
+                        if not (st.busy or st.outbuf or st.inbuf):
+                            self._close(st)
+                            return
+                        st.close_after = True
+                        break
+                    st.inbuf += chunk
+                    if len(st.inbuf) > _MAX_HEADER + _MAX_BODY:
+                        # bytes buffered past any legal frame (incl.
+                        # data streamed while a request is in flight):
+                        # memory-exhaustion guard
+                        self._close(st)
+                        return
+                    if len(chunk) < (64 << 10):
+                        break
+            except (BlockingIOError, InterruptedError):
+                pass
+            except OSError:
+                self._close(st)
+                return
+            self._maybe_parse(st)
+        if events & selectors.EVENT_WRITE:
+            self._flush(st)
+
+    def _maybe_parse(self, st: _ConnState) -> None:
+        """Frame one request off the input buffer and hand it to the
+        workers; one in flight per connection."""
+        if st.busy or st.dead:
+            return
+        head_end = st.inbuf.find(b"\r\n\r\n")
+        if head_end < 0:
+            if len(st.inbuf) > _MAX_HEADER:
+                self._bad(st, 400, close=True)
+            return
+        head = bytes(st.inbuf[:head_end]).decode("latin-1")
+        lines = head.split("\r\n")
+        try:
+            method, target, _ver = lines[0].split(" ", 2)
+        except ValueError:
+            self._bad(st, 400, close=True)
+            return
+        headers = CIMap()
+        for line in lines[1:]:
+            if ":" in line:
+                k, v = line.split(":", 1)
+                headers[k.strip()] = v.strip()
+        if headers.get("Transfer-Encoding"):
+            self._bad(st, 501, close=True)   # no chunked TE (SigV4
+            return                           # clients send lengths)
+        try:
+            length = int(headers.get("Content-Length") or 0)
+        except ValueError:
+            self._bad(st, 400, close=True)   # malformed, not missing
+            return
+        if length > _MAX_BODY:
+            self._bad(st, 400, close=True)
+            return
+        total = head_end + 4 + length
+        if len(st.inbuf) < total:
+            if "100-continue" in (headers.get("Expect", "")
+                                  .lower()) and not st.sent_100:
+                # the client waits for the interim before sending the
+                # body (boto3/curl PUTs) — BaseHTTPRequestHandler sent
+                # this automatically and so must we
+                st.sent_100 = True
+                st.outbuf += b"HTTP/1.1 100 Continue\r\n\r\n"
+                self._want_write(st)
+            return    # body still arriving
+        body = bytes(st.inbuf[head_end + 4:total])
+        del st.inbuf[:total]
+        st.busy = True
+        st.close_after = (headers.get("Connection", "")
+                          .lower() == "close")
+        self._work_q.put((st, HttpRequest(method, target, headers,
+                                          body)))
+
+    def _bad(self, st: _ConnState, status: int,
+             close: bool = False) -> None:
+        st.outbuf += self._render(status, {}, b"")
+        st.close_after = st.close_after or close
+        st.inbuf.clear()
+        self._want_write(st)
+
+    # -- workers --------------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            item = self._work_q.get()
+            if item is None:
+                return
+            st, req = item
+            try:
+                status, headers, body = self.handler(req)
+            except Exception:   # the handler layer catches its own;
+                status, headers, body = 500, {}, b""   # belt only
+            self._done_q.put((st, req, status, headers, body))
+            os.write(self._wake_w, b"x")
+
+    def _drain_done(self) -> None:
+        while True:
+            try:
+                st, req, status, headers, body = \
+                    self._done_q.get_nowait()
+            except queue.Empty:
+                return
+            if st.dead:
+                continue
+            st.outbuf += self._render(status, headers, body)
+            st.busy = False
+            st.sent_100 = False
+            self._want_write(st)
+            # a pipelined next request may already be buffered
+            self._maybe_parse(st)
+
+    # -- writes (loop thread only) --------------------------------------------
+
+    def _render(self, status: int, headers: dict,
+                body: bytes) -> bytes:
+        reason = self.REASONS.get(status, "OK")
+        out = [f"HTTP/1.1 {status} {reason}"]
+        hdrs = dict(headers)
+        hdrs.setdefault("Content-Length", str(len(body)))
+        for k, v in hdrs.items():
+            out.append(f"{k}: {v}")
+        return ("\r\n".join(out) + "\r\n\r\n").encode("latin-1") + body
+
+    def _want_write(self, st: _ConnState) -> None:
+        self._flush(st)
+        if st.dead:
+            return
+        want = selectors.EVENT_READ
+        if st.outbuf:
+            want |= selectors.EVENT_WRITE
+        try:
+            self.sel.modify(st.sock, want, st)
+        except (KeyError, ValueError):
+            pass
+
+    def _flush(self, st: _ConnState) -> None:
+        while st.outbuf:
+            try:
+                n = st.sock.send(bytes(st.outbuf[:256 << 10]))
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self._close(st)
+                return
+            del st.outbuf[:n]
+        if not st.outbuf:
+            if st.close_after:
+                self._close(st)
+                return
+            try:
+                self.sel.modify(st.sock, selectors.EVENT_READ, st)
+            except (KeyError, ValueError):
+                pass

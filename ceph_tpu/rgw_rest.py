@@ -7,7 +7,9 @@ src/rgw/rgw_asio_frontend.cc) that parses S3's REST dialect
 (src/rgw/rgw_rados.cc).  This module is that surface over the rgw_lite
 storage mapping, sized to the repo:
 
-* stdlib ThreadingHTTPServer frontend (the asio/civetweb analog)
+* event-driven HTTP frontend (rgw_frontend.AsyncHttpFrontend — the
+  asio/beast analog: one I/O loop owning the sockets, a bounded
+  handler pool doing the RADOS work)
 * AWS Signature V4: full canonical-request -> string-to-sign -> derived
   signing key verification (UNSIGNED-PAYLOAD and sha256 payloads), with
   access keys provisioned against the cluster's auth key material
@@ -33,7 +35,7 @@ import re
 import threading
 import time
 import urllib.parse
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from ceph_tpu.rgw_frontend import AsyncHttpFrontend
 
 from ceph_tpu.rgw_lite import Bucket
 
@@ -655,12 +657,29 @@ class S3Gateway:
                 pass
 
 
-class _Handler(BaseHTTPRequestHandler):
-    protocol_version = "HTTP/1.1"
-    server_version = "ceph-tpu-rgw/1.0"
+class _S3Request:
+    """One request's routing context, transport-neutral: the async
+    frontend (rgw_frontend) hands it an HttpRequest on a worker thread
+    and takes back (status, headers, body).  The surface the routing
+    methods use — command/path/headers/rfile/_respond — matches the
+    old BaseHTTPRequestHandler shape, so the S3 dialect is unchanged."""
 
-    def log_message(self, fmt, *args):   # quiet
-        pass
+    def __init__(self, server: "RgwRestServer", req) -> None:
+        import io
+        import types
+        self.server = types.SimpleNamespace(rgw=server)
+        self.command = req.method
+        self.path = req.target
+        self.headers = req.headers
+        self.rfile = io.BytesIO(req.body)
+        self._out: tuple[int, dict, bytes] | None = None
+
+    def handle(self) -> tuple[int, dict, bytes]:
+        self._dispatch()
+        if self._out is None:   # a route returned without responding
+            self._out = (500, {"Content-Type": "application/xml"},
+                         _error_xml("InternalError", "no response"))
+        return self._out
 
     # -- auth ----------------------------------------------------------------
 
@@ -721,15 +740,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _respond(self, status: int, body: bytes = b"",
                  headers: dict | None = None) -> None:
-        self.send_response(status)
         merged = dict(self._cors_hdrs or {})
         merged.update(headers or {})
-        for k, v in merged.items():
-            self.send_header(k, v)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        if body and self.command != "HEAD":
-            self.wfile.write(body)
+        # HEAD: length of the real body, no bytes (RFC 9110)
+        merged["Content-Length"] = str(len(body))
+        self._out = (status, merged,
+                     b"" if self.command == "HEAD" else body)
 
     def _dispatch(self) -> None:
         gw: S3Gateway = self.server.rgw.gateway     # type: ignore
@@ -751,9 +767,6 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:   # pragma: no cover
             self._respond(500, _error_xml("InternalError", repr(e)),
                           {"Content-Type": "application/xml"})
-
-    do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _dispatch
-    do_OPTIONS = _dispatch
 
     # -- routing -------------------------------------------------------------
 
@@ -1289,15 +1302,15 @@ class RgwRestServer:
         self.lc_interval = lc_interval
         self._lc_stop = threading.Event()
         self._lc_thread: threading.Thread | None = None
-        host, port = addr.rsplit(":", 1)
-        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
-        self._httpd.rgw = self          # type: ignore
-        self._thread: threading.Thread | None = None
+        #: event-driven frontend (rgw_asio_frontend analog): one I/O
+        #: loop owning the sockets + a bounded handler pool, replacing
+        #: the old thread-per-connection stdlib server
+        self._frontend = AsyncHttpFrontend(
+            lambda req: _S3Request(self, req).handle(), addr)
 
     @property
     def addr(self) -> str:
-        h, p = self._httpd.server_address[:2]
-        return f"{h}:{p}"
+        return self._frontend.addr
 
     def add_key(self, access: str, secret: str) -> None:
         self.keys[access] = secret
@@ -1313,9 +1326,7 @@ class RgwRestServer:
         return access, secret
 
     def start(self) -> "RgwRestServer":
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="rgw-http", daemon=True)
-        self._thread.start()
+        self._frontend.start()
         if self.lc_interval:
             self._lc_thread = threading.Thread(
                 target=self._lc_loop, name="rgw-lc", daemon=True)
@@ -1333,7 +1344,4 @@ class RgwRestServer:
         self._lc_stop.set()
         if self._lc_thread is not None:
             self._lc_thread.join(timeout=5)
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        if self._thread:
-            self._thread.join(timeout=5)
+        self._frontend.stop()

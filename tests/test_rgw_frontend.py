@@ -1,0 +1,111 @@
+"""Event-driven RGW HTTP frontend (rgw_asio_frontend.cc analog): one
+I/O loop + bounded handler pool instead of thread-per-connection —
+keep-alive reuse, many concurrent connections, pipelined requests
+sequenced per connection, and protocol edge refusals."""
+
+from __future__ import annotations
+
+import http.client
+import socket
+import threading
+
+import pytest
+
+from ceph_tpu.rgw_frontend import AsyncHttpFrontend, CIMap
+from ceph_tpu.rgw_rest import RgwRestServer
+from ceph_tpu.tools.vstart import MiniCluster
+
+
+def test_cimap_case_insensitive():
+    m = CIMap([("Content-Length", "5"), ("X-Amz-Date", "d")])
+    assert m.get("content-length") == "5"
+    assert m.get("X-AMZ-DATE") == "d"
+    assert "x-amz-date" in m
+    m["content-LENGTH"] = "9"
+    assert m.get("Content-Length") == "9"
+    assert len(m) == 2          # replaced, not duplicated
+
+
+def test_frontend_echo_keepalive_and_concurrency():
+    seen = []
+
+    def handler(req):
+        seen.append(req.method)
+        return 200, {"X-Echo": req.headers.get("X-Ping", "")}, req.body
+
+    f = AsyncHttpFrontend(handler, "127.0.0.1:0", workers=4).start()
+    try:
+        host, port = f.addr.rsplit(":", 1)
+        # keep-alive: three requests over ONE connection
+        conn = http.client.HTTPConnection(host, int(port), timeout=10)
+        for i in range(3):
+            conn.request("POST", "/x", body=f"b{i}".encode(),
+                         headers={"X-Ping": str(i)})
+            r = conn.getresponse()
+            assert r.status == 200
+            assert r.getheader("X-Echo") == str(i)
+            assert r.read() == f"b{i}".encode()
+        conn.close()
+        # concurrency: 16 parallel connections through 4 workers
+        errs = []
+
+        def one(i):
+            try:
+                c = http.client.HTTPConnection(host, int(port),
+                                               timeout=20)
+                c.request("PUT", "/y", body=b"z" * 10000)
+                r = c.getresponse()
+                assert r.status == 200 and r.read() == b"z" * 10000
+                c.close()
+            except Exception as e:   # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=one, args=(i,))
+              for i in range(16)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(20)
+        assert not errs, errs
+        # chunked transfer-encoding refused (SigV4 clients send lengths)
+        raw = socket.create_connection((host, int(port)), timeout=10)
+        raw.sendall(b"PUT /c HTTP/1.1\r\nHost: x\r\n"
+                    b"Transfer-Encoding: chunked\r\n\r\n")
+        assert b" 501 " in raw.recv(4096)
+        raw.close()
+        # garbage request line refused, connection closed
+        raw = socket.create_connection((host, int(port)), timeout=10)
+        raw.sendall(b"NONSENSE\r\n\r\n")
+        assert b" 400 " in raw.recv(4096)
+        raw.close()
+    finally:
+        f.stop()
+
+
+def test_s3_over_async_frontend_e2e():
+    """The full S3 dialect rides the async frontend (already covered
+    broadly by the rgw suites; this pins HEAD semantics + keep-alive
+    through the real gateway)."""
+    c = MiniCluster(n_osds=3, ms_type="loopback").start()
+    try:
+        c.wait_for_osd_count(3)
+        client = c.client(timeout=20.0)
+        pool = c.create_pool(client, pg_num=4, size=2)
+        srv = RgwRestServer(client.open_ioctx(pool),
+                            max_skew=None).start()
+        try:
+            from test_rgw_versioning import S3Client
+            srv.add_key("k", "s")
+            s3 = S3Client(srv.addr, "k", "s")
+            assert s3.request("PUT", "/fb")[0] == 200
+            st, _b, _h = s3.request("PUT", "/fb/o", body=b"0123456789")
+            assert st == 200
+            # HEAD: status 200, no body, real length advertised
+            st, body, hdrs = s3.request("HEAD", "/fb/o")
+            assert st == 200 and body == b""
+            st, body, _ = s3.request("GET", "/fb/o")
+            assert st == 200 and body == b"0123456789"
+        finally:
+            srv.shutdown()
+    finally:
+        c.stop()
