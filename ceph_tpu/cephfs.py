@@ -39,6 +39,7 @@ from ceph_tpu.client.rados import RadosClient
 from ceph_tpu.mds.caps import BUFFER, CACHE, WANT_READ, WANT_WRITE, WR
 from ceph_tpu.mds.flock import F_RDLCK, F_UNLCK, F_WRLCK
 from ceph_tpu.mds.server import (
+    MClientLease,
     MClientCaps, MClientReply, MClientRequest, MClientSession)
 from ceph_tpu.msg.messenger import (
     ConnectionPolicy, Dispatcher, EntityName, Messenger)
@@ -117,6 +118,11 @@ class CephFS(Dispatcher):
         self._next_fh = 1
         #: last known ino per opened path (open-timeout cancel guard)
         self._path_ino: dict[str, int] = {}
+        #: leased dentry cache (Client.cc dcache): normpath ->
+        #: (expiry, inode dict).  Served by stat() without an MDS
+        #: round-trip; dropped on MClientLease revokes, on our own
+        #: mutations, and at expiry
+        self._lease_cache: dict[str, tuple[float, dict]] = {}
         #: highest cap seq processed per ino — survives missing cap
         #: state, so an open reply racing an already-processed revoke
         #: never reinstalls the stale (higher) grant
@@ -251,6 +257,13 @@ class CephFS(Dispatcher):
 
     def _renew(self) -> None:
         try:
+            now = time.time()
+            with self._lock:
+                # sweep expired lease entries (a tree walk statting
+                # each dir once would otherwise grow the cache forever)
+                for k in [k for k, (exp, _i) in
+                          self._lease_cache.items() if exp <= now]:
+                    del self._lease_cache[k]
             for rank in list(self._have_session):
                 try:
                     con = self.msgr.connect_to(self._addr_of(rank),
@@ -293,6 +306,12 @@ class CephFS(Dispatcher):
             return True
         if isinstance(msg, MClientCaps):
             self._handle_caps(msg)
+            return True
+        if isinstance(msg, MClientLease):
+            if msg.op == "revoke":
+                # a mutation (or new writer) voided the dentry: drop
+                # the cached entry and any descendants cached under it
+                self._lease_drop(msg.path, prefix=True)
             return True
         return False
 
@@ -565,8 +584,39 @@ class CephFS(Dispatcher):
     def listdir(self, path: str) -> dict:
         return self._request("readdir", {"path": path})["entries"]
 
+    def _lease_get(self, norm: str) -> dict | None:
+        with self._lock:
+            ent = self._lease_cache.get(norm)
+            if ent is None:
+                return None
+            if ent[0] < time.time():
+                del self._lease_cache[norm]
+                return None
+            return dict(ent[1])
+
+    def _lease_drop(self, path: str, prefix: bool = False) -> None:
+        norm = self._normpath(path)
+        with self._lock:
+            self._lease_cache.pop(norm, None)
+            if prefix:
+                # a directory moved/vanished: every cached descendant
+                # path string is void
+                pre = norm.rstrip("/") + "/"
+                for k in [k for k in self._lease_cache
+                          if k.startswith(pre)]:
+                    del self._lease_cache[k]
+
     def stat(self, path: str) -> dict:
-        inode = self._request("lookup", {"path": path})["inode"]
+        norm = self._normpath(path)
+        inode = self._lease_get(norm)
+        if inode is None:
+            out = self._request("lookup", {"path": path})
+            inode = out["inode"]
+            ttl = out.get("lease", 0)
+            if ttl:
+                with self._lock:
+                    self._lease_cache[norm] = (time.time() + ttl,
+                                               dict(inode))
         # our OWN buffered size is more recent than the MDS's answer
         # (the MDS only recalls OTHER clients' buffers for a stat)
         with self._lock:
@@ -577,6 +627,7 @@ class CephFS(Dispatcher):
         return inode
 
     def unlink(self, path: str) -> None:
+        self._lease_drop(path)
         out = self._request("unlink", {"path": path})
         if not out.get("removed", True):
             return   # hardlinks remain: the inode (and data) live on
@@ -591,12 +642,17 @@ class CephFS(Dispatcher):
     def link(self, src: str, dst: str) -> dict:
         """Hardlink: a second name for an existing file (POSIX link(2);
         MDS-side remote dentries).  Returns the inode (nlink bumped)."""
+        self._lease_drop(src)    # nlink changed
+        self._lease_drop(dst)
         return self._request("link", {"src": src, "dst": dst})["inode"]
 
     def rmdir(self, path: str) -> None:
+        self._lease_drop(path, prefix=True)
         self._request("rmdir", {"path": path})
 
     def rename(self, src: str, dst: str) -> None:
+        self._lease_drop(src, prefix=True)
+        self._lease_drop(dst, prefix=True)
         self._request("rename", {"src": src, "dst": dst})
 
     def export_dir(self, path: str, to_rank: int) -> dict:
@@ -635,6 +691,7 @@ class CephFS(Dispatcher):
         Enforcement is MDS-side at create and size-report time, so
         buffered writers can overshoot until their flush — the same
         approximate enforcement the reference documents."""
+        self._lease_drop(path)   # our own cached attrs are stale now
         self._request("setquota", {"path": path, "max_bytes": max_bytes,
                                    "max_files": max_files})
 

@@ -71,6 +71,9 @@ register_options([
     Option("crush_backend", OPT_STR, "tpu",
            "bulk placement backend: tpu (BatchMapper) | scalar"),
     Option("osd_pool_default_size", OPT_INT, 3, "replicas per object"),
+    Option("mds_dentry_lease_ttl", OPT_FLOAT, 10.0,
+           "seconds a client may trust a leased dentry+attrs without "
+           "re-asking the MDS (client dcache, MClientLease analog)"),
     Option("osd_pool_default_min_size", OPT_INT, 2,
            "min replicas to serve IO"),
     Option("osd_pool_default_pg_num", OPT_INT, 32, "pgs per new pool"),

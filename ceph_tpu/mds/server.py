@@ -171,6 +171,32 @@ class MClientCaps(Message):
 
 
 @register_message
+class MClientLease(Message):
+    """mds -> client dentry-lease traffic (CEPH_MSG_CLIENT_LEASE=0x311,
+    messages/MClientLease.h reduced): op 'revoke' tells the client its
+    cached dentry+attrs for `path` are void (a mutation touched the
+    name, or a writer opened the file).  Fire-and-forget — the lease's
+    TTL is the backstop, which is what makes it a LEASE."""
+
+    TYPE = 0x311
+
+    def __init__(self, op: str = "revoke", path: str = ""):
+        super().__init__()
+        self.op = op
+        self.path = path
+
+    def encode_payload(self, enc: Encoder):
+        enc.versioned(1, 1, lambda e: (e.str(self.op),
+                                       e.str(self.path)))
+
+    def decode_payload(self, dec: Decoder, version: int):
+        def body(d, v):
+            self.op = d.str()
+            self.path = d.str()
+        dec.versioned(1, body)
+
+
+@register_message
 class MMDSExport(Message):
     """mds -> mds subtree handoff (Migrator MExportDir reduced): the
     exporter has flushed everything and committed the new authority in
@@ -308,6 +334,10 @@ class MDSDaemon(Dispatcher):
         self._parked: dict[int, list] = {}
         #: (ino, client) -> send time of the oldest un-acked revoke
         self._revoke_sent: dict[tuple[int, int], float] = {}
+        #: (parent_ino, name) -> {client: lease expiry} — dentry leases
+        #: granted to lookups on quiescent inodes (client dcache;
+        #: mutations + writer-opens revoke, TTL is the backstop)
+        self._dentry_leases: dict[tuple[int, str], dict[int, float]] = {}
         #: osdmap epoch every WR-cap holder must reach before direct
         #: data writes (bumped by mksnap; rides cap grants and open
         #: replies — the reference's Locker osd_epoch_barrier)
@@ -485,6 +515,15 @@ class MDSDaemon(Dispatcher):
         try:
             now = time.time()
             with self._lock:
+                # prune expired/empty lease rows: without a sweep the
+                # table grows one row per dentry ever looked up
+                for key in list(self._dentry_leases):
+                    holders = self._dentry_leases[key]
+                    for c in [c for c, exp in holders.items()
+                              if exp <= now]:
+                        del holders[c]
+                    if not holders:
+                        del self._dentry_leases[key]
                 if self._reconnect_until and now >= self._reconnect_until:
                     self._reconnect_until = 0.0
                     self._rerun(0)
@@ -1218,6 +1257,10 @@ class MDSDaemon(Dispatcher):
         _p, root_ino, _n = self._resolve(path)
         if root_ino is None:
             return -2, {}
+        # leases are RANK-LOCAL state: the importer cannot revoke what
+        # it never granted, so void them (clients re-lease from the
+        # new authority on their next lookup)
+        self._revoke_lease_subtree(root_ino)
         inode = self._load_inode(root_ino)
         if inode is None or not inode.is_dir():
             return -20, {}
@@ -1490,6 +1533,64 @@ class MDSDaemon(Dispatcher):
         s["con"].send_message(m)
         return True
 
+    def _revoke_dentry_lease(self, parent: int, name: str,
+                             exclude: int | None = None) -> None:
+        """Void every client's lease on one dentry (fire-and-forget +
+        TTL backstop — lease semantics, MClientLease revoke)."""
+        holders = self._dentry_leases.pop((parent, name), None)
+        if not holders:
+            return
+        # revoke even "expired" holders: the client stamps its expiry
+        # at REPLY-receipt time, later than our grant stamp — filtering
+        # by our clock would skip a revoke the client still needs
+        live = [c for c in holders if c != exclude]
+        if not live:
+            return
+        ppath = self._ino_path(parent)
+        if ppath is None:
+            return
+        path = ppath.rstrip("/") + "/" + name
+        for c in live:
+            s = self._sessions.get(c)
+            if s is not None:
+                s["con"].send_message(MClientLease(op="revoke",
+                                                   path=path))
+
+    def _revoke_lease_subtree(self, root_ino: int) -> None:
+        """Void every lease whose dentry lives UNDER root_ino (dir
+        rename moves every descendant path; subtree export moves
+        authority away from this rank's lease table) — walk each leased
+        parent's backpointer chain to test membership."""
+        for (p, n) in list(self._dentry_leases):
+            cur = p
+            for _ in range(64):
+                if cur == root_ino:
+                    self._revoke_dentry_lease(p, n)
+                    break
+                node = self._inodes.get(cur) or self._load_inode(cur)
+                if node is None or not node.parent or cur == ROOT_INO:
+                    break
+                cur = node.parent
+
+    def _revoke_ino_leases(self, ino: int,
+                           exclude: int | None = None) -> None:
+        """Void leases on EVERY dentry of an inode (attr change, or a
+        writer just got WR: cached stats would go stale)."""
+        inode = self._inodes.get(ino) or self._load_inode(ino)
+        if inode is None or not inode.is_dir():
+            # only DIRECTORY dentries are ever leased: skip the parent
+            # dirfrag scan on the file setattr hot path (buffered-size
+            # writebacks land here for every flush)
+            return
+        dentries = list(inode.remote_links)
+        parent = inode.parent
+        if parent:
+            for n, child in self._load_dir(parent).items():
+                if child == ino:
+                    dentries.append([parent, n])
+        for p, n in dentries:
+            self._revoke_dentry_lease(int(p), n, exclude=exclude)
+
     def _issue_revokes(self, ino: int, revokes) -> None:
         now = time.time()
         for client, new_caps, seq in revokes:
@@ -1591,6 +1692,7 @@ class MDSDaemon(Dispatcher):
             qi = self._load_inode(qino)
             if qi is None or not qi.is_dir():
                 return -20, {}
+            self._revoke_ino_leases(qino, exclude=client)
             self._mutate({"e": "setattr", "ino": qino,
                           "quota_bytes": int(a.get("max_bytes", 0)),
                           "quota_files": int(a.get("max_files", 0))})
@@ -1652,7 +1754,7 @@ class MDSDaemon(Dispatcher):
             return 0, {}
 
         if op == "lookup":
-            parent, ino, _name = self._resolve(a["path"])
+            parent, ino, name = self._resolve(a["path"])
             if ino is None:
                 return -2, {}
             inode = self._load_inode(ino)
@@ -1663,7 +1765,22 @@ class MDSDaemon(Dispatcher):
                 # writers first (parks until their acks land)
                 self._fresh_inode(ino, requester=client)
                 inode = self._load_inode(ino)
-            return 0, {"inode": inode.to_dict()}
+            out = {"inode": inode.to_dict()}
+            # dentry lease (Locker::issue_client_lease, reduced to the
+            # coherent subset): DIRECTORY dentries+attrs only.  A file
+            # lease would have to exclude size/mtime — those are cap
+            # (Fs) territory, and a leased file stat racing a writer's
+            # open would miss its sizes; directory attrs here change
+            # only through ops that revoke (rename/rmdir/setattr), so
+            # dir leases are coherent by construction
+            if parent is not None and name and client >= 0 \
+                    and inode.is_dir():
+                ttl = float(self.ctx.conf.get("mds_dentry_lease_ttl"))
+                if ttl > 0:
+                    self._dentry_leases.setdefault(
+                        (parent, name), {})[client] = time.time() + ttl
+                    out["lease"] = ttl
+            return 0, out
 
         if op == "getattr":
             inode = self._load_inode(a["ino"])
@@ -1831,6 +1948,7 @@ class MDSDaemon(Dispatcher):
             if inode is not None and inode.is_dir():
                 return -21, {}
             had_links = inode is not None and bool(inode.remote_links)
+            self._revoke_dentry_lease(parent, name)
             self._mutate({"e": "unlink", "parent": parent, "name": name,
                           "drop_inode": True})
             # no store re-read: with links the inode survived
@@ -1876,6 +1994,7 @@ class MDSDaemon(Dispatcher):
                 return -20, {}
             if self._load_dir(ino):
                 return -39, {}  # ENOTEMPTY
+            self._revoke_dentry_lease(parent, name)
             self._mutate({"e": "unlink", "parent": parent, "name": name,
                           "drop_inode": True})
             norm = self._norm(a["path"])
@@ -1902,6 +2021,11 @@ class MDSDaemon(Dispatcher):
             s_inode = self._load_inode(sino)
             remote = (s_inode is not None
                       and [sp, sname] in s_inode.remote_links)
+            self._revoke_dentry_lease(sp, sname)
+            self._revoke_dentry_lease(dp, dname)
+            if s_inode is not None and s_inode.is_dir():
+                # every descendant's cached PATH string moved with it
+                self._revoke_lease_subtree(sino)
             self._mutate({"e": "batch", "events": [
                 {"e": "link", "parent": dp, "name": dname, "ino": sino,
                  **({"remote": True} if remote else {})},
@@ -1909,6 +2033,7 @@ class MDSDaemon(Dispatcher):
             return 0, {"ino": sino}
 
         if op == "setattr":
+            self._revoke_ino_leases(int(a["ino"]), exclude=client)
             ev = {"e": "setattr", "ino": a["ino"]}
             for k in ("size", "mtime", "mode", "grow"):
                 if k in a:

@@ -1,0 +1,180 @@
+"""CephFS dentry leases (MClientLease.h + Client.cc dcache, reduced to
+the coherent directory subset): a leased dir stat serves from the
+client cache without an MDS round-trip; rename/rmdir/setattr revoke
+across clients; TTL is the backstop."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ceph_tpu.cephfs import CephFS
+from ceph_tpu.tools.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osds=3, ms_type="loopback").start()
+    c.wait_for_osd_count(3)
+    client = c.client(timeout=20.0)
+    meta = c.create_pool(client, pg_num=4, size=2)
+    data = c.create_pool(client, pg_num=8, size=2)
+    c.run_mds(meta, data)
+    yield c
+    c.stop()
+
+
+@pytest.fixture
+def fs(cluster):
+    f = CephFS(cluster.mon_host, cluster.mds.addr, ms_type="loopback")
+    f.mount()
+    yield f
+    f.unmount()
+
+
+def _count_requests(fs):
+    """Wrap fs._request with a counter (restores on the returned
+    callable)."""
+    counter = {"n": 0}
+    real = fs._request
+
+    def counted(op, args, **kw):
+        counter["n"] += 1
+        return real(op, args, **kw)
+
+    fs._request = counted
+    return counter, lambda: setattr(fs, "_request", real)
+
+
+def test_dir_stat_served_from_lease_cache(fs):
+    fs.mkdir("/cachetop")
+    fs.mkdir("/cachetop/proj")
+    st1 = fs.stat("/cachetop/proj")      # populates the lease
+    counter, restore = _count_requests(fs)
+    try:
+        for _ in range(5):
+            st = fs.stat("/cachetop/proj")
+            assert st["ino"] == st1["ino"]
+        assert counter["n"] == 0, "leased dir stat hit the MDS"
+    finally:
+        restore()
+    # files are NOT leased (size/mtime are cap territory): every file
+    # stat round-trips
+    with fs.open("/cachetop/f", "w") as f:
+        f.write(b"x")
+    fs.stat("/cachetop/f")
+    counter, restore = _count_requests(fs)
+    try:
+        fs.stat("/cachetop/f")
+        assert counter["n"] == 1
+    finally:
+        restore()
+
+
+def test_lease_revoked_across_clients_on_mutation(cluster, fs):
+    fs2 = CephFS(cluster.mon_host, cluster.mds.addr,
+                 ms_type="loopback", client_id=777)
+    fs2.mount()
+    try:
+        fs.mkdir("/shared-d")
+        assert fs2.stat("/shared-d")["ino"] > 0   # fs2 caches it
+        assert "/shared-d" in fs2._lease_cache
+        # fs renames the dir: fs2's lease must be revoked — its next
+        # stat sees the new world (bounded by revoke delivery; poll
+        # within a fraction of the 10s TTL to prove it was the revoke)
+        fs.rename("/shared-d", "/shared-e")
+        deadline = time.time() + 3.0
+        gone = False
+        while time.time() < deadline:
+            try:
+                fs2.stat("/shared-d")
+            except OSError:
+                gone = True
+                break
+            time.sleep(0.05)
+        assert gone, "stale dir lease survived a rename"
+        assert fs2.stat("/shared-e")["ino"] > 0
+        # rmdir revokes too
+        assert fs2.stat("/shared-e")    # re-cache
+        fs.rmdir("/shared-e")
+        deadline = time.time() + 3.0
+        gone = False
+        while time.time() < deadline:
+            try:
+                fs2.stat("/shared-e")
+            except OSError:
+                gone = True
+                break
+            time.sleep(0.05)
+        assert gone, "stale dir lease survived rmdir"
+    finally:
+        fs2.unmount()
+
+
+def test_dir_rename_revokes_descendant_leases(cluster, fs):
+    """Renaming a directory moves every descendant PATH: leases cached
+    under the old prefix (on OTHER dentries inside the subtree) must
+    revoke, not just the renamed dentry's own."""
+    fs2 = CephFS(cluster.mon_host, cluster.mds.addr,
+                 ms_type="loopback", client_id=779)
+    fs2.mount()
+    try:
+        fs.mkdir("/tree")
+        fs.mkdir("/tree/sub")
+        fs.mkdir("/tree/sub/leaf")
+        # fs2 leases the DESCENDANT, not /tree itself
+        assert fs2.stat("/tree/sub/leaf")["ino"] > 0
+        assert "/tree/sub/leaf" in fs2._lease_cache
+        fs.rename("/tree", "/forest")
+        deadline = time.time() + 3.0
+        gone = False
+        while time.time() < deadline:
+            try:
+                fs2.stat("/tree/sub/leaf")
+            except OSError:
+                gone = True
+                break
+            time.sleep(0.05)
+        assert gone, "descendant lease survived the dir rename"
+        assert fs2.stat("/forest/sub/leaf")["ino"] > 0
+    finally:
+        fs2.unmount()
+
+
+def test_quota_setattr_revokes_dir_lease(cluster, fs):
+    fs2 = CephFS(cluster.mon_host, cluster.mds.addr,
+                 ms_type="loopback", client_id=778)
+    fs2.mount()
+    try:
+        fs.mkdir("/qd")
+        st = fs2.stat("/qd")
+        assert not st.get("quota_bytes")
+        fs.set_quota("/qd", max_bytes=1 << 20)
+        deadline = time.time() + 3.0
+        ok = False
+        while time.time() < deadline:
+            if fs2.stat("/qd").get("quota_bytes") == 1 << 20:
+                ok = True
+                break
+            time.sleep(0.05)
+        assert ok, "stale dir lease survived a quota setattr"
+    finally:
+        fs2.unmount()
+
+
+def test_lease_ttl_expiry(cluster, fs):
+    cluster.mds.ctx.conf.set("mds_dentry_lease_ttl", "0.3")
+    try:
+        fs.mkdir("/ttl-d")
+        fs.stat("/ttl-d")
+        assert "/ttl-d" in fs._lease_cache
+        time.sleep(0.4)
+        counter, restore = _count_requests(fs)
+        try:
+            fs.stat("/ttl-d")
+            assert counter["n"] == 1, "expired lease served"
+        finally:
+            restore()
+    finally:
+        cluster.mds.ctx.conf.set("mds_dentry_lease_ttl", "10.0")
