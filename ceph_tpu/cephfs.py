@@ -123,6 +123,10 @@ class CephFS(Dispatcher):
         #: round-trip; dropped on MClientLease revokes, on our own
         #: mutations, and at expiry
         self._lease_cache: dict[str, tuple[float, dict]] = {}
+        #: path -> time of its last revoke/drop: a lookup REPLY that
+        #: raced an already-processed revoke must not reinstall the
+        #: lease (the _cap_seq_seen idea, per path)
+        self._lease_dropped_at: dict[str, float] = {}
         #: highest cap seq processed per ino — survives missing cap
         #: state, so an open reply racing an already-processed revoke
         #: never reinstalls the stale (higher) grant
@@ -264,6 +268,10 @@ class CephFS(Dispatcher):
                 for k in [k for k, (exp, _i) in
                           self._lease_cache.items() if exp <= now]:
                     del self._lease_cache[k]
+                for k in [k for k, t in
+                          self._lease_dropped_at.items()
+                          if now - t > 60.0]:
+                    del self._lease_dropped_at[k]
             for rank in list(self._have_session):
                 try:
                     con = self.msgr.connect_to(self._addr_of(rank),
@@ -596,8 +604,10 @@ class CephFS(Dispatcher):
 
     def _lease_drop(self, path: str, prefix: bool = False) -> None:
         norm = self._normpath(path)
+        now = time.time()
         with self._lock:
             self._lease_cache.pop(norm, None)
+            self._lease_dropped_at[norm] = now
             if prefix:
                 # a directory moved/vanished: every cached descendant
                 # path string is void
@@ -605,18 +615,22 @@ class CephFS(Dispatcher):
                 for k in [k for k in self._lease_cache
                           if k.startswith(pre)]:
                     del self._lease_cache[k]
+                    self._lease_dropped_at[k] = now
 
     def stat(self, path: str) -> dict:
         norm = self._normpath(path)
         inode = self._lease_get(norm)
         if inode is None:
+            t0 = time.time()
             out = self._request("lookup", {"path": path})
             inode = out["inode"]
             ttl = out.get("lease", 0)
             if ttl:
                 with self._lock:
-                    self._lease_cache[norm] = (time.time() + ttl,
-                                               dict(inode))
+                    # install ONLY if no revoke landed since we asked
+                    if self._lease_dropped_at.get(norm, 0.0) < t0:
+                        self._lease_cache[norm] = (time.time() + ttl,
+                                                   dict(inode))
         # our OWN buffered size is more recent than the MDS's answer
         # (the MDS only recalls OTHER clients' buffers for a stat)
         with self._lock:

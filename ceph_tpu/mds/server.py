@@ -516,11 +516,14 @@ class MDSDaemon(Dispatcher):
             now = time.time()
             with self._lock:
                 # prune expired/empty lease rows: without a sweep the
-                # table grows one row per dentry ever looked up
+                # table grows one row per dentry ever looked up.  The
+                # 60s margin past our expiry stamp keeps holders
+                # revokable through the client's later reply-receipt
+                # expiry (see _revoke_dentry_lease)
                 for key in list(self._dentry_leases):
                     holders = self._dentry_leases[key]
                     for c in [c for c, exp in holders.items()
-                              if exp <= now]:
+                              if exp + 60.0 <= now]:
                         del holders[c]
                     if not holders:
                         del self._dentry_leases[key]
@@ -1259,8 +1262,10 @@ class MDSDaemon(Dispatcher):
             return -2, {}
         # leases are RANK-LOCAL state: the importer cannot revoke what
         # it never granted, so void them (clients re-lease from the
-        # new authority on their next lookup)
+        # new authority on their next lookup) — the subtree's AND the
+        # exported root's own dentry leases
         self._revoke_lease_subtree(root_ino)
+        self._revoke_ino_leases(root_ino)
         inode = self._load_inode(root_ino)
         if inode is None or not inode.is_dir():
             return -20, {}

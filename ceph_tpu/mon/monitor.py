@@ -474,6 +474,22 @@ class Monitor(Dispatcher):
         self._clog(PRIO_WARN if status != "HEALTH_OK" else PRIO_INFO,
                    "health %s -> %s (%s)", prev or "?", status, detail)
 
+    def _maybe_seed_mon_db(self) -> None:
+        """Self-healing monmap seed: bootstrap normally commits it, but
+        an OSD-boot mutation queued ahead of the bootstrap work item
+        can commit first, making bootstrap's last_committed guard skip
+        — the leader re-seeds from the static config whenever the map
+        lacks a monmap."""
+        if self.osdmap.mon_db or not self.monmap:
+            return
+        mons = {str(r): a for r, a in self.monmap.items()}
+
+        def fn(m: OSDMap):
+            if m.mon_db:
+                return False
+            m.mon_db = {"epoch": 1, "mons": mons}
+        self._work_q.put(("mgr_map", fn, None))
+
     _addr_fix_last = 0.0
 
     def _maybe_fix_my_addr(self) -> None:
@@ -708,6 +724,7 @@ class Monitor(Dispatcher):
                 self._maybe_rotate_service_keys()
                 self._check_mgr_map()
                 self._check_health_transition()
+                self._maybe_seed_mon_db()
             self._maybe_fix_my_addr()
         finally:
             self._schedule_tick()

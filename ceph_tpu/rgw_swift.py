@@ -25,10 +25,9 @@ import hashlib
 import hmac
 import json
 import os
-import threading
 import time
 import urllib.parse
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from ceph_tpu.rgw_frontend import AsyncHttpFrontend
 
 from ceph_tpu.rgw_rest import S3Error, S3Gateway
 
@@ -52,28 +51,23 @@ class SwiftRestServer:
         # a captured token must not let an attacker brute-force the key
         # offline and mint tokens for other accounts
         self._token_secret = os.urandom(32)
-        host, port = addr.rsplit(":", 1)
-        self._httpd = ThreadingHTTPServer((host, int(port)), _SwiftHandler)
-        self._httpd.swift = self           # type: ignore
-        self._thread: threading.Thread | None = None
+        #: the same event-driven frontend the S3 dialect rides
+        #: (rgw_frontend: one I/O loop + bounded handler pool)
+        self._frontend = AsyncHttpFrontend(
+            lambda req: _SwiftRequest(self, req).handle(), addr)
 
     # -- lifecycle ------------------------------------------------------------
 
     @property
     def addr(self) -> str:
-        h, p = self._httpd.server_address[:2]
-        return f"{h}:{p}"
+        return self._frontend.addr
 
     def start(self) -> "SwiftRestServer":
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="rgw-swift",
-            daemon=True)
-        self._thread.start()
+        self._frontend.start()
         return self
 
     def shutdown(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        self._frontend.stop()
 
     # -- accounts / tokens ----------------------------------------------------
 
@@ -107,29 +101,36 @@ class SwiftRestServer:
         return account
 
 
-class _SwiftHandler(BaseHTTPRequestHandler):
-    protocol_version = "HTTP/1.1"
-    server_version = "ceph-tpu-rgw-swift/1.0"
+class _SwiftRequest:
+    """One request's routing context over the async frontend (the same
+    transport-neutral shape as rgw_rest._S3Request)."""
 
-    def log_message(self, fmt, *args):
-        pass
+    def __init__(self, srv: "SwiftRestServer", req) -> None:
+        self._srv = srv
+        self.command = req.method
+        self.path = req.target
+        self.headers = req.headers
+        self._body = req.body
+        self._out: tuple[int, dict, bytes] | None = None
+
+    def handle(self) -> tuple[int, dict, bytes]:
+        self._dispatch()
+        if self._out is None:
+            self._out = (500, {}, b"no response")
+        return self._out
 
     # -- plumbing -------------------------------------------------------------
 
     def _respond(self, status: int, body: bytes = b"",
                  headers: dict | None = None) -> None:
-        self.send_response(status)
-        for k, v in (headers or {}).items():
-            self.send_header(k, v)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        if body and self.command != "HEAD":
-            self.wfile.write(body)
+        merged = dict(headers or {})
+        merged["Content-Length"] = str(len(body))
+        self._out = (status, merged,
+                     b"" if self.command == "HEAD" else body)
 
     def _dispatch(self) -> None:
-        srv: SwiftRestServer = self.server.swift     # type: ignore
-        length = int(self.headers.get("Content-Length") or 0)
-        body = self.rfile.read(length) if length else b""
+        srv = self._srv
+        body = self._body
         parsed = urllib.parse.urlsplit(self.path)
         q = dict(urllib.parse.parse_qsl(parsed.query,
                                         keep_blank_values=True))
@@ -160,8 +161,6 @@ class _SwiftHandler(BaseHTTPRequestHandler):
             return self._respond(code, str(e).encode())
         except Exception as e:   # pragma: no cover
             return self._respond(500, repr(e).encode())
-
-    do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _dispatch
 
     # -- auth -----------------------------------------------------------------
 

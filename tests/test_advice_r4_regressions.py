@@ -126,8 +126,8 @@ def test_swift_token_secret_is_random():
         assert len(a._token_secret) == 32
         assert a._token_secret != b._token_secret
     finally:
-        a._httpd.server_close()
-        b._httpd.server_close()
+        a._frontend.stop()     # closes listener, selector, wake pipe
+        b._frontend.stop()
 
 
 # -- empty bucket owner ----------------------------------------------------
