@@ -19,8 +19,8 @@ from ceph_tpu.messages.osd_msgs import (
     MWatchNotify, MWatchNotifyAck, OP_CALL, OP_NOTIFY, OP_UNWATCH,
     OP_WATCH)
 from ceph_tpu.messages.osd_msgs import (
-    OP_DELETE, OP_OMAP_GET, OP_OMAP_RMKEYS, OP_OMAP_SET, OP_READ,
-    OP_STAT, OP_WRITE, OP_WRITEFULL, OSDOpField)
+    OP_DELETE, OP_OMAP_GET, OP_OMAP_RMKEYS, OP_OMAP_SET, OP_PGLS,
+    OP_READ, OP_STAT, OP_WRITE, OP_WRITEFULL, OSDOpField)
 from ceph_tpu.mon.monitor import MMonSubscribe
 from ceph_tpu.msg.encoding import Decoder, Encoder
 from ceph_tpu.msg.messenger import (
@@ -90,8 +90,11 @@ def ceph_str_hash_rjenkins(s: bytes | str) -> int:
 
 class _Waiter:
     def __init__(self, msg: MOSDOp, base_pool: int, is_write: bool,
-                 direct: bool = False):
+                 direct: bool = False,
+                 fixed_pgid: tuple[int, int] | None = None):
         self.msg = msg
+        #: PG-targeted ops (pgls): the pg is the address, no oid hash
+        self.fixed_pgid = fixed_pgid
         #: the pool the caller named — retargeting re-applies any
         #: cache-tier overlay from this, not from a prior redirect
         self.base_pool = base_pool
@@ -389,8 +392,15 @@ class RadosClient(Dispatcher):
         return (pool_id, pgid), acting_primary
 
     def _send_op(self, w: _Waiter) -> None:
-        pgid, primary = self._calc_target(w.base_pool, w.msg.oid,
-                                          w.is_write, w.direct)
+        if w.fixed_pgid is not None:
+            # PG-targeted op (pgls): the pg IS the address — map it to
+            # its primary directly, never rehash an oid
+            pgid = w.fixed_pgid
+            _up, _p, _a, primary = self.osdmap.pg_to_up_acting_osds(
+                pgid[0], pgid[1])
+        else:
+            pgid, primary = self._calc_target(w.base_pool, w.msg.oid,
+                                              w.is_write, w.direct)
         w.msg.pgid = pgid
         w.msg.epoch = self.osdmap.epoch
         if w.is_write:
@@ -411,8 +421,9 @@ class RadosClient(Dispatcher):
         con.send_message(w.msg)
 
     def aio_operate(self, pool_id: int, oid: str, ops: list[OSDOpField],
-                    snapid: int = 0,
-                    direct: bool = False) -> "AioCompletion":
+                    snapid: int = 0, direct: bool = False,
+                    pgid: tuple[int, int] | None = None
+                    ) -> "AioCompletion":
         """Submit without blocking (librados aio_*): returns a completion
         the caller waits on.  In-flight completions resend on map change
         like synchronous ops."""
@@ -430,15 +441,17 @@ class RadosClient(Dispatcher):
             msg = MOSDOp(client_id=self.client_id, tid=tid,
                          pgid=(pool_id, 0), oid=oid, ops=ops,
                          epoch=self.osdmap.epoch, snapid=snapid)
-            w = _Waiter(msg, pool_id, is_write, direct)
+            w = _Waiter(msg, pool_id, is_write, direct,
+                        fixed_pgid=pgid)
             self._waiters[tid] = w
         self._send_op(w)
         return AioCompletion(self, tid, w)
 
     def operate(self, pool_id: int, oid: str, ops: list[OSDOpField],
-                snapid: int = 0, direct: bool = False) -> MOSDOpReply:
+                snapid: int = 0, direct: bool = False,
+                pgid: tuple[int, int] | None = None) -> MOSDOpReply:
         c = self.aio_operate(pool_id, oid, ops, snapid=snapid,
-                             direct=direct)
+                             direct=direct, pgid=pgid)
         if not c.wait_for_complete(self.timeout):
             c.cancel()
             raise TimeoutError(f"op {c.tid} on {oid} timed out")
@@ -551,3 +564,31 @@ class IoCtx:
         e = Encoder()
         e.list(keys, lambda e2, k: e2.str(k))
         self._op(oid, [OSDOpField(OP_OMAP_RMKEYS, 0, 0, e.tobytes())])
+
+    def list_objects(self) -> list[str]:
+        """Logical object names in the pool (`rados ls`): one PGLS op
+        per PG of the BASE pool, each answered by that PG's primary
+        (Objecter pg-targeted listing; librados nobjects_begin).
+        Re-lists when pg_num grew mid-iteration — a PG split would
+        otherwise silently omit objects rehashed to child PGs."""
+        for _attempt in range(4):
+            pool = self.client.osdmap.pools.get(self.pool_id)
+            if pool is None:
+                raise OSError(2, f"pool {self.pool_id} gone")
+            pg_num = pool.pg_num
+            names: set[str] = set()
+            for ps in range(pg_num):
+                r = self.client.operate(
+                    self.pool_id, "", [OSDOpField(OP_PGLS, 0, 0)],
+                    direct=True, pgid=(self.pool_id, ps))
+                if r.result != 0:
+                    raise OSError(-r.result or 5,
+                                  f"pgls {self.pool_id}.{ps}")
+                blob = r.ops[0].data if r.ops else b""
+                if blob:
+                    names.update(Decoder(blob).list(
+                        lambda d: d.str()))
+            cur = self.client.osdmap.pools.get(self.pool_id)
+            if cur is not None and cur.pg_num == pg_num:
+                return sorted(names)
+        raise OSError(11, "pool splitting continuously; retry listing")

@@ -25,6 +25,7 @@ OP_UNWATCH = 9
 OP_NOTIFY = 10        # fan a payload out to every watcher, wait for acks
 OP_CALL = 11          # in-OSD object class method (cls\0method\0input)
 OP_OMAP_RMKEYS = 12   # remove omap keys (Encoder str list in data)
+OP_PGLS = 13          # list a PG's logical objects (rados ls / pgls)
 
 
 @dataclass

@@ -49,7 +49,7 @@ from ceph_tpu.messages import (
     MOSDECSubOpWriteReply, MOSDFailure, MOSDMapMsg, MOSDOp, MOSDOpReply,
     MOSDPing, MOSDRepOp, MOSDRepOpReply)
 from ceph_tpu.messages.osd_msgs import (
-    OP_CALL, OP_DELETE, OP_NOTIFY, OP_OMAP_GET, OP_OMAP_RMKEYS,
+    OP_CALL, OP_DELETE, OP_NOTIFY, OP_OMAP_GET, OP_OMAP_RMKEYS, OP_PGLS,
     OP_OMAP_SET, OP_READ,
     OP_STAT, OP_UNWATCH, OP_WATCH, OP_WRITE, OP_WRITEFULL, MOSDScrub,
     MOSDScrubReply, MWatchNotify, MWatchNotifyAck, OSDOpField)
@@ -830,6 +830,22 @@ class OSDDaemon(Dispatcher):
                     self._waiting_subops.append((handler, msg))
                 return True
         return False
+
+    def _pgls_field(self, cid: str, ec: bool) -> "OSDOpField":
+        """One PG's client-visible object names (PrimaryLogPG do_pg_op
+        PGNLS): store names reduce to the base (snap clones and EC
+        shard suffixes stripped), LENGTH-PREFIX encoded — names may
+        contain any byte, including newlines."""
+        try:
+            raw = self.store.list_objects(cid)
+        except KeyError:
+            raw = []
+        names = sorted({self._base_oid(o, ec) for o in raw
+                        if not o.startswith(PG.PGMETA)
+                        and CLONE_SEP not in o})
+        enc = Encoder()
+        enc.list(names, lambda e, n: e.str(n))
+        return OSDOpField(OP_PGLS, 0, len(names), enc.tobytes())
 
     @staticmethod
     def _base_oid(oid: str, ec: bool) -> str:
@@ -2033,7 +2049,14 @@ class OSDDaemon(Dispatcher):
         # object in the wrong collection.  Drop and share our newer map —
         # the client recomputes and resends (OSD::handle_op misdirected
         # drop + maybe_share_map)
-        expect = pg_to_pgid(ceph_str_hash_rjenkins(msg.oid), pool.pg_num)
+        is_pgls = any(op.op == OP_PGLS for op in msg.ops)
+        if is_pgls:
+            # pg-targeted op: the pg IS the address (no oid to rehash);
+            # bounds-check against the pool's CURRENT pg_num
+            expect = msg.pgid[1] if msg.pgid[1] < pool.pg_num else -1
+        else:
+            expect = pg_to_pgid(ceph_str_hash_rjenkins(msg.oid),
+                                pool.pg_num)
         if expect != msg.pgid[1]:
             m = self.osdmap
             if msg.epoch < m.epoch and msg.connection is not None:
@@ -2278,6 +2301,9 @@ class OSDDaemon(Dispatcher):
                         OP_STAT, 0, st["size"], b""))
                 except KeyError:
                     result = -2
+            elif op.op == OP_PGLS:
+                reply_ops.append(self._pgls_field(
+                    cid, pool.is_erasure()))
             elif op.op == OP_OMAP_GET:
                 try:
                     omap = self.store.omap_get(cid, msg.oid)
@@ -2510,6 +2536,13 @@ class OSDDaemon(Dispatcher):
             if op.op == OP_READ:
                 self.perf.inc("op_r")
                 self._start_ec_read(msg, pool, pg.up, cid, op)
+            elif op.op == OP_PGLS:
+                # listing needs no shard gather: the primary's own
+                # collection names every object (one shard each)
+                self._op_send_reply(msg, MOSDOpReply(
+                    tid=msg.tid, result=0, epoch=self.osdmap.epoch,
+                    ops=[self._pgls_field(cid, True)]))
+                return
             else:
                 self._reply_err(msg, -22)
                 return
