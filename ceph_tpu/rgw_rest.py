@@ -1276,6 +1276,19 @@ class _S3Request:
                 + "</LifecycleConfiguration>").encode()
 
 
+def derive_s3_credentials(cluster_key: bytes | str) -> tuple[str, str]:
+    """Deterministic S3 credential pair from cluster auth material (the
+    AuthMonitor-issues-rgw-credentials analog) — ONE definition shared
+    by the server's provisioning and by operators deriving the same
+    pair out-of-band."""
+    if isinstance(cluster_key, str):
+        cluster_key = cluster_key.encode()
+    access = "AK" + hashlib.sha256(b"rgw-access" + cluster_key
+                                   ).hexdigest()[:18].upper()
+    secret = hashlib.sha256(b"rgw-secret" + cluster_key).hexdigest()
+    return access, secret
+
+
 class RgwRestServer:
     """The radosgw daemon shell: HTTP frontend + gateway + key table.
 
@@ -1317,11 +1330,7 @@ class RgwRestServer:
 
     def provision_from_cephx(self, cluster_key: bytes | str
                              ) -> tuple[str, str]:
-        if isinstance(cluster_key, str):
-            cluster_key = cluster_key.encode()
-        access = "AK" + hashlib.sha256(b"rgw-access" + cluster_key
-                                       ).hexdigest()[:18].upper()
-        secret = hashlib.sha256(b"rgw-secret" + cluster_key).hexdigest()
+        access, secret = derive_s3_credentials(cluster_key)
         self.add_key(access, secret)
         return access, secret
 

@@ -24,7 +24,7 @@ import threading
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ceph-tpu-daemon")
     p.add_argument("--role", required=True,
-                   choices=["mon", "osd", "mgr", "mds"])
+                   choices=["mon", "osd", "mgr", "mds", "rgw"])
     p.add_argument("--id", type=int, default=0)
     p.add_argument("--addr", default="127.0.0.1:0",
                    help="bind address (mons need an agreed host:port)")
@@ -46,6 +46,15 @@ def main(argv=None) -> int:
     p.add_argument("--heartbeats", action="store_true")
     p.add_argument("--metadata-pool", type=int, default=1)
     p.add_argument("--data-pool", type=int, default=2)
+    p.add_argument("--rgw-pool", type=int, default=1,
+                   help="rgw only: backing pool id")
+    p.add_argument("--rgw-access", default="",
+                   help="rgw only: explicit S3 access key (with "
+                        "--rgw-secret; else derived from --auth-key)")
+    p.add_argument("--rgw-secret", default="")
+    p.add_argument("--rgw-port", type=int, default=0,
+                   help="rgw only: HTTP listen port (0 = ephemeral; "
+                        "the bound address prints on the ready line)")
     args = p.parse_args(argv)
     auth_key = args.auth_key.encode() if args.auth_key else None
     if args.jax_cpu_devices:
@@ -83,6 +92,29 @@ def main(argv=None) -> int:
         d = MgrDaemon(args.mon_host, ms_type="async", addr=args.addr,
                       auth_key=auth_key)
         d.init()
+    elif args.role == "rgw":
+        # the radosgw daemon shell: a RadosClient into the backing
+        # pool + the S3 REST frontend; S3 credentials derive from the
+        # cluster key (provision_from_cephx), so every rgw in the
+        # cluster serves the same access/secret pair
+        from ceph_tpu.client import RadosClient
+        from ceph_tpu.rgw_rest import RgwRestServer
+        if not auth_key and not (args.rgw_access and args.rgw_secret):
+            print("error: an rgw needs credentials — pass --auth-key "
+                  "(S3 keys derive from it) or --rgw-access/"
+                  "--rgw-secret; an empty key table would 403 every "
+                  "request", file=sys.stderr)
+            return 2
+        rc = RadosClient(args.mon_host, ms_type="async",
+                         auth_key=auth_key)
+        rc.connect()
+        d = RgwRestServer(rc.open_ioctx(args.rgw_pool),
+                          addr=f"127.0.0.1:{args.rgw_port}")
+        if args.rgw_access and args.rgw_secret:
+            d.add_key(args.rgw_access, args.rgw_secret)
+        if auth_key:
+            d.provision_from_cephx(auth_key)
+        d.start()
     else:
         from ceph_tpu.mds import MDSDaemon
         d = MDSDaemon(args.mon_host, args.metadata_pool, args.data_pool,
@@ -96,8 +128,10 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, on_signal)
     signal.signal(signal.SIGINT, on_signal)
-    # readiness marker for the spawning harness
-    sys.stdout.write(f"ready {args.role}.{args.id}\n")
+    # readiness marker for the spawning harness (rgw appends its bound
+    # HTTP address — the operator's endpoint)
+    extra_info = f" {d.addr}" if args.role == "rgw" else ""
+    sys.stdout.write(f"ready {args.role}.{args.id}{extra_info}\n")
     sys.stdout.flush()
     stop.wait()
     d.shutdown()

@@ -450,6 +450,8 @@ class ProcCluster:
             proc.kill()
             raise RuntimeError(f"{role}.{rid} failed to start: {line!r}")
         threading.Thread(target=proc.stdout.read, daemon=True).start()
+        #: the full ready line (rgw appends its bound HTTP address)
+        proc.ready_line = line
         self.procs[f"{role}.{rid}"] = proc
         return proc
 
@@ -478,6 +480,20 @@ class ProcCluster:
         proc = self.procs.pop(f"osd.{osd_id}")
         proc.kill()
         proc.wait(timeout=10)
+
+    def run_rgw(self, pool: int, rgw_id: int = 0) -> str:
+        """Spawn a radosgw process over `pool`; returns its HTTP
+        address, read from the ready line — the daemon binds an
+        ephemeral port itself, so there is no pick-then-bind race."""
+        proc = self._spawn("rgw", rgw_id,
+                           ["--mon-host", self.mon_host,
+                            "--rgw-pool", str(pool)])
+        parts = proc.ready_line.split()
+        if len(parts) < 3:
+            raise RuntimeError(
+                f"rgw ready line carried no address: "
+                f"{proc.ready_line!r}")
+        return parts[2]
 
     def client(self, timeout: float = 20.0) -> RadosClient:
         c = RadosClient(self.mon_host, ms_type="async", timeout=timeout,

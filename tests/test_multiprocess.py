@@ -71,3 +71,50 @@ def test_dcn_two_process_mesh():
     (SURVEY §5 ICI-within / DCN-between mapping)."""
     from ceph_tpu.parallel.dcn import run_dcn_pair
     run_dcn_pair(4)
+
+
+def test_rgw_daemon_process(tmp_path):
+    """The radosgw deployment shell (daemon_main --role rgw): a
+    separate OS process serving authenticated S3 over a TCP cluster."""
+    import hashlib
+    import http.client
+    import time as _time
+
+    from ceph_tpu.rgw_rest import derive_s3_credentials, sign_request
+
+    c = ProcCluster(n_osds=3, base_path=str(tmp_path),
+                    auth_key="rgw-proc-key").start()
+    try:
+        client = c.client()
+        c.wait_for_osd_count(3)
+        pool = c.create_pool(client, pg_num=2, size=2)
+        addr = c.run_rgw(pool)
+        # same derivation the daemon applied (provision_from_cephx)
+        access, secret = derive_s3_credentials("rgw-proc-key")
+        host, port = addr.rsplit(":", 1)
+
+        def req(method, path, body=b""):
+            sha = hashlib.sha256(body).hexdigest()
+            amz = _time.strftime("%Y%m%dT%H%M%SZ", _time.gmtime())
+            hdrs = {"Host": addr, "x-amz-date": amz,
+                    "x-amz-content-sha256": sha,
+                    "Authorization": sign_request(
+                        method, path, "", {"host": addr,
+                                           "x-amz-date": amz,
+                                           "x-amz-content-sha256": sha},
+                        sha, access, secret)}
+            conn = http.client.HTTPConnection(host, int(port),
+                                              timeout=30)
+            conn.request(method, path, body=body, headers=hdrs)
+            r = conn.getresponse()
+            out = (r.status, r.read())
+            conn.close()
+            return out
+
+        assert req("PUT", "/procbucket")[0] == 200
+        assert req("PUT", "/procbucket/hello",
+                   b"from another process")[0] == 200
+        st, body = req("GET", "/procbucket/hello")
+        assert st == 200 and body == b"from another process"
+    finally:
+        c.stop()
