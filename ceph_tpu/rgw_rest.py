@@ -450,6 +450,23 @@ class S3Gateway:
         except KeyError:
             raise S3Error("NoSuchKey", key)
 
+    def copy_object(self, src_bucket: str, src_key: str,
+                    dst_bucket: str, dst_key: str,
+                    src_vid: str | None = None,
+                    metadata: dict | None = None,
+                    owner: str | None = None) -> tuple[str, str | None]:
+        """S3 CopyObject (rgw_op.cc RGWCopyObj reduced): server-side
+        read + re-put, so the datalog/versioning/compression semantics
+        are exactly a put's.  metadata None = COPY the source's
+        (x-amz-metadata-directive: COPY); a dict = REPLACE."""
+        data, head = self.get_object(src_bucket, src_key, src_vid)
+        if metadata is None:      # x-amz-metadata-directive: COPY
+            meta = dict(head.get("meta") or {})
+        else:
+            meta = metadata
+        return self.put_object(dst_bucket, dst_key, data, meta,
+                               owner=owner)
+
     def delete_object(self, bucket: str, key: str,
                       vid: str | None = None) -> dict:
         try:
@@ -695,7 +712,7 @@ class _S3Request:
         m = _AUTH_RE.match(auth)
         if not m:
             raise S3Error("AccessDenied", "malformed auth")
-        secret = srv.keys.get(m.group("access"))
+        secret = srv.lookup_key(m.group("access"))
         if secret is None:
             raise S3Error("AccessDenied", "unknown access key")
         payload_sha = self.headers.get("x-amz-content-sha256",
@@ -815,6 +832,11 @@ class _S3Request:
                    + "</InitiateMultipartUploadResult>").encode()
             return self._respond(200, xml)
         if method == "PUT" and "uploadId" in q and "partNumber" in q:
+            if self.headers.get("x-amz-copy-source"):
+                # UploadPartCopy is not implemented: refusing beats
+                # silently storing the empty body as the part
+                raise S3Error("InvalidArgument",
+                              "UploadPartCopy is not supported")
             etag = gw.upload_part(bucket, key, q["uploadId"],
                                   int(q["partNumber"]), body)
             return self._respond(200, b"", {"ETag": f'"{etag}"'})
@@ -844,6 +866,40 @@ class _S3Request:
             return self._respond(204)
         vid = q.get("versionId") or None
         if method == "PUT":
+            copy_src = self.headers.get("x-amz-copy-source", "")
+            if copy_src:
+                # CopyObject: authorize READ on the SOURCE too, then
+                # server-side copy (rgw_op.cc RGWCopyObj)
+                srcq = urllib.parse.urlsplit(copy_src)
+                sparts = urllib.parse.unquote(
+                    srcq.path).lstrip("/").split("/", 1)
+                if len(sparts) != 2 or not sparts[1]:
+                    raise S3Error("InvalidArgument",
+                                  "copy source must be /bucket/key")
+                sbucket, skey = sparts
+                svid = dict(urllib.parse.parse_qsl(
+                    srcq.query)).get("versionId")
+                gw.authorize(sbucket, principal, write=False,
+                             key=skey, vid=svid)
+                directive = self.headers.get(
+                    "x-amz-metadata-directive", "COPY").upper()
+                if directive not in ("COPY", "REPLACE"):
+                    raise S3Error("InvalidArgument",
+                                  f"bad metadata directive "
+                                  f"{directive!r}")
+                meta = (self._meta_headers()
+                        if directive == "REPLACE" else None)
+                etag, put_vid = gw.copy_object(
+                    sbucket, skey, bucket, key, src_vid=svid,
+                    metadata=meta, owner=principal)
+                hdrs = {}
+                if put_vid:
+                    hdrs["x-amz-version-id"] = put_vid
+                xml = ('<?xml version="1.0" encoding="UTF-8"?>'
+                       "<CopyObjectResult>"
+                       + _x("ETag", f'"{etag}"')
+                       + "</CopyObjectResult>").encode()
+                return self._respond(200, xml, hdrs)
             etag, put_vid = gw.put_object(bucket, key, body,
                                           self._meta_headers(),
                                           owner=principal)
@@ -1276,6 +1332,36 @@ class _S3Request:
                 + "</LifecycleConfiguration>").encode()
 
 
+#: pool-resident user registry (the reference stores RGW users as
+#: rados objects, src/rgw/rgw_user.cc): access-key -> json record
+USERS_OID = ".users.registry"
+
+
+def load_pool_users(ioctx) -> dict[str, dict]:
+    """access -> {"secret", "uid", "created"} from the pool registry."""
+    try:
+        omap = ioctx.get_omap(USERS_OID)
+    except OSError:
+        return {}
+    out = {}
+    for k, v in omap.items():
+        try:
+            out[k] = json.loads(v.decode())
+        except ValueError:
+            continue
+    return out
+
+
+def save_pool_user(ioctx, access: str, secret: str, uid: str) -> None:
+    ioctx.set_omap(USERS_OID, {access: json.dumps(
+        {"secret": secret, "uid": uid,
+         "created": time.time()}).encode()})
+
+
+def remove_pool_user(ioctx, access: str) -> None:
+    ioctx.rm_omap_keys(USERS_OID, [access])
+
+
 def derive_s3_credentials(cluster_key: bytes | str) -> tuple[str, str]:
     """Deterministic S3 credential pair from cluster auth material (the
     AuthMonitor-issues-rgw-credentials analog) — ONE definition shared
@@ -1327,6 +1413,26 @@ class RgwRestServer:
 
     def add_key(self, access: str, secret: str) -> None:
         self.keys[access] = secret
+
+    #: pool-user cache TTL: radosgw-admin created users become usable
+    #: within this window without a gateway restart
+    USER_CACHE_TTL = 2.0
+
+    def lookup_key(self, access: str) -> str | None:
+        """Secret for an access key: the in-memory table first, then
+        the POOL user registry (radosgw-admin's store) with a short
+        read-through cache."""
+        secret = self.keys.get(access)
+        if secret is not None:
+            return secret
+        now = self.clock()
+        cached = getattr(self, "_user_cache", None)
+        if cached is None or now - cached[0] > self.USER_CACHE_TTL:
+            users = load_pool_users(self.gateway.io)
+            cached = (now, users)
+            self._user_cache = cached
+        rec = cached[1].get(access)
+        return rec["secret"] if rec else None
 
     def provision_from_cephx(self, cluster_key: bytes | str
                              ) -> tuple[str, str]:

@@ -34,6 +34,8 @@ def main(argv=None) -> int:
     p.add_argument("--mon", required=True, help="mon host(s)")
     p.add_argument("-p", "--pool", type=int, required=True)
     p.add_argument("--ms-type", default="async")
+    p.add_argument("--auth-key", default="",
+                   help="cluster shared key (authenticated clusters)")
     p.add_argument("words", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
     if not args.words:
@@ -41,7 +43,9 @@ def main(argv=None) -> int:
 
     from ceph_tpu.client import RadosClient
     from ceph_tpu.rbd import Image, list_images
-    client = RadosClient(args.mon, ms_type=args.ms_type)
+    client = RadosClient(args.mon, ms_type=args.ms_type,
+                         auth_key=args.auth_key.encode()
+                         if args.auth_key else None)
     client.connect()
     io = client.open_ioctx(args.pool)
     w = args.words

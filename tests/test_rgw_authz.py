@@ -209,3 +209,81 @@ def test_cors_preflight_and_response_headers(rig):
     assert st == 200 and b"app.example.com" in body
     assert alice.request("DELETE", "/corsb", "cors")[0] == 204
     assert alice.request("GET", "/corsb", "cors")[0] == 404
+
+
+def test_copy_object(rig):
+    """S3 CopyObject (x-amz-copy-source): server-side copy, source READ
+    authorized, metadata COPY vs REPLACE directives."""
+    alice, bob = rig["alice"], rig["bob"]
+    assert alice.request("PUT", "/srcb")[0] == 200
+    assert alice.request("PUT", "/dstb")[0] == 200
+    st, _b, _h = alice.request(
+        "PUT", "/srcb/orig", body=b"copy me",
+        headers_extra={"x-amz-meta-color": "blue"})
+    assert st == 200
+    # COPY directive (default): metadata travels
+    st, body, _ = alice.request(
+        "PUT", "/dstb/copied",
+        headers_extra={"x-amz-copy-source": "/srcb/orig"})
+    assert st == 200 and b"CopyObjectResult" in body
+    st, body, hdrs = alice.request("GET", "/dstb/copied")
+    assert st == 200 and body == b"copy me"
+    assert hdrs.get("x-amz-meta-color") == "blue"
+    # REPLACE directive: new metadata only
+    st, _b, _h = alice.request(
+        "PUT", "/dstb/copied2",
+        headers_extra={"x-amz-copy-source": "/srcb/orig",
+                       "x-amz-metadata-directive": "REPLACE",
+                       "x-amz-meta-shape": "round"})
+    assert st == 200
+    st, _body, hdrs = alice.request("GET", "/dstb/copied2")
+    assert hdrs.get("x-amz-meta-shape") == "round"
+    assert "x-amz-meta-color" not in hdrs
+    # bob cannot copy FROM a bucket he cannot read
+    st, _b, _h = bob.request(
+        "PUT", "/dstb/stolen",
+        headers_extra={"x-amz-copy-source": "/srcb/orig"})
+    assert st == 403
+
+
+def test_pool_users_and_radosgw_admin(rig):
+    """radosgw-admin-created users live in the pool registry and
+    authenticate through any gateway over it."""
+    import subprocess
+    import sys as _sys
+
+    from ceph_tpu.tools import rgw_admin_cli
+    c = rig["cluster"]
+    srv = rig["srv"]
+    pool = srv.gateway.io.pool_id
+    base = ["--mon", c.mon_host, "-p", str(pool),
+            "--ms-type", "loopback"]
+    import io as _io
+    out = _io.StringIO()
+    real = _sys.stdout
+    _sys.stdout = out
+    try:
+        assert rgw_admin_cli.main(
+            base + ["user", "create", "--uid", "carol",
+                    "--access", "AKCAROL000", "--secret",
+                    "carol-secret"]) == 0
+        assert rgw_admin_cli.main(base + ["user", "ls"]) == 0
+        assert rgw_admin_cli.main(
+            base + ["user", "info", "--uid", "carol"]) == 0
+    finally:
+        _sys.stdout = real
+    assert "carol" in out.getvalue()
+    # the pool-registered user authenticates via the RUNNING gateway
+    # (read-through cache, no restart)
+    from test_rgw_versioning import S3Client
+    carol = S3Client(srv.addr, "AKCAROL000", "carol-secret")
+    assert carol.request("PUT", "/carols-bucket")[0] == 200
+    assert carol.request("PUT", "/carols-bucket/o",
+                         body=b"hi")[0] == 200
+    assert carol.request("GET", "/carols-bucket/o")[1] == b"hi"
+    # rm revokes (after the cache TTL)
+    assert rgw_admin_cli.main(base + ["user", "rm", "--uid",
+                                      "carol"]) == 0
+    import time as _t
+    _t.sleep(srv.USER_CACHE_TTL + 0.5)
+    assert carol.request("GET", "/carols-bucket/o")[0] == 403
