@@ -29,7 +29,7 @@ def cluster():
     c.stop()
 
 
-def _wait(pred, timeout=20.0, interval=0.1):
+def _wait(pred, timeout=45.0, interval=0.1):
     deadline = time.time() + timeout
     while time.time() < deadline:
         if pred():
@@ -91,13 +91,14 @@ def test_standby_promotion_on_active_death(cluster):
             return (db.get("active_name") == "mgr.0"
                     and [s["name"] for s in db.get("standbys", [])]
                     == ["mgr.1"])
-        assert _wait(map_settled), client.osdmap.mgr_db
+        # generous: late in a full-suite run the 1-core host is slow
+        assert _wait(map_settled, timeout=60.0), client.osdmap.mgr_db
         assert not mgr1.is_active and not mgr1.host.modules
         # kill the active: the mon promotes the standby, which loads
         # the module set and starts answering
         cluster.kill_mgr(0)
         assert _wait(lambda: (client.osdmap.mgr_db or {})
-                     .get("active_name") == "mgr.1", timeout=30.0), \
+                     .get("active_name") == "mgr.1", timeout=60.0), \
             client.osdmap.mgr_db
         assert _wait(lambda: mgr1.is_active)
         assert _wait(lambda: set(ModuleHost.ALWAYS_ON)
