@@ -438,6 +438,8 @@ class MgrDaemon(Dispatcher):
         try:
             if prefix == "pg dump":
                 return json.dumps(self.pg_dump()), 0
+            if prefix == "df":
+                return json.dumps(self.df()), 0
             if prefix == "pg ls":
                 pool = cmd.get("pool")
                 states = cmd.get("states") or None

@@ -57,6 +57,7 @@ COMMANDS = {
     ("mgr", "module", "enable"): ["module"],
     ("mgr", "module", "disable"): ["module"],
     ("pg", "dump"): [],
+    ("df",): [],
     ("pg", "ls"): ["pool"],
     ("iostat",): [],
     ("balancer", "status"): [],
@@ -71,7 +72,7 @@ COMMANDS = {
 
 #: prefixes served by the active MGR (re-targeted via `mgr dump`),
 #: like the reference's mgr command routing
-MGR_COMMANDS = {"pg dump", "pg ls", "iostat", "balancer status",
+MGR_COMMANDS = {"pg dump", "pg ls", "iostat", "df", "balancer status",
                 "balancer optimize", "telemetry show",
                 "mgr module ls", "mgr module enable",
                 "mgr module disable", "osd pool autoscale-status"}

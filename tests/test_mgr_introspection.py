@@ -120,6 +120,12 @@ def test_iostat_and_balancer_status():
         st = c.mgr.iostat()
         assert st["total_wr_ops_s"] > 0, st
         assert all(v["interval_s"] > 0 for v in st["osds"].values())
+        # `ceph df` routes through the mgr tier like pg dump
+        import json as _json
+        rc, out = client.mgr_command({"prefix": "df"})
+        assert rc == 0
+        d = _json.loads(out)
+        assert d["total_objects"] >= 1 and d["per_osd"]
 
         bs = c.mgr.balancer_status()
         assert bs["mode"] == "upmap"
