@@ -633,6 +633,27 @@ class S3Gateway:
         b.put(self._mp_key(upload_id, part), data, unversioned=True)
         return hashlib.md5(data).hexdigest()
 
+    def upload_part_copy(self, bucket: str, key: str, upload_id: str,
+                         part: int, src_bucket: str, src_key: str,
+                         src_vid: str | None = None,
+                         byte_range: tuple[int, int] | None = None
+                         ) -> str:
+        """S3 UploadPartCopy (RGWCopyObj's multipart shape): the part's
+        bytes come from an existing object, optionally a byte range
+        (x-amz-copy-source-range, inclusive ends like HTTP ranges)."""
+        # upload validity FIRST (S3's NoSuchUpload beats range errors,
+        # and a dead upload must not cost a full source read)
+        self._mp_manifest(self._bucket(bucket), upload_id)
+        data, _head = self.get_object(src_bucket, src_key, src_vid)
+        if byte_range is not None:
+            first, last = byte_range
+            if not (0 <= first <= last < len(data)):
+                raise S3Error("InvalidArgument",
+                              f"range {first}-{last} outside object "
+                              f"of {len(data)} bytes")
+            data = data[first:last + 1]
+        return self.upload_part(bucket, key, upload_id, part, data)
+
     def complete_multipart(self, bucket: str, key: str, upload_id: str,
                            parts: list[tuple[int, str]]) -> str:
         # serialized: complete reads parts then deletes them; two racing
@@ -832,11 +853,28 @@ class _S3Request:
                    + "</InitiateMultipartUploadResult>").encode()
             return self._respond(200, xml)
         if method == "PUT" and "uploadId" in q and "partNumber" in q:
-            if self.headers.get("x-amz-copy-source"):
-                # UploadPartCopy is not implemented: refusing beats
-                # silently storing the empty body as the part
-                raise S3Error("InvalidArgument",
-                              "UploadPartCopy is not supported")
+            copy_src = self.headers.get("x-amz-copy-source", "")
+            if copy_src:
+                # UploadPartCopy: the part's bytes come from an
+                # existing (READ-authorized) object, optionally ranged
+                sbucket, skey, svid = self._copy_source(gw, copy_src,
+                                                        principal)
+                rng = None
+                rh = self.headers.get("x-amz-copy-source-range", "")
+                if rh:
+                    m2 = re.match(r"bytes=(\d+)-(\d+)$", rh)
+                    if not m2:
+                        raise S3Error("InvalidArgument",
+                                      f"bad range {rh!r}")
+                    rng = (int(m2.group(1)), int(m2.group(2)))
+                etag = gw.upload_part_copy(
+                    bucket, key, q["uploadId"], int(q["partNumber"]),
+                    sbucket, skey, src_vid=svid, byte_range=rng)
+                xml = ('<?xml version="1.0" encoding="UTF-8"?>'
+                       "<CopyPartResult>"
+                       + _x("ETag", f'"{etag}"')
+                       + "</CopyPartResult>").encode()
+                return self._respond(200, xml)
             etag = gw.upload_part(bucket, key, q["uploadId"],
                                   int(q["partNumber"]), body)
             return self._respond(200, b"", {"ETag": f'"{etag}"'})
@@ -870,17 +908,8 @@ class _S3Request:
             if copy_src:
                 # CopyObject: authorize READ on the SOURCE too, then
                 # server-side copy (rgw_op.cc RGWCopyObj)
-                srcq = urllib.parse.urlsplit(copy_src)
-                sparts = urllib.parse.unquote(
-                    srcq.path).lstrip("/").split("/", 1)
-                if len(sparts) != 2 or not sparts[1]:
-                    raise S3Error("InvalidArgument",
-                                  "copy source must be /bucket/key")
-                sbucket, skey = sparts
-                svid = dict(urllib.parse.parse_qsl(
-                    srcq.query)).get("versionId")
-                gw.authorize(sbucket, principal, write=False,
-                             key=skey, vid=svid)
+                sbucket, skey, svid = self._copy_source(gw, copy_src,
+                                                        principal)
                 directive = self.headers.get(
                     "x-amz-metadata-directive", "COPY").upper()
                 if directive not in ("COPY", "REPLACE"):
@@ -1078,6 +1107,23 @@ class _S3Request:
             return self._respond(200, xml,
                                  {"Content-Type": "application/xml"})
         raise S3Error("InvalidArgument", f"unsupported {method} on bucket")
+
+    def _copy_source(self, gw: S3Gateway, copy_src: str,
+                     principal: str | None) -> tuple[str, str,
+                                                     str | None]:
+        """Parse + READ-authorize an x-amz-copy-source value (shared
+        by CopyObject and UploadPartCopy): (bucket, key, versionId)."""
+        srcq = urllib.parse.urlsplit(copy_src)
+        sparts = urllib.parse.unquote(
+            srcq.path).lstrip("/").split("/", 1)
+        if len(sparts) != 2 or not sparts[1]:
+            raise S3Error("InvalidArgument",
+                          "copy source must be /bucket/key")
+        sbucket, skey = sparts
+        svid = dict(urllib.parse.parse_qsl(srcq.query)).get("versionId")
+        gw.authorize(sbucket, principal, write=False, key=skey,
+                     vid=svid)
+        return sbucket, skey, svid
 
     # -- CORS (rgw_cors.cc) ---------------------------------------------------
 

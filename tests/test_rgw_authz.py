@@ -287,3 +287,70 @@ def test_pool_users_and_radosgw_admin(rig):
     import time as _t
     _t.sleep(srv.USER_CACHE_TTL + 0.5)
     assert carol.request("GET", "/carols-bucket/o")[0] == 403
+
+
+def test_upload_part_copy(rig):
+    """S3 UploadPartCopy: multipart parts sourced from an existing
+    object, full and ranged; a part from an unreadable source is
+    refused."""
+    alice, bob = rig["alice"], rig["bob"]
+    assert alice.request("PUT", "/mpc-src")[0] == 200
+    assert alice.request("PUT", "/mpc-dst")[0] == 200
+    blob = bytes(range(256)) * 64          # 16 KiB source
+    assert alice.request("PUT", "/mpc-src/big", body=blob)[0] == 200
+    st, body, _ = alice.request("POST", "/mpc-dst/assembled",
+                                "uploads")
+    assert st == 200
+    import re as _re
+    uid = _re.search(rb"<UploadId>([^<]+)</UploadId>", body).group(
+        1).decode()
+    # part 1: the whole source; part 2: a byte range; part 3: inline
+    st, body, _ = alice.request(
+        "PUT", "/mpc-dst/assembled", f"partNumber=1&uploadId={uid}",
+        headers_extra={"x-amz-copy-source": "/mpc-src/big"})
+    assert st == 200 and b"CopyPartResult" in body
+    etag1 = _re.search(rb'<ETag>"?([0-9a-f]+)', body).group(1).decode()
+    st, body, _ = alice.request(
+        "PUT", "/mpc-dst/assembled", f"partNumber=2&uploadId={uid}",
+        headers_extra={"x-amz-copy-source": "/mpc-src/big",
+                       "x-amz-copy-source-range": "bytes=0-255"})
+    assert st == 200
+    etag2 = _re.search(rb'<ETag>"?([0-9a-f]+)', body).group(1).decode()
+    st, body, _ = alice.request(
+        "PUT", "/mpc-dst/assembled", f"partNumber=3&uploadId={uid}",
+        body=b"tail")
+    assert st == 200
+    import hashlib as _h
+    etag3 = _h.md5(b"tail").hexdigest()
+    # a bad range on a LIVE upload: 400
+    st, _b0, _h0 = alice.request(
+        "PUT", "/mpc-dst/assembled", f"partNumber=4&uploadId={uid}",
+        headers_extra={"x-amz-copy-source": "/mpc-src/big",
+                       "x-amz-copy-source-range": "bytes=5-999999"})
+    assert st == 400
+    xml = ("<CompleteMultipartUpload>"
+           + "".join(f"<Part><PartNumber>{n}</PartNumber>"
+                     f"<ETag>\"{e}\"</ETag></Part>"
+                     for n, e in ((1, etag1), (2, etag2), (3, etag3)))
+           + "</CompleteMultipartUpload>").encode()
+    st, _b, _h2 = alice.request("POST", "/mpc-dst/assembled",
+                                f"uploadId={uid}", body=xml)
+    assert st == 200
+    st, got, _ = alice.request("GET", "/mpc-dst/assembled")
+    assert st == 200 and got == blob + blob[:256] + b"tail"
+    # after completion the uploadId is dead: NoSuchUpload, not 400
+    st, _b2, _h3 = alice.request(
+        "PUT", "/mpc-dst/assembled", f"partNumber=4&uploadId={uid}",
+        headers_extra={"x-amz-copy-source": "/mpc-src/big",
+                       "x-amz-copy-source-range": "bytes=5-999999"})
+    assert st == 404
+    # the SOURCE-read gate alone refuses: bob owns his destination
+    # (dest write passes) but cannot read alice's source
+    assert bob.request("PUT", "/bob-dst")[0] == 200
+    st, body, _ = bob.request("POST", "/bob-dst/steal", "uploads")
+    uid2 = _re.search(rb"<UploadId>([^<]+)</UploadId>", body).group(
+        1).decode()
+    st, _b3, _h4 = bob.request(
+        "PUT", "/bob-dst/steal", f"partNumber=1&uploadId={uid2}",
+        headers_extra={"x-amz-copy-source": "/mpc-src/big"})
+    assert st == 403
