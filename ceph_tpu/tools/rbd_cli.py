@@ -7,7 +7,7 @@ the operator surface over ceph_tpu.rbd's librbd-lite.
 Commands (the rbd verbs they mirror):
     create NAME --size BYTES [--order N] [--features f1,f2]
     ls | info NAME | rm NAME | resize NAME --size BYTES
-    snap create|rm|protect|unprotect NAME@SNAP
+    snap create|rm|protect|unprotect|rollback NAME@SNAP
     snap ls NAME
     clone PARENT@SNAP CHILD           (COW; parent snap must be protected)
     flatten NAME | children PARENT@SNAP
@@ -103,6 +103,8 @@ def main(argv=None) -> int:
                 img.snap_protect(snap)
             elif verb == "unprotect":
                 img.snap_unprotect(snap)
+            elif verb == "rollback":
+                img.snap_rollback(snap)
             else:
                 raise SystemExit(f"unknown snap verb {verb!r}")
             return 0

@@ -111,6 +111,10 @@ def test_rbd_cli_lifecycle(cluster, tmp_path):
     assert "protected" in text
     # the CLI-made clone reads the parent's bytes
     assert Image(io, "vm1").read(0, 6) == b"golden"
+    # rollback via the CLI restores the snapshot's content
+    img.write(b"SCRIBBLED-OVER", 0)
+    assert rbd_cli.main(base + ["snap", "rollback", "vm0@base"]) == 0
+    assert Image(io, "vm0").read(0, 6) == b"golden"
     # flatten + unprotect + rm via the CLI
     out2 = _io.StringIO()
     sys.stdout = out2
