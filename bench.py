@@ -206,6 +206,19 @@ def main() -> None:
     cb.do_rule_batch(rid, c_xs, numrep, reweight.astype(np.uint32))
     c_crush_mpps = len(c_xs) / (time.perf_counter() - t0) / 1e6
 
+    # kernel telemetry digest (retraces, p50/p99 latency, occupancy):
+    # the timed loops above run inside jitted scans, so close with a few
+    # FENCED standalone calls — real per-call device residency samples —
+    # before summarizing.  A retrace count above the handful of shapes
+    # this harness uses is the regression tell.
+    from ceph_tpu.ops import telemetry
+    telemetry.set_fence_for_timing(True)
+    for _ in range(3):
+        encode(data)
+        bm.do_rule(rid, xs, numrep, rw)
+    telemetry.set_fence_for_timing(False)
+    kernel_summary = telemetry.registry().summary()
+
     print(json.dumps({
         "metric": "ec encode+recover MB/s (k=8,m=4,4KiB chunks, batch=2048)",
         "value": round(combined, 1),
@@ -225,6 +238,7 @@ def main() -> None:
                             round(n_pgs / t_crush_min / 1e6, 3)],
         "c_crush_mpps": round(c_crush_mpps, 3),
         "crush_vs_c": round(crush_mpps / c_crush_mpps, 2),
+        "kernel_telemetry": kernel_summary,
         "device": str(jax.devices()[0]),
     }))
 

@@ -119,6 +119,10 @@ register_options([
     Option("osd_client_message_size_cap", OPT_INT, 256 << 20,
            "bytes of op payloads queued in the sharded op queue before "
            "dispatch threads block (front-door backpressure)"),
+    Option("kernel_fence_for_timing", OPT_BOOL, False,
+           "fence (block_until_ready) each instrumented device kernel "
+           "call so telemetry latency samples are real device time; "
+           "serializes the dispatch pipeline, so keep off on hot paths"),
     Option("log_level", OPT_INT, 1, "default subsystem log level"),
     Option("ms_type", OPT_STR, "async",
            "messenger implementation: async | loopback"),

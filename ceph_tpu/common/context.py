@@ -37,11 +37,21 @@ class CephTpuContext:
             lambda name, **kw: {name: self.conf.get(name)},
             "get one option")
         from ceph_tpu.common import tracing
+        trace_dump = (lambda trace_id=None, **kw: tracing.dump(
+            int(trace_id) if trace_id else None))
         self.admin.register_command(
-            "dump_traces",
-            lambda trace_id=None, **kw: tracing.dump(
-                int(trace_id) if trace_id else None),
+            "dump_traces", trace_dump,
             "stitched cross-daemon trace timelines")
+        # reference-style spelling of the same surface
+        self.admin.register_command(
+            "dump_tracing", trace_dump,
+            "stitched cross-daemon trace timelines [trace_id]")
+        from ceph_tpu.ops import telemetry
+        telemetry.configure_from_conf(self.conf)
+        self.admin.register_command(
+            "dump_kernel_stats", lambda **kw: telemetry.dump(),
+            "device-kernel telemetry: latency/batch histograms, "
+            "byte counters, jit retrace counts")
 
 
 _default: CephTpuContext | None = None

@@ -24,6 +24,7 @@ class PerfCounters:
         self._avg: dict[str, tuple[int, float]] = {}   # (count, sum)
         self._hist: dict[str, list[int]] = {}
         self._hist_bounds: dict[str, list[float]] = {}
+        self._hist_sum: dict[str, float] = {}
 
     # -- mutation -------------------------------------------------------------
 
@@ -48,8 +49,11 @@ class PerfCounters:
     def hinc(self, name: str, value: float) -> None:
         with self._lock:
             bounds = self._hist_bounds[name]
-            bucket = sum(1 for b in bounds if value >= b)
+            # bounds are UPPER-inclusive (`le`) limits, matching the
+            # Prometheus bucket model the exposition emits them as
+            bucket = sum(1 for b in bounds if value > b)
             self._hist[name][bucket] += 1
+            self._hist_sum[name] += value
 
     # -- reading --------------------------------------------------------------
 
@@ -78,7 +82,8 @@ class PerfCounters:
                     out[n] = {"avgcount": c, "sum": s}
                 else:
                     out[n] = {"bounds": self._hist_bounds[n],
-                              "buckets": list(self._hist[n])}
+                              "buckets": list(self._hist[n]),
+                              "sum": self._hist_sum[n]}
             return out
 
 
@@ -103,6 +108,7 @@ class PerfCountersBuilder:
         self._pc._types[name] = HISTOGRAM
         self._pc._hist_bounds[name] = list(bounds)
         self._pc._hist[name] = [0] * (len(bounds) + 1)
+        self._pc._hist_sum[name] = 0.0
         return self
 
     def create_perf_counters(self) -> PerfCounters:
@@ -123,6 +129,10 @@ class PerfCountersCollection:
     def remove(self, name: str) -> None:
         with self._lock:
             self._sets.pop(name, None)
+
+    def get(self, name: str) -> PerfCounters | None:
+        with self._lock:
+            return self._sets.get(name)
 
     def dump(self) -> dict:
         with self._lock:

@@ -452,6 +452,11 @@ class BatchMapper:
                 fastpath.FastMapper(fr) if fr is not None else None)
         return self._fast_cache[ruleno]
 
+    def _jit_entries(self) -> int:
+        """Compile-cache entries across every jitted rule evaluator —
+        the telemetry retrace counter differences this per call."""
+        return sum(f._cache_size() for f in self._jit_cache.values())
+
     def do_rule(self, ruleno: int, xs, result_max: int, reweight) -> jax.Array:
         xs = jnp.asarray(xs, dtype=jnp.uint32)
         reweight = jnp.asarray(reweight, dtype=jnp.int64)
@@ -465,12 +470,21 @@ class BatchMapper:
             if key not in self._jit_cache:
                 self._jit_cache[key] = jax.jit(
                     functools.partial(fast.run, result_max=result_max))
-            return self._jit_cache[key](xs, reweight)
-        key = (ruleno, result_max)
-        if key not in self._jit_cache:
-            self._jit_cache[key] = jax.jit(
-                functools.partial(self._run, ruleno, result_max))
-        return self._jit_cache[key](xs, reweight)
+        else:
+            key = (ruleno, result_max)
+            if key not in self._jit_cache:
+                self._jit_cache[key] = jax.jit(
+                    functools.partial(self._run, ruleno, result_max))
+        fn = self._jit_cache[key]
+        n = xs.shape[0]
+        from ceph_tpu.ops import telemetry
+        return telemetry.timed_kernel(
+            "crush_map",
+            lambda: fn(xs, reweight),
+            batch=n, bytes_in=n * 4 + reweight.shape[0] * 8,
+            bytes_out=n * result_max * 4,
+            cache_entries=self._jit_entries,
+            signature=("crush", id(self), key, n))
 
     # -- the rule interpreter (mapper.c:900-1105) -----------------------------
 

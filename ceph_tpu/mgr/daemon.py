@@ -57,16 +57,21 @@ def _dec_pg_stat(d: Decoder) -> dict:
 class MMgrReport(Message):
     """osd -> mgr: perf counters + pg states (messages/MMgrReport.h).
     v2 adds per-PG stat records for the PGs this osd leads — the pg_dump
-    / pg ls / iostat feed (pg_stat_t reduced); v1 peers interoperate,
-    they just feed the histogram views only."""
+    / pg ls / iostat feed (pg_stat_t reduced); v3 adds the full TYPED
+    perf dump of the daemon's whole counter collection (u64 counters,
+    time-avg {avgcount, sum} pairs, histograms with bucket bounds —
+    every set: osd, messenger, store), the payload the prometheus
+    module turns into real histogram/summary families.  Older peers
+    interoperate: the versioned section skips trailing fields."""
 
     TYPE = 0x701
-    HEAD_VERSION = 2
+    HEAD_VERSION = 3
     COMPAT_VERSION = 1
 
     def __init__(self, osd_id: int = 0, counters: dict | None = None,
                  pg_states: dict | None = None, num_objects: int = 0,
-                 bytes_used: int = 0, pg_stats: dict | None = None):
+                 bytes_used: int = 0, pg_stats: dict | None = None,
+                 perf: dict | None = None):
         super().__init__()
         self.osd_id = osd_id
         self.counters = counters or {}
@@ -75,9 +80,11 @@ class MMgrReport(Message):
         self.bytes_used = bytes_used
         #: pgid-str -> per-PG stat record (primary PGs only)
         self.pg_stats = pg_stats or {}
+        #: set name -> typed `perf dump` payload (PerfCountersCollection)
+        self.perf = perf or {}
 
     def encode_payload(self, enc: Encoder):
-        enc.versioned(2, 1, lambda e: (
+        enc.versioned(3, 1, lambda e: (
             e.s32(self.osd_id),
             e.map(self.counters, lambda e2, k: e2.str(k),
                   lambda e2, v: e2.u64(int(v))),
@@ -85,12 +92,16 @@ class MMgrReport(Message):
                   lambda e2, v: e2.u32(v)),
             e.u64(self.num_objects), e.u64(self.bytes_used),
             e.map(self.pg_stats, lambda e2, k: e2.str(k),
-                  _enc_pg_stat)))
+                  _enc_pg_stat),
+            # typed counter trees are irregular (per-type shapes);
+            # JSON inside the versioned frame keeps the wire stable
+            e.str(json.dumps(self.perf))))
 
     def decode_payload(self, dec: Decoder, version):
         # decode constructs via __new__: every field needs a default
-        # here, v1 payloads carry no pg_stats
+        # here, v1 payloads carry no pg_stats, v2 no perf
         self.pg_stats = {}
+        self.perf = {}
 
         def body(d, v):
             self.osd_id = d.s32()
@@ -102,7 +113,9 @@ class MMgrReport(Message):
             self.bytes_used = d.u64()
             if v >= 2:
                 self.pg_stats = d.map(lambda d2: d2.str(), _dec_pg_stat)
-        dec.versioned(2, body)
+            if v >= 3:
+                self.perf = json.loads(d.str())
+        dec.versioned(3, body)
 
 
 @register_message
@@ -394,6 +407,8 @@ class MgrDaemon(Dispatcher):
             return self.df()
         if data_name == "counters":
             return self.counters()
+        if data_name == "perf_reports":
+            return self.perf_reports()
         if data_name == "health":
             return self.health()
         if data_name == "io_samples":
@@ -543,6 +558,14 @@ class MgrDaemon(Dispatcher):
         with self._lock:
             return {o: dict(r.counters)
                     for o, (_t, r) in self.reports.items()}
+
+    def perf_reports(self) -> dict:
+        """Typed perf dumps by reporting osd (MMgrReport v3 payload):
+        {osd: {set_name: {counter: value | {avgcount, sum} |
+        {bounds, buckets, sum}}}}."""
+        with self._lock:
+            return {o: dict(r.perf)
+                    for o, (_t, r) in self.reports.items() if r.perf}
 
     # -- pg introspection (DaemonServer `pg dump` / `pg ls`) ------------------
 

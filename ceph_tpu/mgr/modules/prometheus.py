@@ -1,6 +1,25 @@
-"""Prometheus exporter module (src/pybind/mgr/prometheus analog): every
-aggregated counter and gauge in the text exposition format, served over
-HTTP on the module's configured port."""
+"""Prometheus exporter module (src/pybind/mgr/prometheus analog).
+
+Serves the text exposition format (0.0.4) over HTTP on the module's
+configured port.  Every family carries ``# HELP``/``# TYPE`` headers;
+histogram-typed perf counters are emitted as real histogram families
+(``_bucket{le=...}`` / ``_sum`` / ``_count``), time-avg counters as
+summary sum+count pairs, and values are never integer-truncated.
+
+Three data sources feed one scrape:
+
+  * cluster aggregates the mgr already maintains (health, osdmap, pg
+    states, df);
+  * the TYPED per-daemon perf dumps riding MMgrReport v3 — every
+    registered set (osd, messenger, bluestore, ...) of every reporting
+    daemon;
+  * the process-global device-kernel telemetry registry
+    (ceph_tpu.ops.telemetry): latency/batch-occupancy histograms, byte
+    counters and jit retrace counts for the EC and CRUSH kernels.  In
+    the in-process MiniCluster every daemon shares that registry; in a
+    multi-process deployment each daemon serves its own via the admin
+    socket (``dump_kernel_stats``) and a sidecar relabels per daemon.
+"""
 
 from __future__ import annotations
 
@@ -9,6 +28,90 @@ import socketserver
 import threading
 
 from ceph_tpu.mgr.module import MgrModule
+from ceph_tpu.ops import telemetry
+
+
+def _num(v) -> str:
+    """Exposition value: ints stay integral, floats keep precision
+    (the old exporter's int(val) silently corrupted time-avg floats)."""
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    return str(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)
+
+
+def _esc(v) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels(d: dict | None) -> str:
+    if not d:
+        return ""
+    inner = ",".join(f'{k}="{_esc(v)}"' for k, v in d.items())
+    return "{" + inner + "}"
+
+
+class Exposition:
+    """Accumulates samples grouped by family so each family is emitted
+    contiguously under exactly one HELP/TYPE header pair (the format's
+    grouping requirement)."""
+
+    def __init__(self):
+        self._order: list[str] = []
+        self._fam: dict[str, tuple[str, str, list[str]]] = {}
+
+    def _family(self, name: str, typ: str, help_: str) -> list[str]:
+        fam = self._fam.get(name)
+        if fam is None:
+            fam = (typ, help_, [])
+            self._fam[name] = fam
+            self._order.append(name)
+        return fam[2]
+
+    def sample(self, name: str, typ: str, help_: str, value,
+               labels: dict | None = None, suffix: str = "") -> None:
+        self._family(name, typ, help_).append(
+            f"{name}{suffix}{_labels(labels)} {_num(value)}")
+
+    def gauge(self, name, help_, value, labels=None):
+        self.sample(name, "gauge", help_, value, labels)
+
+    def counter(self, name, help_, value, labels=None):
+        self.sample(name, "counter", help_, value, labels)
+
+    def summary(self, name, help_, count, sum_, labels=None):
+        rows = self._family(name, "summary", help_)
+        rows.append(f"{name}_sum{_labels(labels)} {_num(sum_)}")
+        rows.append(f"{name}_count{_labels(labels)} {_num(count)}")
+
+    def histogram(self, name, help_, bounds, buckets, sum_, labels=None):
+        """bounds: bucket upper limits; buckets: PER-BUCKET counts with
+        one overflow bucket appended (len(bounds)+1)."""
+        rows = self._family(name, "histogram", help_)
+        acc = 0
+        for le, n in zip(bounds, buckets):
+            acc += n
+            lab = dict(labels or {})
+            lab["le"] = _num(le)
+            rows.append(f"{name}_bucket{_labels(lab)} {acc}")
+        total = acc + buckets[len(bounds)]
+        lab = dict(labels or {})
+        lab["le"] = "+Inf"
+        rows.append(f"{name}_bucket{_labels(lab)} {total}")
+        rows.append(f"{name}_sum{_labels(labels)} {_num(sum_)}")
+        rows.append(f"{name}_count{_labels(labels)} {total}")
+
+    def render(self) -> str:
+        out = []
+        for name in self._order:
+            typ, help_, rows = self._fam[name]
+            out.append(f"# HELP {name} {help_}")
+            out.append(f"# TYPE {name} {typ}")
+            out.extend(rows)
+        return "\n".join(out) + "\n"
 
 
 class Module(MgrModule):
@@ -23,34 +126,99 @@ class Module(MgrModule):
     # -- payload --------------------------------------------------------------
 
     def scrape_text(self) -> str:
-        lines = [
-            "# HELP ceph_health_status cluster health (0=OK 1=WARN)",
-            "# TYPE ceph_health_status gauge",
-            f"ceph_health_status "
-            f"{0 if self.get('health')['status'] == 'HEALTH_OK' else 1}",
-        ]
+        exp = Exposition()
+        self._scrape_cluster(exp)
+        self._scrape_daemon_perf(exp)
+        self._scrape_kernels(exp)
+        return exp.render()
+
+    def _scrape_cluster(self, exp: Exposition) -> None:
+        exp.gauge("ceph_health_status",
+                  "cluster health (0=OK 1=WARN)",
+                  0 if self.get("health")["status"] == "HEALTH_OK" else 1)
         m = self.get_osdmap()
-        lines += [
-            "# TYPE ceph_osd_up gauge",
-            f"ceph_osd_up "
-            f"{sum(1 for o in range(m.max_osd) if m.is_up(o))}",
-            "# TYPE ceph_osd_in gauge",
-            f"ceph_osd_in "
-            f"{sum(1 for o in range(m.max_osd) if m.exists(o) and m.osd_weight[o] > 0)}",
-            "# TYPE ceph_osdmap_epoch gauge",
-            f"ceph_osdmap_epoch {m.epoch}",
-        ]
+        exp.gauge("ceph_osd_up", "osds up",
+                  sum(1 for o in range(m.max_osd) if m.is_up(o)))
+        exp.gauge("ceph_osd_in", "osds in (weight > 0)",
+                  sum(1 for o in range(m.max_osd)
+                      if m.exists(o) and m.osd_weight[o] > 0))
+        exp.gauge("ceph_osdmap_epoch", "current osdmap epoch", m.epoch)
         for state, n in sorted(self.get("pg_summary").items()):
-            lines.append(f'ceph_pg_states{{state="{state}"}} {n}')
+            exp.gauge("ceph_pg_states", "pg count by state", n,
+                      {"state": state})
         df = self.get("df")
-        lines.append(f"ceph_cluster_total_objects {df['total_objects']}")
-        lines.append(f"ceph_cluster_bytes_used {df['total_bytes_used']}")
+        exp.gauge("ceph_cluster_total_objects",
+                  "objects across reporting osds", df["total_objects"])
+        exp.gauge("ceph_cluster_bytes_used",
+                  "bytes used across reporting osds",
+                  df["total_bytes_used"])
+        # legacy flat family (the OSD's own u64 counters) kept for
+        # existing dashboards; floats pass through untruncated
         for osd, counters in sorted(self.get("counters").items()):
             for name, val in sorted(counters.items()):
-                lines.append(
-                    f'ceph_osd_perf{{ceph_daemon="osd.{osd}",'
-                    f'counter="{name}"}} {int(val)}')
-        return "\n".join(lines) + "\n"
+                exp.counter("ceph_osd_perf", "osd u64 perf counters",
+                            val, {"ceph_daemon": f"osd.{osd}",
+                                  "counter": name})
+
+    def _scrape_daemon_perf(self, exp: Exposition) -> None:
+        """Typed perf dumps from MMgrReport v3: one family per counter
+        type, labelled by daemon / set / counter."""
+        for osd, sets in sorted(self.get("perf_reports").items()):
+            daemon = f"osd.{osd}"
+            for set_name, counters in sorted(sets.items()):
+                for cname, val in sorted(counters.items()):
+                    lab = {"ceph_daemon": daemon, "set": set_name,
+                           "counter": cname}
+                    if isinstance(val, dict) and "buckets" in val:
+                        exp.histogram(
+                            "ceph_daemon_perf_hist",
+                            "histogram-typed daemon perf counters",
+                            val["bounds"], val["buckets"],
+                            val.get("sum", 0.0), lab)
+                    elif isinstance(val, dict) and "avgcount" in val:
+                        exp.summary(
+                            "ceph_daemon_perf_latency",
+                            "time-avg daemon perf counters (seconds)",
+                            val["avgcount"], val["sum"], lab)
+                    else:
+                        exp.counter(
+                            "ceph_daemon_perf_counter",
+                            "u64 daemon perf counters", val, lab)
+
+    def _scrape_kernels(self, exp: Exposition) -> None:
+        reg = telemetry.registry()
+        # the two offload kernels always appear (zero-valued before
+        # first use) so dashboards and the format test can rely on the
+        # families existing
+        reg.kernel("ec_encode")
+        reg.kernel("crush_map")
+        for kname, d in sorted(telemetry.dump().items()):
+            p = f"ceph_kernel_{kname}"
+            lat = d["latency_seconds"]
+            bat = d["batch_size"]
+            exp.histogram(f"{p}_latency_seconds",
+                          f"wall time per {kname} device call "
+                          "(fenced = device time; see "
+                          "kernel_fence_for_timing)",
+                          lat["bounds"], lat["buckets"], lat["sum"])
+            exp.histogram(f"{p}_batch_size",
+                          f"batch occupancy per {kname} device call",
+                          bat["bounds"], bat["buckets"], bat["sum"])
+            exp.counter(f"{p}_calls_total",
+                        "completed device calls", d["calls"])
+            exp.counter(f"{p}_traced_total",
+                        "executions inlined under an outer jit trace",
+                        d["traced"])
+            exp.counter(f"{p}_jit_miss_total",
+                        "jit compile-cache misses (retrace+compile)",
+                        d["jit_misses"])
+            exp.counter(f"{p}_jit_hit_total",
+                        "calls served by a cached executable",
+                        d["jit_hits"])
+            exp.counter(f"{p}_bytes_in_total",
+                        "host to device operand bytes", d["bytes_in"])
+            exp.counter(f"{p}_bytes_out_total",
+                        "device to host result bytes", d["bytes_out"])
 
     # -- lifecycle ------------------------------------------------------------
 

@@ -310,7 +310,9 @@ class TcpConnection(Connection):
                         raise OSError("connection lost before write")
                     # frame at send time: the negotiated compression can
                     # change across a reconnect
-                    sock.sendall(self._frame(backlog[0]))
+                    frame = self._frame(backlog[0])
+                    sock.sendall(frame)
+                    self.messenger.count_sent(len(frame))
                     backlog.pop(0)
                 except OSError:
                     with self._lock:
@@ -370,6 +372,9 @@ class TcpConnection(Connection):
                     # a bad frame or handler bug must not kill the reader
                     try:
                         msg = Message.decode(data)
+                        # on-wire size (header + possibly-compressed
+                        # payload): matches the sender's count_sent
+                        msg.wire_bytes = _LEN.size + 1 + frame_len
                         msg.connection = self
                         self.messenger.deliver(msg)
                     except Exception:

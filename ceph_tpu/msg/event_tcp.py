@@ -505,6 +505,12 @@ class EventConnection(Connection):
             if self.out_off >= len(head):
                 self.out_frames.popleft()
                 self.out_off = 0
+                # count at FLUSH, not frame-build: fault-salvaged
+                # messages re-frame on reconnect and must only count
+                # per actual wire traversal (handshake frames carry no
+                # message and are not message traffic)
+                if _msg is not None:
+                    self.messenger.count_sent(len(head))
             else:
                 break
             if self.state == _OPEN:
@@ -559,7 +565,7 @@ class EventConnection(Connection):
                     raise ConnectionError(
                         f"decompressed frame exceeds cap from "
                         f"{self.peer_name}")
-            m.enqueue_dispatch(self, data)
+            m.enqueue_dispatch(self, data, wire_len=total)
 
     def _update_interest(self) -> None:
         if self.sock is None:
@@ -656,12 +662,13 @@ class EventMessenger(Messenger):
         self._deferred.append((fn, args))
         self.wakeup()
 
-    def enqueue_dispatch(self, con: EventConnection, data: bytes) -> None:
+    def enqueue_dispatch(self, con: EventConnection, data: bytes,
+                         wire_len: int = 0) -> None:
         with self._lock:
             self._dispatch_bytes += len(data)
             if self._dispatch_bytes >= self.DISPATCH_HIGH:
                 self.paused = True
-        self._dispatch_q.put((con, data))
+        self._dispatch_q.put((con, data, wire_len))
 
     def register_accepted(self, con: EventConnection) -> None:
         """Handshake done on an accepted session: index it so redials
@@ -882,9 +889,12 @@ class EventMessenger(Messenger):
             item = self._dispatch_q.get()
             if item is None or self._stop:
                 return
-            con, data = item
+            con, data, wire_len = item
             try:
                 msg = Message.decode(data)
+                # on-wire size (header + possibly-compressed payload):
+                # matches the sender's flush-time count_sent
+                msg.wire_bytes = wire_len or len(data)
                 msg.connection = con
                 self.deliver(msg)
             except Exception:

@@ -34,6 +34,7 @@ class LoopbackConnection(Connection):
             return
         # wire round-trip keeps encode/decode honest even in-process
         data = msg.encode()
+        self.messenger.count_sent(len(data))
         peer._enqueue(data, sender=self.messenger)
 
     def mark_down(self) -> None:
@@ -87,6 +88,7 @@ class LoopbackMessenger(Messenger):
             # one bad frame or handler bug must not kill the delivery thread
             try:
                 msg = Message.decode(data)
+                msg.wire_bytes = len(data)
                 msg.connection = self._make_connection(
                     sender.my_addr, sender.my_name)
                 self.deliver(msg)

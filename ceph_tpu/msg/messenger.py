@@ -121,6 +121,18 @@ class Messenger:
         #: an old peer
         self.local_features: int = SUPPORTED_FEATURES
         self._lock = threading.RLock()
+        # per-messenger wire counters (AsyncMessenger's l_msgr_* set);
+        # daemons register this into their context's collection
+        from ceph_tpu.common.perf_counters import PerfCountersBuilder
+        self.perf = (PerfCountersBuilder(f"msgr.{name}")
+                     .add_u64("msg_send").add_u64("msg_recv")
+                     .add_u64("bytes_send").add_u64("bytes_recv")
+                     .create_perf_counters())
+
+    def count_sent(self, nbytes: int) -> None:
+        """Transport send hook: one frame of nbytes left this endpoint."""
+        self.perf.inc("msg_send")
+        self.perf.inc("bytes_send", nbytes)
 
     @staticmethod
     def create(name: EntityName, mtype: str = "async", **kw) -> "Messenger":
@@ -171,6 +183,8 @@ class Messenger:
             self._dispatchers.append(d)
 
     def deliver(self, msg: Message) -> bool:
+        self.perf.inc("msg_recv")
+        self.perf.inc("bytes_recv", getattr(msg, "wire_bytes", 0))
         tb = None
         policy = self.policy_for(msg.connection.peer_name.type
                                  if msg.connection and msg.connection.peer_name

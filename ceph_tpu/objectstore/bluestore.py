@@ -95,6 +95,14 @@ class BlueStoreLite(ObjectStore):
         self._db = LogDB(os.path.join(path, "kv"))
         self._alloc = BitmapAllocator()
         self._f = None
+        # store-level perf set (l_bluestore_* analog); the owning daemon
+        # registers it into its context's collection
+        from ceph_tpu.common.perf_counters import PerfCountersBuilder
+        self.perf = (PerfCountersBuilder("bluestore")
+                     .add_u64("txc")
+                     .add_time_avg("commit_lat")
+                     .add_time_avg("apply_lat")
+                     .create_perf_counters())
         from ceph_tpu.common.lockdep import make_lock
         self._lock = make_lock(f"BlueStore::lock({path})")
         #: blocks displaced by the in-flight transaction batch; returned
@@ -481,6 +489,8 @@ class BlueStoreLite(ObjectStore):
 
 
     def queue_transactions(self, txns, on_commit=None) -> None:
+        import time as _time
+        t_start = _time.perf_counter()
         with self._lock:
             kvt = self._db.get_transaction()
             cache: dict[tuple, dict | None] = {}
@@ -527,7 +537,10 @@ class BlueStoreLite(ObjectStore):
                                         ensure, drop)
 
             try:
+                t_apply = _time.perf_counter()
                 apply_ops()
+                self.perf.tinc("apply_lat",
+                               _time.perf_counter() - t_apply)
             except Exception:
                 self._freed = []
                 self._wal_pending = {}
@@ -581,6 +594,8 @@ class BlueStoreLite(ObjectStore):
             self._wal_rms = []
             self._alloc.release(self._freed)
             self._freed = []
+            self.perf.inc("txc", len(txns))
+            self.perf.tinc("commit_lat", _time.perf_counter() - t_start)
         if on_commit:
             on_commit()
 

@@ -2,12 +2,18 @@
 
 gf_kernel       batched GF(2^8) matrix-vector products: erasure encode/decode.
 crush_kernel    rjenkins1 hashes, crush_ln, straw2 selection — batched over inputs.
+telemetry       stdlib-only kernel stats registry the entry points feed.
+
+The kernel exports resolve lazily (PEP 562): importing this package —
+or ceph_tpu.ops.telemetry, which the mgr's prometheus scraper and every
+CephTpuContext do — must not pull in jax/pallas.
 """
 
-from .gf_kernel import (
-    ec_encode_ref,
-    ec_encode_jax,
-    make_encoder,
-)
-
 __all__ = ["ec_encode_ref", "ec_encode_jax", "make_encoder"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from ceph_tpu.ops import gf_kernel
+        return getattr(gf_kernel, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

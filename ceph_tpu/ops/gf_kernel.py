@@ -46,6 +46,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ceph_tpu.gf.tables import bit_matrix, mul_table
+from ceph_tpu.ops import telemetry
 
 
 # ---------------------------------------------------------------------------
@@ -202,7 +203,13 @@ def _pick_bc(b: int) -> int | None:
 # public API
 # ---------------------------------------------------------------------------
 
-def _encode_dispatch(w_bits, w_blk, data, *, k, m, dot_dtype):
+def _jit_entries() -> int:
+    """Compile-cache entry count across the jitted entry points — the
+    telemetry retrace counter differences this around each call."""
+    return _encode_xla._cache_size() + _encode_pallas._cache_size()
+
+
+def _encode_dispatch_impl(w_bits, w_blk, data, *, k, m, dot_dtype):
     s, _, b = data.shape
     bc = _pick_bc(b)
     # batches below one grid step would pad up to _SB-1 all-zero
@@ -216,6 +223,17 @@ def _encode_dispatch(w_bits, w_blk, data, *, k, m, dot_dtype):
         out = _encode_pallas(w_blk, data, k=k, m=m, bc=bc)
         return out[:s] if pad else out
     return _encode_xla(w_bits, data, k=k, m=m, dot_dtype=dot_dtype)
+
+
+def _encode_dispatch(w_bits, w_blk, data, *, k, m, dot_dtype):
+    s, _, b = data.shape
+    return telemetry.timed_kernel(
+        "ec_encode",
+        lambda: _encode_dispatch_impl(w_bits, w_blk, data,
+                                      k=k, m=m, dot_dtype=dot_dtype),
+        batch=s, bytes_in=s * k * b, bytes_out=s * m * b,
+        cache_entries=_jit_entries,
+        signature=("ec", k, m, s, b, str(dot_dtype)))
 
 
 def ec_encode_jax(coeff: np.ndarray, data, dot_dtype=jnp.int8) -> jax.Array:

@@ -1,0 +1,304 @@
+"""Kernel telemetry at the JAX offload boundary.
+
+The repo's two batchable numeric kernels — GF(2^8) EC encode/decode
+(ops.gf_kernel) and CRUSH straw2 mapping (crush.mapper_jax) — are the
+dominant data path, yet the device boundary itself was uninstrumented.
+This module is the process-global registry those call sites feed:
+
+  * per-kernel wall-time histograms.  By default the sample is the
+    UNFENCED dispatch time (the async runtime acks before execution
+    completes); with ``fence_for_timing`` on, each instrumented call
+    blocks until the result is ready so the sample is real device
+    residency.  The knob is a config option (``kernel_fence_for_timing``)
+    because fencing serializes the pipeline — the hot path runs unfenced;
+  * batch-size/occupancy histograms (how full each device call is — the
+    whole thesis is batching, so occupancy IS the efficiency metric);
+  * host->device / device->host byte counters (input operand bytes and
+    result bytes crossing the boundary per call);
+  * jit compile-cache hit/miss counters.  A miss is a retrace+compile —
+    the silent throughput killer when shapes churn.  Counted from the
+    jitted entry point's own compile cache (``_cache_size`` delta) when
+    available, else from a seen-signature set the call site provides.
+
+Everything here is stdlib-only: importing this module never pulls in
+the kernel modules or pallas (the mgr's prometheus scraper and every
+CephTpuContext import it; ceph_tpu.ops resolves its kernel exports
+lazily for the same reason), and the instrumented call sites pass
+callables for anything device-flavored.
+
+Calls made UNDER an outer jit trace (the bench's chained ``lax.scan``
+loops, any user jit composing our kernels) return tracers: those are
+counted as ``traced`` executions but produce no latency/byte samples —
+a tracer has no wall time and fencing it would throw.
+
+Surfaces: ``dump()`` (admin socket ``dump_kernel_stats``), the mgr
+prometheus module (histogram families per kernel), and ``summary()``
+(bench.py's one-line digest: retraces, p50/p99 latency, occupancy).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+#: latency bucket upper bounds, seconds (log-spaced: 10 us .. 1 s; the
+#: remote-dispatch tunnel's ~0.9 ms step latency lands mid-range)
+LATENCY_BOUNDS = (
+    1e-5, 2e-5, 5e-5, 1e-4, 2e-4, 5e-4,
+    1e-3, 2e-3, 5e-3, 1e-2, 2e-2, 5e-2, 0.1, 0.25, 0.5, 1.0)
+
+#: batch-occupancy bucket upper bounds (stripes or lanes per call)
+BATCH_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+                2048, 4096, 8192, 16384, 32768, 65536)
+
+
+class Histogram:
+    """Cumulative-bucket histogram with a running sum (the Prometheus
+    histogram data model: ``le`` buckets + ``_sum`` + ``_count``)."""
+
+    __slots__ = ("bounds", "buckets", "sum")
+
+    def __init__(self, bounds):
+        self.bounds = tuple(bounds)
+        self.buckets = [0] * (len(self.bounds) + 1)   # last = +Inf
+        self.sum = 0.0
+
+    def add(self, value: float) -> None:
+        i = 0
+        for b in self.bounds:
+            if value <= b:
+                break
+            i += 1
+        self.buckets[i] += 1
+        self.sum += value
+
+    @property
+    def count(self) -> int:
+        return sum(self.buckets)
+
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile (upper bound of the bucket holding it);
+        0.0 with no samples."""
+        total = self.count
+        if not total:
+            return 0.0
+        rank = q * total
+        acc = 0
+        for i, n in enumerate(self.buckets):
+            acc += n
+            if acc >= rank:
+                return (self.bounds[i] if i < len(self.bounds)
+                        else self.bounds[-1])
+        return self.bounds[-1]
+
+    def dump(self) -> dict:
+        return {"bounds": list(self.bounds),
+                "buckets": list(self.buckets),
+                "sum": self.sum, "count": self.count}
+
+
+class KernelStats:
+    """Counters for one named kernel (e.g. "ec_encode", "crush_map")."""
+
+    __slots__ = ("name", "calls", "traced", "jit_misses", "jit_hits",
+                 "bytes_in", "bytes_out", "latency", "batch",
+                 "_signatures", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.calls = 0          # completed device calls (concrete result)
+        self.traced = 0         # executions under an outer jit trace
+        self.jit_misses = 0     # compile-cache misses (retrace+compile)
+        self.jit_hits = 0       # calls served by a cached executable
+        self.bytes_in = 0       # host->device operand bytes
+        self.bytes_out = 0      # device->host result bytes
+        self.latency = Histogram(LATENCY_BOUNDS)
+        self.batch = Histogram(BATCH_BOUNDS)
+        self._signatures: set = set()
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float, *, batch: int = 0, bytes_in: int = 0,
+               bytes_out: int = 0, misses: int = 0) -> None:
+        with self._lock:
+            self.calls += 1
+            self.latency.add(seconds)
+            if batch:
+                self.batch.add(batch)
+            self.bytes_in += bytes_in
+            self.bytes_out += bytes_out
+            if misses > 0:
+                self.jit_misses += misses
+            else:
+                self.jit_hits += 1
+
+    def note_signature(self, sig) -> bool:
+        """Fallback miss detector when the jit cache is not
+        introspectable: True (miss) the first time a shape signature is
+        seen."""
+        with self._lock:
+            if sig in self._signatures:
+                return False
+            self._signatures.add(sig)
+            return True
+
+    def dump(self) -> dict:
+        with self._lock:
+            return {
+                "calls": self.calls,
+                "traced": self.traced,
+                "jit_misses": self.jit_misses,
+                "jit_hits": self.jit_hits,
+                "bytes_in": self.bytes_in,
+                "bytes_out": self.bytes_out,
+                "latency_seconds": self.latency.dump(),
+                "batch_size": self.batch.dump(),
+            }
+
+
+class KernelTelemetry:
+    """The registry: one KernelStats per kernel name."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._kernels: dict[str, KernelStats] = {}
+        #: block_until_ready before closing each latency sample
+        self.fence_for_timing = False
+        #: master switch; off-path cost when False is one attribute read
+        self.enabled = True
+
+    def kernel(self, name: str) -> KernelStats:
+        ks = self._kernels.get(name)
+        if ks is None:
+            with self._lock:
+                ks = self._kernels.setdefault(name, KernelStats(name))
+        return ks
+
+    def dump(self) -> dict:
+        with self._lock:
+            kernels = list(self._kernels.values())
+        return {ks.name: ks.dump() for ks in kernels}
+
+    def reset(self) -> None:
+        """Drop all samples (tests/bench isolation).  Signature sets go
+        too, but jit caches live in jax — miss counting stays a delta
+        against the real cache, so reset never fabricates misses."""
+        with self._lock:
+            self._kernels.clear()
+
+    def summary(self) -> dict:
+        """Compact digest (bench.py prints this next to its JSON)."""
+        out = {}
+        for name, d in self.dump().items():
+            lat = d["latency_seconds"]
+            bat = d["batch_size"]
+            ks = self.kernel(name)
+            out[name] = {
+                "calls": d["calls"],
+                "retraces": d["jit_misses"],
+                "p50_ms": round(ks.latency.quantile(0.5) * 1e3, 3),
+                "p99_ms": round(ks.latency.quantile(0.99) * 1e3, 3),
+                "mean_batch": (round(bat["sum"] / bat["count"], 1)
+                               if bat["count"] else 0),
+                "gb_in": round(d["bytes_in"] / 1e9, 3),
+                "mean_ms": (round(lat["sum"] / lat["count"] * 1e3, 3)
+                            if lat["count"] else 0.0),
+            }
+        return out
+
+
+_REG = KernelTelemetry()
+
+
+def registry() -> KernelTelemetry:
+    return _REG
+
+
+def dump() -> dict:
+    return _REG.dump()
+
+
+def reset() -> None:
+    _REG.reset()
+
+
+def set_fence_for_timing(on: bool) -> None:
+    _REG.fence_for_timing = bool(on)
+
+
+def set_enabled(on: bool) -> None:
+    _REG.enabled = bool(on)
+
+
+def configure_from_conf(conf) -> None:
+    """Bind the fence knob to a context's config (option
+    ``kernel_fence_for_timing``), with hot reload via observer.
+
+    The registry is process-global while configs are per-context
+    (multi-daemon processes construct many): construction only turns
+    fencing ON when this conf explicitly enables it — it never resets
+    the global back to the default, or every later daemon/client
+    construction would silently undo an operator's `config set` on
+    another daemon.  Runtime changes propagate through the observer.
+    """
+    try:
+        if conf.get("kernel_fence_for_timing"):
+            set_fence_for_timing(True)
+        conf.add_observer("kernel_fence_for_timing",
+                          lambda _n, v: set_fence_for_timing(v))
+    except KeyError:   # option table without the knob (stripped config)
+        pass
+
+
+def timed_kernel(name: str, fn, *, batch: int = 0, bytes_in: int = 0,
+                 bytes_out: int = 0, cache_entries=None, signature=None):
+    """Run ``fn()`` (one device call) under telemetry.
+
+    cache_entries: zero-arg callable returning the current jit
+    compile-cache entry count for the kernel's entry points; the delta
+    across the call is the miss count.  signature: hashable shape key
+    used as the fallback miss detector when cache_entries is None or
+    fails.  Tracer results (outer jit trace in progress) are counted
+    but not timed.
+    """
+    if not _REG.enabled:
+        return fn()
+    ks = _REG.kernel(name)
+    before = None
+    if cache_entries is not None:
+        try:
+            before = cache_entries()
+        except Exception:
+            before = None
+    t0 = time.perf_counter()
+    out = fn()
+    if _is_tracer(out):
+        with ks._lock:
+            ks.traced += 1
+        return out
+    if _REG.fence_for_timing:
+        try:
+            import jax
+            jax.block_until_ready(out)
+        except Exception:
+            pass
+    dt = time.perf_counter() - t0
+    misses = 0
+    if before is not None:
+        try:
+            misses = max(0, cache_entries() - before)
+        except Exception:
+            before = None
+    if before is None and signature is not None:
+        misses = 1 if ks.note_signature(signature) else 0
+    ks.record(dt, batch=batch, bytes_in=bytes_in, bytes_out=bytes_out,
+              misses=misses)
+    return out
+
+
+def _is_tracer(x) -> bool:
+    # jax is only imported if the call site already produced a jax
+    # value; a numpy/no-jax result short-circuits on the module check
+    if type(x).__module__.split(".")[0] != "jax":
+        return False
+    import jax
+    return isinstance(x, jax.core.Tracer)

@@ -276,6 +276,11 @@ class OSDDaemon(Dispatcher):
                      .add_time_avg("op_w_latency")
                      .create_perf_counters())
         self.ctx.perf.add(self.perf)
+        # the messenger's and store's own counter sets live in the same
+        # collection: `perf dump` and the mgr report carry all of them
+        self.ctx.perf.add(self.msgr.perf)
+        if hasattr(self.store, "perf"):
+            self.ctx.perf.add(self.store.perf)
         from ceph_tpu.common.op_tracker import OpTracker
         self.op_tracker = OpTracker(
             complaint_time=float(
@@ -517,7 +522,8 @@ class OSDDaemon(Dispatcher):
         con = self.msgr.connect_to(mgr_addr, EntityName("mgr", mgr_rank))
         con.send_message(MMgrReport(
             osd_id=self.osd_id, counters=counters, pg_states=states,
-            num_objects=n_obj, bytes_used=n_bytes, pg_stats=pg_stats))
+            num_objects=n_obj, bytes_used=n_bytes, pg_stats=pg_stats,
+            perf=self.ctx.perf.dump()))
 
     ROTATING_REFRESH = 60.0
 
@@ -2713,6 +2719,7 @@ class OSDDaemon(Dispatcher):
             buf[op.offset:op.offset + len(op.data)] = op.data
             data = bytes(buf)
         self.perf.inc("ec_encode_stripes")
+        t_kernel = time.perf_counter()
         if si is not None and not replace and old_data:
             # ranged: encode ONLY the affected stripes (the batched
             # device call covers [s0, s1)); only those columns travel
@@ -2728,6 +2735,14 @@ class OSDDaemon(Dispatcher):
             shard_off, truncate = 0, True
             shard_len = len(next(iter(shards.values()))) if shards else 0
             sub = shards
+        # device residency on the op's timeline (and, via the trace id,
+        # in the cross-daemon span ring): a traced client op shows where
+        # its TPU time went
+        trk = getattr(msg, "_trk", None)
+        if trk is not None:
+            trk.mark_event(
+                "ec_encode kernel "
+                f"{(time.perf_counter() - t_kernel) * 1e3:.3f}ms")
         reply = MOSDOpReply(tid=msg.tid, result=0, epoch=self.osdmap.epoch)
         meta_t = Transaction()
         entry = self._log_write(pg, meta_t, msg.oid, is_delete=False,

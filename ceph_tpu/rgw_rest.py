@@ -1433,9 +1433,23 @@ class RgwRestServer:
     def __init__(self, ioctx, addr: str = "127.0.0.1:0",
                  compression: str = "none",
                  max_skew: float | None = 900.0, clock=time.time,
-                 lc_interval: float | None = None):
+                 lc_interval: float | None = None, ctx=None):
         self.gateway = S3Gateway(ioctx, compression=compression,
                                  clock=clock)
+        # gateway perf set (rgw's l_rgw_* counters): op counts by verb,
+        # bytes in/out, request latency — registered into the context's
+        # collection so `perf dump` and the prometheus scrape see it
+        from ceph_tpu.common.context import default_context
+        from ceph_tpu.common.perf_counters import PerfCountersBuilder
+        self.perf = (PerfCountersBuilder("rgw")
+                     .add_u64("req").add_u64("failed_req")
+                     .add_u64("get").add_u64("put").add_u64("delete")
+                     .add_u64("head").add_u64("post")
+                     .add_u64("bytes_recv").add_u64("bytes_sent")
+                     .add_time_avg("req_lat")
+                     .create_perf_counters())
+        self._perf_coll = (ctx or default_context()).perf
+        self._perf_coll.add(self.perf)
         self.keys: dict[str, str] = {}
         #: SigV4 freshness window in seconds (AWS: 15 min); None
         #: disables the check.  clock is injectable for tests.
@@ -1451,7 +1465,28 @@ class RgwRestServer:
         #: loop owning the sockets + a bounded handler pool, replacing
         #: the old thread-per-connection stdlib server
         self._frontend = AsyncHttpFrontend(
-            lambda req: _S3Request(self, req).handle(), addr)
+            lambda req: self._handle_counted(req), addr)
+
+    def _handle_counted(self, req) -> tuple[int, dict, bytes]:
+        """Request entry: route through _S3Request under the perf set.
+        An escaping exception (the frontend serves it as a 500) still
+        records latency and failed_req — req and req_lat avgcount must
+        never diverge."""
+        t0 = time.perf_counter()
+        self.perf.inc("req")
+        self.perf.inc("bytes_recv", len(req.body or b""))
+        verb = req.method.lower()
+        if verb in ("get", "put", "delete", "head", "post"):
+            self.perf.inc(verb)
+        status, body = 500, b""
+        try:
+            status, headers, body = _S3Request(self, req).handle()
+            return status, headers, body
+        finally:
+            if status >= 500:
+                self.perf.inc("failed_req")
+            self.perf.inc("bytes_sent", len(body or b""))
+            self.perf.tinc("req_lat", time.perf_counter() - t0)
 
     @property
     def addr(self) -> str:
@@ -1506,3 +1541,7 @@ class RgwRestServer:
         if self._lc_thread is not None:
             self._lc_thread.join(timeout=5)
         self._frontend.stop()
+        # deregister only if the collection still holds OUR set (a
+        # later gateway instance may have replaced it)
+        if self._perf_coll.get(self.perf.name) is self.perf:
+            self._perf_coll.remove(self.perf.name)

@@ -134,7 +134,13 @@ def test_cluster_distributes_deltas_live():
         full = len(encode_osdmap(mon.osdmap))
         for e, b in incs.items():
             assert len(b) < full / 4, (e, len(b), full)
-        # OSDs converged off the same stream
+        # OSDs converged off the same stream (their map pushes ride the
+        # subscription renew tick — wait for it like the client above)
+        deadline = time.time() + 10
+        while time.time() < deadline and any(
+                o.osdmap.epoch < mon.osdmap.epoch
+                for o in c.osds.values()):
+            time.sleep(0.05)
         for osd in c.osds.values():
             assert osd.osdmap.epoch == mon.osdmap.epoch
         # I/O still correct on the delta-built maps

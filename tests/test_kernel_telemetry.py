@@ -1,0 +1,402 @@
+"""Device-boundary telemetry: kernel stats at the JAX offload boundary,
+admin-socket surfaces, and the prometheus exposition format.
+
+The retrace-counter test is the load-bearing one: a compile-cache miss
+is a retrace+compile (the silent throughput killer), and the counter
+must see exactly one miss per distinct shape and zero on repeats.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import telemetry
+
+# chunk widths deliberately absent from every other suite: the jit
+# compile cache is process-global, so shape reuse across test files
+# would eat the misses this file asserts on
+K1, M1, B1 = 5, 2, 224
+K2, M2, B2 = 3, 4, 352
+
+
+def _encode(k, m, b, s=2, seed=0):
+    from ceph_tpu.ops.gf_kernel import ec_encode_jax, ec_encode_ref
+    rng = np.random.default_rng(seed)
+    coeff = rng.integers(1, 256, (m, k), dtype=np.uint8)
+    data = rng.integers(0, 256, (s, k, b), dtype=np.uint8)
+    out = np.asarray(ec_encode_jax(coeff, data))
+    assert (out == ec_encode_ref(coeff, data)).all()
+    return s * k * b, s * m * b
+
+
+# -- kernel stats -------------------------------------------------------------
+
+def test_ec_encode_sample_and_byte_accounting():
+    """N batched encodes -> exactly N latency samples, N batch samples,
+    and the exact operand/result byte totals."""
+    telemetry.reset()
+    n, bi, bo = 4, 0, 0
+    for i in range(n):
+        a, b = _encode(K1, M1, B1, s=3, seed=i)
+        bi, bo = bi + a, bo + b
+    d = telemetry.dump()["ec_encode"]
+    assert d["calls"] == n
+    assert d["latency_seconds"]["count"] == n
+    assert d["batch_size"]["count"] == n
+    assert d["batch_size"]["sum"] == 3 * n
+    assert d["bytes_in"] == bi
+    assert d["bytes_out"] == bo
+
+
+def test_jit_retrace_counter_exact():
+    """Two distinct (k, m, chunk) shapes -> exactly 2 compile-cache
+    misses; repeated same-shape calls -> 0 additional misses."""
+    telemetry.reset()
+    _encode(K1, M1, B1)
+    _encode(K2, M2, B2)
+    d = telemetry.dump()["ec_encode"]
+    assert d["jit_misses"] == 2, d
+    for _ in range(3):
+        _encode(K1, M1, B1)
+        _encode(K2, M2, B2)
+    d = telemetry.dump()["ec_encode"]
+    assert d["jit_misses"] == 2, d
+    assert d["jit_hits"] == 6
+    assert d["calls"] == 8
+
+
+def test_fence_for_timing_knob():
+    telemetry.reset()
+    telemetry.set_fence_for_timing(True)
+    try:
+        _encode(K1, M1, B1)
+    finally:
+        telemetry.set_fence_for_timing(False)
+    d = telemetry.dump()["ec_encode"]
+    assert d["latency_seconds"]["count"] == 1
+    assert d["latency_seconds"]["sum"] > 0
+
+
+def test_crush_do_rule_telemetry():
+    import jax.numpy as jnp
+
+    from ceph_tpu.crush import build_two_level_map
+    from ceph_tpu.crush.mapper_jax import BatchMapper
+
+    telemetry.reset()
+    m, _root, rid = build_two_level_map(4, 4)
+    bm = BatchMapper(m)
+    xs = jnp.arange(96, dtype=jnp.uint32)
+    rw = jnp.full(16, 0x10000, dtype=jnp.int64)
+    bm.do_rule(rid, xs, 3, rw)
+    bm.do_rule(rid, xs, 3, rw)
+    d = telemetry.dump()["crush_map"]
+    assert d["calls"] == 2
+    assert d["jit_misses"] == 1
+    assert d["jit_hits"] == 1
+    assert d["batch_size"]["sum"] == 192
+    assert d["bytes_in"] == 2 * (96 * 4 + 16 * 8)
+    assert d["bytes_out"] == 2 * 96 * 3 * 4
+
+
+def test_traced_calls_produce_no_latency_samples():
+    """Kernel calls inlined under an outer jit (the bench's chained
+    scans) count as traced, not as device calls."""
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops.gf_kernel import make_encoder
+
+    telemetry.reset()
+    rng = np.random.default_rng(7)
+    enc = make_encoder(rng.integers(1, 256, (M1, K1), dtype=np.uint8))
+    data = jnp.asarray(rng.integers(0, 256, (2, K1, B1), dtype=np.uint8))
+
+    @jax.jit
+    def step(d):
+        return enc(d)
+
+    step(data)
+    d = telemetry.dump()["ec_encode"]
+    assert d["traced"] >= 1
+    assert d["latency_seconds"]["count"] == 0
+
+
+# -- admin-socket surfaces ----------------------------------------------------
+
+def test_admin_socket_dump_kernel_stats_and_tracing():
+    from ceph_tpu.common import tracing
+    from ceph_tpu.common.context import CephTpuContext
+
+    telemetry.reset()
+    _encode(K1, M1, B1)
+    ctx = CephTpuContext("osd.99")
+    ks = ctx.admin.execute("dump_kernel_stats")
+    assert ks["ec_encode"]["calls"] == 1
+    assert "latency_seconds" in ks["ec_encode"]
+
+    with tracing.trace_ctx() as tid:
+        tracing.record("osd.99", "unit-test event")
+    rows = ctx.admin.execute("dump_tracing", trace_id=str(tid))
+    assert rows and rows[0]["event"] == "unit-test event"
+    # no filter: the stitched timeline includes our trace
+    assert any(r["trace_id"] == tid
+               for r in ctx.admin.execute("dump_tracing"))
+
+
+def test_fence_knob_is_a_config_option():
+    from ceph_tpu.common.context import CephTpuContext
+
+    ctx = CephTpuContext("client.knob")
+    assert telemetry.registry().fence_for_timing is False
+    ctx.conf.set("kernel_fence_for_timing", "true")
+    assert telemetry.registry().fence_for_timing is True
+    ctx.conf.set("kernel_fence_for_timing", "false")
+    assert telemetry.registry().fence_for_timing is False
+
+
+# -- prometheus exposition ----------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?P<labels>\{[^}]*\})? (?P<value>[^ ]+)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text: str):
+    """Strict line parser: returns {family: {"type", "help",
+    "samples": [(metric_name, labels_dict, float_value)]}} and raises
+    on any malformed line or sample without a preceding header."""
+    fams: dict = {}
+    declared: dict[str, dict] = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_ = rest.partition(" ")
+            declared.setdefault(name, {})["help"] = help_
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, typ = rest.partition(" ")
+            assert typ in ("gauge", "counter", "histogram", "summary",
+                           "untyped"), line
+            declared.setdefault(name, {})["type"] = typ
+            continue
+        assert not line.startswith("#"), f"bad comment line: {line!r}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        name = m.group("name")
+        fam = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[:-len(suffix)] if name.endswith(suffix) else None
+            if base and declared.get(base, {}).get("type") in (
+                    "histogram", "summary"):
+                fam = base
+                break
+        assert fam in declared, f"sample {name} has no TYPE/HELP header"
+        assert "type" in declared[fam] and "help" in declared[fam], name
+        labels = dict(_LABEL_RE.findall(m.group("labels") or ""))
+        value = float(m.group("value").replace("+Inf", "inf"))
+        fams.setdefault(fam, {**declared[fam], "samples": []})[
+            "samples"].append((name, labels, value))
+    return fams
+
+
+class _FakeMap:
+    max_osd = 2
+    epoch = 7
+    osd_weight = [0x10000, 0x10000]
+
+    def is_up(self, o):
+        return True
+
+    def exists(self, o):
+        return True
+
+
+class _FakeMgr:
+    """The minimal MgrDaemon surface the prometheus module reads."""
+
+    def __init__(self, perf_reports=None):
+        self._perf = perf_reports or {}
+
+    osdmap = _FakeMap()
+
+    def get(self, name):
+        return {
+            "health": {"status": "HEALTH_WARN"},
+            "pg_summary": {"active": 8, "peering": 1},
+            "df": {"total_objects": 12, "total_bytes_used": 34567},
+            "counters": {0: {"op_w": 3, "op_w_latency": 1.25}},
+            "perf_reports": self._perf,
+        }[name]
+
+    def get_store(self, key, default=None):
+        return default
+
+
+def _scrape(perf_reports=None) -> str:
+    from ceph_tpu.mgr.modules.prometheus import Module
+    mgr = _FakeMgr(perf_reports)
+    mod = Module.__new__(Module)
+    mod.mgr = mgr
+    return mod.scrape_text()
+
+
+def test_scrape_format_validity():
+    """Every line parses; every family has HELP/TYPE; histogram buckets
+    are cumulative over monotone le bounds and +Inf equals _count."""
+    telemetry.reset()
+    _encode(K1, M1, B1, s=3)
+    _encode(K1, M1, B1, s=3)
+    fams = parse_exposition(_scrape())
+
+    for want in ("ceph_pg_states", "ceph_cluster_total_objects",
+                 "ceph_cluster_bytes_used", "ceph_osd_perf"):
+        assert want in fams, sorted(fams)
+    # floats survive (int(val) used to truncate 1.25 to 1)
+    osd_perf = {(l["counter"]): v
+                for _n, l, v in fams["ceph_osd_perf"]["samples"]}
+    assert osd_perf["op_w_latency"] == 1.25
+
+    hist_fams = [f for f, d in fams.items() if d["type"] == "histogram"]
+    assert "ceph_kernel_ec_encode_latency_seconds" in hist_fams
+    assert "ceph_kernel_crush_map_latency_seconds" in hist_fams
+    for fam in hist_fams:
+        samples = fams[fam]["samples"]
+        by_series: dict = {}
+        for name, labels, value in samples:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            by_series.setdefault(key, {}) \
+                .setdefault(name.rsplit("_", 1)[-1]
+                            if not name.endswith("_bucket") else "bucket",
+                            []).append((labels.get("le"), value))
+        for key, parts in by_series.items():
+            buckets = parts.get("bucket", [])
+            assert buckets, (fam, key)
+            les = [float(le.replace("+Inf", "inf")) for le, _ in buckets]
+            assert les == sorted(les), (fam, les)
+            counts = [v for _le, v in buckets]
+            assert counts == sorted(counts), (fam, counts)   # cumulative
+            assert les[-1] == float("inf")
+            (_, total), = parts["count"]
+            assert counts[-1] == total, (fam, counts, total)
+            assert "sum" in parts, (fam, key)
+
+
+def test_scrape_emits_typed_daemon_perf():
+    """MMgrReport v3 typed dumps become counter/summary/histogram
+    families with untruncated float values."""
+    reports = {0: {
+        "osd.0": {"op_w": 5,
+                  "op_w_latency": {"avgcount": 2, "sum": 0.125}},
+        "msgr.osd.0": {"msg_send": 9, "bytes_send": 4096},
+        "bluestore": {"commit_lat": {"avgcount": 3, "sum": 1.5}},
+        "kern": {"lat": {"bounds": [0.1, 1.0], "buckets": [1, 2, 1],
+                         "sum": 2.25}},
+    }}
+    fams = parse_exposition(_scrape(reports))
+    ctr = {(l["set"], l["counter"]): v for _n, l, v
+           in fams["ceph_daemon_perf_counter"]["samples"]}
+    assert ctr[("msgr.osd.0", "msg_send")] == 9
+    assert ctr[("osd.0", "op_w")] == 5
+    lat = {(l["set"], l["counter"], n.rsplit("_", 1)[-1]): v
+           for n, l, v in fams["ceph_daemon_perf_latency"]["samples"]}
+    assert lat[("bluestore", "commit_lat", "sum")] == 1.5
+    assert lat[("bluestore", "commit_lat", "count")] == 3
+    assert lat[("osd.0", "op_w_latency", "sum")] == 0.125
+    assert fams["ceph_daemon_perf_hist"]["type"] == "histogram"
+    hist = fams["ceph_daemon_perf_hist"]["samples"]
+    inf_bucket = [v for n, l, v in hist
+                  if n.endswith("_bucket") and l.get("le") == "+Inf"]
+    assert inf_bucket == [4]
+
+
+# -- wire format --------------------------------------------------------------
+
+def test_mgr_report_v3_perf_roundtrip():
+    from ceph_tpu.mgr.daemon import MMgrReport
+    from ceph_tpu.msg.message import Message
+
+    perf = {"osd.1": {"op_w": 2,
+                      "op_w_latency": {"avgcount": 1, "sum": 0.5}},
+            "msgr.osd.1": {"msg_send": 11}}
+    msg = MMgrReport(osd_id=1, counters={"op_w": 2},
+                     pg_states={"active": 4}, num_objects=9,
+                     bytes_used=4096, perf=perf)
+    back = Message.decode(msg.encode())
+    assert back.osd_id == 1
+    assert back.counters == {"op_w": 2}
+    assert back.perf == perf
+    assert back.pg_states == {"active": 4}
+
+
+def test_messenger_wire_counters():
+    """Loopback send/recv bumps the messenger perf sets, and the counts
+    ride the v3 perf payload shape (set name msgr.<entity>)."""
+    import time as _t
+
+    from ceph_tpu.mgr.daemon import MMgrReport
+    from ceph_tpu.msg.messenger import (
+        ConnectionPolicy, Dispatcher, EntityName, Messenger)
+
+    class Sink(Dispatcher):
+        def __init__(self):
+            self.got = []
+
+        def ms_dispatch(self, msg):
+            self.got.append(msg)
+            return True
+
+    a = Messenger.create(EntityName("osd", 71), "loopback")
+    b = Messenger.create(EntityName("mgr", 72), "loopback")
+    sink = Sink()
+    for m in (a, b):
+        m.set_policy("osd", ConnectionPolicy.stateful_peer())
+    b.add_dispatcher_tail(sink)
+    try:
+        a.bind("lo:osd71")
+        b.bind("lo:mgr72")
+        a.start()
+        b.start()
+        con = a.connect_to("lo:mgr72", EntityName("mgr", 72))
+        con.send_message(MMgrReport(osd_id=71, counters={"op_w": 1}))
+        deadline = _t.time() + 5
+        while _t.time() < deadline and not sink.got:
+            _t.sleep(0.01)
+        assert sink.got
+        da = a.perf.dump()
+        db = b.perf.dump()
+        assert da["msg_send"] == 1
+        assert da["bytes_send"] > 0
+        assert db["msg_recv"] == 1
+        assert db["bytes_recv"] == da["bytes_send"]
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_bluestore_perf_counters(tmp_path):
+    from ceph_tpu.objectstore import Transaction, create_objectstore
+
+    store = create_objectstore("bluestore", str(tmp_path / "bs"))
+    store.mkfs_if_needed()
+    store.mount()
+    try:
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "o", 0, b"x" * 8192)
+        store.queue_transactions([t])
+        d = store.perf.dump()
+        assert d["txc"] == 1
+        assert d["commit_lat"]["avgcount"] == 1
+        assert d["commit_lat"]["sum"] > 0
+        assert d["apply_lat"]["avgcount"] == 1
+    finally:
+        store.umount()

@@ -93,7 +93,10 @@ def test_standby_promotion_on_active_death(cluster):
                     == ["mgr.1"])
         # generous: late in a full-suite run the 1-core host is slow
         assert _wait(map_settled, timeout=60.0), client.osdmap.mgr_db
-        assert not mgr1.is_active and not mgr1.host.modules
+        assert not mgr1.is_active
+        # module unload runs on the worker queue after the demotion
+        # flag flips — wait for it to drain instead of racing it
+        assert _wait(lambda: not mgr1.host.modules), mgr1.host.modules
         # kill the active: the mon promotes the standby, which loads
         # the module set and starts answering
         cluster.kill_mgr(0)

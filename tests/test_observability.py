@@ -30,6 +30,19 @@ def test_prometheus_exporter_end_to_end():
         assert "ceph_osd_up 2" in body
         assert "ceph_osdmap_epoch" in body
         assert 'ceph_osd_perf{ceph_daemon="osd.0"' in body
+        # proper exposition: headers on every family (the old exporter
+        # emitted ceph_pg_states / ceph_cluster_* headerless), typed
+        # daemon perf from the MMgrReport v3 payload, and the kernel
+        # histogram families
+        assert "# TYPE ceph_pg_states gauge" in body
+        assert "# TYPE ceph_cluster_total_objects gauge" in body
+        assert "# TYPE ceph_daemon_perf_latency summary" in body
+        assert 'set="msgr.osd.0"' in body
+        assert "# TYPE ceph_kernel_ec_encode_latency_seconds histogram" \
+            in body
+        assert "ceph_kernel_crush_map_latency_seconds_bucket" in body
+        from test_kernel_telemetry import parse_exposition
+        parse_exposition(body)   # every line parses, headers precede
         # 404 for other paths
         try:
             urllib.request.urlopen(f"http://127.0.0.1:{port}/nope",
