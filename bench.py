@@ -211,13 +211,21 @@ def main() -> None:
     # FENCED standalone calls — real per-call device residency samples —
     # before summarizing.  A retrace count above the handful of shapes
     # this harness uses is the regression tell.
+    from ceph_tpu.common import tracing
     from ceph_tpu.ops import telemetry
     telemetry.set_fence_for_timing(True)
+    # trace the fenced calls with a zero slow threshold: every one
+    # lands in the slow ring, so the JSON records a tail-latency digest
+    # (count + p99 root-span duration) next to the throughput headline
+    tracing.set_slow_threshold(0.0)
     for _ in range(3):
-        encode(data)
-        bm.do_rule(rid, xs, numrep, rw)
+        with tracing.trace_ctx(name="bench ec_encode", daemon="bench"):
+            encode(data)
+        with tracing.trace_ctx(name="bench crush_map", daemon="bench"):
+            bm.do_rule(rid, xs, numrep, rw)
     telemetry.set_fence_for_timing(False)
     kernel_summary = telemetry.registry().summary()
+    slow_traces = tracing.slow_summary()
 
     print(json.dumps({
         "metric": "ec encode+recover MB/s (k=8,m=4,4KiB chunks, batch=2048)",
@@ -239,6 +247,7 @@ def main() -> None:
         "c_crush_mpps": round(c_crush_mpps, 3),
         "crush_vs_c": round(crush_mpps / c_crush_mpps, 2),
         "kernel_telemetry": kernel_summary,
+        "slow_traces": slow_traces,
         "device": str(jax.devices()[0]),
     }))
 

@@ -450,14 +450,23 @@ class RadosClient(Dispatcher):
     def operate(self, pool_id: int, oid: str, ops: list[OSDOpField],
                 snapid: int = 0, direct: bool = False,
                 pgid: tuple[int, int] | None = None) -> MOSDOpReply:
-        c = self.aio_operate(pool_id, oid, ops, snapid=snapid,
-                             direct=direct, pgid=pgid)
-        if not c.wait_for_complete(self.timeout):
-            c.cancel()
-            raise TimeoutError(f"op {c.tid} on {oid} timed out")
-        if c.get_return_value() < 0:
-            raise OSError(-c.get_return_value(), f"op on {oid} failed")
-        return c.reply
+        # head sampling (tracing_sample_rate): an untraced op opens a
+        # trace at the configured rate, whose root span covers submit
+        # through reply — the tail-retention check then decides whether
+        # the completed trace is worth keeping.  Explicit trace_ctx
+        # callers pass through (already traced).
+        from ceph_tpu.common import tracing
+        with tracing.maybe_sampled(f"osd_op {oid}",
+                                   daemon=f"client.{self.client_id}"):
+            c = self.aio_operate(pool_id, oid, ops, snapid=snapid,
+                                 direct=direct, pgid=pgid)
+            if not c.wait_for_complete(self.timeout):
+                c.cancel()
+                raise TimeoutError(f"op {c.tid} on {oid} timed out")
+            if c.get_return_value() < 0:
+                raise OSError(-c.get_return_value(),
+                              f"op on {oid} failed")
+            return c.reply
 
     # -- pools ----------------------------------------------------------------
 

@@ -23,12 +23,19 @@ class AdminSocket:
         self._thread: threading.Thread | None = None
 
     def register_command(self, command: str, handler,
-                         help: str = "") -> None:
-        """handler(**kwargs) -> JSON-serializable (admin_socket.h:71)."""
+                         help: str = "", aliases: tuple = ()) -> None:
+        """handler(**kwargs) -> JSON-serializable (admin_socket.h:71).
+        aliases register additional spellings of the same command; help
+        output marks them as such instead of duplicating the text."""
         with self._lock:
-            if command in self._commands:
-                raise ValueError(f"admin command {command!r} already registered")
+            for name in (command, *aliases):
+                if name in self._commands:
+                    raise ValueError(
+                        f"admin command {name!r} already registered")
             self._commands[command] = (handler, help)
+            for alias in aliases:
+                self._commands[alias] = (handler,
+                                         f"alias for {command!r}")
 
     def unregister_command(self, command: str) -> None:
         with self._lock:

@@ -119,6 +119,15 @@ register_options([
     Option("osd_client_message_size_cap", OPT_INT, 256 << 20,
            "bytes of op payloads queued in the sharded op queue before "
            "dispatch threads block (front-door backpressure)"),
+    Option("tracing_sample_rate", OPT_FLOAT, 0.0,
+           "head-sampling probability for client ops (0 = trace only "
+           "explicitly opened traces; 1 = trace everything)"),
+    Option("tracing_slow_threshold", OPT_FLOAT, 0.5,
+           "root-span seconds at/above which a completed trace is "
+           "promoted into the slow-trace ring (tail retention) instead "
+           "of aging out with the rest"),
+    Option("tracing_slow_ring", OPT_INT, 64,
+           "completed slow traces retained per process"),
     Option("kernel_fence_for_timing", OPT_BOOL, False,
            "fence (block_until_ready) each instrumented device kernel "
            "call so telemetry latency samples are real device time; "

@@ -37,15 +37,22 @@ class CephTpuContext:
             lambda name, **kw: {name: self.conf.get(name)},
             "get one option")
         from ceph_tpu.common import tracing
+        tracing.configure_from_conf(self.conf)
         trace_dump = (lambda trace_id=None, **kw: tracing.dump(
             int(trace_id) if trace_id else None))
-        self.admin.register_command(
-            "dump_traces", trace_dump,
-            "stitched cross-daemon trace timelines")
-        # reference-style spelling of the same surface
+        # one command, one help string, one reference-style alias: both
+        # spellings serve the span-structured rows (span_id /
+        # parent_span_id / dur / attrs per row)
         self.admin.register_command(
             "dump_tracing", trace_dump,
-            "stitched cross-daemon trace timelines [trace_id]")
+            "span-structured cross-daemon trace timelines "
+            "[trace_id]: time-ordered rows with span_id, "
+            "parent_span_id, duration and attributes",
+            aliases=("dump_traces",))
+        self.admin.register_command(
+            "dump_slow_traces", lambda **kw: tracing.slow_traces(),
+            "completed traces retained by tail sampling (root span "
+            "over tracing_slow_threshold)")
         from ceph_tpu.ops import telemetry
         telemetry.configure_from_conf(self.conf)
         self.admin.register_command(

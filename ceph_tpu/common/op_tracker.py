@@ -8,7 +8,14 @@ commands the reference exposes: `dump_ops_in_flight` (live ops with
 age + their timeline), `dump_historic_ops` (a ring of recently
 completed ops, keeping the slowest), and flags ops older than the
 complaint threshold the way OSD::check_ops_in_flight feeds
-"N slow requests" into the cluster log.
+"N slow requests" into the cluster log.  `slow_digests` is the compact
+newest-slowest view daemons ship to the mgr in MMgrReport v4 (the
+insights module's cluster-wide `slow_ops` feed).
+
+Thread safety: events are appended by dispatch/worker threads and read
+by admin/tick threads, so every events-list mutation and read snapshot
+goes through the tracker lock (the reference guards TrackedOp state
+with OpTracker's sharded lock the same way).
 """
 
 from __future__ import annotations
@@ -29,7 +36,8 @@ class TrackedOp:
                                                  "initiated")]
         self._done = False
         # ops created while handling a traced message JOIN the trace:
-        # their per-op events become cross-daemon span events too
+        # their per-op events become span events attached to the
+        # handling thread's current span
         from ceph_tpu.common import tracing
         self.trace_id = tracing.current()
         if self.trace_id:
@@ -37,7 +45,10 @@ class TrackedOp:
                            self.trace_id)
 
     def mark_event(self, event: str) -> None:
-        self.events.append((time.time(), event))
+        # appended here, read by dump()/check_ops_in_flight() on other
+        # threads: the tracker lock guards both sides
+        with self.tracker._lock:
+            self.events.append((time.time(), event))
         if self.trace_id:
             from ceph_tpu.common import tracing
             tracing.record(self.tracker.daemon,
@@ -55,17 +66,23 @@ class TrackedOp:
 
     @property
     def duration(self) -> float:
-        return self.events[-1][0] - self.initiated_at
+        with self.tracker._lock:
+            return self.events[-1][0] - self.initiated_at
+
+    def _events_snapshot(self) -> list[tuple[float, str]]:
+        with self.tracker._lock:
+            return list(self.events)
 
     def dump(self) -> dict:
         t0 = self.initiated_at
+        events = self._events_snapshot()
         d = {"description": self.description,
              "initiated_at": t0,
              "age": round(self.age, 6),
-             "duration": round(self.duration, 6),
+             "duration": round(events[-1][0] - t0, 6),
              "type_data": {"events": [
                  {"time": round(t - t0, 6), "event": e}
-                 for t, e in self.events]}}
+                 for t, e in events]}}
         if self.trace_id:
             d["trace_id"] = self.trace_id
         return d
@@ -85,7 +102,10 @@ class OpTracker:
         self.history_size = history_size
         self.history_slow_size = history_slow_size
         self.history_slow_threshold = history_slow_threshold
-        self._lock = threading.Lock()
+        # RLock: mark_event fires under the lock from _unregister-free
+        # paths, and duration (which takes the lock) is read inside
+        # _unregister's critical section
+        self._lock = threading.RLock()
         self._inflight: dict[int, TrackedOp] = {}
         self._history: list[TrackedOp] = []       # recent completions
         self._slow_history: list[TrackedOp] = []  # slowest completions
@@ -123,12 +143,31 @@ class OpTracker:
                 "ops": [o.dump() for o in hist],
                 "slowest": [o.dump() for o in slow]}
 
+    def slow_digests(self, limit: int = 10) -> list[dict]:
+        """Compact slowest-completions view for MMgrReport v4: the
+        mgr insights module ranks these across every daemon."""
+        with self._lock:
+            slow = list(self._slow_history)[:limit]
+        out = []
+        for o in slow:
+            events = o._events_snapshot()
+            d = {"daemon": self.daemon,
+                 "description": o.description,
+                 "initiated_at": o.initiated_at,
+                 "duration": round(events[-1][0] - o.initiated_at, 6),
+                 "last_event": events[-1][1]}
+            if o.trace_id:
+                d["trace_id"] = o.trace_id
+            out.append(d)
+        return out
+
     def check_ops_in_flight(self) -> list[str]:
         """Ops past the complaint threshold ("slow request" warnings,
         OSD::check_ops_in_flight)."""
         now = time.time()
         with self._lock:
-            slow = [o for o in self._inflight.values()
+            slow = [(o, o.events[-1][1])
+                    for o in self._inflight.values()
                     if now - o.initiated_at > self.complaint_time]
         return [f"slow request {o.age:.3f}s: {o.description} "
-                f"(last event: {o.events[-1][1]})" for o in slow]
+                f"(last event: {last})" for o, last in slow]

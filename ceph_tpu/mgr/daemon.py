@@ -61,17 +61,23 @@ class MMgrReport(Message):
     perf dump of the daemon's whole counter collection (u64 counters,
     time-avg {avgcount, sum} pairs, histograms with bucket bounds —
     every set: osd, messenger, store), the payload the prometheus
-    module turns into real histogram/summary families.  Older peers
-    interoperate: the versioned section skips trailing fields."""
+    module turns into real histogram/summary families; v4 appends the
+    observability tail — the daemon's tail-sampled slow-trace digests
+    (span rows) and historic slow-op digests, the insights module's
+    cluster-wide `tracing ls` / `slow_ops` feed.  Older peers
+    interoperate: the versioned section skips trailing fields (old
+    mgrs simply never see the v4 tail)."""
 
     TYPE = 0x701
-    HEAD_VERSION = 3
+    HEAD_VERSION = 4
     COMPAT_VERSION = 1
 
     def __init__(self, osd_id: int = 0, counters: dict | None = None,
                  pg_states: dict | None = None, num_objects: int = 0,
                  bytes_used: int = 0, pg_stats: dict | None = None,
-                 perf: dict | None = None):
+                 perf: dict | None = None,
+                 slow_traces: list | None = None,
+                 slow_ops: list | None = None):
         super().__init__()
         self.osd_id = osd_id
         self.counters = counters or {}
@@ -82,9 +88,13 @@ class MMgrReport(Message):
         self.pg_stats = pg_stats or {}
         #: set name -> typed `perf dump` payload (PerfCountersCollection)
         self.perf = perf or {}
+        #: completed slow-trace digests (common/tracing slow ring)
+        self.slow_traces = slow_traces or []
+        #: slowest historic-op digests (OpTracker.slow_digests)
+        self.slow_ops = slow_ops or []
 
     def encode_payload(self, enc: Encoder):
-        enc.versioned(3, 1, lambda e: (
+        enc.versioned(4, 1, lambda e: (
             e.s32(self.osd_id),
             e.map(self.counters, lambda e2, k: e2.str(k),
                   lambda e2, v: e2.u64(int(v))),
@@ -95,13 +105,17 @@ class MMgrReport(Message):
                   _enc_pg_stat),
             # typed counter trees are irregular (per-type shapes);
             # JSON inside the versioned frame keeps the wire stable
-            e.str(json.dumps(self.perf))))
+            e.str(json.dumps(self.perf)),
+            e.str(json.dumps({"slow_traces": self.slow_traces,
+                              "slow_ops": self.slow_ops}))))
 
     def decode_payload(self, dec: Decoder, version):
         # decode constructs via __new__: every field needs a default
-        # here, v1 payloads carry no pg_stats, v2 no perf
+        # here, v1 payloads carry no pg_stats, v2 no perf, v3 no tail
         self.pg_stats = {}
         self.perf = {}
+        self.slow_traces = []
+        self.slow_ops = []
 
         def body(d, v):
             self.osd_id = d.s32()
@@ -115,7 +129,11 @@ class MMgrReport(Message):
                 self.pg_stats = d.map(lambda d2: d2.str(), _dec_pg_stat)
             if v >= 3:
                 self.perf = json.loads(d.str())
-        dec.versioned(3, body)
+            if v >= 4:
+                tail = json.loads(d.str())
+                self.slow_traces = tail.get("slow_traces", [])
+                self.slow_ops = tail.get("slow_ops", [])
+        dec.versioned(4, body)
 
 
 @register_message
@@ -411,6 +429,8 @@ class MgrDaemon(Dispatcher):
             return self.perf_reports()
         if data_name == "health":
             return self.health()
+        if data_name == "insights_feed":
+            return self.insights_feed()
         if data_name == "io_samples":
             with self._lock:
                 return {"current": {o: (t, dict(r.counters))
@@ -613,21 +633,59 @@ class MgrDaemon(Dispatcher):
             rows = [r for r in rows if r["state"] in states]
         return rows
 
+    def insights_feed(self) -> dict:
+        """Per-daemon observability tail from MMgrReport v4: slow-trace
+        digests and historic slow-op digests (the insights module's
+        cluster-wide ranking feed)."""
+        with self._lock:
+            return {o: {"slow_traces": list(r.slow_traces),
+                        "slow_ops": list(r.slow_ops),
+                        "stamp": t}
+                    for o, (t, r) in self.reports.items()}
+
+    #: fraction of existing OSDs that must be exceeded for OSD_DOWN to
+    #: escalate from WARN to ERR (mon_osd_down_out semantics reduced)
+    OSD_DOWN_ERR_RATIO = 0.5
+
     def health(self, stale_after: float = 10.0) -> dict:
+        """Structured health with severities: each check carries
+        severity "warn" or "error"; any error check makes the summary
+        HEALTH_ERR (the prometheus module exports 0=OK 1=WARN 2=ERR)."""
         now = time.time()
         with self._lock:
             stale = [o for o, (t, _r) in self.reports.items()
                      if now - t > stale_after]
         checks = []
         if stale:
-            checks.append({"check": "MGR_STALE_REPORTS", "osds": stale})
+            checks.append({"check": "MGR_STALE_REPORTS", "osds": stale,
+                           "severity": "warn"})
         summary = self.pg_summary()
         degraded = sum(n for s, n in summary.items()
                        if s not in ("active", "replica"))
         if degraded:
-            checks.append({"check": "PG_DEGRADED", "count": degraded})
-        return {"status": "HEALTH_OK" if not checks else "HEALTH_WARN",
-                "checks": checks}
+            checks.append({"check": "PG_DEGRADED", "count": degraded,
+                           "severity": "warn"})
+        m = self.osdmap
+        existing = [o for o in range(m.max_osd) if m.exists(o)]
+        down = [o for o in existing if not m.is_up(o)]
+        if down:
+            # strict majority down escalates to error (half down on an
+            # even-sized cluster is still WARN; a 1-osd cluster fully
+            # down IS a total outage and reads as error)
+            err = len(down) > len(existing) * self.OSD_DOWN_ERR_RATIO
+            checks.append({"check": "OSD_DOWN", "osds": down,
+                           "severity": "error" if err else "warn"})
+        failed = self.host.failed_modules()
+        if failed:
+            checks.append({"check": "MGR_MODULE_ERROR",
+                           "modules": failed, "severity": "error"})
+        if not checks:
+            status = "HEALTH_OK"
+        elif any(c["severity"] == "error" for c in checks):
+            status = "HEALTH_ERR"
+        else:
+            status = "HEALTH_WARN"
+        return {"status": status, "checks": checks}
 
     # -- module-feature delegates (pre-framework API kept working) ------------
 

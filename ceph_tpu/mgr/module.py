@@ -128,13 +128,17 @@ class ModuleHost:
 
     #: modules every active mgr runs regardless of the enabled set
     #: (MgrMap always_on_modules)
-    ALWAYS_ON = ("balancer", "iostat", "telemetry")
+    ALWAYS_ON = ("balancer", "iostat", "telemetry", "insights")
 
     def __init__(self, mgr: "MgrDaemon"):
         self.mgr = mgr
         self.modules: dict[str, MgrModule] = {}
         self._threads: dict[str, threading.Thread] = {}
         self._stopping: set[str] = set()
+        #: name -> repr(error) for modules whose load failed — feeds the
+        #: MGR_MODULE_ERROR health check (the reference marks such
+        #: modules failed in health the same way)
+        self.failed: dict[str, str] = {}
         self._lock = threading.RLock()
 
     # -- registry -------------------------------------------------------------
@@ -190,7 +194,9 @@ class ModuleHost:
                 inst.start()
             except Exception as e:
                 dout("mgr", 0, "module %s failed to load: %r", name, e)
+                self.failed[name] = repr(e)
                 return False
+            self.failed.pop(name, None)
             self.modules[name] = inst
             self._stopping.discard(name)
             if type(inst).serve is not MgrModule.serve:
@@ -212,6 +218,10 @@ class ModuleHost:
         with self._lock:
             inst = self.modules.pop(name, None)
             self._stopping.add(name)
+            # disabling a module is the remediation for a failed load:
+            # clear its health record or MGR_MODULE_ERROR would pin the
+            # cluster in HEALTH_ERR with no operator path out
+            self.failed.pop(name, None)
             t = self._threads.pop(name, None)
         if inst is not None:
             try:
@@ -228,6 +238,11 @@ class ModuleHost:
     def should_stop(self, inst: MgrModule) -> bool:
         return inst.NAME in self._stopping \
             or self.modules.get(inst.NAME) is not inst
+
+    def failed_modules(self) -> dict[str, str]:
+        """Modules whose load failed (health MGR_MODULE_ERROR feed)."""
+        with self._lock:
+            return dict(self.failed)
 
     # -- fan-out --------------------------------------------------------------
 

@@ -1,0 +1,129 @@
+"""Insights module — cluster-wide slow-trace and slow-op aggregation
+(src/pybind/mgr/insights reduced to the observability tier this repo
+needs).
+
+Every daemon ships its tail-sampled slow traces (completed span trees
+whose root crossed ``tracing_slow_threshold``) and its historic
+slow-op digests in MMgrReport v4; this module merges them across the
+cluster, ranks the slowest, and serves three mgr commands:
+
+  * ``tracing ls``        — slowest retained traces cluster-wide
+  * ``tracing show <id>`` — one trace's stitched span TREE (rows from
+                            every reporting daemon merged by span_id)
+  * ``slow_ops``          — slowest completed ops across all daemons
+
+The in-process MiniCluster shares one tracing table so every daemon
+reports the same ring (merged here by trace_id); multi-process daemons
+each ship only their own spans and the merge stitches the cross-daemon
+tree, exactly like zipkin collectors joining on trace id.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ceph_tpu.mgr.module import MgrModule
+
+
+class Module(MgrModule):
+    NAME = "insights"
+    COMMANDS = [
+        {"prefix": "tracing ls",
+         "help": "slowest tail-retained traces across all daemons"},
+        {"prefix": "tracing show",
+         "help": "render one trace's stitched span tree "
+                 "(trace_id=<id>)"},
+        {"prefix": "slow_ops",
+         "help": "slowest completed ops across all daemons"},
+    ]
+
+    # -- aggregation ----------------------------------------------------------
+
+    def _feed(self) -> dict:
+        return self.get("insights_feed")
+
+    def traces(self) -> dict[int, dict]:
+        """trace_id -> merged digest: rows unioned across reporting
+        daemons (dedup by (kind, span_id, event, t)), root metadata
+        from the richest report."""
+        merged: dict[int, dict] = {}
+        seen: dict[int, set] = {}
+        for osd, feed in sorted(self._feed().items()):
+            for digest in feed.get("slow_traces", []):
+                tid = digest.get("trace_id")
+                if tid is None:
+                    continue
+                cur = merged.get(tid)
+                if cur is None:
+                    cur = {"trace_id": tid,
+                           "root": digest.get("root"),
+                           "daemon": digest.get("daemon"),
+                           "duration": digest.get("duration", 0.0),
+                           "completed_at": digest.get("completed_at"),
+                           "reported_by": [],
+                           "rows": []}
+                    merged[tid] = cur
+                    seen[tid] = set()
+                cur["reported_by"].append(osd)
+                cur["duration"] = max(cur["duration"],
+                                      digest.get("duration", 0.0))
+                for r in digest.get("rows", []):
+                    key = (r.get("kind"), r.get("span_id"),
+                           r.get("event"), r.get("t"))
+                    if key in seen[tid]:
+                        continue
+                    seen[tid].add(key)
+                    cur["rows"].append(r)
+        for cur in merged.values():
+            cur["rows"].sort(key=lambda r: r.get("t", 0.0))
+        return merged
+
+    def tracing_ls(self, limit: int = 20) -> list[dict]:
+        ranked = sorted(self.traces().values(),
+                        key=lambda tr: -tr["duration"])[:limit]
+        return [{"trace_id": tr["trace_id"], "root": tr["root"],
+                 "daemon": tr["daemon"],
+                 "duration": tr["duration"],
+                 "n_rows": len(tr["rows"]),
+                 "reported_by": tr["reported_by"]}
+                for tr in ranked]
+
+    def tracing_show(self, trace_id: int) -> dict | None:
+        from ceph_tpu.common.tracing import tree_from_rows
+        tr = self.traces().get(trace_id)
+        if tr is None:
+            return None
+        return {"trace_id": trace_id, "duration": tr["duration"],
+                "reported_by": tr["reported_by"],
+                "tree": tree_from_rows(tr["rows"])}
+
+    def slow_ops(self, limit: int = 20) -> list[dict]:
+        ops = []
+        for _osd, feed in sorted(self._feed().items()):
+            ops.extend(feed.get("slow_ops", []))
+        # in-process daemons never collide (per-daemon trackers), but a
+        # re-reported digest from consecutive reports must not rank twice
+        uniq = {(o.get("daemon"), o.get("description"),
+                 o.get("initiated_at")): o for o in ops}
+        return sorted(uniq.values(),
+                      key=lambda o: -o.get("duration", 0.0))[:limit]
+
+    # -- command tier ---------------------------------------------------------
+
+    def handle_command(self, cmd: dict) -> tuple[str, int]:
+        prefix = cmd.get("prefix", "")
+        if prefix == "tracing ls":
+            limit = int(cmd.get("limit", 20))
+            return json.dumps({"traces": self.tracing_ls(limit)}), 0
+        if prefix == "tracing show":
+            raw = cmd.get("trace_id")
+            if raw is None:
+                return "tracing show needs trace_id=<id>", -22
+            out = self.tracing_show(int(raw))
+            if out is None:
+                return f"no retained trace {raw}", -2
+            return json.dumps(out), 0
+        if prefix == "slow_ops":
+            limit = int(cmd.get("limit", 20))
+            return json.dumps({"ops": self.slow_ops(limit)}), 0
+        return f"module {self.NAME} has no command {prefix!r}", -22

@@ -125,17 +125,22 @@ class Module(MgrModule):
 
     # -- payload --------------------------------------------------------------
 
+    #: health summary -> exposition value
+    HEALTH_VALUES = {"HEALTH_OK": 0, "HEALTH_WARN": 1, "HEALTH_ERR": 2}
+
     def scrape_text(self) -> str:
         exp = Exposition()
         self._scrape_cluster(exp)
         self._scrape_daemon_perf(exp)
+        self._scrape_slow_ops(exp)
         self._scrape_kernels(exp)
         return exp.render()
 
     def _scrape_cluster(self, exp: Exposition) -> None:
         exp.gauge("ceph_health_status",
-                  "cluster health (0=OK 1=WARN)",
-                  0 if self.get("health")["status"] == "HEALTH_OK" else 1)
+                  "cluster health (0=OK 1=WARN 2=ERR)",
+                  self.HEALTH_VALUES.get(
+                      self.get("health")["status"], 1))
         m = self.get_osdmap()
         exp.gauge("ceph_osd_up", "osds up",
                   sum(1 for o in range(m.max_osd) if m.is_up(o)))
@@ -184,6 +189,23 @@ class Module(MgrModule):
                         exp.counter(
                             "ceph_daemon_perf_counter",
                             "u64 daemon perf counters", val, lab)
+
+    def _scrape_slow_ops(self, exp: Exposition) -> None:
+        """Per-daemon slow-op counts from the MMgrReport v4 tail (the
+        insights feed); absent on hosts without the view (unit stubs)."""
+        try:
+            feed = self.get("insights_feed")
+        except Exception:
+            return
+        for osd, entry in sorted(feed.items()):
+            exp.gauge("ceph_daemon_slow_ops",
+                      "slow ops retained in the daemon's historic ring",
+                      len(entry.get("slow_ops", [])),
+                      {"ceph_daemon": f"osd.{osd}"})
+            exp.gauge("ceph_daemon_slow_traces",
+                      "tail-retained slow traces reported by daemon",
+                      len(entry.get("slow_traces", [])),
+                      {"ceph_daemon": f"osd.{osd}"})
 
     def _scrape_kernels(self, exp: Exposition) -> None:
         reg = telemetry.registry()

@@ -124,11 +124,15 @@ class EventConnection(Connection):
         if self._down:
             return
         from ceph_tpu.common import tracing
-        from ceph_tpu.msg.features import FEATURE_TRACE
+        from ceph_tpu.msg.features import FEATURE_TRACE, FEATURE_TRACE_SPANS
         if self.features & FEATURE_TRACE:
             # NEVER emit the trace header extension against a peer
             # that did not negotiate it (features.py's invariant)
             tracing.stamp(msg, str(self.messenger.my_name))
+            if not self.features & FEATURE_TRACE_SPANS:
+                # peer predates the v2 (trace_id, parent_span_id)
+                # extension: fall back to the v1 bare-u64 frame
+                msg.parent_span_id = 0
         m = self.messenger
         with m._lock:
             if self._down:

@@ -28,12 +28,13 @@ FEATURE_TRACE = 1 << 6              # frame-header trace extension
 #: advertised ONLY by ici-wire messengers (not in SUPPORTED_FEATURES):
 #: the peer can redeem staged-buffer tokens for bulk payloads
 FEATURE_ICI_TOKENS = 1 << 7
+FEATURE_TRACE_SPANS = 1 << 8        # v2 (trace_id, parent_span_id) ext
 
 #: everything this build speaks
 SUPPORTED_FEATURES = (FEATURE_BASE | FEATURE_WIRE_COMPRESSION
                       | FEATURE_CEPHX_TICKETS | FEATURE_INCREMENTAL_MAPS
                       | FEATURE_PG_STATS_V2 | FEATURE_EC_RMW_PIPELINE
-                      | FEATURE_TRACE)
+                      | FEATURE_TRACE | FEATURE_TRACE_SPANS)
 
 #: handshake frame: (supported u64, required u64) — ONE definition
 #: shared by both TCP stacks; they must parse each other byte-exact
@@ -49,6 +50,7 @@ _NAMES = {
     FEATURE_INCREMENTAL_MAPS: "incremental-maps",
     FEATURE_PG_STATS_V2: "pg-stats-v2",
     FEATURE_EC_RMW_PIPELINE: "ec-rmw-pipeline",
+    FEATURE_TRACE_SPANS: "trace-spans",
 }
 
 

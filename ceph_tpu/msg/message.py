@@ -17,11 +17,18 @@ _REGISTRY: dict[int, type] = {}
 
 _HEADER = struct.Struct("<IHBBQ I")   # type, flags, ver, compat, seq, len
 _FOOTER = struct.Struct("<I")         # crc32 of payload
-#: header flag bit 0: a trace extension (trace_id u64) follows the
-#: fixed header — untraced frames are byte-identical to the
+#: header flag bit 0: the v1 trace extension (trace_id u64) follows
+#: the fixed header — untraced frames are byte-identical to the
 #: pre-tracing format, so archived corpora still decode/re-encode
 _FLAG_TRACE = 0x1
 _TRACE_EXT = struct.Struct("<Q")
+#: header flag bit 1: the v2 SPAN trace extension
+#: (trace_id u64, parent_span_id u64) — emitted only when the sender
+#: carries a span parent (and, on wire stacks, only to peers that
+#: negotiated FEATURE_TRACE_SPANS); senders without a span parent
+#: keep emitting the v1 extension, so old peers keep decoding
+_FLAG_TRACE_SPAN = 0x2
+_TRACE_SPAN_EXT = struct.Struct("<QQ")
 
 
 def register_message(cls):
@@ -44,10 +51,14 @@ class Message:
         self.seq = 0
         #: filled by the messenger on receive: the Connection it arrived on
         self.connection = None
-        #: cross-daemon trace span id (0 = untraced); rides the frame
+        #: cross-daemon trace id (0 = untraced); rides the frame
         #: header extension and propagates through dispatch threads
         #: (common/tracing)
         self.trace_id = 0
+        #: sender-side span this message descends from (0 = none):
+        #: receivers parent their rx dispatch spans here, stitching
+        #: the cross-daemon span tree
+        self.parent_span_id = 0
 
     # subclasses implement:
     def encode_payload(self, enc: Encoder) -> None:
@@ -63,10 +74,18 @@ class Message:
         self.encode_payload(enc)
         payload = enc.tobytes()
         tid = getattr(self, "trace_id", 0)
-        flags = _FLAG_TRACE if tid else 0
+        psid = getattr(self, "parent_span_id", 0)
+        if tid and psid:
+            flags = _FLAG_TRACE_SPAN
+            ext = _TRACE_SPAN_EXT.pack(tid, psid)
+        elif tid:
+            flags = _FLAG_TRACE
+            ext = _TRACE_EXT.pack(tid)
+        else:
+            flags = 0
+            ext = b""
         header = _HEADER.pack(self.TYPE, flags, self.HEAD_VERSION,
                               self.COMPAT_VERSION, self.seq, len(payload))
-        ext = _TRACE_EXT.pack(tid) if tid else b""
         return header + ext + payload + _FOOTER.pack(zlib.crc32(payload))
 
     @staticmethod
@@ -76,7 +95,14 @@ class Message:
         mtype, flags, ver, compat, seq, plen = _HEADER.unpack_from(data, 0)
         start = _HEADER.size
         trace_id = 0
-        if flags & _FLAG_TRACE:
+        parent_span_id = 0
+        if flags & _FLAG_TRACE_SPAN:
+            if len(data) < start + _TRACE_SPAN_EXT.size:
+                raise DecodeError("truncated span trace extension")
+            trace_id, parent_span_id = \
+                _TRACE_SPAN_EXT.unpack_from(data, start)
+            start += _TRACE_SPAN_EXT.size
+        elif flags & _FLAG_TRACE:
             if len(data) < start + _TRACE_EXT.size:
                 raise DecodeError("truncated trace extension")
             (trace_id,) = _TRACE_EXT.unpack_from(data, start)
@@ -98,6 +124,7 @@ class Message:
         Message.__init__(msg)
         msg.seq = seq
         msg.trace_id = trace_id
+        msg.parent_span_id = parent_span_id
         msg.decode_payload(Decoder(payload), ver)
         return msg
 

@@ -194,14 +194,23 @@ class Messenger:
             policy.throttler_bytes.get(size)
             tb = (policy.throttler_bytes, size)
         tid = getattr(msg, "trace_id", 0)
-        prev_trace = 0
+        rx_span = None
+        prev_trace = (0, 0)
         if tid:
-            # the handling thread JOINS the trace: everything it sends
-            # while dispatching inherits the id (common/tracing.stamp)
+            # the handling thread JOINS the trace under an rx dispatch
+            # span parented to the sender's span (the frame's
+            # parent_span_id): everything it sends while dispatching
+            # inherits the ids (common/tracing.stamp), and work handed
+            # to shard queues re-parents here via the message
             from ceph_tpu.common import tracing
-            tracing.record(str(self.my_name),
-                           f"rx {type(msg).__name__}", tid)
-            prev_trace = tracing.set_current(tid)
+            rx_span = tracing.begin_span(
+                f"rx {type(msg).__name__}", str(self.my_name),
+                trace_id=tid,
+                parent_span_id=getattr(msg, "parent_span_id", 0))
+            if rx_span is not None:
+                msg.parent_span_id = rx_span.span_id
+            prev_trace = tracing.set_current(
+                tid, rx_span.span_id if rx_span else 0)
         try:
             with self._lock:
                 chain = list(self._dispatchers)
@@ -212,6 +221,7 @@ class Messenger:
         finally:
             if tid:
                 from ceph_tpu.common import tracing
+                tracing.finish_span(rx_span)
                 tracing.set_current(prev_trace)
             if tb:
                 tb[0].put(tb[1])

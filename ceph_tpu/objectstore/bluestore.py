@@ -489,6 +489,15 @@ class BlueStoreLite(ObjectStore):
 
 
     def queue_transactions(self, txns, on_commit=None) -> None:
+        # commit span on the calling op's trace: a traced write shows
+        # objectstore commit time next to network fan-out and device
+        # time (no-op context when the thread is untraced)
+        from ceph_tpu.common import tracing
+        with tracing.span("bluestore commit", daemon="bluestore",
+                          txns=len(txns)):
+            self._queue_transactions(txns, on_commit)
+
+    def _queue_transactions(self, txns, on_commit=None) -> None:
         import time as _time
         t_start = _time.perf_counter()
         with self._lock:

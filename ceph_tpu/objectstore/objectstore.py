@@ -110,9 +110,13 @@ class MemStore(ObjectStore):
     # -- transactions ---------------------------------------------------------
 
     def queue_transactions(self, txns, on_commit=None) -> None:
-        with self._lock:
-            for t in txns:
-                self._apply(t)
+        # commit span on the calling op's trace (no-op when untraced)
+        from ceph_tpu.common import tracing
+        with tracing.span("objectstore commit", daemon="objectstore",
+                          txns=len(txns)):
+            with self._lock:
+                for t in txns:
+                    self._apply(t)
         if on_commit:
             on_commit()
 
@@ -273,17 +277,20 @@ class FileStore(MemStore):
         self._mounted = False
 
     def queue_transactions(self, txns, on_commit=None) -> None:
+        from ceph_tpu.common import tracing
         frames = []
         for t in txns:
             blob = t.encode()
             frames.append(_JHDR.pack(len(blob), zlib.crc32(blob)) + blob)
-        with self._lock:
-            assert self._journal_f is not None, "not mounted"
-            self._journal_f.write(b"".join(frames))
-            self._journal_f.flush()
-            os.fsync(self._journal_f.fileno())  # durability point
-            for t in txns:
-                self._apply(t)
+        with tracing.span("objectstore commit", daemon="objectstore",
+                          txns=len(txns)):
+            with self._lock:
+                assert self._journal_f is not None, "not mounted"
+                self._journal_f.write(b"".join(frames))
+                self._journal_f.flush()
+                os.fsync(self._journal_f.fileno())  # durability point
+                for t in txns:
+                    self._apply(t)
         if on_commit:
             on_commit()
 

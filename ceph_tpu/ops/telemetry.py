@@ -263,6 +263,16 @@ def timed_kernel(name: str, fn, *, batch: int = 0, bytes_in: int = 0,
     if not _REG.enabled:
         return fn()
     ks = _REG.kernel(name)
+    # device span on the calling op's trace (common/tracing): a traced
+    # slow write shows WHERE its device time went — h2d operand bytes,
+    # compute wall time, d2h result bytes, and whether the call
+    # retraced.  Free when the thread is untraced (begin_span returns
+    # None on trace_id 0 without taking the table lock).
+    from ceph_tpu.common import tracing
+    dev_span = tracing.begin_span(f"device {name}", "device") \
+        if tracing.current() else None
+    if dev_span is not None and bytes_in:
+        tracing.span_event(dev_span, f"h2d {bytes_in}B")
     before = None
     if cache_entries is not None:
         try:
@@ -270,10 +280,21 @@ def timed_kernel(name: str, fn, *, batch: int = 0, bytes_in: int = 0,
         except Exception:
             before = None
     t0 = time.perf_counter()
-    out = fn()
+    try:
+        out = fn()
+    except BaseException:
+        # the failing call is the one most worth seeing in the trace:
+        # close the span instead of leaking it open (end=None)
+        if dev_span is not None:
+            tracing.set_attrs(dev_span, kernel=name, error=True)
+            tracing.finish_span(dev_span)
+        raise
     if _is_tracer(out):
         with ks._lock:
             ks.traced += 1
+        if dev_span is not None:
+            tracing.set_attrs(dev_span, kernel=name, traced=True)
+            tracing.finish_span(dev_span)
         return out
     if _REG.fence_for_timing:
         try:
@@ -292,6 +313,15 @@ def timed_kernel(name: str, fn, *, batch: int = 0, bytes_in: int = 0,
         misses = 1 if ks.note_signature(signature) else 0
     ks.record(dt, batch=batch, bytes_in=bytes_in, bytes_out=bytes_out,
               misses=misses)
+    if dev_span is not None:
+        tracing.span_event(dev_span, f"compute {dt * 1e3:.3f}ms")
+        if bytes_out:
+            tracing.span_event(dev_span, f"d2h {bytes_out}B")
+        tracing.set_attrs(dev_span, kernel=name, batch=batch,
+                          bytes_in=bytes_in, bytes_out=bytes_out,
+                          retrace=misses > 0,
+                          fenced=_REG.fence_for_timing)
+        tracing.finish_span(dev_span)
     return out
 
 

@@ -359,7 +359,9 @@ class OSDDaemon(Dispatcher):
         message)."""
         handler, msg, cost = item
         from ceph_tpu.common import tracing
-        prev = tracing.set_current(getattr(msg, "trace_id", 0))
+        # parent under the rx dispatch span deliver() stored on the msg
+        prev = tracing.set_current(getattr(msg, "trace_id", 0),
+                                   getattr(msg, "parent_span_id", 0))
         try:
             handler(msg)
         finally:
@@ -519,11 +521,16 @@ class OSDDaemon(Dispatcher):
                     "log_size": len(pg.log.entries),
                     "log_head": pg.log.head, "log_tail": tail}
         counters = dict(self.perf._u64)
+        # v4 tail: completed slow traces (tail-sampled span trees) and
+        # historic slow-op digests — the mgr insights module's feed
+        from ceph_tpu.common import tracing
         con = self.msgr.connect_to(mgr_addr, EntityName("mgr", mgr_rank))
         con.send_message(MMgrReport(
             osd_id=self.osd_id, counters=counters, pg_states=states,
             num_objects=n_obj, bytes_used=n_bytes, pg_stats=pg_stats,
-            perf=self.ctx.perf.dump()))
+            perf=self.ctx.perf.dump(),
+            slow_traces=tracing.slow_trace_digests(),
+            slow_ops=self.op_tracker.slow_digests()))
 
     ROTATING_REFRESH = 60.0
 
@@ -2025,7 +2032,8 @@ class OSDDaemon(Dispatcher):
         tid = getattr(msg, "trace_id", 0)
         from ceph_tpu.common import tracing
         if tid and tracing.current() != tid:
-            prev = tracing.set_current(tid)
+            prev = tracing.set_current(
+                tid, getattr(msg, "parent_span_id", 0))
             try:
                 return self._handle_op(msg)
             finally:

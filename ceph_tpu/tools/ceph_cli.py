@@ -68,6 +68,9 @@ COMMANDS = {
     ("config-key", "get"): ["key"],
     ("config-key", "rm"): ["key"],
     ("config-key", "dump"): [],
+    ("tracing", "ls"): [],
+    ("tracing", "show"): ["trace_id"],
+    ("slow_ops",): [],
 }
 
 #: prefixes served by the active MGR (re-targeted via `mgr dump`),
@@ -75,7 +78,8 @@ COMMANDS = {
 MGR_COMMANDS = {"pg dump", "pg ls", "iostat", "df", "balancer status",
                 "balancer optimize", "telemetry show",
                 "mgr module ls", "mgr module enable",
-                "mgr module disable", "osd pool autoscale-status"}
+                "mgr module disable", "osd pool autoscale-status",
+                "tracing ls", "tracing show", "slow_ops"}
 
 
 def parse_command(words: list[str]) -> dict:

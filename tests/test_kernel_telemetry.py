@@ -141,7 +141,11 @@ def test_admin_socket_dump_kernel_stats_and_tracing():
     with tracing.trace_ctx() as tid:
         tracing.record("osd.99", "unit-test event")
     rows = ctx.admin.execute("dump_tracing", trace_id=str(tid))
-    assert rows and rows[0]["event"] == "unit-test event"
+    # span-structured payload: the root span row precedes the event
+    assert rows and any(r["event"] == "unit-test event" for r in rows)
+    assert rows[0]["kind"] == "span"          # the trace's root span
+    ev = next(r for r in rows if r["event"] == "unit-test event")
+    assert ev["span_id"] == rows[0]["span_id"]   # attached to the root
     # no filter: the stitched timeline includes our trace
     assert any(r["trace_id"] == tid
                for r in ctx.admin.execute("dump_tracing"))
