@@ -128,6 +128,23 @@ register_options([
            "of aging out with the rest"),
     Option("tracing_slow_ring", OPT_INT, 64,
            "completed slow traces retained per process"),
+    Option("kernel_coalesce_max_stripes", OPT_INT, 2048,
+           "stripes per coalesced device call: the dispatch engine "
+           "stacks concurrent EC/CRUSH requests on the batch axis and "
+           "flushes when the batch reaches this many rows"),
+    Option("kernel_coalesce_max_delay_us", OPT_FLOAT, 250.0,
+           "microseconds a queued kernel request may wait for "
+           "coalescing company while the pipeline is busy; an idle "
+           "engine always flushes immediately, so single-op latency "
+           "never pays this"),
+    Option("kernel_dispatch_depth", OPT_INT, 2,
+           "device calls in flight per dispatch engine (2 = double "
+           "buffering: h2d of batch N+1 overlaps compute of batch N)"),
+    Option("osd_ec_dispatch_async", OPT_BOOL, True,
+           "submit EC write encodes through the dispatch engine and "
+           "run transaction-build + shard fan-out in the completion "
+           "continuation, letting concurrent client writes share one "
+           "device call; off = encode synchronously per op"),
     Option("kernel_fence_for_timing", OPT_BOOL, False,
            "fence (block_until_ready) each instrumented device kernel "
            "call so telemetry latency samples are real device time; "

@@ -59,6 +59,43 @@ class CephTpuContext:
             "dump_kernel_stats", lambda **kw: telemetry.dump(),
             "device-kernel telemetry: latency/batch histograms, "
             "byte counters, jit retrace counts")
+        #: lazily-built cross-op coalescing engine (ops.dispatch); one
+        #: per context, like every other service hung off it.  The
+        #: build is locked: two racing first callers splitting across
+        #: two engines would break per-key submission-order delivery
+        import threading
+        self._dispatch = None
+        self._dispatch_lock = threading.Lock()
+        self.admin.register_command(
+            "dump_dispatch_stats", lambda **kw: telemetry.dispatch_dump(),
+            "dispatch-engine telemetry: coalesce factor, queue "
+            "delay/depth, flush reasons, in-flight batches")
+
+    def dispatch_engine(self):
+        """The context's device dispatch engine (built on first use so
+        contexts that never touch a kernel spawn no threads).  The
+        coalescing knobs hot-reload through config observers."""
+        if self._dispatch is None:
+            with self._dispatch_lock:
+                if self._dispatch is not None:
+                    return self._dispatch
+                from ceph_tpu.ops.dispatch import DeviceDispatchEngine
+                eng = DeviceDispatchEngine(
+                    max_stripes=int(self.conf.get(
+                        "kernel_coalesce_max_stripes")),
+                    max_delay_us=float(self.conf.get(
+                        "kernel_coalesce_max_delay_us")),
+                    max_in_flight=int(self.conf.get(
+                        "kernel_dispatch_depth")),
+                    name=f"{self.name}-dispatch")
+                self.conf.add_observer(
+                    "kernel_coalesce_max_stripes",
+                    lambda _n, v: setattr(eng, "max_stripes", int(v)))
+                self.conf.add_observer(
+                    "kernel_coalesce_max_delay_us",
+                    lambda _n, v: setattr(eng, "max_delay_us", float(v)))
+                self._dispatch = eng
+        return self._dispatch
 
 
 _default: CephTpuContext | None = None

@@ -164,6 +164,24 @@ class ErasureCode(ErasureCodeInterface):
             self._encoder = make_encoder(coding)
         return self._encoder(np.asarray(data_chunks, dtype=np.uint8))
 
+    def submit_chunks(self, engine, data_chunks):
+        """Submit an (S, k, B) encode through a dispatch engine
+        (ops.dispatch): returns a DispatchFuture of the (S, m, B)
+        parity.  Concurrent submits against the same codec and chunk
+        width coalesce on the stripe axis into one device call; the
+        engine's zero-stripe padding is bit-exact here because the code
+        is linear (zeros encode to zeros)."""
+        data = np.asarray(data_chunks, dtype=np.uint8)
+        key = ("ec_encode", id(self), self.k, self.m, data.shape[-1],
+               self.runtime)
+        cache_entries = None
+        if self.runtime == "tpu":
+            from ceph_tpu.ops.gf_kernel import _jit_entries
+            cache_entries = _jit_entries
+        return engine.submit(key, self.encode_chunks, data,
+                             label="ec_encode",
+                             cache_entries=cache_entries)
+
     # -- decode (ErasureCode.cc:198-234 / ErasureCodeIsa.cc:150-310) ----------
 
     def _recovery(self, chosen: tuple, targets: tuple) -> np.ndarray:

@@ -198,6 +198,21 @@ class MgrDaemon(Dispatcher):
         self._pg_rows_cache: list[dict] | None = None
         self.host = ModuleHost(self)
         self._active = False
+        #: peer mgr names ever seen in a published MgrMap (active +
+        #: standbys, minus self).  An EMPTY map only implies "I am
+        #: active" while this is empty — once peers are known, a map
+        #: cleared by stale beacons during a mon election must NOT
+        #: self-promote every standby at once (two actives racing
+        #: mutating mon commands); wait for the mon to name one
+        self._peer_mgrs_seen: set[str] = set()
+        #: when the map first went (and stayed) empty, monotonic.  A
+        #: RESTARTED standby has an empty _peer_mgrs_seen too, so the
+        #: peers-seen guard alone can't stop it self-promoting next to
+        #: an incumbent riding out a transiently cleared map — implicit
+        #: active additionally waits out EMPTY_MAP_GRACE so a live mon
+        #: (which names an active within a tick of hearing a beacon)
+        #: always wins the race against self-promotion
+        self._empty_map_since: float | None = None
         #: work the DISPATCH thread must never do itself (module
         #: start/stop, command handling): those paths block on mon
         #: round-trips whose acks only the dispatch thread delivers —
@@ -267,6 +282,11 @@ class MgrDaemon(Dispatcher):
                 # next beacon past the mon's grace and demote a
                 # healthy active
                 self._work_q.put(("tick", None))
+            else:
+                # activation is normally map-driven (ms_dispatch), but
+                # implicit-active's EMPTY_MAP_GRACE can only expire
+                # here when no further map ever arrives (mon down)
+                self._check_activation()
         except (OSError, TimeoutError):
             pass
         self._rot_timer = threading.Timer(5.0, self._renew_tick)
@@ -332,22 +352,67 @@ class MgrDaemon(Dispatcher):
     def is_active(self) -> bool:
         return self._active
 
+    #: how long the map must be STABLY empty before a never-activated
+    #: mgr self-promotes.  A live mon names an active within a tick
+    #: (0.25 s) of hearing any beacon, and beacons ride the 5 s renew
+    #: timer — so whenever a mon can hear us, the named path always
+    #: beats this grace and implicit-active never fires.  It only
+    #: fires when no mon is reachable at all, where a brief dual
+    #: active cannot issue mutating mon commands anyway, and the mon's
+    #: first published map demotes the loser
+    EMPTY_MAP_GRACE = 3.0
+
     def _check_activation(self) -> None:
         """Compare the map's MgrMap against my name; load/unload the
         module set on the transition.  An EMPTY MgrMap (pre-first-
-        publish, or no mon leader) counts as active: single-mgr
-        clusters must serve before the map exists, and the mon
-        publishes within a tick of the first beacon."""
+        publish, or no mon leader) counts as active ONLY while no peer
+        mgr has ever appeared in a map AND the map has been empty past
+        EMPTY_MAP_GRACE: single-mgr clusters must serve before the map
+        exists (the mon publishes within a tick of the first beacon),
+        but once standbys are known an empty map means the mon lost
+        its beacons — every standby assuming the role would run two
+        actives' worth of mutating module commands — and a RESTARTED
+        standby (fresh peers-seen set) catching a transiently cleared
+        map must give the mon the grace window to name one first.  The
+        INCUMBENT active keeps the role across a transiently cleared
+        map (mon election churn): demoting it would stop and reload
+        every module seconds later for nothing."""
         db = self.osdmap.mgr_db or {}
-        want = (not db) or db.get("active_name") == str(self.name)
-        if want and not self._active:
-            self._active = True
+        me = str(self.name)
+        self._peer_mgrs_seen.update(
+            n for n in ([db.get("active_name")]
+                        + [s.get("name") for s in db.get("standbys", [])])
+            if n and n != me)
+        now = time.monotonic()
+        if db:
+            self._empty_map_since = None
+        elif self._empty_map_since is None:
+            self._empty_map_since = now
+        with self._lock:
+            # check-and-transition is atomic: this runs from both the
+            # dispatch thread (map receipt) and the renew timer (grace
+            # re-check when no further map arrives), and a double
+            # enqueue would load the module set twice
+            want = (db.get("active_name") == me
+                    or (not db and (self._active
+                                    or (not self._peer_mgrs_seen
+                                        and self._empty_map_since
+                                        is not None
+                                        and now - self._empty_map_since
+                                        >= self.EMPTY_MAP_GRACE))))
+            if want and not self._active:
+                self._active = True
+                flip = True
+            elif not want and self._active:
+                self._active = False
+                flip = False
+            else:
+                return
+        if flip:
             dout("mgr", 1, "%s taking over as ACTIVE", self.name)
-            self._work_q.put(("activation", True))
-        elif not want and self._active:
-            self._active = False
+        else:
             dout("mgr", 1, "%s demoted to standby", self.name)
-            self._work_q.put(("activation", False))
+        self._work_q.put(("activation", flip))
 
     def module_should_stop(self, inst) -> bool:
         return getattr(self, "_stopped", False) \

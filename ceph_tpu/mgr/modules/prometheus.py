@@ -134,6 +134,7 @@ class Module(MgrModule):
         self._scrape_daemon_perf(exp)
         self._scrape_slow_ops(exp)
         self._scrape_kernels(exp)
+        self._scrape_dispatch(exp)
         return exp.render()
 
     def _scrape_cluster(self, exp: Exposition) -> None:
@@ -241,6 +242,51 @@ class Module(MgrModule):
                         "host to device operand bytes", d["bytes_in"])
             exp.counter(f"{p}_bytes_out_total",
                         "device to host result bytes", d["bytes_out"])
+
+    def _scrape_dispatch(self, exp: Exposition) -> None:
+        """The cross-op coalescing engine (ops.dispatch): how many
+        requests share each device call, how long they queue for the
+        privilege, and how deep the pipeline runs."""
+        d = telemetry.dispatch_dump()
+        p = "ceph_kernel_coalesce"
+        exp.counter(f"{p}_submits_total",
+                    "requests submitted to the dispatch engine",
+                    d["submits"])
+        exp.counter(f"{p}_device_calls_total",
+                    "coalesced device calls dispatched", d["batches"])
+        exp.counter(f"{p}_completed_total",
+                    "requests delivered by the completion thread",
+                    d["completed"])
+        exp.counter(f"{p}_stripes_total",
+                    "stripes dispatched (pre-padding)",
+                    d["stripes_out"])
+        exp.counter(f"{p}_padded_stripes_total",
+                    "zero stripes added by power-of-two shape "
+                    "bucketing", d["padded_stripes"])
+        co = d["coalesce"]
+        exp.histogram(f"{p}_requests",
+                      "requests coalesced per device call (mass above "
+                      "1 is amortized dispatch latency)",
+                      co["bounds"], co["buckets"], co["sum"])
+        qd = d["queue_delay_seconds"]
+        exp.histogram(f"{p}_queue_delay_seconds",
+                      "submit-to-dispatch wait per request (idle "
+                      "flushes keep the single-op path near zero)",
+                      qd["bounds"], qd["buckets"], qd["sum"])
+        dep = d["queue_depth"]
+        exp.histogram(f"{p}_queue_depth",
+                      "engine backlog observed at each flush",
+                      dep["bounds"], dep["buckets"], dep["sum"])
+        for reason, n in sorted(d["flush_reasons"].items()):
+            exp.counter(f"{p}_flush_total",
+                        "batch flushes by reason (idle = no-wait "
+                        "single-op path; full/timeout = coalescing)",
+                        n, {"reason": reason})
+        exp.gauge(f"{p}_in_flight",
+                  "device calls currently outstanding", d["in_flight"])
+        exp.gauge(f"{p}_in_flight_max",
+                  "high-water mark of outstanding device calls",
+                  d["max_in_flight_seen"])
 
     # -- lifecycle ------------------------------------------------------------
 

@@ -415,12 +415,16 @@ class Monitor(Dispatcher):
         return self.msgr.my_addr
 
     def is_leader(self) -> bool:
-        return (self.elector is not None
-                and self.elector.leader == self.mon_id
-                and not self.elector.electing)
+        # snapshot: _maybe_reconfigure nulls self.elector (removed
+        # mon) from the dispatch thread while tick/command threads run
+        # this — a re-read between check and use races to None
+        e = self.elector
+        return (e is not None and e.leader == self.mon_id
+                and not e.electing)
 
     def quorum(self) -> list[int]:
-        return list(self.elector.quorum) if self.elector else []
+        e = self.elector
+        return list(e.quorum) if e else []
 
     # -- mon-to-mon plumbing --------------------------------------------------
 
@@ -498,7 +502,8 @@ class Monitor(Dispatcher):
         the entry through the ordinary `mon add` path so every consumer
         of the map finds the live address again."""
         db = self.osdmap.mon_db
-        if not db or self.elector is None or self.elector.electing:
+        e = self.elector
+        if not db or e is None or e.electing:
             return
         mine = db.get("mons", {}).get(str(self.mon_id))
         if mine is None or mine == self.addr:
@@ -511,8 +516,8 @@ class Monitor(Dispatcher):
                "addr": self.addr}
         if self.is_leader():
             self._work_q.put(("cmd", cmd, None))
-        elif self.elector.leader is not None:
-            self._send_mon(self.elector.leader,
+        elif e.leader is not None:
+            self._send_mon(e.leader,
                            MMonCommand(tid=0, cmd=cmd))
 
     def _current_mon_db(self) -> dict:
@@ -639,26 +644,32 @@ class Monitor(Dispatcher):
     def _request_election(self) -> None:
         # one election at a time: restarting every liveness tick would
         # bump the epoch faster than peers can ack and never converge
-        if self.elector and not self._stop and not self.elector.electing:
+        e = self.elector
+        if e and not self._stop and not e.electing:
             dout("mon", 5, "mon.%d calling new election", self.mon_id)
-            self.elector.start()
+            e.start()
 
     def _on_election_win(self, epoch: int, quorum: list[int]) -> None:
         dout("mon", 5, "mon.%d won election epoch %d quorum %s",
              self.mon_id, epoch, quorum)
         self._mds_watch_since = None    # fresh grace for every rank
-        self.paxos.leader_init(epoch, quorum)
+        p = self.paxos
+        if p is not None:
+            p.leader_init(epoch, quorum)
 
     def _on_election_lose(self, epoch: int, leader: int,
                           quorum: list[int]) -> None:
         dout("mon", 5, "mon.%d peon of mon.%d epoch %d", self.mon_id,
              leader, epoch)
-        self.paxos.peon_init(epoch, leader, quorum)
+        p = self.paxos
+        if p is not None:
+            p.peon_init(epoch, leader, quorum)
 
     def _on_paxos_active(self) -> None:
         """Leader finished the collect phase.  Bootstrap the very first
         map if the store is empty (must not block the calling thread)."""
-        if self.paxos.last_committed == 0:
+        p = self.paxos
+        if p is not None and p.last_committed == 0:
             self._work_q.put(("bootstrap", None, None))
 
     #: incremental history depth (the mon's map trimming: subscribers
@@ -711,13 +722,14 @@ class Monitor(Dispatcher):
 
     def _tick(self) -> None:
         try:
-            if self._probe_addrs and self.elector is None:
+            e, p = self.elector, self.paxos
+            if self._probe_addrs and e is None:
                 if time.time() - self._probe_last > 1.0:
                     self._send_probes()
-            if self.elector:
-                self.elector.tick()
-            if self.paxos:
-                self.paxos.tick()
+            if e:
+                e.tick()
+            if p:
+                p.tick()
             if self.is_leader() and self.osdmap.fs_db:
                 self._check_mds_failures()
             if self.is_leader():
@@ -978,7 +990,10 @@ class Monitor(Dispatcher):
         # carries the auth key table (peons/restarts restore it from
         # here); every client/OSD-facing broadcast re-encodes stripped
         blob = encode_osdmap(m, with_auth=True)
-        return self.paxos.propose_and_wait(blob)
+        p = self.paxos
+        if p is None:      # removed from the monmap mid-command
+            return False
+        return p.propose_and_wait(blob)
 
     def _auth_lookup(self, entity: str):
         """Entity secret for the handshake: the committed auth_db once
@@ -1007,7 +1022,8 @@ class Monitor(Dispatcher):
                          rotation_period=self.cephx_rotation)
 
     def _do_bootstrap(self) -> None:
-        if self.paxos.last_committed > 0:
+        p = self.paxos
+        if p is None or p.last_committed > 0:
             return
 
         def fn(m: OSDMap):
@@ -1037,12 +1053,14 @@ class Monitor(Dispatcher):
             self._handle_probe(msg)
             return True
         if isinstance(msg, MMonElection):
-            if self.elector:
-                self.elector.handle(msg)
+            e = self.elector
+            if e:
+                e.handle(msg)
             return True
         if isinstance(msg, MMonPaxos):
-            if self.paxos:
-                self.paxos.handle(msg)
+            p = self.paxos
+            if p:
+                p.handle(msg)
             return True
         if isinstance(msg, MMonCommand):
             self._handle_command_msg(msg)
@@ -1149,7 +1167,8 @@ class Monitor(Dispatcher):
                               (msg.connection, msg.tid, None)))
             return
         # peon: forward to the leader (MForward)
-        leader = self.elector.leader if self.elector else None
+        e = self.elector
+        leader = e.leader if e else None
         if leader is None or leader == self.mon_id:
             msg.connection.send_message(MMonCommandAck(
                 tid=msg.tid, result=-11, output="no quorum"))
@@ -1425,11 +1444,11 @@ class Monitor(Dispatcher):
                     return "commit failed", -11
                 return json.dumps({"max_mds": n}), 0
             if prefix == "quorum_status":
+                e = self.elector
                 return json.dumps({
                     "quorum": self.quorum(),
-                    "leader": self.elector.leader if self.elector else None,
-                    "election_epoch": self.elector.epoch
-                    if self.elector else 0}), 0
+                    "leader": e.leader if e else None,
+                    "election_epoch": e.epoch if e else 0}), 0
             if prefix == "log last":
                 n = int(cmd.get("num", 100))
                 return json.dumps(self.logstore.last(
@@ -1997,15 +2016,16 @@ class Monitor(Dispatcher):
             check("OSD_OUT", f"{len(out_osds)} osds out",
                   [f"osd.{o} is out" for o in out_osds], osds=out_osds)
         # MON_DOWN: monmap members absent from the current quorum
-        if self.elector is not None and self.monmap:
+        e = self.elector
+        if e is not None and self.monmap:
             q = set(self.quorum())
             missing = [r for r in sorted(self.monmap) if r not in q]
-            if missing and not self.elector.electing:
+            if missing and not e.electing:
                 check("MON_DOWN",
                       f"{len(missing)} mons down",
                       [f"mon.{r} is not in quorum" for r in missing],
                       mons=missing)
-        if self.elector is None or self.elector.electing:
+        if e is None or e.electing:
             check("MON_QUORUM_AT_RISK", "election in progress",
                   [f"last quorum {self.quorum()}"],
                   last_quorum=self.quorum())
@@ -2095,10 +2115,11 @@ class Monitor(Dispatcher):
     def status(self) -> dict:
         with self._lock:
             m = self.osdmap
+            e = self.elector
             return {
                 "epoch": m.epoch,
                 "quorum": self.quorum(),
-                "leader": self.elector.leader if self.elector else None,
+                "leader": e.leader if e else None,
                 "num_osds": sum(1 for o in range(m.max_osd) if m.exists(o)),
                 "num_up_osds": sum(1 for o in range(m.max_osd)
                                    if m.is_up(o)),

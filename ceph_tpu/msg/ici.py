@@ -88,6 +88,23 @@ class IciTransport:
         #: shot, so a resent frame must fail fast as transport loss —
         #: re-pulling a consumed uuid could block the dispatch thread
         self._pulled: dict[tuple[str, int], float] = {}
+        #: wire-mode pinned ledger: bytes of every await_pull
+        #: registration this process ever posted on the CURRENT
+        #: transfer server.  Registrations are one-shot and cannot be
+        #: cancelled, so the ledger only resets when the server itself
+        #: is recycled — unlike the _bufs registry, whose TTL reap
+        #: drops lost frames out of the outstanding() gauge while
+        #: their buffers stay pinned.  Registration times are monotone,
+        #: so "any registration still inside TTL" (the recycle
+        #: precondition) is just the NEWEST timestamp — a scalar, so
+        #: the per-stage quiet-window check never scans anything.
+        self._wire_newest_reg = 0.0
+        self.wire_pinned_bytes = 0  # gauge: sum of the ledger
+        self.wire_recycles = 0      # cumulative server recreations
+        #: bumped on every server swap: a stage that registered on the
+        #: old server sees the bump and re-registers instead of sending
+        #: a token that died with it (stage never takes _wire_lock)
+        self._wire_gen = 0
     # gauge: currently staged, unredeemed
 
     def outstanding(self) -> tuple[int, int]:
@@ -123,6 +140,18 @@ class IciTransport:
 
     _wire_lock = threading.Lock()
 
+    def _start_server(self):
+        """Bind a fresh transfer server (factored so tests and the
+        recycle path share one construction)."""
+        from jax.experimental import transfer
+        dev = self.jax.local_devices()[0]
+        # explicit socket transport addresses: the default local
+        # bulk transport only moves bytes within one process —
+        # peers in OTHER processes need the TCP bulk path
+        return transfer.start_transfer_server(
+            dev.client, "127.0.0.1:0",
+            transport_addresses=["127.0.0.1:0"])
+
     def enable_wire(self) -> str:
         """Start this process's jax transfer server (idempotent).
         Raises on backends without the transfer engine — callers fall
@@ -132,14 +161,7 @@ class IciTransport:
             # caller must never leak a second bound server
             if self._server is not None:
                 return self.server_addr
-            from jax.experimental import transfer
-            dev = self.jax.local_devices()[0]
-            # explicit socket transport addresses: the default local
-            # bulk transport only moves bytes within one process —
-            # peers in OTHER processes need the TCP bulk path
-            server = transfer.start_transfer_server(
-                dev.client, "127.0.0.1:0",
-                transport_addresses=["127.0.0.1:0"])
+            server = self._start_server()
             self._server = server
             self.server_addr = server.address()
             return self.server_addr
@@ -149,17 +171,117 @@ class IciTransport:
         return self._server is not None
 
     #: wire mode: the transfer server's one-shot pull registrations
-    #: cannot be cancelled, so a lost frame pins its buffer until
-    #: process exit.  The leak is BOUNDED: past this many outstanding
-    #: unredeemed bytes, staging refuses and the payload rides the TCP
-    #: frame inline instead (the documented fallback)
+    #: cannot be cancelled, and a successful remote pull is invisible
+    #: to the sender, so two limits govern staging.  WIRE_STAGE_CAP
+    #: bounds the RECENT window (the TTL-reaped registry gauge: bytes
+    #: staged and unredeemed in the last 30 s) — flow control that
+    #: healthy traffic recovers from on its own.  The pinned LEDGER
+    #: counts every registration since the server last started (lost
+    #: frames stay pinned until the server dies, pulled ones the
+    #: engine releases — the sender cannot tell which is which): past
+    #: half of WIRE_STAGE_CAP the transport opportunistically recycles
+    #: the server in any TTL-quiet window, and past WIRE_PIN_HARD_CAP
+    #: it refuses outright until a recycle succeeds, so worst-case
+    #: pinned memory under sustained frame loss is hard-bounded while
+    #: loss-free traffic never stalls before the hard cap
     WIRE_STAGE_CAP = 256 << 20
+    WIRE_PIN_HARD_CAP = 4 * WIRE_STAGE_CAP
 
     def can_stage(self, nbytes: int) -> bool:
         if self._server is None:
             return True      # in-process buffers reap on TTL
-        _n, outstanding = self.outstanding()
-        return outstanding + nbytes <= self.WIRE_STAGE_CAP
+        _n, recent = self.outstanding()     # takes _reg_lock itself
+        with self._reg_lock:
+            recent_ok = recent + nbytes <= self.WIRE_STAGE_CAP
+            ledger_ok = (self.wire_pinned_bytes + nbytes
+                         <= self.WIRE_PIN_HARD_CAP)
+            if (recent_ok and ledger_ok
+                    and self.wire_pinned_bytes
+                    <= self.WIRE_STAGE_CAP // 2):
+                return True
+        if self._recycle_wire_server(nbytes):
+            return True
+        return recent_ok and ledger_ok
+
+    @staticmethod
+    def _close_server(server) -> None:
+        """Best-effort explicit teardown of a transfer server being
+        discarded.  Dropping the Python reference is the documented
+        release mechanism, but if the wrapper exposes an explicit
+        shutdown, call it — relying on GC alone would let a retained
+        reference keep the old server (and every pinned one-shot
+        registration) alive while the ledger reports zero."""
+        for m in ("shutdown", "close", "stop"):
+            fn = getattr(server, m, None)
+            if callable(fn):
+                try:
+                    fn()
+                except Exception:
+                    pass
+                return
+
+    def _recycle_wire_server(self, nbytes: int) -> bool:
+        """The pinned ledger is past its threshold.  If every
+        registration is past TTL — no in-flight frame can legitimately
+        still redeem — replace the transfer server: dropping it
+        releases EVERY orphaned one-shot registration in one stroke
+        (the only release mechanism the transfer engine offers).
+        Tokens still in the wild die as transport loss; op-level
+        retries resend, exactly like any reaped in-process buffer.
+        Returns whether staging may proceed."""
+        now = time.monotonic()
+        # cheap pre-check outside _wire_lock: while traffic is flowing
+        # (no TTL-quiet window) a recycle cannot succeed, and every
+        # sender past the opportunistic threshold would otherwise
+        # serialize on _wire_lock here just to learn that
+        with self._reg_lock:
+            if (self._server is not None
+                    and now - self._wire_newest_reg < self.TTL
+                    and self.wire_pinned_bytes + nbytes
+                    > self.WIRE_STAGE_CAP // 2):
+                return False
+        with self._wire_lock:
+            with self._reg_lock:
+                if self._server is None:
+                    return True
+                if (self.wire_pinned_bytes + nbytes
+                        <= self.WIRE_STAGE_CAP // 2):
+                    return True     # raced another recycler
+                if now - self._wire_newest_reg < self.TTL:
+                    return False    # a recent frame may still redeem
+            try:
+                server = self._start_server()
+            except Exception:
+                return False
+            with self._reg_lock:
+                if time.monotonic() - self._wire_newest_reg < self.TTL:
+                    # a stage committed a registration while the new
+                    # server was binding: its frame is in the wild on
+                    # the CURRENT server, so the swap would lose it.
+                    # Drop the fresh (registration-free) server instead
+                    self._close_server(server)
+                    return False
+                old = self._server
+                self._server = server
+                self.server_addr = server.address()
+                self._wire_gen += 1
+                self._wire_newest_reg = 0.0
+                self.wire_pinned_bytes = 0
+                self.wire_recycles += 1
+                # local registry entries pointed at the old server's
+                # registrations: their buffers are released with it
+                self._bufs.clear()
+                # cached pull connections were created FROM the old
+                # server (server.connect) and die with it — keeping
+                # them would both break every later redemption from
+                # those peers and keep the old server alive, defeating
+                # the release this recycle exists for
+                self._peer_conns.clear()
+            self._close_server(old)
+        from ceph_tpu.common.logging import dout
+        dout("ms", 1, "ici: recycled transfer server (pinned ledger "
+             "at cap, all registrations past TTL)")
+        return True
 
     def stage(self, chunk: bytes, peer: EntityName) -> bytes:
         """Place the payload on a device; returns the token the frame
@@ -181,17 +303,47 @@ class IciTransport:
             self._reap_locked(now)
             self._seq += 1
             token = self._seq
-            self._bufs[token] = {"buf": buf, "nbytes": len(chunk),
-                                 "staged_at": now, "redeemed_at": None}
+            entry = self._bufs[token] = {"buf": buf, "nbytes": len(chunk),
+                                         "staged_at": now,
+                                         "redeemed_at": None}
             self.bytes_staged += len(chunk)
             self.transfers += 1
-        if self._server is not None:
-            self._server.await_pull(token, [buf])
-            addr = self.server_addr.encode()
+        # wire mode: await_pull runs OUTSIDE the locks (senders never
+        # serialize on each other, nor behind a recycle's server bind);
+        # the ledger commit then re-checks the server generation — a
+        # recycle that swapped the server in between killed the
+        # registration just made, so re-register on the live server.
+        # The recycle side re-checks the quiet window at swap time, so
+        # a COMMITTED registration can never die in a swap.
+        while True:
+            with self._reg_lock:
+                server, gen = self._server, self._wire_gen
+            if server is None:
+                return _MARKER + token.to_bytes(8, "little")
+            try:
+                server.await_pull(token, [buf])
+            except Exception:
+                # the snapshotted server may have been recycled (and
+                # explicitly closed) under us — retry on the live one;
+                # a failure on the CURRENT server is genuine
+                with self._reg_lock:
+                    if self._wire_gen != gen:
+                        continue
+                raise
+            with self._reg_lock:
+                if self._wire_gen != gen:
+                    continue
+                # a recycle between the registry insert above and the
+                # gen snapshot wiped _bufs: re-assert the entry (same-
+                # process redemption reads it) before publishing the
+                # token.  Idempotent when no recycle intervened.
+                self._bufs[token] = entry
+                self._wire_newest_reg = time.monotonic()
+                self.wire_pinned_bytes += len(chunk)
+                addr = self.server_addr.encode()
             return (_MARKER_X + token.to_bytes(8, "little")
                     + len(chunk).to_bytes(8, "little")
                     + len(addr).to_bytes(2, "little") + addr)
-        return _MARKER + token.to_bytes(8, "little")
 
     def redeem(self, blob: bytes) -> bytes:
         if blob.startswith(_MARKER_X):

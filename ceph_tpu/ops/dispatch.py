@@ -1,0 +1,477 @@
+"""Cross-op device-call coalescing: the async dispatch engine.
+
+The GF(2^8) kernel sustains TB/s device-resident while the end-to-end
+headline sits near the remote-dispatch tunnel's floor: every OSD EC
+write used to issue its own synchronous device call and eat the ~0.9 ms
+dispatch latency alone (ops/gf_kernel.py header).  This module closes
+that gap the way serving systems do (Clipper's adaptive batching;
+"The Tail at Scale"'s keep-the-pipeline-full): concurrent requests from
+DIFFERENT ops/PGs stack on the batch axis into ONE padded device call.
+
+Three mechanisms, one engine:
+
+* **cross-op coalescing** — ``submit(key, fn, data)`` queues the
+  request; the dispatch thread collects every queued request with the
+  same ``key`` (same kernel + operand identity + trailing shape) into
+  one call.  Flush policy: immediately while the engine is idle (a lone
+  op never waits — single-op latency cannot regress), else accumulate
+  until ``max_stripes`` or ``max_delay_us``, whichever first.  The
+  batch is self-clocking: while batch N computes, batch N+1's requests
+  pile up, exactly the adaptive-batching feedback loop.
+
+* **shape bucketing** — the coalesced batch rounds UP to a power-of-two
+  stripe count with all-zero padding rows (bit-exact for every kernel
+  here: zeros encode to zeros under a linear code, and padded CRUSH
+  lanes are sliced off before delivery).  The jit compile cache is then
+  bounded by the bucket table, not by the distribution of client write
+  sizes.
+
+* **async double-buffered submission** — the dispatch thread issues the
+  device call (the runtime acks before execution: h2d of batch N+1
+  overlaps compute of batch N) and a completion thread materializes
+  results in FIFO order, resolving per-request futures/continuations.
+  ``max_in_flight`` bounds outstanding device calls (2 = classic double
+  buffering).
+
+Delivery-order contract: completions for one ``key`` are delivered in
+submission order, on a single completion thread.  The OSD leans on this
+for per-object log/commit ordering (osd/daemon._ec_write_committed).
+
+Everything here is numpy + threading; jax enters only through the
+``fn`` callables the submitters pass, so importing this module never
+pulls in the kernel stack (same rule as ops.telemetry).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ceph_tpu.ops import telemetry
+
+
+class DispatchFuture:
+    """Completion handle for one submitted request.
+
+    Callbacks added before completion run on the engine's completion
+    thread, in batch order then submission order — the delivery-order
+    contract continuations rely on.  Callbacks added after completion
+    run inline on the caller.
+    """
+
+    __slots__ = ("_ev", "_value", "_exc", "_cbs", "_lock")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._value = None
+        self._exc: BaseException | None = None
+        self._cbs: list = []
+        self._lock = threading.Lock()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: float | None = None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("dispatch result not ready")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    def exception(self, timeout: float | None = None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("dispatch result not ready")
+        return self._exc
+
+    def add_done_callback(self, cb) -> None:
+        with self._lock:
+            if not self._ev.is_set():
+                self._cbs.append(cb)
+                return
+        cb(self)
+
+    def _deliver(self, value, exc: BaseException | None) -> None:
+        with self._lock:
+            self._value = value
+            self._exc = exc
+            self._ev.set()
+            cbs, self._cbs = self._cbs, []
+        for cb in cbs:
+            try:
+                cb(self)
+            except Exception as e:
+                from ceph_tpu.common.logging import dout
+                dout("dispatch", 0, "dispatch continuation failed: %r", e)
+
+
+class _Request:
+    __slots__ = ("key", "fn", "data", "stripes", "future", "t_submit",
+                 "label", "cache_entries", "trace", "span")
+
+    def __init__(self, key, fn, data, stripes, label=None,
+                 cache_entries=None):
+        self.key = key
+        self.fn = fn
+        self.data = data
+        self.stripes = stripes
+        self.future = DispatchFuture()
+        self.t_submit = time.monotonic()
+        self.label = label if label is not None else (
+            key[0] if isinstance(key, tuple) and key
+            and isinstance(key[0], str) else "dispatch")
+        self.cache_entries = cache_entries
+        # a traced submitter gets a per-request device span covering
+        # the coalesced call (timed_kernel's span runs on the engine
+        # thread, outside every op's trace context)
+        from ceph_tpu.common import tracing
+        tid = tracing.current()
+        self.trace = (tid, tracing.current_span()) if tid else None
+        self.span = None
+
+
+class _Batch:
+    __slots__ = ("out", "reqs", "slices", "exc", "t_dispatch", "misses")
+
+    def __init__(self, out, reqs, slices, exc=None, t_dispatch=0.0,
+                 misses=None):
+        self.out = out
+        self.reqs = reqs
+        self.slices = slices
+        self.exc = exc
+        self.t_dispatch = t_dispatch
+        self.misses = misses
+
+
+def bucket_stripes(n: int) -> int:
+    """Power-of-two shape bucket for a batch of n rows (n >= 1)."""
+    return 1 << max(0, (n - 1).bit_length())
+
+
+class DeviceDispatchEngine:
+    """Per-CephContext coalescing dispatcher for batched device kernels.
+
+    ``submit(key, fn, data)``: data is a numpy array whose LEADING axis
+    is the coalesce axis (stripes for EC, x-lanes for CRUSH); fn maps a
+    batched array of the same trailing shape to a device (or host)
+    array with the matching leading axis.  All requests sharing ``key``
+    must be mutually batchable (same fn semantics, same trailing
+    shape); the key should therefore encode the operand identity and
+    the trailing dimensions.
+    """
+
+    def __init__(self, *, max_stripes: int = 2048,
+                 max_delay_us: float = 250.0, max_in_flight: int = 2,
+                 name: str = "dispatch", stats=None):
+        self.max_stripes = int(max_stripes)
+        self.max_delay_us = float(max_delay_us)
+        self.max_in_flight = max(1, int(max_in_flight))
+        self.name = name
+        self.stats = stats if stats is not None \
+            else telemetry.dispatch_stats()
+        self._cv = threading.Condition()
+        self._pending: deque[_Request] = deque()
+        #: per-key pending stripe totals, maintained incrementally so
+        #: the flush-policy checks never rescan the queue
+        self._key_totals: dict = {}
+        self._inflight: deque[_Batch] = deque()
+        self._building = 0          # batches being built/dispatched
+        self._stop = False
+        self._threads: list[threading.Thread] = []
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def _ensure_threads(self) -> None:
+        if self._threads:
+            return
+        for tgt, suffix in ((self._dispatch_loop, "submit"),
+                            (self._complete_loop, "complete")):
+            t = threading.Thread(target=tgt, daemon=True,
+                                 name=f"{self.name}-{suffix}")
+            self._threads.append(t)
+            t.start()
+
+    def stop(self) -> bool:
+        """Drain queued work, then stop both threads.  Returns True
+        when both exited; a thread surviving its join timeout (wedged
+        device call) stays in _threads so a later stop() can re-join."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = [t for t in self._threads if t.is_alive()]
+        return not self._threads
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Wait for the queues to drain (futures may still be resolving
+        for the last popped batch — wait on them for hard ordering)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while (self._pending or self._building or self._inflight):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(min(left, 0.05))
+        return True
+
+    # -- submit ---------------------------------------------------------------
+
+    def submit(self, key, fn, data, *, label=None,
+               cache_entries=None) -> DispatchFuture:
+        data = np.asarray(data)
+        stripes = int(data.shape[0]) if data.ndim else 1
+        req = _Request(key, fn, data, stripes, label=label,
+                       cache_entries=cache_entries)
+        with self._cv:
+            if not self._stop:
+                self._ensure_threads()
+                self._pending.append(req)
+                self._key_totals[req.key] = (
+                    self._key_totals.get(req.key, 0) + stripes)
+                self.stats.record_submit(stripes)
+                self._cv.notify_all()
+                return req.future
+        # engine stopped: run inline so callers never hang.  First wait
+        # out any still-draining queues — stop() lets the threads finish
+        # every queued batch, and an inline run jumping that drain would
+        # break the per-key submission-order contract the OSD's EC
+        # log/commit ordering rides on.  Timed waits, not a bare wait:
+        # the exiting threads' last notify may already have fired.
+        with self._cv:
+            while self._pending or self._building or self._inflight:
+                self._cv.wait(0.05)
+        # inline OUTSIDE the engine lock, so a device call here never
+        # serializes concurrent submit()/flush()/stop() callers
+        # (and future callbacks never fire under the lock)
+        req.future._deliver(*self._run_inline(fn, data))
+        return req.future
+
+    @staticmethod
+    def _run_inline(fn, data):
+        try:
+            return np.asarray(fn(data)), None
+        except BaseException as e:     # noqa: BLE001 — delivered to waiter
+            return None, e
+
+    # -- dispatch thread ------------------------------------------------------
+
+    def _key_stripes(self, key) -> int:
+        return self._key_totals.get(key, 0)
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._stop:
+                    self._cv.wait()
+                if not self._pending:
+                    if self._stop:
+                        self._cv.notify_all()
+                        return
+                    continue
+                first = self._pending[0]
+                deadline = first.t_submit + self.max_delay_us * 1e-6
+                # accumulate while the pipeline is busy; an idle engine
+                # flushes immediately (lone ops never wait).  A ripe
+                # batch (full OR past deadline) still waits for a free
+                # in-flight slot — max_in_flight is a hard bound on
+                # outstanding device calls, not just a deadline gate
+                while not self._stop:
+                    now = time.monotonic()
+                    in_use = len(self._inflight) + self._building
+                    if in_use == 0:
+                        break              # idle: flush immediately
+                    if in_use < self.max_in_flight and (
+                            self._key_stripes(first.key)
+                            >= self.max_stripes
+                            or now >= deadline):
+                        break              # ripe + slot free
+                    self._cv.wait(max(1e-4, min(deadline - now, 0.05))
+                                  if now < deadline else 0.05)
+                # collect the batch in ONE pass, partitioning the
+                # oldest request's key out of the deque: per-key FIFO
+                # is preserved (once size-capped, no later same-key
+                # request may jump into this batch), and nothing is
+                # rescanned or removed one-by-one
+                reqs: list[_Request] = []
+                keep: deque[_Request] = deque()
+                total = 0
+                capped = False
+                for r in self._pending:
+                    if r.key != first.key or capped:
+                        keep.append(r)
+                    elif reqs and total + r.stripes > self.max_stripes:
+                        capped = True
+                        keep.append(r)
+                    else:
+                        reqs.append(r)
+                        total += r.stripes
+                self._pending = keep
+                left = self._key_totals.get(first.key, 0) - total
+                if left > 0:
+                    self._key_totals[first.key] = left
+                else:
+                    self._key_totals.pop(first.key, None)
+                if self._stop:
+                    reason = "stop"
+                elif capped or total >= self.max_stripes:
+                    reason = "full"    # size-capped, incl. next-would-overflow
+                elif not (self._inflight or self._building):
+                    reason = "idle"
+                else:
+                    reason = "timeout"
+                depth = len(self._pending) + len(reqs)
+                self._building += 1
+            self._dispatch_batch(reqs, total, reason, depth)
+
+    def _dispatch_batch(self, reqs: list[_Request], total: int,
+                        reason: str, depth: int) -> None:
+        """Build the padded batch and issue the device call (runs
+        OUTSIDE the engine lock: a first-shape call traces+compiles)."""
+        now = time.monotonic()
+        bucket = bucket_stripes(total)
+        pad = bucket - total
+        # slices first (pure arithmetic, cannot fail): the completion
+        # thread zips reqs against slices, so every request must have
+        # one even when the batch build below dies
+        slices, off = [], 0
+        for r in reqs:
+            slices.append((off, off + r.stripes))
+            off += r.stripes
+        exc = None
+        out = None
+        misses = None
+        try:
+            # everything fallible — pad allocation / concatenate
+            # (MemoryError under pressure, shape mismatch), span
+            # bookkeeping, the device call itself — lands in exc and
+            # fans to the batch's futures; an exception here must
+            # never kill the dispatch thread (a dead thread strands
+            # every outstanding future and the OSD wpend gates behind
+            # them)
+            arrays = [r.data for r in reqs]
+            if pad:
+                arrays.append(np.zeros((pad,) + reqs[0].data.shape[1:],
+                                       dtype=reqs[0].data.dtype))
+            batch_arr = arrays[0] if len(arrays) == 1 \
+                else np.concatenate(arrays, axis=0)
+            traced = [r for r in reqs if r.trace is not None]
+            if traced:
+                from ceph_tpu.common import tracing
+                for r in traced:
+                    r.span = tracing.begin_span(
+                        f"device {r.label}", "device",
+                        trace_id=r.trace[0], parent_span_id=r.trace[1])
+                    if r.span is not None:
+                        tracing.span_event(r.span, f"h2d {r.data.nbytes}B")
+            before = None
+            if reqs[0].cache_entries is not None:
+                try:
+                    before = reqs[0].cache_entries()
+                except Exception:
+                    before = None
+            out = reqs[0].fn(batch_arr)     # async dispatch on jax
+            if before is not None:
+                try:
+                    misses = max(0, reqs[0].cache_entries() - before)
+                except Exception:
+                    misses = None
+        except BaseException as e:          # noqa: BLE001 — fan to futures
+            exc = e
+        finally:
+            try:
+                self.stats.record_batch(
+                    requests=len(reqs), stripes=total, padded=pad,
+                    reason=reason, delays=[now - r.t_submit for r in reqs],
+                    depth=depth)
+            except Exception:
+                pass
+            with self._cv:
+                self._building -= 1
+                self._inflight.append(_Batch(out, reqs, slices, exc,
+                                             t_dispatch=time.monotonic(),
+                                             misses=misses))
+                self.stats.set_in_flight(len(self._inflight)
+                                         + self._building)
+                self._cv.notify_all()
+
+    # -- completion thread ----------------------------------------------------
+
+    def _complete_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._inflight:
+                    if (self._stop and not self._pending
+                            and not self._building):
+                        return
+                    self._cv.wait(0.05 if self._stop else None)
+                batch = self._inflight[0]
+            host, exc = None, batch.exc
+            if exc is None:
+                try:
+                    host = np.asarray(batch.out)   # blocks until ready
+                except BaseException as e:         # noqa: BLE001
+                    exc = e
+            with self._cv:
+                self._inflight.popleft()
+                self.stats.set_in_flight(len(self._inflight)
+                                         + self._building)
+                self._cv.notify_all()
+            dt = time.monotonic() - batch.t_dispatch
+            for req, (a, b) in zip(batch.reqs, batch.slices):
+                if req.span is not None:
+                    from ceph_tpu.common import tracing
+                    if exc is None:
+                        tracing.span_event(req.span,
+                                           f"compute {dt * 1e3:.3f}ms")
+                        tracing.span_event(
+                            req.span, f"d2h {host[a:b].nbytes}B")
+                    attrs = {"kernel": req.label, "batch": len(batch.reqs),
+                             "coalesced": len(batch.reqs) > 1,
+                             "error": exc is not None}
+                    if batch.misses is not None:
+                        attrs["retrace"] = batch.misses > 0
+                    tracing.set_attrs(req.span, **attrs)
+                    tracing.finish_span(req.span)
+                if exc is not None:
+                    req.future._deliver(None, exc)
+                else:
+                    req.future._deliver(host[a:b], None)
+            self.stats.record_complete(len(batch.reqs))
+
+
+# ---------------------------------------------------------------------------
+# CRUSH bulk-remap submit API (ops.crush_kernel's flat_firstn, coalesced)
+# ---------------------------------------------------------------------------
+
+def submit_flat_firstn(engine: DeviceDispatchEngine, x, ids, weights,
+                       reweight, *, numrep: int, tries: int = 51,
+                       key=None) -> DispatchFuture:
+    """Submit a bulk PG remap through the engine: concurrent remap
+    requests against the SAME map state coalesce on the x axis into one
+    device call (the ParallelPGMapper thread pool collapsed into one
+    batched kernel invocation).  Padded lanes (x=0) compute garbage
+    placements that are sliced off before delivery — bit-exactness of
+    the delivered rows is untouched.
+
+    ``key`` defaults to a digest of the bucket/reweight operands; pass
+    an explicit (epoch, rule)-style key when the caller already knows
+    the map identity to skip the hashing.
+    """
+    ids = np.asarray(ids, dtype=np.int32)
+    weights = np.asarray(weights, dtype=np.int64)
+    reweight = np.asarray(reweight, dtype=np.int64)
+    if key is None:
+        key = ("crush_firstn", numrep, tries,
+               hash(ids.tobytes()), hash(weights.tobytes()),
+               hash(reweight.tobytes()))
+
+    def fn(xs):
+        from ceph_tpu.ops.crush_kernel import flat_firstn
+        return flat_firstn(xs, ids, weights, reweight,
+                           numrep=numrep, tries=tries)
+
+    return engine.submit(key, fn, np.asarray(x, dtype=np.uint32),
+                         label="crush_firstn")

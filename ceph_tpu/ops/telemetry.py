@@ -51,6 +51,11 @@ LATENCY_BOUNDS = (
 BATCH_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
                 2048, 4096, 8192, 16384, 32768, 65536)
 
+#: coalesce-factor / queue-depth bucket upper bounds (requests per
+#: device call; the whole point of the dispatch engine is pushing the
+#: mass of this histogram above 1)
+COALESCE_BOUNDS = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 128)
+
 
 class Histogram:
     """Cumulative-bucket histogram with a running sum (the Prometheus
@@ -155,12 +160,127 @@ class KernelStats:
             }
 
 
+class DispatchStats:
+    """Counters for the cross-op coalescing engine (ops.dispatch).
+
+    The engine's efficiency story is four numbers: how many requests
+    share each device call (coalesce factor), how long they queue for
+    the privilege (queue delay), how deep the backlog runs (queue
+    depth), and how many calls are outstanding (in-flight).  Flush
+    reasons tell WHY each batch closed — "idle" flushes are the no-wait
+    single-op path, "full"/"timeout" flushes are coalescing at work.
+    """
+
+    __slots__ = ("_lock", "submits", "stripes_in", "batches",
+                 "stripes_out", "padded_stripes", "completed",
+                 "coalesce", "queue_delay", "queue_depth",
+                 "flush_reasons", "in_flight", "max_in_flight_seen")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.submits = 0          # requests submitted
+        self.stripes_in = 0       # stripes submitted
+        self.batches = 0          # device calls dispatched
+        self.stripes_out = 0      # stripes dispatched (pre-padding)
+        self.padded_stripes = 0   # zero rows added by shape bucketing
+        self.completed = 0        # requests delivered
+        self.coalesce = Histogram(COALESCE_BOUNDS)   # requests/batch
+        self.queue_delay = Histogram(LATENCY_BOUNDS)  # submit->dispatch s
+        self.queue_depth = Histogram(COALESCE_BOUNDS)  # pending at flush
+        self.flush_reasons = {"idle": 0, "full": 0, "timeout": 0,
+                              "stop": 0}
+        self.in_flight = 0        # gauge: batches outstanding on device
+        self.max_in_flight_seen = 0
+
+    def clear(self) -> None:
+        """Reset IN PLACE: live engines hold a reference to this object
+        (captured at construction), so reset must not swap it out."""
+        with self._lock:
+            self.submits = self.stripes_in = 0
+            self.batches = self.stripes_out = self.padded_stripes = 0
+            self.completed = 0
+            self.coalesce = Histogram(COALESCE_BOUNDS)
+            self.queue_delay = Histogram(LATENCY_BOUNDS)
+            self.queue_depth = Histogram(COALESCE_BOUNDS)
+            self.flush_reasons = {"idle": 0, "full": 0, "timeout": 0,
+                                  "stop": 0}
+            self.in_flight = 0
+            self.max_in_flight_seen = 0
+
+    def record_submit(self, stripes: int) -> None:
+        with self._lock:
+            self.submits += 1
+            self.stripes_in += stripes
+
+    def record_batch(self, *, requests: int, stripes: int, padded: int,
+                     reason: str, delays, depth: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.stripes_out += stripes
+            self.padded_stripes += padded
+            self.coalesce.add(requests)
+            self.queue_depth.add(depth)
+            for d in delays:
+                self.queue_delay.add(d)
+            self.flush_reasons[reason] = \
+                self.flush_reasons.get(reason, 0) + 1
+
+    def record_complete(self, requests: int) -> None:
+        with self._lock:
+            self.completed += requests
+
+    def set_in_flight(self, n: int) -> None:
+        with self._lock:
+            self.in_flight = n
+            if n > self.max_in_flight_seen:
+                self.max_in_flight_seen = n
+
+    def dump(self) -> dict:
+        with self._lock:
+            return {
+                "submits": self.submits,
+                "stripes_in": self.stripes_in,
+                "batches": self.batches,
+                "stripes_out": self.stripes_out,
+                "padded_stripes": self.padded_stripes,
+                "completed": self.completed,
+                "coalesce": self.coalesce.dump(),
+                "queue_delay_seconds": self.queue_delay.dump(),
+                "queue_depth": self.queue_depth.dump(),
+                "flush_reasons": dict(self.flush_reasons),
+                "in_flight": self.in_flight,
+                "max_in_flight_seen": self.max_in_flight_seen,
+            }
+
+    def summary(self) -> dict:
+        """bench.py's digest: amortization in three numbers."""
+        with self._lock:
+            batches = self.batches
+            return {
+                "submits": self.submits,
+                "device_calls": batches,
+                "mean_coalesce": (round(self.coalesce.sum / batches, 2)
+                                  if batches else 0.0),
+                "p99_queue_delay_ms": round(
+                    self.queue_delay.quantile(0.99) * 1e3, 3),
+                "calls_per_1k_ops": (round(1000.0 * batches
+                                           / self.submits, 1)
+                                     if self.submits else 0.0),
+                "padded_frac": (round(self.padded_stripes
+                                      / (self.stripes_out
+                                         + self.padded_stripes), 3)
+                                if self.stripes_out else 0.0),
+                "flush_reasons": dict(self.flush_reasons),
+            }
+
+
 class KernelTelemetry:
     """The registry: one KernelStats per kernel name."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._kernels: dict[str, KernelStats] = {}
+        self.dispatch = DispatchStats()
         #: block_until_ready before closing each latency sample
         self.fence_for_timing = False
         #: master switch; off-path cost when False is one attribute read
@@ -184,6 +304,7 @@ class KernelTelemetry:
         against the real cache, so reset never fabricates misses."""
         with self._lock:
             self._kernels.clear()
+        self.dispatch.clear()
 
     def summary(self) -> dict:
         """Compact digest (bench.py prints this next to its JSON)."""
@@ -219,6 +340,22 @@ def dump() -> dict:
 
 def reset() -> None:
     _REG.reset()
+
+
+def dispatch_stats() -> DispatchStats:
+    """The process-global coalescing-engine counters.  Engines created
+    without an explicit stats sink feed this (the MiniCluster's
+    daemons share it exactly like the kernel registry); dump_dispatch
+    and the mgr's ceph_kernel_coalesce_* families read it."""
+    return _REG.dispatch
+
+
+def dispatch_dump() -> dict:
+    return _REG.dispatch.dump()
+
+
+def dispatch_summary() -> dict:
+    return _REG.dispatch.summary()
 
 
 def set_fence_for_timing(on: bool) -> None:

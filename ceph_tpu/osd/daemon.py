@@ -194,6 +194,10 @@ class OSDDaemon(Dispatcher):
         self.osd_id = osd_id
         self.whoami = EntityName("osd", osd_id)
         self.ctx = ctx or CephTpuContext(f"osd.{osd_id}")
+        #: True when the context (and so its dispatch engine) is ours
+        #: to tear down in shutdown(); a caller-supplied ctx may be
+        #: shared with other daemons
+        self._own_ctx = ctx is None
         #: comma-separated monitor addresses (mon_host); boot/failure
         #: reports go to every mon — the leader executes, peons ignore
         self.mon_addr = mon_addr
@@ -235,6 +239,14 @@ class OSDDaemon(Dispatcher):
         self._stop = False
         #: fault injection (reference: OSD.h debug_heartbeat_drops_remaining)
         self.debug_drop_rep_ops = 0
+        #: async EC write dispatch: the encode is SUBMITTED through the
+        #: context's coalescing engine and the transaction-build + shard
+        #: fan-out runs in the completion continuation, so concurrent
+        #: client writes share one device call.  Hot-togglable.
+        self._ec_async = bool(self.ctx.conf.get("osd_ec_dispatch_async"))
+        self.ctx.conf.add_observer(
+            "osd_ec_dispatch_async",
+            lambda _n, v: setattr(self, "_ec_async", bool(v)))
 
         self._auth_key = auth_key
         self._cephx = cephx
@@ -273,6 +285,8 @@ class OSDDaemon(Dispatcher):
                      .add_u64("peering_rounds").add_u64("log_entries")
                      .add_u64("pg_splits")
                      .add_u64("ec_rmw_gather").add_u64("ec_rmw_pipelined")
+                     .add_u64("ec_dispatch_submits")
+                     .add_u64("ec_dispatch_commits")
                      .add_time_avg("op_w_latency")
                      .create_perf_counters())
         self.ctx.perf.add(self.perf)
@@ -447,6 +461,22 @@ class OSDDaemon(Dispatcher):
         self._agent_q.put(None)
         if self._internal_client is not None:
             self._internal_client.shutdown()
+        # drain in-flight async EC commits while the messenger and
+        # store are still up (continuations fan out shards and reply),
+        # then stop the engine's threads.  Only when the ctx is ours:
+        # a caller-supplied context may serve other daemons.  Stragglers
+        # submitting after stop() run inline, so nothing can hang.
+        eng = self.ctx._dispatch if self._own_ctx else None
+        if eng is not None:
+            if not eng.flush(timeout=5.0):
+                dout("osd", 0, "osd.%d shutdown: dispatch engine did "
+                     "not drain in 5s — in-flight EC commits may land "
+                     "on the unmounted store and be dropped",
+                     self.osd_id)
+            if not eng.stop():
+                dout("osd", 0, "osd.%d shutdown: dispatch engine "
+                     "thread(s) still live past join timeout",
+                     self.osd_id)
         self.msgr.shutdown()
         self.store.umount()
 
@@ -578,6 +608,31 @@ class OSDDaemon(Dispatcher):
                     # releasing first would let a new write reclaim the
                     # gate ahead of the queued older writes
                     self._rmw_fail(st)
+                # a pending-write gate whose commits all landed but
+                # whose release was lost (a continuation died mid-
+                # commit) would wedge the object's readers forever:
+                # reap it defensively.  Gates with commits still in
+                # flight are left alone — the engine always resolves
+                # its futures, so the last continuation releases them
+                wpend_waiting: list = []
+                for gid, st in [
+                        (g, s) for g, s in self._ec_reads.items()
+                        if s.get("kind") == "wpend"
+                        and not s.get("pending")
+                        and now - s.get("started", now) > 8.0]:
+                    self._ec_reads.pop(gid, None)
+                    wpg = self.pgs.get(st["pgid"])
+                    if wpg is not None:
+                        if wpg.rmw.get(st["oid"]) == gid:
+                            wpg.rmw.pop(st["oid"], None)
+                        # parked pipelined writes re-dispatch before the
+                        # waiting readers — they arrived first, and the
+                        # release path (_ec_write_committed) keeps that
+                        # per-object order too
+                        wpend_waiting.extend(
+                            m for m, _op in st.get("queue") or [])
+                        wpend_waiting.extend(
+                            wpg.waiting_for_missing.pop(st["oid"], []))
                 # a dead watcher never acks: expire its notifies so the
                 # notifier gets its reply instead of a client timeout
                 stale_notifies = [
@@ -589,6 +644,8 @@ class OSDDaemon(Dispatcher):
                 m = st["msg"]
                 self._op_send_reply(m, MOSDOpReply(
                     tid=m.tid, result=0, epoch=self.osdmap.epoch))
+            for m in wpend_waiting:
+                self._handle_op(m)
             for pg in pgs:
                 self._tick_pg(pg, now)
         finally:
@@ -1018,7 +1075,8 @@ class OSDDaemon(Dispatcher):
                 parent.waiting_for_active.append(inf.msg)
             parent.rmw.clear()
             dead = [gid for gid, st in self._ec_reads.items()
-                    if st["kind"] == "rmw" and st["pgid"] == pgid]
+                    if st["kind"] in ("rmw", "wpend")
+                    and st["pgid"] == pgid]
             for gid in dead:
                 self._requeue_rmw_state(self._ec_reads.pop(gid, None),
                                         parent)
@@ -1124,7 +1182,8 @@ class OSDDaemon(Dispatcher):
             # their client ops requeue (re-executed post-activation)
             pg.rmw.clear()
             dead = [gid for gid, st in self._ec_reads.items()
-                    if st["kind"] == "rmw" and st["pgid"] == pg.pgid]
+                    if st["kind"] in ("rmw", "wpend")
+                    and st["pgid"] == pg.pgid]
             for gid in dead:
                 self._requeue_rmw_state(
                     self._ec_reads.pop(gid, None), pg,
@@ -2521,6 +2580,21 @@ class OSDDaemon(Dispatcher):
         return StripeInfo(k, su)
 
     @staticmethod
+    def _ec_live_shards(pg: PG, n: int) -> dict[int, int]:
+        """{shard: osd} for the up-set slots currently holding a live
+        OSD — every EC write path gates on this against min_size."""
+        up = pg.up
+        return {s: up[s] for s in range(min(n, len(up)))
+                if up[s] != CEPH_NOSD}
+
+    @staticmethod
+    def _ec_shard_columns(si, stripes, parity, n: int) -> dict[int, bytes]:
+        """Stack data+parity stripes, (S, n, su), and cut the per-shard
+        columns the transactions and replica fan-out carry."""
+        full = np.concatenate([stripes, np.asarray(parity)], axis=1)
+        return {s: si.shard_column(full, s).tobytes() for s in range(n)}
+
+    @staticmethod
     def _ec_encode_window(codec, si, data: bytes, s0: int,
                           s1: int) -> dict[int, bytes]:
         """Encode stripes [s0, s1) of `data` in one batched device call
@@ -2529,9 +2603,8 @@ class OSDDaemon(Dispatcher):
         window = np.frombuffer(data[s0 * si.width:s1 * si.width],
                                dtype=np.uint8)
         stripes = si.split(window)
-        parity = np.asarray(codec.encode_chunks(stripes))
-        full = np.concatenate([stripes, parity], axis=1)   # (S, n, su)
-        return {s: si.shard_column(full, s).tobytes() for s in range(n)}
+        return OSDDaemon._ec_shard_columns(
+            si, stripes, codec.encode_chunks(stripes), n)
 
     def _ec_encode_object(self, codec, si, data: bytes) -> dict[int, bytes]:
         """Full object -> {shard: shard bytes}."""
@@ -2574,9 +2647,7 @@ class OSDDaemon(Dispatcher):
         if self._stale_retry(pg, msg):
             self._reply_err(msg, -125)   # ECANCELED: superseded op
             return
-        up = pg.up
-        shard_osds = {s: up[s] for s in range(min(n, len(up)))
-                      if up[s] != CEPH_NOSD}
+        shard_osds = self._ec_live_shards(pg, n)
         if len(shard_osds) < max(k, pool.min_size):
             # below min_size the write could never be re-read
             self._reply_err(msg, -11)
@@ -2604,6 +2675,45 @@ class OSDDaemon(Dispatcher):
                     trk = getattr(msg, "_trk", None)
                     if trk is not None:
                         trk.mark_event("pipelined behind rmw gather")
+                    return
+                if st0 is not None and st0.get("kind") == "wpend":
+                    # async commits in flight for this object, and the
+                    # projected content is already known: chain directly
+                    # onto it — no gather, and the new encode coalesces
+                    # into the SAME device call as the pending one
+                    if reqid in st0.get("reqids", ()):
+                        # resend of a write whose commit is in flight:
+                        # tcp resends are fresh objects (_dedup_resend's
+                        # rule), so re-target the continuation's reply
+                        # at the latest connection — the original may
+                        # have arrived on one that is already dead
+                        st0.setdefault("resends", {})[reqid] = msg
+                        trk = getattr(msg, "_trk", None)
+                        if trk is not None:
+                            trk.mark_event(
+                                "resend of in-flight async write")
+                        return
+                    last = st0.get("tids", {}).get(msg.client_id)
+                    if last is not None and msg.tid < last:
+                        # abandoned older op landing behind a newer
+                        # in-flight write: executing it would roll the
+                        # object back (same rule as _stale_retry)
+                        self._reply_err(msg, -125)
+                        return
+                    if st0.get("failed"):
+                        # poisoned gate: the projected base embeds a
+                        # failed write's bytes — park until the gate
+                        # releases, then re-execute against the last
+                        # committed state
+                        st0.setdefault("queue", []).append((msg, op))
+                        return
+                    self.perf.inc("ec_rmw_pipelined")
+                    replace2 = op.op == OP_WRITEFULL
+                    self._ec_apply_write(
+                        msg, pool, pg, op,
+                        old_data=b"" if replace2
+                        else st0.get("base", b""),
+                        replace=replace2)
                     return
                 # stale gate from a torn-down gather: reclaim it
                 pg.rmw.pop(msg.oid, None)
@@ -2679,7 +2789,17 @@ class OSDDaemon(Dispatcher):
                 m2, op2 = q.pop(0)
                 # a map-change resend of an op already drained earlier in
                 # this queue is in the log now: dedup it here exactly like
-                # the direct path would, or it would apply twice
+                # the direct path would, or it would apply twice.  With
+                # async dispatch the earlier drain may still be
+                # committing — its reqid sits in the state's pending set
+                # rather than the log, so check both.  Don't just drop
+                # it: the in-flight commit's reply must ride THIS (live)
+                # connection, the original may be dead (same re-target
+                # rule as the wpend branch and _dedup_resend's inf.msg)
+                if (m2.client_id, m2.tid) in state.get("reqids", ()):
+                    state.setdefault("resends", {})[
+                        (m2.client_id, m2.tid)] = m2
+                    continue
                 if self._dedup_resend(pg, (m2.client_id, m2.tid), m2):
                     continue
                 if self._stale_retry(pg, m2):
@@ -2692,27 +2812,39 @@ class OSDDaemon(Dispatcher):
                     replace=replace2)
                 if nxt is not None:
                     base = nxt
-            pg.rmw.pop(msg.oid, None)
-            self._ec_reads.pop(state.get("gid"), None)
-            waiting = pg.waiting_for_missing.pop(msg.oid, [])
+            if state.get("pending"):
+                # async encodes from this drain are still committing:
+                # convert the gather gate into a pending-write gate and
+                # let the LAST commit continuation release it — parked
+                # readers must not see pre-commit shards
+                state["kind"] = "wpend"
+                state["started"] = time.time()
+                waiting = []
+            else:
+                pg.rmw.pop(msg.oid, None)
+                self._ec_reads.pop(state.get("gid"), None)
+                waiting = pg.waiting_for_missing.pop(msg.oid, [])
         for m in waiting:
             self._handle_op(m)
 
     def _ec_apply_write(self, msg: MOSDOp, pool, pg: PG, op,
                         old_data: bytes, replace: bool) -> bytes | None:
-        """Apply one EC write (encode + local commit + shard fan-out).
-        Returns the full post-write object content — the projected base
-        the rmw pipeline chains the next queued write onto — or None if
-        the write was refused (reply already sent)."""
+        """Start one EC write: overlay, encode, commit, shard fan-out.
+        With the dispatch engine on, the encode is SUBMITTED
+        (submit-and-continue): this method returns after handing the
+        affected stripes to the coalescing engine, and the
+        transaction-build + fan-out runs in the completion continuation
+        (_ec_write_committed) — the window in which a second client
+        write lands its encode into the SAME device call.  Returns the
+        full post-write object content — the projected base the rmw
+        pipeline chains the next queued write onto — or None if the
+        write was refused (reply already sent).  Caller holds
+        self._lock."""
         codec = self._codec(pool)
         n = codec.get_chunk_count()
         k = codec.get_data_chunk_count()
         si = self._ec_stripe_info(codec, pool)
-        cid = self._pg_cid(pg.pgid)
-        reqid = (msg.client_id, msg.tid)
-        up = pg.up
-        shard_osds = {s: up[s] for s in range(min(n, len(up)))
-                      if up[s] != CEPH_NOSD}
+        shard_osds = self._ec_live_shards(pg, n)
         # the rmw gather is asynchronous: re-check the min_size gate
         # against the CURRENT up set before committing anything
         if len(shard_osds) < max(k, pool.min_size):
@@ -2734,23 +2866,223 @@ class OSDDaemon(Dispatcher):
             # on growth s1 from stripe_range already equals
             # object_stripes(new_size): new_size = offset + len there
             s0, s1 = si.stripe_range(op.offset, len(op.data))
-            sub = self._ec_encode_window(codec, si, data, s0, s1)
             shard_off = s0 * si.su
             shard_len = si.shard_len(len(data))
             truncate = False
-        else:
-            shards = self._ec_encode_object(codec, si, data)
+        elif si is not None:
+            s0, s1 = 0, si.object_stripes(len(data))
             shard_off, truncate = 0, True
-            shard_len = len(next(iter(shards.values()))) if shards else 0
-            sub = shards
-        # device residency on the op's timeline (and, via the trace id,
-        # in the cross-daemon span ring): a traced client op shows where
-        # its TPU time went
+            shard_len = si.shard_len(len(data))
+        else:
+            s0 = s1 = 0
+            shard_off, truncate = 0, True
+            shard_len = 0
+        engine = (self.ctx.dispatch_engine()
+                  if self._ec_async and si is not None else None)
+        if engine is None and si is not None:
+            # the async knob was toggled off with commits still in
+            # flight for this object: a synchronous commit here would
+            # log ahead of them and the object would roll back when
+            # their continuations land — ride the engine's per-key
+            # FIFO behind the pending writes instead
+            gid0 = pg.rmw.get(msg.oid)
+            st0 = (self._ec_reads.get(gid0)
+                   if gid0 is not None else None)
+            if (st0 is not None and st0.get("kind") == "wpend"
+                    and st0.get("pending")):
+                engine = self.ctx.dispatch_engine()
+        if engine is None:
+            # synchronous path: whole-object codecs (shec/lrc/clay
+            # encode through their own bespoke layouts) and the async
+            # knob off
+            if si is None:
+                sub = self._ec_encode_object(codec, si, data)
+                shard_len = (len(next(iter(sub.values())))
+                             if sub else 0)
+            else:
+                sub = self._ec_encode_window(codec, si, data, s0, s1)
+            # device residency on the op's timeline (and, via the trace
+            # id, in the cross-daemon span ring): a traced client op
+            # shows where its TPU time went
+            trk = getattr(msg, "_trk", None)
+            if trk is not None:
+                trk.mark_event(
+                    "ec_encode kernel "
+                    f"{(time.perf_counter() - t_kernel) * 1e3:.3f}ms")
+            self._ec_write_commit(msg, pool, pg, sub, data, shard_osds,
+                                  shard_off, shard_len, truncate)
+            return data
+        # submit-and-continue: gate the object (readers park, later
+        # writes chain onto the projected base), stack the affected
+        # stripes onto the engine's batch axis, return
+        st = self._ec_wpend_state(pg, msg.oid)
+        reqid = (msg.client_id, msg.tid)
+        st.setdefault("reqids", set()).add(reqid)
+        tids = st.setdefault("tids", {})
+        if msg.tid >= tids.get(msg.client_id, 0):
+            tids[msg.client_id] = msg.tid
+        st["pending"] = st.get("pending", 0) + 1
+        st["base"] = data
+        window = np.frombuffer(data[s0 * si.width:s1 * si.width],
+                               dtype=np.uint8)
+        stripes = si.split(window)
+        fut = codec.submit_chunks(engine, stripes)
+        self.perf.inc("ec_dispatch_submits")
         trk = getattr(msg, "_trk", None)
         if trk is not None:
             trk.mark_event(
-                "ec_encode kernel "
-                f"{(time.perf_counter() - t_kernel) * 1e3:.3f}ms")
+                f"ec_encode submitted ({stripes.shape[0]} stripes)")
+        cctx = {"msg": msg, "pool": pool, "pgid": pg.pgid,
+                "oid": msg.oid, "gid": st["gid"], "state": st,
+                "data": data, "stripes": stripes, "n": n, "k": k,
+                "si": si, "shard_off": shard_off,
+                "shard_len": shard_len, "truncate": truncate,
+                "t0": t_kernel}
+        fut.add_done_callback(
+            lambda f, c=cctx: self._ec_write_committed(c, f))
+        return data
+
+    def _ec_wpend_state(self, pg: PG, oid: str) -> dict:
+        """Find or create the pending-write gate for an object with
+        async commits in flight (kind "wpend").  An in-flight rmw
+        gather's state doubles as the gate until _ec_rmw_ready's drain
+        converts it.  Caller holds self._lock."""
+        gid = pg.rmw.get(oid)
+        st = self._ec_reads.get(gid) if gid is not None else None
+        if st is None or st.get("oid") != oid:
+            self._recover_tid += 1
+            gid = (RECOVERY_CLIENT + self.osd_id, self._recover_tid)
+            st = {"kind": "wpend", "pgid": pg.pgid, "oid": oid,
+                  "gid": gid, "queue": [], "started": time.time(),
+                  "pending": 0, "reqids": set(), "tids": {},
+                  "base": b""}
+            pg.rmw[oid] = gid
+            self._ec_reads[gid] = st
+        return st
+
+    def _ec_write_committed(self, c: dict, fut) -> None:
+        """Completion continuation for a submitted EC write (runs on
+        the engine's completion thread, in per-object submission order
+        — the engine's delivery contract IS the log/commit ordering):
+        build the transactions, apply locally, fan out, reply, and
+        release the pending-write gate once the last in-flight commit
+        for the object lands."""
+        msg = c["msg"]
+        # re-join the op's trace: this engine thread has no trace
+        # context, but the commit's shard fan-out must carry the op's
+        # trace id so replica dispatch spans stitch into one tree
+        tid = getattr(msg, "trace_id", 0)
+        from ceph_tpu.common import tracing
+        if tid and tracing.current() != tid:
+            prev = tracing.set_current(
+                tid, getattr(msg, "parent_span_id", 0))
+            try:
+                return self._ec_write_committed(c, fut)
+            finally:
+                tracing.set_current(prev)
+        st = c["state"]
+        reqid = (msg.client_id, msg.tid)
+        waiting: list = []
+        requeue: list = []
+        try:
+            self._ec_write_committed_locked(c, fut, msg, st, reqid,
+                                            waiting, requeue)
+        finally:
+            # OUTER finally: an exception escaping the commit (store or
+            # send error) must not strand the ops the gate release just
+            # popped out of every parking structure — nothing else
+            # (tick reap, map change) would ever replay them
+            for m in requeue:
+                self._handle_op(m)
+            for m in waiting:
+                self._handle_op(m)
+
+    def _ec_write_committed_locked(self, c: dict, fut, msg, st: dict,
+                                   reqid, waiting: list,
+                                   requeue: list) -> None:
+        """Locked half of _ec_write_committed.  Ops to re-dispatch are
+        EXTENDED into waiting/requeue (never rebound) so the caller's
+        outer finally sees them even if the commit raises."""
+        with self._lock:
+            pg = self.pgs.get(c["pgid"])
+            live = (pg is not None
+                    and self._ec_reads.get(c["gid"]) is st
+                    and pg.rmw.get(c["oid"]) == c["gid"])
+            if not live:
+                # the gate was torn down (interval change, split, PG
+                # removal) before this commit landed: nothing was
+                # logged or applied for this write yet, so drop it
+                # whole — the map change that tore the gate down makes
+                # the client resend and the write re-executes fresh
+                trk = getattr(msg, "_trk", None)
+                if trk is not None:
+                    trk.mark_event(
+                        "async commit dropped: gate torn down")
+                return
+            m2 = st.get("resends", {}).pop(reqid, None)
+            if m2 is not None and m2 is not msg:
+                # client resent while this commit was in flight: the
+                # reply must ride the resend's (live) connection
+                trk = getattr(msg, "_trk", None)
+                if trk is not None:
+                    trk.mark_event("superseded by client resend")
+                    trk.finish()
+                msg = c["msg"] = m2
+            st["pending"] = st.get("pending", 1) - 1
+            st.get("reqids", set()).discard(reqid)
+            try:
+                err = fut.exception()
+                if err is not None or st.get("failed"):
+                    # a failed commit poisons the gate: every later
+                    # in-flight encode chained onto st["base"] embeds
+                    # the failed write's bytes, and committing it
+                    # would durably apply data whose client was told
+                    # "error".  Fail the whole chain; retries re-
+                    # execute against the last COMMITTED state once
+                    # the gate releases
+                    st["failed"] = True
+                    if err is not None:
+                        dout("osd", 1, "osd.%d async ec encode failed "
+                             "for %s: %r", self.osd_id, c["oid"], err)
+                    self._reply_err(msg, -5)
+                else:
+                    n, si, pool = c["n"], c["si"], c["pool"]
+                    shard_osds = self._ec_live_shards(pg, n)
+                    if len(shard_osds) < max(c["k"], pool.min_size):
+                        st["failed"] = True
+                        self._reply_err(msg, -11)
+                    else:
+                        sub = self._ec_shard_columns(
+                            si, c["stripes"], fut.result(), n)
+                        trk = getattr(msg, "_trk", None)
+                        if trk is not None:
+                            trk.mark_event(
+                                "ec_encode kernel "
+                                f"{(time.perf_counter() - c['t0']) * 1e3:.3f}"
+                                "ms (async)")
+                        self._ec_write_commit(
+                            msg, pool, pg, sub, c["data"], shard_osds,
+                            c["shard_off"], c["shard_len"],
+                            c["truncate"])
+                        self.perf.inc("ec_dispatch_commits")
+            finally:
+                if not st.get("pending") and st.get("kind") == "wpend":
+                    pg.rmw.pop(c["oid"], None)
+                    self._ec_reads.pop(c["gid"], None)
+                    requeue.extend(
+                        m for m, _op in st.get("queue") or [])
+                    waiting.extend(
+                        pg.waiting_for_missing.pop(c["oid"], []))
+
+    def _ec_write_commit(self, msg: MOSDOp, pool, pg: PG, sub: dict,
+                         data: bytes, shard_osds: dict, shard_off: int,
+                         shard_len: int, truncate: bool) -> None:
+        """Commit one encoded EC write: version allocation + log append
+        + local shard transactions + replica fan-out + client reply.
+        Caller holds self._lock (the direct path holds it across the
+        encode; the async continuation retakes it)."""
+        cid = self._pg_cid(pg.pgid)
+        reqid = (msg.client_id, msg.tid)
         reply = MOSDOpReply(tid=msg.tid, result=0, epoch=self.osdmap.epoch)
         meta_t = Transaction()
         entry = self._log_write(pg, meta_t, msg.oid, is_delete=False,
@@ -2801,7 +3133,6 @@ class OSDDaemon(Dispatcher):
                 truncate=truncate))
         if not waiting:
             self._op_send_reply(msg, reply)
-        return data
 
     def _patched_shard(self, pgid, oid: str, shard: int, chunk: bytes,
                        offset: int, shard_len: int, truncate: bool,

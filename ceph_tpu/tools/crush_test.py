@@ -22,7 +22,49 @@ import time
 import numpy as np
 
 from ceph_tpu.crush import build_flat_map, build_two_level_map, crush_do_rule
-from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+from ceph_tpu.crush.types import (
+    CRUSH_ITEM_NONE, RULE_CHOOSE_FIRSTN, RULE_EMIT, RULE_TAKE)
+
+
+def _flat_firstn_operands(m, rid: int):
+    """(ids, item_weights) when rule ``rid`` is the shape
+    ``ops.crush_kernel.flat_firstn`` computes — ``take <straw2 root of
+    devices> / choose firstn 0 osd / emit`` with stock tunables — else
+    None and the caller uses the generic rule engine."""
+    from ceph_tpu.crush.types import CRUSH_BUCKET_STRAW2
+    rule = m.rules[rid] if 0 <= rid < m.max_rules else None
+    if rule is None or len(rule.steps) != 3:
+        return None
+    take, choose, emit = rule.steps
+    if (take.op != RULE_TAKE or choose.op != RULE_CHOOSE_FIRSTN
+            or choose.arg1 != 0 or choose.arg2 != 0
+            or emit.op != RULE_EMIT):
+        return None
+    root = m.bucket(take.arg1)
+    if (root is None or root.alg != CRUSH_BUCKET_STRAW2
+            or any(i < 0 for i in root.items)
+            or m.tunables != type(m.tunables)()
+            or m.choose_args or m.class_bucket):
+        return None
+    return (np.asarray(root.items, dtype=np.int32),
+            np.asarray(root.item_weights, dtype=np.int64))
+
+
+def _dispatch_flat_firstn(flat, xs, num_rep: int, weight) -> list[list[int]]:
+    """Bulk remap through the device dispatch engine: the x range rides
+    ``submit_flat_firstn`` in engine-sized chunks, so chunk N+1's h2d
+    overlaps chunk N's compute and concurrent callers against the same
+    map coalesce into shared device calls (docs/PERF.md)."""
+    from ceph_tpu.common.context import default_context
+    from ceph_tpu.ops.dispatch import submit_flat_firstn
+    ids, weights = flat
+    reweight = np.asarray(weight, dtype=np.int64)
+    eng = default_context().dispatch_engine()
+    futs = [submit_flat_firstn(eng, xs[i:i + eng.max_stripes], ids,
+                               weights, reweight, numrep=num_rep)
+            for i in range(0, len(xs), eng.max_stripes)]
+    out = np.concatenate([np.asarray(f.result()) for f in futs], axis=0)
+    return [[int(v) for v in row if v != CRUSH_ITEM_NONE] for row in out]
 
 
 def run_test(m, rules, min_x: int, max_x: int, num_rep: int,
@@ -36,12 +78,16 @@ def run_test(m, rules, min_x: int, max_x: int, num_rep: int,
     for rid in rules:
         t0 = time.perf_counter()
         if backend == "tpu":
-            from ceph_tpu.crush.mapper_jax import BatchMapper
-            bm = BatchMapper(m)
-            res = np.asarray(bm.do_rule(
-                rid, xs, num_rep, np.asarray(weight, dtype=np.int64)))
-            rows = [[int(v) for v in row if v != CRUSH_ITEM_NONE]
-                    for row in res]
+            flat = _flat_firstn_operands(m, rid)
+            if flat is not None:
+                rows = _dispatch_flat_firstn(flat, xs, num_rep, weight)
+            else:
+                from ceph_tpu.crush.mapper_jax import BatchMapper
+                bm = BatchMapper(m)
+                res = np.asarray(bm.do_rule(
+                    rid, xs, num_rep, np.asarray(weight, dtype=np.int64)))
+                rows = [[int(v) for v in row if v != CRUSH_ITEM_NONE]
+                        for row in res]
         else:
             rows = [crush_do_rule(m, rid, int(x), num_rep, list(weight))
                     for x in xs]

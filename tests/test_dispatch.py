@@ -1,0 +1,372 @@
+"""Cross-op device-call coalescing engine (ceph_tpu.ops.dispatch).
+
+The load-bearing claims, each pinned here:
+
+  * bit-exactness — N threads submitting MIXED-size encodes through one
+    engine get exactly what ec_encode_ref computes for their own data,
+    no matter how the engine stacked, padded, and sliced the batches;
+  * shape bucketing bounds the jit compile cache by the bucket table
+    (exact-count via the gf_kernel compile-cache delta, the same
+    pattern test_kernel_telemetry uses), so variable-size client
+    writes cannot retrace per distinct size;
+  * flush-on-idle — a lone op never waits out the coalesce delay
+    (reason "idle", coalesce factor 1), so single-op latency cannot
+    regress when the engine is on;
+  * cross-op coalescing — requests queued while the engine is busy
+    share ONE device call, delivered in submission order.
+
+Chunk widths here are deliberately absent from every other suite: the
+jit cache is process-global, and the bounded-cache test counts entries.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import telemetry
+from ceph_tpu.ops.dispatch import (DeviceDispatchEngine, bucket_stripes,
+                                   submit_flat_firstn)
+
+# unique geometry (see module docstring)
+K1, M1, B1 = 4, 2, 288     # bit-exactness suites
+K2, M2, B2 = 6, 3, 416     # bounded-cache suite
+
+
+def _coding(k, m, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, 256, (m, k), dtype=np.uint8)
+
+
+def _stripes(n, k, b, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, (n, k, b), dtype=np.uint8)
+
+
+def _encoder(coding):
+    from ceph_tpu.ops.gf_kernel import make_encoder
+    return make_encoder(coding)
+
+
+# -- bucketing ---------------------------------------------------------------
+
+def test_bucket_stripes_power_of_two():
+    assert [bucket_stripes(n) for n in (1, 2, 3, 4, 5, 8, 9, 1000)] \
+        == [1, 2, 4, 4, 8, 8, 16, 1024]
+
+
+# -- flush-on-idle (the single-op latency guarantee) -------------------------
+
+def test_idle_flush_no_wait_single_op():
+    """A lone submit on an idle engine flushes immediately (reason
+    "idle"), alone in its device call, well under the coalesce delay
+    it would otherwise have waited out."""
+    stats = telemetry.DispatchStats()
+    eng = DeviceDispatchEngine(max_delay_us=200_000.0, stats=stats)
+    try:
+        t0 = time.monotonic()
+        out = eng.submit(("idle", 1), lambda a: a + 1,
+                         np.zeros((3, 2), np.uint8)).result(timeout=10)
+        dt = time.monotonic() - t0
+        assert (out == 1).all() and out.shape == (3, 2)
+        assert dt < 0.1, f"idle op waited {dt:.3f}s (delay is 200ms)"
+        assert stats.flush_reasons["idle"] == 1
+        assert stats.batches == 1
+        assert stats.coalesce.sum == 1     # one request in the call
+    finally:
+        eng.stop()
+
+
+# -- cross-op coalescing -----------------------------------------------------
+
+def test_requests_queued_while_busy_share_one_call():
+    """While the engine chews a slow batch, concurrent submits with the
+    same key accumulate and dispatch as ONE call, completions delivered
+    in submission order."""
+    stats = telemetry.DispatchStats()
+    eng = DeviceDispatchEngine(max_delay_us=50_000.0, stats=stats)
+    entered = threading.Event()
+    release = threading.Event()
+
+    def slow(a):
+        entered.set()
+        release.wait(5.0)
+        return a
+
+    try:
+        blocker = eng.submit(("slow", 0), slow, np.zeros((1,), np.uint8))
+        # wait until the dispatch thread is inside the blocker's fn
+        # (the engine is demonstrably busy) before piling on
+        assert entered.wait(5.0)
+        order: list[int] = []
+        futs = [eng.submit(("fast", 1), lambda a: a * 2,
+                           np.full((i + 1, 4), i, np.int64))
+                for i in range(4)]
+        for i, f in enumerate(futs):
+            f.add_done_callback(lambda _f, i=i: order.append(i))
+        release.set()
+        for i, f in enumerate(futs):
+            out = f.result(timeout=10)
+            assert out.shape == (i + 1, 4)
+            assert (out == 2 * i).all()
+        blocker.result(timeout=10)
+        assert stats.batches == 2, "4 queued requests must share 1 call"
+        assert stats.coalesce.sum == 5          # 1 + 4 requests
+        assert order == [0, 1, 2, 3]            # submission order
+        assert stats.completed == 5
+    finally:
+        eng.stop()
+
+
+def test_max_stripes_caps_a_batch():
+    """A batch closes at max_stripes even with more work queued."""
+    stats = telemetry.DispatchStats()
+    eng = DeviceDispatchEngine(max_stripes=8, max_delay_us=50_000.0,
+                               stats=stats)
+    entered = threading.Event()
+    release = threading.Event()
+
+    def slow(a):
+        entered.set()
+        release.wait(5.0)
+        return a
+
+    try:
+        eng.submit(("slow", 0), slow, np.zeros((1,), np.uint8))
+        assert entered.wait(5.0)
+        futs = [eng.submit(("k", 0), lambda a: a,
+                           np.zeros((4, 2), np.uint8))
+                for _ in range(4)]     # 16 stripes > max 8
+        release.set()
+        for f in futs:
+            f.result(timeout=10)
+        assert stats.batches >= 3      # blocker + at least 2 capped
+        assert stats.flush_reasons["full"] >= 1
+    finally:
+        eng.stop()
+
+
+# -- bit-exactness under concurrency -----------------------------------------
+
+def test_threaded_mixed_size_encodes_bit_exact():
+    """8 writers x 6 mixed-size encodes through one engine: every
+    delivered parity equals ec_encode_ref of that writer's own data."""
+    from ceph_tpu.ops.gf_kernel import ec_encode_ref
+    coding = _coding(K1, M1)
+    encode = _encoder(coding)
+    eng = DeviceDispatchEngine(max_delay_us=500.0,
+                               stats=telemetry.DispatchStats())
+    key = ("ec", K1, M1, B1)
+    errors: list[str] = []
+
+    def writer(wid):
+        rng = np.random.default_rng(100 + wid)
+        for i in range(6):
+            data = _stripes(int(rng.integers(1, 38)), K1, B1,
+                            seed=wid * 100 + i)
+            got = eng.submit(key, encode, data).result(timeout=120)
+            want = ec_encode_ref(coding, data)
+            if not (np.asarray(got) == want).all():
+                errors.append(f"writer {wid} op {i}: mismatch")
+
+    try:
+        threads = [threading.Thread(target=writer, args=(w,))
+                   for w in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not errors, errors
+    finally:
+        eng.stop()
+
+
+def test_padded_bucket_output_equals_unpadded():
+    """Non-power-of-two sizes pad with zero stripes on dispatch; the
+    delivered slice must equal the unpadded reference encode (zeros
+    encode to zeros under a linear code, and the pad is sliced off)."""
+    from ceph_tpu.ops.gf_kernel import ec_encode_ref
+    coding = _coding(K1, M1, seed=1)
+    encode = _encoder(coding)
+    stats = telemetry.DispatchStats()
+    eng = DeviceDispatchEngine(stats=stats)
+    try:
+        for n in (3, 5, 7, 11):
+            data = _stripes(n, K1, B1, seed=n)
+            got = eng.submit(("pad", K1, M1, B1), encode,
+                             data).result(timeout=120)
+            assert got.shape == (n, M1, B1)
+            assert (np.asarray(got)
+                    == ec_encode_ref(coding, data)).all()
+        # 3->4, 5->8, 7->8, 11->16: padding genuinely happened
+        assert stats.padded_stripes == (1 + 3 + 1 + 5)
+    finally:
+        eng.stop()
+
+
+# -- compile-cache bound (the retrace story) ---------------------------------
+
+def test_jit_cache_bounded_by_bucket_table():
+    """40 randomized write sizes in [1, 64] submitted through the
+    engine compile AT MOST one executable per power-of-two bucket —
+    the exact-count compile-cache delta the telemetry suite pioneered.
+    Unbucketed, the same traffic would cost up to 40 retraces."""
+    from ceph_tpu.ops.gf_kernel import _jit_entries
+    coding = _coding(K2, M2, seed=2)
+    encode = _encoder(coding)
+    eng = DeviceDispatchEngine(stats=telemetry.DispatchStats())
+    rng = np.random.default_rng(3)
+    sizes = [int(s) for s in rng.integers(1, 65, 40)]
+    try:
+        # warm nothing: measure the whole sweep's cache growth
+        before = _jit_entries()
+        for i, n in enumerate(sizes):
+            out = eng.submit(("bound", K2, M2, B2), encode,
+                             _stripes(n, K2, B2, seed=i)
+                             ).result(timeout=120)
+            assert out.shape == (n, M2, B2)
+        grown = _jit_entries() - before
+        buckets = {bucket_stripes(n) for n in sizes}
+        assert grown <= len(buckets), \
+            f"{grown} compiles for {len(buckets)} buckets {sorted(buckets)}"
+    finally:
+        eng.stop()
+
+
+# -- EC codec + CRUSH submit APIs --------------------------------------------
+
+def test_ec_submit_chunks_matches_encode_chunks():
+    """ErasureCode.submit_chunks through the engine == encode_chunks
+    direct, for both the device runtime and the numpy oracle."""
+    from ceph_tpu.ec import registry_instance
+    eng = DeviceDispatchEngine(stats=telemetry.DispatchStats())
+    try:
+        for runtime in ("tpu", "cpu"):
+            codec = registry_instance().factory(
+                "jerasure", {"technique": "reed_sol_van", "k": "4",
+                             "m": "2", "runtime": runtime})
+            data = _stripes(9, 4, 512, seed=4)
+            got = codec.submit_chunks(eng, data).result(timeout=120)
+            assert (np.asarray(got)
+                    == codec.encode_chunks(data)).all()
+    finally:
+        eng.stop()
+
+
+def test_submit_flat_firstn_matches_direct():
+    """Coalesced bulk PG remap == the direct kernel call, padded lanes
+    sliced off."""
+    from ceph_tpu.ops import crush_kernel as ck
+    rng = np.random.default_rng(5)
+    n_osds = 24
+    ids = np.arange(n_osds, dtype=np.int32)
+    weights = rng.integers(0x8000, 0x20000, n_osds).astype(np.int64)
+    reweight = np.full(n_osds, 0x10000, dtype=np.int64)
+    reweight[2] = 0
+    xs = rng.integers(0, 2**32, 37, dtype=np.uint32)   # pads to 64
+    eng = DeviceDispatchEngine(stats=telemetry.DispatchStats())
+    try:
+        got = submit_flat_firstn(eng, xs, ids, weights, reweight,
+                                 numrep=3).result(timeout=120)
+        want = np.asarray(ck.flat_firstn(xs, ids, weights, reweight,
+                                         numrep=3))
+        assert got.shape == want.shape == (37, 3)
+        assert (np.asarray(got) == want).all()
+    finally:
+        eng.stop()
+
+
+def test_crush_test_tool_flat_rides_engine():
+    """crush_test's tpu backend on a flat map dispatches through the
+    default context's engine (submit counters move) and stays bit-exact
+    vs. the scalar oracle backend."""
+    import io
+    from ceph_tpu.common.context import default_context
+    from ceph_tpu.crush import build_flat_map
+    from ceph_tpu.tools.crush_test import run_test
+    m, _root, rule = build_flat_map(20, [0x10000] * 15 + [0x20000] * 5)
+    stats = default_context().dispatch_engine().stats
+    s0 = stats.summary()["submits"]
+    tpu = run_test(m, [rule], 0, 300, 3, backend="tpu", out=io.StringIO())
+    assert stats.summary()["submits"] > s0, \
+        "flat rule did not ride the dispatch engine"
+    ref = run_test(m, [rule], 0, 300, 3, backend="scalar",
+                   out=io.StringIO())
+    assert tpu[rule]["sizes"] == ref[rule]["sizes"]
+    assert tpu[rule]["util"] == ref[rule]["util"]
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+def test_stop_drains_then_runs_inline():
+    """stop() completes queued work; submits after stop run inline on
+    the caller (no thread, no hang)."""
+    eng = DeviceDispatchEngine(stats=telemetry.DispatchStats())
+    f1 = eng.submit(("x", 0), lambda a: a + 1, np.zeros((2,), np.int64))
+    eng.stop()
+    assert (f1.result(timeout=10) == 1).all()
+    f2 = eng.submit(("x", 0), lambda a: a + 2, np.zeros((2,), np.int64))
+    assert f2.done() and (f2.result() == 2).all()
+
+
+def test_submit_error_fans_to_the_right_futures():
+    """A failing kernel resolves every future in ITS batch with the
+    exception; the engine keeps serving afterwards."""
+    eng = DeviceDispatchEngine(stats=telemetry.DispatchStats())
+
+    def boom(a):
+        raise RuntimeError("kernel died")
+
+    try:
+        f = eng.submit(("err", 0), boom, np.zeros((1,), np.uint8))
+        with pytest.raises(RuntimeError, match="kernel died"):
+            f.result(timeout=10)
+        ok = eng.submit(("ok", 0), lambda a: a, np.ones((1,), np.uint8))
+        assert (ok.result(timeout=10) == 1).all()
+    finally:
+        eng.stop()
+
+
+def test_batch_build_error_fans_to_futures_engine_survives():
+    """An exception in BATCH CONSTRUCTION (pad/concatenate — e.g. two
+    same-key requests with mismatched trailing shapes, or MemoryError
+    under pressure) resolves the batch's futures with the exception
+    instead of killing the dispatch thread: a dead thread would strand
+    every outstanding future and wedge the engine for good."""
+    eng = DeviceDispatchEngine(max_delay_us=50_000.0,
+                               stats=telemetry.DispatchStats())
+
+    def slow(a):
+        time.sleep(0.3)
+        return a
+
+    try:
+        busy = eng.submit(("busy", 0), slow, np.zeros((2, 4), np.uint8))
+        time.sleep(0.05)   # engine busy: the next two coalesce
+        f1 = eng.submit(("k", 0), lambda a: a, np.zeros((3, 4), np.uint8))
+        f2 = eng.submit(("k", 0), lambda a: a, np.zeros((2, 5), np.uint8))
+        for f in (f1, f2):
+            with pytest.raises(ValueError):
+                f.result(timeout=10)
+        assert busy.result(timeout=10).shape == (2, 4)
+        # the dispatch thread survived: the engine still serves
+        ok = eng.submit(("ok", 0), lambda a: a + 1,
+                        np.zeros((1, 4), np.uint8))
+        assert (ok.result(timeout=10) == 1).all()
+    finally:
+        eng.stop()
+
+
+def test_flush_waits_for_queue_drain():
+    eng = DeviceDispatchEngine(stats=telemetry.DispatchStats())
+    try:
+        futs = [eng.submit(("f", 0), lambda a: a,
+                           np.zeros((2,), np.uint8)) for _ in range(5)]
+        assert eng.flush(timeout=10)
+        for f in futs:
+            assert f.result(timeout=1) is not None
+    finally:
+        eng.stop()
