@@ -98,6 +98,75 @@ def chained_seconds_per_step(step_fn, carry, n_lo: int = 8, n_hi: int = 48,
     return median_band(chained_rates(step_fn, carry, n_lo, n_hi, reps))[0]
 
 
+def _closed_loop_sweep(levels, total_ops: int, stats, make_submit,
+                       name: str, op_bytes: int, actor_key: str,
+                       snapshot=None, extra_row=None) -> dict:
+    """Shared closed-loop concurrency harness for the dispatch sweeps
+    (encode-side dispatch_sweep and decode-side recovery_sweep evolve
+    in lockstep): per level, N barrier-started actors each keep ONE op
+    in flight (submit, wait, repeat), and the row reports wall-clock
+    MB/s, op-latency percentiles, and before/after differencing of the
+    engine's scalar counters.  ``make_submit(engine)`` returns
+    ``submit(actor_id, i) -> future``; ``snapshot(stats)``/
+    ``extra_row(before, stats, calls, n_ops)`` add sweep-specific
+    columns."""
+    import threading
+
+    from ceph_tpu.ops.dispatch import DeviceDispatchEngine
+
+    out = {}
+    for conc in levels:
+        ops_per_actor = max(3, total_ops // conc)
+        eng = DeviceDispatchEngine(name=f"{name}-c{conc}", stats=stats)
+        submit = make_submit(eng)
+        lats: list[float] = []
+        lat_lock = threading.Lock()
+        start = threading.Barrier(conc + 1)
+
+        def actor(aid):
+            start.wait()
+            mine = []
+            for i in range(ops_per_actor):
+                t0 = time.perf_counter()
+                submit(aid, i).result(timeout=120)
+                mine.append(time.perf_counter() - t0)
+            with lat_lock:
+                lats.extend(mine)
+
+        threads = [threading.Thread(target=actor, args=(a,),
+                                    daemon=True)
+                   for a in range(conc)]
+        for t in threads:
+            t.start()
+        sub0, bat0 = stats.submits, stats.batches
+        before = snapshot(stats) if snapshot is not None else None
+        start.wait()           # release every actor at once
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        eng.stop()
+        n_ops = conc * ops_per_actor
+        calls = stats.batches - bat0
+        row = {
+            actor_key: conc,
+            "ops": n_ops,
+            "mbps": round(n_ops * op_bytes / wall / 1e6, 1),
+            "p99_op_ms": round(
+                float(np.percentile(lats, 99)) * 1e3, 3),
+            "median_op_ms": round(
+                float(np.percentile(lats, 50)) * 1e3, 3),
+            "mean_coalesce": (round((stats.submits - sub0) / calls, 2)
+                              if calls else 0.0),
+            "device_calls_per_1k_ops": (round(1000.0 * calls / n_ops, 1)
+                                        if n_ops else 0.0),
+        }
+        if extra_row is not None:
+            row.update(extra_row(before, stats, calls, n_ops))
+        out[str(conc)] = row
+    return out
+
+
 def dispatch_sweep(encode, k: int, chunk: int,
                    levels=(1, 4, 16, 64), op_stripes: int = 32,
                    total_ops: int = 96) -> dict:
@@ -111,61 +180,72 @@ def dispatch_sweep(encode, k: int, chunk: int,
     DispatchStats sink, so the process-wide `dispatch` digest in the
     JSON covers the whole sweep; per-level factors difference the
     scalar counters around each level."""
-    import threading
-
     from ceph_tpu.ops import telemetry
-    from ceph_tpu.ops.dispatch import DeviceDispatchEngine
 
     rng = np.random.default_rng(7)
     op = rng.integers(0, 256, (op_stripes, k, chunk), dtype=np.uint8)
-    op_bytes = op.nbytes
-    stats = telemetry.dispatch_stats()
-    out = {}
-    for conc in levels:
-        ops_per_writer = max(3, total_ops // conc)
-        eng = DeviceDispatchEngine(name=f"bench-c{conc}", stats=stats)
-        key = ("bench_ec", k, chunk)
-        lats: list[float] = []
-        lat_lock = threading.Lock()
-        start = threading.Barrier(conc + 1)
+    key = ("bench_ec", k, chunk)
 
-        def writer():
-            start.wait()
-            mine = []
-            for _ in range(ops_per_writer):
-                t0 = time.perf_counter()
-                eng.submit(key, encode, op).result(timeout=120)
-                mine.append(time.perf_counter() - t0)
-            with lat_lock:
-                lats.extend(mine)
+    def make_submit(eng):
+        return lambda _aid, _i: eng.submit(key, encode, op)
 
-        threads = [threading.Thread(target=writer, daemon=True)
-                   for _ in range(conc)]
-        for t in threads:
-            t.start()
-        sub0, bat0 = stats.submits, stats.batches
-        start.wait()           # release every writer at once
-        t0 = time.perf_counter()
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - t0
-        eng.stop()
-        n_ops = conc * ops_per_writer
-        calls = stats.batches - bat0
-        out[str(conc)] = {
-            "writers": conc,
-            "ops": n_ops,
-            "mbps": round(n_ops * op_bytes / wall / 1e6, 1),
-            "p99_op_ms": round(
-                float(np.percentile(lats, 99)) * 1e3, 3),
-            "median_op_ms": round(
-                float(np.percentile(lats, 50)) * 1e3, 3),
-            "mean_coalesce": (round((stats.submits - sub0) / calls, 2)
-                              if calls else 0.0),
-            "device_calls_per_1k_ops": (round(1000.0 * calls / n_ops, 1)
-                                        if n_ops else 0.0),
-        }
-    return out
+    return _closed_loop_sweep(levels, total_ops,
+                              telemetry.dispatch_stats(), make_submit,
+                              "bench", op.nbytes, "writers")
+
+
+def recovery_sweep(k: int, m: int, chunk: int, levels=(1, 4, 16),
+                   op_stripes: int = 32, total_ops: int = 48) -> dict:
+    """Degraded-read/recovery concurrency sweep through the DECODE
+    dispatch engine: N closed-loop readers each submit one op-sized
+    reconstruction at a time — every op missing 2 chunks, with the
+    erasure PATTERN rotating per reader and per op — exactly the OSD
+    degraded-read/recovery-pull shape.  The point over the encode-side
+    dispatch_sweep: decodes with DIFFERENT recovery matrices still
+    coalesce (heterogeneous-matrix batched kernel, pattern index per
+    stripe), so MB/s climbs with readers while device calls per op and
+    single-pattern batches both fall.  All levels feed the global
+    DecodeDispatchStats sink; per-level factors difference the scalar
+    counters around each level."""
+    from ceph_tpu.ec import registry_instance
+    from ceph_tpu.ops import telemetry
+
+    codec = registry_instance().factory(
+        "isa", {"technique": "cauchy", "k": str(k), "m": str(m)})
+    # 2-erasure patterns over the data chunks (the recovery case that
+    # exercises distinct matrices): rotate through a handful
+    patterns = []
+    for e0 in range(min(k, 4)):
+        e1 = (e0 + 1 + e0 % 2) % k
+        erased = tuple(sorted({e0, e1}))
+        if len(erased) < 2:
+            continue
+        chosen = [c for c in range(k + m) if c not in erased][:k]
+        patterns.append((tuple(chosen), erased))
+    rng = np.random.default_rng(11)
+    op = rng.integers(0, 256, (op_stripes, k, chunk), dtype=np.uint8)
+
+    def make_submit(eng):
+        def submit(rid, i):
+            chosen, targets = patterns[(rid + i) % len(patterns)]
+            return codec.submit_decode_chunks(eng, chosen, op, targets)
+        return submit
+
+    def snapshot(st):
+        return (st.patterns.count, st.patterns.sum)
+
+    def extra_row(before, st, _calls, _n_ops):
+        pat_n = st.patterns.count - before[0]
+        return {"erasures": 2,
+                "mean_patterns_per_call": (
+                    round((st.patterns.sum - before[1]) / pat_n, 2)
+                    if pat_n else 0.0)}
+
+    return _closed_loop_sweep(levels, total_ops,
+                              telemetry.decode_dispatch_stats(),
+                              make_submit, "bench-rec", op.nbytes,
+                              "readers", snapshot=snapshot,
+                              extra_row=extra_row)
 
 
 def main() -> None:
@@ -305,6 +385,12 @@ def main() -> None:
     sweep = dispatch_sweep(encode, k, chunk)
     dispatch_digest = telemetry.dispatch_summary()
 
+    # decode-side twin: degraded-read/recovery concurrency sweep with 2
+    # erasures per op and MIXED recovery patterns across readers — the
+    # heterogeneous-matrix batched decode's amortization story
+    rec_sweep = recovery_sweep(k, m, chunk)
+    decode_digest = telemetry.decode_dispatch_summary()
+
     print(json.dumps({
         "metric": "ec encode+recover MB/s (k=8,m=4,4KiB chunks, batch=2048)",
         "value": round(combined, 1),
@@ -328,6 +414,8 @@ def main() -> None:
         "slow_traces": slow_traces,
         "dispatch": dispatch_digest,
         "dispatch_sweep": sweep,
+        "decode_dispatch": decode_digest,
+        "recovery_sweep": rec_sweep,
         "device": str(jax.devices()[0]),
     }))
 

@@ -145,6 +145,13 @@ register_options([
            "run transaction-build + shard fan-out in the completion "
            "continuation, letting concurrent client writes share one "
            "device call; off = encode synchronously per op"),
+    Option("osd_ec_decode_async", OPT_BOOL, True,
+           "submit EC decodes (degraded reads, recovery pulls, rmw "
+           "gathers) through the decode dispatch engine and finish "
+           "reply/push/overlay in the completion continuation; "
+           "concurrent decodes coalesce into one device call even "
+           "with different erasure patterns (heterogeneous-matrix "
+           "batched kernel); off = decode synchronously per gather"),
     Option("kernel_fence_for_timing", OPT_BOOL, False,
            "fence (block_until_ready) each instrumented device kernel "
            "call so telemetry latency samples are real device time; "

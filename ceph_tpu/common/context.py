@@ -65,11 +65,37 @@ class CephTpuContext:
         #: two engines would break per-key submission-order delivery
         import threading
         self._dispatch = None
+        self._decode_dispatch = None
         self._dispatch_lock = threading.Lock()
         self.admin.register_command(
-            "dump_dispatch_stats", lambda **kw: telemetry.dispatch_dump(),
-            "dispatch-engine telemetry: coalesce factor, queue "
-            "delay/depth, flush reasons, in-flight batches")
+            "dump_dispatch_stats",
+            lambda **kw: {"encode": telemetry.dispatch_dump(),
+                          "decode": telemetry.decode_dispatch_dump()},
+            "dispatch-engine telemetry (encode + decode engines): "
+            "coalesce factor, queue delay/depth, flush reasons, "
+            "in-flight batches; decode adds erasure-pattern "
+            "heterogeneity per call and pattern-table size")
+
+    def _build_engine(self, name: str, stats=None):
+        """One coalescing engine wired to the shared knobs (both the
+        encode and decode engines hot-reload through the same config
+        observers)."""
+        from ceph_tpu.ops.dispatch import DeviceDispatchEngine
+        eng = DeviceDispatchEngine(
+            max_stripes=int(self.conf.get(
+                "kernel_coalesce_max_stripes")),
+            max_delay_us=float(self.conf.get(
+                "kernel_coalesce_max_delay_us")),
+            max_in_flight=int(self.conf.get(
+                "kernel_dispatch_depth")),
+            name=name, stats=stats)
+        self.conf.add_observer(
+            "kernel_coalesce_max_stripes",
+            lambda _n, v: setattr(eng, "max_stripes", int(v)))
+        self.conf.add_observer(
+            "kernel_coalesce_max_delay_us",
+            lambda _n, v: setattr(eng, "max_delay_us", float(v)))
+        return eng
 
     def dispatch_engine(self):
         """The context's device dispatch engine (built on first use so
@@ -79,23 +105,25 @@ class CephTpuContext:
             with self._dispatch_lock:
                 if self._dispatch is not None:
                     return self._dispatch
-                from ceph_tpu.ops.dispatch import DeviceDispatchEngine
-                eng = DeviceDispatchEngine(
-                    max_stripes=int(self.conf.get(
-                        "kernel_coalesce_max_stripes")),
-                    max_delay_us=float(self.conf.get(
-                        "kernel_coalesce_max_delay_us")),
-                    max_in_flight=int(self.conf.get(
-                        "kernel_dispatch_depth")),
-                    name=f"{self.name}-dispatch")
-                self.conf.add_observer(
-                    "kernel_coalesce_max_stripes",
-                    lambda _n, v: setattr(eng, "max_stripes", int(v)))
-                self.conf.add_observer(
-                    "kernel_coalesce_max_delay_us",
-                    lambda _n, v: setattr(eng, "max_delay_us", float(v)))
-                self._dispatch = eng
+                self._dispatch = self._build_engine(
+                    f"{self.name}-dispatch")
         return self._dispatch
+
+    def decode_dispatch_engine(self):
+        """The decode-side twin: EC decodes (degraded reads, recovery
+        pulls, rmw gathers) coalesce here, separately double-buffered
+        from the write path so a recovery storm cannot queue behind —
+        or starve — client encodes.  Feeds the decode stats sink
+        (telemetry.decode_dispatch_stats / ceph_kernel_decode_*)."""
+        if self._decode_dispatch is None:
+            with self._dispatch_lock:
+                if self._decode_dispatch is not None:
+                    return self._decode_dispatch
+                from ceph_tpu.ops import telemetry
+                self._decode_dispatch = self._build_engine(
+                    f"{self.name}-decode",
+                    stats=telemetry.decode_dispatch_stats())
+        return self._decode_dispatch
 
 
 _default: CephTpuContext | None = None

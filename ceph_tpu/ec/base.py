@@ -13,14 +13,33 @@ the numpy oracle available for verification (profile runtime=cpu).
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
+
 import numpy as np
 
 from ceph_tpu.gf.matrix import recovery_matrix
+from ceph_tpu.ops.dispatch import bucket_stripes
 from ceph_tpu.ops.gf_kernel import ec_encode_ref
 
 from .interface import ErasureCodeInterface, ErasureCodeProfile
 
 SIMD_ALIGN = 32  # ErasureCode.h SIMD_ALIGN — chunk padding quantum
+
+#: recovery matrices kept per codec (ErasureCodeIsaTableCache analog);
+#: true LRU — a hot mixed-pattern workload evicts one cold entry at a
+#: time instead of periodically dropping every matrix at once
+DECODE_CACHE_CAP = 256
+
+#: erasure patterns per stacked decode table before the table is
+#: RETIRED and a fresh generation starts: bounds both the table's
+#: host+device memory and the jit signature's table axis on long-lived
+#: daemons with churning shard membership.  In-flight batches keep
+#: their captured (generation-keyed) table alive; the engine key
+#: carries the generation, so cross-generation requests never share a
+#: batch and every stripe's pattern index stays valid for the table it
+#: was registered against.
+PATTERN_TABLE_CAP = 512
 
 
 class ErasureCode(ErasureCodeInterface):
@@ -37,6 +56,12 @@ class ErasureCode(ErasureCodeInterface):
     #: layered codecs fall back to whole-object writes
     supports_rmw_striping = True
 
+    #: codecs whose recovery matrices live at chunk granularity can
+    #: submit decodes through the dispatch engine
+    #: (submit_decode_chunks); packet-level bitmatrix codecs override
+    #: to False and keep the synchronous decode path
+    supports_submit_decode = True
+
     #: profile keys consumed by init (reference: parse() per plugin)
     _PROFILE_KEYS = ("k", "m", "technique", "runtime", "plugin",
                      "crush-failure-domain", "crush-root",
@@ -49,7 +74,27 @@ class ErasureCode(ErasureCodeInterface):
         self.runtime = "tpu"   # "tpu" (device kernel) or "cpu" (numpy oracle)
         self._generator: np.ndarray | None = None
         self._encoder = None
-        self._decode_cache: dict = {}
+        self._decode_cache: OrderedDict = OrderedDict()
+        #: guards _decode_cache AND the pattern tables: decodes now
+        #: submit from many OSD threads through the dispatch engine
+        self._decode_lock = threading.Lock()
+        #: t_bucket -> {"gen": generation counter,
+        #:              "ids": {(chosen, targets): idx},
+        #:              "mats": [(t_bucket, k) uint8 padded matrices],
+        #:              "bits": [(k*8, t_bucket*8) uint8 bit matrices],
+        #:              "snap": stacked pow2-padded table or None,
+        #:              "snap_dev": device-resident copy of snap}
+        #: — the heterogeneous-decode pattern registry.  Append-only
+        #: WITHIN a generation (indices are stable, so a submitted
+        #: stripe's pattern id stays valid however the table grows
+        #: behind it); at PATTERN_TABLE_CAP the whole table retires
+        #: and a fresh generation starts.
+        self._pattern_tables: dict[int, dict] = {}
+        #: monotonic generation source for ALL tables of this codec —
+        #: never reset (init()'s clear included), so an engine key's
+        #: generation component cannot collide across a re-init while
+        #: old-generation requests are still queued
+        self._pattern_gen = 0
         self._chunk_mapping: list[int] = []
 
     # -- profile parsing (ErasureCode.cc:281-329 to_int/to_bool) --------------
@@ -72,7 +117,9 @@ class ErasureCode(ErasureCodeInterface):
         self._generator = np.asarray(self._build_generator(), dtype=np.uint8)
         assert self._generator.shape == (self.k + self.m, self.k)
         self._encoder = None
-        self._decode_cache.clear()
+        with self._decode_lock:
+            self._decode_cache.clear()
+            self._pattern_tables.clear()
 
     def parse(self, profile: ErasureCodeProfile) -> None:
         """Subclasses override to parse technique-specific keys; must set k, m."""
@@ -184,15 +231,33 @@ class ErasureCode(ErasureCodeInterface):
 
     # -- decode (ErasureCode.cc:198-234 / ErasureCodeIsa.cc:150-310) ----------
 
+    def _recovery_cached(self, key, build) -> np.ndarray:
+        """The LRU protocol both recovery caches share (base and the
+        packet-level bitmatrix override): move-to-end on hit, evict the
+        single least-recent entry past the cap — a hot mixed-pattern
+        workload never loses its whole working set at once.  ``build``
+        (the matrix inversion) runs OUTSIDE the lock; a racing
+        duplicate computation is idempotent."""
+        with self._decode_lock:
+            mat = self._decode_cache.get(key)
+            if mat is not None:
+                self._decode_cache.move_to_end(key)
+                return mat
+        mat = build()
+        with self._decode_lock:
+            self._decode_cache[key] = mat
+            self._decode_cache.move_to_end(key)
+            while len(self._decode_cache) > DECODE_CACHE_CAP:
+                self._decode_cache.popitem(last=False)
+        return mat
+
     def _recovery(self, chosen: tuple, targets: tuple) -> np.ndarray:
-        """LRU-ish cached recovery matrix (ErasureCodeIsaTableCache analog)."""
-        key = (chosen, targets)
-        if key not in self._decode_cache:
-            if len(self._decode_cache) > 256:
-                self._decode_cache.clear()
-            self._decode_cache[key] = recovery_matrix(
-                self.generator, list(chosen), list(targets))
-        return self._decode_cache[key]
+        """LRU-cached recovery matrix (ErasureCodeIsaTableCache
+        analog)."""
+        return self._recovery_cached(
+            (chosen, targets),
+            lambda: recovery_matrix(self.generator, list(chosen),
+                                    list(targets)))
 
     def decode_chunks(self, chosen, chunks, targets):
         """chunks: (S, k, B) uint8 rows ``chosen`` -> (S, len(targets), B)."""
@@ -204,6 +269,193 @@ class ErasureCode(ErasureCodeInterface):
             return ec_encode_native(rmat, np.asarray(chunks))
         from ceph_tpu.ops.gf_kernel import ec_encode_jax
         return ec_encode_jax(rmat, np.asarray(chunks, dtype=np.uint8))
+
+    # -- heterogeneous-matrix batched decode (the submit path) ----------------
+
+    def _target_bucket(self, t: int) -> int:
+        """Pad target-row counts up to a per-codec constant: every
+        pattern with <= m targets (the only counts a degraded read or
+        recovery pull can produce) shares ONE bucket, so 1-erasure and
+        2-erasure decodes coalesce into the same device call.  Wider
+        requests (generic decode_chunks callers) get their own pow-2
+        bucket."""
+        return bucket_stripes(max(t, self.m, 1))
+
+    def _register_pattern(self, chosen: tuple, targets: tuple
+                          ) -> tuple[int, int, dict]:
+        """(pattern index, t_bucket, table) for an erasure pattern,
+        creating the padded recovery matrix + bit matrix on first
+        sight.  The returned TABLE is what the submitter must capture
+        (and key its engine requests by ``table["gen"]``): a cap-full
+        table retires wholesale, and an in-flight stripe's index is
+        only meaningful against the generation it registered with.
+        Raises ValueError when the chosen rows are singular."""
+        tb = self._target_bucket(len(targets))
+        with self._decode_lock:
+            tab = self._pattern_tables.get(tb)
+            if tab is not None:
+                idx = tab["ids"].get((chosen, targets))
+                if idx is not None:
+                    return idx, tb, tab
+        # matrix inversion + bit expansion OUTSIDE the lock; a racing
+        # duplicate registration is resolved below
+        rmat = self._recovery(chosen, targets)
+        padded = np.zeros((tb, self.k), dtype=np.uint8)
+        padded[:len(targets)] = rmat
+        from ceph_tpu.gf.tables import bit_matrix
+        bits = bit_matrix(padded)
+        with self._decode_lock:
+            tab = self._pattern_tables.get(tb)
+            if tab is None or len(tab["mats"]) >= PATTERN_TABLE_CAP:
+                # retire the full table: new submissions start a fresh
+                # generation (new engine key); in-flight batches keep
+                # their captured table object alive until delivered
+                self._pattern_gen += 1
+                tab = {"gen": self._pattern_gen,
+                       "ids": {}, "mats": [], "bits": [],
+                       "snap": None, "snap_dev": None}
+                self._pattern_tables[tb] = tab
+            idx = tab["ids"].get((chosen, targets))
+            if idx is None:
+                idx = len(tab["mats"])
+                tab["ids"][(chosen, targets)] = idx
+                tab["mats"].append(padded)
+                tab["bits"].append(bits)
+                tab["snap"] = None       # table grew: re-snapshot
+                tab["snap_dev"] = None   # lazily, host and device
+            return idx, tb, tab
+
+    def _pattern_snapshot(self, tab: dict, device: bool = False):
+        """(stacked pow2-padded bit table (P, k*8, tb*8) int8, padded
+        uint8 matrices, live pattern count) for a captured table
+        object — the operand the batched kernel gathers from.  Pow-2
+        padding with zero matrices bounds the jit cache by the table
+        bucket, not the pattern population; a zero matrix decodes
+        anything to zeros, and no live stripe ever indexes a padded
+        slot.
+
+        ``device=True`` returns a device-RESIDENT table (cached until
+        the table grows): the whole point of coalescing is amortizing
+        the dispatch boundary, so the table must not be re-uploaded
+        host-to-device on every call — the same rule make_encoder
+        applies to the encode tables.  The stack + upload run OUTSIDE
+        the codec lock: the table is append-only within a generation,
+        so a prefix copy is consistent and covers every pattern index
+        any in-flight batch can carry (indices are assigned before
+        submit); a concurrent append just leaves the cached snapshot
+        for the next caller to rebuild."""
+        with self._decode_lock:
+            host = tab["snap"]
+            dev = tab["snap_dev"]
+            mats = list(tab["mats"])
+            if host is not None and (dev is not None or not device):
+                return (dev if device else host), mats, len(mats)
+            bits = list(tab["bits"])
+        n = len(bits)
+        if host is None:
+            host = np.zeros((bucket_stripes(max(n, 1)),)
+                            + bits[0].shape, dtype=np.int8)
+            host[:n] = np.stack(bits)
+        if device:
+            import jax
+            dev = jax.device_put(host)
+        with self._decode_lock:
+            if len(tab["bits"]) == n:    # still current: cache it
+                tab["snap"] = host
+                if device:
+                    tab["snap_dev"] = dev
+        return (dev if device else host), mats, n
+
+    def _decode_batch_fn(self, tab: dict, tb: int, stats=None):
+        """The engine-side fn for one table generation: decodes a
+        coalesced (S, k, B) batch whose stripes may span MANY erasure
+        patterns (pattern index per stripe in the aux array).  The
+        TABLE OBJECT is captured, not looked up: a retired generation
+        stays alive — and its indices meaningful — for exactly as long
+        as batches against it are in flight.  ``stats`` is the
+        DecodeDispatchStats sink the heterogeneity sample lands in —
+        the submitting engine's own sink, so a privately-instrumented
+        engine sees its patterns histogram populated."""
+        def fn(data, pidx):
+            pidx = np.asarray(pidx)
+            uniq = np.unique(pidx)
+            device = self.runtime not in ("cpu", "native")
+            snap, mats, live = self._pattern_snapshot(tab, device=device)
+            if stats is not None:
+                stats.record_patterns(int(uniq.size), live)
+            if not device:
+                if self.runtime == "native":
+                    from ceph_tpu.native import ec_encode_native as enc
+                else:
+                    enc = ec_encode_ref
+                out = np.zeros((data.shape[0], tb, data.shape[-1]),
+                               dtype=np.uint8)
+                for p in uniq:
+                    rows = np.nonzero(pidx == p)[0]
+                    out[rows] = np.asarray(enc(mats[int(p)], data[rows]))
+                return out
+            from ceph_tpu.ops.gf_kernel import ec_decode_batched
+            return ec_decode_batched(snap, pidx, data, k=self.k, t=tb)
+        return fn
+
+    def submit_decode_chunks(self, engine, chosen, chunks, targets):
+        """Submit an (S, k, B) decode through a dispatch engine
+        (ops.dispatch): returns a DispatchFuture of the
+        (S, len(targets), B) rebuilt rows.  The decode-side twin of
+        submit_chunks — but where encodes share one matrix, concurrent
+        decodes with DIFFERENT erasure patterns still coalesce into one
+        device call: each pattern's recovery matrix (reusing the
+        _recovery LRU) is registered in a stacked bit-matrix table, the
+        per-stripe pattern index rides the engine's aux channel, and
+        the kernel gathers the matrix per stripe
+        (gf_kernel.ec_decode_batched).  Raises ValueError synchronously
+        when the chosen rows are singular, so callers can fall back to
+        the widen-and-regather ladder before anything is queued."""
+        data = np.asarray(chunks, dtype=np.uint8)
+        chosen = tuple(chosen)
+        targets = tuple(targets)
+        t = len(targets)
+        idx, tb, tab = self._register_pattern(chosen, targets)
+        pidx = np.full(data.shape[0] if data.ndim else 1, idx,
+                       dtype=np.int32)
+        # the table GENERATION is part of the key: requests against a
+        # retired table must never share a batch with the generation
+        # that replaced it — a pattern index is only meaningful
+        # against the table it registered with
+        key = ("ec_decode", id(self), self.k, tb, data.shape[-1],
+               self.runtime, tab["gen"])
+        cache_entries = None
+        if self.runtime == "tpu":
+            from ceph_tpu.ops.gf_kernel import _decode_jit_entries
+            cache_entries = _decode_jit_entries
+        # heterogeneity samples land in the ENGINE's stats sink when it
+        # is decode-instrumented, falling back to the global decode
+        # registry (engines with a plain DispatchStats sink)
+        from ceph_tpu.ops import telemetry
+        stats = engine.stats if isinstance(
+            engine.stats, telemetry.DecodeDispatchStats) \
+            else telemetry.decode_dispatch_stats()
+        inner = engine.submit(key, self._decode_batch_fn(tab, tb, stats),
+                              data, aux=(pidx,), label="ec_decode",
+                              cache_entries=cache_entries)
+        if t == tb:
+            return inner
+        # the batch computes tb target rows per stripe (the bucket);
+        # deliver only this request's real ones.  The wrapper future
+        # preserves the engine's delivery order — the slice happens in
+        # the inner future's callback, on the completion thread.
+        from ceph_tpu.ops.dispatch import DispatchFuture
+        outer = DispatchFuture()
+
+        def _slice(f, t=t, outer=outer):
+            exc = f.exception()
+            if exc is not None:
+                outer._deliver(None, exc)
+            else:
+                outer._deliver(np.asarray(f.result())[:, :t, :], None)
+
+        inner.add_done_callback(_slice)
+        return outer
 
     def decode(self, want_to_read: set, chunks: dict) -> dict:
         available = set(chunks)

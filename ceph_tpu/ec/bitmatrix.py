@@ -153,6 +153,11 @@ class BitmatrixCode(ErasureCode):
     """RAID-6 code defined by a (2w, k*w) GF(2) coding bitmatrix; chunks are
     reshaped into w packet rows and run through the byte-code kernel."""
 
+    #: recovery matrices here are PACKET-level ((t*w, k*w) over GF(2)
+    #: rows), incompatible with the base pattern table's (t, k) chunk
+    #: geometry — decodes stay on the synchronous path
+    supports_submit_decode = False
+
     TECHNIQUE = ""
     FIXED_W: int | None = None
 
@@ -223,18 +228,15 @@ class BitmatrixCode(ErasureCode):
         return self._join(rebuilt)
 
     def _recovery(self, chosen: tuple, targets: tuple) -> np.ndarray:
-        key = (chosen, targets)
-        if key not in self._decode_cache:
-            if len(self._decode_cache) > 256:
-                self._decode_cache.clear()
+        def build():
             from ceph_tpu.gf.matrix import recovery_matrix
             try:
-                self._decode_cache[key] = recovery_matrix(
-                    self.generator, self._sub_rows(chosen),
-                    self._sub_rows(targets))
+                return recovery_matrix(self.generator,
+                                       self._sub_rows(chosen),
+                                       self._sub_rows(targets))
             except ValueError as e:
                 raise IOError(str(e))
-        return self._decode_cache[key]
+        return self._recovery_cached((chosen, targets), build)
 
 
 class BlaumRoth(BitmatrixCode):

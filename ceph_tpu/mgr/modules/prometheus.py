@@ -135,6 +135,7 @@ class Module(MgrModule):
         self._scrape_slow_ops(exp)
         self._scrape_kernels(exp)
         self._scrape_dispatch(exp)
+        self._scrape_decode_dispatch(exp)
         return exp.render()
 
     def _scrape_cluster(self, exp: Exposition) -> None:
@@ -214,6 +215,7 @@ class Module(MgrModule):
         # first use) so dashboards and the format test can rely on the
         # families existing
         reg.kernel("ec_encode")
+        reg.kernel("ec_decode")
         reg.kernel("crush_map")
         for kname, d in sorted(telemetry.dump().items()):
             p = f"ceph_kernel_{kname}"
@@ -247,8 +249,30 @@ class Module(MgrModule):
         """The cross-op coalescing engine (ops.dispatch): how many
         requests share each device call, how long they queue for the
         privilege, and how deep the pipeline runs."""
-        d = telemetry.dispatch_dump()
-        p = "ceph_kernel_coalesce"
+        self._emit_coalesce(exp, telemetry.dispatch_dump(),
+                            "ceph_kernel_coalesce")
+
+    def _scrape_decode_dispatch(self, exp: Exposition) -> None:
+        """The decode-side engine (heterogeneous-matrix batched GF
+        decode): the same coalescing families under
+        ceph_kernel_decode_coalesce_*, plus the heterogeneity story —
+        distinct erasure patterns per device call and the registered
+        pattern-table size."""
+        d = telemetry.decode_dispatch_dump()
+        p = "ceph_kernel_decode_coalesce"
+        self._emit_coalesce(exp, d, p)
+        pat = d["patterns"]
+        exp.histogram(f"{p}_patterns",
+                      "distinct erasure patterns per coalesced decode "
+                      "call (mass above 1 is heterogeneous-matrix "
+                      "batching at work)",
+                      pat["bounds"], pat["buckets"], pat["sum"])
+        exp.gauge(f"{p}_pattern_table",
+                  "recovery patterns registered in the stacked "
+                  "matrix table (high-water)", d["pattern_table_size"])
+
+    @staticmethod
+    def _emit_coalesce(exp: Exposition, d: dict, p: str) -> None:
         exp.counter(f"{p}_submits_total",
                     "requests submitted to the dispatch engine",
                     d["submits"])

@@ -108,14 +108,15 @@ class DispatchFuture:
 
 
 class _Request:
-    __slots__ = ("key", "fn", "data", "stripes", "future", "t_submit",
-                 "label", "cache_entries", "trace", "span")
+    __slots__ = ("key", "fn", "data", "aux", "stripes", "future",
+                 "t_submit", "label", "cache_entries", "trace", "span")
 
     def __init__(self, key, fn, data, stripes, label=None,
-                 cache_entries=None):
+                 cache_entries=None, aux=None):
         self.key = key
         self.fn = fn
         self.data = data
+        self.aux = aux
         self.stripes = stripes
         self.future = DispatchFuture()
         self.t_submit = time.monotonic()
@@ -220,11 +221,25 @@ class DeviceDispatchEngine:
     # -- submit ---------------------------------------------------------------
 
     def submit(self, key, fn, data, *, label=None,
-               cache_entries=None) -> DispatchFuture:
+               cache_entries=None, aux=None) -> DispatchFuture:
+        """``aux``: optional tuple of per-stripe side arrays (each with
+        the SAME leading axis as ``data``) that coalesce alongside it —
+        concatenated per component, edge-padded (last row repeated) to
+        the shape bucket, and passed to ``fn(batch, *aux_batches)``.  The batched GF
+        decode rides this: the per-stripe erasure-pattern index travels
+        as aux so requests with DIFFERENT recovery matrices still share
+        one device call.  All requests under one key must agree on aux
+        arity and trailing shapes (encode that in the key)."""
         data = np.asarray(data)
         stripes = int(data.shape[0]) if data.ndim else 1
+        if aux is not None:
+            aux = tuple(np.asarray(a) for a in aux)
+            for a in aux:
+                if not a.ndim or a.shape[0] != stripes:
+                    raise ValueError(
+                        f"aux leading axis {a.shape} != stripes {stripes}")
         req = _Request(key, fn, data, stripes, label=label,
-                       cache_entries=cache_entries)
+                       cache_entries=cache_entries, aux=aux)
         with self._cv:
             if not self._stop:
                 self._ensure_threads()
@@ -240,19 +255,29 @@ class DeviceDispatchEngine:
         # break the per-key submission-order contract the OSD's EC
         # log/commit ordering rides on.  Timed waits, not a bare wait:
         # the exiting threads' last notify may already have fired.
+        # EXCEPTION: a continuation re-submitting from one of this
+        # engine's OWN threads (an OSD completion callback re-entering
+        # the engine mid-stop) must not wait on a drain only itself can
+        # advance — that is a guaranteed self-deadlock wedging the
+        # completion thread and stranding every outstanding future.
+        # Running inline immediately forfeits ordering against the
+        # still-queued work, which is strictly better than the wedge.
+        me = threading.current_thread()
         with self._cv:
-            while self._pending or self._building or self._inflight:
-                self._cv.wait(0.05)
+            if me not in self._threads:
+                while self._pending or self._building or self._inflight:
+                    self._cv.wait(0.05)
         # inline OUTSIDE the engine lock, so a device call here never
         # serializes concurrent submit()/flush()/stop() callers
         # (and future callbacks never fire under the lock)
-        req.future._deliver(*self._run_inline(fn, data))
+        req.future._deliver(*self._run_inline(fn, data, aux))
         return req.future
 
     @staticmethod
-    def _run_inline(fn, data):
+    def _run_inline(fn, data, aux=None):
         try:
-            return np.asarray(fn(data)), None
+            out = fn(data) if aux is None else fn(data, *aux)
+            return np.asarray(out), None
         except BaseException as e:     # noqa: BLE001 — delivered to waiter
             return None, e
 
@@ -357,6 +382,25 @@ class DeviceDispatchEngine:
                                        dtype=reqs[0].data.dtype))
             batch_arr = arrays[0] if len(arrays) == 1 \
                 else np.concatenate(arrays, axis=0)
+            # aux side arrays coalesce in lockstep with data: same
+            # concatenation order.  Padding REPEATS the last row (edge
+            # padding) rather than writing zeros: aux rows are
+            # categorical (the decode's pattern index), and zero rows
+            # would invent category 0 in every padded batch — inflating
+            # the distinct-patterns telemetry and gathering a matrix no
+            # live stripe asked for.  Repeating a real row keeps the
+            # category set exact; the padded DATA rows are still
+            # all-zero, so whatever the repeated row selects computes
+            # zeros that are sliced off before delivery.
+            aux_batch = ()
+            if reqs[0].aux is not None:
+                for j in range(len(reqs[0].aux)):
+                    parts = [r.aux[j] for r in reqs]
+                    if pad:
+                        parts.append(np.repeat(parts[-1][-1:], pad,
+                                               axis=0))
+                    aux_batch += (parts[0] if len(parts) == 1
+                                  else np.concatenate(parts, axis=0),)
             traced = [r for r in reqs if r.trace is not None]
             if traced:
                 from ceph_tpu.common import tracing
@@ -372,7 +416,7 @@ class DeviceDispatchEngine:
                     before = reqs[0].cache_entries()
                 except Exception:
                     before = None
-            out = reqs[0].fn(batch_arr)     # async dispatch on jax
+            out = reqs[0].fn(batch_arr, *aux_batch)  # async dispatch on jax
             if before is not None:
                 try:
                     misses = max(0, reqs[0].cache_entries() - before)

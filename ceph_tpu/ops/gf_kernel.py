@@ -68,6 +68,23 @@ def ec_encode_ref(coeff: np.ndarray, data: np.ndarray) -> np.ndarray:
     return np.bitwise_xor.reduce(prods, axis=-2)
 
 
+def ec_decode_ref(tables: np.ndarray, pidx: np.ndarray,
+                  data: np.ndarray) -> np.ndarray:
+    """Reference heterogeneous-matrix decode on host.
+
+    tables : (P, t, k) uint8 stacked recovery matrices
+    pidx   : (S,) integer pattern index per stripe
+    data   : (S, k, B) uint8 surviving chunks
+    returns (S, t, B) uint8 — stripe i rebuilt with tables[pidx[i]]
+    """
+    tables = np.asarray(tables, dtype=np.uint8)
+    data = np.asarray(data, dtype=np.uint8)
+    mats = tables[np.asarray(pidx)]            # (S, t, k)
+    mt = mul_table()
+    prods = mt[mats[:, :, :, None], data[:, None, :, :]]  # (S, t, k, B)
+    return np.bitwise_xor.reduce(prods, axis=2)
+
+
 # ---------------------------------------------------------------------------
 # shared table prep
 # ---------------------------------------------------------------------------
@@ -137,6 +154,116 @@ def _encode_xla(w_bits: jax.Array, data: jax.Array, *, k: int, m: int,
             lambda xt: _xla_tile(w_bits, xt, k, m, dot_dtype), tiles
         ).reshape(-1, m)[:rows]
     return jnp.transpose(packed.reshape(s, b, m), (0, 2, 1)).astype(jnp.uint8)
+
+
+# ---------------------------------------------------------------------------
+# heterogeneous-matrix batched decode (XLA, any backend)
+# ---------------------------------------------------------------------------
+#
+# Encode coalesces trivially: every stripe multiplies the SAME coding
+# matrix, so concurrent ops stack on the batch axis of one matmul.
+# Decode could not — the recovery matrix depends on WHICH chunks
+# survived, so each erasure pattern used to be its own device call
+# (and its own jit entry).  Here the per-pattern bit matrices live
+# stacked in one (P, k*8, t*8) table operand; each stripe carries a
+# pattern index, the matrix is gathered on-device, and the product is
+# one batched dot_general over all stripes of all patterns: the MXU
+# sees a single (S, B, k8) x (S, k8, t8) batched matmul regardless of
+# how many distinct erasure patterns the batch mixes.  The jit cache
+# is bounded by buckets on BOTH data axes: the dispatch engine pow-2
+# buckets the stripe axis, the codec pow-2 pads the table axis, and t
+# is padded to a per-codec constant (zero matrix rows decode to zero
+# rows, sliced off by the submitter).
+
+#: stripes per decode tile: bounds the (ts, B, k*8) bit-expansion and
+#: the gathered (ts, k*8, t*8) matrix stack to VMEM-scale working sets
+#: while the batch streams through lax.map
+_DEC_TILE_S = 256
+
+
+def _decode_tile(w_tab: jax.Array, pidx: jax.Array, x: jax.Array,
+                 k: int, t: int, dot_dtype) -> jax.Array:
+    """x: (TS, k, B) uint8, pidx: (TS,) int32 -> (TS, t, B) uint8."""
+    ts, _, b = x.shape
+    bits = ((x[:, :, :, None].astype(jnp.int32) >> _BITW) & 1)  # (TS,k,B,8)
+    bits = jnp.transpose(bits, (0, 2, 1, 3)).reshape(ts, b, k * 8)
+    w = w_tab[pidx].astype(dot_dtype)                  # (TS, k8, t8) gather
+    acc = jax.lax.dot_general(
+        bits.astype(dot_dtype), w,
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32 if dot_dtype == jnp.bfloat16
+        else jnp.int32,
+    )
+    pb = acc.astype(jnp.int32) & 1                     # (TS, B, t*8)
+    out = jnp.sum(pb.reshape(ts, b, t, 8) << _BITW, axis=-1,
+                  dtype=jnp.int32)
+    return jnp.transpose(out, (0, 2, 1)).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "t", "dot_dtype"))
+def _decode_xla(w_tab: jax.Array, pidx: jax.Array, data: jax.Array, *,
+                k: int, t: int, dot_dtype=jnp.int8) -> jax.Array:
+    """data: (S, k, B) uint8 + per-stripe pattern index -> (S, t, B)."""
+    s = data.shape[0]
+    if s <= _DEC_TILE_S:
+        return _decode_tile(w_tab, pidx, data, k, t, dot_dtype)
+    pad = (-s) % _DEC_TILE_S
+    if pad:
+        data = jnp.concatenate(
+            [data, jnp.zeros((pad,) + data.shape[1:], dtype=data.dtype)])
+        pidx = jnp.concatenate(
+            [pidx, jnp.zeros((pad,), dtype=pidx.dtype)])
+    tiles = (data.reshape(-1, _DEC_TILE_S, *data.shape[1:]),
+             pidx.reshape(-1, _DEC_TILE_S))
+    out = jax.lax.map(
+        lambda xp: _decode_tile(w_tab, xp[1], xp[0], k, t, dot_dtype),
+        tiles)
+    return out.reshape(-1, t, out.shape[-1])[:s]
+
+
+def _decode_jit_entries() -> int:
+    """Compile-cache entry count for the batched decode entry point
+    (kept separate from _jit_entries so encode-side retrace accounting
+    is untouched)."""
+    return _decode_xla._cache_size()
+
+
+def ec_decode_batched(tables_bits: np.ndarray, pidx, data, *,
+                      k: int, t: int, dot_dtype=jnp.int8) -> jax.Array:
+    """Heterogeneous-matrix batched decode: one device call for stripes
+    spanning MIXED erasure patterns.
+
+    tables_bits : (P, k*8, t*8) int8 — stacked bit matrices
+                  (decode_bit_table), P power-of-two padded by the
+                  caller so the jit cache stays bounded by the table
+                  bucket, not the pattern population
+    pidx        : (S,) int — pattern index per stripe
+    data        : (S, k, B) uint8 surviving chunks
+    returns (S, t, B) uint8 (padded target rows are zeros).
+    """
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    pidx = jnp.asarray(pidx, dtype=jnp.int32)
+    tables_bits = jnp.asarray(tables_bits, dtype=jnp.int8)
+    s, _, b = data.shape
+    return telemetry.timed_kernel(
+        "ec_decode",
+        lambda: _decode_xla(tables_bits, pidx, data, k=k, t=t,
+                            dot_dtype=dot_dtype),
+        # the table operand is device-resident across calls (the codec
+        # caches its device_put per snapshot), so only the per-call
+        # operands count as h2d traffic
+        batch=s, bytes_in=s * k * b + pidx.nbytes,
+        bytes_out=s * t * b,
+        cache_entries=_decode_jit_entries,
+        signature=("ec_decode", k, t, s, b, tables_bits.shape[0],
+                   str(dot_dtype)))
+
+
+def decode_bit_table(mats) -> np.ndarray:
+    """Stack per-pattern recovery matrices into the kernel's table
+    operand: [(t, k) uint8, ...] -> (len(mats), k*8, t*8) int8."""
+    return np.stack([bit_matrix(np.asarray(m, dtype=np.uint8))
+                     for m in mats])
 
 
 # ---------------------------------------------------------------------------

@@ -274,6 +274,59 @@ class DispatchStats:
             }
 
 
+class DecodeDispatchStats(DispatchStats):
+    """Decode-side twin of DispatchStats (the heterogeneous-matrix
+    batched GF decode engine).
+
+    Decodes differ from encodes in ONE dimension the base counters
+    cannot see: the recovery matrix varies per erasure pattern, and the
+    whole point of the heterogeneous kernel is that requests with
+    DIFFERENT patterns still share a device call (pattern index carried
+    per stripe, matrices gathered from a stacked table on-device).  So
+    this adds the heterogeneity story: how many distinct erasure
+    patterns each coalesced call carried, and how large the registered
+    pattern table has grown (the matrix-table axis of the jit-cache
+    bound).
+    """
+
+    __slots__ = ("patterns", "pattern_table_size")
+
+    def __init__(self):
+        super().__init__()
+        self.patterns = Histogram(COALESCE_BOUNDS)  # distinct patterns/call
+        self.pattern_table_size = 0   # gauge: registered recovery patterns
+
+    def clear(self) -> None:
+        super().clear()
+        with self._lock:
+            self.patterns = Histogram(COALESCE_BOUNDS)
+            self.pattern_table_size = 0
+
+    def record_patterns(self, distinct: int, table_size: int) -> None:
+        """One batched decode ran with ``distinct`` erasure patterns
+        against a table of ``table_size`` registered patterns."""
+        with self._lock:
+            self.patterns.add(distinct)
+            if table_size > self.pattern_table_size:
+                self.pattern_table_size = table_size
+
+    def dump(self) -> dict:
+        d = super().dump()
+        with self._lock:
+            d["patterns"] = self.patterns.dump()
+            d["pattern_table_size"] = self.pattern_table_size
+        return d
+
+    def summary(self) -> dict:
+        s = super().summary()
+        with self._lock:
+            n = self.patterns.count
+            s["mean_patterns"] = (round(self.patterns.sum / n, 2)
+                                  if n else 0.0)
+            s["pattern_table_size"] = self.pattern_table_size
+        return s
+
+
 class KernelTelemetry:
     """The registry: one KernelStats per kernel name."""
 
@@ -281,6 +334,7 @@ class KernelTelemetry:
         self._lock = threading.Lock()
         self._kernels: dict[str, KernelStats] = {}
         self.dispatch = DispatchStats()
+        self.decode_dispatch = DecodeDispatchStats()
         #: block_until_ready before closing each latency sample
         self.fence_for_timing = False
         #: master switch; off-path cost when False is one attribute read
@@ -305,6 +359,7 @@ class KernelTelemetry:
         with self._lock:
             self._kernels.clear()
         self.dispatch.clear()
+        self.decode_dispatch.clear()
 
     def summary(self) -> dict:
         """Compact digest (bench.py prints this next to its JSON)."""
@@ -356,6 +411,23 @@ def dispatch_dump() -> dict:
 
 def dispatch_summary() -> dict:
     return _REG.dispatch.summary()
+
+
+def decode_dispatch_stats() -> DecodeDispatchStats:
+    """The decode-side coalescing counters (heterogeneous-matrix
+    batched GF decode): engines built by ``ctx.decode_dispatch_engine``
+    feed this, the codec's batched decode fn records the per-call
+    pattern heterogeneity into it, and the mgr's
+    ``ceph_kernel_decode_coalesce_*`` families read it."""
+    return _REG.decode_dispatch
+
+
+def decode_dispatch_dump() -> dict:
+    return _REG.decode_dispatch.dump()
+
+
+def decode_dispatch_summary() -> dict:
+    return _REG.decode_dispatch.summary()
 
 
 def set_fence_for_timing(on: bool) -> None:

@@ -247,6 +247,16 @@ class OSDDaemon(Dispatcher):
         self.ctx.conf.add_observer(
             "osd_ec_dispatch_async",
             lambda _n, v: setattr(self, "_ec_async", bool(v)))
+        #: async EC decode dispatch: degraded reads, recovery pulls and
+        #: rmw gathers SUBMIT the decode through the context's decode
+        #: engine (heterogeneous-matrix batched kernel — mixed erasure
+        #: patterns share one device call) and finish reply/push/
+        #: overlay in the completion continuation.  Hot-togglable.
+        self._ec_decode_async = bool(
+            self.ctx.conf.get("osd_ec_decode_async"))
+        self.ctx.conf.add_observer(
+            "osd_ec_decode_async",
+            lambda _n, v: setattr(self, "_ec_decode_async", bool(v)))
 
         self._auth_key = auth_key
         self._cephx = cephx
@@ -287,6 +297,8 @@ class OSDDaemon(Dispatcher):
                      .add_u64("ec_rmw_gather").add_u64("ec_rmw_pipelined")
                      .add_u64("ec_dispatch_submits")
                      .add_u64("ec_dispatch_commits")
+                     .add_u64("ec_decode_submits")
+                     .add_u64("recovery_decode_stripes")
                      .add_time_avg("op_w_latency")
                      .create_perf_counters())
         self.ctx.perf.add(self.perf)
@@ -466,17 +478,25 @@ class OSDDaemon(Dispatcher):
         # then stop the engine's threads.  Only when the ctx is ours:
         # a caller-supplied context may serve other daemons.  Stragglers
         # submitting after stop() run inline, so nothing can hang.
-        eng = self.ctx._dispatch if self._own_ctx else None
-        if eng is not None:
+        # decode first: its continuations (recovery re-encode, rmw
+        # drain) submit into the encode engine, which must still be
+        # live to take them; encode-side stragglers after its own stop
+        # run inline, so nothing can hang either way
+        engines = ([("decode", self.ctx._decode_dispatch),
+                    ("dispatch", self.ctx._dispatch)]
+                   if self._own_ctx else [])
+        for ename, eng in engines:
+            if eng is None:
+                continue
             if not eng.flush(timeout=5.0):
-                dout("osd", 0, "osd.%d shutdown: dispatch engine did "
-                     "not drain in 5s — in-flight EC commits may land "
-                     "on the unmounted store and be dropped",
-                     self.osd_id)
+                dout("osd", 0, "osd.%d shutdown: %s engine did "
+                     "not drain in 5s — in-flight EC completions may "
+                     "land on the unmounted store and be dropped",
+                     self.osd_id, ename)
             if not eng.stop():
-                dout("osd", 0, "osd.%d shutdown: dispatch engine "
+                dout("osd", 0, "osd.%d shutdown: %s engine "
                      "thread(s) still live past join timeout",
-                     self.osd_id)
+                     self.osd_id, ename)
         self.msgr.shutdown()
         self.store.umount()
 
@@ -3440,23 +3460,135 @@ class OSDDaemon(Dispatcher):
         if stale:
             self._ec_gather(reqid, state)
             return
+        if self._ec_submit_decode(reqid, state):
+            # submit-and-continue: the decode rides the decode engine
+            # (coalescing with every other in-flight gather's decode —
+            # even under DIFFERENT erasure patterns) and the completion
+            # continuation finishes the read
+            return
         try:
             data = self._ec_decode_state(state)
-        except IOError:
-            # non-MDS codecs (shec) cannot decode from every k-subset:
-            # widen the gather by one shard and keep going
+        except (ValueError, IOError):
+            # non-MDS codecs cannot decode from every k-subset: widen
+            # the gather by one shard and keep going.  IOError is the
+            # bitmatrix/shec spelling; a plain matrix codec whose
+            # chosen rows are singular raises ValueError from
+            # recovery_matrix (unreachable for the bundled MDS codecs,
+            # but a third-party generator must widen, not wedge)
             with self._lock:
                 state["k"] = len(state["shards"]) + 1
             self._ec_gather(reqid, state)
             return
+        self._ec_read_finish(reqid, state, data)
+
+    def _ec_submit_decode(self, reqid, state: dict) -> bool:
+        """Submit the gather's reconstruction through the decode
+        dispatch engine: True when the completion continuation now owns
+        the rest of the read.  False falls back to the synchronous
+        path — whole-object codecs (si None), packet-level bitmatrix
+        codecs, the knob off, a widened (non-MDS) gather, no missing
+        data rows, or a singular chosen set (the widen ladder handles
+        that one just like the sync decode's IOError)."""
+        if not self._ec_decode_async:
+            return False
+        pool = state["pool"]
+        codec = self._codec(pool)
+        if not getattr(codec, "supports_submit_decode", False):
+            return False
+        si = self._ec_stripe_info(codec, pool)
+        if si is None:
+            return False
+        k = codec.get_data_chunk_count()
+        if state["k"] != k:
+            return False
+        # cheap pre-check BEFORE any array assembly: a healthy read
+        # (all k data shards gathered) needs no device call, and the
+        # sync fallback would otherwise redo the whole assembly
+        if all(s < k for s in sorted(state["shards"])[:k]):
+            return False
+        size = state["size"]
+        chosen, arr, targets, stripes = self._ec_gathered_stripes(
+            si, k, state["shards"], size)
+        # targets cannot be empty here: the pre-check above bailed on
+        # the all-data-shards case, so at least one parity shard is in
+        # `chosen` and at least one data row is missing
+        engine = self.ctx.decode_dispatch_engine()
+        try:
+            fut = codec.submit_decode_chunks(engine, chosen, arr,
+                                             targets)
+        except (ValueError, IOError):
+            return False
+        self.perf.inc("ec_decode_submits")
+        if state["kind"] == "recover":
+            self.perf.inc("recovery_decode_stripes", int(arr.shape[0]))
+        trk = getattr(state.get("msg"), "_trk", None)
+        if trk is not None:
+            trk.mark_event(
+                f"ec_decode submitted ({arr.shape[0]} stripes, "
+                f"{len(targets)} targets)")
+        cctx = (reqid, state, si, stripes, targets, size)
+        fut.add_done_callback(
+            lambda f, c=cctx: self._ec_decode_done(*c, f))
+        return True
+
+    def _ec_decode_done(self, reqid, state: dict, si, stripes, targets,
+                        size: int, fut) -> None:
+        """Decode-engine completion continuation (runs on the decode
+        engine's completion thread): overlay the rebuilt rows and
+        finish the gather — client reply, rmw overlay-and-drain, or
+        recovery store/push."""
+        err = fut.exception()
+        if err is not None:
+            # device-side failure: re-enter the retry ladder exactly
+            # like the synchronous decode's IOError widen
+            dout("osd", 1, "osd.%d async ec decode failed for %s: %r",
+                 self.osd_id, state.get("oid"), err)
+            with self._lock:
+                if self._ec_reads.get(reqid) is not state:
+                    return
+                state["k"] = len(state["shards"]) + 1
+            self._ec_gather(reqid, state)
+            return
+        rec = np.asarray(fut.result())
+        for idx, d in enumerate(targets):
+            stripes[:, d, :] = rec[:, idx, :]
+        data = si.join(stripes).tobytes()[:size]
+        # re-join the op's trace: the completion thread has no trace
+        # context, but the reply / shard fan-out must stitch into the
+        # op's span tree (same rule as _ec_write_committed)
+        msg = state.get("msg")
+        tid = getattr(msg, "trace_id", 0) if msg is not None else 0
+        from ceph_tpu.common import tracing
+        if tid and tracing.current() != tid:
+            prev = tracing.set_current(
+                tid, getattr(msg, "parent_span_id", 0))
+            try:
+                self._ec_read_finish(reqid, state, data)
+            finally:
+                tracing.set_current(prev)
+            return
+        self._ec_read_finish(reqid, state, data)
+
+    def _ec_read_finish(self, reqid, state: dict, data: bytes) -> None:
+        """Reconstructed object bytes in hand (synchronous decode or
+        decode-engine continuation): complete the gather by kind."""
         if state["kind"] == "rmw":
             # the rmw state stays registered in _ec_reads until the
             # pipeline drain completes: a write arriving in this window
             # must find it live and join its queue, not mistake the gate
-            # for a torn-down gather and usurp it (_ec_rmw_ready pops)
+            # for a torn-down gather and usurp it (_ec_rmw_ready pops;
+            # it also detects a gate lost to an interval change while
+            # an async decode was in flight and requeues instead)
             self._ec_rmw_ready(state, data)
             return
         with self._lock:
+            if self._ec_reads.get(reqid) is not state:
+                # superseded while the decode was in flight (a client
+                # resend re-registered this reqid with a fresh gather,
+                # or a teardown claimed the state): the live owner
+                # replies — a completion here would double-reply or
+                # double-push
+                return
             self._ec_reads.pop(reqid, None)
         if state["kind"] == "client":
             msg = state["msg"]
@@ -3469,19 +3601,13 @@ class OSDDaemon(Dispatcher):
             return
         self._ec_recover_done(state, data)
 
-    def _ec_decode_state(self, state: dict) -> bytes:
-        """Gathered shards -> full object bytes.  Striped pools decode
-        all stripes in one batched device call; whole-object pools go
-        through the codec's own decode."""
-        pool = state["pool"]
-        codec = self._codec(pool)
-        k = codec.get_data_chunk_count()
-        si = self._ec_stripe_info(codec, pool)
-        size = state["size"]
-        shards = state["shards"]
-        if si is None:
-            decoded = codec.decode(set(range(k)), dict(shards))
-            return b"".join(decoded[i] for i in range(k))[:size]
+    @staticmethod
+    def _ec_gathered_stripes(si, k: int, shards: dict, size: int):
+        """Shared shard-to-array assembly for the sync and async decode
+        paths (they MUST reconstruct identically whatever the
+        osd_ec_decode_async setting): (chosen, arr (S, k_chosen, su) of
+        gathered columns, missing data-row targets, stripes buffer
+        with the surviving data rows scattered in)."""
         shard_len = si.shard_len(size)
         chosen = sorted(shards)[:k]
         cols = []
@@ -3497,6 +3623,23 @@ class OSDDaemon(Dispatcher):
         for i, s in enumerate(chosen):
             if s < k:
                 stripes[:, s, :] = arr[:, i, :]
+        return chosen, arr, targets, stripes
+
+    def _ec_decode_state(self, state: dict) -> bytes:
+        """Gathered shards -> full object bytes.  Striped pools decode
+        all stripes in one batched device call; whole-object pools go
+        through the codec's own decode."""
+        pool = state["pool"]
+        codec = self._codec(pool)
+        k = codec.get_data_chunk_count()
+        si = self._ec_stripe_info(codec, pool)
+        size = state["size"]
+        shards = state["shards"]
+        if si is None:
+            decoded = codec.decode(set(range(k)), dict(shards))
+            return b"".join(decoded[i] for i in range(k))[:size]
+        chosen, arr, targets, stripes = self._ec_gathered_stripes(
+            si, k, shards, size)
         if targets:
             rec = np.asarray(codec.decode_chunks(chosen, arr, targets))
             for idx, d in enumerate(targets):
@@ -3505,15 +3648,55 @@ class OSDDaemon(Dispatcher):
 
     def _ec_recover_done(self, state: dict, data: bytes) -> None:
         """Reconstructed the full object: re-encode and deliver the
-        destination shard's chunk."""
+        destination shard's chunk.  With async dispatch on, the
+        re-encode SUBMITS through the encode engine — the reservation
+        window's concurrent in-flight pulls coalesce their re-encodes
+        into one device call — and the store/push runs in the
+        continuation."""
         pool = state["pool"]
+        codec = self._codec(pool)
+        si = self._ec_stripe_info(codec, pool)
+        if self._ec_async and si is not None:
+            stripes = si.split(np.frombuffer(data, dtype=np.uint8))
+            n = codec.get_chunk_count()
+            fut = codec.submit_chunks(self.ctx.dispatch_engine(),
+                                      stripes)
+            self.perf.inc("ec_dispatch_submits")
+            fut.add_done_callback(
+                lambda f, c=(state, data, si, stripes, n):
+                self._ec_recover_encoded(*c, f))
+            return
+        chunks = self._ec_encode_object(codec, si, data)
+        self._ec_recover_store(state, data, chunks)
+
+    def _ec_recover_encoded(self, state: dict, data: bytes, si,
+                            stripes, n: int, fut) -> None:
+        """Encode-engine continuation for a recovery re-encode."""
+        err = fut.exception()
+        if err is not None:
+            # the pull itself succeeded; a failed re-encode just
+            # releases the recovering gate so the recovery window can
+            # retry the object (it is still missing)
+            dout("osd", 1, "osd.%d recovery re-encode failed for "
+                 "%s: %r", self.osd_id, state.get("oid"), err)
+            pg = self.pgs.get(state["pgid"])
+            if pg is not None:
+                with self._lock:
+                    pg.recovering.pop(state["oid"], None)
+            return
+        chunks = self._ec_shard_columns(si, stripes, fut.result(), n)
+        # keep the submit/commit pair convergent: operators read
+        # in-flight encodes as submits - commits
+        self.perf.inc("ec_dispatch_commits")
+        self._ec_recover_store(state, data, chunks)
+
+    def _ec_recover_store(self, state: dict, data: bytes,
+                          chunks: dict) -> None:
+        """Store (self) or push (peer) the recovered shard."""
         pgid = state["pgid"]
         oid = state["oid"]
         need = state["need"]
         dest_shard = state["dest_shard"]
-        codec = self._codec(pool)
-        si = self._ec_stripe_info(codec, pool)
-        chunks = self._ec_encode_object(codec, si, data)
         cid = f"{pgid[0]}.{pgid[1]}"
         shard_oid = f"{oid}:{dest_shard}"
         from ceph_tpu.osd.ec_util import HashInfo

@@ -124,7 +124,19 @@ def test_pg_autoscaler_grows_filling_pool(cluster):
         io.write_full(f"fill-{i}", b"x" * 4096)
     mgr = cluster.run_mgr(0)
     try:
-        assert _wait(lambda: mgr.is_active)
+        # the whole autonomous chain — OSD stat reports -> mgr host
+        # tick (5 s timer) -> maybe_scale -> mon `osd pool set pg_num`
+        # -> map propagation -> PG splits -> client map refresh — is a
+        # stack of independent timers that all slip together under
+        # full-suite load on a 1-core host.  One generous wall-clock
+        # DEADLINE for the whole chain, polled against, instead of
+        # per-step timeouts sized for an idle machine; the poll
+        # interval is coarse so the wait itself does not eat the core
+        # the timers need.
+        deadline = time.time() + 150.0
+        left = lambda: max(5.0, deadline - time.time())  # noqa: E731
+        assert _wait(lambda: mgr.is_active, timeout=left(),
+                     interval=0.25)
         # configure a small budget through the module-option store
         # (mon-side config-key), then enable the module — from here on
         # everything is autonomous: host tick -> maybe_scale -> mon
@@ -135,11 +147,17 @@ def test_pg_autoscaler_grows_filling_pool(cluster):
                                        "module": "pg_autoscaler"})
         assert rc == 0, out
         # wait for the report feed, then for the autonomous growth
-        assert _wait(lambda: mgr.pg_dump()["num_pgs"] > 0, timeout=30.0)
+        assert _wait(lambda: mgr.pg_dump()["num_pgs"] > 0,
+                     timeout=left(), interval=0.25)
         assert _wait(
             lambda: client.osdmap.pools.get(pool) is not None
-            and client.osdmap.pools[pool].pg_num > 2, timeout=45.0), \
+            and client.osdmap.pools[pool].pg_num > 2,
+            timeout=left(), interval=0.25), \
             f"pg_num still {client.osdmap.pools[pool].pg_num}"
+        # growth may land in steps; poll until the full target, not
+        # just past the first split
+        _wait(lambda: client.osdmap.pools[pool].pg_num >= 8,
+              timeout=left(), interval=0.25)
         grown = client.osdmap.pools[pool].pg_num
         assert grown >= 8
         # autoscale-status reports what it did
