@@ -27,6 +27,22 @@ CRUSH runs with non-uniform bucket weights, a skewed reweight vector, and out
 OSDs — the retry-ladder-heavy case, not the easy uniform one.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+
+Sections: the run is split into named sweeps selectable with
+``--sections`` (comma list) so any ONE section completes well inside a
+590 s harness timeout on slow hosts:
+
+  ec              device EC encode/recover rates + C baseline + the
+                  fenced kernel-telemetry digest
+  crush           device bulk CRUSH placement rate + C baseline
+  dispatch_sweep  encode-side cross-op coalescing concurrency sweep
+  recovery_sweep  decode-side (heterogeneous-pattern) concurrency sweep
+  map_churn       map-epoch consumption storm: scalar full-scan vs the
+                  shared PG mapping service (epochs/s, per-epoch scan
+                  time, changed-PG counts), bit-verified vs the oracle
+
+Default (no flag) runs every section EXCEPT map_churn — byte-compatible
+with the historical flagship JSON; ``--sections all`` adds map_churn.
 """
 
 from __future__ import annotations
@@ -248,176 +264,340 @@ def recovery_sweep(k: int, m: int, chunk: int, levels=(1, 4, 16),
                               extra_row=extra_row)
 
 
-def main() -> None:
+def map_churn(pools: int = 6, pg_num: int = 256, hosts: int = 16,
+              per_host: int = 4, epochs: int = 10) -> dict:
+    """Map-epoch consumption sweep: a reweight/mark-down/override storm
+    over many pools, comparing the seed's scalar full scan (every PG
+    through pg_to_up_acting_osds on every epoch) against the shared
+    mapping service (incremental pool recompute + on-device diff +
+    O(changed) reads).  Every epoch's shared-cache reads are verified
+    bit-identical to the scalar oracle across ALL PGs — the timing rows
+    only count the work each consumption strategy actually does."""
+    from ceph_tpu.crush import build_two_level_map
+    from ceph_tpu.osd import OSDMap, PGPool, SharedPGMappingService
+
+    crush, _root, rule = build_two_level_map(hosts, per_host)
+    n = hosts * per_host
+    m = OSDMap(crush=crush, epoch=2)
+    m.set_max_osd(n)
+    for o in range(n):
+        m.mark_up(o)
+    for p in range(1, pools + 1):
+        m.pools[p] = PGPool(pool_id=p, size=3, crush_rule=rule,
+                            pg_num=pg_num)
+    svc = SharedPGMappingService()
+    svc.update_to(m)    # epoch 0->2: full build (+ kernel compile)
+    rng = np.random.default_rng(5)
+    t_shared: list[float] = []
+    t_scalar: list[float] = []
+    changed_counts: list[int] = []
+    verified = True
+    for i in range(epochs):
+        new = m.copy()
+        new.epoch = m.epoch + 1
+        kind = i % 5
+        osd = int(rng.integers(0, n))
+        if kind == 0:      # reweight storm step (pools recompute)
+            new.osd_weight[osd] = int(rng.choice(
+                (0x4000, 0x8000, 0xC000, 0x10000)))
+        elif kind == 1:    # mark down (state-only: tables reuse)
+            new.osd_state[osd] = new.osd_state[osd] & ~2
+        elif kind == 2:    # mark back up
+            new.osd_state[osd] = new.osd_state[osd] | 3
+        elif kind == 3:    # pg_temp inject/clear (override-only)
+            pgid = (1 + int(rng.integers(0, pools)),
+                    int(rng.integers(0, pg_num)))
+            if pgid in new.pg_temp:
+                del new.pg_temp[pgid]
+            else:
+                new.pg_temp[pgid] = [osd, (osd + 1) % n]
+        else:              # mark out / back in (weight edge)
+            new.osd_weight[osd] = (0x10000 if new.osd_weight[osd] == 0
+                                   else 0)
+        # shared-cache consumption: epoch update + reading every
+        # changed PG (what _scan_pgs does beyond its local PGs)
+        t0 = time.perf_counter()
+        upd = svc.update_to(new, from_epoch=m.epoch)
+        reads = (upd.changed if not upd.full
+                 else [(pid, pg) for pid, pool in new.pools.items()
+                       for pg in range(pool.pg_num)])
+        for pid, pg in reads:
+            svc.lookup(new, pid, pg)
+        t_shared.append(time.perf_counter() - t0)
+        changed_counts.append(len(reads))
+        # scalar baseline: the seed's full per-epoch scan
+        t0 = time.perf_counter()
+        oracle = {(pid, pg): new.pg_to_up_acting_osds(pid, pg)
+                  for pid, pool in new.pools.items()
+                  for pg in range(pool.pg_num)}
+        t_scalar.append(time.perf_counter() - t0)
+        # bit-identical acceptance gate, over EVERY pg
+        for (pid, pg), want in oracle.items():
+            if svc.lookup(new, pid, pg) != want:
+                verified = False
+        m = new
+    from ceph_tpu.ops import telemetry
+    med = (lambda xs: sorted(xs)[len(xs) // 2])
+    sh, sc = med(t_shared), med(t_scalar)
+    digest = telemetry.mapping_summary()
+    # the bit-verify gate above reads EVERY pg per epoch through the
+    # same global stats — those lookup counters describe the gate, not
+    # the timed consumption loop, so report the timed reads instead
+    digest.pop("lookups", None)
+    digest.pop("lookup_fallbacks", None)
+    digest["timed_reads"] = int(sum(changed_counts))
+    return {
+        "pgs": pools * pg_num,
+        "osds": n,
+        "epochs": epochs,
+        "scalar_epoch_s": round(sc, 4),
+        "shared_epoch_s": round(sh, 4),
+        "speedup": round(sc / sh, 1) if sh > 0 else 0.0,
+        "scalar_epochs_per_s": round(1.0 / sc, 2) if sc > 0 else 0.0,
+        "shared_epochs_per_s": round(1.0 / sh, 2) if sh > 0 else 0.0,
+        "mean_changed_pgs": round(sum(changed_counts)
+                                  / len(changed_counts), 1),
+        "verified": verified,
+        "mapping": digest,
+    }
+
+
+SECTIONS = ("ec", "crush", "dispatch_sweep", "recovery_sweep",
+            "map_churn")
+#: the historical flagship run (map_churn is opt-in: it is a
+#: consumption-path sweep, not a device-kernel headline)
+DEFAULT_SECTIONS = ("ec", "crush", "dispatch_sweep", "recovery_sweep")
+
+
+def main(argv=None) -> None:
+    import argparse
+
     import jax
     import jax.numpy as jnp
 
-    from ceph_tpu.gf.matrix import gen_cauchy1_matrix, recovery_matrix
-    from ceph_tpu.ops.gf_kernel import make_encoder
+    ap = argparse.ArgumentParser(
+        prog="bench",
+        description="tpu-rados flagship benchmark; see module "
+                    "docstring for the section list")
+    ap.add_argument(
+        "--sections", default=None, metavar="NAMES",
+        help="comma list of sweeps to run (%s), or 'all'; default "
+             "runs the flagship set (%s).  Any single section "
+             "completes well inside a 590 s harness timeout."
+             % (",".join(SECTIONS), ",".join(DEFAULT_SECTIONS)))
+    args = ap.parse_args(argv)
+    if args.sections is None:
+        secs = set(DEFAULT_SECTIONS)
+    elif args.sections.strip() == "all":
+        secs = set(SECTIONS)
+    else:
+        secs = {s.strip() for s in args.sections.split(",") if s.strip()}
+        unknown = secs - set(SECTIONS)
+        if unknown:
+            ap.error(f"unknown sections {sorted(unknown)}; "
+                     f"choose from {SECTIONS}")
 
     k, m = 8, 4
     chunk = 4096          # 4 KiB chunks — BASELINE.json config
     stripes = 2048        # 64 MiB of data per device call
     erasures = [1, k + 1]  # one data + one parity chunk lost
-
-    gen = gen_cauchy1_matrix(k, m)
-    coding = gen[k:]
-    chosen = [i for i in range(k + m) if i not in set(erasures)][:k]
-    rmat = recovery_matrix(gen, chosen, erasures)
-    encode = make_encoder(coding)
-    recover = make_encoder(rmat)
-
-    rng = np.random.default_rng(0)
-    data = jnp.asarray(
-        rng.integers(0, 256, (stripes, k, chunk), dtype=np.uint8))
     data_bytes = stripes * k * chunk
+    rng = np.random.default_rng(0)
+    out: dict = {}
 
-    def enc_step(d):
-        p = encode(d)
-        return d.at[0, 0, 0].set(p[0, 0, 0] ^ jnp.uint8(1))
+    encode = None
+    if secs & {"ec", "dispatch_sweep"}:
+        from ceph_tpu.gf.matrix import gen_cauchy1_matrix, recovery_matrix
+        from ceph_tpu.ops.gf_kernel import make_encoder
 
-    t_enc, t_enc_min, t_enc_max = median_band(chained_rates(enc_step, data))
-    enc_mbps = data_bytes / t_enc / 1e6
+        gen = gen_cauchy1_matrix(k, m)
+        coding = gen[k:]
+        chosen = [i for i in range(k + m) if i not in set(erasures)][:k]
+        rmat = recovery_matrix(gen, chosen, erasures)
+        encode = make_encoder(coding)
+        recover = make_encoder(rmat)
 
-    surv = jnp.asarray(
-        rng.integers(0, 256, (stripes, k, chunk), dtype=np.uint8))
+    data = None
+    if "ec" in secs:
+        data = jnp.asarray(
+            rng.integers(0, 256, (stripes, k, chunk), dtype=np.uint8))
 
-    def dec_step(s):
-        r = recover(s)
-        return s.at[0, 0, 0].set(r[0, 0, 0] ^ jnp.uint8(1))
+        def enc_step(d):
+            p = encode(d)
+            return d.at[0, 0, 0].set(p[0, 0, 0] ^ jnp.uint8(1))
 
-    t_dec, t_dec_min, t_dec_max = median_band(chained_rates(dec_step, surv))
-    dec_mbps = data_bytes / t_dec / 1e6
+        t_enc, t_enc_min, t_enc_max = median_band(
+            chained_rates(enc_step, data))
+        enc_mbps = data_bytes / t_enc / 1e6
 
-    combined = 2 * data_bytes / (t_enc + t_dec) / 1e6
+        surv = jnp.asarray(
+            rng.integers(0, 256, (stripes, k, chunk), dtype=np.uint8))
 
-    # CRUSH bulk placement (BASELINE config #5 shape): 10k-OSD two-level map
-    # (250 hosts x 40 osds), chooseleaf firstn 3, 64k PGs per device call.
-    # Non-uniform: skewed per-osd bucket weights, 10% reweighted to 0.5,
-    # 2% out — the retry ladder actually fires.
-    from ceph_tpu.crush import build_two_level_map
-    from ceph_tpu.crush.mapper_jax import BatchMapper
+        def dec_step(s):
+            r = recover(s)
+            return s.at[0, 0, 0].set(r[0, 0, 0] ^ jnp.uint8(1))
 
-    crush_map, _root, rid = build_two_level_map(250, 40)
-    wrng = np.random.default_rng(42)
-    for b in crush_map.buckets:
-        if b is not None and b.type == 1:  # host level: skew osd weights
-            b.item_weights = [int(w) for w in
-                              wrng.integers(0x8000, 0x20000, b.size)]
-            b.weight = sum(b.item_weights)
-    root = crush_map.bucket(-1)
-    root.item_weights = [crush_map.bucket(h).weight for h in root.items]
-    root.weight = sum(root.item_weights)
+        t_dec, t_dec_min, t_dec_max = median_band(
+            chained_rates(dec_step, surv))
+        dec_mbps = data_bytes / t_dec / 1e6
 
-    n_osds = 10000
-    reweight = np.full(n_osds, 0x10000, dtype=np.int64)
-    idx = wrng.permutation(n_osds)
-    reweight[idx[:1000]] = 0x8000   # 10% half-weight
-    reweight[idx[1000:1200]] = 0    # 2% out
+        combined = 2 * data_bytes / (t_enc + t_dec) / 1e6
 
-    bm = BatchMapper(crush_map)
-    n_pgs, numrep = 65536, 3
-    rw = jnp.asarray(reweight)
-    xs = jnp.asarray(rng.integers(0, 2**32, (n_pgs,), dtype=np.uint32))
-    bm.do_rule(rid, xs, numrep, rw)  # compile
+        # single-core C baseline (ceph_tpu/native): ISA-L-class SIMD
+        # encode, same inputs, same math
+        from ceph_tpu.native import ec_encode_native
 
-    def crush_step(x):
-        p = bm.do_rule(rid, x, numrep, rw)
-        return x ^ p[:, 0].astype(jnp.uint32)
+        cpu_data = np.asarray(data)
+        t_c = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            ec_encode_native(coding, cpu_data)
+            t_c = min(t_c, time.perf_counter() - t0)
+        c_enc_mbps = data_bytes / t_c / 1e6
+        t_c = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            ec_encode_native(rmat, cpu_data)
+            t_c = min(t_c, time.perf_counter() - t0)
+        c_dec_mbps = data_bytes / t_c / 1e6
+        c_combined = 2 / (1 / c_enc_mbps + 1 / c_dec_mbps)
 
-    t_crush, t_crush_min, t_crush_max = median_band(
-        chained_rates(crush_step, xs, n_lo=4, n_hi=24, reps=5,
-                      inner=4))
-    crush_mpps = n_pgs / t_crush / 1e6
+        out.update({
+            "metric": "ec encode+recover MB/s "
+                      "(k=8,m=4,4KiB chunks, batch=2048)",
+            "value": round(combined, 1),
+            "unit": "MB/s",
+            "vs_baseline": round(combined / c_combined, 2),
+            "encode_mbps": round(enc_mbps, 1),
+            "encode_mbps_band": [round(data_bytes / t_enc_max / 1e6, 1),
+                                 round(data_bytes / t_enc_min / 1e6, 1)],
+            "recover_mbps": round(dec_mbps, 1),
+            "recover_mbps_band": [round(data_bytes / t_dec_max / 1e6, 1),
+                                  round(data_bytes / t_dec_min / 1e6, 1)],
+            "c_encode_mbps": round(c_enc_mbps, 1),
+            "c_recover_mbps": round(c_dec_mbps, 1),
+            "encode_vs_c": round(enc_mbps / c_enc_mbps, 2),
+        })
 
-    # single-core C baselines (ceph_tpu/native): ISA-L-class SIMD encode and
-    # scalar crush_do_rule, same inputs, same math
-    from ceph_tpu.native import CrushBaseline, ec_encode_native
+    bm = None
+    if "crush" in secs:
+        # CRUSH bulk placement (BASELINE config #5 shape): 10k-OSD
+        # two-level map (250 hosts x 40 osds), chooseleaf firstn 3, 64k
+        # PGs per device call.  Non-uniform: skewed per-osd bucket
+        # weights, 10% reweighted to 0.5, 2% out — the retry ladder
+        # actually fires.
+        from ceph_tpu.crush import build_two_level_map
+        from ceph_tpu.crush.mapper_jax import BatchMapper
 
-    cpu_data = np.asarray(data)
-    t_c = float("inf")
-    for _ in range(3):
+        crush_map, _root, rid = build_two_level_map(250, 40)
+        wrng = np.random.default_rng(42)
+        for b in crush_map.buckets:
+            if b is not None and b.type == 1:  # host level: skew weights
+                b.item_weights = [int(w) for w in
+                                  wrng.integers(0x8000, 0x20000, b.size)]
+                b.weight = sum(b.item_weights)
+        root = crush_map.bucket(-1)
+        root.item_weights = [crush_map.bucket(h).weight
+                             for h in root.items]
+        root.weight = sum(root.item_weights)
+
+        n_osds = 10000
+        reweight = np.full(n_osds, 0x10000, dtype=np.int64)
+        idx = wrng.permutation(n_osds)
+        reweight[idx[:1000]] = 0x8000   # 10% half-weight
+        reweight[idx[1000:1200]] = 0    # 2% out
+
+        bm = BatchMapper(crush_map)
+        n_pgs, numrep = 65536, 3
+        rw = jnp.asarray(reweight)
+        xs = jnp.asarray(rng.integers(0, 2**32, (n_pgs,),
+                                      dtype=np.uint32))
+        bm.do_rule(rid, xs, numrep, rw)  # compile
+
+        def crush_step(x):
+            p = bm.do_rule(rid, x, numrep, rw)
+            return x ^ p[:, 0].astype(jnp.uint32)
+
+        t_crush, t_crush_min, t_crush_max = median_band(
+            chained_rates(crush_step, xs, n_lo=4, n_hi=24, reps=5,
+                          inner=4))
+        crush_mpps = n_pgs / t_crush / 1e6
+
+        # single-core C baseline: scalar straw2 crush_do_rule
+        from ceph_tpu.native import CrushBaseline
+
+        cb = CrushBaseline(crush_map)
+        c_xs = np.asarray(xs[:8192], dtype=np.uint32)
+        cb.do_rule_batch(rid, c_xs[:256], numrep,
+                         reweight.astype(np.uint32))
         t0 = time.perf_counter()
-        ec_encode_native(coding, cpu_data)
-        t_c = min(t_c, time.perf_counter() - t0)
-    c_enc_mbps = data_bytes / t_c / 1e6
-    t_c = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        ec_encode_native(rmat, cpu_data)
-        t_c = min(t_c, time.perf_counter() - t0)
-    c_dec_mbps = data_bytes / t_c / 1e6
-    c_combined = 2 / (1 / c_enc_mbps + 1 / c_dec_mbps)
+        cb.do_rule_batch(rid, c_xs, numrep, reweight.astype(np.uint32))
+        c_crush_mpps = len(c_xs) / (time.perf_counter() - t0) / 1e6
 
-    cb = CrushBaseline(crush_map)
-    c_xs = np.asarray(xs[:8192], dtype=np.uint32)
-    cb.do_rule_batch(rid, c_xs[:256], numrep, reweight.astype(np.uint32))
-    t0 = time.perf_counter()
-    cb.do_rule_batch(rid, c_xs, numrep, reweight.astype(np.uint32))
-    c_crush_mpps = len(c_xs) / (time.perf_counter() - t0) / 1e6
+        out.update({
+            "crush_mpps": round(crush_mpps, 3),
+            "crush_mpps_band": [round(n_pgs / t_crush_max / 1e6, 3),
+                                round(n_pgs / t_crush_min / 1e6, 3)],
+            "c_crush_mpps": round(c_crush_mpps, 3),
+            "crush_vs_c": round(crush_mpps / c_crush_mpps, 2),
+        })
 
-    # kernel telemetry digest (retraces, p50/p99 latency, occupancy):
-    # the timed loops above run inside jitted scans, so close with a few
-    # FENCED standalone calls — real per-call device residency samples —
-    # before summarizing.  A retrace count above the handful of shapes
-    # this harness uses is the regression tell.
-    from ceph_tpu.common import tracing
     from ceph_tpu.ops import telemetry
-    telemetry.set_fence_for_timing(True)
-    # trace the fenced calls with a zero slow threshold: every one
-    # lands in the slow ring, so the JSON records a tail-latency digest
-    # (count + p99 root-span duration) next to the throughput headline
-    tracing.set_slow_threshold(0.0)
-    for _ in range(3):
-        with tracing.trace_ctx(name="bench ec_encode", daemon="bench"):
-            encode(data)
-        with tracing.trace_ctx(name="bench crush_map", daemon="bench"):
-            bm.do_rule(rid, xs, numrep, rw)
-    telemetry.set_fence_for_timing(False)
-    kernel_summary = telemetry.registry().summary()
-    slow_traces = tracing.slow_summary()
+    if "ec" in secs and "crush" in secs:
+        # kernel telemetry digest (retraces, p50/p99 latency,
+        # occupancy): the timed loops above run inside jitted scans, so
+        # close with a few FENCED standalone calls — real per-call
+        # device residency samples — before summarizing.  A retrace
+        # count above the handful of shapes this harness uses is the
+        # regression tell.
+        from ceph_tpu.common import tracing
+        telemetry.set_fence_for_timing(True)
+        # trace the fenced calls with a zero slow threshold: every one
+        # lands in the slow ring, so the JSON records a tail-latency
+        # digest (count + p99 root-span duration) next to the
+        # throughput headline
+        tracing.set_slow_threshold(0.0)
+        for _ in range(3):
+            with tracing.trace_ctx(name="bench ec_encode",
+                                   daemon="bench"):
+                encode(data)
+            with tracing.trace_ctx(name="bench crush_map",
+                                   daemon="bench"):
+                bm.do_rule(rid, xs, numrep, rw)
+        telemetry.set_fence_for_timing(False)
+        out["kernel_telemetry"] = telemetry.registry().summary()
+        out["slow_traces"] = tracing.slow_summary()
 
-    # cross-op coalescing: offered-concurrency sweep through the
-    # dispatch engine (1/4/16/64 in-flight writers, OSD-write-sized
-    # ops).  The headline EC numbers above are device-resident; this
-    # is the END-TO-END rate a concurrent client population sees, and
-    # the coalesce factor is the amortization making up the gap.
-    sweep = dispatch_sweep(encode, k, chunk)
-    dispatch_digest = telemetry.dispatch_summary()
+    if "dispatch_sweep" in secs:
+        # cross-op coalescing: offered-concurrency sweep through the
+        # dispatch engine (1/4/16/64 in-flight writers, OSD-write-sized
+        # ops).  The headline EC numbers above are device-resident;
+        # this is the END-TO-END rate a concurrent client population
+        # sees, and the coalesce factor is the amortization making up
+        # the gap.
+        sweep = dispatch_sweep(encode, k, chunk)
+        out["dispatch"] = telemetry.dispatch_summary()   # key order as
+        out["dispatch_sweep"] = sweep                    # historically
 
-    # decode-side twin: degraded-read/recovery concurrency sweep with 2
-    # erasures per op and MIXED recovery patterns across readers — the
-    # heterogeneous-matrix batched decode's amortization story
-    rec_sweep = recovery_sweep(k, m, chunk)
-    decode_digest = telemetry.decode_dispatch_summary()
+    if "recovery_sweep" in secs:
+        # decode-side twin: degraded-read/recovery concurrency sweep
+        # with 2 erasures per op and MIXED recovery patterns across
+        # readers — the heterogeneous-matrix batched decode's
+        # amortization story
+        rec = recovery_sweep(k, m, chunk)
+        out["decode_dispatch"] = telemetry.decode_dispatch_summary()
+        out["recovery_sweep"] = rec
 
-    print(json.dumps({
-        "metric": "ec encode+recover MB/s (k=8,m=4,4KiB chunks, batch=2048)",
-        "value": round(combined, 1),
-        "unit": "MB/s",
-        "vs_baseline": round(combined / c_combined, 2),
-        "encode_mbps": round(enc_mbps, 1),
-        "encode_mbps_band": [round(data_bytes / t_enc_max / 1e6, 1),
-                             round(data_bytes / t_enc_min / 1e6, 1)],
-        "recover_mbps": round(dec_mbps, 1),
-        "recover_mbps_band": [round(data_bytes / t_dec_max / 1e6, 1),
-                              round(data_bytes / t_dec_min / 1e6, 1)],
-        "c_encode_mbps": round(c_enc_mbps, 1),
-        "c_recover_mbps": round(c_dec_mbps, 1),
-        "encode_vs_c": round(enc_mbps / c_enc_mbps, 2),
-        "crush_mpps": round(crush_mpps, 3),
-        "crush_mpps_band": [round(n_pgs / t_crush_max / 1e6, 3),
-                            round(n_pgs / t_crush_min / 1e6, 3)],
-        "c_crush_mpps": round(c_crush_mpps, 3),
-        "crush_vs_c": round(crush_mpps / c_crush_mpps, 2),
-        "kernel_telemetry": kernel_summary,
-        "slow_traces": slow_traces,
-        "dispatch": dispatch_digest,
-        "dispatch_sweep": sweep,
-        "decode_dispatch": decode_digest,
-        "recovery_sweep": rec_sweep,
-        "device": str(jax.devices()[0]),
-    }))
+    if "map_churn" in secs:
+        # map-epoch consumption: scalar full scan vs the shared PG
+        # mapping service, bit-verified against the oracle
+        out["map_churn"] = map_churn()
+
+    if "metric" not in out:
+        out = {"metric": "sections " + "+".join(sorted(secs)),
+               **out}
+    out["device"] = str(jax.devices()[0])
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

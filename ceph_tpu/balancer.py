@@ -23,6 +23,24 @@ from __future__ import annotations
 from .osd.osdmap import CEPH_NOSD, CRUSH_ITEM_NONE, OSDMap
 
 
+def _shared_service(osdmap: OSDMap):
+    """The default context's shared mapping cache, warmed to this map
+    (osd.mapping.SharedPGMappingService) — None when the
+    osdmap_mapping_shared knob is off or warming fails.  The balancer
+    reads the same epoch-keyed tables every other consumer does; every
+    read still falls back to the scalar oracle on a cache miss."""
+    try:
+        from .common.context import default_context
+        ctx = default_context()
+        if not ctx.conf.get("osdmap_mapping_shared"):
+            return None
+        svc = ctx.mapping_service()
+        svc.warm(osdmap)
+        return svc
+    except Exception:
+        return None
+
+
 def crush_parent(osdmap: OSDMap, osd: int) -> int | None:
     """The id of the bucket directly containing this osd (CrushWrapper
     get_immediate_parent_id)."""
@@ -39,13 +57,16 @@ def _candidate_osds(osdmap: OSDMap) -> list[int]:
             and not osdmap._is_out(o)]
 
 
-def pool_pg_histogram(osdmap: OSDMap, pool_id: int
+def pool_pg_histogram(osdmap: OSDMap, pool_id: int, service=None
                       ) -> dict[int, list[tuple[int, int]]]:
-    """osd -> [(pgid_ps, position)] placements for one pool."""
+    """osd -> [(pgid_ps, position)] placements for one pool, read from
+    the shared mapping cache (scalar per-PG pipeline when disabled)."""
     pool = osdmap.pools[pool_id]
+    svc = service if service is not None else _shared_service(osdmap)
     out: dict[int, list[tuple[int, int]]] = {}
     for ps in range(pool.pg_num):
-        up, _p, _a, _ap = osdmap.pg_to_up_acting_osds(pool_id, ps)
+        up, _p, _a, _ap = (svc.lookup(osdmap, pool_id, ps) if svc
+                           else osdmap.pg_to_up_acting_osds(pool_id, ps))
         for pos, o in enumerate(up):
             if o not in (CEPH_NOSD, CRUSH_ITEM_NONE):
                 out.setdefault(o, []).append((ps, pos))
@@ -84,11 +105,12 @@ def calc_pg_upmaps(osdmap: OSDMap, pool_ids: list[int] | None = None,
     cands = _candidate_osds(m)
     if len(cands) < 2:
         return changes
+    svc = _shared_service(m)
     budget = max_optimizations
     for pool_id in (pool_ids if pool_ids is not None
                     else sorted(m.pools)):
         pool = m.pools[pool_id]
-        hist = pool_pg_histogram(m, pool_id)
+        hist = pool_pg_histogram(m, pool_id, service=svc)
         counts = {o: len(hist.get(o, [])) for o in cands}
         total = sum(counts.values())
         mean = total / len(cands)
@@ -98,7 +120,9 @@ def calc_pg_upmaps(osdmap: OSDMap, pool_ids: list[int] | None = None,
             for ps in range(pool.pg_num)}
 
         def up_of(ps: int) -> list[int]:
-            raw = list(m._pg_to_raw_osds(pool, ps))
+            raw = svc.raw_row(m, pool_id, ps) if svc else None
+            if raw is None:
+                raw = list(m._pg_to_raw_osds(pool, ps))
             for frm, to in planned[ps]:
                 if frm in raw and to not in raw and m.exists(to) \
                         and not m._is_out(to):

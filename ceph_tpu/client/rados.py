@@ -160,6 +160,22 @@ class RadosClient(Dispatcher):
         self.mon_addrs = [a for a in mon_addr.split(",") if a]
         self.timeout = timeout
         self.osdmap = OSDMap()
+        #: op targeting reads the context's shared epoch-keyed mapping
+        #: cache (Objecter-side OSDMapMapping): _calc_target becomes a
+        #: cached-raw pipeline tail instead of a scalar crush_do_rule
+        #: per op.  Hot-togglable; any epoch mismatch falls back to the
+        #: scalar oracle, so correctness never depends on the cache.
+        self._map_shared = bool(
+            self.ctx.conf.get("osdmap_mapping_shared"))
+        self.ctx.conf.add_observer(
+            "osdmap_mapping_shared",
+            lambda _n, v: setattr(self, "_map_shared", bool(v)))
+        #: newest-map slot + single background warm worker: map storms
+        #: must neither stall the dispatch thread nor spawn a thread
+        #: per epoch (the slot keeps only the latest, matching the
+        #: service's own newest-wins queueing)
+        self._warm_latest: OSDMap | None = None
+        self._warm_thread: threading.Thread | None = None
         self._map_event = threading.Event()
         self._lock = threading.RLock()
         self._next_tid = 1
@@ -260,6 +276,20 @@ class RadosClient(Dispatcher):
                 # backfill (it sends the chain or a full map)
                 self._subscribe()
                 return True
+            if self._map_shared:
+                # warm the shared cache in the BACKGROUND: the op path
+                # must never stall behind a table build (a light client
+                # on a many-pool cluster would otherwise pay an
+                # OSD-sized rebuild on its dispatch thread); until the
+                # build lands, targeting falls back to the scalar
+                # oracle per op — exactly the seed's cost
+                with self._lock:
+                    self._warm_latest = newmap
+                    if self._warm_thread is None:
+                        self._warm_thread = threading.Thread(
+                            target=self._warm_worker, daemon=True,
+                            name="rados-map-warm")
+                        self._warm_thread.start()
             self._map_event.set()
             for w in pending:   # resend on map change (Objecter semantics)
                 self._send_op(w)
@@ -388,16 +418,42 @@ class RadosClient(Dispatcher):
         # the reduced pg and must compute the identical mapping
         pgid = pg_to_pgid(ps, pool.pg_num)
         _up, _primary, _acting, acting_primary = \
-            self.osdmap.pg_to_up_acting_osds(pool_id, pgid)
+            self._pg_mapping(pool_id, pgid)
         return (pool_id, pgid), acting_primary
+
+    def _warm_worker(self) -> None:
+        """Drain the newest-map slot into the shared mapping cache;
+        exits (and deregisters) when the slot is empty.  The slot
+        write and the exit decision share self._lock, so a map landing
+        while we exit always sees _warm_thread None and respawns."""
+        while True:
+            with self._lock:
+                nm = self._warm_latest
+                self._warm_latest = None
+                if nm is None:
+                    self._warm_thread = None
+                    return
+            try:
+                self.ctx.mapping_service().update_to(nm)
+            except Exception:
+                pass   # reads keep falling back to the scalar oracle
+
+    def _pg_mapping(self, pool_id: int, pgid: int
+                    ) -> tuple[list[int], int, list[int], int]:
+        """(up, up_primary, acting, acting_primary) — shared mapping
+        cache when enabled (scalar-oracle fallback on any epoch or
+        object mismatch), else the scalar pipeline."""
+        if self._map_shared:
+            return self.ctx.mapping_service().lookup(
+                self.osdmap, pool_id, pgid)
+        return self.osdmap.pg_to_up_acting_osds(pool_id, pgid)
 
     def _send_op(self, w: _Waiter) -> None:
         if w.fixed_pgid is not None:
             # PG-targeted op (pgls): the pg IS the address — map it to
             # its primary directly, never rehash an oid
             pgid = w.fixed_pgid
-            _up, _p, _a, primary = self.osdmap.pg_to_up_acting_osds(
-                pgid[0], pgid[1])
+            _up, _p, _a, primary = self._pg_mapping(pgid[0], pgid[1])
         else:
             pgid, primary = self._calc_target(w.base_pool, w.msg.oid,
                                               w.is_write, w.direct)

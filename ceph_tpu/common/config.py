@@ -70,6 +70,19 @@ register_options([
            "default EC execution runtime: tpu | cpu"),
     Option("crush_backend", OPT_STR, "tpu",
            "bulk placement backend: tpu (BatchMapper) | scalar"),
+    Option("osdmap_mapping_min_pgs", OPT_INT, 1024,
+           "pools with fewer PGs than this rebuild their cached raw "
+           "tables with the scalar rule engine instead of a device "
+           "call (per-call dispatch + jit-compile overhead dominates "
+           "tiny pools); the epoch cache, incremental invalidation "
+           "and delta detection are identical either way"),
+    Option("osdmap_mapping_shared", OPT_BOOL, True,
+           "serve PG->OSD mappings from the context's shared "
+           "epoch-keyed mapping cache (osd.mapping."
+           "SharedPGMappingService): OSD map consumption becomes "
+           "O(changed PGs + local PGs), client op targeting and the "
+           "balancer read cached raw placements; off = every consumer "
+           "runs the scalar pg_to_up_acting_osds pipeline per PG"),
     Option("osd_pool_default_size", OPT_INT, 3, "replicas per object"),
     Option("mds_dentry_lease_ttl", OPT_FLOAT, 10.0,
            "seconds a client may trust a leased dentry+attrs without "

@@ -66,6 +66,7 @@ class CephTpuContext:
         import threading
         self._dispatch = None
         self._decode_dispatch = None
+        self._mapping_service = None
         self._dispatch_lock = threading.Lock()
         self.admin.register_command(
             "dump_dispatch_stats",
@@ -75,6 +76,12 @@ class CephTpuContext:
             "coalesce factor, queue delay/depth, flush reasons, "
             "in-flight batches; decode adds erasure-pattern "
             "heterogeneity per call and pattern-table size")
+        self.admin.register_command(
+            "dump_mapping_stats",
+            lambda **kw: telemetry.mapping_dump(),
+            "shared PG-mapping-service telemetry: epoch-update "
+            "latency, pools recomputed vs reused, changed-PG counts, "
+            "epoch-skips, cache lookups vs scalar fallbacks")
 
     def _build_engine(self, name: str, stats=None):
         """One coalescing engine wired to the shared knobs (both the
@@ -124,6 +131,20 @@ class CephTpuContext:
                     f"{self.name}-decode",
                     stats=telemetry.decode_dispatch_stats())
         return self._decode_dispatch
+
+    def mapping_service(self):
+        """The context's shared epoch-keyed PG mapping cache
+        (osd.mapping.SharedPGMappingService) — one per context like
+        the dispatch engines; N daemons hanging off one context
+        advancing the same epoch share a single table build, and its
+        per-pool remaps ride this context's dispatch engine."""
+        if self._mapping_service is None:
+            with self._dispatch_lock:
+                if self._mapping_service is not None:
+                    return self._mapping_service
+                from ceph_tpu.osd.mapping import SharedPGMappingService
+                self._mapping_service = SharedPGMappingService(self)
+        return self._mapping_service
 
 
 _default: CephTpuContext | None = None

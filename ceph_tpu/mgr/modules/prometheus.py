@@ -136,6 +136,7 @@ class Module(MgrModule):
         self._scrape_kernels(exp)
         self._scrape_dispatch(exp)
         self._scrape_decode_dispatch(exp)
+        self._scrape_mapping(exp)
         return exp.render()
 
     def _scrape_cluster(self, exp: Exposition) -> None:
@@ -270,6 +271,55 @@ class Module(MgrModule):
         exp.gauge(f"{p}_pattern_table",
                   "recovery patterns registered in the stacked "
                   "matrix table (high-water)", d["pattern_table_size"])
+
+    @staticmethod
+    def _scrape_mapping(exp: Exposition) -> None:
+        """The shared PG mapping service (osd.mapping): how often an
+        epoch actually recomputes vs reuses cached pool tables, how
+        many PGs each epoch really changed, burst epoch-skips, and the
+        cache-hit story for mapping reads."""
+        d = telemetry.mapping_dump()
+        p = "ceph_kernel_mapping"
+        exp.counter(f"{p}_epoch_updates_total",
+                    "map epochs computed by the shared mapping "
+                    "service", d["epoch_updates"])
+        exp.counter(f"{p}_epoch_skips_total",
+                    "map epochs never computed: burst coalescing "
+                    "(only the newest queued target runs) and "
+                    "multi-epoch catch-up jumps both count",
+                    d["epoch_skips"])
+        exp.counter(f"{p}_pools_recomputed_total",
+                    "pool raw tables rebuilt on device",
+                    d["pools_recomputed"])
+        exp.counter(f"{p}_pools_reused_total",
+                    "pool raw tables carried over unchanged "
+                    "(signature hit)", d["pools_reused"])
+        exp.counter(f"{p}_full_rescans_total",
+                    "consumer scans that could not be served a delta "
+                    "(first map, chain gap)", d["full_rescans"])
+        exp.counter(f"{p}_lookups_total",
+                    "mapping reads served from the cache",
+                    d["lookups"])
+        exp.counter(f"{p}_lookup_fallbacks_total",
+                    "mapping reads that fell back to the scalar "
+                    "oracle (epoch/object mismatch)",
+                    d["lookup_fallbacks"])
+        lat = d["update_latency_seconds"]
+        exp.histogram(f"{p}_update_latency_seconds",
+                      "per-epoch mapping update wall time "
+                      "(incremental recompute + device diff + delta)",
+                      lat["bounds"], lat["buckets"], lat["sum"])
+        ch = d["changed_pgs"]
+        exp.histogram(f"{p}_changed_pgs",
+                      "exact changed-PG count per computed epoch "
+                      "(the O(changed) map-consumption bound)",
+                      ch["bounds"], ch["buckets"], ch["sum"])
+        exp.gauge(f"{p}_cached_pgs",
+                  "PGs resident in the cached raw tables",
+                  d["cached_pgs"])
+        exp.gauge(f"{p}_cached_pools",
+                  "pools resident in the cached raw tables",
+                  d["cached_pools"])
 
     @staticmethod
     def _emit_coalesce(exp: Exposition, d: dict, p: str) -> None:

@@ -519,3 +519,31 @@ def submit_flat_firstn(engine: DeviceDispatchEngine, x, ids, weights,
 
     return engine.submit(key, fn, np.asarray(x, dtype=np.uint32),
                          label="crush_firstn")
+
+
+def submit_do_rule(engine: DeviceDispatchEngine, mapper, ruleno: int,
+                   xs, result_max: int, reweight, *,
+                   key=None) -> DispatchFuture:
+    """Submit a general-rule bulk PG remap (BatchMapper.do_rule)
+    through the engine.  Pool remaps for the SAME (map, rule, size,
+    reweight) — e.g. several pools sharing one crush rule, or several
+    OSD daemons in one context advancing the same epoch — coalesce on
+    the x axis into ONE device call.  Padded lanes (x=0) compute
+    garbage placements that are sliced off before delivery, exactly
+    like submit_flat_firstn.
+
+    ``mapper`` is a crush.mapper_jax.BatchMapper (or anything with its
+    ``do_rule`` signature); ``key`` defaults to the mapper identity +
+    rule + shape + a reweight digest, so callers holding one mapper
+    per crush-map identity get cross-request coalescing for free.
+    """
+    reweight = np.asarray(reweight, dtype=np.int64)
+    if key is None:
+        key = ("crush_rule", id(mapper), ruleno, result_max,
+               hash(reweight.tobytes()))
+
+    def fn(batch):
+        return mapper.do_rule(ruleno, batch, result_max, reweight)
+
+    return engine.submit(key, fn, np.asarray(xs, dtype=np.uint32),
+                         label="crush_rule")

@@ -327,6 +327,109 @@ class DecodeDispatchStats(DispatchStats):
         return s
 
 
+class MappingStats:
+    """Counters for the shared PG mapping service (osd.mapping).
+
+    The service's efficiency story: how often an epoch actually
+    recomputes (vs reusing cached pool tables), how many PGs each
+    epoch really changed (the O(changed) scan bound), how many queued
+    epochs were skipped outright (burst coalescing), and how often a
+    read had to fall back to the scalar oracle (epoch/object mismatch
+    — the correctness escape hatch, not an error).
+    """
+
+    __slots__ = ("_lock", "epoch_updates", "epoch_skips",
+                 "pools_recomputed", "pools_reused", "full_rescans",
+                 "lookups", "lookup_fallbacks", "update_latency",
+                 "changed_pgs", "cached_pgs", "cached_pools")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.epoch_updates = 0     # epochs actually computed
+        self.epoch_skips = 0       # queued epochs never computed
+        self.pools_recomputed = 0  # pool tables rebuilt on device
+        self.pools_reused = 0      # pool tables carried over unchanged
+        self.full_rescans = 0      # deltas unavailable -> full consumer scan
+        self.lookups = 0           # reads served from the cache
+        self.lookup_fallbacks = 0  # reads that fell back to the oracle
+        self.update_latency = Histogram(LATENCY_BOUNDS)  # per-epoch s
+        self.changed_pgs = Histogram(BATCH_BOUNDS)       # delta size/epoch
+        self.cached_pgs = 0        # gauge: PGs resident in raw tables
+        self.cached_pools = 0      # gauge: pools resident
+
+    def clear(self) -> None:
+        with self._lock:
+            self.epoch_updates = self.epoch_skips = 0
+            self.pools_recomputed = self.pools_reused = 0
+            self.full_rescans = 0
+            self.lookups = self.lookup_fallbacks = 0
+            self.update_latency = Histogram(LATENCY_BOUNDS)
+            self.changed_pgs = Histogram(BATCH_BOUNDS)
+            self.cached_pgs = 0
+            self.cached_pools = 0
+
+    def record_update(self, *, seconds: float, recomputed: int,
+                      reused: int, changed: int, cached_pgs: int,
+                      cached_pools: int) -> None:
+        with self._lock:
+            self.epoch_updates += 1
+            self.pools_recomputed += recomputed
+            self.pools_reused += reused
+            self.update_latency.add(seconds)
+            self.changed_pgs.add(changed)
+            self.cached_pgs = cached_pgs
+            self.cached_pools = cached_pools
+
+    def record_skip(self, n: int = 1) -> None:
+        with self._lock:
+            self.epoch_skips += n
+
+    def record_full_rescan(self) -> None:
+        with self._lock:
+            self.full_rescans += 1
+
+    def record_lookup(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.lookups += 1
+            else:
+                self.lookup_fallbacks += 1
+
+    def dump(self) -> dict:
+        with self._lock:
+            return {
+                "epoch_updates": self.epoch_updates,
+                "epoch_skips": self.epoch_skips,
+                "pools_recomputed": self.pools_recomputed,
+                "pools_reused": self.pools_reused,
+                "full_rescans": self.full_rescans,
+                "lookups": self.lookups,
+                "lookup_fallbacks": self.lookup_fallbacks,
+                "update_latency_seconds": self.update_latency.dump(),
+                "changed_pgs": self.changed_pgs.dump(),
+                "cached_pgs": self.cached_pgs,
+                "cached_pools": self.cached_pools,
+            }
+
+    def summary(self) -> dict:
+        """bench.py's digest: incrementality in a few numbers."""
+        with self._lock:
+            n = self.update_latency.count
+            return {
+                "epoch_updates": self.epoch_updates,
+                "epoch_skips": self.epoch_skips,
+                "pools_recomputed": self.pools_recomputed,
+                "pools_reused": self.pools_reused,
+                "mean_update_ms": (round(self.update_latency.sum / n
+                                         * 1e3, 3) if n else 0.0),
+                "mean_changed_pgs": (round(self.changed_pgs.sum
+                                           / self.changed_pgs.count, 1)
+                                     if self.changed_pgs.count else 0.0),
+                "lookups": self.lookups,
+                "lookup_fallbacks": self.lookup_fallbacks,
+            }
+
+
 class KernelTelemetry:
     """The registry: one KernelStats per kernel name."""
 
@@ -335,6 +438,7 @@ class KernelTelemetry:
         self._kernels: dict[str, KernelStats] = {}
         self.dispatch = DispatchStats()
         self.decode_dispatch = DecodeDispatchStats()
+        self.mapping = MappingStats()
         #: block_until_ready before closing each latency sample
         self.fence_for_timing = False
         #: master switch; off-path cost when False is one attribute read
@@ -360,6 +464,7 @@ class KernelTelemetry:
             self._kernels.clear()
         self.dispatch.clear()
         self.decode_dispatch.clear()
+        self.mapping.clear()
 
     def summary(self) -> dict:
         """Compact digest (bench.py prints this next to its JSON)."""
@@ -428,6 +533,22 @@ def decode_dispatch_dump() -> dict:
 
 def decode_dispatch_summary() -> dict:
     return _REG.decode_dispatch.summary()
+
+
+def mapping_stats() -> MappingStats:
+    """The process-global shared-mapping-service counters: every
+    SharedPGMappingService (one per context) feeds this, the
+    ``dump_mapping_stats`` admin command and the mgr's
+    ``ceph_kernel_mapping_*`` families read it."""
+    return _REG.mapping
+
+
+def mapping_dump() -> dict:
+    return _REG.mapping.dump()
+
+
+def mapping_summary() -> dict:
+    return _REG.mapping.summary()
 
 
 def set_fence_for_timing(on: bool) -> None:

@@ -9,7 +9,7 @@ ParallelPGMapper analog with the thread pool replaced by one device call.
 """
 
 from .osdmap import OSDMap, PGPool, pg_to_pgid, ceph_stable_mod
-from .mapping import OSDMapMapping
+from .mapping import MapUpdate, OSDMapMapping, SharedPGMappingService
 
 __all__ = ["OSDMap", "PGPool", "pg_to_pgid", "ceph_stable_mod",
-           "OSDMapMapping"]
+           "OSDMapMapping", "SharedPGMappingService", "MapUpdate"]

@@ -257,6 +257,16 @@ class OSDDaemon(Dispatcher):
         self.ctx.conf.add_observer(
             "osd_ec_decode_async",
             lambda _n, v: setattr(self, "_ec_decode_async", bool(v)))
+        #: shared epoch-keyed mapping cache: map consumption rides the
+        #: context's SharedPGMappingService — _scan_pgs walks only the
+        #: changed-PG delta + locally-held PGs, and per-PG reads are
+        #: cached-raw pipeline tails instead of scalar CRUSH.
+        #: Hot-togglable (off = seed's full scalar scan).
+        self._map_shared = bool(
+            self.ctx.conf.get("osdmap_mapping_shared"))
+        self.ctx.conf.add_observer(
+            "osdmap_mapping_shared",
+            lambda _n, v: setattr(self, "_map_shared", bool(v)))
 
         self._auth_key = auth_key
         self._cephx = cephx
@@ -299,7 +309,11 @@ class OSDDaemon(Dispatcher):
                      .add_u64("ec_dispatch_commits")
                      .add_u64("ec_decode_submits")
                      .add_u64("recovery_decode_stripes")
+                     .add_u64("map_epochs")
+                     .add_u64("map_pgs_scanned")
+                     .add_u64("map_pgs_changed")
                      .add_time_avg("op_w_latency")
+                     .add_time_avg("map_scan_latency")
                      .create_perf_counters())
         self.ctx.perf.add(self.perf)
         # the messenger's and store's own counter sets live in the same
@@ -809,11 +823,26 @@ class OSDDaemon(Dispatcher):
             # (OSD::handle_osd_map request_full analog)
             self._renew_map_subscription(time.time(), force=True)
             return
-        del oldmap
         dout("osd", 5, "osd.%d got map epoch %d", self.osd_id, newmap.epoch)
         self._apply_config_db(newmap)
         self._split_pgs(newmap)
-        self._scan_pgs()
+        upd = None
+        if self._map_shared:
+            # advance the shared cache (daemons on one context share a
+            # single table build; a burst computes only the newest
+            # epoch) and take the exact changed-PG delta from OUR old
+            # epoch so the scan below is O(changed + local)
+            try:
+                upd = self.ctx.mapping_service().update_to(
+                    newmap, from_epoch=oldmap.epoch)
+            except Exception as e:   # cache is an optimization, never a wall
+                dout("osd", 1, "osd.%d mapping service update failed, "
+                     "falling back to scalar scan: %r", self.osd_id, e)
+        del oldmap
+        self.perf.inc("map_epochs")
+        t_scan = time.time()
+        self._scan_pgs(upd)
+        self.perf.tinc("map_scan_latency", time.time() - t_scan)
         with self._lock:
             waiting = [m for m in self._waiting_for_map
                        if m.epoch <= newmap.epoch]
@@ -1142,43 +1171,73 @@ class OSDDaemon(Dispatcher):
                  "(%d objects moved)", self.osd_id, pgid, len(children),
                  moved)
 
-    def _scan_pgs(self) -> None:
+    def _scan_pgs(self, upd=None) -> None:
         """On every new map: (re)start peering for PGs whose membership
-        changed (the map-change edge of the peering statechart)."""
+        changed (the map-change edge of the peering statechart).
+
+        With a MapUpdate delta from the shared mapping service, only
+        the changed PGs plus every locally-held PG (current members AND
+        strays — their notify/teardown edges depend on OUR state, not
+        the map diff) are examined, and each read is a cached-raw
+        pipeline tail — O(changed + local) host work instead of
+        O(cluster PGs) scalar CRUSH.  Without a delta (shared cache
+        off, first map, or a chain gap) every PG is walked as before."""
         m = self.osdmap
-        for pool_id, pool in m.pools.items():
-            for pgnum in range(pool.pg_num):
-                up, _upp, _acting, primary = \
-                    m.pg_to_up_acting_osds(pool_id, pgnum)
-                pgid = (pool_id, pgnum)
-                if self.osd_id not in up:
-                    pg = self.pgs.get(pgid)
-                    if pg and pg.state != STATE_INACTIVE:
-                        pg.state = STATE_INACTIVE
-                        # no longer a member: a held/queued recovery slot
-                        # must not leak (it would wedge every later PG)
-                        self.local_reserver.cancel(pgid)
-                    # stray notify (PG stray semantics): we hold data for
-                    # a PG we are no longer (or never were) up for.  The
-                    # new primary may have NOTHING — a child remapped
-                    # onto fresh OSDs after pgp_num grew, or a wide
-                    # reshuffle — and only learns prior holders from
-                    # these notifies.
-                    if (pg is not None and primary != self.osd_id
-                            and primary != CEPH_NOSD
-                            and (pg.log.entries
-                                 or pg.info.last_update > EVERSION_ZERO)):
-                        con = self._osd_con(primary)
-                        if con:
-                            con.send_message(MOSDPGNotify(
-                                pgid=pgid,
-                                info=self._advertised_info(pg),
-                                epoch=m.epoch, from_osd=self.osd_id))
-                    continue
-                pg = self._get_pg(pgid)
-                if pg.up != up or pg.primary != primary \
-                        or pg.state == STATE_INACTIVE:
-                    self._start_peering(pg, up, primary)
+        if upd is not None and not upd.full:
+            scan = set(upd.changed)
+            scan.update(self.pgs.keys())
+            pgids = sorted(scan)
+            self.perf.inc("map_pgs_changed", len(upd.changed))
+        else:
+            pgids = [(pool_id, pgnum)
+                     for pool_id, pool in m.pools.items()
+                     for pgnum in range(pool.pg_num)]
+        self.perf.inc("map_pgs_scanned", len(pgids))
+        for pool_id, pgnum in pgids:
+            pool = m.pools.get(pool_id)
+            if pool is None or not (0 <= pgnum < pool.pg_num):
+                continue   # locally-held PG of a deleted/shrunk pool
+            up, _upp, _acting, primary = \
+                self._pg_mapping(pool_id, pgnum)
+            pgid = (pool_id, pgnum)
+            if self.osd_id not in up:
+                pg = self.pgs.get(pgid)
+                if pg and pg.state != STATE_INACTIVE:
+                    pg.state = STATE_INACTIVE
+                    # no longer a member: a held/queued recovery slot
+                    # must not leak (it would wedge every later PG)
+                    self.local_reserver.cancel(pgid)
+                # stray notify (PG stray semantics): we hold data for
+                # a PG we are no longer (or never were) up for.  The
+                # new primary may have NOTHING — a child remapped
+                # onto fresh OSDs after pgp_num grew, or a wide
+                # reshuffle — and only learns prior holders from
+                # these notifies.
+                if (pg is not None and primary != self.osd_id
+                        and primary != CEPH_NOSD
+                        and (pg.log.entries
+                             or pg.info.last_update > EVERSION_ZERO)):
+                    con = self._osd_con(primary)
+                    if con:
+                        con.send_message(MOSDPGNotify(
+                            pgid=pgid,
+                            info=self._advertised_info(pg),
+                            epoch=m.epoch, from_osd=self.osd_id))
+                continue
+            pg = self._get_pg(pgid)
+            if pg.up != up or pg.primary != primary \
+                    or pg.state == STATE_INACTIVE:
+                self._start_peering(pg, up, primary)
+
+    def _pg_mapping(self, pool_id: int, pgnum: int
+                    ) -> tuple[list[int], int, list[int], int]:
+        """(up, up_primary, acting, acting_primary) for one PG — from
+        the shared mapping cache when enabled (falls back to the
+        scalar oracle on any epoch/object mismatch), else scalar."""
+        if self._map_shared:
+            return self.ctx.mapping_service().lookup(
+                self.osdmap, pool_id, pgnum)
+        return self.osdmap.pg_to_up_acting_osds(pool_id, pgnum)
 
     def _start_peering(self, pg: PG, up: list[int], primary: int) -> None:
         # interval change: the old interval's recovery slot is void
@@ -2101,7 +2160,7 @@ class OSDDaemon(Dispatcher):
         """(up, acting_primary) — ops are accepted by the acting primary,
         matching the client's _calc_target (osdc/Objecter.cc:2795)."""
         up, _up_primary, _acting, acting_primary = \
-            self.osdmap.pg_to_up_acting_osds(pgid[0], pgid[1])
+            self._pg_mapping(pgid[0], pgid[1])
         return up, acting_primary
 
     def _handle_op(self, msg: MOSDOp) -> None:

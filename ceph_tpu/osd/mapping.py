@@ -1,4 +1,5 @@
-"""Bulk PG -> OSD mapping on device (OSDMapMapping / ParallelPGMapper analog).
+"""Bulk PG -> OSD mapping on device (OSDMapMapping / ParallelPGMapper analog)
+and the shared, epoch-keyed PG mapping service.
 
 The reference computes the full PG->OSD table with a thread pool over pgid
 batches (src/osd/OSDMapMapping.h:17 ParallelPGMapper, used by the mgr balancer
@@ -9,22 +10,60 @@ engine (ceph_tpu.crush.mapper_jax.BatchMapper).
 Post-CRUSH overrides (upmap, primary affinity, temps) are sparse per-PG state
 and apply host-side on the dense result — the same split the reference uses
 (its mapping cache also stores raw CRUSH output and applies overrides on read).
+
+Three layers:
+
+* ``OSDMapMapping`` — the per-epoch table builder.  ``update()`` is now
+  INCREMENTAL: each pool carries a signature (crush content, rule, size,
+  pg_num/pgp_num, the reweights of the OSDs its rule can actually reach) and
+  only pools whose signature moved recompute; untouched pools reuse their raw
+  tables.  One BatchMapper is cached per crush-map identity, so
+  unchanged-crush epochs skip the mapper rebuild entirely.  Remaps submit
+  through the context's dispatch engine (ops.dispatch.submit_do_rule) when
+  one is supplied: pools sharing a rule — and daemons sharing a context —
+  coalesce into one device call, and the double-buffered pipeline overlaps
+  pool N+1's h2d with pool N's compute.
+
+* ``SharedPGMappingService`` — one instance per CephTpuContext
+  (``ctx.mapping_service()``), the epoch-keyed cache every mapping consumer
+  reads: OSD map consumption (daemon._scan_pgs), client op targeting
+  (client.rados), the balancer, and the offline tools.  On a new epoch it
+  updates the mapping, diffs old-vs-new raw tables ON DEVICE, and derives the
+  exact changed-PG delta (candidates from the device diff + override/osd-state
+  diffs, then filtered through the host-side pipeline tail) so map consumption
+  is O(changed PGs + local PGs) instead of O(cluster PGs).  A burst of epochs
+  coalesces: while one update runs, later maps queue and only the NEWEST is
+  computed (epoch-skip).  Reads are epoch- and identity-checked — a reader
+  holding a different map object or epoch falls back to the scalar oracle, so
+  the scalar ``pg_to_up_acting_osds`` remains the source of truth.
+
+Contract (same as the reference's mapping cache): maps are immutable once
+published — advance by building a NEW OSDMap with a higher epoch (OSDMap.copy
++ mutate), never by mutating a map the service has already seen.
 """
 
 from __future__ import annotations
 
+import threading
+import time
+from collections import deque
+
 import numpy as np
 
-from ceph_tpu.crush.mapper_jax import BatchMapper
-from ceph_tpu.crush.types import CRUSH_ITEM_NONE
-from ceph_tpu.ops.crush_kernel import hash32_2
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE, CrushMap
+from ceph_tpu.ops import telemetry
 
-from .osdmap import CEPH_NOSD, OSDMap, PGPool, ceph_stable_mod
+from .osdmap import MAX_AFFINITY, OSDMap, PGPool
+
+__all__ = ["OSDMapMapping", "SharedPGMappingService", "MapUpdate",
+           "pps_batch", "crush_signature", "rule_devices"]
 
 
 def pps_batch(pool: PGPool, pgids: np.ndarray) -> np.ndarray:
     """Vectorized raw_pg_to_pps over pg ids (osd_types.cc:1505-1521)."""
     import jax.numpy as jnp
+
+    from ceph_tpu.ops.crush_kernel import hash32_2
     ps = np.asarray(pgids, dtype=np.uint32)
     bmask = pool.pgp_num_mask
     low = ps & bmask
@@ -33,37 +72,318 @@ def pps_batch(pool: PGPool, pgids: np.ndarray) -> np.ndarray:
                                jnp.uint32(pool.pool_id & 0xFFFFFFFF)))
 
 
-class OSDMapMapping:
-    """Full-map PG->OSD cache, updated per epoch (OSDMapMapping.h:324-332)."""
+def pps_batch_scalar(pool: PGPool, pgids: np.ndarray) -> np.ndarray:
+    """Scalar-backend twin of pps_batch (no jax import)."""
+    return np.asarray([pool.raw_pg_to_pps(int(pg)) for pg in pgids],
+                      dtype=np.uint32)
 
-    def __init__(self, osdmap: OSDMap):
+
+def crush_signature(crush: CrushMap) -> int:
+    """Content hash of everything placement reads from the crush map:
+    bucket structure/weights, rules, tunables, choose_args.  O(map
+    size) per epoch — noise next to one pool remap — and it is what
+    lets unchanged-crush epochs reuse both the compiled BatchMapper
+    and every pool's raw table."""
+    buckets = tuple(
+        (b.id, b.type, b.alg, b.hash, tuple(b.items),
+         tuple(b.item_weights), b.weight)
+        for b in crush.buckets if b is not None)
+    rules = tuple(
+        (i, tuple((s.op, s.arg1, s.arg2) for s in r.steps))
+        for i, r in enumerate(crush.rules) if r is not None)
+    t = crush.tunables
+    tun = (t.choose_local_tries, t.choose_local_fallback_tries,
+           t.choose_total_tries, t.chooseleaf_descend_once,
+           t.chooseleaf_vary_r, t.chooseleaf_stable, t.straw_calc_version)
+    return hash((crush.max_devices, buckets, rules, tun,
+                 repr(crush.choose_args)))
+
+
+def rule_devices(crush: CrushMap, ruleno: int) -> tuple[int, ...]:
+    """Devices reachable from a rule's take roots — the OSDs whose
+    reweight can change this rule's raw output.  Sorted tuple."""
+    rule = crush.rules[ruleno] if 0 <= ruleno < len(crush.rules) else None
+    if rule is None:
+        return ()
+    from ceph_tpu.crush.types import RULE_TAKE
+    devs: set[int] = set()
+    stack = [s.arg1 for s in rule.steps if s.op == RULE_TAKE]
+    seen: set[int] = set()
+    while stack:
+        item = stack.pop()
+        if item >= 0:
+            devs.add(item)
+            continue
+        if item in seen:
+            continue
+        seen.add(item)
+        b = crush.bucket(item)
+        if b is not None:
+            stack.extend(b.items)
+    return tuple(sorted(devs))
+
+
+def _changed_rows(old: np.ndarray, new: np.ndarray) -> np.ndarray:
+    """Row indices where the two (pg_num, size) raw tables differ.
+    The elementwise compare + row reduce runs on device; only the
+    boolean row mask comes back to host."""
+    if old.shape != new.shape:
+        return np.arange(new.shape[0])
+    if new.size == 0:
+        return np.zeros(0, dtype=np.int64)
+    try:
+        import jax.numpy as jnp
+        mask = np.asarray(jnp.any(jnp.asarray(old) != jnp.asarray(new),
+                                  axis=1))
+    except Exception:   # scalar backend / no device: host diff
+        mask = (old != new).any(axis=1)
+    return np.flatnonzero(mask)
+
+
+def pool_signatures(m: OSDMap, reach: dict | None = None
+                    ) -> tuple[int, dict[int, tuple]]:
+    """(crush_sig, {pool_id: signature}) — the per-pool placement
+    signature covering everything the RAW table depends on: crush
+    content, rule, size/pg_num/pgp_num/type, and the reweights of the
+    rule's reachable OSDs.  Two maps with equal signatures produce
+    bit-identical raw tables.  ``reach`` is an optional
+    (crush_sig, rule) -> devices memo shared across calls."""
+    csig = crush_signature(m.crush)
+    if reach is None:
+        reach = {}
+    sigs: dict[int, tuple] = {}
+    w = m.osd_weight
+    for pool_id, pool in m.pools.items():
+        if (pool.crush_rule < 0 or pool.crush_rule >= m.crush.max_rules
+                or m.crush.rules[pool.crush_rule] is None):
+            sigs[pool_id] = ("invalid", pool.pg_num)
+            continue
+        devs = reach.get((csig, pool.crush_rule))
+        if devs is None:
+            devs = rule_devices(m.crush, pool.crush_rule)
+            reach[(csig, pool.crush_rule)] = devs
+        wsig = hash(tuple(w[o] if 0 <= o < len(w) else 0 for o in devs))
+        sigs[pool_id] = (csig, pool.crush_rule, pool.size, pool.pg_num,
+                        pool.pgp_num, pool.type, wsig)
+    return csig, sigs
+
+
+def scalar_rows(crush: CrushMap, ruleno: int, xs, numrep: int,
+                weights) -> np.ndarray:
+    """(len(xs), numrep) raw table via the scalar rule engine,
+    CRUSH_ITEM_NONE-padded — the pure-python twin of a batched
+    do_rule call (small pools, scalar backend, offline tools)."""
+    from ceph_tpu.crush.mapper_ref import crush_do_rule
+    w = [int(x) for x in weights]
+    out = np.full((len(xs), numrep), CRUSH_ITEM_NONE, dtype=np.int32)
+    for i, x in enumerate(xs):
+        row = crush_do_rule(crush, ruleno, int(x), numrep, w)
+        out[i, :len(row)] = row[:numrep]
+    return out
+
+
+def _vec(lst: list, n: int, fill: int = 0) -> np.ndarray:
+    out = np.full(n, fill, dtype=np.int64)
+    out[:len(lst)] = lst[:n] if len(lst) > n else lst
+    return out
+
+
+def _finish_from(m: OSDMap, pool: PGPool, pool_id: int, pg: int,
+                 raw_tab: dict, pps_tab: dict
+                 ) -> tuple[list[int], int, list[int], int]:
+    """Pipeline tail (upmap -> up -> affinity -> temps) over a cached
+    raw row — the O(1) host work the cache reduces map reads to."""
+    raw = [int(o) for o in raw_tab[pool_id][pg]]
+    if not pool.is_erasure():
+        raw = [o for o in raw if o != CRUSH_ITEM_NONE]
+    pps_arr = pps_tab.get(pool_id)
+    pps = int(pps_arr[pg]) if pps_arr is not None else None
+    return m._finish_pg_mapping(pool, (pool_id, pg), raw, pps)
+
+
+class _Tables:
+    """One epoch's published tables: the map object they were built
+    from (identity IS the primary cache key — see module contract),
+    the raw placements, the pps seeds, and the per-pool signatures.
+
+    ``bound`` / ``rejected`` memoize OTHER map objects of the same
+    epoch that have been content-checked against the signatures —
+    N daemons on one context each decode their own copy of a published
+    epoch, and equal signatures mean bit-identical raw tables, so
+    copies bind once and read the shared tables from then on."""
+
+    __slots__ = ("osdmap", "raw", "pps", "sigs", "epoch", "bound",
+                 "rejected")
+
+    def __init__(self, osdmap, raw, pps, sigs, epoch):
         self.osdmap = osdmap
-        self._mappers: dict[int, BatchMapper] = {}
+        self.raw = raw
+        self.pps = pps
+        self.sigs = sigs
+        self.epoch = epoch
+        # id -> weakref (OSDMap is an eq-dataclass, hence unhashable;
+        # membership verifies the ref still IS the object, so a reused
+        # id after GC can never alias)
+        self.bound: dict[int, object] = {}
+        self.rejected: dict[int, object] = {}
+
+    @staticmethod
+    def _has(memo: dict, osdmap) -> bool:
+        r = memo.get(id(osdmap))
+        return r is not None and r() is osdmap
+
+    @staticmethod
+    def _memo(memo: dict, osdmap) -> None:
+        import weakref
+        dead = [k for k, r in memo.items() if r() is None]
+        for k in dead:
+            del memo[k]
+        memo[id(osdmap)] = weakref.ref(osdmap)
+
+
+class _UpdateInfo:
+    __slots__ = ("prev", "recomputed", "reused")
+
+    def __init__(self, prev, recomputed, reused):
+        self.prev = prev
+        self.recomputed = recomputed
+        self.reused = reused
+
+
+class MapUpdate:
+    """What a consumer gets back from update_to(): the epochs it
+    covers and the exact changed-PG list — or full=True when the
+    delta chain cannot serve the caller's from_epoch (first map, or a
+    reader older than the retained delta log), meaning: rescan
+    everything, but still read the mappings from the cache."""
+
+    __slots__ = ("epoch_from", "epoch_to", "changed", "full")
+
+    def __init__(self, epoch_from, epoch_to, changed, full):
+        self.epoch_from = epoch_from
+        self.epoch_to = epoch_to
+        self.changed = changed
+        self.full = full
+
+    def __repr__(self):
+        return (f"MapUpdate({self.epoch_from}->{self.epoch_to}, "
+                f"{'full' if self.full else len(self.changed)})")
+
+
+class OSDMapMapping:
+    """Full-map PG->OSD cache, updated per epoch (OSDMapMapping.h:324-332).
+
+    ``update()`` recomputes only pools whose placement inputs changed
+    since the cached epoch; see the module docstring.  ``backend``
+    mirrors the ``crush_backend`` option: "tpu" uses the batched
+    device mapper, "scalar" the pure-python oracle (slow, but it keeps
+    the incremental reuse and exists for hosts without a device)."""
+
+    def __init__(self, osdmap: OSDMap | None = None, *,
+                 backend: str = "tpu", min_device_pgs: int = 0):
+        self.osdmap = osdmap
+        #: pools below this pg_num rebuild with the scalar rule engine
+        #: (device dispatch + compile overhead dominates tiny pools);
+        #: the osdmap_mapping_min_pgs option
+        self.min_device_pgs = min_device_pgs
+        #: one BatchMapper per crush-map identity (content signature),
+        #: kept across update() calls so unchanged-crush epochs skip
+        #: the compile_map/mapper rebuild
+        self._mappers: dict[int, object] = {}
         self._raw: dict[int, np.ndarray] = {}    # pool -> (pg_num, size) raw
         self._pps: dict[int, np.ndarray] = {}    # pool -> (pg_num,) pps seeds
+        self._sigs: dict[int, tuple] = {}        # pool -> placement signature
+        self._reach: dict[tuple, tuple] = {}     # (crush_sig, rule) -> devs
         self.epoch = -1
+        self.backend = backend
 
-    def update(self) -> None:
-        """Recompute every pool's raw placements (start_update/update)."""
-        m = self.osdmap
-        self._mappers.clear()
-        self._raw.clear()
-        self._pps.clear()
-        bm = BatchMapper(m.crush)
+    def mapper_for(self, crush: CrushMap, csig: int | None = None):
+        """The cached BatchMapper for this crush content (built on
+        miss).  Offline tools share the production mapper path here."""
+        if csig is None:
+            csig = crush_signature(crush)
+        bm = self._mappers.get(csig)
+        if bm is None:
+            from ceph_tpu.crush.mapper_jax import BatchMapper
+            bm = BatchMapper(crush)
+            self._mappers[csig] = bm
+            # bound: the tool path (place() with per-run crush maps)
+            # must not accumulate compiled programs for process life
+            while len(self._mappers) > 4:
+                self._mappers.pop(next(iter(self._mappers)))
+        return bm
+
+    def update(self, osdmap: OSDMap | None = None,
+               engine=None) -> _UpdateInfo:
+        """Advance the cache to ``osdmap`` (default: the constructor's
+        map re-read — the seed-compatible full path).  Recomputes only
+        signature-changed pools; with ``engine`` the per-pool remaps
+        ride the dispatch engine (submit-all, then collect)."""
+        m = osdmap if osdmap is not None else self.osdmap
+        if m is None:
+            raise ValueError("OSDMapMapping.update: no osdmap")
+        # prev pairs the CURRENT tables with the map they were built
+        # from; nothing on self is reassigned until the commit point
+        # below, so a mid-update exception (device error, future
+        # timeout) leaves the old state fully consistent and the next
+        # successful update diffs against the right old map
+        prev = _Tables(self.osdmap if self.epoch >= 0 else None,
+                       self._raw, self._pps, self._sigs, self.epoch)
+        # drop reachability memos of dead crush content before reuse
+        csig, sigs = pool_signatures(m, self._reach)
+        self._reach = {k: v for k, v in self._reach.items()
+                       if k[0] == csig}
         weights = np.zeros(max(m.max_osd, 1), dtype=np.int64)
         weights[:len(m.osd_weight)] = m.osd_weight
+        raw: dict[int, np.ndarray] = {}
+        pps_t: dict[int, np.ndarray] = {}
+        recomputed: list[int] = []
+        reused: list[int] = []
+        futures: list[tuple[int, object]] = []
+        bm = None
         for pool_id, pool in m.pools.items():
-            if (pool.crush_rule < 0 or pool.crush_rule >= m.crush.max_rules
-                    or m.crush.rules[pool.crush_rule] is None):
+            sig = sigs[pool_id]
+            invalid = sig[0] == "invalid"
+            if prev.sigs.get(pool_id) == sig and pool_id in prev.raw:
+                raw[pool_id] = prev.raw[pool_id]
+                if pool_id in prev.pps:
+                    pps_t[pool_id] = prev.pps[pool_id]
+                reused.append(pool_id)
+                continue
+            recomputed.append(pool_id)
+            if invalid:
                 # invalid rule -> empty raw, matching _pg_to_raw_osds's []
-                self._raw[pool_id] = np.zeros((pool.pg_num, 0), dtype=np.int32)
+                raw[pool_id] = np.zeros((pool.pg_num, 0), dtype=np.int32)
                 continue
             pgids = np.arange(pool.pg_num, dtype=np.uint32)
+            if (self.backend == "scalar"
+                    or pool.pg_num < self.min_device_pgs):
+                pps = pps_batch_scalar(pool, pgids)
+                pps_t[pool_id] = pps
+                raw[pool_id] = scalar_rows(m.crush, pool.crush_rule,
+                                           pps, pool.size, weights)
+                continue
             pps = pps_batch(pool, pgids)
-            out = bm.do_rule(pool.crush_rule, pps, pool.size, weights)
-            self._raw[pool_id] = np.asarray(out)
-            self._pps[pool_id] = pps
+            pps_t[pool_id] = pps
+            if bm is None:
+                # mapper_for reuses the compiled mapper across epochs
+                # for unchanged crush content (and bounds the dict for
+                # the tool path)
+                bm = self.mapper_for(m.crush, csig)
+            if engine is not None:
+                from ceph_tpu.ops.dispatch import submit_do_rule
+                futures.append((pool_id, submit_do_rule(
+                    engine, bm, pool.crush_rule, pps, pool.size,
+                    weights)))
+            else:
+                raw[pool_id] = np.asarray(bm.do_rule(
+                    pool.crush_rule, pps, pool.size, weights))
+        for pool_id, fut in futures:
+            raw[pool_id] = np.asarray(fut.result(timeout=120.0))
+        self.osdmap = m
+        self._raw, self._pps, self._sigs = raw, pps_t, sigs
         self.epoch = m.epoch
+        return _UpdateInfo(prev, recomputed, reused)
 
     def get_raw(self, pool_id: int) -> np.ndarray:
         """(pg_num, size) int32 raw CRUSH output, CRUSH_ITEM_NONE holes."""
@@ -72,16 +392,436 @@ class OSDMapMapping:
     def get(self, pool_id: int, pgid: int
             ) -> tuple[list[int], int, list[int], int]:
         """Full pipeline for one PG using the cached raw placement."""
-        m = self.osdmap
-        pool = m.pools[pool_id]
-        raw = [int(o) for o in self._raw[pool_id][pgid]]
-        if not pool.is_erasure():
-            raw = [o for o in raw if o != CRUSH_ITEM_NONE]
-        pps = int(self._pps[pool_id][pgid]) if pool_id in self._pps else None
-        return m._finish_pg_mapping(pool, (pool_id, pgid), raw, pps)
+        return _finish_from(self.osdmap, self.osdmap.pools[pool_id],
+                            pool_id, pgid, self._raw, self._pps)
 
     def pg_counts(self, pool_id: int) -> np.ndarray:
         """Per-OSD PG count histogram for a pool (balancer input)."""
         raw = self._raw[pool_id]
         valid = raw[(raw != CRUSH_ITEM_NONE) & (raw >= 0)]
         return np.bincount(valid, minlength=self.osdmap.max_osd)
+
+
+class SharedPGMappingService:
+    """The epoch-keyed shared mapping cache (one per CephTpuContext).
+
+    See the module docstring for the design.  Thread contract: any
+    number of concurrent update_to()/lookup() callers; one update
+    computes at a time, later targets queue with only the newest kept
+    (epoch-skip), waiters return as soon as the cache reaches their
+    epoch."""
+
+    #: delta-log entries retained (epoch transitions a lagging reader
+    #: can still be served incrementally)
+    DELTA_LOG = 64
+
+    def __init__(self, ctx=None, backend: str | None = None):
+        self._cv = threading.Condition()
+        self._ctx = ctx
+        #: explicit backend override (tests / engine-less tools);
+        #: None = follow the context's crush_backend option
+        self._backend_override = backend
+        self._mapping: OSDMapMapping | None = None
+        self._tables: dict[int, _Tables] = {}     # current + previous epoch
+        self._deltas: deque = deque(maxlen=self.DELTA_LOG)
+        self._pending: OSDMap | None = None
+        self._updating = False
+        #: the service's published epoch — MONOTONIC, unlike the inner
+        #: mapping's (a warm() against an older map rebuilds tables
+        #: without regressing this, so update_to waiters can rely on
+        #: "epoch only moves forward")
+        self._epoch = -1
+        #: False after a warm() installed tables outside the online
+        #: epoch sequence: the NEXT online update's delta would be
+        #: computed against those tables, so it must not be logged
+        self._chain_valid = True
+        self.stats = telemetry.mapping_stats()
+
+    # -- plumbing -------------------------------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def _backend(self) -> str:
+        if self._backend_override is not None:
+            return self._backend_override
+        if self._ctx is None:
+            return "tpu"
+        try:
+            return str(self._ctx.conf.get("crush_backend"))
+        except KeyError:
+            return "tpu"
+
+    def _engine(self):
+        if self._ctx is None or self._backend() == "scalar":
+            return None
+        return self._ctx.dispatch_engine()
+
+    def _ensure_mapping(self) -> OSDMapMapping:
+        if self._mapping is None:
+            self._mapping = OSDMapMapping(backend=self._backend())
+        else:
+            # both knobs follow the live config (an operator flipping
+            # crush_backend to scalar mid-flight — wedged device —
+            # must take effect on the next update)
+            self._mapping.backend = self._backend()
+        if self._ctx is not None:
+            try:
+                self._mapping.min_device_pgs = int(
+                    self._ctx.conf.get("osdmap_mapping_min_pgs"))
+            except KeyError:
+                pass
+        return self._mapping
+
+    # -- epoch advance --------------------------------------------------------
+
+    def update_to(self, osdmap: OSDMap,
+                  from_epoch: int | None = None) -> MapUpdate:
+        """Bring the cache to (at least) osdmap's epoch and return the
+        delta since ``from_epoch`` (default: the service's previous
+        epoch).  Concurrent callers advancing the same epoch share one
+        computation; a burst queues and only the newest target is
+        computed."""
+        with self._cv:
+            if from_epoch is None:
+                from_epoch = self.epoch
+            target = osdmap.epoch
+            if target > self.epoch:
+                # queue with only the newest target kept; skipped
+                # intermediates are counted ONCE, by the jump
+                # arithmetic of whichever update actually runs
+                if (self._pending is None
+                        or target > self._pending.epoch):
+                    self._pending = osdmap
+            while True:
+                if self.epoch >= target:
+                    return self._delta_since(from_epoch, target)
+                if self._updating:
+                    self._cv.wait()
+                    continue
+                work = self._pending
+                self._pending = None
+                if work is None or work.epoch <= self.epoch:
+                    # the queued target was consumed by an update that
+                    # FAILED (or was superseded): re-queue our own map
+                    # so this loop makes progress instead of spinning
+                    if (self._pending is None
+                            or target > self._pending.epoch):
+                        self._pending = osdmap
+                    continue
+                self._updating = True
+                chain_valid = self._chain_valid
+                mapping = self._ensure_mapping()
+                break
+        t0 = time.perf_counter()
+        try:
+            info = mapping.update(work, engine=self._engine())
+            if chain_valid:
+                changed, full = self._compute_delta(info)
+            else:
+                # prev tables came from a warm() outside the online
+                # sequence: a delta against them would be discarded
+                # below anyway — skip the whole candidate pass
+                changed, full = None, True
+        except BaseException:
+            with self._cv:
+                self._updating = False
+                self._cv.notify_all()
+            raise
+        dt = time.perf_counter() - t0
+        cached_pgs = sum(int(r.shape[0]) for r in mapping._raw.values())
+        with self._cv:
+            prev = info.prev
+            newt = _Tables(work, mapping._raw, mapping._pps,
+                           mapping._sigs, work.epoch)
+            self._tables = ({prev.epoch: prev, work.epoch: newt}
+                            if prev.epoch >= 0 else {work.epoch: newt})
+            if full or not self._chain_valid:
+                # chain break (first map, or the prev tables came from
+                # a warm() outside the online sequence): a delta
+                # against them must never be served to online readers
+                self._deltas.clear()
+            else:
+                self._deltas.append((prev.epoch, work.epoch,
+                                     tuple(changed)))
+            self._chain_valid = True
+            skipped = (work.epoch - prev.epoch - 1
+                       if prev.epoch >= 0 else 0)
+            self._epoch = max(self._epoch, work.epoch)
+            self._updating = False
+            self._cv.notify_all()
+        if skipped > 0:
+            self.stats.record_skip(skipped)
+        self.stats.record_update(
+            seconds=dt, recomputed=len(info.recomputed),
+            reused=len(info.reused),
+            changed=(len(changed) if not full else cached_pgs),
+            cached_pgs=cached_pgs, cached_pools=len(mapping._raw))
+        with self._cv:
+            # work.epoch >= target and _epoch is monotonic, so the
+            # cache is guaranteed at/past the caller's map now; the
+            # delta is clamped to the CALLER's epoch, not the head
+            return self._delta_since(from_epoch, target)
+
+    def warm(self, osdmap: OSDMap) -> None:
+        """Make the cache serve THIS map object — the offline-consumer
+        entry (balancer, osdmaptool, what-if runs) whose maps sit at a
+        fixed epoch, are rebuilt per run, or may not even belong to
+        the online cluster.  A map already served (same object, or a
+        content-equal copy of a cached epoch) binds for the cost of a
+        signature hash; anything else rebuilds DETACHED from the
+        online epoch sequence: tables install for reads, but the
+        incremental delta chain is invalidated (never extended with a
+        diff against offline tables), the published epoch never
+        regresses, and the next online update serves one full rescan.
+        On a context shared with online consumers a warm therefore
+        costs them cache hits, never correctness — the deployed
+        topology gives daemons their own contexts."""
+        if self._tables_for(osdmap) is not None:
+            with self._cv:
+                self._epoch = max(self._epoch, osdmap.epoch)
+            return
+        with self._cv:
+            while self._updating:
+                self._cv.wait()
+            self._updating = True
+            mapping = self._ensure_mapping()
+        t0 = time.perf_counter()
+        try:
+            info = mapping.update(osdmap, engine=self._engine())
+        except BaseException:
+            with self._cv:
+                self._updating = False
+                self._cv.notify_all()
+            raise
+        cached_pgs = sum(int(r.shape[0]) for r in mapping._raw.values())
+        with self._cv:
+            self._tables = {osdmap.epoch: _Tables(
+                osdmap, mapping._raw, mapping._pps, mapping._sigs,
+                osdmap.epoch)}
+            self._deltas.clear()
+            self._chain_valid = False
+            self._epoch = max(self._epoch, osdmap.epoch)
+            self._updating = False
+            self._cv.notify_all()
+        self.stats.record_update(
+            seconds=time.perf_counter() - t0,
+            recomputed=len(info.recomputed), reused=len(info.reused),
+            changed=0, cached_pgs=cached_pgs,
+            cached_pools=len(mapping._raw))
+
+    def _delta_since(self, from_epoch: int,
+                     to_epoch: int | None = None) -> MapUpdate:
+        """Union of logged deltas covering EXACTLY (from_epoch,
+        to_epoch] — clamped to the caller's own map epoch, never the
+        (possibly newer) cache head: a PG that changed at the caller's
+        epoch but reverted by the head would be invisible in the
+        head-spanning union, yet the caller's map DOES see it.
+        Called under the lock."""
+        tgt = self.epoch if to_epoch is None else min(to_epoch,
+                                                     self.epoch)
+        if from_epoch >= tgt:
+            return MapUpdate(from_epoch, tgt, (), False)
+        changed: set = set()
+        e = tgt
+        for frm, to, delta in reversed(self._deltas):
+            if to > e:
+                if frm < e:
+                    break    # tgt sits inside a skipped jump
+                continue     # entry entirely newer than the caller
+            if to != e:
+                break
+            changed.update(delta)
+            e = frm
+            if e <= from_epoch:
+                break
+        if e != from_epoch:
+            # chain gap (first map, log overflow, a reader epoch inside
+            # a skipped jump, or a warm() broke the chain): full
+            # rescan, still served from cache where possible
+            self.stats.record_full_rescan()
+            return MapUpdate(from_epoch, tgt, None, True)
+        return MapUpdate(from_epoch, tgt, sorted(changed), False)
+
+    # -- delta derivation -----------------------------------------------------
+
+    def _compute_delta(self, info: _UpdateInfo):
+        """Exact changed-PG set for one epoch transition: candidates
+        from (a) the on-device raw-table diff of recomputed pools,
+        (b) PGs whose raw rows reference OSDs with changed up/exists
+        state or primary affinity, and (c) override-keyed PGs whose
+        entries moved (or any override key when osd visibility/weights
+        moved — upmap validity reads them); then each candidate's full
+        (up, up_primary, acting, acting_primary) is compared old-vs-new
+        through the cached tables.  O(changed + overrides) host work."""
+        old = info.prev
+        mapping = self._mapping
+        m_new = mapping.osdmap
+        if old.osdmap is None or old.epoch < 0:
+            return None, True
+        m_old = old.osdmap
+        no = max(m_old.max_osd, m_new.max_osd, 1)
+        st = (_vec(m_old.osd_state, no) != _vec(m_new.osd_state, no))
+        af = (_vec(m_old.osd_primary_affinity, no, MAX_AFFINITY)
+              != _vec(m_new.osd_primary_affinity, no, MAX_AFFINITY))
+        changed_osds = np.flatnonzero(st | af)
+        weights_moved = bool((_vec(m_old.osd_weight, no)
+                              != _vec(m_new.osd_weight, no)).any())
+        cand: set[tuple[int, int]] = set()
+        recomputed = set(info.recomputed)
+        for pool_id, pool in m_new.pools.items():
+            new_raw = mapping._raw.get(pool_id)
+            if new_raw is None:
+                continue
+            old_pool = m_old.pools.get(pool_id)
+            old_raw = old.raw.get(pool_id)
+            if (old_pool is None or old_raw is None
+                    or old_pool.pg_num != pool.pg_num
+                    or old_pool.type != pool.type
+                    or old_raw.shape != new_raw.shape):
+                cand.update((pool_id, pg) for pg in range(pool.pg_num))
+                continue
+            if pool_id in recomputed:
+                for pg in _changed_rows(old_raw, new_raw):
+                    cand.add((pool_id, int(pg)))
+                if old_pool.pgp_num != pool.pgp_num:
+                    # pps is the affinity seed: it can move a primary
+                    # even where the raw row happens to coincide
+                    po = old.pps.get(pool_id)
+                    pn = mapping._pps.get(pool_id)
+                    if po is None or pn is None:
+                        cand.update((pool_id, pg)
+                                    for pg in range(pool.pg_num))
+                    else:
+                        for pg in np.flatnonzero(po != pn):
+                            cand.add((pool_id, int(pg)))
+            if changed_osds.size and new_raw.size:
+                mask = np.isin(new_raw, changed_osds).any(axis=1)
+                if old_raw is not new_raw:   # reused pools alias
+                    mask |= np.isin(old_raw, changed_osds).any(axis=1)
+                for pg in np.flatnonzero(mask):
+                    cand.add((pool_id, int(pg)))
+        ov_keys: set[tuple[int, int]] = set()
+        for attr in ("pg_temp", "primary_temp", "pg_upmap",
+                     "pg_upmap_items"):
+            do = getattr(m_old, attr)
+            dn = getattr(m_new, attr)
+            for k in set(do) | set(dn):
+                if do.get(k) != dn.get(k):
+                    ov_keys.add(k)
+            if changed_osds.size or weights_moved:
+                ov_keys.update(do)
+                ov_keys.update(dn)
+        for pool_id, pg in ov_keys:
+            pool = m_new.pools.get(pool_id)
+            if pool is not None and 0 <= pg < pool.pg_num:
+                cand.add((pool_id, pg))
+        changed = []
+        for pool_id, pg in cand:
+            pool_n = m_new.pools[pool_id]
+            new_t = _finish_from(m_new, pool_n, pool_id, pg,
+                                 mapping._raw, mapping._pps)
+            pool_o = m_old.pools.get(pool_id)
+            old_t = None
+            if (pool_o is not None and pg < pool_o.pg_num
+                    and pool_id in old.raw
+                    and pg < old.raw[pool_id].shape[0]):
+                old_t = _finish_from(m_old, pool_o, pool_id, pg,
+                                     old.raw, old.pps)
+            if new_t != old_t:
+                changed.append((pool_id, pg))
+        return sorted(changed), False
+
+    # -- reads ----------------------------------------------------------------
+
+    def _tables_for(self, osdmap: OSDMap) -> _Tables | None:
+        with self._cv:
+            t = self._tables.get(osdmap.epoch)
+            if t is None:
+                return None
+            # identity first: the module contract is that maps are
+            # immutable once published, so the object the tables were
+            # built from IS the epoch's content
+            if t.osdmap is osdmap or t._has(t.bound, osdmap):
+                return t
+            if t._has(t.rejected, osdmap):
+                return None
+        # a DIFFERENT object at the same epoch — usually another
+        # daemon's decode of the same published map.  Equal placement
+        # signatures mean bit-identical raw tables (the pipeline tail
+        # always reads the CALLER's map), so content-check once and
+        # bind; a mismatch (foreign cluster sharing a context) is
+        # memoized too so every later read is a cheap oracle fallback
+        try:
+            _csig, sigs = pool_signatures(osdmap)
+        except Exception:
+            return None
+        with self._cv:
+            t2 = self._tables.get(osdmap.epoch)
+            if t2 is None:
+                return None
+            if sigs == t2.sigs:
+                t2._memo(t2.bound, osdmap)
+                return t2
+            t2._memo(t2.rejected, osdmap)
+            return None
+
+    def lookup(self, osdmap: OSDMap, pool_id: int, pgid: int
+               ) -> tuple[list[int], int, list[int], int]:
+        """pg_to_up_acting_osds served from the cache; scalar-oracle
+        fallback on any epoch/object/pool mismatch."""
+        pool = osdmap.pools[pool_id]
+        t = self._tables_for(osdmap)
+        if t is not None:
+            row = t.raw.get(pool_id)
+            if row is not None and 0 <= pgid < row.shape[0]:
+                self.stats.record_lookup(True)
+                return _finish_from(osdmap, pool, pool_id, pgid,
+                                    t.raw, t.pps)
+        self.stats.record_lookup(False)
+        return osdmap.pg_to_up_acting_osds(pool_id, pgid)
+
+    def raw_row(self, osdmap: OSDMap, pool_id: int,
+                pg: int) -> list[int] | None:
+        """Cached _pg_to_raw_osds row (balancer's what-if input), or
+        None when the cache cannot serve this map/pool."""
+        t = self._tables_for(osdmap)
+        if t is None:
+            return None
+        r = t.raw.get(pool_id)
+        if r is None or not (0 <= pg < r.shape[0]):
+            return None
+        row = [int(o) for o in r[pg]]
+        if not osdmap.pools[pool_id].is_erasure():
+            row = [o for o in row if o != CRUSH_ITEM_NONE]
+        return row
+
+    def pg_counts(self, osdmap: OSDMap, pool_id: int) -> np.ndarray:
+        """Per-OSD PG count histogram for a pool (osdmaptool input);
+        requires the cache to be at this map (update_to it first)."""
+        t = self._tables_for(osdmap)
+        if t is None:
+            raise KeyError(f"mapping cache not at epoch {osdmap.epoch}")
+        raw = t.raw[pool_id]
+        valid = raw[(raw != CRUSH_ITEM_NONE) & (raw >= 0)]
+        return np.bincount(valid, minlength=osdmap.max_osd)
+
+    def place(self, crush: CrushMap, ruleno: int, xs, numrep: int,
+              reweight) -> np.ndarray:
+        """Bulk rule evaluation for offline tools (psim/crushtool):
+        the production path — cached mapper, dispatch-engine
+        submission — without needing an OSDMap."""
+        xs = np.asarray(xs, dtype=np.uint32)
+        reweight = np.asarray(reweight, dtype=np.int64)
+        mapping = self._ensure_mapping()
+        if mapping.backend == "scalar":
+            return scalar_rows(crush, ruleno, xs, numrep, reweight)
+        bm = mapping.mapper_for(crush)
+        engine = self._engine()
+        if engine is not None:
+            from ceph_tpu.ops.dispatch import submit_do_rule
+            return np.asarray(submit_do_rule(
+                engine, bm, ruleno, xs, numrep,
+                reweight).result(timeout=120.0))
+        return np.asarray(bm.do_rule(ruleno, xs, numrep, reweight))

@@ -1,6 +1,11 @@
 """osdmaptool --test-map-pgs analog (src/tools/osdmaptool.cc:32-42,184-196):
 map every PG of every pool through the full placement pipeline and print the
-distribution summary (avg/min/max PGs per OSD, mapping rate)."""
+distribution summary (avg/min/max PGs per OSD, mapping rate).
+
+Runs through the context's shared PG mapping service — the same
+epoch-keyed cache, incremental invalidation and dispatch-engine path
+the OSDs/client/balancer use — so the tool exercises (and measures)
+the production mapping path, not a private mapper."""
 
 from __future__ import annotations
 
@@ -10,23 +15,24 @@ import time
 
 import numpy as np
 
+from ceph_tpu.common.context import default_context
 from ceph_tpu.crush import build_two_level_map
-from ceph_tpu.osd import OSDMap, OSDMapMapping, PGPool
+from ceph_tpu.osd import OSDMap, PGPool
 
 
 def test_map_pgs(m: OSDMap, out=sys.stdout, dump: bool = False) -> dict:
     t0 = time.perf_counter()
-    mapping = OSDMapMapping(m)
-    mapping.update()
+    svc = default_context().mapping_service()
+    svc.warm(m)
     total = np.zeros(max(m.max_osd, 1), dtype=np.int64)
     n_pgs = 0
     for pool_id, pool in m.pools.items():
-        counts = mapping.pg_counts(pool_id)
+        counts = svc.pg_counts(m, pool_id)
         total[:len(counts)] += counts
         n_pgs += pool.pg_num
         if dump:
             for pg in range(pool.pg_num):
-                up, upp, acting, actp = mapping.get(pool_id, pg)
+                up, upp, acting, actp = svc.lookup(m, pool_id, pg)
                 print(f"{pool_id}.{pg}\t{up}\t{upp}", file=out)
     dt = time.perf_counter() - t0
     in_osds = total[total > 0]

@@ -16,17 +16,18 @@ def simulate(hosts: int = 16, per_host: int = 4, objects: int = 4096,
              numrep: int = 3) -> dict:
     import numpy as np
 
+    from ceph_tpu.common.context import default_context
     from ceph_tpu.crush import build_two_level_map
-    from ceph_tpu.crush.mapper_jax import BatchMapper
 
     crush_map, _root, rid = build_two_level_map(hosts, per_host)
     n_dev = hosts * per_host
     reweight = np.full(n_dev, 0x10000, dtype=np.int64)
-    bm = BatchMapper(crush_map)
-    import jax.numpy as jnp
     rng = np.random.default_rng(0)
-    xs = jnp.asarray(rng.integers(0, 2 ** 32, (objects,), dtype=np.uint32))
-    out = np.asarray(bm.do_rule(rid, xs, numrep, jnp.asarray(reweight)))
+    xs = rng.integers(0, 2 ** 32, (objects,), dtype=np.uint32)
+    # the production bulk-placement path: the shared mapping service's
+    # cached mapper + dispatch-engine submission, not a private mapper
+    svc = default_context().mapping_service()
+    out = np.asarray(svc.place(crush_map, rid, xs, numrep, reweight))
     counts = np.zeros(n_dev, dtype=np.int64)
     for col in range(out.shape[1]):
         valid = out[:, col] >= 0
