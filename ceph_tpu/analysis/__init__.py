@@ -1,0 +1,170 @@
+"""ceph_tpu.analysis — whole-tree concurrency + jit-boundary static
+analyzer.
+
+The reference treats lock-order checking as a first-class subsystem
+(src/common/lockdep.{h,cc}): every named mutex feeds one global order
+graph and a cycle aborts the process.  Runtime lockdep only sees the
+interleavings a run happens to produce; this package complements it
+with AST-level *may* analysis over the whole tree, so the concurrency
+and jit-purity invariants the dispatch/decode/mapping hot paths
+established by convention are mechanically enforced on every PR.
+
+Five check families (one module each):
+
+* ``lock-order``  — static may-hold-A-while-taking-B graph, propagated
+  inter-procedurally and unioned with the runtime
+  ``common/lockdep.py`` graph; cycles report a witness path per edge.
+* ``bare-lock``   — ``threading.Lock/RLock/Condition`` constructed
+  outside ``lockdep.make_lock``/``make_condition`` is invisible to
+  runtime lockdep and is a finding.
+* ``blocking``    — ``.result()``, blocking ``acquire()``,
+  ``time.sleep`` and host-sync calls reachable from dispatch/
+  completion-thread callbacks (they deadlock or stall the
+  double-buffered pipeline).
+* ``jit-purity``  — functions handed to ``jax.jit`` or the dispatch
+  engines must not read clocks/randomness/config, log, or mutate
+  captured state (retrace + correctness hazards).
+* ``registry``    — every ``conf.get(key)`` key must exist in
+  ``common/config.py``'s option table; every perf-counter mutation
+  must name a counter registered in its ``PerfCounters`` set.
+
+Findings diff against a checked-in baseline (``baseline.txt``, driven
+to empty) and per-line suppressions:
+
+    some_flagged_line()   # analysis: allow[check-id] -- justification
+
+Run ``python -m ceph_tpu.analysis`` (no third-party deps; stdlib
+``ast`` only — the modules in THIS package must never import jax,
+numpy, or the kernel stack, so the gate stays a few seconds of parse
+work).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+CHECKS = ("lock-order", "bare-lock", "blocking", "jit-purity",
+          "registry")
+
+
+@dataclass(frozen=True)
+class Finding:
+    check: str          # check family id ("bare-lock", ...)
+    path: str           # repo-relative posix path
+    line: int           # 1-based; 0 for tree-level findings (cycles)
+    code: str           # stable short code within the family
+    message: str
+
+    def key(self) -> str:
+        """Baseline identity: check + anchor site + code, WITHOUT the
+        message — messages embed volatile detail (a lock-order cycle's
+        witness sites carry other files' line numbers), which would
+        churn baselined entries on unrelated edits."""
+        return f"{self.check}|{self.path}|{self.line}|{self.code}"
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.check}/{self.code}] {self.message}"
+
+
+@dataclass
+class Report:
+    findings: list = field(default_factory=list)
+    suppressed: list = field(default_factory=list)  # (Finding, reason)
+
+    def extend(self, other: "Report") -> None:
+        self.findings.extend(other.findings)
+        self.suppressed.extend(other.suppressed)
+
+
+def _suppression(index, path: str, line: int, check: str):
+    """Return the justification string when ``path:line`` (or the line
+    above it) carries ``# analysis: allow[check] -- reason``."""
+    mod = index.by_path.get(path)
+    if mod is None:
+        return None
+    for ln in (line, line - 1):
+        allow = mod.allows.get(ln)
+        if allow:
+            for chk, reason in allow:
+                if chk in (check, "*"):
+                    return reason or "(unjustified)"
+    return None
+
+
+def _emit(report: Report, index, f: Finding) -> None:
+    reason = _suppression(index, f.path, f.line, f.check)
+    if reason is None:
+        report.findings.append(f)
+    else:
+        report.suppressed.append((f, reason))
+
+
+def run(root: str, checks=CHECKS, runtime_graph: dict | None = None,
+        index=None) -> Report:
+    """Analyze every ``*.py`` under ``root`` (a package directory).
+    ``runtime_graph`` is a ``lockdep.export_graph()`` dict unioned into
+    the static lock-order graph."""
+    from ceph_tpu.analysis import core
+    if index is None:
+        index = core.TreeIndex.build(root)
+    report = Report()
+    if "bare-lock" in checks:
+        from ceph_tpu.analysis import bare_locks
+        for f in bare_locks.check(index):
+            _emit(report, index, f)
+    if "lock-order" in checks:
+        from ceph_tpu.analysis import lock_order
+        for f in lock_order.check(index, runtime_graph=runtime_graph):
+            _emit(report, index, f)
+    if "blocking" in checks:
+        from ceph_tpu.analysis import blocking
+        for f in blocking.check(index):
+            _emit(report, index, f)
+    if "jit-purity" in checks:
+        from ceph_tpu.analysis import jit_purity
+        for f in jit_purity.check(index):
+            _emit(report, index, f)
+    if "registry" in checks:
+        from ceph_tpu.analysis import registry_lint
+        for f in registry_lint.check(index):
+            _emit(report, index, f)
+    report.findings.sort(key=lambda f: (f.path, f.line, f.check, f.code))
+    return report
+
+
+# -- baseline -----------------------------------------------------------------
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.txt")
+
+
+def load_baseline(path: str) -> set[str]:
+    keys: set[str] = set()
+    if not os.path.exists(path):
+        return keys
+    with open(path, encoding="utf-8") as f:
+        for ln in f:
+            ln = ln.strip()
+            if ln and not ln.startswith("#"):
+                keys.add(ln)
+    return keys
+
+
+def save_baseline(path: str, findings) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("# ceph_tpu.analysis baseline — accepted pre-existing "
+                "findings.\n# One Finding.key() per line; keep EMPTY: "
+                "new findings should be\n# fixed or suppressed inline "
+                "with a justification, not baselined.\n")
+        for fi in sorted(findings, key=lambda x: x.key()):
+            f.write(fi.key() + "\n")
+
+
+def diff_baseline(report: Report, baseline: set[str]):
+    """-> (new_findings, stale_keys)."""
+    current = {f.key() for f in report.findings}
+    new = [f for f in report.findings if f.key() not in baseline]
+    stale = sorted(baseline - current)
+    return new, stale

@@ -1,0 +1,101 @@
+"""CLI: ``python -m ceph_tpu.analysis [root] [options]``.
+
+Exit status is 0 when no findings are NEW relative to the checked-in
+baseline (``ceph_tpu/analysis/baseline.txt``), 1 otherwise — wired as
+the fast pre-test step of the tier-1 command in ROADMAP.md, so every
+PR is gated on a clean run.  The analysis itself is pure-AST stdlib
+work (the only jax cost is the parent package's import-time x64
+config; no kernels, no devices).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ceph_tpu import analysis
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m ceph_tpu.analysis",
+        description="whole-tree concurrency + jit-boundary static "
+                    "analyzer (see docs/STATIC_ANALYSIS.md)")
+    p.add_argument("root", nargs="?", default=None,
+                   help="package directory to analyze (default: the "
+                        "installed ceph_tpu package)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: the checked-in "
+                        "ceph_tpu/analysis/baseline.txt)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept the current findings as the baseline")
+    p.add_argument("--runtime-graph", default=None, metavar="FILE",
+                   help="lockdep.export_graph() JSON to union into "
+                        "the static lock-order graph")
+    p.add_argument("--checks", default=",".join(analysis.CHECKS),
+                   help="comma-separated subset of: "
+                        + ", ".join(analysis.CHECKS))
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also list inline-suppressed findings")
+    args = p.parse_args(argv)
+
+    root = args.root
+    if root is None:
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+    checks = tuple(c.strip() for c in args.checks.split(",")
+                   if c.strip())
+    unknown = [c for c in checks if c not in analysis.CHECKS]
+    if unknown:
+        p.error(f"unknown checks: {unknown}")
+    runtime_graph = None
+    if args.runtime_graph:
+        with open(args.runtime_graph, encoding="utf-8") as f:
+            runtime_graph = json.load(f)
+
+    report = analysis.run(root, checks=checks,
+                          runtime_graph=runtime_graph)
+    baseline_path = args.baseline or analysis.default_baseline_path()
+    baseline = analysis.load_baseline(baseline_path)
+    new, stale = analysis.diff_baseline(report, baseline)
+
+    if args.write_baseline:
+        analysis.save_baseline(baseline_path, report.findings)
+        print(f"baseline written: {len(report.findings)} finding(s) "
+              f"-> {baseline_path}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "root": root,
+            "checks": list(checks),
+            "findings": [vars(f) | {"new": f.key() not in baseline}
+                         for f in report.findings],
+            "suppressed": [vars(f) | {"reason": r}
+                           for f, r in report.suppressed],
+            "stale_baseline": stale,
+            "exit": 1 if new else 0,
+        }, indent=2, sort_keys=True))
+    else:
+        for f in report.findings:
+            tag = "NEW " if f.key() not in baseline else "base"
+            print(f"{tag} {f.render()}")
+        if args.show_suppressed:
+            for f, reason in report.suppressed:
+                print(f"supp {f.render()}  [allowed: {reason}]")
+        for k in stale:
+            print(f"stale baseline entry (fixed — remove it): {k}")
+        n_s = len(report.suppressed)
+        print(f"{len(report.findings)} finding(s) "
+              f"({len(new)} new, {n_s} suppressed inline, "
+              f"{len(stale)} stale baseline) across "
+              f"{len(checks)} check(s)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,51 @@
+"""Bare-lock lint (check family ``bare-lock``).
+
+Every ``threading.Lock()``/``RLock()``/``Condition()`` constructed
+outside ``common/lockdep.py``'s ``make_lock``/``make_condition``
+factories is invisible to runtime lock-order checking — the exact gap
+this PR closes on the dispatch/decode/mapping hot paths.  New code
+must name its locks; the few justified bare locks (import-time module
+locks created before lockdep can be enabled, per-instance leaf locks
+with measured overhead concerns) carry inline suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ceph_tpu.analysis import Finding
+from ceph_tpu.analysis.core import TreeIndex, name_chain
+
+_CTORS = {"Lock", "RLock", "Condition"}
+
+
+def check(index: TreeIndex):
+    findings = []
+    for relpath, mod in sorted(index.by_path.items()):
+        if mod.modname.endswith("common.lockdep"):
+            continue        # the factory itself
+        threading_aliases = {a for a, imp in mod.imports.items()
+                             if imp == ("module", "threading")}
+        from_imports = {a for a, imp in mod.imports.items()
+                        if imp[0] == "symbol" and imp[1] == "threading"
+                        and imp[2] in _CTORS}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = name_chain(node.func)
+            if not chain:
+                continue
+            hit = None
+            if (len(chain) == 2 and chain[0] in threading_aliases
+                    and chain[1] in _CTORS):
+                hit = chain[1]
+            elif len(chain) == 1 and chain[0] in from_imports:
+                hit = chain[0]
+            if hit:
+                findings.append(Finding(
+                    "bare-lock", relpath, node.lineno, hit.lower(),
+                    f"bare threading.{hit}() — invisible to lockdep; "
+                    f"use lockdep.make_lock(name)"
+                    + ("/make_condition(name)" if hit == "Condition"
+                       else "")))
+    return findings

@@ -1,0 +1,186 @@
+"""Completion-thread blocking lint (check family ``blocking``).
+
+The dispatch engines deliver every future on ONE completion thread
+(ops/dispatch.py's delivery-order contract).  A continuation that
+blocks — waiting on another dispatch future with ``.result()``, a
+blocking bare ``acquire()``, ``time.sleep`` — stalls every later
+completion in the pipeline, and waiting on a future of the SAME
+engine is a guaranteed self-deadlock (the wait can only be satisfied
+by the thread doing the waiting).  Host-sync calls (``np.asarray`` /
+``block_until_ready`` on device values) serialize the double-buffered
+pipeline the same way.
+
+Roots: every function/lambda registered via ``add_done_callback``.
+The lint flags blocking patterns in any function reachable from a
+root through the best-effort call graph.  ``with lock:`` critical
+sections are NOT flagged — bounded exclusion is how continuations are
+meant to synchronize; parking the thread is not.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ceph_tpu.analysis import Finding
+from ceph_tpu.analysis.core import TreeIndex, name_chain
+
+#: reachability bound: a callback calling this many functions deep is
+#: beyond useful precision (and beyond plausible completion-path code)
+MAX_DEPTH = 6
+
+
+def _roots(index: TreeIndex):
+    """Functions registered as dispatch-future callbacks, with the
+    call site that registered them (for the report)."""
+    roots = []
+    for fi in index.all_functions():
+        for cs in fi.call_sites:
+            node = cs.node
+            if not isinstance(node, ast.Call):
+                continue
+            chain = name_chain(node.func)
+            if not chain or chain[-1] != "add_done_callback":
+                continue
+            for arg in node.args:
+                target = None
+                ach = name_chain(arg)
+                if isinstance(arg, ast.Lambda):
+                    target = fi.nested.get(
+                        f"<lambda@{arg.lineno}:{arg.col_offset}>")
+                elif ach:
+                    spec = None
+                    if len(ach) == 1:
+                        spec = ("name", ach[0])
+                    elif ach[0] in ("self", "cls") and len(ach) == 2:
+                        spec = ("self", ach[1])
+                    if spec:
+                        target = index.resolve_call(fi, spec)
+                if target is not None:
+                    roots.append((target, fi, cs.line))
+    return roots
+
+
+def _reachable(index: TreeIndex, roots):
+    """fn -> (root, depth, via) for every function reachable from a
+    callback root."""
+    out = {}
+    frontier = [(fn, fn, 0) for fn, _src, _ln in roots]
+    for fn, root, _d in frontier:
+        out.setdefault(fn, (root, 0, None))
+    while frontier:
+        nxt = []
+        for fn, root, d in frontier:
+            if d >= MAX_DEPTH:
+                continue
+            for cs in fn.call_sites:
+                g = index.resolve_call(fn, cs.spec)
+                if g is not None and g not in out:
+                    out[g] = (root, d + 1, fn)
+                    nxt.append((g, root, d + 1))
+        frontier = nxt
+    return out
+
+
+def _params(fi) -> set:
+    args = getattr(fi.node, "args", None)
+    if args is None:
+        return set()
+    out = {a.arg for a in (list(args.posonlyargs) + list(args.args)
+                           + list(args.kwonlyargs))}
+    if args.vararg:
+        out.add(args.vararg.arg)
+    if args.kwarg:
+        out.add(args.kwarg.arg)
+    return out
+
+
+def _blocking_sites(index: TreeIndex, fi):
+    """(line, code, detail) blocking patterns directly inside fi."""
+    sites = []
+    params = _params(fi)
+    for node in ast.walk(fi.node):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = name_chain(node.func)
+        if not chain:
+            continue
+        tail = chain[-1]
+        if tail == "result" and len(chain) > 1:
+            # .result() DIRECTLY on a future the callback received as
+            # a parameter (incl. ones threaded down a continuation
+            # chain) is the standard already-complete read, not a
+            # wait.  Only the two-element form qualifies: a future
+            # reached THROUGH a parameter (self._w.result()) is
+            # attribute-stored state, exactly the create-then-wait
+            # self-deadlock this check exists for.
+            if len(chain) == 2 and chain[0] in params:
+                continue
+            sites.append((node.lineno, "future-wait",
+                          f"{'.'.join(chain)}() blocks on another "
+                          f"completion"))
+        elif chain == ("time", "sleep"):
+            sites.append((node.lineno, "sleep", "time.sleep parks the "
+                          "completion thread"))
+        elif tail == "block_until_ready":
+            sites.append((node.lineno, "host-sync",
+                          "block_until_ready fences the device "
+                          "pipeline"))
+        elif tail == "asarray" and len(chain) == 2 and \
+                chain[0] in ("np", "numpy"):
+            # jnp.asarray stays device-side/async — only a HOST
+            # asarray materializes and stalls the pipeline
+            sites.append((node.lineno, "host-sync",
+                          f"{chain[0]}.asarray on a device value "
+                          f"synchronizes the pipeline"))
+        elif tail == "acquire" and len(chain) > 1:
+            # blocking bare acquire (with-statements are exempt):
+            # acquire(False) / acquire(timeout=..) are bounded
+            def bounded_timeout(v) -> bool:
+                # timeout=-1 (or any negative constant) is the
+                # documented block-forever spelling; a non-constant
+                # timeout is assumed bounded.  Negative literals parse
+                # as UnaryOp(USub, Constant), not negative Constants.
+                if isinstance(v, ast.UnaryOp) and \
+                        isinstance(v.op, ast.USub) and \
+                        isinstance(v.operand, ast.Constant) and \
+                        isinstance(v.operand.value, (int, float)):
+                    return False
+                return not (isinstance(v, ast.Constant)
+                            and isinstance(v.value, (int, float))
+                            and v.value < 0)
+            blocking = True
+            if node.args and isinstance(node.args[0], ast.Constant):
+                blocking = bool(node.args[0].value)
+            for kw in node.keywords:
+                if kw.arg in ("blocking",) and isinstance(
+                        kw.value, ast.Constant):
+                    blocking = bool(kw.value.value)
+                if kw.arg == "timeout" and bounded_timeout(kw.value):
+                    blocking = False
+            if len(node.args) >= 2 and bounded_timeout(node.args[1]):
+                blocking = False
+            if blocking:
+                sites.append((node.lineno, "acquire",
+                              f"unbounded {'.'.join(chain)}()"))
+    return sites
+
+
+def check(index: TreeIndex):
+    roots = _roots(index)
+    reach = _reachable(index, roots)
+    findings = []
+    seen = set()
+    for fn in sorted(reach, key=lambda f: f.qualname):
+        root, depth, via = reach[fn]
+        for line, code, detail in _blocking_sites(index, fn):
+            key = (fn.module.relpath, line, code)
+            if key in seen:
+                continue
+            seen.add(key)
+            how = "a completion callback" if depth == 0 else (
+                f"reachable from completion callback "
+                f"{root.qualname} (depth {depth})")
+            findings.append(Finding(
+                "blocking", fn.module.relpath, line, code,
+                f"{detail}; {fn.qualname} is {how}"))
+    return findings

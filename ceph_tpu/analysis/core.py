@@ -1,0 +1,644 @@
+"""Fact extraction for the static checks (stdlib ``ast`` only).
+
+One pass over every module under the analyzed package builds a
+``TreeIndex``:
+
+* every function/method (including nested defs) with its AST node;
+* per-class lock attributes — ``self._lock = lockdep.make_lock("X")``
+  resolves to the name ``X``; a bare ``threading.Lock()`` gets the
+  synthesized name ``module.Class.attr`` (and is marked bare);
+* per-function acquisition events and call sites, each annotated with
+  the with-statement lock stack held at that point;
+* a best-effort call graph: ``self.m()`` resolves within the class
+  (and in-tree bases), bare names within the module and its
+  from-imports, ``self.attr.m()`` through attribute types inferred
+  from constructor calls and ``__init__`` parameter annotations, and
+  — for otherwise-unresolvable attribute calls — a unique-method-name
+  fallback (skipped when ambiguous).
+
+Lock names are normalized so the per-instance suffix convention
+(``OSD::osd_lock(0)``) collapses to one graph node per name family
+(``OSD::osd_lock(*)``) — the same name-based merging runtime lockdep
+does, extended over instances.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_ALLOW_RE = re.compile(
+    r"#\s*analysis:\s*allow\[([\w*,-]+)\]\s*(?:--\s*(.*?))?\s*$")
+_PAREN_RE = re.compile(r"\([^()]*\)")
+
+
+def normalize_name(name: str) -> str:
+    """Collapse per-instance suffixes: ``OSD::osd_lock(0)`` ->
+    ``OSD::osd_lock(*)`` (one order-graph node per name family)."""
+    return _PAREN_RE.sub("(*)", name)
+
+
+def name_chain(node) -> tuple | None:
+    """``a.b.c`` -> ("a","b","c"); ``self._lock`` -> ("self","_lock");
+    None for anything that is not a pure Name/Attribute chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _static_str(node) -> str | None:
+    """A string literal or f-string with formatted parts as ``*``."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        out = []
+        for part in node.values:
+            if isinstance(part, ast.Constant):
+                out.append(str(part.value))
+            else:
+                out.append("*")
+        return "".join(out)
+    return None
+
+
+class LockDef:
+    __slots__ = ("name", "bare", "line")
+
+    def __init__(self, name: str, bare: bool, line: int):
+        self.name = normalize_name(name)
+        self.bare = bare
+        self.line = line
+
+
+class AcqEvent:
+    __slots__ = ("lock", "line", "held", "blocking")
+
+    def __init__(self, lock: str, line: int, held: tuple,
+                 blocking: bool = True):
+        self.lock = lock
+        self.line = line
+        self.held = held
+        self.blocking = blocking
+
+
+class CallSite:
+    __slots__ = ("spec", "line", "held", "node")
+
+    def __init__(self, spec: tuple, line: int, held: tuple, node):
+        self.spec = spec
+        self.line = line
+        self.held = held
+        self.node = node
+
+
+class FunctionInfo:
+    def __init__(self, qualname: str, name: str, node, module,
+                 cls=None, parent=None):
+        self.qualname = qualname      # mod.Class.meth / mod.fn.<locals>.g
+        self.name = name
+        self.node = node
+        self.module = module
+        self.cls = cls                # ClassInfo or None
+        self.parent = parent          # enclosing FunctionInfo
+        self.nested: dict[str, FunctionInfo] = {}
+        self.acq_events: list[AcqEvent] = []
+        self.call_sites: list[CallSite] = []
+        self.decorators: list = node.decorator_list if hasattr(
+            node, "decorator_list") else []
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+    def __repr__(self):
+        return f"<fn {self.qualname}>"
+
+
+class ClassInfo:
+    def __init__(self, name: str, node, module):
+        self.name = name
+        self.node = node
+        self.module = module
+        self.bases: list[tuple] = [b for b in (
+            name_chain(x) for x in node.bases) if b]
+        self.attr_locks: dict[str, LockDef] = {}
+        self.attr_types: dict[str, str] = {}   # attr -> class name
+        self.methods: dict[str, FunctionInfo] = {}
+
+
+class ModuleInfo:
+    def __init__(self, path: str, relpath: str, modname: str, tree,
+                 source: str):
+        self.path = path
+        self.relpath = relpath
+        self.modname = modname
+        self.tree = tree
+        self.source = source
+        self.classes: dict[str, ClassInfo] = {}
+        self.functions: dict[str, FunctionInfo] = {}   # module-level
+        self.module_locks: dict[str, LockDef] = {}
+        #: alias -> ("module", dotted) | ("symbol", dotted, orig)
+        self.imports: dict[str, tuple] = {}
+        #: lineno -> [(check, reason)] suppression comments
+        self.allows: dict[int, list] = {}
+        for i, ln in enumerate(source.splitlines(), 1):
+            m = _ALLOW_RE.search(ln)
+            if m:
+                checks = [c.strip() for c in m.group(1).split(",")]
+                reason = (m.group(2) or "").strip()
+                self.allows[i] = [(c, reason) for c in checks]
+
+
+class TreeIndex:
+    """All modules of one analyzed package + resolution helpers."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.base = os.path.dirname(self.root)
+        self.modules: dict[str, ModuleInfo] = {}
+        self.by_path: dict[str, ModuleInfo] = {}
+        #: bare method name -> [FunctionInfo] across every class
+        self.methods_by_name: dict[str, list] = {}
+        #: class name -> [ClassInfo]
+        self.classes_by_name: dict[str, list] = {}
+
+    # -- construction ---------------------------------------------------------
+
+    @classmethod
+    def build(cls, root: str) -> "TreeIndex":
+        idx = cls(root)
+        for dirpath, dirnames, filenames in os.walk(idx.root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    idx._load(os.path.join(dirpath, fn))
+        for mod in idx.modules.values():
+            idx._scan_module(mod)
+        return idx
+
+    def _load(self, path: str) -> None:
+        rel = os.path.relpath(path, self.base).replace(os.sep, "/")
+        modname = rel[:-3].replace("/", ".")
+        if modname.endswith(".__init__"):
+            modname = modname[:-len(".__init__")]
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            return
+        mod = ModuleInfo(path, rel, modname, tree, source)
+        self.modules[modname] = mod
+        self.by_path[rel] = mod
+        self._index_module(mod)
+
+    def _index_module(self, mod: ModuleInfo) -> None:
+        for node in mod.tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mod.imports[a.asname or a.name.split(".")[0]] = (
+                        "module", a.name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:   # relative: resolve against package
+                    base = mod.modname.split(".")
+                    if not mod.path.endswith("__init__.py"):
+                        base = base[:-1]
+                    base = base[:len(base) - (node.level - 1)]
+                    src = ".".join(base + ([node.module]
+                                           if node.module else []))
+                else:
+                    src = node.module
+                if src:
+                    for a in node.names:
+                        mod.imports[a.asname or a.name] = (
+                            "symbol", src, a.name)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                f = FunctionInfo(f"{mod.modname}.{node.name}",
+                                 node.name, node, mod)
+                mod.functions[node.name] = f
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(node.name, node, mod)
+                mod.classes[node.name] = ci
+                self.classes_by_name.setdefault(node.name, []).append(ci)
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        fi = FunctionInfo(
+                            f"{mod.modname}.{node.name}.{sub.name}",
+                            sub.name, sub, mod, cls=ci)
+                        ci.methods[sub.name] = fi
+                        self.methods_by_name.setdefault(
+                            sub.name, []).append(fi)
+                    elif isinstance(sub, ast.Assign):
+                        self._note_attr_assign(mod, ci, sub,
+                                               class_body=True)
+            elif isinstance(node, ast.Assign):
+                # owner = the module, so unrelated module-level _LOCKs
+                # in different files stay distinct graph nodes
+                ld = self._lock_def(mod, node.value,
+                                    self._assign_name(node),
+                                    owner=mod.modname)
+                if ld:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            mod.module_locks[t.id] = ld
+
+    @staticmethod
+    def _assign_name(node) -> str | None:
+        t = node.targets[0] if node.targets else None
+        if isinstance(t, ast.Name):
+            return t.id
+        if isinstance(t, ast.Attribute):
+            return t.attr
+        return None
+
+    def _lock_def(self, mod: ModuleInfo, value, attr: str | None,
+                  owner: str = "") -> LockDef | None:
+        """Recognize a lock-constructing RHS; None otherwise."""
+        if not isinstance(value, ast.Call):
+            return None
+        chain = name_chain(value.func)
+        if not chain:
+            return None
+        tail = chain[-1]
+        if tail in ("make_lock", "make_condition"):
+            # make_condition(name, lock=self.X) shares ONE lock object
+            # between a mutex and its condition — model it as an alias
+            # of X, not a second node, or a real inversion through the
+            # shared lock would split across two names and hide
+            if tail == "make_condition":
+                shared = value.args[1] if len(value.args) > 1 else None
+                for kw in value.keywords:
+                    if kw.arg == "lock":
+                        shared = kw.value
+                inner = name_chain(shared) if shared is not None \
+                    else None
+                if inner and inner[0] == "self" and len(inner) == 2:
+                    return LockDef(f"@alias:{inner[1]}", False,
+                                   value.lineno)
+            nm = _static_str(value.args[0]) if value.args else None
+            return LockDef(nm or f"{owner}.{attr}", False, value.lineno)
+        if tail in _LOCK_CTORS and (
+                chain[0] == "threading" or len(chain) == 1):
+            # Condition(existing_lock) aliases the wrapped lock
+            if tail == "Condition" and value.args:
+                inner = name_chain(value.args[0])
+                if inner and inner[0] == "self" and len(inner) == 2:
+                    return LockDef(f"@alias:{inner[1]}", True,
+                                   value.lineno)
+            return LockDef(f"{owner}.{attr}", True, value.lineno)
+        return None
+
+    def _note_attr_assign(self, mod: ModuleInfo, ci: ClassInfo, node,
+                          class_body: bool = False) -> None:
+        owner = f"{mod.modname}.{ci.name}"
+        attr = self._assign_name(node)
+        if attr is None:
+            return
+        ld = self._lock_def(mod, node.value, attr, owner)
+        targets_self = class_body or any(
+            isinstance(t, ast.Attribute) and
+            isinstance(t.value, ast.Name) and t.value.id in ("self", "cls")
+            for t in node.targets)
+        if not targets_self:
+            return
+        if ld:
+            if ld.name.startswith("@alias:"):
+                src = ci.attr_locks.get(ld.name[len("@alias:"):])
+                if src is not None:
+                    ci.attr_locks[attr] = src
+                else:
+                    ci.attr_locks[attr] = LockDef(
+                        f"{owner}.{attr}", True, ld.line)
+            else:
+                ci.attr_locks[attr] = ld
+            return
+        # attribute types: self.x = ClassName(...) (annotated-param
+        # assignments are typed by the pass in _collect_attrs)
+        if isinstance(node.value, ast.Call):
+            chain = name_chain(node.value.func)
+            if chain and chain[-1][:1].isupper():
+                ci.attr_types.setdefault(attr, chain[-1])
+
+    # -- per-function scanning ------------------------------------------------
+
+    def _scan_module(self, mod: ModuleInfo) -> None:
+        for fi in list(mod.functions.values()):
+            self._scan_function(fi)
+        for ci in mod.classes.values():
+            # attribute facts first (any method may assign self.x)
+            for fi in ci.methods.values():
+                self._collect_attrs(mod, ci, fi)
+            for fi in ci.methods.values():
+                self._scan_function(fi)
+
+    def _collect_attrs(self, mod: ModuleInfo, ci: ClassInfo,
+                       fi: FunctionInfo) -> None:
+        ann: dict[str, str] = {}
+        args = fi.node.args
+        for a in list(args.args) + list(args.kwonlyargs):
+            if a.annotation is not None:
+                t = None
+                if isinstance(a.annotation, ast.Constant) and \
+                        isinstance(a.annotation.value, str):
+                    t = a.annotation.value.strip("'\"")
+                else:
+                    ch = name_chain(a.annotation)
+                    if ch:
+                        t = ch[-1]
+                if t:
+                    ann[a.arg] = t.split("[")[0].split(".")[-1]
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign):
+                self._note_attr_assign(mod, ci, node)
+                # self.x = annotated_param
+                attr = self._assign_name(node)
+                if attr and isinstance(node.value, ast.Name) and \
+                        node.value.id in ann:
+                    ci.attr_types.setdefault(attr, ann[node.value.id])
+
+    def _scan_function(self, fi: FunctionInfo) -> None:
+        self._scan_block(fi, fi.node.body, [])
+
+    def _scan_block(self, fi: FunctionInfo, stmts, held: list) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                pushed = 0
+                for item in st.items:
+                    self._scan_expr(fi, item.context_expr, held)
+                    lk = self.resolve_lock_expr(fi, item.context_expr)
+                    if lk is not None:
+                        fi.acq_events.append(AcqEvent(
+                            lk, st.lineno, tuple(held)))
+                        held.append(lk)
+                        pushed += 1
+                self._scan_block(fi, st.body, held)
+                for _ in range(pushed):
+                    held.pop()
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nf = FunctionInfo(
+                    f"{fi.qualname}.<locals>.{st.name}", st.name,
+                    st, fi.module, cls=fi.cls, parent=fi)
+                fi.nested[st.name] = nf
+                # a nested def runs later (often on another thread):
+                # scan with an EMPTY held stack, but record the
+                # definition as a call site so reachability flows
+                self._scan_block(nf, st.body, [])
+                fi.call_sites.append(CallSite(
+                    ("nested", st.name), st.lineno, tuple(held), st))
+            elif isinstance(st, ast.ClassDef):
+                pass    # local classes: out of scope
+            else:
+                for _field, value in ast.iter_fields(st):
+                    if isinstance(value, ast.expr):
+                        self._scan_expr(fi, value, held)
+                    elif isinstance(value, list):
+                        if value and isinstance(value[0], ast.stmt):
+                            self._scan_block(fi, value, held)
+                        else:
+                            for v in value:
+                                if isinstance(v, ast.expr):
+                                    self._scan_expr(fi, v, held)
+                                elif isinstance(v, ast.ExceptHandler):
+                                    self._scan_block(fi, v.body, held)
+
+    def _scan_expr(self, fi: FunctionInfo, node, held: list) -> None:
+        # collect Call nodes without descending into Lambda bodies —
+        # a lambda runs later (usually on another thread/callback), so
+        # its calls must not inherit the current held-lock stack
+        calls, lambdas, stack = [], [], [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.Lambda):
+                lambdas.append(n)
+                continue
+            if isinstance(n, ast.Call):
+                calls.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        for lam in lambdas:
+            # lineno:col so two lambdas on one line get distinct nodes
+            name = f"<lambda@{lam.lineno}:{lam.col_offset}>"
+            nf = FunctionInfo(f"{fi.qualname}.<locals>.{name}", name,
+                              lam, fi.module, cls=fi.cls, parent=fi)
+            nf.decorators = []
+            fi.nested[name] = nf
+            self._scan_expr(nf, lam.body, [])
+            fi.call_sites.append(CallSite(("nested", name), lam.lineno,
+                                          tuple(held), lam))
+        for call in calls:
+            chain = name_chain(call.func)
+            if not chain:
+                continue
+            line, snap = call.lineno, tuple(held)
+            if chain[-1] == "acquire" and len(chain) > 1:
+                lk = self.resolve_lock_expr(fi, call.func.value)
+                if lk is not None:
+                    blocking = True
+                    if call.args and isinstance(call.args[0],
+                                                ast.Constant):
+                        blocking = bool(call.args[0].value)
+                    for kw in call.keywords:
+                        if kw.arg == "blocking" and isinstance(
+                                kw.value, ast.Constant):
+                            blocking = bool(kw.value.value)
+                    fi.acq_events.append(AcqEvent(lk, line, snap,
+                                                  blocking=blocking))
+                    continue
+            fi.call_sites.append(CallSite(
+                self._call_spec(fi, chain), line, snap, call))
+
+    @staticmethod
+    def _call_spec(fi: FunctionInfo, chain: tuple) -> tuple:
+        if len(chain) == 1:
+            return ("name", chain[0])
+        if chain[0] in ("self", "cls"):
+            if len(chain) == 2:
+                return ("self", chain[1])
+            if len(chain) == 3:
+                return ("selfattr", chain[1], chain[2])
+        if len(chain) == 2:
+            return ("dotted", chain[0], chain[1])
+        return ("unique", chain[-1])
+
+    # -- resolution -----------------------------------------------------------
+
+    def find_class(self, name: str, mod: ModuleInfo) -> ClassInfo | None:
+        if name in mod.classes:
+            return mod.classes[name]
+        imp = mod.imports.get(name)
+        if imp and imp[0] == "symbol":
+            m2 = self.modules.get(imp[1])
+            if m2 and imp[2] in m2.classes:
+                return m2.classes[imp[2]]
+        cands = self.classes_by_name.get(name, [])
+        return cands[0] if len(cands) == 1 else None
+
+    def _class_lock(self, ci: ClassInfo, attr: str,
+                    seen=None) -> LockDef | None:
+        if seen is None:
+            seen = set()
+        if id(ci) in seen:
+            return None
+        seen.add(id(ci))
+        if attr in ci.attr_locks:
+            return ci.attr_locks[attr]
+        for b in ci.bases:
+            bc = self.find_class(b[-1], ci.module)
+            if bc is not None:
+                ld = self._class_lock(bc, attr, seen)
+                if ld is not None:
+                    return ld
+        return None
+
+    def _class_attr_type(self, ci: ClassInfo, attr: str) -> str | None:
+        if attr in ci.attr_types:
+            return ci.attr_types[attr]
+        for b in ci.bases:
+            bc = self.find_class(b[-1], ci.module)
+            if bc is not None:
+                t = self._class_attr_type(bc, attr)
+                if t:
+                    return t
+        return None
+
+    def resolve_lock_expr(self, fi: FunctionInfo, expr) -> str | None:
+        chain = name_chain(expr)
+        if not chain:
+            return None
+        mod = fi.module
+        if chain[0] in ("self", "cls") and fi.cls is not None:
+            if len(chain) == 2:
+                ld = self._class_lock(fi.cls, chain[1])
+                return ld.name if ld else None
+            if len(chain) == 3:
+                t = self._class_attr_type(fi.cls, chain[1])
+                if t:
+                    c2 = self.find_class(t, mod)
+                    if c2 is not None:
+                        ld = self._class_lock(c2, chain[2])
+                        if ld:
+                            return ld.name
+                return None
+            return None
+        if len(chain) == 1:
+            ld = mod.module_locks.get(chain[0])
+            return ld.name if ld else None
+        if len(chain) == 2:
+            ci = self.find_class(chain[0], mod)
+            if ci is not None:
+                ld = self._class_lock(ci, chain[1])
+                return ld.name if ld else None
+            imp = mod.imports.get(chain[0])
+            if imp and imp[0] == "module":
+                m2 = self.modules.get(imp[1])
+                if m2:
+                    ld = m2.module_locks.get(chain[1])
+                    return ld.name if ld else None
+        return None
+
+    def resolve_call(self, fi: FunctionInfo,
+                     spec: tuple) -> FunctionInfo | None:
+        kind = spec[0]
+        mod = fi.module
+        if kind == "nested":
+            return fi.nested.get(spec[1])
+        if kind == "name":
+            n = spec[1]
+            cur = fi
+            while cur is not None:
+                if n in cur.nested:
+                    return cur.nested[n]
+                cur = cur.parent
+            if n in mod.functions:
+                return mod.functions[n]
+            if n in mod.classes:
+                return mod.classes[n].methods.get("__init__")
+            imp = mod.imports.get(n)
+            if imp and imp[0] == "symbol":
+                m2 = self.modules.get(imp[1])
+                if m2:
+                    if imp[2] in m2.functions:
+                        return m2.functions[imp[2]]
+                    if imp[2] in m2.classes:
+                        return m2.classes[imp[2]].methods.get(
+                            "__init__")
+            return None
+        if kind == "self" and fi.cls is not None:
+            m = self._class_method(fi.cls, spec[1])
+            if m is not None:
+                return m
+            return self._unique_method(spec[1])
+        if kind == "selfattr" and fi.cls is not None:
+            t = self._class_attr_type(fi.cls, spec[1])
+            if t:
+                c2 = self.find_class(t, mod)
+                if c2 is not None:
+                    m = self._class_method(c2, spec[2])
+                    if m is not None:
+                        return m
+            return self._unique_method(spec[2])
+        if kind == "dotted":
+            base, meth = spec[1], spec[2]
+            ci = self.find_class(base, mod)
+            if ci is not None:
+                return self._class_method(ci, meth)
+            imp = mod.imports.get(base)
+            m2 = None
+            if imp and imp[0] == "module":
+                m2 = self.modules.get(imp[1])
+            elif imp and imp[0] == "symbol":
+                # `from . import x` / `from pkg import mod` where the
+                # symbol IS a submodule
+                m2 = self.modules.get(f"{imp[1]}.{imp[2]}")
+            if imp and m2 is not None:
+                if meth in m2.functions:
+                    return m2.functions[meth]
+                if meth in m2.classes:
+                    return m2.classes[meth].methods.get("__init__")
+            if imp and imp[0] == "module":
+                return None
+            return self._unique_method(meth)
+        if kind == "unique":
+            return self._unique_method(spec[1])
+        return None
+
+    def _class_method(self, ci: ClassInfo, name: str,
+                      seen=None) -> FunctionInfo | None:
+        if seen is None:
+            seen = set()
+        if id(ci) in seen:
+            return None
+        seen.add(id(ci))
+        if name in ci.methods:
+            return ci.methods[name]
+        for b in ci.bases:
+            bc = self.find_class(b[-1], ci.module)
+            if bc is not None:
+                m = self._class_method(bc, name, seen)
+                if m is not None:
+                    return m
+        return None
+
+    def _unique_method(self, name: str) -> FunctionInfo | None:
+        cands = self.methods_by_name.get(name, [])
+        return cands[0] if len(cands) == 1 else None
+
+    # -- iteration helpers ----------------------------------------------------
+
+    def all_functions(self):
+        for mod in self.modules.values():
+            stack = list(mod.functions.values())
+            for ci in mod.classes.values():
+                stack.extend(ci.methods.values())
+            while stack:
+                fi = stack.pop()
+                yield fi
+                stack.extend(fi.nested.values())

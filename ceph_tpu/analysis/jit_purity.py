@@ -1,0 +1,207 @@
+"""Jit-boundary purity lint (check family ``jit-purity``).
+
+Functions traced by ``jax.jit`` — and the batch closures handed to
+the dispatch engines — execute under tracing/retracing rules that make
+host side effects hazards:
+
+* ``time.*`` / ``random.*`` / ``np.random``: traced once, frozen into
+  the compiled executable — silently wrong on every cache hit;
+* ``conf.get``: a hot-reloadable option read mid-trace splits one
+  logical batch across two config states (the pow-2 bucketing
+  discipline assumes the batch is uniform);
+* logging (``dout``/``logger``/``print``): fires at trace time, not
+  call time, and on the dispatch thread stalls the pipeline;
+* mutating captured state (``self.x = ..``, ``global``/``nonlocal``
+  writes, subscript stores to captured names): tracer leaks and
+  retrace-order dependence.
+
+Targets: functions decorated with ``jax.jit`` (bare or via
+``functools.partial``), named functions passed to ``jax.jit(..)``
+calls, and functions/closures passed as the ``fn`` argument of the
+engines' ``submit``/``submit_chunks``/``submit_decode_chunks``.  The
+scan covers the target's own body and its locally nested defs — the
+host-side wrappers *around* a jit call (telemetry timing etc.) are
+exactly the code that SHOULD do host work, so the scan does not chase
+cross-module calls.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ceph_tpu.analysis import Finding
+from ceph_tpu.analysis.core import TreeIndex, name_chain
+
+_SUBMIT_METHODS = {"submit", "submit_chunks", "submit_decode_chunks",
+                   "submit_flat_firstn", "submit_do_rule"}
+
+
+def _is_jit_expr(node) -> bool:
+    """``jax.jit`` / ``jit`` / ``functools.partial(jax.jit, ..)``."""
+    chain = name_chain(node)
+    if chain and chain[-1] == "jit":
+        return True
+    if isinstance(node, ast.Call):
+        ch = name_chain(node.func)
+        if ch and ch[-1] == "partial" and node.args:
+            a0 = name_chain(node.args[0])
+            return bool(a0) and a0[-1] == "jit"
+    return False
+
+
+def _targets(index: TreeIndex):
+    """(FunctionInfo, why) for every jit-traced / engine-submitted
+    function we can resolve statically."""
+    out = []
+    seen = set()
+
+    def add(fn, why):
+        if fn is not None and fn not in seen:
+            seen.add(fn)
+            out.append((fn, why))
+
+    for fi in index.all_functions():
+        for dec in fi.decorators:
+            if _is_jit_expr(dec):
+                add(fi, "decorated with jax.jit")
+    for fi in index.all_functions():
+        for cs in fi.call_sites:
+            node = cs.node
+            if not isinstance(node, ast.Call):
+                continue
+            chain = name_chain(node.func)
+            if not chain:
+                continue
+            if chain[-1] == "jit" and node.args:
+                a0 = name_chain(node.args[0])
+                if a0 and len(a0) == 1:
+                    add(index.resolve_call(fi, ("name", a0[0])),
+                        "passed to jax.jit")
+            elif chain[-1] in _SUBMIT_METHODS:
+                # engine.submit(key, fn, data, ..): fn is arg 1 for
+                # submit, arg 0 shape varies for the helpers — resolve
+                # any bare-name argument that names a local function
+                for arg in node.args:
+                    a = name_chain(arg)
+                    if a and len(a) == 1:
+                        g = index.resolve_call(fi, ("name", a[0]))
+                        if g is not None and (g.parent is not None
+                                              or a[0] == "fn"):
+                            add(g, f"submitted to the dispatch engine "
+                                   f"via {chain[-1]}")
+    return out
+
+
+def _param_names(fn) -> set:
+    node = fn.node
+    names: set = set()
+    args = getattr(node, "args", None)
+    if args is not None:
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            names.add(a.arg)
+        if args.vararg:
+            names.add(args.vararg.arg)
+        if args.kwarg:
+            names.add(args.kwarg.arg)
+    return names
+
+
+def _bound_names(target, names: set) -> None:
+    """Names BOUND by an assignment target.  A Subscript/Attribute
+    store (``state["n"] = ..``) binds nothing — its base stays a
+    captured name, which is exactly what the mutation check flags."""
+    if isinstance(target, ast.Name):
+        names.add(target.id)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            _bound_names(e, names)
+    elif isinstance(target, ast.Starred):
+        _bound_names(target.value, names)
+
+
+def _local_names(fn) -> set:
+    """Locally-bound names (assignment/loop/with targets)."""
+    node = fn.node
+    names: set = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                _bound_names(t, names)
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            _bound_names(n.target, names)
+        elif isinstance(n, (ast.For, ast.comprehension)):
+            _bound_names(n.target, names)
+        elif isinstance(n, ast.withitem) and n.optional_vars:
+            _bound_names(n.optional_vars, names)
+    return names
+
+
+def _scan(fn, why, findings) -> None:
+    params = _param_names(fn)
+    local = _local_names(fn) - params
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Call):
+            chain = name_chain(node.func)
+            if not chain:
+                continue
+            dotted = ".".join(chain)
+            if chain[0] == "time" and len(chain) > 1:
+                _emit(findings, fn, node.lineno, "clock",
+                      f"{dotted}() reads the host clock", why)
+            elif (chain[0] in ("random",) or chain[:2] ==
+                  ("np", "random") or chain[:2] == ("numpy", "random")):
+                _emit(findings, fn, node.lineno, "random",
+                      f"{dotted}() draws host randomness", why)
+            elif len(chain) >= 2 and chain[-2] == "conf" and \
+                    chain[-1] == "get":
+                _emit(findings, fn, node.lineno, "conf",
+                      f"{dotted}() reads hot-reloadable config", why)
+            elif chain[-1] == "dout" or chain[0] in ("logging",) or \
+                    chain[0] == "print" or (len(chain) == 2 and
+                                            chain[0] == "logger"):
+                _emit(findings, fn, node.lineno, "logging",
+                      f"{dotted}() logs at trace time", why)
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            _emit(findings, fn, node.lineno, "mutation",
+                  f"{'global' if isinstance(node, ast.Global) else 'nonlocal'}"
+                  f" write to captured state", why)
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    base = t.value
+                    while isinstance(base, (ast.Attribute,
+                                            ast.Subscript)):
+                        base = base.value
+                    # stores into params (incl. self) or captured
+                    # names mutate state the trace cache can't see;
+                    # stores into locally-created containers are
+                    # trace-time scaffolding and fine
+                    if isinstance(base, ast.Name) and (
+                            base.id in params or base.id not in local):
+                        _emit(findings, fn, node.lineno, "mutation",
+                              f"store into captured object "
+                              f"{base.id!r}", why)
+
+
+def _emit(findings, fn, line, code, detail, why):
+    findings.append(Finding(
+        "jit-purity", fn.module.relpath, line, code,
+        f"{detail} inside {fn.qualname} ({why}) — retrace/correctness "
+        f"hazard in traced code"))
+
+
+def check(index: TreeIndex):
+    findings: list = []
+    for fn, why in _targets(index):
+        _scan(fn, why, findings)
+    # dedupe (a function can be both decorated and passed around)
+    out, seen = [], set()
+    for f in findings:
+        k = (f.path, f.line, f.code)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out

@@ -1,0 +1,259 @@
+"""Static lock-order analysis (check family ``lock-order``).
+
+From every function's acquisition events (``with``/``acquire()``
+sites, each annotated with the lock stack held there) and call sites,
+build the may-hold-A-while-taking-B graph:
+
+* ``with A: with B`` records A->B directly;
+* ``with A: f()`` records A->M for every lock M in f's transitive
+  *effective acquire* set (interprocedural fixpoint over the
+  best-effort call graph).
+
+The static graph is unioned with the runtime ``lockdep`` graph (a
+``lockdep.export_graph()`` snapshot, ``--runtime-graph``), then every
+strongly connected component with more than one lock is reported as a
+cycle, with one witness site per edge — the static witness spells out
+the hold-site -> call-chain -> acquire-site path.
+
+An edge is suppressed by ``# analysis: allow[lock-order] -- reason``
+on its hold/call site line (the reference's per-site
+``lockdep_will_lock`` escape hatch).
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.analysis import Finding
+from ceph_tpu.analysis.core import TreeIndex, normalize_name
+
+
+def _edge_suppressed(index: TreeIndex, mod, line: int) -> bool:
+    from ceph_tpu import analysis
+    return analysis._suppression(
+        index, mod.relpath, line, "lock-order") is not None
+
+
+def effective_acquires(index: TreeIndex):
+    """Fixpoint: fn -> set of lock names it may acquire transitively.
+    Also returns a cause map for witness reconstruction:
+    cause[(fn, lock)] = ("direct", relpath, line)
+                      | ("call", callee_fn, relpath, line)."""
+    funcs = sorted(index.all_functions(), key=lambda f: f.qualname)
+    eff: dict = {f: set() for f in funcs}
+    cause: dict = {}
+    for f in funcs:
+        for ev in f.acq_events:
+            if ev.lock not in eff[f]:
+                eff[f].add(ev.lock)
+                cause[(f, ev.lock)] = ("direct", f.module.relpath,
+                                       ev.line)
+    resolved: dict = {}
+    for f in funcs:
+        # "nested" call sites mark where a closure/lambda is DEFINED,
+        # not where it runs: it executes later, usually on another
+        # thread with an empty held stack, so neither its acquire set
+        # nor held-edges may flow through the definition site.  (Its
+        # own body still contributes its own events: all_functions()
+        # yields nested functions directly.  A local helper that IS
+        # called synchronously also has a normal ("name", ..) site.)
+        resolved[f] = [(index.resolve_call(f, cs.spec), cs)
+                       for cs in f.call_sites
+                       if cs.spec[0] != "nested"]
+    changed = True
+    while changed:
+        changed = False
+        for f in funcs:
+            for g, cs in resolved[f]:
+                if g is None or g not in eff:
+                    continue
+                for m in eff[g]:
+                    if m not in eff[f]:
+                        eff[f].add(m)
+                        cause[(f, m)] = ("call", g, f.module.relpath,
+                                         cs.line)
+                        changed = True
+    return eff, cause, resolved
+
+
+def _witness(cause, f, lock, limit: int = 6) -> str:
+    """hold-to-acquire chain for 'f eventually acquires lock'."""
+    hops = []
+    cur = f
+    while limit:
+        limit -= 1
+        c = cause.get((cur, lock))
+        if c is None:
+            break
+        if c[0] == "direct":
+            hops.append(f"{c[1]}:{c[2]} acquires")
+            break
+        hops.append(f"{c[2]}:{c[3]} calls {c[1].qualname}")
+        cur = c[1]
+    return " -> ".join(hops) if hops else "(unknown)"
+
+
+def build_graph(index: TreeIndex, runtime_graph: dict | None = None):
+    """-> {(a, b): site_str} over normalized lock names."""
+    eff, cause, resolved = effective_acquires(index)
+    edges: dict = {}
+
+    def add(a: str, b: str, site: str) -> None:
+        if a != b and (a, b) not in edges:
+            edges[(a, b)] = site
+
+    for f in sorted(eff, key=lambda x: x.qualname):
+        mod = f.module
+        for ev in f.acq_events:
+            if _edge_suppressed(index, mod, ev.line):
+                continue
+            for h in ev.held:
+                add(h, ev.lock,
+                    f"{mod.relpath}:{ev.line} in {f.qualname}")
+        for g, cs in resolved[f]:
+            if g is None or not cs.held:
+                continue
+            if _edge_suppressed(index, mod, cs.line):
+                continue
+            for m in eff.get(g, ()):
+                site = (f"{mod.relpath}:{cs.line} in {f.qualname} "
+                        f"calls {g.qualname}; "
+                        f"{_witness(cause, g, m)}")
+                for h in cs.held:
+                    add(h, m, site)
+    if runtime_graph:
+        for e in runtime_graph.get("edges", []):
+            a = normalize_name(str(e.get("a", "")))
+            b = normalize_name(str(e.get("b", "")))
+            if a and b:
+                site = str(e.get("site", "")).strip().splitlines()
+                add(a, b, "runtime: " + (site[0].strip() if site
+                                         else "(no site)"))
+    return edges
+
+
+def _sccs(nodes, succ):
+    """Tarjan, iterative; yields SCCs as lists."""
+    index_of: dict = {}
+    low: dict = {}
+    on_stack: set = set()
+    stack: list = []
+    counter = [0]
+    out = []
+    for root in sorted(nodes):
+        if root in index_of:
+            continue
+        work = [(root, iter(sorted(succ.get(root, ()))))]
+        index_of[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index_of:
+                    index_of[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(succ.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index_of[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index_of[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                out.append(comp)
+    return out
+
+
+def _shortest_cycle(comp, succ):
+    """BFS a minimal cycle inside one SCC; -> [n0, n1, ..., n0]."""
+    comp_set = set(comp)
+    best = None
+    for start in sorted(comp):
+        prev: dict = {}
+        queue, seen, found = [start], {start}, None
+        while queue and found is None:
+            nxt = []
+            for v in queue:
+                for w in sorted(succ.get(v, ())):
+                    if w == start:
+                        found = v
+                        break
+                    if w in comp_set and w not in seen:
+                        seen.add(w)
+                        prev[w] = v
+                        nxt.append(w)
+                if found is not None:
+                    break
+            queue = nxt
+        if found is not None:
+            path = [found]
+            while path[-1] != start:
+                path.append(prev[path[-1]])
+            path.reverse()
+            path.append(start)
+            if best is None or len(path) < len(best):
+                best = path
+            if len(best) == 3:      # A -> B -> A: minimal possible
+                break
+    return best
+
+
+def format_cycle(path, edges) -> str:
+    """Render a cycle with one witness per edge, both directions
+    included — the dual-witness message lockdep raises with."""
+    parts = []
+    for a, b in zip(path, path[1:]):
+        parts.append(f"{a} -> {b}  [{edges.get((a, b), '(no site)')}]")
+    return "lock-order cycle: " + "; ".join(parts)
+
+
+def check(index: TreeIndex, runtime_graph: dict | None = None):
+    edges = build_graph(index, runtime_graph)
+    succ: dict = {}
+    nodes: set = set()
+    for (a, b) in edges:
+        succ.setdefault(a, set()).add(b)
+        nodes.add(a)
+        nodes.add(b)
+    findings = []
+    for comp in _sccs(nodes, succ):
+        if len(comp) < 2:
+            continue
+        path = _shortest_cycle(comp, succ) or sorted(comp) + [
+            sorted(comp)[0]]
+        msg = format_cycle(path, edges)
+        # anchor at the first static witness so an inline suppression
+        # (or a fix) at that site owns the finding
+        anchor_path, anchor_line = "(runtime)", 0
+        for a, b in zip(path, path[1:]):
+            site = edges.get((a, b), "")
+            if site and not site.startswith("runtime:"):
+                loc = site.split(" ", 1)[0]
+                if ":" in loc:
+                    p, _, ln = loc.rpartition(":")
+                    if ln.isdigit():
+                        anchor_path, anchor_line = p, int(ln)
+                        break
+        # the node set rides the code so distinct cycles keep distinct
+        # baseline keys even when anchored at ("(runtime)", 0) —
+        # Finding.key() excludes the (witness-bearing, volatile)
+        # message, and node names are line-stable
+        code = "cycle:" + "+".join(sorted(set(path)))
+        findings.append(Finding("lock-order", anchor_path, anchor_line,
+                                code, msg))
+    findings.sort(key=lambda f: f.message)
+    return findings

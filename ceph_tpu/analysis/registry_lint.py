@@ -1,0 +1,99 @@
+"""Registry-consistency lints (check family ``registry``).
+
+Two sub-checks keyed by the codebase's two central registries:
+
+* ``conf-key`` — every string-literal ``*.conf.get("key")`` /
+  ``conf.get("key")`` / ``conf.set("key", ..)`` must name an option in
+  ``common/config.py``'s table (``OPTIONS`` plus every
+  ``register_options([Option(..)])`` call in the tree).  A typo'd key
+  raises ``KeyError`` at runtime — on whatever rarely-exercised path
+  reads it first.
+
+* ``perf-counter`` — every counter mutation (``.inc/.dec/.tinc/
+  .hinc(name)``, plus ``.set(name, v)`` on a ``perf``-named receiver)
+  must name a counter registered via some ``PerfCountersBuilder``
+  chain in the tree (an unregistered name raises ``KeyError`` inside
+  the counter lock at runtime).  Membership is checked against the
+  union of every declared set — object-precise matching is
+  undecidable here, and a union miss is always a real bug.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ceph_tpu.analysis import Finding
+from ceph_tpu.analysis.core import TreeIndex, name_chain
+
+_MUTATORS = {"inc", "dec", "tinc", "hinc"}
+
+
+def _option_names(index: TreeIndex) -> set:
+    names: set = set()
+    for mod in index.modules.values():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                ch = name_chain(node.func)
+                if ch and ch[-1] == "Option" and node.args and \
+                        isinstance(node.args[0], ast.Constant):
+                    names.add(node.args[0].value)
+    return names
+
+
+def _registered_counters(index: TreeIndex) -> set:
+    """Union of every counter name declared by a builder chain."""
+    union: set = set()
+    for mod in index.modules.values():
+        for node in ast.walk(mod.tree):
+            # builder chains hang Attribute off a Call
+            # (PerfCountersBuilder(..).add_u64("a").add_u64("b")), so
+            # match on the method attribute alone, not a name chain
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("add_u64", "add_time_avg",
+                                       "add_histogram") and \
+                    node.args and isinstance(node.args[0],
+                                             ast.Constant):
+                union.add(node.args[0].value)
+    return union
+
+
+def check(index: TreeIndex):
+    findings = []
+    options = _option_names(index)
+    counters = _registered_counters(index)
+    for relpath, mod in sorted(index.by_path.items()):
+        if mod.modname.endswith("common.config"):
+            continue     # the table itself (defaults, casts, errors)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = name_chain(node.func)
+            if not chain or len(chain) < 2:
+                continue
+            tail = chain[-1]
+            arg0 = node.args[0] if node.args else None
+            literal = arg0.value if isinstance(arg0, ast.Constant) \
+                and isinstance(getattr(arg0, "value", None), str) \
+                else None
+            if tail in ("get", "set") and chain[-2] == "conf":
+                if literal is not None and literal not in options:
+                    findings.append(Finding(
+                        "registry", relpath, node.lineno, "conf-key",
+                        f"conf.{tail}({literal!r}): key not in "
+                        f"common/config.py's option table "
+                        f"(KeyError at runtime)"))
+            elif literal is not None and (
+                    tail in _MUTATORS
+                    or (tail == "set" and "perf" in chain[:-1])):
+                # counter mutation — receiver must not be a conf
+                if chain[-2] == "conf":
+                    continue
+                if literal not in counters:
+                    findings.append(Finding(
+                        "registry", relpath, node.lineno,
+                        "perf-counter",
+                        f".{tail}({literal!r}): counter never "
+                        f"registered by any PerfCountersBuilder chain "
+                        f"(KeyError inside the counter lock)"))
+    return findings

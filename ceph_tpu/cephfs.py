@@ -73,6 +73,7 @@ class _CapState:
         self.inflight = 0
         #: serializes writebacks so two flushers can never reorder
         #: overlapping extents (older batch landing over a newer one)
+        # analysis: allow[bare-lock] -- client-writeback leaf lock; CephFS client hierarchy conversion deferred with its subsystem
         self.wb_lock = threading.Lock()
 
 
@@ -101,6 +102,7 @@ class CephFS(Dispatcher):
                 keyring=TicketKeyring(self.rados._fetch_ticket)))
         self.msgr.set_policy("mds", ConnectionPolicy.stateful_peer())
         self.msgr.add_dispatcher_tail(self)
+        # analysis: allow[bare-lock] -- client session RLock, held across FS ops by design; CephFS lockdep pass deferred
         self._lock = threading.RLock()
         self._next_tid = 1
         self._waiters: dict[int, tuple[threading.Event, list]] = {}
@@ -114,6 +116,7 @@ class CephFS(Dispatcher):
         #: revoke_grace — bounded, rare, and safe; a per-ino scope
         #: can't exclude the close because open learns the ino only
         #: from the reply
+        # analysis: allow[bare-lock] -- objectcacher leaf lock; CephFS lockdep pass deferred
         self._oc_lock = threading.Lock()
         self._next_fh = 1
         #: last known ino per opened path (open-timeout cancel guard)
@@ -138,6 +141,7 @@ class CephFS(Dispatcher):
         self._osd_epoch_barrier = 0
         #: signaled when an in-flight direct write drains (revoke acks
         #: for WR wait on it)
+        # analysis: allow[bare-lock] -- condition deliberately shares the client RLock above
         self._inflight_cv = threading.Condition(self._lock)
         #: multi-active routing: cached rank addrs, opened sessions,
         #: and last-known authoritative rank per path

@@ -145,6 +145,7 @@ class RadosClient(Dispatcher):
     """RadosClient + Objecter (librados/RadosClient.cc:229 connect)."""
 
     _next_client_id = 1
+    # analysis: allow[bare-lock] -- import-time class-level client-id allocator; leaf
     _id_lock = threading.Lock()
 
     def __init__(self, mon_addr: str, ctx: CephTpuContext | None = None,
@@ -177,6 +178,7 @@ class RadosClient(Dispatcher):
         self._warm_latest: OSDMap | None = None
         self._warm_thread: threading.Thread | None = None
         self._map_event = threading.Event()
+        # analysis: allow[bare-lock] -- client session RLock; client-local hierarchy, conversion deferred
         self._lock = threading.RLock()
         self._next_tid = 1
         self._waiters: dict[int, _Waiter] = {}

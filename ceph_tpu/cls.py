@@ -20,6 +20,7 @@ import json
 import threading
 
 _REGISTRY: dict[tuple[str, str], object] = {}
+# analysis: allow[bare-lock] -- import-time cls-method registry lock; leaf
 _LOCK = threading.Lock()
 
 

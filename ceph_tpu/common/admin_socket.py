@@ -16,6 +16,7 @@ import threading
 
 class AdminSocket:
     def __init__(self, path: str | None = None):
+        # analysis: allow[bare-lock] -- command-table leaf lock, held only around dict ops
         self._lock = threading.Lock()
         self._commands: dict[str, tuple] = {}
         self._path = path

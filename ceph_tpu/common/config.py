@@ -185,6 +185,7 @@ class Config:
 
     def __init__(self, options: dict[str, Option] | None = None):
         self._options = options if options is not None else OPTIONS
+        # analysis: allow[bare-lock] -- config underpins lockdep's own enable gate (g_lockdep reads conf) -- bare avoids a bootstrap cycle; leaf around layer dicts
         self._lock = threading.RLock()
         self._values: dict[str, dict[str, object]] = {}  # name -> src -> val
         self._observers: dict[str, list] = {}            # name -> callbacks

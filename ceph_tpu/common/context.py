@@ -63,11 +63,12 @@ class CephTpuContext:
         #: per context, like every other service hung off it.  The
         #: build is locked: two racing first callers splitting across
         #: two engines would break per-key submission-order delivery
-        import threading
+        from ceph_tpu.common import lockdep
         self._dispatch = None
         self._decode_dispatch = None
         self._mapping_service = None
-        self._dispatch_lock = threading.Lock()
+        self._dispatch_lock = lockdep.make_lock(
+            "CephTpuContext::dispatch_build")
         self.admin.register_command(
             "dump_dispatch_stats",
             lambda **kw: {"encode": telemetry.dispatch_dump(),

@@ -125,8 +125,48 @@ class DebugRLock:
     def __exit__(self, *exc) -> None:
         self.release()
 
+    # threading.Condition protocol: a Condition wrapping a DebugRLock
+    # calls these around wait().  They delegate straight to the inner
+    # RLock — the held-stack entry goes stale for the duration of the
+    # wait, which is harmless (the thread is blocked and acquires
+    # nothing until _acquire_restore returns), and re-acquiring after a
+    # wait is a continuation of the original hold, not a new edge.
+
+    def _is_owned(self):
+        return self._lock._is_owned()
+
+    def _release_save(self):
+        return self._lock._release_save()
+
+    def _acquire_restore(self, state) -> None:
+        self._lock._acquire_restore(state)
+
 
 def make_lock(name: str):
     """Factory the daemons use: plain RLock in production, DebugRLock
     under lockdep (Mutex(name) with g_lockdep)."""
     return DebugRLock(name) if _enabled else threading.RLock()
+
+
+def make_condition(name: str, lock=None) -> threading.Condition:
+    """Condition-variable factory (Cond + Mutex(name) in the
+    reference).  Under lockdep the condition's lock is a named
+    DebugRLock, so every `with cv:` records order edges like any other
+    mutex; wait() releases/re-acquires through the Condition protocol
+    above.  ``lock`` lets callers share one named lock between a mutex
+    and its condition."""
+    if lock is None:
+        lock = make_lock(name)
+    return threading.Condition(lock)
+
+
+def export_graph() -> dict:
+    """Snapshot the runtime order graph for offline union with the
+    static analyzer (`python -m ceph_tpu.analysis --runtime-graph`).
+    Shape: {"edges": [{"a": .., "b": .., "site": ..}, ...]} where a->b
+    means b was acquired while a was held."""
+    with _registry_lock:
+        return {"edges": [
+            {"a": a, "b": b, "site": _edge_sites.get((a, b), "")}
+            for a, follows in sorted(_follows.items())
+            for b in sorted(follows)]}

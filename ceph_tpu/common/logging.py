@@ -13,6 +13,7 @@ import logging
 import sys
 import threading
 
+# analysis: allow[bare-lock] -- import-time leaf lock on the dout hot path
 _lock = threading.Lock()
 _levels: dict[str, int] = {}
 _DEFAULT_LEVEL = 1

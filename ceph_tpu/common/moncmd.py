@@ -34,6 +34,7 @@ class MonCommander:
         self.msgr = msgr
         self.mon_addrs = mon_addrs
         self._osdmap_fn = osdmap_fn
+        # analysis: allow[bare-lock] -- mon command-table leaf lock
         self._lock = threading.Lock()
         self._tid = 0
         self._waiters: dict[int, queue.Queue] = {}

@@ -20,8 +20,9 @@ with OpTracker's sharded lock the same way).
 
 from __future__ import annotations
 
-import threading
 import time
+
+from ceph_tpu.common import lockdep
 
 
 class TrackedOp:
@@ -102,10 +103,10 @@ class OpTracker:
         self.history_size = history_size
         self.history_slow_size = history_slow_size
         self.history_slow_threshold = history_slow_threshold
-        # RLock: mark_event fires under the lock from _unregister-free
-        # paths, and duration (which takes the lock) is read inside
-        # _unregister's critical section
-        self._lock = threading.RLock()
+        # RLock semantics required: mark_event fires under the lock
+        # from _unregister-free paths, and duration (which takes the
+        # lock) is read inside _unregister's critical section
+        self._lock = lockdep.make_lock(f"OpTracker::lock({daemon})")
         self._inflight: dict[int, TrackedOp] = {}
         self._history: list[TrackedOp] = []       # recent completions
         self._slow_history: list[TrackedOp] = []  # slowest completions

@@ -18,6 +18,7 @@ HISTOGRAM = "histogram"
 class PerfCounters:
     def __init__(self, name: str):
         self.name = name
+        # analysis: allow[bare-lock] -- per-counter-set leaf lock on every hot-path inc(); never held across a call
         self._lock = threading.Lock()
         self._types: dict[str, str] = {}
         self._u64: dict[str, int] = {}
@@ -119,6 +120,7 @@ class PerfCountersCollection:
     """All counter sets of one context (perf_counters_collection_t)."""
 
     def __init__(self):
+        # analysis: allow[bare-lock] -- collection registry leaf lock
         self._lock = threading.Lock()
         self._sets: dict[str, PerfCounters] = {}
 

@@ -11,6 +11,7 @@ class Throttle:
         self.name = name
         self._max = max_amount
         self._current = 0
+        # analysis: allow[bare-lock] -- bounded byte-throttle condition; waiters hold no other lock (messenger deliver waits before taking any)
         self._cond = threading.Condition()
 
     @property

@@ -41,8 +41,12 @@ import time
 from collections import OrderedDict
 from contextlib import contextmanager
 
+from ceph_tpu.common import lockdep
+
 _tls = threading.local()
-_lock = threading.Lock()
+# import-time module lock: named under CEPH_TPU_LOCKDEP=1 (the env
+# gate is read before any module imports), plain otherwise
+_lock = lockdep.make_lock("tracing::registry")
 
 #: active/recent traces kept for stitching (FIFO eviction; slow traces
 #: survive in the dedicated ring below)

@@ -47,6 +47,7 @@ class LzmaCompressor(Compressor):
         return lzma.decompress(data)
 
 
+# analysis: allow[bare-lock] -- import-time plugin registry lock; leaf
 _LOCK = threading.Lock()
 _FACTORIES = {
     "none": Compressor,

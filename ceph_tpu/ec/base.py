@@ -13,11 +13,11 @@ the numpy oracle available for verification (profile runtime=cpu).
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 
 import numpy as np
 
+from ceph_tpu.common import lockdep
 from ceph_tpu.gf.matrix import recovery_matrix
 from ceph_tpu.ops.dispatch import bucket_stripes
 from ceph_tpu.ops.gf_kernel import ec_encode_ref
@@ -77,7 +77,7 @@ class ErasureCode(ErasureCodeInterface):
         self._decode_cache: OrderedDict = OrderedDict()
         #: guards _decode_cache AND the pattern tables: decodes now
         #: submit from many OSD threads through the dispatch engine
-        self._decode_lock = threading.Lock()
+        self._decode_lock = lockdep.make_lock("ErasureCode::decode")
         #: t_bucket -> {"gen": generation counter,
         #:              "ids": {(chosen, targets): idx},
         #:              "mats": [(t_bucket, k) uint8 padded matrices],
@@ -218,6 +218,7 @@ class ErasureCode(ErasureCodeInterface):
         width coalesce on the stripe axis into one device call; the
         engine's zero-stripe padding is bit-exact here because the code
         is linear (zeros encode to zeros)."""
+        # analysis: allow[blocking] -- chunk input is host bytes/numpy by API contract
         data = np.asarray(data_chunks, dtype=np.uint8)
         key = ("ec_encode", id(self), self.k, self.m, data.shape[-1],
                self.runtime)
@@ -452,6 +453,7 @@ class ErasureCode(ErasureCodeInterface):
             if exc is not None:
                 outer._deliver(None, exc)
             else:
+                # analysis: allow[blocking] -- delivered value is already host numpy (completion thread materialized it)
                 outer._deliver(np.asarray(f.result())[:, :t, :], None)
 
         inner.add_done_callback(_slice)

@@ -32,9 +32,11 @@ class ErasureCodePluginRegistry:
     """Singleton name -> plugin map (ErasureCodePlugin.h:45-79)."""
 
     _instance: "ErasureCodePluginRegistry | None" = None
+    # analysis: allow[bare-lock] -- plugin registry singleton guard; startup only
     _instance_lock = threading.Lock()
 
     def __init__(self):
+        # analysis: allow[bare-lock] -- plugin instance-cache leaf lock
         self._lock = threading.Lock()
         self._plugins: dict[str, ErasureCodePlugin] = {}
         self.disable_dlclose = True  # vestigial reference knob, kept for parity

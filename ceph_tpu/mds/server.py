@@ -314,6 +314,7 @@ class MDSDaemon(Dispatcher):
         #: client ops park while old clients reassert (MDS rejoin)
         self._reconnect_until = 0.0
         self._beacon_timer: threading.Timer | None = None
+        # analysis: allow[bare-lock] -- MDS daemon RLock; MDS hierarchy conversion deferred with its subsystem
         self._lock = threading.RLock()
         #: ino -> Inode (inode cache; authoritative once loaded)
         self._inodes: dict[int, Inode] = {}

@@ -179,6 +179,7 @@ class MgrDaemon(Dispatcher):
         self.mgr_id = mgr_id
         self.name = EntityName("mgr", mgr_id)
         self.osdmap = OSDMap()
+        # analysis: allow[bare-lock] -- mgr report-buffer leaf lock
         self._lock = threading.Lock()
         #: osd -> (last report time, MMgrReport)
         self.reports: dict[int, tuple[float, MMgrReport]] = {}

@@ -139,6 +139,7 @@ class ModuleHost:
         #: MGR_MODULE_ERROR health check (the reference marks such
         #: modules failed in health the same way)
         self.failed: dict[str, str] = {}
+        # analysis: allow[bare-lock] -- module-host RLock, mgr-local; held across module callbacks by design
         self._lock = threading.RLock()
 
     # -- registry -------------------------------------------------------------

@@ -37,6 +37,8 @@ import threading
 import time
 import zlib
 
+from ceph_tpu.common import lockdep
+
 from .message import Message
 from .messenger import Connection, ConnectionPolicy, EntityName, Messenger
 
@@ -222,7 +224,7 @@ class TcpConnection(Connection):
         self._sock = sock
         self._sendq: queue.Queue = queue.Queue()
         self._down = False
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("TcpConnection::lock")
         self._writer = threading.Thread(target=self._write_loop, daemon=True)
         self._writer.start()
         if sock is not None:

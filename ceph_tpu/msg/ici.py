@@ -40,6 +40,8 @@ import time
 
 import numpy as np
 
+from ceph_tpu.common import lockdep
+
 from .loopback import LoopbackConnection, LoopbackMessenger
 from .message import Message
 from .messenger import EntityName
@@ -63,7 +65,7 @@ class IciTransport:
     transport loss and the op-level retry repairs it."""
 
     _instance = None
-    _lock = threading.Lock()
+    _lock = lockdep.make_lock("IciTransport::instance")
 
     #: seconds an unredeemed staged buffer survives (message lost)
     TTL = 30.0
@@ -76,7 +78,7 @@ class IciTransport:
         self.devices = jax.devices()
         self._bufs: dict[int, dict] = {}
         self._seq = 0
-        self._reg_lock = threading.Lock()
+        self._reg_lock = lockdep.make_lock("IciTransport::registry")
         self.bytes_staged = 0      # cumulative
         self.transfers = 0         # cumulative
         #: cross-process pull endpoint (enable_wire)
@@ -138,7 +140,7 @@ class IciTransport:
 
     # -- cross-process pull endpoint (RDMAStack analog) -----------------------
 
-    _wire_lock = threading.Lock()
+    _wire_lock = lockdep.make_lock("IciTransport::wire")
 
     def _start_server(self):
         """Bind a fresh transfer server (factored so tests and the

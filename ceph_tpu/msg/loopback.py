@@ -9,11 +9,15 @@ from __future__ import annotations
 import queue
 import threading
 
+from ceph_tpu.common import lockdep
+
 from .message import Message
 from .messenger import Connection, EntityName, Messenger
 
 _registry: dict[str, "LoopbackMessenger"] = {}
-_registry_lock = threading.Lock()
+# import-time module lock: named under CEPH_TPU_LOCKDEP=1, plain
+# otherwise (created before tests can call lockdep.enable())
+_registry_lock = lockdep.make_lock("loopback::registry")
 
 
 class LoopbackConnection(Connection):

@@ -10,9 +10,9 @@ peers, byte throttles.
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass, field
 
+from ceph_tpu.common import lockdep
 from ceph_tpu.common.throttle import Throttle
 
 from .message import Message
@@ -120,7 +120,7 @@ class Messenger:
         #: what this endpoint advertises; tests shrink it to simulate
         #: an old peer
         self.local_features: int = SUPPORTED_FEATURES
-        self._lock = threading.RLock()
+        self._lock = lockdep.make_lock(f"Messenger::lock({name})")
         # per-messenger wire counters (AsyncMessenger's l_msgr_* set);
         # daemons register this into their context's collection
         from ceph_tpu.common.perf_counters import PerfCountersBuilder

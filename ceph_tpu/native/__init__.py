@@ -22,6 +22,7 @@ import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "baseline.c")
+# analysis: allow[bare-lock] -- import-time ctypes loader guard; leaf
 _LOCK = threading.Lock()
 _LIB: ctypes.CDLL | None = None
 

@@ -58,6 +58,7 @@ class BitmapAllocator:
     def __init__(self):
         self._free: set[int] = set()
         self._next = 0
+        # analysis: allow[bare-lock] -- allocator free-set leaf lock (BlueStore::lock itself is named)
         self._lock = threading.Lock()
 
     def allocate(self, n_blocks: int) -> list[int]:

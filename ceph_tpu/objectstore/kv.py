@@ -65,6 +65,7 @@ class KeyValueDB:
 class MemDB(KeyValueDB):
     def __init__(self):
         self._data: dict[tuple[str, str], bytes] = {}
+        # analysis: allow[bare-lock] -- MemDB map leaf lock
         self._lock = threading.Lock()
 
     def submit_transaction(self, t: KVTransaction) -> None:

@@ -50,6 +50,7 @@ from collections import deque
 
 import numpy as np
 
+from ceph_tpu.common import lockdep
 from ceph_tpu.ops import telemetry
 
 
@@ -69,7 +70,7 @@ class DispatchFuture:
         self._value = None
         self._exc: BaseException | None = None
         self._cbs: list = []
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("DispatchFuture::lock")
 
     def done(self) -> bool:
         return self._ev.is_set()
@@ -172,7 +173,8 @@ class DeviceDispatchEngine:
         self.name = name
         self.stats = stats if stats is not None \
             else telemetry.dispatch_stats()
-        self._cv = threading.Condition()
+        self._cv = lockdep.make_condition(
+            f"DeviceDispatchEngine::cv({name})")
         self._pending: deque[_Request] = deque()
         #: per-key pending stripe totals, maintained incrementally so
         #: the flush-policy checks never rescan the queue
@@ -230,9 +232,11 @@ class DeviceDispatchEngine:
         as aux so requests with DIFFERENT recovery matrices still share
         one device call.  All requests under one key must agree on aux
         arity and trailing shapes (encode that in the key)."""
+        # analysis: allow[blocking] -- caller-input normalization: submit() receives host arrays (numpy/bytes), not device values
         data = np.asarray(data)
         stripes = int(data.shape[0]) if data.ndim else 1
         if aux is not None:
+            # analysis: allow[blocking] -- aux side arrays are host numpy by contract
             aux = tuple(np.asarray(a) for a in aux)
             for a in aux:
                 if not a.ndim or a.shape[0] != stripes:
@@ -277,6 +281,7 @@ class DeviceDispatchEngine:
     def _run_inline(fn, data, aux=None):
         try:
             out = fn(data) if aux is None else fn(data, *aux)
+            # analysis: allow[blocking] -- stopped-engine inline fallback materializes deliberately (no pipeline left to stall)
             return np.asarray(out), None
         except BaseException as e:     # noqa: BLE001 — delivered to waiter
             return None, e

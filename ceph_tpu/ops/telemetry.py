@@ -38,8 +38,9 @@ prometheus module (histogram families per kernel), and ``summary()``
 
 from __future__ import annotations
 
-import threading
 import time
+
+from ceph_tpu.common import lockdep
 
 #: latency bucket upper bounds, seconds (log-spaced: 10 us .. 1 s; the
 #: remote-dispatch tunnel's ~0.9 ms step latency lands mid-range)
@@ -120,7 +121,7 @@ class KernelStats:
         self.latency = Histogram(LATENCY_BOUNDS)
         self.batch = Histogram(BATCH_BOUNDS)
         self._signatures: set = set()
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock(f"KernelStats::lock({name})")
 
     def record(self, seconds: float, *, batch: int = 0, bytes_in: int = 0,
                bytes_out: int = 0, misses: int = 0) -> None:
@@ -177,7 +178,7 @@ class DispatchStats:
                  "flush_reasons", "in_flight", "max_in_flight_seen")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("DispatchStats::lock")
         self.submits = 0          # requests submitted
         self.stripes_in = 0       # stripes submitted
         self.batches = 0          # device calls dispatched
@@ -344,7 +345,7 @@ class MappingStats:
                  "changed_pgs", "cached_pgs", "cached_pools")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("MappingStats::lock")
         self.epoch_updates = 0     # epochs actually computed
         self.epoch_skips = 0       # queued epochs never computed
         self.pools_recomputed = 0  # pool tables rebuilt on device
@@ -434,7 +435,7 @@ class KernelTelemetry:
     """The registry: one KernelStats per kernel name."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("KernelTelemetry::lock")
         self._kernels: dict[str, KernelStats] = {}
         self.dispatch = DispatchStats()
         self.decode_dispatch = DecodeDispatchStats()

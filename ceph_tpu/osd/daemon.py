@@ -2670,6 +2670,7 @@ class OSDDaemon(Dispatcher):
     def _ec_shard_columns(si, stripes, parity, n: int) -> dict[int, bytes]:
         """Stack data+parity stripes, (S, n, su), and cut the per-shard
         columns the transactions and replica fan-out carry."""
+        # analysis: allow[blocking] -- parity is the engine-delivered host array (completion thread materialized it)
         full = np.concatenate([stripes, np.asarray(parity)], axis=1)
         return {s: si.shard_column(full, s).tobytes() for s in range(n)}
 
@@ -3608,6 +3609,7 @@ class OSDDaemon(Dispatcher):
                 state["k"] = len(state["shards"]) + 1
             self._ec_gather(reqid, state)
             return
+        # analysis: allow[blocking] -- fut already delivered: engine futures carry host numpy
         rec = np.asarray(fut.result())
         for idx, d in enumerate(targets):
             stripes[:, d, :] = rec[:, idx, :]
@@ -3700,6 +3702,7 @@ class OSDDaemon(Dispatcher):
         chosen, arr, targets, stripes = self._ec_gathered_stripes(
             si, k, shards, size)
         if targets:
+            # analysis: allow[blocking] -- synchronous scalar fallback path: decode_chunks returns host numpy
             rec = np.asarray(codec.decode_chunks(chosen, arr, targets))
             for idx, d in enumerate(targets):
                 stripes[:, d, :] = rec[:, idx, :]

@@ -50,6 +50,7 @@ from collections import deque
 
 import numpy as np
 
+from ceph_tpu.common import lockdep
 from ceph_tpu.crush.types import CRUSH_ITEM_NONE, CrushMap
 from ceph_tpu.ops import telemetry
 
@@ -416,7 +417,7 @@ class SharedPGMappingService:
     DELTA_LOG = 64
 
     def __init__(self, ctx=None, backend: str | None = None):
-        self._cv = threading.Condition()
+        self._cv = lockdep.make_condition("SharedPGMappingService::cv")
         self._ctx = ctx
         #: explicit backend override (tests / engine-less tools);
         #: None = follow the context's crush_backend option

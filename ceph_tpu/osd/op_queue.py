@@ -199,6 +199,7 @@ class ShardedOpQueue:
         self._threads: list[threading.Thread] = []
         for s in range(self._n):
             q = MClockQueue(classes, client_template=client_template)
+            # analysis: allow[bare-lock] -- per-shard parking condition: waiters hold no other lock; one node per shard would still merge by name
             cv = threading.Condition()
             self._shards.append((q, cv))
             for w in range(max(1, n_workers_per_shard)):

@@ -29,6 +29,7 @@ class AsyncReserver:
     def __init__(self, max_allowed: int = 1, name: str = ""):
         self.name = name
         self._max = max(1, int(max_allowed))
+        # analysis: allow[bare-lock] -- reservation-table leaf lock
         self._lock = threading.Lock()
         self._granted: set = set()
         #: heap of (-prio, seq, key); callbacks kept aside so a cancel

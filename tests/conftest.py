@@ -30,3 +30,41 @@ if _plat is None:
 jax.config.update("jax_platforms", _plat)
 
 import ceph_tpu  # noqa: E402,F401  (enables x64 before tests create arrays)
+
+import pytest  # noqa: E402
+
+from ceph_tpu.common import lockdep  # noqa: E402
+
+_LOCKDEP_ENV = os.environ.get("CEPH_TPU_LOCKDEP", "") not in ("", "0")
+#: modules that ALWAYS run under runtime lockdep, even in a plain
+#: tier-1 run: the async hot paths this repo's lock discipline exists
+#: for.  Their engines/trackers/messengers are constructed per-test,
+#: so make_lock hands them DebugRLocks while the fixture is active.
+_LOCKDEP_MODULES = {"test_dispatch", "test_decode_dispatch",
+                    "test_mapping_service"}
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_guard(request):
+    """Under CEPH_TPU_LOCKDEP=1 (every test) or for the dispatch/
+    decode/mapping modules (always): enable lockdep, reset the order
+    graph between tests, and assert no violations at teardown — daemon
+    threads swallow the LockOrderError raise, so the violations list
+    is the reliable signal (lockdep.py's CI contract)."""
+    mod = getattr(request, "module", None)
+    modname = mod.__name__.rsplit(".", 1)[-1] if mod else ""
+    if not (_LOCKDEP_ENV or modname in _LOCKDEP_MODULES):
+        yield
+        return
+    lockdep.reset()
+    was = lockdep.enabled()
+    lockdep.enable(True)
+    try:
+        yield
+        assert not lockdep.violations, (
+            "lock-order violations recorded during this test (the "
+            "raise may have died on a daemon thread):\n\n"
+            + "\n\n".join(lockdep.violations))
+    finally:
+        lockdep.enable(was or _LOCKDEP_ENV)
+        lockdep.reset()

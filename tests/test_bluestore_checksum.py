@@ -16,8 +16,18 @@ from ceph_tpu.tools.vstart import MiniCluster
 
 
 def _corrupt_block(store, cid: str, oid: str, flip_at: int = 100) -> None:
-    """Flip one byte inside the object's first block on disk."""
+    """Flip one byte inside the object's first block on disk.
+
+    The client ack can beat a replica's transaction apply landing in
+    the on-disk meta under full-suite load, so poll briefly for the
+    object to appear before corrupting it."""
+    import time as _time
+    deadline = _time.time() + 10.0
     meta = store._meta(cid, oid)
+    while meta is None and _time.time() < deadline:
+        _time.sleep(0.05)
+        meta = store._meta(cid, oid)
+    assert meta is not None, f"{cid}/{oid} never materialized in store"
     block = next(b for b in meta["extents"] if b >= 0)
     pos = block * 4096 + flip_at
     with open(store._block_path, "r+b") as f:

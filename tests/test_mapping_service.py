@@ -438,7 +438,16 @@ def test_epoch_burst_e2e_skip_and_peering():
                 return          # partitioned: map pushes are lost
             orig_handle(msg)
 
-        victim._handle_map = flaky_handle
+        drops = {"n": 0}
+
+        def flaky_counting(msg, _orig=flaky_handle):
+            if dropping["on"]:
+                drops["n"] += 1
+            _orig(msg)
+
+        # install the interceptor BEFORE reading e0: a push landing in
+        # between would advance the epoch past the frozen baseline
+        victim._handle_map = flaky_counting
         e0 = victim.osdmap.epoch
         for i, w in enumerate(("0.9", "0.8", "0.7", "0.6")):
             res, _ = client.mon_command(
@@ -447,6 +456,19 @@ def test_epoch_burst_e2e_skip_and_peering():
             assert res == 0
         target = c.mon.osdmap.epoch
         assert target - e0 >= 4
+        # drain the in-flight pushes INTO the partition before healing:
+        # a push sent during the outage but delivered after the heal
+        # would advance the victim piecemeal and shrink the one-jump
+        # skip count this test is about (wait for the drop counter to
+        # go quiet, not a fixed sleep — lockdep runs are slower)
+        quiet = time.time() + 0.5
+        deadline = time.time() + 10
+        while time.time() < deadline and time.time() < quiet:
+            n = drops["n"]
+            time.sleep(0.1)
+            if drops["n"] != n:
+                quiet = time.time() + 0.5
+        assert victim.osdmap.epoch == e0
         st = telemetry.mapping_stats()
         before = st.dump()
         # heal the partition; the renewal carries our stale epoch and

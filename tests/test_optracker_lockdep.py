@@ -111,6 +111,46 @@ class TestLockdep:
                 with a:
                     pass
 
+    def test_condition_wait_records_order_and_wakes(self):
+        """make_condition wraps a DebugRLock: `with cv:` records order
+        edges like any mutex, and wait/notify work through the
+        Condition protocol delegation (_is_owned/_release_save/
+        _acquire_restore)."""
+        from ceph_tpu.common.lockdep import make_condition, make_lock
+        cv = make_condition("CV::test")
+        outer = make_lock("Outer::test")
+        state = {"go": False}
+
+        def waker():
+            time.sleep(0.05)
+            with cv:
+                state["go"] = True
+                cv.notify_all()
+
+        t = threading.Thread(target=waker)
+        t.start()
+        with outer:                  # edge Outer::test -> CV::test
+            with cv:
+                assert cv.wait_for(lambda: state["go"], timeout=5.0)
+        t.join()
+        # the reverse order is now a violation
+        with pytest.raises(LockOrderError):
+            with cv:
+                with outer:
+                    pass
+
+    def test_export_graph_edges(self):
+        from ceph_tpu.common import lockdep
+        a, b = DebugRLock("exp_a"), DebugRLock("exp_b")
+        with a:
+            with b:
+                pass
+        g = lockdep.export_graph()
+        assert {"a": "exp_a", "b": "exp_b"} == {
+            k: v for k, v in next(
+                e for e in g["edges"]
+                if e["a"] == "exp_a").items() if k != "site"}
+
     def test_threads_have_independent_held_stacks(self):
         a, b = DebugRLock("t1"), DebugRLock("t2")
         errs = []
