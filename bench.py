@@ -116,7 +116,7 @@ def chained_seconds_per_step(step_fn, carry, n_lo: int = 8, n_hi: int = 48,
 
 def _closed_loop_sweep(levels, total_ops: int, stats, make_submit,
                        name: str, op_bytes: int, actor_key: str,
-                       snapshot=None, extra_row=None) -> dict:
+                       snapshot=None, extra_row=None, mesh=None) -> dict:
     """Shared closed-loop concurrency harness for the dispatch sweeps
     (encode-side dispatch_sweep and decode-side recovery_sweep evolve
     in lockstep): per level, N barrier-started actors each keep ONE op
@@ -133,7 +133,8 @@ def _closed_loop_sweep(levels, total_ops: int, stats, make_submit,
     out = {}
     for conc in levels:
         ops_per_actor = max(3, total_ops // conc)
-        eng = DeviceDispatchEngine(name=f"{name}-c{conc}", stats=stats)
+        eng = DeviceDispatchEngine(name=f"{name}-c{conc}", stats=stats,
+                                   mesh=mesh)
         submit = make_submit(eng)
         lats: list[float] = []
         lat_lock = threading.Lock()
@@ -185,7 +186,7 @@ def _closed_loop_sweep(levels, total_ops: int, stats, make_submit,
 
 def dispatch_sweep(encode, k: int, chunk: int,
                    levels=(1, 4, 16, 64), op_stripes: int = 32,
-                   total_ops: int = 96) -> dict:
+                   total_ops: int = 96, coding=None) -> dict:
     """Offered-concurrency sweep through the cross-op coalescing
     engine (ops.dispatch): N closed-loop writers each submit one
     op-sized encode at a time and wait for its parity, exactly the OSD
@@ -195,7 +196,14 @@ def dispatch_sweep(encode, k: int, chunk: int,
     while device calls per op falls".  All levels feed the global
     DispatchStats sink, so the process-wide `dispatch` digest in the
     JSON covers the whole sweep; per-level factors difference the
-    scalar counters around each level."""
+    scalar counters around each level.
+
+    Mesh column: the per-level rows above run single-device engines
+    (the ``kernel_mesh_devices=1`` number); with ``coding`` and a
+    multi-device backend, ONE extra run at the top writer level uses a
+    MESH-sharded engine (batch fans out across every local device) and
+    lands in ``mesh_devices`` / ``encode_mbps_mesh`` /
+    ``mesh_sharded_flushes``."""
     from ceph_tpu.ops import telemetry
 
     rng = np.random.default_rng(7)
@@ -205,9 +213,29 @@ def dispatch_sweep(encode, k: int, chunk: int,
     def make_submit(eng):
         return lambda _aid, _i: eng.submit(key, encode, op)
 
-    return _closed_loop_sweep(levels, total_ops,
-                              telemetry.dispatch_stats(), make_submit,
-                              "bench", op.nbytes, "writers")
+    out = _closed_loop_sweep(levels, total_ops,
+                             telemetry.dispatch_stats(), make_submit,
+                             "bench", op.nbytes, "writers")
+    import jax
+    n_dev = len(jax.devices())
+    out["mesh_devices"] = n_dev
+    if coding is not None and n_dev > 1:
+        from ceph_tpu.ops.gf_kernel import make_encoder
+        from ceph_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(n_dev)
+        mesh_encode = make_encoder(coding, mesh=mesh)
+        mesh_stats = telemetry.DispatchStats()   # private sink: the
+        # global digest stays the single-device sweep's story
+        conc = max(levels)
+        row = _closed_loop_sweep(
+            (conc,), total_ops, mesh_stats,
+            lambda eng: (lambda _aid, _i: eng.submit(
+                key, mesh_encode, op)),
+            "bench-mesh", op.nbytes, "writers", mesh=mesh)[str(conc)]
+        out["encode_mbps_mesh"] = row["mbps"]
+        out["mesh_sharded_flushes"] = mesh_stats.sharded_flushes
+        out["mesh_mean_devices"] = mesh_stats.summary()["mean_devices"]
+    return out
 
 
 def recovery_sweep(k: int, m: int, chunk: int, levels=(1, 4, 16),
@@ -272,7 +300,18 @@ def map_churn(pools: int = 6, pg_num: int = 256, hosts: int = 16,
     mapping service (incremental pool recompute + on-device diff +
     O(changed) reads).  Every epoch's shared-cache reads are verified
     bit-identical to the scalar oracle across ALL PGs — the timing rows
-    only count the work each consumption strategy actually does."""
+    only count the work each consumption strategy actually does.
+
+    Mesh column: a THIRD consumption strategy rides a context-backed
+    service whose pool remaps submit through the (mesh-sharded when the
+    backend is multi-device) dispatch engine and whose on-device epoch
+    diff shards over the kernel mesh — ``shared_epoch_s_mesh`` /
+    ``mesh_devices``; ``mesh_devices`` 1 means one device and the row
+    measures the engine path alone.  The mesh pass runs as a SEPARATE
+    replay over the same recorded epoch sequence, after the ``mapping``
+    digest is captured, so both the plain ``shared_epoch_s`` row and
+    the digest stay comparable with the historical JSON."""
+    from ceph_tpu.common.context import CephTpuContext
     from ceph_tpu.crush import build_two_level_map
     from ceph_tpu.osd import OSDMap, PGPool, SharedPGMappingService
 
@@ -285,6 +324,7 @@ def map_churn(pools: int = 6, pg_num: int = 256, hosts: int = 16,
     for p in range(1, pools + 1):
         m.pools[p] = PGPool(pool_id=p, size=3, crush_rule=rule,
                             pg_num=pg_num)
+    base = m
     svc = SharedPGMappingService()
     svc.update_to(m)    # epoch 0->2: full build (+ kernel compile)
     rng = np.random.default_rng(5)
@@ -292,6 +332,7 @@ def map_churn(pools: int = 6, pg_num: int = 256, hosts: int = 16,
     t_scalar: list[float] = []
     changed_counts: list[int] = []
     verified = True
+    epoch_log: list[tuple[int, object, dict]] = []  # (from, map, oracle)
     for i in range(epochs):
         new = m.copy()
         new.epoch = m.epoch + 1
@@ -335,10 +376,12 @@ def map_churn(pools: int = 6, pg_num: int = 256, hosts: int = 16,
         for (pid, pg), want in oracle.items():
             if svc.lookup(new, pid, pg) != want:
                 verified = False
+        epoch_log.append((m.epoch, new, oracle))
         m = new
     from ceph_tpu.ops import telemetry
-    med = (lambda xs: sorted(xs)[len(xs) // 2])
-    sh, sc = med(t_shared), med(t_scalar)
+    # capture the digest BEFORE the mesh replay: it then describes
+    # exactly the engine-less service's work, byte-comparable with
+    # pre-mesh runs (the global mapping stats sink is shared)
     digest = telemetry.mapping_summary()
     # the bit-verify gate above reads EVERY pg per epoch through the
     # same global stats — those lookup counters describe the gate, not
@@ -346,13 +389,55 @@ def map_churn(pools: int = 6, pg_num: int = 256, hosts: int = 16,
     digest.pop("lookups", None)
     digest.pop("lookup_fallbacks", None)
     digest["timed_reads"] = int(sum(changed_counts))
+    # mesh/engine-backed replay of the SAME epoch sequence.  The
+    # min-pgs floor would route this workload's pool sizes to the
+    # scalar rebuild path (engine never touched — the column would
+    # measure nothing); zero it so recomputed pools really submit
+    # through the (mesh-sharded when multi-device) dispatch engine.
+    mesh_ctx = CephTpuContext("bench-map-mesh")
+    mesh_ctx.conf.set("osdmap_mapping_min_pgs", 0)
+    svc_mesh = SharedPGMappingService(mesh_ctx)
+    svc_mesh.update_to(base)
+    t_mesh: list[float] = []
+    for frm, new, oracle in epoch_log:
+        t0 = time.perf_counter()
+        upd_m = svc_mesh.update_to(new, from_epoch=frm)
+        reads_m = (upd_m.changed if not upd_m.full
+                   else [(pid, pg) for pid, pool in new.pools.items()
+                         for pg in range(pool.pg_num)])
+        for pid, pg in reads_m:
+            svc_mesh.lookup(new, pid, pg)
+        t_mesh.append(time.perf_counter() - t0)
+        for (pid, pg), want in oracle.items():
+            if svc_mesh.lookup(new, pid, pg) != want:
+                verified = False
+    # mesh_devices is EVIDENCE, not aspiration: read the placement the
+    # replay's engine actually used (1 = the engine path ran without a
+    # mesh — single-device backend or mesh build failure)
+    mesh_devices = 1
+    if mesh_ctx._dispatch is not None:
+        pm = mesh_ctx._dispatch.placement_mesh()
+        if pm is not None:
+            mesh_devices = int(pm.size)
+    # the mesh context's engines were lazily built for this section
+    # only: drain and stop their threads instead of leaking them for
+    # the rest of the bench process
+    for eng in (mesh_ctx._dispatch, mesh_ctx._decode_dispatch):
+        if eng is not None:
+            eng.stop()
+    med = (lambda xs: sorted(xs)[len(xs) // 2])
+    sh, sc = med(t_shared), med(t_scalar)
+    shm = med(t_mesh)
     return {
         "pgs": pools * pg_num,
         "osds": n,
         "epochs": epochs,
         "scalar_epoch_s": round(sc, 4),
         "shared_epoch_s": round(sh, 4),
+        "shared_epoch_s_mesh": round(shm, 4),
+        "mesh_devices": mesh_devices,
         "speedup": round(sc / sh, 1) if sh > 0 else 0.0,
+        "speedup_mesh": round(sc / shm, 1) if shm > 0 else 0.0,
         "scalar_epochs_per_s": round(1.0 / sc, 2) if sc > 0 else 0.0,
         "shared_epochs_per_s": round(1.0 / sh, 2) if sh > 0 else 0.0,
         "mean_changed_pgs": round(sum(changed_counts)
@@ -575,7 +660,7 @@ def main(argv=None) -> None:
         # this is the END-TO-END rate a concurrent client population
         # sees, and the coalesce factor is the amortization making up
         # the gap.
-        sweep = dispatch_sweep(encode, k, chunk)
+        sweep = dispatch_sweep(encode, k, chunk, coding=coding)
         out["dispatch"] = telemetry.dispatch_summary()   # key order as
         out["dispatch_sweep"] = sweep                    # historically
 

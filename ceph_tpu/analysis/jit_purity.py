@@ -23,6 +23,17 @@ scan covers the target's own body and its locally nested defs — the
 host-side wrappers *around* a jit call (telemetry timing etc.) are
 exactly the code that SHOULD do host work, so the scan does not chase
 cross-module calls.
+
+Placement scaffolding exemption: the mesh-sharded dispatch path
+(ops.dispatch) hands engine closures SHARDED batches, and those
+closures legitimately build/cache device placements host-side —
+``jax.device_put``, ``NamedSharding``/``PartitionSpec`` construction,
+``make_mesh`` — before invoking the jitted kernel.  These run on the
+engine thread OUTSIDE any trace (the closure CALLS jit; it is not
+traced itself), so a captured-state store whose value is placement
+construction is host-side scaffolding, not a tracer leak: the
+mutation check skips stores whose right-hand side IS a call to one of
+``_PLACEMENT_FNS`` (the whole value — a compound RHS stays flagged).
 """
 
 from __future__ import annotations
@@ -34,6 +45,24 @@ from ceph_tpu.analysis.core import TreeIndex, name_chain
 
 _SUBMIT_METHODS = {"submit", "submit_chunks", "submit_decode_chunks",
                    "submit_flat_firstn", "submit_do_rule"}
+
+#: host-side device-placement constructors: a store whose value is
+#: built from one of these is sharding scaffolding (see module
+#: docstring), exempt from the captured-state mutation check
+_PLACEMENT_FNS = {"device_put", "NamedSharding", "PartitionSpec",
+                  "make_mesh"}
+
+
+def _is_placement_value(value) -> bool:
+    """True when an assignment's RHS IS a placement-scaffolding call
+    (``jax.device_put(..)`` / ``NamedSharding(..)`` / ...) — the whole
+    value, not merely containing one: a compound RHS like
+    ``(traced_x, jax.device_put(..))`` could smuggle tracer-derived
+    state into captured storage behind an incidental placement call,
+    so it stays a mutation finding."""
+    return (isinstance(value, ast.Call)
+            and bool(ch := name_chain(value.func))
+            and ch[-1] in _PLACEMENT_FNS)
 
 
 def _is_jit_expr(node) -> bool:
@@ -167,6 +196,15 @@ def _scan(fn, why, findings) -> None:
                   f"{'global' if isinstance(node, ast.Global) else 'nonlocal'}"
                   f" write to captured state", why)
         elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            # sharding-scaffolding stores are exempt ONLY in engine
+            # submit closures (they run on the engine thread outside
+            # any trace); inside a genuinely jit-TRACED function the
+            # same store executes once at trace time and never again —
+            # exactly the staleness hazard this check exists to catch
+            if (isinstance(node, ast.Assign)
+                    and why.startswith("submitted")
+                    and _is_placement_value(node.value)):
+                continue
             targets = node.targets if isinstance(node, ast.Assign) \
                 else [node.target]
             for t in targets:

@@ -153,6 +153,12 @@ register_options([
     Option("kernel_dispatch_depth", OPT_INT, 2,
            "device calls in flight per dispatch engine (2 = double "
            "buffering: h2d of batch N+1 overlaps compute of batch N)"),
+    Option("kernel_mesh_devices", OPT_INT, 0,
+           "devices the dispatch engines shard each coalesced batch "
+           "over (the stripe/PG axis splits across a dp x ec device "
+           "mesh): 0 = all local devices, 1 = single-device (exact "
+           "pre-mesh engine behavior), N = the first N devices; "
+           "ignored when the backend exposes one device"),
     Option("osd_ec_dispatch_async", OPT_BOOL, True,
            "submit EC write encodes through the dispatch engine and "
            "run transaction-build + shard fan-out in the completion "

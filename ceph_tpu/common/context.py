@@ -14,8 +14,24 @@ from .perf_counters import PerfCountersCollection
 
 
 class CephTpuContext:
-    def __init__(self, name: str = "client", admin_path: str | None = None):
+    def __init__(self, name: str = "client", admin_path: str | None = None,
+                 *, process_index: int | None = None,
+                 n_processes: int | None = None,
+                 coordinator: str | None = None):
+        """``process_index``/``n_processes``/``coordinator`` opt this
+        context into the multi-controller deployment mode (SURVEY §5's
+        two-plane design): jax.distributed initializes against the
+        coordinator, the kernel mesh spans every process's devices
+        (engines place their own flushes over the process-local
+        submesh — the ICI domain), and ``messenger_stack_for`` routes
+        control-plane traffic ici intra-process / tcp across."""
         self.name = name
+        self.process_index = 0 if process_index is None else int(process_index)
+        self.n_processes = 1 if not n_processes else int(n_processes)
+        if self.n_processes > 1:
+            from ceph_tpu.parallel.dcn import init_distributed
+            init_distributed(coordinator, self.n_processes,
+                             self.process_index)
         self.conf = Config()
         self.perf = PerfCountersCollection()
         self.admin = AdminSocket(admin_path)
@@ -67,22 +83,83 @@ class CephTpuContext:
         self._dispatch = None
         self._decode_dispatch = None
         self._mapping_service = None
+        self._kernel_mesh = None        # (knob_value, mesh-or-None)
         self._dispatch_lock = lockdep.make_lock(
             "CephTpuContext::dispatch_build")
+        # knob flip rebuilds the mesh and swaps it into LIVE engines
+        # (takes effect from their next flush)
+        self.conf.add_observer(
+            "kernel_mesh_devices", lambda _n, _v: self._remesh())
         self.admin.register_command(
             "dump_dispatch_stats",
             lambda **kw: {"encode": telemetry.dispatch_dump(),
                           "decode": telemetry.decode_dispatch_dump()},
             "dispatch-engine telemetry (encode + decode engines): "
             "coalesce factor, queue delay/depth, flush reasons, "
-            "in-flight batches; decode adds erasure-pattern "
-            "heterogeneity per call and pattern-table size")
+            "in-flight batches, mesh fan-out (devices per flush, "
+            "sharded-flush count, mesh shape); decode adds "
+            "erasure-pattern heterogeneity per call and "
+            "pattern-table size")
         self.admin.register_command(
             "dump_mapping_stats",
             lambda **kw: telemetry.mapping_dump(),
             "shared PG-mapping-service telemetry: epoch-update "
             "latency, pools recomputed vs reused, changed-PG counts, "
             "epoch-skips, cache lookups vs scalar fallbacks")
+
+    def kernel_mesh(self):
+        """The ("dp", "ec") device mesh this context's dispatch engines
+        shard over, or None (knob ``kernel_mesh_devices`` = 1, a
+        single-device backend, or jax unavailable).  Built lazily on
+        first engine construction — a context that never touches a
+        kernel never imports jax.  In the multi-controller deployment
+        mode this is the GLOBAL mesh spanning every process; engines
+        place their own flushes over its process-local submesh."""
+        knob = int(self.conf.get("kernel_mesh_devices"))
+        with self._dispatch_lock:
+            cached = self._kernel_mesh
+            if cached is not None and cached[0] == knob:
+                return cached[1]
+            mesh = None
+            if knob != 1:
+                try:
+                    import jax
+                    n = len(jax.devices())
+                    if knob > 1:
+                        n = min(knob, n)
+                    if n > 1:
+                        from ceph_tpu.parallel.mesh import make_mesh
+                        # pure dp by default: the engine coalesce axis
+                        # is stripes/PGs; an ec axis only pays when the
+                        # codec's k+m divides it (factor_devices)
+                        mesh = make_mesh(n)
+                except Exception as e:
+                    # loud, like the engine's placement failure path:
+                    # an operator who asked for N devices must not
+                    # silently run single-device with no diagnostic
+                    from ceph_tpu.common.logging import dout
+                    dout("context", 0, "%s: kernel mesh unavailable, "
+                         "engines run single-device: %r", self.name, e)
+                    mesh = None
+            self._kernel_mesh = (knob, mesh)
+            return mesh
+
+    def _remesh(self) -> None:
+        """kernel_mesh_devices observer: rebuild and swap into live
+        engines (their next flush re-places; see engine.set_mesh)."""
+        with self._dispatch_lock:
+            self._kernel_mesh = None
+            mesh = self.kernel_mesh()
+            for eng in (self._dispatch, self._decode_dispatch):
+                if eng is not None:
+                    eng.set_mesh(mesh)
+
+    def messenger_stack_for(self, peer_process: int) -> str:
+        """Control-plane routing for the multi-controller deployment:
+        device-buffer ici inside the process, tcp async across (the
+        SURVEY §5 two-plane rule, parallel.dcn.pick_stack)."""
+        from ceph_tpu.parallel.dcn import pick_stack
+        return pick_stack(peer_process, self.process_index)
 
     def _build_engine(self, name: str, stats=None):
         """One coalescing engine wired to the shared knobs (both the
@@ -96,7 +173,7 @@ class CephTpuContext:
                 "kernel_coalesce_max_delay_us")),
             max_in_flight=int(self.conf.get(
                 "kernel_dispatch_depth")),
-            name=name, stats=stats)
+            name=name, stats=stats, mesh=self.kernel_mesh())
         self.conf.add_observer(
             "kernel_coalesce_max_stripes",
             lambda _n, v: setattr(eng, "max_stripes", int(v)))

@@ -74,6 +74,13 @@ class ErasureCode(ErasureCodeInterface):
         self.runtime = "tpu"   # "tpu" (device kernel) or "cpu" (numpy oracle)
         self._generator: np.ndarray | None = None
         self._encoder = None
+        #: {mesh: encoder} LRU — submit_chunks through a mesh-sharded
+        #: engine uses an encoder whose bit tables are replicated over
+        #: that mesh (one broadcast at build, none per flush); keyed by
+        #: mesh (not a single slot), so one codec feeding
+        #: differently-meshed engines does not rebuild tables on every
+        #: alternating submit
+        self._mesh_encoders: OrderedDict = OrderedDict()
         self._decode_cache: OrderedDict = OrderedDict()
         #: guards _decode_cache AND the pattern tables: decodes now
         #: submit from many OSD threads through the dispatch engine
@@ -117,6 +124,8 @@ class ErasureCode(ErasureCodeInterface):
         self._generator = np.asarray(self._build_generator(), dtype=np.uint8)
         assert self._generator.shape == (self.k + self.m, self.k)
         self._encoder = None
+        with self._decode_lock:
+            self._mesh_encoders.clear()
         with self._decode_lock:
             self._decode_cache.clear()
             self._pattern_tables.clear()
@@ -211,24 +220,64 @@ class ErasureCode(ErasureCodeInterface):
             self._encoder = make_encoder(coding)
         return self._encoder(np.asarray(data_chunks, dtype=np.uint8))
 
+    #: distinct meshes whose encoders one codec keeps resident
+    MESH_ENCODER_CAP = 4
+
+    def _encoder_for_mesh(self, mesh):
+        """Encoder with bit tables replicated over ``mesh`` (the
+        engine's placement mesh) — a mesh-sharded batch then meets
+        mesh-resident tables instead of a per-flush broadcast.
+        Mesh-keyed true LRU (meshes hash by value, so a hot-reload's
+        rebuilt-but-equal mesh hits the same entry), the OrderedDict
+        idiom the recovery caches use; the build (bit tables +
+        broadcast) runs OUTSIDE the lock, a racing duplicate is
+        idempotent."""
+        with self._decode_lock:
+            enc = self._mesh_encoders.get(mesh)
+            if enc is not None:
+                self._mesh_encoders.move_to_end(mesh)
+                return enc
+        from ceph_tpu.ops.gf_kernel import make_encoder
+        enc = make_encoder(self.generator[self.k:], mesh=mesh)
+        with self._decode_lock:
+            self._mesh_encoders[mesh] = enc
+            self._mesh_encoders.move_to_end(mesh)
+            while len(self._mesh_encoders) > self.MESH_ENCODER_CAP:
+                self._mesh_encoders.popitem(last=False)
+        return enc
+
     def submit_chunks(self, engine, data_chunks):
         """Submit an (S, k, B) encode through a dispatch engine
         (ops.dispatch): returns a DispatchFuture of the (S, m, B)
         parity.  Concurrent submits against the same codec and chunk
         width coalesce on the stripe axis into one device call; the
         engine's zero-stripe padding is bit-exact here because the code
-        is linear (zeros encode to zeros)."""
+        is linear (zeros encode to zeros).  On a mesh-sharded engine
+        the coalesced batch additionally splits its stripe axis across
+        the mesh (host runtimes opt out — sharding a batch a numpy fn
+        would immediately gather back is pure overhead)."""
         # analysis: allow[blocking] -- chunk input is host bytes/numpy by API contract
         data = np.asarray(data_chunks, dtype=np.uint8)
         key = ("ec_encode", id(self), self.k, self.m, data.shape[-1],
                self.runtime)
         cache_entries = None
+        fn = self.encode_chunks
+        place = False
         if self.runtime == "tpu":
             from ceph_tpu.ops.gf_kernel import _jit_entries
             cache_entries = _jit_entries
-        return engine.submit(key, self.encode_chunks, data,
+            # mesh placement only fits the BASE dense-matrix encode:
+            # codecs overriding encode_chunks (packet-level bitmatrix,
+            # clay's layered transform) run their own host/packet
+            # pipelines a sharded batch would break or gather back
+            if type(self).encode_chunks is ErasureCode.encode_chunks:
+                place = True
+                mesh = engine.placement_mesh()
+                if mesh is not None:
+                    fn = self._encoder_for_mesh(mesh)
+        return engine.submit(key, fn, data,
                              label="ec_encode",
-                             cache_entries=cache_entries)
+                             cache_entries=cache_entries, place=place)
 
     # -- decode (ErasureCode.cc:198-234 / ErasureCodeIsa.cc:150-310) ----------
 
@@ -326,7 +375,8 @@ class ErasureCode(ErasureCodeInterface):
                 tab["snap_dev"] = None   # lazily, host and device
             return idx, tb, tab
 
-    def _pattern_snapshot(self, tab: dict, device: bool = False):
+    def _pattern_snapshot(self, tab: dict, device: bool = False,
+                          mesh=None):
         """(stacked pow2-padded bit table (P, k*8, tb*8) int8, padded
         uint8 matrices, live pattern count) for a captured table
         object — the operand the batched kernel gathers from.  Pow-2
@@ -339,7 +389,11 @@ class ErasureCode(ErasureCodeInterface):
         the table grows): the whole point of coalescing is amortizing
         the dispatch boundary, so the table must not be re-uploaded
         host-to-device on every call — the same rule make_encoder
-        applies to the encode tables.  The stack + upload run OUTSIDE
+        applies to the encode tables.  ``mesh`` (a mesh-sharded
+        engine's placement mesh) places the device table REPLICATED
+        over the mesh so the gather kernel meets a sharded batch with
+        consistent shardings; the cached copy is keyed to the mesh and
+        rebuilt when it changes.  The stack + upload run OUTSIDE
         the codec lock: the table is append-only within a generation,
         so a prefix copy is consistent and covers every pattern index
         any in-flight batch can carry (indices are assigned before
@@ -348,6 +402,10 @@ class ErasureCode(ErasureCodeInterface):
         with self._decode_lock:
             host = tab["snap"]
             dev = tab["snap_dev"]
+            if tab.get("snap_dev_mesh") != mesh:
+                dev = None   # mesh changed: re-place (VALUE equality —
+                # a hot-reload rebuilds an equal Mesh object, and the
+                # cached table placed on it is still the right one)
             mats = list(tab["mats"])
             if host is not None and (dev is not None or not device):
                 return (dev if device else host), mats, len(mats)
@@ -359,12 +417,18 @@ class ErasureCode(ErasureCodeInterface):
             host[:n] = np.stack(bits)
         if device:
             import jax
-            dev = jax.device_put(host)
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                dev = jax.device_put(
+                    host, NamedSharding(mesh, PartitionSpec()))
+            else:
+                dev = jax.device_put(host)
         with self._decode_lock:
             if len(tab["bits"]) == n:    # still current: cache it
                 tab["snap"] = host
                 if device:
                     tab["snap_dev"] = dev
+                    tab["snap_dev_mesh"] = mesh
         return (dev if device else host), mats, n
 
     def _decode_batch_fn(self, tab: dict, tb: int, stats=None):
@@ -378,10 +442,17 @@ class ErasureCode(ErasureCodeInterface):
         the submitting engine's own sink, so a privately-instrumented
         engine sees its patterns histogram populated."""
         def fn(data, pidx):
-            pidx = np.asarray(pidx)
-            uniq = np.unique(pidx)
+            # the pattern-heterogeneity sample reads pidx host-side (it
+            # is tiny); the copy feeding the KERNEL stays as the engine
+            # delivered it — on a mesh-sharded engine that is a sharded
+            # device array gathered per-stripe on every chip
+            host_pidx = np.asarray(pidx)
+            uniq = np.unique(host_pidx)
             device = self.runtime not in ("cpu", "native")
-            snap, mats, live = self._pattern_snapshot(tab, device=device)
+            mesh = getattr(getattr(data, "sharding", None), "mesh", None) \
+                if device else None
+            snap, mats, live = self._pattern_snapshot(
+                tab, device=device, mesh=mesh)
             if stats is not None:
                 stats.record_patterns(int(uniq.size), live)
             if not device:
@@ -392,7 +463,7 @@ class ErasureCode(ErasureCodeInterface):
                 out = np.zeros((data.shape[0], tb, data.shape[-1]),
                                dtype=np.uint8)
                 for p in uniq:
-                    rows = np.nonzero(pidx == p)[0]
+                    rows = np.nonzero(host_pidx == p)[0]
                     out[rows] = np.asarray(enc(mats[int(p)], data[rows]))
                 return out
             from ceph_tpu.ops.gf_kernel import ec_decode_batched
@@ -438,7 +509,8 @@ class ErasureCode(ErasureCodeInterface):
             else telemetry.decode_dispatch_stats()
         inner = engine.submit(key, self._decode_batch_fn(tab, tb, stats),
                               data, aux=(pidx,), label="ec_decode",
-                              cache_entries=cache_entries)
+                              cache_entries=cache_entries,
+                              place=self.runtime == "tpu")
         if t == tb:
             return inner
         # the batch computes tb target rows per stripe (the bucket);

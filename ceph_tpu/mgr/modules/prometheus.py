@@ -250,8 +250,39 @@ class Module(MgrModule):
         """The cross-op coalescing engine (ops.dispatch): how many
         requests share each device call, how long they queue for the
         privilege, and how deep the pipeline runs."""
-        self._emit_coalesce(exp, telemetry.dispatch_dump(),
-                            "ceph_kernel_coalesce")
+        d = telemetry.dispatch_dump()
+        self._emit_coalesce(exp, d, "ceph_kernel_coalesce")
+        self._emit_mesh(exp, d, "encode")
+
+    @staticmethod
+    def _emit_mesh(exp: Exposition, d: dict, engine: str) -> None:
+        """ceph_kernel_mesh_*: the multi-device fan-out story per
+        dispatch engine — mesh shape, how many flushes went out
+        sharded, how many devices each flush landed on, and per-device
+        shard occupancy.  mesh_devices 0 = no mesh configured (single
+        device or kernel_mesh_devices=1)."""
+        p = "ceph_kernel_mesh"
+        lab = {"engine": engine}
+        exp.gauge(f"{p}_devices",
+                  "devices in the engine's kernel mesh "
+                  "(0 = single-device engine)", d["mesh_devices"], lab)
+        exp.gauge(f"{p}_dp", "mesh data-parallel axis extent",
+                  d["mesh_dp"], lab)
+        exp.gauge(f"{p}_ec", "mesh erasure-shard axis extent",
+                  d["mesh_ec"], lab)
+        exp.counter(f"{p}_sharded_flushes_total",
+                    "coalesced flushes placed across more than one "
+                    "device", d["sharded_flushes"], lab)
+        du = d["devices_used"]
+        exp.histogram(f"{p}_flush_devices",
+                      "devices each coalesced flush landed on (mass "
+                      "above 1 is the multi-chip path at work)",
+                      du["bounds"], du["buckets"], du["sum"], lab)
+        ss = d["shard_stripes"]
+        exp.histogram(f"{p}_shard_stripes",
+                      "stripes per device shard per sharded flush "
+                      "(per-chip occupancy after the batch splits)",
+                      ss["bounds"], ss["buckets"], ss["sum"], lab)
 
     def _scrape_decode_dispatch(self, exp: Exposition) -> None:
         """The decode-side engine (heterogeneous-matrix batched GF
@@ -262,6 +293,7 @@ class Module(MgrModule):
         d = telemetry.decode_dispatch_dump()
         p = "ceph_kernel_decode_coalesce"
         self._emit_coalesce(exp, d, p)
+        self._emit_mesh(exp, d, "decode")
         pat = d["patterns"]
         exp.histogram(f"{p}_patterns",
                       "distinct erasure patterns per coalesced decode "

@@ -16,6 +16,15 @@ tie-break of ``bucket_straw2_choose`` (mapper.c:361-384).
 
 int64 is required (jax_enable_x64 is switched on in ceph_tpu.__init__): straw2
 draws are s64 and the ln tables are 48-bit fixed point.
+
+Mesh contract: every kernel here is elementwise along the x (batch) axis —
+each lane's draws, retry ladder and reject tests read only that lane plus the
+replicated map operands — so a mesh-sharded dispatch engine may split x over
+any device mesh with bit-identical results (GSPMD partitions the jitted call;
+``jnp.any`` in the while_loop conds becomes the only cross-shard collective).
+Callers placing x with a committed sharding must hand the operand tables in
+uncommitted (numpy/jnp.asarray) or replicated over the SAME mesh — the submit
+helpers in ops.dispatch do the latter when they see a sharded batch.
 """
 
 from __future__ import annotations

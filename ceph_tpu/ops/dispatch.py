@@ -33,20 +33,39 @@ Three mechanisms, one engine:
   ``max_in_flight`` bounds outstanding device calls (2 = classic double
   buffering).
 
+* **mesh-sharded fan-out** — an engine built with a device ``mesh``
+  places every coalesced batch ACROSS the mesh before the kernel sees
+  it: the shape bucket rounds up to a multiple of the mesh size (every
+  shard non-empty, the jit cache still bounded by the bucket table —
+  now keyed by (bucket, mesh) because committed input shardings are
+  part of jax's compile-cache key), the batch is ``device_put`` with a
+  ``NamedSharding`` splitting the stripe/PG axis over the ``("dp",
+  "ec")`` axes, and aux side arrays shard in lockstep.  XLA partitions
+  the jitted kernel (GSPMD), results stay device-resident and sharded
+  until the completion thread materializes them.  One flush saturates
+  every chip instead of one; bit-exactness is untouched because the
+  kernels are elementwise/row-independent along the coalesce axis.  In
+  a multi-controller deployment (jax.distributed) the engine's own
+  flushes are process-local data, so placement uses the GLOBAL mesh's
+  process-local submesh — each process's engine saturates its ICI
+  domain while collective SPMD work spans the full mesh.
+
 Delivery-order contract: completions for one ``key`` are delivered in
 submission order, on a single completion thread.  The OSD leans on this
 for per-object log/commit ordering (osd/daemon._ec_write_committed).
 
 Everything here is numpy + threading; jax enters only through the
-``fn`` callables the submitters pass, so importing this module never
-pulls in the kernel stack (same rule as ops.telemetry).
+``fn`` callables the submitters pass — and, on mesh-sharded engines,
+through the lazily-built ``_MeshPlacement`` scaffolding — so importing
+this module never pulls in the kernel stack (same rule as
+ops.telemetry).
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 
 import numpy as np
 
@@ -110,10 +129,12 @@ class DispatchFuture:
 
 class _Request:
     __slots__ = ("key", "fn", "data", "aux", "stripes", "future",
-                 "t_submit", "label", "cache_entries", "trace", "span")
+                 "t_submit", "label", "cache_entries", "trace", "span",
+                 "place")
 
     def __init__(self, key, fn, data, stripes, label=None,
-                 cache_entries=None, aux=None):
+                 cache_entries=None, aux=None, place=True):
+        self.place = place
         self.key = key
         self.fn = fn
         self.data = data
@@ -152,6 +173,69 @@ def bucket_stripes(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
 
+def mesh_bucket_stripes(n: int, devices: int) -> int:
+    """Shape bucket for a mesh of ``devices``: the power-of-two bucket
+    rounded UP to a multiple of the mesh size, so the sharded leading
+    axis divides evenly (jax rejects uneven NamedSharding placement)
+    and every device's shard is non-empty.  For power-of-two meshes
+    this is just max(bucket, devices); the bucket table stays bounded
+    either way (it is a function of the pow-2 bucket)."""
+    b = bucket_stripes(n)
+    if devices > 1 and b % devices:
+        b += devices - b % devices
+    return max(b, devices)
+
+
+def _mesh_shape(mesh) -> tuple[int, int]:
+    """(dp, ec) gauge values for a mesh — the ONE place the
+    missing-axis defaults live (a dp-only mesh is dp x 1, never
+    dp x 0): (0, 0) means no mesh."""
+    if mesh is None:
+        return 0, 0
+    shape = dict(mesh.shape)
+    ec = int(shape.get("ec", 1))
+    dp = int(shape.get("dp", max(1, int(mesh.size) // max(ec, 1))))
+    return dp, ec
+
+
+class _MeshPlacement:
+    """Host-side placement scaffolding for a mesh-sharded engine.
+
+    Built lazily on the first flush of an engine holding a mesh (so
+    engines without one never import jax), it caches one
+    ``NamedSharding`` per operand rank: the leading (stripe/PG) axis
+    splits over every mesh axis, trailing axes replicate.  In a
+    multi-controller deployment the engine's own flushes are
+    process-local host data, so placement targets the GLOBAL mesh's
+    process-local submesh (the process's ICI domain); single-process
+    engines place over the full mesh.
+    """
+
+    __slots__ = ("mesh", "place_mesh", "devices", "_shardings")
+
+    def __init__(self, mesh):
+        import jax
+        self.mesh = mesh
+        self.place_mesh = (mesh.local_mesh if jax.process_count() > 1
+                           else mesh)
+        self.devices = int(self.place_mesh.size)
+        self._shardings: dict = {}
+
+    def sharding(self, ndim: int):
+        s = self._shardings.get(ndim)
+        if s is None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            spec = PartitionSpec(tuple(self.place_mesh.axis_names),
+                                 *([None] * (ndim - 1)))
+            s = NamedSharding(self.place_mesh, spec)
+            self._shardings[ndim] = s
+        return s
+
+    def put(self, arr):
+        import jax
+        return jax.device_put(arr, self.sharding(arr.ndim))
+
+
 class DeviceDispatchEngine:
     """Per-CephContext coalescing dispatcher for batched device kernels.
 
@@ -166,13 +250,17 @@ class DeviceDispatchEngine:
 
     def __init__(self, *, max_stripes: int = 2048,
                  max_delay_us: float = 250.0, max_in_flight: int = 2,
-                 name: str = "dispatch", stats=None):
+                 name: str = "dispatch", stats=None, mesh=None):
         self.max_stripes = int(max_stripes)
         self.max_delay_us = float(max_delay_us)
         self.max_in_flight = max(1, int(max_in_flight))
         self.name = name
         self.stats = stats if stats is not None \
             else telemetry.dispatch_stats()
+        #: jax.sharding.Mesh (or None): batches fan out across it —
+        #: see the module docstring's mesh-sharded fan-out mechanism
+        self._mesh = mesh
+        self._placement: _MeshPlacement | None = None
         self._cv = lockdep.make_condition(
             f"DeviceDispatchEngine::cv({name})")
         self._pending: deque[_Request] = deque()
@@ -183,6 +271,84 @@ class DeviceDispatchEngine:
         self._building = 0          # batches being built/dispatched
         self._stop = False
         self._threads: list[threading.Thread] = []
+
+    # -- mesh -----------------------------------------------------------------
+
+    def set_mesh(self, mesh) -> None:
+        """Swap the engine's device mesh (knob hot-reload).  Takes
+        effect from the next flush; in-flight batches keep the
+        placement they were built with (their fns re-place operands to
+        match whatever sharding the batch actually carries, so late
+        completion stays correct)."""
+        with self._cv:
+            self._mesh = mesh
+            self._placement = None
+        try:
+            self.stats.set_mesh_shape(*_mesh_shape(mesh))
+        except Exception:
+            pass
+
+    def _mesh_placement(self) -> _MeshPlacement | None:
+        """The live placement scaffolding, built lazily on first use.
+        A build failure (single-device backend, jax unavailable)
+        disables the mesh loudly ONCE instead of failing every flush.
+
+        Lock-free fast paths for the two common cases — no mesh, and
+        placement already built: submitters probe this per op
+        (placement_mesh) and must not pay the engine condvar for it.
+        The unlocked attribute reads race only with set_mesh, and
+        benignly: a stale answer delays the new placement by at most
+        one flush, and every fn re-places operands to match whatever
+        sharding its batch actually carries."""
+        mesh = self._mesh
+        placement = self._placement
+        if mesh is None:
+            return None
+        if placement is not None and placement.mesh is mesh:
+            if self.stats.mesh_devices == 0:
+                # a stats clear() (tests/bench isolation) zeroed the
+                # shape gauges: republish so the mesh gauge cannot
+                # read "no mesh" next to a growing sharded-flush count
+                self._publish_mesh_shape(placement)
+            return placement
+        with self._cv:
+            mesh = self._mesh
+            placement = self._placement
+        if mesh is None:
+            return None
+        if placement is not None and placement.mesh is mesh:
+            return placement
+        try:
+            placement = _MeshPlacement(mesh)
+            if placement.devices <= 1:
+                placement = None
+        except Exception as e:
+            from ceph_tpu.common.logging import dout
+            dout("dispatch", 0, "%s: mesh placement unavailable, "
+                 "running single-device: %r", self.name, e)
+            placement = None
+        with self._cv:
+            if self._mesh is mesh:
+                self._placement = placement
+                if placement is None:
+                    self._mesh = None
+        if placement is not None:
+            self._publish_mesh_shape(placement)
+        return placement
+
+    def _publish_mesh_shape(self, placement: _MeshPlacement) -> None:
+        try:
+            self.stats.set_mesh_shape(*_mesh_shape(placement.mesh))
+        except Exception:
+            pass
+
+    def placement_mesh(self):
+        """The mesh this engine's batches are actually placed over (the
+        process-local submesh under jax.distributed), or None.
+        Submitters use it to pre-replicate operand tables so the jitted
+        kernel sees mesh-consistent shardings."""
+        p = self._mesh_placement()
+        return p.place_mesh if p is not None else None
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -223,7 +389,8 @@ class DeviceDispatchEngine:
     # -- submit ---------------------------------------------------------------
 
     def submit(self, key, fn, data, *, label=None,
-               cache_entries=None, aux=None) -> DispatchFuture:
+               cache_entries=None, aux=None,
+               place: bool = True) -> DispatchFuture:
         """``aux``: optional tuple of per-stripe side arrays (each with
         the SAME leading axis as ``data``) that coalesce alongside it —
         concatenated per component, edge-padded (last row repeated) to
@@ -231,7 +398,12 @@ class DeviceDispatchEngine:
         decode rides this: the per-stripe erasure-pattern index travels
         as aux so requests with DIFFERENT recovery matrices still share
         one device call.  All requests under one key must agree on aux
-        arity and trailing shapes (encode that in the key)."""
+        arity and trailing shapes (encode that in the key).
+
+        ``place=False`` opts this request out of mesh-sharded placement
+        (host-runtime fns — numpy/native codecs — would only gather the
+        sharded batch straight back).  Requests sharing a key must
+        agree on it (encode the runtime in the key, as the codecs do)."""
         # analysis: allow[blocking] -- caller-input normalization: submit() receives host arrays (numpy/bytes), not device values
         data = np.asarray(data)
         stripes = int(data.shape[0]) if data.ndim else 1
@@ -243,7 +415,7 @@ class DeviceDispatchEngine:
                     raise ValueError(
                         f"aux leading axis {a.shape} != stripes {stripes}")
         req = _Request(key, fn, data, stripes, label=label,
-                       cache_entries=cache_entries, aux=aux)
+                       cache_entries=cache_entries, aux=aux, place=place)
         with self._cv:
             if not self._stop:
                 self._ensure_threads()
@@ -361,7 +533,16 @@ class DeviceDispatchEngine:
         """Build the padded batch and issue the device call (runs
         OUTSIDE the engine lock: a first-shape call traces+compiles)."""
         now = time.monotonic()
-        bucket = bucket_stripes(total)
+        # mesh-sharded engines round the bucket up to a multiple of the
+        # mesh size (every shard non-empty, even NamedSharding split);
+        # place=False requests keep the seed's pure pow-2 bucket, and
+        # 0-d submits (no batch axis to split — padding would have to
+        # concatenate onto a scalar) always run unplaced
+        placement = (self._mesh_placement()
+                     if reqs[0].place and reqs[0].data.ndim else None)
+        devices = placement.devices if placement is not None else 1
+        bucket = (mesh_bucket_stripes(total, devices) if devices > 1
+                  else bucket_stripes(total))
         pad = bucket - total
         # slices first (pure arithmetic, cannot fail): the completion
         # thread zips reqs against slices, so every request must have
@@ -406,6 +587,16 @@ class DeviceDispatchEngine:
                                                axis=0))
                     aux_batch += (parts[0] if len(parts) == 1
                                   else np.concatenate(parts, axis=0),)
+            if placement is not None:
+                # device_put with the sharding on dispatch: the batch
+                # (and its aux arrays, in lockstep) split their leading
+                # axis across the mesh BEFORE the kernel fn runs, so
+                # the jitted call compiles partitioned (GSPMD) and its
+                # result stays sharded until the completion thread
+                # materializes it.  A placement failure lands in exc
+                # and fans to the batch's futures like any build error.
+                batch_arr = placement.put(batch_arr)
+                aux_batch = tuple(placement.put(a) for a in aux_batch)
             traced = [r for r in reqs if r.trace is not None]
             if traced:
                 from ceph_tpu.common import tracing
@@ -434,7 +625,9 @@ class DeviceDispatchEngine:
                 self.stats.record_batch(
                     requests=len(reqs), stripes=total, padded=pad,
                     reason=reason, delays=[now - r.t_submit for r in reqs],
-                    depth=depth)
+                    depth=depth, devices=devices,
+                    shard_stripes=(bucket // devices if devices > 1
+                                   else 0))
             except Exception:
                 pass
             with self._cv:
@@ -495,6 +688,39 @@ class DeviceDispatchEngine:
 # CRUSH bulk-remap submit API (ops.crush_kernel's flat_firstn, coalesced)
 # ---------------------------------------------------------------------------
 
+#: mesh-replicated CRUSH operand tables, LRU-cached per (mesh, engine
+#: key): the engine key already digests the operand content (bucket
+#: ids/weights/reweight or mapper+rule+reweight), so repeated flushes
+#: against the same map state reuse one broadcast instead of
+#: re-uploading the tables per flush — the same residency rule
+#: make_encoder and the decode pattern snapshot follow
+_PLACED_OPS_CAP = 32
+_placed_ops: OrderedDict = OrderedDict()
+_placed_ops_lock = lockdep.make_lock("dispatch::placed_operands")
+
+
+def _replicate_cached(mesh, cache_key, build):
+    """build() -> operands device_put-replicated over ``mesh``, cached
+    under (mesh, cache_key) — true LRU (move-to-end on hit, evict the
+    single least-recent entry past the cap), the same OrderedDict
+    idiom the codec recovery caches use; meshes are hashable.
+    build() runs OUTSIDE the lock; a racing duplicate broadcast is
+    idempotent."""
+    k = (mesh, cache_key)
+    with _placed_ops_lock:
+        v = _placed_ops.get(k)
+        if v is not None:
+            _placed_ops.move_to_end(k)
+            return v
+    v = build()
+    with _placed_ops_lock:
+        _placed_ops[k] = v
+        _placed_ops.move_to_end(k)
+        while len(_placed_ops) > _PLACED_OPS_CAP:
+            _placed_ops.popitem(last=False)
+    return v
+
+
 def submit_flat_firstn(engine: DeviceDispatchEngine, x, ids, weights,
                        reweight, *, numrep: int, tries: int = 51,
                        key=None) -> DispatchFuture:
@@ -517,10 +743,26 @@ def submit_flat_firstn(engine: DeviceDispatchEngine, x, ids, weights,
                hash(ids.tobytes()), hash(weights.tobytes()),
                hash(reweight.tobytes()))
 
-    def fn(xs):
+    def fn(xs, key=key):
         from ceph_tpu.ops.crush_kernel import flat_firstn
-        return flat_firstn(xs, ids, weights, reweight,
-                           numrep=numrep, tries=tries)
+        i, w, rw = ids, weights, reweight
+        mesh = getattr(getattr(xs, "sharding", None), "mesh", None)
+        if mesh is not None and getattr(mesh, "size", 1) > 1:
+            # host-side placement scaffolding, not traced compute: the
+            # engine handed us a mesh-sharded batch, so replicate the
+            # bucket/reweight operands over the same mesh — the jitted
+            # kernel then compiles with consistent shardings (sharded
+            # x, replicated tables) instead of erroring on mixed
+            # committed device sets.  Cached per (mesh, key): the key
+            # digests the operand content, so same-map flushes reuse
+            # one broadcast.
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+            i, w, rw = _replicate_cached(
+                mesh, key,
+                lambda: jax.device_put(
+                    (i, w, rw), NamedSharding(mesh, PartitionSpec())))
+        return flat_firstn(xs, i, w, rw, numrep=numrep, tries=tries)
 
     return engine.submit(key, fn, np.asarray(x, dtype=np.uint32),
                          label="crush_firstn")
@@ -547,8 +789,23 @@ def submit_do_rule(engine: DeviceDispatchEngine, mapper, ruleno: int,
         key = ("crush_rule", id(mapper), ruleno, result_max,
                hash(reweight.tobytes()))
 
-    def fn(batch):
-        return mapper.do_rule(ruleno, batch, result_max, reweight)
+    def fn(batch, key=key):
+        rw = reweight
+        mesh = getattr(getattr(batch, "sharding", None), "mesh", None)
+        if mesh is not None and getattr(mesh, "size", 1) > 1:
+            # host-side placement scaffolding (see submit_flat_firstn):
+            # replicate the reweight vector over the batch's mesh so
+            # do_rule's jitted evaluator sees consistent shardings (the
+            # mapper's compiled-map arrays are uncommitted and follow);
+            # cached per (mesh, key) — the key digests mapper identity,
+            # rule and reweight content
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+            rw = _replicate_cached(
+                mesh, key,
+                lambda: jax.device_put(
+                    rw, NamedSharding(mesh, PartitionSpec())))
+        return mapper.do_rule(ruleno, batch, result_max, rw)
 
     return engine.submit(key, fn, np.asarray(xs, dtype=np.uint32),
                          label="crush_rule")

@@ -336,13 +336,28 @@ def _jit_entries() -> int:
     return _encode_xla._cache_size() + _encode_pallas._cache_size()
 
 
+def _multi_device(x) -> bool:
+    """True when x is committed/sharded across more than one device
+    (a mesh-sharded engine batch).  numpy inputs have no sharding;
+    tracers (outer-jit composition) conservatively count as single."""
+    try:
+        return len(x.sharding.device_set) > 1
+    except Exception:
+        return False
+
+
 def _encode_dispatch_impl(w_bits, w_blk, data, *, k, m, dot_dtype):
     s, _, b = data.shape
     bc = _pick_bc(b)
     # batches below one grid step would pad up to _SB-1 all-zero
-    # stripes through the Pallas path; the XLA path wastes nothing
+    # stripes through the Pallas path; the XLA path wastes nothing.
+    # Mesh-sharded batches take the XLA path too: GSPMD partitions it
+    # along the sharded stripe axis for free, while a pallas_call is an
+    # opaque custom call XLA cannot split (a shard_map wrapper around
+    # the fused kernel is the follow-up that lifts this)
     if (w_blk is not None and bc is not None and s >= _SB
-            and jax.default_backend() == "tpu"):
+            and jax.default_backend() == "tpu"
+            and not _multi_device(data)):
         pad = (-s) % _SB
         if pad:
             data = jnp.concatenate(
@@ -381,18 +396,49 @@ def ec_encode_jax(coeff: np.ndarray, data, dot_dtype=jnp.int8) -> jax.Array:
     return out[0] if squeeze else out
 
 
-def make_encoder(coeff: np.ndarray, dot_dtype=jnp.int8):
-    """Return a jitted encode(data (S,k,B) uint8) -> (S,m,B) with tables resident."""
+def make_encoder(coeff: np.ndarray, dot_dtype=jnp.int8, mesh=None):
+    """Return a jitted encode(data (S,k,B) uint8) -> (S,m,B) with tables resident.
+
+    ``mesh``: optional jax.sharding.Mesh — the bit tables are placed
+    REPLICATED over it, so encode() accepts batches a mesh-sharded
+    dispatch engine split across those devices without re-broadcasting
+    the tables on every flush (and without tripping jax's mixed
+    committed-device check)."""
     coeff = np.asarray(coeff, dtype=np.uint8)
     m, k = coeff.shape
     wb = bit_matrix(coeff)
-    w_bits = jax.device_put(jnp.asarray(wb))
-    w_blk = (jax.device_put(jnp.asarray(_blockdiag(wb, _G)))
-             if jax.default_backend() == "tpu" else None)
+    wb_host = jnp.asarray(wb)               # uncommitted: follows any batch
+    blk_host = (jnp.asarray(_blockdiag(wb, _G))
+                if jax.default_backend() == "tpu" else None)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        rep = NamedSharding(mesh, PartitionSpec())
+        w_bits = jax.device_put(wb_host, rep)
+        w_blk = (jax.device_put(blk_host, rep) if blk_host is not None
+                 else None)
+    else:
+        w_bits = jax.device_put(wb_host)
+        w_blk = (jax.device_put(blk_host) if blk_host is not None
+                 else None)
 
     def encode(data):
-        return _encode_dispatch(w_bits, w_blk,
-                                jnp.asarray(data, dtype=jnp.uint8),
+        data = jnp.asarray(data, dtype=jnp.uint8)
+        wb_use, blk_use = w_bits, w_blk
+        # VALUE equality, not identity: a knob hot-reload rebuilds an
+        # EQUAL Mesh object (jax Mesh __eq__ is value-based, same
+        # devices/layout), and tables committed to the equal mesh are
+        # fully compatible — an identity check would silently take the
+        # re-broadcast fallback on every flush forever after a rebuild
+        if mesh is not None and getattr(
+                getattr(data, "sharding", None), "mesh", None) != mesh:
+            # the batch arrived committed to a DIFFERENT mesh (knob
+            # hot-reload between submit and flush) or unplaced (engine
+            # stopped, inline run): mesh-committed tables would trip
+            # jax's mixed-committed-devices check, so fall back to the
+            # uncommitted copies — jit re-places them to match the
+            # batch, trading one broadcast for correctness
+            wb_use, blk_use = wb_host, blk_host
+        return _encode_dispatch(wb_use, blk_use, data,
                                 k=k, m=m, dot_dtype=dot_dtype)
 
     return encode

@@ -170,12 +170,21 @@ class DispatchStats:
     depth), and how many calls are outstanding (in-flight).  Flush
     reasons tell WHY each batch closed — "idle" flushes are the no-wait
     single-op path, "full"/"timeout" flushes are coalescing at work.
+
+    Mesh-sharded engines (ops.dispatch with a device mesh) add the
+    fan-out story: how many devices each flush actually landed on
+    (devices_used — mass above 1 is the multi-chip path at work), how
+    many stripes each device's shard carried (shard_stripes — the
+    per-chip occupancy after the batch splits), how many flushes went
+    out sharded at all, and the engine's mesh shape gauges.
     """
 
     __slots__ = ("_lock", "submits", "stripes_in", "batches",
                  "stripes_out", "padded_stripes", "completed",
                  "coalesce", "queue_delay", "queue_depth",
-                 "flush_reasons", "in_flight", "max_in_flight_seen")
+                 "flush_reasons", "in_flight", "max_in_flight_seen",
+                 "sharded_flushes", "devices_used", "shard_stripes",
+                 "mesh_devices", "mesh_dp", "mesh_ec")
 
     def __init__(self):
         self._lock = lockdep.make_lock("DispatchStats::lock")
@@ -192,6 +201,12 @@ class DispatchStats:
                               "stop": 0}
         self.in_flight = 0        # gauge: batches outstanding on device
         self.max_in_flight_seen = 0
+        self.sharded_flushes = 0  # flushes placed across > 1 device
+        self.devices_used = Histogram(COALESCE_BOUNDS)  # devices/flush
+        self.shard_stripes = Histogram(BATCH_BOUNDS)  # stripes/device
+        self.mesh_devices = 0     # gauge: devices in the engine's mesh
+        self.mesh_dp = 0          # gauge: mesh dp axis
+        self.mesh_ec = 0          # gauge: mesh ec axis
 
     def clear(self) -> None:
         """Reset IN PLACE: live engines hold a reference to this object
@@ -207,6 +222,10 @@ class DispatchStats:
                                   "stop": 0}
             self.in_flight = 0
             self.max_in_flight_seen = 0
+            self.sharded_flushes = 0
+            self.devices_used = Histogram(COALESCE_BOUNDS)
+            self.shard_stripes = Histogram(BATCH_BOUNDS)
+            self.mesh_devices = self.mesh_dp = self.mesh_ec = 0
 
     def record_submit(self, stripes: int) -> None:
         with self._lock:
@@ -214,7 +233,8 @@ class DispatchStats:
             self.stripes_in += stripes
 
     def record_batch(self, *, requests: int, stripes: int, padded: int,
-                     reason: str, delays, depth: int) -> None:
+                     reason: str, delays, depth: int,
+                     devices: int = 1, shard_stripes: int = 0) -> None:
         with self._lock:
             self.batches += 1
             self.stripes_out += stripes
@@ -225,6 +245,18 @@ class DispatchStats:
                 self.queue_delay.add(d)
             self.flush_reasons[reason] = \
                 self.flush_reasons.get(reason, 0) + 1
+            self.devices_used.add(devices)
+            if devices > 1:
+                self.sharded_flushes += 1
+                if shard_stripes:
+                    self.shard_stripes.add(shard_stripes)
+
+    def set_mesh_shape(self, dp: int, ec: int) -> None:
+        """Record the engine's mesh shape (1x1 = single device)."""
+        with self._lock:
+            self.mesh_dp = int(dp)
+            self.mesh_ec = int(ec)
+            self.mesh_devices = int(dp) * int(ec)
 
     def record_complete(self, requests: int) -> None:
         with self._lock:
@@ -251,12 +283,19 @@ class DispatchStats:
                 "flush_reasons": dict(self.flush_reasons),
                 "in_flight": self.in_flight,
                 "max_in_flight_seen": self.max_in_flight_seen,
+                "sharded_flushes": self.sharded_flushes,
+                "devices_used": self.devices_used.dump(),
+                "shard_stripes": self.shard_stripes.dump(),
+                "mesh_devices": self.mesh_devices,
+                "mesh_dp": self.mesh_dp,
+                "mesh_ec": self.mesh_ec,
             }
 
     def summary(self) -> dict:
         """bench.py's digest: amortization in three numbers."""
         with self._lock:
             batches = self.batches
+            dev_n = self.devices_used.count
             return {
                 "submits": self.submits,
                 "device_calls": batches,
@@ -272,6 +311,10 @@ class DispatchStats:
                                          + self.padded_stripes), 3)
                                 if self.stripes_out else 0.0),
                 "flush_reasons": dict(self.flush_reasons),
+                "mesh_devices": self.mesh_devices,
+                "sharded_flushes": self.sharded_flushes,
+                "mean_devices": (round(self.devices_used.sum / dev_n, 2)
+                                 if dev_n else 0.0),
             }
 
 

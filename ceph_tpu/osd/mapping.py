@@ -124,18 +124,33 @@ def rule_devices(crush: CrushMap, ruleno: int) -> tuple[int, ...]:
     return tuple(sorted(devs))
 
 
-def _changed_rows(old: np.ndarray, new: np.ndarray) -> np.ndarray:
+def _changed_rows(old: np.ndarray, new: np.ndarray,
+                  mesh=None) -> np.ndarray:
     """Row indices where the two (pg_num, size) raw tables differ.
     The elementwise compare + row reduce runs on device; only the
-    boolean row mask comes back to host."""
+    boolean row mask comes back to host.  With a ``mesh`` (the
+    context's kernel mesh) and a PG axis the mesh size divides —
+    pg_num is a power of two in practice — both tables split their PG
+    axis across the mesh, so the epoch diff fans out with the rest of
+    the mapping pipeline instead of serializing on one chip."""
     if old.shape != new.shape:
         return np.arange(new.shape[0])
     if new.size == 0:
         return np.zeros(0, dtype=np.int64)
     try:
         import jax.numpy as jnp
-        mask = np.asarray(jnp.any(jnp.asarray(old) != jnp.asarray(new),
-                                  axis=1))
+        if (mesh is not None and getattr(mesh, "size", 1) > 1
+                and old.shape[0] % mesh.size == 0):
+            # single sharded placement straight from host (jnp.asarray
+            # first would pay an extra default-device transfer)
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+            spec = NamedSharding(
+                mesh, PartitionSpec(tuple(mesh.axis_names), None))
+            o, n = jax.device_put(old, spec), jax.device_put(new, spec)
+        else:
+            o, n = jnp.asarray(old), jnp.asarray(new)
+        mask = np.asarray(jnp.any(o != n, axis=1))
     except Exception:   # scalar backend / no device: host diff
         mask = (old != new).any(axis=1)
     return np.flatnonzero(mask)
@@ -459,6 +474,21 @@ class SharedPGMappingService:
             return None
         return self._ctx.dispatch_engine()
 
+    def _mesh(self):
+        """The mesh for the on-device epoch diff — EXACTLY the mesh
+        the engine places this service's remap batches over (its
+        process-local submesh under jax.distributed; the diff tables
+        are process-local host data, so placing onto non-addressable
+        devices would raise).  Delegates to the engine so the
+        multi-controller placement rule lives in one place."""
+        eng = self._engine()
+        if eng is None:
+            return None
+        try:
+            return eng.placement_mesh()
+        except Exception:
+            return None
+
     def _ensure_mapping(self) -> OSDMapMapping:
         if self._mapping is None:
             self._mapping = OSDMapMapping(backend=self._backend())
@@ -671,6 +701,7 @@ class SharedPGMappingService:
                               != _vec(m_new.osd_weight, no)).any())
         cand: set[tuple[int, int]] = set()
         recomputed = set(info.recomputed)
+        mesh = self._mesh()     # once per epoch, not per pool
         for pool_id, pool in m_new.pools.items():
             new_raw = mapping._raw.get(pool_id)
             if new_raw is None:
@@ -684,7 +715,7 @@ class SharedPGMappingService:
                 cand.update((pool_id, pg) for pg in range(pool.pg_num))
                 continue
             if pool_id in recomputed:
-                for pg in _changed_rows(old_raw, new_raw):
+                for pg in _changed_rows(old_raw, new_raw, mesh=mesh):
                     cand.add((pool_id, int(pg)))
                 if old_pool.pgp_num != pool.pgp_num:
                     # pps is the affinity seed: it can move a primary

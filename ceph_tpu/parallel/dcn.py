@@ -37,6 +37,36 @@ def pick_stack(peer_process: int, my_process: int) -> str:
     return "ici" if peer_process == my_process else "async"
 
 
+_DISTRIBUTED = {"params": None}
+
+
+def init_distributed(coordinator: str | None, n_processes: int,
+                     process_index: int) -> None:
+    """Idempotent jax.distributed bring-up — the deployment-mode entry
+    CephTpuContext(process_index=, n_processes=, coordinator=) calls.
+    Must run before any jax backend initialization in the process;
+    after it, jax.devices() spans every process and the context's
+    kernel mesh is the GLOBAL mesh (engines place their own flushes
+    over the process-local submesh).  A repeat call with the SAME
+    topology is a no-op; a different coordinator/topology raises loudly
+    here instead of failing far away on a mismatched device count
+    (jax.distributed can only initialize once per process)."""
+    if coordinator is None:
+        raise ValueError(
+            "multi-process CephTpuContext needs a coordinator address")
+    params = (coordinator, int(n_processes), int(process_index))
+    prev = _DISTRIBUTED["params"]
+    if prev is not None:
+        if prev != params:
+            raise RuntimeError(
+                f"jax.distributed already initialized as {prev}; "
+                f"cannot re-initialize as {params}")
+        return
+    import jax
+    jax.distributed.initialize(coordinator, n_processes, process_index)
+    _DISTRIBUTED["params"] = params
+
+
 def run_dcn_pair(n_devices: int = 8, timeout: float = 240.0,
                  retries: int = 1) -> None:
     """Spawn the two-process mesh proof; raises on any failure.
@@ -53,7 +83,31 @@ def run_dcn_pair(n_devices: int = 8, timeout: float = 240.0,
     raise last
 
 
+def run_engine_pair(n_devices: int = 8, timeout: float = 240.0,
+                    retries: int = 1) -> None:
+    """The DEPLOYMENT-MODE proof: two OS processes, each constructing a
+    CephTpuContext in multi-controller mode, sharing ONE global mesh.
+    Each process drives an EC write workload through its mesh-sharded
+    dispatch engine (flushes fan out over its local submesh — the ICI
+    domain), runs one global-mesh collective over DCN, and cross-checks
+    digests over the TCP messenger stack pick_stack routes to.  Raises
+    on any failure."""
+    last: Exception | None = None
+    for _attempt in range(retries + 1):
+        try:
+            _run_pair_once(n_devices, timeout, engine=True)
+            return
+        except (RuntimeError, TimeoutError) as e:
+            last = e
+    raise last
+
+
 def _run_dcn_pair_once(n_devices: int, timeout: float) -> None:
+    _run_pair_once(n_devices, timeout, engine=False)
+
+
+def _run_pair_once(n_devices: int, timeout: float,
+                   engine: bool = False) -> None:
     assert n_devices >= 2 and n_devices % 2 == 0, \
         "need an even global device count of at least 2"
     from ceph_tpu.common import free_port
@@ -71,7 +125,8 @@ def _run_dcn_pair_once(n_devices: int, timeout: float) -> None:
              "--coordinator", coord, "--num-processes", "2",
              "--process-id", str(pid),
              "--local-devices", str(n_devices // 2),
-             "--ms-port", str(ms_port)],
+             "--ms-port", str(ms_port)]
+            + (["--engine"] if engine else []),
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True))
     deadline = time.time() + timeout
@@ -91,6 +146,131 @@ def _run_dcn_pair_once(n_devices: int, timeout: float) -> None:
                 f"dcn worker {pid} failed (rc={p.returncode}):\n{out}")
 
 
+def _engine_worker(args) -> int:
+    """Deployment-mode worker (run_engine_pair): a CephTpuContext in
+    multi-controller mode, its mesh-sharded dispatch engine driven by a
+    real EC write workload, one global-mesh collective, and a messenger
+    digest cross-check on the stack pick_stack routes to."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import ceph_tpu  # noqa: F401  (x64 for the GF kernels)
+    from ceph_tpu.common.context import CephTpuContext
+
+    # the context IS the deployment entry: it initializes
+    # jax.distributed and hands every engine the global mesh
+    ctx = CephTpuContext(f"dcn-engine{args.process_id}",
+                         process_index=args.process_id,
+                         n_processes=args.num_processes,
+                         coordinator=args.coordinator)
+    n_global = args.num_processes * args.local_devices
+    assert len(jax.devices()) == n_global, (len(jax.devices()), n_global)
+    mesh = ctx.kernel_mesh()
+    assert mesh is not None and int(mesh.size) == n_global, mesh
+    eng = ctx.dispatch_engine()
+    place_mesh = eng.placement_mesh()
+    assert place_mesh is not None \
+        and int(place_mesh.size) == args.local_devices, place_mesh
+
+    # EC write workload: both processes push the SAME deterministic
+    # ops through their OWN engine (each flush shards over the local
+    # submesh), so the parity digests must agree bit-exactly
+    from ceph_tpu.ec import registry_instance
+    from ceph_tpu.ops.gf_kernel import ec_encode_ref
+    k, m, chunk = 4, 2, 256
+    codec = registry_instance().factory(
+        "isa", {"technique": "cauchy", "k": str(k), "m": str(m)})
+    coding = codec.generator[k:]
+    rng = np.random.default_rng(0)
+    ops = [rng.integers(0, 256, (s, k, chunk), dtype=np.uint8)
+           for s in (3, 8, 5, args.local_devices * 4)]
+    futs = [codec.submit_chunks(eng, d) for d in ops]
+    digest = 0
+    for d, f in zip(ops, futs):
+        got = np.asarray(f.result(timeout=120))
+        want = ec_encode_ref(coding, d)
+        assert (got == want).all(), "engine parity mismatch vs oracle"
+        digest = (digest + int(got.astype(np.int64).sum())) & 0xFFFFFFFF
+    st = eng.stats
+    assert st.sharded_flushes >= 1, "no flush actually sharded"
+    assert st.mesh_devices == n_global, st.mesh_devices
+
+    # global-mesh collective: every process contributes its local rows
+    # of one global array; the reduction rides DCN between processes
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rows = np.full((args.local_devices, 8), args.process_id + 1,
+                   dtype=np.int64)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(tuple(mesh.axis_names), None)), rows)
+    total = int(jax.jit(jnp.sum)(arr))
+    want_total = 8 * args.local_devices * sum(
+        p + 1 for p in range(args.num_processes))
+    assert total == want_total, (total, want_total)
+
+    # control plane: digests cross the process boundary on the stack
+    # the deployment rule picks (tcp/async between processes)
+    stack = ctx.messenger_stack_for(1 - args.process_id)
+    assert stack == "async", stack
+    assert ctx.messenger_stack_for(args.process_id) == "ici"
+    from ceph_tpu.messages import MMonCommand, MMonCommandAck
+    from ceph_tpu.msg.messenger import Dispatcher, EntityName, Messenger
+    result = {}
+    if args.process_id == 0:
+        class D(Dispatcher):
+            def ms_dispatch(self, msg):
+                if isinstance(msg, MMonCommand):
+                    ok = msg.cmd.get("digest") == digest
+                    msg.connection.send_message(MMonCommandAck(
+                        tid=msg.tid, result=0 if ok else -1,
+                        output=str(digest)))
+                    result["peer"] = msg.cmd
+                    return True
+                return False
+
+        ms = Messenger.create(EntityName("mon", 0), stack)
+        ms.add_dispatcher_tail(D())
+        ms.bind(f"127.0.0.1:{args.ms_port}")
+        ms.start()
+        deadline = _time.time() + 60
+        while "peer" not in result and _time.time() < deadline:
+            _time.sleep(0.05)
+        ms.shutdown()
+        assert result.get("peer", {}).get("digest") == digest, result
+    else:
+        acked = {}
+
+        class D(Dispatcher):
+            def ms_dispatch(self, msg):
+                if isinstance(msg, MMonCommandAck):
+                    acked["rc"] = msg.result
+                    acked["digest"] = msg.output
+                    return True
+                return False
+
+        ms = Messenger.create(EntityName("osd", 1), stack)
+        ms.add_dispatcher_tail(D())
+        ms.start()
+        con = ms.connect_to(f"127.0.0.1:{args.ms_port}",
+                            EntityName("mon", 0))
+        con.send_message(MMonCommand(tid=1, cmd={
+            "digest": digest, "process": args.process_id}))
+        deadline = _time.time() + 60
+        while "rc" not in acked and _time.time() < deadline:
+            _time.sleep(0.05)
+        _time.sleep(0.1)     # let the frame flush before teardown
+        ms.shutdown()
+        assert acked.get("rc") == 0, acked
+        assert acked.get("digest") == str(digest), acked
+    eng.stop()
+    print(f"dcn engine worker {args.process_id}: digest {digest}, "
+          f"{st.sharded_flushes} sharded flushes over "
+          f"{args.local_devices} local of {n_global} global devices")
+    return 0
+
+
 def worker_main(argv=None) -> int:
     import argparse
     ap = argparse.ArgumentParser()
@@ -99,6 +279,9 @@ def worker_main(argv=None) -> int:
     ap.add_argument("--process-id", type=int, required=True)
     ap.add_argument("--local-devices", type=int, required=True)
     ap.add_argument("--ms-port", type=int, required=True)
+    ap.add_argument("--engine", action="store_true",
+                    help="run the dispatch-engine deployment-mode "
+                         "worker instead of the raw mesh proof")
     args = ap.parse_args(argv)
 
     # platform setup MUST precede any jax backend initialization
@@ -108,6 +291,8 @@ def worker_main(argv=None) -> int:
         f"{args.local_devices}").strip()
     import jax
     jax.config.update("jax_platforms", "cpu")
+    if args.engine:
+        return _engine_worker(args)
     jax.distributed.initialize(args.coordinator, args.num_processes,
                                args.process_id)
     import functools

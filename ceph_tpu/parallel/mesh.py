@@ -16,15 +16,23 @@ from jax.sharding import Mesh
 def factor_devices(n: int, ec_max: int = 4, ec_divides: int | None = None) -> tuple[int, int]:
     """Split n devices into (dp, ec).
 
-    ec is the largest divisor of n that is <= ec_max and (when given) divides
-    ``ec_divides`` (the k+m chunk count), so chunk rows split evenly across the
-    ec axis.  Falls back to ec=1 (pure data parallelism) for awkward n.
+    Without ``ec_divides`` the split is pure data parallelism (ec=1): an
+    ec axis only helps when the k+m chunk count is KNOWN to divide it —
+    otherwise chunk rows split unevenly across the ec axis and shard_map
+    callers fail on the ragged block.  (The old default picked the
+    largest ec <= ec_max whenever it divided n, handing ec=4 meshes to
+    callers that never promised any chunk-axis divisibility.)  With
+    ``ec_divides`` (the k+m chunk count), ec is the largest divisor of n
+    that is <= ec_max and divides it, so chunk rows split evenly; ec=1
+    remains the fallback for awkward n.
     """
+    if ec_divides is None:
+        return n, 1
     best = 1
     for d in range(1, n + 1):
         if n % d or d > ec_max:
             continue
-        if ec_divides is not None and ec_divides % d:
+        if ec_divides % d:
             continue
         best = d
     return n // best, best

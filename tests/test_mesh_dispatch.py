@@ -1,0 +1,440 @@
+"""Mesh-sharded dispatch: one engine flush fans out across all 8
+virtual devices (conftest pins XLA_FLAGS
+--xla_force_host_platform_device_count=8, the same mechanism the
+driver's multichip dryrun uses).
+
+The load-bearing claims, each pinned here:
+
+  * bit-exactness — mesh-sharded flushes deliver exactly what
+    ec_encode_ref / the recovery-matrix oracle / the scalar CRUSH rule
+    engine compute, for every kernel the engines carry (encode, the
+    heterogeneous-pattern decode with its aux channel, flat_firstn,
+    do_rule);
+  * shard padding — buckets round up to a multiple of the mesh size
+    (jax rejects uneven NamedSharding splits), the pad rows are zeros
+    and sliced off, and the padded accounting is exact;
+  * the jit compile cache is bounded by the (bucket, mesh) table —
+    committed input shardings are part of jax's cache key, so the
+    pow-2 bucket discipline carries over unchanged;
+  * kernel_mesh_devices=1 is the exact seed path: no mesh, pure pow-2
+    buckets, single-device flushes;
+  * telemetry/observability: devices_used, sharded flushes, the mesh
+    gauges, and the ceph_kernel_mesh_* prometheus family.
+
+Chunk widths here (480, 544) are deliberately absent from every other
+suite: the jit cache is process-global and the bounded-cache test
+counts entries.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import telemetry
+from ceph_tpu.ops.dispatch import (DeviceDispatchEngine, bucket_stripes,
+                                   mesh_bucket_stripes,
+                                   submit_do_rule, submit_flat_firstn)
+
+K1, M1, B1 = 4, 2, 480     # bit-exactness suites
+K2, M2, B2 = 6, 2, 544     # bounded-cache suite
+
+
+def _mesh(n=8, **kw):
+    from ceph_tpu.parallel.mesh import make_mesh
+    return make_mesh(n, **kw)
+
+
+def _coding(k, m, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, 256, (m, k), dtype=np.uint8)
+
+
+def _stripes(n, k, b, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, (n, k, b), dtype=np.uint8)
+
+
+# -- bucketing ----------------------------------------------------------------
+
+def test_mesh_bucket_stripes():
+    assert [mesh_bucket_stripes(n, 8) for n in (1, 3, 8, 9, 17, 100)] \
+        == [8, 8, 8, 16, 32, 128]
+    # non-pow2 mesh: pow-2 bucket rounds UP to a mesh multiple
+    assert mesh_bucket_stripes(5, 6) == 12
+    assert mesh_bucket_stripes(1, 1) == 1        # degenerate = seed
+    assert [mesh_bucket_stripes(n, 1) for n in (3, 5, 9)] \
+        == [bucket_stripes(n) for n in (3, 5, 9)]
+
+
+def test_factor_devices_defaults_to_pure_dp():
+    """The engine-mesh bugfix: without an ec_divides promise the split
+    is pure data parallelism — ec > 1 would split chunk rows unevenly
+    for k+m the axis does not divide."""
+    from ceph_tpu.parallel.mesh import factor_devices
+    assert factor_devices(8) == (8, 1)
+    assert factor_devices(4) == (4, 1)
+    assert factor_devices(8, ec_divides=12) == (2, 4)
+    m = _mesh(8)
+    assert dict(m.shape) == {"dp": 8, "ec": 1}
+
+
+# -- bit-exactness ------------------------------------------------------------
+
+def test_threaded_mixed_size_encodes_bit_exact_on_mesh():
+    """6 writers x 5 mixed-size encodes through ONE mesh-sharded
+    engine: every delivered parity equals ec_encode_ref of that
+    writer's own data, and the flushes really land on all 8 devices."""
+    from ceph_tpu.ops.gf_kernel import ec_encode_ref, make_encoder
+    mesh = _mesh(8)
+    coding = _coding(K1, M1)
+    encode = make_encoder(coding, mesh=mesh)
+    stats = telemetry.DispatchStats()
+    eng = DeviceDispatchEngine(max_delay_us=500.0, stats=stats,
+                               mesh=mesh)
+    key = ("ec", K1, M1, B1)
+    errors: list[str] = []
+
+    def writer(wid):
+        rng = np.random.default_rng(300 + wid)
+        for i in range(5):
+            data = _stripes(int(rng.integers(1, 30)), K1, B1,
+                            seed=wid * 100 + i)
+            got = eng.submit(key, encode, data).result(timeout=120)
+            if not (np.asarray(got) == ec_encode_ref(coding, data)).all():
+                errors.append(f"writer {wid} op {i}: mismatch")
+
+    try:
+        threads = [threading.Thread(target=writer, args=(w,))
+                   for w in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not errors, errors
+        assert stats.sharded_flushes == stats.batches > 0
+        d = stats.devices_used.dump()
+        # every flush landed on all 8 devices: the whole histogram
+        # mass sits in the le=8 bucket
+        assert d["sum"] == 8 * d["count"]
+        assert stats.mesh_devices == 8
+        assert stats.shard_stripes.count == stats.batches
+    finally:
+        eng.stop()
+
+
+def test_codec_submit_chunks_mesh_matches_oracle():
+    """ErasureCode.submit_chunks through a mesh engine == the numpy
+    oracle; the cpu-runtime codec opts out of placement (host fn) and
+    still matches."""
+    from ceph_tpu.ec import registry_instance
+    stats = telemetry.DispatchStats()
+    eng = DeviceDispatchEngine(stats=stats, mesh=_mesh(8))
+    try:
+        for runtime in ("tpu", "cpu"):
+            codec = registry_instance().factory(
+                "jerasure", {"technique": "reed_sol_van", "k": str(K1),
+                             "m": str(M1), "runtime": runtime})
+            data = _stripes(9, K1, B1, seed=4)
+            got = codec.submit_chunks(eng, data).result(timeout=120)
+            assert (np.asarray(got)
+                    == codec.encode_chunks(data)).all()
+        assert stats.sharded_flushes >= 1          # the tpu flush
+        assert stats.devices_used.dump()["buckets"][0] >= 1  # the cpu one
+    finally:
+        eng.stop()
+
+
+def test_decode_mixed_patterns_mesh_bit_exact():
+    """submit_decode_chunks through a mesh engine: stripes spanning
+    MIXED erasure patterns share one sharded call (the pattern index
+    rides the aux channel, sharded in lockstep; the stacked matrix
+    table replicates over the mesh) and every rebuilt row equals the
+    recovery-matrix oracle."""
+    from ceph_tpu.ec import registry_instance
+    from ceph_tpu.gf.matrix import recovery_matrix
+    from ceph_tpu.ops.gf_kernel import ec_encode_ref
+    codec = registry_instance().factory(
+        "isa", {"technique": "cauchy", "k": str(K1), "m": str(M1)})
+    gen = codec.generator
+    stats = telemetry.DecodeDispatchStats()
+    eng = DeviceDispatchEngine(max_delay_us=50_000.0, stats=stats,
+                               mesh=_mesh(8))
+    patterns = [((1, 2, 3, 4), (0,)), ((0, 2, 3, 5), (1, 4)),
+                ((0, 1, 3, 4), (2,))]
+    release = threading.Event()
+
+    def slow(a):
+        release.wait(5.0)
+        return a
+
+    try:
+        blocker = eng.submit(("slow", 0), slow, np.zeros((1,), np.uint8))
+        futs, wants = [], []
+        for i, (chosen, targets) in enumerate(patterns):
+            data = _stripes(3 + 2 * i, K1, B1, seed=20 + i)
+            futs.append(codec.submit_decode_chunks(
+                eng, chosen, data, targets))
+            wants.append(ec_encode_ref(
+                recovery_matrix(gen, list(chosen), list(targets)), data))
+        release.set()
+        for f, want in zip(futs, wants):
+            assert (np.asarray(f.result(timeout=120)) == want).all()
+        blocker.result(timeout=120)
+        assert stats.sharded_flushes >= 1
+        # the three patterns coalesced (engine was busy): at least one
+        # call carried > 1 distinct pattern
+        assert stats.patterns.sum > stats.patterns.count
+    finally:
+        eng.stop()
+
+
+def test_crush_submits_mesh_bit_exact_vs_scalar_oracle():
+    """submit_flat_firstn and submit_do_rule through a mesh engine vs
+    the SCALAR rule engine (mapper_ref semantics via scalar_rows): the
+    sharded remap is bit-identical, padded lanes sliced off."""
+    from ceph_tpu.crush import build_flat_map, build_two_level_map
+    from ceph_tpu.crush.mapper_jax import BatchMapper
+    from ceph_tpu.osd.mapping import scalar_rows
+    rng = np.random.default_rng(9)
+    eng = DeviceDispatchEngine(stats=telemetry.DispatchStats(),
+                               mesh=_mesh(8))
+    try:
+        # flat map: submit_flat_firstn vs the scalar oracle rows
+        n_osds = 20
+        weights = [0x10000] * 12 + [0x20000] * 8
+        m, root, rule = build_flat_map(n_osds, weights)
+        bucket = m.bucket(root)
+        ids = np.asarray(bucket.items, dtype=np.int32)
+        w = np.asarray(bucket.item_weights, dtype=np.int64)
+        reweight = np.full(n_osds, 0x10000, dtype=np.int64)
+        reweight[3] = 0
+        xs = rng.integers(0, 2**32, 53, dtype=np.uint32)  # pads to 56
+        got = np.asarray(submit_flat_firstn(
+            eng, xs, ids, w, reweight, numrep=3).result(timeout=120))
+        want = scalar_rows(m, rule, xs, 3, reweight)
+        assert (got == want).all()
+        # two-level map: submit_do_rule vs the scalar oracle
+        m2, _root2, rule2 = build_two_level_map(4, 3)
+        bm = BatchMapper(m2)
+        rw2 = np.full(12, 0x10000, dtype=np.int64)
+        xs2 = rng.integers(0, 2**32, 21, dtype=np.uint32)
+        got2 = np.asarray(submit_do_rule(
+            eng, bm, rule2, xs2, 3, rw2).result(timeout=120))
+        assert (got2 == scalar_rows(m2, rule2, xs2, 3, rw2)).all()
+    finally:
+        eng.stop()
+
+
+# -- shard padding ------------------------------------------------------------
+
+def test_shard_padding_equality_and_accounting():
+    """Sizes that divide the mesh unevenly pad up to a mesh multiple;
+    the delivered slice equals the unpadded reference and the padded
+    accounting is exact (mesh_bucket_stripes, not pow-2)."""
+    from ceph_tpu.ops.gf_kernel import ec_encode_ref, make_encoder
+    mesh = _mesh(8)
+    coding = _coding(K1, M1, seed=1)
+    encode = make_encoder(coding, mesh=mesh)
+    stats = telemetry.DispatchStats()
+    eng = DeviceDispatchEngine(stats=stats, mesh=mesh)
+    sizes = (1, 3, 7, 9, 13)
+    try:
+        for n in sizes:
+            data = _stripes(n, K1, B1, seed=n)
+            got = eng.submit(("pad", K1, M1, B1), encode,
+                             data).result(timeout=120)
+            assert got.shape == (n, M1, B1)
+            assert (np.asarray(got)
+                    == ec_encode_ref(coding, data)).all()
+        assert stats.padded_stripes == sum(
+            mesh_bucket_stripes(n, 8) - n for n in sizes)
+    finally:
+        eng.stop()
+
+
+# -- compile-cache bound ------------------------------------------------------
+
+def test_jit_cache_bounded_by_bucket_and_mesh():
+    """30 randomized write sizes in [1, 64] through a MESH engine
+    compile AT MOST one executable per (mesh-rounded bucket) — the
+    sharding is part of jax's compile-cache key, so the (bucket, mesh)
+    table bounds the cache exactly as the pow-2 table did on one
+    device.  Geometry unique to this test (see module docstring)."""
+    from ceph_tpu.ops.gf_kernel import _jit_entries, make_encoder
+    mesh = _mesh(8)
+    coding = _coding(K2, M2, seed=2)
+    encode = make_encoder(coding, mesh=mesh)
+    eng = DeviceDispatchEngine(stats=telemetry.DispatchStats(),
+                               mesh=mesh)
+    rng = np.random.default_rng(3)
+    sizes = [int(s) for s in rng.integers(1, 65, 30)]
+    try:
+        before = _jit_entries()
+        for i, n in enumerate(sizes):
+            out = eng.submit(("bound", K2, M2, B2), encode,
+                             _stripes(n, K2, B2, seed=i)
+                             ).result(timeout=120)
+            assert out.shape == (n, M2, B2)
+        grown = _jit_entries() - before
+        buckets = {mesh_bucket_stripes(n, 8) for n in sizes}
+        assert grown <= len(buckets), \
+            f"{grown} compiles for {len(buckets)} buckets {sorted(buckets)}"
+    finally:
+        eng.stop()
+
+
+# -- single-device knob == seed path ------------------------------------------
+
+def test_single_device_knob_is_exact_seed_path():
+    """kernel_mesh_devices=1: the context builds NO mesh, engines pad
+    pure pow-2 buckets, and every flush is single-device — byte-
+    identical engine behavior to the pre-mesh seed."""
+    from ceph_tpu.common.context import CephTpuContext
+    from ceph_tpu.ops.gf_kernel import ec_encode_ref, make_encoder
+    ctx = CephTpuContext("mesh-knob1")
+    ctx.conf.set("kernel_mesh_devices", 1)
+    assert ctx.kernel_mesh() is None
+    stats = telemetry.DispatchStats()
+    eng = DeviceDispatchEngine(stats=stats, mesh=ctx.kernel_mesh())
+    coding = _coding(K1, M1, seed=5)
+    encode = make_encoder(coding)
+    sizes = (3, 5, 11)
+    try:
+        for n in sizes:
+            data = _stripes(n, K1, B1, seed=40 + n)
+            got = eng.submit(("knob1", K1, M1, B1), encode,
+                             data).result(timeout=120)
+            assert (np.asarray(got)
+                    == ec_encode_ref(coding, data)).all()
+        # seed pow-2 padding accounting, to the stripe
+        assert stats.padded_stripes == sum(
+            bucket_stripes(n) - n for n in sizes)
+        assert stats.sharded_flushes == 0
+        assert stats.mesh_devices == 0
+        d = stats.devices_used.dump()
+        assert d["buckets"][0] == d["count"] == stats.batches
+    finally:
+        eng.stop()
+
+
+def test_context_mesh_knob_default_and_hot_reload():
+    """Default knob (0 = all) builds the 8-device mesh; flipping the
+    knob at runtime swaps the mesh into LIVE engines (next flush)."""
+    from ceph_tpu.common.context import CephTpuContext
+    ctx = CephTpuContext("mesh-reload")
+    mesh = ctx.kernel_mesh()
+    assert mesh is not None and int(mesh.size) == 8
+    eng = ctx.dispatch_engine()
+    stats = eng.stats
+    data = _stripes(4, K1, B1, seed=77)
+    try:
+        eng.submit(("hot", K1, B1, 0), lambda a: a, data,
+                   ).result(timeout=120)
+        s0 = stats.sharded_flushes
+        assert s0 >= 1
+        ctx.conf.set("kernel_mesh_devices", 1)
+        assert ctx.kernel_mesh() is None
+        eng.submit(("hot", K1, B1, 1), lambda a: a, data,
+                   ).result(timeout=120)
+        assert stats.sharded_flushes == s0       # unsharded now
+        ctx.conf.set("kernel_mesh_devices", 0)
+        eng.submit(("hot", K1, B1, 2), lambda a: a, data,
+                   ).result(timeout=120)
+        assert stats.sharded_flushes == s0 + 1   # sharded again
+    finally:
+        eng.stop()
+
+
+# -- mapping-service diff -----------------------------------------------------
+
+def test_mapping_diff_shards_over_mesh_and_matches_host():
+    """The on-device old-vs-new raw diff with a mesh equals the host
+    diff — for mesh-divisible row counts (sharded) and indivisible
+    ones (single-device fallback) alike."""
+    from ceph_tpu.osd.mapping import _changed_rows
+    mesh = _mesh(8)
+    rng = np.random.default_rng(11)
+    for rows in (64, 61):       # divisible / not
+        old = rng.integers(0, 50, (rows, 3)).astype(np.int32)
+        new = old.copy()
+        idx = rng.choice(rows, size=7, replace=False)
+        new[idx, 0] += 1
+        want = np.flatnonzero((old != new).any(axis=1))
+        got = _changed_rows(old, new, mesh=mesh)
+        assert (np.sort(got) == want).all()
+
+
+# -- observability ------------------------------------------------------------
+
+class _FakeMap:
+    max_osd = 1
+    epoch = 3
+    osd_weight = [0x10000]
+
+    def is_up(self, o):
+        return True
+
+    def exists(self, o):
+        return True
+
+
+class _FakeMgr:
+    osdmap = _FakeMap()
+
+    def get(self, name):
+        return {
+            "health": {"status": "HEALTH_OK"},
+            "pg_summary": {},
+            "df": {"total_objects": 0, "total_bytes_used": 0},
+            "counters": {},
+            "perf_reports": {},
+        }[name]
+
+    def get_store(self, key, default=None):
+        return default
+
+
+def test_prometheus_mesh_family_and_stats_dump():
+    """A sharded flush surfaces in dump_dispatch_stats (devices_used /
+    sharded_flushes / mesh gauges) and the scrape exports the
+    ceph_kernel_mesh_* family for both engines."""
+    from ceph_tpu.mgr.modules.prometheus import Module
+    telemetry.reset()
+    eng = DeviceDispatchEngine(stats=telemetry.dispatch_stats(),
+                               mesh=_mesh(8))
+    try:
+        eng.submit(("prom", 0), lambda a: a,
+                   np.zeros((5, 4), np.int64)).result(timeout=120)
+    finally:
+        eng.stop()
+    d = telemetry.dispatch_dump()
+    assert d["sharded_flushes"] == 1
+    assert d["mesh_devices"] == 8 and d["mesh_dp"] == 8
+    assert d["devices_used"]["sum"] == 8
+    assert d["shard_stripes"]["count"] == 1
+    mod = Module.__new__(Module)
+    mod.mgr = _FakeMgr()
+    text = mod.scrape_text()
+    assert 'ceph_kernel_mesh_devices{engine="encode"} 8' in text
+    assert 'ceph_kernel_mesh_devices{engine="decode"} 0' in text
+    assert 'ceph_kernel_mesh_sharded_flushes_total{engine="encode"} 1' \
+        in text
+    assert '# TYPE ceph_kernel_mesh_flush_devices histogram' in text
+    assert 'ceph_kernel_mesh_shard_stripes_bucket' in text
+
+
+# -- deployment mode (two OS processes, one global mesh) ----------------------
+
+@pytest.mark.slow
+def test_dcn_engine_pair_two_processes():
+    """The deployment-mode proof: two OS processes, each constructing
+    CephTpuContext(process_index=, n_processes=, coordinator=), share
+    one global mesh; each drives an EC write workload through its
+    mesh-sharded engine (flushes fan out over its local submesh), runs
+    a global-mesh DCN collective, and cross-checks digests over the
+    TCP messenger stack pick_stack routes to."""
+    from ceph_tpu.parallel.dcn import run_engine_pair
+    run_engine_pair(8)

@@ -341,6 +341,76 @@ class TestJitPurity:
             """}, checks=("jit-purity",))
         assert rep.findings == []
 
+    def test_placement_scaffolding_store_exempt(self, tmp_path):
+        """The mesh-dispatch idiom: an engine closure caching a
+        jax.device_put/NamedSharding placement into captured state is
+        host-side sharding scaffolding (it runs on the engine thread
+        outside any trace), NOT a tracer leak — no mutation finding."""
+        rep = _run(tmp_path, {"m.py": """
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+            def submit_it(eng, data, tables, ids):
+                def fn(batch):
+                    mesh = batch.sharding.mesh
+                    ops = tables.get("ops")
+                    if ops is None:
+                        ops = tables["ops"] = jax.device_put(
+                            ids, NamedSharding(mesh, PartitionSpec()))
+                    return ops
+                return eng.submit(("k",), fn, data)
+            """}, checks=("jit-purity",))
+        assert rep.findings == []
+
+    def test_non_placement_store_still_fires(self, tmp_path):
+        """The exemption is scoped to placement construction: the same
+        captured-store shape WITHOUT device_put/NamedSharding on the
+        right-hand side stays a mutation finding."""
+        rep = _run(tmp_path, {"m.py": """
+            def submit_it(eng, data, tables, ids):
+                def fn(batch):
+                    ops = tables.get("ops")
+                    if ops is None:
+                        ops = tables["ops"] = (ids, batch.shape)
+                    return ops
+                return eng.submit(("k",), fn, data)
+            """}, checks=("jit-purity",))
+        codes = sorted(f.code for f in rep.findings)
+        assert codes == ["mutation"]
+
+    def test_jit_traced_placement_store_still_fires(self, tmp_path):
+        """The exemption is scoped to engine submit closures: inside a
+        function genuinely TRACED by jax.jit the same device_put store
+        runs once at trace time and never on cache hits — it stays a
+        mutation finding."""
+        rep = _run(tmp_path, {"m.py": """
+            import jax
+            @jax.jit
+            def k(x, cache):
+                cache["dev"] = jax.device_put(x)
+                return x
+            """}, checks=("jit-purity",))
+        codes = sorted(f.code for f in rep.findings)
+        assert codes == ["mutation"]
+
+    def test_compound_rhs_with_placement_still_fires(self, tmp_path):
+        """The exemption covers stores whose WHOLE value is placement
+        construction: a compound RHS smuggling other state next to a
+        device_put stays a mutation finding."""
+        rep = _run(tmp_path, {"m.py": """
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+            def submit_it(eng, data, tables, ids):
+                def fn(batch):
+                    if "ops" not in tables:
+                        tables["ops"] = (batch.sum(), jax.device_put(
+                            ids, NamedSharding(batch.sharding.mesh,
+                                               PartitionSpec())))
+                    return tables["ops"]
+                return eng.submit(("k",), fn, data)
+            """}, checks=("jit-purity",))
+        codes = sorted(f.code for f in rep.findings)
+        assert codes == ["mutation"]
+
 
 # -- registry -----------------------------------------------------------------
 
