@@ -40,9 +40,14 @@ Sections: the run is split into named sweeps selectable with
   map_churn       map-epoch consumption storm: scalar full-scan vs the
                   shared PG mapping service (epochs/s, per-epoch scan
                   time, changed-PG counts), bit-verified vs the oracle
+  profile         pipeline-profile micro-section: a short concurrent
+                  encode/decode burst + a few mapping epochs, emitting
+                  the where-did-the-time-go digest (phase shares,
+                  compile seconds, utilization) into the JSON
 
-Default (no flag) runs every section EXCEPT map_churn — byte-compatible
-with the historical flagship JSON; ``--sections all`` adds map_churn.
+Default (no flag) runs every section EXCEPT map_churn and profile —
+byte-compatible with the historical flagship JSON; ``--sections all``
+adds both.
 """
 
 from __future__ import annotations
@@ -447,8 +452,90 @@ def map_churn(pools: int = 6, pg_num: int = 256, hosts: int = 16,
     }
 
 
+def profile_section(k: int = 8, m: int = 4, chunk: int = 1024,
+                    writers: int = 4, ops_each: int = 10,
+                    epochs: int = 4) -> dict:
+    """Pipeline-profile micro-section: a short burst of concurrent
+    encodes + heterogeneous decodes through context-backed dispatch
+    engines and a few mapping epochs, then the profiler digest — the
+    bench JSON gains the same where-did-the-time-go attribution
+    (phase shares, compile seconds, utilization, mapping phase split)
+    an operator reads from ``dump_pipeline_profile`` on a live
+    daemon.  Deliberately tiny: it exists to capture phase SHARES per
+    bench round, not to be a throughput sweep."""
+    import threading
+
+    from ceph_tpu.common.context import CephTpuContext
+    from ceph_tpu.crush import build_two_level_map
+    from ceph_tpu.ec import registry_instance
+    from ceph_tpu.ops import telemetry
+    from ceph_tpu.osd import OSDMap, PGPool, SharedPGMappingService
+
+    # the phase ledgers are process-global and earlier sections'
+    # engines feed them: clear so the digest describes THIS section's
+    # burst (shares, first-call compile events, utilization window),
+    # not the whole run.  Runs last in main(), after every other
+    # section's digest is already captured into the JSON.
+    telemetry.dispatch_stats().phases.clear()
+    telemetry.decode_dispatch_stats().phases.clear()
+    telemetry.mapping_stats().clear()
+    codec = registry_instance().factory(
+        "isa", {"technique": "cauchy", "k": str(k), "m": str(m)})
+    ctx = CephTpuContext("bench-profile")
+    eng = ctx.dispatch_engine()
+    deng = ctx.decode_dispatch_engine()
+    rng = np.random.default_rng(13)
+    op = rng.integers(0, 256, (32, k, chunk), dtype=np.uint8)
+    patterns = []
+    for e0 in range(min(k, 3)):
+        erased = (e0, (e0 + 2) % k)
+        erased = tuple(sorted(set(erased)))
+        chosen = [c for c in range(k + m) if c not in erased][:k]
+        patterns.append((tuple(chosen), erased))
+    start = threading.Barrier(writers + 1)
+
+    def actor(aid):
+        start.wait()
+        for i in range(ops_each):
+            codec.submit_chunks(eng, op).result(timeout=120)
+            if i % 2 == 0:
+                chosen, targets = patterns[(aid + i) % len(patterns)]
+                codec.submit_decode_chunks(
+                    deng, chosen, op, targets).result(timeout=120)
+
+    threads = [threading.Thread(target=actor, args=(a,), daemon=True)
+               for a in range(writers)]
+    for t in threads:
+        t.start()
+    start.wait()
+    for t in threads:
+        t.join()
+    eng.flush()
+    deng.flush()
+    # a few mapping epochs so the digest's mapping phase split is live
+    crush, _root, rule = build_two_level_map(4, 2)
+    mp = OSDMap(crush=crush, epoch=2)
+    mp.set_max_osd(8)
+    for o in range(8):
+        mp.mark_up(o)
+    for p in (1, 2):
+        mp.pools[p] = PGPool(pool_id=p, size=3, crush_rule=rule,
+                             pg_num=64)
+    svc = SharedPGMappingService()
+    svc.update_to(mp)
+    for i in range(epochs):
+        new = mp.copy()
+        new.epoch = mp.epoch + 1
+        new.osd_weight[i % 8] = 0x8000 if i % 2 else 0x10000
+        svc.update_to(new)
+        mp = new
+    for e in (eng, deng):
+        e.stop()
+    return telemetry.pipeline_profile_digest()
+
+
 SECTIONS = ("ec", "crush", "dispatch_sweep", "recovery_sweep",
-            "map_churn")
+            "map_churn", "profile")
 #: the historical flagship run (map_churn is opt-in: it is a
 #: consumption-path sweep, not a device-kernel headline)
 DEFAULT_SECTIONS = ("ec", "crush", "dispatch_sweep", "recovery_sweep")
@@ -677,6 +764,14 @@ def main(argv=None) -> None:
         # map-epoch consumption: scalar full scan vs the shared PG
         # mapping service, bit-verified against the oracle
         out["map_churn"] = map_churn()
+
+    if "profile" in secs:
+        # pipeline phase attribution: where a coalesced batch's
+        # submit->delivery wall-clock goes (phase shares, compile
+        # seconds, utilization, mapping phase split) — the
+        # dump_pipeline_profile story embedded per bench round.
+        # Render with: python -m ceph_tpu.tools.profile_report
+        out["profile"] = profile_section()
 
     if "metric" not in out:
         out = {"metric": "sections " + "+".join(sorted(secs)),

@@ -171,6 +171,10 @@ register_options([
            "concurrent decodes coalesce into one device call even "
            "with different erasure patterns (heterogeneous-matrix "
            "batched kernel); off = decode synchronously per gather"),
+    Option("kernel_profile_ring", OPT_INT, 256,
+           "recent per-batch pipeline-profile records retained per "
+           "dispatch engine (the dump_pipeline_profile ring); "
+           "aggregated phase histograms are unbounded-time regardless"),
     Option("kernel_fence_for_timing", OPT_BOOL, False,
            "fence (block_until_ready) each instrumented device kernel "
            "call so telemetry latency samples are real device time; "

@@ -105,7 +105,18 @@ class CephTpuContext:
             lambda **kw: telemetry.mapping_dump(),
             "shared PG-mapping-service telemetry: epoch-update "
             "latency, pools recomputed vs reused, changed-PG counts, "
-            "epoch-skips, cache lookups vs scalar fallbacks")
+            "epoch-skips, cache lookups vs scalar fallbacks, and the "
+            "per-epoch device/delta/host-tail phase split")
+        self.admin.register_command(
+            "dump_pipeline_profile",
+            lambda **kw: telemetry.pipeline_profile_dump(),
+            "per-batch pipeline phase attribution for both dispatch "
+            "engines: queue-wait/build/place/launch/compute/"
+            "materialize/deliver histograms per kernel family, the "
+            "compile ledger (first-call jit cost, separate from "
+            "steady-state compute), device busy-seconds/utilization/"
+            "shard-imbalance, a ring of recent per-batch records, and "
+            "the mapping service's epoch phase split")
 
     def kernel_mesh(self):
         """The ("dp", "ec") device mesh this context's dispatch engines

@@ -72,10 +72,20 @@ _slow: "OrderedDict[int, dict]" = OrderedDict()
 
 
 class Span:
-    """One node of a trace tree."""
+    """One node of a trace tree.
+
+    Two clocks per span, deliberately: ``start``/``end`` are
+    wall-clock DISPLAY timestamps (row ordering, dashboards, humans
+    correlating with logs), while ``start_mono``/``end_mono`` pair a
+    monotonic clock for every DURATION — an NTP step mid-span used to
+    yield negative/skewed durations, which then mis-ranked the
+    slow-trace tail sampling exactly when a clock jump made latency
+    interesting.
+    """
 
     __slots__ = ("trace_id", "span_id", "parent_span_id", "name",
-                 "daemon", "start", "end", "attrs")
+                 "daemon", "start", "end", "attrs", "start_mono",
+                 "end_mono")
 
     def __init__(self, trace_id: int, span_id: int, parent_span_id: int,
                  name: str, daemon: str, start: float,
@@ -86,12 +96,16 @@ class Span:
         self.name = name
         self.daemon = daemon
         self.start = start
+        self.start_mono = time.monotonic()
         self.end: float | None = None
+        self.end_mono: float | None = None
         self.attrs = attrs or {}
 
     @property
     def duration(self) -> float | None:
-        return None if self.end is None else self.end - self.start
+        """Monotonic-clock duration (never negative, NTP-immune)."""
+        return (None if self.end_mono is None
+                else self.end_mono - self.start_mono)
 
     def row(self) -> dict:
         r = {"trace_id": self.trace_id, "daemon": self.daemon,
@@ -220,10 +234,20 @@ def begin_span(name: str, daemon: str, trace_id: int | None = None,
 
 
 def finish_span(span: Span | None, t: float | None = None) -> None:
+    """Close a span.  ``t`` (wall clock) overrides the DISPLAY end
+    timestamp only — duration math always pairs the monotonic clock,
+    with an explicit t treated as a caller-computed wall offset from
+    the span's own start (``t=span.start`` = instantaneous marker), so
+    a stepped wall clock can never produce a negative duration."""
     if span is None:
         return
     with _lock:
-        span.end = time.time() if t is None else t
+        if t is None:
+            span.end = time.time()
+            span.end_mono = time.monotonic()
+        else:
+            span.end = t
+            span.end_mono = span.start_mono + max(0.0, t - span.start)
 
 
 def span_event(span: Span | None, event: str,

@@ -63,8 +63,11 @@ class MMgrReport(Message):
     every set: osd, messenger, store), the payload the prometheus
     module turns into real histogram/summary families; v4 appends the
     observability tail — the daemon's tail-sampled slow-trace digests
-    (span rows) and historic slow-op digests, the insights module's
-    cluster-wide `tracing ls` / `slow_ops` feed.  Older peers
+    (span rows), historic slow-op digests, and the pipeline-profile
+    phase digest (telemetry.pipeline_profile_digest), the insights
+    module's cluster-wide `tracing ls` / `slow_ops` / `profile` feed.
+    The tail is a JSON dict, so the profile key rides the SAME v4
+    frame — old peers simply never read it.  Older peers
     interoperate: the versioned section skips trailing fields (old
     mgrs simply never see the v4 tail)."""
 
@@ -77,7 +80,8 @@ class MMgrReport(Message):
                  bytes_used: int = 0, pg_stats: dict | None = None,
                  perf: dict | None = None,
                  slow_traces: list | None = None,
-                 slow_ops: list | None = None):
+                 slow_ops: list | None = None,
+                 profile: dict | None = None):
         super().__init__()
         self.osd_id = osd_id
         self.counters = counters or {}
@@ -92,6 +96,9 @@ class MMgrReport(Message):
         self.slow_traces = slow_traces or []
         #: slowest historic-op digests (OpTracker.slow_digests)
         self.slow_ops = slow_ops or []
+        #: pipeline-profile phase digest (phase shares per kernel
+        #: family, compile ledger, utilization, mapping phase split)
+        self.profile = profile or {}
 
     def encode_payload(self, enc: Encoder):
         enc.versioned(4, 1, lambda e: (
@@ -107,7 +114,8 @@ class MMgrReport(Message):
             # JSON inside the versioned frame keeps the wire stable
             e.str(json.dumps(self.perf)),
             e.str(json.dumps({"slow_traces": self.slow_traces,
-                              "slow_ops": self.slow_ops}))))
+                              "slow_ops": self.slow_ops,
+                              "profile": self.profile}))))
 
     def decode_payload(self, dec: Decoder, version):
         # decode constructs via __new__: every field needs a default
@@ -116,6 +124,7 @@ class MMgrReport(Message):
         self.perf = {}
         self.slow_traces = []
         self.slow_ops = []
+        self.profile = {}
 
         def body(d, v):
             self.osd_id = d.s32()
@@ -133,6 +142,7 @@ class MMgrReport(Message):
                 tail = json.loads(d.str())
                 self.slow_traces = tail.get("slow_traces", [])
                 self.slow_ops = tail.get("slow_ops", [])
+                self.profile = tail.get("profile", {})
         dec.versioned(4, body)
 
 
@@ -701,11 +711,13 @@ class MgrDaemon(Dispatcher):
 
     def insights_feed(self) -> dict:
         """Per-daemon observability tail from MMgrReport v4: slow-trace
-        digests and historic slow-op digests (the insights module's
-        cluster-wide ranking feed)."""
+        digests, historic slow-op digests, and the pipeline-profile
+        phase digest (the insights module's cluster-wide ranking and
+        where-did-the-time-go feed)."""
         with self._lock:
             return {o: {"slow_traces": list(r.slow_traces),
                         "slow_ops": list(r.slow_ops),
+                        "profile": dict(r.profile),
                         "stamp": t}
                     for o, (t, r) in self.reports.items()}
 

@@ -3,19 +3,32 @@
 needs).
 
 Every daemon ships its tail-sampled slow traces (completed span trees
-whose root crossed ``tracing_slow_threshold``) and its historic
-slow-op digests in MMgrReport v4; this module merges them across the
-cluster, ranks the slowest, and serves three mgr commands:
+whose root crossed ``tracing_slow_threshold``), its historic slow-op
+digests, and its pipeline-profile phase digest in MMgrReport v4; this
+module merges them across the cluster, ranks the slowest, and serves
+five mgr commands:
 
   * ``tracing ls``        — slowest retained traces cluster-wide
   * ``tracing show <id>`` — one trace's stitched span TREE (rows from
                             every reporting daemon merged by span_id)
   * ``slow_ops``          — slowest completed ops across all daemons
+  * ``profile phases``    — cluster-wide where-did-the-time-go: phase
+                            seconds/shares per engine × kernel family,
+                            compile ledger, mapping epoch split
+  * ``profile top``       — top-N (engine, kernel, phase) stalls by
+                            cluster-total seconds
 
 The in-process MiniCluster shares one tracing table so every daemon
 reports the same ring (merged here by trace_id); multi-process daemons
 each ship only their own spans and the merge stitches the cross-daemon
-tree, exactly like zipkin collectors joining on trace id.
+tree, exactly like zipkin collectors joining on trace id.  Profile
+digests merge by SUMMING phase seconds across daemons (multi-process
+daemons have distinct telemetry registries, so engine pipelines are
+distinct), with one dedup rule mirroring the tracing/slow-op merges:
+daemons shipping a byte-identical digest are reading ONE shared
+process-global registry (the in-process MiniCluster topology), so
+they contribute once, with every reporter listed — otherwise an
+N-daemon in-process cluster would inflate every total N-fold.
 """
 
 from __future__ import annotations
@@ -35,6 +48,13 @@ class Module(MgrModule):
                  "(trace_id=<id>)"},
         {"prefix": "slow_ops",
          "help": "slowest completed ops across all daemons"},
+        {"prefix": "profile phases",
+         "help": "cluster-wide pipeline phase attribution per engine "
+                 "and kernel family (seconds + shares, compile "
+                 "ledger, mapping epoch split)"},
+        {"prefix": "profile top",
+         "help": "top-N (engine, kernel, phase) stalls by "
+                 "cluster-total seconds (limit=<n>)"},
     ]
 
     # -- aggregation ----------------------------------------------------------
@@ -108,6 +128,96 @@ class Module(MgrModule):
         return sorted(uniq.values(),
                       key=lambda o: -o.get("duration", 0.0))[:limit]
 
+    # -- pipeline-profile aggregation -----------------------------------------
+
+    def profile_phases(self) -> dict:
+        """Cluster-merged where-did-the-time-go: per engine × kernel
+        family, phase seconds summed across every reporting daemon
+        (shares recomputed over the merged totals), the compile
+        ledger, utilization per daemon, and the mapping epoch split."""
+        engines: dict = {}
+        compile_: dict = {}
+        util: dict = {}
+        mapping = {"seconds": {}, "epochs": 0}
+        # dedup byte-identical digests (shared in-process registry —
+        # see module docstring): one contribution, every reporter
+        by_digest: dict = {}
+        for osd, feed in sorted(self._feed().items()):
+            prof = feed.get("profile") or {}
+            if not prof:
+                continue
+            key = json.dumps(prof, sort_keys=True)
+            entry = by_digest.setdefault(key, (prof, []))
+            entry[1].append(osd)
+        for prof, osds in by_digest.values():
+            for engine in ("encode", "decode"):
+                d = prof.get(engine) or {}
+                for kernel, row in (d.get("kernels") or {}).items():
+                    cur = engines.setdefault(engine, {}).setdefault(
+                        kernel, {"seconds": {}, "batches": 0,
+                                 "reported_by": []})
+                    for ph, s in (row.get("seconds") or {}).items():
+                        cur["seconds"][ph] = \
+                            cur["seconds"].get(ph, 0.0) + s
+                    cur["batches"] += row.get("batches", 0)
+                    cur["reported_by"].extend(osds)
+                for kernel, c in (d.get("compile") or {}).items():
+                    cc = compile_.setdefault(engine, {}).setdefault(
+                        kernel, {"seconds": 0.0, "events": 0,
+                                 "reported_by": []})
+                    cc["seconds"] += c.get("seconds", 0.0)
+                    cc["events"] += c.get("events", 0)
+                    cc["reported_by"].extend(osds)
+                if d:
+                    for o in osds:   # gauges, not sums: safe to
+                        # repeat for every daemon sharing the digest
+                        util.setdefault(engine, {})[f"osd.{o}"] = {
+                            "busy_seconds": d.get("busy_seconds", 0.0),
+                            "utilization": d.get("utilization", 0.0),
+                            "devices_seen": d.get("devices_seen", 1)}
+            mp = prof.get("mapping") or {}
+            for ph, s in (mp.get("seconds") or {}).items():
+                mapping["seconds"][ph] = \
+                    mapping["seconds"].get(ph, 0.0) + s
+            mapping["epochs"] += mp.get("epochs", 0)
+        for per in engines.values():
+            for cur in per.values():
+                total = sum(cur["seconds"].values())
+                cur["share"] = {
+                    ph: (round(s / total, 4) if total else 0.0)
+                    for ph, s in cur["seconds"].items()}
+        return {"engines": engines, "compile": compile_,
+                "utilization": util, "mapping": mapping}
+
+    def profile_top(self, limit: int = 10) -> list[dict]:
+        """Ranked (engine, kernel, phase) rows by cluster-total
+        seconds — the top stalls.  Compile cost ranks too, as its own
+        ``compile`` phase row, so a retrace storm surfaces next to a
+        queue-wait stall instead of hiding in a separate ledger."""
+        merged = self.profile_phases()
+        rows = []
+        for engine, per in merged["engines"].items():
+            for kernel, cur in per.items():
+                total = sum(cur["seconds"].values())
+                for ph, s in cur["seconds"].items():
+                    rows.append({
+                        "engine": engine, "kernel": kernel,
+                        "phase": ph, "seconds": round(s, 6),
+                        "share": (round(s / total, 4) if total
+                                  else 0.0),
+                        "reported_by": cur["reported_by"]})
+        for engine, per in merged["compile"].items():
+            for kernel, c in per.items():
+                rows.append({
+                    "engine": engine, "kernel": kernel,
+                    "phase": "compile",
+                    "seconds": round(c["seconds"], 6),
+                    "share": None,
+                    "events": c["events"],
+                    "reported_by": c["reported_by"]})
+        rows.sort(key=lambda r: -r["seconds"])
+        return rows[:limit]
+
     # -- command tier ---------------------------------------------------------
 
     def handle_command(self, cmd: dict) -> tuple[str, int]:
@@ -126,4 +236,9 @@ class Module(MgrModule):
         if prefix == "slow_ops":
             limit = int(cmd.get("limit", 20))
             return json.dumps({"ops": self.slow_ops(limit)}), 0
+        if prefix == "profile phases":
+            return json.dumps(self.profile_phases()), 0
+        if prefix == "profile top":
+            limit = int(cmd.get("limit", 10))
+            return json.dumps({"stalls": self.profile_top(limit)}), 0
         return f"module {self.NAME} has no command {prefix!r}", -22

@@ -137,6 +137,7 @@ class Module(MgrModule):
         self._scrape_dispatch(exp)
         self._scrape_decode_dispatch(exp)
         self._scrape_mapping(exp)
+        self._scrape_phase_profile(exp)
         return exp.render()
 
     def _scrape_cluster(self, exp: Exposition) -> None:
@@ -352,6 +353,67 @@ class Module(MgrModule):
         exp.gauge(f"{p}_cached_pools",
                   "pools resident in the cached raw tables",
                   d["cached_pools"])
+        for phase, h in sorted(d["phase_seconds"].items()):
+            exp.histogram(
+                f"{p}_phase_seconds",
+                "per-epoch mapping cost split: device remap vs "
+                "changed-PG candidate extraction (delta) vs the host "
+                "pipeline tail (state/affinity/upmap filtering)",
+                h["bounds"], h["buckets"], h["sum"], {"phase": phase})
+
+    @staticmethod
+    def _scrape_phase_profile(exp: Exposition) -> None:
+        """The pipeline phase profiler (ops.telemetry.PhaseStats):
+        where each flushed batch's submit→delivery wall-clock went,
+        per engine × kernel family × phase, with first-call jit cost
+        in its own compile families and the device-utilization story
+        (busy seconds, utilization gauge, shard imbalance).  Ring-less
+        dump — the scrape reads only aggregates; the mapping phase
+        split is emitted by _scrape_mapping, which already holds the
+        mapping dump."""
+        prof = telemetry.pipeline_profile_dump(include_recent=False)
+        for engine in ("encode", "decode"):
+            d = prof[engine]
+            lab = {"engine": engine}
+            for kernel, per in sorted(d["phases"].items()):
+                for phase, h in sorted(per.items()):
+                    exp.histogram(
+                        "ceph_kernel_phase_seconds",
+                        "seconds each pipeline phase contributed per "
+                        "coalesced batch (phases sum to the batch's "
+                        "submit-to-delivery wall-clock; compile "
+                        "batches report launch/compute in the "
+                        "compile families instead)",
+                        h["bounds"], h["buckets"], h["sum"],
+                        {**lab, "kernel": kernel, "phase": phase})
+            for kernel, c in sorted(d["compile"].items()):
+                klab = {**lab, "kernel": kernel}
+                exp.counter("ceph_kernel_compile_seconds_total",
+                            "jit trace+compile seconds attributed to "
+                            "first-call batches per (kernel, bucket, "
+                            "mesh), separate from steady-state "
+                            "compute", c["seconds"], klab)
+                exp.counter("ceph_kernel_compile_events_total",
+                            "first-call batches that paid a jit "
+                            "trace+compile", c["events"], klab)
+            exp.counter("ceph_kernel_util_busy_seconds_total",
+                        "device-busy integral: compute seconds times "
+                        "devices each flush landed on",
+                        d["busy_seconds"], lab)
+            exp.gauge("ceph_kernel_util_utilization",
+                      "device-busy fraction of the profiling window "
+                      "(busy seconds / wall / devices)",
+                      d["utilization"], lab)
+            exp.gauge("ceph_kernel_util_devices",
+                      "widest flush fan-out the profiler observed",
+                      d["devices_seen"], lab)
+            si = d["shard_imbalance"]
+            exp.histogram("ceph_kernel_util_shard_imbalance",
+                          "padded-lane share per sharded flush (rows "
+                          "are contiguous, so padding concentrates in "
+                          "the tail shards — mass near 0 means even "
+                          "per-chip work)",
+                          si["bounds"], si["buckets"], si["sum"], lab)
 
     @staticmethod
     def _emit_coalesce(exp: Exposition, d: dict, p: str) -> None:

@@ -156,16 +156,23 @@ class _Request:
 
 
 class _Batch:
-    __slots__ = ("out", "reqs", "slices", "exc", "t_dispatch", "misses")
+    __slots__ = ("out", "reqs", "slices", "exc", "t_dispatch", "misses",
+                 "profile")
 
     def __init__(self, out, reqs, slices, exc=None, t_dispatch=0.0,
-                 misses=None):
+                 misses=None, profile=None):
         self.out = out
         self.reqs = reqs
         self.slices = slices
         self.exc = exc
         self.t_dispatch = t_dispatch
         self.misses = misses
+        #: dispatch-side half of the phase ledger (telemetry.PHASES):
+        #: monotonic anchors + build/place/launch durations; the
+        #: completion thread closes compute/materialize/deliver and
+        #: records the batch profile.  None when the dispatch died
+        #: before the ledger started.
+        self.profile = profile
 
 
 def bucket_stripes(n: int) -> int:
@@ -554,6 +561,15 @@ class DeviceDispatchEngine:
         exc = None
         out = None
         misses = None
+        # phase ledger (telemetry.PHASES): contiguous monotonic marks —
+        # queue_wait ended at `now`; build/place/launch close below;
+        # the completion thread closes compute/materialize/deliver so
+        # the phase sum reconstructs submit→delivery wall-clock exactly
+        profile = {"t_submit0": reqs[0].t_submit, "t0": now,
+                   "build": 0.0, "place": 0.0, "launch": 0.0,
+                   "t_launch_end": now, "bucket": bucket,
+                   "devices": devices, "stripes": total,
+                   "family": reqs[0].label}
         try:
             # everything fallible — pad allocation / concatenate
             # (MemoryError under pressure, shape mismatch), span
@@ -587,6 +603,8 @@ class DeviceDispatchEngine:
                                                axis=0))
                     aux_batch += (parts[0] if len(parts) == 1
                                   else np.concatenate(parts, axis=0),)
+            t_build_end = time.monotonic()
+            profile["build"] = t_build_end - now
             if placement is not None:
                 # device_put with the sharding on dispatch: the batch
                 # (and its aux arrays, in lockstep) split their leading
@@ -597,6 +615,8 @@ class DeviceDispatchEngine:
                 # and fans to the batch's futures like any build error.
                 batch_arr = placement.put(batch_arr)
                 aux_batch = tuple(placement.put(a) for a in aux_batch)
+            t_place_end = time.monotonic()
+            profile["place"] = t_place_end - t_build_end
             traced = [r for r in reqs if r.trace is not None]
             if traced:
                 from ceph_tpu.common import tracing
@@ -605,6 +625,16 @@ class DeviceDispatchEngine:
                         f"device {r.label}", "device",
                         trace_id=r.trace[0], parent_span_id=r.trace[1])
                     if r.span is not None:
+                        # the per-phase story a slow traced op needs:
+                        # how long it queued for coalescing company and
+                        # how long the padded batch took to assemble,
+                        # next to the existing h2d/compute/d2h events
+                        tracing.span_event(
+                            r.span, "queue-wait "
+                            f"{(now - r.t_submit) * 1e3:.3f}ms")
+                        tracing.span_event(
+                            r.span,
+                            f"build {profile['build'] * 1e3:.3f}ms")
                         tracing.span_event(r.span, f"h2d {r.data.nbytes}B")
             before = None
             if reqs[0].cache_entries is not None:
@@ -613,6 +643,10 @@ class DeviceDispatchEngine:
                 except Exception:
                     before = None
             out = reqs[0].fn(batch_arr, *aux_batch)  # async dispatch on jax
+            profile["t_launch_end"] = time.monotonic()
+            # span bookkeeping + the cache probe sit between place and
+            # launch: charge them to launch so the ledger stays gapless
+            profile["launch"] = profile["t_launch_end"] - t_place_end
             if before is not None:
                 try:
                     misses = max(0, reqs[0].cache_entries() - before)
@@ -634,7 +668,8 @@ class DeviceDispatchEngine:
                 self._building -= 1
                 self._inflight.append(_Batch(out, reqs, slices, exc,
                                              t_dispatch=time.monotonic(),
-                                             misses=misses))
+                                             misses=misses,
+                                             profile=profile))
                 self.stats.set_in_flight(len(self._inflight)
                                          + self._building)
                 self._cv.notify_all()
@@ -651,9 +686,25 @@ class DeviceDispatchEngine:
                     self._cv.wait(0.05 if self._stop else None)
                 batch = self._inflight[0]
             host, exc = None, batch.exc
+            t_ready = t_mat = 0.0
             if exc is None:
                 try:
-                    host = np.asarray(batch.out)   # blocks until ready
+                    # split device compute from d2h: waiting out the
+                    # async execution first (free — the work is already
+                    # in flight) leaves np.asarray measuring only the
+                    # materialize copy.  compute is anchored at launch
+                    # end, so completion-thread pickup wait (which
+                    # overlaps execution under double buffering) is
+                    # attributed to compute, keeping the ledger gapless.
+                    wait = getattr(batch.out, "block_until_ready", None)
+                    if wait is not None:
+                        try:
+                            wait()
+                        except Exception:
+                            pass   # np.asarray below surfaces the error
+                    t_ready = time.monotonic()
+                    host = np.asarray(batch.out)   # d2h materialize
+                    t_mat = time.monotonic()
                 except BaseException as e:         # noqa: BLE001
                     exc = e
             with self._cv:
@@ -682,6 +733,25 @@ class DeviceDispatchEngine:
                 else:
                     req.future._deliver(host[a:b], None)
             self.stats.record_complete(len(batch.reqs))
+            if exc is None and batch.profile is not None:
+                pr = batch.profile
+                t_end = time.monotonic()
+                try:
+                    self.stats.phases.record_batch(
+                        pr["family"],
+                        phases={"queue_wait": pr["t0"] - pr["t_submit0"],
+                                "build": pr["build"],
+                                "place": pr["place"],
+                                "launch": pr["launch"],
+                                "compute": t_ready - pr["t_launch_end"],
+                                "materialize": t_mat - t_ready,
+                                "deliver": t_end - t_mat},
+                        e2e_s=t_end - pr["t_submit0"],
+                        requests=len(batch.reqs),
+                        stripes=pr["stripes"], bucket=pr["bucket"],
+                        devices=pr["devices"], misses=batch.misses)
+                except Exception:
+                    pass   # profiling must never wedge completions
 
 
 # ---------------------------------------------------------------------------

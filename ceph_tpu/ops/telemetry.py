@@ -39,6 +39,7 @@ prometheus module (histogram families per kernel), and ``summary()``
 from __future__ import annotations
 
 import time
+from collections import deque
 
 from ceph_tpu.common import lockdep
 
@@ -56,6 +57,35 @@ BATCH_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
 #: device call; the whole point of the dispatch engine is pushing the
 #: mass of this histogram above 1)
 COALESCE_BOUNDS = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 128)
+
+#: fraction bucket upper bounds (shard imbalance, padded-lane share)
+FRACTION_BOUNDS = (0.01, 0.02, 0.05, 0.1, 0.15, 0.25, 0.4, 0.6,
+                   0.8, 1.0)
+
+#: the dispatch pipeline's phases, in TIMELINE order.  The ledger is
+#: continuous — each phase starts exactly where the previous ended —
+#: so the per-batch phase sum reconstructs the batch's submit→delivery
+#: wall-clock (the "where did the time go" invariant the profiler
+#: tests pin):
+#:
+#:   queue_wait   oldest submit → dispatch thread starts the batch
+#:   build        pad/concat of the coalesced host batch (+ aux)
+#:   place        device_put / h2d placement (mesh sharding included)
+#:   launch       the fn() call — async dispatch ack; a first-call
+#:                batch's jit trace+compile lands here (attributed to
+#:                the compile ledger, not steady-state)
+#:   compute      launch ack → result ready (device execution; also
+#:                absorbs completion-thread pickup wait, which overlaps
+#:                execution under double buffering)
+#:   materialize  d2h materialization (np.asarray of the ready result)
+#:   deliver      per-request slicing + future/continuation fan-out
+PHASES = ("queue_wait", "build", "place", "launch", "compute",
+          "materialize", "deliver")
+
+#: default bound on retained per-batch profile records per engine
+#: (the ``kernel_profile_ring`` option rebinds it at runtime)
+PROFILE_RING_DEFAULT = 256
+_profile_ring = PROFILE_RING_DEFAULT
 
 
 class Histogram:
@@ -161,6 +191,188 @@ class KernelStats:
             }
 
 
+class PhaseStats:
+    """Per-batch pipeline phase attribution for one dispatch engine.
+
+    Three ledgers, one question — where does a flushed batch's
+    submit→delivery wall-clock go:
+
+    * **phase histograms**, per kernel family (the request label:
+      ec_encode, ec_decode, crush_rule, ...) × phase (PHASES above).
+      Steady-state only — a first-call batch's launch+compute carry
+      jit trace/compile cost and would poison the compute story, so
+      they are diverted to
+    * the **compile ledger**: total seconds and event count per
+      family, attributed on the FIRST flush of each (family, bucket,
+      mesh) combination (or whenever the submitter's jit-cache probe
+      reports a miss — the ground truth when available);
+    * **device utilization**: busy-seconds integral (compute seconds ×
+      devices the flush landed on), a utilization gauge over the
+      window since construction/clear, and the shard-imbalance story
+      for mesh engines (padded-lane share of each sharded flush — rows
+      are contiguous, so padding concentrates in the tail shards).
+
+    A bounded ring of recent per-batch profile records rides along so
+    ``dump_pipeline_profile`` can show the last N batches verbatim,
+    not just aggregates.
+    """
+
+    __slots__ = ("_lock", "phase", "compile_seconds", "compile_events",
+                 "_compiled_keys", "busy_seconds", "devices_seen",
+                 "shard_imbalance", "last_shard_imbalance", "records",
+                 "_anchor")
+
+    def __init__(self, name: str = "phase"):
+        self._lock = lockdep.make_lock(f"PhaseStats::lock({name})")
+        #: (family, phase) -> Histogram of seconds (steady-state)
+        self.phase: dict[tuple, Histogram] = {}
+        self.compile_seconds: dict[str, float] = {}
+        self.compile_events: dict[str, int] = {}
+        #: (family, bucket, devices) combos already charged a compile
+        self._compiled_keys: set = set()
+        self.busy_seconds = 0.0     # sum of compute_s * devices
+        self.devices_seen = 1       # widest flush fan-out observed
+        self.shard_imbalance = Histogram(FRACTION_BOUNDS)
+        self.last_shard_imbalance = 0.0
+        self.records: deque = deque(maxlen=_profile_ring)
+        self._anchor = time.monotonic()   # utilization window start
+
+    def clear(self) -> None:
+        with self._lock:
+            self.phase = {}
+            self.compile_seconds = {}
+            self.compile_events = {}
+            self._compiled_keys = set()
+            self.busy_seconds = 0.0
+            self.devices_seen = 1
+            self.shard_imbalance = Histogram(FRACTION_BOUNDS)
+            self.last_shard_imbalance = 0.0
+            self.records = deque(maxlen=_profile_ring)
+            self._anchor = time.monotonic()
+
+    def _resize_ring(self, n: int) -> None:
+        with self._lock:
+            self.records = deque(self.records, maxlen=n)
+
+    def record_batch(self, family: str, *, phases: dict, e2e_s: float,
+                     requests: int, stripes: int, bucket: int,
+                     devices: int, misses=None) -> None:
+        """One flushed batch's full ledger.  ``phases`` maps PHASES
+        names to seconds (missing = 0); ``misses`` is the submitter's
+        jit-cache delta when probed (None = not probed — first-call
+        detection falls back to the (family, bucket, devices) set)."""
+        d = max(1, int(devices))
+        with self._lock:
+            key = (family, int(bucket), d)
+            first = key not in self._compiled_keys
+            if first:
+                self._compiled_keys.add(key)
+            compiled = (misses > 0) if misses is not None else first
+            if compiled:
+                self.compile_seconds[family] = (
+                    self.compile_seconds.get(family, 0.0)
+                    + phases.get("launch", 0.0)
+                    + phases.get("compute", 0.0))
+                self.compile_events[family] = \
+                    self.compile_events.get(family, 0) + 1
+            for ph in PHASES:
+                if compiled and ph in ("launch", "compute"):
+                    continue      # charged to the compile ledger above
+                h = self.phase.get((family, ph))
+                if h is None:
+                    h = self.phase[(family, ph)] = \
+                        Histogram(LATENCY_BOUNDS)
+                h.add(phases.get(ph, 0.0))
+            self.busy_seconds += phases.get("compute", 0.0) * d
+            if d > self.devices_seen:
+                self.devices_seen = d
+            if d > 1 and bucket:
+                imb = max(0.0, 1.0 - stripes / bucket)
+                self.shard_imbalance.add(imb)
+                self.last_shard_imbalance = imb
+            self.records.append({
+                "t": time.time(), "kernel": family,
+                "requests": int(requests), "stripes": int(stripes),
+                "bucket": int(bucket), "devices": d,
+                "compiled": bool(compiled), "e2e_s": float(e2e_s),
+                "phases": {ph: float(phases.get(ph, 0.0))
+                           for ph in PHASES}})
+
+    def utilization(self) -> float:
+        """Device-busy fraction of the window since construction /
+        clear: busy-seconds integral over wall × widest fan-out.  An
+        always-on approximation (compile time counts as busy), not a
+        per-flush exactness claim."""
+        with self._lock:
+            wall = time.monotonic() - self._anchor
+            if wall <= 0.0:
+                return 0.0
+            return min(1.0, self.busy_seconds
+                       / (wall * max(1, self.devices_seen)))
+
+    def dump(self, include_recent: bool = True) -> dict:
+        """``include_recent=False`` skips copying the per-batch record
+        ring — the prometheus scrape only reads the aggregates, and
+        copying 256 dicts under the stats lock per poll is pure
+        waste there."""
+        util = self.utilization()
+        with self._lock:
+            fams: dict = {}
+            for (family, ph), h in self.phase.items():
+                fams.setdefault(family, {})[ph] = h.dump()
+            return {
+                "phases": fams,
+                "compile": {f: {"seconds": self.compile_seconds[f],
+                                "events": self.compile_events.get(f, 0)}
+                            for f in self.compile_seconds},
+                "busy_seconds": self.busy_seconds,
+                "utilization": round(util, 4),
+                "devices_seen": self.devices_seen,
+                "shard_imbalance": self.shard_imbalance.dump(),
+                "last_shard_imbalance": self.last_shard_imbalance,
+                "window_seconds": round(
+                    time.monotonic() - self._anchor, 3),
+                "recent": ([dict(r) for r in self.records]
+                           if include_recent else []),
+            }
+
+    def summary(self) -> dict:
+        """Compact digest (MMgrReport carriage / bench JSON): per
+        kernel family the phase totals and shares, plus the compile
+        ledger and the utilization gauges.  Ring omitted — digests
+        travel the wire every tick."""
+        util = self.utilization()
+        with self._lock:
+            fams: dict = {}
+            for (family, ph), h in self.phase.items():
+                fams.setdefault(family, {})[ph] = h.sum
+            out_f: dict = {}
+            for family, per in fams.items():
+                total = sum(per.values())
+                out_f[family] = {
+                    "seconds": {ph: round(s, 6)
+                                for ph, s in per.items()},
+                    "share": {ph: (round(s / total, 4) if total else 0.0)
+                              for ph, s in per.items()},
+                    "batches": max((self.phase[(family, ph)].count
+                                    for ph in PHASES
+                                    if (family, ph) in self.phase),
+                                   default=0),
+                }
+            return {
+                "kernels": out_f,
+                "compile": {f: {"seconds": round(
+                                    self.compile_seconds[f], 6),
+                                "events": self.compile_events.get(f, 0)}
+                            for f in self.compile_seconds},
+                "busy_seconds": round(self.busy_seconds, 6),
+                "utilization": round(util, 4),
+                "devices_seen": self.devices_seen,
+                "last_shard_imbalance": round(
+                    self.last_shard_imbalance, 4),
+            }
+
+
 class DispatchStats:
     """Counters for the cross-op coalescing engine (ops.dispatch).
 
@@ -184,10 +396,14 @@ class DispatchStats:
                  "coalesce", "queue_delay", "queue_depth",
                  "flush_reasons", "in_flight", "max_in_flight_seen",
                  "sharded_flushes", "devices_used", "shard_stripes",
-                 "mesh_devices", "mesh_dp", "mesh_ec")
+                 "mesh_devices", "mesh_dp", "mesh_ec", "phases")
 
     def __init__(self):
         self._lock = lockdep.make_lock("DispatchStats::lock")
+        #: per-batch pipeline phase attribution (its own lock: the
+        #: completion thread records a full profile per flush while
+        #: submitters hammer record_submit)
+        self.phases = PhaseStats(type(self).__name__)
         self.submits = 0          # requests submitted
         self.stripes_in = 0       # stripes submitted
         self.batches = 0          # device calls dispatched
@@ -226,6 +442,7 @@ class DispatchStats:
             self.devices_used = Histogram(COALESCE_BOUNDS)
             self.shard_stripes = Histogram(BATCH_BOUNDS)
             self.mesh_devices = self.mesh_dp = self.mesh_ec = 0
+        self.phases.clear()
 
     def record_submit(self, stripes: int) -> None:
         with self._lock:
@@ -380,12 +597,22 @@ class MappingStats:
     epochs were skipped outright (burst coalescing), and how often a
     read had to fall back to the scalar oracle (epoch/object mismatch
     — the correctness escape hatch, not an error).
+
+    The PHASE split answers ROADMAP item 2's standing question — is
+    the epoch cost device or host: each computed epoch divides into
+    ``device`` (pool remaps through the mapper/dispatch engine, pps
+    seeding included), ``delta`` (changed-PG candidate extraction: the
+    on-device raw-table diff plus state/affinity/override membership),
+    and ``host_tail`` (the per-candidate pipeline tail — upmap/
+    affinity/temp filtering through ``_finish_from`` — that still
+    finishes host-side).
     """
 
     __slots__ = ("_lock", "epoch_updates", "epoch_skips",
                  "pools_recomputed", "pools_reused", "full_rescans",
                  "lookups", "lookup_fallbacks", "update_latency",
-                 "changed_pgs", "cached_pgs", "cached_pools")
+                 "changed_pgs", "cached_pgs", "cached_pools",
+                 "phase_device", "phase_delta", "phase_host_tail")
 
     def __init__(self):
         self._lock = lockdep.make_lock("MappingStats::lock")
@@ -400,6 +627,10 @@ class MappingStats:
         self.changed_pgs = Histogram(BATCH_BOUNDS)       # delta size/epoch
         self.cached_pgs = 0        # gauge: PGs resident in raw tables
         self.cached_pools = 0      # gauge: pools resident
+        # per-epoch phase attribution (see class docstring)
+        self.phase_device = Histogram(LATENCY_BOUNDS)
+        self.phase_delta = Histogram(LATENCY_BOUNDS)
+        self.phase_host_tail = Histogram(LATENCY_BOUNDS)
 
     def clear(self) -> None:
         with self._lock:
@@ -411,6 +642,17 @@ class MappingStats:
             self.changed_pgs = Histogram(BATCH_BOUNDS)
             self.cached_pgs = 0
             self.cached_pools = 0
+            self.phase_device = Histogram(LATENCY_BOUNDS)
+            self.phase_delta = Histogram(LATENCY_BOUNDS)
+            self.phase_host_tail = Histogram(LATENCY_BOUNDS)
+
+    def record_phases(self, *, device_s: float, delta_s: float,
+                      host_tail_s: float) -> None:
+        """One computed epoch's phase split (seconds per phase)."""
+        with self._lock:
+            self.phase_device.add(device_s)
+            self.phase_delta.add(delta_s)
+            self.phase_host_tail.add(host_tail_s)
 
     def record_update(self, *, seconds: float, recomputed: int,
                       reused: int, changed: int, cached_pgs: int,
@@ -453,7 +695,26 @@ class MappingStats:
                 "changed_pgs": self.changed_pgs.dump(),
                 "cached_pgs": self.cached_pgs,
                 "cached_pools": self.cached_pools,
+                "phase_seconds": {
+                    "device": self.phase_device.dump(),
+                    "delta": self.phase_delta.dump(),
+                    "host_tail": self.phase_host_tail.dump(),
+                },
             }
+
+    def phase_summary(self) -> dict:
+        """Per-phase totals + shares across computed epochs (the
+        MMgrReport digest / `profile phases` mapping row)."""
+        with self._lock:
+            sums = {"device": self.phase_device.sum,
+                    "delta": self.phase_delta.sum,
+                    "host_tail": self.phase_host_tail.sum}
+            epochs = self.phase_device.count
+        total = sum(sums.values())
+        return {"seconds": {k: round(v, 6) for k, v in sums.items()},
+                "share": {k: (round(v / total, 4) if total else 0.0)
+                          for k, v in sums.items()},
+                "epochs": epochs}
 
     def summary(self) -> dict:
         """bench.py's digest: incrementality in a few numbers."""
@@ -595,6 +856,37 @@ def mapping_summary() -> dict:
     return _REG.mapping.summary()
 
 
+def pipeline_profile_dump(include_recent: bool = True) -> dict:
+    """The full per-engine pipeline phase profile — the
+    ``dump_pipeline_profile`` admin-socket payload: phase histograms
+    per kernel family, the compile ledger, utilization gauges, and the
+    bounded ring of recent per-batch records, for both dispatch
+    engines, plus the mapping service's epoch phase split.
+    ``include_recent=False`` drops the ring (aggregate-only readers:
+    the prometheus scrape)."""
+    return {"encode": _REG.dispatch.phases.dump(include_recent),
+            "decode": _REG.decode_dispatch.phases.dump(include_recent),
+            "mapping": _REG.mapping.phase_summary()}
+
+
+def pipeline_profile_digest() -> dict:
+    """Compact phase-share digest (no histograms, no ring) — the
+    MMgrReport v4 carriage and bench.py's ``profile`` section."""
+    return {"encode": _REG.dispatch.phases.summary(),
+            "decode": _REG.decode_dispatch.phases.summary(),
+            "mapping": _REG.mapping.phase_summary()}
+
+
+def set_profile_ring(n) -> None:
+    """Rebind the per-engine recent-batch profile ring bound (the
+    ``kernel_profile_ring`` option); existing records are kept up to
+    the new bound, newest first."""
+    global _profile_ring
+    _profile_ring = max(1, int(n))
+    _REG.dispatch.phases._resize_ring(_profile_ring)
+    _REG.decode_dispatch.phases._resize_ring(_profile_ring)
+
+
 def set_fence_for_timing(on: bool) -> None:
     _REG.fence_for_timing = bool(on)
 
@@ -620,6 +912,14 @@ def configure_from_conf(conf) -> None:
         conf.add_observer("kernel_fence_for_timing",
                           lambda _n, v: set_fence_for_timing(v))
     except KeyError:   # option table without the knob (stripped config)
+        pass
+    try:
+        ring = int(conf.get("kernel_profile_ring"))
+        if ring != PROFILE_RING_DEFAULT:
+            set_profile_ring(ring)
+        conf.add_observer("kernel_profile_ring",
+                          lambda _n, v: set_profile_ring(v))
+    except KeyError:
         pass
 
 

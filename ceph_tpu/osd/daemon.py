@@ -585,16 +585,19 @@ class OSDDaemon(Dispatcher):
                     "log_size": len(pg.log.entries),
                     "log_head": pg.log.head, "log_tail": tail}
         counters = dict(self.perf._u64)
-        # v4 tail: completed slow traces (tail-sampled span trees) and
-        # historic slow-op digests — the mgr insights module's feed
+        # v4 tail: completed slow traces (tail-sampled span trees),
+        # historic slow-op digests, and the pipeline-profile phase
+        # digest — the mgr insights module's feed
         from ceph_tpu.common import tracing
+        from ceph_tpu.ops import telemetry
         con = self.msgr.connect_to(mgr_addr, EntityName("mgr", mgr_rank))
         con.send_message(MMgrReport(
             osd_id=self.osd_id, counters=counters, pg_states=states,
             num_objects=n_obj, bytes_used=n_bytes, pg_stats=pg_stats,
             perf=self.ctx.perf.dump(),
             slow_traces=tracing.slow_trace_digests(),
-            slow_ops=self.op_tracker.slow_digests()))
+            slow_ops=self.op_tracker.slow_digests(),
+            profile=telemetry.pipeline_profile_digest()))
 
     ROTATING_REFRESH = 60.0
 

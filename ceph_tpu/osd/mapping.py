@@ -546,10 +546,13 @@ class SharedPGMappingService:
                 mapping = self._ensure_mapping()
                 break
         t0 = time.perf_counter()
+        delta_s = host_tail_s = 0.0
         try:
             info = mapping.update(work, engine=self._engine())
+            device_s = time.perf_counter() - t0
             if chain_valid:
-                changed, full = self._compute_delta(info)
+                changed, full, delta_s, host_tail_s = \
+                    self._compute_delta(info)
             else:
                 # prev tables came from a warm() outside the online
                 # sequence: a delta against them would be discarded
@@ -589,6 +592,11 @@ class SharedPGMappingService:
             reused=len(info.reused),
             changed=(len(changed) if not full else cached_pgs),
             cached_pgs=cached_pgs, cached_pools=len(mapping._raw))
+        # where did this epoch go: device remap vs candidate
+        # extraction vs the host pipeline tail (ROADMAP item 2's
+        # bottleneck question, readable via dump_mapping_stats)
+        self.stats.record_phases(device_s=device_s, delta_s=delta_s,
+                                 host_tail_s=host_tail_s)
         with self._cv:
             # work.epoch >= target and _epoch is monotonic, so the
             # cache is guaranteed at/past the caller's map now; the
@@ -685,12 +693,17 @@ class SharedPGMappingService:
         entries moved (or any override key when osd visibility/weights
         moved — upmap validity reads them); then each candidate's full
         (up, up_primary, acting, acting_primary) is compared old-vs-new
-        through the cached tables.  O(changed + overrides) host work."""
+        through the cached tables.  O(changed + overrides) host work.
+
+        Returns (changed, full, delta_s, host_tail_s): the epoch's
+        phase split — candidate extraction (incl. the on-device raw
+        diff) vs the per-candidate host pipeline tail."""
+        t0 = time.perf_counter()
         old = info.prev
         mapping = self._mapping
         m_new = mapping.osdmap
         if old.osdmap is None or old.epoch < 0:
-            return None, True
+            return None, True, 0.0, 0.0
         m_old = old.osdmap
         no = max(m_old.max_osd, m_new.max_osd, 1)
         st = (_vec(m_old.osd_state, no) != _vec(m_new.osd_state, no))
@@ -749,6 +762,7 @@ class SharedPGMappingService:
             pool = m_new.pools.get(pool_id)
             if pool is not None and 0 <= pg < pool.pg_num:
                 cand.add((pool_id, pg))
+        t_cand = time.perf_counter()
         changed = []
         for pool_id, pg in cand:
             pool_n = m_new.pools[pool_id]
@@ -763,7 +777,8 @@ class SharedPGMappingService:
                                      old.raw, old.pps)
             if new_t != old_t:
                 changed.append((pool_id, pg))
-        return sorted(changed), False
+        return (sorted(changed), False, t_cand - t0,
+                time.perf_counter() - t_cand)
 
     # -- reads ----------------------------------------------------------------
 

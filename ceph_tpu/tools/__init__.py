@@ -1,7 +1,8 @@
 """CLI tools and harnesses (reference layer 7: src/tools/, src/vstart.sh).
 
-vstart        in-process MiniCluster harness
-crush_test    crushtool --test analog (batched)
-osdmap_test   osdmaptool --test-map-pgs analog
-ec_benchmark  ceph_erasure_code_benchmark analog
+vstart          in-process MiniCluster harness
+crush_test      crushtool --test analog (batched)
+osdmap_test     osdmaptool --test-map-pgs analog
+ec_benchmark    ceph_erasure_code_benchmark analog
+profile_report  pipeline where-did-the-time-go table renderer
 """
