@@ -535,10 +535,111 @@ def profile_section(k: int = 8, m: int = 4, chunk: int = 1024,
 
 
 SECTIONS = ("ec", "crush", "dispatch_sweep", "recovery_sweep",
-            "map_churn", "profile")
+            "map_churn", "profile", "qos")
 #: the historical flagship run (map_churn is opt-in: it is a
 #: consumption-path sweep, not a device-kernel headline)
 DEFAULT_SECTIONS = ("ec", "crush", "dispatch_sweep", "recovery_sweep")
+
+
+def qos_section(measure_s: float = 2.5, warmup_s: float = 0.8,
+                service_s: float = 0.002) -> dict:
+    """Multi-tenant dmClock fairness sweep (--sections qos; validated
+    standalone — the full bench exceeds the 590 s budget on this host).
+
+    Four tenants drive one sharded op queue whose handler has a FIXED
+    per-op service time (capacity = 1/service_s with one shard
+    worker): a hog (weight 8) floods, gold holds a 100 ops/s
+    reservation, silver (weight 2) shares the excess, bronze is capped
+    at 50 ops/s.  The sweep runs twice — dmclock lanes with profiles
+    vs one aggregate FIFO class (QoS off = the seed's arbitration) —
+    and reports per-tenant throughput + queue-wait p99, the
+    reservation attainment, the limit overshoot, and the hog:silver
+    excess ratio vs the configured 4.0."""
+    import threading as _th
+
+    from ceph_tpu.osd.op_queue import ClassInfo, ShardedOpQueue
+
+    profiles = {
+        "hog": ClassInfo(weight=8.0),
+        "gold": ClassInfo(reservation=100.0, weight=0.01),
+        "silver": ClassInfo(weight=2.0),
+        "bronze": ClassInfo(weight=8.0, limit=50.0),
+    }
+    pumps = {"hog": 8, "gold": 3, "silver": 4, "bronze": 4}
+
+    def run(qos_on: bool) -> dict:
+        lock = _th.Lock()
+        counts = {t: 0 for t in profiles}
+        waits: dict[str, list] = {t: [] for t in profiles}
+
+        def handler(klass, item, served=None):
+            time.sleep(service_s)
+            tenant, sem = item
+            with lock:
+                counts[tenant] += 1
+                if served is not None:
+                    waits[tenant].append(served[1])
+            sem.release()
+
+        wq = ShardedOpQueue(
+            handler, n_shards=1, name="bench-qos",
+            client_template=ClassInfo(weight=100.0),
+            client_profiles={f"client.{t}": p
+                             for t, p in profiles.items()}
+            if qos_on else None)
+        stop = _th.Event()
+
+        def pump(tenant):
+            klass = f"client.{tenant}" if qos_on else "client"
+            sem = _th.Semaphore(0)
+            while not stop.is_set():
+                wq.enqueue(tenant, klass, (tenant, sem))
+                sem.acquire()
+
+        threads = [_th.Thread(target=pump, args=(t,), daemon=True)
+                   for t, n in pumps.items() for _ in range(n)]
+        for t in threads:
+            t.start()
+        time.sleep(warmup_s)
+        with lock:
+            base = dict(counts)
+            for v in waits.values():
+                v.clear()
+        t0 = time.perf_counter()
+        time.sleep(measure_s)
+        with lock:
+            snap = {t: counts[t] - base[t] for t in profiles}
+            wsnap = {t: sorted(waits[t]) for t in profiles}
+        elapsed = time.perf_counter() - t0
+        stop.set()
+        wq.shutdown()
+        rates = {t: snap[t] / elapsed for t in profiles}
+        p99 = {t: (w[int(0.99 * (len(w) - 1))] if w else 0.0)
+               for t, w in wsnap.items()}
+        return {"tenant_ops_s": {t: round(r, 1)
+                                 for t, r in rates.items()},
+                "tenant_wait_p99_s": {t: round(v, 4)
+                                      for t, v in p99.items()},
+                "_rates": rates}
+
+    qos = run(qos_on=True)
+    fifo = run(qos_on=False)
+    r = qos.pop("_rates")
+    rf = fifo.pop("_rates")
+    hog_silver = r["hog"] / max(r["silver"], 1e-9)
+    return {
+        "capacity_ops_s": round(1.0 / service_s, 1),
+        "profiles": {t: {"reservation": p.reservation,
+                         "weight": p.weight, "limit": p.limit}
+                     for t, p in profiles.items()},
+        "qos": qos,
+        "fifo": fifo,
+        "reservation_attainment": round(r["gold"] / 100.0, 3),
+        "reservation_attainment_fifo": round(rf["gold"] / 100.0, 3),
+        "limit_overshoot": round(r["bronze"] / 50.0, 3),
+        "excess_ratio_hog_silver": round(hog_silver, 2),
+        "excess_ratio_configured": 4.0,
+    }
 
 
 def main(argv=None) -> None:
@@ -772,6 +873,13 @@ def main(argv=None) -> None:
         # dump_pipeline_profile story embedded per bench round.
         # Render with: python -m ceph_tpu.tools.profile_report
         out["profile"] = profile_section()
+
+    if "qos" in secs:
+        # multi-tenant dmclock fairness: per-tenant throughput/p99
+        # with vs without QoS lanes, reservation attainment, limit
+        # overshoot, and the excess-sharing ratio against the
+        # configured weights
+        out["qos"] = qos_section()
 
     if "metric" not in out:
         out = {"metric": "sections " + "+".join(sorted(secs)),

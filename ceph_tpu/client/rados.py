@@ -12,6 +12,7 @@ Jenkins lookup2 string hash, distinct from the CRUSH rjenkins1 mix.
 from __future__ import annotations
 
 import threading
+from contextlib import contextmanager
 
 from ceph_tpu.common.context import CephTpuContext
 from ceph_tpu.messages import MMonCommand, MMonCommandAck, MOSDMapMsg, MOSDOp
@@ -185,6 +186,25 @@ class RadosClient(Dispatcher):
         self._cmd_waiters: dict[int, tuple[threading.Event, list]] = {}
         #: (pool, oid) -> watch callback(payload)
         self._watch_cbs: dict[tuple, object] = {}
+        #: dmClock client state (qos.dmclock.ServiceTracker), one
+        #: tracker PER QOS ENTITY — the tenant lane (or the bare
+        #: client when untenanted): every outgoing MOSDOp is stamped
+        #: with (delta, rho) for its target OSD — completions of THAT
+        #: TENANT anywhere / in reservation phase since its last op to
+        #: that OSD — so per-tenant reservations and limits hold
+        #: across OSDs, not per daemon.  A single shared tracker would
+        #: cross-contaminate tenants behind one gateway client: a hog's
+        #: completions would inflate an idle tenant's delta and lock it
+        #: out of its own weight/limit budget for service it never
+        #: received.  Replies feed phases back via MOSDOpReply.qos_phase
+        #: into the completing op's own tenant tracker.  LRU-bounded:
+        #: one-shot tenants age out rather than growing the map forever.
+        from collections import OrderedDict
+        self._qos_trackers: "OrderedDict[str, object]" = OrderedDict()
+        #: thread-local QoS tenant lane (qos_tenant() context manager):
+        #: ops submitted by this thread bill to the tenant — the RGW
+        #: front wraps each authenticated request in its tenant's lane
+        self._qos_tl = threading.local()
         self.name = EntityName("client", self.client_id)
         self.msgr = Messenger.create(self.name, ms_type)
         self.msgr.set_auth(auth_key)
@@ -300,6 +320,12 @@ class RadosClient(Dispatcher):
             with self._lock:
                 w = self._waiters.pop(msg.tid, None)
             if w is not None:
+                # dmclock response accounting (phase echo -> rho): count
+                # into the completing op's OWN tenant tracker before
+                # waking the waiter, so the lane's next op carries the
+                # completion in its (delta, rho)
+                self._tracker_for(w.msg.qos_tenant).track_resp(
+                    getattr(msg, "qos_phase", 0))
                 w.reply = msg
                 w.event.set()
             return True
@@ -474,14 +500,50 @@ class RadosClient(Dispatcher):
                 w.msg.write_snapc = pool.snap_seq
         if primary == CEPH_NOSD:
             return  # no primary this epoch; resent on next map
+        # dmClock tags for THIS target from the op's own tenant lane:
+        # (re)sends re-stamp because a retargeted op bills its service
+        # deltas to the osd actually serving it (dmclock ServiceTracker
+        # get_params per request)
+        w.msg.qos_delta, w.msg.qos_rho = \
+            self._tracker_for(w.msg.qos_tenant).get_params(primary)
         addr = self.osdmap.osd_addrs[primary]
         con = self.msgr.connect_to(addr, EntityName("osd", primary))
         con.send_message(w.msg)
 
+    #: distinct tenant trackers retained per client (LRU)
+    QOS_TRACKER_CAP = 1024
+
+    def _tracker_for(self, tenant: str):
+        """The tenant lane's own ServiceTracker (lazy, LRU-bounded);
+        '' is the untenanted per-client lane."""
+        from ceph_tpu.qos.dmclock import ServiceTracker
+        with self._lock:
+            t = self._qos_trackers.get(tenant)
+            if t is None:
+                t = self._qos_trackers[tenant] = ServiceTracker()
+                while len(self._qos_trackers) > self.QOS_TRACKER_CAP:
+                    self._qos_trackers.popitem(last=False)
+            else:
+                self._qos_trackers.move_to_end(tenant)
+            return t
+
+    @contextmanager
+    def qos_tenant(self, tenant: str | None):
+        """Bill every op submitted by this thread inside the block to
+        the tenant's QoS lane (the RGW request wrapper): the tenant tag
+        rides each MOSDOp and the OSDs schedule it as client.<tenant>
+        with the qos_db profile.  Nests; None is a no-op lane."""
+        prev = getattr(self._qos_tl, "tenant", None)
+        self._qos_tl.tenant = tenant
+        try:
+            yield
+        finally:
+            self._qos_tl.tenant = prev
+
     def aio_operate(self, pool_id: int, oid: str, ops: list[OSDOpField],
                     snapid: int = 0, direct: bool = False,
-                    pgid: tuple[int, int] | None = None
-                    ) -> "AioCompletion":
+                    pgid: tuple[int, int] | None = None,
+                    tenant: str | None = None) -> "AioCompletion":
         """Submit without blocking (librados aio_*): returns a completion
         the caller waits on.  In-flight completions resend on map change
         like synchronous ops."""
@@ -493,12 +555,15 @@ class RadosClient(Dispatcher):
         is_write = any(op.op in (OP_WRITE, OP_WRITEFULL, OP_DELETE,
                                  OP_OMAP_SET, OP_OMAP_RMKEYS)
                        for op in ops)
+        if tenant is None:
+            tenant = getattr(self._qos_tl, "tenant", None)
         with self._lock:
             tid = self._next_tid
             self._next_tid += 1
             msg = MOSDOp(client_id=self.client_id, tid=tid,
                          pgid=(pool_id, 0), oid=oid, ops=ops,
-                         epoch=self.osdmap.epoch, snapid=snapid)
+                         epoch=self.osdmap.epoch, snapid=snapid,
+                         qos_tenant=tenant or "")
             w = _Waiter(msg, pool_id, is_write, direct,
                         fixed_pgid=pgid)
             self._waiters[tid] = w
@@ -507,7 +572,8 @@ class RadosClient(Dispatcher):
 
     def operate(self, pool_id: int, oid: str, ops: list[OSDOpField],
                 snapid: int = 0, direct: bool = False,
-                pgid: tuple[int, int] | None = None) -> MOSDOpReply:
+                pgid: tuple[int, int] | None = None,
+                tenant: str | None = None) -> MOSDOpReply:
         # head sampling (tracing_sample_rate): an untraced op opens a
         # trace at the configured rate, whose root span covers submit
         # through reply — the tail-retention check then decides whether
@@ -517,7 +583,8 @@ class RadosClient(Dispatcher):
         with tracing.maybe_sampled(f"osd_op {oid}",
                                    daemon=f"client.{self.client_id}"):
             c = self.aio_operate(pool_id, oid, ops, snapid=snapid,
-                                 direct=direct, pgid=pgid)
+                                 direct=direct, pgid=pgid,
+                                 tenant=tenant)
             if not c.wait_for_complete(self.timeout):
                 c.cancel()
                 raise TimeoutError(f"op {c.tid} on {oid} timed out")
@@ -543,15 +610,26 @@ class IoCtx:
     """Pool I/O handle (librados IoCtx)."""
 
     def __init__(self, client: RadosClient, pool_id: int,
-                 direct: bool = False):
+                 direct: bool = False, tenant: str | None = None):
         self.client = client
         self.pool_id = pool_id
         #: bypass cache-tier overlays (tier-agent internal I/O)
         self.direct = direct
+        #: explicit QoS tenant lane: every op through this handle bills
+        #: to the tenant (overrides the client's thread-local lane) —
+        #: rgw_lite buckets and bench tenants use this form
+        self.tenant = tenant
+
+    def with_tenant(self, tenant: str | None) -> "IoCtx":
+        """A view of this pool handle whose ops bill to the tenant's
+        QoS lane (librados would set the ioctx namespace/tenant)."""
+        return IoCtx(self.client, self.pool_id, direct=self.direct,
+                     tenant=tenant)
 
     def _op(self, oid, ops, snapid=0):
         return self.client.operate(self.pool_id, oid, ops,
-                                   snapid=snapid, direct=self.direct)
+                                   snapid=snapid, direct=self.direct,
+                                   tenant=self.tenant)
 
     def write_full(self, oid: str, data: bytes) -> None:
         self._op(oid, [OSDOpField(OP_WRITEFULL, 0, len(data), data)])
@@ -559,13 +637,14 @@ class IoCtx:
     def aio_write_full(self, oid: str, data: bytes) -> "AioCompletion":
         return self.client.aio_operate(
             self.pool_id, oid, [OSDOpField(OP_WRITEFULL, 0, len(data),
-                                           data)], direct=self.direct)
+                                           data)], direct=self.direct,
+            tenant=self.tenant)
 
     def aio_read(self, oid: str, length: int = 0,
                  offset: int = 0) -> "AioCompletion":
         return self.client.aio_operate(
             self.pool_id, oid, [OSDOpField(OP_READ, offset, length)],
-            direct=self.direct)
+            direct=self.direct, tenant=self.tenant)
 
     def write(self, oid: str, data: bytes, offset: int = 0) -> None:
         self._op(oid, [OSDOpField(OP_WRITE, offset, len(data), data)])

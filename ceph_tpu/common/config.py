@@ -125,6 +125,17 @@ register_options([
     Option("osd_op_queue_max_client_backlog", OPT_INT, 512,
            "client ops queued per shard before dispatch backpressure "
            "blocks the intake (peer/recovery classes are never gated)"),
+    Option("osd_qos_tenant_lanes", OPT_BOOL, True,
+           "schedule client ops by the MOSDOp's authenticated tenant "
+           "tag (client.<tenant> dmclock lanes with per-tenant "
+           "profiles from the OSDMap qos_db); off = per-client-id "
+           "lanes only, tenant tags ignored"),
+    Option("osd_qos_idle_client_timeout", OPT_FLOAT, 60.0,
+           "seconds a dynamic per-client/per-tenant dmclock lane may "
+           "sit idle (empty queue, no enqueues) before the scheduler "
+           "evicts its state — bounds the lane table under millions "
+           "of one-shot clients; served/wait totals fold into the "
+           "dump_qos_stats evicted rollup"),
     Option("osd_max_backfills", OPT_INT, 1,
            "PGs an osd recovers concurrently (reservation slots)"),
     Option("osd_recovery_max_active", OPT_INT, 3,

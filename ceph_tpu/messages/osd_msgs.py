@@ -57,12 +57,14 @@ def _dec_pgid(dec: Decoder) -> tuple[int, int]:
 @register_message
 class MOSDOp(Message):
     TYPE = 42  # MSG_OSD_OP
-    HEAD_VERSION = 3       # v3: write_snapc (writer-side SnapContext)
+    HEAD_VERSION = 4       # v4: dmclock QoS tags (FEATURE_QOS_TAGS)
 
     def __init__(self, client_id: int = 0, tid: int = 0,
                  pgid: tuple[int, int] = (0, 0), oid: str = "",
                  ops: list[OSDOpField] | None = None, epoch: int = 0,
-                 snapid: int = 0, write_snapc: int = 0):
+                 snapid: int = 0, write_snapc: int = 0,
+                 qos_tenant: str = "", qos_delta: int = 1,
+                 qos_rho: int = 1):
         super().__init__()
         self.client_id = client_id
         self.tid = tid
@@ -77,13 +79,25 @@ class MOSDOp(Message):
         #: writer that learned of a snapshot before the serving OSD did
         #: still gets copy-on-write
         self.write_snapc = write_snapc
+        #: v4 QoS extension (behind FEATURE_QOS_TAGS; old peers skip
+        #: the trailing fields and schedule untagged): the tenant lane
+        #: this op bills to (RGW stamps the authenticated tenant; empty
+        #: = per-client lane), and the dmClock (delta, rho) pair from
+        #: the client's ServiceTracker — completions anywhere / in
+        #: reservation phase since the last op to THIS osd — that make
+        #: reservations and limits hold cluster-wide
+        self.qos_tenant = qos_tenant
+        self.qos_delta = qos_delta
+        self.qos_rho = qos_rho
 
     def encode_payload(self, enc):
-        enc.versioned(3, 1, lambda e: (
+        enc.versioned(4, 1, lambda e: (
             e.u64(self.client_id), e.u64(self.tid), _enc_pgid(e, self.pgid),
             e.str(self.oid), e.u32(self.epoch),
             e.list(self.ops, lambda e2, op: op.encode(e2)),
-            e.u64(self.snapid), e.u64(self.write_snapc)))
+            e.u64(self.snapid), e.u64(self.write_snapc),
+            e.str(self.qos_tenant), e.u32(self.qos_delta),
+            e.u32(self.qos_rho)))
 
     def decode_payload(self, dec, version):
         def body(d, v):
@@ -95,25 +109,40 @@ class MOSDOp(Message):
             self.ops = d.list(OSDOpField.decode)
             self.snapid = d.u64() if v >= 2 else 0
             self.write_snapc = d.u64() if v >= 3 else 0
-        dec.versioned(3, body)
+            if v >= 4:
+                self.qos_tenant = d.str()
+                self.qos_delta = d.u32()
+                self.qos_rho = d.u32()
+            else:   # old peer: untagged mClock increments
+                self.qos_tenant = ""
+                self.qos_delta = 1
+                self.qos_rho = 1
+        dec.versioned(4, body)
 
 
 @register_message
 class MOSDOpReply(Message):
     TYPE = 43  # MSG_OSD_OPREPLY
+    HEAD_VERSION = 2       # v2: dmclock phase-served echo
 
     def __init__(self, tid: int = 0, result: int = 0, epoch: int = 0,
-                 ops: list[OSDOpField] | None = None):
+                 ops: list[OSDOpField] | None = None,
+                 qos_phase: int = 0):
         super().__init__()
         self.tid = tid
         self.result = result
         self.epoch = epoch
         self.ops = ops or []   # read results travel back in op fields
+        #: v2: which dmclock phase served the op (qos.dmclock.PHASE_*;
+        #: 0 = unscheduled/old peer) — the client's ServiceTracker
+        #: counts reservation-phase completions (rho) from this
+        self.qos_phase = qos_phase
 
     def encode_payload(self, enc):
-        enc.versioned(1, 1, lambda e: (
+        enc.versioned(2, 1, lambda e: (
             e.u64(self.tid), e.s32(self.result), e.u32(self.epoch),
-            e.list(self.ops, lambda e2, op: op.encode(e2))))
+            e.list(self.ops, lambda e2, op: op.encode(e2)),
+            e.u8(self.qos_phase)))
 
     def decode_payload(self, dec, version):
         def body(d, v):
@@ -121,7 +150,8 @@ class MOSDOpReply(Message):
             self.result = d.s32()
             self.epoch = d.u32()
             self.ops = d.list(OSDOpField.decode)
-        dec.versioned(1, body)
+            self.qos_phase = d.u8() if v >= 2 else 0
+        dec.versioned(2, body)
 
 
 @register_message

@@ -81,7 +81,8 @@ class MMgrReport(Message):
                  perf: dict | None = None,
                  slow_traces: list | None = None,
                  slow_ops: list | None = None,
-                 profile: dict | None = None):
+                 profile: dict | None = None,
+                 qos: dict | None = None):
         super().__init__()
         self.osd_id = osd_id
         self.counters = counters or {}
@@ -99,6 +100,10 @@ class MMgrReport(Message):
         #: pipeline-profile phase digest (phase shares per kernel
         #: family, compile ledger, utilization, mapping phase split)
         self.profile = profile or {}
+        #: per-tenant dmclock accounting digest (qos lanes: backlog,
+        #: phase-served counts, wait totals) — rides the SAME v4 JSON
+        #: tail as profile, so old peers simply never read it
+        self.qos = qos or {}
 
     def encode_payload(self, enc: Encoder):
         enc.versioned(4, 1, lambda e: (
@@ -115,7 +120,8 @@ class MMgrReport(Message):
             e.str(json.dumps(self.perf)),
             e.str(json.dumps({"slow_traces": self.slow_traces,
                               "slow_ops": self.slow_ops,
-                              "profile": self.profile}))))
+                              "profile": self.profile,
+                              "qos": self.qos}))))
 
     def decode_payload(self, dec: Decoder, version):
         # decode constructs via __new__: every field needs a default
@@ -125,6 +131,7 @@ class MMgrReport(Message):
         self.slow_traces = []
         self.slow_ops = []
         self.profile = {}
+        self.qos = {}
 
         def body(d, v):
             self.osd_id = d.s32()
@@ -143,6 +150,7 @@ class MMgrReport(Message):
                 self.slow_traces = tail.get("slow_traces", [])
                 self.slow_ops = tail.get("slow_ops", [])
                 self.profile = tail.get("profile", {})
+                self.qos = tail.get("qos", {})
         dec.versioned(4, body)
 
 
@@ -507,6 +515,8 @@ class MgrDaemon(Dispatcher):
             return self.health()
         if data_name == "insights_feed":
             return self.insights_feed()
+        if data_name == "qos_feed":
+            return self.qos_feed()
         if data_name == "io_samples":
             with self._lock:
                 return {"current": {o: (t, dict(r.counters))
@@ -720,6 +730,14 @@ class MgrDaemon(Dispatcher):
                         "profile": dict(r.profile),
                         "stamp": t}
                     for o, (t, r) in self.reports.items()}
+
+    def qos_feed(self) -> dict:
+        """Per-daemon dmclock accounting from the MMgrReport v4 tail:
+        osd -> {lanes: {class: {backlog, served{phase}, wait_sum_s}},
+        evicted rollup} — the prometheus ceph_qos_* source."""
+        with self._lock:
+            return {o: dict(r.qos)
+                    for o, (_t, r) in self.reports.items() if r.qos}
 
     #: fraction of existing OSDs that must be exceeded for OSD_DOWN to
     #: escalate from WARN to ERR (mon_osd_down_out semantics reduced)

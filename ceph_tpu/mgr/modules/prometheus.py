@@ -133,6 +133,7 @@ class Module(MgrModule):
         self._scrape_cluster(exp)
         self._scrape_daemon_perf(exp)
         self._scrape_slow_ops(exp)
+        self._scrape_qos(exp)
         self._scrape_kernels(exp)
         self._scrape_dispatch(exp)
         self._scrape_decode_dispatch(exp)
@@ -210,6 +211,53 @@ class Module(MgrModule):
                       "tail-retained slow traces reported by daemon",
                       len(entry.get("slow_traces", [])),
                       {"ceph_daemon": f"osd.{osd}"})
+
+    def _scrape_qos(self, exp: Exposition) -> None:
+        """Per-tenant dmclock accounting from the MMgrReport v4 qos
+        tail: phase-served counters, lane backlog, and cumulative
+        queue-wait per (daemon, lane) — the multi-tenant fairness
+        story (reservation floors show up as the reservation phase
+        share, caps as the limit phase).  Absent on hosts without the
+        feed (unit stubs)."""
+        try:
+            feed = self.get("qos_feed")
+        except Exception:
+            return
+        for osd, entry in sorted(feed.items()):
+            daemon = f"osd.{osd}"
+            ev = entry.get("evicted", {})
+            # the eviction rollup rides the SAME families as one more
+            # pseudo-lane ("evicted" cannot collide — real lanes carry
+            # the client. prefix): without it, sum-over-lanes
+            # dashboards would undercount exactly in the
+            # millions-of-one-shot-clients regime eviction targets.
+            # The rollup has no backlog (only empty lanes evict).
+            rows = sorted(entry.get("lanes", {}).items())
+            rows.append(("evicted", {"served": ev.get("served", {}),
+                                     "wait_sum_s":
+                                         ev.get("wait_sum_s", 0.0)}))
+            for lane, row in rows:
+                lab = {"ceph_daemon": daemon, "qos_class": lane}
+                for phase, n in sorted(row.get("served", {}).items()):
+                    exp.counter(
+                        "ceph_qos_served_total",
+                        "ops served per dmclock phase per lane "
+                        "(reservation = floor honored, weight = "
+                        "excess share, limit = work-conserving "
+                        "fallback past every cap)",
+                        n, {**lab, "phase": phase})
+                if "backlog" in row:
+                    exp.gauge("ceph_qos_backlog",
+                              "ops queued in the lane at report time",
+                              row.get("backlog", 0), lab)
+                exp.counter("ceph_qos_wait_seconds_total",
+                            "cumulative dmclock queue wait "
+                            "(throttle time) per lane",
+                            row.get("wait_sum_s", 0.0), lab)
+            exp.counter("ceph_qos_evicted_lanes_total",
+                        "idle dynamic lanes evicted by the "
+                        "osd_qos_idle_client_timeout sweep",
+                        ev.get("classes", 0), {"ceph_daemon": daemon})
 
     def _scrape_kernels(self, exp: Exposition) -> None:
         reg = telemetry.registry()

@@ -1658,6 +1658,43 @@ class Monitor(Dispatcher):
                 if not self._mutate(fn):
                     return "commit failed", -11
                 return "tier removed", 0
+            if prefix == "qos set":
+                # per-tenant dmclock profile -> the replicated qos_db
+                # (every OSD folds it into its scheduler on the next
+                # map push; `ceph qos set tenant=gold reservation=100
+                # weight=10 limit=0`)
+                from ceph_tpu.qos.dmclock import QosProfile
+                tenant = str(cmd["tenant"])
+                if not tenant:
+                    return "empty tenant", -22
+                prof = QosProfile(
+                    reservation=float(cmd.get("reservation", 0.0)),
+                    weight=float(cmd.get("weight", 1.0)),
+                    limit=float(cmd.get("limit", 0.0)))
+                try:
+                    prof.validate()
+                except ValueError as e:
+                    return str(e), -22
+
+                def fn(m: OSDMap):
+                    m.qos_db[tenant] = prof.to_dict()
+                if not self._mutate(fn):
+                    return "commit failed", -11
+                return json.dumps({"tenant": tenant,
+                                   **prof.to_dict(),
+                                   "epoch": self.osdmap.epoch}), 0
+            if prefix == "qos rm":
+                tenant = str(cmd["tenant"])
+                if tenant not in self.osdmap.qos_db:
+                    return f"no qos profile for {tenant!r}", -2
+
+                def fn(m: OSDMap):
+                    m.qos_db.pop(tenant, None)
+                if not self._mutate(fn):
+                    return "commit failed", -11
+                return f"qos profile for {tenant} removed", 0
+            if prefix == "qos ls":
+                return json.dumps(self.osdmap.qos_db), 0
             if prefix == "osd getmap":
                 return json.dumps({"epoch": self.osdmap.epoch}), 0
             if prefix == "osd getcrushmap":

@@ -29,12 +29,19 @@ FEATURE_TRACE = 1 << 6              # frame-header trace extension
 #: the peer can redeem staged-buffer tokens for bulk payloads
 FEATURE_ICI_TOKENS = 1 << 7
 FEATURE_TRACE_SPANS = 1 << 8        # v2 (trace_id, parent_span_id) ext
+#: MOSDOp v4 / MOSDOpReply v2 dmclock QoS extension (tenant id +
+#: (delta, rho) tags out, phase-served echo back).  The extension is
+#: payload-versioned — old peers skip the trailing fields via the
+#: length-prefixed section and simply schedule the op untagged — so
+#: the bit advertises the capability rather than gating framing
+FEATURE_QOS_TAGS = 1 << 9
 
 #: everything this build speaks
 SUPPORTED_FEATURES = (FEATURE_BASE | FEATURE_WIRE_COMPRESSION
                       | FEATURE_CEPHX_TICKETS | FEATURE_INCREMENTAL_MAPS
                       | FEATURE_PG_STATS_V2 | FEATURE_EC_RMW_PIPELINE
-                      | FEATURE_TRACE | FEATURE_TRACE_SPANS)
+                      | FEATURE_TRACE | FEATURE_TRACE_SPANS
+                      | FEATURE_QOS_TAGS)
 
 #: handshake frame: (supported u64, required u64) — ONE definition
 #: shared by both TCP stacks; they must parse each other byte-exact
@@ -51,6 +58,7 @@ _NAMES = {
     FEATURE_PG_STATS_V2: "pg-stats-v2",
     FEATURE_EC_RMW_PIPELINE: "ec-rmw-pipeline",
     FEATURE_TRACE_SPANS: "trace-spans",
+    FEATURE_QOS_TAGS: "qos-tags",
 }
 
 

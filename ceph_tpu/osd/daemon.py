@@ -62,6 +62,8 @@ from ceph_tpu.msg.messenger import (
 from ceph_tpu.objectstore import Transaction, create_objectstore
 from ceph_tpu.osd.map_codec import advance_map, encode_osdmap
 from ceph_tpu.osd.osdmap import CEPH_NOSD, OSDMap, pg_to_pgid
+from ceph_tpu.qos.dmclock import (
+    PHASE_LIMIT, PHASE_NAMES, PHASE_RESERVATION, PHASE_WEIGHT)
 from ceph_tpu.client.rados import ceph_str_hash_rjenkins
 from ceph_tpu.osd.pg import (
     EVERSION_ZERO, LOG_DELETE, LOG_MODIFY, PG, LogEntry, MissingItem,
@@ -190,10 +192,17 @@ class OSDDaemon(Dispatcher):
                  ms_type: str = "async", addr: str = "127.0.0.1:0",
                  heartbeats: bool = True, auth_key=None,
                  mgr_addr: str | None = None,
-                 cephx: tuple[str, str] | None = None):
+                 cephx: tuple[str, str] | None = None,
+                 conf: dict | None = None):
         self.osd_id = osd_id
         self.whoami = EntityName("osd", osd_id)
         self.ctx = ctx or CephTpuContext(f"osd.{osd_id}")
+        # startup config overrides (vstart.sh -o analog): applied at the
+        # CLI layer BEFORE any subsystem reads its options, so knobs
+        # consumed at construction (osd_op_queue, shard count, ...) see
+        # them — the central config-db only lands with the first map
+        for k, v in (conf or {}).items():
+            self.ctx.conf.set(k, v, source="cli")
         #: True when the context (and so its dispatch engine) is ours
         #: to tear down in shutdown(); a caller-supplied ctx may be
         #: shared with other daemons
@@ -312,8 +321,12 @@ class OSDDaemon(Dispatcher):
                      .add_u64("map_epochs")
                      .add_u64("map_pgs_scanned")
                      .add_u64("map_pgs_changed")
+                     .add_u64("qos_reservation_served")
+                     .add_u64("qos_weight_served")
+                     .add_u64("qos_limit_served")
                      .add_time_avg("op_w_latency")
                      .add_time_avg("map_scan_latency")
+                     .add_time_avg("qos_wait")
                      .create_perf_counters())
         self.ctx.perf.add(self.perf)
         # the messenger's and store's own counter sets live in the same
@@ -340,14 +353,24 @@ class OSDDaemon(Dispatcher):
         self.ctx.admin.register_command(
             "pg dump", lambda **kw: self._pg_dump(), "pg states")
 
-        # sharded op queue with mClock QoS (osd/OSD.h ShardedOpWQ over
-        # osd/mClock*): ops shard by pgid, classes arbitrate by
-        # reservation/weight/limit.  One worker per shard keeps per-PG
-        # FIFO order.  "direct" executes on dispatch threads (legacy).
+        # sharded op queue with mClock/dmClock QoS (osd/OSD.h ShardedOpWQ
+        # over osd/mClock* + src/dmclock): ops shard by pgid, classes
+        # arbitrate by reservation/weight/limit with distributed
+        # (delta, rho) increments from the MOSDOp wire tags.  One worker
+        # per shard keeps per-PG FIFO order.  "direct" executes on
+        # dispatch threads (legacy/seed FIFO).
         from ceph_tpu.osd.op_queue import ClassInfo, ShardedOpQueue
         self._use_opwq = str(self.ctx.conf.get("osd_op_queue")) == "mclock"
         self._mclock_per_client = bool(int(
             self.ctx.conf.get("osd_mclock_per_client")))
+        #: tenant lanes (osd_qos_tenant_lanes): client ops carrying an
+        #: authenticated tenant tag schedule as client.<tenant> with
+        #: the OSDMap qos_db's profile for that tenant
+        self._qos_tenant_lanes = bool(
+            self.ctx.conf.get("osd_qos_tenant_lanes"))
+        self.ctx.conf.add_observer(
+            "osd_qos_tenant_lanes",
+            lambda _n, v: setattr(self, "_qos_tenant_lanes", bool(v)))
         self.opwq = (ShardedOpQueue(
             self._opwq_handle,
             n_shards=int(self.ctx.conf.get("osd_op_num_shards")),
@@ -360,8 +383,20 @@ class OSDDaemon(Dispatcher):
                 limit=float(self.ctx.conf.get(
                     "osd_mclock_client_limit"))),
             max_client_backlog=int(self.ctx.conf.get(
-                "osd_op_queue_max_client_backlog")))
+                "osd_op_queue_max_client_backlog")),
+            idle_timeout=float(self.ctx.conf.get(
+                "osd_qos_idle_client_timeout")))
             if self._use_opwq else None)
+        if self.opwq is not None:
+            self.ctx.conf.add_observer(
+                "osd_qos_idle_client_timeout",
+                lambda _n, v: self.opwq.set_idle_timeout(float(v)))
+        #: the qos_db snapshot currently folded into the scheduler
+        self._qos_profiles_applied: dict = {}
+        self.ctx.admin.register_command(
+            "dump_qos_stats", lambda **kw: self._dump_qos_stats(),
+            "per-tenant dmclock accounting: backlog, phase-served "
+            "counts, queue-wait totals, applied profiles")
 
         # recovery reservations (AsyncReserver / osd_max_backfills): a PG
         # needs a slot before pulling; pulls run in a bounded window
@@ -392,29 +427,92 @@ class OSDDaemon(Dispatcher):
             "dump_reservations", lambda **kw: self.local_reserver.dump(),
             "recovery reservation slots")
 
-    def _opwq_handle(self, klass: str, item) -> None:
+    def _opwq_handle(self, klass: str, item, served=None) -> None:
         """Shard worker: run the dispatch handler bound at enqueue.
         The worker JOINS the op's trace (the dispatch thread's
         thread-local died at the queue boundary; the id lives on the
-        message)."""
+        message).  ``served`` is the dmclock (phase, queue-wait) pair:
+        the phase is stamped onto the message for the reply's echo
+        (client rho accounting) and counted in the qos perf set, and a
+        traced op gets a ``qos_wait`` event so ``tracing show``
+        explains a throttled op."""
         handler, msg, cost = item
         from ceph_tpu.common import tracing
         # parent under the rx dispatch span deliver() stored on the msg
         prev = tracing.set_current(getattr(msg, "trace_id", 0),
                                    getattr(msg, "parent_span_id", 0))
         try:
+            if served is not None:
+                phase, wait = served
+                msg._qos_phase = phase
+                if phase == PHASE_RESERVATION:
+                    self.perf.inc("qos_reservation_served")
+                elif phase == PHASE_WEIGHT:
+                    self.perf.inc("qos_weight_served")
+                elif phase == PHASE_LIMIT:
+                    self.perf.inc("qos_limit_served")
+                self.perf.tinc("qos_wait", wait)
+                if tracing.current():   # untraced majority skips the
+                    tracing.record(     # event formatting entirely
+                        f"osd.{self.osd_id}",
+                        f"qos_wait {wait * 1000.0:.2f}ms class={klass} "
+                        f"phase={PHASE_NAMES.get(phase, phase)}")
             handler(msg)
         finally:
             tracing.set_current(prev)
             self._op_throttle.put(cost)
 
     def _client_class(self, msg) -> str:
-        """dmclock class for a client op: per-client tag streams when
+        """dmclock class for a client op: the authenticated TENANT lane
+        when the op carries one and osd_qos_tenant_lanes is on (the
+        MOSDOp v4 qos_tenant tag the RGW front stamps — its profile
+        comes from the OSDMap qos_db), else per-client tag streams when
         osd_mclock_per_client is on (mClockClientQueue), else one
-        aggregate class (mClockOpClassQueue)."""
+        aggregate class (mClockOpClassQueue).
+
+        Trust boundary: the tenant tag is client-asserted, like this
+        reduction's client_id/epoch — the gateway (which authenticates
+        the S3 principal) is the trusted stamper, and a direct rados
+        client claiming another tenant's lane is equivalent to the
+        pre-existing client_id spoof.  Binding tenants to cephx
+        entity caps (the reference's osd cap profile machinery) is the
+        hardening step when untrusted direct clients matter; operators
+        running such clients today should leave per-client lanes on
+        and keep osd_qos_tenant_lanes for gateway-fronted pools."""
+        if self._qos_tenant_lanes:
+            tenant = getattr(msg, "qos_tenant", "")
+            if tenant:
+                return f"client.{tenant}"
         if self._mclock_per_client:
             return f"client.{getattr(msg, 'client_id', 0)}"
         return "client"
+
+    def _dump_qos_stats(self) -> dict:
+        """Admin `dump_qos_stats`: the merged per-lane dmclock
+        accounting plus the qos_db snapshot this daemon scheduled
+        from."""
+        if self.opwq is None:
+            return {"queue": "direct", "classes": {},
+                    "profiles": dict(self._qos_profiles_applied)}
+        out = self.opwq.dump_qos()
+        out["queue"] = "mclock"
+        out["tenant_lanes"] = self._qos_tenant_lanes
+        out["profiles"] = dict(self._qos_profiles_applied)
+        return out
+
+    def _qos_digest(self) -> dict:
+        """Per-lane accounting digest for the MMgrReport v4 tail (the
+        mgr qos_feed -> ceph_qos_* prometheus families): client lanes
+        + the aggregate evicted rollup, totals only."""
+        if self.opwq is None:
+            return {}
+        d = self.opwq.dump_qos()
+        lanes = {}
+        for name, row in d["classes"].items():
+            lanes[name] = {"backlog": row["backlog"],
+                           "served": row["served"],
+                           "wait_sum_s": row["wait_sum_s"]}
+        return {"lanes": lanes, "evicted": d["evicted"]}
 
     @staticmethod
     def _op_cost(msg) -> int:
@@ -441,7 +539,9 @@ class OSDDaemon(Dispatcher):
             cost = min(self._op_cost(msg), self._op_throttle.max_amount)
             self._op_throttle.get(cost)
             if not self.opwq.enqueue(shard_key, klass,
-                                     (handler, msg, cost)):
+                                     (handler, msg, cost),
+                                     delta=getattr(msg, "qos_delta", 1),
+                                     rho=getattr(msg, "qos_rho", 1)):
                 # client backlog cap: refuse (no reply) — the client's
                 # timeout resend retries once the shard drains
                 self._op_throttle.put(cost)
@@ -597,7 +697,8 @@ class OSDDaemon(Dispatcher):
             perf=self.ctx.perf.dump(),
             slow_traces=tracing.slow_trace_digests(),
             slow_ops=self.op_tracker.slow_digests(),
-            profile=telemetry.pipeline_profile_digest()))
+            profile=telemetry.pipeline_profile_digest(),
+            qos=self._qos_digest()))
 
     ROTATING_REFRESH = 60.0
 
@@ -828,6 +929,7 @@ class OSDDaemon(Dispatcher):
             return
         dout("osd", 5, "osd.%d got map epoch %d", self.osd_id, newmap.epoch)
         self._apply_config_db(newmap)
+        self._apply_qos_db(newmap)
         self._split_pgs(newmap)
         upd = None
         if self._map_shared:
@@ -879,6 +981,26 @@ class OSDDaemon(Dispatcher):
                 dout("osd", 5, "osd.%d ignoring unknown config %s",
                      self.osd_id, name)
         self._mon_config_applied = set(desired)
+
+    def _apply_qos_db(self, m: OSDMap) -> None:
+        """Fold the map's per-tenant QoS profiles into the scheduler
+        (`ceph qos set/rm` -> qos_db -> every OSD's mClock lanes): the
+        dmclock class for tenant T is client.T, so a tenant's
+        reservation/weight/limit apply the moment its map lands —
+        including to lanes already backlogged."""
+        if self.opwq is None or m.qos_db == self._qos_profiles_applied:
+            return
+        from ceph_tpu.osd.op_queue import ClassInfo
+        from ceph_tpu.qos.dmclock import profiles_from_db
+        profiles = {
+            f"client.{tenant}": ClassInfo(reservation=p.reservation,
+                                          weight=p.weight,
+                                          limit=p.limit)
+            for tenant, p in profiles_from_db(m.qos_db).items()}
+        self.opwq.set_client_profiles(profiles)
+        self._qos_profiles_applied = dict(m.qos_db)
+        dout("osd", 5, "osd.%d applied qos_db (%d tenants)",
+             self.osd_id, len(profiles))
 
     def _pg_stats_summary(self) -> tuple[dict, int]:
         """(state -> count over primary PGs, degraded object count).
@@ -2338,11 +2460,15 @@ class OSDDaemon(Dispatcher):
 
     def _op_send_reply(self, msg: MOSDOp, reply: "MOSDOpReply") -> None:
         """Single client-reply chokepoint: closes the op's TrackedOp
-        timeline (OpRequest lifecycle) and sends."""
+        timeline (OpRequest lifecycle), echoes the dmclock phase that
+        served the op (the client's ServiceTracker counts rho from
+        it), and sends."""
         trk = getattr(msg, "_trk", None)
         if trk is not None:
             trk.mark_event(f"reply result={reply.result}")
             trk.finish()
+        if not reply.qos_phase:
+            reply.qos_phase = getattr(msg, "_qos_phase", 0)
         if msg.connection is not None:
             msg.connection.send_message(reply)
 

@@ -1,4 +1,4 @@
-"""Sharded op queue with mClock QoS scheduling.
+"""Sharded op queue with mClock/dmClock QoS scheduling.
 
 The reference pushes every op through a sharded work queue
 (osd/OSD.h:1725-1807 ShardedOpWQ over ShardedThreadPool,
@@ -15,26 +15,39 @@ This is that engine, reduced to its algorithmic core:
     pg-keyed sharding gives the per-PG ordering the OSD requires.
   * `MClockQueue` — dmclock tag math: each class k has a reservation
     r_k (ops/s guaranteed), weight w_k (share of excess), limit l_k
-    (ops/s cap, 0 = none).  Each enqueued op gets tags
-        R_k = max(now, R_k_prev + 1/r_k)
-        L_k = max(now, L_k_prev + 1/l_k)
-        P_k = max(now, P_k_prev + 1/w_k)        (proportional tag)
+    (ops/s cap, 0 = none).  Tags track the class's HEAD item and advance
+    per served op by that op's distributed-service increments
+        R_k = max(now, R_k_prev + rho/r_k)
+        L_k = max(now, L_k_prev + delta/l_k)
+        P_k = max(now, P_k_prev + delta/w_k)     (proportional tag)
+    where (delta, rho) ride each op from the client's ServiceTracker
+    (ceph_tpu.qos.dmclock): delta counts the tenant's completions on
+    ANY osd since its last op here, rho the reservation-phase subset —
+    so reservations and limits hold for the tenant cluster-wide.  Local
+    ops and old peers carry delta = rho = 1, which is exactly mClock.
     Dequeue picks the earliest R-tag that is ≤ now (reservation phase);
     otherwise the earliest P-tag among classes whose L-tag permits
     (weight phase); otherwise — every backlogged class limit-throttled —
     the earliest L-tag (work-conserving fallback: serve whoever's cap
-    expires soonest rather than idle).
+    expires soonest rather than idle).  Every dequeue reports the phase
+    served and the op's queue wait, feeding the reply's phase echo (rho
+    accounting), the qos_wait trace event, and ``dump_qos_stats``.
 
 dmclock reference: the mClock paper's tag rules as embodied in the
-reference's `osd_op_queue=mclock_*` options (common/options.cc).
+reference's `osd_op_queue=mclock_*` options (common/options.cc), plus
+the dmClock (delta, rho) extension from src/dmclock.
 """
 
 from __future__ import annotations
 
+import inspect
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
+
+from ceph_tpu.qos.dmclock import (
+    PHASE_LIMIT, PHASE_RESERVATION, PHASE_WEIGHT)
 
 
 @dataclass
@@ -56,124 +69,281 @@ DEFAULT_CLASSES = {
     "snaptrim": ClassInfo(reservation=0.0, weight=5.0, limit=100.0),
 }
 
+_PHASES = (PHASE_RESERVATION, PHASE_WEIGHT, PHASE_LIMIT)
+
 
 @dataclass
 class _ClassState:
     info: ClassInfo
+    #: queued (item, delta, rho, t_enq, r_tag, p_tag, l_tag): each
+    #: request carries ITS OWN tags, assigned at arrival by chaining
+    #: from the previous request's (dmclock RequestTag — the chain is
+    #: what makes overloaded reservations share r-proportionally
+    #: instead of round-robin); the scheduler reads the head's tags
     q: deque = field(default_factory=deque)
+    #: chain tail: the tags of the most recently enqueued request
     r_tag: float = 0.0
     p_tag: float = 0.0
     l_tag: float = 0.0
+    #: class created on demand (per-client / per-tenant lane) — subject
+    #: to idle eviction, unlike the static class table
+    dynamic: bool = False
+    last_active: float = 0.0
+    # -- dump_qos_stats accounting (per class, merged across shards) --
+    served: list = field(default_factory=lambda: [0, 0, 0, 0])
+    wait_sum: float = 0.0
+    wait_max: float = 0.0
+    enqueued: int = 0
 
 
 class MClockQueue:
-    """Single-shard mClock scheduler over named op classes.
+    """Single-shard dmClock scheduler over named op classes.
 
-    Client ops may be tagged per client ("client.<id>" class names,
-    mClockClientQueue analog): each client gets its own dmclock tag
-    stream from the ``client_template`` (reservation/weight/limit), so
-    one chatty client cannot starve the rest — the per-client
-    reservations/limits the reference's dmclock client queue provides.
-    Idle per-client classes are pruned so the table stays bounded."""
+    Client ops may be tagged per client or per TENANT ("client.<id>" /
+    "client.<tenant>" class names, mClockClientQueue analog): each lane
+    gets its own dmclock tag stream — from ``client_profiles`` when the
+    OSDMap's qos_db names the tenant (``ceph qos set``), else from the
+    ``client_template`` — so one chatty tenant cannot starve the rest.
+    Idle dynamic lanes are evicted after ``idle_timeout`` seconds of
+    quiet so millions of one-shot clients never grow the table without
+    bound; their served/wait totals fold into an ``evicted`` rollup so
+    dump_qos_stats stays truthful across evictions.
+    """
 
-    #: idle per-client classes older than this are dropped
+    #: default quiet period before an idle dynamic lane is dropped
+    #: (osd_qos_idle_client_timeout overrides per daemon)
     CLIENT_IDLE_PRUNE = 60.0
 
+    #: eviction sweep cadence, in dynamic-lane enqueues
+    _PRUNE_EVERY = 256
+
     def __init__(self, classes: dict[str, ClassInfo] | None = None,
-                 client_template: ClassInfo | None = None):
+                 client_template: ClassInfo | None = None,
+                 client_profiles: dict[str, ClassInfo] | None = None,
+                 idle_timeout: float | None = None):
         self._classes: dict[str, _ClassState] = {}
         for name, info in (classes or DEFAULT_CLASSES).items():
             self._classes[name] = _ClassState(info=info)
         self.client_template = client_template
-        self._client_last_seen: dict[str, float] = {}
+        #: full-class-name ("client.<tenant>") -> ClassInfo from the
+        #: distributed qos_db; consulted before the template
+        self.client_profiles = dict(client_profiles or {})
+        self.idle_timeout = (self.CLIENT_IDLE_PRUNE if idle_timeout is None
+                             else float(idle_timeout))
+        #: first-segment group -> queued items (O(1) class_backlog for
+        #: the hot dot-free prefixes: "client" covers client + client.*)
+        self._group_len: dict[str, int] = {}
         self._enq_count = 0
         self._len = 0
+        #: rollup of evicted lanes (bounded: totals only)
+        self._evicted = {"classes": 0, "served": [0, 0, 0, 0],
+                         "wait_sum": 0.0, "enqueued": 0}
 
     def __len__(self) -> int:
         return self._len
 
-    def class_backlog(self, prefix: str) -> int:
-        """Queued items across classes matching the prefix."""
-        return sum(len(st.q) for n, st in self._classes.items()
-                   if n == prefix or n.startswith(prefix + "."))
+    @staticmethod
+    def _group(name: str) -> str:
+        return name.split(".", 1)[0]
 
-    def enqueue(self, klass: str, item, now: float | None = None) -> None:
-        now = time.monotonic() if now is None else now
+    def exact_backlog(self, klass: str) -> int:
+        """Queued items of exactly this class — O(1), the per-lane
+        intake-cap check on the enqueue hot path."""
         st = self._classes.get(klass)
-        if st is None:
-            if klass.startswith("client.") and self.client_template:
-                info = ClassInfo(
-                    reservation=self.client_template.reservation,
-                    weight=self.client_template.weight,
-                    limit=self.client_template.limit)
-            else:
-                info = ClassInfo()
-            st = self._classes[klass] = _ClassState(info=info)
-        if klass.startswith("client."):
-            self._client_last_seen[klass] = now
-            self._enq_count += 1
-            if self._enq_count % 256 == 0:
-                self._prune_clients(now)
+        return len(st.q) if st is not None else 0
+
+    def class_backlog(self, prefix: str) -> int:
+        """Queued items across classes matching the prefix (the class
+        itself or prefix.* descendants).  Dot-free prefixes — the hot
+        aggregate check ("client") — read a maintained per-group
+        counter instead of scanning every lane."""
+        if "." not in prefix:
+            return self._group_len.get(prefix, 0)
+        dotted = prefix + "."
+        return sum(len(st.q) for n, st in self._classes.items()
+                   if n == prefix or n.startswith(dotted))
+
+    def _client_info(self, klass: str) -> ClassInfo:
+        prof = self.client_profiles.get(klass)
+        if prof is not None:
+            return ClassInfo(reservation=prof.reservation,
+                             weight=prof.weight, limit=prof.limit)
+        if klass.startswith("client.") and self.client_template:
+            t = self.client_template
+            return ClassInfo(reservation=t.reservation, weight=t.weight,
+                             limit=t.limit)
+        return ClassInfo()
+
+    def set_client_profiles(
+            self, profiles: dict[str, ClassInfo]) -> None:
+        """Fold a new qos_db snapshot in: future lanes resolve against
+        it, and EXISTING dynamic lanes re-resolve now — a `ceph qos
+        set` takes effect on a backlogged tenant without waiting for
+        its queue to drain."""
+        self.client_profiles = dict(profiles)
+        for name, st in self._classes.items():
+            if st.dynamic:
+                info = self._client_info(name)
+                if (info.reservation, info.weight, info.limit) != (
+                        st.info.reservation, st.info.weight,
+                        st.info.limit):
+                    st.info = info
+                    self._retag(st)
+
+    @staticmethod
+    def _tag_chain(st: _ClassState, now: float, delta: int,
+                   rho: int) -> tuple[float, float, float]:
+        """Tags for the next request of the class (dmclock RequestTag):
+        an idle class restarts its chain from arrival (no accumulated
+        debt OR credit); a backlogged class chains max(prev + inc,
+        arrival), per-op increments scaled by the request's distributed
+        (delta, rho).  Weight 0 is treated as the minimum share, not a
+        crash."""
         i = st.info
         if not st.q:
-            # idle class: tags restart from now (dmclock idle reset);
-            # weight 0 is treated as the minimum share, not a crash
-            st.r_tag = now + (1.0 / i.reservation if i.reservation else 0.0)
-            st.p_tag = now + 1.0 / max(i.weight, 1e-6)
-            st.l_tag = now + (1.0 / i.limit if i.limit else 0.0)
-        st.q.append(item)
+            r = now + (rho / i.reservation if i.reservation else 0.0)
+            p = now + delta / max(i.weight, 1e-6)
+            lt = now + (delta / i.limit if i.limit else 0.0)
+        else:
+            r = (max(st.r_tag + rho / i.reservation, now)
+                 if i.reservation else 0.0)
+            p = max(st.p_tag + delta / max(i.weight, 1e-6), now)
+            lt = (max(st.l_tag + delta / i.limit, now)
+                  if i.limit else 0.0)
+        return r, p, lt
+
+    def enqueue(self, klass: str, item, now: float | None = None,
+                delta: int = 1, rho: int = 1) -> None:
+        now = time.monotonic() if now is None else now
+        delta = max(1, int(delta))
+        rho = max(0, int(rho))
+        st = self._classes.get(klass)
+        if st is None:
+            st = self._classes[klass] = _ClassState(
+                info=self._client_info(klass), dynamic=True)
+        if st.dynamic:
+            st.last_active = now
+            self._enq_count += 1
+            if self._enq_count % self._PRUNE_EVERY == 0:
+                self.prune(now)
+        r, p, lt = self._tag_chain(st, now, delta, rho)
+        st.r_tag, st.p_tag, st.l_tag = r, p, lt
+        st.q.append((item, delta, rho, now, r, p, lt))
+        st.enqueued += 1
         self._len += 1
+        g = self._group(klass)
+        self._group_len[g] = self._group_len.get(g, 0) + 1
 
-    def _prune_clients(self, now: float) -> None:
-        stale = [n for n, seen in self._client_last_seen.items()
-                 if now - seen > self.CLIENT_IDLE_PRUNE
-                 and not self._classes[n].q]
+    def prune(self, now: float | None = None) -> None:
+        """Evict idle dynamic lanes (quiet for idle_timeout with an
+        empty queue), folding their accounting into the rollup."""
+        now = time.monotonic() if now is None else now
+        stale = [n for n, st in self._classes.items()
+                 if st.dynamic and not st.q
+                 and now - st.last_active > self.idle_timeout]
+        ev = self._evicted
         for n in stale:
-            del self._classes[n]
-            del self._client_last_seen[n]
+            st = self._classes.pop(n)
+            ev["classes"] += 1
+            ev["enqueued"] += st.enqueued
+            ev["wait_sum"] += st.wait_sum
+            for p in range(4):
+                ev["served"][p] += st.served[p]
 
-    def _advance(self, st: _ClassState, now: float) -> None:
-        i = st.info
-        if i.reservation:
-            st.r_tag = max(now, st.r_tag + 1.0 / i.reservation)
-        if i.limit:
-            st.l_tag = max(now, st.l_tag + 1.0 / i.limit)
-        st.p_tag = max(now, st.p_tag + 1.0 / max(i.weight, 1e-6))
+    def _retag(self, st: _ClassState) -> None:
+        """Rebuild the class's tag chain under a CHANGED profile
+        (`ceph qos set` on a backlogged tenant): every queued request
+        re-tags from its recorded arrival and (delta, rho), so the new
+        reservation/weight/limit govern the existing backlog too —
+        not just ops enqueued after the map landed."""
+        old = st.q
+        st.q = deque()
+        for item, delta, rho, t_enq, _r, _p, _l in old:
+            r, p, lt = self._tag_chain(st, t_enq, delta, rho)
+            st.r_tag, st.p_tag, st.l_tag = r, p, lt
+            st.q.append((item, delta, rho, t_enq, r, p, lt))
+
+    def _pop(self, name: str, st: _ClassState, now: float,
+             phase: int) -> tuple:
+        item, _delta, _rho, t_enq, _r, _p, _l = st.q.popleft()
+        self._len -= 1
+        g = self._group(name)
+        left = self._group_len.get(g, 1) - 1
+        if left:
+            self._group_len[g] = left
+        else:
+            self._group_len.pop(g, None)
+        wait = max(0.0, now - t_enq)
+        st.served[phase] += 1
+        st.wait_sum += wait
+        if wait > st.wait_max:
+            st.wait_max = wait
+        if st.dynamic:
+            st.last_active = now
+        return name, item, phase, wait
 
     def dequeue(self, now: float | None = None):
-        """Return (class, item) or None if empty."""
+        """Return (class, item, phase, wait_seconds) or None if empty.
+        Selection reads each class's HEAD request tags (q[0][4:7])."""
         now = time.monotonic() if now is None else now
         backlogged = [(n, st) for n, st in self._classes.items() if st.q]
         if not backlogged:
             return None
         # phase 1: honor reservations that are due
-        due = [(st.r_tag, n, st) for n, st in backlogged
-               if st.info.reservation and st.r_tag <= now]
+        due = [(st.q[0][4], n, st) for n, st in backlogged
+               if st.info.reservation and st.q[0][4] <= now]
         if due:
             _tag, name, st = min(due)
-            self._advance(st, now)
-            self._len -= 1
-            return name, st.q.popleft()
+            return self._pop(name, st, now, PHASE_RESERVATION)
         # phase 2: weight-proportional among classes under their limit
-        ok = [(st.p_tag, n, st) for n, st in backlogged
-              if not st.info.limit or st.l_tag <= now]
+        ok = [(st.q[0][5], n, st) for n, st in backlogged
+              if not st.info.limit or st.q[0][6] <= now]
         if ok:
             _tag, name, st = min(ok)
-            self._advance(st, now)
-            self._len -= 1
-            return name, st.q.popleft()
+            return self._pop(name, st, now, PHASE_WEIGHT)
         # phase 3: everything limited — work-conserving: earliest limit tag
-        _tag, name, st = min((st.l_tag, n, st) for n, st in backlogged)
-        self._advance(st, now)
-        self._len -= 1
-        return name, st.q.popleft()
+        _tag, name, st = min((st.q[0][6], n, st) for n, st in backlogged)
+        return self._pop(name, st, now, PHASE_LIMIT)
+
+    def dump_qos(self) -> dict:
+        """Per-class accounting snapshot (dump_qos_stats feed)."""
+        classes = {}
+        for n, st in self._classes.items():
+            classes[n] = {
+                "backlog": len(st.q),
+                "enqueued": st.enqueued,
+                "served": {"reservation": st.served[PHASE_RESERVATION],
+                           "weight": st.served[PHASE_WEIGHT],
+                           "limit": st.served[PHASE_LIMIT]},
+                "wait_sum_s": st.wait_sum,
+                "wait_max_s": st.wait_max,
+                "dynamic": st.dynamic,
+                "profile": {"reservation": st.info.reservation,
+                            "weight": st.info.weight,
+                            "limit": st.info.limit}}
+        ev = self._evicted
+        return {"classes": classes,
+                "evicted": {
+                    "classes": ev["classes"],
+                    "enqueued": ev["enqueued"],
+                    "wait_sum_s": ev["wait_sum"],
+                    "served": {
+                        "reservation": ev["served"][PHASE_RESERVATION],
+                        "weight": ev["served"][PHASE_WEIGHT],
+                        "limit": ev["served"][PHASE_LIMIT]}}}
 
 
 class ShardedOpQueue:
-    """N independent mClock shards, each drained by worker thread(s).
+    """N independent dmClock shards, each drained by worker thread(s).
 
     Items shard by key (hash(pgid) % n_shards) so per-PG order is kept
     and one stuck PG only wedges its shard (ShardedOpWQ semantics).
+
+    The handler may take a third parameter — ``handler(klass, item,
+    served)`` with ``served = (phase, wait_seconds)`` — to learn which
+    dmclock phase served the op and how long it queued (the MOSDOpReply
+    phase echo + qos_wait trace event); two-parameter handlers keep
+    working unchanged.
     """
 
     #: tagged clients together may queue up to this many times the
@@ -185,8 +355,27 @@ class ShardedOpQueue:
                  classes: dict[str, ClassInfo] | None = None,
                  name: str = "osd",
                  client_template: ClassInfo | None = None,
-                 max_client_backlog: int = 0):
+                 max_client_backlog: int = 0,
+                 client_profiles: dict[str, ClassInfo] | None = None,
+                 idle_timeout: float | None = None):
         self._handler = handler
+        try:
+            params = inspect.signature(handler).parameters.values()
+            # count what can actually be fed POSITIONALLY (keyword-only
+            # and **kwargs can't take the served tuple; counting them
+            # would make the worker call a 2-positional handler with 3
+            # args and wedge the queue); *args handlers take
+            # everything, and an unsignaturable callable is assumed
+            # modern (3-arg) rather than silently losing phase data
+            positional = sum(
+                1 for p in params
+                if p.kind in (p.POSITIONAL_ONLY,
+                              p.POSITIONAL_OR_KEYWORD))
+            self._handler_takes_served = (
+                positional >= 3
+                or any(p.kind == p.VAR_POSITIONAL for p in params))
+        except (TypeError, ValueError):
+            self._handler_takes_served = True
         self._n = max(1, n_shards)
         self._shards = []
         self._stop = False
@@ -198,7 +387,9 @@ class ShardedOpQueue:
         self.max_client_backlog = max_client_backlog
         self._threads: list[threading.Thread] = []
         for s in range(self._n):
-            q = MClockQueue(classes, client_template=client_template)
+            q = MClockQueue(classes, client_template=client_template,
+                            client_profiles=client_profiles,
+                            idle_timeout=idle_timeout)
             # analysis: allow[bare-lock] -- per-shard parking condition: waiters hold no other lock; one node per shard would still merge by name
             cv = threading.Condition()
             self._shards.append((q, cv))
@@ -209,7 +400,8 @@ class ShardedOpQueue:
                 t.start()
                 self._threads.append(t)
 
-    def enqueue(self, shard_key, klass: str, item) -> bool:
+    def enqueue(self, shard_key, klass: str, item,
+                delta: int = 1, rho: int = 1) -> bool:
         """Queue an item; returns False when a CLIENT op is refused at
         the per-shard backlog cap.  Refusal (not blocking) is the
         backpressure mechanism: the caller runs on the daemon's single
@@ -233,7 +425,7 @@ class ShardedOpQueue:
                 # memory — without it N distinct client ids could queue
                 # N x cap items between them
                 if (klass.startswith("client.")
-                        and q.class_backlog(klass)
+                        and q.exact_backlog(klass)
                         >= self.max_client_backlog):
                     return False
                 total_cap = (self.max_client_backlog
@@ -242,9 +434,53 @@ class ShardedOpQueue:
                              * self.CLIENT_AGGREGATE_FACTOR)
                 if q.class_backlog("client") >= total_cap:
                     return False
-            q.enqueue(klass, item)
+            q.enqueue(klass, item, delta=delta, rho=rho)
             cv.notify()
         return True
+
+    def set_client_profiles(
+            self, profiles: dict[str, ClassInfo]) -> None:
+        """Push a new qos_db snapshot into every shard (map change)."""
+        for q, cv in self._shards:
+            with cv:
+                q.set_client_profiles(profiles)
+
+    def set_idle_timeout(self, timeout: float) -> None:
+        """Hot-reload the idle-lane eviction quiet period."""
+        for q, cv in self._shards:
+            with cv:
+                q.idle_timeout = float(timeout)
+
+    def dump_qos(self) -> dict:
+        """dump_qos_stats payload: the per-class accounting merged
+        across shards (served counts sum, wait_max maxes)."""
+        merged: dict = {}
+        evicted = {"classes": 0, "enqueued": 0, "wait_sum_s": 0.0,
+                   "served": {"reservation": 0, "weight": 0, "limit": 0}}
+        for q, cv in self._shards:
+            with cv:
+                d = q.dump_qos()
+            for name, row in d["classes"].items():
+                agg = merged.get(name)
+                if agg is None:
+                    merged[name] = dict(row)
+                    merged[name]["served"] = dict(row["served"])
+                    continue
+                agg["backlog"] += row["backlog"]
+                agg["enqueued"] += row["enqueued"]
+                agg["wait_sum_s"] += row["wait_sum_s"]
+                agg["wait_max_s"] = max(agg["wait_max_s"],
+                                        row["wait_max_s"])
+                for ph, n in row["served"].items():
+                    agg["served"][ph] += n
+                agg["profile"] = row["profile"]
+            ev = d["evicted"]
+            evicted["classes"] += ev["classes"]
+            evicted["enqueued"] += ev["enqueued"]
+            evicted["wait_sum_s"] += ev["wait_sum_s"]
+            for ph, n in ev["served"].items():
+                evicted["served"][ph] += n
+        return {"shards": self._n, "classes": merged, "evicted": evicted}
 
     def shutdown(self) -> None:
         self._stop = True
@@ -264,9 +500,12 @@ class ShardedOpQueue:
                 got = q.dequeue()
             if got is None:
                 continue
-            klass, item = got
+            klass, item, phase, wait = got
             try:
-                self._handler(klass, item)
+                if self._handler_takes_served:
+                    self._handler(klass, item, (phase, wait))
+                else:
+                    self._handler(klass, item)
             except Exception:
                 from ceph_tpu.common.logging import get_logger
                 get_logger("osd").exception("opwq handler failed (%s)",

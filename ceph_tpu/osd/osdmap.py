@@ -157,6 +157,11 @@ class OSDMap:
     #: probing joiner learns the authoritative member set.  Empty on
     #: clusters bootstrapped with a static monmap before first commit
     mon_db: dict = field(default_factory=dict)
+    #: per-tenant QoS profiles (dmclock ClientInfo distribution):
+    #: tenant -> {"reservation", "weight", "limit"}, committed by
+    #: `ceph qos set/rm` and folded into every OSD's mClock scheduler
+    #: on map application — all OSDs agree on the tenant lanes
+    qos_db: dict = field(default_factory=dict)
     #: per-osd laggy history (osd_xinfo_t vector)
     osd_xinfo: list[OSDXInfo] = field(default_factory=list)
 
@@ -174,7 +179,7 @@ class OSDMap:
             setattr(m, attr, list(getattr(self, attr)))
         for attr in ("pools", "pg_upmap", "pg_upmap_items", "pg_temp",
                      "primary_temp", "config_db", "auth_db", "fs_db",
-                     "crush_names", "mgr_db", "mon_db"):
+                     "crush_names", "mgr_db", "mon_db", "qos_db"):
             setattr(m, attr, dict(getattr(self, attr)))
         return m
 

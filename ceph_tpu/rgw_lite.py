@@ -27,9 +27,17 @@ _LAYOUT = StripeLayout(stripe_unit=1 << 16, stripe_count=2,
 class Bucket:
     INDEX_FMT = ".bucket.index.{name}"
 
-    def __init__(self, ioctx, name: str, compression: str = "none"):
+    def __init__(self, ioctx, name: str, compression: str = "none",
+                 tenant: str | None = None):
+        #: tenant scopes every rados op of this bucket handle to the
+        #: tenant's QoS lane (rgw_user tenant -> dmclock class on the
+        #: OSDs); plain dict-backed test ioctxs lack with_tenant and
+        #: pass through unscoped
+        if tenant and hasattr(ioctx, "with_tenant"):
+            ioctx = ioctx.with_tenant(tenant)
         self.io = ioctx
         self.name = name
+        self.tenant = tenant
         self.comp = _compressor.create(compression)
         self.compression = compression
 

@@ -800,7 +800,13 @@ class _S3Request:
             parts = parsed.path.lstrip("/").split("/", 1)
             bucket = urllib.parse.unquote(parts[0])
             key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
-            self._route(gw, self.command, bucket, key, q, body, principal)
+            # tenant QoS lane: every rados op this request issues —
+            # index omap, striped data, multipart staging — bills to
+            # the authenticated user's tenant, so the OSDs' dmclock
+            # schedulers arbitrate S3 traffic per tenant end-to-end
+            with self.server.rgw.rados_lane(principal):
+                self._route(gw, self.command, bucket, key, q, body,
+                            principal)
         except S3Error as e:
             self._respond(e.status, _error_xml(e.code, str(e)),
                           {"Content-Type": "application/xml"})
@@ -1400,9 +1406,13 @@ def load_pool_users(ioctx) -> dict[str, dict]:
     return out
 
 
-def save_pool_user(ioctx, access: str, secret: str, uid: str) -> None:
+def save_pool_user(ioctx, access: str, secret: str, uid: str,
+                   tenant: str | None = None) -> None:
+    """tenant names the user's QoS lane (rgw_user tenant field); it
+    defaults to the uid so every user is its own lane until an
+    operator groups users under a shared tenant."""
     ioctx.set_omap(USERS_OID, {access: json.dumps(
-        {"secret": secret, "uid": uid,
+        {"secret": secret, "uid": uid, "tenant": tenant or uid,
          "created": time.time()}).encode()})
 
 
@@ -1435,7 +1445,8 @@ class RgwRestServer:
     def __init__(self, ioctx, addr: str = "127.0.0.1:0",
                  compression: str = "none",
                  max_skew: float | None = 900.0, clock=time.time,
-                 lc_interval: float | None = None, ctx=None):
+                 lc_interval: float | None = None, ctx=None,
+                 frontend_workers: int = 8):
         self.gateway = S3Gateway(ioctx, compression=compression,
                                  clock=clock)
         # gateway perf set (rgw's l_rgw_* counters): op counts by verb,
@@ -1453,6 +1464,9 @@ class RgwRestServer:
         self._perf_coll = (ctx or default_context()).perf
         self._perf_coll.add(self.perf)
         self.keys: dict[str, str] = {}
+        #: access key -> QoS tenant lane for in-memory keys (pool
+        #: users carry their tenant in the registry record)
+        self.key_tenants: dict[str, str] = {}
         #: SigV4 freshness window in seconds (AWS: 15 min); None
         #: disables the check.  clock is injectable for tests.
         self.max_skew = max_skew
@@ -1465,9 +1479,13 @@ class RgwRestServer:
         self._lc_thread: threading.Thread | None = None
         #: event-driven frontend (rgw_asio_frontend analog): one I/O
         #: loop owning the sockets + a bounded handler pool, replacing
-        #: the old thread-per-connection stdlib server
+        #: the old thread-per-connection stdlib server.  The pool must
+        #: exceed the expected concurrent-request fan-in or tenants
+        #: head-of-line block each other at HTTP before the OSDs'
+        #: dmclock lanes ever see their ops (rgw_thread_pool_size)
         self._frontend = AsyncHttpFrontend(
-            lambda req: self._handle_counted(req), addr)
+            lambda req: self._handle_counted(req), addr,
+            workers=frontend_workers)
 
     def _handle_counted(self, req) -> tuple[int, dict, bytes]:
         """Request entry: route through _S3Request under the perf set.
@@ -1494,12 +1512,27 @@ class RgwRestServer:
     def addr(self) -> str:
         return self._frontend.addr
 
-    def add_key(self, access: str, secret: str) -> None:
+    def add_key(self, access: str, secret: str,
+                tenant: str | None = None) -> None:
         self.keys[access] = secret
+        if tenant:
+            self.key_tenants[access] = tenant
 
     #: pool-user cache TTL: radosgw-admin created users become usable
     #: within this window without a gateway restart
     USER_CACHE_TTL = 2.0
+
+    def _pool_user_table(self) -> dict:
+        """The pool user registry behind ONE shared TTL read-through
+        cache (lookup_key and tenant_of both consult it — without the
+        sharing every authenticated request would pay a rados round
+        trip for its tenant lookup)."""
+        now = self.clock()
+        cached = getattr(self, "_user_cache", None)
+        if cached is None or now - cached[0] > self.USER_CACHE_TTL:
+            cached = (now, load_pool_users(self.gateway.io))
+            self._user_cache = cached
+        return cached[1]
 
     def lookup_key(self, access: str) -> str | None:
         """Secret for an access key: the in-memory table first, then
@@ -1508,14 +1541,40 @@ class RgwRestServer:
         secret = self.keys.get(access)
         if secret is not None:
             return secret
-        now = self.clock()
-        cached = getattr(self, "_user_cache", None)
-        if cached is None or now - cached[0] > self.USER_CACHE_TTL:
-            users = load_pool_users(self.gateway.io)
-            cached = (now, users)
-            self._user_cache = cached
-        rec = cached[1].get(access)
+        rec = self._pool_user_table().get(access)
         return rec["secret"] if rec else None
+
+    def tenant_of(self, access: str | None) -> str | None:
+        """QoS tenant lane for an authenticated principal: the
+        explicit add_key tenant, then the pool user record's tenant
+        (defaulting to its uid), then the access key itself — every
+        authenticated identity lands in SOME lane.  In-memory keys
+        without a tenant short-circuit before the pool table: their
+        lane is the access key, no registry read needed."""
+        if not access:
+            return None
+        tenant = self.key_tenants.get(access)
+        if tenant:
+            return tenant
+        if access in self.keys:
+            return access
+        rec = self._pool_user_table().get(access)
+        if rec:
+            return rec.get("tenant") or rec.get("uid") or access
+        return access
+
+    def rados_lane(self, principal: str | None):
+        """Context manager billing the calling thread's rados ops to
+        the principal's tenant lane (no-op for anonymous requests or
+        non-rados io handles — unit tests run the gateway over plain
+        dict-backed stubs)."""
+        import contextlib
+        client = getattr(self.gateway.io, "client", None)
+        tenant = self.tenant_of(principal)
+        if tenant is None or client is None \
+                or not hasattr(client, "qos_tenant"):
+            return contextlib.nullcontext()
+        return client.qos_tenant(tenant)
 
     def provision_from_cephx(self, cluster_key: bytes | str
                              ) -> tuple[str, str]:

@@ -71,6 +71,9 @@ COMMANDS = {
     ("tracing", "ls"): [],
     ("tracing", "show"): ["trace_id"],
     ("slow_ops",): [],
+    ("qos", "set"): ["tenant"],
+    ("qos", "rm"): ["tenant"],
+    ("qos", "ls"): [],
 }
 
 #: prefixes served by the active MGR (re-targeted via `mgr dump`),

@@ -7,7 +7,7 @@ short user-cache TTL, no restarts.
     python -m ceph_tpu.tools.rgw_admin_cli --mon <host> -p <pool> <cmd>
 
 Commands:
-    user create --uid NAME [--access A] [--secret S]
+    user create --uid NAME [--access A] [--secret S] [--tenant T]
     user ls | user info --uid NAME | user rm --uid NAME
     bucket ls                       (the pool's bucket registry)
 """
@@ -50,6 +50,9 @@ def main(argv=None) -> int:
             if verb == "create":
                 sub.add_argument("--access", default="")
                 sub.add_argument("--secret", default="")
+                sub.add_argument("--tenant", default="",
+                                 help="QoS tenant lane (defaults to "
+                                      "the uid; see docs/QOS.md)")
             a = sub.parse_args(w[2:])
             users = load_pool_users(io)
             if verb == "ls":
@@ -68,9 +71,12 @@ def main(argv=None) -> int:
                 access = a.access or \
                     "AK" + secrets.token_hex(9).upper()
                 secret = a.secret or secrets.token_hex(20)
-                save_pool_user(io, access, secret, a.uid)
+                save_pool_user(io, access, secret, a.uid,
+                               tenant=a.tenant or None)
                 print(json.dumps({"uid": a.uid, "access_key": access,
-                                  "secret_key": secret}, indent=1))
+                                  "secret_key": secret,
+                                  "tenant": a.tenant or a.uid},
+                                 indent=1))
                 return 0
             mine = {acc: r for acc, r in users.items()
                     if r.get("uid") == a.uid}

@@ -23,7 +23,8 @@ class MiniCluster:
     def __init__(self, n_osds: int = 3, ms_type: str = "async",
                  store_type: str = "memstore", base_path: str = "",
                  heartbeats: bool = False, n_mons: int = 1,
-                 auth_key=None, cephx: bool = False):
+                 auth_key=None, cephx: bool = False,
+                 osd_conf: dict | None = None):
         # namespace loopback addresses per cluster: sequential tests reuse
         # names like "mon.0", and a timer from a dying daemon of the
         # previous cluster must never reach this one
@@ -40,6 +41,10 @@ class MiniCluster:
         self._n_initial = n_osds
         self._n_mons = n_mons
         self.auth_key = auth_key
+        #: startup config overrides applied to every OSD's context at
+        #: construction (vstart.sh -o analog): knobs read before the
+        #: first map lands (osd_op_queue, shard count, qos timeouts)
+        self.osd_conf = dict(osd_conf or {})
         #: full cephx mode: per-entity keys + tickets (wire stacks).
         #: The seed keyring (mon keys + admin) is generated here — the
         #: `ceph-authtool` bootstrap step
@@ -306,7 +311,8 @@ class MiniCluster:
                         store_path=path, ms_type=self.ms_type, addr=addr,
                         heartbeats=self.heartbeats,
                         auth_key=self.auth_key, cephx=cephx,
-                        mgr_addr=self.mgr.addr if self.mgr else None)
+                        mgr_addr=self.mgr.addr if self.mgr else None,
+                        conf=self.osd_conf)
         osd.init()
         self.osds[osd_id] = osd
         return osd

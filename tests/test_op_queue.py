@@ -59,7 +59,7 @@ def test_idle_class_tag_reset():
     assert q.dequeue(now=0.0)[1] == 1
     # long idle gap: tags must restart from now, not accumulate debt
     q.enqueue("a", 2, now=100.0)
-    _, item = q.dequeue(now=100.0)
+    item = q.dequeue(now=100.0)[1]
     assert item == 2
 
 

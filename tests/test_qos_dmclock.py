@@ -1,0 +1,370 @@
+"""dmClock tag algebra + distributed service tracking + QoS wire ext.
+
+Property tests pin the MClockQueue equilibrium against a water-filling
+oracle (reservation floors, weight-proportional excess, limit caps,
+work-conserving fallback); ServiceTracker tests pin the (delta, rho)
+accounting incl. the two-OSD cluster-wide reservation; wire tests pin
+the MOSDOp v4 / MOSDOpReply v2 QoS extension round-trip and the
+old-peer downgrade in both directions."""
+
+import random
+
+from ceph_tpu.msg.encoding import Decoder, Encoder
+from ceph_tpu.messages.osd_msgs import MOSDOp, MOSDOpReply, OSDOpField
+from ceph_tpu.osd.op_queue import ClassInfo, MClockQueue
+from ceph_tpu.qos.dmclock import (
+    PHASE_LIMIT, PHASE_RESERVATION, PHASE_WEIGHT, QosProfile,
+    ServiceTracker, profiles_from_db)
+
+
+# -- discrete-event oracle ----------------------------------------------------
+
+def expected_rates(profiles: dict[str, ClassInfo],
+                   capacity: float) -> dict[str, float]:
+    """Steady-state service rates for FULLY BACKLOGGED classes at a
+    fixed-capacity server: s_i = clamp(max(r_i, lambda * w_i), <= l_i)
+    with lambda chosen so the rates sum to capacity (water-filling).
+    Reservations beyond capacity share proportionally (earliest-R
+    round robin); if every class is limit-capped below capacity the
+    work-conserving fallback hands the surplus out proportional to the
+    limits (earliest-L service equalizes l-tag progress)."""
+    res_total = sum(p.reservation for p in profiles.values())
+    if res_total >= capacity:
+        return {n: capacity * p.reservation / res_total
+                for n, p in profiles.items()}
+
+    def rate(n, lam):
+        p = profiles[n]
+        s = max(p.reservation, lam * p.weight)
+        return min(s, p.limit) if p.limit else s
+
+    cap_total = sum(rate(n, float("1e18")) for n in profiles)
+    if cap_total <= capacity:
+        base = {n: rate(n, float("1e18")) for n in profiles}
+        lim_total = sum(p.limit for p in profiles.values())
+        extra = capacity - cap_total
+        return {n: base[n] + extra * profiles[n].limit / lim_total
+                for n in profiles}
+    lo, hi = 0.0, 1e18
+    for _ in range(200):
+        mid = (lo + hi) / 2
+        if sum(rate(n, mid) for n in profiles) > capacity:
+            hi = mid
+        else:
+            lo = mid
+    return {n: rate(n, lo) for n in profiles}
+
+
+def drive(profiles: dict[str, ClassInfo], capacity: float,
+          n_ops: int = 6000,
+          demand: dict[str, float] | None = None) -> dict[str, dict]:
+    """Serve n_ops at a fixed-capacity server under OPEN arrivals
+    (each class demands `demand[n]` ops/s, default the full capacity —
+    genuine overload, queues grow); virtual time advances 1/capacity
+    per service.  Returns per-class served counts and phases."""
+    q = MClockQueue(profiles)
+    demand = demand or {n: capacity for n in profiles}
+    next_arr = {n: 0.0 for n in profiles}
+    now = 0.0
+    out = {n: {"served": 0, "phases": {PHASE_RESERVATION: 0,
+                                       PHASE_WEIGHT: 0, PHASE_LIMIT: 0}}
+           for n in profiles}
+    for _ in range(n_ops):
+        now += 1.0 / capacity
+        for n, rate in demand.items():
+            while next_arr[n] <= now:
+                q.enqueue(n, 0, now=next_arr[n])
+                next_arr[n] += 1.0 / rate
+        got = q.dequeue(now=now)
+        assert got is not None, "work-conserving: backlog never idles"
+        name, _item, phase, _wait = got
+        out[name]["served"] += 1
+        out[name]["phases"][phase] += 1
+    return out
+
+
+def _assert_rates(profiles, capacity, n_ops=6000, tol=0.12):
+    got = drive(profiles, capacity, n_ops)
+    want = expected_rates(profiles, capacity)
+    t = n_ops / capacity
+    for n in profiles:
+        measured = got[n]["served"] / t
+        assert abs(measured - want[n]) <= tol * capacity, (
+            n, measured, want[n], {k: v["served"] for k, v in got.items()})
+    return got
+
+
+def test_reservation_floor_under_heavy_competitor():
+    profiles = {
+        "hog": ClassInfo(weight=100.0),
+        "gold": ClassInfo(reservation=100.0, weight=0.001),
+    }
+    got = _assert_rates(profiles, capacity=500.0)
+    # the floor is served in reservation phase, not weight luck
+    assert got["gold"]["phases"][PHASE_RESERVATION] \
+        > 0.8 * got["gold"]["served"]
+
+
+def test_weight_proportional_excess():
+    profiles = {
+        "a": ClassInfo(weight=8.0),
+        "b": ClassInfo(weight=2.0),
+        "c": ClassInfo(weight=1.0),
+    }
+    got = _assert_rates(profiles, capacity=400.0)
+    assert got["a"]["served"] / max(1, got["b"]["served"]) > 3.0
+    assert got["b"]["served"] / max(1, got["c"]["served"]) > 1.5
+
+
+def test_limit_caps_and_floor_coexist():
+    profiles = {
+        "hog": ClassInfo(weight=10.0),
+        "gold": ClassInfo(reservation=80.0, weight=0.001),
+        "capped": ClassInfo(weight=50.0, limit=40.0),
+    }
+    got = _assert_rates(profiles, capacity=400.0)
+    t = 6000 / 400.0
+    # the cap holds within 10% despite the large weight
+    assert got["capped"]["served"] / t <= 40.0 * 1.1
+
+
+def test_work_conserving_fallback_all_limited():
+    profiles = {
+        "x": ClassInfo(weight=1.0, limit=50.0),
+        "y": ClassInfo(weight=1.0, limit=100.0),
+    }
+    got = drive(profiles, capacity=600.0, n_ops=3000)
+    # every op served (drive asserts no idling); surplus beyond the
+    # caps flows through the fallback phase, proportional to limits
+    assert got["x"]["phases"][PHASE_LIMIT] > 0
+    assert got["y"]["phases"][PHASE_LIMIT] > 0
+    ratio = got["y"]["served"] / max(1, got["x"]["served"])
+    assert 1.5 < ratio < 2.7, ratio
+
+
+def test_reservations_beyond_capacity_share_proportionally():
+    profiles = {
+        "r1": ClassInfo(reservation=300.0, weight=0.001),
+        "r2": ClassInfo(reservation=100.0, weight=0.001),
+    }
+    _assert_rates(profiles, capacity=200.0, tol=0.15)
+
+
+def test_randomized_profiles_match_oracle():
+    rng = random.Random(1234)
+    for trial in range(6):
+        profiles = {}
+        for i in range(rng.randint(2, 5)):
+            res = rng.choice([0.0, 0.0, rng.uniform(10, 80)])
+            w = rng.uniform(0.5, 20.0)
+            lim = rng.choice([0.0, 0.0, rng.uniform(120, 300)])
+            if lim and res > lim:
+                res = lim / 2
+            profiles[f"t{i}"] = ClassInfo(reservation=res, weight=w,
+                                          limit=lim)
+        _assert_rates(profiles, capacity=500.0, n_ops=8000, tol=0.15)
+
+
+# -- distributed (delta, rho) -------------------------------------------------
+
+def test_service_tracker_params_and_accounting():
+    st = ServiceTracker()
+    assert st.get_params(0) == (1, 1)       # first contact
+    st.track_resp(PHASE_RESERVATION)
+    st.track_resp(PHASE_RESERVATION)
+    st.track_resp(PHASE_WEIGHT)
+    assert st.get_params(0) == (3, 2)       # 3 done, 2 in reservation
+    assert st.get_params(0) == (1, 0)       # nothing since the refresh
+    assert st.get_params(1) == (1, 1)       # new server: fresh contact
+    d = st.dump()
+    assert d["completions"] == 3 and d["reservation_completions"] == 2
+
+
+def test_service_tracker_prunes_idle_servers():
+    st = ServiceTracker(idle_age=0.0)
+    for s in range(64):
+        st.get_params(s, now=float(s))
+    st._prune(now=1e9)
+    assert st.server_count() == 0
+
+
+def test_cluster_wide_reservation_via_delta_rho():
+    """Two OSDs, one reserved tenant + a heavy competitor on each.
+    With ServiceTracker (delta, rho) riding the ops the tenant's
+    COMBINED reservation service stays near r; naive per-op (1, 1)
+    tags double-dip to ~2r."""
+    def run(tracked: bool) -> float:
+        capacity = 400.0          # per OSD
+        r = 100.0
+        queues = [MClockQueue({
+            "hog": ClassInfo(weight=1000.0),
+            "gold": ClassInfo(reservation=r, weight=0.001)})
+            for _ in range(2)]
+        tracker = ServiceTracker()
+        now = 0.0
+        for q in queues:
+            for _ in range(4):
+                q.enqueue("hog", 0, now=now)
+                d, rho = tracker.get_params(id(q)) if tracked else (1, 1)
+                q.enqueue("gold", 0, now=now, delta=d, rho=rho)
+        served_gold = 0
+        n_steps = 4000
+        for _ in range(n_steps):
+            now += 1.0 / capacity
+            for q in queues:
+                got = q.dequeue(now=now)
+                if got is None:
+                    continue
+                name, _i, phase, _w = got
+                if name == "gold":
+                    served_gold += 1
+                    tracker.track_resp(phase)
+                    d, rho = (tracker.get_params(id(q)) if tracked
+                              else (1, 1))
+                    q.enqueue("gold", 0, now=now, delta=d, rho=rho)
+                else:
+                    q.enqueue("hog", 0, now=now)
+        return served_gold / (n_steps / capacity)
+
+    naive = run(tracked=False)
+    tracked = run(tracked=True)
+    assert naive > 170.0, naive        # ~2r double dip
+    assert tracked < 140.0, tracked    # ~r cluster-wide floor
+    assert tracked > 70.0, tracked     # ... but the floor still holds
+
+
+def test_client_trackers_are_per_tenant():
+    """One gateway RadosClient serves many tenants: each tenant lane
+    gets its OWN ServiceTracker, so a hog's completions can never
+    inflate an idle tenant's (delta, rho) and charge it for service
+    it did not receive."""
+    from ceph_tpu.client.rados import RadosClient
+    c = RadosClient.__new__(RadosClient)
+    import threading
+    from collections import OrderedDict
+    c._lock = threading.RLock()
+    c._qos_trackers = OrderedDict()
+    hog = c._tracker_for("hog")
+    gold = c._tracker_for("gold")
+    assert hog is not gold
+    assert c._tracker_for("hog") is hog
+    gold.get_params(0)
+    for _ in range(500):
+        hog.track_resp(PHASE_WEIGHT)
+    # gold's view of osd.0 is untouched by the hog's completions
+    assert c._tracker_for("gold").get_params(0) == (1, 0)
+    # LRU bound: one-shot tenants age out
+    c.QOS_TRACKER_CAP = 8
+    for i in range(32):
+        c._tracker_for(f"one-{i}")
+    assert len(c._qos_trackers) == 8
+
+
+# -- profiles -----------------------------------------------------------------
+
+def test_qos_profile_validation_and_db_roundtrip():
+    import pytest
+    p = QosProfile(reservation=10, weight=5, limit=50)
+    p.validate()
+    assert QosProfile.from_dict(p.to_dict()) == p
+    with pytest.raises(ValueError):
+        QosProfile(weight=0).validate()
+    with pytest.raises(ValueError):
+        QosProfile(reservation=100, weight=1, limit=50).validate()
+    db = {"gold": p.to_dict(), "broken": "not-a-dict"}
+    profs = profiles_from_db(db)
+    assert set(profs) == {"gold"} and profs["gold"].reservation == 10
+
+
+# -- wire: MOSDOp v4 / MOSDOpReply v2 ----------------------------------------
+
+def _roundtrip(msg, cls, my_version=None):
+    enc = Encoder()
+    msg.encode_payload(enc)
+    out = cls.__new__(cls)
+    out.decode_payload(Decoder(enc.tobytes()), 0)
+    return out
+
+
+def test_mosdop_qos_roundtrip():
+    m = MOSDOp(client_id=7, tid=9, pgid=(1, 3), oid="o",
+               ops=[OSDOpField(op=2, offset=0, length=3, data=b"abc")],
+               epoch=5, qos_tenant="gold", qos_delta=4, qos_rho=2)
+    got = _roundtrip(m, MOSDOp)
+    assert (got.qos_tenant, got.qos_delta, got.qos_rho) == ("gold", 4, 2)
+    assert got.oid == "o" and got.tid == 9
+
+    r = MOSDOpReply(tid=9, result=0, epoch=5,
+                    qos_phase=PHASE_RESERVATION)
+    got = _roundtrip(r, MOSDOpReply)
+    assert got.qos_phase == PHASE_RESERVATION
+
+
+def test_mosdop_old_peer_decodes_v4_payload():
+    """A seed-era (v3) decoder reads a v4 payload: the versioned
+    section's length prefix skips the QoS tail, every v3 field
+    lands intact."""
+    m = MOSDOp(client_id=7, tid=9, pgid=(1, 3), oid="obj",
+               ops=[OSDOpField(op=1)], epoch=5, snapid=2,
+               write_snapc=4, qos_tenant="gold", qos_delta=9,
+               qos_rho=9)
+    enc = Encoder()
+    m.encode_payload(enc)
+    seen = {}
+
+    def v3_body(d, v):
+        assert v == 4                      # the writer's version
+        seen["client_id"] = d.u64()
+        seen["tid"] = d.u64()
+        seen["pgid"] = (d.s64(), d.u32())
+        seen["oid"] = d.str()
+        seen["epoch"] = d.u32()
+        seen["ops"] = d.list(OSDOpField.decode)
+        seen["snapid"] = d.u64()
+        seen["write_snapc"] = d.u64()
+        # ... and STOPS: the qos tail is skipped by the section length
+    Decoder(enc.tobytes()).versioned(3, v3_body)
+    assert seen["oid"] == "obj" and seen["write_snapc"] == 4
+
+    # reply side: v1 decoder over a v2 payload
+    r = MOSDOpReply(tid=9, result=-5, epoch=5, qos_phase=PHASE_WEIGHT)
+    enc = Encoder()
+    r.encode_payload(enc)
+    got = {}
+
+    def v1_body(d, v):
+        got["tid"] = d.u64()
+        got["result"] = d.s32()
+        got["epoch"] = d.u32()
+        got["ops"] = d.list(OSDOpField.decode)
+    Decoder(enc.tobytes()).versioned(1, v1_body)
+    assert got["tid"] == 9 and got["result"] == -5
+
+
+def test_mosdop_new_peer_decodes_v3_payload():
+    """An old-peer (v3) MOSDOp decodes on this build with neutral QoS
+    defaults: empty tenant, delta = rho = 1 (exact mClock)."""
+    enc = Encoder()
+    enc.versioned(3, 1, lambda e: (
+        e.u64(7), e.u64(9), e.s64(1), e.u32(3), e.str("obj"), e.u32(5),
+        e.list([OSDOpField(op=1)], lambda e2, op: op.encode(e2)),
+        e.u64(0), e.u64(0)))
+    m = MOSDOp.__new__(MOSDOp)
+    m.decode_payload(Decoder(enc.tobytes()), 0)
+    assert m.oid == "obj"
+    assert (m.qos_tenant, m.qos_delta, m.qos_rho) == ("", 1, 1)
+
+    enc = Encoder()
+    enc.versioned(1, 1, lambda e: (
+        e.u64(9), e.s32(0), e.u32(5),
+        e.list([], lambda e2, op: op.encode(e2))))
+    r = MOSDOpReply.__new__(MOSDOpReply)
+    r.decode_payload(Decoder(enc.tobytes()), 0)
+    assert r.qos_phase == 0
+
+
+def test_feature_bit_registered():
+    from ceph_tpu.msg.features import (
+        FEATURE_QOS_TAGS, SUPPORTED_FEATURES, feature_names)
+    assert SUPPORTED_FEATURES & FEATURE_QOS_TAGS
+    assert "qos-tags" in feature_names(FEATURE_QOS_TAGS)
