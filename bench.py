@@ -297,7 +297,7 @@ def recovery_sweep(k: int, m: int, chunk: int, levels=(1, 4, 16),
                               extra_row=extra_row)
 
 
-def map_churn(pools: int = 6, pg_num: int = 256, hosts: int = 16,
+def map_churn(pools: int = 6, pg_num: int = 1024, hosts: int = 16,
               per_host: int = 4, epochs: int = 10) -> dict:
     """Map-epoch consumption sweep: a reweight/mark-down/override storm
     over many pools, comparing the seed's scalar full scan (every PG
@@ -306,6 +306,16 @@ def map_churn(pools: int = 6, pg_num: int = 256, hosts: int = 16,
     O(changed) reads).  Every epoch's shared-cache reads are verified
     bit-identical to the scalar oracle across ALL PGs — the timing rows
     only count the work each consumption strategy actually does.
+
+    Fused column: the primary ``shared_epoch_s`` row now runs the
+    FUSED device ladder (PR 10 — packed up/acting tables, fused-output
+    epoch diff, row-slice reads); an extra replay with
+    ``osdmap_mapping_fused`` off reports the PR 5 host-tail cost as
+    ``shared_epoch_s_unfused`` and the ``fused_speedup`` ratio — the
+    ISSUE 10 acceptance number.  The default scale moved 1536 -> 6144
+    PGs with this PR (ROADMAP item 3 direction): at toy scale the
+    per-candidate host tail was already cheap; the fused ladder's win
+    is that epoch cost stays flat while changed-PG counts grow.
 
     Mesh column: a THIRD consumption strategy rides a context-backed
     service whose pool remaps submit through the (mesh-sharded when the
@@ -344,22 +354,30 @@ def map_churn(pools: int = 6, pg_num: int = 256, hosts: int = 16,
         kind = i % 5
         osd = int(rng.integers(0, n))
         if kind == 0:      # reweight storm step (pools recompute)
-            new.osd_weight[osd] = int(rng.choice(
-                (0x4000, 0x8000, 0xC000, 0x10000)))
-        elif kind == 1:    # mark down (state-only: tables reuse)
-            new.osd_state[osd] = new.osd_state[osd] & ~2
-        elif kind == 2:    # mark back up
-            new.osd_state[osd] = new.osd_state[osd] | 3
-        elif kind == 3:    # pg_temp inject/clear (override-only)
-            pgid = (1 + int(rng.integers(0, pools)),
-                    int(rng.integers(0, pg_num)))
-            if pgid in new.pg_temp:
-                del new.pg_temp[pgid]
-            else:
-                new.pg_temp[pgid] = [osd, (osd + 1) % n]
+            for o in rng.integers(0, n, 4):
+                new.osd_weight[int(o)] = int(rng.choice(
+                    (0x4000, 0x8000, 0xC000, 0x10000)))
+        elif kind == 1:    # host failure: a whole failure domain goes
+            host = int(rng.integers(0, hosts))   # down (state-only:
+            for o in range(host * per_host,      # tables reuse, many
+                           (host + 1) * per_host):   # PGs remap)
+                new.osd_state[o] = new.osd_state[o] & ~2
+        elif kind == 2:    # a host comes back
+            host = int(rng.integers(0, hosts))
+            for o in range(host * per_host, (host + 1) * per_host):
+                new.osd_state[o] = new.osd_state[o] | 3
+        elif kind == 3:    # pg_temp inject/clear burst (override-only)
+            for _ in range(4):
+                pgid = (1 + int(rng.integers(0, pools)),
+                        int(rng.integers(0, pg_num)))
+                if pgid in new.pg_temp:
+                    del new.pg_temp[pgid]
+                else:
+                    new.pg_temp[pgid] = [osd, (osd + 1) % n]
         else:              # mark out / back in (weight edge)
-            new.osd_weight[osd] = (0x10000 if new.osd_weight[osd] == 0
-                                   else 0)
+            for o in rng.integers(0, n, 2):
+                new.osd_weight[int(o)] = (
+                    0x10000 if new.osd_weight[int(o)] == 0 else 0)
         # shared-cache consumption: epoch update + reading every
         # changed PG (what _scan_pgs does beyond its local PGs)
         t0 = time.perf_counter()
@@ -394,6 +412,23 @@ def map_churn(pools: int = 6, pg_num: int = 256, hosts: int = 16,
     digest.pop("lookups", None)
     digest.pop("lookup_fallbacks", None)
     digest["timed_reads"] = int(sum(changed_counts))
+    # unfused replay of the SAME epoch sequence (the PR 5 host-tail
+    # consumption path): same cache machinery, per-candidate
+    # _finish_from delta + host-tail lookups — the A/B for the fused
+    # ladder the primary rows above ran.  Timing only: the fused run
+    # already bit-verified every epoch against the oracle.
+    svc_uf = SharedPGMappingService(fused=False)
+    svc_uf.update_to(base)
+    t_unfused: list[float] = []
+    for frm, new, _oracle in epoch_log:
+        t0 = time.perf_counter()
+        upd_u = svc_uf.update_to(new, from_epoch=frm)
+        reads_u = (upd_u.changed if not upd_u.full
+                   else [(pid, pg) for pid, pool in new.pools.items()
+                         for pg in range(pool.pg_num)])
+        for pid, pg in reads_u:
+            svc_uf.lookup(new, pid, pg)
+        t_unfused.append(time.perf_counter() - t0)
     # mesh/engine-backed replay of the SAME epoch sequence.  The
     # min-pgs floor would route this workload's pool sizes to the
     # scalar rebuild path (engine never touched — the column would
@@ -433,15 +468,18 @@ def map_churn(pools: int = 6, pg_num: int = 256, hosts: int = 16,
     med = (lambda xs: sorted(xs)[len(xs) // 2])
     sh, sc = med(t_shared), med(t_scalar)
     shm = med(t_mesh)
+    shu = med(t_unfused)
     return {
         "pgs": pools * pg_num,
         "osds": n,
         "epochs": epochs,
         "scalar_epoch_s": round(sc, 4),
         "shared_epoch_s": round(sh, 4),
+        "shared_epoch_s_unfused": round(shu, 4),
         "shared_epoch_s_mesh": round(shm, 4),
         "mesh_devices": mesh_devices,
         "speedup": round(sc / sh, 1) if sh > 0 else 0.0,
+        "fused_speedup": round(shu / sh, 2) if sh > 0 else 0.0,
         "speedup_mesh": round(sc / shm, 1) if shm > 0 else 0.0,
         "scalar_epochs_per_s": round(1.0 / sc, 2) if sc > 0 else 0.0,
         "shared_epochs_per_s": round(1.0 / sh, 2) if sh > 0 else 0.0,
@@ -532,6 +570,92 @@ def profile_section(k: int = 8, m: int = 4, chunk: int = 1024,
     for e in (eng, deng):
         e.stop()
     return telemetry.pipeline_profile_digest()
+
+
+def placement_digest(crush_map, rid: int, bm, reweight: np.ndarray,
+                     t_crush: float, n_pgs: int, numrep: int = 3,
+                     sample: int = 2048) -> dict:
+    """Fused-pipeline placement digest for the crush section: the full
+    raw→up→acting ladder (ops.placement_kernel) over all ``n_pgs`` PGs
+    of a 10k-OSD map in one device call — affinity skew, temps and
+    upmap pairs injected so every ladder stage does real work — vs the
+    per-PG host pipeline tail it replaces.  ``pipeline_mpps`` composes
+    the measured raw rate (``t_crush`` per batch) with the ladder;
+    a ``sample`` of rows is bit-verified against the host tail."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops import placement_kernel as pk
+    from ceph_tpu.osd import OSDMap, PGPool
+    from ceph_tpu.osd.mapping import _finish_from, pps_batch
+
+    n_osds = len(reweight)
+    m = OSDMap(crush=crush_map, epoch=2)
+    m.set_max_osd(n_osds)
+    for o in range(n_osds):
+        m.osd_state[o] = 3                      # exists | up
+        m.osd_weight[o] = int(reweight[o])
+    orng = np.random.default_rng(9)
+    for o in orng.integers(0, n_osds, 500):     # 5%-ish affinity skew
+        m.osd_primary_affinity[int(o)] = 0x8000
+    pool = PGPool(pool_id=1, size=numrep, crush_rule=rid, pg_num=n_pgs)
+    m.pools[1] = pool
+    for pg in orng.integers(0, n_pgs, 512):
+        m.pg_temp[(1, int(pg))] = [int(x) for x in
+                                   orng.integers(0, n_osds, numrep)]
+    for pg in orng.integers(0, n_pgs, 512):
+        frm = int(orng.integers(0, n_osds))
+        m.pg_upmap_items[(1, int(pg))] = [(frm, (frm + 7) % n_osds)]
+    for pg in orng.integers(0, n_pgs, 256):
+        m.primary_temp[(1, int(pg))] = int(orng.integers(0, n_osds))
+
+    pgids = np.arange(n_pgs, dtype=np.uint32)
+    pps = np.asarray(pps_batch(pool, pgids))
+    raw = np.asarray(bm.do_rule(rid, jnp.asarray(pps), numrep,
+                                jnp.asarray(reweight)), dtype=np.int32)
+    width, pairs = pk.pool_widths(m)
+    ops_ = pk.build_operands(m, 1, pool, raw, pps, width=width,
+                             pairs=pairs)
+
+    def make_step():
+        from ceph_tpu.ops.placement_kernel import _ladder_jit
+        fn = _ladder_jit(ops_.erasure)
+        aux = tuple(jnp.asarray(a) for a in ops_.aux())
+        vecs = (jnp.asarray(ops_.state), jnp.asarray(ops_.weight),
+                jnp.asarray(ops_.affinity))
+
+        def step(r):
+            packed = fn(r, *aux, *vecs)
+            return r.at[0, 0].set(packed[0, 0] ^ r[0, 0])
+        return step
+
+    # lean counts: the ladder is one fused call per step and the crush
+    # section is already the longest on slow hosts
+    t_ladder, _lo, _hi = median_band(chained_rates(
+        make_step(), jnp.asarray(raw), n_lo=2, n_hi=12, reps=3,
+        inner=3))
+
+    # host-tail baseline on a sample (the per-PG _finish_from the
+    # ladder replaces), and the bit-exactness gate on the same rows
+    packed = pk.run_ladder(ops_)
+    raw_tab, pps_tab = {1: raw}, {1: pps}
+    idx = orng.integers(0, n_pgs, sample)
+    t0 = time.perf_counter()
+    wants = [_finish_from(m, pool, 1, int(pg), raw_tab, pps_tab)
+             for pg in idx]
+    t_tail = (time.perf_counter() - t0) / sample
+    verified = all(
+        pk.unpack_row(packed[int(pg)], width) == want
+        for pg, want in zip(idx, wants))
+    ladder_mpps = n_pgs / t_ladder / 1e6
+    return {
+        "pgs": n_pgs,
+        "osds": n_osds,
+        "ladder_mpps": round(ladder_mpps, 3),
+        "pipeline_mpps": round(n_pgs / (t_crush + t_ladder) / 1e6, 3),
+        "host_tail_mpps": round(1.0 / t_tail / 1e6, 4),
+        "ladder_vs_host_tail": round(ladder_mpps * 1e6 * t_tail, 1),
+        "verified": verified,
+    }
 
 
 SECTIONS = ("ec", "crush", "dispatch_sweep", "recovery_sweep",
@@ -814,6 +938,11 @@ def main(argv=None) -> None:
             "c_crush_mpps": round(c_crush_mpps, 3),
             "crush_vs_c": round(crush_mpps / c_crush_mpps, 2),
         })
+        # fused raw→up→acting ladder over the same map: the
+        # device-resident pipeline-tail story (ISSUE 10), bit-verified
+        # against the host tail on a sample
+        out["placement"] = placement_digest(
+            crush_map, rid, bm, reweight, t_crush, n_pgs)
 
     from ceph_tpu.ops import telemetry
     if "ec" in secs and "crush" in secs:

@@ -130,6 +130,25 @@ def calc_pg_upmaps(osdmap: OSDMap, pool_ids: list[int] | None = None,
             up, _ = m._raw_to_up_osds(pool, raw)
             return up
 
+        # ps -> up under the CURRENT planned pairs (the map itself
+        # never changes inside this optimization), batch-filled
+        # through the fused ladder and invalidated per moved PG — so
+        # the whole over-full OSD's candidate set costs ONE device
+        # call up front and each later iteration re-evaluates only
+        # what a move actually changed (host up_of stays the fallback
+        # and the oracle: bit-identical by the ladder contract)
+        ups_cache: dict[int, list[int]] = {}
+
+        def fill_ups(cand_list):
+            missing = [ps for ps, _pos in cand_list
+                       if ps not in ups_cache]
+            if svc is None or not missing:
+                return
+            got = svc.what_if_up(
+                m, pool_id, [(ps, planned[ps]) for ps in missing])
+            if got is not None:
+                ups_cache.update(zip(missing, got))
+
         while budget > 0:
             over = max(cands, key=lambda o: counts[o])
             under = min(cands, key=lambda o: counts[o])
@@ -141,8 +160,12 @@ def calc_pg_upmaps(osdmap: OSDMap, pool_ids: list[int] | None = None,
                     and mean - counts[under] <= max_deviation:
                 break
             moved = False
-            for ps, _pos in sorted(hist.get(over, [])):
-                up = up_of(ps)
+            over_cands = sorted(hist.get(over, []))
+            fill_ups(over_cands)
+            for ps, _pos in over_cands:
+                up = ups_cache.get(ps)
+                if up is None:
+                    up = up_of(ps)
                 if over not in up:
                     continue
                 # prefer the most-underfull legal destination
@@ -160,6 +183,7 @@ def calc_pg_upmaps(osdmap: OSDMap, pool_ids: list[int] | None = None,
                     pairs.append((src if src is not None else over, to))
                     pairs = [p for p in pairs if p[0] != p[1]]
                     planned[ps] = pairs
+                    ups_cache.pop(ps, None)   # pairs moved: re-score
                     changes[(pool_id, ps)] = pairs
                     counts[over] -= 1
                     counts[to] += 1

@@ -76,6 +76,15 @@ register_options([
            "call (per-call dispatch + jit-compile overhead dominates "
            "tiny pools); the epoch cache, incremental invalidation "
            "and delta detection are identical either way"),
+    Option("osdmap_mapping_fused", OPT_BOOL, True,
+           "fuse the post-CRUSH placement pipeline tail (upmap -> "
+           "up/state filter -> primary affinity -> pg_temp/"
+           "primary_temp) into one device ladder per epoch "
+           "(ops.placement_kernel): the mapping service publishes "
+           "packed (up, acting, primaries) tables next to the raw "
+           "ones, reads become row slices, and epoch deltas diff the "
+           "fused outputs on device; off (or crush_backend=scalar) = "
+           "the per-PG host pipeline tail of PR 5"),
     Option("osdmap_mapping_shared", OPT_BOOL, True,
            "serve PG->OSD mappings from the context's shared "
            "epoch-keyed mapping cache (osd.mapping."

@@ -258,6 +258,11 @@ class FastMapper:
             self.leaf_off = jnp.asarray(lo)
         # the fused Pallas column kernels (2.5x the XLA path on this
         # backend); TPU-only — the CPU mesh tests keep the XLA path.
+        # Mesh-sharded batches reach these kernels through the
+        # shard_map wrapper in BatchMapper._fast_sharded_fn (a
+        # pallas_call is an opaque custom call GSPMD cannot split, so
+        # the batch splits BEFORE the kernel; run() itself is
+        # row-independent along x by the oracle-equivalence contract).
         # The gate honors jax.default_device(<tpu>) too: a multi-
         # platform process (cpu default + tpu reachable) running under
         # that context IS on the tpu even though default_backend()

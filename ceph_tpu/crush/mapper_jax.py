@@ -457,6 +457,29 @@ class BatchMapper:
         the telemetry retrace counter differences this per call."""
         return sum(f._cache_size() for f in self._jit_cache.values())
 
+    def _fast_sharded_fn(self, fast, ruleno: int, result_max: int, xs):
+        """The shard_map-wrapped fast path for a mesh-sharded batch:
+        the Pallas column kernels are opaque custom calls GSPMD cannot
+        split, so each device runs the full fused ladder on its local
+        rows (row-independent by the oracle-equivalence contract) with
+        the reweight vector replicated — PR 7's XLA-only routing guard
+        for sharded fastpath batches, lifted.  Returns the jit-cache
+        KEY, or None when the batch is not row-sharded (or
+        single-device)."""
+        from ceph_tpu.ops.gf_kernel import _multi_device, _row_sharding
+        if not _multi_device(xs):
+            return None
+        sh = _row_sharding(xs)
+        if sh is None:
+            return None
+        key = ("fast_sh", ruleno, result_max, sh)
+        if key not in self._jit_cache:
+            from ceph_tpu.ops.gf_kernel import build_sharded_rows_fn
+            self._jit_cache[key] = build_sharded_rows_fn(
+                functools.partial(fast.run, result_max=result_max),
+                sh, n_replicated=1)
+        return key
+
     def do_rule(self, ruleno: int, xs, result_max: int, reweight) -> jax.Array:
         xs = jnp.asarray(xs, dtype=jnp.uint32)
         reweight = jnp.asarray(reweight, dtype=jnp.int64)
@@ -466,10 +489,15 @@ class BatchMapper:
             return jnp.full((xs.shape[0], result_max), NONE, dtype=jnp.int32)
         fast = self._fastpath(ruleno)
         if fast is not None:
-            key = ("fast", ruleno, result_max)
-            if key not in self._jit_cache:
-                self._jit_cache[key] = jax.jit(
-                    functools.partial(fast.run, result_max=result_max))
+            key = None
+            if fast._pallas is not None:
+                key = self._fast_sharded_fn(fast, ruleno, result_max, xs)
+            if key is None:
+                key = ("fast", ruleno, result_max)
+                if key not in self._jit_cache:
+                    self._jit_cache[key] = jax.jit(
+                        functools.partial(fast.run,
+                                          result_max=result_max))
         else:
             key = (ruleno, result_max)
             if key not in self._jit_cache:

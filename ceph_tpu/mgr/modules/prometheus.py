@@ -401,6 +401,23 @@ class Module(MgrModule):
         exp.gauge(f"{p}_cached_pools",
                   "pools resident in the cached raw tables",
                   d["cached_pools"])
+        exp.counter(f"{p}_fused_epochs_total",
+                    "computed epochs that published complete fused "
+                    "(device-resident) up/acting tables",
+                    d.get("fused_epochs", 0))
+        exp.counter(f"{p}_unfused_epochs_total",
+                    "computed epochs served by the host pipeline "
+                    "tail (fused ladder off or unavailable)",
+                    d.get("unfused_epochs", 0))
+        exp.counter(f"{p}_fused_lookups_total",
+                    "mapping reads answered by a packed fused-row "
+                    "slice (subset of the cache lookups)",
+                    d.get("fused_lookups", 0))
+        exp.gauge(f"{p}_host_tail_share",
+                  "host-tail share of the total mapping epoch cost "
+                  "(device + delta + host_tail) — collapses toward 0 "
+                  "when the fused placement ladder serves the tail",
+                  d.get("host_tail_share", 0.0))
         for phase, h in sorted(d["phase_seconds"].items()):
             exp.histogram(
                 f"{p}_phase_seconds",

@@ -879,3 +879,48 @@ def submit_do_rule(engine: DeviceDispatchEngine, mapper, ruleno: int,
 
     return engine.submit(key, fn, np.asarray(xs, dtype=np.uint32),
                          label="crush_rule")
+
+
+def submit_finish_ladder(engine: DeviceDispatchEngine, operands, *,
+                         key=None) -> DispatchFuture:
+    """Submit one pool's fused placement-pipeline tail (raw -> up ->
+    acting; ops.placement_kernel) through the engine.  ``operands`` is
+    a placement_kernel.LadderOperands: the raw table is the data
+    channel, the per-PG override/pps tables ride aux in lockstep, and
+    the per-OSD state/weight/affinity vectors are captured operands —
+    mesh-replicated on sharded batches exactly like the CRUSH reweight
+    vector.  Pools (and daemons) sharing one epoch's operand digest
+    and table widths coalesce on the PG axis into ONE device call.
+
+    ``key`` defaults to a digest of the captured vectors plus the
+    static table shape; pass an explicit (epoch, widths)-style key when
+    the caller already knows the map identity."""
+    state, weight, affinity = (operands.state, operands.weight,
+                               operands.affinity)
+    if key is None:
+        key = ("pg_finish", operands.erasure, operands.width,
+               operands.items.shape[1], hash(state.tobytes()),
+               hash(weight.tobytes()), hash(affinity.tobytes()))
+
+    def fn(batch, *aux, key=key):
+        from ceph_tpu.ops.placement_kernel import _ladder_jit
+        st, w, af = state, weight, affinity
+        mesh = getattr(getattr(batch, "sharding", None), "mesh", None)
+        if mesh is not None and getattr(mesh, "size", 1) > 1:
+            # host-side placement scaffolding (see submit_flat_firstn):
+            # replicate the per-OSD vectors over the batch's mesh so
+            # the jitted ladder compiles with consistent shardings
+            # (sharded PG tables, replicated osd vectors); cached per
+            # (mesh, key) — the key digests the vector content
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+            st, w, af = _replicate_cached(
+                mesh, key,
+                lambda: jax.device_put(
+                    (st, w, af), NamedSharding(mesh, PartitionSpec())))
+        return _ladder_jit(operands.erasure)(batch, *aux, st, w, af)
+
+    from ceph_tpu.ops.placement_kernel import ladder_cache_entries
+    return engine.submit(key, fn, operands.raw, aux=operands.aux(),
+                         label="pg_finish",
+                         cache_entries=ladder_cache_entries)

@@ -346,24 +346,103 @@ def _multi_device(x) -> bool:
         return False
 
 
+def _row_sharding(x):
+    """x's NamedSharding when it splits ONLY the leading (stripe)
+    axis — the dispatch engine's placement contract — else None."""
+    try:
+        sh = x.sharding
+        spec = sh.spec
+    except Exception:
+        return None
+    if getattr(sh, "mesh", None) is None or len(spec) == 0:
+        return None
+    if spec[0] is None or any(s is not None for s in spec[1:]):
+        return None
+    return sh
+
+
+def build_sharded_rows_fn(fn, sh, n_replicated: int = 0):
+    """jit(shard_map(fn)) over a committed row sharding ``sh`` — the
+    ONE construction site for the wrappers that let an opaque
+    ``pallas_call`` (a custom call GSPMD cannot split) ride a
+    mesh-sharded engine batch: the batch splits BEFORE the kernel, one
+    program per device, output re-assembled under the same sharding.
+    ``fn(data_shard, *replicated)`` must be row-independent along the
+    leading axis (every kernel in this repo's dispatch channels is —
+    the crush_kernel mesh contract); the ``n_replicated`` trailing
+    operands broadcast whole to every shard.  Callers cache the
+    returned callable per (sharding, static-args) — a fresh wrapper
+    per flush would re-trace on the hot dispatch path."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec
+
+    rep_specs = tuple(PartitionSpec() for _ in range(n_replicated))
+    # check_rep=False: pallas_call has no shard_map replication rule
+    # (jax raises NotImplementedError otherwise); replication here is
+    # by construction — every replicated operand is broadcast whole
+    return jax.jit(shard_map(
+        fn, mesh=sh.mesh, in_specs=(sh.spec,) + rep_specs,
+        out_specs=sh.spec, check_rep=False))
+
+
+def shard_map_rows(fn, data, *replicated):
+    """One-shot convenience over build_sharded_rows_fn: run
+    ``fn(data_shard, *replicated)`` over ``data``'s committed row
+    sharding.  Uncached — use build_sharded_rows_fn (and cache the
+    result) on hot paths."""
+    return build_sharded_rows_fn(
+        fn, data.sharding, len(replicated))(data, *replicated)
+
+
+def _pallas_rows(w_blk, data, *, k, m, bc):
+    """The fused Pallas encode over one (local) row block, padding the
+    stripe axis to the grid quantum."""
+    s = data.shape[0]
+    pad = (-s) % _SB
+    if pad:
+        data = jnp.concatenate(
+            [data, jnp.zeros((pad, k, data.shape[2]), dtype=data.dtype)])
+    out = _encode_pallas(w_blk, data, k=k, m=m, bc=bc)
+    return out[:s] if pad else out
+
+
+def _pallas_rows_shard(d, w, *, k, m, bc):
+    """_pallas_rows with shard_map's (data, replicated...) arg order."""
+    return _pallas_rows(w, d, k=k, m=m, bc=bc)
+
+
+@functools.lru_cache(maxsize=32)
+def _pallas_sharded_fn(sh, k: int, m: int, bc: int):
+    """Cached sharded Pallas encode per (sharding, k, m, bc) —
+    NamedShardings are hashable, so the cache key is exact."""
+    return build_sharded_rows_fn(
+        functools.partial(_pallas_rows_shard, k=k, m=m, bc=bc), sh,
+        n_replicated=1)
+
+
 def _encode_dispatch_impl(w_bits, w_blk, data, *, k, m, dot_dtype):
     s, _, b = data.shape
     bc = _pick_bc(b)
     # batches below one grid step would pad up to _SB-1 all-zero
-    # stripes through the Pallas path; the XLA path wastes nothing.
-    # Mesh-sharded batches take the XLA path too: GSPMD partitions it
-    # along the sharded stripe axis for free, while a pallas_call is an
-    # opaque custom call XLA cannot split (a shard_map wrapper around
-    # the fused kernel is the follow-up that lifts this)
+    # stripes through the Pallas path; the XLA path wastes nothing
     if (w_blk is not None and bc is not None and s >= _SB
-            and jax.default_backend() == "tpu"
-            and not _multi_device(data)):
-        pad = (-s) % _SB
-        if pad:
-            data = jnp.concatenate(
-                [data, jnp.zeros((pad, k, b), dtype=data.dtype)])
-        out = _encode_pallas(w_blk, data, k=k, m=m, bc=bc)
-        return out[:s] if pad else out
+            and jax.default_backend() == "tpu"):
+        if not _multi_device(data):
+            return _pallas_rows(w_blk, data, k=k, m=m, bc=bc)
+        # mesh-sharded batch: pallas_call is an opaque custom call
+        # GSPMD cannot split, so wrap it in shard_map — the stripe
+        # axis splits BEFORE the kernel and each device runs its own
+        # fused program (PR 7's XLA-only routing guard, lifted).
+        # Tables committed to a different mesh than the batch (knob
+        # hot-reload race) fall back to the XLA path, which jit
+        # re-places freely.
+        sh = _row_sharding(data)
+        blk_mesh = getattr(getattr(w_blk, "sharding", None), "mesh",
+                           None)
+        if (sh is not None
+                and s // len(data.sharding.device_set) >= _SB
+                and (blk_mesh is None or blk_mesh == sh.mesh)):
+            return _pallas_sharded_fn(sh, k, m, bc)(data, w_blk)
     return _encode_xla(w_bits, data, k=k, m=m, dot_dtype=dot_dtype)
 
 

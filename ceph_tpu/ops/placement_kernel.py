@@ -1,0 +1,364 @@
+"""Fused device-resident placement pipeline tail (raw -> up -> acting).
+
+The batched mapper (crush.mapper_jax) computes raw CRUSH placements for
+a whole pool in one device call, but the seed finished every PG
+host-side: ``OSDMap._finish_pg_mapping`` (upmap -> up/state filter ->
+primary affinity -> pg_temp/primary_temp) ran per PG per epoch, and the
+PR 8 phase profiler attributed the mapping service's epoch cost to
+exactly that ``host_tail``.  This module fuses the whole tail into ONE
+jitted ladder over the PG axis:
+
+    raw table (N, W) + pps seeds + dense epoch operands
+        -> (up, up_primary, acting, acting_primary) for ALL N PGs
+
+Semantics are the scalar oracle's, bit for bit (OSDMap.cc:2228-2445
+via osd.osdmap._finish_pg_mapping):
+
+  * ``pg_upmap`` rows replace the raw row wholesale when every entry
+    exists and is not out; otherwise ``pg_upmap_items`` pairs apply
+    SEQUENTIALLY (each pair sees the previous pair's rewrite, first
+    occurrence of ``frm`` rewritten, ``to`` must be absent/exists/in);
+  * up filtering keeps positions with NONE holes for erasure pools and
+    stable-compacts for replicated ones;
+  * primary affinity replays the hash coin-flip ladder with the pps
+    seed (first winning position; default-affinity osds always win);
+  * pg_temp replaces acting when present and non-empty; primary_temp
+    overrides acting_primary, else the first non-NOSD member — unless
+    acting equals up, which inherits up_primary.
+
+Dense operand layout (built by OSDMap.dense_osd_vectors /
+dense_pool_overrides): every per-PG table is NONE/NOSD padded to a
+shared width ``W`` and pairs to ``P``, so pools (and daemons) sharing
+one epoch's operand digest coalesce into one device call through
+``ops.dispatch.submit_finish_ladder``; the per-OSD state/weight/
+affinity vectors are captured operands, mesh-replicated on sharded
+batches exactly like the CRUSH reweight vector.  Every step is
+row-independent along the PG axis, so a mesh-sharded engine splits the
+batch across devices with bit-identical results (the crush_kernel mesh
+contract).
+
+Output packing: one (N, 2*W + 4) int32 array per call —
+``[up (W) | acting (W) | up_len | up_primary | acting_len |
+acting_primary]`` — rows unpack to the oracle tuple with
+``unpack_row``; padded cells are a deterministic NOSD fill, so two
+packed rows are equal IFF their oracle tuples are, which is what lets
+the mapping service diff whole epochs on device.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+
+NONE = CRUSH_ITEM_NONE          # 0x7FFFFFFF — raw-table hole
+NOSD = -1                       # CEPH_NOSD — up/acting hole
+_MAX_AFFINITY = 0x10000
+_OSD_EXISTS = 1
+_OSD_UP = 2
+
+
+# ---------------------------------------------------------------------------
+# the jitted ladder
+# ---------------------------------------------------------------------------
+
+def _ladder_impl(raw, pps, raw_len, up_rows, up_len, items, temp_rows,
+                 temp_len, ptemp, state, weight, affinity, *,
+                 erasure: bool):
+    """See the module docstring.  All tables int32 except pps (uint32)
+    and weight (int64); shapes: raw/up_rows/temp_rows (N, W), items
+    (N, P, 2), the rest (N,) or (M,)."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops.crush_kernel import hash32_2
+
+    n, w = raw.shape
+    m_osd = state.shape[0]
+    iota = jnp.arange(w, dtype=jnp.int32)[None, :]
+
+    def in_range(o):
+        return (o >= 0) & (o < m_osd)
+
+    def gather(vec, o):
+        return vec[jnp.clip(o, 0, m_osd - 1)]
+
+    def exists(o):
+        return in_range(o) & ((gather(state, o) & _OSD_EXISTS) != 0)
+
+    def is_up(o):
+        return in_range(o) & ((gather(state, o) & _OSD_UP) != 0)
+
+    def not_out(o):
+        return in_range(o) & (gather(weight, o) != 0)
+
+    # -- base row: the raw list _finish_from hands to _apply_upmap
+    # (replicated compacts NONE holes first; erasure keeps positions)
+    if erasure:
+        base = raw
+        base_len = raw_len
+    else:
+        keep0 = raw != NONE
+        order0 = jnp.argsort(~keep0, axis=1, stable=True)
+        base = jnp.take_along_axis(raw, order0, axis=1)
+        base_len = jnp.sum(keep0, axis=1).astype(jnp.int32)
+        base = jnp.where(iota < base_len[:, None], base, NONE)
+
+    # -- pg_upmap_items: sequential pair rewrites (each pair sees the
+    # previous pair's result — a static unroll over the pair axis).
+    # Padded pairs are (-1, -1): -1 never appears in a raw row (cells
+    # are osd ids or NONE), so pads can never match, while a genuine
+    # NONE `frm` matches erasure holes exactly like list.index does.
+    # Both scans mask to the ACTIVE row length: the scalar list simply
+    # has no cells past it, and an unmasked NONE `frm` would match a
+    # NONE pad cell on a hole-free row — writing `to` into the pad and
+    # making a later pair's `to not in raw` check wrongly fail.
+    wrow = base
+    base_mask = iota < base_len[:, None]
+    p_pairs = items.shape[1]
+    for p in range(p_pairs):
+        frm = items[:, p, 0]
+        to = items[:, p, 1]
+        match = base_mask & (wrow == frm[:, None])
+        has = jnp.any(match, axis=1)
+        to_in = jnp.any(base_mask & (wrow == to[:, None]), axis=1)
+        cond = has & ~to_in & exists(to) & not_out(to)
+        first = jnp.argmax(match, axis=1).astype(jnp.int32)
+        wrow = jnp.where(cond[:, None] & (iota == first[:, None]),
+                         to[:, None], wrow)
+
+    # -- pg_upmap: wholesale replacement when present and every entry
+    # exists and is in (OSDMap._apply_upmap's validity gate); an
+    # invalid or absent entry falls through to the items result
+    upmask = iota < up_len[:, None]
+    ent_ok = ~upmask | (exists(up_rows) & not_out(up_rows))
+    allok = jnp.all(ent_ok, axis=1) & (up_len > 0)
+    row = jnp.where(allok[:, None], up_rows, wrow)
+    row_len = jnp.where(allok, up_len, base_len)
+
+    # -- raw -> up: drop nonexistent/down osds (NONE-positional for
+    # erasure, stable compaction for replicated; OSDMap.cc:2275-2297)
+    lenmask = iota < row_len[:, None]
+    valid = lenmask & (row != NONE) & exists(row) & is_up(row)
+    if erasure:
+        up = jnp.where(lenmask, jnp.where(valid, row, NOSD), NOSD)
+        up_len_o = row_len
+    else:
+        order = jnp.argsort(~valid, axis=1, stable=True)
+        up = jnp.take_along_axis(row, order, axis=1)
+        up_len_o = jnp.sum(valid, axis=1).astype(jnp.int32)
+        up = jnp.where(iota < up_len_o[:, None], up, NOSD)
+    up_real = up != NOSD
+    has_any = jnp.any(up_real, axis=1)
+    firstj = jnp.argmax(up_real, axis=1)
+    first_val = jnp.take_along_axis(up, firstj[:, None], axis=1)[:, 0]
+    up_primary = jnp.where(has_any, first_val, NOSD)
+
+    # -- primary affinity (OSDMap.cc _apply_primary_affinity): skip
+    # entirely when every member has default affinity; otherwise the
+    # first member winning its coin flip (default always wins) takes
+    # primary, falling back to the positional primary
+    aff = jnp.where(in_range(up), gather(affinity, up),
+                    _MAX_AFFINITY).astype(jnp.int32)
+    non_default = up_real & (aff != _MAX_AFFINITY)
+    default_all = ~jnp.any(non_default, axis=1)
+    h = (hash32_2(pps[:, None], up.astype(jnp.uint32))
+         >> jnp.uint32(16)).astype(jnp.int32)
+    win = up_real & ((aff == _MAX_AFFINITY) | (h < aff))
+    has_win = jnp.any(win, axis=1)
+    wj = jnp.argmax(win, axis=1)
+    wval = jnp.take_along_axis(up, wj[:, None], axis=1)[:, 0]
+    prim = jnp.where(default_all, up_primary,
+                     jnp.where(has_win, wval, up_primary))
+
+    # -- temps (OSDMap.cc:2417-2445): pg_temp replaces acting when
+    # present and non-empty; primary_temp overrides, else the first
+    # non-NOSD member — with acting == up inheriting up_primary
+    tset = temp_len > 0
+    acting = jnp.where(tset[:, None], temp_rows, up)
+    act_len = jnp.where(tset, temp_len, up_len_o)
+    act_real = acting != NOSD
+    act_has = jnp.any(act_real, axis=1)
+    aj = jnp.argmax(act_real, axis=1)
+    act_first = jnp.where(
+        act_has, jnp.take_along_axis(acting, aj[:, None], axis=1)[:, 0],
+        NOSD)
+    same = (act_len == up_len_o) & jnp.all(acting == up, axis=1)
+    ap = jnp.where(ptemp != NOSD, ptemp,
+                   jnp.where(same, prim, act_first))
+
+    return jnp.concatenate(
+        [up, acting, up_len_o[:, None], prim[:, None],
+         act_len[:, None], ap[:, None]], axis=1).astype(jnp.int32)
+
+
+@functools.lru_cache(maxsize=2)
+def _ladder_jit(erasure: bool):
+    import jax
+    return jax.jit(functools.partial(_ladder_impl, erasure=erasure))
+
+
+def ladder_cache_entries() -> int:
+    """Compile-cache entries across the fused-ladder entry points — the
+    dispatch profiler's retrace/compile probe differences this.  The
+    factory call is cached and only builds the jit wrapper, never
+    traces."""
+    return sum(_ladder_jit(flag)._cache_size() for flag in (False, True))
+
+
+def run_ladder(operands: "LadderOperands") -> np.ndarray:
+    """Direct (engine-less) fused-ladder evaluation: one jitted device
+    call, result materialized to host.  The PG axis pads up to a
+    power-of-two bucket (all-zero rows compute garbage that is sliced
+    off — the dispatch engine's shape-bucketing rule) so the jit cache
+    is bounded by the bucket table, not the pg_num population.  The
+    dispatch-engine path is ops.dispatch.submit_finish_ladder."""
+    n = operands.raw.shape[0]
+    bucket = 1 << max(0, (n - 1).bit_length())
+    pad = bucket - n
+
+    def padded(arr):
+        if not pad:
+            return arr
+        return np.concatenate(
+            [arr, np.zeros((pad,) + arr.shape[1:], dtype=arr.dtype)])
+
+    fn = _ladder_jit(operands.erasure)
+    out = fn(padded(operands.raw), padded(operands.pps),
+             padded(operands.raw_len), padded(operands.up_rows),
+             padded(operands.up_len), padded(operands.items),
+             padded(operands.temp_rows), padded(operands.temp_len),
+             padded(operands.ptemp), operands.state, operands.weight,
+             operands.affinity)
+    # analysis: allow[blocking] -- engine-less entry point: callers want the host table
+    return np.asarray(out)[:n]
+
+
+# ---------------------------------------------------------------------------
+# dense operand bundle
+# ---------------------------------------------------------------------------
+
+class LadderOperands:
+    """One pool's (or one what-if batch's) dense ladder operands.
+
+    ``raw``/``pps``/``raw_len`` and the override tables have the PG
+    leading axis (they coalesce/shard through the engine's data+aux
+    channels); ``state``/``weight``/``affinity`` are the per-OSD
+    vectors shared by every pool of the epoch (captured operands,
+    mesh-replicated by the submit helper)."""
+
+    __slots__ = ("raw", "pps", "raw_len", "up_rows", "up_len", "items",
+                 "temp_rows", "temp_len", "ptemp", "state", "weight",
+                 "affinity", "erasure", "width")
+
+    def __init__(self, *, raw, pps, raw_len, up_rows, up_len, items,
+                 temp_rows, temp_len, ptemp, state, weight, affinity,
+                 erasure, width):
+        self.raw = raw
+        self.pps = pps
+        self.raw_len = raw_len
+        self.up_rows = up_rows
+        self.up_len = up_len
+        self.items = items
+        self.temp_rows = temp_rows
+        self.temp_len = temp_len
+        self.ptemp = ptemp
+        self.state = state
+        self.weight = weight
+        self.affinity = affinity
+        self.erasure = bool(erasure)
+        self.width = int(width)
+
+    def aux(self) -> tuple:
+        """The per-PG side arrays in submit_finish_ladder's aux order."""
+        return (self.pps, self.raw_len, self.up_rows, self.up_len,
+                self.items, self.temp_rows, self.temp_len, self.ptemp)
+
+
+def pad_raw(raw: np.ndarray, width: int) -> np.ndarray:
+    """(N, w) raw table NONE-padded to the shared ladder width."""
+    raw = np.asarray(raw, dtype=np.int32)
+    n, w = raw.shape
+    if w == width:
+        return raw
+    out = np.full((n, width), NONE, dtype=np.int32)
+    out[:, :w] = raw
+    return out
+
+
+def build_operands(m, pool_id: int, pool, raw: np.ndarray,
+                   pps: np.ndarray, *, width: int, pairs: int,
+                   vectors=None) -> LadderOperands:
+    """Dense ladder operands for one pool at one epoch.  ``width`` and
+    ``pairs`` are the epoch-shared table widths (so pools coalesce);
+    ``vectors`` memoizes m.dense_osd_vectors() across pools."""
+    n = int(pool.pg_num)
+    raw_np = np.asarray(raw, dtype=np.int32)
+    raw_w = raw_np.shape[1] if raw_np.ndim == 2 else 0
+    if vectors is None:
+        vectors = m.dense_osd_vectors()
+    state, weight, affinity = vectors
+    up_rows, up_len, items, temp_rows, temp_len, ptemp = \
+        m.dense_pool_overrides(pool_id, n, width, pairs)
+    return LadderOperands(
+        raw=pad_raw(raw_np.reshape(n, raw_w), width),
+        pps=np.asarray(pps, dtype=np.uint32),
+        raw_len=np.full(n, raw_w, dtype=np.int32),
+        up_rows=up_rows, up_len=up_len, items=items,
+        temp_rows=temp_rows, temp_len=temp_len, ptemp=ptemp,
+        state=state, weight=weight, affinity=affinity,
+        erasure=pool.is_erasure(), width=width)
+
+
+def pool_widths(m, pools=None) -> tuple[int, int]:
+    """(width, pairs) shared by every pool of an epoch: W covers the
+    widest of pool size / pg_upmap row / pg_temp row, P the longest
+    pg_upmap_items pair list — each rounded up (P to a power of two,
+    W's excess over the max size to a power of two) so the jit/bucket
+    key space stays bounded under override churn."""
+    if pools is None:
+        pools = m.pools
+    w = max((int(p.size) for p in pools.values()), default=1)
+    w_need = w
+    for (pid, _pg), lst in m.pg_upmap.items():
+        if pid in pools:
+            w_need = max(w_need, len(lst))
+    for (pid, _pg), lst in m.pg_temp.items():
+        if pid in pools:
+            w_need = max(w_need, len(lst))
+    if w_need > w:
+        extra = w_need - w
+        w += 1 << (extra - 1).bit_length() if extra > 1 else 1
+    p = 1
+    for (pid, _pg), lst in m.pg_upmap_items.items():
+        if pid in pools:
+            p = max(p, len(lst))
+    if p > 1:
+        p = 1 << (p - 1).bit_length()
+    return max(w, 1), p
+
+
+def unpack_row(row, width: int) -> tuple[list[int], int, list[int], int]:
+    """One packed ladder row -> the oracle's (up, up_primary, acting,
+    acting_primary) tuple."""
+    lst = row.tolist() if hasattr(row, "tolist") else list(row)
+    w = width
+    up_len = lst[2 * w]
+    act_len = lst[2 * w + 2]
+    return (lst[:up_len], lst[2 * w + 1],
+            lst[w:w + act_len], lst[2 * w + 3])
+
+
+def normalize_packed(packed: np.ndarray, width: int,
+                     to_width: int) -> np.ndarray:
+    """Re-pad a packed table to a wider layout (NOSD fill) so two
+    epochs built at different shared widths compare row-for-row."""
+    if width == to_width:
+        return packed
+    n = packed.shape[0]
+    out = np.full((n, 2 * to_width + 4), NOSD, dtype=np.int32)
+    out[:, :width] = packed[:, :width]
+    out[:, to_width:to_width + width] = packed[:, width:2 * width]
+    out[:, 2 * to_width:] = packed[:, 2 * width:]
+    return out

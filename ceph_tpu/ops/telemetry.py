@@ -606,13 +606,22 @@ class MappingStats:
     and ``host_tail`` (the per-candidate pipeline tail — upmap/
     affinity/temp filtering through ``_finish_from`` — that still
     finishes host-side).
+
+    The FUSED counters track PR 10's device-resident pipeline tail:
+    ``fused_epochs``/``unfused_epochs`` count computed epochs that
+    published complete packed (up, acting) tables vs those serving the
+    host tail, ``fused_lookups`` counts reads answered by a packed-row
+    slice (a subset of ``lookups``), and the ``host_tail_share`` gauge
+    is the host-tail phase's share of the total epoch cost — the
+    number ``profile phases`` watches collapse on a fused cluster.
     """
 
     __slots__ = ("_lock", "epoch_updates", "epoch_skips",
                  "pools_recomputed", "pools_reused", "full_rescans",
                  "lookups", "lookup_fallbacks", "update_latency",
                  "changed_pgs", "cached_pgs", "cached_pools",
-                 "phase_device", "phase_delta", "phase_host_tail")
+                 "phase_device", "phase_delta", "phase_host_tail",
+                 "fused_epochs", "unfused_epochs", "fused_lookups")
 
     def __init__(self):
         self._lock = lockdep.make_lock("MappingStats::lock")
@@ -631,6 +640,10 @@ class MappingStats:
         self.phase_device = Histogram(LATENCY_BOUNDS)
         self.phase_delta = Histogram(LATENCY_BOUNDS)
         self.phase_host_tail = Histogram(LATENCY_BOUNDS)
+        # fused-vs-fallback epoch/read accounting (see class docstring)
+        self.fused_epochs = 0
+        self.unfused_epochs = 0
+        self.fused_lookups = 0
 
     def clear(self) -> None:
         with self._lock:
@@ -645,6 +658,8 @@ class MappingStats:
             self.phase_device = Histogram(LATENCY_BOUNDS)
             self.phase_delta = Histogram(LATENCY_BOUNDS)
             self.phase_host_tail = Histogram(LATENCY_BOUNDS)
+            self.fused_epochs = self.unfused_epochs = 0
+            self.fused_lookups = 0
 
     def record_phases(self, *, device_s: float, delta_s: float,
                       host_tail_s: float) -> None:
@@ -674,12 +689,30 @@ class MappingStats:
         with self._lock:
             self.full_rescans += 1
 
-    def record_lookup(self, hit: bool) -> None:
+    def record_lookup(self, hit: bool, fused: bool = False) -> None:
         with self._lock:
             if hit:
                 self.lookups += 1
+                if fused:
+                    self.fused_lookups += 1
             else:
                 self.lookup_fallbacks += 1
+
+    def record_fused_epoch(self, fused: bool) -> None:
+        """One computed epoch's tail mode: complete packed fused
+        tables vs the host-tail fallback."""
+        with self._lock:
+            if fused:
+                self.fused_epochs += 1
+            else:
+                self.unfused_epochs += 1
+
+    def _host_tail_share(self) -> float:
+        """Called under the lock: host-tail share of the total epoch
+        phase cost (the collapse gauge)."""
+        total = (self.phase_device.sum + self.phase_delta.sum
+                 + self.phase_host_tail.sum)
+        return (self.phase_host_tail.sum / total) if total else 0.0
 
     def dump(self) -> dict:
         with self._lock:
@@ -695,6 +728,10 @@ class MappingStats:
                 "changed_pgs": self.changed_pgs.dump(),
                 "cached_pgs": self.cached_pgs,
                 "cached_pools": self.cached_pools,
+                "fused_epochs": self.fused_epochs,
+                "unfused_epochs": self.unfused_epochs,
+                "fused_lookups": self.fused_lookups,
+                "host_tail_share": round(self._host_tail_share(), 6),
                 "phase_seconds": {
                     "device": self.phase_device.dump(),
                     "delta": self.phase_delta.dump(),
@@ -710,11 +747,14 @@ class MappingStats:
                     "delta": self.phase_delta.sum,
                     "host_tail": self.phase_host_tail.sum}
             epochs = self.phase_device.count
+            fused, unfused = self.fused_epochs, self.unfused_epochs
         total = sum(sums.values())
         return {"seconds": {k: round(v, 6) for k, v in sums.items()},
                 "share": {k: (round(v / total, 4) if total else 0.0)
                           for k, v in sums.items()},
-                "epochs": epochs}
+                "epochs": epochs,
+                "fused_epochs": fused,
+                "unfused_epochs": unfused}
 
     def summary(self) -> dict:
         """bench.py's digest: incrementality in a few numbers."""
@@ -732,6 +772,10 @@ class MappingStats:
                                      if self.changed_pgs.count else 0.0),
                 "lookups": self.lookups,
                 "lookup_fallbacks": self.lookup_fallbacks,
+                "fused_epochs": self.fused_epochs,
+                "unfused_epochs": self.unfused_epochs,
+                "fused_lookups": self.fused_lookups,
+                "host_tail_share": round(self._host_tail_share(), 6),
             }
 
 

@@ -204,11 +204,46 @@ def _vec(lst: list, n: int, fill: int = 0) -> np.ndarray:
     return out
 
 
+def _pool_override_digests(m: OSDMap) -> dict[int, int]:
+    """Per-pool content digest of the four override dicts — part of
+    the fused-table signature, so override-only churn recomputes just
+    the touched pool's ladder."""
+    acc: dict[int, list] = {}
+    for attr in ("pg_upmap", "pg_upmap_items", "pg_temp",
+                 "primary_temp"):
+        d = getattr(m, attr)
+        for (pid, pg), v in d.items():
+            if isinstance(v, list):
+                v = tuple(tuple(e) if isinstance(e, (list, tuple))
+                          else e for e in v)
+            acc.setdefault(pid, []).append((attr, pg, v))
+    return {pid: hash(tuple(sorted(entries)))
+            for pid, entries in acc.items()}
+
+
+def _tail_equal(a: OSDMap, b: OSDMap) -> bool:
+    """True when two maps agree on every PIPELINE-TAIL input (state,
+    weights, affinity, overrides) — the gate for serving one map's
+    fused rows to another object of the same epoch.  The raw-table
+    signature already matched; this covers what it deliberately does
+    not."""
+    return (a.max_osd == b.max_osd
+            and a.osd_state == b.osd_state
+            and a.osd_weight == b.osd_weight
+            and a.osd_primary_affinity == b.osd_primary_affinity
+            and a.pg_upmap == b.pg_upmap
+            and a.pg_upmap_items == b.pg_upmap_items
+            and a.pg_temp == b.pg_temp
+            and a.primary_temp == b.primary_temp)
+
+
 def _finish_from(m: OSDMap, pool: PGPool, pool_id: int, pg: int,
                  raw_tab: dict, pps_tab: dict
                  ) -> tuple[list[int], int, list[int], int]:
     """Pipeline tail (upmap -> up -> affinity -> temps) over a cached
-    raw row — the O(1) host work the cache reduces map reads to."""
+    raw row — the scalar oracle the fused device ladder
+    (ops.placement_kernel) is bit-exact against, and the fallback when
+    fused tables are unavailable."""
     raw = [int(o) for o in raw_tab[pool_id][pg]]
     if not pool.is_erasure():
         raw = [o for o in raw if o != CRUSH_ITEM_NONE]
@@ -220,28 +255,41 @@ def _finish_from(m: OSDMap, pool: PGPool, pool_id: int, pg: int,
 class _Tables:
     """One epoch's published tables: the map object they were built
     from (identity IS the primary cache key — see module contract),
-    the raw placements, the pps seeds, and the per-pool signatures.
+    the raw placements, the pps seeds, the per-pool signatures, and —
+    when the fused device ladder ran — the packed
+    (up, up_primary, acting, acting_primary) tables plus their shared
+    width and tail signatures.
 
     ``bound`` / ``rejected`` memoize OTHER map objects of the same
     epoch that have been content-checked against the signatures —
     N daemons on one context each decode their own copy of a published
     epoch, and equal signatures mean bit-identical raw tables, so
-    copies bind once and read the shared tables from then on."""
+    copies bind once and read the shared tables from then on.
+    ``tail_bound`` additionally memoizes copies whose PIPELINE-TAIL
+    inputs matched too (the raw signature deliberately excludes
+    state/affinity/overrides): only those may read the fused rows —
+    everyone else gets the host tail against their OWN map."""
 
     __slots__ = ("osdmap", "raw", "pps", "sigs", "epoch", "bound",
-                 "rejected")
+                 "rejected", "fused", "fused_w", "tail_sigs",
+                 "tail_bound")
 
-    def __init__(self, osdmap, raw, pps, sigs, epoch):
+    def __init__(self, osdmap, raw, pps, sigs, epoch, fused=None,
+                 fused_w=None, tail_sigs=None):
         self.osdmap = osdmap
         self.raw = raw
         self.pps = pps
         self.sigs = sigs
         self.epoch = epoch
+        self.fused = fused if fused is not None else {}
+        self.fused_w = fused_w if fused_w is not None else {}
+        self.tail_sigs = tail_sigs if tail_sigs is not None else {}
         # id -> weakref (OSDMap is an eq-dataclass, hence unhashable;
         # membership verifies the ref still IS the object, so a reused
         # id after GC can never alias)
         self.bound: dict[int, object] = {}
         self.rejected: dict[int, object] = {}
+        self.tail_bound: dict[int, object] = {}
 
     @staticmethod
     def _has(memo: dict, osdmap) -> bool:
@@ -296,12 +344,18 @@ class OSDMapMapping:
     the incremental reuse and exists for hosts without a device)."""
 
     def __init__(self, osdmap: OSDMap | None = None, *,
-                 backend: str = "tpu", min_device_pgs: int = 0):
+                 backend: str = "tpu", min_device_pgs: int = 0,
+                 fused: bool = True):
         self.osdmap = osdmap
         #: pools below this pg_num rebuild with the scalar rule engine
         #: (device dispatch + compile overhead dominates tiny pools);
         #: the osdmap_mapping_min_pgs option
         self.min_device_pgs = min_device_pgs
+        #: fuse the post-CRUSH pipeline tail on device (the
+        #: osdmap_mapping_fused option): publish packed
+        #: (up, acting, primaries) tables next to the raw ones.
+        #: Ignored on the scalar backend.
+        self.fused = fused
         #: one BatchMapper per crush-map identity (content signature),
         #: kept across update() calls so unchanged-crush epochs skip
         #: the compile_map/mapper rebuild
@@ -309,6 +363,9 @@ class OSDMapMapping:
         self._raw: dict[int, np.ndarray] = {}    # pool -> (pg_num, size) raw
         self._pps: dict[int, np.ndarray] = {}    # pool -> (pg_num,) pps seeds
         self._sigs: dict[int, tuple] = {}        # pool -> placement signature
+        self._fused: dict[int, np.ndarray] = {}  # pool -> packed ladder rows
+        self._fused_w: dict[int, int] = {}       # pool -> packed width
+        self._tail_sigs: dict[int, tuple] = {}   # pool -> tail signature
         self._reach: dict[tuple, tuple] = {}     # (crush_sig, rule) -> devs
         self.epoch = -1
         self.backend = backend
@@ -344,7 +401,9 @@ class OSDMapMapping:
         # timeout) leaves the old state fully consistent and the next
         # successful update diffs against the right old map
         prev = _Tables(self.osdmap if self.epoch >= 0 else None,
-                       self._raw, self._pps, self._sigs, self.epoch)
+                       self._raw, self._pps, self._sigs, self.epoch,
+                       fused=self._fused, fused_w=self._fused_w,
+                       tail_sigs=self._tail_sigs)
         # drop reachability memos of dead crush content before reuse
         csig, sigs = pool_signatures(m, self._reach)
         self._reach = {k: v for k, v in self._reach.items()
@@ -372,14 +431,26 @@ class OSDMapMapping:
                 raw[pool_id] = np.zeros((pool.pg_num, 0), dtype=np.int32)
                 continue
             pgids = np.arange(pool.pg_num, dtype=np.uint32)
+            # pps seeds depend ONLY on (pool_id, pg_num, pgp_num) —
+            # reweight/crush churn recomputes the raw table but may
+            # reuse the seeds (noticeable per epoch on slow hosts)
+            old_pool = (prev.osdmap.pools.get(pool_id)
+                        if prev.osdmap is not None else None)
+            pps = (prev.pps.get(pool_id)
+                   if (old_pool is not None
+                       and old_pool.pg_num == pool.pg_num
+                       and old_pool.pgp_num == pool.pgp_num)
+                   else None)
             if (self.backend == "scalar"
                     or pool.pg_num < self.min_device_pgs):
-                pps = pps_batch_scalar(pool, pgids)
+                if pps is None:
+                    pps = pps_batch_scalar(pool, pgids)
                 pps_t[pool_id] = pps
                 raw[pool_id] = scalar_rows(m.crush, pool.crush_rule,
                                            pps, pool.size, weights)
                 continue
-            pps = pps_batch(pool, pgids)
+            if pps is None:
+                pps = pps_batch(pool, pgids)
             pps_t[pool_id] = pps
             if bm is None:
                 # mapper_for reuses the compiled mapper across epochs
@@ -396,10 +467,103 @@ class OSDMapMapping:
                     pool.crush_rule, pps, pool.size, weights))
         for pool_id, fut in futures:
             raw[pool_id] = np.asarray(fut.result(timeout=120.0))
+        fused: dict[int, np.ndarray] = {}
+        fused_w: dict[int, int] = {}
+        tail_sigs: dict[int, tuple] = {}
+        if self.fused and self.backend != "scalar":
+            try:
+                self._build_fused(m, sigs, raw, pps_t, prev, engine,
+                                  fused, fused_w, tail_sigs)
+            except Exception as e:
+                from ceph_tpu.common.logging import dout
+                dout("mapping", 0, "fused placement ladder failed, "
+                     "serving host pipeline tail: %r", e)
+                fused, fused_w, tail_sigs = {}, {}, {}
         self.osdmap = m
         self._raw, self._pps, self._sigs = raw, pps_t, sigs
+        self._fused, self._fused_w = fused, fused_w
+        self._tail_sigs = tail_sigs
         self.epoch = m.epoch
         return _UpdateInfo(prev, recomputed, reused)
+
+    def _build_fused(self, m: OSDMap, sigs: dict, raw: dict,
+                     pps_t: dict, prev: _Tables, engine,
+                     fused: dict, fused_w: dict,
+                     tail_sigs: dict) -> None:
+        """Run the device ladder for every pool whose TAIL signature
+        moved (raw signature + osd state/weight/affinity digest +
+        per-pool override digest); unchanged pools alias their packed
+        tables forward.  With an ``engine`` the per-pool ladders
+        submit through submit_finish_ladder (pools sharing the epoch
+        digest and widths coalesce into one device call, mesh-sharded
+        on the PG axis); without one, each pool runs a direct jitted
+        call at its own pow-2 bucket (pool pg_nums are powers of two
+        in practice, so the bucket set — and the jit cache — stays
+        stable under whichever subset recomputes each epoch).
+
+        Maps below ``min_device_pgs`` TOTAL PGs skip the fused build
+        entirely (same policy as the raw-table rebuild: per-call
+        dispatch + jit-compile overhead dominates toy maps, and the
+        host tail is already cheap there); engine-less services and
+        dedicated tests default the floor to 0."""
+        if sum(int(p.pg_num) for p in m.pools.values()) \
+                < self.min_device_pgs:
+            return
+        from ceph_tpu.ops import placement_kernel as pk
+        width, pairs = pk.pool_widths(m)
+        vectors = m.dense_osd_vectors()
+        state, weight, affinity = vectors
+        epoch_digest = (hash(state.tobytes()), hash(weight.tobytes()),
+                        hash(affinity.tobytes()), width, pairs)
+        ov = _pool_override_digests(m)
+        jobs: list[tuple[int, object]] = []
+        for pool_id, pool in m.pools.items():
+            if pool_id not in raw:
+                continue
+            tsig = (sigs[pool_id], epoch_digest, ov.get(pool_id))
+            tail_sigs[pool_id] = tsig
+            if (prev.tail_sigs.get(pool_id) == tsig
+                    and pool_id in prev.fused
+                    and raw.get(pool_id) is prev.raw.get(pool_id)):
+                fused[pool_id] = prev.fused[pool_id]
+                fused_w[pool_id] = prev.fused_w[pool_id]
+                continue
+            pps = pps_t.get(pool_id)
+            if pps is None:
+                # invalid-rule pools skip the remap, but the ladder
+                # still needs the affinity seed (it is what
+                # _finish_pg_mapping would compute per read)
+                pgids = np.arange(pool.pg_num, dtype=np.uint32)
+                pps = pps_batch(pool, pgids)
+                pps_t[pool_id] = pps
+            jobs.append((pool_id, pk.build_operands(
+                m, pool_id, pool, raw[pool_id], pps, width=width,
+                pairs=pairs, vectors=vectors)))
+        if not jobs:
+            return
+        if engine is not None:
+            from ceph_tpu.ops.dispatch import submit_finish_ladder
+            futs = [(pid, submit_finish_ladder(engine, op))
+                    for pid, op in jobs]
+            for pid, fut in futs:
+                fused[pid] = np.asarray(fut.result(timeout=120.0))
+                fused_w[pid] = width
+        else:
+            # per-pool direct calls, NOT a concatenated group: pool
+            # pg_nums are powers of two in practice, so each pool hits
+            # one stable jit bucket, while a concatenated batch of
+            # whichever subset recomputed this epoch walks a different
+            # pow2 bucket per churn kind and recompiles on toy hosts
+            for pid, op in jobs:
+                fused[pid] = pk.run_ladder(op)
+                fused_w[pid] = width
+
+    def fused_complete(self) -> bool:
+        """True when every pool of the cached map has a packed fused
+        table — the gate for device-diff deltas and the
+        fused-vs-fallback epoch counters."""
+        return (self.osdmap is not None
+                and all(pid in self._fused for pid in self.osdmap.pools))
 
     def get_raw(self, pool_id: int) -> np.ndarray:
         """(pg_num, size) int32 raw CRUSH output, CRUSH_ITEM_NONE holes."""
@@ -407,7 +571,13 @@ class OSDMapMapping:
 
     def get(self, pool_id: int, pgid: int
             ) -> tuple[list[int], int, list[int], int]:
-        """Full pipeline for one PG using the cached raw placement."""
+        """Full pipeline for one PG: a fused-table row read when the
+        device ladder ran, the host tail over the cached raw placement
+        otherwise."""
+        f = self._fused.get(pool_id)
+        if f is not None and 0 <= pgid < f.shape[0]:
+            from ceph_tpu.ops import placement_kernel as pk
+            return pk.unpack_row(f[pgid], self._fused_w[pool_id])
         return _finish_from(self.osdmap, self.osdmap.pools[pool_id],
                             pool_id, pgid, self._raw, self._pps)
 
@@ -431,12 +601,23 @@ class SharedPGMappingService:
     #: can still be served incrementally)
     DELTA_LOG = 64
 
-    def __init__(self, ctx=None, backend: str | None = None):
+    #: packed fused tables at/below this many elements diff with one
+    #: vectorized numpy compare instead of a device call — per-call
+    #: dispatch overhead dominates tiny tables, exactly the
+    #: osdmap_mapping_min_pgs rationale (1M elements ~ a 100k-PG pool
+    #: at width 3, where the device/mesh diff starts paying)
+    FUSED_DIFF_HOST_MAX = 1 << 20
+
+    def __init__(self, ctx=None, backend: str | None = None,
+                 fused: bool | None = None):
         self._cv = lockdep.make_condition("SharedPGMappingService::cv")
         self._ctx = ctx
         #: explicit backend override (tests / engine-less tools);
         #: None = follow the context's crush_backend option
         self._backend_override = backend
+        #: explicit fused-ladder override (tests / bench A-B runs);
+        #: None = follow the osdmap_mapping_fused option
+        self._fused_override = fused
         self._mapping: OSDMapMapping | None = None
         self._tables: dict[int, _Tables] = {}     # current + previous epoch
         self._deltas: deque = deque(maxlen=self.DELTA_LOG)
@@ -469,6 +650,16 @@ class SharedPGMappingService:
         except KeyError:
             return "tpu"
 
+    def _fused_enabled(self) -> bool:
+        if self._fused_override is not None:
+            return bool(self._fused_override)
+        if self._ctx is None:
+            return True
+        try:
+            return bool(self._ctx.conf.get("osdmap_mapping_fused"))
+        except KeyError:
+            return True
+
     def _engine(self):
         if self._ctx is None or self._backend() == "scalar":
             return None
@@ -491,12 +682,14 @@ class SharedPGMappingService:
 
     def _ensure_mapping(self) -> OSDMapMapping:
         if self._mapping is None:
-            self._mapping = OSDMapMapping(backend=self._backend())
+            self._mapping = OSDMapMapping(backend=self._backend(),
+                                          fused=self._fused_enabled())
         else:
-            # both knobs follow the live config (an operator flipping
+            # the knobs follow the live config (an operator flipping
             # crush_backend to scalar mid-flight — wedged device —
             # must take effect on the next update)
             self._mapping.backend = self._backend()
+            self._mapping.fused = self._fused_enabled()
         if self._ctx is not None:
             try:
                 self._mapping.min_device_pgs = int(
@@ -568,7 +761,10 @@ class SharedPGMappingService:
         with self._cv:
             prev = info.prev
             newt = _Tables(work, mapping._raw, mapping._pps,
-                           mapping._sigs, work.epoch)
+                           mapping._sigs, work.epoch,
+                           fused=mapping._fused,
+                           fused_w=mapping._fused_w,
+                           tail_sigs=mapping._tail_sigs)
             self._tables = ({prev.epoch: prev, work.epoch: newt}
                             if prev.epoch >= 0 else {work.epoch: newt})
             if full or not self._chain_valid:
@@ -592,6 +788,7 @@ class SharedPGMappingService:
             reused=len(info.reused),
             changed=(len(changed) if not full else cached_pgs),
             cached_pgs=cached_pgs, cached_pools=len(mapping._raw))
+        self.stats.record_fused_epoch(mapping.fused_complete())
         # where did this epoch go: device remap vs candidate
         # extraction vs the host pipeline tail (ROADMAP item 2's
         # bottleneck question, readable via dump_mapping_stats)
@@ -638,7 +835,9 @@ class SharedPGMappingService:
         with self._cv:
             self._tables = {osdmap.epoch: _Tables(
                 osdmap, mapping._raw, mapping._pps, mapping._sigs,
-                osdmap.epoch)}
+                osdmap.epoch, fused=mapping._fused,
+                fused_w=mapping._fused_w,
+                tail_sigs=mapping._tail_sigs)}
             self._deltas.clear()
             self._chain_valid = False
             self._epoch = max(self._epoch, osdmap.epoch)
@@ -649,6 +848,7 @@ class SharedPGMappingService:
             recomputed=len(info.recomputed), reused=len(info.reused),
             changed=0, cached_pgs=cached_pgs,
             cached_pools=len(mapping._raw))
+        self.stats.record_fused_epoch(mapping.fused_complete())
 
     def _delta_since(self, from_epoch: int,
                      to_epoch: int | None = None) -> MapUpdate:
@@ -685,8 +885,62 @@ class SharedPGMappingService:
 
     # -- delta derivation -----------------------------------------------------
 
+    def _fused_delta(self, old: _Tables, mapping: OSDMapMapping):
+        """Exact changed-PG set by diffing both epochs' PACKED fused
+        tables on device: rows encode the full oracle tuple with
+        deterministic padding, so row inequality IS tuple inequality —
+        no candidate extraction, no per-candidate host tail.  Returns
+        None when either epoch lacks complete fused coverage (the host
+        candidate path below stays the exactness fallback)."""
+        m_new = mapping.osdmap
+        m_old = old.osdmap
+        mesh = self._mesh()
+        changed: list[tuple[int, int]] = []
+        for pool_id, pool in m_new.pools.items():
+            newp = mapping._fused.get(pool_id)
+            if newp is None:
+                return None
+            old_pool = m_old.pools.get(pool_id)
+            if old_pool is None:
+                changed.extend((pool_id, pg)
+                               for pg in range(pool.pg_num))
+                continue
+            oldp = old.fused.get(pool_id)
+            if oldp is None:
+                return None
+            wn = mapping._fused_w[pool_id]
+            wo = old.fused_w[pool_id]
+            if wn == wo and oldp.shape == newp.shape:
+                if oldp.size <= self.FUSED_DIFF_HOST_MAX:
+                    # toy tables: one vectorized host compare beats a
+                    # device round trip by ~30x on this class of host;
+                    # production pool sizes take the device diff below
+                    mask = np.flatnonzero((oldp != newp).any(axis=1))
+                    changed.extend((pool_id, int(pg)) for pg in mask)
+                    continue
+                for pg in _changed_rows(oldp, newp, mesh=mesh):
+                    changed.append((pool_id, int(pg)))
+                continue
+            # shared width or pg_num moved (override growth, pool
+            # resize): normalize to a common layout and compare the
+            # overlapping rows host-side — rare, and still exact
+            from ceph_tpu.ops.placement_kernel import normalize_packed
+            w = max(wo, wn)
+            a = normalize_packed(oldp, wo, w)
+            b = normalize_packed(newp, wn, w)
+            k = min(a.shape[0], b.shape[0])
+            if k:
+                for pg in np.flatnonzero((a[:k] != b[:k]).any(axis=1)):
+                    changed.append((pool_id, int(pg)))
+            changed.extend((pool_id, pg)
+                           for pg in range(k, newp.shape[0]))
+        return sorted(changed)
+
     def _compute_delta(self, info: _UpdateInfo):
-        """Exact changed-PG set for one epoch transition: candidates
+        """Exact changed-PG set for one epoch transition.  With
+        complete fused tables on both sides the delta is a pure
+        device diff of the packed outputs (_fused_delta) and the host
+        tail contributes NOTHING; otherwise candidates come
         from (a) the on-device raw-table diff of recomputed pools,
         (b) PGs whose raw rows reference OSDs with changed up/exists
         state or primary affinity, and (c) override-keyed PGs whose
@@ -704,6 +958,9 @@ class SharedPGMappingService:
         m_new = mapping.osdmap
         if old.osdmap is None or old.epoch < 0:
             return None, True, 0.0, 0.0
+        fused = self._fused_delta(old, mapping)
+        if fused is not None:
+            return fused, False, time.perf_counter() - t0, 0.0
         m_old = old.osdmap
         no = max(m_old.max_osd, m_new.max_osd, 1)
         st = (_vec(m_old.osd_state, no) != _vec(m_new.osd_state, no))
@@ -804,23 +1061,51 @@ class SharedPGMappingService:
             _csig, sigs = pool_signatures(osdmap)
         except Exception:
             return None
+        tail_ok = False
         with self._cv:
             t2 = self._tables.get(osdmap.epoch)
-            if t2 is None:
+        if t2 is not None and sigs == t2.sigs and t2.fused:
+            # the raw signature deliberately excludes tail inputs:
+            # verify them once (outside the lock — pure content
+            # compare) so this copy may read the FUSED rows too;
+            # a tail-divergent copy still binds, but reads go through
+            # the host tail against its own map
+            try:
+                tail_ok = _tail_equal(t2.osdmap, osdmap)
+            except Exception:
+                tail_ok = False
+        with self._cv:
+            t3 = self._tables.get(osdmap.epoch)
+            if t3 is None:
                 return None
-            if sigs == t2.sigs:
-                t2._memo(t2.bound, osdmap)
-                return t2
-            t2._memo(t2.rejected, osdmap)
+            if sigs == t3.sigs:
+                t3._memo(t3.bound, osdmap)
+                # tail_ok was verified against t2's map: only valid if
+                # the published tables were not swapped meanwhile (a
+                # racing warm() replacing the epoch)
+                if tail_ok and t3 is t2:
+                    t3._memo(t3.tail_bound, osdmap)
+                return t3
+            t3._memo(t3.rejected, osdmap)
             return None
 
     def lookup(self, osdmap: OSDMap, pool_id: int, pgid: int
                ) -> tuple[list[int], int, list[int], int]:
-        """pg_to_up_acting_osds served from the cache; scalar-oracle
-        fallback on any epoch/object/pool mismatch."""
+        """pg_to_up_acting_osds served from the cache — a packed-row
+        read when the fused ladder published this pool (and the caller
+        holds the service's map object or a tail-verified copy), the
+        host pipeline tail over the cached raw row otherwise;
+        scalar-oracle fallback on any epoch/object/pool mismatch."""
         pool = osdmap.pools[pool_id]
         t = self._tables_for(osdmap)
         if t is not None:
+            if t.fused and (t.osdmap is osdmap
+                            or t._has(t.tail_bound, osdmap)):
+                fr = t.fused.get(pool_id)
+                if fr is not None and 0 <= pgid < fr.shape[0]:
+                    self.stats.record_lookup(True, fused=True)
+                    from ceph_tpu.ops.placement_kernel import unpack_row
+                    return unpack_row(fr[pgid], t.fused_w[pool_id])
             row = t.raw.get(pool_id)
             if row is not None and 0 <= pgid < row.shape[0]:
                 self.stats.record_lookup(True)
@@ -843,6 +1128,71 @@ class SharedPGMappingService:
         if not osdmap.pools[pool_id].is_erasure():
             row = [o for o in row if o != CRUSH_ITEM_NONE]
         return row
+
+    def what_if_up(self, osdmap: OSDMap, pool_id: int,
+                   candidates: list[tuple[int, list]]
+                   ) -> list[list[int]] | None:
+        """Batched what-if scoring for the balancer: the ``up`` set
+        each candidate ``(pg, upmap_items_pairs)`` would produce —
+        raw row + pair rewrites + state filtering, NO full-upmap/temp
+        overrides, exactly the host ``up_of`` the balancer used to run
+        per candidate — evaluated for ALL candidates in one fused
+        ladder call.  None when the cache cannot serve this map or the
+        fused ladder is unavailable (caller falls back to the host
+        pipeline)."""
+        if not candidates:
+            return []
+        mapping = self._mapping
+        if (mapping is None or not getattr(mapping, "fused", False)
+                or mapping.backend == "scalar"):
+            return None
+        t = self._tables_for(osdmap)
+        if t is None:
+            return None
+        raw = t.raw.get(pool_id)
+        pps = t.pps.get(pool_id)
+        pool = osdmap.pools.get(pool_id)
+        if raw is None or pps is None or pool is None:
+            return None
+        pgs = [pg for pg, _prs in candidates]
+        if any(not (0 <= pg < raw.shape[0]) for pg in pgs):
+            return None
+        from ceph_tpu.ops import placement_kernel as pk
+        b = len(candidates)
+        pairs = max(max((len(prs) for _pg, prs in candidates),
+                        default=1), 1)
+        width = max(int(pool.size), raw.shape[1], 1)
+        state, weight, affinity = osdmap.dense_osd_vectors()
+        idx = np.asarray(pgs, dtype=np.int64)
+        items = np.full((b, pairs, 2), -1, dtype=np.int32)
+        for i, (_pg, prs) in enumerate(candidates):
+            for j, (frm, to) in enumerate(prs[:pairs]):
+                items[i, j, 0] = frm
+                items[i, j, 1] = to
+        ops_ = pk.LadderOperands(
+            raw=pk.pad_raw(raw[idx], width),
+            pps=np.asarray(pps)[idx].astype(np.uint32),
+            raw_len=np.full(b, raw.shape[1], dtype=np.int32),
+            up_rows=np.full((b, width), CRUSH_ITEM_NONE,
+                            dtype=np.int32),
+            up_len=np.zeros(b, dtype=np.int32),
+            items=items,
+            temp_rows=np.full((b, width), -1, dtype=np.int32),
+            temp_len=np.zeros(b, dtype=np.int32),
+            ptemp=np.full(b, -1, dtype=np.int32),
+            state=state, weight=weight, affinity=affinity,
+            erasure=pool.is_erasure(), width=width)
+        try:
+            engine = self._engine()
+            if engine is not None:
+                from ceph_tpu.ops.dispatch import submit_finish_ladder
+                packed = np.asarray(submit_finish_ladder(
+                    engine, ops_).result(timeout=120.0))
+            else:
+                packed = pk.run_ladder(ops_)
+        except Exception:
+            return None
+        return [pk.unpack_row(packed[i], width)[0] for i in range(b)]
 
     def pg_counts(self, osdmap: OSDMap, pool_id: int) -> np.ndarray:
         """Per-OSD PG count histogram for a pool (osdmaptool input);

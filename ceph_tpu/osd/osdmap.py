@@ -224,6 +224,67 @@ class OSDMap:
     def mark_out(self, osd: int) -> None:
         self.osd_weight[osd] = 0
 
+    # -- dense operand extraction (fused placement ladder) --------------------
+
+    def dense_osd_vectors(self):
+        """(state, weight, affinity) numpy vectors of length
+        max(max_osd, 1) — the per-OSD operands of the fused placement
+        ladder (ops.placement_kernel).  Sliced to max_osd exactly: the
+        scalar pipeline's bounds checks all read ``0 <= o < max_osd``,
+        so entries past it must not exist on device either."""
+        import numpy as np
+        n = max(self.max_osd, 1)
+        state = np.zeros(n, dtype=np.int32)
+        weight = np.zeros(n, dtype=np.int64)
+        affinity = np.full(n, MAX_AFFINITY, dtype=np.int32)
+        k = min(self.max_osd, len(self.osd_state))
+        state[:k] = self.osd_state[:k]
+        k = min(self.max_osd, len(self.osd_weight))
+        weight[:k] = self.osd_weight[:k]
+        k = min(self.max_osd, len(self.osd_primary_affinity))
+        affinity[:k] = self.osd_primary_affinity[:k]
+        return state, weight, affinity
+
+    def dense_pool_overrides(self, pool_id: int, pg_num: int,
+                             width: int, pairs: int):
+        """One pool's sparse overrides as dense per-PG tables for the
+        fused ladder: (up_rows, up_len, items, temp_rows, temp_len,
+        ptemp).  pg_upmap/pg_temp rows are NONE/NOSD padded to
+        ``width``; pg_upmap_items pairs are (-1, -1) padded to
+        ``pairs`` (-1 never matches a raw cell, so pads are inert
+        while genuine entries — including NONE frms — keep the scalar
+        list semantics)."""
+        import numpy as np
+        up_rows = np.full((pg_num, width), CRUSH_ITEM_NONE,
+                          dtype=np.int32)
+        up_len = np.zeros(pg_num, dtype=np.int32)
+        for (pid, pg), lst in self.pg_upmap.items():
+            if pid != pool_id or not (0 <= pg < pg_num):
+                continue
+            n = min(len(lst), width)
+            up_rows[pg, :n] = lst[:n]
+            up_len[pg] = n
+        items = np.full((pg_num, pairs, 2), -1, dtype=np.int32)
+        for (pid, pg), prs in self.pg_upmap_items.items():
+            if pid != pool_id or not (0 <= pg < pg_num):
+                continue
+            for i, (frm, to) in enumerate(prs[:pairs]):
+                items[pg, i, 0] = frm
+                items[pg, i, 1] = to
+        temp_rows = np.full((pg_num, width), CEPH_NOSD, dtype=np.int32)
+        temp_len = np.zeros(pg_num, dtype=np.int32)
+        for (pid, pg), lst in self.pg_temp.items():
+            if pid != pool_id or not (0 <= pg < pg_num):
+                continue
+            n = min(len(lst), width)
+            temp_rows[pg, :n] = lst[:n]
+            temp_len[pg] = n
+        ptemp = np.full(pg_num, CEPH_NOSD, dtype=np.int32)
+        for (pid, pg), osd in self.primary_temp.items():
+            if pid == pool_id and 0 <= pg < pg_num:
+                ptemp[pg] = osd
+        return up_rows, up_len, items, temp_rows, temp_len, ptemp
+
     # -- placement pipeline (scalar oracle) -----------------------------------
 
     def _pg_to_raw_osds(self, pool: PGPool, ps: int,

@@ -185,31 +185,49 @@ def _small_map(epoch=2, pools=2, pg_num=32):
 
 def test_mapping_service_phase_split_live():
     """A live service's computed epochs split into device vs delta vs
-    host-tail phases, readable from dump_mapping_stats."""
+    host-tail phases, readable from dump_mapping_stats — and the PR 10
+    fused ladder COLLAPSES the host tail: the default (fused) service
+    records zero host-tail seconds while an unfused twin of the same
+    churn still pays it."""
     from ceph_tpu.osd import SharedPGMappingService
 
+    def churn(svc, m):
+        svc.update_to(m)
+        for i in range(3):
+            new = m.copy()
+            new.epoch = m.epoch + 1
+            new.osd_weight[i % 8] = 0x8000 if i % 2 == 0 else 0x10000
+            upd = svc.update_to(new)
+            assert not upd.full
+            m = new
+
     telemetry.reset()
-    svc = SharedPGMappingService()
-    m = _small_map()
-    svc.update_to(m)
-    for i in range(3):
-        new = m.copy()
-        new.epoch = m.epoch + 1
-        new.osd_weight[i % 8] = 0x8000 if i % 2 == 0 else 0x10000
-        upd = svc.update_to(new)
-        assert not upd.full
-        m = new
+    churn(SharedPGMappingService(), _small_map())
     d = telemetry.mapping_dump()
     ph = d["phase_seconds"]
     assert set(ph) == {"device", "delta", "host_tail"}
     assert ph["device"]["count"] == 4          # first map + 3 epochs
     assert ph["device"]["sum"] > 0.0
-    # the 3 churn epochs ran the candidate pass and the host tail
+    # the 3 churn epochs diffed fused outputs on device: the candidate
+    # pass still costs delta time, the host tail contributes NOTHING
     assert ph["delta"]["sum"] > 0.0
-    assert ph["host_tail"]["sum"] > 0.0
+    assert ph["host_tail"]["sum"] == 0.0
+    assert d["host_tail_share"] == 0.0
+    assert d["fused_epochs"] == 4
+    assert d["unfused_epochs"] == 0
     summ = telemetry.mapping_stats().phase_summary()
     assert summ["epochs"] == 4
+    assert summ["fused_epochs"] == 4
     assert sum(summ["share"].values()) == pytest.approx(1.0, abs=0.01)
+    # the unfused twin (knob off) pays the per-candidate host tail
+    telemetry.reset()
+    churn(SharedPGMappingService(fused=False), _small_map())
+    d = telemetry.mapping_dump()
+    assert d["phase_seconds"]["host_tail"]["sum"] > 0.0
+    assert d["host_tail_share"] > 0.0
+    assert d["fused_epochs"] == 0
+    assert d["unfused_epochs"] == 4
+    telemetry.reset()
 
 
 # -- admin socket -------------------------------------------------------------
