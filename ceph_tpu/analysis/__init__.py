@@ -9,7 +9,7 @@ with AST-level *may* analysis over the whole tree, so the concurrency
 and jit-purity invariants the dispatch/decode/mapping hot paths
 established by convention are mechanically enforced on every PR.
 
-Five check families (one module each):
+Six check families (one module each):
 
 * ``lock-order``  — static may-hold-A-while-taking-B graph, propagated
   inter-procedurally and unioned with the runtime
@@ -27,6 +27,10 @@ Five check families (one module each):
 * ``registry``    — every ``conf.get(key)`` key must exist in
   ``common/config.py``'s option table; every perf-counter mutation
   must name a counter registered in its ``PerfCounters`` set.
+* ``thread-except`` — ``except`` handlers catching ``BaseException``
+  (or bare) reachable from thread run-loops must re-raise or deliver
+  the exception to a waiter/supervisor; a swallowed loop error
+  strands every future behind it.
 
 Findings diff against a checked-in baseline (``baseline.txt``, driven
 to empty) and per-line suppressions:
@@ -45,7 +49,7 @@ import os
 from dataclasses import dataclass, field
 
 CHECKS = ("lock-order", "bare-lock", "blocking", "jit-purity",
-          "registry")
+          "registry", "thread-except")
 
 
 @dataclass(frozen=True)
@@ -129,6 +133,10 @@ def run(root: str, checks=CHECKS, runtime_graph: dict | None = None,
     if "registry" in checks:
         from ceph_tpu.analysis import registry_lint
         for f in registry_lint.check(index):
+            _emit(report, index, f)
+    if "thread-except" in checks:
+        from ceph_tpu.analysis import thread_except
+        for f in thread_except.check(index):
             _emit(report, index, f)
     report.findings.sort(key=lambda f: (f.path, f.line, f.check, f.code))
     return report

@@ -1,0 +1,154 @@
+"""Thread run-loop exception-swallowing lint (check family
+``thread-except``).
+
+A daemon/engine thread's run-loop is the LAST handler its work will
+ever see: an ``except`` that catches ``BaseException`` (or a bare
+``except:``) and drops the exception on the floor turns a dead batch
+into an invisible one — waiters park forever behind futures nobody
+will resolve, and the thrasher reads it as a hang, not a failure.
+PR 11's supervised engine formalized the contract: a run-loop handler
+that catches everything must DELIVER the exception somewhere — fan it
+to the waiting futures (``exc = e`` / ``_deliver(None, e)``), hand it
+to the supervisor, or re-``raise``.
+
+Roots: every function reachable as a thread body — ``target=`` of a
+``threading.Thread(...)`` construction, and ``run`` methods of
+``Thread`` subclasses.  The lint flags, in any function reachable from
+a root through the best-effort call graph, an ``except`` handler that
+
+* catches ``BaseException`` explicitly, is a bare ``except:``, or
+  names it inside a tuple, AND
+* neither ``raise``s in its body NOR binds the exception
+  (``as e``) and references that name (the static proxy for
+  "delivered it to a waiter or the supervisor").
+
+Handlers catching ``Exception`` or narrower are NOT flagged — absorbing
+expected errors is normal; it is the catch-everything-and-vanish shape
+(which also eats ``InjectedThreadDeath`` and ``KeyboardInterrupt``)
+that must prove delivery.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ceph_tpu.analysis import Finding
+from ceph_tpu.analysis.core import TreeIndex, name_chain
+
+#: reachability bound, same rationale as the blocking check
+MAX_DEPTH = 6
+
+
+def _thread_roots(index: TreeIndex):
+    """Functions that run as a thread body: Thread(target=...) args and
+    run() methods of Thread subclasses."""
+    roots = []
+    for fi in index.all_functions():
+        for cs in fi.call_sites:
+            node = cs.node
+            if not isinstance(node, ast.Call):
+                continue
+            chain = name_chain(node.func)
+            if not chain or chain[-1] != "Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg != "target":
+                    continue
+                target = None
+                ach = name_chain(kw.value)
+                if isinstance(kw.value, ast.Lambda):
+                    target = fi.nested.get(
+                        f"<lambda@{kw.value.lineno}:"
+                        f"{kw.value.col_offset}>")
+                elif ach:
+                    spec = None
+                    if len(ach) == 1:
+                        spec = ("name", ach[0])
+                    elif ach[0] in ("self", "cls") and len(ach) == 2:
+                        spec = ("self", ach[1])
+                    if spec:
+                        target = index.resolve_call(fi, spec)
+                if target is not None:
+                    roots.append(target)
+    for mod in index.modules.values():
+        for ci in mod.classes.values():
+            if any(b and b[-1] == "Thread" for b in ci.bases):
+                run = ci.methods.get("run")
+                if run is not None:
+                    roots.append(run)
+    return roots
+
+
+def _reachable(index: TreeIndex, roots):
+    out = {}
+    frontier = [(fn, 0) for fn in roots]
+    for fn, _d in frontier:
+        out.setdefault(fn, 0)
+    while frontier:
+        nxt = []
+        for fn, d in frontier:
+            if d >= MAX_DEPTH:
+                continue
+            for cs in fn.call_sites:
+                g = index.resolve_call(fn, cs.spec)
+                if g is not None and g not in out:
+                    out[g] = d + 1
+                    nxt.append((g, d + 1))
+        frontier = nxt
+    return out
+
+
+def _catches_base(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True                      # bare except:
+    if isinstance(t, ast.Tuple):
+        return any(_catches_base_expr(e) for e in t.elts)
+    return _catches_base_expr(t)
+
+
+def _catches_base_expr(node) -> bool:
+    chain = name_chain(node)
+    return bool(chain) and chain[-1] == "BaseException"
+
+
+def _delivers(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body re-raises or references its bound
+    exception name (the static proxy for delivering it to a waiter,
+    the log, or the supervisor)."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if (handler.name and isinstance(node, ast.Name)
+                and node.id == handler.name
+                and isinstance(node.ctx, ast.Load)):
+            return True
+    return False
+
+
+def check(index: TreeIndex):
+    reach = _reachable(index, _thread_roots(index))
+    findings = []
+    seen = set()
+    for fn in sorted(reach, key=lambda f: f.qualname):
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if not _catches_base(handler) or _delivers(handler):
+                    continue
+                key = (fn.module.relpath, handler.lineno)
+                if key in seen:
+                    continue
+                seen.add(key)
+                what = ("bare except:" if handler.type is None
+                        else "except BaseException")
+                findings.append(Finding(
+                    "thread-except", fn.module.relpath,
+                    handler.lineno, "swallow",
+                    f"{what} in thread run-loop path {fn.qualname} "
+                    f"neither re-raises nor uses the caught "
+                    f"exception — a swallowed loop error strands "
+                    f"every waiter behind it (deliver it to a "
+                    f"future/supervisor or re-raise)"))
+    return findings

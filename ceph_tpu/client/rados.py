@@ -11,7 +11,9 @@ Jenkins lookup2 string hash, distinct from the CRUSH rjenkins1 mix.
 
 from __future__ import annotations
 
+import random
 import threading
+import time
 from contextlib import contextmanager
 
 from ceph_tpu.common.context import CephTpuContext
@@ -106,6 +108,13 @@ class _Waiter:
         self.direct = direct
         self.event = threading.Event()
         self.reply: MOSDOpReply | None = None
+        #: map-change/stale-epoch resend count: the first resend is
+        #: immediate, later ones back off exponentially with jitter
+        self.resends = 0
+        #: True while a deferred resend row sits in _resend_q: later
+        #: map epochs coalesce into it (it targets from the newest map
+        #: when it fires) instead of queueing duplicate sends
+        self.resend_queued = False
 
 
 class AioCompletion:
@@ -205,6 +214,24 @@ class RadosClient(Dispatcher):
         #: ops submitted by this thread bill to the tenant — the RGW
         #: front wraps each authenticated request in its tenant's lane
         self._qos_tl = threading.local()
+        #: capped-backoff resend queue: (due monotonic, waiter) rows
+        #: drained by a single coalesced timer — a map storm neither
+        #: re-sends every in-flight op once per epoch nor spawns a
+        #: timer per op
+        self._resend_q: list[tuple[float, _Waiter]] = []
+        self._resend_timer: threading.Timer | None = None
+        #: the armed timer's deadline (monotonic): a new row due
+        #: EARLIER must cancel and re-arm, or a short-backoff op waits
+        #: behind a max-backoff op's far timer
+        self._resend_due: float = 0.0
+        #: client-side Objecter counters (librados perf dump analog):
+        #: resend volume and how many of them were backoff-deferred
+        from ceph_tpu.common.perf_counters import PerfCountersBuilder
+        self.perf = (PerfCountersBuilder(f"objecter.{self.client_id}")
+                     .add_u64("op_resends")
+                     .add_u64("op_resend_backoffs")
+                     .create_perf_counters())
+        self.ctx.perf.add(self.perf)
         self.name = EntityName("client", self.client_id)
         self.msgr = Messenger.create(self.name, ms_type)
         self.msgr.set_auth(auth_key)
@@ -279,6 +306,11 @@ class RadosClient(Dispatcher):
         self._stopped = True
         if getattr(self, "_sub_timer", None) is not None:
             self._sub_timer.cancel()
+        with self._lock:
+            if self._resend_timer is not None:
+                self._resend_timer.cancel()
+                self._resend_timer = None
+            self._resend_q.clear()
         self.msgr.shutdown()
 
     # -- dispatch -------------------------------------------------------------
@@ -314,7 +346,7 @@ class RadosClient(Dispatcher):
                         self._warm_thread.start()
             self._map_event.set()
             for w in pending:   # resend on map change (Objecter semantics)
-                self._send_op(w)
+                self._resend_op(w)
             return True
         if isinstance(msg, MOSDOpReply):
             with self._lock:
@@ -509,6 +541,86 @@ class RadosClient(Dispatcher):
         addr = self.osdmap.osd_addrs[primary]
         con = self.msgr.connect_to(addr, EntityName("osd", primary))
         con.send_message(w.msg)
+
+    def _resend_op(self, w: _Waiter) -> None:
+        """Resend an in-flight op after a map change / stale-epoch
+        retarget, with CAPPED EXPONENTIAL BACKOFF + JITTER past the
+        first resend: one map flip never delays an op, but an op that
+        keeps being resent (map storm, flapping primary) waits
+        ~base * 2^(n-1) ms (jittered, capped) between attempts instead
+        of hammering the cluster once per epoch.  Deferred resends
+        re-target from the NEWEST map when their timer fires — so an
+        epoch arriving while a resend is already queued coalesces into
+        the queued row (a second row would just duplicate the send)."""
+        base = float(self.ctx.conf.get("client_resend_backoff_ms"))
+        cap = float(self.ctx.conf.get("client_resend_backoff_max_ms"))
+        send_now = False
+        # one critical section for check-bump-queue: concurrent map
+        # deliveries racing the resend_queued check must not both
+        # queue (or both count) the same waiter
+        with self._lock:
+            if w.resend_queued:
+                return
+            w.resends += 1
+            self.perf.inc("op_resends")
+            if w.resends <= 1:
+                send_now = True
+            else:
+                delay = min(cap, base * (2 ** (w.resends - 2)))
+                delay *= (0.5 + 0.5 * random.random()) / 1e3
+                self.perf.inc("op_resend_backoffs")
+                w.resend_queued = True
+                self._resend_q.append((time.monotonic() + delay, w))
+                self._arm_resend_timer()
+        if send_now:
+            self._send_op(w)
+
+    def _arm_resend_timer(self) -> None:
+        """Under self._lock: one coalesced timer at the earliest due
+        time serves the whole queue.  An armed timer is re-armed when
+        a NEW row is due before its deadline — otherwise a 25 ms
+        backoff queued behind a 2 s one would wait the full 2 s."""
+        if not self._resend_q or getattr(self, "_stopped", False):
+            return
+        due = min(t for t, _ in self._resend_q)
+        if self._resend_timer is not None:
+            if due >= self._resend_due:
+                return
+            self._resend_timer.cancel()
+        timer = threading.Timer(max(0.0, due - time.monotonic()),
+                                self._drain_resends)
+        timer.daemon = True
+        self._resend_timer = timer
+        self._resend_due = due
+        timer.start()
+
+    def _drain_resends(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._resend_timer = None
+            live = [(t, w) for t, w in self._resend_q
+                    if w.msg.tid in self._waiters]   # replied: drop
+            ready = [w for t, w in live if t <= now]
+            self._resend_q = [(t, w) for t, w in live if t > now]
+            for w in ready:
+                w.resend_queued = False
+            self._arm_resend_timer()
+        for w in ready:
+            try:
+                self._send_op(w)
+            except (OSError, TimeoutError):
+                pass   # next map change (or timeout) retries again
+            except Exception as e:
+                # anything else (a pool deleted under the op making
+                # _calc_target raise) must not unwind the ONE shared
+                # timer thread mid-fan: the remaining ready waiters
+                # were already dequeued with resend_queued=False and
+                # would never be re-sent — stranded until their own
+                # op timeout on a healthy cluster
+                from ceph_tpu.common.logging import dout
+                dout("rados", 0, "%s: resend of tid %d failed "
+                     "(waiter left for map change/timeout): %r",
+                     self.name, w.msg.tid, e)
 
     #: distinct tenant trackers retained per client (LRU)
     QOS_TRACKER_CAP = 1024

@@ -191,6 +191,45 @@ register_options([
            "concurrent decodes coalesce into one device call even "
            "with different erasure patterns (heterogeneous-matrix "
            "batched kernel); off = decode synchronously per gather"),
+    Option("kernel_failpoints", OPT_STR, "",
+           "armed device-runtime failpoints (common/failpoint.py): "
+           "'name=mode[;name=mode...]' where name is a boundary site "
+           "optionally channel-qualified (dispatch.launch:ec_encode) "
+           "and mode is always|prob:P|oneshot|nth:K|off; empty "
+           "disarms everything; the failpoint set/clear/ls admin "
+           "commands drive the same registry"),
+    Option("kernel_fault_max_retries", OPT_INT, 2,
+           "device re-attempts per coalesced batch after a transient "
+           "device failure before the batch fails over to the host "
+           "oracle (or fans its error); each retry waits an "
+           "exponentially growing jittered backoff"),
+    Option("kernel_fault_backoff_ms", OPT_FLOAT, 5.0,
+           "base retry backoff in milliseconds: attempt i waits "
+           "base * 2^i scaled by uniform jitter in [0.5, 1.0)"),
+    Option("kernel_fault_backoff_max_ms", OPT_FLOAT, 200.0,
+           "cap on a single retry backoff wait"),
+    Option("kernel_fault_breaker_threshold", OPT_INT, 3,
+           "consecutive device-path batch failures (retries "
+           "exhausted) on one kernel channel before its circuit "
+           "breaker opens and batches route through the bit-exact "
+           "host oracle while a background probe retries the device"),
+    Option("kernel_fault_probe_interval", OPT_FLOAT, 0.5,
+           "seconds between background device-path probes while a "
+           "channel breaker is open; a successful probe closes the "
+           "breaker and traffic returns to the device"),
+    Option("kernel_fault_thread_restarts", OPT_INT, 4,
+           "times a dead dispatch/completion thread is restarted "
+           "per engine (in-flight batches re-fan to the replacement); "
+           "past the budget the engine is wedged: every waiter gets "
+           "a loud EngineWedgedError and flush() raises"),
+    Option("client_resend_backoff_ms", OPT_FLOAT, 25.0,
+           "base backoff in milliseconds before an Objecter resend "
+           "of an already-resent in-flight op (map-change/stale-epoch "
+           "retargeting): resend i of one op waits ~base * 2^(i-1) "
+           "with uniform jitter; the FIRST resend is immediate, so a "
+           "single map change never delays an op"),
+    Option("client_resend_backoff_max_ms", OPT_FLOAT, 2000.0,
+           "cap on a single client resend backoff wait"),
     Option("kernel_profile_ring", OPT_INT, 256,
            "recent per-batch pipeline-profile records retained per "
            "dispatch engine (the dump_pipeline_profile ring); "

@@ -71,6 +71,18 @@ class CephTpuContext:
             "over tracing_slow_threshold)")
         from ceph_tpu.ops import telemetry
         telemetry.configure_from_conf(self.conf)
+        # fault injection + degraded-mode visibility: the failpoint
+        # registry is process-global (like the telemetry registry);
+        # this context's config option and admin commands drive it
+        from ceph_tpu.common import failpoint
+        failpoint.configure_from_conf(self.conf)
+        failpoint.register_admin(self.admin)
+        self.admin.register_command(
+            "dump_fault_stats", lambda **kw: self.fault_digest(),
+            "device-runtime fault/degradation counters per dispatch "
+            "engine: retries, host-oracle fallback batches/stripes, "
+            "circuit-breaker opens/closes and per-channel states, "
+            "background-probe outcomes, thread deaths/restarts")
         self.admin.register_command(
             "dump_kernel_stats", lambda **kw: telemetry.dump(),
             "device-kernel telemetry: latency/batch histograms, "
@@ -117,6 +129,28 @@ class CephTpuContext:
             "steady-state compute), device busy-seconds/utilization/"
             "shard-imbalance, a ring of recent per-batch records, and "
             "the mapping service's epoch phase split")
+
+    def fault_digest(self) -> dict:
+        """telemetry.fault_digest() with THIS context's engines'
+        per-channel breaker maps overlaid.  The counter sinks are
+        process-global (every in-process daemon shares them, which is
+        what a per-process exporter wants), but ``breaker_states`` is
+        keyed by channel only — daemon B re-closing a breaker there is
+        last-writer-wins over daemon A's still-open one.  The shipped
+        MMgrReport ``faults`` tail and the admin payload attribute
+        degradation to ONE daemon, so they must read breaker ground
+        truth from that daemon's own engines; a context that never
+        built an engine has no breakers (and must not inherit another
+        daemon's)."""
+        from ceph_tpu.ops import telemetry
+        digest = telemetry.fault_digest()
+        with self._dispatch_lock:
+            engines = {"encode": self._dispatch,
+                       "decode": self._decode_dispatch}
+        for key, eng in engines.items():
+            digest[key]["breaker_states"] = (
+                eng.breaker_states() if eng is not None else {})
+        return digest
 
     def kernel_mesh(self):
         """The ("dp", "ec") device mesh this context's dispatch engines
@@ -191,6 +225,23 @@ class CephTpuContext:
         self.conf.add_observer(
             "kernel_coalesce_max_delay_us",
             lambda _n, v: setattr(eng, "max_delay_us", float(v)))
+        # fault-domain knobs (retry ladder, breaker, supervision):
+        # same construction-read + hot-reload-observer pattern
+        for opt, attr, cast in (
+                ("kernel_fault_max_retries", "fault_max_retries", int),
+                ("kernel_fault_backoff_ms", "fault_backoff_ms", float),
+                ("kernel_fault_backoff_max_ms",
+                 "fault_backoff_max_ms", float),
+                ("kernel_fault_breaker_threshold",
+                 "breaker_threshold", int),
+                ("kernel_fault_probe_interval", "probe_interval",
+                 float),
+                ("kernel_fault_thread_restarts", "thread_restarts",
+                 int)):
+            setattr(eng, attr, cast(self.conf.get(opt)))
+            self.conf.add_observer(
+                opt, lambda _n, v, a=attr, c=cast:
+                setattr(eng, a, c(v)))
         return eng
 
     def dispatch_engine(self):

@@ -263,6 +263,18 @@ class ErasureCode(ErasureCodeInterface):
         cache_entries = None
         fn = self.encode_chunks
         place = False
+        fallback = None
+        if type(self).encode_chunks is ErasureCode.encode_chunks:
+            # bit-exact host oracle for the engine's failure ladder
+            # (zeros-pad linearity holds for the oracle exactly as for
+            # the kernel).  Only the base dense encode qualifies: an
+            # overriding codec's packet/layered pipeline has no dense
+            # generator equivalent, so it keeps retry-only recovery.
+            coding = self.generator[self.k:]
+
+            def fallback(batch, _c=coding):
+                # analysis: allow[blocking] -- host-oracle fallback receives the engine's rebuilt HOST batch (numpy), never a device value
+                return ec_encode_ref(_c, np.asarray(batch))
         if self.runtime == "tpu":
             from ceph_tpu.ops.gf_kernel import _jit_entries
             cache_entries = _jit_entries
@@ -277,7 +289,8 @@ class ErasureCode(ErasureCodeInterface):
                     fn = self._encoder_for_mesh(mesh)
         return engine.submit(key, fn, data,
                              label="ec_encode",
-                             cache_entries=cache_entries, place=place)
+                             cache_entries=cache_entries, place=place,
+                             fallback=fallback)
 
     # -- decode (ErasureCode.cc:198-234 / ErasureCodeIsa.cc:150-310) ----------
 
@@ -460,15 +473,43 @@ class ErasureCode(ErasureCodeInterface):
                     from ceph_tpu.native import ec_encode_native as enc
                 else:
                     enc = ec_encode_ref
-                out = np.zeros((data.shape[0], tb, data.shape[-1]),
-                               dtype=np.uint8)
-                for p in uniq:
-                    rows = np.nonzero(host_pidx == p)[0]
-                    out[rows] = np.asarray(enc(mats[int(p)], data[rows]))
-                return out
+                return self._host_pattern_decode(enc, mats, host_pidx,
+                                                 data, tb)
             from ceph_tpu.ops.gf_kernel import ec_decode_batched
             return ec_decode_batched(snap, pidx, data, k=self.k, t=tb)
         return fn
+
+    @staticmethod
+    def _host_pattern_decode(enc, mats, host_pidx, data, tb):
+        """Group a coalesced decode batch by pattern index and rebuild
+        each group with its padded recovery matrix — THE host decode
+        semantics, shared by the cpu-runtime branch of
+        ``_decode_batch_fn`` and the engine's fallback oracle.  One
+        copy on purpose: the two callers must stay byte-for-byte
+        equivalent or fallback-vs-device bit-exactness silently
+        breaks on the decode channel."""
+        out = np.zeros((data.shape[0], tb, data.shape[-1]),
+                       dtype=np.uint8)
+        for p in np.unique(host_pidx):
+            rows = np.nonzero(host_pidx == p)[0]
+            out[rows] = np.asarray(enc(mats[int(p)], data[rows]))
+        return out
+
+    def _decode_fallback_fn(self, tab: dict, tb: int):
+        """Bit-exact host oracle for one decode table generation — the
+        engine's failure ladder runs it when the device path stays
+        broken: group the coalesced batch by pattern index and rebuild
+        each group with its padded recovery matrix through
+        ``ec_encode_ref`` (exactly the cpu-runtime branch of
+        ``_decode_batch_fn``, which PR 4's tests pin bit-identical to
+        the batched kernel)."""
+        def fb(data, pidx):
+            host_pidx = np.asarray(pidx)
+            data = np.asarray(data)
+            _snap, mats, _live = self._pattern_snapshot(tab)
+            return self._host_pattern_decode(ec_encode_ref, mats,
+                                             host_pidx, data, tb)
+        return fb
 
     def submit_decode_chunks(self, engine, chosen, chunks, targets):
         """Submit an (S, k, B) decode through a dispatch engine
@@ -510,7 +551,8 @@ class ErasureCode(ErasureCodeInterface):
         inner = engine.submit(key, self._decode_batch_fn(tab, tb, stats),
                               data, aux=(pidx,), label="ec_decode",
                               cache_entries=cache_entries,
-                              place=self.runtime == "tpu")
+                              place=self.runtime == "tpu",
+                              fallback=self._decode_fallback_fn(tab, tb))
         if t == tb:
             return inner
         # the batch computes tb target rows per stripe (the bucket);

@@ -82,7 +82,8 @@ class MMgrReport(Message):
                  slow_traces: list | None = None,
                  slow_ops: list | None = None,
                  profile: dict | None = None,
-                 qos: dict | None = None):
+                 qos: dict | None = None,
+                 faults: dict | None = None):
         super().__init__()
         self.osd_id = osd_id
         self.counters = counters or {}
@@ -104,6 +105,11 @@ class MMgrReport(Message):
         #: phase-served counts, wait totals) — rides the SAME v4 JSON
         #: tail as profile, so old peers simply never read it
         self.qos = qos or {}
+        #: device-runtime fault digest (telemetry.fault_digest():
+        #: per-engine breaker states, fallback/retry/probe counters) —
+        #: same v4 JSON tail carriage; the mgr raises KERNEL_DEGRADED
+        #: while any reported channel breaker is not closed
+        self.faults = faults or {}
 
     def encode_payload(self, enc: Encoder):
         enc.versioned(4, 1, lambda e: (
@@ -121,7 +127,8 @@ class MMgrReport(Message):
             e.str(json.dumps({"slow_traces": self.slow_traces,
                               "slow_ops": self.slow_ops,
                               "profile": self.profile,
-                              "qos": self.qos}))))
+                              "qos": self.qos,
+                              "faults": self.faults}))))
 
     def decode_payload(self, dec: Decoder, version):
         # decode constructs via __new__: every field needs a default
@@ -132,6 +139,7 @@ class MMgrReport(Message):
         self.slow_ops = []
         self.profile = {}
         self.qos = {}
+        self.faults = {}
 
         def body(d, v):
             self.osd_id = d.s32()
@@ -151,6 +159,7 @@ class MMgrReport(Message):
                 self.slow_ops = tail.get("slow_ops", [])
                 self.profile = tail.get("profile", {})
                 self.qos = tail.get("qos", {})
+                self.faults = tail.get("faults", {})
         dec.versioned(4, body)
 
 
@@ -517,6 +526,11 @@ class MgrDaemon(Dispatcher):
             return self.insights_feed()
         if data_name == "qos_feed":
             return self.qos_feed()
+        if data_name == "faults_feed":
+            # same cutoff health() applies: a daemon that died (or was
+            # removed) mid-outage must not pin the per-daemon breaker
+            # gauge open on every scrape forever
+            return self.faults_feed(self.REPORT_STALE_AFTER)
         if data_name == "io_samples":
             with self._lock:
                 return {"current": {o: (t, dict(r.counters))
@@ -739,11 +753,50 @@ class MgrDaemon(Dispatcher):
             return {o: dict(r.qos)
                     for o, (_t, r) in self.reports.items() if r.qos}
 
+    def faults_feed(self, stale_after: float | None = None) -> dict:
+        """Per-daemon device-runtime fault digests from the MMgrReport
+        v4 tail (ctx.fault_digest per daemon) — the health
+        KERNEL_DEGRADED and prometheus per-daemon breaker sources.
+        With ``stale_after``, daemons whose last report is older are
+        dropped: retained reports are never pruned, so a daemon that
+        died (or was removed) mid-outage would otherwise pin its open
+        breaker — and the health warning — forever."""
+        now = time.time()
+        with self._lock:
+            return {o: dict(r.faults)
+                    for o, (t, r) in self.reports.items()
+                    if r.faults and (stale_after is None
+                                     or now - t <= stale_after)}
+
+    def _degraded_kernel_channels(self,
+                                  stale_after: float | None = None
+                                  ) -> dict:
+        """osd -> [\"engine/channel\", ...] for every reported channel
+        whose circuit breaker is not closed (the daemon is serving
+        that kernel from the host oracle)."""
+        out: dict[int, list[str]] = {}
+        for osd, digest in self.faults_feed(stale_after).items():
+            degraded = [
+                f"{engine}/{ch}"
+                for engine, d in sorted(digest.items())
+                if isinstance(d, dict)
+                for ch, st in sorted(d.get("breaker_states",
+                                           {}).items())
+                if st != 0]
+            if degraded:
+                out[osd] = degraded
+        return out
+
     #: fraction of existing OSDs that must be exceeded for OSD_DOWN to
     #: escalate from WARN to ERR (mon_osd_down_out semantics reduced)
     OSD_DOWN_ERR_RATIO = 0.5
 
-    def health(self, stale_after: float = 10.0) -> dict:
+    #: seconds after which a daemon's retained report is treated as
+    #: stale (MGR_STALE_REPORTS, and the cutoff for fault attribution:
+    #: a silent daemon is STALE, not degraded-forever)
+    REPORT_STALE_AFTER = 10.0
+
+    def health(self, stale_after: float = REPORT_STALE_AFTER) -> dict:
         """Structured health with severities: each check carries
         severity "warn" or "error"; any error check makes the summary
         HEALTH_ERR (the prometheus module exports 0=OK 1=WARN 2=ERR)."""
@@ -775,6 +828,18 @@ class MgrDaemon(Dispatcher):
         if failed:
             checks.append({"check": "MGR_MODULE_ERROR",
                            "modules": failed, "severity": "error"})
+        # same cutoff MGR_STALE_REPORTS uses: a daemon that stopped
+        # reporting mid-outage shows up as stale, not as degraded
+        degraded_kernels = self._degraded_kernel_channels(stale_after)
+        if degraded_kernels:
+            # a daemon is serving kernel traffic from the host oracle
+            # (open/half-open breaker): data stays correct (bit-exact
+            # degradation) but the accelerator is out — surface it
+            # like any degraded-redundancy state
+            checks.append({"check": "KERNEL_DEGRADED",
+                           "daemons": {str(o): chs for o, chs
+                                       in degraded_kernels.items()},
+                           "severity": "warn"})
         if not checks:
             status = "HEALTH_OK"
         elif any(c["severity"] == "error" for c in checks):

@@ -134,6 +134,7 @@ class Module(MgrModule):
         self._scrape_daemon_perf(exp)
         self._scrape_slow_ops(exp)
         self._scrape_qos(exp)
+        self._scrape_fault_feed(exp)
         self._scrape_kernels(exp)
         self._scrape_dispatch(exp)
         self._scrape_decode_dispatch(exp)
@@ -259,6 +260,34 @@ class Module(MgrModule):
                         "osd_qos_idle_client_timeout sweep",
                         ev.get("classes", 0), {"ceph_daemon": daemon})
 
+    def _scrape_fault_feed(self, exp: Exposition) -> None:
+        """Per-daemon circuit-breaker states from the MMgrReport v4
+        faults tail.  The process-local ``ceph_kernel_breaker_state``
+        family below reads the shared (last-writer-wins) stats sink —
+        fine for one daemon per process, but it cannot attribute
+        degradation across daemons; this family carries each daemon's
+        OWN engine ground truth (ctx.fault_digest overlay), so alerts
+        on an open breaker name the right daemon.  Absent on hosts
+        without the feed (unit stubs)."""
+        try:
+            feed = self.get("faults_feed")
+        except Exception:
+            return
+        for osd, digest in sorted(feed.items()):
+            for engine, d in sorted(digest.items()):
+                if not isinstance(d, dict):
+                    continue
+                for ch, st in sorted(d.get("breaker_states",
+                                           {}).items()):
+                    exp.gauge(
+                        "ceph_kernel_daemon_breaker_state",
+                        "per-daemon per-channel circuit-breaker state "
+                        "from the shipped faults digest: 0 closed "
+                        "(device path live), 1 open (host oracle), "
+                        "2 half-open (probe in flight)",
+                        st, {"ceph_daemon": f"osd.{osd}",
+                             "engine": engine, "channel": ch})
+
     def _scrape_kernels(self, exp: Exposition) -> None:
         reg = telemetry.registry()
         # the two offload kernels always appear (zero-valued before
@@ -302,6 +331,69 @@ class Module(MgrModule):
         d = telemetry.dispatch_dump()
         self._emit_coalesce(exp, d, "ceph_kernel_coalesce")
         self._emit_mesh(exp, d, "encode")
+        self._emit_faults(exp, d, "encode")
+
+    @staticmethod
+    def _emit_faults(exp: Exposition, d: dict, engine: str) -> None:
+        """ceph_kernel_fallback_* / ceph_kernel_breaker_*: the
+        degraded-mode story per dispatch engine — how often the device
+        path failed and was retried, how much traffic the bit-exact
+        host oracle served, each channel's circuit-breaker state
+        (0 closed / 1 open / 2 half-open mid-probe), breaker
+        transitions, background-probe outcomes, and engine run-loop
+        deaths/restarts under thread supervision."""
+        f = d.get("faults", {})
+        lab = {"engine": engine}
+        p = "ceph_kernel_fallback"
+        exp.counter(f"{p}_retries_total",
+                    "device re-attempts of failed coalesced batches "
+                    "(bounded exponential backoff + jitter)",
+                    f.get("retries", 0), lab)
+        exp.counter(f"{p}_retry_successes_total",
+                    "re-attempts that healed the batch on the device",
+                    f.get("retry_successes", 0), lab)
+        exp.counter(f"{p}_batches_total",
+                    "coalesced batches served by the bit-exact host "
+                    "oracle instead of the device",
+                    f.get("fallback_batches", 0), lab)
+        exp.counter(f"{p}_stripes_total",
+                    "stripes those host-oracle batches carried",
+                    f.get("fallback_stripes", 0), lab)
+        for outcome, key in (("success", "probe_successes"),
+                             ("failure", "probe_failures")):
+            exp.counter(f"{p}_probes_total",
+                        "background device-path probes while a "
+                        "breaker was open",
+                        f.get(key, 0), lab | {"outcome": outcome})
+        exp.counter(f"{p}_thread_deaths_total",
+                    "engine run-loop deaths observed by thread "
+                    "supervision",
+                    f.get("thread_deaths", 0), lab)
+        exp.counter(f"{p}_thread_restarts_total",
+                    "run-loops revived (in-flight batches re-fanned)",
+                    f.get("thread_restarts", 0), lab)
+        for transition, key in (("open", "breaker_opens"),
+                                ("close", "breaker_closes")):
+            exp.counter("ceph_kernel_breaker_transitions_total",
+                        "channel circuit-breaker transitions "
+                        "(open = device path abandoned for the host "
+                        "oracle, close = device path healed)",
+                        f.get(key, 0), lab | {"transition": transition})
+        states = f.get("breaker_states", {})
+        for ch in sorted(states):
+            exp.gauge("ceph_kernel_breaker_state",
+                      "per-channel circuit-breaker state: 0 closed "
+                      "(device path live), 1 open (host oracle), "
+                      "2 half-open (probe in flight)",
+                      states[ch], lab | {"channel": ch})
+        if not states:
+            # the family must exist even before any breaker has ever
+            # tripped, so dashboards and the format test can rely on it
+            exp.gauge("ceph_kernel_breaker_state",
+                      "per-channel circuit-breaker state: 0 closed "
+                      "(device path live), 1 open (host oracle), "
+                      "2 half-open (probe in flight)",
+                      0, lab | {"channel": "none"})
 
     @staticmethod
     def _emit_mesh(exp: Exposition, d: dict, engine: str) -> None:
@@ -343,6 +435,7 @@ class Module(MgrModule):
         p = "ceph_kernel_decode_coalesce"
         self._emit_coalesce(exp, d, p)
         self._emit_mesh(exp, d, "decode")
+        self._emit_faults(exp, d, "decode")
         pat = d["patterns"]
         exp.histogram(f"{p}_patterns",
                       "distinct erasure patterns per coalesced decode "

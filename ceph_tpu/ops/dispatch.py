@@ -63,14 +63,22 @@ ops.telemetry).
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from collections import OrderedDict, deque
 
 import numpy as np
 
-from ceph_tpu.common import lockdep
+from ceph_tpu.common import failpoint, lockdep
 from ceph_tpu.ops import telemetry
+
+
+class EngineWedgedError(RuntimeError):
+    """The engine's thread-restart budget is exhausted: every pending
+    and in-flight waiter has been failed with this error, ``flush()``
+    raises it, and new submits run inline (never silently dropped,
+    never hung)."""
 
 
 class DispatchFuture:
@@ -115,6 +123,13 @@ class DispatchFuture:
 
     def _deliver(self, value, exc: BaseException | None) -> None:
         with self._lock:
+            if self._ev.is_set():
+                # first delivery wins: a revived run-loop re-fanning
+                # its batch, or _wedge racing the live completion
+                # thread, must never overwrite a delivered result
+                # (an acked op's value flipping to EngineWedgedError
+                # — or the reverse — after callbacks already fired)
+                return
             self._value = value
             self._exc = exc
             self._ev.set()
@@ -130,11 +145,19 @@ class DispatchFuture:
 class _Request:
     __slots__ = ("key", "fn", "data", "aux", "stripes", "future",
                  "t_submit", "label", "cache_entries", "trace", "span",
-                 "place")
+                 "place", "fallback")
 
     def __init__(self, key, fn, data, stripes, label=None,
-                 cache_entries=None, aux=None, place=True):
+                 cache_entries=None, aux=None, place=True,
+                 fallback=None):
         self.place = place
+        #: bit-exact host-path oracle for this request's kernel channel
+        #: (ec_encode_ref / the host pattern decode / scalar CRUSH /
+        #: the numpy ladder): the supervised-recovery ladder runs it
+        #: when the device path stays broken past the retry budget, and
+        #: an OPEN channel breaker routes batches straight to it.
+        #: Requests sharing a key must agree on it (same submitter).
+        self.fallback = fallback
         self.key = key
         self.fn = fn
         self.data = data
@@ -157,16 +180,20 @@ class _Request:
 
 class _Batch:
     __slots__ = ("out", "reqs", "slices", "exc", "t_dispatch", "misses",
-                 "profile")
+                 "profile", "via_fallback")
 
     def __init__(self, out, reqs, slices, exc=None, t_dispatch=0.0,
-                 misses=None, profile=None):
+                 misses=None, profile=None, via_fallback=False):
         self.out = out
         self.reqs = reqs
         self.slices = slices
         self.exc = exc
         self.t_dispatch = t_dispatch
         self.misses = misses
+        #: the dispatch thread already served this batch from the host
+        #: oracle (open breaker): completion must not re-enter the
+        #: device-retry ladder on its error
+        self.via_fallback = via_fallback
         #: dispatch-side half of the phase ledger (telemetry.PHASES):
         #: monotonic anchors + build/place/launch durations; the
         #: completion thread closes compute/materialize/deliver and
@@ -243,6 +270,30 @@ class _MeshPlacement:
         return jax.device_put(arr, self.sharding(arr.ndim))
 
 
+#: exception classes the retry ladder treats as PERMANENT (programming
+#: errors — shape mismatches, bad operands): retrying cannot help and
+#: the host oracle would fail identically, so they fan immediately
+_PERMANENT_ERRORS = (ValueError, TypeError, KeyError, IndexError,
+                     AttributeError)
+
+
+class _Breaker:
+    """Per-channel circuit breaker state (guarded by the engine cv).
+
+    closed -> open after ``breaker_threshold`` consecutive device-path
+    batch failures (each already past its retry budget); while open
+    (or half-open, mid-probe) batches with a host fallback skip the
+    device entirely; the background probe replays a retained one-stripe
+    sample of the last failed batch and a success re-closes."""
+
+    __slots__ = ("state", "consecutive", "probe")
+
+    def __init__(self):
+        self.state = telemetry.BREAKER_CLOSED
+        self.consecutive = 0
+        self.probe = None        # (fn, data_sample, aux_sample)
+
+
 class DeviceDispatchEngine:
     """Per-CephContext coalescing dispatcher for batched device kernels.
 
@@ -277,7 +328,31 @@ class DeviceDispatchEngine:
         self._inflight: deque[_Batch] = deque()
         self._building = 0          # batches being built/dispatched
         self._stop = False
-        self._threads: list[threading.Thread] = []
+        #: role -> live thread ("submit" dispatches, "complete"
+        #: materializes); supervised — see _thread_main
+        self._threads: dict[str, threading.Thread] = {}
+        # -- fault domain (retry / breaker / supervision knobs; the
+        # context wires them to the kernel_fault_* options) ----------
+        self.fault_max_retries = 2
+        self.fault_backoff_ms = 5.0
+        self.fault_backoff_max_ms = 200.0
+        self.breaker_threshold = 3
+        self.probe_interval = 0.5
+        self.thread_restarts = 4
+        #: a run-loop that stayed healthy this long since its last
+        #: death earns its restart budget back (like the breaker's
+        #: consecutive counter): the budget bounds death STORMS, not
+        #: isolated recovered deaths spread over an engine's lifetime
+        self.thread_restart_window = 300.0
+        #: channel (kernel family label) -> _Breaker, under self._cv
+        self._breakers: dict[str, _Breaker] = {}
+        self._probe_thread: threading.Thread | None = None
+        self._probe_wake = threading.Event()
+        self._deaths: dict[str, int] = {}
+        self._death_t: dict[str, float] = {}
+        self._wedged = False
+        self._wedge_exc: BaseException | None = None
+        self._jitter = random.Random()
 
     # -- mesh -----------------------------------------------------------------
 
@@ -362,42 +437,122 @@ class DeviceDispatchEngine:
     def _ensure_threads(self) -> None:
         if self._threads:
             return
-        for tgt, suffix in ((self._dispatch_loop, "submit"),
-                            (self._complete_loop, "complete")):
-            t = threading.Thread(target=tgt, daemon=True,
-                                 name=f"{self.name}-{suffix}")
-            self._threads.append(t)
+        for role, tgt in (("submit", self._dispatch_loop),
+                          ("complete", self._complete_loop)):
+            t = threading.Thread(target=self._thread_main,
+                                 args=(role, tgt), daemon=True,
+                                 name=f"{self.name}-{role}")
+            self._threads[role] = t
             t.start()
+
+    def _thread_main(self, role: str, tgt) -> None:
+        """Run-loop supervisor: a loop death (failpoint-injected
+        InjectedThreadDeath, or any escaped BaseException) is counted
+        and the loop RE-ENTERED on this thread up to ``thread_restarts``
+        times — the queued requests and in-flight batches stay where
+        they are, so the revived loop re-fans them instead of wedging
+        every waiter.  Past the budget the engine wedges: every pending
+        future is failed with a loud EngineWedgedError and flush()
+        raises it."""
+        while True:
+            try:
+                tgt()
+                return                      # clean exit (stop)
+            except BaseException as e:      # noqa: BLE001 — supervised
+                from ceph_tpu.common.logging import dout
+                with self._cv:
+                    now = time.monotonic()
+                    prev = self._death_t.get(role)
+                    if (prev is not None and now - prev
+                            > float(self.thread_restart_window)):
+                        # healthy since the last death: budget earned
+                        # back — only a death STORM may wedge
+                        self._deaths[role] = 0
+                    self._death_t[role] = now
+                    self._deaths[role] = n = self._deaths.get(role, 0) + 1
+                    revive = (not self._stop
+                              and n <= self.thread_restarts)
+                try:
+                    self.stats.record_thread_death(restarted=revive)
+                except Exception:
+                    pass
+                dout("dispatch", 0,
+                     "%s: %s run-loop died (%d/%d): %r%s", self.name,
+                     role, n, self.thread_restarts, e,
+                     " — reviving" if revive else " — WEDGED")
+                if revive:
+                    continue
+                self._wedge(role, e)
+                return
+
+    def _wedge(self, role: str, cause: BaseException) -> None:
+        """Restart budget exhausted: fail every waiter loudly (a
+        stranded future wedges OSD wpend gates and client ops behind a
+        silent timeout — the exact failure mode this forbids)."""
+        exc = EngineWedgedError(
+            f"{self.name}: {role} thread died "
+            f"{self._deaths.get(role, 0)} times "
+            f"(thread_restarts={self.thread_restarts}); last: {cause!r}")
+        with self._cv:
+            self._wedged = True
+            self._wedge_exc = exc
+            victims = [r.future for r in self._pending]
+            self._pending.clear()
+            self._key_totals.clear()
+            for b in self._inflight:
+                victims.extend(r.future for r in b.reqs)
+            self._inflight.clear()
+            self._cv.notify_all()
+        self._probe_wake.set()
+        for fut in victims:
+            if not fut.done():
+                fut._deliver(None, exc)
 
     def stop(self) -> bool:
         """Drain queued work, then stop both threads.  Returns True
         when both exited; a thread surviving its join timeout (wedged
-        device call) stays in _threads so a later stop() can re-join."""
+        device call) stays in _threads so a later stop() can re-join.
+        On a WEDGED engine every outstanding future has already been
+        failed with EngineWedgedError — stop() returns False so
+        shutdown paths log it."""
         with self._cv:
             self._stop = True
             self._cv.notify_all()
-        for t in self._threads:
+        self._probe_wake.set()
+        for t in list(self._threads.values()):
             t.join(timeout=5.0)
-        self._threads = [t for t in self._threads if t.is_alive()]
-        return not self._threads
+        self._threads = {r: t for r, t in self._threads.items()
+                         if t.is_alive()}
+        pt = self._probe_thread
+        if pt is not None:
+            pt.join(timeout=2.0)
+        return not self._threads and not self._wedged
 
     def flush(self, timeout: float = 10.0) -> bool:
         """Wait for the queues to drain (futures may still be resolving
-        for the last popped batch — wait on them for hard ordering)."""
+        for the last popped batch — wait on them for hard ordering).
+        Raises EngineWedgedError instead of silently timing out when
+        the engine's thread-restart budget is exhausted — a wedged
+        engine can never drain, and the waiters have already been
+        failed with the same error."""
         deadline = time.monotonic() + timeout
         with self._cv:
             while (self._pending or self._building or self._inflight):
+                if self._wedged:
+                    raise self._wedge_exc
                 left = deadline - time.monotonic()
                 if left <= 0:
                     return False
                 self._cv.wait(min(left, 0.05))
+            if self._wedged:
+                raise self._wedge_exc
         return True
 
     # -- submit ---------------------------------------------------------------
 
     def submit(self, key, fn, data, *, label=None,
                cache_entries=None, aux=None,
-               place: bool = True) -> DispatchFuture:
+               place: bool = True, fallback=None) -> DispatchFuture:
         """``aux``: optional tuple of per-stripe side arrays (each with
         the SAME leading axis as ``data``) that coalesce alongside it —
         concatenated per component, edge-padded (last row repeated) to
@@ -410,7 +565,15 @@ class DeviceDispatchEngine:
         ``place=False`` opts this request out of mesh-sharded placement
         (host-runtime fns — numpy/native codecs — would only gather the
         sharded batch straight back).  Requests sharing a key must
-        agree on it (encode the runtime in the key, as the codecs do)."""
+        agree on it (encode the runtime in the key, as the codecs do).
+
+        ``fallback``: optional bit-exact host oracle
+        ``fallback(batch, *aux) -> array`` for this kernel channel.
+        With one, a batch whose device path fails past the bounded
+        retry ladder is served by the oracle instead of fanning the
+        error, and an open channel breaker routes batches straight to
+        it while the background probe retries the device (see the
+        module's failure-domain notes)."""
         # analysis: allow[blocking] -- caller-input normalization: submit() receives host arrays (numpy/bytes), not device values
         data = np.asarray(data)
         stripes = int(data.shape[0]) if data.ndim else 1
@@ -422,9 +585,10 @@ class DeviceDispatchEngine:
                     raise ValueError(
                         f"aux leading axis {a.shape} != stripes {stripes}")
         req = _Request(key, fn, data, stripes, label=label,
-                       cache_entries=cache_entries, aux=aux, place=place)
+                       cache_entries=cache_entries, aux=aux, place=place,
+                       fallback=fallback)
         with self._cv:
-            if not self._stop:
+            if not self._stop and not self._wedged:
                 self._ensure_threads()
                 self._pending.append(req)
                 self._key_totals[req.key] = (
@@ -445,24 +609,36 @@ class DeviceDispatchEngine:
         # completion thread and stranding every outstanding future.
         # Running inline immediately forfeits ordering against the
         # still-queued work, which is strictly better than the wedge.
+        # (A WEDGED engine takes the same inline path: its queues were
+        # already failed and drained, so the wait below is a no-op and
+        # new work is served host-side rather than dropped or hung.)
         me = threading.current_thread()
         with self._cv:
-            if me not in self._threads:
+            if me not in self._threads.values():
                 while self._pending or self._building or self._inflight:
                     self._cv.wait(0.05)
         # inline OUTSIDE the engine lock, so a device call here never
         # serializes concurrent submit()/flush()/stop() callers
         # (and future callbacks never fire under the lock)
-        req.future._deliver(*self._run_inline(fn, data, aux))
+        req.future._deliver(*self._run_inline(fn, data, aux, fallback))
         return req.future
 
     @staticmethod
-    def _run_inline(fn, data, aux=None):
+    def _run_inline(fn, data, aux=None, fallback=None):
         try:
             out = fn(data) if aux is None else fn(data, *aux)
             # analysis: allow[blocking] -- stopped-engine inline fallback materializes deliberately (no pipeline left to stall)
             return np.asarray(out), None
         except BaseException as e:     # noqa: BLE001 — delivered to waiter
+            if fallback is not None and not isinstance(
+                    e, _PERMANENT_ERRORS):
+                try:
+                    out = (fallback(data) if aux is None
+                           else fallback(data, *aux))
+                    # analysis: allow[blocking] -- host-oracle result is already numpy
+                    return np.asarray(out), None
+                except BaseException as e2:  # noqa: BLE001 — to waiter
+                    return None, e2
             return None, e
 
     # -- dispatch thread ------------------------------------------------------
@@ -472,6 +648,10 @@ class DeviceDispatchEngine:
 
     def _dispatch_loop(self) -> None:
         while True:
+            # thread-death injection site: OUTSIDE every handler, so
+            # the raise reaches _thread_main's supervisor (the real
+            # failure this models is a loop bug, not a batch error)
+            failpoint.hit("dispatch.dispatch_thread_death")
             with self._cv:
                 while not self._pending and not self._stop:
                     self._cv.wait()
@@ -540,17 +720,6 @@ class DeviceDispatchEngine:
         """Build the padded batch and issue the device call (runs
         OUTSIDE the engine lock: a first-shape call traces+compiles)."""
         now = time.monotonic()
-        # mesh-sharded engines round the bucket up to a multiple of the
-        # mesh size (every shard non-empty, even NamedSharding split);
-        # place=False requests keep the seed's pure pow-2 bucket, and
-        # 0-d submits (no batch axis to split — padding would have to
-        # concatenate onto a scalar) always run unplaced
-        placement = (self._mesh_placement()
-                     if reqs[0].place and reqs[0].data.ndim else None)
-        devices = placement.devices if placement is not None else 1
-        bucket = (mesh_bucket_stripes(total, devices) if devices > 1
-                  else bucket_stripes(total))
-        pad = bucket - total
         # slices first (pure arithmetic, cannot fail): the completion
         # thread zips reqs against slices, so every request must have
         # one even when the batch build below dies
@@ -561,50 +730,67 @@ class DeviceDispatchEngine:
         exc = None
         out = None
         misses = None
-        # phase ledger (telemetry.PHASES): contiguous monotonic marks —
-        # queue_wait ended at `now`; build/place/launch close below;
-        # the completion thread closes compute/materialize/deliver so
-        # the phase sum reconstructs submit→delivery wall-clock exactly
-        profile = {"t_submit0": reqs[0].t_submit, "t0": now,
-                   "build": 0.0, "place": 0.0, "launch": 0.0,
-                   "t_launch_end": now, "bucket": bucket,
-                   "devices": devices, "stripes": total,
-                   "family": reqs[0].label}
+        profile = None
+        placement = None
+        devices = 1
+        bucket, pad = total, 0
+        via_fallback = False
+        channel = reqs[0].label
         try:
-            # everything fallible — pad allocation / concatenate
-            # (MemoryError under pressure, shape mismatch), span
-            # bookkeeping, the device call itself — lands in exc and
-            # fans to the batch's futures; an exception here must
-            # never kill the dispatch thread (a dead thread strands
-            # every outstanding future and the OSD wpend gates behind
-            # them)
-            arrays = [r.data for r in reqs]
-            if pad:
-                arrays.append(np.zeros((pad,) + reqs[0].data.shape[1:],
-                                       dtype=reqs[0].data.dtype))
-            batch_arr = arrays[0] if len(arrays) == 1 \
-                else np.concatenate(arrays, axis=0)
-            # aux side arrays coalesce in lockstep with data: same
-            # concatenation order.  Padding REPEATS the last row (edge
-            # padding) rather than writing zeros: aux rows are
-            # categorical (the decode's pattern index), and zero rows
-            # would invent category 0 in every padded batch — inflating
-            # the distinct-patterns telemetry and gathering a matrix no
-            # live stripe asked for.  Repeating a real row keeps the
-            # category set exact; the padded DATA rows are still
-            # all-zero, so whatever the repeated row selects computes
-            # zeros that are sliced off before delivery.
-            aux_batch = ()
-            if reqs[0].aux is not None:
-                for j in range(len(reqs[0].aux)):
-                    parts = [r.aux[j] for r in reqs]
-                    if pad:
-                        parts.append(np.repeat(parts[-1][-1:], pad,
-                                               axis=0))
-                    aux_batch += (parts[0] if len(parts) == 1
-                                  else np.concatenate(parts, axis=0),)
+            # EVERYTHING fallible sits inside this try — mesh lookup,
+            # bucketing, breaker routing, the profile dict, pad
+            # allocation / concatenate (MemoryError under pressure,
+            # shape mismatch), span bookkeeping, the device call itself
+            # — and lands in exc to fan to the batch's futures.  An
+            # exception escaping this frame would reach the supervisor
+            # with _building already incremented and the reqs already
+            # partitioned out of _pending: the revived loop could never
+            # re-fan them, flush() would time out silently forever —
+            # the exact silent-wedge failure mode this PR forbids.
+            #
+            # mesh-sharded engines round the bucket up to a multiple of
+            # the mesh size (every shard non-empty, even NamedSharding
+            # split); place=False requests keep the seed's pure pow-2
+            # bucket, and 0-d submits (no batch axis to split — padding
+            # would have to concatenate onto a scalar) always run
+            # unplaced
+            placement = (self._mesh_placement()
+                         if reqs[0].place and reqs[0].data.ndim
+                         else None)
+            devices = placement.devices if placement is not None else 1
+            bucket = (mesh_bucket_stripes(total, devices)
+                      if devices > 1 else bucket_stripes(total))
+            pad = bucket - total
+            # an OPEN (or half-open) breaker routes the batch straight
+            # to the host oracle — no device attempt, no retry ladder;
+            # the background probe owns re-trying the device path
+            via_fallback = (reqs[0].fallback is not None
+                            and self._breaker_routed(channel))
+            if via_fallback:
+                placement = None
+                devices = 1
+                bucket = bucket_stripes(total)
+                pad = bucket - total
+            # phase ledger (telemetry.PHASES): contiguous monotonic
+            # marks — queue_wait ended at `now`; build/place/launch
+            # close below; the completion thread closes compute/
+            # materialize/deliver so the phase sum reconstructs
+            # submit→delivery wall-clock exactly
+            profile = {"t_submit0": reqs[0].t_submit, "t0": now,
+                       "build": 0.0, "place": 0.0, "launch": 0.0,
+                       "t_launch_end": now, "bucket": bucket,
+                       "devices": devices, "stripes": total,
+                       "family": reqs[0].label}
+            batch_arr, aux_batch = self._assemble(reqs, pad)
             t_build_end = time.monotonic()
             profile["build"] = t_build_end - now
+            if not via_fallback:
+                # h2d boundary failpoint: fires for EVERY device-path
+                # batch — on an unmeshed engine the transfer is
+                # implicit in the kernel call, but the fault being
+                # modeled (h2d failure) exists regardless, and chaos
+                # coverage must not silently shrink to meshed hosts
+                failpoint.hit("dispatch.device_put", tag=channel)
             if placement is not None:
                 # device_put with the sharding on dispatch: the batch
                 # (and its aux arrays, in lockstep) split their leading
@@ -637,12 +823,19 @@ class DeviceDispatchEngine:
                             f"build {profile['build'] * 1e3:.3f}ms")
                         tracing.span_event(r.span, f"h2d {r.data.nbytes}B")
             before = None
-            if reqs[0].cache_entries is not None:
+            if reqs[0].cache_entries is not None and not via_fallback:
                 try:
                     before = reqs[0].cache_entries()
                 except Exception:
                     before = None
-            out = reqs[0].fn(batch_arr, *aux_batch)  # async dispatch on jax
+            if via_fallback:
+                # host oracle on the dispatch thread — exactly where a
+                # cpu-runtime fn would run; the result is already host
+                # numpy, so the completion thread's materialize is free
+                out = reqs[0].fallback(batch_arr, *aux_batch)
+            else:
+                failpoint.hit("dispatch.launch", tag=channel)
+                out = reqs[0].fn(batch_arr, *aux_batch)  # async dispatch
             profile["t_launch_end"] = time.monotonic()
             # span bookkeeping + the cache probe sit between place and
             # launch: charge them to launch so the ledger stays gapless
@@ -664,20 +857,37 @@ class DeviceDispatchEngine:
                                    else 0))
             except Exception:
                 pass
+            victims = None
             with self._cv:
                 self._building -= 1
-                self._inflight.append(_Batch(out, reqs, slices, exc,
-                                             t_dispatch=time.monotonic(),
-                                             misses=misses,
-                                             profile=profile))
+                if self._wedged:
+                    # the completion side wedged while this batch was
+                    # building: queueing it would strand its futures
+                    # behind a thread that will never come back
+                    victims = [r.future for r in reqs]
+                else:
+                    self._inflight.append(
+                        _Batch(out, reqs, slices, exc,
+                               t_dispatch=time.monotonic(),
+                               misses=misses, profile=profile,
+                               via_fallback=via_fallback))
                 self.stats.set_in_flight(len(self._inflight)
                                          + self._building)
                 self._cv.notify_all()
+            if victims is not None:
+                for fut in victims:
+                    if not fut.done():
+                        fut._deliver(None, self._wedge_exc)
 
     # -- completion thread ----------------------------------------------------
 
     def _complete_loop(self) -> None:
         while True:
+            # thread-death injection site: outside every handler (see
+            # _dispatch_loop) — the satellite regression this guards:
+            # a dead completion thread used to wedge flush()/stop()
+            # into silent timeouts with every waiter stranded
+            failpoint.hit("dispatch.complete_thread_death")
             with self._cv:
                 while not self._inflight:
                     if (self._stop and not self._pending
@@ -685,6 +895,7 @@ class DeviceDispatchEngine:
                         return
                     self._cv.wait(0.05 if self._stop else None)
                 batch = self._inflight[0]
+            channel = batch.reqs[0].label
             host, exc = None, batch.exc
             t_ready = t_mat = 0.0
             if exc is None:
@@ -696,6 +907,9 @@ class DeviceDispatchEngine:
                     # end, so completion-thread pickup wait (which
                     # overlaps execution under double buffering) is
                     # attributed to compute, keeping the ledger gapless.
+                    if not batch.via_fallback:
+                        failpoint.hit("dispatch.block_until_ready",
+                                      tag=channel)
                     wait = getattr(batch.out, "block_until_ready", None)
                     if wait is not None:
                         try:
@@ -707,31 +921,77 @@ class DeviceDispatchEngine:
                     t_mat = time.monotonic()
                 except BaseException as e:         # noqa: BLE001
                     exc = e
+            # supervised recovery: a failed device-path batch walks the
+            # bounded retry ladder, then the channel's host oracle; a
+            # batch the dispatch thread already served via the oracle
+            # never re-enters (its error is final)
+            if batch.via_fallback:
+                # same rule as the recovery ladder below: the "launch"
+                # anchor timed the host oracle, not a device call —
+                # recording it would let an outage dominate the steady
+                # device phase histograms with host-path runtimes
+                batch.profile = None
+                if exc is None:
+                    total = batch.slices[-1][1] if batch.slices else 0
+                    self.stats.record_fallback(total)
+            elif exc is not None:
+                host, exc, how = self._recover_batch(batch, exc)
+                if how is not None:
+                    batch.profile = None   # phase anchors now span the
+                    # recovery ladder: keep the steady-state ledger
+                    # clean rather than record a fabricated profile
+                    t_ready = t_mat = time.monotonic()
+            else:
+                self._record_device_ok(channel)
             with self._cv:
-                self._inflight.popleft()
+                if self._inflight and self._inflight[0] is batch:
+                    self._inflight.popleft()
                 self.stats.set_in_flight(len(self._inflight)
                                          + self._building)
                 self._cv.notify_all()
             dt = time.monotonic() - batch.t_dispatch
             for req, (a, b) in zip(batch.reqs, batch.slices):
                 if req.span is not None:
-                    from ceph_tpu.common import tracing
-                    if exc is None:
-                        tracing.span_event(req.span,
-                                           f"compute {dt * 1e3:.3f}ms")
-                        tracing.span_event(
-                            req.span, f"d2h {host[a:b].nbytes}B")
-                    attrs = {"kernel": req.label, "batch": len(batch.reqs),
-                             "coalesced": len(batch.reqs) > 1,
-                             "error": exc is not None}
-                    if batch.misses is not None:
-                        attrs["retrace"] = batch.misses > 0
-                    tracing.set_attrs(req.span, **attrs)
-                    tracing.finish_span(req.span)
-                if exc is not None:
-                    req.future._deliver(None, exc)
-                else:
-                    req.future._deliver(host[a:b], None)
+                    # the batch is already popped from _inflight: an
+                    # escaped span-sink error here would revive the
+                    # loop with this batch's remaining futures stranded
+                    # forever — tracing must never wedge completions
+                    try:
+                        from ceph_tpu.common import tracing
+                        if exc is None:
+                            tracing.span_event(req.span,
+                                               f"compute {dt * 1e3:.3f}ms")
+                            tracing.span_event(
+                                req.span, f"d2h {host[a:b].nbytes}B")
+                        attrs = {"kernel": req.label,
+                                 "batch": len(batch.reqs),
+                                 "coalesced": len(batch.reqs) > 1,
+                                 "error": exc is not None}
+                        if batch.misses is not None:
+                            attrs["retrace"] = batch.misses > 0
+                        tracing.set_attrs(req.span, **attrs)
+                        tracing.finish_span(req.span)
+                    except Exception:
+                        pass
+                try:
+                    if exc is not None:
+                        req.future._deliver(None, exc)
+                    else:
+                        req.future._deliver(host[a:b], None)
+                except BaseException as e:  # noqa: BLE001 — see below
+                    # _deliver shields continuations with `except
+                    # Exception` only; one raising past that (SystemExit
+                    # in a done-callback) would escape here AFTER the
+                    # batch was popped — the supervisor would revive the
+                    # loop, but nothing could ever re-fan this batch, so
+                    # its remaining futures would hang forever.  The
+                    # future itself is already resolved (value set
+                    # before callbacks run): log loudly and keep fanning.
+                    from ceph_tpu.common.logging import dout
+                    dout("dispatch", 0,
+                         "%s: continuation for %s raised past Exception"
+                         " (swallowed to protect the batch fan-out): %r",
+                         self.name, req.label, e)
             self.stats.record_complete(len(batch.reqs))
             if exc is None and batch.profile is not None:
                 pr = batch.profile
@@ -752,6 +1012,229 @@ class DeviceDispatchEngine:
                         devices=pr["devices"], misses=batch.misses)
                 except Exception:
                     pass   # profiling must never wedge completions
+
+
+    # -- supervised recovery (retry ladder, breaker, probe) -------------------
+
+    @staticmethod
+    def _assemble(reqs: list[_Request], pad: int):
+        """THE batch-assembly contract, shared by the dispatch path and
+        the recovery ladder (a retried/fallback batch must present the
+        exact layout the original device batch had, or the completion
+        thread's slices lie).  Data pads with zero stripes; aux side
+        arrays coalesce in lockstep with data — same concatenation
+        order — but padding REPEATS the last row (edge padding) rather
+        than writing zeros: aux rows are categorical (the decode's
+        pattern index), and zero rows would invent category 0 in every
+        padded batch — inflating the distinct-patterns telemetry and
+        gathering a matrix no live stripe asked for.  Repeating a real
+        row keeps the category set exact; the padded DATA rows are
+        still all-zero, so whatever the repeated row selects computes
+        zeros that are sliced off before delivery."""
+        arrays = [r.data for r in reqs]
+        if pad:
+            arrays.append(np.zeros((pad,) + reqs[0].data.shape[1:],
+                                   dtype=reqs[0].data.dtype))
+        data = arrays[0] if len(arrays) == 1 \
+            else np.concatenate(arrays, axis=0)
+        aux = ()
+        if reqs[0].aux is not None:
+            for j in range(len(reqs[0].aux)):
+                parts = [r.aux[j] for r in reqs]
+                if pad:
+                    parts.append(np.repeat(parts[-1][-1:], pad, axis=0))
+                aux += (parts[0] if len(parts) == 1
+                        else np.concatenate(parts, axis=0),)
+        return data, aux
+
+    @classmethod
+    def _build_host_batch(cls, reqs: list[_Request]):
+        """Rebuild the padded HOST batch for a retry/fallback run (the
+        original batch may be a device-placed array whose backing
+        devices are exactly what failed).  Pure pow-2 bucket, no
+        placement — recovery runs single-device; every kernel here is
+        bit-exact regardless of sharding."""
+        total = sum(r.stripes for r in reqs)
+        pad = (bucket_stripes(total) - total) if reqs[0].data.ndim else 0
+        return cls._assemble(reqs, pad)
+
+    def _recover_batch(self, batch: _Batch, exc: BaseException):
+        """The failure ladder for one device-path batch: bounded
+        retries with exponential backoff + jitter (transient errors
+        only), then the channel's bit-exact host oracle, then fan the
+        error.  Runs on the completion thread — holding the FIFO head
+        during recovery is exactly the delivery-order contract.
+        Returns (host_result, exc, how) with how in
+        {"retry", "fallback", None}."""
+        reqs = batch.reqs
+        channel = reqs[0].label
+        transient = not isinstance(exc, _PERMANENT_ERRORS)
+        if transient and not self._breaker_routed(channel):
+            for attempt in range(max(0, int(self.fault_max_retries))):
+                delay = min(float(self.fault_backoff_max_ms),
+                            float(self.fault_backoff_ms)
+                            * (2 ** attempt)) / 1e3
+                # jittered exponential backoff: decorrelates retry
+                # storms across engines/channels (Tail at Scale rule)
+                time.sleep(delay * (0.5 + 0.5 * self._jitter.random()))
+                try:
+                    data, aux = self._build_host_batch(reqs)
+                    failpoint.hit("dispatch.launch", tag=channel)
+                    out = reqs[0].fn(data, *aux)
+                    failpoint.hit("dispatch.block_until_ready",
+                                  tag=channel)
+                    wait = getattr(out, "block_until_ready", None)
+                    if wait is not None:
+                        wait()
+                    # analysis: allow[blocking] -- recovery materializes synchronously by design (the pipeline head is already stalled on this batch)
+                    host = np.asarray(out)
+                except BaseException as e:    # noqa: BLE001 — ladder
+                    exc = e
+                    self.stats.record_retry(False)
+                    if isinstance(e, _PERMANENT_ERRORS):
+                        break
+                    continue
+                self.stats.record_retry(True)
+                self._record_device_ok(channel)
+                return host, None, "retry"
+        if transient:
+            self._record_device_failure(channel, reqs)
+        fb = reqs[0].fallback
+        if fb is not None and transient:
+            try:
+                data, aux = self._build_host_batch(reqs)
+                # analysis: allow[blocking] -- host-oracle result is already numpy
+                host = np.asarray(fb(data, *aux))
+            except BaseException as e:        # noqa: BLE001 — to waiters
+                return None, e, None
+            total = batch.slices[-1][1] if batch.slices else 0
+            self.stats.record_fallback(total)
+            return host, None, "fallback"
+        return None, exc, None
+
+    def _breaker_routed(self, channel: str) -> bool:
+        """True while this channel's batches must take the host oracle
+        (breaker open or mid-probe).  Lock-free empty-dict fast path:
+        the common case is no breaker has ever tripped."""
+        if not self._breakers:
+            return False
+        with self._cv:
+            b = self._breakers.get(channel)
+            return (b is not None
+                    and b.state != telemetry.BREAKER_CLOSED)
+
+    def _record_device_ok(self, channel: str) -> None:
+        if not self._breakers:
+            return
+        with self._cv:
+            b = self._breakers.get(channel)
+            if b is None or (b.consecutive == 0
+                             and b.state == telemetry.BREAKER_CLOSED):
+                return
+            b.consecutive = 0
+            changed = b.state != telemetry.BREAKER_CLOSED
+            b.state = telemetry.BREAKER_CLOSED
+            b.probe = None
+        if changed:
+            self.stats.record_breaker(channel,
+                                      telemetry.BREAKER_CLOSED)
+
+    def _record_device_failure(self, channel: str,
+                               reqs: list[_Request]) -> None:
+        """One batch exhausted its device retries.  Past the threshold
+        the channel breaker OPENS: a one-stripe sample of this batch is
+        retained for the background probe, and every later batch with a
+        fallback routes host-side until a probe heals the device."""
+        opened = False
+        with self._cv:
+            b = self._breakers.get(channel)
+            if b is None:
+                b = self._breakers[channel] = _Breaker()
+            b.consecutive += 1
+            if (b.state == telemetry.BREAKER_CLOSED
+                    and reqs[0].fallback is not None
+                    and b.consecutive
+                    >= max(1, int(self.breaker_threshold))):
+                b.state = telemetry.BREAKER_OPEN
+                r0 = reqs[0]
+                sample = (r0.data[:1].copy() if r0.data.ndim
+                          else r0.data.copy())
+                auxs = (None if r0.aux is None
+                        else tuple(a[:1].copy() for a in r0.aux))
+                b.probe = (r0.fn, sample, auxs)
+                opened = True
+        if opened:
+            self.stats.record_breaker(channel, telemetry.BREAKER_OPEN)
+            self._ensure_probe_thread()
+
+    def _ensure_probe_thread(self) -> None:
+        with self._cv:
+            if self._stop or self._wedged:
+                return
+            t = self._probe_thread
+            if t is not None and t.is_alive():
+                return
+            self._probe_wake.clear()
+            t = threading.Thread(target=self._probe_loop, daemon=True,
+                                 name=f"{self.name}-probe")
+            self._probe_thread = t
+            t.start()
+
+    def _probe_loop(self) -> None:
+        """Background device-path probe: while any channel breaker is
+        open, periodically replay its retained one-stripe sample
+        through the device path; success re-closes the breaker and
+        traffic returns to the device on the next flush.  Exits (and
+        is respawned on the next open) once every breaker is closed."""
+        while True:
+            self._probe_wake.wait(max(0.05, float(self.probe_interval)))
+            probes = []
+            with self._cv:
+                if self._stop or self._wedged:
+                    self._probe_thread = None
+                    return
+                for ch, b in self._breakers.items():
+                    if (b.state != telemetry.BREAKER_CLOSED
+                            and b.probe is not None):
+                        b.state = telemetry.BREAKER_HALF_OPEN
+                        probes.append((ch, b, b.probe))
+                if not probes:
+                    self._probe_thread = None
+                    return
+            for ch, b, (fn, data, aux) in probes:
+                self.stats.record_breaker(
+                    ch, telemetry.BREAKER_HALF_OPEN)
+                ok = False
+                try:
+                    failpoint.hit("dispatch.device_put", tag=ch)
+                    failpoint.hit("dispatch.launch", tag=ch)
+                    out = fn(data) if aux is None else fn(data, *aux)
+                    failpoint.hit("dispatch.block_until_ready", tag=ch)
+                    wait = getattr(out, "block_until_ready", None)
+                    if wait is not None:
+                        wait()
+                    # analysis: allow[blocking] -- probe thread materializes its own one-stripe sample; nothing queues behind it
+                    np.asarray(out)
+                    ok = True
+                except Exception:
+                    ok = False
+                self.stats.record_probe(ok)
+                with self._cv:
+                    if b.state == telemetry.BREAKER_HALF_OPEN:
+                        if ok:
+                            b.state = telemetry.BREAKER_CLOSED
+                            b.consecutive = 0
+                            b.probe = None
+                        else:
+                            b.state = telemetry.BREAKER_OPEN
+                    state = b.state
+                self.stats.record_breaker(ch, state)
+
+    def breaker_states(self) -> dict[str, int]:
+        """channel -> telemetry.BREAKER_* for this engine (tests and
+        the thrasher's reconvergence gate)."""
+        with self._cv:
+            return {ch: b.state for ch, b in self._breakers.items()}
 
 
 # ---------------------------------------------------------------------------
@@ -834,8 +1317,16 @@ def submit_flat_firstn(engine: DeviceDispatchEngine, x, ids, weights,
                     (i, w, rw), NamedSharding(mesh, PartitionSpec())))
         return flat_firstn(xs, i, w, rw, numrep=numrep, tries=tries)
 
+    def host_oracle(xs, numrep=numrep, tries=tries):
+        # bit-exact scalar CRUSH (crush.mapper_ref) — the breaker's
+        # host-path degradation for this channel
+        from ceph_tpu.crush.mapper_ref import flat_firstn_ref
+        rows = flat_firstn_ref(np.asarray(xs), ids, weights, reweight,
+                               numrep=numrep, tries=tries)
+        return np.asarray(rows, dtype=np.int32)
+
     return engine.submit(key, fn, np.asarray(x, dtype=np.uint32),
-                         label="crush_firstn")
+                         label="crush_firstn", fallback=host_oracle)
 
 
 def submit_do_rule(engine: DeviceDispatchEngine, mapper, ruleno: int,
@@ -877,8 +1368,28 @@ def submit_do_rule(engine: DeviceDispatchEngine, mapper, ruleno: int,
                     rw, NamedSharding(mesh, PartitionSpec())))
         return mapper.do_rule(ruleno, batch, result_max, rw)
 
+    host_oracle = None
+    cmap = getattr(mapper, "map", None)
+    if cmap is not None:
+        def host_oracle(batch, cmap=cmap):
+            # scalar rule interpreter per lane, NONE-padded to the
+            # batched mapper's row shape (dense prefix for firstn,
+            # positional holes for indep — crush.mapper_jax contract)
+            from ceph_tpu.crush.mapper_ref import crush_do_rule
+            none = 0x7FFFFFFF
+            rw = [int(v) for v in np.asarray(reweight)]
+            out = np.full((np.asarray(batch).shape[0], result_max),
+                          none, dtype=np.int32)
+            for i, x in enumerate(np.asarray(batch)):
+                row = crush_do_rule(cmap, ruleno, int(x), result_max,
+                                    rw)
+                if row:
+                    out[i, :len(row)] = np.asarray(row,
+                                                   dtype=np.int32)
+            return out
+
     return engine.submit(key, fn, np.asarray(xs, dtype=np.uint32),
-                         label="crush_rule")
+                         label="crush_rule", fallback=host_oracle)
 
 
 def submit_finish_ladder(engine: DeviceDispatchEngine, operands, *,
@@ -920,7 +1431,15 @@ def submit_finish_ladder(engine: DeviceDispatchEngine, operands, *,
                     (st, w, af), NamedSharding(mesh, PartitionSpec())))
         return _ladder_jit(operands.erasure)(batch, *aux, st, w, af)
 
+    def host_oracle(batch, *aux, erasure=operands.erasure):
+        # numpy twin of the fused ladder (placement_kernel.ladder_ref):
+        # same packed-row output, bit for bit, no device involved
+        from ceph_tpu.ops.placement_kernel import ladder_ref
+        return ladder_ref(batch, *aux, state, weight, affinity,
+                          erasure=erasure)
+
     from ceph_tpu.ops.placement_kernel import ladder_cache_entries
     return engine.submit(key, fn, operands.raw, aux=operands.aux(),
                          label="pg_finish",
-                         cache_entries=ladder_cache_entries)
+                         cache_entries=ladder_cache_entries,
+                         fallback=host_oracle)

@@ -199,6 +199,158 @@ def _ladder_jit(erasure: bool):
     return jax.jit(functools.partial(_ladder_impl, erasure=erasure))
 
 
+# ---------------------------------------------------------------------------
+# numpy host oracle (the engine's pg_finish fallback channel)
+# ---------------------------------------------------------------------------
+
+_CRUSH_HASH_SEED = 1315423911    # crush/hash.c crush_hash_seed
+
+
+def _mix_np(a, b, c):
+    a = a - b - c; a = a ^ (c >> np.uint32(13))
+    b = b - c - a; b = b ^ (a << np.uint32(8))
+    c = c - a - b; c = c ^ (b >> np.uint32(13))
+    a = a - b - c; a = a ^ (c >> np.uint32(12))
+    b = b - c - a; b = b ^ (a << np.uint32(16))
+    c = c - a - b; c = c ^ (b >> np.uint32(5))
+    a = a - b - c; a = a ^ (c >> np.uint32(3))
+    b = b - c - a; b = b ^ (a << np.uint32(10))
+    c = c - a - b; c = c ^ (b >> np.uint32(15))
+    return a, b, c
+
+
+def _hash32_2_np(a, b):
+    """crush_hash32_2 elementwise on numpy uint32 — the affinity
+    coin-flip hash, host-side (no jax import on this path: the device
+    runtime being broken is exactly when this runs)."""
+    a = np.asarray(a, dtype=np.uint32)
+    b = np.asarray(b, dtype=np.uint32)
+    a, b = np.broadcast_arrays(a, b)
+    h = np.uint32(_CRUSH_HASH_SEED) ^ a ^ b
+    x = np.full(h.shape, 231232, dtype=np.uint32)
+    y = np.full(h.shape, 1232, dtype=np.uint32)
+    a, b, h = _mix_np(a.copy(), b.copy(), h)
+    x, a, h = _mix_np(x, a, h)
+    b, y, h = _mix_np(b, y, h)
+    return h
+
+
+def ladder_ref(raw, pps, raw_len, up_rows, up_len, items, temp_rows,
+               temp_len, ptemp, state, weight, affinity, *,
+               erasure: bool) -> np.ndarray:
+    """Numpy twin of ``_ladder_impl`` — the bit-exact host oracle the
+    dispatch engine degrades the ``pg_finish`` channel to when the
+    device path is out (and the unit tests' ground truth for the
+    fused ladder).  Operand-for-operand and step-for-step the same
+    pipeline; see ``_ladder_impl`` for the semantics commentary."""
+    raw = np.asarray(raw, dtype=np.int32)
+    pps = np.asarray(pps, dtype=np.uint32)
+    raw_len = np.asarray(raw_len, dtype=np.int32)
+    up_rows = np.asarray(up_rows, dtype=np.int32)
+    up_len = np.asarray(up_len, dtype=np.int32)
+    items = np.asarray(items, dtype=np.int32)
+    temp_rows = np.asarray(temp_rows, dtype=np.int32)
+    temp_len = np.asarray(temp_len, dtype=np.int32)
+    ptemp = np.asarray(ptemp, dtype=np.int32)
+    state = np.asarray(state, dtype=np.int32)
+    weight = np.asarray(weight)
+    affinity = np.asarray(affinity, dtype=np.int32)
+
+    n, w = raw.shape
+    m_osd = state.shape[0]
+    iota = np.arange(w, dtype=np.int32)[None, :]
+
+    def in_range(o):
+        return (o >= 0) & (o < m_osd)
+
+    def gather(vec, o):
+        return vec[np.clip(o, 0, m_osd - 1)]
+
+    def exists(o):
+        return in_range(o) & ((gather(state, o) & _OSD_EXISTS) != 0)
+
+    def is_up(o):
+        return in_range(o) & ((gather(state, o) & _OSD_UP) != 0)
+
+    def not_out(o):
+        return in_range(o) & (gather(weight, o) != 0)
+
+    if erasure:
+        base = raw
+        base_len = raw_len
+    else:
+        keep0 = raw != NONE
+        order0 = np.argsort(~keep0, axis=1, kind="stable")
+        base = np.take_along_axis(raw, order0, axis=1)
+        base_len = np.sum(keep0, axis=1).astype(np.int32)
+        base = np.where(iota < base_len[:, None], base, NONE)
+
+    wrow = base
+    base_mask = iota < base_len[:, None]
+    for p in range(items.shape[1]):
+        frm = items[:, p, 0]
+        to = items[:, p, 1]
+        match = base_mask & (wrow == frm[:, None])
+        has = np.any(match, axis=1)
+        to_in = np.any(base_mask & (wrow == to[:, None]), axis=1)
+        cond = has & ~to_in & exists(to) & not_out(to)
+        first = np.argmax(match, axis=1).astype(np.int32)
+        wrow = np.where(cond[:, None] & (iota == first[:, None]),
+                        to[:, None], wrow)
+
+    upmask = iota < up_len[:, None]
+    ent_ok = ~upmask | (exists(up_rows) & not_out(up_rows))
+    allok = np.all(ent_ok, axis=1) & (up_len > 0)
+    row = np.where(allok[:, None], up_rows, wrow)
+    row_len = np.where(allok, up_len, base_len)
+
+    lenmask = iota < row_len[:, None]
+    valid = lenmask & (row != NONE) & exists(row) & is_up(row)
+    if erasure:
+        up = np.where(lenmask, np.where(valid, row, NOSD), NOSD)
+        up_len_o = row_len
+    else:
+        order = np.argsort(~valid, axis=1, kind="stable")
+        up = np.take_along_axis(row, order, axis=1)
+        up_len_o = np.sum(valid, axis=1).astype(np.int32)
+        up = np.where(iota < up_len_o[:, None], up, NOSD)
+    up_real = up != NOSD
+    has_any = np.any(up_real, axis=1)
+    firstj = np.argmax(up_real, axis=1)
+    first_val = np.take_along_axis(up, firstj[:, None], axis=1)[:, 0]
+    up_primary = np.where(has_any, first_val, NOSD)
+
+    aff = np.where(in_range(up), gather(affinity, up),
+                   _MAX_AFFINITY).astype(np.int32)
+    non_default = up_real & (aff != _MAX_AFFINITY)
+    default_all = ~np.any(non_default, axis=1)
+    h = (_hash32_2_np(pps[:, None], up.astype(np.uint32))
+         >> np.uint32(16)).astype(np.int32)
+    win = up_real & ((aff == _MAX_AFFINITY) | (h < aff))
+    has_win = np.any(win, axis=1)
+    wj = np.argmax(win, axis=1)
+    wval = np.take_along_axis(up, wj[:, None], axis=1)[:, 0]
+    prim = np.where(default_all, up_primary,
+                    np.where(has_win, wval, up_primary))
+
+    tset = temp_len > 0
+    acting = np.where(tset[:, None], temp_rows, up)
+    act_len = np.where(tset, temp_len, up_len_o)
+    act_real = acting != NOSD
+    act_has = np.any(act_real, axis=1)
+    aj = np.argmax(act_real, axis=1)
+    act_first = np.where(
+        act_has, np.take_along_axis(acting, aj[:, None], axis=1)[:, 0],
+        NOSD)
+    same = (act_len == up_len_o) & np.all(acting == up, axis=1)
+    ap = np.where(ptemp != NOSD, ptemp,
+                  np.where(same, prim, act_first))
+
+    return np.concatenate(
+        [up, acting, up_len_o[:, None], prim[:, None],
+         act_len[:, None], ap[:, None]], axis=1).astype(np.int32)
+
+
 def ladder_cache_entries() -> int:
     """Compile-cache entries across the fused-ladder entry points — the
     dispatch profiler's retrace/compile probe differences this.  The

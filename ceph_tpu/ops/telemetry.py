@@ -373,6 +373,14 @@ class PhaseStats:
             }
 
 
+#: circuit-breaker states (ceph_kernel_breaker_state gauge values):
+#: closed = device path live, open = routing through the host oracle,
+#: half-open = a background probe is deciding
+BREAKER_CLOSED = 0
+BREAKER_OPEN = 1
+BREAKER_HALF_OPEN = 2
+
+
 class DispatchStats:
     """Counters for the cross-op coalescing engine (ops.dispatch).
 
@@ -396,7 +404,11 @@ class DispatchStats:
                  "coalesce", "queue_delay", "queue_depth",
                  "flush_reasons", "in_flight", "max_in_flight_seen",
                  "sharded_flushes", "devices_used", "shard_stripes",
-                 "mesh_devices", "mesh_dp", "mesh_ec", "phases")
+                 "mesh_devices", "mesh_dp", "mesh_ec", "phases",
+                 "retries", "retry_successes", "fallback_batches",
+                 "fallback_stripes", "breaker_opens", "breaker_closes",
+                 "probe_successes", "probe_failures", "thread_deaths",
+                 "thread_restarts", "breaker_states")
 
     def __init__(self):
         self._lock = lockdep.make_lock("DispatchStats::lock")
@@ -423,6 +435,20 @@ class DispatchStats:
         self.mesh_devices = 0     # gauge: devices in the engine's mesh
         self.mesh_dp = 0          # gauge: mesh dp axis
         self.mesh_ec = 0          # gauge: mesh ec axis
+        # -- fault-domain counters (ops.dispatch supervised recovery) --
+        self.retries = 0          # device re-attempts after a failure
+        self.retry_successes = 0  # re-attempts that healed the batch
+        self.fallback_batches = 0  # batches served by the host oracle
+        self.fallback_stripes = 0  # stripes those batches carried
+        self.breaker_opens = 0    # channel breakers opened
+        self.breaker_closes = 0   # channel breakers re-closed
+        self.probe_successes = 0  # background probes that healed
+        self.probe_failures = 0   # background probes that failed
+        self.thread_deaths = 0    # engine run-loop deaths observed
+        self.thread_restarts = 0  # run-loops revived by supervision
+        #: channel -> BREAKER_* (most recent transition per channel
+        #: across every engine feeding this sink)
+        self.breaker_states: dict[str, int] = {}
 
     def clear(self) -> None:
         """Reset IN PLACE: live engines hold a reference to this object
@@ -442,6 +468,12 @@ class DispatchStats:
             self.devices_used = Histogram(COALESCE_BOUNDS)
             self.shard_stripes = Histogram(BATCH_BOUNDS)
             self.mesh_devices = self.mesh_dp = self.mesh_ec = 0
+            self.retries = self.retry_successes = 0
+            self.fallback_batches = self.fallback_stripes = 0
+            self.breaker_opens = self.breaker_closes = 0
+            self.probe_successes = self.probe_failures = 0
+            self.thread_deaths = self.thread_restarts = 0
+            self.breaker_states = {}
         self.phases.clear()
 
     def record_submit(self, stripes: int) -> None:
@@ -475,6 +507,74 @@ class DispatchStats:
             self.mesh_ec = int(ec)
             self.mesh_devices = int(dp) * int(ec)
 
+    def record_retry(self, success: bool) -> None:
+        """One device re-attempt of a failed batch finished."""
+        with self._lock:
+            self.retries += 1
+            if success:
+                self.retry_successes += 1
+
+    def record_fallback(self, stripes: int) -> None:
+        """One batch was served by the bit-exact host oracle."""
+        with self._lock:
+            self.fallback_batches += 1
+            self.fallback_stripes += stripes
+
+    def record_breaker(self, channel: str, state: int) -> None:
+        """A channel breaker transitioned (BREAKER_* constants)."""
+        with self._lock:
+            prev = self.breaker_states.get(channel, BREAKER_CLOSED)
+            self.breaker_states[channel] = state
+            # opens = CLOSED -> OPEN only (a failed probe's HALF_OPEN
+            # -> OPEN is the SAME outage, not a new one); closes =
+            # any re-entry into CLOSED
+            if state == BREAKER_OPEN and prev == BREAKER_CLOSED:
+                self.breaker_opens += 1
+            elif state == BREAKER_CLOSED and prev != BREAKER_CLOSED:
+                self.breaker_closes += 1
+
+    def record_probe(self, success: bool) -> None:
+        with self._lock:
+            if success:
+                self.probe_successes += 1
+            else:
+                self.probe_failures += 1
+
+    def record_thread_death(self, restarted: bool) -> None:
+        with self._lock:
+            self.thread_deaths += 1
+            if restarted:
+                self.thread_restarts += 1
+
+    def degraded_channels(self) -> list[str]:
+        """Channels currently off the device path (breaker not
+        closed) — the mgr health feed."""
+        with self._lock:
+            return sorted(c for c, s in self.breaker_states.items()
+                          if s != BREAKER_CLOSED)
+
+    def _fault_dict(self) -> dict:
+        """Under self._lock: the ONE fault-counter shape every surface
+        (admin dump, MMgrReport digest, prometheus) serializes — a key
+        added here reaches them all in lockstep."""
+        return {
+            "retries": self.retries,
+            "retry_successes": self.retry_successes,
+            "fallback_batches": self.fallback_batches,
+            "fallback_stripes": self.fallback_stripes,
+            "breaker_opens": self.breaker_opens,
+            "breaker_closes": self.breaker_closes,
+            "probe_successes": self.probe_successes,
+            "probe_failures": self.probe_failures,
+            "thread_deaths": self.thread_deaths,
+            "thread_restarts": self.thread_restarts,
+            "breaker_states": dict(self.breaker_states),
+        }
+
+    def fault_dump(self) -> dict:
+        with self._lock:
+            return self._fault_dict()
+
     def record_complete(self, requests: int) -> None:
         with self._lock:
             self.completed += requests
@@ -506,7 +606,7 @@ class DispatchStats:
                 "mesh_devices": self.mesh_devices,
                 "mesh_dp": self.mesh_dp,
                 "mesh_ec": self.mesh_ec,
-            }
+            } | {"faults": self._fault_dict()}
 
     def summary(self) -> dict:
         """bench.py's digest: amortization in three numbers."""
@@ -911,6 +1011,15 @@ def pipeline_profile_dump(include_recent: bool = True) -> dict:
     return {"encode": _REG.dispatch.phases.dump(include_recent),
             "decode": _REG.decode_dispatch.phases.dump(include_recent),
             "mapping": _REG.mapping.phase_summary()}
+
+
+def fault_digest() -> dict:
+    """Per-engine fault/degradation digest — the MMgrReport v4
+    ``faults`` tail (mgr health raises KERNEL_DEGRADED while any
+    reported channel breaker is not closed), the ``dump_fault_stats``
+    admin payload, and the thrasher chaos gate's reconvergence probe."""
+    return {"encode": _REG.dispatch.fault_dump(),
+            "decode": _REG.decode_dispatch.fault_dump()}
 
 
 def pipeline_profile_digest() -> dict:

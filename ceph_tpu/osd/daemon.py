@@ -602,7 +602,16 @@ class OSDDaemon(Dispatcher):
         for ename, eng in engines:
             if eng is None:
                 continue
-            if not eng.flush(timeout=5.0):
+            try:
+                drained = eng.flush(timeout=5.0)
+            except Exception as e:
+                # a WEDGED engine raises (its waiters were already
+                # failed loudly with EngineWedgedError): shutdown
+                # proceeds — there is nothing left to drain
+                dout("osd", 0, "osd.%d shutdown: %s engine wedged: "
+                     "%r", self.osd_id, ename, e)
+                drained = True
+            if not drained:
                 dout("osd", 0, "osd.%d shutdown: %s engine did "
                      "not drain in 5s — in-flight EC completions may "
                      "land on the unmounted store and be dropped",
@@ -698,7 +707,8 @@ class OSDDaemon(Dispatcher):
             slow_traces=tracing.slow_trace_digests(),
             slow_ops=self.op_tracker.slow_digests(),
             profile=telemetry.pipeline_profile_digest(),
-            qos=self._qos_digest()))
+            qos=self._qos_digest(),
+            faults=self.ctx.fault_digest()))
 
     ROTATING_REFRESH = 60.0
 

@@ -19,6 +19,120 @@ import time
 from ceph_tpu.tools.vstart import MiniCluster
 
 
+class DeviceChaos:
+    """Device-runtime chaos: fires failpoints at the dispatch engine's
+    device boundaries (common/failpoint.py) while the mixed workload
+    runs — the accelerator-side analog of killing OSDs.
+
+    A storm keeps every kernel channel's launch failing at
+    ``BASE_RATE`` (the >=10%% chaos-gate floor: transient faults that
+    the bounded retry ladder must absorb), and each step may also
+    declare a HARD OUTAGE on one channel (mode ``always`` — the
+    breaker must open and the bit-exact host oracle must carry the
+    channel), heal one, arm the device_put / block_until_ready
+    boundaries, or kill an engine run-loop outright (supervision must
+    revive it and re-fan its in-flight batches).  ``clear()`` disarms
+    everything; afterwards every breaker must re-close via the
+    background probes — the reconvergence half of the durability
+    contract."""
+
+    #: the three kernel channels the chaos gate names (encode, decode,
+    #: fused placement ladder); crush channels ride the same machinery
+    CHANNELS = ("ec_encode", "ec_decode", "pg_finish")
+    BASE_RATE = 0.15
+
+    def __init__(self, rng: random.Random):
+        self.rng = rng
+        self.actions = 0
+        #: channels currently under a hard outage (breaker expected
+        #: open while this is non-empty)
+        self.outages: set[str] = set()
+
+    def start(self) -> None:
+        from ceph_tpu.common import failpoint
+        failpoint.seed(self.rng.randrange(1 << 31))
+        for ch in self.CHANNELS:
+            failpoint.set(f"dispatch.launch:{ch}",
+                          f"prob:{self.BASE_RATE}")
+
+    def step(self) -> str:
+        from ceph_tpu.common import failpoint
+        roll = self.rng.random()
+        ch = self.rng.choice(self.CHANNELS)
+        self.actions += 1
+        if roll < 0.22:
+            failpoint.set(f"dispatch.launch:{ch}", "always")
+            self.outages.add(ch)
+            return f"chaos outage {ch}"
+        if roll < 0.5:
+            failpoint.set(f"dispatch.launch:{ch}",
+                          f"prob:{self.BASE_RATE}")
+            self.outages.discard(ch)
+            return f"chaos heal {ch}"
+        if roll < 0.68:
+            site = self.rng.choice(("dispatch.device_put",
+                                    "dispatch.block_until_ready"))
+            failpoint.set(f"{site}:{ch}", f"prob:{self.BASE_RATE}")
+            return f"chaos arm {site}:{ch}"
+        if roll < 0.82:
+            role = self.rng.choice(("dispatch", "complete"))
+            failpoint.set(f"dispatch.{role}_thread_death", "oneshot")
+            return f"chaos kill {role} run-loop"
+        return "chaos noop"
+
+    def clear(self) -> None:
+        from ceph_tpu.common import failpoint
+        failpoint.clear()
+        self.outages.clear()
+
+    @staticmethod
+    def await_reconverged(timeout: float = 20.0,
+                          cluster=None) -> tuple[bool, dict]:
+        """After clear(): wait for every channel breaker to re-close
+        via the background probes.  When a MiniCluster is given, the
+        gate additionally reads each live engine's OWN breaker map:
+        the process-global stats sink is shared by every in-process
+        daemon and keyed by channel only, so daemon B's re-close there
+        is last-writer-wins over daemon A's still-open breaker — the
+        per-engine maps are the ground truth the acceptance gate
+        needs.  Returns (reconverged, final fault digest)."""
+        from ceph_tpu.ops import telemetry
+
+        def engine_states() -> list[int]:
+            if cluster is None:
+                return []
+            states: list[int] = []
+            for osd in list(cluster.osds.values()):
+                ctx = getattr(osd, "ctx", None)
+                # private attrs on purpose: the public accessors
+                # lazily BUILD an engine, and a daemon that never
+                # dispatched has no breakers to wait on
+                for eng in (getattr(ctx, "_dispatch", None),
+                            getattr(ctx, "_decode_dispatch", None)):
+                    if eng is not None:
+                        states.extend(eng.breaker_states().values())
+            return states
+
+        deadline = time.time() + timeout
+        digest: dict = {}
+        while time.time() < deadline:
+            digest = telemetry.fault_digest()
+            if cluster is not None:
+                # live-engine ground truth ONLY: a daemon killed
+                # mid-outage leaves its OPEN as the sink's last write
+                # for that channel forever (its engine is stopped and
+                # can never re-close), which would fail the gate on a
+                # healthy cluster
+                states = engine_states()
+            else:
+                states = [st for d in digest.values()
+                          for st in d.get("breaker_states", {}).values()]
+            if all(st == telemetry.BREAKER_CLOSED for st in states):
+                return True, digest
+            time.sleep(0.25)
+        return False, digest
+
+
 class Workload(threading.Thread):
     """Continuous write/read/delete mix against one pool."""
 
@@ -239,12 +353,21 @@ class Thrasher:
 def run_soak(duration: float = 25.0, seed: int = 7,
              n_osds: int = 6, base_path: str = "",
              ms_type: str = "loopback", n_mons: int = 1,
-             thrash_mons: bool = False) -> dict:
+             thrash_mons: bool = False,
+             device_chaos: bool = False) -> dict:
     """The standalone soak: returns a result dict (the pytest wrapper
     asserts).  OSDs are filestore-backed: kill_osd is PROCESS death with
     the disk surviving, like the reference Thrasher — wiping stores
     faster than recovery completes would lose data in any storage
-    system."""
+    system.
+
+    ``device_chaos=True`` additionally storms the DEVICE runtime
+    (DeviceChaos): failpoints fire at the dispatch engines' device
+    boundaries on every kernel channel while OSDs die around them.
+    The acked-object durability contract is unchanged — a device fault
+    may slow an op (retry ladder) or degrade it host-side (breaker +
+    bit-exact oracle) but never corrupt it — and after the storm every
+    breaker must re-close (reconvergence to the device path)."""
     if not base_path:
         import tempfile
         base_path = tempfile.mkdtemp(prefix="thrash-")
@@ -252,15 +375,30 @@ def run_soak(duration: float = 25.0, seed: int = 7,
     if ms_type == "ici":
         from ceph_tpu.msg.ici import IciTransport
         ici_t = IciTransport.instance()
+    chaos = None
+    osd_conf = {}
+    if device_chaos:
+        # toy pools sit under the osdmap_mapping_min_pgs floor and
+        # would never exercise the fused-ladder device channel: lower
+        # it so pg_finish traffic is real during the storm
+        osd_conf["osdmap_mapping_min_pgs"] = 1
     c = MiniCluster(n_osds=n_osds, ms_type=ms_type,
                     store_type="filestore", n_mons=n_mons,
-                    base_path=base_path, heartbeats=True).start()
+                    base_path=base_path, heartbeats=True,
+                    osd_conf=osd_conf).start()
     try:
         c.wait_for_osd_count(n_osds)
         client = c.client(timeout=20.0)
-        rep = c.create_pool(client, pg_num=8, size=3)
+        # chaos mode runs the fused ladder on these toy pools
+        # (min_pgs=1 above): on a COLD process the first map epoch per
+        # pool pays the ladder's jit trace+compile inside _handle_map
+        # — tens of seconds on a 1-core host — so the epoch wait must
+        # be compile-sized or a cold standalone run flakes at setup
+        ept = 90.0 if device_chaos else 10.0
+        rep = c.create_pool(client, pg_num=8, size=3,
+                            epoch_timeout=ept)
         ec = c.create_pool(client, pg_num=8, pool_type="erasure",
-                           k=2, m=2)
+                           k=2, m=2, epoch_timeout=ept)
         rng = random.Random(seed)
         w1 = Workload(c, rep, "r", random.Random(seed + 1))
         w2 = Workload(c, ec, "e", random.Random(seed + 2),
@@ -269,6 +407,16 @@ def run_soak(duration: float = 25.0, seed: int = 7,
         w2.start()
         th = Thrasher(c, seed=seed, pools={rep: 8, ec: 8},
                       thrash_mons=thrash_mons)
+        if device_chaos:
+            # fault-free warmup first: on a cold process the first ops
+            # PAY the jit compiles (encode kernel, mapper, ladder);
+            # arming failpoints before any op has ever succeeded would
+            # storm an empty pipeline and measure nothing
+            wdl = time.time() + 8.0
+            while w1.ops + w2.ops < 6 and time.time() < wdl:
+                time.sleep(0.25)
+            chaos = DeviceChaos(random.Random(seed + 3))
+            chaos.start()
         deadline = time.time() + duration
         log = []
         health_seen: set[str] = set()
@@ -287,8 +435,18 @@ def run_soak(duration: float = 25.0, seed: int = 7,
 
         while time.time() < deadline:
             log.append(th.step())
+            if chaos is not None:
+                log.append(chaos.step())
             sample_health()
             time.sleep(rng.uniform(0.5, 1.5))
+        reconverged = None
+        fault_digest: dict = {}
+        if chaos is not None:
+            # faults clear BEFORE the heal/verify phase: the storm is
+            # over, the probes must re-close every breaker and traffic
+            # must return to the device path while recovery drains
+            chaos.clear()
+            reconverged, fault_digest = chaos.await_reconverged(cluster=c)
         w1.stop()
         w2.stop()
         w1.join(timeout=30)
@@ -336,16 +494,24 @@ def run_soak(duration: float = 25.0, seed: int = 7,
             "rep_errors": w1.errors, "ec_errors": w2.errors,
             "corruptions": w1.corruptions + w2.corruptions,
             "lost_rep": bad1, "lost_ec": bad2,
+            "chaos_actions": chaos.actions if chaos else 0,
+            "breakers_reconverged": reconverged,
+            "fault_digest": fault_digest,
         }
     finally:
+        if chaos is not None:
+            chaos.clear()   # failpoints are process-global: a failed
+            # soak must never leave them armed for the next test
         c.stop()
 
 
 if __name__ == "__main__":
     import json
     import sys
-    res = run_soak(duration=float(sys.argv[1]) if len(sys.argv) > 1
-                   else 25.0)
+    args = [a for a in sys.argv[1:] if a != "--chaos"]
+    res = run_soak(duration=float(args[0]) if args else 25.0,
+                   device_chaos="--chaos" in sys.argv)
     print(json.dumps({k: v for k, v in res.items() if k != "log"}))
-    sys.exit(1 if (res["corruptions"] or res["lost_rep"]
-                   or res["lost_ec"]) else 0)
+    bad = (res["corruptions"] or res["lost_rep"] or res["lost_ec"]
+           or res["breakers_reconverged"] is False)
+    sys.exit(1 if bad else 0)

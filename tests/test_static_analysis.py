@@ -460,6 +460,133 @@ class TestRegistry:
         assert rep.findings == []
 
 
+# -- thread-except ------------------------------------------------------------
+
+class TestThreadExcept:
+    def test_fires_on_swallowed_base_exception(self, tmp_path):
+        rep = _run(tmp_path, {"m.py": """
+            import threading
+
+            def loop():
+                while True:
+                    try:
+                        step()
+                    except BaseException:
+                        pass
+
+            def step():
+                return 1
+
+            def start():
+                threading.Thread(target=loop, daemon=True).start()
+            """}, checks=("thread-except",))
+        assert [f.code for f in rep.findings] == ["swallow"]
+        assert "loop" in rep.findings[0].message
+
+    def test_fires_on_bare_except_in_thread_subclass_run(self, tmp_path):
+        rep = _run(tmp_path, {"m.py": """
+            import threading
+
+            class Worker(threading.Thread):
+                def run(self):
+                    while True:
+                        try:
+                            self.step()
+                        except:
+                            continue
+
+                def step(self):
+                    return 1
+            """}, checks=("thread-except",))
+        assert [f.code for f in rep.findings] == ["swallow"]
+
+    def test_fires_through_call_graph(self, tmp_path):
+        rep = _run(tmp_path, {"m.py": """
+            import threading
+
+            def loop():
+                helper()
+
+            def helper():
+                try:
+                    work()
+                except BaseException as e:
+                    del e      # bound but never READ: still swallowed
+
+            def work():
+                return 1
+
+            def start():
+                threading.Thread(target=loop).start()
+            """}, checks=("thread-except",))
+        assert [f.code for f in rep.findings] == ["swallow"]
+
+    def test_delivering_and_reraising_handlers_clean(self, tmp_path):
+        rep = _run(tmp_path, {"m.py": """
+            import threading
+
+            def loop():
+                exc = None
+                while True:
+                    try:
+                        step()
+                    except BaseException as e:
+                        exc = e          # delivered to the waiter
+                    try:
+                        step()
+                    except BaseException:
+                        raise            # re-raised to the supervisor
+                    try:
+                        step()
+                    except ValueError:
+                        pass             # narrow catch: normal absorb
+                    try:
+                        step()
+                    except Exception:
+                        continue         # Exception (not Base): fine
+                return exc
+
+            def step():
+                return 1
+
+            def start():
+                threading.Thread(target=loop).start()
+            """}, checks=("thread-except",))
+        assert rep.findings == []
+
+    def test_not_flagged_outside_thread_paths(self, tmp_path):
+        rep = _run(tmp_path, {"m.py": """
+            def plain_helper():
+                try:
+                    work()
+                except BaseException:
+                    pass       # not reachable from any thread body
+
+            def work():
+                return 1
+            """}, checks=("thread-except",))
+        assert rep.findings == []
+
+    def test_inline_suppression(self, tmp_path):
+        rep = _run(tmp_path, {"m.py": """
+            import threading
+
+            def loop():
+                try:
+                    step()
+                except BaseException:  # analysis: allow[thread-except] -- fixture
+                    pass
+
+            def step():
+                return 1
+
+            def start():
+                threading.Thread(target=loop).start()
+            """}, checks=("thread-except",))
+        assert rep.findings == []
+        assert len(rep.suppressed) == 1
+
+
 # -- baseline workflow --------------------------------------------------------
 
 class TestBaseline:
@@ -526,11 +653,14 @@ class TestTreeGate:
         table (a refactor that silently blinds a check family would
         otherwise pass the gate forever)."""
         from ceph_tpu.analysis import blocking, jit_purity, \
-            registry_lint
+            registry_lint, thread_except
         root = os.path.dirname(os.path.abspath(ceph_tpu.__file__))
         idx = core.TreeIndex.build(root)
         assert len(jit_purity._targets(idx)) >= 4
         assert len(blocking._roots(idx)) >= 3
+        # thread run-loop roots: the supervised engine loops, the
+        # probe loop, daemon threads, Thread-subclass run()s
+        assert len(thread_except._thread_roots(idx)) >= 4
         edges = lock_order.build_graph(idx)
         assert len(edges) >= 10
         assert "osdmap_mapping_shared" in \
