@@ -659,10 +659,79 @@ def placement_digest(crush_map, rid: int, bm, reweight: np.ndarray,
 
 
 SECTIONS = ("ec", "crush", "dispatch_sweep", "recovery_sweep",
-            "map_churn", "profile", "qos")
+            "map_churn", "profile", "qos", "scrub")
 #: the historical flagship run (map_churn is opt-in: it is a
 #: consumption-path sweep, not a device-kernel headline)
 DEFAULT_SECTIONS = ("ec", "crush", "dispatch_sweep", "recovery_sweep")
+
+
+def _tenant_queue_rates(profiles, pump_threads, *, service_s,
+                        warmup_s, measure_s, qos_on=True,
+                        extra_pumps=()):
+    """Shared closed-loop tenant-pump harness for the queue-level QoS
+    sweeps (qos_section and scrub_section both drive it — ONE copy,
+    so the 4-tenant scenario cannot drift between them).  Pumps run
+    closed-loop against one ShardedOpQueue whose handler has a FIXED
+    per-op service time (capacity = 1/service_s with one shard
+    worker); ``extra_pumps`` adds (name, klass, threads) pump sets
+    (the scrub storm) on top of the tenant lanes.  Returns
+    (rates, wait_p99) keyed by pump name."""
+    import threading as _th
+
+    from ceph_tpu.osd.op_queue import ClassInfo, ShardedOpQueue
+
+    lock = _th.Lock()
+    names = list(pump_threads) + [n for n, _k, _t in extra_pumps]
+    counts = {n: 0 for n in names}
+    waits: dict = {n: [] for n in names}
+
+    def handler(klass, item, served=None):
+        time.sleep(service_s)
+        name, sem = item
+        with lock:
+            counts[name] += 1
+            if served is not None:
+                waits[name].append(served[1])
+        sem.release()
+
+    wq = ShardedOpQueue(
+        handler, n_shards=1, name="bench-tenants",
+        client_template=ClassInfo(weight=100.0),
+        client_profiles={f"client.{t}": p
+                         for t, p in profiles.items()}
+        if qos_on else None)
+    stop = _th.Event()
+
+    def pump(name, klass):
+        sem = _th.Semaphore(0)
+        while not stop.is_set():
+            wq.enqueue(name, klass, (name, sem))
+            sem.acquire()
+
+    specs = [(t, f"client.{t}" if qos_on else "client", n)
+             for t, n in pump_threads.items()]
+    specs += list(extra_pumps)
+    threads = [_th.Thread(target=pump, args=(n, k), daemon=True)
+               for n, k, cnt in specs for _ in range(cnt)]
+    for t in threads:
+        t.start()
+    time.sleep(warmup_s)
+    with lock:
+        base = dict(counts)
+        for v in waits.values():
+            v.clear()
+    t0 = time.perf_counter()
+    time.sleep(measure_s)
+    with lock:
+        snap = {n: counts[n] - base[n] for n in names}
+        wsnap = {n: sorted(waits[n]) for n in names}
+    elapsed = time.perf_counter() - t0
+    stop.set()
+    wq.shutdown()
+    rates = {n: c / elapsed for n, c in snap.items()}
+    p99 = {n: (w[int(0.99 * (len(w) - 1))] if w else 0.0)
+           for n, w in wsnap.items()}
+    return rates, p99
 
 
 def qos_section(measure_s: float = 2.5, warmup_s: float = 0.8,
@@ -679,9 +748,7 @@ def qos_section(measure_s: float = 2.5, warmup_s: float = 0.8,
     and reports per-tenant throughput + queue-wait p99, the
     reservation attainment, the limit overshoot, and the hog:silver
     excess ratio vs the configured 4.0."""
-    import threading as _th
-
-    from ceph_tpu.osd.op_queue import ClassInfo, ShardedOpQueue
+    from ceph_tpu.osd.op_queue import ClassInfo
 
     profiles = {
         "hog": ClassInfo(weight=8.0),
@@ -692,54 +759,9 @@ def qos_section(measure_s: float = 2.5, warmup_s: float = 0.8,
     pumps = {"hog": 8, "gold": 3, "silver": 4, "bronze": 4}
 
     def run(qos_on: bool) -> dict:
-        lock = _th.Lock()
-        counts = {t: 0 for t in profiles}
-        waits: dict[str, list] = {t: [] for t in profiles}
-
-        def handler(klass, item, served=None):
-            time.sleep(service_s)
-            tenant, sem = item
-            with lock:
-                counts[tenant] += 1
-                if served is not None:
-                    waits[tenant].append(served[1])
-            sem.release()
-
-        wq = ShardedOpQueue(
-            handler, n_shards=1, name="bench-qos",
-            client_template=ClassInfo(weight=100.0),
-            client_profiles={f"client.{t}": p
-                             for t, p in profiles.items()}
-            if qos_on else None)
-        stop = _th.Event()
-
-        def pump(tenant):
-            klass = f"client.{tenant}" if qos_on else "client"
-            sem = _th.Semaphore(0)
-            while not stop.is_set():
-                wq.enqueue(tenant, klass, (tenant, sem))
-                sem.acquire()
-
-        threads = [_th.Thread(target=pump, args=(t,), daemon=True)
-                   for t, n in pumps.items() for _ in range(n)]
-        for t in threads:
-            t.start()
-        time.sleep(warmup_s)
-        with lock:
-            base = dict(counts)
-            for v in waits.values():
-                v.clear()
-        t0 = time.perf_counter()
-        time.sleep(measure_s)
-        with lock:
-            snap = {t: counts[t] - base[t] for t in profiles}
-            wsnap = {t: sorted(waits[t]) for t in profiles}
-        elapsed = time.perf_counter() - t0
-        stop.set()
-        wq.shutdown()
-        rates = {t: snap[t] / elapsed for t in profiles}
-        p99 = {t: (w[int(0.99 * (len(w) - 1))] if w else 0.0)
-               for t, w in wsnap.items()}
+        rates, p99 = _tenant_queue_rates(
+            profiles, pumps, service_s=service_s, warmup_s=warmup_s,
+            measure_s=measure_s, qos_on=qos_on)
         return {"tenant_ops_s": {t: round(r, 1)
                                  for t, r in rates.items()},
                 "tenant_wait_p99_s": {t: round(v, 4)
@@ -764,6 +786,119 @@ def qos_section(measure_s: float = 2.5, warmup_s: float = 0.8,
         "excess_ratio_hog_silver": round(hog_silver, 2),
         "excess_ratio_configured": 4.0,
     }
+
+
+def scrub_section(n_objects: int = 384, obj_bytes: int = 8192,
+                  measure_s: float = 2.0, warmup_s: float = 0.6,
+                  service_s: float = 0.002) -> dict:
+    """Background-integrity sweep (--sections scrub; validated
+    standalone — the full bench exceeds the 590 s budget on this
+    host).  Two sub-sweeps:
+
+    (a) digest throughput: a PG-sized object population digested by
+        the seed's scalar shard_crc loop vs the batched scrub_digest
+        channel through a private dispatch engine (objects/s + MB/s,
+        bit-verified against each other);
+
+    (b) tenant reservation attainment with and without the background
+        class: the qos_section's 4-tenant queue with a continuous
+        scrub pump added — scrub ops riding background_best_effort vs
+        jammed into the aggregate client class vs scrub off — so the
+        number the fairness gate watches (gold's attainment under a
+        scrub storm, relative to the scrub-off baseline) prices the
+        QoS lane directly."""
+    from ceph_tpu.ops.dispatch import (
+        DeviceDispatchEngine, submit_scrub_digest)
+    from ceph_tpu.ops.telemetry import DispatchStats
+    from ceph_tpu.osd.ec_util import shard_crc
+    from ceph_tpu.osd.op_queue import ClassInfo
+
+    rng = np.random.default_rng(11)
+    sizes = rng.integers(obj_bytes // 2, obj_bytes, n_objects)
+    blobs = [rng.integers(0, 256, int(s), dtype=np.uint8).tobytes()
+             for s in sizes]
+    total_bytes = int(sizes.sum())
+
+    # scalar: the seed's per-object host loop
+    t_scalar = float("inf")
+    scalar_crcs = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        scalar_crcs = [shard_crc(b) for b in blobs]
+        t_scalar = min(t_scalar, time.perf_counter() - t0)
+
+    # batched: PG-sized groups through one private engine (the groups
+    # coalesce on the shared width bucket, exactly like concurrent
+    # PG scrubs in the OSD)
+    group = 64
+    eng = DeviceDispatchEngine(name="bench-scrub",
+                               stats=DispatchStats())
+    try:
+        futs = [submit_scrub_digest(
+            eng, blobs[i:i + group])
+            for i in range(0, len(blobs), group)]
+        for f in futs:
+            f.result(timeout=120.0)       # jit warmup outside timing
+        t_batched = float("inf")
+        digs = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            futs = [submit_scrub_digest(eng, blobs[i:i + group])
+                    for i in range(0, len(blobs), group)]
+            digs = np.concatenate(
+                [np.asarray(f.result(timeout=120.0)) for f in futs])
+            t_batched = min(t_batched, time.perf_counter() - t0)
+        verified = all(int(digs[i, 0]) == scalar_crcs[i]
+                       for i in range(len(blobs)))
+        eng_summary = eng.stats.summary()
+    finally:
+        eng.stop()
+
+    digest = {
+        "objects": n_objects,
+        "mbytes": round(total_bytes / 1e6, 2),
+        "scalar_objects_s": round(n_objects / t_scalar, 1),
+        "scalar_mbps": round(total_bytes / t_scalar / 1e6, 1),
+        "batched_objects_s": round(n_objects / t_batched, 1),
+        "batched_mbps": round(total_bytes / t_batched / 1e6, 1),
+        "batched_vs_scalar": round(t_scalar / t_batched, 2),
+        "mean_coalesce": eng_summary["mean_coalesce"],
+        "verified": verified,
+    }
+
+    # -- (b) reservation attainment with/without the background class
+    profiles = {
+        "hog": ClassInfo(weight=8.0),
+        "gold": ClassInfo(reservation=100.0, weight=0.01),
+        "silver": ClassInfo(weight=2.0),
+        "bronze": ClassInfo(weight=8.0, limit=50.0),
+    }
+    pumps = {"hog": 8, "gold": 3, "silver": 4, "bronze": 4}
+
+    def run(scrub_class: str | None) -> dict:
+        extra = (() if scrub_class is None
+                 else (("_scrub", scrub_class, 4),))
+        rates, _p99 = _tenant_queue_rates(
+            profiles, pumps, service_s=service_s, warmup_s=warmup_s,
+            measure_s=measure_s, extra_pumps=extra)
+        rates.setdefault("_scrub", 0.0)
+        return rates
+
+    off = run(None)
+    bg = run("background_best_effort")
+    fg = run("client")    # scrub jammed into the aggregate client lane
+    fairness = {
+        "capacity_ops_s": round(1.0 / service_s, 1),
+        "gold_reservation": 100.0,
+        "attainment_scrub_off": round(off["gold"] / 100.0, 3),
+        "attainment_background": round(bg["gold"] / 100.0, 3),
+        "attainment_client_class": round(fg["gold"] / 100.0, 3),
+        "attainment_vs_off": round(
+            bg["gold"] / max(off["gold"], 1e-9), 3),
+        "scrub_ops_s_background": round(bg["_scrub"], 1),
+        "scrub_ops_s_client_class": round(fg["_scrub"], 1),
+    }
+    return {"digest": digest, "fairness": fairness}
 
 
 def main(argv=None) -> None:
@@ -1009,6 +1144,12 @@ def main(argv=None) -> None:
         # overshoot, and the excess-sharing ratio against the
         # configured weights
         out["qos"] = qos_section()
+
+    if "scrub" in secs:
+        # background integrity: scalar vs batched digest throughput
+        # and tenant reservation attainment under a scrub storm with
+        # vs without the background_best_effort class
+        out["scrub"] = scrub_section()
 
     if "metric" not in out:
         out = {"metric": "sections " + "+".join(sorted(secs)),

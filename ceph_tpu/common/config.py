@@ -222,6 +222,62 @@ register_options([
            "per engine (in-flight batches re-fan to the replacement); "
            "past the budget the engine is wedged: every waiter gets "
            "a loud EngineWedgedError and flush() raises"),
+    Option("osd_scrub_batched", OPT_BOOL, True,
+           "compute scrub-map digests as one coalesced device batch "
+           "per PG through the scrub_digest dispatch channel (crc32 + "
+           "GF shard digest over stacked object/omap rows); off = the "
+           "seed's per-object host shard_crc loop (always the "
+           "fallback when the channel degrades)"),
+    Option("osd_scrub_chunk_timeout", OPT_FLOAT, 15.0,
+           "seconds a scrubbing primary waits for replica scrub maps "
+           "per gather round; peers the osdmap marks down are "
+           "recorded as missing immediately instead of waited out"),
+    Option("osd_scrub_retry_backoff_ms", OPT_FLOAT, 150.0,
+           "backoff before the single MOSDScrub re-request to a "
+           "replica that never answered the first gather round; a "
+           "peer still silent after the retry lands in the report's "
+           "missing_peers and the PG is never reported clean"),
+    Option("osd_scrub_verify_repairs", OPT_BOOL, True,
+           "re-fetch each repaired copy's digest (a follow-up scrub "
+           "of just the repaired oids) before counting it repaired; "
+           "repairs that never verify surface as repair_unverified"),
+    Option("osd_scrub_verify_timeout", OPT_FLOAT, 6.0,
+           "seconds to keep re-checking a pending repair (pushes and "
+           "recovery pulls apply asynchronously) before reporting it "
+           "repair_unverified"),
+    Option("osd_scrub_background_weight", OPT_FLOAT, 1.0,
+           "dmclock weight of the background_best_effort class scrub "
+           "ops schedule in: background integrity shares only excess "
+           "capacity, so a full-cluster deep scrub cannot starve "
+           "tenant reservations"),
+    Option("osd_scrub_background_limit", OPT_FLOAT, 0.0,
+           "ops/s cap on the background_best_effort class (0 = "
+           "unlimited — weight-arbitrated only)"),
+    Option("osd_scrub_cost", OPT_INT, 4,
+           "dmclock cost units one scrub map-build CHUNK charges (the "
+           "delta its background tag advances by): a chunk's bulk "
+           "read + digest batch is still a few small-op service "
+           "times, and without cost scaling the per-op scheduler "
+           "would hand the background class cost-times its weight's "
+           "worth of worker-seconds"),
+    Option("osd_scrub_chunk_objects", OPT_INT, 16,
+           "store objects per scrub map-build chunk (chunky scrub): "
+           "each background lane op reads+digests at most this many "
+           "objects, so scrub's non-preemptive service quantum stays "
+           "small-op sized and a tenant op never waits out a "
+           "whole-PG map build"),
+    Option("osd_scrub_sleep", OPT_FLOAT, 0.004,
+           "seconds between scrub map-build chunks (the reference's "
+           "osd_scrub_sleep, implemented as a delayed requeue so "
+           "neither a shard worker nor an engine thread parks): "
+           "paces the storm's python-side work so continuous deep "
+           "scrub rides the excess instead of contending for the "
+           "serving threads; 0 = no pacing"),
+    Option("osd_scrub_auto_interval", OPT_FLOAT, 0.0,
+           "seconds between automatic full deep-scrub sweeps "
+           "(scrub_all_pgs) this osd starts for the PGs it leads; "
+           "0 disables the continuous driver (manual/admin scrubs "
+           "only)"),
     Option("client_resend_backoff_ms", OPT_FLOAT, 25.0,
            "base backoff in milliseconds before an Objecter resend "
            "of an already-resent in-flight op (map-change/stale-epoch "

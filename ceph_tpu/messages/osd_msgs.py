@@ -583,51 +583,78 @@ class MWatchNotifyAck(Message):
 @register_message
 class MOSDScrub(Message):
     """primary -> replica: send your scrub map for this PG
-    (MOSDRepScrub analog)."""
+    (MOSDRepScrub analog).  v2 adds an optional oid filter so the
+    verified-repair pass can re-fetch JUST the repaired objects'
+    digests instead of re-scrubbing the whole collection; old peers
+    (compat 1) skip the field and reply with the full map, which the
+    primary filters — correct either way."""
 
     TYPE = 120
+    HEAD_VERSION = 2
 
     def __init__(self, pgid: tuple[int, int] = (0, 0), scrub_id: int = 0,
-                 from_osd: int = 0):
+                 from_osd: int = 0, oids: list[str] | None = None):
         super().__init__()
         self.pgid = pgid
         self.scrub_id = scrub_id
         self.from_osd = from_osd
+        #: None = map the whole collection; a list restricts the map
+        #: to exactly these store oids (repair verification)
+        self.oids = oids
 
     def encode_payload(self, enc):
-        enc.versioned(1, 1, lambda e: (
+        enc.versioned(2, 1, lambda e: (
             _enc_pgid(e, self.pgid), e.u64(self.scrub_id),
-            e.s32(self.from_osd)))
+            e.s32(self.from_osd),
+            e.u8(0 if self.oids is None else 1),
+            e.list(self.oids or [], lambda e2, o: e2.str(o))))
 
     def decode_payload(self, dec, version):
         def body(d, v):
             self.pgid = _dec_pgid(d)
             self.scrub_id = d.u64()
             self.from_osd = d.s32()
-        dec.versioned(1, body)
+            self.oids = None
+            if v >= 2:
+                has = d.u8()
+                lst = d.list(lambda d2: d2.str())
+                self.oids = lst if has else None
+        dec.versioned(2, body)
 
 
 @register_message
 class MOSDScrubReply(Message):
-    """replica -> primary: {oid: (size, data_crc, omap_crc)}."""
+    """replica -> primary: {oid: (size, data_crc, omap_crc)}.  v2 adds
+    the per-oid version blobs ("_v" attrs): scrub maps are gathered
+    seconds apart under load, so the primary must distinguish
+    SAME-VERSION divergence (corruption — repair it) from
+    version-skewed divergence (an in-flight write or recovery — the
+    replication machinery owns it; a scrub repair there would push a
+    stale copy over an acked newer write)."""
 
     TYPE = 121
+    HEAD_VERSION = 2
 
     def __init__(self, pgid: tuple[int, int] = (0, 0), scrub_id: int = 0,
-                 from_osd: int = 0, scrub_map: dict | None = None):
+                 from_osd: int = 0, scrub_map: dict | None = None,
+                 versions: dict | None = None):
         super().__init__()
         self.pgid = pgid
         self.scrub_id = scrub_id
         self.from_osd = from_osd
         self.scrub_map = scrub_map or {}
+        #: oid -> raw "_v" blob (b"" for objects without one)
+        self.versions = versions or {}
 
     def encode_payload(self, enc):
-        enc.versioned(1, 1, lambda e: (
+        enc.versioned(2, 1, lambda e: (
             _enc_pgid(e, self.pgid), e.u64(self.scrub_id),
             e.s32(self.from_osd),
             e.map(self.scrub_map, lambda e2, k: e2.str(k),
                   lambda e2, t: (e2.u64(t[0]), e2.u32(t[1]),
-                                 e2.u32(t[2])))))
+                                 e2.u32(t[2]))),
+            e.map(self.versions, lambda e2, k: e2.str(k),
+                  lambda e2, v: e2.bytes(v))))
 
     def decode_payload(self, dec, version):
         def body(d, v):
@@ -637,4 +664,8 @@ class MOSDScrubReply(Message):
             self.scrub_map = d.map(
                 lambda d2: d2.str(),
                 lambda d2: (d2.u64(), d2.u32(), d2.u32()))
-        dec.versioned(1, body)
+            self.versions = {}
+            if v >= 2:
+                self.versions = d.map(lambda d2: d2.str(),
+                                      lambda d2: d2.bytes())
+        dec.versioned(2, body)

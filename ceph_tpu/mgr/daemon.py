@@ -69,10 +69,13 @@ class MMgrReport(Message):
     The tail is a JSON dict, so the profile key rides the SAME v4
     frame — old peers simply never read it.  Older peers
     interoperate: the versioned section skips trailing fields (old
-    mgrs simply never see the v4 tail)."""
+    mgrs simply never see the v4 tail).  v5 adds the scrub key to the
+    tail — the per-daemon background-integrity digest
+    (``_scrub_digest_report``) feeding the mgr scrub_feed and the
+    ``ceph_scrub_*`` prometheus families."""
 
     TYPE = 0x701
-    HEAD_VERSION = 4
+    HEAD_VERSION = 5
     COMPAT_VERSION = 1
 
     def __init__(self, osd_id: int = 0, counters: dict | None = None,
@@ -83,7 +86,8 @@ class MMgrReport(Message):
                  slow_ops: list | None = None,
                  profile: dict | None = None,
                  qos: dict | None = None,
-                 faults: dict | None = None):
+                 faults: dict | None = None,
+                 scrub: dict | None = None):
         super().__init__()
         self.osd_id = osd_id
         self.counters = counters or {}
@@ -110,9 +114,12 @@ class MMgrReport(Message):
         #: same v4 JSON tail carriage; the mgr raises KERNEL_DEGRADED
         #: while any reported channel breaker is not closed
         self.faults = faults or {}
+        #: per-daemon background-integrity counters (deep scrub /
+        #: verified repair; v5 tail key) — the scrub_feed source
+        self.scrub = scrub or {}
 
     def encode_payload(self, enc: Encoder):
-        enc.versioned(4, 1, lambda e: (
+        enc.versioned(5, 1, lambda e: (
             e.s32(self.osd_id),
             e.map(self.counters, lambda e2, k: e2.str(k),
                   lambda e2, v: e2.u64(int(v))),
@@ -128,7 +135,8 @@ class MMgrReport(Message):
                               "slow_ops": self.slow_ops,
                               "profile": self.profile,
                               "qos": self.qos,
-                              "faults": self.faults}))))
+                              "faults": self.faults,
+                              "scrub": self.scrub}))))
 
     def decode_payload(self, dec: Decoder, version):
         # decode constructs via __new__: every field needs a default
@@ -140,6 +148,7 @@ class MMgrReport(Message):
         self.profile = {}
         self.qos = {}
         self.faults = {}
+        self.scrub = {}
 
         def body(d, v):
             self.osd_id = d.s32()
@@ -160,7 +169,8 @@ class MMgrReport(Message):
                 self.profile = tail.get("profile", {})
                 self.qos = tail.get("qos", {})
                 self.faults = tail.get("faults", {})
-        dec.versioned(4, body)
+                self.scrub = tail.get("scrub", {})
+        dec.versioned(5, body)
 
 
 @register_message
@@ -526,6 +536,8 @@ class MgrDaemon(Dispatcher):
             return self.insights_feed()
         if data_name == "qos_feed":
             return self.qos_feed()
+        if data_name == "scrub_feed":
+            return self.scrub_feed()
         if data_name == "faults_feed":
             # same cutoff health() applies: a daemon that died (or was
             # removed) mid-outage must not pin the per-daemon breaker
@@ -752,6 +764,15 @@ class MgrDaemon(Dispatcher):
         with self._lock:
             return {o: dict(r.qos)
                     for o, (_t, r) in self.reports.items() if r.qos}
+
+    def scrub_feed(self) -> dict:
+        """Per-daemon background-integrity counters from the
+        MMgrReport v5 tail: osd -> {objects_scrubbed, inconsistent,
+        repaired, repair_unverified, ...} — the prometheus
+        ceph_scrub_* source and the insights integrity row."""
+        with self._lock:
+            return {o: dict(r.scrub)
+                    for o, (_t, r) in self.reports.items() if r.scrub}
 
     def faults_feed(self, stale_after: float | None = None) -> dict:
         """Per-daemon device-runtime fault digests from the MMgrReport

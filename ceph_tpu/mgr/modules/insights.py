@@ -55,6 +55,12 @@ class Module(MgrModule):
         {"prefix": "profile top",
          "help": "top-N (engine, kernel, phase) stalls by "
                  "cluster-total seconds (limit=<n>)"},
+        {"prefix": "integrity",
+         "help": "cluster-wide background-integrity rollup: per-osd "
+                 "deep-scrub counters (objects checked, batched vs "
+                 "scalar digests, inconsistencies found, repairs "
+                 "verified/unverified, missing-peer scrubs) and the "
+                 "cluster totals"},
     ]
 
     # -- aggregation ----------------------------------------------------------
@@ -218,6 +224,27 @@ class Module(MgrModule):
         rows.sort(key=lambda r: -r["seconds"])
         return rows[:limit]
 
+    # -- background integrity -------------------------------------------------
+
+    def integrity(self) -> dict:
+        """Cluster-wide scrub rollup from the MMgrReport v5 scrub
+        tail: per-daemon counters plus summed totals.  The headline
+        invariant the operator watches: ``repair_unverified`` stays 0
+        — every repair the scrub path fired had its digest re-fetched
+        and matched."""
+        try:
+            feed = self.get("scrub_feed")
+        except Exception:
+            feed = {}
+        totals: dict = {}
+        per_osd = {}
+        for osd, entry in sorted(feed.items()):
+            per_osd[f"osd.{osd}"] = dict(entry)
+            for k, v in entry.items():
+                if isinstance(v, (int, float)):
+                    totals[k] = totals.get(k, 0) + v
+        return {"totals": totals, "per_osd": per_osd}
+
     # -- command tier ---------------------------------------------------------
 
     def handle_command(self, cmd: dict) -> tuple[str, int]:
@@ -241,4 +268,6 @@ class Module(MgrModule):
         if prefix == "profile top":
             limit = int(cmd.get("limit", 10))
             return json.dumps({"stalls": self.profile_top(limit)}), 0
+        if prefix == "integrity":
+            return json.dumps(self.integrity()), 0
         return f"module {self.NAME} has no command {prefix!r}", -22

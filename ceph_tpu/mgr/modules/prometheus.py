@@ -134,6 +134,7 @@ class Module(MgrModule):
         self._scrape_daemon_perf(exp)
         self._scrape_slow_ops(exp)
         self._scrape_qos(exp)
+        self._scrape_scrub(exp)
         self._scrape_fault_feed(exp)
         self._scrape_kernels(exp)
         self._scrape_dispatch(exp)
@@ -259,6 +260,49 @@ class Module(MgrModule):
                         "idle dynamic lanes evicted by the "
                         "osd_qos_idle_client_timeout sweep",
                         ev.get("classes", 0), {"ceph_daemon": daemon})
+
+    def _scrape_scrub(self, exp: Exposition) -> None:
+        """ceph_scrub_*: per-daemon background-integrity counters from
+        the MMgrReport v5 scrub tail — how much each OSD's deep scrub
+        checked, how the digests were computed (batched device calls
+        vs scalar fallbacks), and the found/repaired/unverified
+        ledger.  A non-zero ceph_scrub_repair_unverified_total is the
+        alert: a repair was fired whose re-fetched digest never
+        matched."""
+        try:
+            feed = self.get("scrub_feed")
+        except Exception:
+            return
+        families = {
+            "sweeps": ("ceph_scrub_sweeps_total",
+                       "full scrub_all_pgs sweeps completed"),
+            "pgs_scrubbed": ("ceph_scrub_pgs_total",
+                             "PG deep-scrub chunks completed"),
+            "objects_scrubbed": ("ceph_scrub_objects_total",
+                                 "objects deep-scrubbed"),
+            "digest_batches": ("ceph_scrub_digest_batches_total",
+                               "coalesced scrub_digest device batches"),
+            "digest_objects": ("ceph_scrub_digest_objects_total",
+                               "object/omap rows digested in batched "
+                               "device calls"),
+            "scalar_fallbacks": ("ceph_scrub_scalar_fallbacks_total",
+                                 "scrub maps that fell back to the "
+                                 "scalar shard_crc loop"),
+            "inconsistent": ("ceph_scrub_inconsistent_total",
+                             "inconsistent objects/shards found"),
+            "repaired": ("ceph_scrub_repaired_total",
+                         "repairs whose re-fetched digest VERIFIED"),
+            "repair_unverified": ("ceph_scrub_repair_unverified_total",
+                                  "repairs fired but never verified "
+                                  "within osd_scrub_verify_timeout"),
+            "missing_peer_scrubs": ("ceph_scrub_missing_peer_total",
+                                    "scrubs with a replica map "
+                                    "missing (PG not reported clean)"),
+        }
+        for osd, entry in sorted(feed.items()):
+            lab = {"ceph_daemon": f"osd.{osd}"}
+            for key, (fam, help_) in families.items():
+                exp.counter(fam, help_, entry.get(key, 0), lab)
 
     def _scrape_fault_feed(self, exp: Exposition) -> None:
         """Per-daemon circuit-breaker states from the MMgrReport v4

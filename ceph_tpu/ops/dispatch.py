@@ -1443,3 +1443,47 @@ def submit_finish_ladder(engine: DeviceDispatchEngine, operands, *,
                          label="pg_finish",
                          cache_entries=ladder_cache_entries,
                          fallback=host_oracle)
+
+
+def submit_scrub_digest(engine: DeviceDispatchEngine, blobs,
+                        key=None) -> DispatchFuture:
+    """Submit a batch of byte blobs (object payloads / omap blobs) for
+    integrity digesting through the engine — the FIFTH kernel channel
+    (``scrub_digest``), with everything the other four have: a
+    bit-exact host oracle (the literal ``shard_crc`` loop), the
+    device-boundary failpoint sites (which fire by channel tag with no
+    extra code here), the bounded retry ladder, and a per-channel
+    circuit breaker.  Returns a DispatchFuture of (len(blobs), 2)
+    uint32 — col 0 crc32 (== ``osd.ec_util.shard_crc``), col 1 the
+    packed GF shard digest.
+
+    Rows zero-pad to a shared pow-2 width (checksum_kernel.row_width)
+    and the key is just that width, so concurrent scrubs of DIFFERENT
+    PGs — or different daemons in one context — coalesce into one
+    device call; the per-row unpad operands (the crc Z^-pad matrix
+    columns and the GF alpha^-t lane multipliers) ride the aux channel
+    in lockstep, which is what makes zero-padding bit-exact here
+    despite crc32 not being linear in the padded row."""
+    from ceph_tpu.ops import checksum_kernel as ck
+    lengths = np.array([len(b) for b in blobs], dtype=np.int64)
+    w = ck.row_width(int(lengths.max()) if len(blobs) else 0)
+    data = np.zeros((len(blobs), w), dtype=np.uint8)
+    for i, b in enumerate(blobs):
+        if len(b):
+            data[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+    mats, invp = ck.digest_operands(lengths, w)
+    if key is None:
+        key = ("scrub_digest", w)
+
+    def fn(batch, lens, m, p):
+        from ceph_tpu.ops.checksum_kernel import scrub_digest_batched
+        return scrub_digest_batched(batch, m, p)
+
+    def host_oracle(batch, lens, m, p):
+        from ceph_tpu.ops.checksum_kernel import scrub_digest_ref
+        return scrub_digest_ref(batch, lens)
+
+    return engine.submit(key, fn, data, aux=(lengths, mats, invp),
+                         label="scrub_digest",
+                         cache_entries=ck.digest_jit_entries,
+                         fallback=host_oracle)

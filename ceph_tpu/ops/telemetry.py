@@ -879,6 +879,62 @@ class MappingStats:
             }
 
 
+class ScrubStats:
+    """Background-integrity counters (deep scrub + verified repair).
+
+    Process-global like the dispatch sinks: every OSD in the process
+    folds its scrub accounting in (the per-daemon copies feed
+    ``dump_scrub_stats`` and the ``ceph_scrub_*`` prometheus families
+    through the MMgrReport tail), so this sink is the cluster-wide
+    roll-up the thrasher's scrub-storm gate and bench.py poll —
+    "every injected corruption detected and repaired" is a claim
+    about the whole MiniCluster, not one daemon."""
+
+    #: the counter vocabulary (unknown keys are still accepted — the
+    #: sink must never make a daemon's accounting throw)
+    FIELDS = ("sweeps", "pgs_scrubbed", "objects_scrubbed",
+              "digest_batches", "digest_objects", "scalar_fallbacks",
+              "inconsistent", "repaired", "repair_unverified",
+              "missing_peer_scrubs", "missing_peer_retries")
+
+    def __init__(self):
+        self._lock = lockdep.make_lock("ScrubStats::lock")
+        self._counts: dict[str, int] = {f: 0 for f in self.FIELDS}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + int(n)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counts = {f: 0 for f in self.FIELDS}
+
+    def dump(self) -> dict:
+        with self._lock:
+            return dict(self._counts)
+
+    def summary(self) -> dict:
+        """bench.py / thrasher digest: the integrity story in a few
+        numbers — how much was checked, how it was digested (batched
+        vs scalar), and whether every found inconsistency ended in a
+        VERIFIED repair."""
+        with self._lock:
+            c = dict(self._counts)
+        batched = c.get("digest_objects", 0)
+        scalar_batches = c.get("scalar_fallbacks", 0)
+        return {
+            "objects_scrubbed": c.get("objects_scrubbed", 0),
+            "pgs_scrubbed": c.get("pgs_scrubbed", 0),
+            "digest_batches": c.get("digest_batches", 0),
+            "batched_digest_objects": batched,
+            "scalar_fallback_batches": scalar_batches,
+            "inconsistent": c.get("inconsistent", 0),
+            "repaired": c.get("repaired", 0),
+            "repair_unverified": c.get("repair_unverified", 0),
+            "missing_peer_scrubs": c.get("missing_peer_scrubs", 0),
+        }
+
+
 class KernelTelemetry:
     """The registry: one KernelStats per kernel name."""
 
@@ -888,6 +944,7 @@ class KernelTelemetry:
         self.dispatch = DispatchStats()
         self.decode_dispatch = DecodeDispatchStats()
         self.mapping = MappingStats()
+        self.scrub = ScrubStats()
         #: block_until_ready before closing each latency sample
         self.fence_for_timing = False
         #: master switch; off-path cost when False is one attribute read
@@ -914,6 +971,7 @@ class KernelTelemetry:
         self.dispatch.clear()
         self.decode_dispatch.clear()
         self.mapping.clear()
+        self.scrub.clear()
 
     def summary(self) -> dict:
         """Compact digest (bench.py prints this next to its JSON)."""
@@ -982,6 +1040,22 @@ def decode_dispatch_dump() -> dict:
 
 def decode_dispatch_summary() -> dict:
     return _REG.decode_dispatch.summary()
+
+
+def scrub_stats() -> ScrubStats:
+    """The process-global background-integrity counters: every OSD's
+    scrub path feeds this alongside its own per-daemon accounting;
+    the thrasher's scrub-storm gate and bench.py's scrub section read
+    the cluster-wide roll-up here."""
+    return _REG.scrub
+
+
+def scrub_dump() -> dict:
+    return _REG.scrub.dump()
+
+
+def scrub_summary() -> dict:
+    return _REG.scrub.summary()
 
 
 def mapping_stats() -> MappingStats:

@@ -63,7 +63,8 @@ from ceph_tpu.objectstore import Transaction, create_objectstore
 from ceph_tpu.osd.map_codec import advance_map, encode_osdmap
 from ceph_tpu.osd.osdmap import CEPH_NOSD, OSDMap, pg_to_pgid
 from ceph_tpu.qos.dmclock import (
-    PHASE_LIMIT, PHASE_NAMES, PHASE_RESERVATION, PHASE_WEIGHT)
+    BACKGROUND_BEST_EFFORT, PHASE_LIMIT, PHASE_NAMES, PHASE_NONE,
+    PHASE_RESERVATION, PHASE_WEIGHT)
 from ceph_tpu.client.rados import ceph_str_hash_rjenkins
 from ceph_tpu.osd.pg import (
     EVERSION_ZERO, LOG_DELETE, LOG_MODIFY, PG, LogEntry, MissingItem,
@@ -183,6 +184,30 @@ RECOVERY_CLIENT = 0xFFFFFFFF00000000
 
 #: reqid client for the tier agent's guarded evict deletes
 TIER_AGENT_CLIENT = 0xFFFFFFFF00000001
+
+
+class _ScrubChunk:
+    """Queue item for one background deep-scrub chunk (one PG's
+    scrub): shaped like a message for the opwq handler's getattr
+    probes (trace/qos tags), so a sweep's chunks ride the sharded
+    mClock queue in the background_best_effort class like any op."""
+
+    __slots__ = ("pgid", "trace_id", "parent_span_id", "_qos_phase",
+                 "qos_delta", "qos_rho")
+
+    def __init__(self, pgid: tuple[int, int], cost: int = 1):
+        self.pgid = pgid
+        self.trace_id = 0
+        self.parent_span_id = 0
+        #: stamped by the opwq handler with the dmclock phase served
+        self._qos_phase = PHASE_NONE
+        #: dmclock cost scaling (osd_scrub_cost): a scrub map build is
+        #: many small-op service times, so its weight tag advances by
+        #: that many units per op — without this the per-op scheduler
+        #: would hand the background class cost-times its weight's
+        #: worth of worker-seconds
+        self.qos_delta = max(1, int(cost))
+        self.qos_rho = 0
 
 
 class OSDDaemon(Dispatcher):
@@ -324,9 +349,16 @@ class OSDDaemon(Dispatcher):
                      .add_u64("qos_reservation_served")
                      .add_u64("qos_weight_served")
                      .add_u64("qos_limit_served")
+                     .add_u64("scrub_objects")
+                     .add_u64("scrub_inconsistent")
+                     .add_u64("scrub_repaired")
+                     .add_u64("scrub_repair_unverified")
+                     .add_u64("scrub_digest_batches")
+                     .add_u64("scrub_missing_peers")
                      .add_time_avg("op_w_latency")
                      .add_time_avg("map_scan_latency")
                      .add_time_avg("qos_wait")
+                     .add_time_avg("scrub_chunk_latency")
                      .create_perf_counters())
         self.ctx.perf.add(self.perf)
         # the messenger's and store's own counter sets live in the same
@@ -359,8 +391,22 @@ class OSDDaemon(Dispatcher):
         # (delta, rho) increments from the MOSDOp wire tags.  One worker
         # per shard keeps per-PG FIFO order.  "direct" executes on
         # dispatch threads (legacy/seed FIFO).
-        from ceph_tpu.osd.op_queue import ClassInfo, ShardedOpQueue
+        from ceph_tpu.osd.op_queue import (
+            DEFAULT_CLASSES, ClassInfo, ShardedOpQueue)
         self._use_opwq = str(self.ctx.conf.get("osd_op_queue")) == "mclock"
+        # deep-scrub chunks and replica scrub-map ops schedule in the
+        # background_best_effort class (the reference's mClockScheduler
+        # class of the same name): weight/limit from the osd_scrub_*
+        # knobs, never a reservation — background integrity runs in the
+        # excess so tenant floors hold under a full-cluster scrub storm
+        opwq_classes = {n: ClassInfo(c.reservation, c.weight, c.limit)
+                        for n, c in DEFAULT_CLASSES.items()}
+        opwq_classes[BACKGROUND_BEST_EFFORT] = ClassInfo(
+            reservation=0.0,
+            weight=float(self.ctx.conf.get(
+                "osd_scrub_background_weight")),
+            limit=float(self.ctx.conf.get(
+                "osd_scrub_background_limit")))
         self._mclock_per_client = bool(int(
             self.ctx.conf.get("osd_mclock_per_client")))
         #: tenant lanes (osd_qos_tenant_lanes): client ops carrying an
@@ -374,6 +420,7 @@ class OSDDaemon(Dispatcher):
         self.opwq = (ShardedOpQueue(
             self._opwq_handle,
             n_shards=int(self.ctx.conf.get("osd_op_num_shards")),
+            classes=opwq_classes,
             name=f"osd.{osd_id}",
             client_template=ClassInfo(
                 reservation=float(self.ctx.conf.get(
@@ -397,6 +444,26 @@ class OSDDaemon(Dispatcher):
             "dump_qos_stats", lambda **kw: self._dump_qos_stats(),
             "per-tenant dmclock accounting: backlog, phase-served "
             "counts, queue-wait totals, applied profiles")
+
+        #: background-integrity accounting (dump_scrub_stats / the
+        #: MMgrReport scrub tail / ceph_scrub_* prometheus families)
+        self._scrub_lock = make_lock(f"OSD::scrub_stats({osd_id})")
+        self._scrub_stats: dict = {
+            "sweeps": 0, "pgs_scrubbed": 0, "objects_scrubbed": 0,
+            "digest_batches": 0, "digest_objects": 0,
+            "scalar_fallbacks": 0, "inconsistent": 0, "repaired": 0,
+            "repair_unverified": 0, "missing_peer_scrubs": 0,
+            "missing_peer_retries": 0, "last_sweep": {}}
+        self._scrub_sweeping = False
+        self._scrub_auto_last = time.time()
+        self.ctx.admin.register_command(
+            "dump_scrub_stats", lambda **kw: self._dump_scrub_stats(),
+            "background-integrity accounting: sweep/PG/object counts, "
+            "batched-digest vs scalar-fallback split, inconsistencies "
+            "found / repairs verified / repairs unverified, "
+            "missing-peer rounds, the last sweep's report, and the "
+            "background_best_effort dmclock lane this daemon's scrub "
+            "ops ride")
 
         # recovery reservations (AsyncReserver / osd_max_backfills): a PG
         # needs a slot before pulling; pulls run in a bounded window
@@ -708,7 +775,8 @@ class OSDDaemon(Dispatcher):
             slow_ops=self.op_tracker.slow_digests(),
             profile=telemetry.pipeline_profile_digest(),
             qos=self._qos_digest(),
-            faults=self.ctx.fault_digest()))
+            faults=self.ctx.fault_digest(),
+            scrub=self._scrub_digest_report()))
 
     ROTATING_REFRESH = 60.0
 
@@ -731,6 +799,7 @@ class OSDDaemon(Dispatcher):
                     pass
             self._renew_map_subscription(now)
             self._agent_scan(now)
+            self._maybe_auto_scrub(now)
             self._mgr_report()
             self.clog.flush()
             # PG state summary to the mons (MPGStats flow): feeds the
@@ -2076,7 +2145,17 @@ class OSDDaemon(Dispatcher):
             self._handle_notify_ack(msg)
             return True
         if isinstance(msg, MOSDScrub):
-            self._enqueue_op("scrub", msg.pgid, self._handle_scrub, msg)
+            # replica scrub-map building is background work too: it
+            # rides the same background_best_effort lane as the
+            # primary's chunks — cost-scaled, a map build is many
+            # small-op service times — so a scrub storm's replica half
+            # is dmclock-arbitrated instead of competing as peer
+            # traffic
+            msg.qos_delta = max(1, int(self.ctx.conf.get(
+                "osd_scrub_cost")))
+            msg.qos_rho = 0
+            self._enqueue_op(BACKGROUND_BEST_EFFORT, msg.pgid,
+                             self._handle_scrub, msg)
             return True
         if isinstance(msg, MOSDScrubReply):
             self._handle_scrub_reply(msg)
@@ -3987,18 +4066,98 @@ class OSDDaemon(Dispatcher):
             self._op_send_reply(m, MOSDOpReply(
                 tid=m.tid, result=0, epoch=self.osdmap.epoch))
 
-    # -- scrub (PG::scrub / chunky_scrub, collapsed) --------------------------
+    # -- scrub (PG::scrub / chunky_scrub: batched digests, verified ----------
+    # repair, background QoS lane) --------------------------------------------
 
-    def _scrub_map(self, cid: str) -> dict:
-        """{oid: (size, data_crc, omap_crc)} for every object in the
-        collection (pgmeta excluded)."""
-        from ceph_tpu.osd.ec_util import shard_crc
-        out = {}
+    #: wait budget for one coalesced digest batch (covers the engine's
+    #: whole retry/fallback ladder; the scalar loop backstops a miss)
+    SCRUB_DIGEST_TIMEOUT = 30.0
+
+    #: _scrub_stats key -> per-daemon perf counter
+    _SCRUB_PERF = {"objects_scrubbed": "scrub_objects",
+                   "inconsistent": "scrub_inconsistent",
+                   "repaired": "scrub_repaired",
+                   "repair_unverified": "scrub_repair_unverified",
+                   "digest_batches": "scrub_digest_batches",
+                   "missing_peer_scrubs": "scrub_missing_peers"}
+
+    def _scrub_note(self, **counts) -> None:
+        """Fold counts into this daemon's scrub accounting, the
+        process-global telemetry sink (the thrasher's cluster-wide
+        scrub-storm gate), and the registered perf counters."""
+        from ceph_tpu.ops import telemetry
+        sink = telemetry.scrub_stats()
+        with self._scrub_lock:
+            for k, v in counts.items():
+                if v:
+                    self._scrub_stats[k] = self._scrub_stats.get(k, 0) + v
+        for k, v in counts.items():
+            if not v:
+                continue
+            sink.inc(k, int(v))
+            c = self._SCRUB_PERF.get(k)
+            if c:
+                self.perf.inc(c, int(v))
+
+    def _scrub_digest_rows(self, blobs: list) -> "np.ndarray | None":
+        """(len(blobs), 2) uint32 digests via ONE coalesced device
+        batch on the scrub_digest channel, or None — the caller runs
+        the bit-exact scalar loop (knob off, empty batch, rows wider
+        than the kernel cap, or a permanent engine error; transient
+        device faults never reach here — the engine's retry ladder and
+        host oracle absorb them)."""
+        if not blobs or not bool(self.ctx.conf.get("osd_scrub_batched")):
+            return None
+        from ceph_tpu.ops import checksum_kernel as ck
+        if max(len(b) for b in blobs) > ck.MAX_WIDTH:
+            return None
         try:
-            oids = self.store.list_objects(cid)
-        except KeyError:
-            return out
-        for oid in oids:
+            from ceph_tpu.ops.dispatch import submit_scrub_digest
+            fut = submit_scrub_digest(self.ctx.decode_dispatch_engine(),
+                                      blobs)
+            # analysis: allow[blocking] -- scrub chunks are background ops; the future carries host numpy once delivered
+            digs = np.asarray(fut.result(
+                timeout=self.SCRUB_DIGEST_TIMEOUT))
+        except Exception as e:
+            dout("osd", 1, "osd.%d scrub digest batch failed, scalar "
+                 "fallback: %r", self.osd_id, e)
+            self._scrub_note(scalar_fallbacks=1)
+            return None
+        self._scrub_note(digest_batches=1, digest_objects=len(blobs))
+        return digs
+
+    def _scrub_read_rows(self, cid: str, oids: list | None = None,
+                         names: list | None = None) -> tuple:
+        """Bulk-read one scrub chunk's objects: returns (sentinels,
+        rows) where sentinels maps oids whose store read failed
+        checksum to SCRUB_CORRUPT (bluestore verifies every block on
+        read; the sentinel is wire-compatible with the triple and
+        diverges from every healthy map entry, so the compare pass
+        repairs this copy from a clean peer), rows are
+        (oid, data, omap_blob, hinfo) awaiting digests, and the third
+        dict maps every seen oid to its raw "_v" blob (the
+        version-skew guard the compare pass needs)."""
+        out: dict = {}
+        rows: list = []
+        vers: dict = {}
+        if names is None:
+            # callers that already listed the collection (the chunk
+            # chain) pass their slice straight in — re-listing the
+            # whole collection per 16-name chunk is O(N^2/step)
+            try:
+                names = self.store.list_objects(cid)
+            except KeyError:
+                return out, rows, vers
+            if oids is not None:
+                sel = set(oids)
+                names = [o for o in names if o in sel]
+        pool = None
+        try:
+            pool = self.osdmap.pools.get(int(cid.split(".", 1)[0]))
+        except ValueError:
+            pass
+        ec = pool is not None and pool.is_erasure()
+        for oid in names:
             if oid.startswith(PG.PGMETA):
                 continue
             try:
@@ -4007,24 +4166,192 @@ class OSDDaemon(Dispatcher):
             except KeyError:
                 continue
             except IOError:
-                # store-level checksum mismatch (bluestore verifies
-                # every block on read): a distinct sentinel — wire-
-                # compatible with the (size, crc, crc) triple — diverges
-                # from every healthy map entry, so the compare pass
-                # repairs this copy from a clean peer
                 out[oid] = SCRUB_CORRUPT
+                vers[oid] = self._getattr_safe(cid, oid, "_v") or b""
                 continue
             oblob = repr(sorted(omap.items())).encode()
-            out[oid] = (len(data), shard_crc(data), shard_crc(oblob))
+            hinfo = (self._getattr_safe(cid, oid, "hinfo")
+                     if ec and ":" in oid else None)
+            vers[oid] = self._getattr_safe(cid, oid, "_v") or b""
+            rows.append((oid, data, oblob, hinfo))
+        return out, rows, vers
+
+    @staticmethod
+    def _scrub_fill(out: dict, rows: list, digs) -> dict:
+        """Fill the (size, data_crc, omap_crc) triples from a digest
+        matrix (crc32 column; None = the seed's scalar shard_crc
+        loop, bit-exact either way) and apply the EC hinfo sweep: a
+        shard whose bytes diverge from their write-time checksum is
+        this copy's SCRUB_CORRUPT — the detector the primary's shard
+        sweep repairs from."""
+        from ceph_tpu.osd.ec_util import shard_crc
+        n = len(rows)
+        for i, (oid, data, oblob, hinfo) in enumerate(rows):
+            if digs is not None:
+                dcrc, ocrc = int(digs[i, 0]), int(digs[n + i, 0])
+            else:
+                dcrc, ocrc = shard_crc(data), shard_crc(oblob)
+            if hinfo and dcrc.to_bytes(4, "little") != hinfo:
+                out[oid] = SCRUB_CORRUPT
+                continue
+            out[oid] = (len(data), dcrc, ocrc)
         return out
 
+    def _scrub_map(self, cid: str,
+                   oids: list | None = None) -> tuple[dict, dict]:
+        """({oid: (size, data_crc, omap_crc)}, {oid: "_v" blob}) for
+        every object in the collection (pgmeta excluded), or just
+        ``oids`` (repair verification).
+
+        Every object payload and omap blob stacks into ONE coalesced
+        digest batch (the scrub_digest dispatch channel) instead of
+        the seed's per-object host loop; the scalar ``shard_crc``
+        loop remains the bit-exact fallback.  This is the synchronous
+        build (direct callers, opwq off); the lane path uses the
+        submit-and-continue variant (_scrub_digest_async) so shard
+        workers never park on device latency."""
+        out, rows, vers = self._scrub_read_rows(cid, oids=oids)
+        digs = self._scrub_digest_rows(
+            [r[1] for r in rows] + [r[2] for r in rows])
+        return self._scrub_fill(out, rows, digs), vers
+
+    def _scrub_digest_async(self, rows: list, finish) -> None:
+        """Submit one chunk's digest batch and continue in the
+        engine's completion callback — the shard worker returns as
+        soon as the batch is queued, so scrub's worker quantum is
+        reads + submit, never device turnaround (the
+        submit-and-continue rule every async channel here follows).
+        ``finish(digs_or_None)`` runs on the engine's completion
+        thread (None = take the scalar loop)."""
+        blobs = [r[1] for r in rows] + [r[2] for r in rows]
+        if not blobs or not bool(
+                self.ctx.conf.get("osd_scrub_batched")):
+            finish(None)
+            return
+        from ceph_tpu.ops import checksum_kernel as ck
+        if max(len(b) for b in blobs) > ck.MAX_WIDTH:
+            finish(None)
+            return
+        try:
+            from ceph_tpu.ops.dispatch import submit_scrub_digest
+            fut = submit_scrub_digest(
+                self.ctx.decode_dispatch_engine(), blobs)
+        except Exception as e:
+            dout("osd", 1, "osd.%d scrub digest submit failed, "
+                 "scalar fallback: %r", self.osd_id, e)
+            self._scrub_note(scalar_fallbacks=1)
+            finish(None)
+            return
+
+        def cb(f) -> None:
+            if f.exception() is not None:
+                self._scrub_note(scalar_fallbacks=1)
+                finish(None)
+                return
+            self._scrub_note(digest_batches=1,
+                             digest_objects=len(blobs))
+            # analysis: allow[blocking] -- delivered engine futures carry host numpy; asarray here is a view, not d2h
+            finish(np.asarray(f.result()))
+
+        fut.add_done_callback(cb)
+
+    def _scrub_map_lane(self, cid: str, pgid, done,
+                        oids: list | None = None,
+                        cancelled=None) -> None:
+        """Build a scrub map through the background dmclock lane in
+        CHUNKS of osd_scrub_chunk_objects store objects per op (the
+        reference's chunky scrub): each lane op is a small-op-sized
+        service quantum, so excess-capacity scrub service never parks
+        a shard worker behind a whole-PG bulk read + digest while a
+        tenant op waits.  Every chunk carries the cost-scaled
+        background tag (osd_scrub_cost).  ``done(map)`` fires on a
+        shard worker after the last chunk; with the op queue off the
+        map builds synchronously."""
+        if self.opwq is None:
+            done(self._scrub_map(cid, oids=oids))
+            return
+        try:
+            names = [o for o in self.store.list_objects(cid)
+                     if not o.startswith(PG.PGMETA)]
+        except KeyError:
+            names = []
+        if oids is not None:
+            sel = set(oids)
+            names = [o for o in names if o in sel]
+        if not names:
+            done(({}, {}))
+            return
+        step = max(1, int(self.ctx.conf.get("osd_scrub_chunk_objects")))
+        cost = int(self.ctx.conf.get("osd_scrub_cost"))
+        acc: dict = {}
+        acc_vers: dict = {}
+        state = {"i": 0}
+
+        def chunk(_msg) -> None:
+            # worker quantum: bulk reads + digest submit only; the
+            # digest completes (and the chain advances) on the
+            # engine's completion thread
+            if cancelled is not None and cancelled():
+                return     # caller gave up (jam fallback): stop here
+            i = state["i"]
+            state["i"] = i + step
+            out, rows, vers = self._scrub_read_rows(
+                cid, names=names[i:i + step])
+            acc_vers.update(vers)
+
+            def finish(digs) -> None:
+                try:
+                    acc.update(self._scrub_fill(out, rows, digs))
+                except Exception as e:  # never strand the sweep
+                    dout("osd", 1, "osd.%d scrub chunk fill failed: "
+                         "%r", self.osd_id, e)
+                if cancelled is not None and cancelled():
+                    return
+                if state["i"] >= len(names) or self._stop:
+                    # shutdown mid-chain: deliver what we have — the
+                    # stopped op queue would never serve another
+                    # chunk, and the waiter must not park out its
+                    # whole timeout against a dead daemon
+                    done((acc, acc_vers))
+                    return
+                # osd_scrub_sleep as a DELAYED REQUEUE (the mclock-era
+                # reference's scrub_requeue_callback): the chain
+                # advances from a timer thread even unpaced, because
+                # _enqueue_op can block on the op-byte throttle and
+                # pacing must park neither a shard worker nor the
+                # engine completion thread this runs on
+                t = threading.Timer(
+                    max(0.0, float(self.ctx.conf.get(
+                        "osd_scrub_sleep"))),
+                    lambda: self._enqueue_op(
+                        BACKGROUND_BEST_EFFORT, pgid, chunk,
+                        _ScrubChunk(pgid, cost=cost)))
+                t.daemon = True
+                t.start()
+
+            self._scrub_digest_async(rows, finish)
+
+        self._enqueue_op(BACKGROUND_BEST_EFFORT, pgid, chunk,
+                         _ScrubChunk(pgid, cost=cost))
+
     def _handle_scrub(self, msg: MOSDScrub) -> None:
+        """Replica scrub-map request: the map builds through THIS
+        daemon's background lane in chunks, and the reply goes out
+        when the last chunk lands — a scrub storm's replica half is
+        arbitrated, cost-tagged background work end to end."""
         cid = f"{msg.pgid[0]}.{msg.pgid[1]}"
         con = msg.connection or self._osd_con(msg.from_osd)
-        if con:
+        if con is None:
+            return
+
+        def reply(mv) -> None:
+            m, vers = mv
             con.send_message(MOSDScrubReply(
                 pgid=msg.pgid, scrub_id=msg.scrub_id,
-                from_osd=self.osd_id, scrub_map=self._scrub_map(cid)))
+                from_osd=self.osd_id, scrub_map=m, versions=vers))
+
+        self._scrub_map_lane(cid, msg.pgid, reply,
+                             oids=getattr(msg, "oids", None))
 
     def _handle_scrub_reply(self, msg: MOSDScrubReply) -> None:
         with self._lock:
@@ -4032,72 +4359,182 @@ class OSDDaemon(Dispatcher):
             if st is None:
                 return
             st["maps"][msg.from_osd] = msg.scrub_map
+            st["vers"][msg.from_osd] = getattr(msg, "versions", {})
             if set(st["maps"]) >= st["expect"]:
                 st["event"].set()
 
-    def scrub_pg(self, pgid: tuple[int, int],
-                 timeout: float = 15.0) -> dict:
-        """Primary-driven scrub: gather per-replica object maps, compare,
-        repair divergent copies (authority = the primary's logged state,
-        with the primary itself repairing via pull when IT diverges from
-        the quorum of its replicas)."""
-        pg = self.pgs.get(pgid)
-        if pg is None or pg.primary != self.osd_id:
-            raise ValueError(f"not primary for {pgid}")
-        cid = self._pg_cid(pgid)
-        pool = self.osdmap.pools.get(pgid[0])
-        peers = [o for o in pg.up if o != self.osd_id and o != CEPH_NOSD]
+    def _scrub_gather(self, pgid, peers: list, timeout: float,
+                      oids: list | None = None) -> tuple[dict, dict]:
+        """One replica scrub-map gather round: ask ``peers``, wait up
+        to ``timeout``, return ({osd: map}, {osd: versions}) for
+        whatever arrived (the caller owns retry and missing-peer
+        accounting)."""
+        if not peers:
+            return {}, {}
         with self._lock:
             self._scrub_seq += 1
             sid = self._scrub_seq
-            st = {"maps": {self.osd_id: self._scrub_map(cid)},
-                  "expect": set(peers) | {self.osd_id},
+            st = {"maps": {}, "vers": {}, "expect": set(peers),
                   "event": threading.Event()}
             self._scrubs[sid] = st
         for o in peers:
             con = self._osd_con(o)
             if con:
                 con.send_message(MOSDScrub(pgid=pgid, scrub_id=sid,
-                                           from_osd=self.osd_id))
+                                           from_osd=self.osd_id,
+                                           oids=oids))
         st["event"].wait(timeout)
         with self._lock:
             self._scrubs.pop(sid, None)
-        maps = st["maps"]
-        report = {"checked": 0, "inconsistent": [], "repaired": []}
-        all_oids = sorted({o for m in maps.values() for o in m})
+            return dict(st["maps"]), dict(st["vers"])
+
+    def scrub_pg(self, pgid: tuple[int, int],
+                 timeout: float | None = None) -> dict:
+        """Primary-driven deep scrub: gather per-replica object maps
+        (each built as one batched digest call), compare the packed
+        triples vectorized, repair divergent copies (authority = the
+        most common healthy triple, the primary pushing when it
+        agrees and repulling when it is the outlier; EC shards rebuild
+        through the batched decode path), and VERIFY every repair by
+        re-fetching the repaired copy's digest before counting it.
+
+        Report keys: ``checked``, ``inconsistent``, ``repaired``
+        (verified only), ``repair_unverified``, ``missing_peers``
+        (replicas that never answered — recorded, never silently
+        compared as absent), ``clean`` (no inconsistency AND every
+        peer reported; a PG with a missing peer map is never clean)."""
+        pg = self.pgs.get(pgid)
+        if pg is None or pg.primary != self.osd_id:
+            raise ValueError(f"not primary for {pgid}")
+        if timeout is None:
+            timeout = float(self.ctx.conf.get("osd_scrub_chunk_timeout"))
+        t0 = time.monotonic()
+        cid = self._pg_cid(pgid)
+        pool = self.osdmap.pools.get(pgid[0])
+        peers = [o for o in pg.up
+                 if o != self.osd_id and o != CEPH_NOSD]
+        # peers the map already marks down go straight to
+        # missing_peers instead of being waited out
+        live = [o for o in peers if self.osdmap.is_up(o)]
+        # start the primary's own chunked lane build FIRST (it only
+        # enqueues), then gather — the replicas build their maps
+        # concurrently with ours instead of serializing the two
+        # slowest phases and eating into their own gather timeout
+        own_box: dict = {"dead": False}
+        own_ev = threading.Event()
+
+        def _own_done(mv) -> None:
+            own_box["map"] = mv
+            own_ev.set()
+
+        self._scrub_map_lane(cid, pgid, _own_done,
+                             cancelled=lambda: own_box["dead"])
+        got, gvers = self._scrub_gather(pgid, live, timeout)
+        if own_ev.wait(4.0 * float(self.ctx.conf.get(
+                "osd_scrub_chunk_timeout"))) and "map" in own_box:
+            own_map, own_vers = own_box["map"]
+        else:
+            # lane jammed: cancel the chain and build directly rather
+            # than wedge the sweep
+            own_box["dead"] = True
+            own_map, own_vers = self._scrub_map(cid)
+        maps = {self.osd_id: own_map}
+        vers = {self.osd_id: own_vers}
+        maps.update(got)
+        vers.update(gvers)
+        missing = set(peers) - set(maps)
+        retry = sorted(missing & set(live))
+        if retry:
+            # a silent replica is retried ONCE with backoff — the seed
+            # dropped it from maps and compared its objects as if the
+            # copy never existed
+            self._scrub_note(missing_peer_retries=1)
+            time.sleep(float(self.ctx.conf.get(
+                "osd_scrub_retry_backoff_ms")) / 1e3)
+            got, gvers = self._scrub_gather(pgid, retry, timeout)
+            maps.update(got)
+            vers.update(gvers)
+            missing = set(peers) - set(maps)
+        report = {"checked": 0, "inconsistent": [], "repaired": [],
+                  "repair_unverified": [],
+                  "missing_peers": sorted(missing), "clean": False}
         if pool is not None and pool.is_erasure():
-            # EC: shards are per-osd; integrity is the hinfo sweep
-            for oid in all_oids:
-                report["checked"] += 1
-                logical = oid.rsplit(":", 1)[0] if ":" in oid else oid
-                got = self._read_shard_verified(
-                    pgid, logical, oid.rsplit(":", 1)[1])                     if ":" in oid else None
-                if ":" in oid and got is None:
-                    report["inconsistent"].append(oid)
-            return report
-        for oid in all_oids:
-            report["checked"] += 1
-            vals = {o: maps[o].get(oid) for o in maps}
-            want = vals.get(self.osd_id)
-            counts: dict = {}
-            for v in vals.values():
-                counts[v] = counts.get(v, 0) + 1
-            majority = max(counts, key=lambda v: counts[v])
-            if all(v == want for v in vals.values()):
+            pending = self._scrub_compare_ec(pg, pgid, maps, vers,
+                                             report)
+        else:
+            pending = self._scrub_compare_replicated(
+                pg, pgid, cid, maps, vers, report)
+        self._scrub_verify_repairs(pgid, cid, pending, report)
+        # never report a PG clean when a peer map is missing
+        report["clean"] = (not report["inconsistent"] and not missing
+                           and not report["repair_unverified"])
+        self._scrub_note(
+            pgs_scrubbed=1, objects_scrubbed=report["checked"],
+            inconsistent=len(report["inconsistent"]),
+            repaired=len(report["repaired"]),
+            repair_unverified=len(report["repair_unverified"]),
+            missing_peer_scrubs=1 if missing else 0)
+        self.perf.tinc("scrub_chunk_latency", time.monotonic() - t0)
+        return report
+
+    def _scrub_compare_replicated(self, pg: PG, pgid, cid: str,
+                                  maps: dict, vers: dict,
+                                  report: dict) -> list:
+        """Replicated compare, vectorized: the per-osd maps pack into
+        (oid x responder) size/crc/presence tables and one numpy pass
+        finds the divergent rows — the seed walked a python dict per
+        oid.  Authority semantics unchanged: the most common HEALTHY
+        triple wins (a checksum-failed copy can never be
+        authoritative, even as a majority); the primary pushes its
+        copy when it agrees, repulls from a healthy peer when it is
+        the outlier.  Returns the tentative repairs [(oid, osd, want)]
+        for the verification pass."""
+        all_oids = sorted({o for m in maps.values() for o in m})
+        report["checked"] += len(all_oids)
+        if not all_oids:
+            return []
+        osds = sorted(maps)
+        rows, n = len(all_oids), len(osds)
+        sizes = np.zeros((rows, n), dtype=np.uint64)
+        dcrc = np.zeros((rows, n), dtype=np.uint64)
+        ocrc = np.zeros((rows, n), dtype=np.uint64)
+        present = np.zeros((rows, n), dtype=bool)
+        idx = {oid: i for i, oid in enumerate(all_oids)}
+        for j, osd in enumerate(osds):
+            for oid, val in maps[osd].items():
+                i = idx[oid]
+                present[i, j] = True
+                sizes[i, j], dcrc[i, j], ocrc[i, j] = val
+        p = osds.index(self.osd_id)
+        same = (present == present[:, p:p + 1]) & (
+            ~present | ((sizes == sizes[:, p:p + 1])
+                        & (dcrc == dcrc[:, p:p + 1])
+                        & (ocrc == ocrc[:, p:p + 1])))
+        pending = []
+        for i in np.nonzero(~same.all(axis=1))[0]:
+            oid = all_oids[int(i)]
+            if not self._scrub_settled(pg, oid, maps, vers, osds):
+                # version-skewed divergence: an in-flight write,
+                # delete, or recovery — the replication machinery owns
+                # it, and a scrub "repair" here would push a STALE
+                # copy over an acked newer write (or mark the
+                # primary's own newer copy missing).  Only
+                # SAME-version divergence is corruption.
                 continue
             report["inconsistent"].append(oid)
-            # authority = the most common HEALTHY value (checksum-failed
-            # copies can never be authoritative, even as a majority)
-            healthy = {o: val for o, val in vals.items()
+            vals = {osd: maps[osd].get(oid) for osd in osds}
+            want = vals.get(self.osd_id)
+            healthy = {osd: val for osd, val in vals.items()
                        if val is not None and val != SCRUB_CORRUPT}
             hcounts: dict = {}
             for val in healthy.values():
                 hcounts[val] = hcounts.get(val, 0) + 1
-            hmaj = max(hcounts, key=lambda v: (hcounts[v], v == want)) \
+            hmaj = max(hcounts,
+                       key=lambda v: (hcounts[v], v == want)) \
                 if hcounts else None
             if want == hmaj and want is not None:
-                # the primary agrees with the healthy majority: push its
-                # copy over every divergent (or corrupt) replica
+                # the primary agrees with the healthy majority: push
+                # its copy over every divergent (or corrupt) replica
                 try:
                     data = self.store.read(cid, oid)
                     omap = self.store.omap_get(cid, oid)
@@ -4108,29 +4545,264 @@ class OSDDaemon(Dispatcher):
                     v = self._getattr_safe(cid, oid, name)
                     if v:
                         attrs[name] = v
-                for o, val in vals.items():
-                    if o == self.osd_id or val == want:
+                for osd, val in vals.items():
+                    if osd == self.osd_id or val == want:
                         continue
-                    con = self._osd_con(o)
+                    con = self._osd_con(osd)
                     if con:
                         con.send_message(MOSDPGPush(
                             pgid=pgid, oid=oid, data=data, omap=omap,
                             attrs=attrs))
-                        report["repaired"].append((oid, o))
+                        pending.append((oid, osd, want))
             else:
                 # the primary is the outlier (divergent or corrupt):
-                # repull from a healthy peer holding the healthy-majority
-                # value
-                good = next((o for o, val in healthy.items()
-                             if val == hmaj and o != self.osd_id), None)
+                # repull from a healthy peer holding the
+                # healthy-majority value
+                good = next((osd for osd, val in healthy.items()
+                             if val == hmaj and osd != self.osd_id),
+                            None)
                 ent = pg.log.index.get(oid)
                 if good is not None and ent is not None:
                     with self._lock:
                         pg.missing[oid] = MissingItem(need=ent.version)
                         pg.state = STATE_RECOVERING
                     self._pull_object(pg, oid, good)
-                    report["repaired"].append((oid, self.osd_id))
-        return report
+                    pending.append((oid, self.osd_id, hmaj))
+        return pending
+
+    def _scrub_settled(self, pg: PG, oid: str, maps: dict,
+                       vers: dict, osds) -> bool:
+        """True when every PRESENT copy of ``oid`` reports the version
+        the pg log currently heads for it (legacy copies without a
+        "_v" blob count as settled — there is nothing to judge), and
+        the object is live in the log.  Scrub maps are gathered
+        seconds apart under load: only same-version divergence is
+        corruption; version skew means a write/delete/recovery is in
+        flight and the next sweep will see it converged."""
+        ent = pg.log.index.get(oid)
+        if ent is not None and ent.is_delete():
+            return False        # delete in flight
+        if ent is None:
+            # trimmed history: no logged head to compare against —
+            # settled iff every present copy agrees on ITS version
+            # (same-version divergence on a cold object is exactly
+            # the corruption scrub exists for)
+            vs = {(vers.get(osd) or {}).get(oid) for osd in osds
+                  if maps[osd].get(oid) is not None}
+            vs.discard(None)
+            vs.discard(b"")
+            return len(vs) <= 1
+        want = enc_version(ent.version)
+        for osd in osds:
+            if maps[osd].get(oid) is None:
+                continue        # absence is handled by the repair path
+            v = (vers.get(osd) or {}).get(oid)
+            if v and v != want:
+                return False
+        return True
+
+    def _scrub_compare_ec(self, pg: PG, pgid, maps: dict, vers: dict,
+                          report: dict) -> list:
+        """EC PGs: shards differ by construction, so cross-copy
+        compare is meaningless — integrity is (a) each owner's hinfo
+        sweep, which surfaces a shard whose bytes diverge from their
+        write-time checksum as SCRUB_CORRUPT in that owner's own map,
+        and (b) an existence sweep (a shard absent from its responding
+        owner while the object lives in the pg log).  Bad shards
+        rebuild through the batched decode path (_recover_ec_object ->
+        submit_decode_chunks) and verify like every repair — the
+        seed's EC branch only reported, never repaired."""
+        up = list(pg.up)
+        logicals = sorted({soid.rsplit(":", 1)[0]
+                           for m in maps.values() for soid in m
+                           if ":" in soid})
+        pending = []
+        for logical in logicals:
+            report["checked"] += 1
+            ent = pg.log.index.get(logical)
+            live = ent is not None and not ent.is_delete()
+            if live:
+                # version-skew guard (see _scrub_settled): any present
+                # shard off the logged head means the write/recovery
+                # is still propagating — not corruption
+                want = enc_version(ent.version)
+                skewed = False
+                for owner in up:
+                    if owner == CEPH_NOSD or owner not in maps:
+                        continue
+                    for sh in range(len(up)):
+                        v = (vers.get(owner) or {}).get(
+                            f"{logical}:{sh}")
+                        if v and v != want:
+                            skewed = True
+                if skewed:
+                    continue
+            for s, owner in enumerate(up):
+                if owner == CEPH_NOSD or owner not in maps:
+                    continue   # down/silent peer: missing_peers owns it
+                soid = f"{logical}:{s}"
+                val = maps[owner].get(soid)
+                if not (val == SCRUB_CORRUPT or (val is None and live)):
+                    continue
+                report["inconsistent"].append(soid)
+                if live:
+                    self._recover_ec_object(pg, logical,
+                                            dest_osd=owner,
+                                            dest_shard=s)
+                    # want=None: verified by ANY healthy follow-up
+                    # triple — the rebuilt chunk's digest is not
+                    # knowable on the primary
+                    pending.append((soid, owner, None))
+        return pending
+
+    def _scrub_verify_repairs(self, pgid, cid: str, pending: list,
+                              report: dict) -> None:
+        """The fire-and-forget fix: a repair only counts once the
+        repaired copy's digest is re-fetched (one follow-up scrub of
+        JUST the repaired oids) and matches the authority triple
+        (``want``; None accepts any healthy value — EC shard
+        rebuilds).  Pushes and recovery pulls apply asynchronously, so
+        this polls until osd_scrub_verify_timeout; what never verifies
+        lands in repair_unverified, never silently in repaired."""
+        if not pending:
+            return
+        if not bool(self.ctx.conf.get("osd_scrub_verify_repairs")):
+            report["repaired"].extend(
+                (oid, osd) for oid, osd, _ in pending)
+            return
+        left = {(oid, osd): want for oid, osd, want in pending}
+        deadline = time.monotonic() + float(
+            self.ctx.conf.get("osd_scrub_verify_timeout"))
+        while left:
+            by_osd: dict[int, list] = {}
+            for (oid, osd) in left:
+                by_osd.setdefault(osd, []).append(oid)
+            gto = max(0.5, min(
+                float(self.ctx.conf.get("osd_scrub_chunk_timeout")),
+                deadline - time.monotonic()))
+            for osd, oids in sorted(by_osd.items()):
+                if osd == self.osd_id:
+                    m, _v = self._scrub_map(cid, oids=sorted(oids))
+                else:
+                    m = self._scrub_gather(
+                        pgid, [osd], timeout=gto,
+                        oids=sorted(oids))[0].get(osd, {})
+                for oid in sorted(oids):
+                    want = left[(oid, osd)]
+                    got = m.get(oid)
+                    if (got is not None and got != SCRUB_CORRUPT
+                            and (want is None or got == want)):
+                        report["repaired"].append((oid, osd))
+                        del left[(oid, osd)]
+            if not left or time.monotonic() >= deadline:
+                break
+            time.sleep(0.2)
+        report["repair_unverified"].extend(sorted(left))
+
+    def scrub_all_pgs(self, timeout: float = 300.0) -> dict:
+        """One full deep-scrub sweep of every PG this OSD leads, run
+        on the CALLING thread (the continuous driver's own thread).
+        Every piece of scrub WORK — the primary's map build and each
+        replica's — is an op served through the background_best_effort
+        dmclock lane (visible in dump_qos_stats), so a continuous
+        full-cluster deep scrub competes only for the excess and
+        cannot starve tenant reservations; the network waits (replica
+        gathers, repair verification) park here and never hold a
+        shard worker.  Returns the aggregate report."""
+        with self._lock:
+            pgids = [pgid for pgid, pg in self.pgs.items()
+                     if pg.primary == self.osd_id]
+        agg = {"pgs": 0, "checked": 0, "inconsistent": [],
+               "repaired": [], "repair_unverified": [],
+               "missing_peers": [], "clean": True}
+        t0 = time.monotonic()
+        deadline = t0 + timeout
+        sleep = float(self.ctx.conf.get("osd_scrub_sleep"))
+        for i, pgid in enumerate(pgids):
+            if time.monotonic() >= deadline or self._stop:
+                break
+            if i and sleep > 0:
+                # osd_scrub_sleep between PGs too: a sweep's fixed
+                # per-PG cost (gather messages, digest dispatch,
+                # compare) is python-side work the serving threads
+                # contend with — pacing it is what makes "continuous"
+                # scrub background in CPU terms, not just queue terms
+                time.sleep(sleep)
+            try:
+                rep = self.scrub_pg(pgid)
+            except (ValueError, KeyError):
+                continue    # primaryship moved mid-sweep (map churn)
+            except Exception as e:
+                dout("osd", 1, "osd.%d scrub chunk %s failed: %r",
+                     self.osd_id, pgid, e)
+                continue
+            agg["pgs"] += 1
+            agg["checked"] += rep["checked"]
+            for k in ("inconsistent", "repaired", "repair_unverified",
+                      "missing_peers"):
+                agg[k].extend(rep[k])
+            agg["clean"] = agg["clean"] and rep["clean"]
+        summary = {
+            "pgs": agg["pgs"], "checked": agg["checked"],
+            "inconsistent": len(agg["inconsistent"]),
+            "repaired": len(agg["repaired"]),
+            "repair_unverified": len(agg["repair_unverified"]),
+            "missing_peers": sorted(set(agg["missing_peers"])),
+            "clean": agg["clean"],
+            "seconds": round(time.monotonic() - t0, 3)}
+        with self._scrub_lock:
+            self._scrub_stats["sweeps"] += 1
+            self._scrub_stats["last_sweep"] = summary
+        from ceph_tpu.ops import telemetry
+        telemetry.scrub_stats().inc("sweeps", 1)
+        return agg
+
+    def _maybe_auto_scrub(self, now: float) -> None:
+        """The continuous background-integrity driver: every
+        osd_scrub_auto_interval seconds one full scrub_all_pgs sweep
+        of the PGs this osd leads, on its own thread (a sweep blocks
+        on replica maps; the tick timer must not)."""
+        iv = float(self.ctx.conf.get("osd_scrub_auto_interval"))
+        if (iv <= 0 or self._scrub_sweeping or self._stop
+                or now - self._scrub_auto_last < iv):
+            return
+        self._scrub_sweeping = True
+        threading.Thread(target=self._scrub_auto_sweep,
+                         name=f"osd.{self.osd_id}-scrub",
+                         daemon=True).start()
+
+    def _scrub_auto_sweep(self) -> None:
+        try:
+            self.scrub_all_pgs()
+        except Exception as e:
+            dout("osd", 1, "osd.%d auto scrub sweep failed: %r",
+                 self.osd_id, e)
+        finally:
+            self._scrub_auto_last = time.time()
+            self._scrub_sweeping = False
+
+    def _dump_scrub_stats(self) -> dict:
+        """Admin ``dump_scrub_stats``: the daemon's background-
+        integrity accounting plus the dmclock lane its scrub ops
+        ride."""
+        with self._scrub_lock:
+            out = dict(self._scrub_stats)
+            out["last_sweep"] = dict(self._scrub_stats["last_sweep"])
+        out["qos_class"] = BACKGROUND_BEST_EFFORT
+        out["batched"] = bool(self.ctx.conf.get("osd_scrub_batched"))
+        out["auto_interval"] = float(
+            self.ctx.conf.get("osd_scrub_auto_interval"))
+        if self.opwq is not None:
+            out["background_lane"] = self.opwq.dump_qos()[
+                "classes"].get(BACKGROUND_BEST_EFFORT)
+        return out
+
+    def _scrub_digest_report(self) -> dict:
+        """Compact per-daemon scrub counters for the MMgrReport tail
+        (mgr scrub_feed -> ceph_scrub_* prometheus families)."""
+        with self._scrub_lock:
+            return {k: v for k, v in self._scrub_stats.items()
+                    if k != "last_sweep"}
 
     # -- peers ----------------------------------------------------------------
 

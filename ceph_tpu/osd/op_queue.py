@@ -60,13 +60,19 @@ class ClassInfo:
 
 
 #: default op classes (osd_op_queue mclock profiles: client ops get
-#: weight-dominant service, recovery/scrub/snaptrim run in the excess)
+#: weight-dominant service, recovery/scrub/snaptrim run in the excess;
+#: deep-scrub chunks and replica scrub-map ops ride the dedicated
+#: background_best_effort class — the reference's mClockScheduler
+#: class of the same name — whose weight/limit the daemon wires to
+#: osd_scrub_background_weight/_limit)
 DEFAULT_CLASSES = {
     "client": ClassInfo(reservation=0.0, weight=100.0, limit=0.0),
     "subop": ClassInfo(reservation=0.0, weight=80.0, limit=0.0),
     "recovery": ClassInfo(reservation=10.0, weight=10.0, limit=0.0),
     "scrub": ClassInfo(reservation=0.0, weight=5.0, limit=100.0),
     "snaptrim": ClassInfo(reservation=0.0, weight=5.0, limit=100.0),
+    "background_best_effort": ClassInfo(reservation=0.0, weight=1.0,
+                                        limit=0.0),
 }
 
 _PHASES = (PHASE_RESERVATION, PHASE_WEIGHT, PHASE_LIMIT)

@@ -50,6 +50,14 @@ PHASE_LIMIT = 3
 PHASE_NAMES = {PHASE_NONE: "none", PHASE_RESERVATION: "reservation",
                PHASE_WEIGHT: "weight", PHASE_LIMIT: "limit"}
 
+#: op-class name for background housekeeping work — deep scrub chunks
+#: and their replica map-building ops schedule here (the reference
+#: runs scrub under ``background_best_effort`` in
+#: src/osd/scheduler/mClockScheduler): no reservation, a small weight,
+#: an optional cap, so a full-cluster scrub storm only ever consumes
+#: excess capacity and tenant reservation floors hold untouched.
+BACKGROUND_BEST_EFFORT = "background_best_effort"
+
 
 @dataclass
 class QosProfile:

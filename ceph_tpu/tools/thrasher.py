@@ -133,6 +133,152 @@ class DeviceChaos:
         return False, digest
 
 
+class ScrubStorm(threading.Thread):
+    """``--scrub-storm``: continuous deep scrub + on-disk bit-flip
+    injection while the OSD-kill/chaos storm runs.
+
+    A dedicated integrity pool holds a fixed object population with
+    KNOWN payloads (the workload pools keep overwriting theirs, which
+    would make "repaired back to truth" unverifiable).  The storm
+    loop alternates full ``scrub_all_pgs`` sweeps on every live OSD
+    with bit flips written straight into a random copy's object store
+    — version attrs untouched, so log-based recovery cannot see the
+    damage and only integrity checking can.  Gate (``verify()``):
+    after heal, every injected corruption was detected and repaired —
+    every live copy of every integrity object reads back as its
+    written payload — with the cluster scrub ledger alongside.  The
+    ledger's ``repair_unverified`` may be transiently non-zero during
+    a kill storm (the repair target died mid-verification); the gate
+    is final convergence (``unrepaired`` empty), not a zero there."""
+
+    def __init__(self, cluster: MiniCluster, pool: int,
+                 rng: random.Random, n_objects: int = 8):
+        super().__init__(daemon=True, name="scrub-storm")
+        self.cluster = cluster
+        self.pool = pool
+        self.rng = rng
+        self._halt = threading.Event()
+        self.payloads: dict[str, bytes] = {}
+        self.injected: list[tuple[int, str, str]] = []
+        self.sweeps = 0
+        self.sweep_errors = 0
+        # generous timeout + per-object retries: with --chaos the
+        # first writes pay cold jit compiles and may time out once
+        client = cluster.client(timeout=60.0)
+        try:
+            io = client.open_ioctx(pool)
+            for i in range(n_objects):
+                body = f"integrity-{i}-".encode() * 64
+                for _attempt in range(3):
+                    try:
+                        io.write_full(f"int{i}", body)
+                    except (TimeoutError, OSError):
+                        time.sleep(1.0)
+                        continue
+                    self.payloads[f"int{i}"] = body
+                    break
+        finally:
+            client.shutdown()
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def _placement(self, oid: str):
+        from ceph_tpu.client.rados import ceph_str_hash_rjenkins
+        from ceph_tpu.osd.osdmap import pg_to_pgid
+        m = self.cluster.mon.osdmap
+        pool = m.pools.get(self.pool)
+        if pool is None:
+            return None, []
+        pg = pg_to_pgid(ceph_str_hash_rjenkins(oid), pool.pg_num)
+        up, _primary, _a, _ap = m.pg_to_up_acting_osds(self.pool, pg)
+        return pg, [o for o in up if o >= 0]
+
+    def _flip_one(self) -> str | None:
+        """Flip one bit of one copy, store-direct (silent corruption:
+        no log entry, no version change — scrub's problem to find)."""
+        from ceph_tpu.objectstore import Transaction
+        if not self.payloads:
+            return None     # every seed write failed: nothing to flip
+        oid = self.rng.choice(sorted(self.payloads))
+        pg, up = self._placement(oid)
+        cands = [o for o in up if o in self.cluster.osds]
+        if pg is None or not cands:
+            return None
+        victim = self.rng.choice(cands)
+        osd = self.cluster.osds.get(victim)
+        if osd is None:
+            return None
+        cid = f"{self.pool}.{pg}"
+        try:
+            data = osd.store.read(cid, oid)
+            if not data:
+                return None
+            off = self.rng.randrange(len(data))
+            osd.store.apply_transaction(Transaction().write(
+                cid, oid, off, bytes([data[off] ^ 0x40])))
+        except Exception:
+            return None      # victim died under us: the storm goes on
+        self.injected.append((victim, cid, oid))
+        return f"scrub-storm flip {oid} on osd.{victim}"
+
+    def _sweep_all(self, ignore_halt: bool = False) -> None:
+        for _i, osd in sorted(self.cluster.osds.items()):
+            if self._halt.is_set() and not ignore_halt:
+                return
+            try:
+                osd.scrub_all_pgs(timeout=60.0)
+                self.sweeps += 1
+            except Exception:
+                self.sweep_errors += 1
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            if self.rng.random() < 0.7:
+                self._flip_one()
+            self._sweep_all()
+            self._halt.wait(0.25)
+
+    def _bad_copies(self) -> list[tuple[int, str, str]]:
+        bad = []
+        for oid, body in sorted(self.payloads.items()):
+            pg, up = self._placement(oid)
+            if pg is None:
+                continue
+            cid = f"{self.pool}.{pg}"
+            for o in up:
+                osd = self.cluster.osds.get(o)
+                if osd is None:
+                    continue
+                try:
+                    data = osd.store.read(cid, oid)
+                except Exception:
+                    bad.append((o, oid, "unreadable"))
+                    continue
+                if data != body:
+                    bad.append((o, oid, "mismatch"))
+        return bad
+
+    def verify(self, timeout: float = 90.0) -> dict:
+        """Post-heal gate: keep sweeping until every live copy of
+        every integrity object matches its written payload (injected
+        corruption detected AND repaired), or the deadline."""
+        from ceph_tpu.ops import telemetry
+        end = time.time() + timeout
+        bad = self._bad_copies()
+        while bad and time.time() < end:
+            self._sweep_all(ignore_halt=True)
+            time.sleep(0.5)
+            bad = self._bad_copies()
+        return {"objects": len(self.payloads),
+                "injected": len(self.injected),
+                "sweeps": self.sweeps,
+                "sweep_errors": self.sweep_errors,
+                "unrepaired": [f"osd.{o}:{oid}:{why}"
+                               for o, oid, why in bad],
+                "ledger": telemetry.scrub_summary()}
+
+
 class Workload(threading.Thread):
     """Continuous write/read/delete mix against one pool."""
 
@@ -354,7 +500,8 @@ def run_soak(duration: float = 25.0, seed: int = 7,
              n_osds: int = 6, base_path: str = "",
              ms_type: str = "loopback", n_mons: int = 1,
              thrash_mons: bool = False,
-             device_chaos: bool = False) -> dict:
+             device_chaos: bool = False,
+             scrub_storm: bool = False) -> dict:
     """The standalone soak: returns a result dict (the pytest wrapper
     asserts).  OSDs are filestore-backed: kill_osd is PROCESS death with
     the disk surviving, like the reference Thrasher — wiping stores
@@ -367,7 +514,13 @@ def run_soak(duration: float = 25.0, seed: int = 7,
     The acked-object durability contract is unchanged — a device fault
     may slow an op (retry ladder) or degrade it host-side (breaker +
     bit-exact oracle) but never corrupt it — and after the storm every
-    breaker must re-close (reconvergence to the device path)."""
+    breaker must re-close (reconvergence to the device path).
+
+    ``scrub_storm=True`` runs ScrubStorm alongside: continuous deep
+    scrub of every PG plus on-disk bit-flip injection into a dedicated
+    integrity pool while OSDs die and (with device_chaos) the digest
+    channel itself degrades.  Gate: every injected corruption detected
+    and repaired, zero acked corruption."""
     if not base_path:
         import tempfile
         base_path = tempfile.mkdtemp(prefix="thrash-")
@@ -376,12 +529,19 @@ def run_soak(duration: float = 25.0, seed: int = 7,
         from ceph_tpu.msg.ici import IciTransport
         ici_t = IciTransport.instance()
     chaos = None
+    storm = None
     osd_conf = {}
     if device_chaos:
         # toy pools sit under the osdmap_mapping_min_pgs floor and
         # would never exercise the fused-ladder device channel: lower
         # it so pg_finish traffic is real during the storm
         osd_conf["osdmap_mapping_min_pgs"] = 1
+    if scrub_storm:
+        # sweeps must not park a whole chunk timeout behind every
+        # killed replica: short gathers + verification windows keep
+        # the storm's scrub duty cycle high
+        osd_conf.setdefault("osd_scrub_chunk_timeout", 4.0)
+        osd_conf.setdefault("osd_scrub_verify_timeout", 8.0)
     c = MiniCluster(n_osds=n_osds, ms_type=ms_type,
                     store_type="filestore", n_mons=n_mons,
                     base_path=base_path, heartbeats=True,
@@ -407,6 +567,11 @@ def run_soak(duration: float = 25.0, seed: int = 7,
         w2.start()
         th = Thrasher(c, seed=seed, pools={rep: 8, ec: 8},
                       thrash_mons=thrash_mons)
+        if scrub_storm:
+            spool = c.create_pool(client, pg_num=8, size=3,
+                                  epoch_timeout=ept)
+            storm = ScrubStorm(c, spool, random.Random(seed + 4))
+            storm.start()
         if device_chaos:
             # fault-free warmup first: on a cold process the first ops
             # PAY the jit compiles (encode kernel, mapper, ladder);
@@ -449,12 +614,17 @@ def run_soak(duration: float = 25.0, seed: int = 7,
             reconverged, fault_digest = chaos.await_reconverged(cluster=c)
         w1.stop()
         w2.stop()
+        if storm is not None:
+            storm.stop()
         w1.join(timeout=30)
         w2.join(timeout=30)
+        if storm is not None:
+            storm.join(timeout=60)
         th.heal()
         c.wait_for_osd_count(n_osds, timeout=30)
         c.wait_for_epoch(c.mon.osdmap.epoch, timeout=30)
         time.sleep(3.0)   # recovery settles
+        scrub_result = storm.verify() if storm is not None else None
         vclient = c.client(timeout=20.0)
         # health must transition: WARN during the storm, OK after heal
         import json as _json
@@ -497,21 +667,32 @@ def run_soak(duration: float = 25.0, seed: int = 7,
             "chaos_actions": chaos.actions if chaos else 0,
             "breakers_reconverged": reconverged,
             "fault_digest": fault_digest,
+            "scrub_storm": scrub_result,
         }
     finally:
         if chaos is not None:
             chaos.clear()   # failpoints are process-global: a failed
             # soak must never leave them armed for the next test
+        if storm is not None:
+            storm.stop()
         c.stop()
 
 
 if __name__ == "__main__":
     import json
     import sys
-    args = [a for a in sys.argv[1:] if a != "--chaos"]
+    flags = ("--chaos", "--scrub-storm")
+    args = [a for a in sys.argv[1:] if a not in flags]
     res = run_soak(duration=float(args[0]) if args else 25.0,
-                   device_chaos="--chaos" in sys.argv)
+                   device_chaos="--chaos" in sys.argv,
+                   scrub_storm="--scrub-storm" in sys.argv)
     print(json.dumps({k: v for k, v in res.items() if k != "log"}))
+    sres = res.get("scrub_storm") or {}
     bad = (res["corruptions"] or res["lost_rep"] or res["lost_ec"]
-           or res["breakers_reconverged"] is False)
+           or res["breakers_reconverged"] is False
+           or bool(sres.get("unrepaired"))
+           # a storm that never seeded its integrity pool proved
+           # nothing — the gate must not pass vacuously
+           or (res.get("scrub_storm") is not None
+               and sres.get("objects", 0) == 0))
     sys.exit(1 if bad else 0)

@@ -1,0 +1,385 @@
+"""Device-accelerated background integrity (the batched deep-scrub
+pipeline): the scrub_digest kernel channel's bit-exactness and fault
+ladder, the rebuilt scrub path's missing-peer and verified-repair
+semantics, the EC branch's detect-and-repair, and the
+background_best_effort QoS lane the whole thing schedules in."""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common import failpoint
+from ceph_tpu.objectstore import Transaction
+from ceph_tpu.ops import telemetry
+from ceph_tpu.ops import checksum_kernel as ck
+from ceph_tpu.ops.dispatch import (
+    DeviceDispatchEngine, submit_scrub_digest)
+from ceph_tpu.client.rados import ceph_str_hash_rjenkins
+from ceph_tpu.osd.osdmap import pg_to_pgid
+from ceph_tpu.tools.vstart import MiniCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoint.clear()
+    yield
+    failpoint.clear()
+
+
+def _engine(**kw):
+    eng = DeviceDispatchEngine(stats=telemetry.DispatchStats(), **kw)
+    eng.fault_backoff_ms = 1.0
+    eng.fault_backoff_max_ms = 5.0
+    eng.probe_interval = 0.05
+    return eng
+
+
+def _wait_breaker(eng, channel, state, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if eng.breaker_states().get(channel) == state:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# -- the digest kernel channel ------------------------------------------------
+
+class TestDigestKernel:
+    #: edge sizes: empty, sub-word, word-aligned, odd, bucket edges
+    SIZES = [0, 1, 2, 3, 4, 5, 7, 8, 9, 31, 63, 64, 255, 256, 257,
+             1000, 1024, 2047]
+
+    def test_bit_exact_property_random_sizes_and_patterns(self):
+        """The acceptance pin: the batched digest (through the engine,
+        padding and aux operands included) equals the literal
+        shard_crc loop for random sizes and byte patterns."""
+        rng = np.random.default_rng(7)
+        eng = _engine()
+        try:
+            for round_ in range(2):
+                sizes = list(self.SIZES) + [
+                    int(s) for s in rng.integers(0, 5000, 12)]
+                blobs = [rng.integers(0, 256, s, dtype=np.uint8)
+                         .tobytes() for s in sizes]
+                got = np.asarray(
+                    submit_scrub_digest(eng, blobs).result(60))
+                assert got.shape == (len(blobs), 2)
+                for i, b in enumerate(blobs):
+                    assert int(got[i, 0]) == (zlib.crc32(b)
+                                              & 0xFFFFFFFF), (round_, i)
+                    assert int(got[i, 1]) == ck.gf_digest_ref(
+                        np.frombuffer(b, dtype=np.uint8)), (round_, i)
+        finally:
+            eng.stop()
+
+    def test_single_bit_flip_changes_both_digests(self):
+        rng = np.random.default_rng(3)
+        row = rng.integers(0, 256, 513, dtype=np.uint8)
+        base = ck.scrub_digest_ref(row[None, :], [513])[0]
+        for pos in (0, 1, 255, 512):
+            flipped = row.copy()
+            flipped[pos] ^= 0x10
+            d = ck.scrub_digest_ref(flipped[None, :], [513])[0]
+            assert d[0] != base[0], pos
+            assert d[1] != base[1], pos
+
+    def test_width_buckets_are_shared_pow2(self):
+        """Different PGs coalesce because the submit key is only the
+        padded width bucket."""
+        assert ck.row_width(0) == ck.MIN_WIDTH
+        assert ck.row_width(5) == ck.MIN_WIDTH
+        assert ck.row_width(9) == 16
+        assert ck.row_width(4096) == 4096
+        assert ck.row_width(4097) == 8192
+
+    def test_transient_fault_retries_bit_exact(self):
+        eng = _engine()
+        try:
+            failpoint.set("dispatch.launch:scrub_digest", "nth:1")
+            blobs = [b"retry-me" * 40, b"x" * 7]
+            got = np.asarray(submit_scrub_digest(eng, blobs).result(60))
+            for i, b in enumerate(blobs):
+                assert int(got[i, 0]) == (zlib.crc32(b) & 0xFFFFFFFF)
+            d = eng.stats.fault_dump()
+            assert d["retries"] >= 1 and d["retry_successes"] >= 1, d
+        finally:
+            eng.stop()
+
+    def test_hard_outage_opens_breaker_falls_back_then_recloses(self):
+        """The PR 11 fault ladder on the fifth channel: a hard device
+        outage opens the scrub_digest breaker, every batch is served
+        by the bit-exact shard_crc oracle, and clearing the fault lets
+        the background probe re-close the breaker."""
+        eng = _engine()
+        eng.breaker_threshold = 2
+        try:
+            failpoint.set("dispatch.launch:scrub_digest", "always")
+            blobs = [b"outage" * 50, b"", b"z" * 129]
+            for _ in range(3):
+                got = np.asarray(
+                    submit_scrub_digest(eng, blobs).result(60))
+                for i, b in enumerate(blobs):
+                    assert int(got[i, 0]) == (zlib.crc32(b)
+                                              & 0xFFFFFFFF)
+            d = eng.stats.fault_dump()
+            assert d["breaker_opens"] >= 1, d
+            assert d["fallback_batches"] >= 1, d
+            assert eng.breaker_states()["scrub_digest"] == \
+                telemetry.BREAKER_OPEN
+            failpoint.clear()
+            assert _wait_breaker(eng, "scrub_digest",
+                                 telemetry.BREAKER_CLOSED)
+            got = np.asarray(submit_scrub_digest(
+                eng, [b"healed" * 3]).result(60))
+            assert int(got[0, 0]) == (zlib.crc32(b"healed" * 3)
+                                      & 0xFFFFFFFF)
+        finally:
+            eng.stop()
+
+
+# -- the rebuilt scrub path (MiniCluster) -------------------------------------
+
+@pytest.fixture(scope="class")
+def cluster():
+    """Class-scoped: the semantics tests each use their own pool and
+    oids, and the one test that KILLS an osd builds its own cluster —
+    sharing the MiniCluster keeps the suite's wall-clock down (the
+    870 s tier-1 budget is tight)."""
+    c = MiniCluster(n_osds=3, ms_type="loopback").start()
+    c.wait_for_osd_count(3)
+    try:
+        yield c
+    finally:
+        c.stop()
+
+
+def _pg_of(cluster, pool, oid):
+    m = cluster.mon.osdmap
+    pg = pg_to_pgid(ceph_str_hash_rjenkins(oid), m.pools[pool].pg_num)
+    up, primary, _a, _ap = m.pg_to_up_acting_osds(pool, pg)
+    return pg, up, primary
+
+
+class TestScrubSemantics:
+    def test_missing_peer_recorded_never_clean(self):
+        """A replica that never replies lands in missing_peers (after
+        one retry) and the PG is NOT reported clean — the seed dropped
+        it from maps and compared its objects as if the copy never
+        existed."""
+        client = cluster_ = None
+        c = MiniCluster(n_osds=3, ms_type="loopback").start()
+        try:
+            c.wait_for_osd_count(3)
+            client = c.client()
+            pool = c.create_pool(client, pg_num=4, size=3)
+            io = client.open_ioctx(pool)
+            io.write_full("mp", b"present" * 100)
+            time.sleep(0.3)
+            pg, up, primary = _pg_of(c, pool, "mp")
+            victim = next(o for o in up if o != primary)
+            c.kill_osd(victim)
+            rep = c.osds[primary].scrub_pg((pool, pg), timeout=1.0)
+            assert rep["missing_peers"] == [victim], rep
+            assert rep["clean"] is False, rep
+            # the surviving copies still compared clean
+            assert rep["inconsistent"] == [], rep
+            st = c.osds[primary].ctx.admin.execute("dump_scrub_stats")
+            assert st["missing_peer_scrubs"] >= 1, st
+        finally:
+            _ = client, cluster_
+            c.stop()
+
+    def test_replica_corruption_repaired_and_verified(self, cluster):
+        client = cluster.client()
+        pool = cluster.create_pool(client, pg_num=4, size=3)
+        io = client.open_ioctx(pool)
+        io.write_full("sc", b"truth" * 200)
+        time.sleep(0.3)
+        pg, up, primary = _pg_of(cluster, pool, "sc")
+        victim_id = next(o for o in up if o != primary)
+        victim = cluster.osds[victim_id]
+        cid = f"{pool}.{pg}"
+        victim.store.apply_transaction(
+            Transaction().truncate(cid, "sc", 0)
+            .write(cid, "sc", 0, b"lies!" * 200))
+        rep = cluster.osds[primary].scrub_pg((pool, pg))
+        assert "sc" in rep["inconsistent"], rep
+        # the fire-and-forget fix: the repair only counted after its
+        # digest was re-fetched and matched the authority triple
+        assert ("sc", victim_id) in rep["repaired"], rep
+        assert rep["repair_unverified"] == [], rep
+        assert victim.store.read(cid, "sc") == b"truth" * 200
+        rep2 = cluster.osds[primary].scrub_pg((pool, pg))
+        assert rep2["inconsistent"] == [] and rep2["clean"], rep2
+
+    def test_primary_outlier_repull_verified(self, cluster):
+        client = cluster.client()
+        pool = cluster.create_pool(client, pg_num=4, size=3)
+        io = client.open_ioctx(pool)
+        io.write_full("pc", b"quorum" * 150)
+        time.sleep(0.3)
+        pg, up, primary = _pg_of(cluster, pool, "pc")
+        prim = cluster.osds[primary]
+        cid = f"{pool}.{pg}"
+        prim.store.apply_transaction(
+            Transaction().truncate(cid, "pc", 0)
+            .write(cid, "pc", 0, b"drifted"))
+        rep = prim.scrub_pg((pool, pg))
+        assert "pc" in rep["inconsistent"], rep
+        assert ("pc", primary) in rep["repaired"], rep
+        assert prim.store.read(cid, "pc") == b"quorum" * 150
+        assert io.read("pc") == b"quorum" * 150
+
+    def test_ec_shard_corruption_detected_decoded_repaired(self,
+                                                           cluster):
+        """The EC branch satellite: corrupt one shard on disk, the
+        hinfo sweep flags it (the owner's own scrub map reports
+        SCRUB_CORRUPT), the batched decode path rebuilds it, and a
+        re-scrub comes back clean — the seed's EC branch only
+        reported, never repaired."""
+        client = cluster.client()
+        pool = cluster.create_pool(client, pg_num=4,
+                                   pool_type="erasure", k=2, m=1)
+        io = client.open_ioctx(pool)
+        body = b"erasure-coded-truth!" * 100
+        io.write_full("eobj", body)
+        time.sleep(0.3)
+        pg, up, primary = _pg_of(cluster, pool, "eobj")
+        shard = 1 if up[0] == primary else 0
+        owner = up[shard]
+        cid = f"{pool}.{pg}"
+        soid = f"eobj:{shard}"
+        store = cluster.osds[owner].store
+        chunk = store.read(cid, soid)
+        flipped = bytes(b ^ 0x55 for b in chunk)
+        store.apply_transaction(
+            Transaction().truncate(cid, soid, 0)
+            .write(cid, soid, 0, flipped))
+        rep = cluster.osds[primary].scrub_pg((pool, pg))
+        assert soid in rep["inconsistent"], rep
+        assert (soid, owner) in rep["repaired"], rep
+        assert rep["repair_unverified"] == [], rep
+        # the rebuilt shard carries the original bytes + a matching
+        # hinfo, and the object reads back whole
+        assert store.read(cid, soid) == chunk
+        rep2 = cluster.osds[primary].scrub_pg((pool, pg))
+        assert rep2["inconsistent"] == [] and rep2["clean"], rep2
+        assert io.read("eobj") == body
+
+    def test_version_skew_not_treated_as_corruption(self, cluster):
+        """Scrub maps are gathered seconds apart under load: a copy at
+        a DIFFERENT version than the logged head is an in-flight
+        write, not corruption — scrub must neither report nor "repair"
+        it (the repair would push a stale copy over an acked newer
+        write, the lost_rep failure the scrub-storm soak exposed)."""
+        from ceph_tpu.osd.daemon import enc_version
+        client = cluster.client()
+        pool = cluster.create_pool(client, pg_num=4, size=3)
+        io = client.open_ioctx(pool)
+        io.write_full("vs", b"acked-old" * 50)
+        time.sleep(0.3)
+        pg, up, primary = _pg_of(cluster, pool, "vs")
+        victim_id = next(o for o in up if o != primary)
+        victim = cluster.osds[victim_id]
+        cid = f"{pool}.{pg}"
+        # simulate mid-gather skew: the replica's copy has advanced
+        # past the primary's logged head (a landing newer write)
+        newer = b"acked-newer" * 50
+        victim.store.apply_transaction(
+            Transaction().truncate(cid, "vs", 0)
+            .write(cid, "vs", 0, newer)
+            .setattr(cid, "vs", "_v", enc_version((99, 99))))
+        rep = cluster.osds[primary].scrub_pg((pool, pg))
+        assert "vs" not in rep["inconsistent"], rep
+        assert all(oid != "vs" for oid, _o in rep["repaired"]), rep
+        # the newer copy was NOT clobbered by a stale repair push,
+        # and the primary never marked its own copy missing
+        assert victim.store.read(cid, "vs") == newer
+        assert "vs" not in cluster.osds[primary].pgs[
+            (pool, pg)].missing
+
+    def test_scrub_map_rides_the_digest_channel(self, cluster):
+        """The batched path is the live default: a scrub increments
+        the digest-batch ledger (device channel, not the scalar loop)
+        and the kernel registry sees scrub_digest calls."""
+        client = cluster.client()
+        pool = cluster.create_pool(client, pg_num=4, size=3)
+        io = client.open_ioctx(pool)
+        for i in range(6):
+            io.write_full(f"d{i}", f"payload-{i}".encode() * 50)
+        time.sleep(0.3)
+        pg, _up, primary = _pg_of(cluster, pool, "d0")
+        before = cluster.osds[primary].ctx.admin.execute(
+            "dump_scrub_stats")["digest_batches"]
+        rep = cluster.osds[primary].scrub_pg((pool, pg))
+        assert rep["clean"], rep
+        st = cluster.osds[primary].ctx.admin.execute(
+            "dump_scrub_stats")
+        assert st["digest_batches"] > before, st
+        assert telemetry.dump().get("scrub_digest", {}).get(
+            "calls", 0) >= 1
+
+    def test_scrub_all_pgs_serves_from_background_lane(self, cluster):
+        """The sweep driver's chunks are dmclock-arbitrated in the
+        background_best_effort class — visible in dump_qos_stats —
+        and the aggregate report + sweep ledger land in
+        dump_scrub_stats."""
+        client = cluster.client()
+        pool = cluster.create_pool(client, pg_num=8, size=3)
+        io = client.open_ioctx(pool)
+        for i in range(10):
+            io.write_full(f"bg{i}", f"bg-{i}".encode() * 30)
+        time.sleep(0.3)
+        total_pgs = 0
+        for osd in cluster.osds.values():
+            agg = osd.scrub_all_pgs()
+            total_pgs += agg["pgs"]
+            assert agg["clean"], agg
+        assert total_pgs >= 8
+        served = 0
+        for osd in cluster.osds.values():
+            d = osd.ctx.admin.execute("dump_qos_stats")
+            row = d["classes"].get("background_best_effort")
+            if row:
+                served += sum(row["served"].values())
+            st = osd.ctx.admin.execute("dump_scrub_stats")
+            assert st["qos_class"] == "background_best_effort"
+        assert served > 0
+        swept = [osd.ctx.admin.execute("dump_scrub_stats")["sweeps"]
+                 for osd in cluster.osds.values()]
+        assert sum(swept) >= 3, swept
+
+
+class TestScrubObservability:
+    def test_mgr_report_carries_scrub_tail(self):
+        from ceph_tpu.mgr.daemon import MMgrReport
+        msg = MMgrReport(osd_id=3, scrub={"objects_scrubbed": 7,
+                                          "repaired": 1})
+        from ceph_tpu.msg.message import Message
+        back = Message.decode(msg.encode())
+        assert back.scrub == {"objects_scrubbed": 7, "repaired": 1}
+
+    def test_mosd_scrub_oid_filter_roundtrip(self):
+        from ceph_tpu.messages.osd_msgs import MOSDScrub
+        from ceph_tpu.msg.message import Message
+        m = MOSDScrub(pgid=(4, 2), scrub_id=9, from_osd=1,
+                      oids=["a", "b:0"])
+        back = Message.decode(m.encode())
+        assert back.oids == ["a", "b:0"]
+        assert Message.decode(
+            MOSDScrub(pgid=(4, 2), scrub_id=9,
+                      from_osd=1).encode()).oids is None
+
+    def test_scrub_telemetry_sink_rolls_up(self):
+        sink = telemetry.scrub_stats()
+        base = sink.dump().get("objects_scrubbed", 0)
+        sink.inc("objects_scrubbed", 5)
+        assert sink.dump()["objects_scrubbed"] == base + 5
+        s = telemetry.scrub_summary()
+        assert "repair_unverified" in s and "repaired" in s
