@@ -734,6 +734,42 @@ def _tenant_queue_rates(profiles, pump_threads, *, service_s,
     return rates, p99
 
 
+def _tenant_device_burst(tenants, ops_each: int = 3, k: int = 4,
+                         m: int = 2, chunk: int = 512) -> dict:
+    """Tiny tagged-submit burst through a context-backed dispatch
+    engine: each tenant's encode batches carry a ``cost_tag``, plus
+    one scrub-style batch riding as background_best_effort, so the
+    qos section's JSON gains the same tenant device-time ledger
+    digest the mgr ships in the MMgrReport tail."""
+    from ceph_tpu.common.context import CephTpuContext
+    from ceph_tpu.ec import registry_instance
+    from ceph_tpu.ops import telemetry
+    from ceph_tpu.ops.dispatch import BACKGROUND_BEST_EFFORT
+
+    # the ledger is process-global and earlier sections' engines feed
+    # it untagged: clear so the digest attributes THIS burst
+    telemetry.tenant_stats().clear()
+    codec = registry_instance().factory(
+        "isa", {"technique": "cauchy", "k": str(k), "m": str(m)})
+    ctx = CephTpuContext("bench-qos-tenants")
+    eng = ctx.dispatch_engine()
+    rng = np.random.default_rng(7)
+    op = rng.integers(0, 256, (8, k, chunk), dtype=np.uint8)
+    futs = []
+    for tenant in tenants:
+        futs.extend(codec.submit_chunks(eng, op,
+                                        cost_tag=(tenant, "client"))
+                    for _ in range(ops_each))
+    futs.append(codec.submit_chunks(
+        eng, op,
+        cost_tag=(BACKGROUND_BEST_EFFORT, BACKGROUND_BEST_EFFORT)))
+    for f in futs:
+        f.result(timeout=120)
+    eng.flush()
+    eng.stop()
+    return telemetry.tenant_usage_digest()
+
+
 def qos_section(measure_s: float = 2.5, warmup_s: float = 0.8,
                 service_s: float = 0.002) -> dict:
     """Multi-tenant dmClock fairness sweep (--sections qos; validated
@@ -747,7 +783,9 @@ def qos_section(measure_s: float = 2.5, warmup_s: float = 0.8,
     vs one aggregate FIFO class (QoS off = the seed's arbitration) —
     and reports per-tenant throughput + queue-wait p99, the
     reservation attainment, the limit overshoot, and the hog:silver
-    excess ratio vs the configured 4.0."""
+    excess ratio vs the configured 4.0.  A tagged device burst then
+    captures the tenant device-time ledger digest under
+    ``tenant_usage`` (renderable by tools/profile_report.py)."""
     from ceph_tpu.osd.op_queue import ClassInfo
 
     profiles = {
@@ -785,6 +823,7 @@ def qos_section(measure_s: float = 2.5, warmup_s: float = 0.8,
         "limit_overshoot": round(r["bronze"] / 50.0, 3),
         "excess_ratio_hog_silver": round(hog_silver, 2),
         "excess_ratio_configured": 4.0,
+        "tenant_usage": _tenant_device_burst(list(profiles)),
     }
 
 

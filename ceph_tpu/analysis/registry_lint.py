@@ -16,16 +16,43 @@ Two sub-checks keyed by the codebase's two central registries:
   the counter lock at runtime).  Membership is checked against the
   union of every declared set — object-precise matching is
   undecidable here, and a union miss is always a real bug.
+
+* ``module-option`` — every ``get_module_option("mgr_*")`` and
+  ``get_module_option("kernel_*")`` literal must ALSO be registered
+  in common/config.py's option table: mgr-module knobs that mirror
+  daemon-level options (the slo module's windows, the tenant-ledger
+  knobs) stay discoverable through one registry instead of drifting
+  into module-private names.
+
+* ``doc-drift`` — every prometheus family name
+  (``ceph_[a-z0-9_]+``) referenced in docs/OBSERVABILITY.md must be
+  emitted by the exporter (a string literal — or an f-string
+  prefix/suffix pair — in mgr/modules/prometheus.py), so the
+  monitoring doc cannot document families a refactor renamed away.
 """
 
 from __future__ import annotations
 
 import ast
+import os
+import re
 
 from ceph_tpu.analysis import Finding
 from ceph_tpu.analysis.core import TreeIndex, name_chain
 
 _MUTATORS = {"inc", "dec", "tinc", "hinc"}
+
+#: get_module_option prefixes that must resolve in the option table
+_MODULE_OPT_PREFIXES = ("mgr_", "kernel_")
+
+#: a family reference, not a repo path: must not end in "_" (prefix
+#: globs like ceph_scrub_* name a family SET, matched by their base),
+#: and the ceph_tpu package name itself is excluded
+_DOC_FAMILY_RE = re.compile(r"\bceph_[a-z0-9_]*[a-z0-9]\b")
+
+#: exposition row suffixes a doc may name directly (the family base
+#: name is what the exporter declares)
+_FAMILY_SUFFIXES = ("_bucket", "_sum", "_count")
 
 
 def _option_names(index: TreeIndex) -> set:
@@ -58,10 +85,75 @@ def _registered_counters(index: TreeIndex) -> set:
     return union
 
 
+def _exporter_names(index: TreeIndex) -> tuple[set, list]:
+    """(string literals, f-string (prefix, suffix) pairs) from the
+    prometheus module — the vocabulary the doc-drift check matches
+    family references against."""
+    literals: set = set()
+    fstrings: list = []
+    for mod in index.modules.values():
+        if not mod.modname.endswith("mgr.modules.prometheus"):
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                literals.add(node.value)
+            elif isinstance(node, ast.JoinedStr):
+                parts = node.values
+                prefix = parts[0].value if parts and isinstance(
+                    parts[0], ast.Constant) else ""
+                suffix = parts[-1].value if len(parts) > 1 and \
+                    isinstance(parts[-1], ast.Constant) else ""
+                if isinstance(prefix, str) and prefix:
+                    fstrings.append((prefix, suffix
+                                     if isinstance(suffix, str)
+                                     else ""))
+    return literals, fstrings
+
+
+def _doc_drift(index: TreeIndex) -> list:
+    doc = os.path.join(index.base, "docs", "OBSERVABILITY.md")
+    try:
+        with open(doc, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return []
+    literals, fstrings = _exporter_names(index)
+    if not literals and not fstrings:
+        return []   # exporter absent from the analyzed package
+
+    def known(name: str) -> bool:
+        cands = [name] + [name[:-len(s)] for s in _FAMILY_SUFFIXES
+                          if name.endswith(s)]
+        for c in cands:
+            if c in literals:
+                return True
+            for pre, suf in fstrings:
+                if c.startswith(pre) and c.endswith(suf):
+                    return True
+        return False
+
+    findings = []
+    seen: set = set()
+    for lineno, line in enumerate(lines, 1):
+        for name in _DOC_FAMILY_RE.findall(line):
+            if name.startswith("ceph_tpu") or name in seen \
+                    or known(name):
+                continue
+            seen.add(name)
+            findings.append(Finding(
+                "registry", "docs/OBSERVABILITY.md", lineno,
+                "doc-drift",
+                f"{name}: prometheus family referenced by the doc "
+                f"but never emitted by mgr/modules/prometheus.py"))
+    return findings
+
+
 def check(index: TreeIndex):
     findings = []
     options = _option_names(index)
     counters = _registered_counters(index)
+    findings.extend(_doc_drift(index))
     for relpath, mod in sorted(index.by_path.items()):
         if mod.modname.endswith("common.config"):
             continue     # the table itself (defaults, casts, errors)
@@ -76,7 +168,14 @@ def check(index: TreeIndex):
             literal = arg0.value if isinstance(arg0, ast.Constant) \
                 and isinstance(getattr(arg0, "value", None), str) \
                 else None
-            if tail in ("get", "set") and chain[-2] == "conf":
+            if tail == "get_module_option" and literal is not None \
+                    and literal.startswith(_MODULE_OPT_PREFIXES) \
+                    and literal not in options:
+                findings.append(Finding(
+                    "registry", relpath, node.lineno, "module-option",
+                    f"get_module_option({literal!r}): daemon-style "
+                    f"knob not in common/config.py's option table"))
+            elif tail in ("get", "set") and chain[-2] == "conf":
                 if literal is not None and literal not in options:
                     findings.append(Finding(
                         "registry", relpath, node.lineno, "conf-key",

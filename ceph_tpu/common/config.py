@@ -294,6 +294,29 @@ register_options([
            "fence (block_until_ready) each instrumented device kernel "
            "call so telemetry latency samples are real device time; "
            "serializes the dispatch pipeline, so keep off on hot paths"),
+    Option("kernel_tenant_ledger_enabled", OPT_BOOL, True,
+           "apportion each coalesced batch's device busy integral "
+           "(compute x devices) to its requests' cost_tags by stripe "
+           "share and accumulate the per-tenant x engine x channel "
+           "device-time ledger (dump_tenant_usage / the MMgrReport "
+           "tenant_usage tail / ceph_tenant_* prometheus families); "
+           "measurement-only — scheduling never reads it"),
+    Option("kernel_tenant_ledger_max_tenants", OPT_INT, 1024,
+           "distinct tenants the device-time ledger tracks before new "
+           "tenants fold into the _overflow bucket (a tenant-name "
+           "flood cannot grow the table without bound; overflow work "
+           "stays counted, so conservation holds)"),
+    Option("mgr_slo_fast_window_s", OPT_FLOAT, 300.0,
+           "fast burn-rate window of the mgr slo module: QOS_SLO_BURN "
+           "fires only while the fast AND slow windows both burn at "
+           ">= 1.0, and clears once the fast window recovers"),
+    Option("mgr_slo_slow_window_s", OPT_FLOAT, 3600.0,
+           "slow burn-rate window of the mgr slo module (the "
+           "sustained-violation proof; see mgr_slo_fast_window_s)"),
+    Option("mgr_slo_max_samples", OPT_INT, 2048,
+           "rolling counter samples the mgr slo module retains for "
+           "windowed burn evaluation (also time-bounded by the slow "
+           "window)"),
     Option("log_level", OPT_INT, 1, "default subsystem log level"),
     Option("ms_type", OPT_STR, "async",
            "messenger implementation: async | loopback"),

@@ -246,7 +246,7 @@ class ErasureCode(ErasureCodeInterface):
                 self._mesh_encoders.popitem(last=False)
         return enc
 
-    def submit_chunks(self, engine, data_chunks):
+    def submit_chunks(self, engine, data_chunks, cost_tag=None):
         """Submit an (S, k, B) encode through a dispatch engine
         (ops.dispatch): returns a DispatchFuture of the (S, m, B)
         parity.  Concurrent submits against the same codec and chunk
@@ -255,7 +255,9 @@ class ErasureCode(ErasureCodeInterface):
         is linear (zeros encode to zeros).  On a mesh-sharded engine
         the coalesced batch additionally splits its stripe axis across
         the mesh (host runtimes opt out — sharding a batch a numpy fn
-        would immediately gather back is pure overhead)."""
+        would immediately gather back is pure overhead).  ``cost_tag``
+        is the (tenant, dmclock class) pair the tenant device-time
+        ledger attributes this request's stripe share to."""
         # analysis: allow[blocking] -- chunk input is host bytes/numpy by API contract
         data = np.asarray(data_chunks, dtype=np.uint8)
         key = ("ec_encode", id(self), self.k, self.m, data.shape[-1],
@@ -290,7 +292,7 @@ class ErasureCode(ErasureCodeInterface):
         return engine.submit(key, fn, data,
                              label="ec_encode",
                              cache_entries=cache_entries, place=place,
-                             fallback=fallback)
+                             fallback=fallback, cost_tag=cost_tag)
 
     # -- decode (ErasureCode.cc:198-234 / ErasureCodeIsa.cc:150-310) ----------
 
@@ -511,7 +513,8 @@ class ErasureCode(ErasureCodeInterface):
                                              host_pidx, data, tb)
         return fb
 
-    def submit_decode_chunks(self, engine, chosen, chunks, targets):
+    def submit_decode_chunks(self, engine, chosen, chunks, targets,
+                             cost_tag=None):
         """Submit an (S, k, B) decode through a dispatch engine
         (ops.dispatch): returns a DispatchFuture of the
         (S, len(targets), B) rebuilt rows.  The decode-side twin of
@@ -552,7 +555,8 @@ class ErasureCode(ErasureCodeInterface):
                               data, aux=(pidx,), label="ec_decode",
                               cache_entries=cache_entries,
                               place=self.runtime == "tpu",
-                              fallback=self._decode_fallback_fn(tab, tb))
+                              fallback=self._decode_fallback_fn(tab, tb),
+                              cost_tag=cost_tag)
         if t == tb:
             return inner
         # the batch computes tb target rows per stripe (the bucket);

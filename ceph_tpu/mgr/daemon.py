@@ -72,7 +72,12 @@ class MMgrReport(Message):
     mgrs simply never see the v4 tail).  v5 adds the scrub key to the
     tail — the per-daemon background-integrity digest
     (``_scrub_digest_report``) feeding the mgr scrub_feed and the
-    ``ceph_scrub_*`` prometheus families."""
+    ``ceph_scrub_*`` prometheus families.  The tenant_usage key (same
+    JSON-tail carriage — no version bump needed, old mgrs skip it) is
+    the tenant device-time ledger digest
+    (``telemetry.tenant_usage_digest``) feeding the mgr tenant_feed,
+    the slo module's burn-rate engine, and the
+    ``ceph_tenant_device_seconds_total`` prometheus family."""
 
     TYPE = 0x701
     HEAD_VERSION = 5
@@ -87,7 +92,8 @@ class MMgrReport(Message):
                  profile: dict | None = None,
                  qos: dict | None = None,
                  faults: dict | None = None,
-                 scrub: dict | None = None):
+                 scrub: dict | None = None,
+                 tenant_usage: dict | None = None):
         super().__init__()
         self.osd_id = osd_id
         self.counters = counters or {}
@@ -117,6 +123,10 @@ class MMgrReport(Message):
         #: per-daemon background-integrity counters (deep scrub /
         #: verified repair; v5 tail key) — the scrub_feed source
         self.scrub = scrub or {}
+        #: tenant device-time ledger digest (per-tenant x engine x
+        #: channel device-seconds + wait quantiles; JSON-tail key) —
+        #: the tenant_feed / slo-module source
+        self.tenant_usage = tenant_usage or {}
 
     def encode_payload(self, enc: Encoder):
         enc.versioned(5, 1, lambda e: (
@@ -136,7 +146,8 @@ class MMgrReport(Message):
                               "profile": self.profile,
                               "qos": self.qos,
                               "faults": self.faults,
-                              "scrub": self.scrub}))))
+                              "scrub": self.scrub,
+                              "tenant_usage": self.tenant_usage}))))
 
     def decode_payload(self, dec: Decoder, version):
         # decode constructs via __new__: every field needs a default
@@ -149,6 +160,7 @@ class MMgrReport(Message):
         self.qos = {}
         self.faults = {}
         self.scrub = {}
+        self.tenant_usage = {}
 
         def body(d, v):
             self.osd_id = d.s32()
@@ -170,6 +182,7 @@ class MMgrReport(Message):
                 self.qos = tail.get("qos", {})
                 self.faults = tail.get("faults", {})
                 self.scrub = tail.get("scrub", {})
+                self.tenant_usage = tail.get("tenant_usage", {})
         dec.versioned(5, body)
 
 
@@ -536,6 +549,10 @@ class MgrDaemon(Dispatcher):
             return self.insights_feed()
         if data_name == "qos_feed":
             return self.qos_feed()
+        if data_name == "tenant_feed":
+            return self.tenant_feed()
+        if data_name == "osdmap_slo_db":
+            return dict(self.osdmap.slo_db)
         if data_name == "scrub_feed":
             return self.scrub_feed()
         if data_name == "faults_feed":
@@ -765,6 +782,17 @@ class MgrDaemon(Dispatcher):
             return {o: dict(r.qos)
                     for o, (_t, r) in self.reports.items() if r.qos}
 
+    def tenant_feed(self) -> dict:
+        """Per-daemon tenant device-time ledger digests from the
+        MMgrReport JSON tail: osd -> {tenants: {tenant:
+        {device_seconds, share, channels}}, total_device_seconds} —
+        the prometheus ceph_tenant_* source and the slo module's
+        usage feed."""
+        with self._lock:
+            return {o: dict(r.tenant_usage)
+                    for o, (_t, r) in self.reports.items()
+                    if r.tenant_usage}
+
     def scrub_feed(self) -> dict:
         """Per-daemon background-integrity counters from the
         MMgrReport v5 tail: osd -> {objects_scrubbed, inconsistent,
@@ -861,6 +889,13 @@ class MgrDaemon(Dispatcher):
                            "daemons": {str(o): chs for o, chs
                                        in degraded_kernels.items()},
                            "severity": "warn"})
+        # QOS_SLO_BURN: the slo module owns the burn-rate math; a
+        # missing/failed module must not take cluster health down with
+        # it (it already surfaces via MGR_MODULE_ERROR)
+        try:
+            checks.extend(self._module("slo").health_checks())
+        except Exception:
+            pass
         if not checks:
             status = "HEALTH_OK"
         elif any(c["severity"] == "error" for c in checks):

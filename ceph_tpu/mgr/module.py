@@ -128,7 +128,7 @@ class ModuleHost:
 
     #: modules every active mgr runs regardless of the enabled set
     #: (MgrMap always_on_modules)
-    ALWAYS_ON = ("balancer", "iostat", "telemetry", "insights")
+    ALWAYS_ON = ("balancer", "iostat", "telemetry", "insights", "slo")
 
     def __init__(self, mgr: "MgrDaemon"):
         self.mgr = mgr

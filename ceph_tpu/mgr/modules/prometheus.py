@@ -134,6 +134,8 @@ class Module(MgrModule):
         self._scrape_daemon_perf(exp)
         self._scrape_slow_ops(exp)
         self._scrape_qos(exp)
+        self._scrape_tenant_usage(exp)
+        self._scrape_slo(exp)
         self._scrape_scrub(exp)
         self._scrape_fault_feed(exp)
         self._scrape_kernels(exp)
@@ -260,6 +262,62 @@ class Module(MgrModule):
                         "idle dynamic lanes evicted by the "
                         "osd_qos_idle_client_timeout sweep",
                         ev.get("classes", 0), {"ceph_daemon": daemon})
+
+    def _scrape_tenant_usage(self, exp: Exposition) -> None:
+        """ceph_tenant_*: the tenant device-time ledger from the
+        MMgrReport tenant_usage tail — per (daemon, tenant, engine,
+        channel) attributed device-seconds and the per-tenant
+        share-of-device gauge.  Tenant names are user-supplied strings;
+        the label layer escapes them per the exposition spec.  Absent
+        on hosts without the feed (unit stubs)."""
+        try:
+            feed = self.get("tenant_feed")
+        except Exception:
+            return
+        for osd, digest in sorted(feed.items()):
+            daemon = f"osd.{osd}"
+            for tenant, trec in sorted(
+                    (digest.get("tenants") or {}).items()):
+                exp.gauge(
+                    "ceph_tenant_device_share",
+                    "tenant's share of this daemon's attributed "
+                    "device-seconds (the _untagged bucket keeps the "
+                    "shares summing to 1)",
+                    trec.get("share", 0.0),
+                    {"ceph_daemon": daemon, "tenant": tenant})
+                for eng, chans in sorted(
+                        (trec.get("engines") or {}).items()):
+                    for ch, row in sorted(chans.items()):
+                        lab = {"ceph_daemon": daemon, "tenant": tenant,
+                               "engine": eng, "channel": ch}
+                        exp.counter(
+                            "ceph_tenant_device_seconds_total",
+                            "device busy seconds (compute x devices) "
+                            "apportioned to the tenant by stripe "
+                            "share of each coalesced batch",
+                            row.get("device_seconds", 0.0), lab)
+                        exp.counter(
+                            "ceph_tenant_requests_total",
+                            "dispatch requests attributed to the "
+                            "tenant", row.get("requests", 0), lab)
+
+    def _scrape_slo(self, exp: Exposition) -> None:
+        """ceph_slo_burn_rate{tenant,objective}: the slo module's
+        fast-window burn per declared objective (>= 1.0 while the
+        objective is violated over the window)."""
+        try:
+            if not self.get_osdmap().slo_db:
+                return
+            gauges = self.mgr._module("slo").burn_gauges()
+        except Exception:
+            return
+        for tenant, per in sorted(gauges.items()):
+            for obj, burn in sorted(per.items()):
+                exp.gauge(
+                    "ceph_slo_burn_rate",
+                    "fast-window SLO burn rate per tenant objective "
+                    "(1.0 = at the objective boundary)",
+                    burn, {"tenant": tenant, "objective": obj})
 
     def _scrape_scrub(self, exp: Exposition) -> None:
         """ceph_scrub_*: per-daemon background-integrity counters from

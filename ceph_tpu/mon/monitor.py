@@ -1695,6 +1695,45 @@ class Monitor(Dispatcher):
                 return f"qos profile for {tenant} removed", 0
             if prefix == "qos ls":
                 return json.dumps(self.osdmap.qos_db), 0
+            if prefix == "qos slo set":
+                # per-tenant SLO objectives -> the replicated slo_db
+                # (the mgr slo module evaluates them as burn rates;
+                # `ceph qos slo set tenant=gold
+                # reservation_attainment=0.9 p99_latency_s=0.05
+                # device_share=0.5`)
+                from ceph_tpu.qos.dmclock import SloObjective
+                tenant = str(cmd["tenant"])
+                if not tenant:
+                    return "empty tenant", -22
+                slo = SloObjective(
+                    reservation_attainment=float(
+                        cmd.get("reservation_attainment", 0.0)),
+                    p99_latency_s=float(cmd.get("p99_latency_s", 0.0)),
+                    device_share=float(cmd.get("device_share", 0.0)))
+                try:
+                    slo.validate()
+                except ValueError as e:
+                    return str(e), -22
+
+                def fn(m: OSDMap):
+                    m.slo_db[tenant] = slo.to_dict()
+                if not self._mutate(fn):
+                    return "commit failed", -11
+                return json.dumps({"tenant": tenant,
+                                   **slo.to_dict(),
+                                   "epoch": self.osdmap.epoch}), 0
+            if prefix == "qos slo rm":
+                tenant = str(cmd["tenant"])
+                if tenant not in self.osdmap.slo_db:
+                    return f"no slo for {tenant!r}", -2
+
+                def fn(m: OSDMap):
+                    m.slo_db.pop(tenant, None)
+                if not self._mutate(fn):
+                    return "commit failed", -11
+                return f"slo for {tenant} removed", 0
+            if prefix == "qos slo ls":
+                return json.dumps(self.osdmap.slo_db), 0
             if prefix == "osd getmap":
                 return json.dumps({"epoch": self.osdmap.epoch}), 0
             if prefix == "osd getcrushmap":

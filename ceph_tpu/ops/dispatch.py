@@ -72,6 +72,7 @@ import numpy as np
 
 from ceph_tpu.common import failpoint, lockdep
 from ceph_tpu.ops import telemetry
+from ceph_tpu.qos.dmclock import BACKGROUND_BEST_EFFORT
 
 
 class EngineWedgedError(RuntimeError):
@@ -145,12 +146,15 @@ class DispatchFuture:
 class _Request:
     __slots__ = ("key", "fn", "data", "aux", "stripes", "future",
                  "t_submit", "label", "cache_entries", "trace", "span",
-                 "place", "fallback")
+                 "place", "fallback", "cost_tag")
 
     def __init__(self, key, fn, data, stripes, label=None,
                  cache_entries=None, aux=None, place=True,
-                 fallback=None):
+                 fallback=None, cost_tag=None):
         self.place = place
+        #: (tenant, dmclock class) for the device-time ledger; None
+        #: lands in the visible _untagged bucket at completion
+        self.cost_tag = cost_tag
         #: bit-exact host-path oracle for this request's kernel channel
         #: (ec_encode_ref / the host pattern decode / scalar CRUSH /
         #: the numpy ladder): the supervised-recovery ladder runs it
@@ -315,6 +319,11 @@ class DeviceDispatchEngine:
         self.name = name
         self.stats = stats if stats is not None \
             else telemetry.dispatch_stats()
+        #: ledger "engine" dimension: the stats sink decides (the two
+        #: context engines are distinguished exactly this way), so
+        #: per-test engines with private sinks still label sensibly
+        self._ledger_engine = ("decode" if isinstance(
+            self.stats, telemetry.DecodeDispatchStats) else "encode")
         #: jax.sharding.Mesh (or None): batches fan out across it —
         #: see the module docstring's mesh-sharded fan-out mechanism
         self._mesh = mesh
@@ -552,7 +561,8 @@ class DeviceDispatchEngine:
 
     def submit(self, key, fn, data, *, label=None,
                cache_entries=None, aux=None,
-               place: bool = True, fallback=None) -> DispatchFuture:
+               place: bool = True, fallback=None,
+               cost_tag=None) -> DispatchFuture:
         """``aux``: optional tuple of per-stripe side arrays (each with
         the SAME leading axis as ``data``) that coalesce alongside it —
         concatenated per component, edge-padded (last row repeated) to
@@ -573,7 +583,17 @@ class DeviceDispatchEngine:
         retry ladder is served by the oracle instead of fanning the
         error, and an open channel breaker routes batches straight to
         it while the background probe retries the device (see the
-        module's failure-domain notes)."""
+        module's failure-domain notes).
+
+        ``cost_tag``: optional (tenant, dmclock_class) pair for the
+        tenant-attributed device-time ledger.  Batches still coalesce
+        ACROSS tenants exactly as before (the tag plays no part in
+        batching); at completion the batch's busy integral
+        (compute_s × devices) is apportioned to each request by stripe
+        share and accounted under its tag in
+        ``telemetry.TenantDeviceStats``.  Untagged requests land in
+        the visible ``_untagged`` bucket — never dropped, so the
+        ledger's tenant sum conserves the engine's busy-seconds."""
         # analysis: allow[blocking] -- caller-input normalization: submit() receives host arrays (numpy/bytes), not device values
         data = np.asarray(data)
         stripes = int(data.shape[0]) if data.ndim else 1
@@ -586,7 +606,7 @@ class DeviceDispatchEngine:
                         f"aux leading axis {a.shape} != stripes {stripes}")
         req = _Request(key, fn, data, stripes, label=label,
                        cache_entries=cache_entries, aux=aux, place=place,
-                       fallback=fallback)
+                       fallback=fallback, cost_tag=cost_tag)
         with self._cv:
             if not self._stop and not self._wedged:
                 self._ensure_threads()
@@ -1012,6 +1032,39 @@ class DeviceDispatchEngine:
                         devices=pr["devices"], misses=batch.misses)
                 except Exception:
                     pass   # profiling must never wedge completions
+                try:
+                    # tenant apportionment: the SAME busy integral the
+                    # phase ledger just accumulated (compute × devices),
+                    # split across the batch's requests by stripe share
+                    # — shares sum to 1 over the real stripes (padding
+                    # carries no tag and no share), so the per-tenant
+                    # ledger conserves busy_seconds exactly
+                    busy = (t_ready - pr["t_launch_end"]) * pr["devices"]
+                    total = max(1, pr["stripes"])
+                    groups: dict = {}
+                    for req in batch.reqs:
+                        tag = req.cost_tag
+                        if tag is None:
+                            tenant, klass = None, ""
+                        elif isinstance(tag, str):
+                            tenant, klass = tag, ""
+                        else:
+                            tenant, klass = tag[0], tag[1]
+                        g = groups.setdefault(
+                            (tenant, klass, req.label), [0, 0, []])
+                        g[0] += req.stripes
+                        g[1] += 1
+                        g[2].append(pr["t0"] - req.t_submit)
+                    ledger = telemetry.tenant_stats()
+                    for (tenant, klass, chan), (s, n, waits) \
+                            in groups.items():
+                        ledger.record_batch(
+                            tenant, klass,
+                            engine=self._ledger_engine, channel=chan,
+                            device_seconds=busy * (s / total),
+                            requests=n, stripes=s, queue_waits=waits)
+                except Exception:
+                    pass   # the ledger must never wedge completions
 
 
     # -- supervised recovery (retry ladder, breaker, probe) -------------------
@@ -1276,7 +1329,7 @@ def _replicate_cached(mesh, cache_key, build):
 
 def submit_flat_firstn(engine: DeviceDispatchEngine, x, ids, weights,
                        reweight, *, numrep: int, tries: int = 51,
-                       key=None) -> DispatchFuture:
+                       key=None, cost_tag=None) -> DispatchFuture:
     """Submit a bulk PG remap through the engine: concurrent remap
     requests against the SAME map state coalesce on the x axis into one
     device call (the ParallelPGMapper thread pool collapsed into one
@@ -1326,12 +1379,13 @@ def submit_flat_firstn(engine: DeviceDispatchEngine, x, ids, weights,
         return np.asarray(rows, dtype=np.int32)
 
     return engine.submit(key, fn, np.asarray(x, dtype=np.uint32),
-                         label="crush_firstn", fallback=host_oracle)
+                         label="crush_firstn", fallback=host_oracle,
+                         cost_tag=cost_tag)
 
 
 def submit_do_rule(engine: DeviceDispatchEngine, mapper, ruleno: int,
                    xs, result_max: int, reweight, *,
-                   key=None) -> DispatchFuture:
+                   key=None, cost_tag=None) -> DispatchFuture:
     """Submit a general-rule bulk PG remap (BatchMapper.do_rule)
     through the engine.  Pool remaps for the SAME (map, rule, size,
     reweight) — e.g. several pools sharing one crush rule, or several
@@ -1389,11 +1443,12 @@ def submit_do_rule(engine: DeviceDispatchEngine, mapper, ruleno: int,
             return out
 
     return engine.submit(key, fn, np.asarray(xs, dtype=np.uint32),
-                         label="crush_rule", fallback=host_oracle)
+                         label="crush_rule", fallback=host_oracle,
+                         cost_tag=cost_tag)
 
 
 def submit_finish_ladder(engine: DeviceDispatchEngine, operands, *,
-                         key=None) -> DispatchFuture:
+                         key=None, cost_tag=None) -> DispatchFuture:
     """Submit one pool's fused placement-pipeline tail (raw -> up ->
     acting; ops.placement_kernel) through the engine.  ``operands`` is
     a placement_kernel.LadderOperands: the raw table is the data
@@ -1442,11 +1497,11 @@ def submit_finish_ladder(engine: DeviceDispatchEngine, operands, *,
     return engine.submit(key, fn, operands.raw, aux=operands.aux(),
                          label="pg_finish",
                          cache_entries=ladder_cache_entries,
-                         fallback=host_oracle)
+                         fallback=host_oracle, cost_tag=cost_tag)
 
 
 def submit_scrub_digest(engine: DeviceDispatchEngine, blobs,
-                        key=None) -> DispatchFuture:
+                        key=None, cost_tag=None) -> DispatchFuture:
     """Submit a batch of byte blobs (object payloads / omap blobs) for
     integrity digesting through the engine — the FIFTH kernel channel
     (``scrub_digest``), with everything the other four have: a
@@ -1486,4 +1541,7 @@ def submit_scrub_digest(engine: DeviceDispatchEngine, blobs,
     return engine.submit(key, fn, data, aux=(lengths, mats, invp),
                          label="scrub_digest",
                          cache_entries=ck.digest_jit_entries,
-                         fallback=host_oracle)
+                         fallback=host_oracle,
+                         cost_tag=cost_tag if cost_tag is not None
+                         else (BACKGROUND_BEST_EFFORT,
+                               BACKGROUND_BEST_EFFORT))

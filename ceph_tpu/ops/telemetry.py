@@ -935,6 +935,144 @@ class ScrubStats:
         }
 
 
+#: ledger bucket for work submitted WITHOUT a cost tag.  Untagged
+#: device time is attributed here — visibly — never dropped: the
+#: conservation property (sum over tenants == engine busy-seconds)
+#: holds only because every batch lands somewhere.
+UNTAGGED_TENANT = "_untagged"
+
+#: ledger bucket absorbing tenants beyond the table bound
+#: (kernel_tenant_ledger_max_tenants): overflow stays counted, so
+#: conservation survives a tenant-name flood; only per-name
+#: attribution degrades.
+OVERFLOW_TENANT = "_overflow"
+
+#: default bound on distinct tenants the ledger tracks
+TENANT_LEDGER_MAX_DEFAULT = 1024
+
+
+class TenantDeviceStats:
+    """Tenant-attributed device-time ledger (per-tenant × engine ×
+    channel).
+
+    The dispatch engines apportion each completed batch's busy
+    integral (``compute_s × devices``, the same product PhaseStats
+    accumulates into ``busy_seconds``) to the batch's requests by
+    stripe share and record it here under the request's ``cost_tag``
+    (tenant + dmClock class).  Rows carry device-seconds, batch/request
+    /stripe counts and a queue-wait histogram (submit → dispatch, the
+    same window PhaseStats calls queue_wait); ``dump`` adds
+    share-of-device gauges.
+
+    Feeds ``dump_tenant_usage`` (admin socket), the MMgrReport
+    ``tenant_usage`` tail (→ mgr tenant_feed → the slo module and the
+    ``ceph_tenant_device_seconds_total`` prometheus family), and
+    ``tools/profile_report.py``'s per-tenant table.
+
+    Attribution is measurement-only: nothing here feeds back into
+    batch admission (that is ROADMAP item 1's unified runtime).
+    """
+
+    def __init__(self):
+        self._lock = lockdep.make_lock("TenantDeviceStats::lock")
+        #: (tenant, engine, channel) -> row dict
+        self._rows: dict[tuple, dict] = {}
+        self._tenants: set = set()
+        self.enabled = True
+        self.max_tenants = TENANT_LEDGER_MAX_DEFAULT
+
+    def _key_tenant(self, tenant) -> str:
+        t = str(tenant) if tenant else UNTAGGED_TENANT
+        if t in self._tenants:
+            return t
+        if len(self._tenants) >= self.max_tenants and t not in (
+                UNTAGGED_TENANT, OVERFLOW_TENANT):
+            return OVERFLOW_TENANT
+        self._tenants.add(t)
+        return t
+
+    def record_batch(self, tenant, qos_class, *, engine: str,
+                     channel: str, device_seconds: float,
+                     requests: int, stripes: int,
+                     queue_waits=()) -> None:
+        """Account one tenant's share of one completed device batch."""
+        if not self.enabled:
+            return
+        with self._lock:
+            t = self._key_tenant(tenant)
+            row = self._rows.get((t, engine, channel))
+            if row is None:
+                row = self._rows[(t, engine, channel)] = {
+                    "qos_class": str(qos_class or ""),
+                    "device_seconds": 0.0, "batches": 0,
+                    "requests": 0, "stripes": 0,
+                    "queue_wait": Histogram(LATENCY_BOUNDS)}
+            row["device_seconds"] += float(device_seconds)
+            row["batches"] += 1
+            row["requests"] += int(requests)
+            row["stripes"] += int(stripes)
+            for w in queue_waits:
+                row["queue_wait"].add(max(0.0, float(w)))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rows.clear()
+            self._tenants.clear()
+
+    def total_device_seconds(self) -> float:
+        with self._lock:
+            return sum(r["device_seconds"] for r in self._rows.values())
+
+    def dump(self) -> dict:
+        """Full ledger (the ``dump_tenant_usage`` admin payload):
+        tenant -> engine -> channel rows with queue-wait histograms,
+        plus per-tenant share-of-device gauges."""
+        with self._lock:
+            rows = {k: dict(r) for k, r in self._rows.items()}
+        total = sum(r["device_seconds"] for r in rows.values())
+        tenants: dict = {}
+        for (t, eng, ch), r in sorted(rows.items()):
+            trec = tenants.setdefault(
+                t, {"device_seconds": 0.0, "share": 0.0, "engines": {}})
+            trec["device_seconds"] += r["device_seconds"]
+            trec["engines"].setdefault(eng, {})[ch] = {
+                "qos_class": r["qos_class"],
+                "device_seconds": r["device_seconds"],
+                "batches": r["batches"], "requests": r["requests"],
+                "stripes": r["stripes"],
+                "queue_wait": r["queue_wait"].dump()}
+        for trec in tenants.values():
+            trec["share"] = (trec["device_seconds"] / total
+                             if total else 0.0)
+        return {"tenants": tenants, "total_device_seconds": total}
+
+    def digest(self) -> dict:
+        """Compact ledger (no histogram buckets) — the MMgrReport
+        ``tenant_usage`` tail and bench.py's qos-section carriage."""
+        with self._lock:
+            rows = {k: dict(r) for k, r in self._rows.items()}
+        total = sum(r["device_seconds"] for r in rows.values())
+        tenants: dict = {}
+        for (t, eng, ch), r in sorted(rows.items()):
+            trec = tenants.setdefault(
+                t, {"device_seconds": 0.0, "share": 0.0, "engines": {}})
+            trec["device_seconds"] += r["device_seconds"]
+            trec["engines"].setdefault(eng, {})[ch] = {
+                "qos_class": r["qos_class"],
+                "device_seconds": round(r["device_seconds"], 9),
+                "batches": r["batches"], "requests": r["requests"],
+                "stripes": r["stripes"],
+                "wait_p99_s": round(r["queue_wait"].quantile(0.99), 6),
+                "wait_sum_s": round(r["queue_wait"].sum, 9),
+                "wait_count": r["queue_wait"].count}
+        for trec in tenants.values():
+            trec["share"] = round(
+                trec["device_seconds"] / total if total else 0.0, 6)
+            trec["device_seconds"] = round(trec["device_seconds"], 9)
+        return {"tenants": tenants,
+                "total_device_seconds": round(total, 9)}
+
+
 class KernelTelemetry:
     """The registry: one KernelStats per kernel name."""
 
@@ -945,6 +1083,7 @@ class KernelTelemetry:
         self.decode_dispatch = DecodeDispatchStats()
         self.mapping = MappingStats()
         self.scrub = ScrubStats()
+        self.tenant = TenantDeviceStats()
         #: block_until_ready before closing each latency sample
         self.fence_for_timing = False
         #: master switch; off-path cost when False is one attribute read
@@ -972,6 +1111,7 @@ class KernelTelemetry:
         self.decode_dispatch.clear()
         self.mapping.clear()
         self.scrub.clear()
+        self.tenant.clear()
 
     def summary(self) -> dict:
         """Compact digest (bench.py prints this next to its JSON)."""
@@ -1056,6 +1196,24 @@ def scrub_dump() -> dict:
 
 def scrub_summary() -> dict:
     return _REG.scrub.summary()
+
+
+def tenant_stats() -> TenantDeviceStats:
+    """The process-global tenant-attributed device-time ledger: both
+    dispatch engines apportion completed batches here by cost tag;
+    ``dump_tenant_usage``, the MMgrReport ``tenant_usage`` tail and
+    the ``ceph_tenant_device_seconds_total`` families read it."""
+    return _REG.tenant
+
+
+def tenant_dump() -> dict:
+    return _REG.tenant.dump()
+
+
+def tenant_usage_digest() -> dict:
+    """Compact per-tenant ledger digest — the MMgrReport carriage and
+    bench.py's qos-section ``tenant_usage`` key."""
+    return _REG.tenant.digest()
 
 
 def mapping_stats() -> MappingStats:
@@ -1146,6 +1304,27 @@ def configure_from_conf(conf) -> None:
             set_profile_ring(ring)
         conf.add_observer("kernel_profile_ring",
                           lambda _n, v: set_profile_ring(v))
+    except KeyError:
+        pass
+    # tenant-ledger knobs: same only-turn-away-from-default rule as the
+    # fence — a later context's default construction must not undo an
+    # operator's `config set` on another daemon in the same process
+    try:
+        if not bool(conf.get("kernel_tenant_ledger_enabled")):
+            _REG.tenant.enabled = False
+        conf.add_observer(
+            "kernel_tenant_ledger_enabled",
+            lambda _n, v: setattr(_REG.tenant, "enabled", bool(v)))
+    except KeyError:
+        pass
+    try:
+        cap = int(conf.get("kernel_tenant_ledger_max_tenants"))
+        if cap != TENANT_LEDGER_MAX_DEFAULT:
+            _REG.tenant.max_tenants = max(1, cap)
+        conf.add_observer(
+            "kernel_tenant_ledger_max_tenants",
+            lambda _n, v: setattr(_REG.tenant, "max_tenants",
+                                  max(1, int(v))))
     except KeyError:
         pass
 

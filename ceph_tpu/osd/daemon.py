@@ -444,6 +444,15 @@ class OSDDaemon(Dispatcher):
             "dump_qos_stats", lambda **kw: self._dump_qos_stats(),
             "per-tenant dmclock accounting: backlog, phase-served "
             "counts, queue-wait totals, applied profiles")
+        from ceph_tpu.ops import telemetry
+        self.ctx.admin.register_command(
+            "dump_tenant_usage",
+            lambda **kw: telemetry.tenant_dump(),
+            "tenant device-time ledger: per-tenant x engine x channel "
+            "device-seconds apportioned from coalesced dispatch "
+            "batches by stripe share, batch/request/stripe counts, "
+            "queue-wait histograms, and share-of-device gauges "
+            "(untagged work lands in the _untagged bucket)")
 
         #: background-integrity accounting (dump_scrub_stats / the
         #: MMgrReport scrub tail / ceph_scrub_* prometheus families)
@@ -578,7 +587,11 @@ class OSDDaemon(Dispatcher):
         for name, row in d["classes"].items():
             lanes[name] = {"backlog": row["backlog"],
                            "served": row["served"],
-                           "wait_sum_s": row["wait_sum_s"]}
+                           "wait_sum_s": row["wait_sum_s"],
+                           # cumulative LATENCY_BOUNDS buckets: the mgr
+                           # slo module diffs these across report
+                           # intervals for a windowed p99 per lane
+                           "wait_buckets": row["wait_buckets"]}
         return {"lanes": lanes, "evicted": d["evicted"]}
 
     @staticmethod
@@ -776,7 +789,8 @@ class OSDDaemon(Dispatcher):
             profile=telemetry.pipeline_profile_digest(),
             qos=self._qos_digest(),
             faults=self.ctx.fault_digest(),
-            scrub=self._scrub_digest_report()))
+            scrub=self._scrub_digest_report(),
+            tenant_usage=telemetry.tenant_usage_digest()))
 
     ROTATING_REFRESH = 60.0
 
@@ -3224,7 +3238,10 @@ class OSDDaemon(Dispatcher):
         window = np.frombuffer(data[s0 * si.width:s1 * si.width],
                                dtype=np.uint8)
         stripes = si.split(window)
-        fut = codec.submit_chunks(engine, stripes)
+        fut = codec.submit_chunks(
+            engine, stripes,
+            cost_tag=(getattr(msg, "qos_tenant", "") or "client",
+                      "client"))
         self.perf.inc("ec_dispatch_submits")
         trk = getattr(msg, "_trk", None)
         if trk is not None:
@@ -3791,9 +3808,14 @@ class OSDDaemon(Dispatcher):
         # the all-data-shards case, so at least one parity shard is in
         # `chosen` and at least one data row is missing
         engine = self.ctx.decode_dispatch_engine()
+        if state["kind"] == "recover":
+            tag = ("recovery", "recovery")
+        else:
+            tag = (getattr(state.get("msg"), "qos_tenant", "")
+                   or "client", "client")
         try:
             fut = codec.submit_decode_chunks(engine, chosen, arr,
-                                             targets)
+                                             targets, cost_tag=tag)
         except (ValueError, IOError):
             return False
         self.perf.inc("ec_decode_submits")
@@ -3940,7 +3962,8 @@ class OSDDaemon(Dispatcher):
             stripes = si.split(np.frombuffer(data, dtype=np.uint8))
             n = codec.get_chunk_count()
             fut = codec.submit_chunks(self.ctx.dispatch_engine(),
-                                      stripes)
+                                      stripes,
+                                      cost_tag=("recovery", "recovery"))
             self.perf.inc("ec_dispatch_submits")
             fut.add_done_callback(
                 lambda f, c=(state, data, si, stripes, n):

@@ -242,8 +242,11 @@ def encode_osdmap(m: OSDMap, *, with_auth: bool = False) -> bytes:
         # v11: per-tenant QoS profiles (dmclock ClientInfo distribution,
         # `ceph qos set/rm/ls`) — every OSD schedules from the same db
         e.bytes(_json.dumps(m.qos_db).encode() if m.qos_db else b"")
+        # v12: per-tenant SLO objectives (`ceph qos slo set/rm/ls`) —
+        # the mgr slo module's burn-rate engine reads them off the map
+        e.bytes(_json.dumps(m.slo_db).encode() if m.slo_db else b"")
 
-    enc.versioned(11, 1, body)
+    enc.versioned(12, 1, body)
     return enc.tobytes()
 
 
@@ -310,7 +313,7 @@ def diff_osdmap(old: OSDMap, new: OSDMap) -> dict:
         encode_crush(new.crush, enc_new)
         inc["crush"] = enc_new.tobytes()
     for attr in ("config_db", "fs_db", "crush_names",
-                 "mgr_db", "mon_db", "qos_db"):
+                 "mgr_db", "mon_db", "qos_db", "slo_db"):
         if getattr(old, attr) != getattr(new, attr):
             inc[attr] = _json.dumps(getattr(new, attr))
     return inc
@@ -352,7 +355,7 @@ def apply_incremental(m: OSDMap, inc: dict) -> None:
     if "crush" in inc:
         m.crush = decode_crush(Decoder(inc["crush"]))
     for attr in ("config_db", "fs_db", "crush_names",
-                 "mgr_db", "mon_db", "qos_db"):
+                 "mgr_db", "mon_db", "qos_db", "slo_db"):
         if attr in inc:
             setattr(m, attr, _json.loads(inc[attr]))
     m.epoch = inc["epoch"]
@@ -388,13 +391,14 @@ def encode_incremental(inc: dict) -> bytes:
                              e2.f64(x.laggy_interval)))
         e.bytes(inc.get("crush", b""))
         for attr in ("config_db", "fs_db", "crush_names",
-                     "mgr_db", "mon_db", "qos_db"):  # mon_db: v2; qos: v3
+                     "mgr_db", "mon_db", "qos_db",
+                     "slo_db"):  # mon_db: v2; qos: v3; slo: v4
             has = attr in inc
             e.u8(1 if has else 0)
             if has:
                 e.bytes(inc[attr].encode())
 
-    enc.versioned(3, 1, body)
+    enc.versioned(4, 1, body)
     return enc.tobytes()
 
 
@@ -443,6 +447,8 @@ def decode_incremental(data: bytes) -> dict:
             side.append("mon_db")
         if version >= 3:
             side.append("qos_db")
+        if version >= 4:
+            side.append("slo_db")
         for attr in side:
             if d.u8():
                 inc[attr] = d.bytes().decode()
@@ -511,6 +517,7 @@ def decode_osdmap(data: bytes) -> OSDMap:
         mgr_db = {}
         mon_db = {}
         qos_db = {}
+        slo_db = {}
         if version >= 6:
             import json as _json
             blob = d.bytes()
@@ -536,9 +543,14 @@ def decode_osdmap(data: bytes) -> OSDMap:
                 blob = d.bytes()
                 if blob:
                     qos_db = _json.loads(blob.decode())
+            if version >= 12:
+                blob = d.bytes()
+                if blob:
+                    slo_db = _json.loads(blob.decode())
         return OSDMap(epoch=epoch, crush=crush, max_osd=max_osd,
                       config_db=config_db, auth_db=auth_db, fs_db=fs_db,
                       mgr_db=mgr_db, mon_db=mon_db, qos_db=qos_db,
+                      slo_db=slo_db,
                       crush_names=crush_names, osd_xinfo=xinfo,
                       osd_state=osd_state, osd_weight=osd_weight,
                       osd_primary_affinity=affinity, osd_addrs=osd_addrs,

@@ -458,10 +458,12 @@ class OSDMapMapping:
                 # the tool path)
                 bm = self.mapper_for(m.crush, csig)
             if engine is not None:
+                from ceph_tpu.ops.dispatch import BACKGROUND_BEST_EFFORT
                 from ceph_tpu.ops.dispatch import submit_do_rule
                 futures.append((pool_id, submit_do_rule(
                     engine, bm, pool.crush_rule, pps, pool.size,
-                    weights)))
+                    weights,
+                    cost_tag=("system", BACKGROUND_BEST_EFFORT))))
             else:
                 raw[pool_id] = np.asarray(bm.do_rule(
                     pool.crush_rule, pps, pool.size, weights))
@@ -542,8 +544,11 @@ class OSDMapMapping:
         if not jobs:
             return
         if engine is not None:
+            from ceph_tpu.ops.dispatch import BACKGROUND_BEST_EFFORT
             from ceph_tpu.ops.dispatch import submit_finish_ladder
-            futs = [(pid, submit_finish_ladder(engine, op))
+            futs = [(pid, submit_finish_ladder(
+                engine, op,
+                cost_tag=("system", BACKGROUND_BEST_EFFORT)))
                     for pid, op in jobs]
             for pid, fut in futs:
                 fused[pid] = np.asarray(fut.result(timeout=120.0))
@@ -1185,9 +1190,12 @@ class SharedPGMappingService:
         try:
             engine = self._engine()
             if engine is not None:
-                from ceph_tpu.ops.dispatch import submit_finish_ladder
+                from ceph_tpu.ops.dispatch import (
+                    BACKGROUND_BEST_EFFORT, submit_finish_ladder)
                 packed = np.asarray(submit_finish_ladder(
-                    engine, ops_).result(timeout=120.0))
+                    engine, ops_,
+                    cost_tag=("system", BACKGROUND_BEST_EFFORT),
+                ).result(timeout=120.0))
             else:
                 packed = pk.run_ladder(ops_)
         except Exception:
@@ -1217,8 +1225,10 @@ class SharedPGMappingService:
         bm = mapping.mapper_for(crush)
         engine = self._engine()
         if engine is not None:
-            from ceph_tpu.ops.dispatch import submit_do_rule
+            from ceph_tpu.ops.dispatch import (
+                BACKGROUND_BEST_EFFORT, submit_do_rule)
             return np.asarray(submit_do_rule(
-                engine, bm, ruleno, xs, numrep,
-                reweight).result(timeout=120.0))
+                engine, bm, ruleno, xs, numrep, reweight,
+                cost_tag=("system", BACKGROUND_BEST_EFFORT),
+            ).result(timeout=120.0))
         return np.asarray(bm.do_rule(ruleno, xs, numrep, reweight))

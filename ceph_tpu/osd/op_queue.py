@@ -46,6 +46,7 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
+from ceph_tpu.ops.telemetry import LATENCY_BOUNDS, Histogram
 from ceph_tpu.qos.dmclock import (
     PHASE_LIMIT, PHASE_RESERVATION, PHASE_WEIGHT)
 
@@ -100,6 +101,11 @@ class _ClassState:
     wait_sum: float = 0.0
     wait_max: float = 0.0
     enqueued: int = 0
+    #: queue-wait distribution (the mgr slo module's p99 source: the
+    #: digest ships cumulative buckets, and windowed bucket DELTAS give
+    #: an exact rolling p99 estimate without per-op samples)
+    wait_hist: Histogram = field(
+        default_factory=lambda: Histogram(LATENCY_BOUNDS))
 
 
 class MClockQueue:
@@ -143,7 +149,8 @@ class MClockQueue:
         self._len = 0
         #: rollup of evicted lanes (bounded: totals only)
         self._evicted = {"classes": 0, "served": [0, 0, 0, 0],
-                         "wait_sum": 0.0, "enqueued": 0}
+                         "wait_sum": 0.0, "enqueued": 0,
+                         "wait_hist": Histogram(LATENCY_BOUNDS)}
 
     def __len__(self) -> int:
         return self._len
@@ -255,6 +262,10 @@ class MClockQueue:
             ev["wait_sum"] += st.wait_sum
             for p in range(4):
                 ev["served"][p] += st.served[p]
+            evh = ev["wait_hist"]
+            for i, c in enumerate(st.wait_hist.buckets):
+                evh.buckets[i] += c
+            evh.sum += st.wait_hist.sum
 
     def _retag(self, st: _ClassState) -> None:
         """Rebuild the class's tag chain under a CHANGED profile
@@ -282,6 +293,7 @@ class MClockQueue:
         wait = max(0.0, now - t_enq)
         st.served[phase] += 1
         st.wait_sum += wait
+        st.wait_hist.add(wait)
         if wait > st.wait_max:
             st.wait_max = wait
         if st.dynamic:
@@ -323,6 +335,7 @@ class MClockQueue:
                            "limit": st.served[PHASE_LIMIT]},
                 "wait_sum_s": st.wait_sum,
                 "wait_max_s": st.wait_max,
+                "wait_buckets": list(st.wait_hist.buckets),
                 "dynamic": st.dynamic,
                 "profile": {"reservation": st.info.reservation,
                             "weight": st.info.weight,
@@ -477,6 +490,8 @@ class ShardedOpQueue:
                 agg["wait_sum_s"] += row["wait_sum_s"]
                 agg["wait_max_s"] = max(agg["wait_max_s"],
                                         row["wait_max_s"])
+                for i, c in enumerate(row["wait_buckets"]):
+                    agg["wait_buckets"][i] += c
                 for ph, n in row["served"].items():
                     agg["served"][ph] += n
                 agg["profile"] = row["profile"]

@@ -162,6 +162,11 @@ class OSDMap:
     #: `ceph qos set/rm` and folded into every OSD's mClock scheduler
     #: on map application — all OSDs agree on the tenant lanes
     qos_db: dict = field(default_factory=dict)
+    #: per-tenant SLO objectives: tenant -> {"reservation_attainment",
+    #: "p99_latency_s", "device_share"}, committed by `ceph qos slo
+    #: set/rm` and consumed by the mgr slo module's burn-rate engine
+    #: (measurement-only — no OSD behavior keys off it)
+    slo_db: dict = field(default_factory=dict)
     #: per-osd laggy history (osd_xinfo_t vector)
     osd_xinfo: list[OSDXInfo] = field(default_factory=list)
 
@@ -179,7 +184,8 @@ class OSDMap:
             setattr(m, attr, list(getattr(self, attr)))
         for attr in ("pools", "pg_upmap", "pg_upmap_items", "pg_temp",
                      "primary_temp", "config_db", "auth_db", "fs_db",
-                     "crush_names", "mgr_db", "mon_db", "qos_db"):
+                     "crush_names", "mgr_db", "mon_db", "qos_db",
+                     "slo_db"):
             setattr(m, attr, dict(getattr(self, attr)))
         return m
 

@@ -164,6 +164,72 @@ class ServiceTracker:
                     "tracked_servers": len(self._servers)}
 
 
+#: SLO objective kinds (the slo_db record schema + the ``objective``
+#: label of the ceph_slo_burn_rate prometheus family)
+SLO_ATTAINMENT = "reservation_attainment"   # floor: fraction in [0, 1]
+SLO_P99_LATENCY = "p99_latency_s"           # ceiling: seconds
+SLO_DEVICE_SHARE = "device_share"           # ceiling: fraction in [0, 1]
+
+SLO_OBJECTIVES = (SLO_ATTAINMENT, SLO_P99_LATENCY, SLO_DEVICE_SHARE)
+
+
+@dataclass
+class SloObjective:
+    """Per-tenant SLO record ``ceph qos slo set`` commits into the
+    OSDMap's slo_db (alongside qos_db) and the mgr slo module evaluates
+    as multi-window burn rates.  Any objective left at 0 is undeclared
+    and never evaluated:
+
+      reservation_attainment  floor on the fraction of the tenant's
+                              dmclock reservation actually attained
+                              (reservation-phase service rate / r)
+      p99_latency_s           ceiling on the tenant lane's p99 queue
+                              wait, seconds
+      device_share            ceiling on the tenant's share of total
+                              attributed device-seconds
+    """
+
+    reservation_attainment: float = 0.0
+    p99_latency_s: float = 0.0
+    device_share: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {SLO_ATTAINMENT: self.reservation_attainment,
+                SLO_P99_LATENCY: self.p99_latency_s,
+                SLO_DEVICE_SHARE: self.device_share}
+
+    @staticmethod
+    def from_dict(d: dict) -> "SloObjective":
+        return SloObjective(
+            reservation_attainment=float(d.get(SLO_ATTAINMENT, 0.0)),
+            p99_latency_s=float(d.get(SLO_P99_LATENCY, 0.0)),
+            device_share=float(d.get(SLO_DEVICE_SHARE, 0.0)))
+
+    def validate(self) -> None:
+        if not 0.0 <= self.reservation_attainment <= 1.0:
+            raise ValueError(
+                "reservation_attainment must be within [0, 1]")
+        if self.p99_latency_s < 0:
+            raise ValueError("p99_latency_s must be >= 0")
+        if not 0.0 <= self.device_share <= 1.0:
+            raise ValueError("device_share must be within [0, 1]")
+        if not any((self.reservation_attainment, self.p99_latency_s,
+                    self.device_share)):
+            raise ValueError("at least one objective must be set")
+
+
+def slos_from_db(slo_db: dict) -> dict[str, SloObjective]:
+    """Decode the OSDMap slo_db (tenant -> plain dict) into objectives;
+    malformed entries are skipped rather than wedging map application."""
+    out: dict[str, SloObjective] = {}
+    for tenant, rec in (slo_db or {}).items():
+        try:
+            out[str(tenant)] = SloObjective.from_dict(rec)
+        except (TypeError, ValueError, AttributeError):
+            continue
+    return out
+
+
 def profiles_from_db(qos_db: dict) -> dict[str, QosProfile]:
     """Decode the OSDMap qos_db (tenant -> plain dict) into profiles;
     malformed entries are skipped rather than wedging map application."""

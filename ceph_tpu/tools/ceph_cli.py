@@ -74,6 +74,11 @@ COMMANDS = {
     ("qos", "set"): ["tenant"],
     ("qos", "rm"): ["tenant"],
     ("qos", "ls"): [],
+    ("qos", "slo", "set"): ["tenant"],
+    ("qos", "slo", "rm"): ["tenant"],
+    ("qos", "slo", "ls"): [],
+    ("slo", "status"): [],
+    ("usage", "top"): [],
 }
 
 #: prefixes served by the active MGR (re-targeted via `mgr dump`),
@@ -82,7 +87,8 @@ MGR_COMMANDS = {"pg dump", "pg ls", "iostat", "df", "balancer status",
                 "balancer optimize", "telemetry show",
                 "mgr module ls", "mgr module enable",
                 "mgr module disable", "osd pool autoscale-status",
-                "tracing ls", "tracing show", "slow_ops"}
+                "tracing ls", "tracing show", "slow_ops",
+                "slo status", "usage top"}
 
 
 def parse_command(words: list[str]) -> dict:

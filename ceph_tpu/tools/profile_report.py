@@ -11,6 +11,13 @@ view:
     (cluster-merged), and
   * a whole bench JSON line (the "profile" key is found and used).
 
+It also accepts the tenant device-time ledger's surfaces — the
+``dump_tenant_usage`` admin output, the MMgrReport ``tenant_usage``
+digest, the mgr slo module's ``usage top`` merge, or a bench JSON
+line carrying a ``tenant_usage`` key — and renders a per-tenant
+where-did-the-DEVICE-go table (device-seconds, cluster share, and
+the per-engine/channel split) next to the phase table.
+
 Output: per engine × kernel family, total attributed seconds and the
 percentage each phase contributed (queue-wait, build, place, launch,
 compute, materialize, deliver), the compile ledger (first-call jit
@@ -85,6 +92,82 @@ def _pct(s: float, total: float) -> str:
     return f"{100.0 * s / total:5.1f}%" if total else "    --"
 
 
+def normalize_tenant(doc: dict) -> dict | None:
+    """Any tenant-usage surface -> {"tenants": {tenant:
+    {"device_seconds", "channels": {(engine, channel): row}}},
+    "total"} — or None when the document carries no tenant ledger.
+
+    Accepts the admin dump / MMgrReport digest (``tenants`` mapping),
+    the slo module's ``usage top`` output (``tenants`` LIST of ranked
+    rows), and any wrapper carrying a ``tenant_usage`` key (a bench
+    JSON line)."""
+    if isinstance(doc.get("tenant_usage"), dict):
+        doc = doc["tenant_usage"]
+    tenants = doc.get("tenants")
+    if tenants is None:
+        return None
+    if isinstance(tenants, list):     # `usage top` ranked rows
+        tenants = {r.get("tenant", "?"): r for r in tenants
+                   if isinstance(r, dict)}
+    if not isinstance(tenants, dict):
+        return None
+    out: dict = {}
+    total = float(doc.get("total_device_seconds", 0.0) or 0.0)
+    for tenant, trec in tenants.items():
+        if not isinstance(trec, dict):
+            continue
+        channels = {}
+        for eng, chans in (trec.get("engines") or {}).items():
+            for ch, row in (chans or {}).items():
+                channels[(eng, ch)] = row
+        out[str(tenant)] = {
+            "device_seconds": float(trec.get("device_seconds", 0.0)),
+            "channels": channels}
+    if not total:
+        total = sum(t["device_seconds"] for t in out.values())
+    return {"tenants": out, "total": total}
+
+
+def render_tenant(doc: dict) -> str | None:
+    """The per-tenant where-did-the-device-go table, or None when the
+    document carries no tenant ledger."""
+    n = normalize_tenant(doc)
+    if n is None:
+        return None
+    lines: list[str] = []
+    header = (f"{'tenant':<20} {'device_s':>10} {'share':>7} "
+              f"{'engine':<8} {'channel':<14} {'chan_s':>10} "
+              f"{'batches':>8} {'requests':>9}")
+    lines.append("tenant device-time ledger (busy integral "
+                 "apportioned by stripe share):")
+    lines.append(header)
+    lines.append("-" * len(header))
+    total = n["total"]
+    ranked = sorted(n["tenants"].items(),
+                    key=lambda kv: -kv[1]["device_seconds"])
+    for tenant, trec in ranked:
+        first = True
+        chans = sorted(trec["channels"].items()) or [((None, None), {})]
+        for (eng, ch), row in chans:
+            head = (f"{tenant:<20} {trec['device_seconds']:>10.4f} "
+                    f"{_pct(trec['device_seconds'], total):>7}"
+                    if first else f"{'':<20} {'':>10} {'':>7}")
+            first = False
+            if eng is None:
+                lines.append(head)
+                continue
+            lines.append(
+                f"{head} {eng:<8} {ch:<14} "
+                f"{row.get('device_seconds', 0.0):>10.4f} "
+                f"{row.get('batches', 0):>8} "
+                f"{row.get('requests', 0):>9}")
+    if not ranked:
+        lines.append("(no tenant-attributed device time in this "
+                     "window)")
+    lines.append(f"{'total':<20} {total:>10.4f}")
+    return "\n".join(lines)
+
+
 def render(doc: dict) -> str:
     """The where-did-the-time-go table, as one printable string."""
     n = normalize(doc)
@@ -139,6 +222,10 @@ def render(doc: dict) -> str:
         lines.append("")
         lines.append(f"mapping epochs ({mp.get('epochs', 0)} computed,"
                      f" {total:.4f}s): {cells}")
+    tenant = render_tenant(doc)
+    if tenant is not None:
+        lines.append("")
+        lines.append(tenant)
     return "\n".join(lines)
 
 

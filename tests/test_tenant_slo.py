@@ -1,0 +1,543 @@
+"""Tenant-attributed device time + SLO burn rates: the cost_tag
+ledger's conservation property, slo_db map distribution (full +
+incremental codec) and the mon `qos slo` command tier, the slo
+module's multi-window burn-rate math, the ceph_tenant_* /
+ceph_slo_burn_rate prometheus families (including a hostile tenant
+name through the real scrape parser), profile_report's per-tenant
+table, and the e2e gate: a hog violating its SLO on a live
+MiniCluster raises QOS_SLO_BURN for exactly that tenant and clears
+once the pressure stops."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from test_kernel_telemetry import parse_exposition            # noqa: E402
+from test_qos_fairness import (                               # noqa: E402
+    _install_service_delay, _Pump, _set_profiles,
+    _wait_profiles_applied)
+
+from ceph_tpu.msg.encoding import Decoder, Encoder            # noqa: E402
+from ceph_tpu.ops.telemetry import LATENCY_BOUNDS             # noqa: E402
+from ceph_tpu.osd.map_codec import (                          # noqa: E402
+    apply_incremental, decode_incremental, decode_osdmap, diff_osdmap,
+    encode_incremental, encode_osdmap)
+from ceph_tpu.osd.osdmap import OSDMap                        # noqa: E402
+from ceph_tpu.tools.vstart import MiniCluster                 # noqa: E402
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+EVIL_TENANT = 'evil"tenant\n\\'
+
+
+# -- slo_db distribution ------------------------------------------------------
+
+def test_osdmap_codec_carries_slo_db():
+    m = OSDMap(epoch=3)
+    m.set_max_osd(2)
+    m.slo_db = {"gold": {"reservation_attainment": 0.95,
+                         "p99_latency_s": 0.05, "device_share": 0.0}}
+    got = decode_osdmap(encode_osdmap(m))
+    assert got.slo_db == m.slo_db
+    # copy() duplicates the db (mon _mutate mutates the copy)
+    c = m.copy()
+    c.slo_db["hog"] = {"reservation_attainment": 0.0,
+                       "p99_latency_s": 0.01, "device_share": 0.0}
+    assert "hog" not in m.slo_db
+
+
+def test_incremental_carries_slo_db():
+    old = OSDMap(epoch=5)
+    old.set_max_osd(2)
+    new = old.copy()
+    new.epoch = 6
+    new.slo_db = {"gold": {"reservation_attainment": 0.9,
+                           "p99_latency_s": 0.0, "device_share": 0.5}}
+    inc = diff_osdmap(old, new)
+    assert "slo_db" in inc
+    dec = decode_incremental(encode_incremental(inc))
+    m = old.copy()
+    apply_incremental(m, dec)
+    assert m.epoch == 6 and m.slo_db == new.slo_db
+    # removal distributes too
+    newer = new.copy()
+    newer.epoch = 7
+    newer.slo_db = {}
+    inc2 = decode_incremental(encode_incremental(
+        diff_osdmap(new, newer)))
+    apply_incremental(m, inc2)
+    assert m.slo_db == {}
+
+
+def test_mon_qos_slo_commands():
+    cluster = MiniCluster(n_osds=1, ms_type="loopback").start()
+    try:
+        cluster.wait_for_osd_count(1)
+        client = cluster.client(timeout=15.0)
+        rc, out = client.mon_command(
+            {"prefix": "qos slo set", "tenant": "gold",
+             "reservation_attainment": 0.95, "p99_latency_s": 0.05})
+        assert rc == 0, out
+        # validation: fractions in [0,1], at least one objective set
+        rc, _ = client.mon_command(
+            {"prefix": "qos slo set", "tenant": "bad",
+             "reservation_attainment": 1.5})
+        assert rc == -22
+        rc, _ = client.mon_command(
+            {"prefix": "qos slo set", "tenant": "bad"})
+        assert rc == -22
+        rc, out = client.mon_command({"prefix": "qos slo ls"})
+        assert rc == 0
+        db = json.loads(out)
+        assert set(db) == {"gold"}
+        assert db["gold"]["reservation_attainment"] == 0.95
+        assert db["gold"]["p99_latency_s"] == 0.05
+        rc, _ = client.mon_command({"prefix": "qos slo rm",
+                                    "tenant": "gold"})
+        assert rc == 0
+        rc, _ = client.mon_command({"prefix": "qos slo rm",
+                                    "tenant": "gold"})
+        assert rc == -2
+        rc, out = client.mon_command({"prefix": "qos slo ls"})
+        assert json.loads(out) == {}
+    finally:
+        cluster.stop()
+
+
+# -- conservation property ----------------------------------------------------
+
+def test_tenant_ledger_conserves_busy_seconds():
+    """Sum over tenant rows equals the engines' busy-seconds integral
+    (within 5%), with untagged traffic visible in _untagged and scrub
+    riding as background_best_effort — nothing silently vanishes."""
+    from ceph_tpu.common.context import CephTpuContext
+    from ceph_tpu.ec import registry_instance
+    from ceph_tpu.ops import telemetry
+    from ceph_tpu.ops.dispatch import BACKGROUND_BEST_EFFORT
+
+    telemetry.tenant_stats().clear()
+    b0 = (telemetry.dispatch_stats().phases.busy_seconds
+          + telemetry.decode_dispatch_stats().phases.busy_seconds)
+    k, m = 4, 2
+    codec = registry_instance().factory(
+        "isa", {"technique": "cauchy", "k": str(k), "m": str(m)})
+    ctx = CephTpuContext("test-tenant-ledger")
+    eng = ctx.dispatch_engine()
+    deng = ctx.decode_dispatch_engine()
+    rng = np.random.default_rng(11)
+    op = rng.integers(0, 256, (8, k, 512), dtype=np.uint8)
+    futs = []
+    for tenant in ("hog", "gold", "silver", "bronze"):
+        futs.extend(codec.submit_chunks(eng, op,
+                                        cost_tag=(tenant, "client"))
+                    for _ in range(3))
+    # scrub-style background work and an untagged straggler
+    futs.append(codec.submit_chunks(
+        eng, op,
+        cost_tag=(BACKGROUND_BEST_EFFORT, BACKGROUND_BEST_EFFORT)))
+    futs.append(codec.submit_chunks(eng, op))
+    chosen = tuple(c for c in range(k + m) if c != 0)[:k]
+    futs.append(codec.submit_decode_chunks(
+        deng, chosen, op, (0,), cost_tag=("gold", "client")))
+    for f in futs:
+        f.result(timeout=120)
+    eng.flush()
+    deng.flush()
+    eng.stop()
+    deng.stop()
+    busy = (telemetry.dispatch_stats().phases.busy_seconds
+            + telemetry.decode_dispatch_stats().phases.busy_seconds
+            - b0)
+    ledger = telemetry.tenant_stats().total_device_seconds()
+    assert busy > 0
+    assert abs(ledger - busy) <= 0.05 * busy, (ledger, busy)
+    digest = telemetry.tenant_usage_digest()
+    tenants = digest["tenants"]
+    assert {"hog", "gold", "silver", "bronze",
+            BACKGROUND_BEST_EFFORT, "_untagged"} <= set(tenants)
+    # shares sum to ~1 (the _untagged bucket keeps the total honest)
+    assert abs(sum(t["share"] for t in tenants.values()) - 1.0) < 0.01
+    # the decode channel shows up under its own engine for gold
+    assert "decode" in tenants["gold"]["engines"]
+    # the full dump carries queue-wait histograms per channel
+    dump = telemetry.tenant_dump()
+    row = dump["tenants"]["gold"]["engines"]["encode"]
+    ch = next(iter(row.values()))
+    assert "queue_wait" in ch and ch["queue_wait"]["count"] >= 3
+
+
+# -- burn-rate engine (unit) --------------------------------------------------
+
+class _SloStubMgr:
+    """Controllable feeds for the slo module: mutate .tenant_feed /
+    .qos_feed / .osdmap between ticks."""
+
+    class _Map:
+        def __init__(self):
+            self.slo_db = {}
+            self.qos_db = {}
+
+    def __init__(self):
+        self.osdmap = self._Map()
+        self.tenant_feed = {}
+        self.qos_feed = {}
+
+    def get(self, name):
+        return {"tenant_feed": self.tenant_feed,
+                "qos_feed": self.qos_feed}[name]
+
+    def get_store(self, key, default=None):
+        return default
+
+
+def _lane(served_res, served_weight, backlog=0, buckets=None):
+    return {"served": {"reservation": served_res,
+                       "weight": served_weight, "limit": 0},
+            "backlog": backlog,
+            "wait_buckets": buckets or [0] * (len(LATENCY_BOUNDS) + 1)}
+
+
+def _bucket_counts(value_s, n):
+    """n samples all landing in the bucket covering value_s."""
+    counts = [0] * (len(LATENCY_BOUNDS) + 1)
+    for i, b in enumerate(LATENCY_BOUNDS):
+        if value_s <= b:
+            counts[i] = n
+            return counts
+    counts[-1] = n
+    return counts
+
+
+def test_slo_burn_math_and_multi_window_rule():
+    from ceph_tpu.mgr.modules.slo import Module
+
+    stub = _SloStubMgr()
+    stub.osdmap.slo_db = {
+        "gold": {"reservation_attainment": 0.9, "p99_latency_s": 0.0,
+                 "device_share": 0.0},
+        "hog": {"reservation_attainment": 0.0, "p99_latency_s": 0.01,
+                "device_share": 0.0},
+        "pig": {"reservation_attainment": 0.0, "p99_latency_s": 0.0,
+                "device_share": 0.5},
+        "idle": {"reservation_attainment": 0.9, "p99_latency_s": 0.0,
+                 "device_share": 0.0},
+    }
+    stub.osdmap.qos_db = {
+        "gold": {"reservation": 100.0, "weight": 1.0, "limit": 0.0},
+        "idle": {"reservation": 100.0, "weight": 1.0, "limit": 0.0}}
+    mod = Module(stub)
+    t0 = 1000.0
+    stub.qos_feed = {0: {"lanes": {
+        "client.gold": _lane(0, 0), "client.hog": _lane(0, 0),
+        "client.idle": _lane(0, 0)}}}
+    stub.tenant_feed = {0: {"tenants": {}, "total_device_seconds": 0.0}}
+    mod.tick(t0)
+    # 10 s later: gold attained 20% of its floor, hog's window p99 sits
+    # at 50 ms vs a 10 ms ceiling, pig took 80% of the device vs 50%
+    stub.qos_feed = {0: {"lanes": {
+        "client.gold": _lane(200, 800, backlog=5),
+        "client.hog": _lane(0, 500,
+                            buckets=_bucket_counts(0.05, 100)),
+        "client.idle": _lane(0, 0)}}}
+    stub.tenant_feed = {0: {
+        "tenants": {"pig": {"device_seconds": 8.0, "share": 0.8,
+                            "engines": {}},
+                    "_untagged": {"device_seconds": 2.0, "share": 0.2,
+                                  "engines": {}}},
+        "total_device_seconds": 10.0}}
+    mod.tick(t0 + 10.0)
+    st = mod.status(now=t0 + 10.0)
+    gold = st["tenants"]["gold"]["burn"]["reservation_attainment"]
+    # attained 0.2 against a 0.9 floor: burn = 0.8 / 0.1 = 8
+    assert abs(gold["fast"] - 8.0) < 0.1, gold
+    hog = st["tenants"]["hog"]["burn"]["p99_latency_s"]
+    assert abs(hog["fast"] - 5.0) < 0.1, hog       # 0.05 / 0.01
+    pig = st["tenants"]["pig"]["burn"]["device_share"]
+    assert abs(pig["fast"] - 1.6) < 0.01, pig      # 0.8 / 0.5
+    # demand gate: idle declared a floor but had no traffic -> vacuous
+    idle = st["tenants"]["idle"]["burn"]["reservation_attainment"]
+    assert idle["fast"] == 0.0
+    assert st["tenants"]["idle"]["burning"] == []
+    # both windows cover the damage interval -> burning
+    assert st["tenants"]["gold"]["burning"] == ["reservation_attainment"]
+    assert st["tenants"]["hog"]["burning"] == ["p99_latency_s"]
+    checks = mod.health_checks()
+    assert checks and checks[0]["check"] == "QOS_SLO_BURN"
+    assert set(checks[0]["tenants"]) == {"gold", "hog", "pig"}
+    # gauges mirror the fast burns
+    g = mod.burn_gauges()
+    assert abs(g["hog"]["p99_latency_s"] - 5.0) < 0.1
+    # pressure stops: counters freeze and gold's backlog drains (a
+    # standing backlog would rightly keep its attainment floor
+    # burning).  Once the fast window's base is a post-damage sample
+    # the fast burn drops to 0 and the alert clears even though the
+    # slow window still covers the violation.
+    stub.qos_feed = {0: {"lanes": {
+        "client.gold": _lane(200, 800),
+        "client.hog": _lane(0, 500,
+                            buckets=_bucket_counts(0.05, 100)),
+        "client.idle": _lane(0, 0)}}}
+    mod.tick(t0 + 400.0)
+    mod.tick(t0 + 800.0)
+    st2 = mod.status(now=t0 + 800.0)
+    assert st2["tenants"]["hog"]["burn"]["p99_latency_s"]["fast"] == 0.0
+    assert all(not rec["burning"] for rec in st2["tenants"].values())
+    assert mod.health_checks() == []
+
+
+def test_slo_module_merges_feeds_by_insights_rule():
+    """Byte-identical tenant digests (shared in-process registry)
+    contribute ONCE with every reporter listed; distinct digests and
+    qos lanes SUM across OSDs."""
+    from ceph_tpu.mgr.modules.slo import Module
+
+    stub = _SloStubMgr()
+    same = {"tenants": {"gold": {"device_seconds": 4.0, "share": 1.0,
+                                 "engines": {}}},
+            "total_device_seconds": 4.0}
+    stub.tenant_feed = {0: json.loads(json.dumps(same)),
+                        1: json.loads(json.dumps(same)),
+                        2: {"tenants": {"gold": {"device_seconds": 1.0,
+                                                 "share": 1.0,
+                                                 "engines": {}}},
+                            "total_device_seconds": 1.0}}
+    stub.qos_feed = {0: {"lanes": {"client.gold": _lane(5, 10)}},
+                     1: {"lanes": {"client.gold": _lane(7, 20)}}}
+    mod = Module(stub)
+    merged = mod._tenant_usage_merged()
+    # 4.0 once (dedup) + 1.0 distinct = 5.0, NOT 9.0
+    assert abs(merged["total_device_seconds"] - 5.0) < 1e-9
+    assert merged["tenants"]["gold"]["device_seconds"] == 5.0
+    assert merged["reported_by"] == [0, 1, 2]
+    lanes = mod._lanes_merged()
+    assert lanes["gold"]["served_res"] == 12
+    assert lanes["gold"]["served_total"] == 42
+    top = mod.usage_top()
+    assert top["tenants"][0]["tenant"] == "gold"
+    assert set(top["tenants"][0]["reported_by"]) == {0, 1, 2}
+
+
+# -- exporter surfaces --------------------------------------------------------
+
+def _unescape_label(v: str) -> str:
+    out, i = [], 0
+    while i < len(v):
+        if v[i] == "\\" and i + 1 < len(v):
+            out.append({"n": "\n", "\\": "\\", '"': '"'}.get(
+                v[i + 1], v[i + 1]))
+            i += 2
+        else:
+            out.append(v[i])
+            i += 1
+    return "".join(out)
+
+
+def test_prometheus_tenant_and_slo_families_survive_evil_names():
+    from ceph_tpu.mgr.modules.prometheus import Module
+
+    class _SloStub:
+        def burn_gauges(self):
+            return {EVIL_TENANT: {"p99_latency_s": 2.5}}
+
+    class _Mgr:
+        class _Map:
+            max_osd = 1
+            epoch = 1
+            osd_weight = [0x10000]
+            slo_db = {EVIL_TENANT: {"p99_latency_s": 0.01}}
+
+            def is_up(self, o):
+                return True
+
+            def exists(self, o):
+                return True
+
+        osdmap = _Map()
+
+        def get(self, name):
+            return {
+                "health": {"status": "HEALTH_OK"},
+                "pg_summary": {},
+                "df": {"total_objects": 0, "total_bytes_used": 0},
+                "counters": {},
+                "perf_reports": {},
+                "tenant_feed": {0: {
+                    "tenants": {EVIL_TENANT: {
+                        "device_seconds": 1.5, "share": 0.75,
+                        "engines": {"encode": {"ec_encode": {
+                            "qos_class": "client",
+                            "device_seconds": 1.5, "batches": 2,
+                            "requests": 9}}}}},
+                    "total_device_seconds": 2.0}},
+            }[name]
+
+        def get_store(self, key, default=None):
+            return default
+
+        def _module(self, name):
+            assert name == "slo"
+            return _SloStub()
+
+    mod = Module.__new__(Module)
+    mod.mgr = _Mgr()
+    text = mod.scrape_text()
+    fams = parse_exposition(text)     # raises on any malformed line
+    for fam, typ in (("ceph_tenant_device_share", "gauge"),
+                     ("ceph_tenant_device_seconds_total", "counter"),
+                     ("ceph_tenant_requests_total", "counter"),
+                     ("ceph_slo_burn_rate", "gauge")):
+        assert fam in fams and fams[fam]["type"] == typ, fam
+    share = fams["ceph_tenant_device_share"]["samples"][0]
+    assert _unescape_label(share[1]["tenant"]) == EVIL_TENANT
+    assert share[2] == 0.75
+    ds = {(_unescape_label(s[1]["tenant"]), s[1]["engine"],
+           s[1]["channel"]): s[2]
+          for s in fams["ceph_tenant_device_seconds_total"]["samples"]}
+    assert ds[(EVIL_TENANT, "encode", "ec_encode")] == 1.5
+    burn = fams["ceph_slo_burn_rate"]["samples"][0]
+    assert _unescape_label(burn[1]["tenant"]) == EVIL_TENANT
+    assert burn[1]["objective"] == "p99_latency_s"
+    assert burn[2] == 2.5
+
+
+def test_profile_report_renders_tenant_table():
+    from ceph_tpu.tools.profile_report import render, render_tenant
+
+    digest = {"tenants": {
+        "gold": {"device_seconds": 0.12, "share": 0.6,
+                 "engines": {"encode": {"ec_encode": {
+                     "qos_class": "client", "device_seconds": 0.12,
+                     "batches": 4, "requests": 9}}}},
+        "_untagged": {"device_seconds": 0.08, "share": 0.4,
+                      "engines": {}}},
+        "total_device_seconds": 0.2}
+    # admin-dump / MMgrReport digest shape
+    out = render_tenant(digest)
+    assert "gold" in out and "_untagged" in out and "ec_encode" in out
+    # bench JSON line wrapper
+    assert "gold" in render({"tenant_usage": digest})
+    # `usage top` ranked-rows shape
+    top = {"tenants": [{"tenant": "gold", "device_seconds": 0.12,
+                        "engines": {}}],
+           "total_device_seconds": 0.2}
+    assert "gold" in render_tenant(top)
+    # no ledger -> no table, and render() omits the section
+    assert render_tenant({"engines": {}}) is None
+
+
+# -- e2e: burn fires, names the right tenant, clears --------------------------
+
+def _wait(cond, timeout=20.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_slo_burn_e2e_fires_for_the_violated_tenant_and_clears():
+    """The acceptance gate: 4 tenants over an EC pool on 3 OSDs with a
+    live mgr; the hog floods past its own p99 objective and
+    QOS_SLO_BURN names exactly the hog within the (shrunken) fast
+    window; `slo status` and `usage top` tell the same story from at
+    least two OSDs' merged feeds; stopping the hog clears the alert."""
+    cluster = MiniCluster(
+        n_osds=3, ms_type="loopback",
+        osd_conf={"osd_op_num_shards": 1}).start()
+    try:
+        mgr = cluster.run_mgr()
+        for oid in list(cluster.osds):
+            cluster.kill_osd(oid)
+            cluster.run_osd(oid)
+        cluster.wait_for_osd_count(3)
+        client = cluster.client(timeout=30.0)
+        pool = cluster.create_pool(client, pg_num=8,
+                                   pool_type="erasure", k=2, m=1)
+        profiles = {"hog": {"weight": 8.0},
+                    "gold": {"reservation": 50.0, "weight": 0.01},
+                    "silver": {"weight": 2.0},
+                    "bronze": {"weight": 4.0}}
+        _set_profiles(client, profiles)
+        _wait_profiles_applied(cluster, profiles)
+        for osd in cluster.osds.values():
+            _install_service_delay(osd, 0.002)
+        # hog: a p99 queue-wait ceiling its own flood tramples;
+        # gold: a generous ceiling nobody can violate (bounds cap 1 s)
+        rc, out = client.mon_command(
+            {"prefix": "qos slo set", "tenant": "hog",
+             "p99_latency_s": 0.0001})
+        assert rc == 0, out
+        rc, out = client.mon_command(
+            {"prefix": "qos slo set", "tenant": "gold",
+             "p99_latency_s": 10.0})
+        assert rc == 0, out
+        assert _wait(lambda: "hog" in (mgr.osdmap.slo_db or {})), \
+            mgr.osdmap.slo_db
+        # shrink the windows so the gate runs in seconds; the module
+        # reads these through the mon config-key store
+        mgr.set_store("mgr/slo/mgr_slo_fast_window_s", 1.5)
+        mgr.set_store("mgr/slo/mgr_slo_slow_window_s", 4.0)
+        slo = mgr._module("slo")
+        slo.tick(time.time())            # pre-flood baseline
+        pumps = {t: _Pump(client, pool, t, n).start()
+                 for t, n in (("hog", 8), ("gold", 2),
+                              ("silver", 2), ("bronze", 2))}
+        try:
+            def burning_hog():
+                slo.tick(time.time())
+                st = slo.status()
+                return st["tenants"]["hog"]["burning"] == \
+                    ["p99_latency_s"]
+            # fires within the fast window (plus report latency)
+            assert _wait(burning_hog, timeout=20.0, interval=0.4)
+            st = slo.status()
+            # exactly the violated tenant: gold's generous objective
+            # never burns
+            assert st["tenants"]["gold"]["burning"] == [], st
+            health = mgr.health()
+            slo_checks = [c for c in health["checks"]
+                          if c["check"] == "QOS_SLO_BURN"]
+            assert slo_checks, health
+            assert set(slo_checks[0]["tenants"]) == {"hog"}
+            assert health["status"] in ("HEALTH_WARN", "HEALTH_ERR")
+            # the command tier tells the same story
+            out, rc = mgr._handle_command({"prefix": "slo status"})
+            assert rc == 0
+            assert json.loads(out)["tenants"]["hog"]["burning"] == \
+                ["p99_latency_s"]
+            out, rc = mgr._handle_command({"prefix": "usage top"})
+            assert rc == 0
+            top = json.loads(out)
+            names = [r["tenant"] for r in top["tenants"]]
+            assert "hog" in names, top
+            # merged from at least two OSDs' feeds (byte-identical
+            # in-process digests dedup but list every reporter)
+            assert len(top["reported_by"]) >= 2, top
+            hog_row = next(r for r in top["tenants"]
+                           if r["tenant"] == "hog")
+            assert len(hog_row["reported_by"]) >= 2, hog_row
+            assert hog_row["device_seconds"] > 0
+        finally:
+            for p in pumps.values():
+                p.halt()
+            for p in pumps.values():
+                p.join()
+
+        def cleared():
+            slo.tick(time.time())
+            return not slo.health_checks()
+        # once the fast window's base post-dates the flood the burn
+        # drops to 0 and the warning clears
+        assert _wait(cleared, timeout=20.0, interval=0.4)
+        st = slo.status()
+        assert st["tenants"]["hog"]["burning"] == [], st
+    finally:
+        cluster.stop()
