@@ -44,10 +44,15 @@ Sections: the run is split into named sweeps selectable with
                   encode/decode burst + a few mapping epochs, emitting
                   the where-did-the-time-go digest (phase shares,
                   compile seconds, utilization) into the JSON
+  objectstore     device-resident objectstore write path: on-disk
+                  bluestore write/read MB/s scalar vs the
+                  bluestore_data checksum channel, the isolated
+                  csum-settle micro, and the tpu_bitplane compression
+                  leg — bit-verified against the host oracles
 
 Default (no flag) runs every section EXCEPT map_churn and profile —
 byte-compatible with the historical flagship JSON; ``--sections all``
-adds both.
+adds the opt-ins.
 """
 
 from __future__ import annotations
@@ -659,7 +664,7 @@ def placement_digest(crush_map, rid: int, bm, reweight: np.ndarray,
 
 
 SECTIONS = ("ec", "crush", "dispatch_sweep", "recovery_sweep",
-            "map_churn", "profile", "qos", "scrub")
+            "map_churn", "profile", "qos", "scrub", "objectstore")
 #: the historical flagship run (map_churn is opt-in: it is a
 #: consumption-path sweep, not a device-kernel headline)
 DEFAULT_SECTIONS = ("ec", "crush", "dispatch_sweep", "recovery_sweep")
@@ -940,6 +945,224 @@ def scrub_section(n_objects: int = 384, obj_bytes: int = 8192,
     return {"digest": digest, "fairness": fairness}
 
 
+def objectstore_section(n_objects: int = 96,
+                        obj_bytes: int = 65536) -> dict:
+    """Device-resident objectstore write path (--sections
+    objectstore; validated standalone).  Three sub-sweeps over a real
+    on-disk BlueStoreLite:
+
+    (a) write+read MB/s: the seed's scalar per-block ``zlib.crc32``
+        store vs one whose commits settle checksums through the
+        ``bluestore_data`` channel (batched reads verify through the
+        same channel); every committed checksum in the batched store
+        is re-verified against host zlib.crc32 of the stored bytes,
+        and every read is byte-compared against the written payloads;
+
+    (b) csum settle micro: the channel's digest call vs the host crc32
+        loop over identical staged payloads — the isolated quantity
+        the channel accelerates, free of fsync/KV noise;
+
+    (c) compression-on head-to-head: the seed scalar path with the
+        registry's host zlib plugin vs the device store with
+        tpu_bitplane (plane extraction batched per commit), same
+        6-bit payloads, ``compression_mode=force`` both sides —
+        write+read MB/s, stored-byte ratios, round-trip and csum
+        verification.  Read-side channel verification is priced by
+        (a)/(b); here it is disabled so the leg isolates the
+        compressor comparison."""
+    import os as _os
+    import shutil as _shutil
+    import tempfile
+    import zlib as _zlib
+
+    from ceph_tpu.common.context import CephTpuContext
+    from ceph_tpu.objectstore.bluestore import (
+        BLOCK, BlueStoreLite)
+    from ceph_tpu.objectstore.transaction import Transaction
+
+    rng = np.random.default_rng(23)
+    # 6-bit payloads: two provably-zero bit planes, so the bitplane
+    # leg clearly clears the required-ratio gate; the csum legs are
+    # content-agnostic
+    payloads = [rng.integers(0, 64, obj_bytes,
+                             dtype=np.uint8).tobytes()
+                for _ in range(n_objects)]
+    total = n_objects * obj_bytes
+    group = 8   # objects per transaction -> blocks per digest batch
+
+    base = tempfile.mkdtemp(prefix="bench-objstore-")
+    ctx = CephTpuContext("bench-objectstore")
+    ctx.conf.set("bluestore_batched_csum_min", "1", source="cli")
+
+    def mkstore(name, use_ctx):
+        path = _os.path.join(base, name)
+        s = BlueStoreLite(path, ctx=ctx if use_ctx else None)
+        s.mkfs()
+        s.mount()
+        t = Transaction().create_collection("2.0")
+        s.apply_transaction(t)
+        return s
+
+    def write_all(store):
+        t0 = time.perf_counter()
+        for i in range(0, n_objects, group):
+            txn = Transaction()
+            for j in range(i, min(i + group, n_objects)):
+                txn.write("2.0", f"obj-{j}", 0, payloads[j])
+            store.apply_transaction(txn)
+        return time.perf_counter() - t0
+
+    def read_all(store):
+        best, got = float("inf"), None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            got = [store.read("2.0", f"obj-{j}")
+                   for j in range(n_objects)]
+            best = min(best, time.perf_counter() - t0)
+        return best, got
+
+    def verify_csums(store):
+        """Every committed csum must equal host zlib.crc32 of the
+        block's STORED bytes — the bit-exactness gate on the device
+        digest."""
+        for blob in store._db.get_range("obj").values():
+            meta = json.loads(blob.decode())
+            co = meta.get("comp") or []
+            for bi, b in enumerate(meta["extents"]):
+                if b < 0:
+                    continue
+                comp = co[bi] if bi < len(co) else None
+                data = store._read_block(b)
+                stored = data[:comp[1]] if comp else data
+                if _zlib.crc32(stored) != meta["csum"][bi]:
+                    return False
+        return True
+
+    out: dict = {}
+    try:
+        scalar = mkstore("scalar", use_ctx=False)
+        batched = mkstore("batched", use_ctx=True)
+        try:
+            write_all(batched)        # jit warmup outside timing
+            t_ws = min(write_all(scalar) for _ in range(2))
+            t_wb = min(write_all(batched) for _ in range(2))
+            t_rs, got_s = read_all(scalar)
+            t_rb, got_b = read_all(batched)
+            verified = (got_s == payloads and got_b == payloads
+                        and verify_csums(batched))
+            from ceph_tpu.ops import telemetry
+            bstats = telemetry.bluestore_summary()
+        finally:
+            scalar.umount()
+            batched.umount()
+
+        # (b) the isolated csum-settle quantity: host crc loop vs one
+        # channel digest over the same staged payloads
+        blobs = [p[i:i + BLOCK]
+                 for p in payloads[:16]
+                 for i in range(0, obj_bytes, BLOCK)]
+        t_host = float("inf")
+        host_crcs = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            host_crcs = [_zlib.crc32(b) for b in blobs]
+            t_host = min(t_host, time.perf_counter() - t0)
+        from ceph_tpu.ops.dispatch import (
+            DeviceDispatchEngine, submit_bluestore_data)
+        from ceph_tpu.ops.telemetry import DispatchStats
+        eng = DeviceDispatchEngine(name="bench-objstore",
+                                   stats=DispatchStats())
+        try:
+            submit_bluestore_data(eng, blobs).result(timeout=120.0)
+            t_dev = float("inf")
+            dig = None
+            for _ in range(3):
+                t0 = time.perf_counter()
+                dig = np.asarray(submit_bluestore_data(
+                    eng, blobs).result(timeout=120.0))
+                t_dev = min(t_dev, time.perf_counter() - t0)
+            micro_ok = all(int(dig[i, 0]) == host_crcs[i]
+                           for i in range(len(blobs)))
+        finally:
+            eng.stop()
+
+        # (c) compression-on head-to-head: seed scalar path + host
+        # zlib vs the device store + tpu_bitplane, force mode both
+        # sides.  Channel read-verify is priced by (a)/(b) — off here
+        # so the leg isolates the compressor comparison.
+        def stored_ratio(store):
+            stored = logical = 0
+            for blob in store._db.get_range("obj").values():
+                meta = json.loads(blob.decode())
+                for bi, b in enumerate(meta["extents"]):
+                    if b < 0:
+                        continue
+                    ce = (meta.get("comp") or [None] * (bi + 1))[bi]
+                    logical += BLOCK
+                    stored += ce[1] if ce else BLOCK
+            return stored / max(logical, 1)
+
+        ctx.conf.set("bluestore_batched_read_verify", "false",
+                     source="cli")
+        comp_s = mkstore("comp-scalar", use_ctx=False)
+        comp_b = mkstore("comp-batched", use_ctx=True)
+        try:
+            comp_s.set_pool_compression(2, "force", "zlib")
+            comp_b.set_pool_compression(2, "force", "tpu_bitplane")
+            write_all(comp_b)     # jit warmup outside timing
+            t_cws = min(write_all(comp_s) for _ in range(2))
+            t_cwb = min(write_all(comp_b) for _ in range(2))
+            t_crs, got_cs = read_all(comp_s)
+            t_crb, got_cb = read_all(comp_b)
+            comp_ok = (got_cs == payloads and got_cb == payloads
+                       and verify_csums(comp_s)
+                       and verify_csums(comp_b))
+            ratio_s = stored_ratio(comp_s)
+            ratio_b = stored_ratio(comp_b)
+        finally:
+            comp_s.umount()
+            comp_b.umount()
+
+        out = {
+            "objects": n_objects,
+            "mbytes": round(total / 1e6, 2),
+            "write_scalar_mbps": round(total / t_ws / 1e6, 1),
+            "write_batched_mbps": round(total / t_wb / 1e6, 1),
+            "write_batched_vs_scalar": round(t_ws / t_wb, 2),
+            "read_scalar_mbps": round(total / t_rs / 1e6, 1),
+            "read_batched_mbps": round(total / t_rb / 1e6, 1),
+            "csum_settle_host_mbps": round(
+                len(blobs) * BLOCK / t_host / 1e6, 1),
+            "csum_settle_device_mbps": round(
+                len(blobs) * BLOCK / t_dev / 1e6, 1),
+            "csum_settle_batched_vs_scalar": round(t_host / t_dev, 2),
+            "csum_batches": bstats.get("csum_batches", 0),
+            "batched_csum_blocks": bstats.get("batched_csum_blocks", 0),
+            "read_verify_batches": bstats.get("read_verify_batches", 0),
+            "comp_write_scalar_zlib_mbps": round(
+                total / t_cws / 1e6, 1),
+            "comp_write_batched_bitplane_mbps": round(
+                total / t_cwb / 1e6, 1),
+            "comp_write_batched_vs_scalar": round(t_cws / t_cwb, 2),
+            "comp_read_scalar_zlib_mbps": round(
+                total / t_crs / 1e6, 1),
+            "comp_read_batched_bitplane_mbps": round(
+                total / t_crb / 1e6, 1),
+            "comp_read_batched_vs_scalar": round(t_crs / t_crb, 2),
+            "comp_stored_ratio_zlib": round(ratio_s, 3),
+            "comp_stored_ratio_bitplane": round(ratio_b, 3),
+            "compress_verified": comp_ok,
+            "verified": verified and micro_ok,
+        }
+    finally:
+        for eng_attr in ("_decode_dispatch", "_dispatch"):
+            e = getattr(ctx, eng_attr, None)
+            if e is not None:
+                e.stop()
+        _shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
 def main(argv=None) -> None:
     import argparse
 
@@ -1189,6 +1412,13 @@ def main(argv=None) -> None:
         # and tenant reservation attainment under a scrub storm with
         # vs without the background_best_effort class
         out["scrub"] = scrub_section()
+
+    if "objectstore" in secs:
+        # device-resident objectstore write path: on-disk bluestore
+        # write/read MB/s scalar vs the bluestore_data channel, the
+        # isolated csum-settle micro, and the bitplane compression
+        # leg — all bit-verified against the host oracles
+        out["objectstore"] = objectstore_section()
 
     if "metric" not in out:
         out = {"metric": "sections " + "+".join(sorted(secs)),

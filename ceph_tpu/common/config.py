@@ -317,11 +317,52 @@ register_options([
            "rolling counter samples the mgr slo module retains for "
            "windowed burn evaluation (also time-bounded by the slow "
            "window)"),
+    Option("bluestore_batched_csum", OPT_BOOL, True,
+           "settle each bluestore transaction batch's write-time "
+           "block checksums as ONE coalesced device digest through "
+           "the bluestore_data dispatch channel (the scrub digest "
+           "kernel's crc32 column over stored payloads); off = the "
+           "seed's inline scalar zlib.crc32 per block (always the "
+           "fallback when the channel degrades)"),
+    Option("bluestore_batched_csum_min", OPT_INT, 4,
+           "minimum pending blocks before a commit's checksum batch "
+           "rides the device; smaller batches take the scalar path "
+           "(a one-block digest is cheaper on the host)"),
+    Option("bluestore_data_timeout", OPT_FLOAT, 30.0,
+           "seconds a bluestore commit or batched read waits on its "
+           "bluestore_data digest future before falling back to "
+           "scalar crc32 (generous: the engine's own retry/breaker "
+           "ladder resolves failures far sooner)"),
+    Option("bluestore_batched_read_verify", OPT_BOOL, True,
+           "verify wide reads' block checksums as one bluestore_data "
+           "digest call instead of per-block scalar crc32; any "
+           "engine failure falls back to the scalar per-block path — "
+           "reads never lose verification, only batching"),
+    Option("bluestore_batched_read_min", OPT_INT, 8,
+           "minimum checksummed blocks a read must cover before its "
+           "verification batches to the device"),
+    Option("bluestore_compression_mode", OPT_STR, "none",
+           "default objectstore block compression mode when a pool "
+           "sets none: none | aggressive | force (per-pool "
+           "compression_mode overrides; passive is not carried — "
+           "client hints do not exist in this stack)"),
+    Option("bluestore_compression_algorithm", OPT_STR, "tpu_bitplane",
+           "default compressor plugin for block compression "
+           "(compressor registry name: tpu_bitplane | zlib | lzma)"),
+    Option("bluestore_compression_required_ratio", OPT_FLOAT, 0.875,
+           "a compressed block is kept only if stored_size <= "
+           "block_size * ratio; otherwise it is stored raw "
+           "(compress_rejected)"),
+    Option("bluestore_compression_verify", OPT_BOOL, True,
+           "round-trip every compressed block (decompress and "
+           "compare byte-identical) before committing it; a "
+           "mismatch stores the block raw and counts "
+           "compress_roundtrip_failures"),
     Option("log_level", OPT_INT, 1, "default subsystem log level"),
     Option("ms_type", OPT_STR, "async",
            "messenger implementation: async | loopback"),
     Option("objectstore", OPT_STR, "memstore",
-           "object store backend: memstore | filestore"),
+           "object store backend: memstore | filestore | bluestore"),
 ])
 
 

@@ -3,19 +3,35 @@ named-plugin pattern as the erasure-code registry; the reference's QAT
 hook is the precedent for hardware-offloaded plugins behind this API).
 
 Plugins: zlib and lzma (stdlib-backed; the reference's
-snappy/zstd/lz4 are external libs this image doesn't carry) plus an
-identity "none".
+snappy/zstd/lz4 are external libs this image doesn't carry), an
+identity "none", and ``tpu_bitplane`` — the device bit-plane coder
+(ops/compression_kernel.py) with host zlib as its oracle/fallback,
+BlueStore's default compression algorithm.
+
+``create`` validates kwargs against each plugin's declared ``KWARGS``
+(an unknown kwarg names the accepted set instead of leaking an opaque
+TypeError), and every plugin's ``decompress`` raises the typed
+``CompressionError`` on malformed input so read paths can map corrupt
+compressed data to EIO instead of leaking ``zlib.error``/``LZMAError``.
 """
 
 from __future__ import annotations
 
 import lzma
+import struct
 import threading
 import zlib
 
 
+class CompressionError(Exception):
+    """A compressed payload could not be decoded (corrupt/truncated
+    body, unknown scheme tag).  Read paths map this to EIO."""
+
+
 class Compressor:
     name = "none"
+    #: kwargs ``create`` accepts for this plugin (name -> caster)
+    KWARGS: dict = {}
 
     def compress(self, data: bytes) -> bytes:
         return data
@@ -26,25 +42,118 @@ class Compressor:
 
 class ZlibCompressor(Compressor):
     name = "zlib"
+    KWARGS = {"level": int}
 
     def __init__(self, level: int = 5):
-        self.level = level
+        self.level = int(level)
 
     def compress(self, data: bytes) -> bytes:
         return zlib.compress(data, self.level)
 
     def decompress(self, data: bytes) -> bytes:
-        return zlib.decompress(data)
+        try:
+            return zlib.decompress(data)
+        except zlib.error as e:
+            raise CompressionError(f"zlib decompress failed: {e}") from e
 
 
 class LzmaCompressor(Compressor):
     name = "lzma"
+    KWARGS = {"level": int}
+
+    def __init__(self, level: int = 6):
+        # level maps to the lzma preset (0 fastest .. 9 smallest) —
+        # the seed silently ignored a passed level
+        self.level = int(level)
 
     def compress(self, data: bytes) -> bytes:
-        return lzma.compress(data)
+        return lzma.compress(data, preset=self.level)
 
     def decompress(self, data: bytes) -> bytes:
-        return lzma.decompress(data)
+        try:
+            return lzma.decompress(data)
+        except lzma.LZMAError as e:
+            raise CompressionError(f"lzma decompress failed: {e}") from e
+
+
+class TpuBitplaneCompressor(Compressor):
+    """Device bit-plane coder: fixed-width entropy coding as a batched
+    bit-matrix kernel (ops/compression_kernel.py), with host zlib as
+    the fallback coder when plane-dropping cannot win (random data).
+
+    Output framing (1 scheme byte + body):
+      0x00  stored raw (neither coder helped)
+      0x01  bit-plane body (compression_kernel.encode/decode_block)
+      0x02  zlib body
+    """
+
+    name = "tpu_bitplane"
+    KWARGS = {"level": int, "device": bool}
+
+    _T_RAW, _T_PLANE, _T_ZLIB = b"\x00", b"\x01", b"\x02"
+
+    def __init__(self, level: int = 5, device: bool = True):
+        self.level = int(level)       # zlib-fallback level
+        self.device = bool(device)    # False = numpy oracle only
+
+    def compress(self, data: bytes) -> bytes:
+        if not data:
+            return self._T_RAW
+        from ceph_tpu.ops import compression_kernel as bk
+        if len(data) <= bk.MAX_BLOCK:
+            planes = bk.pack_planes([data], device=self.device)[0]
+            body = bk.encode_block(data, planes)
+            if len(body) < len(data):
+                return self._T_PLANE + body
+        z = zlib.compress(data, self.level)
+        if len(z) < len(data):
+            return self._T_ZLIB + z
+        return self._T_RAW + data
+
+    def compress_batch(self, blobs: list) -> list:
+        """Batch flavor: every blob's plane extraction rides ONE
+        device call (BlueStore uses this for multi-block writes)."""
+        from ceph_tpu.ops import compression_kernel as bk
+        small = [i for i, b in enumerate(blobs)
+                 if b and len(b) <= bk.MAX_BLOCK]
+        planes = bk.pack_planes([blobs[i] for i in small],
+                                device=self.device)
+        out = []
+        by_idx = dict(zip(small, planes))
+        for i, data in enumerate(blobs):
+            if i not in by_idx:
+                out.append(self.compress(data))
+                continue
+            body = bk.encode_block(data, by_idx[i])
+            if len(body) < len(data):
+                out.append(self._T_PLANE + body)
+                continue
+            z = zlib.compress(data, self.level)
+            out.append(self._T_ZLIB + z if len(z) < len(data)
+                       else self._T_RAW + data)
+        return out
+
+    def decompress(self, data: bytes) -> bytes:
+        if not data:
+            raise CompressionError("tpu_bitplane: empty payload")
+        tag, body = data[:1], data[1:]
+        if tag == self._T_RAW:
+            return body
+        if tag == self._T_ZLIB:
+            try:
+                return zlib.decompress(body)
+            except zlib.error as e:
+                raise CompressionError(
+                    f"tpu_bitplane zlib body corrupt: {e}") from e
+        if tag == self._T_PLANE:
+            from ceph_tpu.ops import compression_kernel as bk
+            try:
+                return bk.decode_block(body)
+            except (ValueError, struct.error) as e:
+                raise CompressionError(
+                    f"tpu_bitplane body corrupt: {e}") from e
+        raise CompressionError(
+            f"tpu_bitplane: unknown scheme tag {tag!r}")
 
 
 # analysis: allow[bare-lock] -- import-time plugin registry lock; leaf
@@ -53,6 +162,7 @@ _FACTORIES = {
     "none": Compressor,
     "zlib": ZlibCompressor,
     "lzma": LzmaCompressor,
+    "tpu_bitplane": TpuBitplaneCompressor,
 }
 
 
@@ -62,12 +172,22 @@ def register(name: str, factory) -> None:
 
 
 def create(name: str, **kw) -> Compressor:
-    """Compressor::create (compressor/Compressor.h:97)."""
+    """Compressor::create (compressor/Compressor.h:97).  Kwargs are
+    validated against the plugin's declared ``KWARGS`` — an unknown
+    one raises a ValueError naming the accepted set (the seed raised
+    an opaque TypeError from the factory call)."""
     with _LOCK:
         factory = _FACTORIES.get(name)
     if factory is None:
         raise KeyError(f"compressor {name!r} unknown; "
                        f"known: {sorted(_FACTORIES)}")
+    accepted = getattr(factory, "KWARGS", None)
+    if accepted is not None:
+        bad = sorted(set(kw) - set(accepted))
+        if bad:
+            raise ValueError(
+                f"compressor {name!r} does not accept {bad}; "
+                f"accepted kwargs: {sorted(accepted)}")
     return factory(**kw)
 
 

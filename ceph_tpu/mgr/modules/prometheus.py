@@ -137,6 +137,7 @@ class Module(MgrModule):
         self._scrape_tenant_usage(exp)
         self._scrape_slo(exp)
         self._scrape_scrub(exp)
+        self._scrape_bluestore(exp)
         self._scrape_fault_feed(exp)
         self._scrape_kernels(exp)
         self._scrape_dispatch(exp)
@@ -361,6 +362,64 @@ class Module(MgrModule):
             lab = {"ceph_daemon": f"osd.{osd}"}
             for key, (fam, help_) in families.items():
                 exp.counter(fam, help_, entry.get(key, 0), lab)
+
+    def _scrape_bluestore(self, exp: Exposition) -> None:
+        """ceph_bluestore_*: the process-global objectstore write/read
+        path ledger — how block checksums were computed (coalesced
+        bluestore_data device batches vs scalar crc32), the block
+        compression outcome mix, and the error counters that should
+        alert (csum_errors, decompress_errors, kv_journal_truncated).
+        Process-local like the ceph_kernel_* families: one daemon per
+        process attributes cleanly; a shared process aggregates."""
+        families = {
+            "csum_batches": ("ceph_bluestore_csum_batches_total",
+                             "coalesced bluestore_data digest batches "
+                             "at commit"),
+            "csum_blocks": ("ceph_bluestore_csum_blocks_total",
+                            "blocks checksummed in batched device "
+                            "calls"),
+            "csum_scalar_blocks": (
+                "ceph_bluestore_csum_scalar_blocks_total",
+                "blocks checksummed by the scalar zlib.crc32 path "
+                "(knob off, small batch, engine-thread caller, or "
+                "fallback)"),
+            "csum_fallbacks": ("ceph_bluestore_csum_fallbacks_total",
+                               "batched digest calls that failed over "
+                               "to scalar crc32"),
+            "read_verify_batches": (
+                "ceph_bluestore_read_verify_batches_total",
+                "wide reads whose block verification rode one "
+                "device digest call"),
+            "read_verify_blocks": (
+                "ceph_bluestore_read_verify_blocks_total",
+                "blocks verified in batched read digests"),
+            "compress_blocks": ("ceph_bluestore_compress_blocks_total",
+                                "blocks committed compressed (ratio "
+                                "met, round-trip verified)"),
+            "compress_rejected": (
+                "ceph_bluestore_compress_rejected_total",
+                "blocks stored raw: ratio not met or plugin error"),
+            "compress_roundtrip_failures": (
+                "ceph_bluestore_compress_roundtrip_failures_total",
+                "compressed blocks that failed byte-identical "
+                "round-trip verification and were stored raw"),
+            "decompress_errors": (
+                "ceph_bluestore_decompress_errors_total",
+                "reads that hit a corrupt compressed body (EIO)"),
+            "csum_errors": ("ceph_bluestore_csum_errors_total",
+                            "read-time block checksum mismatches "
+                            "(EIO)"),
+            "kv_journal_truncated": (
+                "ceph_bluestore_kv_journal_truncated_total",
+                "KV journal replays that stopped at a short/corrupt "
+                "frame (transactions past it are LOST)"),
+            "kv_journal_lost_bytes": (
+                "ceph_bluestore_kv_journal_lost_bytes_total",
+                "unreplayed journal bytes past replay stop points"),
+        }
+        dump = telemetry.bluestore_dump()
+        for key, (fam, help_) in families.items():
+            exp.counter(fam, help_, dump.get(key, 0))
 
     def _scrape_fault_feed(self, exp: Exposition) -> None:
         """Per-daemon circuit-breaker states from the MMgrReport v4

@@ -85,13 +85,29 @@ def _okey(cid: str, oid: str) -> str:
     return f"{cid}\x00{oid}"
 
 
-class BlueStoreLite(ObjectStore):
-    """ObjectStore on a block file + KV metadata."""
+#: compression_mode values that compress (the reference's "passive"
+#: compresses only on client hints, which this stack does not carry)
+_COMP_MODES_ON = ("aggressive", "force")
 
-    def __init__(self, path: str):
+
+class BlueStoreLite(ObjectStore):
+    """ObjectStore on a block file + KV metadata.
+
+    With a context, write-time block checksums batch into the
+    ``bluestore_data`` dispatch channel (one coalesced device digest
+    call per transaction batch, coalescing further across concurrent
+    txcs/stores at the engine), reads above a threshold verify through
+    the same channel, and per-pool/global ``compression_mode`` runs
+    blocks through a compressor plugin before they hit the block file.
+    Without one (or with the knobs off) every path is the seed's
+    scalar ``zlib.crc32`` loop — which also remains the bit-exact
+    oracle the channel's fault ladder falls back to."""
+
+    def __init__(self, path: str, ctx=None):
         if not path:
             raise ValueError("bluestore needs a directory path")
         self.path = path
+        self._ctx = ctx
         self._block_path = os.path.join(path, "block")
         self._db = LogDB(os.path.join(path, "kv"))
         self._alloc = BitmapAllocator()
@@ -103,12 +119,38 @@ class BlueStoreLite(ObjectStore):
                      .add_u64("txc")
                      .add_time_avg("commit_lat")
                      .add_time_avg("apply_lat")
+                     .add_u64("csum_batches")
+                     .add_u64("csum_blocks")
+                     .add_u64("csum_scalar_blocks")
+                     .add_u64("csum_fallbacks")
+                     .add_u64("read_verify_batches")
+                     .add_u64("read_verify_blocks")
+                     .add_u64("compress_blocks")
+                     .add_u64("compress_rejected")
+                     .add_u64("compress_roundtrip_failures")
+                     .add_u64("kv_journal_truncated")
                      .create_perf_counters())
         from ceph_tpu.common.lockdep import make_lock
         self._lock = make_lock(f"BlueStore::lock({path})")
         #: blocks displaced by the in-flight transaction batch; returned
         #: to the allocator only after its KV commit lands
         self._freed: list[int] = []
+        #: freshly allocated block -> STORED payload whose crc32 the
+        #: in-flight batch still owes; ONE coalesced device call at
+        #: commit fills them (scalar zlib on any failure — a csum is
+        #: never committed unset)
+        self._pending_csum: dict[int, bytes] = {}
+        #: engine the in-flight batch rides (None = scalar batch)
+        self._batch_eng = None
+        #: cid -> resolved compression policy, cached per batch so the
+        #: hot per-block path reads the conf once per collection
+        self._comp_cache: dict[str, tuple | None] = {}
+        #: pool id -> (compression_mode, compression_algorithm) pushed
+        #: from the osdmap's per-pool fields (set_pool_compression)
+        self._pool_comp: dict[int, tuple[str, str]] = {}
+        #: algorithm -> plugin instance (compressor.create is registry-
+        #: locked; the write path must not take that lock per block)
+        self._compressors: dict[str, object] = {}
         #: whether the in-flight batch wrote any block (a pure deferred-
         #: write batch skips the block-file fsync entirely — the whole
         #: point of the WAL path: one KV commit, no data syncs)
@@ -147,6 +189,17 @@ class BlueStoreLite(ObjectStore):
 
     def mount(self) -> None:
         self._db.open()
+        # surface the KV journal's replay-truncation ledger: a chopped
+        # journal means lost transactions, and it must be visible as a
+        # counter (perf + the process-global sink), never just a log line
+        tf = getattr(self._db, "truncated_frames", 0)
+        if tf:
+            from ceph_tpu.ops import telemetry
+            self.perf.inc("kv_journal_truncated", tf)
+            telemetry.bluestore_stats().inc("kv_journal_truncated", tf)
+            telemetry.bluestore_stats().inc(
+                "kv_journal_lost_bytes",
+                getattr(self._db, "truncated_bytes", 0))
         self._f = open(self._block_path, "r+b")
         # rebuild the allocator from the committed extent maps — the
         # only crash-safe source of truth (fsck-style recovery; a
@@ -185,7 +238,219 @@ class BlueStoreLite(ObjectStore):
     @staticmethod
     def _new_meta() -> dict:
         return {"size": 0, "extents": [], "attrs": {}, "omap": {},
-                "csum": [], "wal_n": 0, "wal_seq": 0}
+                "csum": [], "comp": [], "wal_n": 0, "wal_seq": 0}
+
+    # -- config / engine / compression plumbing -------------------------------
+
+    def _conf(self, key: str, default):
+        """A registered option off the owning context's conf, or the
+        default for bare stores (tools, tests without a context)."""
+        if self._ctx is None:
+            return default
+        try:
+            return self._ctx.conf.get(key)
+        except Exception:
+            return default
+
+    def _batch_engine(self):
+        """The engine this batch's ``bluestore_data`` submissions ride
+        — or None for the scalar path.  None when: no context, knob
+        off, or the CALLER is an engine worker thread (store commits
+        run on completion threads via EC-write and recovery
+        continuations; blocking on a future there would starve the
+        thread that delivers it).  The channel rides the decode engine
+        so store digests coalesce with scrub's — one checksum
+        definition, one width-bucketed batch stream."""
+        if self._ctx is None or not bool(
+                self._conf("bluestore_batched_csum", True)):
+            return None
+        try:
+            eng = self._ctx.decode_dispatch_engine()
+            enc = self._ctx.dispatch_engine()
+        except Exception:
+            return None
+        if eng.owns_current_thread() or enc.owns_current_thread():
+            return None
+        return eng
+
+    def set_pool_compression(self, pool_id: int, mode: str,
+                             algorithm: str = "") -> None:
+        """Per-pool compression override, pushed by the owning OSD
+        when the osdmap's pool table changes (`osd pool set <p>
+        compression_mode aggressive`); empty strings fall back to the
+        ``bluestore_compression_*`` conf."""
+        with self._lock:
+            if mode or algorithm:
+                self._pool_comp[int(pool_id)] = (str(mode),
+                                                 str(algorithm))
+            else:
+                self._pool_comp.pop(int(pool_id), None)
+            self._comp_cache.clear()
+
+    def _comp_policy(self, okey: str | None):
+        """(algorithm, required_ratio) when the block should try
+        compression, else None — per-pool mode/algorithm first (cid
+        prefix "pool.pg"), then the global conf; cached per cid for
+        the batch."""
+        if okey is None:
+            return None
+        cid = okey.split("\x00", 1)[0]
+        if cid in self._comp_cache:
+            return self._comp_cache[cid]
+        mode = alg = ""
+        head = cid.split(".", 1)[0]
+        if head.lstrip("-").isdigit():
+            mode, alg = self._pool_comp.get(int(head), ("", ""))
+        if not mode:
+            mode = str(self._conf("bluestore_compression_mode", "none"))
+        pol = None
+        if mode in _COMP_MODES_ON:
+            if not alg:
+                alg = str(self._conf("bluestore_compression_algorithm",
+                                     "tpu_bitplane"))
+            pol = (alg, float(self._conf(
+                "bluestore_compression_required_ratio", 0.875)))
+        self._comp_cache[cid] = pol
+        return pol
+
+    def _compressor(self, alg: str):
+        c = self._compressors.get(alg)
+        if c is None:
+            from ceph_tpu import compressor as _comp
+            c = _comp.create(alg)
+            self._compressors[alg] = c
+        return c
+
+    def _compress_block(self, padded: bytes, policy):
+        """(stored_bytes, comp_entry|None) for one logical block.
+        Compression must never fail a write: any plugin error or a
+        failed round-trip stores the block raw.  A compressed block
+        commits ONLY after decompressing back byte-identical."""
+        if policy is None:
+            return padded, None
+        alg, ratio = policy
+        from ceph_tpu.ops import telemetry
+        bs = telemetry.bluestore_stats()
+        try:
+            comp = self._compressor(alg).compress(padded)
+        except Exception:
+            bs.inc("compress_rejected")
+            return padded, None
+        if len(comp) > int(BLOCK * ratio):
+            bs.inc("compress_rejected")
+            return padded, None
+        if bool(self._conf("bluestore_compression_verify", True)):
+            try:
+                ok = self._compressor(alg).decompress(comp) == padded
+            except Exception:
+                ok = False
+            if not ok:
+                bs.inc("compress_roundtrip_failures")
+                return padded, None
+        bs.inc("compress_blocks")
+        self.perf.inc("compress_blocks")
+        return comp, [alg, len(comp)]
+
+    def _compress_blocks(self, blocks: list, policy) -> list:
+        """Batch flavor of ``_compress_block`` for a multi-block
+        write: plugins exposing ``compress_batch`` (tpu_bitplane) get
+        ONE device call for the whole span; others fall back
+        per-block.  Same ratio gate and round-trip verification per
+        block."""
+        alg, ratio = policy
+        comp = self._compressor(alg)
+        batch = getattr(comp, "compress_batch", None)
+        if batch is None:
+            return [self._compress_block(b, policy) for b in blocks]
+        from ceph_tpu.ops import telemetry
+        bs = telemetry.bluestore_stats()
+        try:
+            bodies = batch(list(blocks))
+        except Exception:
+            bs.inc("compress_rejected", len(blocks))
+            return [(b, None) for b in blocks]
+        verify = bool(self._conf("bluestore_compression_verify", True))
+        out = []
+        for b, body in zip(blocks, bodies):
+            if len(body) > int(BLOCK * ratio):
+                bs.inc("compress_rejected")
+                out.append((b, None))
+                continue
+            if verify:
+                try:
+                    ok = comp.decompress(body) == b
+                except Exception:
+                    ok = False
+                if not ok:
+                    bs.inc("compress_roundtrip_failures")
+                    out.append((b, None))
+                    continue
+            bs.inc("compress_blocks")
+            self.perf.inc("compress_blocks")
+            out.append((body, [alg, len(body)]))
+        return out
+
+    def _flush_pending_csums(self, cache) -> None:
+        """Fill every csum slot the batch left pending with ONE
+        coalesced device digest over the stored payloads — the
+        ``bluestore_data`` channel, reusing the scrub digest kernel
+        (crc32 column).  The engine coalesces this call with scrub
+        digests and other stores' batches at equal width buckets.  Any
+        channel failure (breaker open, timeout, device fault) drops to
+        the scalar ``zlib.crc32`` oracle, so a csum slot is never
+        committed unset.  Runs after apply, before the fsync/KV build,
+        so the final metas carry real checksums."""
+        if not self._pending_csum:
+            return
+        # blocks written then displaced within this same batch (COW
+        # overwrite of a fresh block) owe nothing
+        for b in self._freed:
+            self._pending_csum.pop(b, None)
+        pending, self._pending_csum = self._pending_csum, {}
+        if not pending:
+            return
+        blocks = sorted(pending)
+        blobs = [pending[b] for b in blocks]
+        from ceph_tpu.ops import telemetry
+        bs = telemetry.bluestore_stats()
+        crc_map: dict[int, int] = {}
+        eng = self._batch_eng
+        if eng is not None and len(blobs) >= int(
+                self._conf("bluestore_batched_csum_min", 4)):
+            from ceph_tpu.ops.dispatch import submit_bluestore_data
+            try:
+                dig = submit_bluestore_data(
+                    eng, blobs,
+                    cost_tag=("_bluestore", "client")).result(
+                    timeout=float(
+                        self._conf("bluestore_data_timeout", 30.0)))
+                crc_map = {b: int(dig[i, 0]) & 0xFFFFFFFF
+                           for i, b in enumerate(blocks)}
+                bs.inc("csum_batches")
+                bs.inc("csum_blocks", len(blocks))
+                self.perf.inc("csum_batches")
+                self.perf.inc("csum_blocks", len(blocks))
+            except Exception as e:
+                from ceph_tpu.common.logging import dout
+                dout("bluestore", 1,
+                     "bluestore_data digest batch failed (%s); "
+                     "scalar crc32 carries the batch", e)
+                bs.inc("csum_fallbacks")
+                crc_map = {}
+        if not crc_map:
+            crc_map = {b: zlib.crc32(pending[b]) for b in blocks}
+            bs.inc("csum_scalar_blocks", len(blocks))
+        # fill the slots: every pending block is a fresh, unique
+        # allocation, so walking the batch cache's extent maps finds
+        # each exactly once (clones may alias a crc to two slots —
+        # both get the same stored-payload digest)
+        for key, m in cache.items():
+            if key[0] == "__coll__" or m is None:
+                continue
+            cs = self._csums(m)
+            for bi, b in enumerate(m["extents"]):
+                if b in crc_map and cs[bi] is None:
+                    cs[bi] = crc_map[b]
 
     # -- block I/O ------------------------------------------------------------
 
@@ -194,15 +459,53 @@ class BlueStoreLite(ObjectStore):
         data = self._f.read(BLOCK)
         return data + bytes(BLOCK - len(data))
 
-    def _read_verified(self, block: int, crc) -> bytes:
-        """Read + verify a block against its stored crc32 (BlueStore
-        verifies every blob checksum on read; None = legacy/no csum)."""
+    def _stored_read(self, block: int, crc, comp=None) -> bytes:
+        """The STORED payload of a block — compressed body or raw
+        padded block — verified against its crc32.  A block staged by
+        the in-flight batch serves from memory (its crc is computed at
+        the commit's coalesced flush)."""
+        pend = self._pending_csum.get(block)
+        if pend is not None:
+            return pend
         data = self._read_block(block)
-        if crc is not None and zlib.crc32(data) != crc:
+        stored = data[:comp[1]] if comp else data
+        if crc is not None and zlib.crc32(stored) != crc:
+            from ceph_tpu.ops import telemetry
+            telemetry.bluestore_stats().inc("csum_errors")
             raise IOError(
                 f"bluestore checksum mismatch on block {block}: "
-                f"stored {crc:#x}, computed {zlib.crc32(data):#x}")
-        return data
+                f"stored {crc:#x}, computed {zlib.crc32(stored):#x}")
+        return stored
+
+    def _decompress_stored(self, block: int, stored: bytes,
+                           comp) -> bytes:
+        """Stored payload -> logical BLOCK bytes.  Decompression
+        failures surface as IOError (EIO), exactly like a checksum
+        mismatch — the typed CompressionError never leaks to RADOS."""
+        if not comp:
+            return stored
+        try:
+            out = self._compressor(comp[0]).decompress(stored)
+        except Exception as e:
+            from ceph_tpu.ops import telemetry
+            telemetry.bluestore_stats().inc("decompress_errors")
+            raise IOError(
+                f"bluestore decompression failed on block {block} "
+                f"(alg {comp[0]}): {e}") from e
+        if len(out) != BLOCK:
+            from ceph_tpu.ops import telemetry
+            telemetry.bluestore_stats().inc("decompress_errors")
+            raise IOError(
+                f"bluestore decompression length mismatch on block "
+                f"{block}: {len(out)} != {BLOCK}")
+        return out
+
+    def _read_verified(self, block: int, crc, comp=None) -> bytes:
+        """Read + verify a block against its stored crc32 and return
+        its LOGICAL bytes (BlueStore verifies every blob checksum on
+        read; None = legacy/no csum)."""
+        return self._decompress_stored(
+            block, self._stored_read(block, crc, comp), comp)
 
     @staticmethod
     def _csums(meta: dict) -> list:
@@ -211,27 +514,60 @@ class BlueStoreLite(ObjectStore):
             cs.append(None)
         return cs
 
+    @staticmethod
+    def _comps(meta: dict) -> list:
+        """Per-extent compression entries ([alg, stored_len] | None),
+        parallel to csum; absent in pre-compression metas."""
+        co = meta.setdefault("comp", [])
+        while len(co) < len(meta["extents"]):
+            co.append(None)
+        return co
+
+    def _stage_csum(self, nb: int, stored: bytes, cs: list,
+                    bi: int) -> None:
+        """Record a freshly written block's checksum obligation: into
+        the batch's pending map when this batch rides the engine (one
+        coalesced device call at commit), else the scalar crc32 the
+        seed computed inline — which is also the flush's fallback, so
+        a csum slot is never committed unset."""
+        if self._batch_eng is not None:
+            self._pending_csum[nb] = stored
+            cs[bi] = None
+        else:
+            cs[bi] = zlib.crc32(stored)
+
     def _patch_block(self, meta: dict, bi: int, boff: int,
-                     chunk: bytes) -> None:
-        """COW-patch one block and update its checksum.  The extent map
-        grows with holes as needed — a truncate-extended region has
-        size > extents coverage, and deferred writes may land there."""
+                     chunk: bytes, okey: str | None = None,
+                     pre=None) -> None:
+        """COW-patch one block, route it through the compression
+        policy, and stage its checksum.  The extent map grows with
+        holes as needed — a truncate-extended region has size >
+        extents coverage, and deferred writes may land there.
+        ``pre``: (stored, comp_entry) already produced by a batched
+        compression pass for full-block writes."""
         while len(meta["extents"]) <= bi:
             meta["extents"].append(-1)
         cs = self._csums(meta)
+        co = self._comps(meta)
         old_block = meta["extents"][bi]
         if boff == 0 and len(chunk) == BLOCK:
             patched = chunk
         elif old_block >= 0:
-            old = self._read_verified(old_block, cs[bi])
+            old = self._read_verified(old_block, cs[bi], co[bi])
             patched = old[:boff] + chunk + old[boff + len(chunk):]
         else:
             patched = bytes(boff) + chunk
         padded = patched[:BLOCK].ljust(BLOCK, b"\x00")
+        if pre is not None:
+            stored, centry = pre
+        else:
+            stored, centry = self._compress_block(
+                padded, self._comp_policy(okey))
         nb = self._alloc.allocate(1)[0]
-        self._write_block(nb, padded)
+        self._write_block(nb, stored, pad=centry is None)
         meta["extents"][bi] = nb
-        cs[bi] = zlib.crc32(padded)
+        co[bi] = centry
+        self._stage_csum(nb, stored, cs, bi)
         if old_block >= 0:
             self._freed.append(old_block)
 
@@ -281,31 +617,95 @@ class BlueStoreLite(ObjectStore):
         folded content; the entry keys are deleted in the same commit
         that persists the patched extent map."""
         for key, bi, boff, data in self._wal_entries(okey, meta):
-            self._patch_block(meta, bi, boff, data)
+            self._patch_block(meta, bi, boff, data, okey=okey)
             if key is not None:
                 self._wal_rms.append(key)
         self._wal_pending.pop(okey, None)
         meta["wal_n"] = 0
 
-    def _write_block(self, block: int, data: bytes) -> None:
+    def _write_block(self, block: int, data: bytes,
+                     pad: bool = True) -> None:
+        """Write a block's STORED payload.  ``pad=False`` (compressed
+        payloads) writes only the stored bytes — the block's tail
+        keeps whatever it held, and reads slice to the comp entry's
+        stored length before verifying."""
         self._f.seek(block * BLOCK)
-        self._f.write(data[:BLOCK].ljust(BLOCK, b"\x00"))
+        self._f.write(data[:BLOCK].ljust(BLOCK, b"\x00") if pad
+                      else data[:BLOCK])
         self._block_dirty = True
+
+    def _batch_read_verify(self, meta: dict, offset: int, end: int,
+                           cs: list, co: list) -> dict[int, bytes]:
+        """Verify a wide read's block checksums in ONE device digest
+        call (the same ``bluestore_data`` channel write commits use,
+        cost-tagged as read work).  Returns {bi: logical bytes} for
+        the blocks it verified; {} routes the read through the scalar
+        per-block path — including on any engine failure, so reads
+        never lose verification, only batching."""
+        if not bool(self._conf("bluestore_batched_read_verify", True)):
+            return {}
+        bis = []
+        for bi in range(offset // BLOCK, -(-end // BLOCK)):
+            if (bi < len(meta["extents"]) and meta["extents"][bi] >= 0
+                    and bi < len(cs) and cs[bi] is not None
+                    and meta["extents"][bi] not in self._pending_csum):
+                bis.append(bi)
+        if len(bis) < int(self._conf("bluestore_batched_read_min", 8)):
+            return {}
+        eng = self._batch_engine()
+        if eng is None:
+            return {}
+        stored = []
+        for bi in bis:
+            comp = co[bi] if bi < len(co) else None
+            data = self._read_block(meta["extents"][bi])
+            stored.append(data[:comp[1]] if comp else data)
+        from ceph_tpu.ops import telemetry
+        from ceph_tpu.ops.dispatch import submit_bluestore_data
+        try:
+            dig = submit_bluestore_data(
+                eng, stored, cost_tag=("_bluestore", "read")).result(
+                timeout=float(self._conf("bluestore_data_timeout",
+                                         30.0)))
+        except Exception:
+            telemetry.bluestore_stats().inc("csum_fallbacks")
+            return {}
+        out = {}
+        for i, bi in enumerate(bis):
+            crc = int(dig[i, 0]) & 0xFFFFFFFF
+            if crc != cs[bi]:
+                telemetry.bluestore_stats().inc("csum_errors")
+                raise IOError(
+                    f"bluestore checksum mismatch on block "
+                    f"{meta['extents'][bi]}: stored {cs[bi]:#x}, "
+                    f"computed {crc:#x}")
+            out[bi] = self._decompress_stored(
+                meta["extents"][bi], stored[i],
+                co[bi] if bi < len(co) else None)
+        bs = telemetry.bluestore_stats()
+        bs.inc("read_verify_batches")
+        bs.inc("read_verify_blocks", len(bis))
+        return out
 
     def _obj_read(self, okey: str, meta: dict, offset: int,
                   length: int) -> bytes:
         out = bytearray()
         end = min(offset + length, meta["size"])
         cs = meta.get("csum") or []
+        co = meta.get("comp") or []
+        verified = self._batch_read_verify(meta, offset, end, cs, co)
         pos = offset
         while pos < end:
             bi = pos // BLOCK
             boff = pos % BLOCK
             n = min(BLOCK - boff, end - pos)
             if bi < len(meta["extents"]) and meta["extents"][bi] >= 0:
-                blk = self._read_verified(
-                    meta["extents"][bi],
-                    cs[bi] if bi < len(cs) else None)
+                blk = verified.get(bi)
+                if blk is None:
+                    blk = self._read_verified(
+                        meta["extents"][bi],
+                        cs[bi] if bi < len(cs) else None,
+                        co[bi] if bi < len(co) else None)
                 out += blk[boff:boff + n]
             else:
                 out += bytes(n)     # hole
@@ -343,6 +743,20 @@ class BlueStoreLite(ObjectStore):
         need_blocks = -(-max(end, meta["size"]) // BLOCK)
         while len(meta["extents"]) < need_blocks:
             meta["extents"].append(-1)
+        # pre-compress the write's aligned full blocks in ONE batched
+        # plugin call (tpu_bitplane: one device plane-extraction for
+        # the whole span instead of one per block)
+        pres: dict[int, tuple] = {}
+        policy = self._comp_policy(okey)
+        if policy is not None:
+            first = -(-offset // BLOCK) * BLOCK
+            full = [(pos // BLOCK, data[pos - offset:pos - offset + BLOCK])
+                    for pos in range(first, end - BLOCK + 1, BLOCK)]
+            if len(full) > 1:
+                pres = dict(zip(
+                    (bi for bi, _ in full),
+                    self._compress_blocks([c for _, c in full],
+                                          policy)))
         pos = offset
         di = 0
         while pos < end:
@@ -351,7 +765,8 @@ class BlueStoreLite(ObjectStore):
             n = min(BLOCK - boff, end - pos)
             # COW via the checksum-maintaining patcher: the old extent
             # stays valid until the KV commit flips the map
-            self._patch_block(meta, bi, boff, data[di:di + n])
+            self._patch_block(meta, bi, boff, data[di:di + n],
+                              okey=okey, pre=pres.get(bi))
             pos += n
             di += n
         meta["size"] = max(meta["size"], end)
@@ -362,6 +777,7 @@ class BlueStoreLite(ObjectStore):
         extent -1 (reads synthesize zeros), edges COW-patch."""
         self._fold_wal(okey, meta)
         cs = self._csums(meta)
+        co = self._comps(meta)
         end = offset + length
         pos = offset
         while pos < end:
@@ -373,13 +789,16 @@ class BlueStoreLite(ObjectStore):
                     self._freed.append(meta["extents"][bi])
                     meta["extents"][bi] = -1
                     cs[bi] = None
+                    co[bi] = None
                 else:
-                    self._patch_block(meta, bi, boff, bytes(n))
+                    self._patch_block(meta, bi, boff, bytes(n),
+                                      okey=okey)
             pos += n
         if end > meta["size"]:
             while len(meta["extents"]) < -(-end // BLOCK):
                 meta["extents"].append(-1)
                 cs.append(None)
+                co.append(None)
             meta["size"] = end
 
     def _obj_truncate(self, okey: str, meta: dict, length: int) -> None:
@@ -389,14 +808,16 @@ class BlueStoreLite(ObjectStore):
             self._freed.extend(b for b in meta["extents"][keep:]
                                if b >= 0)
             cs = self._csums(meta)
+            co = self._comps(meta)
             meta["extents"] = meta["extents"][:keep]
             meta["csum"] = cs[:keep]
+            meta["comp"] = co[:keep]
             # zero the tail of the boundary block (COW)
             if length % BLOCK and meta["extents"] \
                     and meta["extents"][-1] >= 0:
                 tail = length % BLOCK
                 self._patch_block(meta, len(meta["extents"]) - 1, tail,
-                                  bytes(BLOCK - tail))
+                                  bytes(BLOCK - tail), okey=okey)
         meta["size"] = length
 
     # -- transactions ---------------------------------------------------------
@@ -472,6 +893,7 @@ class BlueStoreLite(ObjectStore):
                 self._purge_wal(_okey(op.cid, op.dest), prev)
             self._fold_wal(_okey(op.cid, op.oid), m)
             cs = self._csums(m)
+            co = self._comps(m)
             dst = self._new_meta()
             dst["size"] = m["size"]
             dst["attrs"] = dict(m["attrs"])
@@ -480,12 +902,22 @@ class BlueStoreLite(ObjectStore):
                 if src < 0:
                     dst["extents"].append(-1)
                     dst["csum"].append(None)
+                    dst["comp"].append(None)
                     continue
+                # copy the STORED payload (compressed body stays
+                # compressed — no decode/re-encode round-trip)
+                stored = self._stored_read(src, cs[bi], co[bi])
                 nb = self._alloc.allocate(1)[0]
-                self._write_block(
-                    nb, self._read_verified(src, cs[bi]))
+                self._write_block(nb, stored, pad=co[bi] is None)
                 dst["extents"].append(nb)
-                dst["csum"].append(cs[bi])
+                dst["comp"].append(co[bi])
+                if src in self._pending_csum:
+                    # source was written THIS batch: its crc is still
+                    # pending; the clone owes the same digest
+                    self._pending_csum[nb] = stored
+                    dst["csum"].append(None)
+                else:
+                    dst["csum"].append(cs[bi])
             cache[(op.cid, op.dest)] = dst
 
 
@@ -511,6 +943,12 @@ class BlueStoreLite(ObjectStore):
             self._freed = []
             self._wal_pending = {}
             self._wal_rms = []
+            self._pending_csum = {}
+            self._comp_cache.clear()
+            # bind the batch's engine once: every block this batch
+            # stages rides (or skips) the channel consistently, and
+            # engine-thread callers collapse to the scalar path here
+            self._batch_eng = self._batch_engine()
 
             def coll_exists(cid):
                 if ("__coll__", cid) in cache:
@@ -551,10 +989,16 @@ class BlueStoreLite(ObjectStore):
                 apply_ops()
                 self.perf.tinc("apply_lat",
                                _time.perf_counter() - t_apply)
+                # settle the batch's checksum debt (one coalesced
+                # device digest, scalar oracle on any failure) BEFORE
+                # the fsync and KV build below read the final metas
+                self._flush_pending_csums(cache)
             except Exception:
                 self._freed = []
                 self._wal_pending = {}
                 self._wal_rms = []
+                self._pending_csum = {}
+                self._comp_cache.clear()
                 self._block_dirty = False
                 raise
             # data before metadata: fsync the block file, then ONE

@@ -102,10 +102,21 @@ class LogDB(MemDB):
         self._log_path = os.path.join(path, "kv.log")
         self._ckpt_path = os.path.join(path, "kv.ckpt")
         self._f = None
+        #: replay truncation found by the LAST ``open()``: whether the
+        #: replay stopped at a short/corrupt frame with bytes left
+        #: behind, and how many bytes were dropped.  The seed broke
+        #: out of the loop SILENTLY: a chopped journal looked like a
+        #: clean mount while every later transaction was lost.  The
+        #: owning store accumulates these into its
+        #: ``kv_journal_truncated`` counter at mount.
+        self.truncated_frames = 0
+        self.truncated_bytes = 0
 
     def open(self) -> None:
         os.makedirs(self.path, exist_ok=True)
         self._data.clear()
+        self.truncated_frames = 0
+        self.truncated_bytes = 0
         if os.path.exists(self._ckpt_path):
             with open(self._ckpt_path, "rb") as f:
                 d = Decoder(f.read())
@@ -115,6 +126,7 @@ class LogDB(MemDB):
             with open(self._log_path, "rb") as f:
                 data = f.read()
             off = 0
+            replayed = 0
             while off + _FRAME.size <= len(data):
                 length, crc = _FRAME.unpack_from(data, off)
                 start = off + _FRAME.size
@@ -123,6 +135,22 @@ class LogDB(MemDB):
                     break
                 MemDB.submit_transaction(self, KVTransaction.decode(blob))
                 off = start + length
+                replayed += 1
+            leftover = len(data) - off
+            if leftover:
+                # a torn tail after a crash is one short frame and
+                # expected; ANYTHING beyond the stop point is lost
+                # either way, so say so loudly instead of presenting a
+                # silently shortened history as a clean mount
+                self.truncated_frames += 1
+                self.truncated_bytes += leftover
+                from ceph_tpu.common.logging import dout
+                dout("kv", 0,
+                     "LogDB %s: replay STOPPED at a short/corrupt "
+                     "frame: %d transactions replayed, %d bytes "
+                     "unreplayed past offset %d — any transactions "
+                     "in those bytes are LOST",
+                     self._log_path, replayed, leftover, off)
         self._f = open(self._log_path, "ab")
 
     def close(self) -> None:

@@ -349,13 +349,16 @@ class FileStore(MemStore):
             off = start + length
 
 
-def create(store_type: str, path: str = "") -> ObjectStore:
-    """ObjectStore::create (os/ObjectStore.h:85) analog."""
+def create(store_type: str, path: str = "", ctx=None) -> ObjectStore:
+    """ObjectStore::create (os/ObjectStore.h:85) analog.  ``ctx``
+    (optional CephTpuContext) lets bluestore batch its write-time
+    checksums through the device dispatch engines and read conf knobs;
+    the other backends ignore it."""
     if store_type == "memstore":
         return MemStore(path)
     if store_type == "filestore":
         return FileStore(path)
     if store_type == "bluestore":
         from .bluestore import BlueStoreLite
-        return BlueStoreLite(path)
+        return BlueStoreLite(path, ctx=ctx)
     raise ValueError(f"unknown objectstore type {store_type!r}")

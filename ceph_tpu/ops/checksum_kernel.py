@@ -342,20 +342,34 @@ def digest_jit_entries() -> int:
         return 0
 
 
-def scrub_digest_batched(data, mats, invp):
-    """One batched device digest call: data (S, W) uint8 zero-padded
-    rows, mats/invp from ``digest_operands``.  Returns (S, 2) uint32 —
-    col 0 crc32 (== shard_crc of the unpadded row), col 1 the packed
-    GF Horner digest — bit-exact vs ``scrub_digest_ref``."""
+def _digest_batched(kname: str, data, mats, invp):
     import jax.numpy as jnp
     data = jnp.asarray(np.asarray(data, dtype=np.uint8))
     mats = jnp.asarray(np.asarray(mats, dtype=np.uint32))
     invp = jnp.asarray(np.asarray(invp, dtype=np.uint8))
     s, w = data.shape
     return telemetry.timed_kernel(
-        "scrub_digest",
+        kname,
         lambda: _jit_digest()(data, mats, invp, w=int(w)),
         batch=int(s), bytes_in=int(s) * int(w) + mats.nbytes + invp.nbytes,
         bytes_out=int(s) * 8,
         cache_entries=digest_jit_entries,
-        signature=("scrub_digest", int(s), int(w)))
+        signature=(kname, int(s), int(w)))
+
+
+def scrub_digest_batched(data, mats, invp):
+    """One batched device digest call: data (S, W) uint8 zero-padded
+    rows, mats/invp from ``digest_operands``.  Returns (S, 2) uint32 —
+    col 0 crc32 (== shard_crc of the unpadded row), col 1 the packed
+    GF Horner digest — bit-exact vs ``scrub_digest_ref``."""
+    return _digest_batched("scrub_digest", data, mats, invp)
+
+
+def bluestore_digest_batched(data, mats, invp):
+    """The objectstore flavor of the batched digest: identical math
+    through the SAME jitted entry point (equal-width store and scrub
+    batches share one compiled executable — one checksum definition for
+    both), but accounted under its own telemetry family so the
+    ``ceph_kernel_bluestore_data_*`` histograms track the write/read
+    hot path separately from background scrub."""
+    return _digest_batched("bluestore_data", data, mats, invp)

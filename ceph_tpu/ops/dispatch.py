@@ -557,6 +557,18 @@ class DeviceDispatchEngine:
                 raise self._wedge_exc
         return True
 
+    def owns_current_thread(self) -> bool:
+        """True when the caller IS one of this engine's own worker
+        threads (dispatch/completion).  A submitter that would BLOCK on
+        a future from such a thread must take a host path instead: the
+        wait would starve the very thread that materializes batches and
+        delivers results — a guaranteed self-deadlock.  BlueStore's
+        batched-csum flush checks this before riding the engine (store
+        commits run on engine completion threads via EC-write and
+        recovery continuations)."""
+        with self._cv:
+            return threading.current_thread() in self._threads.values()
+
     # -- submit ---------------------------------------------------------------
 
     def submit(self, key, fn, data, *, label=None,
@@ -1545,3 +1557,50 @@ def submit_scrub_digest(engine: DeviceDispatchEngine, blobs,
                          cost_tag=cost_tag if cost_tag is not None
                          else (BACKGROUND_BEST_EFFORT,
                                BACKGROUND_BEST_EFFORT))
+
+
+def submit_bluestore_data(engine: DeviceDispatchEngine, blobs,
+                          key=None, cost_tag=None) -> DispatchFuture:
+    """Submit a batch of STORED block payloads (raw padded blocks or
+    compressed bodies — lengths vary, which is exactly what the unpad
+    epilogue absorbs) for checksumming through the engine — the SIXTH
+    kernel channel (``bluestore_data``), the objectstore's write/read
+    hot path.  Same contract as ``submit_scrub_digest``: returns a
+    DispatchFuture of (len(blobs), 2) uint32 with col 0 the crc32 of
+    each blob (== the scalar ``zlib.crc32`` loop BlueStore ran per
+    block in the seed), a bit-exact host oracle as the breaker
+    fallback, the channel-tagged device-boundary failpoints
+    (``dispatch.launch:bluestore_data``), the bounded retry ladder and
+    a per-channel circuit breaker.
+
+    The key is just the padded width, so concurrent transaction
+    batches — different stores, different daemons on one context —
+    coalesce into one device call, like every other channel.  The
+    digest math IS the scrub kernel's (one checksum definition for
+    store and scrub); only the channel label and telemetry family
+    differ, so the store path's health is observable on its own."""
+    from ceph_tpu.ops import checksum_kernel as ck
+    lengths = np.array([len(b) for b in blobs], dtype=np.int64)
+    w = ck.row_width(int(lengths.max()) if len(blobs) else 0)
+    data = np.zeros((len(blobs), w), dtype=np.uint8)
+    for i, b in enumerate(blobs):
+        if len(b):
+            data[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+    mats, invp = ck.digest_operands(lengths, w)
+    if key is None:
+        key = ("bluestore_data", w)
+
+    def fn(batch, lens, m, p):
+        from ceph_tpu.ops.checksum_kernel import bluestore_digest_batched
+        return bluestore_digest_batched(batch, m, p)
+
+    def host_oracle(batch, lens, m, p):
+        from ceph_tpu.ops.checksum_kernel import scrub_digest_ref
+        return scrub_digest_ref(batch, lens)
+
+    return engine.submit(key, fn, data, aux=(lengths, mats, invp),
+                         label="bluestore_data",
+                         cache_entries=ck.digest_jit_entries,
+                         fallback=host_oracle,
+                         cost_tag=cost_tag if cost_tag is not None
+                         else ("_bluestore", "client"))

@@ -935,6 +935,59 @@ class ScrubStats:
         }
 
 
+class BlueStoreStats:
+    """Device-resident objectstore counters (the ``bluestore_data``
+    channel's write/read offload plus block compression and the KV
+    journal's truncation ledger).
+
+    Process-global like the other sinks: every BlueStoreLite in the
+    process folds its accounting in; ``bluestore_dump`` and the
+    ``ceph_bluestore_*`` prometheus families read it, and bench.py's
+    objectstore section polls ``summary()``."""
+
+    FIELDS = ("csum_batches", "csum_blocks", "csum_scalar_blocks",
+              "csum_fallbacks", "read_verify_batches",
+              "read_verify_blocks", "compress_blocks",
+              "compress_rejected", "compress_roundtrip_failures",
+              "decompress_errors", "csum_errors",
+              "kv_journal_truncated", "kv_journal_lost_bytes")
+
+    def __init__(self):
+        self._lock = lockdep.make_lock("BlueStoreStats::lock")
+        self._counts: dict[str, int] = {f: 0 for f in self.FIELDS}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + int(n)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counts = {f: 0 for f in self.FIELDS}
+
+    def dump(self) -> dict:
+        with self._lock:
+            return dict(self._counts)
+
+    def summary(self) -> dict:
+        """bench/test digest: how the store's checksum work was
+        computed (batched device calls vs scalar), what compression
+        did, and whether anything went wrong."""
+        with self._lock:
+            c = dict(self._counts)
+        return {
+            "csum_batches": c.get("csum_batches", 0),
+            "batched_csum_blocks": c.get("csum_blocks", 0),
+            "scalar_csum_blocks": c.get("csum_scalar_blocks", 0),
+            "csum_fallbacks": c.get("csum_fallbacks", 0),
+            "read_verify_batches": c.get("read_verify_batches", 0),
+            "read_verify_blocks": c.get("read_verify_blocks", 0),
+            "compress_blocks": c.get("compress_blocks", 0),
+            "compress_rejected": c.get("compress_rejected", 0),
+            "csum_errors": c.get("csum_errors", 0),
+            "kv_journal_truncated": c.get("kv_journal_truncated", 0),
+        }
+
+
 #: ledger bucket for work submitted WITHOUT a cost tag.  Untagged
 #: device time is attributed here — visibly — never dropped: the
 #: conservation property (sum over tenants == engine busy-seconds)
@@ -1083,6 +1136,7 @@ class KernelTelemetry:
         self.decode_dispatch = DecodeDispatchStats()
         self.mapping = MappingStats()
         self.scrub = ScrubStats()
+        self.bluestore = BlueStoreStats()
         self.tenant = TenantDeviceStats()
         #: block_until_ready before closing each latency sample
         self.fence_for_timing = False
@@ -1111,6 +1165,7 @@ class KernelTelemetry:
         self.decode_dispatch.clear()
         self.mapping.clear()
         self.scrub.clear()
+        self.bluestore.clear()
         self.tenant.clear()
 
     def summary(self) -> dict:
@@ -1196,6 +1251,22 @@ def scrub_dump() -> dict:
 
 def scrub_summary() -> dict:
     return _REG.scrub.summary()
+
+
+def bluestore_stats() -> BlueStoreStats:
+    """The process-global device-resident-objectstore counters: every
+    BlueStoreLite's write/read/compression paths feed this;
+    ``dump_bluestore_stats``, the ``ceph_bluestore_*`` prometheus
+    families and bench.py's objectstore section read it."""
+    return _REG.bluestore
+
+
+def bluestore_dump() -> dict:
+    return _REG.bluestore.dump()
+
+
+def bluestore_summary() -> dict:
+    return _REG.bluestore.summary()
 
 
 def tenant_stats() -> TenantDeviceStats:

@@ -237,7 +237,8 @@ class OSDDaemon(Dispatcher):
         self.mon_addr = mon_addr
         self.mon_addrs = [a for a in mon_addr.split(",") if a]
         self.mgr_addr = mgr_addr
-        self.store = create_objectstore(store_type, store_path)
+        self.store = create_objectstore(store_type, store_path,
+                                        ctx=self.ctx)
         self.osdmap = OSDMap()
         from ceph_tpu.common.lockdep import make_lock
         self._lock = make_lock(f"OSD::osd_lock({osd_id})")
@@ -440,6 +441,8 @@ class OSDDaemon(Dispatcher):
                 lambda _n, v: self.opwq.set_idle_timeout(float(v)))
         #: the qos_db snapshot currently folded into the scheduler
         self._qos_profiles_applied: dict = {}
+        #: pool_id -> (mode, alg) last pushed to the objectstore
+        self._pool_comp_applied: dict = {}
         self.ctx.admin.register_command(
             "dump_qos_stats", lambda **kw: self._dump_qos_stats(),
             "per-tenant dmclock accounting: backlog, phase-served "
@@ -453,6 +456,13 @@ class OSDDaemon(Dispatcher):
             "batches by stripe share, batch/request/stripe counts, "
             "queue-wait histograms, and share-of-device gauges "
             "(untagged work lands in the _untagged bucket)")
+        self.ctx.admin.register_command(
+            "dump_bluestore_stats",
+            lambda **kw: telemetry.bluestore_dump(),
+            "device-resident objectstore accounting: bluestore_data "
+            "checksum batches vs scalar blocks, batched read "
+            "verification, block-compression outcomes, and the KV "
+            "journal truncation ledger")
 
         #: background-integrity accounting (dump_scrub_stats / the
         #: MMgrReport scrub tail / ceph_scrub_* prometheus families)
@@ -701,6 +711,9 @@ class OSDDaemon(Dispatcher):
                      "thread(s) still live past join timeout",
                      self.osd_id, ename)
         self.msgr.shutdown()
+        # store LAST: a bluestore commit during the drain window above
+        # runs its bluestore_data digest inline on a stopped engine
+        # (or scalar on failure), so umount never races a pending batch
         self.store.umount()
 
     # -- tick (OSD::tick analog: watchdog for stuck peering/recovery) ---------
@@ -1023,6 +1036,7 @@ class OSDDaemon(Dispatcher):
         dout("osd", 5, "osd.%d got map epoch %d", self.osd_id, newmap.epoch)
         self._apply_config_db(newmap)
         self._apply_qos_db(newmap)
+        self._apply_pool_compression(newmap)
         self._split_pgs(newmap)
         upd = None
         if self._map_shared:
@@ -1094,6 +1108,25 @@ class OSDDaemon(Dispatcher):
         self._qos_profiles_applied = dict(m.qos_db)
         dout("osd", 5, "osd.%d applied qos_db (%d tenants)",
              self.osd_id, len(profiles))
+
+    def _apply_pool_compression(self, m: OSDMap) -> None:
+        """Push the map's per-pool compression opts (`osd pool set <p>
+        compression_mode aggressive`) down to the objectstore; only
+        bluestore exposes the hook."""
+        setter = getattr(self.store, "set_pool_compression", None)
+        if setter is None:
+            return
+        for pool_id, pool in m.pools.items():
+            mode = getattr(pool, "compression_mode", "")
+            alg = getattr(pool, "compression_algorithm", "")
+            applied = self._pool_comp_applied.get(pool_id)
+            if applied != (mode, alg):
+                setter(pool_id, mode, alg)
+                self._pool_comp_applied[pool_id] = (mode, alg)
+        for pool_id in list(self._pool_comp_applied):
+            if pool_id not in m.pools:
+                setter(pool_id, "", "")
+                del self._pool_comp_applied[pool_id]
 
     def _pg_stats_summary(self) -> tuple[dict, int]:
         """(state -> count over primary PGs, degraded object count).

@@ -161,6 +161,9 @@ def _enc_pool(e2: Encoder, p: PGPool) -> None:
     e2.str(p.cache_mode)
     e2.u64(p.target_max_objects)
     e2.f64(p.cache_min_flush_age)
+    # v13: per-pool objectstore compression (pg_pool_t compression opts)
+    e2.str(p.compression_mode)
+    e2.str(p.compression_algorithm)
 
 
 def _dec_pool(d2: Decoder, version: int = 999) -> PGPool:
@@ -179,6 +182,9 @@ def _dec_pool(d2: Decoder, version: int = 999) -> PGPool:
         p.cache_mode = d2.str()
         p.target_max_objects = d2.u64()
         p.cache_min_flush_age = d2.f64()
+    if version >= 13:
+        p.compression_mode = d2.str()
+        p.compression_algorithm = d2.str()
     return p
 
 
@@ -246,7 +252,7 @@ def encode_osdmap(m: OSDMap, *, with_auth: bool = False) -> bytes:
         # the mgr slo module's burn-rate engine reads them off the map
         e.bytes(_json.dumps(m.slo_db).encode() if m.slo_db else b"")
 
-    enc.versioned(12, 1, body)
+    enc.versioned(13, 1, body)
     return enc.tobytes()
 
 

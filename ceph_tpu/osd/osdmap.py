@@ -79,6 +79,11 @@ class PGPool:
     cache_mode: str = ""       # "" | "writeback"
     target_max_objects: int = 0
     cache_min_flush_age: float = 0.0
+    # per-pool objectstore compression (pg_pool_t compression opts):
+    # OSDs push these to their bluestore backend on map apply; ""
+    # falls back to the bluestore_compression_* conf
+    compression_mode: str = ""        # "" | "none" | "aggressive" | "force"
+    compression_algorithm: str = ""   # "" | a compressor plugin name
 
     def __post_init__(self):
         if self.pgp_num == 0:

@@ -36,9 +36,11 @@ class DeviceChaos:
     background probes — the reconvergence half of the durability
     contract."""
 
-    #: the three kernel channels the chaos gate names (encode, decode,
-    #: fused placement ladder); crush channels ride the same machinery
-    CHANNELS = ("ec_encode", "ec_decode", "pg_finish")
+    #: the kernel channels the chaos gate names (encode, decode, fused
+    #: placement ladder, objectstore write-time digests); crush and
+    #: scrub channels ride the same machinery
+    CHANNELS = ("ec_encode", "ec_decode", "pg_finish",
+                "bluestore_data")
     BASE_RATE = 0.15
 
     def __init__(self, rng: random.Random):
@@ -542,8 +544,15 @@ def run_soak(duration: float = 25.0, seed: int = 7,
         # the storm's scrub duty cycle high
         osd_conf.setdefault("osd_scrub_chunk_timeout", 4.0)
         osd_conf.setdefault("osd_scrub_verify_timeout", 8.0)
+    # toy commits stage a handful of blocks each; drop the batch
+    # floors so the bluestore_data channel is live for every storm
+    osd_conf.setdefault("bluestore_batched_csum_min", 1)
+    osd_conf.setdefault("bluestore_batched_read_min", 1)
+    # bluestore-backed soak: kill_osd is a clean shutdown (the store
+    # unmounts), so the disk-backed store is safe here AND the
+    # bluestore_data channel sees real commit traffic all storm long
     c = MiniCluster(n_osds=n_osds, ms_type=ms_type,
-                    store_type="filestore", n_mons=n_mons,
+                    store_type="bluestore", n_mons=n_mons,
                     base_path=base_path, heartbeats=True,
                     osd_conf=osd_conf).start()
     try:
